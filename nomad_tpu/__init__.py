@@ -1,0 +1,29 @@
+"""nomad-tpu: a TPU-native workload-orchestration framework.
+
+A brand-new framework with the capabilities of HashiCorp Nomad (reference:
+/root/reference, v0.13.0-dev), re-designed TPU-first rather than ported.
+
+The defining feature is the scheduling backend: the reference's per-node
+ranking loop (`scheduler/stack.go:116 GenericStack.Select` -> feasibility
+checks -> BinPack/Spread/NodeAffinity iterators -> `nomad/structs/funcs.go:175
+ScoreFitBinPack`) becomes a single vectorized score matrix over
+(candidate-nodes x placements) computed under `jax.jit`, with feasibility as
+boolean masks, deterministic emulation of the reference's limited-walk
+selection, and top-k/argmax placement picks.  The node axis shards over a
+`jax.sharding.Mesh` for multi-chip scale.
+
+Layout (mirrors SURVEY.md section 7):
+  structs/   -- data model: Job/TaskGroup/Task/Node/Allocation/Eval/Plan,
+                resource math, network index
+  state/     -- in-memory MVCC state store + columnar NodeTable (the
+                TPU-resident "cluster tensor")
+  sched/     -- schedulers: reference-semantics oracle chain, the TPU stack,
+                reconciler, generic/batch/system schedulers, harness
+  ops/       -- JAX kernels: score matrix, constraint LUT compilation,
+                selection emulation
+  parallel/  -- device mesh + shardings (node axis / eval-batch axis)
+  server/    -- control plane: eval broker, blocked evals, plan queue,
+                plan applier, workers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Schedulers.
+
+`oracle_*` modules are a faithful host-side re-expression of the
+reference's pull-based iterator chain (scheduler/stack.go:116 Select) —
+they serve as (a) the differential-parity oracle for the TPU kernel and
+(b) the "stock" baseline the bench compares against.  `tpu_stack` is the
+vectorized JAX backend.  `generic_sched`/`system_sched` sit above either
+stack, mirroring scheduler/generic_sched.go and system_sched.go.
+"""
+from .scheduler import (  # noqa: F401
+    BUILTIN_SCHEDULERS,
+    new_scheduler,
+    register_scheduler,
+    SchedulerError,
+    SetStatusError,
+)

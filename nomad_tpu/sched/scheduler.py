@@ -1,0 +1,80 @@
+"""Scheduler registry and interfaces (reference scheduler/scheduler.go).
+
+`BUILTIN_SCHEDULERS` maps eval type -> factory (scheduler.go:23); the TPU
+backend is not a separate type here — both the generic and system
+schedulers take a ``use_tpu`` flag (driven by
+`SchedulerConfiguration.tpu_scheduler_enabled`) selecting between the
+oracle stack and the vectorized stack, mirroring how the reference selects
+binpack/spread via runtime scheduler config (stack.go:382).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Protocol, TYPE_CHECKING
+
+from ..structs import Evaluation, Plan, PlanResult
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..state.store import StateSnapshot
+
+SCHEDULER_VERSION = 1
+
+
+class SchedulerError(Exception):
+    pass
+
+
+class SetStatusError(SchedulerError):
+    """Raised when a scheduler fails and the eval should be marked failed
+    (reference scheduler.go SetStatusError)."""
+
+    def __init__(self, err: str, eval_status: str) -> None:
+        super().__init__(err)
+        self.eval_status = eval_status
+
+
+class Planner(Protocol):
+    """The scheduler's only write path
+    (reference scheduler/scheduler.go:112)."""
+
+    def submit_plan(self, plan: Plan) -> "tuple[PlanResult, StateSnapshot]":
+        ...
+
+    def update_eval(self, evaluation: Evaluation) -> None:
+        ...
+
+    def create_eval(self, evaluation: Evaluation) -> None:
+        ...
+
+    def reblock_eval(self, evaluation: Evaluation) -> None:
+        ...
+
+
+BUILTIN_SCHEDULERS: Dict[str, Callable] = {}
+
+
+def register_scheduler(name: str, factory: Callable) -> None:
+    BUILTIN_SCHEDULERS[name] = factory
+
+
+def new_scheduler(
+    name: str,
+    state: "StateSnapshot",
+    planner: Planner,
+    **kwargs,
+):
+    factory = BUILTIN_SCHEDULERS.get(name)
+    if factory is None:
+        raise SchedulerError(f"unknown scheduler {name!r}")
+    return factory(state, planner, **kwargs)
+
+
+def _register_builtins() -> None:
+    from .generic_sched import BatchScheduler, ServiceScheduler
+    from .system_sched import SystemScheduler
+
+    register_scheduler("service", ServiceScheduler)
+    register_scheduler("batch", BatchScheduler)
+    register_scheduler("system", SystemScheduler)
+
+
+_register_builtins()

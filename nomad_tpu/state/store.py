@@ -1,0 +1,511 @@
+"""In-memory indexed state store.
+
+Plays the role of the reference's go-memdb `StateStore`
+(`nomad/state/state_store.go`, schema `nomad/state/schema.go:59`): tables
+for nodes, jobs (+versions), allocs, evals, deployments, job summaries and
+scheduler config, each with a modify-index, plus `upsert_plan_results`
+(state_store.go:240), the single write path for scheduler plans.
+
+Concurrency model (a deliberate departure from go-memdb's MVCC): the
+control plane is a single-process event loop where plan application is
+serialized (as in the reference, `nomad/plan_apply.go:45-70`), so a
+"snapshot" is an O(1) fence — it records the current index and delegates
+reads to the live tables; no mutation can interleave with a scheduler pass.
+This keeps eval throughput free of O(cluster) snapshot copies, which
+matters when the scoring backend is fast enough that snapshotting would
+dominate.  `SnapshotAt` provides the same `snapshot_min_index` wait the
+reference workers use (state_store.go:127).
+
+The store also owns the columnar `NodeTable` mirror (the device-resident
+"cluster tensor") and keeps it incrementally in sync on node/alloc writes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..structs import (
+    Allocation,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_LOST,
+    ALLOC_DESIRED_STOP,
+    Deployment,
+    Evaluation,
+    Job,
+    JOB_STATUS_DEAD,
+    JOB_STATUS_PENDING,
+    JOB_STATUS_RUNNING,
+    JOB_TYPE_SYSTEM,
+    Node,
+    Plan,
+    PlanResult,
+    SchedulerConfiguration,
+    compute_node_class,
+)
+from .node_table import NodeTable
+
+
+class StateStore:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._index = 0
+        self._table_index: Dict[str, int] = defaultdict(int)
+
+        self.nodes: Dict[str, Node] = {}
+        self.jobs: Dict[Tuple[str, str], Job] = {}
+        self.job_versions: Dict[Tuple[str, str], List[Job]] = defaultdict(list)
+        self.allocs: Dict[str, Allocation] = {}
+        self.evals: Dict[str, Evaluation] = {}
+        self.deployments: Dict[str, Deployment] = {}
+        self.scheduler_config = SchedulerConfiguration()
+
+        # secondary indexes
+        self._allocs_by_node: Dict[str, set] = defaultdict(set)
+        self._allocs_by_job: Dict[Tuple[str, str], set] = defaultdict(set)
+        self._allocs_by_eval: Dict[str, set] = defaultdict(set)
+        self._evals_by_job: Dict[Tuple[str, str], set] = defaultdict(set)
+        self._deployments_by_job: Dict[Tuple[str, str], set] = defaultdict(set)
+
+        # columnar mirror of the node table + per-node live-usage columns
+        self.node_table = NodeTable()
+
+        # change notification for blocking queries
+        self._watch_cond = threading.Condition(self._lock)
+        self._watchers: List[Callable[[str, int], None]] = []
+
+    # ------------------------------------------------------------------
+    # index plumbing
+    # ------------------------------------------------------------------
+
+    def latest_index(self) -> int:
+        return self._index
+
+    def table_index(self, table: str) -> int:
+        return self._table_index[table]
+
+    def _bump(self, *tables: str) -> int:
+        self._index += 1
+        for t in tables:
+            self._table_index[t] = self._index
+        self._watch_cond.notify_all()
+        for cb in self._watchers:
+            for t in tables:
+                cb(t, self._index)
+        return self._index
+
+    def add_watcher(self, cb: Callable[[str, int], None]) -> None:
+        with self._lock:
+            self._watchers.append(cb)
+
+    def wait_for_index(self, index: int, timeout: float = 5.0) -> bool:
+        """Block until the store has advanced to at least ``index``
+        (reference state_store.go:127 SnapshotMinIndex)."""
+        deadline = time.monotonic() + timeout
+        with self._watch_cond:
+            while self._index < index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._watch_cond.wait(remaining)
+            return True
+
+    def snapshot(self) -> "StateSnapshot":
+        return StateSnapshot(self, self._index)
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0) -> "StateSnapshot":
+        if not self.wait_for_index(index, timeout):
+            raise TimeoutError(
+                f"timeout waiting for state at index {index} (at {self._index})"
+            )
+        return self.snapshot()
+
+    # ------------------------------------------------------------------
+    # nodes
+    # ------------------------------------------------------------------
+
+    def upsert_node(self, node: Node) -> int:
+        with self._lock:
+            if not node.computed_class:
+                node.computed_class = compute_node_class(node)
+            existing = self.nodes.get(node.id)
+            if existing is not None:
+                node.create_index = existing.create_index
+            else:
+                node.create_index = self._index + 1
+            node.modify_index = self._index + 1
+            self.nodes[node.id] = node
+            self.node_table.upsert_node(node)
+            return self._bump("nodes")
+
+    def delete_node(self, node_id: str) -> int:
+        with self._lock:
+            if node_id in self.nodes:
+                del self.nodes[node_id]
+                self.node_table.delete_node(node_id)
+            return self._bump("nodes")
+
+    def update_node_status(self, node_id: str, status: str) -> int:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise KeyError(node_id)
+            node.status = status
+            node.status_updated_at = time.time()
+            node.modify_index = self._index + 1
+            self.node_table.upsert_node(node)
+            return self._bump("nodes")
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise KeyError(node_id)
+            node.scheduling_eligibility = eligibility
+            node.modify_index = self._index + 1
+            self.node_table.upsert_node(node)
+            return self._bump("nodes")
+
+    def update_node_drain(
+        self, node_id: str, drain: bool, strategy=None
+    ) -> int:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise KeyError(node_id)
+            node.drain = drain
+            node.drain_strategy = strategy
+            from ..structs import NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE
+
+            node.scheduling_eligibility = (
+                NODE_SCHED_INELIGIBLE if drain else NODE_SCHED_ELIGIBLE
+            )
+            node.modify_index = self._index + 1
+            self.node_table.upsert_node(node)
+            return self._bump("nodes")
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self.nodes.get(node_id)
+
+    def iter_nodes(self) -> Iterable[Node]:
+        return list(self.nodes.values())
+
+    # ------------------------------------------------------------------
+    # jobs
+    # ------------------------------------------------------------------
+
+    def upsert_job(self, job: Job, keep_versions: int = 6) -> int:
+        with self._lock:
+            key = (job.namespace, job.id)
+            existing = self.jobs.get(key)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.version = existing.version + 1
+            else:
+                job.create_index = self._index + 1
+                job.version = 0
+            job.modify_index = self._index + 1
+            job.job_modify_index = self._index + 1
+            if job.status not in (JOB_STATUS_DEAD,):
+                job.status = JOB_STATUS_PENDING
+            self.jobs[key] = job
+            versions = self.job_versions[key]
+            versions.insert(0, job)
+            del versions[keep_versions:]
+            return self._bump("jobs")
+
+    def delete_job(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            key = (namespace, job_id)
+            self.jobs.pop(key, None)
+            self.job_versions.pop(key, None)
+            return self._bump("jobs")
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self.jobs.get((namespace, job_id))
+
+    def job_by_version(
+        self, namespace: str, job_id: str, version: int
+    ) -> Optional[Job]:
+        for j in self.job_versions.get((namespace, job_id), []):
+            if j.version == version:
+                return j
+        return None
+
+    def iter_jobs(self) -> Iterable[Job]:
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # evals
+    # ------------------------------------------------------------------
+
+    def upsert_evals(self, evals: List[Evaluation]) -> int:
+        with self._lock:
+            for ev in evals:
+                existing = self.evals.get(ev.id)
+                if existing is not None:
+                    ev.create_index = existing.create_index
+                else:
+                    ev.create_index = self._index + 1
+                ev.modify_index = self._index + 1
+                self.evals[ev.id] = ev
+                self._evals_by_job[(ev.namespace, ev.job_id)].add(ev.id)
+            return self._bump("evals")
+
+    def delete_eval(self, eval_id: str) -> None:
+        with self._lock:
+            ev = self.evals.pop(eval_id, None)
+            if ev is not None:
+                self._evals_by_job[(ev.namespace, ev.job_id)].discard(eval_id)
+            self._bump("evals")
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self.evals.get(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return [
+            self.evals[eid]
+            for eid in self._evals_by_job.get((namespace, job_id), ())
+            if eid in self.evals
+        ]
+
+    # ------------------------------------------------------------------
+    # allocs
+    # ------------------------------------------------------------------
+
+    def upsert_allocs(self, allocs: List[Allocation]) -> int:
+        with self._lock:
+            self._upsert_allocs_locked(allocs)
+            return self._bump("allocs")
+
+    def _upsert_allocs_locked(self, allocs: List[Allocation]) -> None:
+        for alloc in allocs:
+            existing = self.allocs.get(alloc.id)
+            if existing is not None:
+                alloc.create_index = existing.create_index
+                # preserve the job from the existing alloc if absent
+                if alloc.job is None:
+                    alloc.job = existing.job
+                was_live = not existing.terminal_status()
+            else:
+                alloc.create_index = self._index + 1
+                was_live = False
+            alloc.modify_index = self._index + 1
+            self.allocs[alloc.id] = alloc
+            self._allocs_by_node[alloc.node_id].add(alloc.id)
+            self._allocs_by_job[(alloc.namespace, alloc.job_id)].add(alloc.id)
+            if alloc.eval_id:
+                self._allocs_by_eval[alloc.eval_id].add(alloc.id)
+            is_live = not alloc.terminal_status()
+            if was_live != is_live or existing is None:
+                self.node_table.update_node_usage(
+                    alloc.node_id, self._live_usage_for_node(alloc.node_id)
+                )
+
+    def _live_usage_for_node(self, node_id: str):
+        cpu = mem = disk = 0
+        for aid in self._allocs_by_node.get(node_id, ()):
+            a = self.allocs[aid]
+            if a.terminal_status():
+                continue
+            c = a.comparable_resources()
+            cpu += c.cpu
+            mem += c.memory_mb
+            disk += c.disk_mb
+        return cpu, mem, disk
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self.allocs.get(alloc_id)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return [
+            self.allocs[aid]
+            for aid in self._allocs_by_node.get(node_id, ())
+            if aid in self.allocs
+        ]
+
+    def allocs_by_node_terminal(
+        self, node_id: str, terminal: bool
+    ) -> List[Allocation]:
+        return [
+            a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal
+        ]
+
+    def allocs_by_job(
+        self, namespace: str, job_id: str, all_versions: bool = True
+    ) -> List[Allocation]:
+        return [
+            self.allocs[aid]
+            for aid in self._allocs_by_job.get((namespace, job_id), ())
+            if aid in self.allocs
+        ]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        return [
+            self.allocs[aid]
+            for aid in self._allocs_by_eval.get(eval_id, ())
+            if aid in self.allocs
+        ]
+
+    # ------------------------------------------------------------------
+    # deployments
+    # ------------------------------------------------------------------
+
+    def upsert_deployment(self, deployment: Deployment) -> int:
+        with self._lock:
+            existing = self.deployments.get(deployment.id)
+            if existing is not None:
+                deployment.create_index = existing.create_index
+            else:
+                deployment.create_index = self._index + 1
+            deployment.modify_index = self._index + 1
+            self.deployments[deployment.id] = deployment
+            self._deployments_by_job[
+                (deployment.namespace, deployment.job_id)
+            ].add(deployment.id)
+            return self._bump("deployments")
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self.deployments.get(deployment_id)
+
+    def deployments_by_job(
+        self, namespace: str, job_id: str
+    ) -> List[Deployment]:
+        return [
+            self.deployments[did]
+            for did in self._deployments_by_job.get((namespace, job_id), ())
+            if did in self.deployments
+        ]
+
+    def latest_deployment_by_job(
+        self, namespace: str, job_id: str
+    ) -> Optional[Deployment]:
+        deployments = self.deployments_by_job(namespace, job_id)
+        if not deployments:
+            return None
+        return max(deployments, key=lambda d: d.create_index)
+
+    # ------------------------------------------------------------------
+    # scheduler config
+    # ------------------------------------------------------------------
+
+    def get_scheduler_config(self) -> SchedulerConfiguration:
+        return self.scheduler_config
+
+    def set_scheduler_config(self, config: SchedulerConfiguration) -> int:
+        with self._lock:
+            self.scheduler_config = config
+            return self._bump("scheduler_config")
+
+    # ------------------------------------------------------------------
+    # plan results -- the one write path for the scheduler
+    # (reference state_store.go:240 UpsertPlanResults)
+    # ------------------------------------------------------------------
+
+    def upsert_plan_results(
+        self, result: PlanResult, eval_id: str = ""
+    ) -> int:
+        with self._lock:
+            updates: List[Allocation] = []
+            for allocs in result.node_update.values():
+                updates.extend(allocs)
+            for allocs in result.node_preemptions.values():
+                updates.extend(allocs)
+            for allocs in result.node_allocation.values():
+                updates.extend(allocs)
+            self._upsert_allocs_locked(updates)
+            if result.deployment is not None:
+                d = result.deployment
+                existing = self.deployments.get(d.id)
+                if existing is None:
+                    d.create_index = self._index + 1
+                d.modify_index = self._index + 1
+                self.deployments[d.id] = d
+                self._deployments_by_job[(d.namespace, d.job_id)].add(d.id)
+            for upd in result.deployment_updates:
+                d = self.deployments.get(upd.deployment_id)
+                if d is not None:
+                    d.status = upd.status
+                    d.status_description = upd.status_description
+                    d.modify_index = self._index + 1
+            return self._bump("allocs", "deployments")
+
+    # ------------------------------------------------------------------
+    # job status derivation (reference state_store.go setJobStatus)
+    # ------------------------------------------------------------------
+
+    def derive_job_status(self, namespace: str, job_id: str) -> str:
+        job = self.job_by_id(namespace, job_id)
+        if job is None:
+            return JOB_STATUS_DEAD
+        allocs = self.allocs_by_job(namespace, job_id)
+        evals = self.evals_by_job(namespace, job_id)
+        if any(not a.terminal_status() for a in allocs):
+            return JOB_STATUS_RUNNING
+        if any(not e.terminal_status() for e in evals):
+            return JOB_STATUS_PENDING
+        if job.type == JOB_TYPE_SYSTEM or job.is_periodic() or job.is_parameterized():
+            return JOB_STATUS_RUNNING if not job.stop else JOB_STATUS_DEAD
+        if allocs or evals:
+            return JOB_STATUS_DEAD
+        return JOB_STATUS_PENDING
+
+
+class StateSnapshot:
+    """A read view fenced at an index.
+
+    Mutation is serialized behind the plan applier in this control plane, so
+    the snapshot can delegate to the live store; it exists to carry the
+    snapshot index (for plan verification ordering) and to present the small
+    `State` read surface the schedulers consume
+    (reference scheduler/scheduler.go:65-109).
+    """
+
+    def __init__(self, store: StateStore, index: int) -> None:
+        self._store = store
+        self.index = index
+
+    # the scheduler-facing read surface
+    def nodes(self) -> List[Node]:
+        return list(self._store.iter_nodes())
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._store.node_by_id(node_id)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._store.job_by_id(namespace, job_id)
+
+    def job_by_version(self, namespace: str, job_id: str, version: int):
+        return self._store.job_by_version(namespace, job_id, version)
+
+    def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
+        return self._store.allocs_by_job(namespace, job_id)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return self._store.allocs_by_node(node_id)
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool):
+        return self._store.allocs_by_node_terminal(node_id, terminal)
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._store.alloc_by_id(alloc_id)
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._store.eval_by_id(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return self._store.evals_by_job(namespace, job_id)
+
+    def deployments_by_job(self, namespace: str, job_id: str):
+        return self._store.deployments_by_job(namespace, job_id)
+
+    def latest_deployment_by_job(self, namespace: str, job_id: str):
+        return self._store.latest_deployment_by_job(namespace, job_id)
+
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return self._store.get_scheduler_config()
+
+    @property
+    def node_table(self) -> NodeTable:
+        return self._store.node_table
