@@ -1,0 +1,12 @@
+"""JAX kernels: the vectorized scoring backend.
+
+`constraints.py` compiles constraints/affinities/spreads into boolean or
+float lookup tables over interned column vocabularies (exact reference
+operator semantics evaluated host-side over the tiny vocab; the device
+does only `lut[codes]` gathers).  `score.py` is the jitted score kernel +
+deterministic limited-walk selection that reproduces the reference's
+GenericStack.Select bit-for-bit.  `batch.py` scans/vmaps the kernel over
+picks and evals for throughput.
+"""
+from .score import score_and_select, ScoreInputs  # noqa: F401
+from .constraints import MaskCompiler  # noqa: F401

@@ -1,0 +1,212 @@
+"""The vectorized score kernel + deterministic selection.
+
+This is the TPU-native replacement for the reference's innermost loop
+(SURVEY.md section 3.2): one jitted function scores *all* candidate nodes
+at once — fit masks, BestFit-v3 bin-packing (funcs.go:175), job
+anti-affinity (rank.go:527), rescheduling penalty (rank.go:573), node
+affinity (rank.go:658), spread boosts (spread.go:163), mean normalization
+(rank.go:706) — and then *exactly emulates* the reference's shuffled
+limited walk (select.go: LimitIterator with skip-threshold 0 / max-skip 3,
+MaxScoreIterator's first-wins strict max) over the score vector, so the
+selected node is bit-identical to what the pull-based iterator chain
+would have chosen while doing O(N) vector math instead of O(limit) pointer
+chasing.
+
+Score-append semantics are reproduced as a (sum, count) pair: each term
+contributes to the sum and increments the count only under the reference's
+append conditions; the final score is sum/count.
+
+Shapes are fixed to the node arena capacity so jit traces cache across
+cluster churn; vacant rows are masked.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_SKIP = 3  # (reference stack.go:17)
+SKIP_THRESHOLD = 0.0  # (reference stack.go:13)
+NO_NODE = -1
+
+
+class ScoreInputs(NamedTuple):
+    """Arena-shaped kernel inputs.  All float arrays share one dtype
+    (f64 for bit-parity tests on CPU, f32 on TPU).  `perm` is the rotated
+    visit order for this select; `n_candidates` the number of real
+    candidates at its front."""
+
+    cpu_total: jnp.ndarray  # [C] node capacity minus node-reserved
+    mem_total: jnp.ndarray  # [C]
+    disk_total: jnp.ndarray  # [C]
+    cpu_used: jnp.ndarray  # [C] proposed usage (state + plan deltas)
+    mem_used: jnp.ndarray  # [C]
+    disk_used: jnp.ndarray  # [C]
+    feasible: jnp.ndarray  # bool[C] all static+dynamic feasibility masks
+    collisions: jnp.ndarray  # i32[C] proposed allocs of same job+tg
+    penalty: jnp.ndarray  # bool[C] rescheduling penalty nodes
+    affinity_score: jnp.ndarray  # f[C] normalized affinity score
+    spread_boost: jnp.ndarray  # f[C] total spread boost
+    perm: jnp.ndarray  # i32[C] walk order: perm[i] = row at position i
+    ask_cpu: jnp.ndarray  # f scalar
+    ask_mem: jnp.ndarray  # f scalar
+    ask_disk: jnp.ndarray  # f scalar
+    desired_count: jnp.ndarray  # i32 scalar (tg.count)
+    limit: jnp.ndarray  # i32 scalar (visit limit; INT32_MAX = unlimited)
+    n_candidates: jnp.ndarray  # i32 scalar
+
+
+def _score_vectors(inp: ScoreInputs, spread_fit: bool):
+    """Returns (feasible_after_fit bool[C], final_scores f[C])."""
+    dtype = inp.cpu_total.dtype
+    cpu_after = inp.cpu_used + inp.ask_cpu
+    mem_after = inp.mem_used + inp.ask_mem
+    disk_after = inp.disk_used + inp.ask_disk
+
+    fit = (
+        (cpu_after <= inp.cpu_total)
+        & (mem_after <= inp.mem_total)
+        & (disk_after <= inp.disk_total)
+    )
+    feasible = inp.feasible & fit
+
+    safe_cpu_total = jnp.where(inp.cpu_total > 0, inp.cpu_total, 1.0)
+    safe_mem_total = jnp.where(inp.mem_total > 0, inp.mem_total, 1.0)
+    free_cpu = 1.0 - cpu_after / safe_cpu_total
+    free_mem = 1.0 - mem_after / safe_mem_total
+    base = jnp.power(
+        jnp.asarray(10.0, dtype), free_cpu
+    ) + jnp.power(jnp.asarray(10.0, dtype), free_mem)
+    if spread_fit:
+        fitness = jnp.clip(base - 2.0, 0.0, 18.0)
+    else:
+        fitness = jnp.clip(20.0 - base, 0.0, 18.0)
+    binpack = fitness / 18.0
+
+    score_sum = binpack
+    count = jnp.ones_like(binpack)
+
+    has_collision = inp.collisions > 0
+    anti = jnp.where(
+        has_collision,
+        -(inp.collisions.astype(dtype) + 1.0)
+        / inp.desired_count.astype(dtype),
+        0.0,
+    )
+    score_sum = score_sum + anti
+    count = count + has_collision.astype(dtype)
+
+    score_sum = score_sum - inp.penalty.astype(dtype)
+    count = count + inp.penalty.astype(dtype)
+
+    has_aff = inp.affinity_score != 0.0
+    score_sum = score_sum + jnp.where(has_aff, inp.affinity_score, 0.0)
+    count = count + has_aff.astype(dtype)
+
+    has_spread = inp.spread_boost != 0.0
+    score_sum = score_sum + jnp.where(has_spread, inp.spread_boost, 0.0)
+    count = count + has_spread.astype(dtype)
+
+    final = score_sum / count
+    return feasible, final
+
+
+def _limited_walk_argmax(
+    feasible: jnp.ndarray,
+    scores: jnp.ndarray,
+    perm: jnp.ndarray,
+    limit: jnp.ndarray,
+    n_candidates: jnp.ndarray,
+):
+    """Emulate LimitIterator + MaxScoreIterator over all nodes at once.
+
+    `perm` is the *rotated* visit order for this select: the reference's
+    StaticIterator keeps its offset across Reset (feasible.go:75-113), so
+    consecutive selects continue round-robin through the shuffled list;
+    the caller rotates the permutation by the accumulated pull count and
+    advances it by the returned `pulls`.
+
+    The walk visits feasible nodes in order.  The first up-to-3 nodes
+    scoring <= threshold are diverted to a side list that is replayed
+    only if the source runs dry before `limit` nodes were emitted
+    (select.go:35-75).  Replay normally preserves diversion order; with
+    exactly two diverted nodes the reference's re-skip quirk replays them
+    in reverse (the first diverted node is re-appended before being
+    returned), which we reproduce.  The winner is the strict maximum over
+    emitted nodes, earliest emitted wins ties (select.go:94-113).
+
+    Pull accounting: if at least `limit` nodes are emitted from the
+    source, the walk stops at the limit-th one and the pull count is its
+    1-based position; otherwise the whole candidate list is consumed.
+    Infeasible nodes consume pulls (they are filtered mid-chain), which
+    is exactly how the reference's rotation advances.
+    """
+    s = scores[perm]
+    f = feasible[perm]
+
+    bad = f & (s <= SKIP_THRESHOLD)
+    bad_rank = jnp.cumsum(bad.astype(jnp.int32))
+    diverted = bad & (bad_rank <= MAX_SKIP)
+    nd = f & ~diverted
+    nd_cum = jnp.cumsum(nd.astype(jnp.int32))
+    nd_count = nd_cum[-1]
+    nd_rank = nd_cum - 1
+    n_div = jnp.sum(diverted.astype(jnp.int32))
+    div_rank = jnp.cumsum(diverted.astype(jnp.int32)) - 1
+    # two-diverted replay reversal (see docstring)
+    div_order = jnp.where(n_div == 2, 1 - div_rank, div_rank)
+    emit_order = jnp.where(nd, nd_rank, nd_count + div_order)
+    emitted = f & (emit_order < limit)
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype=s.dtype)
+    masked = jnp.where(emitted, s, neg_inf)
+    best = jnp.max(masked)
+    candidates = emitted & (masked == best)
+    order_key = jnp.where(
+        candidates, emit_order, jnp.asarray(2**31 - 1, jnp.int32)
+    )
+    win_pos = jnp.argmin(order_key)
+    chosen_row = perm[win_pos]
+    any_emitted = jnp.any(emitted)
+    chosen_row = jnp.where(any_emitted, chosen_row, NO_NODE)
+
+    limit_reached = nd_count >= limit
+    lth_pos = jnp.argmax(nd_cum >= limit)
+    pulls = jnp.where(limit_reached, lth_pos + 1, n_candidates)
+    return chosen_row, best, jnp.sum(f.astype(jnp.int32)), pulls
+
+
+@functools.partial(jax.jit, static_argnames=("spread_fit",))
+def score_and_select(inp: ScoreInputs, spread_fit: bool = False):
+    """Returns (chosen_row, chosen_score, feasible_count, pulls).
+    chosen_row == -1 when no feasible node was emitted."""
+    feasible, final = _score_vectors(inp, spread_fit)
+    chosen_row, best, feasible_count, pulls = _limited_walk_argmax(
+        feasible, final, inp.perm, inp.limit, inp.n_candidates
+    )
+    return chosen_row, best, feasible_count, pulls
+
+
+@functools.partial(jax.jit, static_argnames=("spread_fit",))
+def score_all(inp: ScoreInputs, spread_fit: bool = False):
+    """Scores + feasibility only (system stack / diagnostics)."""
+    feasible, final = _score_vectors(inp, spread_fit)
+    return feasible, final
+
+
+def make_perm(rng, rows, capacity: int) -> np.ndarray:
+    """Walk order matching the oracle's seeded Fisher-Yates shuffle
+    (sched/feasible.py shuffle_nodes) applied to the same candidate list:
+    perm[i] = arena row visited at walk position i.  Arena rows not in the
+    candidate list are appended at the end; they are masked infeasible and
+    can never win, but keep the perm a full permutation of the arena."""
+    rows = list(rows)
+    for i in range(len(rows) - 1, 0, -1):
+        j = rng.randint(0, i)
+        rows[i], rows[j] = rows[j], rows[i]
+    present = set(rows)
+    rows.extend(r for r in range(capacity) if r not in present)
+    return np.asarray(rows, dtype=np.int32)

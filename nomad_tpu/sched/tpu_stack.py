@@ -1,0 +1,661 @@
+"""TPU placement stacks: the vectorized backend behind the same `Stack`
+surface as the oracle chain (reference scheduler/stack.go).
+
+Division of labor per SURVEY.md section 7:
+
+* **Device (jit kernel, ops/score.py)** — fit masks + all scoring terms
+  over every candidate node at once, plus the exact emulation of the
+  reference's shuffled limited-walk selection.
+* **Host, once per (job, task group)** — constraint compilation to LUT
+  masks (ops/constraints.py), affinity weight vectors, spread desired
+  counts: tiny vocab-sized work.
+* **Host, once per placement** — plan-delta vectors (proposed usage,
+  anti-affinity collisions, distinct_hosts), spread use counts, and exact
+  port/device assignment for the single *winning* node via the oracle
+  BinPackIterator (rank.py) — mirroring how the reference does the
+  combinatorial port/device assignment inside binpack only for nodes it
+  actually visits.  If the winner fails exact verification (e.g. a port
+  collision the count-based mask could not see), the node is masked and
+  the kernel re-runs: the recheck loop the reference performs in the plan
+  applier (plan_apply.go:629), pulled forward.
+
+Preemption mode (`options.preempt`) delegates to a shadow oracle stack
+sharing this eval's context and the *same* shuffled visit order — greedy
+preemption picking is inherently sequential (preemption.go:218) and rare,
+so it stays host-side, bit-identical by construction.
+
+Known divergence from the oracle (documented, intentional): when a
+computed class is memoized eligible but a transient availability check
+(CSI plugin health) fails, the reference aborts the whole walk
+(feasible.go:1080 returns nil); the mask path simply excludes the node
+and keeps looking, which can place where the reference would block.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..ops.constraints import MaskCompiler
+from ..ops.score import NO_NODE, ScoreInputs, make_perm, score_and_select
+from ..structs import (
+    CONSTRAINT_DISTINCT_HOSTS,
+    CONSTRAINT_DISTINCT_PROPERTY,
+    Job,
+    Node,
+    TaskGroup,
+)
+from .context import EvalContext
+from .propertyset import PropertySet
+from .rank import BinPackIterator, RankedNode, StaticRankIterator
+from .stack import (
+    GenericStack,
+    SelectOptions,
+    SystemStack,
+    compute_visit_limit,
+    task_group_constraints,
+)
+
+INT32_MAX = 2**31 - 1
+
+
+class _SingleNodeSource:
+    """Feeds exactly one RankedNode into a BinPackIterator."""
+
+    def __init__(self, ranked: RankedNode) -> None:
+        self.ranked = ranked
+        self.done = False
+
+    def next(self) -> Optional[RankedNode]:
+        if self.done:
+            return None
+        self.done = True
+        return self.ranked
+
+    def reset(self) -> None:
+        self.done = False
+
+
+class TPUGenericStack:
+    def __init__(
+        self, batch: bool, ctx: EvalContext, seed: Optional[int] = None
+    ) -> None:
+        self.batch = batch
+        self.ctx = ctx
+        self.table = ctx.state.node_table
+        self.compiler = MaskCompiler(self.table)
+        self.job: Optional[Job] = None
+        self.nodes: List[Node] = []
+        self.shuffled_nodes: List[Node] = []
+        self.candidate_rows: np.ndarray = np.zeros(0, dtype=np.int32)
+        self.perm: np.ndarray = np.zeros(0, dtype=np.int32)
+        self.limit = 2
+        self._static_mask_cache: Dict[Tuple, np.ndarray] = {}
+        self._affinity_cache: Dict[Tuple, Tuple[np.ndarray, float]] = {}
+        self._spread_psets: Dict[str, List[PropertySet]] = {}
+        self._spread_info: Dict[str, Dict] = {}
+        self._sum_spread_weights = 0
+        self._shadow: Optional[GenericStack] = None
+        self._extra_excluded_rows: Set[int] = set()
+        # rotating pull offset: the reference StaticIterator keeps its
+        # position across selects (feasible.go:75) so consecutive
+        # placements continue round-robin through the shuffled list
+        self._offset = 0
+
+    # ------------------------------------------------------------------
+
+    def set_nodes(self, base_nodes: List[Node]) -> None:
+        nodes = list(base_nodes)
+        from .feasible import shuffle_nodes
+
+        shuffle_nodes(self.ctx.rng, nodes)
+        self.nodes = base_nodes
+        self.shuffled_nodes = nodes
+        rows = [
+            self.table.row_of[n.id]
+            for n in nodes
+            if n.id in self.table.row_of
+        ]
+        self.candidate_rows = np.asarray(rows, dtype=np.int32)
+        # perm must be a full arena permutation: candidates first, in the
+        # shuffled visit order
+        present = set(rows)
+        perm = rows + [
+            r for r in range(self.table.capacity) if r not in present
+        ]
+        self.perm = np.asarray(perm, dtype=np.int32)
+        self.limit = compute_visit_limit(len(nodes), self.batch)
+        self._offset = 0
+        if self._shadow is not None:
+            self._shadow.source.set_nodes(self.shuffled_nodes)
+            self._shadow.limit.set_limit(self.limit)
+
+    def set_job(self, job: Job) -> None:
+        if self.job is not None and self.job.version == job.version:
+            return
+        self.job = job
+        self.ctx.eligibility.set_job(job)
+        self._static_mask_cache.clear()
+        self._affinity_cache.clear()
+        self._spread_psets.clear()
+        self._spread_info.clear()
+        self._sum_spread_weights = 0
+        if self._shadow is not None:
+            self._shadow.set_job(job)
+
+    # ------------------------------------------------------------------
+
+    def select(
+        self, tg: TaskGroup, options: Optional[SelectOptions] = None
+    ) -> Optional[RankedNode]:
+        if options is not None and options.preempt:
+            return self._shadow_select(tg, options)
+
+        if options is not None and options.preferred_nodes:
+            original_rows = self.candidate_rows
+            original_perm = self.perm
+            preferred_rows = [
+                self.table.row_of[n.id]
+                for n in options.preferred_nodes
+                if n.id in self.table.row_of
+            ]
+            self.candidate_rows = np.asarray(
+                preferred_rows, dtype=np.int32
+            )
+            present = set(preferred_rows)
+            self.perm = np.asarray(
+                preferred_rows
+                + [
+                    r
+                    for r in range(self.table.capacity)
+                    if r not in present
+                ],
+                dtype=np.int32,
+            )
+            options_new = SelectOptions(
+                penalty_node_ids=options.penalty_node_ids,
+                preferred_nodes=[],
+                preempt=options.preempt,
+            )
+            saved_offset = self._offset
+            self._offset = 0
+            option = self.select(tg, options_new)
+            # the reference resets the source offset when restoring the
+            # original node set (stack.go:119-133 SetNodes)
+            self.candidate_rows = original_rows
+            self.perm = original_perm
+            self._offset = 0
+            if option is not None:
+                return option
+            return self.select(tg, options_new)
+
+        self.ctx.reset()
+        self._extra_excluded_rows = set()
+        return self._select_vectorized(tg, options)
+
+    # ------------------------------------------------------------------
+
+    def _shadow_select(self, tg, options):
+        """Preemption path: oracle chain over the identical visit order."""
+        if self._shadow is None:
+            self._shadow = GenericStack(self.batch, self.ctx)
+            if self.job is not None:
+                # force through the version fast-path check
+                self._shadow.job_version = None
+                self._shadow.set_job(self.job)
+            self._shadow.source.set_nodes(self.shuffled_nodes)
+            self._shadow.limit.set_limit(self.limit)
+        # shadow select must not re-shuffle: bypass its set_nodes, and
+        # keep the rotating offset in sync with the vectorized walk
+        self._shadow.source.nodes = self.shuffled_nodes
+        self._shadow.source.offset = self._offset
+        self._shadow.source.seen = 0
+        self._shadow.limit.set_limit(self.limit)
+        option = self._shadow.select(tg, options)
+        n = len(self.shuffled_nodes)
+        if n:
+            self._offset = self._shadow.source.offset % n
+        return option
+
+    # ------------------------------------------------------------------
+
+    def _select_vectorized(
+        self, tg: TaskGroup, options: Optional[SelectOptions]
+    ) -> Optional[RankedNode]:
+        C = self.table.capacity
+        dtype = np.float64
+
+        static_mask = self._static_feasibility(tg)
+
+        candidate_mask = np.zeros(C, dtype=bool)
+        candidate_mask[self.candidate_rows] = True
+
+        d_cpu, d_mem, d_disk, collisions, job_rows, job_tg_rows = (
+            self._plan_adjusted_state(tg)
+        )
+
+        mask = candidate_mask & static_mask & self.table.active
+        if self._extra_excluded_rows:
+            mask[list(self._extra_excluded_rows)] = False
+
+        # distinct_hosts (feasible.go:470)
+        job_distinct = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in self.job.constraints
+        )
+        tg_distinct = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints
+        )
+        if job_distinct:
+            mask[list(job_rows)] = False
+        elif tg_distinct:
+            mask[list(job_tg_rows)] = False
+
+        # distinct_property (feasible.go:569)
+        mask &= self._distinct_property_mask(tg)
+
+        penalty = np.zeros(C, dtype=bool)
+        if options is not None and options.penalty_node_ids:
+            for node_id in options.penalty_node_ids:
+                row = self.table.row_of.get(node_id)
+                if row is not None:
+                    penalty[row] = True
+
+        affinity_vec = self._affinity_vector(tg)
+        spread_vec, has_spreads = self._spread_vector(tg)
+
+        has_affinities = bool(
+            list(self.job.affinities)
+            or list(tg.affinities)
+            or any(t.affinities for t in tg.tasks)
+        )
+        limit = (
+            INT32_MAX
+            if (has_affinities or has_spreads)
+            else self.limit
+        )
+
+        ask_cpu = float(sum(t.resources.cpu for t in tg.tasks))
+        ask_mem = float(sum(t.resources.memory_mb for t in tg.tasks))
+        ask_disk = float(tg.ephemeral_disk.size_mb)
+
+        # rotate the candidate portion of the perm by the accumulated
+        # pull offset (StaticIterator round-robin continuation)
+        n_cand = len(self.candidate_rows)
+        cand = self.perm[:n_cand]
+        rest = self.perm[n_cand:]
+        off = self._offset % n_cand if n_cand else 0
+        rotated = np.concatenate(
+            [cand[off:], cand[:off], rest]
+        ).astype(np.int32)
+
+        inputs = ScoreInputs(
+            cpu_total=self.table.cpu_total,
+            mem_total=self.table.mem_total,
+            disk_total=self.table.disk_total,
+            cpu_used=self.table.cpu_used + d_cpu,
+            mem_used=self.table.mem_used + d_mem,
+            disk_used=self.table.disk_used + d_disk,
+            feasible=mask,
+            collisions=collisions,
+            penalty=penalty,
+            affinity_score=affinity_vec,
+            spread_boost=spread_vec,
+            perm=rotated,
+            ask_cpu=np.asarray(ask_cpu, dtype),
+            ask_mem=np.asarray(ask_mem, dtype),
+            ask_disk=np.asarray(ask_disk, dtype),
+            desired_count=np.asarray(tg.count, np.int32),
+            limit=np.asarray(limit, np.int32),
+            n_candidates=np.asarray(n_cand, np.int32),
+        )
+        spread_fit = (
+            self.ctx.state.scheduler_config().effective_scheduler_algorithm()
+            == "spread"
+        )
+
+        while True:
+            chosen_row, _score, _n, pulls = score_and_select(
+                inputs, spread_fit=spread_fit
+            )
+            chosen_row = int(chosen_row)
+            if chosen_row == NO_NODE:
+                if n_cand:
+                    self._offset = (self._offset + int(pulls)) % n_cand
+                self._populate_class_eligibility(tg, static_mask)
+                return None
+            node_id = self.table.node_ids[chosen_row]
+            option = self._verify_winner(node_id, tg)
+            if option is not None:
+                if n_cand:
+                    self._offset = (self._offset + int(pulls)) % n_cand
+                return option
+            # count-mask admitted a node exact assignment rejects
+            # (e.g. specific port collision): exclude and re-run; the
+            # rejected node becomes an infeasible pull, exactly as if
+            # binpack had exhausted it mid-walk
+            self._extra_excluded_rows.add(chosen_row)
+            new_mask = inputs.feasible.copy()
+            new_mask[chosen_row] = False
+            inputs = inputs._replace(feasible=new_mask)
+
+    # ------------------------------------------------------------------
+
+    def _verify_winner(
+        self, node_id: str, tg: TaskGroup
+    ) -> Optional[RankedNode]:
+        """Exact port/device assignment + fit for the winning node via the
+        oracle binpack step (rank.py BinPackIterator)."""
+        node = self.ctx.state.node_by_id(node_id)
+        if node is None:
+            return None
+        ranked = RankedNode(node=node)
+        source = _SingleNodeSource(ranked)
+        algorithm = (
+            self.ctx.state.scheduler_config().effective_scheduler_algorithm()
+        )
+        binpack = BinPackIterator(
+            self.ctx, source, False, self.job.priority, algorithm
+        )
+        binpack.set_job(self.job)
+        binpack.set_task_group(tg)
+        return binpack.next()
+
+    # ------------------------------------------------------------------
+
+    def _static_feasibility(self, tg: TaskGroup) -> np.ndarray:
+        key = (self.job.id, self.job.version, tg.name, self.table.generation)
+        cached = self._static_mask_cache.get(key)
+        if cached is not None:
+            return cached
+        C = self.table.capacity
+        mask = self.table.eligible.copy()
+
+        for constraint in self.job.constraints:
+            m = self.compiler.constraint_mask(constraint)
+            if m is not None:
+                mask &= m
+
+        constraints, drivers = task_group_constraints(tg)
+        for constraint in constraints:
+            m = self.compiler.constraint_mask(constraint)
+            if m is not None:
+                mask &= m
+        for driver in drivers:
+            col = self.table.column(f"driver.{driver}")
+            mask &= col.codes != -1
+        for name, req in tg.volumes.items():
+            if req.type == "host":
+                col = self.table.column(f"hostvol.{req.source}")
+                if req.read_only:
+                    mask &= col.codes != -1
+                else:
+                    rw_code = col.interner.lookup("rw")
+                    mask &= col.codes == rw_code
+            elif req.type == "csi":
+                col = self.table.column(f"csi.{req.source}")
+                mask &= col.codes != -1
+        if tg.networks:
+            mode = tg.networks[0].mode or "host"
+            if mode != "host":
+                col = self.table.column(f"netmode.{mode}")
+                mask &= col.codes != -1
+
+        device_reqs = [
+            req for task in tg.tasks for req in task.resources.devices
+        ]
+        dev_mask = self.compiler.device_feasibility(device_reqs)
+        if dev_mask is not None:
+            mask &= dev_mask
+
+        self._static_mask_cache[key] = mask
+        return mask
+
+    # ------------------------------------------------------------------
+
+    def _plan_adjusted_state(self, tg: TaskGroup):
+        """Proposed-alloc deltas relative to the store's live usage
+        columns, plus job/job+tg proposed rows and collision counts
+        (mirrors context.go:120 ProposedAllocs applied columnarly)."""
+        C = self.table.capacity
+        d_cpu = np.zeros(C, dtype=np.float64)
+        d_mem = np.zeros(C, dtype=np.float64)
+        d_disk = np.zeros(C, dtype=np.float64)
+        collisions = np.zeros(C, dtype=np.int32)
+        job_rows: Set[int] = set()
+        job_tg_rows: Set[int] = set()
+
+        plan = self.ctx.plan
+        state = self.ctx.state
+        removed_ids: Set[str] = set()
+
+        for node_id, allocs in plan.node_update.items():
+            row = self.table.row_of.get(node_id)
+            for alloc in allocs:
+                removed_ids.add(alloc.id)
+                if row is None:
+                    continue
+                existing = state.alloc_by_id(alloc.id)
+                if existing is not None and not existing.terminal_status():
+                    res = existing.comparable_resources()
+                    d_cpu[row] -= res.cpu
+                    d_mem[row] -= res.memory_mb
+                    d_disk[row] -= res.disk_mb
+        for node_id, allocs in plan.node_preemptions.items():
+            row = self.table.row_of.get(node_id)
+            for alloc in allocs:
+                removed_ids.add(alloc.id)
+                if row is None:
+                    continue
+                existing = state.alloc_by_id(alloc.id)
+                if existing is not None and not existing.terminal_status():
+                    res = existing.comparable_resources()
+                    d_cpu[row] -= res.cpu
+                    d_mem[row] -= res.memory_mb
+                    d_disk[row] -= res.disk_mb
+        plan_alloc_ids: Set[str] = set()
+        for node_id, allocs in plan.node_allocation.items():
+            row = self.table.row_of.get(node_id)
+            if row is None:
+                continue
+            for alloc in allocs:
+                plan_alloc_ids.add(alloc.id)
+                res = alloc.comparable_resources()
+                d_cpu[row] += res.cpu
+                d_mem[row] += res.memory_mb
+                d_disk[row] += res.disk_mb
+                existing = state.alloc_by_id(alloc.id)
+                if (
+                    existing is not None
+                    and not existing.terminal_status()
+                    and alloc.id not in removed_ids
+                ):
+                    # in-place replacement: the old version's usage is in
+                    # the base columns; back it out
+                    old = existing.comparable_resources()
+                    d_cpu[row] -= old.cpu
+                    d_mem[row] -= old.memory_mb
+                    d_disk[row] -= old.disk_mb
+                if alloc.job_id == self.job.id:
+                    job_rows.add(row)
+                    if alloc.task_group == tg.name:
+                        job_tg_rows.add(row)
+                        collisions[row] += 1
+
+        # existing state allocs of this job
+        for alloc in state.allocs_by_job(
+            self.job.namespace, self.job.id
+        ):
+            if alloc.terminal_status():
+                continue
+            if alloc.id in removed_ids or alloc.id in plan_alloc_ids:
+                continue
+            row = self.table.row_of.get(alloc.node_id)
+            if row is None:
+                continue
+            job_rows.add(row)
+            if alloc.task_group == tg.name:
+                job_tg_rows.add(row)
+                collisions[row] += 1
+        return d_cpu, d_mem, d_disk, collisions, job_rows, job_tg_rows
+
+    # ------------------------------------------------------------------
+
+    def _affinity_vector(self, tg: TaskGroup) -> np.ndarray:
+        key = (tg.name, self.table.generation)
+        cached = self._affinity_cache.get(key)
+        if cached is None:
+            affinities = (
+                list(self.job.affinities)
+                + list(tg.affinities)
+                + [a for t in tg.tasks for a in t.affinities]
+            )
+            total, sum_weight = self.compiler.affinity_score_vector(
+                affinities
+            )
+            vec = (
+                total / sum_weight
+                if sum_weight
+                else np.zeros(self.table.capacity)
+            )
+            cached = (vec, sum_weight)
+            self._affinity_cache[key] = cached
+        return cached[0]
+
+    # ------------------------------------------------------------------
+
+    def _spread_vector(self, tg: TaskGroup) -> Tuple[np.ndarray, bool]:
+        """Total spread boost per node (spread.py semantics, vectorized
+        per select because use counts track the accumulating plan)."""
+        C = self.table.capacity
+        combined = list(tg.spreads) + list(self.job.spreads)
+        if not combined:
+            return np.zeros(C, dtype=np.float64), False
+
+        if tg.name not in self._spread_psets:
+            psets = []
+            # job-level spreads first, then tg-level (spread.go:79-92)
+            for spread in list(self.job.spreads) + list(tg.spreads):
+                pset = PropertySet(self.ctx, self.job)
+                pset.set_target_attribute(spread.attribute, tg.name)
+                psets.append(pset)
+            self._spread_psets[tg.name] = psets
+            info: Dict[str, Dict] = {}
+            total_count = tg.count
+            sum_weights = 0
+            for spread in combined:
+                desired: Dict[str, float] = {}
+                sum_desired = 0.0
+                for target in spread.targets:
+                    dc = (float(target.percent) / 100.0) * float(
+                        total_count
+                    )
+                    desired[target.value] = dc
+                    sum_desired += dc
+                if 0 < sum_desired < float(total_count):
+                    desired["*"] = float(total_count) - sum_desired
+                info[spread.attribute] = {
+                    "weight": spread.weight,
+                    "desired_counts": desired,
+                }
+                sum_weights += spread.weight
+            self._spread_info[tg.name] = info
+            self._sum_spread_weights = sum_weights
+        else:
+            for pset in self._spread_psets[tg.name]:
+                pset.populate_proposed()
+
+        total = np.zeros(C, dtype=np.float64)
+        info = self._spread_info[tg.name]
+        for pset in self._spread_psets[tg.name]:
+            attr_info = info.get(pset.target_attribute)
+            if attr_info is None:
+                continue
+            desired_counts = attr_info["desired_counts"]
+            combined_use = pset.get_combined_use_map()
+            if desired_counts:
+                weight_frac = float(attr_info["weight"]) / float(
+                    self._sum_spread_weights
+                )
+                total += self.compiler.spread_boost_vector(
+                    pset.target_attribute,
+                    weight_frac,
+                    desired_counts,
+                    combined_use,
+                )
+            else:
+                total += self.compiler.spread_boost_vector(
+                    pset.target_attribute, None, None, combined_use
+                )
+        return total, True
+
+    # ------------------------------------------------------------------
+
+    def _distinct_property_mask(self, tg: TaskGroup) -> np.ndarray:
+        C = self.table.capacity
+        mask = np.ones(C, dtype=bool)
+        constraints = [
+            (c, "")
+            for c in self.job.constraints
+            if c.operand == CONSTRAINT_DISTINCT_PROPERTY
+        ] + [
+            (c, tg.name)
+            for c in tg.constraints
+            if c.operand == CONSTRAINT_DISTINCT_PROPERTY
+        ]
+        if not constraints:
+            return mask
+        from .feasible import target_column_key
+
+        for constraint, scope in constraints:
+            pset = PropertySet(self.ctx, self.job)
+            pset.set_constraint(constraint, scope)
+            key = target_column_key(constraint.ltarget)
+            if not key:
+                continue
+            col = self.table.column(key)
+            combined = pset.get_combined_use_map()
+            allowed = pset.allowed_count
+            lut = np.ones(len(col.interner.values) + 1, dtype=bool)
+            for i, value in enumerate(col.interner.values):
+                lut[i] = combined.get(value, 0) < allowed
+            lut[-1] = False  # missing property fails
+            mask &= lut[col.codes]
+        return mask
+
+    # ------------------------------------------------------------------
+
+    def _populate_class_eligibility(
+        self, tg: TaskGroup, static_mask: np.ndarray
+    ) -> None:
+        """After a failed placement, record which computed classes passed
+        the feasibility layer so blocked evals unblock correctly
+        (context.go:190 EvalEligibility; mask-derived here)."""
+        elig = self.ctx.eligibility
+        col = self.table.column("node.computed_class")
+        candidate_mask = np.zeros(self.table.capacity, dtype=bool)
+        candidate_mask[self.candidate_rows] = True
+        active = candidate_mask & self.table.active & self.table.eligible
+        for code, klass in enumerate(col.interner.values):
+            rows = (col.codes == code) & active
+            if not rows.any():
+                continue
+            ok = bool((rows & static_mask).any())
+            if not elig.job_escaped:
+                elig.set_job_eligibility(ok, klass)
+            if not elig.tg_escaped.get(tg.name, False):
+                elig.set_task_group_eligibility(ok, tg.name, klass)
+
+
+class TPUSystemStack(SystemStack):
+    """System stack on the vectorized backend.
+
+    The system scheduler calls select once per node
+    (system_sched.go:computePlacements); scoring one node vectorially
+    gains nothing, so the oracle SystemStack is reused as-is.  The
+    batched system path (score every node for the job in one kernel) is
+    provided by ops/batch.py for the eval-stream pipeline.
+    """
+
+    def __init__(self, ctx: EvalContext, seed=None) -> None:
+        super().__init__(ctx)

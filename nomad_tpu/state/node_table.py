@@ -343,4 +343,11 @@ def _resolve_column_value(node: "Node", key: str) -> Optional[str]:
         name = key[len("csi.") :]
         healthy = node.csi_node_plugins.get(name)
         return "1" if healthy else None
+    if key.startswith("netmode."):
+        mode = key[len("netmode.") :]
+        for net in node.node_resources.networks:
+            if (net.mode or "host") == mode:
+                return "1"
+        # host mode is implicitly available on every node
+        return "1" if mode == "host" else None
     return None

@@ -1,0 +1,84 @@
+"""Constraint operator semantics (reference feasible.go:750 and
+feasible_test.go TestCheckConstraint/TestCheckVersionConstraint ...).
+"""
+from nomad_tpu.sched.operators import (
+    check_constraint,
+    check_version_constraint,
+)
+
+
+def chk(op, l, r, lf=True, rf=True):
+    return check_constraint(op, l, r, lf, rf)
+
+
+def test_equality():
+    assert chk("=", "foo", "foo")
+    assert not chk("=", "foo", "bar")
+    assert chk("==", "a", "a")
+    assert chk("is", "a", "a")
+    assert not chk("=", None, "a", lf=False)
+
+
+def test_inequality():
+    assert chk("!=", "a", "b")
+    assert not chk("!=", "a", "a")
+    # missing value != present value
+    assert chk("!=", None, "a", lf=False)
+    # both missing are equal
+    assert not chk("!=", None, None, lf=False, rf=False)
+
+
+def test_lexical_order():
+    assert chk("<", "abc", "abd")
+    assert chk("<=", "abc", "abc")
+    assert chk(">", "b", "a")
+    assert not chk(">", "a", "b")
+
+
+def test_is_set():
+    assert chk("is_set", "anything", None, rf=False)
+    assert not chk("is_set", None, None, lf=False, rf=False)
+    assert chk("is_not_set", None, None, lf=False, rf=False)
+    assert not chk("is_not_set", "x", None, rf=False)
+
+
+def test_version():
+    assert chk("version", "1.2.3", ">= 1.0, < 2.0")
+    assert not chk("version", "2.1.0", ">= 1.0, < 2.0")
+    assert chk("version", "0.13.0", "> 0.12")
+    assert chk("version", "1.7.0-beta", "< 1.7.0")
+    assert not chk("version", "banana", "> 1.0")
+    assert not chk("version", "1.0", "banana")
+
+
+def test_version_pessimistic():
+    assert check_version_constraint("1.2.5", "~> 1.2")
+    assert check_version_constraint("1.2.5", "~> 1.2.3")
+    assert not check_version_constraint("1.3.0", "~> 1.2.3")
+    assert not check_version_constraint("2.0.0", "~> 1.2")
+
+
+def test_semver():
+    assert chk("semver", "1.2.3", ">= 1.0.0")
+    assert not chk("semver", "0.9.0", ">= 1.0.0")
+
+
+def test_regexp():
+    assert chk("regexp", "linux-x64", "linux")
+    assert chk("regexp", "ubuntu-20.04", r"2[02]\.04")
+    assert not chk("regexp", "darwin", "linux")
+    # bad pattern fails closed
+    assert not chk("regexp", "x", "(unclosed")
+
+
+def test_set_contains():
+    assert chk("set_contains", "a,b,c", "a,c")
+    assert not chk("set_contains", "a,b", "a,z")
+    assert chk("set_contains_all", "a, b, c", "b")
+    assert chk("set_contains_any", "a,b", "z,b")
+    assert not chk("set_contains_any", "a,b", "z,y")
+
+
+def test_distinct_operators_pass_through():
+    assert chk("distinct_hosts", None, None, lf=False, rf=False)
+    assert chk("distinct_property", "x", "2")

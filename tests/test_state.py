@@ -1,0 +1,127 @@
+"""State store + columnar node table tests
+(reference model: nomad/state/state_store_test.go).
+"""
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.state import NodeTable, StateStore
+from nomad_tpu.structs import (
+    NODE_SCHED_INELIGIBLE,
+    NODE_STATUS_DOWN,
+    PlanResult,
+)
+
+
+def test_upsert_node_indexes():
+    s = StateStore()
+    n = mock.node()
+    idx = s.upsert_node(n)
+    assert idx == s.latest_index()
+    assert s.node_by_id(n.id) is n
+    assert n.computed_class
+
+
+def test_job_versioning():
+    s = StateStore()
+    j1 = mock.job(id="j")
+    s.upsert_job(j1)
+    assert j1.version == 0
+    j2 = mock.job(id="j")
+    s.upsert_job(j2)
+    assert j2.version == 1
+    assert s.job_by_version("default", "j", 0) is j1
+    assert s.job_by_id("default", "j") is j2
+
+
+def test_alloc_indexes_and_usage_columns():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    a = mock.alloc(node_id=n.id)
+    s.upsert_allocs([a])
+    assert s.allocs_by_node(n.id) == [a]
+    assert s.allocs_by_job(a.namespace, a.job_id) == [a]
+    row = s.node_table.row_of[n.id]
+    assert s.node_table.cpu_used[row] == 500
+    assert s.node_table.mem_used[row] == 256
+    # terminal transition clears usage
+    a2 = mock.alloc(id=a.id, node_id=n.id, job_id=a.job_id)
+    a2.client_status = "failed"
+    s.upsert_allocs([a2])
+    assert s.node_table.cpu_used[row] == 0
+
+
+def test_node_eligibility_column():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    row = s.node_table.row_of[n.id]
+    assert s.node_table.eligible[row]
+    s.update_node_status(n.id, NODE_STATUS_DOWN)
+    assert not s.node_table.eligible[row]
+
+
+def test_node_drain_toggles_eligibility():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    s.update_node_drain(n.id, True)
+    assert s.node_by_id(n.id).scheduling_eligibility == NODE_SCHED_INELIGIBLE
+    row = s.node_table.row_of[n.id]
+    assert not s.node_table.eligible[row]
+
+
+def test_plan_results_write_path():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    a = mock.alloc(node_id=n.id)
+    result = PlanResult(node_allocation={n.id: [a]})
+    s.upsert_plan_results(result)
+    assert s.alloc_by_id(a.id) is a
+
+
+def test_wait_for_index():
+    s = StateStore()
+    assert s.wait_for_index(0)
+    assert not s.wait_for_index(99, timeout=0.05)
+
+
+def test_node_table_arena_growth_and_reuse():
+    t = NodeTable(capacity=2)
+    nodes = [mock.node() for _ in range(5)]
+    for n in nodes:
+        t.upsert_node(n)
+    assert t.capacity >= 5
+    assert t.active.sum() == 5
+    t.delete_node(nodes[0].id)
+    assert t.active.sum() == 4
+    n_new = mock.node()
+    t.upsert_node(n_new)
+    # freed row is reused
+    assert t.capacity >= 5
+    assert t.active.sum() == 5
+
+
+def test_node_table_column_backfill():
+    t = NodeTable()
+    a = mock.node()
+    a.attributes["zone"] = "z1"
+    t.upsert_node(a)
+    # column created after the node exists: must backfill
+    col = t.column("attr.zone")
+    row = t.row_of[a.id]
+    assert col.interner.values[col.codes[row]] == "z1"
+    b = mock.node()  # no zone attr
+    t.upsert_node(b)
+    assert col.codes[t.row_of[b.id]] == -1
+
+
+def test_snapshot_surface():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    snap = s.snapshot()
+    assert snap.node_by_id(n.id) is n
+    assert len(snap.nodes()) == 1
+    assert snap.scheduler_config() is s.scheduler_config

@@ -1,0 +1,129 @@
+"""Unit tests for the data model and resource math
+(reference test model: nomad/structs/funcs_test.go, structs_test.go).
+"""
+import math
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    Allocation,
+    AllocatedResources,
+    AllocatedTaskResources,
+    AllocatedSharedResources,
+    ComparableResources,
+    NetworkIndex,
+    NetworkResource,
+    Port,
+    allocs_fit,
+    compute_node_class,
+    score_fit_binpack,
+    score_fit_spread,
+)
+
+
+def util(cpu, mem):
+    return ComparableResources(cpu=cpu, memory_mb=mem)
+
+
+def test_score_fit_binpack_bounds():
+    node = mock.node()
+    node.reserved_resources.cpu = 0
+    node.reserved_resources.memory_mb = 0
+    # empty node: free=1.0 both => 20 - 20 = 0
+    assert score_fit_binpack(node, util(0, 0)) == 0.0
+    # full node: free=0 => 20 - 2 = 18
+    full = util(node.node_resources.cpu, node.node_resources.memory_mb)
+    assert score_fit_binpack(node, full) == 18.0
+    # spread is the inverse shape
+    assert score_fit_spread(node, util(0, 0)) == 18.0
+    assert score_fit_spread(node, full) == 0.0
+
+
+def test_score_fit_formula():
+    node = mock.node()
+    node.reserved_resources.cpu = 0
+    node.reserved_resources.memory_mb = 0
+    u = util(2000, 4096)
+    free_cpu = 1 - 2000 / node.node_resources.cpu
+    free_mem = 1 - 4096 / node.node_resources.memory_mb
+    expected = 20.0 - (10**free_cpu + 10**free_mem)
+    assert abs(score_fit_binpack(node, u) - expected) < 1e-12
+
+
+def test_allocs_fit_dimensions():
+    node = mock.node()
+    fits, dim, used = allocs_fit(node, [])
+    assert fits
+    big = Allocation(
+        allocated_resources=AllocatedResources(
+            tasks={
+                "t": AllocatedTaskResources(cpu=100000, memory_mb=10)
+            }
+        )
+    )
+    fits, dim, _ = allocs_fit(node, [big])
+    assert not fits and dim == "cpu"
+
+
+def test_allocs_fit_ignores_terminal():
+    node = mock.node()
+    dead = mock.alloc(client_status="failed")
+    fits, _, used = allocs_fit(node, [dead])
+    assert fits and used.cpu == 0
+
+
+def test_network_index_static_collision():
+    node = mock.node()
+    idx = NetworkIndex()
+    idx.set_node(node)
+    ask = NetworkResource(reserved_ports=[Port("http", 8080)])
+    offer = idx.assign_ports(ask)
+    assert offer is not None and offer[0].value == 8080
+    idx.add_reserved_ports(offer)
+    # same static port again collides
+    assert idx.assign_ports(ask) is None
+
+
+def test_network_index_dynamic_ports():
+    node = mock.node()
+    idx = NetworkIndex()
+    idx.set_node(node)
+    ask = NetworkResource(dynamic_ports=[Port("a"), Port("b")])
+    offer = idx.assign_ports(ask)
+    assert len(offer) == 2
+    assert offer[0].value != offer[1].value
+
+
+def test_computed_class_stability():
+    a = mock.node()
+    b = mock.node()
+    # names/ids differ but class-relevant state matches
+    b.attributes = dict(a.attributes)
+    b.meta = dict(a.meta)
+    b.datacenter = a.datacenter
+    b.node_class = a.node_class
+    b.node_resources.devices = a.node_resources.devices
+    assert compute_node_class(a) == compute_node_class(b)
+    b.attributes = dict(a.attributes, extra="1")
+    assert compute_node_class(a) != compute_node_class(b)
+    # unique.* keys are excluded
+    c = mock.node()
+    c.attributes = dict(a.attributes)
+    c.meta = dict(a.meta)
+    c.datacenter = a.datacenter
+    c.attributes["unique.hostname"] = "xyz"
+    assert compute_node_class(a) == compute_node_class(c)
+
+
+def test_alloc_terminal_status():
+    a = mock.alloc()
+    assert not a.terminal_status()
+    a.desired_status = "stop"
+    assert a.terminal_status()
+    b = mock.alloc(client_status="failed")
+    assert b.terminal_status()
+
+
+def test_alloc_index_parse():
+    a = mock.alloc()
+    a.name = "job.web[7]"
+    assert a.index() == 7
