@@ -1,0 +1,265 @@
+"""Evaluation broker (reference nomad/eval_broker.go).
+
+Leader-only priority-queue broker with at-least-once delivery: ack/nack
+with nack-timeout redelivery, a delivery limit that shunts poison evals to
+a failed queue, per-JobID dedup ("evaluations for a given job are not run
+in parallel", structs.go:9535 — while one eval of a job is outstanding,
+later ones wait in a per-job pending heap), and delayed evals (wait_until)
+held in a time heap.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import Evaluation, new_id
+
+DEFAULT_NACK_TIMEOUT = 60.0
+DEFAULT_DELIVERY_LIMIT = 3
+FAILED_QUEUE = "_failed"
+
+
+class _ReadyQueue:
+    """Priority heap: highest priority first, then FIFO by create index."""
+
+    def __init__(self) -> None:
+        self.heap: List[Tuple[int, int, Evaluation]] = []
+        self._counter = itertools.count()
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(
+            self.heap, (-ev.priority, next(self._counter), ev)
+        )
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self.heap:
+            return None
+        return heapq.heappop(self.heap)[2]
+
+    def peek_priority(self) -> Optional[int]:
+        if not self.heap:
+            return None
+        return -self.heap[0][0]
+
+    def __len__(self) -> int:
+        return len(self.heap)
+
+
+class EvalBroker:
+    def __init__(
+        self,
+        nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+        delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+    ) -> None:
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self._lock = threading.Condition()
+        self._enabled = False
+
+        self._ready: Dict[str, _ReadyQueue] = {}
+        # eval id -> (eval, token, deadline, timer)
+        self._unack: Dict[str, Tuple[Evaluation, str, threading.Timer]] = {}
+        # (namespace, job_id) -> outstanding eval id
+        self._job_evals: Dict[Tuple[str, str], str] = {}
+        # (namespace, job_id) -> heap of waiting evals (priority desc,
+        # create_index asc) -- reference eval_broker.go:117
+        self._pending: Dict[Tuple[str, str], List] = {}
+        self._pending_counter = itertools.count()
+        # delayed evals: (wait_until, n, eval)
+        self._delayed: List[Tuple[float, int, Evaluation]] = []
+        self._delivery_count: Dict[str, int] = {}
+        self.stats = {
+            "total_ready": 0,
+            "total_unacked": 0,
+            "total_blocked": 0,
+            "total_waiting": 0,
+            "delivery_failures": 0,
+        }
+
+    # ------------------------------------------------------------------
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                self.flush()
+            self._lock.notify_all()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def flush(self) -> None:
+        for _, _, timer in self._unack.values():
+            timer.cancel()
+        self._ready.clear()
+        self._unack.clear()
+        self._job_evals.clear()
+        self._pending.clear()
+        self._delayed.clear()
+        self._delivery_count.clear()
+
+    # ------------------------------------------------------------------
+
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._enqueue_locked(ev, ev.type)
+            self._lock.notify_all()
+
+    def enqueue_all(self, evals: List[Evaluation]) -> None:
+        with self._lock:
+            for ev in evals:
+                self._enqueue_locked(ev, ev.type)
+            self._lock.notify_all()
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        if not self._enabled:
+            return
+        if ev.id in self._unack or any(
+            ev.id is q_ev.id
+            for q in self._ready.values()
+            for _, _, q_ev in q.heap
+        ):
+            return
+        if ev.wait_until and ev.wait_until > time.time():
+            heapq.heappush(
+                self._delayed,
+                (ev.wait_until, next(self._pending_counter), ev),
+            )
+            self.stats["total_waiting"] += 1
+            return
+        job_key = (ev.namespace, ev.job_id)
+        if queue != FAILED_QUEUE and ev.job_id:
+            outstanding = self._job_evals.get(job_key)
+            if outstanding and outstanding != ev.id:
+                heapq.heappush(
+                    self._pending.setdefault(job_key, []),
+                    (-ev.priority, next(self._pending_counter), ev),
+                )
+                self.stats["total_blocked"] += 1
+                return
+            self._job_evals[job_key] = ev.id
+        self._ready.setdefault(queue, _ReadyQueue()).push(ev)
+        self.stats["total_ready"] += 1
+
+    # ------------------------------------------------------------------
+
+    def dequeue(
+        self, schedulers: List[str], timeout: Optional[float] = None
+    ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue across the given scheduler queues; returns
+        (eval, token) or (None, "") on timeout/disable."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._lock:
+            while True:
+                self._promote_delayed_locked()
+                ev = self._pop_ready_locked(schedulers)
+                if ev is not None:
+                    token = new_id()
+                    timer = threading.Timer(
+                        self.nack_timeout, self._nack_expired, [ev.id, token]
+                    )
+                    timer.daemon = True
+                    timer.start()
+                    self._unack[ev.id] = (ev, token, timer)
+                    self.stats["total_unacked"] += 1
+                    return ev, token
+                if not self._enabled:
+                    return None, ""
+                wait = 0.05
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, ""
+                    wait = min(wait, remaining)
+                self._lock.wait(wait)
+
+    def _pop_ready_locked(self, schedulers) -> Optional[Evaluation]:
+        best_queue = None
+        best_priority = None
+        for name in schedulers:
+            q = self._ready.get(name)
+            if q is None or not len(q):
+                continue
+            p = q.peek_priority()
+            if best_priority is None or p > best_priority:
+                best_priority = p
+                best_queue = q
+        if best_queue is None:
+            return None
+        self.stats["total_ready"] -= 1
+        return best_queue.pop()
+
+    def _promote_delayed_locked(self) -> None:
+        now = time.time()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, ev = heapq.heappop(self._delayed)
+            self.stats["total_waiting"] -= 1
+            self._enqueue_locked(ev, ev.type)
+
+    # ------------------------------------------------------------------
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            entry = self._unack.get(eval_id)
+            if entry is None or entry[1] != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            ev, _, timer = entry
+            timer.cancel()
+            del self._unack[eval_id]
+            self.stats["total_unacked"] -= 1
+            self._delivery_count.pop(eval_id, None)
+            job_key = (ev.namespace, ev.job_id)
+            if self._job_evals.get(job_key) == eval_id:
+                del self._job_evals[job_key]
+                pending = self._pending.get(job_key)
+                if pending:
+                    _, _, nxt = heapq.heappop(pending)
+                    if not pending:
+                        del self._pending[job_key]
+                    self.stats["total_blocked"] -= 1
+                    self._enqueue_locked(nxt, nxt.type)
+            self._lock.notify_all()
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            entry = self._unack.get(eval_id)
+            if entry is None or entry[1] != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            ev, _, timer = entry
+            timer.cancel()
+            del self._unack[eval_id]
+            self.stats["total_unacked"] -= 1
+            job_key = (ev.namespace, ev.job_id)
+            if self._job_evals.get(job_key) == eval_id:
+                del self._job_evals[job_key]
+            count = self._delivery_count.get(eval_id, 0) + 1
+            self._delivery_count[eval_id] = count
+            if count >= self.delivery_limit:
+                self.stats["delivery_failures"] += 1
+                self._enqueue_locked(ev, FAILED_QUEUE)
+            else:
+                self._enqueue_locked(ev, ev.type)
+            self._lock.notify_all()
+
+    def _nack_expired(self, eval_id: str, token: str) -> None:
+        try:
+            self.nack(eval_id, token)
+        except ValueError:
+            pass
+
+    # ------------------------------------------------------------------
+
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        entry = self._unack.get(eval_id)
+        return entry[1] if entry else None
+
+    def ready_count(self) -> int:
+        return sum(len(q) for q in self._ready.values())
+
+    def failed(self) -> List[Evaluation]:
+        q = self._ready.get(FAILED_QUEUE)
+        return [e for _, _, e in q.heap] if q else []

@@ -1,0 +1,174 @@
+"""Serialized plan applier (reference nomad/plan_apply.go).
+
+A single thread pops plans from the queue, re-verifies every touched node
+against current state (evaluateNodePlan:629 re-runs AllocsFit), commits
+the feasible subset (partial commits set a refresh index so the submitting
+worker retries on fresh state), and applies results through the store's
+plan-results write path.  The reference pipelines verification of plan
+N+1 against an optimistic snapshot while plan N's raft apply is in flight
+(plan_apply.go:45-70); with an in-process store the apply is a dict write,
+so the pipeline bubble the reference hides does not exist here — the
+applier stays strictly serial, preserving the correctness contract.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..state.store import StateStore
+from ..structs import (
+    Allocation,
+    NetworkIndex,
+    Node,
+    Plan,
+    PlanResult,
+    allocs_fit,
+)
+
+
+def evaluate_node_plan(
+    store: StateStore, plan: Plan, node_id: str
+) -> Tuple[bool, str]:
+    """Whether the plan's changes to one node fit
+    (reference plan_apply.go:629 evaluateNodePlan)."""
+    # evict-only plans always fit: they only remove things
+    # (reference plan_apply.go:631)
+    if not plan.node_allocation.get(node_id):
+        return True, ""
+
+    node = store.node_by_id(node_id)
+    if node is None:
+        return False, "node does not exist"
+    if node.status != "ready":
+        return False, "node is not ready for placements"
+    if node.scheduling_eligibility != "eligible":
+        return False, "node is not eligible"
+    if node.drain:
+        return False, "node is draining"
+
+    proposed = [
+        a
+        for a in store.allocs_by_node(node_id)
+        if not a.terminal_status()
+    ]
+    remove_ids = {a.id for a in plan.node_update.get(node_id, ())}
+    remove_ids |= {a.id for a in plan.node_preemptions.get(node_id, ())}
+    proposed = [a for a in proposed if a.id not in remove_ids]
+    by_id = {a.id: a for a in proposed}
+    for alloc in plan.node_allocation.get(node_id, ()):
+        by_id[alloc.id] = alloc
+    fit, dim, _util = allocs_fit(node, list(by_id.values()))
+    return fit, dim
+
+
+def evaluate_plan(
+    store: StateStore, plan: Plan
+) -> Tuple[PlanResult, bool]:
+    """Verify the plan per node; returns (result, fully_committed)
+    (reference plan_apply.go:400 evaluatePlan)."""
+    result = PlanResult(
+        node_update={},
+        node_allocation={},
+        node_preemptions={},
+        deployment=plan.deployment,
+        deployment_updates=list(plan.deployment_updates),
+    )
+    node_ids = (
+        set(plan.node_update)
+        | set(plan.node_allocation)
+        | set(plan.node_preemptions)
+    )
+    partial = False
+    for node_id in sorted(node_ids):
+        fit, _reason = evaluate_node_plan(store, plan, node_id)
+        if fit:
+            if plan.node_update.get(node_id):
+                result.node_update[node_id] = plan.node_update[node_id]
+            if plan.node_allocation.get(node_id):
+                result.node_allocation[node_id] = plan.node_allocation[
+                    node_id
+                ]
+            if plan.node_preemptions.get(node_id):
+                result.node_preemptions[node_id] = plan.node_preemptions[
+                    node_id
+                ]
+        else:
+            partial = True
+            if plan.all_at_once:
+                # reject everything (reference plan_apply.go:514)
+                result.node_update = {}
+                result.node_allocation = {}
+                result.node_preemptions = {}
+                result.deployment = None
+                result.deployment_updates = []
+                break
+    if partial:
+        result.refresh_index = store.latest_index()
+        # a partial commit must not carry deployment mutations computed
+        # against the full plan (reference plan_apply.go:447)
+        result.deployment = None
+        result.deployment_updates = []
+    return result, not partial
+
+
+class PlanApplier:
+    """The single apply thread + capacity-change fanout to blocked
+    evals."""
+
+    def __init__(self, store: StateStore, plan_queue, blocked=None) -> None:
+        self.store = store
+        self.plan_queue = plan_queue
+        self.blocked = blocked
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.applied = 0
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="plan-applier", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.plan_queue.dequeue(timeout=0.1)
+            if pending is None:
+                continue
+            try:
+                result = self.apply(pending.plan)
+                pending.respond(result, None)
+            except Exception as exc:  # noqa: BLE001
+                pending.respond(None, exc)
+
+    def apply(self, plan: Plan) -> PlanResult:
+        result, _full = evaluate_plan(self.store, plan)
+        if (
+            result.node_update
+            or result.node_allocation
+            or result.node_preemptions
+            or result.deployment is not None
+            or result.deployment_updates
+        ):
+            index = self.store.upsert_plan_results(result, plan.eval_id)
+            result.alloc_index = index
+            self.applied += 1
+            self._notify_capacity_change(result, index)
+        return result
+
+    def _notify_capacity_change(self, result: PlanResult, index: int) -> None:
+        """Stopped/preempted allocs free capacity: unblock their node
+        classes (reference blocked_evals.go:watchCapacity wiring in
+        nomad/plan_apply.go + state store)."""
+        if self.blocked is None:
+            return
+        freed_nodes = set(result.node_update) | set(result.node_preemptions)
+        for node_id in freed_nodes:
+            node = self.store.node_by_id(node_id)
+            if node is not None:
+                self.blocked.unblock(node.computed_class, index)

@@ -1,0 +1,346 @@
+"""The control plane in one process (reference nomad/server.go +
+nomad/leader.go).
+
+Wires the state store, eval broker, blocked-evals tracker, plan queue,
+the serialized plan applier, N scheduling workers and the node heartbeat
+monitor, and exposes the write-path operations the RPC endpoints perform
+in the reference (job register -> eval create, node register/heartbeat ->
+node evals, etc.).
+
+Consensus/federation scope for this stage: the reference replicates this
+state machine with Raft and gossips membership with Serf
+(nomad/server.go:105-186); here a single process owns the store and the
+leader services are always enabled.  The store's index plumbing,
+snapshot-fencing and the broker/applier protocols are the Raft-facing
+surfaces and keep their reference semantics so a replicated log can slot
+in underneath.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..state.store import StateStore
+from ..structs import (
+    Allocation,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_DESIRED_STOP,
+    Evaluation,
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_JOB_DEREGISTER,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    Job,
+    JOB_TYPE_CORE,
+    JOB_TYPE_SERVICE,
+    Node,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+)
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .worker import Worker
+
+DEFAULT_HEARTBEAT_TTL = 30.0
+
+
+class Server:
+    def __init__(
+        self,
+        num_schedulers: int = 1,
+        heartbeat_ttl: float = DEFAULT_HEARTBEAT_TTL,
+        seed: Optional[int] = None,
+        nack_timeout: float = 60.0,
+    ) -> None:
+        self.store = StateStore()
+        self.broker = EvalBroker(nack_timeout=nack_timeout)
+        self.blocked = BlockedEvals(self.broker)
+        self.plan_queue = PlanQueue()
+        self.applier = PlanApplier(
+            self.store, self.plan_queue, self.blocked
+        )
+        self.workers: List[Worker] = [
+            Worker(self, seed=seed) for _ in range(num_schedulers)
+        ]
+        self.heartbeat_ttl = heartbeat_ttl
+        self._heartbeat_timers: Dict[str, threading.Timer] = {}
+        self._running = False
+
+    # -- lifecycle (reference leader.go:222 establishLeadership) -------
+
+    def start(self) -> None:
+        self.broker.set_enabled(True)
+        self.blocked.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self.applier.start()
+        for worker in self.workers:
+            worker.start()
+        self._running = True
+        self.restore_evals()
+
+    def stop(self) -> None:
+        self._running = False
+        for worker in self.workers:
+            worker.stop()
+        self.applier.stop()
+        for timer in self._heartbeat_timers.values():
+            timer.cancel()
+        self.plan_queue.set_enabled(False)
+        self.blocked.set_enabled(False)
+        self.broker.set_enabled(False)
+
+    def restore_evals(self) -> None:
+        """Re-enqueue non-terminal evals from state after (re)start
+        (reference leader.go:352 restoreEvals)."""
+        for ev in list(self.store.evals.values()):
+            if ev.should_enqueue():
+                self.broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked.block(ev)
+
+    # -- eval routing (reference fsm.go:715) ----------------------------
+
+    def on_eval_update(self, ev: Evaluation) -> None:
+        if ev.should_enqueue():
+            self.broker.enqueue(ev)
+        elif ev.should_block():
+            self.blocked.block(ev)
+
+    # -- job API (reference nomad/job_endpoint.go Register:349) ---------
+
+    def register_job(self, job: Job) -> Evaluation:
+        self._validate_job(job)
+        self.store.upsert_job(job)
+        if job.is_periodic() or job.is_parameterized():
+            # launched by the periodic dispatcher / dispatch call instead
+            return None
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            job_modify_index=job.modify_index,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.store.upsert_evals([ev])
+        self.on_eval_update(ev)
+        return ev
+
+    def deregister_job(
+        self, namespace: str, job_id: str, purge: bool = False
+    ) -> Optional[Evaluation]:
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        if purge:
+            self.store.delete_job(namespace, job_id)
+        else:
+            job.stop = True
+            self.store.upsert_job(job)
+        self.blocked.untrack(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.store.upsert_evals([ev])
+        self.on_eval_update(ev)
+        return ev
+
+    def _validate_job(self, job: Job) -> None:
+        if not job.id:
+            raise ValueError("missing job ID")
+        if not job.task_groups:
+            raise ValueError("job requires at least one task group")
+        names = set()
+        for tg in job.task_groups:
+            if tg.name in names:
+                raise ValueError(f"duplicate task group {tg.name!r}")
+            names.add(tg.name)
+            if tg.count < 0:
+                raise ValueError("task group count must be >= 0")
+            if not tg.tasks and job.type != JOB_TYPE_CORE:
+                raise ValueError(
+                    f"task group {tg.name!r} requires at least one task"
+                )
+        if job.type not in ("service", "batch", "system"):
+            raise ValueError(f"invalid job type {job.type!r}")
+
+    # -- node API (reference nomad/node_endpoint.go) --------------------
+
+    def register_node(self, node: Node) -> None:
+        if node.status == "initializing":
+            node.status = NODE_STATUS_READY
+        self.store.upsert_node(node)
+        self._reset_heartbeat(node.id)
+        self.blocked.unblock(
+            node.computed_class, self.store.latest_index()
+        )
+        self._create_node_evals(node.id)
+
+    def heartbeat(self, node_id: str) -> None:
+        """(reference nomad/heartbeat.go resetHeartbeatTimer)"""
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            raise KeyError(node_id)
+        if node.status == NODE_STATUS_DOWN:
+            self.update_node_status(node_id, NODE_STATUS_READY)
+        self._reset_heartbeat(node_id)
+
+    def _reset_heartbeat(self, node_id: str) -> None:
+        timer = self._heartbeat_timers.pop(node_id, None)
+        if timer is not None:
+            timer.cancel()
+        if not self._running:
+            return
+        timer = threading.Timer(
+            self.heartbeat_ttl, self._heartbeat_expired, [node_id]
+        )
+        timer.daemon = True
+        timer.start()
+        self._heartbeat_timers[node_id] = timer
+
+    def _heartbeat_expired(self, node_id: str) -> None:
+        """Missed TTL: node goes down, evals fan out
+        (reference heartbeat.go:135 invalidateHeartbeat)."""
+        try:
+            self.update_node_status(node_id, NODE_STATUS_DOWN)
+        except KeyError:
+            pass
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self.store.update_node_status(node_id, status)
+        node = self.store.node_by_id(node_id)
+        if status == NODE_STATUS_READY:
+            self._reset_heartbeat(node_id)
+            self.blocked.unblock(
+                node.computed_class, self.store.latest_index()
+            )
+        self._create_node_evals(node_id)
+
+    def update_node_drain(
+        self, node_id: str, drain: bool, strategy=None
+    ) -> None:
+        self.store.update_node_drain(node_id, drain, strategy)
+        self._create_node_evals(node_id)
+
+    def update_node_eligibility(
+        self, node_id: str, eligibility: str
+    ) -> None:
+        self.store.update_node_eligibility(node_id, eligibility)
+        node = self.store.node_by_id(node_id)
+        if eligibility == "eligible":
+            self.blocked.unblock(
+                node.computed_class, self.store.latest_index()
+            )
+
+    def _create_node_evals(self, node_id: str) -> List[Evaluation]:
+        """One eval per job with allocs on the node, plus system jobs
+        (reference node_endpoint.go:1316 createNodeEvals)."""
+        evals = []
+        seen_jobs = set()
+        for alloc in self.store.allocs_by_node(node_id):
+            key = (alloc.namespace, alloc.job_id)
+            if key in seen_jobs:
+                continue
+            seen_jobs.add(key)
+            job = self.store.job_by_id(*key)
+            sched_type = job.type if job is not None else JOB_TYPE_SERVICE
+            ev = Evaluation(
+                namespace=alloc.namespace,
+                priority=job.priority if job else 50,
+                type=sched_type,
+                triggered_by=EVAL_TRIGGER_NODE_UPDATE,
+                job_id=alloc.job_id,
+                node_id=node_id,
+                status=EVAL_STATUS_PENDING,
+            )
+            evals.append(ev)
+        for job in self.store.iter_jobs():
+            if job.type != "system" or job.stopped():
+                continue
+            key = (job.namespace, job.id)
+            if key in seen_jobs:
+                continue
+            node = self.store.node_by_id(node_id)
+            if node is None or job.datacenters and node.datacenter not in job.datacenters:
+                continue
+            seen_jobs.add(key)
+            evals.append(
+                Evaluation(
+                    namespace=job.namespace,
+                    priority=job.priority,
+                    type="system",
+                    triggered_by=EVAL_TRIGGER_NODE_UPDATE,
+                    job_id=job.id,
+                    node_id=node_id,
+                    status=EVAL_STATUS_PENDING,
+                )
+            )
+        if evals:
+            self.store.upsert_evals(evals)
+            for ev in evals:
+                self.on_eval_update(ev)
+        return evals
+
+    # -- client-side alloc updates (reference node_endpoint.go:1065) ----
+
+    def update_allocs_from_client(self, updates: List[Allocation]) -> None:
+        """Client pushes alloc status changes; terminal transitions free
+        capacity and may trigger reschedule evals."""
+        self.store.upsert_allocs(updates)
+        evals = []
+        seen = set()
+        for alloc in updates:
+            if not alloc.terminal_status():
+                continue
+            node = self.store.node_by_id(alloc.node_id)
+            if node is not None:
+                self.blocked.unblock(
+                    node.computed_class, self.store.latest_index()
+                )
+            key = (alloc.namespace, alloc.job_id)
+            if key in seen:
+                continue
+            job = self.store.job_by_id(*key)
+            if job is None or job.stopped():
+                continue
+            if alloc.client_status == ALLOC_CLIENT_STATUS_FAILED:
+                seen.add(key)
+                evals.append(
+                    Evaluation(
+                        namespace=alloc.namespace,
+                        priority=job.priority,
+                        type=job.type,
+                        triggered_by="alloc-failure",
+                        job_id=alloc.job_id,
+                        status=EVAL_STATUS_PENDING,
+                    )
+                )
+        if evals:
+            self.store.upsert_evals(evals)
+            for ev in evals:
+                self.on_eval_update(ev)
+
+    # -- helpers ---------------------------------------------------------
+
+    def drain_to_idle(self, timeout: float = 10.0) -> bool:
+        """Wait until no evals are in flight (test/bench helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (
+                self.broker.ready_count() == 0
+                and self.broker.stats["total_unacked"] == 0
+                and self.plan_queue.stats["depth"] == 0
+            ):
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,130 @@
+"""Scheduling worker (reference nomad/worker.go).
+
+Each worker loops: dequeue an eval from the broker, fence the state at
+the eval's modify index (snapshot_min_index, worker.go:228), run the
+registered scheduler for the eval type, and ack/nack.  The worker is the
+scheduler's `Planner`: plans go to the plan queue and the worker blocks
+for the applier's verdict; a partial commit hands back a refreshed
+snapshot so the scheduler retries against fresh state (worker.go:277-339
+SubmitPlan / RefreshIndex).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..sched import new_scheduler
+from ..state.store import StateSnapshot, StateStore
+from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_BLOCKED
+
+
+class Worker:
+    def __init__(
+        self,
+        server,
+        schedulers: Optional[List[str]] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        self.server = server
+        self.store: StateStore = server.store
+        self.schedulers = schedulers or ["service", "batch", "system"]
+        self.seed = seed
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.evals_processed = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, name="worker", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def set_pause(self, paused: bool) -> None:
+        """Leaders pause half their workers to favor broker/plan work
+        (reference leader.go establishLeadership)."""
+        if paused:
+            self._paused.set()
+        else:
+            self._paused.clear()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                self._stop.wait(0.05)
+                continue
+            ev, token = self.server.broker.dequeue(
+                self.schedulers, timeout=0.1
+            )
+            if ev is None:
+                continue
+            try:
+                self.process_eval(ev, token)
+            except Exception:  # noqa: BLE001
+                try:
+                    self.server.broker.nack(ev.id, token)
+                except ValueError:
+                    pass
+
+    # -- one eval ------------------------------------------------------
+
+    def process_eval(self, ev: Evaluation, token: str) -> None:
+        try:
+            snap = self.store.snapshot_min_index(
+                max(ev.modify_index, ev.snapshot_index), timeout=5.0
+            )
+        except TimeoutError:
+            self.server.broker.nack(ev.id, token)
+            return
+        # stamp the state fence, so a later Block() can tell whether a
+        # capacity change arrived after this scheduling pass (reference
+        # worker.go:277 attaches SnapshotIndex to submitted plans)
+        ev.snapshot_index = snap.index
+        self._eval_token = token
+        self._pending_evals: List[Evaluation] = []
+        scheduler = new_scheduler(
+            ev.type, snap, self, seed=self.seed,
+            use_tpu=self.store.get_scheduler_config().tpu_scheduler_enabled,
+        )
+        try:
+            scheduler.process(ev)
+        except Exception:  # noqa: BLE001
+            self.server.broker.nack(ev.id, token)
+            raise
+        self.evals_processed += 1
+        self.server.broker.ack(ev.id, token)
+
+    # -- Planner interface (scheduler.go:112) --------------------------
+
+    def submit_plan(
+        self, plan: Plan
+    ) -> Tuple[PlanResult, Optional[StateSnapshot]]:
+        plan.snapshot_index = self.store.latest_index()
+        pending = self.server.plan_queue.enqueue(plan)
+        result = pending.wait(timeout=10.0)
+        if result is None:
+            raise RuntimeError("plan rejected")
+        if result.refresh_index:
+            snap = self.store.snapshot_min_index(result.refresh_index)
+            return result, snap
+        return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.store.upsert_evals([ev])
+        self.server.on_eval_update(ev)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.store.upsert_evals([ev])
+        self.server.on_eval_update(ev)
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.store.upsert_evals([ev])
+        self.server.blocked.block(ev)

@@ -1,0 +1,343 @@
+"""Control-plane integration tests: broker, blocked evals, plan applier,
+workers, heartbeats (reference model: nomad/eval_broker_test.go,
+blocked_evals_test.go, plan_apply_test.go, worker_test.go).
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import EvalBroker, Server
+from nomad_tpu.server.plan_apply import evaluate_plan
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_RUNNING,
+    Allocation,
+    AllocatedResources,
+    AllocatedTaskResources,
+    Evaluation,
+    NODE_STATUS_DOWN,
+    Plan,
+)
+
+
+# ---------------------------------------------------------------------------
+# broker
+# ---------------------------------------------------------------------------
+
+
+def test_broker_priority_order():
+    b = EvalBroker()
+    b.set_enabled(True)
+    low = mock.evaluation(priority=10, job_id="a")
+    high = mock.evaluation(priority=90, job_id="b")
+    b.enqueue(low)
+    b.enqueue(high)
+    ev, token = b.dequeue(["service"], timeout=1)
+    assert ev is high
+    b.ack(ev.id, token)
+    ev2, token2 = b.dequeue(["service"], timeout=1)
+    assert ev2 is low
+    b.ack(ev2.id, token2)
+
+
+def test_broker_job_dedup():
+    """Two evals for one job: the second waits until the first acks
+    (reference structs.go:9535)."""
+    b = EvalBroker()
+    b.set_enabled(True)
+    e1 = mock.evaluation(job_id="job1")
+    e2 = mock.evaluation(job_id="job1")
+    b.enqueue(e1)
+    b.enqueue(e2)
+    ev, token = b.dequeue(["service"], timeout=1)
+    assert ev is e1
+    # second eval for same job is not available yet
+    ev_none, _ = b.dequeue(["service"], timeout=0.1)
+    assert ev_none is None
+    b.ack(e1.id, token)
+    ev2, token2 = b.dequeue(["service"], timeout=1)
+    assert ev2 is e2
+    b.ack(e2.id, token2)
+
+
+def test_broker_nack_redelivery_and_failed_queue():
+    b = EvalBroker(delivery_limit=2)
+    b.set_enabled(True)
+    e = mock.evaluation(job_id="j")
+    b.enqueue(e)
+    ev, token = b.dequeue(["service"], timeout=1)
+    b.nack(ev.id, token)
+    ev, token = b.dequeue(["service"], timeout=1)
+    assert ev is e
+    b.nack(ev.id, token)
+    # hit the delivery limit -> failed queue
+    assert b.failed() == [e]
+    ev_none, _ = b.dequeue(["service"], timeout=0.1)
+    assert ev_none is None
+
+
+def test_broker_nack_timeout_redelivers():
+    b = EvalBroker(nack_timeout=0.1)
+    b.set_enabled(True)
+    e = mock.evaluation(job_id="j")
+    b.enqueue(e)
+    ev, token = b.dequeue(["service"], timeout=1)
+    # never ack; timer should nack for us
+    ev2, token2 = b.dequeue(["service"], timeout=2)
+    assert ev2 is e
+    b.ack(ev2.id, token2)
+
+
+def test_broker_token_mismatch():
+    b = EvalBroker()
+    b.set_enabled(True)
+    e = mock.evaluation(job_id="j")
+    b.enqueue(e)
+    ev, token = b.dequeue(["service"], timeout=1)
+    with pytest.raises(ValueError):
+        b.ack(ev.id, "bogus")
+    b.ack(ev.id, token)
+
+
+def test_broker_delayed_eval():
+    b = EvalBroker()
+    b.set_enabled(True)
+    e = mock.evaluation(job_id="j")
+    e.wait_until = time.time() + 0.2
+    b.enqueue(e)
+    ev, _ = b.dequeue(["service"], timeout=0.05)
+    assert ev is None
+    ev, token = b.dequeue(["service"], timeout=2)
+    assert ev is e
+    b.ack(ev.id, token)
+
+
+# ---------------------------------------------------------------------------
+# plan applier verification
+# ---------------------------------------------------------------------------
+
+
+def _resources(cpu, mem):
+    return AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu=cpu, memory_mb=mem)}
+    )
+
+
+def test_evaluate_plan_partial_commit():
+    store = StateStore()
+    n1 = mock.node()
+    n2 = mock.node()
+    store.upsert_node(n1)
+    store.upsert_node(n2)
+    # fill n2 completely
+    filler = mock.alloc(node_id=n2.id)
+    filler.allocated_resources = _resources(3900, 7900)
+    store.upsert_allocs([filler])
+
+    plan = Plan(
+        node_allocation={
+            n1.id: [
+                mock.alloc(node_id=n1.id)
+            ],
+            n2.id: [
+                mock.alloc(node_id=n2.id)
+            ],
+        }
+    )
+    result, full = evaluate_plan(store, plan)
+    assert not full
+    assert n1.id in result.node_allocation
+    assert n2.id not in result.node_allocation
+    assert result.refresh_index > 0
+
+
+def test_evaluate_plan_all_at_once_rejects_everything():
+    store = StateStore()
+    n1 = mock.node()
+    n2 = mock.node()
+    store.upsert_node(n1)
+    store.upsert_node(n2)
+    filler = mock.alloc(node_id=n2.id)
+    filler.allocated_resources = _resources(3900, 7900)
+    store.upsert_allocs([filler])
+    plan = Plan(
+        all_at_once=True,
+        node_allocation={
+            n1.id: [mock.alloc(node_id=n1.id)],
+            n2.id: [mock.alloc(node_id=n2.id)],
+        },
+    )
+    result, full = evaluate_plan(store, plan)
+    assert not full
+    assert not result.node_allocation
+
+
+def test_evaluate_plan_stops_always_fit():
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(n)
+    a = mock.alloc(node_id=n.id)
+    store.upsert_allocs([a])
+    plan = Plan(node_update={n.id: [a]})
+    result, full = evaluate_plan(store, plan)
+    assert full
+
+
+# ---------------------------------------------------------------------------
+# full server loop
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def server():
+    s = Server(num_schedulers=2, heartbeat_ttl=60.0, seed=42)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_server_end_to_end_placement(server):
+    for _ in range(5):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 5
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    allocs = server.store.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 5
+    ev = server.store.evals_by_job(job.namespace, job.id)[0]
+    assert ev.status == "complete"
+
+
+def test_server_blocked_eval_unblocks_on_capacity(server):
+    # tiny node, job too large => blocked
+    n = mock.node()
+    n.node_resources.cpu = 600
+    n.node_resources.memory_mb = 512
+    from nomad_tpu.structs import compute_node_class
+
+    n.computed_class = compute_node_class(n)
+    server.register_node(n)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].resources.cpu = 400
+    job.task_groups[0].tasks[0].resources.memory_mb = 256
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    placed = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(placed) < 2
+    assert server.blocked.blocked_count() >= 1
+    # add capacity: blocked eval re-runs and completes the job
+    big = mock.node()
+    server.register_node(big)
+    assert server.drain_to_idle(10)
+    time.sleep(0.2)
+    server.drain_to_idle(10)
+    placed = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(placed) == 2
+
+
+def test_server_node_down_reschedules(server):
+    nodes = [mock.node() for _ in range(3)]
+    for n in nodes:
+        server.register_node(n)
+    job = mock.job()
+    job.task_groups[0].count = 3
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    allocs = server.store.allocs_by_job(job.namespace, job.id)
+    victim_node = allocs[0].node_id
+    # mark allocs running so loss is observable
+    for a in allocs:
+        a.client_status = ALLOC_CLIENT_STATUS_RUNNING
+    server.store.upsert_allocs(allocs)
+
+    server.update_node_status(victim_node, NODE_STATUS_DOWN)
+    assert server.drain_to_idle(10)
+    time.sleep(0.2)
+    server.drain_to_idle(10)
+    live = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(live) == 3
+    assert all(a.node_id != victim_node for a in live)
+    lost = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if a.client_status == "lost"
+    ]
+    assert lost
+
+
+def test_server_job_deregister_stops_allocs(server):
+    for _ in range(3):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 3
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    server.deregister_job(job.namespace, job.id)
+    assert server.drain_to_idle(10)
+    live = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if a.desired_status == "run"
+    ]
+    assert not live
+
+
+def test_server_job_validation(server):
+    bad = mock.job()
+    bad.task_groups = []
+    with pytest.raises(ValueError):
+        server.register_job(bad)
+    bad2 = mock.job()
+    bad2.type = "bogus"
+    with pytest.raises(ValueError):
+        server.register_job(bad2)
+
+
+def test_server_system_job_runs_everywhere(server):
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        server.register_node(n)
+    job = mock.system_job()
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    allocs = server.store.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 4
+    assert {a.node_id for a in allocs} == {n.id for n in nodes}
+    # a new node joining gets the system job too
+    late = mock.node()
+    server.register_node(late)
+    assert server.drain_to_idle(10)
+    time.sleep(0.2)
+    server.drain_to_idle(10)
+    allocs = server.store.allocs_by_job(job.namespace, job.id)
+    assert late.id in {a.node_id for a in allocs}
+
+
+def test_server_heartbeat_expiry():
+    s = Server(num_schedulers=1, heartbeat_ttl=0.2, seed=1)
+    s.start()
+    try:
+        n = mock.node()
+        s.register_node(n)
+        time.sleep(0.5)
+        assert s.store.node_by_id(n.id).status == NODE_STATUS_DOWN
+        # heartbeat revives
+        s.heartbeat(n.id)
+        assert s.store.node_by_id(n.id).status == "ready"
+    finally:
+        s.stop()
