@@ -1,0 +1,318 @@
+"""Benchmark: placements/sec on a simulated 10k-node / 100k-alloc cluster
+(BASELINE.json config family; binpack service placements).
+
+Compares three backends on identical evaluation streams:
+  * oracle   — the host iterator chain with reference semantics
+               (the "stock binpack" baseline);
+  * tpu-sel  — the per-placement vectorized kernel behind the full
+               scheduler (exact parity path);
+  * tpu-batch — the batched (evals x nodes x picks) scan kernel, E evals
+               per launch, including host-side input assembly and result
+               translation (the production dispatch path).
+
+Prints ONE JSON line: headline = tpu-batch placements/sec,
+vs_baseline = ratio over the oracle.  Details go to stderr.
+"""
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.ops.batch import batch_plan_picks_shared
+from nomad_tpu.sched.feasible import shuffle_permutation
+from nomad_tpu.sched.generic_sched import ServiceScheduler
+from nomad_tpu.sched.testing import Harness
+from nomad_tpu.sched.util import ready_nodes_in_dcs
+from nomad_tpu.structs import (
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Allocation,
+    alloc_name,
+    compute_node_class,
+)
+
+N_NODES = 10_000
+N_ALLOCS = 100_000
+TG_COUNT = 10  # placements per eval
+ORACLE_EVALS = 12
+TPU_SEL_EVALS = 8
+BATCH_E = 256
+BATCH_ROUNDS = 3
+CHECK_EVALS = 6
+SEED_BASE = 1000
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_cluster():
+    rng = random.Random(7)
+    h = Harness()
+    log(f"building {N_NODES} nodes / {N_ALLOCS} allocs ...")
+    nodes = []
+    t0 = time.time()
+    for i in range(N_NODES):
+        n = mock.node()
+        n.node_resources.cpu = rng.choice([8000, 16000, 32000])
+        n.node_resources.memory_mb = rng.choice([16384, 32768, 65536])
+        nodes.append(n)
+    # one computed-class hash per spec bucket, not per node
+    class_cache = {}
+    for n in nodes:
+        key = (n.node_resources.cpu, n.node_resources.memory_mb)
+        if key not in class_cache:
+            class_cache[key] = compute_node_class(n)
+        n.computed_class = class_cache[key]
+        h.store.upsert_node(n)
+    log(f"  nodes in {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    filler_job = mock.job(id="filler")
+    allocs = []
+    for i in range(N_ALLOCS):
+        node = nodes[rng.randrange(N_NODES)]
+        allocs.append(
+            Allocation(
+                namespace="default",
+                job_id="filler",
+                job=filler_job,
+                task_group="web",
+                name=alloc_name("filler", "web", i),
+                node_id=node.id,
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            cpu=rng.choice([100, 200, 500]),
+                            memory_mb=rng.choice([128, 256, 512]),
+                        )
+                    },
+                    shared=AllocatedSharedResources(disk_mb=100),
+                ),
+                client_status="running",
+            )
+        )
+    h.store.upsert_allocs(allocs)
+    log(f"  allocs in {time.time()-t0:.1f}s")
+    return h, nodes
+
+
+def make_eval(h, i):
+    job = mock.job(id=f"bench-{i}")
+    job.task_groups[0].count = TG_COUNT
+    h.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    return job, ev
+
+
+def bench_scheduler(h, evals, use_tpu, label):
+    h.reject_plan = True  # score against pristine state every eval
+    placements = {}
+    t0 = time.time()
+    for i, (job, ev) in enumerate(evals):
+        h.process(
+            ServiceScheduler, ev, use_tpu=use_tpu, seed=SEED_BASE + i
+        )
+        plan = h.plans[-1]
+        placements[i] = sorted(
+            (a.name, a.node_id)
+            for v in plan.node_allocation.values()
+            for a in v
+        )
+    dt = time.time() - t0
+    n_placed = sum(len(p) for p in placements.values())
+    rate = n_placed / dt
+    log(
+        f"{label}: {len(evals)} evals, {n_placed} placements in "
+        f"{dt:.2f}s -> {rate:.1f} placements/s"
+    )
+    return rate, placements
+
+
+def bench_batched(h, check_against=None):
+    """Batched kernel path: E evals per launch; node columns ship once,
+    per-eval data is just the walk orders + ask scalars."""
+    table = h.store.node_table
+    C = table.capacity
+    snap = h.store.snapshot()
+    job0 = mock.job(id="shape-probe")
+    job0.task_groups[0].count = TG_COUNT
+    node_list, _ = ready_nodes_in_dcs(snap, job0.datacenters)
+    n_cand = len(node_list)
+    import math
+
+    limit = max(2, math.ceil(math.log2(n_cand)))
+    base_rows = np.asarray(
+        [table.row_of[n.id] for n in node_list], dtype=np.int32
+    )
+    present = set(base_rows.tolist())
+    rest = np.asarray(
+        [r for r in range(C) if r not in present], dtype=np.int32
+    )
+    feasible = np.zeros(C, dtype=bool)
+    feasible[base_rows] = True
+    feasible &= table.eligible & table.active
+
+    import jax
+
+    dev_cols = jax.device_put(
+        (table.cpu_total, table.mem_total, table.disk_total,
+         feasible, table.cpu_used, table.mem_used, table.disk_used)
+    )
+
+    def perms_for(eval_indexes):
+        out = np.empty((len(eval_indexes), C), dtype=np.int32)
+        for k, i in enumerate(eval_indexes):
+            rng = random.Random(SEED_BASE + i)
+            order = shuffle_permutation(rng, n_cand)
+            out[k, :n_cand] = base_rows[order]
+            out[k, n_cand:] = rest
+        return out
+
+    def dispatch(eval_indexes):
+        """Async kernel dispatch; returns the device rows array."""
+        E = len(eval_indexes)
+        perms = perms_for(eval_indexes)
+        return batch_plan_picks_shared(
+            *dev_cols,
+            perms,
+            np.full(E, 500.0),
+            np.full(E, 256.0),
+            np.full(E, 300.0),
+            np.full(E, TG_COUNT, np.int32),
+            np.full(E, limit, np.int32),
+            np.int32(n_cand),
+            TG_COUNT,
+        )
+
+    def translate(eval_indexes, rows):
+        out = {}
+        for k, i in enumerate(eval_indexes):
+            out[i] = sorted(
+                (alloc_name(f"bench-{i}", "web", p), table.node_ids[r])
+                for p, r in enumerate(rows[k])
+                if r >= 0
+            )
+        return out
+
+    def launch(eval_indexes):
+        return translate(
+            eval_indexes, np.asarray(dispatch(eval_indexes))
+        )
+
+    log("tpu-batch: compiling ...")
+    t0 = time.time()
+    launch(list(range(BATCH_E)))
+    log(f"  compile+warmup {time.time()-t0:.1f}s")
+
+    all_placements = {}
+    t0 = time.time()
+    # pipeline: dispatch is async, so assemble batch k+1 while the device
+    # runs batch k; only the result fetch synchronizes
+    batches = [
+        list(range(i * BATCH_E, (i + 1) * BATCH_E))
+        for i in range(BATCH_ROUNDS)
+    ]
+    inflight = None  # (eval_indexes, device rows)
+    for batch_ids in batches:
+        perms = perms_for(batch_ids)
+        E = len(batch_ids)
+        rows_dev = batch_plan_picks_shared(
+            *dev_cols,
+            perms,
+            np.full(E, 500.0),
+            np.full(E, 256.0),
+            np.full(E, 300.0),
+            np.full(E, TG_COUNT, np.int32),
+            np.full(E, limit, np.int32),
+            np.int32(n_cand),
+            TG_COUNT,
+        )
+        if inflight is not None:
+            prev_ids, prev_rows = inflight
+            all_placements.update(translate(prev_ids, np.asarray(prev_rows)))
+        inflight = (batch_ids, rows_dev)
+    prev_ids, prev_rows = inflight
+    all_placements.update(translate(prev_ids, np.asarray(prev_rows)))
+    dt = time.time() - t0
+    n_placed = sum(len(p) for p in all_placements.values())
+    rate = n_placed / dt
+    per_eval_ms = dt / (BATCH_ROUNDS * BATCH_E) * 1000
+    log(
+        f"tpu-batch: {BATCH_ROUNDS * BATCH_E} evals, {n_placed} "
+        f"placements in {dt:.2f}s -> {rate:.1f} placements/s "
+        f"({per_eval_ms:.2f} ms/eval amortized)"
+    )
+
+    if check_against:
+        matched = mismatched = 0
+        got = launch(sorted(check_against))
+        for i, oracle_p in check_against.items():
+            if [nid for _, nid in got[i]] == [
+                nid for _, nid in oracle_p
+            ]:
+                matched += 1
+            else:
+                mismatched += 1
+        log(
+            f"tpu-batch decision check vs oracle: {matched} identical, "
+            f"{mismatched} divergent"
+        )
+    return rate
+
+
+def main():
+    h, nodes = build_cluster()
+
+    oracle_evals = [make_eval(h, i) for i in range(ORACLE_EVALS)]
+    oracle_rate, oracle_placements = bench_scheduler(
+        h, oracle_evals, use_tpu=False, label="oracle"
+    )
+
+    tpu_evals = [make_eval(h, i) for i in range(TPU_SEL_EVALS)]
+    # warm the kernel once before timing
+    h.reject_plan = True
+    h.process(
+        ServiceScheduler, tpu_evals[0][1], use_tpu=True, seed=SEED_BASE
+    )
+    tpu_rate, tpu_placements = bench_scheduler(
+        h, tpu_evals, use_tpu=True, label="tpu-sel"
+    )
+
+    # per-select parity on the shared prefix
+    same = sum(
+        1
+        for i in range(min(ORACLE_EVALS, TPU_SEL_EVALS))
+        if [n for _, n in oracle_placements[i]]
+        == [n for _, n in tpu_placements[i]]
+    )
+    log(
+        f"tpu-sel decision check vs oracle: {same}/"
+        f"{min(ORACLE_EVALS, TPU_SEL_EVALS)} evals identical"
+    )
+
+    check = {
+        i: oracle_placements[i] for i in range(CHECK_EVALS)
+    }
+    batch_rate = bench_batched(h, check)
+
+    print(
+        json.dumps(
+            {
+                "metric": "placements_per_sec_10k_nodes_binpack",
+                "value": round(batch_rate, 1),
+                "unit": "placements/s",
+                "vs_baseline": round(batch_rate / oracle_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

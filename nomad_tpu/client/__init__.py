@@ -1,0 +1,3 @@
+from .client import Client  # noqa: F401
+from .alloc_runner import AllocRunner  # noqa: F401
+from .task_runner import TaskRunner  # noqa: F401

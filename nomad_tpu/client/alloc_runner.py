@@ -1,0 +1,139 @@
+"""Allocation runner: one per allocation, owns the task runners and the
+client-status fan-in (reference client/allocrunner/alloc_runner.go:35,
+task-state fan-in :443 handleTaskStateUpdates).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+from ..structs import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    Allocation,
+    TaskState,
+)
+from .task_runner import TASK_STATE_DEAD, TASK_STATE_RUNNING, TaskRunner
+
+
+class AllocRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        data_dir: str = "",
+        on_update: Optional[Callable[[Allocation], None]] = None,
+        drivers: Optional[Dict[str, object]] = None,
+    ) -> None:
+        self.alloc = alloc
+        self.on_update = on_update
+        self._lock = threading.Lock()
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self._destroyed = False
+
+        job = alloc.job
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        if tg is None:
+            raise ValueError(
+                f"alloc {alloc.id} references unknown task group "
+                f"{alloc.task_group!r}"
+            )
+        self.tg = tg
+        alloc_dir = (
+            os.path.join(data_dir, "allocs", alloc.id) if data_dir else ""
+        )
+        env = {
+            "NOMAD_ALLOC_ID": alloc.id,
+            "NOMAD_ALLOC_NAME": alloc.name,
+            "NOMAD_ALLOC_INDEX": str(alloc.index()),
+            "NOMAD_JOB_NAME": job.name if job else "",
+            "NOMAD_JOB_ID": job.id if job else "",
+            "NOMAD_GROUP_NAME": tg.name,
+            "NOMAD_NAMESPACE": alloc.namespace,
+            "NOMAD_DC": "",
+            "NOMAD_ALLOC_DIR": alloc_dir,
+        }
+        is_batch = job is not None and job.type == "batch"
+        for task in tg.tasks:
+            driver = None
+            if drivers is not None:
+                driver = drivers.get(task.driver)
+            self.task_runners[task.name] = TaskRunner(
+                alloc_id=alloc.id,
+                task=task,
+                restart_policy=tg.restart_policy,
+                batch=is_batch,
+                alloc_dir=alloc_dir,
+                env={**env, "NOMAD_TASK_NAME": task.name},
+                on_state_change=self._on_task_state,
+                driver=driver,
+            )
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        self.alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+        for tr in self.task_runners.values():
+            tr.start()
+
+    def _on_task_state(self, task_name: str, state: TaskState) -> None:
+        with self._lock:
+            self.alloc.task_states[task_name] = state
+            self._sync_client_status()
+        if self.on_update is not None:
+            self.on_update(self.alloc)
+
+    def _sync_client_status(self) -> None:
+        """Derive the alloc's client status from task states
+        (reference alloc_runner.go clientAlloc/getClientStatus)."""
+        states = [tr.state for tr in self.task_runners.values()]
+        if any(s.state == TASK_STATE_DEAD and s.failed for s in states):
+            self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+        elif all(s.state == TASK_STATE_DEAD for s in states):
+            self.alloc.client_status = ALLOC_CLIENT_STATUS_COMPLETE
+        elif any(s.state == TASK_STATE_RUNNING for s in states):
+            self.alloc.client_status = ALLOC_CLIENT_STATUS_RUNNING
+        else:
+            self.alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+
+        # a leader task dying stops the rest (reference
+        # alloc_runner.go handleTaskStateUpdates leader handling)
+        leader_dead = any(
+            tr.task.leader and tr.state.state == TASK_STATE_DEAD
+            for tr in self.task_runners.values()
+        )
+        if leader_dead:
+            for tr in self.task_runners.values():
+                if not tr.task.leader:
+                    tr.kill()
+
+    # ------------------------------------------------------------------
+
+    def destroy(self) -> None:
+        with self._lock:
+            self._destroyed = True
+        for tr in self.task_runners.values():
+            tr.kill()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ok = True
+        for tr in self.task_runners.values():
+            ok = tr.wait(timeout) and ok
+        return ok
+
+    def is_terminal(self) -> bool:
+        return self.alloc.client_terminal_status()
+
+    def task_state_snapshot(self) -> Dict[str, Dict]:
+        """Persistable view for client restarts
+        (reference client/state/state_database.go)."""
+        return {
+            name: {
+                "state": tr.state.state,
+                "failed": tr.state.failed,
+                "task_id": tr.task_id,
+            }
+            for name, tr in self.task_runners.items()
+        }

@@ -1,0 +1,208 @@
+"""Client agent (reference client/client.go:309).
+
+Registers the fingerprinted node, heartbeats, watches the server for
+allocation changes (the in-process analog of the blocking
+`Node.GetClientAllocs` query, node_endpoint.go:926), reconciles desired
+vs running allocs (client.go:2183 runAllocs), runs them through
+AllocRunners and pushes client-status updates back (`Node.UpdateAlloc`).
+
+Local state is persisted as JSON under the data dir so a restarted client
+restores its alloc runners (reference client/state/state_database.go +
+Restore paths)."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import replace as _replace
+from typing import Dict, List, Optional
+
+from ..structs import (
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Node,
+)
+from .alloc_runner import AllocRunner
+from .drivers import BUILTIN_DRIVERS, new_driver
+from .fingerprint import fingerprint_drivers, run_fingerprinters
+
+
+class Client:
+    def __init__(
+        self,
+        server,
+        node: Optional[Node] = None,
+        data_dir: str = "",
+        heartbeat_interval: float = 10.0,
+        watch_interval: float = 0.05,
+        drivers: Optional[List[str]] = None,
+        fingerprint: bool = True,
+        include_tpu_fingerprint: bool = False,
+    ) -> None:
+        self.server = server
+        self.node = node or Node()
+        self.data_dir = data_dir
+        self.heartbeat_interval = heartbeat_interval
+        self.watch_interval = watch_interval
+        self.drivers = {
+            name: new_driver(name)
+            for name in (drivers or list(BUILTIN_DRIVERS))
+        }
+        if fingerprint:
+            run_fingerprinters(
+                self.node, include_tpu=include_tpu_fingerprint
+            )
+        fingerprint_drivers(self.node, self.drivers)
+
+        self.alloc_runners: Dict[str, AllocRunner] = {}
+        self._known_alloc_index: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._restore()
+        self.server.register_node(self.node)
+        self._stop.clear()
+        for target, name in (
+            (self._heartbeat_loop, "client-heartbeat"),
+            (self._watch_allocs_loop, "client-watch"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        for runner in self.alloc_runners.values():
+            runner.destroy()
+        self._persist()
+
+    # ------------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self.server.heartbeat(self.node.id)
+            except KeyError:
+                self.server.register_node(self.node)
+
+    def _watch_allocs_loop(self) -> None:
+        """(reference client.go:1961 watchAllocations)"""
+        while not self._stop.wait(self.watch_interval):
+            try:
+                self._run_allocs()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _run_allocs(self) -> None:
+        """Diff server-desired allocs against running runners
+        (reference client.go:2183 runAllocs)."""
+        server_allocs = {
+            a.id: a
+            for a in self.server.store.allocs_by_node(self.node.id)
+        }
+        with self._lock:
+            # removals / stops
+            for alloc_id, runner in list(self.alloc_runners.items()):
+                desired = server_allocs.get(alloc_id)
+                if desired is None or desired.desired_status in (
+                    ALLOC_DESIRED_STOP,
+                    "evict",
+                ):
+                    runner.destroy()
+                    if desired is None:
+                        del self.alloc_runners[alloc_id]
+                        self._known_alloc_index.pop(alloc_id, None)
+            # additions
+            for alloc_id, alloc in server_allocs.items():
+                if alloc.terminal_status():
+                    continue
+                if alloc_id in self.alloc_runners:
+                    continue
+                if alloc.job is None:
+                    alloc.job = self.server.store.job_by_id(
+                        alloc.namespace, alloc.job_id
+                    )
+                if alloc.job is None:
+                    continue
+                runner = AllocRunner(
+                    alloc,
+                    data_dir=self.data_dir,
+                    on_update=self._push_alloc_update,
+                    drivers=self.drivers,
+                )
+                self.alloc_runners[alloc_id] = runner
+                runner.run()
+        self._persist()
+
+    def _push_alloc_update(self, alloc: Allocation) -> None:
+        """(reference client.go allocSync -> Node.UpdateAlloc)"""
+        update = _replace(alloc)
+        update.job = None
+        update.modify_time = time.time()
+        # rebind the full job on the server side
+        update.job = self.server.store.job_by_id(
+            alloc.namespace, alloc.job_id
+        )
+        self.server.update_allocs_from_client([update])
+
+    # ------------------------------------------------------------------
+    # local persistence (reference client/state/)
+
+    def _state_path(self) -> Optional[str]:
+        if not self.data_dir:
+            return None
+        return os.path.join(self.data_dir, "client_state.json")
+
+    def _persist(self) -> None:
+        path = self._state_path()
+        if path is None:
+            return
+        with self._lock:
+            state = {
+                "node_id": self.node.id,
+                "allocs": {
+                    alloc_id: runner.task_state_snapshot()
+                    for alloc_id, runner in self.alloc_runners.items()
+                },
+            }
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, path)
+
+    def _restore(self) -> None:
+        path = self._state_path()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return
+        if state.get("node_id"):
+            self.node.id = state["node_id"]
+        # task reattachment: ask each driver to recover; unrecovered
+        # tasks will be restarted by the watch loop on the next diff
+        for alloc_id, tasks in state.get("allocs", {}).items():
+            for task_name, snap in tasks.items():
+                for driver in self.drivers.values():
+                    if driver.recover_task(snap.get("task_id", ""), snap):
+                        break
+
+    # ------------------------------------------------------------------
+
+    def running_allocs(self) -> List[str]:
+        with self._lock:
+            return [
+                alloc_id
+                for alloc_id, r in self.alloc_runners.items()
+                if not r.is_terminal()
+            ]

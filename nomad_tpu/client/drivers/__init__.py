@@ -1,0 +1,38 @@
+"""Task drivers.
+
+The reference runs drivers as separate go-plugin gRPC processes
+(plugins/drivers/driver.go:40 DriverPlugin: Fingerprint, StartTask,
+WaitTask, StopTask, DestroyTask, ...).  Here drivers implement the same
+lifecycle surface in-process behind a registry; the executor boundary
+(subprocess isolation for exec/raw_exec) is the process seam instead.
+"""
+from typing import Dict, Type
+
+from .base import DriverHandle, DriverPlugin, TaskExitResult
+from .mock import MockDriver
+from .exec import ExecDriver, RawExecDriver
+
+BUILTIN_DRIVERS: Dict[str, Type[DriverPlugin]] = {
+    "mock_driver": MockDriver,
+    "exec": ExecDriver,
+    "raw_exec": RawExecDriver,
+}
+
+
+def new_driver(name: str) -> DriverPlugin:
+    cls = BUILTIN_DRIVERS.get(name)
+    if cls is None:
+        raise KeyError(f"unknown driver {name!r}")
+    return cls()
+
+
+__all__ = [
+    "BUILTIN_DRIVERS",
+    "new_driver",
+    "DriverPlugin",
+    "DriverHandle",
+    "TaskExitResult",
+    "MockDriver",
+    "ExecDriver",
+    "RawExecDriver",
+]

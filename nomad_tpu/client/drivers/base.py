@@ -1,0 +1,91 @@
+"""Driver plugin contract (reference plugins/drivers/driver.go:40)."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class TaskExitResult:
+    exit_code: int = 0
+    signal: int = 0
+    oom_killed: bool = False
+    err: Optional[str] = None
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and self.err is None
+
+
+@dataclass
+class TaskConfig:
+    """What StartTask receives: task identity + interpolated config +
+    resources + env."""
+
+    id: str = ""
+    name: str = ""
+    alloc_id: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    alloc_dir: str = ""
+    resources: Optional[object] = None
+
+
+class DriverHandle:
+    """A running task instance (reference drivers' TaskHandle)."""
+
+    def __init__(self, task_id: str) -> None:
+        self.task_id = task_id
+        self._exit = threading.Event()
+        self._result: Optional[TaskExitResult] = None
+
+    def set_exit(self, result: TaskExitResult) -> None:
+        self._result = result
+        self._exit.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[TaskExitResult]:
+        if not self._exit.wait(timeout):
+            return None
+        return self._result
+
+    def is_running(self) -> bool:
+        return not self._exit.is_set()
+
+
+class RecoverableError(Exception):
+    """Start failure the task runner may retry
+    (reference plugins/drivers/errors.go)."""
+
+
+class DriverPlugin:
+    """Lifecycle surface shared by all drivers."""
+
+    name = "base"
+
+    def fingerprint(self) -> Dict[str, str]:
+        """Detected/healthy attributes, merged into the node."""
+        return {f"driver.{self.name}": "1"}
+
+    def start_task(self, cfg: TaskConfig) -> DriverHandle:
+        raise NotImplementedError
+
+    def wait_task(
+        self, task_id: str, timeout: Optional[float] = None
+    ) -> Optional[TaskExitResult]:
+        raise NotImplementedError
+
+    def stop_task(
+        self, task_id: str, timeout: float = 5.0, signal: str = "SIGTERM"
+    ) -> None:
+        raise NotImplementedError
+
+    def destroy_task(self, task_id: str, force: bool = False) -> None:
+        raise NotImplementedError
+
+    def inspect_task(self, task_id: str) -> Optional[DriverHandle]:
+        raise NotImplementedError
+
+    def recover_task(self, task_id: str, handle_state: Dict) -> bool:
+        """Reattach to a task after client restart
+        (reference DriverPlugin.RecoverTask)."""
+        return False

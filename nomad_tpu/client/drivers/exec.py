@@ -1,0 +1,157 @@
+"""Exec drivers: run real subprocesses
+(reference drivers/exec + drivers/rawexec; the reference isolates exec
+tasks with libcontainer — here both variants share the subprocess
+executor, with `exec` additionally entering a private working dir and a
+restricted environment as the portable slice of that isolation).
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import signal as _signal
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from .base import DriverHandle, DriverPlugin, TaskConfig, TaskExitResult
+
+
+class _ProcHandle(DriverHandle):
+    def __init__(self, task_id: str, proc: subprocess.Popen) -> None:
+        super().__init__(task_id)
+        self.proc = proc
+
+
+class RawExecDriver(DriverPlugin):
+    name = "raw_exec"
+
+    def __init__(self) -> None:
+        self.handles: Dict[str, _ProcHandle] = {}
+
+    def _build_command(self, cfg: TaskConfig):
+        command = cfg.config.get("command", "")
+        args = cfg.config.get("args", [])
+        if isinstance(args, str):
+            args = shlex.split(args)
+        return [command] + list(args)
+
+    def _popen(self, cfg: TaskConfig, argv) -> subprocess.Popen:
+        cwd = cfg.alloc_dir or None
+        env = dict(os.environ)
+        env.update(cfg.env or {})
+        stdout = subprocess.DEVNULL
+        stderr = subprocess.DEVNULL
+        if cfg.alloc_dir:
+            os.makedirs(cfg.alloc_dir, exist_ok=True)
+            stdout = open(
+                os.path.join(cfg.alloc_dir, f"{cfg.name}.stdout"), "ab"
+            )
+            stderr = open(
+                os.path.join(cfg.alloc_dir, f"{cfg.name}.stderr"), "ab"
+            )
+        return subprocess.Popen(
+            argv, cwd=cwd, env=env, stdout=stdout, stderr=stderr,
+            start_new_session=True,
+        )
+
+    def start_task(self, cfg: TaskConfig) -> DriverHandle:
+        argv = self._build_command(cfg)
+        try:
+            proc = self._popen(cfg, argv)
+        except OSError as exc:
+            raise RuntimeError(f"failed to start task: {exc}") from exc
+        handle = _ProcHandle(cfg.id, proc)
+        self.handles[cfg.id] = handle
+
+        def waiter():
+            code = proc.wait()
+            if code < 0:
+                handle.set_exit(TaskExitResult(exit_code=0, signal=-code))
+            else:
+                handle.set_exit(TaskExitResult(exit_code=code))
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        return handle
+
+    def wait_task(self, task_id, timeout=None):
+        handle = self.handles.get(task_id)
+        if handle is None:
+            return TaskExitResult(err="unknown task")
+        return handle.wait(timeout)
+
+    def stop_task(self, task_id, timeout=5.0, signal="SIGTERM"):
+        handle = self.handles.get(task_id)
+        if handle is None or not handle.is_running():
+            return
+        sig = getattr(_signal, signal, _signal.SIGTERM)
+        try:
+            os.killpg(os.getpgid(handle.proc.pid), sig)
+        except ProcessLookupError:
+            return
+        if handle.wait(timeout) is None:
+            try:
+                os.killpg(os.getpgid(handle.proc.pid), _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def destroy_task(self, task_id, force=False):
+        handle = self.handles.get(task_id)
+        if handle is not None and handle.is_running():
+            if not force:
+                raise RuntimeError("task is still running")
+            self.stop_task(task_id, timeout=0.5, signal="SIGKILL")
+        self.handles.pop(task_id, None)
+
+    def inspect_task(self, task_id):
+        return self.handles.get(task_id)
+
+    def recover_task(self, task_id, handle_state):
+        pid = handle_state.get("pid")
+        if pid is None:
+            return False
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return False
+        # reattach: poll the pid until it exits
+        handle = DriverHandle(task_id)
+        self.handles[task_id] = handle  # type: ignore[assignment]
+
+        def poll():
+            import time
+
+            while True:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    handle.set_exit(TaskExitResult(exit_code=0))
+                    return
+                time.sleep(0.5)
+
+        threading.Thread(target=poll, daemon=True).start()
+        return True
+
+
+class ExecDriver(RawExecDriver):
+    name = "exec"
+
+    def _popen(self, cfg: TaskConfig, argv) -> subprocess.Popen:
+        # restricted environment: only the task's own env plus PATH
+        cwd = cfg.alloc_dir or None
+        env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+        env.update(cfg.env or {})
+        stdout = subprocess.DEVNULL
+        stderr = subprocess.DEVNULL
+        if cfg.alloc_dir:
+            os.makedirs(cfg.alloc_dir, exist_ok=True)
+            stdout = open(
+                os.path.join(cfg.alloc_dir, f"{cfg.name}.stdout"), "ab"
+            )
+            stderr = open(
+                os.path.join(cfg.alloc_dir, f"{cfg.name}.stderr"), "ab"
+            )
+        return subprocess.Popen(
+            argv, cwd=cwd, env=env, stdout=stdout, stderr=stderr,
+            start_new_session=True,
+        )

@@ -1,0 +1,94 @@
+"""Mock driver: scriptable fault injection for tests
+(reference drivers/mock/driver.go:75-101).
+
+Config keys (all optional):
+  run_for             seconds the task "runs" before exiting (default 0)
+  exit_code           exit code when it exits
+  exit_signal         signal number when it exits
+  start_error         error message raised from start_task
+  start_error_recoverable   whether that error is recoverable
+  start_block_for     seconds start_task blocks before returning
+  kill_after          seconds after a stop request before the task dies
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .base import (
+    DriverHandle,
+    DriverPlugin,
+    RecoverableError,
+    TaskConfig,
+    TaskExitResult,
+)
+
+
+class MockDriver(DriverPlugin):
+    name = "mock_driver"
+
+    def __init__(self) -> None:
+        self.handles: Dict[str, DriverHandle] = {}
+        self._timers: Dict[str, threading.Timer] = {}
+
+    def start_task(self, cfg: TaskConfig) -> DriverHandle:
+        conf = cfg.config
+        if conf.get("start_block_for"):
+            time.sleep(float(conf["start_block_for"]))
+        if conf.get("start_error"):
+            if conf.get("start_error_recoverable"):
+                raise RecoverableError(conf["start_error"])
+            raise RuntimeError(conf["start_error"])
+
+        handle = DriverHandle(cfg.id)
+        self.handles[cfg.id] = handle
+        run_for = float(conf.get("run_for", 0))
+        exit_code = int(conf.get("exit_code", 0))
+        exit_signal = int(conf.get("exit_signal", 0))
+
+        def finish():
+            handle.set_exit(
+                TaskExitResult(exit_code=exit_code, signal=exit_signal)
+            )
+
+        if run_for > 0:
+            timer = threading.Timer(run_for, finish)
+            timer.daemon = True
+            timer.start()
+            self._timers[cfg.id] = timer
+        elif run_for < 0:
+            pass  # run forever until stopped
+        else:
+            finish()
+        return handle
+
+    def wait_task(self, task_id, timeout=None):
+        handle = self.handles.get(task_id)
+        if handle is None:
+            return TaskExitResult(err="unknown task")
+        return handle.wait(timeout)
+
+    def stop_task(self, task_id, timeout=5.0, signal="SIGTERM"):
+        handle = self.handles.get(task_id)
+        if handle is None:
+            return
+        timer = self._timers.pop(task_id, None)
+        if timer is not None:
+            timer.cancel()
+        kill_after = 0.0
+        if handle.is_running():
+            if kill_after > 0:
+                time.sleep(kill_after)
+            handle.set_exit(TaskExitResult(exit_code=0, signal=15))
+
+    def destroy_task(self, task_id, force=False):
+        self.stop_task(task_id)
+        self.handles.pop(task_id, None)
+
+    def inspect_task(self, task_id):
+        return self.handles.get(task_id)
+
+    def recover_task(self, task_id, handle_state):
+        # mock tasks do not survive a client restart
+        return False

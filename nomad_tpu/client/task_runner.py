@@ -1,0 +1,182 @@
+"""Task runner: drive one task through start/wait/restart
+(reference client/allocrunner/taskrunner/task_runner.go:62, restart
+policy logic in taskrunner/restarts/).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..structs import (
+    RestartPolicy,
+    Task,
+    TaskState,
+)
+from .drivers import DriverPlugin, new_driver
+from .drivers.base import RecoverableError, TaskConfig, TaskExitResult
+
+TASK_STATE_PENDING = "pending"
+TASK_STATE_RUNNING = "running"
+TASK_STATE_DEAD = "dead"
+
+
+class RestartTracker:
+    """(reference client/allocrunner/taskrunner/restarts/restarts.go)"""
+
+    def __init__(self, policy: RestartPolicy, batch: bool) -> None:
+        self.policy = policy
+        self.batch = batch
+        self.count = 0
+        self.start_time = time.time()
+
+    def next_restart(self, result: TaskExitResult) -> Optional[float]:
+        """Returns the delay before restarting, or None to stop."""
+        now = time.time()
+        if now - self.start_time > self.policy.interval_s:
+            self.count = 0
+            self.start_time = now
+        # successful batch tasks never restart; services restart on any
+        # exit per their policy
+        if self.batch and result.successful():
+            return None
+        self.count += 1
+        if self.count > self.policy.attempts:
+            if self.policy.mode == "delay":
+                self.count = 0
+                self.start_time = now + self.policy.interval_s
+                return self.policy.interval_s
+            return None
+        return self.policy.delay_s
+
+
+class TaskRunner:
+    def __init__(
+        self,
+        alloc_id: str,
+        task: Task,
+        restart_policy: RestartPolicy,
+        batch: bool,
+        alloc_dir: str = "",
+        env: Optional[Dict[str, str]] = None,
+        on_state_change: Optional[Callable[[str, TaskState], None]] = None,
+        driver: Optional[DriverPlugin] = None,
+    ) -> None:
+        self.alloc_id = alloc_id
+        self.task = task
+        self.alloc_dir = alloc_dir
+        self.env = env or {}
+        self.driver = driver or new_driver(task.driver)
+        self.restarts = RestartTracker(restart_policy, batch)
+        self.state = TaskState(state=TASK_STATE_PENDING)
+        self.on_state_change = on_state_change
+        self.task_id = f"{alloc_id[:8]}-{task.name}"
+        self._kill = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.exit_result: Optional[TaskExitResult] = None
+
+    # ------------------------------------------------------------------
+
+    def _set_state(self, state: str, failed: bool = False, event: str = ""):
+        self.state.state = state
+        self.state.failed = self.state.failed or failed
+        if state == TASK_STATE_RUNNING and not self.state.started_at:
+            self.state.started_at = time.time()
+        if state == TASK_STATE_DEAD:
+            self.state.finished_at = time.time()
+        if event:
+            self.state.events.append(
+                {"type": event, "time": time.time()}
+            )
+        if self.on_state_change is not None:
+            self.on_state_change(self.task.name, self.state)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name=f"task-{self.task_id}", daemon=True
+        )
+        self._thread.start()
+
+    def run(self) -> None:
+        """Start/wait/restart loop (reference task_runner.go:446 Run)."""
+        try:
+            while not self._kill.is_set():
+                cfg = TaskConfig(
+                    id=self.task_id,
+                    name=self.task.name,
+                    alloc_id=self.alloc_id,
+                    config=dict(self.task.config),
+                    env={**self.env, **self.task.env},
+                    alloc_dir=self.alloc_dir,
+                    resources=self.task.resources,
+                )
+                try:
+                    handle = self.driver.start_task(cfg)
+                except RecoverableError as exc:
+                    result = TaskExitResult(exit_code=-1, err=str(exc))
+                    self._set_state(
+                        TASK_STATE_PENDING, event="Driver Failure"
+                    )
+                    if not self._maybe_restart(result):
+                        return
+                    continue
+                except Exception as exc:  # noqa: BLE001
+                    self.exit_result = TaskExitResult(
+                        exit_code=-1, err=str(exc)
+                    )
+                    self._set_state(
+                        TASK_STATE_DEAD, failed=True,
+                        event="Driver Failure",
+                    )
+                    return
+
+                self._set_state(TASK_STATE_RUNNING, event="Started")
+
+                # wait for exit or kill
+                result = None
+                while result is None and not self._kill.is_set():
+                    result = self.driver.wait_task(self.task_id, timeout=0.1)
+                if self._kill.is_set():
+                    self.driver.stop_task(
+                        self.task_id, timeout=self.task.kill_timeout_s
+                    )
+                    result = self.driver.wait_task(self.task_id, 1.0)
+                    self.exit_result = result
+                    self._set_state(TASK_STATE_DEAD, event="Killed")
+                    return
+
+                self.exit_result = result
+                if not self._maybe_restart(result):
+                    return
+        finally:
+            self._done.set()
+
+    def _maybe_restart(self, result: TaskExitResult) -> bool:
+        delay = self.restarts.next_restart(result)
+        if delay is None:
+            self._set_state(
+                TASK_STATE_DEAD,
+                failed=not result.successful(),
+                event="Terminated",
+            )
+            return False
+        self._set_state(
+            TASK_STATE_PENDING, event="Restarting"
+        )
+        # interruptible sleep
+        if self._kill.wait(delay):
+            self._set_state(TASK_STATE_DEAD, event="Killed")
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+
+    def kill(self) -> None:
+        self._kill.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def is_running(self) -> bool:
+        return self.state.state == TASK_STATE_RUNNING

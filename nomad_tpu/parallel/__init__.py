@@ -1,0 +1,7 @@
+from .mesh import (  # noqa: F401
+    make_mesh,
+    sharded_batch_plan,
+    sharded_score_and_select,
+    node_sharding,
+    eval_sharding,
+)

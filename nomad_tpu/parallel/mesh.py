@@ -1,0 +1,224 @@
+"""Device mesh + shardings for multi-chip scheduling.
+
+The reference scales by running `NumSchedulers` workers per server and
+federating regions over Serf/Raft (SURVEY.md section 2.10); the TPU-native
+equivalents are two mesh axes:
+
+* ``evals`` — data parallelism over independent evaluations (the unit the
+  reference parallelizes across workers; broker dedup keeps them
+  conflict-light, the plan applier serializes the rest);
+* ``nodes`` — the long axis: the cluster's node table sharded across
+  chips, the honest analog of sequence/context parallelism for a cluster
+  scheduler (SURVEY.md section 5 "long-context").
+
+Scoring is embarrassingly parallel along ``nodes``; the only cross-shard
+communication is an all-gather of the per-node score/feasibility vectors
+(f32 + bool per node — tens of KB at 10k nodes, ICI-cheap) before the
+selection walk, which every device then computes identically (replicated,
+deterministic).  This keeps the walk bit-identical to the single-chip
+path while the O(N x terms) scoring work and the node-column residency
+scale with the mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f=None, **kwargs):
+    """shard_map with replication checking off: the selection walk's
+    outputs are replicated by construction (post-all-gather), which the
+    static varying-axes inference cannot prove."""
+    for flag in ("check_vma", "check_rep"):
+        try:
+            return _shard_map(f, **kwargs, **{flag: False})
+        except TypeError:
+            continue
+    return _shard_map(f, **kwargs)
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.batch import BatchInputs, plan_picks
+from ..ops.score import ScoreInputs, _limited_walk_argmax, _score_vectors
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    eval_axis: Optional[int] = None,
+    backend: Optional[str] = None,
+) -> Mesh:
+    """Build an (evals, nodes) mesh over the available devices.  When the
+    default backend has fewer devices than requested, fall back to the
+    CPU backend (virtual host devices for sharding tests)."""
+    devices = jax.devices(backend) if backend else jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n_devices:
+                devices = cpu
+        except RuntimeError:
+            pass
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if eval_axis is None:
+        # favor the node axis: it is the long dimension
+        eval_axis = 2 if (n % 2 == 0 and n >= 4) else 1
+    node_axis = n // eval_axis
+    mesh_devices = np.asarray(devices).reshape(eval_axis, node_axis)
+    return Mesh(mesh_devices, axis_names=("evals", "nodes"))
+
+
+def node_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("nodes"))
+
+
+def eval_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("evals"))
+
+
+def sharded_score_and_select(mesh: Mesh, spread_fit: bool = False):
+    """The node-sharded single-placement kernel: each device scores its
+    shard of the node arena locally (O(N/devices) work, columns resident
+    per shard), the per-node score/feasibility vectors are all-gathered
+    over ICI, and the selection walk runs replicated — bit-identical to
+    the single-chip kernel.
+
+    ScoreInputs layout: node-indexed fields sharded P('nodes'); `perm`
+    and scalars replicated.
+    """
+    node_fields = ScoreInputs(
+        cpu_total=P("nodes"),
+        mem_total=P("nodes"),
+        disk_total=P("nodes"),
+        cpu_used=P("nodes"),
+        mem_used=P("nodes"),
+        disk_used=P("nodes"),
+        feasible=P("nodes"),
+        collisions=P("nodes"),
+        penalty=P("nodes"),
+        affinity_score=P("nodes"),
+        spread_boost=P("nodes"),
+        perm=P(),
+        ask_cpu=P(),
+        ask_mem=P(),
+        ask_disk=P(),
+        desired_count=P(),
+        limit=P(),
+        n_candidates=P(),
+    )
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(node_fields,),
+        out_specs=(P(), P(), P(), P()),
+    )
+    def _run(inp: ScoreInputs):
+        feasible, final = _score_vectors(inp, spread_fit)
+        final = jax.lax.all_gather(final, "nodes", axis=0, tiled=True)
+        feasible = jax.lax.all_gather(
+            feasible, "nodes", axis=0, tiled=True
+        )
+        return _limited_walk_argmax(
+            feasible, final, inp.perm, inp.limit, inp.n_candidates
+        )
+
+    return _run
+
+
+def sharded_batch_plan(
+    mesh: Mesh,
+    n_candidates: int,
+    n_picks: int,
+    spread_fit: bool = False,
+):
+    """Build the sharded batched planner: node columns sharded over the
+    ``nodes`` axis, the eval batch sharded over ``evals``; scoring is
+    local, score vectors are all-gathered over ``nodes`` for the
+    replicated selection walk.
+
+    Returns a function
+    ``(cpu_total, mem_total, disk_total, batch: BatchInputs) -> rows[E,P]``
+    whose arguments may be host arrays; shardings are applied via
+    `jax.device_put` inside.
+    """
+
+    col_spec = P("nodes")
+    # per-eval fields: node-indexed ones shard on both axes, scalars on
+    # evals only
+    batch_spec = BatchInputs(
+        feasible=P("evals", "nodes"),
+        base_cpu_used=P("evals", "nodes"),
+        base_mem_used=P("evals", "nodes"),
+        base_disk_used=P("evals", "nodes"),
+        base_collisions=P("evals", "nodes"),
+        penalty=P("evals", "nodes"),
+        affinity_score=P("evals", "nodes"),
+        perm=P("evals", "nodes"),
+        ask_cpu=P("evals"),
+        ask_mem=P("evals"),
+        ask_disk=P("evals"),
+        desired_count=P("evals"),
+        limit=P("evals"),
+        distinct_hosts=P("evals"),
+    )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(col_spec, col_spec, col_spec, batch_spec),
+        out_specs=P("evals"),
+    )
+    def _run(cpu_total, mem_total, disk_total, batch: BatchInputs):
+        # gather full node columns over the nodes axis (ICI all-gather);
+        # the walk needs the global ordering
+        gather = lambda x: jax.lax.all_gather(
+            x, "nodes", axis=0, tiled=True
+        )
+        cpu_t = gather(cpu_total)
+        mem_t = gather(mem_total)
+        disk_t = gather(disk_total)
+
+        def one_eval(b: BatchInputs):
+            full = BatchInputs(
+                feasible=gather(b.feasible),
+                base_cpu_used=gather(b.base_cpu_used),
+                base_mem_used=gather(b.base_mem_used),
+                base_disk_used=gather(b.base_disk_used),
+                base_collisions=gather(b.base_collisions),
+                penalty=gather(b.penalty),
+                affinity_score=gather(b.affinity_score),
+                perm=gather(b.perm),
+                ask_cpu=b.ask_cpu,
+                ask_mem=b.ask_mem,
+                ask_disk=b.ask_disk,
+                desired_count=b.desired_count,
+                limit=b.limit,
+                distinct_hosts=b.distinct_hosts,
+            )
+            return plan_picks(
+                cpu_t,
+                mem_t,
+                disk_t,
+                full,
+                jnp.asarray(n_candidates, jnp.int32),
+                n_picks,
+                spread_fit,
+            )
+
+        return jax.vmap(one_eval)(batch)
+
+    def run(cpu_total, mem_total, disk_total, batch: BatchInputs):
+        return _run(cpu_total, mem_total, disk_total, batch)
+
+    return run

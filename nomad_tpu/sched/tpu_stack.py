@@ -34,6 +34,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set, Tuple
 
+import jax
 import numpy as np
 
 from ..ops.constraints import MaskCompiler
@@ -315,9 +316,10 @@ class TPUGenericStack:
         )
 
         while True:
-            chosen_row, _score, _n, pulls = score_and_select(
-                inputs, spread_fit=spread_fit
-            )
+            outs = score_and_select(inputs, spread_fit=spread_fit)
+            # one device->host sync for all outputs: device round trips
+            # dominate per-select latency on tunneled hardware
+            chosen_row, _score, _n, pulls = jax.device_get(outs)
             chosen_row = int(chosen_row)
             if chosen_row == NO_NODE:
                 if n_cand:

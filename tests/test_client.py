@@ -1,0 +1,306 @@
+"""Client runtime tests: drivers, task/alloc runners, full client<->server
+loop (reference model: drivers/mock tests, task_runner_test.go,
+client_test.go).
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import AllocRunner, Client, TaskRunner
+from nomad_tpu.client.drivers import MockDriver, RawExecDriver
+from nomad_tpu.client.drivers.base import TaskConfig
+from nomad_tpu.client.fingerprint import run_fingerprinters
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    Node,
+    RestartPolicy,
+    Task,
+)
+
+
+def wait_until(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def test_mock_driver_run_for_and_exit_code():
+    d = MockDriver()
+    h = d.start_task(
+        TaskConfig(id="t1", config={"run_for": 0.05, "exit_code": 2})
+    )
+    res = d.wait_task("t1", timeout=2)
+    assert res.exit_code == 2
+
+
+def test_mock_driver_start_error():
+    d = MockDriver()
+    with pytest.raises(RuntimeError):
+        d.start_task(TaskConfig(id="t1", config={"start_error": "boom"}))
+
+
+def test_raw_exec_driver_real_process(tmp_path):
+    d = RawExecDriver()
+    cfg = TaskConfig(
+        id="t1",
+        name="echo",
+        config={"command": "/bin/sh", "args": ["-c", "echo hi; exit 3"]},
+        alloc_dir=str(tmp_path),
+    )
+    d.start_task(cfg)
+    res = d.wait_task("t1", timeout=5)
+    assert res.exit_code == 3
+    out = (tmp_path / "echo.stdout").read_bytes()
+    assert b"hi" in out
+
+
+def test_raw_exec_driver_stop(tmp_path):
+    d = RawExecDriver()
+    cfg = TaskConfig(
+        id="t1",
+        name="sleep",
+        config={"command": "/bin/sleep", "args": ["30"]},
+        alloc_dir=str(tmp_path),
+    )
+    h = d.start_task(cfg)
+    assert h.is_running()
+    d.stop_task("t1", timeout=2)
+    res = d.wait_task("t1", timeout=2)
+    assert res is not None and res.signal != 0
+
+
+# ---------------------------------------------------------------------------
+# task runner
+# ---------------------------------------------------------------------------
+
+
+def _task(**config):
+    return Task(name="t", driver="mock_driver", config=config)
+
+
+def test_task_runner_completes():
+    tr = TaskRunner(
+        "alloc1",
+        _task(run_for=0.05, exit_code=0),
+        RestartPolicy(attempts=0, interval_s=10, delay_s=0.01),
+        batch=True,
+    )
+    tr.start()
+    assert tr.wait(5)
+    assert tr.state.state == "dead"
+    assert not tr.state.failed
+
+
+def test_task_runner_restarts_then_fails():
+    tr = TaskRunner(
+        "alloc1",
+        _task(run_for=0.01, exit_code=1),
+        RestartPolicy(attempts=2, interval_s=100, delay_s=0.01, mode="fail"),
+        batch=True,
+    )
+    tr.start()
+    assert tr.wait(5)
+    assert tr.state.failed
+    # 1 initial + 2 restarts = 3 starts
+    starts = [e for e in tr.state.events if e["type"] == "Started"]
+    assert len(starts) == 3
+
+
+def test_task_runner_kill():
+    tr = TaskRunner(
+        "alloc1",
+        _task(run_for=-1),
+        RestartPolicy(attempts=0, interval_s=10, delay_s=0.01),
+        batch=False,
+    )
+    tr.start()
+    assert wait_until(lambda: tr.is_running())
+    tr.kill()
+    assert tr.wait(5)
+    assert tr.state.state == "dead"
+
+
+# ---------------------------------------------------------------------------
+# alloc runner
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_runner_client_status_fanin():
+    job = mock.job()
+    job.task_groups[0].restart_policy = RestartPolicy(
+        attempts=0, interval_s=10, delay_s=0.01, mode="fail"
+    )
+    job.task_groups[0].tasks[0] = Task(
+        name="web", driver="mock_driver", config={"run_for": 0.05}
+    )
+    alloc = mock.alloc(job=job)
+    updates = []
+    runner = AllocRunner(alloc, on_update=lambda a: updates.append(a))
+    runner.run()
+    assert runner.wait(5)
+    assert alloc.client_status == "complete"
+    assert updates
+
+
+def test_alloc_runner_failed_task_fails_alloc():
+    job = mock.job()
+    job.task_groups[0].restart_policy = RestartPolicy(
+        attempts=0, interval_s=10, delay_s=0.01
+    )
+    job.task_groups[0].tasks[0] = Task(
+        name="web", driver="mock_driver",
+        config={"run_for": 0.02, "exit_code": 1},
+    )
+    alloc = mock.alloc(job=job)
+    runner = AllocRunner(alloc)
+    runner.run()
+    assert runner.wait(5)
+    assert alloc.client_status == "failed"
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_populates_node():
+    n = Node()
+    n.node_resources.cpu = 0
+    n.node_resources.memory_mb = 0
+    n.node_resources.disk_mb = 0
+    run_fingerprinters(n, include_tpu=False)
+    assert n.attributes["kernel.name"] == "linux"
+    assert int(n.attributes["cpu.numcores"]) >= 1
+    assert n.node_resources.cpu > 0
+    assert n.node_resources.memory_mb > 0
+    assert "unique.hostname" in n.attributes
+
+
+# ---------------------------------------------------------------------------
+# full client <-> server loop
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=3)
+    server.start()
+    clients = []
+
+    def add_client(**kwargs):
+        node = mock.node()
+        c = Client(
+            server,
+            node=node,
+            fingerprint=False,
+            heartbeat_interval=5.0,
+            **kwargs,
+        )
+        c.start()
+        clients.append(c)
+        return c
+
+    yield server, add_client
+    for c in clients:
+        c.stop()
+    server.stop()
+
+
+def test_client_runs_scheduled_job(cluster):
+    server, add_client = cluster
+    c1 = add_client()
+    c2 = add_client()
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0] = Task(
+        name="web", driver="mock_driver", config={"run_for": -1}
+    )
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    assert wait_until(
+        lambda: sum(
+            a.client_status == "running"
+            for a in server.store.allocs_by_job(job.namespace, job.id)
+        )
+        == 2,
+        timeout=10,
+    )
+
+
+def test_client_batch_job_completes(cluster):
+    server, add_client = cluster
+    add_client()
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0] = Task(
+        name="work", driver="mock_driver", config={"run_for": 0.05}
+    )
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    assert wait_until(
+        lambda: any(
+            a.client_status == "complete"
+            for a in server.store.allocs_by_job(job.namespace, job.id)
+        ),
+        timeout=10,
+    )
+
+
+def test_client_failed_alloc_reschedules(cluster):
+    server, add_client = cluster
+    add_client()
+    add_client()
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].restart_policy = RestartPolicy(
+        attempts=0, interval_s=10, delay_s=0.01
+    )
+    from nomad_tpu.structs import ReschedulePolicy
+
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=3,
+        interval_s=300,
+        delay_s=0.0,
+        delay_function="constant",
+        unlimited=False,
+    )
+    job.task_groups[0].tasks[0] = Task(
+        name="web", driver="mock_driver",
+        config={"run_for": 0.05, "exit_code": 1},
+    )
+    server.register_job(job)
+    # the failed alloc triggers an alloc-failure eval which replaces it
+    assert wait_until(
+        lambda: len(
+            server.store.allocs_by_job(job.namespace, job.id)
+        )
+        >= 2,
+        timeout=15,
+    )
+
+
+def test_client_stop_job_stops_tasks(cluster):
+    server, add_client = cluster
+    client = add_client()
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0] = Task(
+        name="web", driver="mock_driver", config={"run_for": -1}
+    )
+    server.register_job(job)
+    assert wait_until(
+        lambda: len(client.running_allocs()) == 1, timeout=10
+    )
+    server.deregister_job(job.namespace, job.id)
+    assert wait_until(
+        lambda: len(client.running_allocs()) == 0, timeout=10
+    )
