@@ -1,0 +1,304 @@
+"""Deployment watcher: drives rolling updates
+(reference nomad/deploymentwatcher/deployments_watcher.go:60).
+
+Watches active deployments, derives allocation health, updates per-group
+deployment state, creates follow-up evals so the scheduler places the
+next max_parallel batch, promotes canaries (manually or auto_promote),
+fails deployments on unhealthy allocs or missed progress deadlines, and
+auto-reverts the job to the latest stable version when configured.
+
+Health derivation: the reference's client-side allochealth hooks report
+health over RPC (deployments_watcher.go:336 SetAllocHealth).  Clients
+here push task states; the watcher applies the "task_states" health
+check: an alloc is healthy once all its tasks have been running for
+min_healthy_time, unhealthy if it fails.  `set_alloc_health` remains the
+external override hook ("checks"-based health can feed it)."""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace as _replace
+from typing import Dict, List, Optional
+
+from ..structs import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    AllocDeploymentStatus,
+    Deployment,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    Evaluation,
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+)
+
+DESC_PROGRESS_DEADLINE = "Failed due to progress deadline"
+DESC_UNHEALTHY_ALLOCS = "Failed due to unhealthy allocations"
+DESC_PROMOTED = "Deployment promoted"
+DESC_SUCCESSFUL = "Deployment completed successfully"
+
+
+class DeploymentWatcher:
+    def __init__(self, server, interval: float = 0.1) -> None:
+        self.server = server
+        self.store = server.store
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # deployment id -> last time healthy count improved
+        self._last_progress: Dict[str, float] = {}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="deployment-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                for deployment in list(self.store.deployments.values()):
+                    if deployment.active():
+                        self._watch_one(deployment)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+
+    def _watch_one(self, d: Deployment) -> None:
+        job = self.store.job_by_id(d.namespace, d.job_id)
+        if job is None or job.version != d.job_version:
+            return
+
+        allocs = [
+            a
+            for a in self.store.allocs_by_job(d.namespace, d.job_id)
+            if a.deployment_id == d.id
+        ]
+        now = time.time()
+        changed = False
+        unhealthy_seen = False
+
+        for alloc in allocs:
+            ds = alloc.deployment_status
+            if ds is not None and ds.healthy is not None:
+                if ds.is_unhealthy():
+                    unhealthy_seen = True
+                continue
+            health = self._derive_health(job, alloc, now)
+            if health is None:
+                continue
+            if alloc.deployment_status is None:
+                alloc.deployment_status = AllocDeploymentStatus()
+            alloc.deployment_status.healthy = health
+            alloc.deployment_status.timestamp = now
+            changed = True
+            if health is False:
+                unhealthy_seen = True
+
+        # recompute per-group counters
+        healthy_total = 0
+        for group, state in d.task_groups.items():
+            group_allocs = [a for a in allocs if a.task_group == group]
+            state.placed_allocs = len(group_allocs)
+            state.healthy_allocs = sum(
+                1
+                for a in group_allocs
+                if a.deployment_status is not None
+                and a.deployment_status.is_healthy()
+            )
+            state.unhealthy_allocs = sum(
+                1
+                for a in group_allocs
+                if a.deployment_status is not None
+                and a.deployment_status.is_unhealthy()
+            )
+            healthy_total += state.healthy_allocs
+
+        entry = self._last_progress.get(d.id)
+        if entry is None or healthy_total > entry[0]:
+            self._last_progress[d.id] = (healthy_total, now)
+
+        if unhealthy_seen:
+            self._fail_deployment(d, job, DESC_UNHEALTHY_ALLOCS)
+            return
+
+        # progress deadline
+        for group, state in d.task_groups.items():
+            deadline = state.progress_deadline_s
+            if deadline <= 0:
+                continue
+            entry = self._last_progress.get(d.id)
+            last = entry[1] if entry is not None else now
+            if (
+                state.healthy_allocs
+                < max(state.desired_total, state.desired_canaries)
+                and now - last > deadline
+            ):
+                self._fail_deployment(d, job, DESC_PROGRESS_DEADLINE)
+                return
+
+        # auto-promotion: all canaries healthy
+        if d.requires_promotion() and d.has_auto_promote():
+            ready = all(
+                s.desired_canaries == 0
+                or s.healthy_allocs >= s.desired_canaries
+                for s in d.task_groups.values()
+            )
+            if ready:
+                self.promote(d.id)
+                return
+
+        # completion: every group fully healthy and promoted
+        complete = all(
+            s.healthy_allocs >= s.desired_total
+            and (s.desired_canaries == 0 or s.promoted)
+            for s in d.task_groups.values()
+        ) and bool(d.task_groups)
+        if complete:
+            d.status = DEPLOYMENT_STATUS_SUCCESSFUL
+            d.status_description = DESC_SUCCESSFUL
+            self.store.upsert_deployment(d)
+            # the deployed version becomes the stable version
+            job.stable = True
+            self._last_progress.pop(d.id, None)
+            self._create_eval(d, job)
+            return
+
+        if changed:
+            self.store.upsert_deployment(d)
+            # health progress unblocks the next max_parallel batch
+            self._create_eval(d, job)
+
+    # ------------------------------------------------------------------
+
+    def _derive_health(self, job, alloc, now: float) -> Optional[bool]:
+        tg = job.lookup_task_group(alloc.task_group)
+        update = tg.update if tg is not None else None
+        min_healthy = (
+            update.min_healthy_time_s if update is not None else 10.0
+        )
+        deadline = (
+            update.healthy_deadline_s if update is not None else 300.0
+        )
+        if alloc.client_status == ALLOC_CLIENT_STATUS_FAILED:
+            return False
+        if alloc.client_status == ALLOC_CLIENT_STATUS_RUNNING:
+            started = max(
+                (s.started_at for s in alloc.task_states.values()),
+                default=alloc.create_time,
+            ) or alloc.create_time
+            if now - started >= min_healthy:
+                return True
+        if now - alloc.create_time > deadline:
+            return False
+        return None
+
+    # ------------------------------------------------------------------
+
+    def set_alloc_health(
+        self, alloc_ids: List[str], healthy: bool
+    ) -> None:
+        """(reference Deployment.SetAllocHealth RPC)"""
+        now = time.time()
+        for alloc_id in alloc_ids:
+            alloc = self.store.alloc_by_id(alloc_id)
+            if alloc is None:
+                continue
+            if alloc.deployment_status is None:
+                alloc.deployment_status = AllocDeploymentStatus()
+            alloc.deployment_status.healthy = healthy
+            alloc.deployment_status.timestamp = now
+
+    def promote(self, deployment_id: str, groups: Optional[List[str]] = None):
+        """(reference deployments_watcher.go PromoteDeployment)"""
+        d = self.store.deployment_by_id(deployment_id)
+        if d is None or not d.active():
+            return
+        job = self.store.job_by_id(d.namespace, d.job_id)
+        for group, state in d.task_groups.items():
+            if groups is not None and group not in groups:
+                continue
+            unhealthy_canaries = state.desired_canaries - min(
+                state.healthy_allocs, state.desired_canaries
+            )
+            if state.desired_canaries and unhealthy_canaries > 0:
+                raise ValueError(
+                    f"group {group!r} has unpromotable canaries"
+                )
+            state.promoted = True
+        d.status_description = DESC_PROMOTED
+        self.store.upsert_deployment(d)
+        if job is not None:
+            self._create_eval(d, job)
+
+    def fail(self, deployment_id: str) -> None:
+        d = self.store.deployment_by_id(deployment_id)
+        if d is None or not d.active():
+            return
+        job = self.store.job_by_id(d.namespace, d.job_id)
+        self._fail_deployment(d, job, "Deployment marked as failed")
+
+    def pause(self, deployment_id: str, pause: bool) -> None:
+        d = self.store.deployment_by_id(deployment_id)
+        if d is None:
+            return
+        from ..structs import DEPLOYMENT_STATUS_PAUSED
+
+        if pause and d.status == DEPLOYMENT_STATUS_RUNNING:
+            d.status = DEPLOYMENT_STATUS_PAUSED
+        elif not pause and d.status == DEPLOYMENT_STATUS_PAUSED:
+            d.status = DEPLOYMENT_STATUS_RUNNING
+        self.store.upsert_deployment(d)
+
+    # ------------------------------------------------------------------
+
+    def _fail_deployment(self, d: Deployment, job, desc: str) -> None:
+        d.status = DEPLOYMENT_STATUS_FAILED
+        d.status_description = desc
+        self.store.upsert_deployment(d)
+        self._last_progress.pop(d.id, None)
+
+        # auto-revert to the latest stable version
+        if job is not None and any(
+            s.auto_revert for s in d.task_groups.values()
+        ):
+            stable = self._latest_stable_version(job)
+            if stable is not None and stable.version != job.version:
+                reverted = _replace(stable)
+                reverted.stable = True
+                self.store.upsert_job(reverted)
+                job = reverted
+        if job is not None:
+            self._create_eval(d, job)
+
+    def _latest_stable_version(self, job):
+        versions = self.store.job_versions.get(
+            (job.namespace, job.id), []
+        )
+        for v in versions:
+            if v.stable and v.version != job.version:
+                return v
+        return None
+
+    def _create_eval(self, d: Deployment, job) -> None:
+        ev = Evaluation(
+            namespace=d.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+            job_id=d.job_id,
+            deployment_id=d.id,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.store.upsert_evals([ev])
+        self.server.on_eval_update(ev)

@@ -1,0 +1,184 @@
+"""Node drainer: job-aware migration of allocations off draining nodes
+(reference nomad/drainer/drainer.go:130, watch_jobs.go, drain_heap.go).
+
+For each draining node, allocations migrate in batches bounded by each
+task group's `migrate` stanza max_parallel: a new batch is released only
+when the previously-migrated allocs' replacements are healthy elsewhere.
+System-job allocs drain last (after all service/batch allocs are gone)
+unless ignore_system_jobs is set.  A drain deadline force-migrates
+whatever remains.  When a node has nothing left to drain, its drain flag
+clears and the node stays ineligible.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import (
+    ALLOC_CLIENT_STATUS_RUNNING,
+    Allocation,
+    JOB_TYPE_SYSTEM,
+    Node,
+)
+
+
+class Drainer:
+    def __init__(self, server, interval: float = 0.1) -> None:
+        self.server = server
+        self.store = server.store
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="drainer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                for node in list(self.store.iter_nodes()):
+                    if node.drain:
+                        self._drain_node(node)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+
+    def _drain_node(self, node: Node) -> None:
+        now = time.time()
+        strategy = node.drain_strategy
+        deadline_hit = (
+            strategy is not None
+            and strategy.force_deadline_unix > 0
+            and now >= strategy.force_deadline_unix
+        )
+        ignore_system = (
+            strategy is not None and strategy.ignore_system_jobs
+        )
+
+        allocs = [
+            a
+            for a in self.store.allocs_by_node(node.id)
+            if not a.terminal_status()
+        ]
+        service_batch = [
+            a
+            for a in allocs
+            if a.job is None or a.job.type != JOB_TYPE_SYSTEM
+        ]
+        system = [
+            a
+            for a in allocs
+            if a.job is not None and a.job.type == JOB_TYPE_SYSTEM
+        ]
+
+        if not allocs or (not service_batch and ignore_system):
+            self._finish_drain(node)
+            return
+
+        marked_any = False
+        if deadline_hit:
+            # force-migrate everything remaining
+            for alloc in service_batch + ([] if ignore_system else system):
+                if not alloc.desired_transition.should_migrate():
+                    alloc.desired_transition.migrate = True
+                    marked_any = True
+            if marked_any:
+                self._notify(allocs)
+            if not service_batch and not system:
+                self._finish_drain(node)
+            return
+
+        # per (job, tg) batching bounded by migrate.max_parallel
+        by_group: Dict[Tuple[str, str, str], List[Allocation]] = {}
+        for alloc in service_batch:
+            key = (alloc.namespace, alloc.job_id, alloc.task_group)
+            by_group.setdefault(key, []).append(alloc)
+
+        for (ns, job_id, tg_name), group_allocs in by_group.items():
+            job = self.store.job_by_id(ns, job_id)
+            tg = job.lookup_task_group(tg_name) if job else None
+            max_parallel = 1
+            if tg is not None and tg.migrate is not None:
+                max_parallel = max(1, tg.migrate.max_parallel)
+
+            # in-flight = allocs of this group (anywhere) already marked
+            # for migration and not yet replaced by a healthy alloc
+            in_flight = 0
+            for a in self.store.allocs_by_job(ns, job_id):
+                if a.task_group != tg_name:
+                    continue
+                if (
+                    not a.terminal_status()
+                    and a.desired_transition.should_migrate()
+                ):
+                    in_flight += 1
+            budget = max_parallel - in_flight
+            for alloc in group_allocs:
+                if budget <= 0:
+                    break
+                if alloc.desired_transition.should_migrate():
+                    continue
+                alloc.desired_transition.migrate = True
+                marked_any = True
+                budget -= 1
+
+        # system allocs drain only after everything else is gone
+        if not service_batch and system and not ignore_system:
+            for alloc in system:
+                if not alloc.desired_transition.should_migrate():
+                    alloc.desired_transition.migrate = True
+                    marked_any = True
+
+        if marked_any:
+            self._notify(allocs)
+        elif not allocs:
+            self._finish_drain(node)
+
+    # ------------------------------------------------------------------
+
+    def _notify(self, allocs: List[Allocation]) -> None:
+        """Persist the transition marks and create migration evals."""
+        self.store.upsert_allocs(allocs)
+        seen = set()
+        for alloc in allocs:
+            if not alloc.desired_transition.should_migrate():
+                continue
+            key = (alloc.namespace, alloc.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            job = self.store.job_by_id(*key)
+            if job is None:
+                continue
+            from ..structs import Evaluation, EVAL_STATUS_PENDING
+
+            ev = Evaluation(
+                namespace=alloc.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by="node-drain",
+                job_id=alloc.job_id,
+                status=EVAL_STATUS_PENDING,
+            )
+            self.store.upsert_evals([ev])
+            self.server.on_eval_update(ev)
+
+    def _finish_drain(self, node: Node) -> None:
+        """(reference drainer.go handleDoneNode: drain clears, node stays
+        ineligible)"""
+        node.drain = False
+        node.drain_strategy = None
+        self.store.upsert_node(node)

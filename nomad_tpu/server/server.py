@@ -40,6 +40,8 @@ from ..structs import (
     NODE_STATUS_READY,
 )
 from .blocked_evals import BlockedEvals
+from .deployment_watcher import DeploymentWatcher
+from .drainer import Drainer
 from .eval_broker import EvalBroker
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
@@ -66,6 +68,8 @@ class Server:
         self.workers: List[Worker] = [
             Worker(self, seed=seed) for _ in range(num_schedulers)
         ]
+        self.deployment_watcher = DeploymentWatcher(self)
+        self.drainer = Drainer(self)
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._running = False
@@ -79,11 +83,15 @@ class Server:
         self.applier.start()
         for worker in self.workers:
             worker.start()
+        self.deployment_watcher.start()
+        self.drainer.start()
         self._running = True
         self.restore_evals()
 
     def stop(self) -> None:
         self._running = False
+        self.deployment_watcher.stop()
+        self.drainer.stop()
         for worker in self.workers:
             worker.stop()
         self.applier.stop()

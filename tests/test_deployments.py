@@ -1,0 +1,284 @@
+"""Deployment rolling-update and node-drain integration tests
+(reference model: nomad/deploymentwatcher/deployments_watcher_test.go,
+nomad/drainer_int_test.go).
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    DrainStrategy,
+    MigrateStrategy,
+    Task,
+    UpdateStrategy,
+)
+
+
+def wait_until(cond, timeout=10.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def server():
+    s = Server(num_schedulers=2, heartbeat_ttl=60.0, seed=11)
+    # fast health checks for tests
+    s.deployment_watcher.interval = 0.05
+    s.drainer.interval = 0.05
+    s.start()
+    yield s
+    s.stop()
+
+
+def _deployed_job(count=4, canary=0, max_parallel=2, auto_revert=False,
+                  auto_promote=False):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].update = UpdateStrategy(
+        max_parallel=max_parallel,
+        min_healthy_time_s=0.05,
+        healthy_deadline_s=5.0,
+        progress_deadline_s=30.0,
+        canary=canary,
+        auto_revert=auto_revert,
+        auto_promote=auto_promote,
+    )
+    return job
+
+
+def _mark_running(server, job):
+    """Simulate clients reporting the allocs running."""
+    allocs = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status() and a.client_status == "pending"
+    ]
+    for a in allocs:
+        a.client_status = "running"
+    if allocs:
+        server.store.upsert_allocs(allocs)
+    return allocs
+
+
+def test_deployment_created_and_completes(server):
+    for _ in range(4):
+        server.register_node(mock.node())
+    job = _deployed_job()
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+
+    # v0 of a job: no running allocs before, so a deployment is created
+    d = server.store.latest_deployment_by_job(job.namespace, job.id)
+    assert d is not None
+    assert d.task_groups["web"].desired_total == 4
+
+    assert wait_until(
+        lambda: bool(_mark_running(server, job)) or True, timeout=1
+    )
+    _mark_running(server, job)
+    assert wait_until(
+        lambda: server.store.latest_deployment_by_job(
+            job.namespace, job.id
+        ).status
+        == "successful",
+        timeout=15,
+    )
+    assert server.store.job_by_id(job.namespace, job.id).stable
+
+
+def test_rolling_update_respects_max_parallel(server):
+    for _ in range(6):
+        server.register_node(mock.node())
+    job = _deployed_job(count=4, max_parallel=1)
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    _mark_running(server, job)
+    assert wait_until(
+        lambda: server.store.latest_deployment_by_job(
+            job.namespace, job.id
+        ).status
+        == "successful",
+        timeout=15,
+    )
+
+    # register v1 with a changed task config -> destructive update
+    job2 = _deployed_job(count=4, max_parallel=1)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/sleep"}
+    server.register_job(job2)
+    assert server.drain_to_idle(10)
+
+    # only max_parallel=1 alloc may be destroyed before replacements
+    # become healthy
+    stopped = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if a.desired_status == "stop"
+    ]
+    assert len(stopped) == 1
+
+    # drive the rolling update to completion by marking each new batch
+    # running
+    def pump():
+        _mark_running(server, job)
+        d = server.store.latest_deployment_by_job(job.namespace, job.id)
+        return d.job_version == 1 and d.status == "successful"
+
+    assert wait_until(pump, timeout=20)
+    live = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(live) == 4
+    assert all(a.job.version == 1 for a in live if a.job)
+
+
+def test_canary_deployment_requires_promotion(server):
+    for _ in range(6):
+        server.register_node(mock.node())
+    job = _deployed_job(count=3, canary=1, max_parallel=1)
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    _mark_running(server, job)
+    assert wait_until(
+        lambda: server.store.latest_deployment_by_job(
+            job.namespace, job.id
+        ).status
+        == "successful",
+        timeout=15,
+    )
+
+    job2 = _deployed_job(count=3, canary=1, max_parallel=1)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/true"}
+    server.register_job(job2)
+    assert server.drain_to_idle(10)
+
+    d = server.store.latest_deployment_by_job(job.namespace, job.id)
+    assert d.job_version == 1
+    state = d.task_groups["web"]
+    assert state.desired_canaries == 1
+    # v0 allocs still running while the canary is unpromoted
+    v0_live = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status() and a.job and a.job.version == 0
+    ]
+    assert len(v0_live) == 3
+
+    _mark_running(server, job)
+    time.sleep(0.3)
+    _mark_running(server, job)
+    # promote and drive to completion
+    assert wait_until(
+        lambda: d.task_groups["web"].healthy_allocs >= 1, timeout=10
+    )
+    server.deployment_watcher.promote(d.id)
+
+    def pump():
+        _mark_running(server, job)
+        dd = server.store.latest_deployment_by_job(job.namespace, job.id)
+        return dd.status == "successful" and dd.job_version == 1
+
+    assert wait_until(pump, timeout=20)
+
+
+def test_failed_deployment_auto_reverts(server):
+    for _ in range(6):
+        server.register_node(mock.node())
+    job = _deployed_job(count=2, max_parallel=2, auto_revert=True)
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    _mark_running(server, job)
+    assert wait_until(
+        lambda: server.store.latest_deployment_by_job(
+            job.namespace, job.id
+        ).status
+        == "successful",
+        timeout=15,
+    )
+
+    job2 = _deployed_job(count=2, max_parallel=2, auto_revert=True)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/false"}
+    server.register_job(job2)
+    assert server.drain_to_idle(10)
+
+    # the v1 allocs fail health
+    v1 = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if a.job and a.job.version == 1 and not a.terminal_status()
+    ]
+    assert v1
+    for a in v1:
+        a.client_status = "failed"
+    server.store.upsert_allocs(v1)
+
+    assert wait_until(
+        lambda: any(
+            d.status == "failed"
+            for d in server.store.deployments_by_job(
+                job.namespace, job.id
+            )
+        ),
+        timeout=10,
+    )
+    # auto-revert re-registered the stable version as v2
+    assert wait_until(
+        lambda: server.store.job_by_id(job.namespace, job.id).version
+        >= 2,
+        timeout=10,
+    )
+    reverted = server.store.job_by_id(job.namespace, job.id)
+    assert reverted.task_groups[0].tasks[0].config == {
+        "command": "/bin/date"
+    }
+
+
+def test_node_drain_migrates_allocs(server):
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        server.register_node(n)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=2)
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    _mark_running(server, job)
+
+    victim = server.store.allocs_by_job(job.namespace, job.id)[0].node_id
+    server.update_node_drain(
+        victim, True, DrainStrategy(force_deadline_unix=time.time() + 30)
+    )
+
+    assert wait_until(
+        lambda: not [
+            a
+            for a in server.store.allocs_by_node(victim)
+            if not a.terminal_status()
+        ],
+        timeout=15,
+    )
+    # node finished draining: flag cleared, stays ineligible
+    assert wait_until(
+        lambda: not server.store.node_by_id(victim).drain, timeout=10
+    )
+    assert (
+        server.store.node_by_id(victim).scheduling_eligibility
+        == "ineligible"
+    )
+    live = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(live) == 4
+    assert all(a.node_id != victim for a in live)
