@@ -1,0 +1,166 @@
+"""Core scheduler: garbage collection of terminal state
+(reference nomad/core_sched.go:34; eval type "_core",
+structs.go:3707).
+
+Registered like any scheduler and driven by periodic `_core` evals the
+leader enqueues (reference leader.go schedulePeriodic), so GC flows
+through the same broker/worker machinery as placements.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..structs import (
+    Evaluation,
+    EVAL_STATUS_COMPLETE,
+    JOB_STATUS_DEAD,
+)
+
+# GC job IDs carried in the eval's job_id (reference core_sched.go:43-60)
+CORE_JOB_EVAL_GC = "eval-gc"
+CORE_JOB_JOB_GC = "job-gc"
+CORE_JOB_NODE_GC = "node-gc"
+CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
+CORE_JOB_FORCE_GC = "force-gc"
+
+DEFAULT_EVAL_GC_THRESHOLD_S = 3600.0
+DEFAULT_JOB_GC_THRESHOLD_S = 4 * 3600.0
+DEFAULT_NODE_GC_THRESHOLD_S = 24 * 3600.0
+DEFAULT_DEPLOYMENT_GC_THRESHOLD_S = 3600.0
+
+
+class CoreScheduler:
+    def __init__(
+        self,
+        state,
+        planner,
+        eval_gc_threshold: float = DEFAULT_EVAL_GC_THRESHOLD_S,
+        job_gc_threshold: float = DEFAULT_JOB_GC_THRESHOLD_S,
+        node_gc_threshold: float = DEFAULT_NODE_GC_THRESHOLD_S,
+        deployment_gc_threshold: float = DEFAULT_DEPLOYMENT_GC_THRESHOLD_S,
+        **_kwargs,
+    ) -> None:
+        self.snap = state
+        self.planner = planner
+        self.eval_gc_threshold = eval_gc_threshold
+        self.job_gc_threshold = job_gc_threshold
+        self.node_gc_threshold = node_gc_threshold
+        self.deployment_gc_threshold = deployment_gc_threshold
+
+    # the snapshot delegates to the live store in this control plane;
+    # GC mutates through the store directly (the reference applies raft
+    # dereg/reap messages)
+    @property
+    def store(self):
+        return self.snap._store
+
+    def process(self, evaluation: Evaluation) -> None:
+        job = evaluation.job_id
+        force = job == CORE_JOB_FORCE_GC
+        if job in (CORE_JOB_EVAL_GC,) or force:
+            self.eval_gc(force)
+        if job in (CORE_JOB_JOB_GC,) or force:
+            self.job_gc(force)
+        if job in (CORE_JOB_DEPLOYMENT_GC,) or force:
+            self.deployment_gc(force)
+        if job in (CORE_JOB_NODE_GC,) or force:
+            self.node_gc(force)
+        evaluation.status = EVAL_STATUS_COMPLETE
+        self.planner.update_eval(evaluation)
+
+    # ------------------------------------------------------------------
+
+    def _old_enough(self, ts: float, threshold: float, force: bool) -> bool:
+        return force or (time.time() - ts) > threshold
+
+    def eval_gc(self, force: bool = False) -> int:
+        """Reap terminal evals and their terminal allocs
+        (reference core_sched.go:228 evalGC)."""
+        store = self.store
+        reaped = 0
+        for ev in list(store.evals.values()):
+            if not ev.terminal_status():
+                continue
+            if not self._old_enough(
+                ev.modify_time, self.eval_gc_threshold, force
+            ):
+                continue
+            allocs = store.allocs_by_eval(ev.id)
+            if any(not a.terminal_status() for a in allocs):
+                continue
+            for alloc in allocs:
+                store.allocs.pop(alloc.id, None)
+                store._allocs_by_node.get(alloc.node_id, set()).discard(
+                    alloc.id
+                )
+                store._allocs_by_job.get(
+                    (alloc.namespace, alloc.job_id), set()
+                ).discard(alloc.id)
+            store.delete_eval(ev.id)
+            reaped += 1
+        return reaped
+
+    def job_gc(self, force: bool = False) -> int:
+        """Reap dead jobs whose evals/allocs are all terminal
+        (reference core_sched.go:90 jobGC)."""
+        store = self.store
+        reaped = 0
+        for job in list(store.iter_jobs()):
+            status = store.derive_job_status(job.namespace, job.id)
+            if status != JOB_STATUS_DEAD or job.is_periodic():
+                continue
+            if not self._old_enough(
+                job.submit_time, self.job_gc_threshold, force
+            ):
+                continue
+            allocs = store.allocs_by_job(job.namespace, job.id)
+            evals = store.evals_by_job(job.namespace, job.id)
+            if any(not a.terminal_status() for a in allocs):
+                continue
+            if any(not e.terminal_status() for e in evals):
+                continue
+            for alloc in allocs:
+                store.allocs.pop(alloc.id, None)
+                store._allocs_by_node.get(alloc.node_id, set()).discard(
+                    alloc.id
+                )
+            for ev in evals:
+                store.delete_eval(ev.id)
+            store.delete_job(job.namespace, job.id)
+            reaped += 1
+        return reaped
+
+    def deployment_gc(self, force: bool = False) -> int:
+        """(reference core_sched.go deploymentGC)"""
+        store = self.store
+        reaped = 0
+        for d in list(store.deployments.values()):
+            if d.active():
+                continue
+            if not self._old_enough(0.0, self.deployment_gc_threshold, force):
+                continue
+            store.deployments.pop(d.id, None)
+            store._deployments_by_job.get(
+                (d.namespace, d.job_id), set()
+            ).discard(d.id)
+            reaped += 1
+        return reaped
+
+    def node_gc(self, force: bool = False) -> int:
+        """Reap down nodes with no allocs
+        (reference core_sched.go nodeGC)."""
+        store = self.store
+        reaped = 0
+        for node in list(store.iter_nodes()):
+            if node.status != "down":
+                continue
+            if not self._old_enough(
+                node.status_updated_at, self.node_gc_threshold, force
+            ):
+                continue
+            if store.allocs_by_node(node.id):
+                continue
+            store.delete_node(node.id)
+            reaped += 1
+        return reaped

@@ -71,10 +71,12 @@ def new_scheduler(
 def _register_builtins() -> None:
     from .generic_sched import BatchScheduler, ServiceScheduler
     from .system_sched import SystemScheduler
+    from .core_sched import CoreScheduler
 
     register_scheduler("service", ServiceScheduler)
     register_scheduler("batch", BatchScheduler)
     register_scheduler("system", SystemScheduler)
+    register_scheduler("_core", CoreScheduler)
 
 
 _register_builtins()

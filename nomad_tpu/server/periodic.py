@@ -1,0 +1,141 @@
+"""Periodic job dispatcher (reference nomad/periodic.go:22).
+
+Tracks periodic jobs, computes next launch times from their cron specs,
+and at each fire forces a child job (`<parent>/periodic-<ts>`) plus its
+eval — the leader-side cron launcher.
+
+Cron support: the five-field subset (minute hour dom month dow) with
+"*", "*/n", single values and comma lists — the overwhelmingly common
+shapes; arbitrary ranges can be added in the parser without touching the
+dispatcher.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace as _replace
+from datetime import datetime, timedelta
+from typing import Dict, List, Optional
+
+from ..structs import Job
+
+
+def _field_matches(spec: str, value: int, base: int = 0) -> bool:
+    if spec == "*":
+        return True
+    for part in spec.split(","):
+        if part.startswith("*/"):
+            step = int(part[2:])
+            if (value - base) % step == 0:
+                return True
+        elif "-" in part:
+            lo, hi = part.split("-")
+            if int(lo) <= value <= int(hi):
+                return True
+        elif part and int(part) == value:
+            return True
+    return False
+
+
+def next_cron_launch(spec: str, after: float) -> Optional[float]:
+    """Next time matching a 5-field cron spec strictly after `after`."""
+    fields = spec.split()
+    if len(fields) != 5:
+        return None
+    minute, hour, dom, month, dow = fields
+    t = datetime.fromtimestamp(int(after) - int(after) % 60)
+    t += timedelta(minutes=1)
+    for _ in range(366 * 24 * 60):  # search up to a year
+        if (
+            _field_matches(minute, t.minute)
+            and _field_matches(hour, t.hour)
+            and _field_matches(dom, t.day, base=1)
+            and _field_matches(month, t.month, base=1)
+            and _field_matches(dow, t.isoweekday() % 7)
+        ):
+            return t.timestamp()
+        t += timedelta(minutes=1)
+    return None
+
+
+class PeriodicDispatcher:
+    def __init__(self, server, interval: float = 0.25) -> None:
+        self.server = server
+        self.store = server.store
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (namespace, job_id) -> next launch time
+        self._next: Dict[tuple, float] = {}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="periodic-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _tick(self) -> None:
+        now = time.time()
+        for job in list(self.store.iter_jobs()):
+            if not job.is_periodic() or job.stopped():
+                continue
+            if not job.periodic.enabled:
+                continue
+            key = (job.namespace, job.id)
+            nxt = self._next.get(key)
+            if nxt is None:
+                nxt = next_cron_launch(job.periodic.spec, now)
+                if nxt is None:
+                    continue
+                self._next[key] = nxt
+                continue
+            if now < nxt:
+                continue
+            if job.periodic.prohibit_overlap and self._has_running_child(
+                job
+            ):
+                # skip this launch window
+                self._next[key] = next_cron_launch(job.periodic.spec, now)
+                continue
+            self.force_launch(job, launch_time=nxt)
+            self._next[key] = next_cron_launch(job.periodic.spec, now)
+
+    def _has_running_child(self, parent: Job) -> bool:
+        for job in self.store.iter_jobs():
+            if job.parent_id != parent.id:
+                continue
+            status = self.store.derive_job_status(job.namespace, job.id)
+            if status in ("pending", "running"):
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+
+    def force_launch(
+        self, parent: Job, launch_time: Optional[float] = None
+    ) -> Job:
+        """Create and register the child job for one launch
+        (reference periodic.go createEval / derivedJob)."""
+        ts = int(launch_time or time.time())
+        child = _replace(parent)
+        child.id = f"{parent.id}/periodic-{ts}"
+        child.name = child.id
+        child.parent_id = parent.id
+        child.periodic = None
+        self.server.register_job(child)
+        return child

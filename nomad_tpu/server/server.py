@@ -43,6 +43,7 @@ from .blocked_evals import BlockedEvals
 from .deployment_watcher import DeploymentWatcher
 from .drainer import Drainer
 from .eval_broker import EvalBroker
+from .periodic import PeriodicDispatcher
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .worker import Worker
@@ -70,6 +71,7 @@ class Server:
         ]
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = Drainer(self)
+        self.periodic = PeriodicDispatcher(self)
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._running = False
@@ -85,11 +87,13 @@ class Server:
             worker.start()
         self.deployment_watcher.start()
         self.drainer.start()
+        self.periodic.start()
         self._running = True
         self.restore_evals()
 
     def stop(self) -> None:
         self._running = False
+        self.periodic.stop()
         self.deployment_watcher.stop()
         self.drainer.stop()
         for worker in self.workers:
@@ -337,6 +341,22 @@ class Server:
             self.store.upsert_evals(evals)
             for ev in evals:
                 self.on_eval_update(ev)
+
+    # -- GC (reference nomad/core_sched.go; system gc endpoint) ----------
+
+    def force_gc(self) -> None:
+        from ..sched.core_sched import CORE_JOB_FORCE_GC
+        from ..structs import JOB_TYPE_CORE
+
+        ev = Evaluation(
+            priority=100,
+            type=JOB_TYPE_CORE,
+            triggered_by="scheduled",
+            job_id=CORE_JOB_FORCE_GC,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.store.upsert_evals([ev])
+        self.on_eval_update(ev)
 
     # -- helpers ---------------------------------------------------------
 

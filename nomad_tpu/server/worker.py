@@ -27,7 +27,7 @@ class Worker:
     ) -> None:
         self.server = server
         self.store: StateStore = server.store
-        self.schedulers = schedulers or ["service", "batch", "system"]
+        self.schedulers = schedulers or ["service", "batch", "system", "_core"]
         self.seed = seed
         self._stop = threading.Event()
         self._paused = threading.Event()

@@ -249,6 +249,7 @@ class StateStore:
                 else:
                     ev.create_index = self._index + 1
                 ev.modify_index = self._index + 1
+                ev.modify_time = time.time()
                 self.evals[ev.id] = ev
                 self._evals_by_job[(ev.namespace, ev.job_id)].add(ev.id)
             return self._bump("evals")
@@ -445,6 +446,8 @@ class StateStore:
             return JOB_STATUS_RUNNING
         if any(not e.terminal_status() for e in evals):
             return JOB_STATUS_PENDING
+        if job.stop:
+            return JOB_STATUS_DEAD
         if job.type == JOB_TYPE_SYSTEM or job.is_periodic() or job.is_parameterized():
             return JOB_STATUS_RUNNING if not job.stop else JOB_STATUS_DEAD
         if allocs or evals:

@@ -854,6 +854,7 @@ class Evaluation:
     snapshot_index: int = 0
     create_index: int = 0
     modify_index: int = 0
+    modify_time: float = 0.0
 
     def terminal_status(self) -> bool:
         return self.status in (

@@ -1,0 +1,122 @@
+"""Core GC + periodic dispatch tests (reference model:
+nomad/core_sched_test.go, nomad/periodic_test.go).
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.periodic import next_cron_launch
+from nomad_tpu.structs import Periodic
+
+
+def wait_until(cond, timeout=10.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def server():
+    s = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=21)
+    s.periodic.interval = 0.05
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_force_gc_reaps_dead_job(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    allocs = server.store.allocs_by_job(job.namespace, job.id)
+    for a in allocs:
+        a.client_status = "complete"
+    server.store.upsert_allocs(allocs)
+    server.deregister_job(job.namespace, job.id)
+    assert server.drain_to_idle(10)
+
+    server.force_gc()
+    assert server.drain_to_idle(10)
+    assert wait_until(
+        lambda: server.store.job_by_id(job.namespace, job.id) is None
+    )
+    assert not server.store.allocs_by_job(job.namespace, job.id)
+
+
+def test_gc_spares_live_jobs(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    server.force_gc()
+    assert server.drain_to_idle(10)
+    time.sleep(0.2)
+    assert server.store.job_by_id(job.namespace, job.id) is not None
+    assert server.store.allocs_by_job(job.namespace, job.id)
+
+
+def test_node_gc_reaps_down_nodes(server):
+    n = mock.node()
+    server.register_node(n)
+    server.update_node_status(n.id, "down")
+    server.force_gc()
+    assert server.drain_to_idle(10)
+    assert wait_until(lambda: server.store.node_by_id(n.id) is None)
+
+
+def test_next_cron_launch():
+    # every minute
+    base = time.mktime((2026, 7, 29, 12, 0, 30, 0, 0, -1))
+    nxt = next_cron_launch("* * * * *", base)
+    assert nxt is not None
+    assert 0 < nxt - base <= 60
+    # every 5 minutes
+    nxt5 = next_cron_launch("*/5 * * * *", base)
+    assert time.localtime(nxt5).tm_min % 5 == 0
+    # specific hour
+    nxt_h = next_cron_launch("0 3 * * *", base)
+    tm = time.localtime(nxt_h)
+    assert tm.tm_hour == 3 and tm.tm_min == 0
+    assert next_cron_launch("bogus", base) is None
+
+
+def test_periodic_job_launches_children(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.periodic = Periodic(enabled=True, spec="* * * * *")
+    server.register_job(job)
+    # no eval for the parent itself
+    assert not server.store.evals_by_job(job.namespace, job.id)
+    # force a launch rather than waiting a minute
+    child = server.periodic.force_launch(job)
+    assert child.parent_id == job.id
+    assert child.id.startswith(job.id + "/periodic-")
+    assert server.drain_to_idle(10)
+    assert server.store.allocs_by_job(child.namespace, child.id)
+
+
+def test_periodic_prohibit_overlap(server):
+    for _ in range(1):
+        server.register_node(mock.node())
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.periodic = Periodic(
+        enabled=True, spec="* * * * *", prohibit_overlap=True
+    )
+    server.register_job(job)
+    child = server.periodic.force_launch(job)
+    assert server.drain_to_idle(10)
+    # with the child pending/running, the overlap guard reports busy
+    assert server.periodic._has_running_child(job)
