@@ -1,0 +1,2 @@
+from .http import HTTPServer, start_http_server  # noqa: F401
+from .codec import job_to_dict, job_from_dict  # noqa: F401

@@ -1,0 +1,336 @@
+"""JSON codecs for the API data model (reference api/ package types;
+the reference's msgpack self-describing encoding maps to plain JSON
+here)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..structs import (
+    Affinity,
+    Allocation,
+    Constraint,
+    Deployment,
+    EphemeralDisk,
+    Evaluation,
+    Job,
+    MigrateStrategy,
+    NetworkResource,
+    Node,
+    Periodic,
+    Port,
+    RequestedDevice,
+    ReschedulePolicy,
+    Resources,
+    RestartPolicy,
+    Spread,
+    SpreadTarget,
+    Task,
+    TaskGroup,
+    UpdateStrategy,
+    VolumeRequest,
+)
+
+
+def _clean(value: Any) -> Any:
+    """Dataclass -> JSON-safe dict, dropping private/None-heavy noise."""
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {
+            f.name: _clean(getattr(value, f.name))
+            for f in dataclasses.fields(value)
+            if f.name not in ("job", "metrics")  # avoid cycles/bloat
+        }
+    if isinstance(value, dict):
+        return {str(k): _clean(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_clean(v) for v in value]
+    return value
+
+
+def job_to_dict(job: Job) -> Dict:
+    return _clean(job)
+
+
+def node_to_dict(node: Node) -> Dict:
+    return _clean(node)
+
+
+def alloc_to_dict(alloc: Allocation) -> Dict:
+    d = _clean(alloc)
+    d["job_version"] = alloc.job.version if alloc.job else None
+    return d
+
+
+def eval_to_dict(ev: Evaluation) -> Dict:
+    return _clean(ev)
+
+
+def deployment_to_dict(d: Deployment) -> Dict:
+    return _clean(d)
+
+
+# ---------------------------------------------------------------------------
+# Job parsing from API dicts (accepts both snake_case and the reference
+# API's CamelCase field names)
+# ---------------------------------------------------------------------------
+
+
+def _get(d: Dict, *names, default=None):
+    for name in names:
+        if name in d:
+            return d[name]
+    return default
+
+
+def _constraints(raw) -> List[Constraint]:
+    out = []
+    for c in raw or []:
+        out.append(
+            Constraint(
+                ltarget=_get(c, "ltarget", "LTarget", default=""),
+                rtarget=_get(c, "rtarget", "RTarget", default=""),
+                operand=_get(c, "operand", "Operand", default="="),
+            )
+        )
+    return out
+
+
+def _affinities(raw) -> List[Affinity]:
+    out = []
+    for a in raw or []:
+        out.append(
+            Affinity(
+                ltarget=_get(a, "ltarget", "LTarget", default=""),
+                rtarget=_get(a, "rtarget", "RTarget", default=""),
+                operand=_get(a, "operand", "Operand", default="="),
+                weight=int(_get(a, "weight", "Weight", default=50)),
+            )
+        )
+    return out
+
+
+def _spreads(raw) -> List[Spread]:
+    out = []
+    for s in raw or []:
+        targets = tuple(
+            SpreadTarget(
+                value=_get(t, "value", "Value", default=""),
+                percent=int(_get(t, "percent", "Percent", default=0)),
+            )
+            for t in _get(s, "targets", "SpreadTarget", default=[]) or []
+        )
+        out.append(
+            Spread(
+                attribute=_get(s, "attribute", "Attribute", default=""),
+                weight=int(_get(s, "weight", "Weight", default=50)),
+                targets=targets,
+            )
+        )
+    return out
+
+
+def _networks(raw) -> List[NetworkResource]:
+    out = []
+    for n in raw or []:
+        reserved = [
+            Port(
+                label=_get(p, "label", "Label", default=""),
+                value=int(_get(p, "value", "Value", "Static", default=0)),
+                to=int(_get(p, "to", "To", default=0)),
+            )
+            for p in _get(n, "reserved_ports", "ReservedPorts", default=[])
+            or []
+        ]
+        dynamic = [
+            Port(
+                label=_get(p, "label", "Label", default=""),
+                to=int(_get(p, "to", "To", default=0)),
+            )
+            for p in _get(n, "dynamic_ports", "DynamicPorts", default=[])
+            or []
+        ]
+        out.append(
+            NetworkResource(
+                mode=_get(n, "mode", "Mode", default="host"),
+                mbits=int(_get(n, "mbits", "MBits", default=0)),
+                reserved_ports=reserved,
+                dynamic_ports=dynamic,
+            )
+        )
+    return out
+
+
+def _resources(raw) -> Resources:
+    raw = raw or {}
+    devices = []
+    for dev in _get(raw, "devices", "Devices", default=[]) or []:
+        devices.append(
+            RequestedDevice(
+                name=_get(dev, "name", "Name", default=""),
+                count=int(_get(dev, "count", "Count", default=1)),
+                constraints=_constraints(
+                    _get(dev, "constraints", "Constraints")
+                ),
+                affinities=_affinities(
+                    _get(dev, "affinities", "Affinities")
+                ),
+            )
+        )
+    return Resources(
+        cpu=int(_get(raw, "cpu", "CPU", default=100)),
+        memory_mb=int(_get(raw, "memory_mb", "MemoryMB", default=300)),
+        disk_mb=int(_get(raw, "disk_mb", "DiskMB", default=0)),
+        networks=_networks(_get(raw, "networks", "Networks")),
+        devices=devices,
+    )
+
+
+def _task(raw) -> Task:
+    return Task(
+        name=_get(raw, "name", "Name", default=""),
+        driver=_get(raw, "driver", "Driver", default="exec"),
+        config=_get(raw, "config", "Config", default={}) or {},
+        env=_get(raw, "env", "Env", default={}) or {},
+        resources=_resources(_get(raw, "resources", "Resources")),
+        constraints=_constraints(_get(raw, "constraints", "Constraints")),
+        affinities=_affinities(_get(raw, "affinities", "Affinities")),
+        leader=bool(_get(raw, "leader", "Leader", default=False)),
+        kill_timeout_s=float(
+            _get(raw, "kill_timeout_s", "KillTimeout", default=5.0)
+        ),
+        meta=_get(raw, "meta", "Meta", default={}) or {},
+    )
+
+
+def _task_group(raw) -> TaskGroup:
+    tg = TaskGroup(
+        name=_get(raw, "name", "Name", default=""),
+        count=int(_get(raw, "count", "Count", default=1)),
+        tasks=[_task(t) for t in _get(raw, "tasks", "Tasks", default=[])],
+        constraints=_constraints(_get(raw, "constraints", "Constraints")),
+        affinities=_affinities(_get(raw, "affinities", "Affinities")),
+        spreads=_spreads(_get(raw, "spreads", "Spreads")),
+        networks=_networks(_get(raw, "networks", "Networks")),
+        meta=_get(raw, "meta", "Meta", default={}) or {},
+    )
+    rp = _get(raw, "restart_policy", "RestartPolicy")
+    if rp:
+        tg.restart_policy = RestartPolicy(
+            attempts=int(_get(rp, "attempts", "Attempts", default=2)),
+            interval_s=float(_get(rp, "interval_s", "Interval", default=1800)),
+            delay_s=float(_get(rp, "delay_s", "Delay", default=15)),
+            mode=_get(rp, "mode", "Mode", default="fail"),
+        )
+    rsp = _get(raw, "reschedule_policy", "ReschedulePolicy")
+    if rsp:
+        tg.reschedule_policy = ReschedulePolicy(
+            attempts=int(_get(rsp, "attempts", "Attempts", default=0)),
+            interval_s=float(_get(rsp, "interval_s", "Interval", default=0)),
+            delay_s=float(_get(rsp, "delay_s", "Delay", default=30)),
+            delay_function=_get(
+                rsp, "delay_function", "DelayFunction",
+                default="exponential",
+            ),
+            max_delay_s=float(
+                _get(rsp, "max_delay_s", "MaxDelay", default=3600)
+            ),
+            unlimited=bool(
+                _get(rsp, "unlimited", "Unlimited", default=True)
+            ),
+        )
+    upd = _get(raw, "update", "Update")
+    if upd:
+        tg.update = _update_strategy(upd)
+    mig = _get(raw, "migrate", "Migrate")
+    if mig:
+        tg.migrate = MigrateStrategy(
+            max_parallel=int(
+                _get(mig, "max_parallel", "MaxParallel", default=1)
+            ),
+        )
+    disk = _get(raw, "ephemeral_disk", "EphemeralDisk")
+    if disk:
+        tg.ephemeral_disk = EphemeralDisk(
+            sticky=bool(_get(disk, "sticky", "Sticky", default=False)),
+            size_mb=int(_get(disk, "size_mb", "SizeMB", default=300)),
+            migrate=bool(_get(disk, "migrate", "Migrate", default=False)),
+        )
+    vols = _get(raw, "volumes", "Volumes", default={}) or {}
+    for name, v in vols.items():
+        tg.volumes[name] = VolumeRequest(
+            name=name,
+            type=_get(v, "type", "Type", default="host"),
+            source=_get(v, "source", "Source", default=""),
+            read_only=bool(_get(v, "read_only", "ReadOnly", default=False)),
+        )
+    return tg
+
+
+def _update_strategy(raw) -> UpdateStrategy:
+    return UpdateStrategy(
+        stagger_s=float(_get(raw, "stagger_s", "Stagger", default=30)),
+        max_parallel=int(
+            _get(raw, "max_parallel", "MaxParallel", default=1)
+        ),
+        min_healthy_time_s=float(
+            _get(raw, "min_healthy_time_s", "MinHealthyTime", default=10)
+        ),
+        healthy_deadline_s=float(
+            _get(raw, "healthy_deadline_s", "HealthyDeadline", default=300)
+        ),
+        progress_deadline_s=float(
+            _get(
+                raw, "progress_deadline_s", "ProgressDeadline", default=600
+            )
+        ),
+        auto_revert=bool(
+            _get(raw, "auto_revert", "AutoRevert", default=False)
+        ),
+        auto_promote=bool(
+            _get(raw, "auto_promote", "AutoPromote", default=False)
+        ),
+        canary=int(_get(raw, "canary", "Canary", default=0)),
+    )
+
+
+def job_from_dict(raw: Dict) -> Job:
+    job = Job(
+        id=_get(raw, "id", "ID", default=""),
+        name=_get(raw, "name", "Name", default="")
+        or _get(raw, "id", "ID", default=""),
+        namespace=_get(raw, "namespace", "Namespace", default="default"),
+        region=_get(raw, "region", "Region", default="global"),
+        type=_get(raw, "type", "Type", default="service"),
+        priority=int(_get(raw, "priority", "Priority", default=50)),
+        datacenters=_get(
+            raw, "datacenters", "Datacenters", default=["dc1"]
+        ),
+        task_groups=[
+            _task_group(tg)
+            for tg in _get(raw, "task_groups", "TaskGroups", default=[])
+        ],
+        constraints=_constraints(_get(raw, "constraints", "Constraints")),
+        affinities=_affinities(_get(raw, "affinities", "Affinities")),
+        spreads=_spreads(_get(raw, "spreads", "Spreads")),
+        meta=_get(raw, "meta", "Meta", default={}) or {},
+        all_at_once=bool(
+            _get(raw, "all_at_once", "AllAtOnce", default=False)
+        ),
+    )
+    upd = _get(raw, "update", "Update")
+    if upd:
+        job.update = _update_strategy(upd)
+        for tg in job.task_groups:
+            if tg.update is None:
+                tg.update = job.update
+    per = _get(raw, "periodic", "Periodic")
+    if per:
+        job.periodic = Periodic(
+            enabled=bool(_get(per, "enabled", "Enabled", default=True)),
+            spec=_get(per, "spec", "Spec", "Cron", default=""),
+            prohibit_overlap=bool(
+                _get(per, "prohibit_overlap", "ProhibitOverlap",
+                     default=False)
+            ),
+        )
+    return job

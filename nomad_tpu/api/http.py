@@ -1,0 +1,514 @@
+"""HTTP API (reference command/agent/http.go:252-327 route table).
+
+Serves the `/v1/*` surface over the in-process server: jobs (list,
+register, read, delete, evaluations, allocations, plan, scale,
+periodic force), nodes (list, read, drain, eligibility), allocations,
+evaluations, deployments (+promote/fail/pause), operator scheduler
+configuration (incl. the TPU-backend toggle), agent info/members, status
+leader, search, system gc, and metrics.
+
+ACL enforcement: when the server has ACLs enabled, every request resolves
+its X-Nomad-Token header to a policy set and is checked against the
+namespace capability the route requires (reference nomad/acl.go).
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..structs import DrainStrategy, SchedulerConfiguration, PreemptionConfig
+from .codec import (
+    alloc_to_dict,
+    deployment_to_dict,
+    eval_to_dict,
+    job_from_dict,
+    job_to_dict,
+    node_to_dict,
+)
+
+
+class HTTPError(Exception):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+class APIHandler(BaseHTTPRequestHandler):
+    server_ref = None  # class attr set by start_http_server
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence default logging
+        pass
+
+    # -- plumbing -------------------------------------------------------
+
+    def _body(self) -> Dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw)
+        except ValueError as exc:
+            raise HTTPError(400, f"invalid JSON body: {exc}")
+
+    def _respond(self, payload: Any, code: int = 200) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, message: str) -> None:
+        self._respond({"error": message}, code)
+
+    def _check_acl(self, capability: str, namespace: str = "default"):
+        srv = self.server_ref
+        acls = getattr(srv, "acls", None)
+        if acls is None or not acls.enabled:
+            return
+        token = self.headers.get("X-Nomad-Token", "")
+        if not acls.allowed(token, namespace, capability):
+            raise HTTPError(403, "Permission denied")
+
+    # -- dispatch -------------------------------------------------------
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_PUT(self):
+        self._dispatch("PUT")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def _dispatch(self, method: str) -> None:
+        url = urlparse(self.path)
+        path = url.path.rstrip("/")
+        query = {k: v[0] for k, v in parse_qs(url.query).items()}
+        try:
+            handled = self._route(method, path, query)
+            if not handled:
+                self._error(404, f"no handler for {method} {path}")
+        except HTTPError as exc:
+            self._error(exc.code, str(exc))
+        except (KeyError, ValueError) as exc:
+            self._error(400, str(exc))
+        except Exception as exc:  # noqa: BLE001
+            self._error(500, f"{type(exc).__name__}: {exc}")
+
+    # -- routes (reference http.go registerHandlers) --------------------
+
+    def _route(self, method: str, path: str, q: Dict[str, str]) -> bool:
+        srv = self.server_ref
+        store = srv.store
+        ns = q.get("namespace", "default")
+
+        if path == "/v1/jobs":
+            if method == "GET":
+                self._check_acl("read-job", ns)
+                prefix = q.get("prefix", "")
+                jobs = [
+                    {
+                        "ID": j.id,
+                        "Name": j.name,
+                        "Type": j.type,
+                        "Priority": j.priority,
+                        "Status": store.derive_job_status(j.namespace, j.id),
+                        "Namespace": j.namespace,
+                    }
+                    for j in store.iter_jobs()
+                    if j.id.startswith(prefix)
+                ]
+                self._respond(jobs)
+                return True
+            if method in ("POST", "PUT"):
+                self._check_acl("submit-job", ns)
+                body = self._body()
+                raw_job = body.get("Job") or body.get("job") or body
+                job = job_from_dict(raw_job)
+                ev = srv.register_job(job)
+                self._respond(
+                    {"EvalID": ev.id if ev else "", "JobModifyIndex": job.modify_index}
+                )
+                return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)", path)
+        if m:
+            job_id = m.group(1)
+            if method == "GET":
+                self._check_acl("read-job", ns)
+                job = store.job_by_id(ns, job_id)
+                if job is None:
+                    raise HTTPError(404, "job not found")
+                d = job_to_dict(job)
+                d["status"] = store.derive_job_status(ns, job_id)
+                self._respond(d)
+                return True
+            if method in ("POST", "PUT"):
+                self._check_acl("submit-job", ns)
+                body = self._body()
+                raw_job = body.get("Job") or body.get("job") or body
+                job = job_from_dict(raw_job)
+                job.id = job_id
+                ev = srv.register_job(job)
+                self._respond({"EvalID": ev.id if ev else ""})
+                return True
+            if method == "DELETE":
+                self._check_acl("submit-job", ns)
+                purge = q.get("purge", "false") == "true"
+                ev = srv.deregister_job(ns, job_id, purge=purge)
+                self._respond({"EvalID": ev.id if ev else ""})
+                return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/evaluations", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            self._respond(
+                [eval_to_dict(e) for e in store.evals_by_job(ns, m.group(1))]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/allocations", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            self._respond(
+                [
+                    alloc_to_dict(a)
+                    for a in store.allocs_by_job(ns, m.group(1))
+                ]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/deployments", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            self._respond(
+                [
+                    deployment_to_dict(d)
+                    for d in store.deployments_by_job(ns, m.group(1))
+                ]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/periodic/force", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            job = store.job_by_id(ns, m.group(1))
+            if job is None or not job.is_periodic():
+                raise HTTPError(404, "periodic job not found")
+            child = srv.periodic.force_launch(job)
+            self._respond({"JobID": child.id})
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/scale", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            body = self._body()
+            job = store.job_by_id(ns, m.group(1))
+            if job is None:
+                raise HTTPError(404, "job not found")
+            group = body.get("Target", {}).get("Group") or body.get("group")
+            count = body.get("Count") or body.get("count")
+            tg = job.lookup_task_group(group)
+            if tg is None:
+                raise HTTPError(400, f"unknown group {group!r}")
+            tg.count = int(count)
+            ev = srv.register_job(job)
+            self._respond({"EvalID": ev.id if ev else ""})
+            return True
+
+        if path == "/v1/nodes" and method == "GET":
+            self._check_acl("node:read")
+            prefix = q.get("prefix", "")
+            self._respond(
+                [
+                    {
+                        "ID": n.id,
+                        "Name": n.name,
+                        "Datacenter": n.datacenter,
+                        "Status": n.status,
+                        "SchedulingEligibility": n.scheduling_eligibility,
+                        "Drain": n.drain,
+                    }
+                    for n in store.iter_nodes()
+                    if n.id.startswith(prefix)
+                ]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/node/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("node:read")
+            node = store.node_by_id(m.group(1))
+            if node is None:
+                raise HTTPError(404, "node not found")
+            self._respond(node_to_dict(node))
+            return True
+
+        m = re.fullmatch(r"/v1/node/([^/]+)/allocations", path)
+        if m and method == "GET":
+            self._check_acl("node:read")
+            self._respond(
+                [alloc_to_dict(a) for a in store.allocs_by_node(m.group(1))]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/node/([^/]+)/drain", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("node:write")
+            body = self._body()
+            enable = bool(
+                body.get("DrainSpec") or body.get("drain", False)
+            )
+            strategy = None
+            if enable:
+                import time as _t
+
+                spec = body.get("DrainSpec") or {}
+                deadline_s = float(
+                    spec.get("Deadline", 3600e9) / 1e9
+                    if spec.get("Deadline")
+                    else 3600.0
+                )
+                strategy = DrainStrategy(
+                    ignore_system_jobs=bool(
+                        spec.get("IgnoreSystemJobs", False)
+                    ),
+                    force_deadline_unix=_t.time() + deadline_s,
+                )
+            srv.update_node_drain(m.group(1), enable, strategy)
+            self._respond({})
+            return True
+
+        m = re.fullmatch(r"/v1/node/([^/]+)/eligibility", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("node:write")
+            body = self._body()
+            elig = body.get("Eligibility") or body.get("eligibility")
+            srv.update_node_eligibility(m.group(1), elig)
+            self._respond({})
+            return True
+
+        if path == "/v1/allocations" and method == "GET":
+            self._check_acl("read-job", ns)
+            prefix = q.get("prefix", "")
+            self._respond(
+                [
+                    alloc_to_dict(a)
+                    for a in store.allocs.values()
+                    if a.id.startswith(prefix)
+                ]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/allocation/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            alloc = store.alloc_by_id(m.group(1))
+            if alloc is None:
+                raise HTTPError(404, "alloc not found")
+            self._respond(alloc_to_dict(alloc))
+            return True
+
+        if path == "/v1/evaluations" and method == "GET":
+            self._check_acl("read-job", ns)
+            self._respond(
+                [eval_to_dict(e) for e in store.evals.values()]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/evaluation/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            ev = store.eval_by_id(m.group(1))
+            if ev is None:
+                raise HTTPError(404, "eval not found")
+            self._respond(eval_to_dict(ev))
+            return True
+
+        if path == "/v1/deployments" and method == "GET":
+            self._check_acl("read-job", ns)
+            self._respond(
+                [deployment_to_dict(d) for d in store.deployments.values()]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/deployment/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            d = store.deployment_by_id(m.group(1))
+            if d is None:
+                raise HTTPError(404, "deployment not found")
+            self._respond(deployment_to_dict(d))
+            return True
+
+        m = re.fullmatch(r"/v1/deployment/promote/([^/]+)", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            srv.deployment_watcher.promote(m.group(1))
+            self._respond({})
+            return True
+
+        m = re.fullmatch(r"/v1/deployment/fail/([^/]+)", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            srv.deployment_watcher.fail(m.group(1))
+            self._respond({})
+            return True
+
+        m = re.fullmatch(r"/v1/deployment/pause/([^/]+)", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            body = self._body()
+            srv.deployment_watcher.pause(
+                m.group(1), bool(body.get("Pause", True))
+            )
+            self._respond({})
+            return True
+
+        if path == "/v1/operator/scheduler/configuration":
+            if method == "GET":
+                cfg = store.get_scheduler_config()
+                self._respond(
+                    {
+                        "SchedulerAlgorithm": cfg.scheduler_algorithm,
+                        "TPUSchedulerEnabled": cfg.tpu_scheduler_enabled,
+                        "PreemptionConfig": {
+                            "SystemSchedulerEnabled": cfg.preemption_config.system_scheduler_enabled,
+                            "BatchSchedulerEnabled": cfg.preemption_config.batch_scheduler_enabled,
+                            "ServiceSchedulerEnabled": cfg.preemption_config.service_scheduler_enabled,
+                        },
+                    }
+                )
+                return True
+            if method in ("POST", "PUT"):
+                self._check_acl("operator:write")
+                body = self._body()
+                pre = body.get("PreemptionConfig", {})
+                cfg = SchedulerConfiguration(
+                    scheduler_algorithm=body.get(
+                        "SchedulerAlgorithm", "binpack"
+                    ),
+                    tpu_scheduler_enabled=bool(
+                        body.get("TPUSchedulerEnabled", False)
+                    ),
+                    preemption_config=PreemptionConfig(
+                        system_scheduler_enabled=pre.get(
+                            "SystemSchedulerEnabled", True
+                        ),
+                        batch_scheduler_enabled=pre.get(
+                            "BatchSchedulerEnabled", False
+                        ),
+                        service_scheduler_enabled=pre.get(
+                            "ServiceSchedulerEnabled", False
+                        ),
+                    ),
+                )
+                store.set_scheduler_config(cfg)
+                self._respond({"Updated": True})
+                return True
+
+        if path == "/v1/status/leader" and method == "GET":
+            self._respond("local")
+            return True
+
+        if path == "/v1/agent/self" and method == "GET":
+            self._respond(
+                {
+                    "member": {"Name": "local", "Status": "alive"},
+                    "stats": {
+                        "broker": srv.broker.stats,
+                        "blocked": srv.blocked.stats,
+                        "plan_queue": srv.plan_queue.stats,
+                    },
+                }
+            )
+            return True
+
+        if path == "/v1/metrics" and method == "GET":
+            metrics = getattr(srv, "metrics", None)
+            self._respond(metrics.dump() if metrics else {})
+            return True
+
+        if path == "/v1/search" and method in ("POST", "PUT", "GET"):
+            body = self._body() if method != "GET" else q
+            prefix = body.get("Prefix") or body.get("prefix", "")
+            context = body.get("Context") or body.get("context", "all")
+            self._respond(self._search(store, prefix, context))
+            return True
+
+        if path == "/v1/system/gc" and method in ("POST", "PUT"):
+            self._check_acl("operator:write")
+            srv.force_gc()
+            self._respond({})
+            return True
+
+        return False
+
+    @staticmethod
+    def _search(store, prefix: str, context: str) -> Dict:
+        """Prefix search over the main tables
+        (reference nomad/search_endpoint.go)."""
+        out: Dict[str, list] = {"Matches": {}, "Truncations": {}}
+        limit = 20
+
+        def matches(items):
+            hits = [i for i in items if i.startswith(prefix)]
+            return hits[:limit], len(hits) > limit
+
+        if context in ("jobs", "all"):
+            hits, trunc = matches([j.id for j in store.iter_jobs()])
+            out["Matches"]["jobs"] = hits
+            out["Truncations"]["jobs"] = trunc
+        if context in ("nodes", "all"):
+            hits, trunc = matches([n.id for n in store.iter_nodes()])
+            out["Matches"]["nodes"] = hits
+            out["Truncations"]["nodes"] = trunc
+        if context in ("allocs", "all"):
+            hits, trunc = matches(list(store.allocs))
+            out["Matches"]["allocs"] = hits
+            out["Truncations"]["allocs"] = trunc
+        if context in ("evals", "all"):
+            hits, trunc = matches(list(store.evals))
+            out["Matches"]["evals"] = hits
+            out["Truncations"]["evals"] = trunc
+        if context in ("deployment", "all"):
+            hits, trunc = matches(list(store.deployments))
+            out["Matches"]["deployment"] = hits
+            out["Truncations"]["deployment"] = trunc
+        return out
+
+
+class HTTPServer:
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646):
+        handler = type("BoundHandler", (APIHandler,), {"server_ref": server})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="http-api", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def start_http_server(server, host="127.0.0.1", port=0) -> HTTPServer:
+    http = HTTPServer(server, host, port)
+    http.start()
+    return http

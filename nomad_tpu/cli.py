@@ -1,0 +1,413 @@
+"""Command-line interface (reference command/commands.go registry).
+
+    nomad-tpu agent -dev [-http-port N]        run a dev server+client
+    nomad-tpu job run <file.hcl|file.json>     submit a job
+    nomad-tpu job status [job_id]              list jobs / job detail
+    nomad-tpu job stop [-purge] <job_id>       stop a job
+    nomad-tpu job scale <job_id> <group> <n>   scale a group
+    nomad-tpu node status [node_id]            list/inspect nodes
+    nomad-tpu node drain -enable|-disable <id> drain a node
+    nomad-tpu node eligibility -enable|-disable <id>
+    nomad-tpu alloc status <alloc_id>
+    nomad-tpu eval status <eval_id>
+    nomad-tpu deployment status [id] | promote <id> | fail <id>
+    nomad-tpu operator scheduler get-config|set-config [...]
+    nomad-tpu system gc
+    nomad-tpu version
+
+Talks to the HTTP API at $NOMAD_ADDR (default http://127.0.0.1:4646).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+def _addr() -> str:
+    return os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+
+
+def _request(
+    method: str, path: str, body: Optional[Dict] = None
+) -> Any:
+    url = _addr() + path
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    token = os.environ.get("NOMAD_TOKEN")
+    if token:
+        req.add_header("X-Nomad-Token", token)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as exc:
+        try:
+            detail = json.loads(exc.read()).get("error", "")
+        except Exception:  # noqa: BLE001
+            detail = ""
+        print(f"Error ({exc.code}): {detail or exc.reason}", file=sys.stderr)
+        sys.exit(1)
+    except urllib.error.URLError as exc:
+        print(
+            f"Error connecting to {_addr()}: {exc.reason}", file=sys.stderr
+        )
+        sys.exit(1)
+
+
+def _table(rows, headers) -> None:
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers))
+    for row in rows:
+        print(fmt.format(*[str(c) for c in row]))
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_agent(args) -> None:
+    from .api.http import start_http_server
+    from .client import Client
+    from .server import Server
+
+    server = Server(num_schedulers=args.num_schedulers)
+    server.start()
+    http = start_http_server(server, port=args.http_port)
+    print(f"==> nomad-tpu agent started; HTTP on :{http.port}")
+    clients = []
+    if args.dev:
+        client = Client(server, include_tpu_fingerprint=True)
+        client.start()
+        clients.append(client)
+        print(f"==> dev client node {client.node.id[:8]} registered")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("==> shutting down")
+    finally:
+        for c in clients:
+            c.stop()
+        http.stop()
+        server.stop()
+
+
+def cmd_job_run(args) -> None:
+    path = args.file
+    if path.endswith(".json"):
+        with open(path) as f:
+            raw = json.load(f)
+        job_payload = raw.get("Job") or raw.get("job") or raw
+        from .api.codec import job_from_dict, job_to_dict
+
+        job = job_from_dict(job_payload)
+    else:
+        from . import jobspec
+        from .api.codec import job_to_dict
+
+        job = jobspec.parse_file(path)
+    from .api.codec import job_to_dict
+
+    resp = _request("POST", "/v1/jobs", {"Job": job_to_dict(job)})
+    print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_job_status(args) -> None:
+    if not args.job_id:
+        jobs = _request("GET", "/v1/jobs")
+        if not jobs:
+            print("No running jobs")
+            return
+        _table(
+            [
+                (j["ID"][:20], j["Type"], j["Priority"], j["Status"])
+                for j in jobs
+            ],
+            ["ID", "Type", "Priority", "Status"],
+        )
+        return
+    job = _request("GET", f"/v1/job/{args.job_id}")
+    print(f"ID            = {job['id']}")
+    print(f"Name          = {job['name']}")
+    print(f"Type          = {job['type']}")
+    print(f"Priority      = {job['priority']}")
+    print(f"Status        = {job.get('status', '')}")
+    print(f"Datacenters   = {','.join(job['datacenters'])}")
+    allocs = _request("GET", f"/v1/job/{args.job_id}/allocations")
+    if allocs:
+        print("\nAllocations")
+        _table(
+            [
+                (
+                    a["id"][:8],
+                    a["node_id"][:8],
+                    a["task_group"],
+                    a["desired_status"],
+                    a["client_status"],
+                )
+                for a in allocs
+            ],
+            ["ID", "Node ID", "Task Group", "Desired", "Status"],
+        )
+
+
+def cmd_job_stop(args) -> None:
+    purge = "?purge=true" if args.purge else ""
+    resp = _request("DELETE", f"/v1/job/{args.job_id}{purge}")
+    print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_job_scale(args) -> None:
+    resp = _request(
+        "POST",
+        f"/v1/job/{args.job_id}/scale",
+        {"Target": {"Group": args.group}, "Count": args.count},
+    )
+    print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_node_status(args) -> None:
+    if not args.node_id:
+        nodes = _request("GET", "/v1/nodes")
+        _table(
+            [
+                (
+                    n["ID"][:8],
+                    n["Name"],
+                    n["Datacenter"],
+                    n["SchedulingEligibility"],
+                    n["Status"],
+                )
+                for n in nodes
+            ],
+            ["ID", "Name", "DC", "Eligibility", "Status"],
+        )
+        return
+    node = _request("GET", f"/v1/node/{args.node_id}")
+    print(f"ID          = {node['id']}")
+    print(f"Name        = {node['name']}")
+    print(f"Datacenter  = {node['datacenter']}")
+    print(f"Status      = {node['status']}")
+    print(f"Eligibility = {node['scheduling_eligibility']}")
+    print(f"Drain       = {node['drain']}")
+    res = node["node_resources"]
+    print(
+        f"Resources   = cpu {res['cpu']} MHz, mem {res['memory_mb']} MiB,"
+        f" disk {res['disk_mb']} MiB"
+    )
+    allocs = _request("GET", f"/v1/node/{args.node_id}/allocations")
+    if allocs:
+        print("\nAllocations")
+        _table(
+            [
+                (a["id"][:8], a["job_id"][:20], a["client_status"])
+                for a in allocs
+            ],
+            ["ID", "Job", "Status"],
+        )
+
+
+def cmd_node_drain(args) -> None:
+    body = {}
+    if args.enable:
+        body = {"DrainSpec": {"Deadline": int(args.deadline * 1e9)}}
+    _request("POST", f"/v1/node/{args.node_id}/drain", body)
+    print(
+        f"==> Node {args.node_id[:8]} drain "
+        f"{'enabled' if args.enable else 'disabled'}"
+    )
+
+
+def cmd_node_eligibility(args) -> None:
+    elig = "eligible" if args.enable else "ineligible"
+    _request(
+        "POST",
+        f"/v1/node/{args.node_id}/eligibility",
+        {"Eligibility": elig},
+    )
+    print(f"==> Node {args.node_id[:8]} marked {elig}")
+
+
+def cmd_alloc_status(args) -> None:
+    alloc = _request("GET", f"/v1/allocation/{args.alloc_id}")
+    print(f"ID           = {alloc['id']}")
+    print(f"Name         = {alloc['name']}")
+    print(f"Node ID      = {alloc['node_id']}")
+    print(f"Job ID       = {alloc['job_id']}")
+    print(f"Desired      = {alloc['desired_status']}")
+    print(f"Status       = {alloc['client_status']}")
+    for task, state in (alloc.get("task_states") or {}).items():
+        print(f"\nTask {task!r}: {state['state']}"
+              f"{' (failed)' if state.get('failed') else ''}")
+
+
+def cmd_eval_status(args) -> None:
+    ev = _request("GET", f"/v1/evaluation/{args.eval_id}")
+    print(f"ID           = {ev['id']}")
+    print(f"Type         = {ev['type']}")
+    print(f"TriggeredBy  = {ev['triggered_by']}")
+    print(f"Job ID       = {ev['job_id']}")
+    print(f"Status       = {ev['status']}")
+    if ev.get("blocked_eval"):
+        print(f"BlockedEval  = {ev['blocked_eval']}")
+
+
+def cmd_deployment(args) -> None:
+    if args.action == "status":
+        if args.id:
+            d = _request("GET", f"/v1/deployment/{args.id}")
+            print(json.dumps(d, indent=2))
+        else:
+            ds = _request("GET", "/v1/deployments")
+            _table(
+                [
+                    (d["id"][:8], d["job_id"][:20], d["status"])
+                    for d in ds
+                ],
+                ["ID", "Job", "Status"],
+            )
+    elif args.action == "promote":
+        _request("POST", f"/v1/deployment/promote/{args.id}", {})
+        print("==> Deployment promoted")
+    elif args.action == "fail":
+        _request("POST", f"/v1/deployment/fail/{args.id}", {})
+        print("==> Deployment failed")
+
+
+def cmd_operator_scheduler(args) -> None:
+    if args.action == "get-config":
+        print(
+            json.dumps(
+                _request("GET", "/v1/operator/scheduler/configuration"),
+                indent=2,
+            )
+        )
+    else:
+        cfg = _request("GET", "/v1/operator/scheduler/configuration")
+        if args.algorithm:
+            cfg["SchedulerAlgorithm"] = args.algorithm
+        if args.tpu is not None:
+            cfg["TPUSchedulerEnabled"] = args.tpu == "true"
+        _request("POST", "/v1/operator/scheduler/configuration", cfg)
+        print("==> Scheduler configuration updated")
+
+
+def cmd_system_gc(args) -> None:
+    _request("POST", "/v1/system/gc", {})
+    print("==> GC triggered")
+
+
+def cmd_version(args) -> None:
+    from . import __version__
+
+    print(f"nomad-tpu v{__version__}")
+
+
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    agent = sub.add_parser("agent")
+    agent.add_argument("-dev", action="store_true", dest="dev")
+    agent.add_argument("-http-port", type=int, default=4646,
+                       dest="http_port")
+    agent.add_argument("-num-schedulers", type=int, default=2,
+                       dest="num_schedulers")
+    agent.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job")
+    job_sub = job.add_subparsers(dest="job_cmd", required=True)
+    jr = job_sub.add_parser("run")
+    jr.add_argument("file")
+    jr.set_defaults(fn=cmd_job_run)
+    js = job_sub.add_parser("status")
+    js.add_argument("job_id", nargs="?")
+    js.set_defaults(fn=cmd_job_status)
+    jst = job_sub.add_parser("stop")
+    jst.add_argument("-purge", action="store_true", dest="purge")
+    jst.add_argument("job_id")
+    jst.set_defaults(fn=cmd_job_stop)
+    jsc = job_sub.add_parser("scale")
+    jsc.add_argument("job_id")
+    jsc.add_argument("group")
+    jsc.add_argument("count", type=int)
+    jsc.set_defaults(fn=cmd_job_scale)
+
+    node = sub.add_parser("node")
+    node_sub = node.add_subparsers(dest="node_cmd", required=True)
+    ns = node_sub.add_parser("status")
+    ns.add_argument("node_id", nargs="?")
+    ns.set_defaults(fn=cmd_node_status)
+    nd = node_sub.add_parser("drain")
+    nd_group = nd.add_mutually_exclusive_group(required=True)
+    nd_group.add_argument("-enable", action="store_true", dest="enable")
+    nd_group.add_argument("-disable", action="store_false", dest="enable")
+    nd.add_argument("-deadline", type=float, default=3600.0,
+                    dest="deadline")
+    nd.add_argument("node_id")
+    nd.set_defaults(fn=cmd_node_drain)
+    ne = node_sub.add_parser("eligibility")
+    ne_group = ne.add_mutually_exclusive_group(required=True)
+    ne_group.add_argument("-enable", action="store_true", dest="enable")
+    ne_group.add_argument("-disable", action="store_false", dest="enable")
+    ne.add_argument("node_id")
+    ne.set_defaults(fn=cmd_node_eligibility)
+
+    alloc = sub.add_parser("alloc")
+    alloc_sub = alloc.add_subparsers(dest="alloc_cmd", required=True)
+    als = alloc_sub.add_parser("status")
+    als.add_argument("alloc_id")
+    als.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval")
+    ev_sub = ev.add_subparsers(dest="eval_cmd", required=True)
+    evs = ev_sub.add_parser("status")
+    evs.add_argument("eval_id")
+    evs.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment")
+    dep.add_argument("action",
+                     choices=["status", "promote", "fail"])
+    dep.add_argument("id", nargs="?")
+    dep.set_defaults(fn=cmd_deployment)
+
+    op = sub.add_parser("operator")
+    op_sub = op.add_subparsers(dest="op_cmd", required=True)
+    osch = op_sub.add_parser("scheduler")
+    osch.add_argument("action", choices=["get-config", "set-config"])
+    osch.add_argument("-algorithm", choices=["binpack", "spread"],
+                      default=None)
+    osch.add_argument("-tpu", choices=["true", "false"], default=None)
+    osch.set_defaults(fn=cmd_operator_scheduler)
+
+    system = sub.add_parser("system")
+    system.add_argument("action", choices=["gc"])
+    system.set_defaults(fn=cmd_system_gc)
+
+    version = sub.add_parser("version")
+    version.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> None:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,450 @@
+"""Jobspec parser (reference jobspec/parse.go:26 Parse).
+
+Parses the HCL-style job file dialect into a `structs.Job`:
+
+    job "example" {
+      datacenters = ["dc1"]
+      type        = "service"
+      group "web" {
+        count = 3
+        constraint { attribute = "${attr.kernel.name}" value = "linux" }
+        update { max_parallel = 2 canary = 1 }
+        task "server" {
+          driver = "exec"
+          config { command = "/bin/sleep" args = ["600"] }
+          resources { cpu = 500 memory = 256 }
+          env { FOO = "bar" }
+        }
+      }
+    }
+
+A hand-rolled tokenizer + recursive-descent block parser covering the
+HCL1 subset job files actually use: string/number/bool scalars, lists,
+`key = value` assignments, labeled and unlabeled blocks, comments (#,
+//, /* */).  JSON job payloads bypass this via api/codec.job_from_dict.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .api.codec import job_from_dict
+from .structs import Job
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>\#[^\n]*|//[^\n]*|/\*.*?\*/)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<punct>[{}\[\],=])
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.\-]*)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+class ParseError(ValueError):
+    pass
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise ParseError(f"unexpected character {text[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        tokens.append((kind, m.group()))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]) -> None:
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> Tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise ParseError("unexpected end of input")
+        self.pos += 1
+        return tok
+
+    def expect(self, value: str) -> None:
+        kind, tok = self.next()
+        if tok != value:
+            raise ParseError(f"expected {value!r}, got {tok!r}")
+
+    # -- grammar --------------------------------------------------------
+
+    def parse_body(self, stop: Optional[str] = "}") -> Dict[str, Any]:
+        """A body is a sequence of assignments and blocks.  Repeated
+        blocks accumulate into lists under the block name."""
+        out: Dict[str, Any] = {}
+        while True:
+            tok = self.peek()
+            if tok is None:
+                if stop is None:
+                    return out
+                raise ParseError(f"expected {stop!r}, got end of input")
+            if tok[1] == stop:
+                self.next()
+                return out
+            self._parse_item(out)
+
+    def _parse_item(self, out: Dict[str, Any]) -> None:
+        kind, name = self.next()
+        if kind == "string":
+            name = _unquote(name)
+        elif kind != "ident":
+            raise ParseError(f"expected identifier, got {name!r}")
+
+        tok = self.peek()
+        if tok is None:
+            raise ParseError("unexpected end after " + name)
+
+        if tok[1] == "=":
+            self.next()
+            out[name] = self._parse_value()
+            return
+
+        # block: optional labels then {
+        labels: List[str] = []
+        while tok is not None and tok[0] == "string":
+            labels.append(_unquote(self.next()[1]))
+            tok = self.peek()
+        if tok is None or tok[1] != "{":
+            raise ParseError(
+                f"expected '{{' after block {name!r}, got "
+                f"{tok[1] if tok else 'EOF'!r}"
+            )
+        self.next()
+        body = self.parse_body("}")
+        if labels:
+            body["__label__"] = labels[0]
+        existing = out.get(name)
+        if existing is None:
+            out[name] = [body]
+        elif isinstance(existing, list):
+            existing.append(body)
+        else:
+            out[name] = [existing, body]
+
+    def _parse_value(self) -> Any:
+        kind, tok = self.next()
+        if kind == "string":
+            return _unquote(tok)
+        if kind == "number":
+            return float(tok) if "." in tok else int(tok)
+        if kind == "ident":
+            if tok == "true":
+                return True
+            if tok == "false":
+                return False
+            return tok
+        if tok == "[":
+            items = []
+            while True:
+                nxt = self.peek()
+                if nxt is None:
+                    raise ParseError("unterminated list")
+                if nxt[1] == "]":
+                    self.next()
+                    return items
+                items.append(self._parse_value())
+                if self.peek() and self.peek()[1] == ",":
+                    self.next()
+        if tok == "{":
+            return self.parse_body("}")
+        raise ParseError(f"unexpected token {tok!r}")
+
+
+def _unquote(raw: str) -> str:
+    body = raw[1:-1]
+    return body.replace('\\"', '"').replace("\\\\", "\\").replace(
+        "\\n", "\n"
+    ).replace("\\t", "\t")
+
+
+def _first(blocks, default=None):
+    if isinstance(blocks, list):
+        return blocks[0] if blocks else default
+    return blocks if blocks is not None else default
+
+
+def _all(blocks) -> List[Dict]:
+    if blocks is None:
+        return []
+    if isinstance(blocks, list):
+        return blocks
+    return [blocks]
+
+
+# ---------------------------------------------------------------------------
+# HCL tree -> API dict -> Job
+# ---------------------------------------------------------------------------
+
+
+def _constraint_dicts(body: Dict) -> List[Dict]:
+    out = []
+    for c in _all(body.get("constraint")):
+        operand = c.get("operator", c.get("operand", "="))
+        ltarget = c.get("attribute", "")
+        rtarget = str(c.get("value", ""))
+        # sugar forms (reference jobspec/parse.go parseConstraints)
+        for sugar in (
+            "version",
+            "semver",
+            "regexp",
+            "distinct_hosts",
+            "distinct_property",
+            "set_contains",
+        ):
+            if sugar in c:
+                operand = sugar
+                if sugar in ("distinct_hosts",):
+                    rtarget = ""
+                elif sugar == "distinct_property":
+                    ltarget = str(c[sugar])
+                    rtarget = str(c.get("value", ""))
+                else:
+                    rtarget = str(c[sugar])
+        out.append(
+            {"ltarget": ltarget, "rtarget": rtarget, "operand": operand}
+        )
+    return out
+
+
+def _affinity_dicts(body: Dict) -> List[Dict]:
+    out = []
+    for a in _all(body.get("affinity")):
+        operand = a.get("operator", "=")
+        rtarget = str(a.get("value", ""))
+        for sugar in ("version", "semver", "regexp", "set_contains"):
+            if sugar in a:
+                operand = sugar
+                rtarget = str(a[sugar])
+        out.append(
+            {
+                "ltarget": a.get("attribute", ""),
+                "rtarget": rtarget,
+                "operand": operand,
+                "weight": int(a.get("weight", 50)),
+            }
+        )
+    return out
+
+
+def _spread_dicts(body: Dict) -> List[Dict]:
+    out = []
+    for s in _all(body.get("spread")):
+        targets = [
+            {
+                "value": t.get("__label__", t.get("value", "")),
+                "percent": int(t.get("percent", 0)),
+            }
+            for t in _all(s.get("target"))
+        ]
+        out.append(
+            {
+                "attribute": s.get("attribute", ""),
+                "weight": int(s.get("weight", 50)),
+                "targets": targets,
+            }
+        )
+    return out
+
+
+def _network_dicts(body: Dict) -> List[Dict]:
+    out = []
+    for n in _all(body.get("network")):
+        reserved, dynamic = [], []
+        for p in _all(n.get("port")):
+            label = p.get("__label__", "")
+            if "static" in p:
+                reserved.append(
+                    {"label": label, "value": int(p["static"]),
+                     "to": int(p.get("to", 0))}
+                )
+            else:
+                dynamic.append(
+                    {"label": label, "to": int(p.get("to", 0))}
+                )
+        out.append(
+            {
+                "mode": n.get("mode", "host"),
+                "mbits": int(n.get("mbits", 0)),
+                "reserved_ports": reserved,
+                "dynamic_ports": dynamic,
+            }
+        )
+    return out
+
+
+def _duration_s(value, default: float) -> float:
+    """Parse 30, "30s", "5m", "1h30m"."""
+    if value is None:
+        return default
+    if isinstance(value, (int, float)):
+        return float(value)
+    total = 0.0
+    for num, unit in re.findall(r"([\d.]+)(h|m|s|ms)", str(value)):
+        mult = {"h": 3600, "m": 60, "s": 1, "ms": 0.001}[unit]
+        total += float(num) * mult
+    return total if total else default
+
+
+def _task_dict(body: Dict) -> Dict:
+    resources = _first(body.get("resources"), {}) or {}
+    devices = [
+        {
+            "name": d.get("__label__", d.get("name", "")),
+            "count": int(d.get("count", 1)),
+            "constraints": _constraint_dicts(d),
+            "affinities": _affinity_dicts(d),
+        }
+        for d in _all(resources.get("device"))
+    ]
+    return {
+        "name": body.get("__label__", body.get("name", "")),
+        "driver": body.get("driver", "exec"),
+        "config": _first(body.get("config"), {}) or {},
+        "env": _first(body.get("env"), {}) or {},
+        "resources": {
+            "cpu": int(resources.get("cpu", 100)),
+            "memory_mb": int(
+                resources.get("memory", resources.get("memory_mb", 300))
+            ),
+            "networks": _network_dicts(resources),
+            "devices": devices,
+        },
+        "constraints": _constraint_dicts(body),
+        "affinities": _affinity_dicts(body),
+        "leader": bool(body.get("leader", False)),
+        "kill_timeout_s": _duration_s(body.get("kill_timeout"), 5.0),
+        "meta": _first(body.get("meta"), {}) or {},
+    }
+
+
+def _update_dict(body: Dict) -> Dict:
+    return {
+        "stagger_s": _duration_s(body.get("stagger"), 30.0),
+        "max_parallel": int(body.get("max_parallel", 1)),
+        "min_healthy_time_s": _duration_s(
+            body.get("min_healthy_time"), 10.0
+        ),
+        "healthy_deadline_s": _duration_s(
+            body.get("healthy_deadline"), 300.0
+        ),
+        "progress_deadline_s": _duration_s(
+            body.get("progress_deadline"), 600.0
+        ),
+        "auto_revert": bool(body.get("auto_revert", False)),
+        "auto_promote": bool(body.get("auto_promote", False)),
+        "canary": int(body.get("canary", 0)),
+    }
+
+
+def _group_dict(body: Dict) -> Dict:
+    out = {
+        "name": body.get("__label__", body.get("name", "")),
+        "count": int(body.get("count", 1)),
+        "tasks": [_task_dict(t) for t in _all(body.get("task"))],
+        "constraints": _constraint_dicts(body),
+        "affinities": _affinity_dicts(body),
+        "spreads": _spread_dicts(body),
+        "networks": _network_dicts(body),
+        "meta": _first(body.get("meta"), {}) or {},
+    }
+    rp = _first(body.get("restart"))
+    if rp:
+        out["restart_policy"] = {
+            "attempts": int(rp.get("attempts", 2)),
+            "interval_s": _duration_s(rp.get("interval"), 1800.0),
+            "delay_s": _duration_s(rp.get("delay"), 15.0),
+            "mode": rp.get("mode", "fail"),
+        }
+    rsp = _first(body.get("reschedule"))
+    if rsp:
+        out["reschedule_policy"] = {
+            "attempts": int(rsp.get("attempts", 0)),
+            "interval_s": _duration_s(rsp.get("interval"), 0.0),
+            "delay_s": _duration_s(rsp.get("delay"), 30.0),
+            "delay_function": rsp.get("delay_function", "exponential"),
+            "max_delay_s": _duration_s(rsp.get("max_delay"), 3600.0),
+            "unlimited": bool(rsp.get("unlimited", True)),
+        }
+    upd = _first(body.get("update"))
+    if upd:
+        out["update"] = _update_dict(upd)
+    mig = _first(body.get("migrate"))
+    if mig:
+        out["migrate"] = {
+            "max_parallel": int(mig.get("max_parallel", 1))
+        }
+    disk = _first(body.get("ephemeral_disk"))
+    if disk:
+        out["ephemeral_disk"] = {
+            "sticky": bool(disk.get("sticky", False)),
+            "size_mb": int(disk.get("size", disk.get("size_mb", 300))),
+            "migrate": bool(disk.get("migrate", False)),
+        }
+    vols = {}
+    for v in _all(body.get("volume")):
+        name = v.get("__label__", "")
+        vols[name] = {
+            "type": v.get("type", "host"),
+            "source": v.get("source", ""),
+            "read_only": bool(v.get("read_only", False)),
+        }
+    if vols:
+        out["volumes"] = vols
+    return out
+
+
+def parse(text: str) -> Job:
+    """Parse an HCL job file into a Job."""
+    tree = _Parser(_tokenize(text)).parse_body(stop=None)
+    jobs = _all(tree.get("job"))
+    if not jobs:
+        raise ParseError("no 'job' block found")
+    body = jobs[0]
+    job_dict = {
+        "id": body.get("__label__", body.get("id", "")),
+        "name": body.get("name", body.get("__label__", "")),
+        "namespace": body.get("namespace", "default"),
+        "region": body.get("region", "global"),
+        "type": body.get("type", "service"),
+        "priority": int(body.get("priority", 50)),
+        "datacenters": body.get("datacenters", ["dc1"]),
+        "all_at_once": bool(body.get("all_at_once", False)),
+        "task_groups": [_group_dict(g) for g in _all(body.get("group"))],
+        "constraints": _constraint_dicts(body),
+        "affinities": _affinity_dicts(body),
+        "spreads": _spread_dicts(body),
+        "meta": _first(body.get("meta"), {}) or {},
+    }
+    upd = _first(body.get("update"))
+    if upd:
+        job_dict["update"] = _update_dict(upd)
+    per = _first(body.get("periodic"))
+    if per:
+        job_dict["periodic"] = {
+            "enabled": bool(per.get("enabled", True)),
+            "spec": per.get("cron", per.get("spec", "")),
+            "prohibit_overlap": bool(per.get("prohibit_overlap", False)),
+        }
+    return job_from_dict(job_dict)
+
+
+def parse_file(path: str) -> Job:
+    with open(path) as f:
+        return parse(f.read())
