@@ -1,0 +1,259 @@
+"""ACL system (reference acl/acl.go:43, acl/policy.go, nomad/acl.go).
+
+Policies grant namespace capabilities plus coarse node/agent/operator/
+quota rights; tokens reference policies; management tokens bypass all
+checks.  Resolution (token -> merged ACL object) is cached with the same
+intent as the reference's LRU (nomad/server.go:89 aclCacheSize).
+
+Policy JSON shape (HCL in the reference; JSON here):
+
+    {
+      "namespaces": {
+        "default": {"policy": "write"},
+        "web-*":   {"capabilities": ["submit-job", "read-job"]}
+      },
+      "node": "write",
+      "agent": "read",
+      "operator": "read",
+      "quota": "read"
+    }
+"""
+from __future__ import annotations
+
+import fnmatch
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .structs import new_id
+
+# namespace capability sets (reference acl/policy.go:19-60)
+NAMESPACE_CAPABILITIES = {
+    "deny": set(),
+    "read": {"list-jobs", "read-job", "read-logs", "read-fs"},
+    "write": {
+        "list-jobs",
+        "read-job",
+        "submit-job",
+        "dispatch-job",
+        "read-logs",
+        "read-fs",
+        "alloc-exec",
+        "alloc-lifecycle",
+        "scale-job",
+    },
+}
+
+COARSE_POLICIES = ("deny", "read", "write")
+
+
+@dataclass
+class NamespacePolicy:
+    policy: str = ""  # deny | read | write
+    capabilities: Set[str] = field(default_factory=set)
+
+    def allowed(self) -> Set[str]:
+        caps = set(self.capabilities)
+        if self.policy:
+            caps |= NAMESPACE_CAPABILITIES.get(self.policy, set())
+        if self.policy == "deny":
+            return set()
+        return caps
+
+
+@dataclass
+class Policy:
+    name: str = ""
+    namespaces: Dict[str, NamespacePolicy] = field(default_factory=dict)
+    node: str = ""  # deny | read | write
+    agent: str = ""
+    operator: str = ""
+    quota: str = ""
+
+    @classmethod
+    def from_dict(cls, name: str, raw: Dict) -> "Policy":
+        namespaces = {}
+        for ns, rules in (raw.get("namespaces") or {}).items():
+            namespaces[ns] = NamespacePolicy(
+                policy=rules.get("policy", ""),
+                capabilities=set(rules.get("capabilities") or ()),
+            )
+        return cls(
+            name=name,
+            namespaces=namespaces,
+            node=raw.get("node", ""),
+            agent=raw.get("agent", ""),
+            operator=raw.get("operator", ""),
+            quota=raw.get("quota", ""),
+        )
+
+
+@dataclass
+class Token:
+    accessor_id: str = field(default_factory=new_id)
+    secret_id: str = field(default_factory=new_id)
+    name: str = ""
+    type: str = "client"  # client | management
+    policies: List[str] = field(default_factory=list)
+    global_: bool = False
+
+    def is_management(self) -> bool:
+        return self.type == "management"
+
+
+class ACL:
+    """A merged capability view over a set of policies
+    (reference acl/acl.go:43)."""
+
+    def __init__(self, policies: List[Policy], management: bool = False):
+        self.management = management
+        self.policies = policies
+
+    def _namespace_caps(self, namespace: str) -> Set[str]:
+        caps: Set[str] = set()
+        denied = False
+        for policy in self.policies:
+            # exact match beats glob (reference acl.go findClosestMatching)
+            exact = policy.namespaces.get(namespace)
+            matched = exact
+            if matched is None:
+                best_len = -1
+                for pattern, ns_policy in policy.namespaces.items():
+                    if fnmatch.fnmatchcase(namespace, pattern):
+                        if len(pattern) > best_len:
+                            best_len = len(pattern)
+                            matched = ns_policy
+            if matched is None:
+                continue
+            if matched.policy == "deny":
+                denied = True
+            caps |= matched.allowed()
+        return set() if denied and not caps else caps
+
+    def allow_namespace_operation(
+        self, namespace: str, capability: str
+    ) -> bool:
+        if self.management:
+            return True
+        return capability in self._namespace_caps(namespace)
+
+    def _coarse(self, attr: str, write: bool) -> bool:
+        if self.management:
+            return True
+        level = "deny"
+        for policy in self.policies:
+            value = getattr(policy, attr)
+            if value == "write":
+                level = "write"
+            elif value == "read" and level != "write":
+                level = "read"
+        if write:
+            return level == "write"
+        return level in ("read", "write")
+
+    def allow_node_read(self) -> bool:
+        return self._coarse("node", write=False)
+
+    def allow_node_write(self) -> bool:
+        return self._coarse("node", write=True)
+
+    def allow_agent_read(self) -> bool:
+        return self._coarse("agent", write=False)
+
+    def allow_agent_write(self) -> bool:
+        return self._coarse("agent", write=True)
+
+    def allow_operator_read(self) -> bool:
+        return self._coarse("operator", write=False)
+
+    def allow_operator_write(self) -> bool:
+        return self._coarse("operator", write=True)
+
+
+class ACLStore:
+    """Token/policy storage + resolution cache
+    (reference nomad/acl.go ResolveToken)."""
+
+    def __init__(self, enabled: bool = False) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self.policies: Dict[str, Policy] = {}
+        self.tokens_by_secret: Dict[str, Token] = {}
+        self.tokens_by_accessor: Dict[str, Token] = {}
+        self._cache: Dict[str, ACL] = {}
+
+    # -- management -----------------------------------------------------
+
+    def bootstrap(self) -> Token:
+        """Create the initial management token
+        (reference acl_endpoint.go Bootstrap)."""
+        token = Token(name="Bootstrap Token", type="management")
+        with self._lock:
+            self.tokens_by_secret[token.secret_id] = token
+            self.tokens_by_accessor[token.accessor_id] = token
+        return token
+
+    def upsert_policy(self, policy: Policy) -> None:
+        with self._lock:
+            self.policies[policy.name] = policy
+            self._cache.clear()
+
+    def delete_policy(self, name: str) -> None:
+        with self._lock:
+            self.policies.pop(name, None)
+            self._cache.clear()
+
+    def create_token(self, token: Token) -> Token:
+        with self._lock:
+            for p in token.policies:
+                if p not in self.policies:
+                    raise ValueError(f"unknown policy {p!r}")
+            self.tokens_by_secret[token.secret_id] = token
+            self.tokens_by_accessor[token.accessor_id] = token
+        return token
+
+    def delete_token(self, accessor_id: str) -> None:
+        with self._lock:
+            token = self.tokens_by_accessor.pop(accessor_id, None)
+            if token is not None:
+                self.tokens_by_secret.pop(token.secret_id, None)
+                self._cache.pop(token.secret_id, None)
+
+    # -- resolution -----------------------------------------------------
+
+    def resolve(self, secret_id: str) -> Optional[ACL]:
+        if not secret_id:
+            return ACL([], management=False)
+        with self._lock:
+            cached = self._cache.get(secret_id)
+            if cached is not None:
+                return cached
+            token = self.tokens_by_secret.get(secret_id)
+            if token is None:
+                return None
+            acl = ACL(
+                [
+                    self.policies[p]
+                    for p in token.policies
+                    if p in self.policies
+                ],
+                management=token.is_management(),
+            )
+            self._cache[secret_id] = acl
+            return acl
+
+    def allowed(
+        self, secret_id: str, namespace: str, capability: str
+    ) -> bool:
+        """Route-level check used by the HTTP layer.  Capability forms:
+        "submit-job" (namespace capability), "node:read"/"node:write",
+        "agent:...", "operator:...".
+        """
+        acl = self.resolve(secret_id)
+        if acl is None:
+            return False
+        if ":" in capability:
+            scope, mode = capability.split(":", 1)
+            method = getattr(acl, f"allow_{scope}_{mode}", None)
+            return bool(method and method())
+        return acl.allow_namespace_operation(namespace, capability)

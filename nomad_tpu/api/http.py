@@ -446,6 +446,112 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond(self._search(store, prefix, context))
             return True
 
+        # -- ACLs (reference nomad/acl_endpoint.go) ---------------------
+        if path == "/v1/acl/bootstrap" and method in ("POST", "PUT"):
+            acls = srv.acls
+            if acls.tokens_by_secret:
+                raise HTTPError(400, "ACL bootstrap already done")
+            token = acls.bootstrap()
+            self._respond(
+                {
+                    "AccessorID": token.accessor_id,
+                    "SecretID": token.secret_id,
+                    "Type": token.type,
+                }
+            )
+            return True
+
+        if path == "/v1/acl/policies" and method == "GET":
+            self._check_acl("operator:read")
+            self._respond(
+                [
+                    {"Name": p.name}
+                    for p in srv.acls.policies.values()
+                ]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/acl/policy/([^/]+)", path)
+        if m:
+            from ..acl import Policy
+
+            name = m.group(1)
+            if method == "GET":
+                self._check_acl("operator:read")
+                policy = srv.acls.policies.get(name)
+                if policy is None:
+                    raise HTTPError(404, "policy not found")
+                self._respond(
+                    {
+                        "Name": policy.name,
+                        "Namespaces": {
+                            ns: {
+                                "Policy": np.policy,
+                                "Capabilities": sorted(np.capabilities),
+                            }
+                            for ns, np in policy.namespaces.items()
+                        },
+                        "Node": policy.node,
+                        "Operator": policy.operator,
+                    }
+                )
+                return True
+            if method in ("POST", "PUT"):
+                self._check_acl("operator:write")
+                body = self._body()
+                rules = body.get("Rules") or body.get("rules") or body
+                if isinstance(rules, str):
+                    rules = json.loads(rules)
+                srv.acls.upsert_policy(Policy.from_dict(name, rules))
+                self._respond({})
+                return True
+            if method == "DELETE":
+                self._check_acl("operator:write")
+                srv.acls.delete_policy(name)
+                self._respond({})
+                return True
+
+        if path == "/v1/acl/tokens":
+            if method == "GET":
+                self._check_acl("operator:read")
+                self._respond(
+                    [
+                        {
+                            "AccessorID": t.accessor_id,
+                            "Name": t.name,
+                            "Type": t.type,
+                            "Policies": t.policies,
+                        }
+                        for t in srv.acls.tokens_by_accessor.values()
+                    ]
+                )
+                return True
+            if method in ("POST", "PUT"):
+                self._check_acl("operator:write")
+                from ..acl import Token
+
+                body = self._body()
+                token = Token(
+                    name=body.get("Name", ""),
+                    type=body.get("Type", "client"),
+                    policies=body.get("Policies") or [],
+                )
+                srv.acls.create_token(token)
+                self._respond(
+                    {
+                        "AccessorID": token.accessor_id,
+                        "SecretID": token.secret_id,
+                    }
+                )
+                return True
+
+        m = re.fullmatch(r"/v1/acl/token/([^/]+)", path)
+        if m and method == "DELETE":
+            self._check_acl("operator:write")
+            srv.acls.delete_token(m.group(1))
+            self._respond({})
+            return True
+
         if path == "/v1/system/gc" and method in ("POST", "PUT"):
             self._check_acl("operator:write")
             srv.force_gc()

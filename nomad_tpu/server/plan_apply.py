@@ -115,10 +115,13 @@ class PlanApplier:
     """The single apply thread + capacity-change fanout to blocked
     evals."""
 
-    def __init__(self, store: StateStore, plan_queue, blocked=None) -> None:
+    def __init__(
+        self, store: StateStore, plan_queue, blocked=None, metrics=None
+    ) -> None:
         self.store = store
         self.plan_queue = plan_queue
         self.blocked = blocked
+        self.metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.applied = 0
@@ -147,6 +150,9 @@ class PlanApplier:
                 pending.respond(None, exc)
 
     def apply(self, plan: Plan) -> PlanResult:
+        import time as _time
+
+        start = _time.monotonic()
         result, _full = evaluate_plan(self.store, plan)
         if (
             result.node_update
@@ -159,6 +165,14 @@ class PlanApplier:
             result.alloc_index = index
             self.applied += 1
             self._notify_capacity_change(result, index)
+        if self.metrics is not None:
+            # (reference plan_apply.go:185 plan.evaluate/apply timings)
+            self.metrics.add_sample(
+                "plan.apply", (_time.monotonic() - start) * 1000.0
+            )
+            self.metrics.incr("plan.applied")
+            if not _full:
+                self.metrics.incr("plan.partial_commit")
         return result
 
     def _notify_capacity_change(self, result: PlanResult, index: int) -> None:

@@ -58,13 +58,19 @@ class Server:
         heartbeat_ttl: float = DEFAULT_HEARTBEAT_TTL,
         seed: Optional[int] = None,
         nack_timeout: float = 60.0,
+        acl_enabled: bool = False,
     ) -> None:
+        from ..acl import ACLStore
+        from ..telemetry import Metrics
+
         self.store = StateStore()
+        self.acls = ACLStore(enabled=acl_enabled)
+        self.metrics = Metrics()
         self.broker = EvalBroker(nack_timeout=nack_timeout)
         self.blocked = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.applier = PlanApplier(
-            self.store, self.plan_queue, self.blocked
+            self.store, self.plan_queue, self.blocked, self.metrics
         )
         self.workers: List[Worker] = [
             Worker(self, seed=seed) for _ in range(num_schedulers)

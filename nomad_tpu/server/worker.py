@@ -90,15 +90,26 @@ class Worker:
         ev.snapshot_index = snap.index
         self._eval_token = token
         self._pending_evals: List[Evaluation] = []
+        metrics = getattr(self.server, "metrics", None)
         scheduler = new_scheduler(
             ev.type, snap, self, seed=self.seed,
             use_tpu=self.store.get_scheduler_config().tpu_scheduler_enabled,
         )
+        import time as _time
+
+        start = _time.monotonic()
         try:
             scheduler.process(ev)
         except Exception:  # noqa: BLE001
             self.server.broker.nack(ev.id, token)
             raise
+        if metrics is not None:
+            # (reference worker.go:245 invoke_scheduler timing)
+            metrics.add_sample(
+                f"worker.invoke_scheduler_{ev.type}",
+                (_time.monotonic() - start) * 1000.0,
+            )
+            metrics.incr("worker.evals_processed")
         self.evals_processed += 1
         self.server.broker.ack(ev.id, token)
 

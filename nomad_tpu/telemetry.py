@@ -1,0 +1,97 @@
+"""Telemetry: in-memory metrics with counters, gauges and timing samples
+(reference go-metrics usage; sinks like statsd/prometheus are
+export-format adapters over this store — `dump()` is the /v1/metrics
+payload, `prometheus_text()` the scrape format).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+
+class _Summary:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+
+    def snapshot(self) -> Dict:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "mean": self.total / self.count if self.count else 0.0,
+            "min": self.min if self.count else 0.0,
+            "max": self.max,
+        }
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._samples: Dict[str, _Summary] = defaultdict(_Summary)
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def add_sample(self, name: str, value: float) -> None:
+        with self._lock:
+            self._samples[name].add(value)
+
+    @contextmanager
+    def measure(self, name: str):
+        """(reference go-metrics MeasureSince)"""
+        start = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add_sample(name, (time.monotonic() - start) * 1000.0)
+
+    def dump(self) -> Dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "samples": {
+                    k: s.snapshot() for k, s in self._samples.items()
+                },
+            }
+
+    def prometheus_text(self) -> str:
+        lines: List[str] = []
+
+        def esc(name: str) -> str:
+            return name.replace(".", "_").replace("-", "_")
+
+        with self._lock:
+            for name, value in sorted(self._counters.items()):
+                lines.append(f"# TYPE {esc(name)} counter")
+                lines.append(f"{esc(name)} {value}")
+            for name, value in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {esc(name)} gauge")
+                lines.append(f"{esc(name)} {value}")
+            for name, summary in sorted(self._samples.items()):
+                base = esc(name)
+                snap = summary.snapshot()
+                lines.append(f"# TYPE {base} summary")
+                lines.append(f"{base}_count {snap['count']}")
+                lines.append(f"{base}_sum {snap['sum']}")
+        return "\n".join(lines) + "\n"

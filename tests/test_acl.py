@@ -1,0 +1,141 @@
+"""ACL system tests (reference model: acl/acl_test.go,
+nomad/acl_endpoint_test.go).
+"""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.acl import ACLStore, Policy, Token
+from nomad_tpu.api import start_http_server
+from nomad_tpu.server import Server
+
+
+def test_management_token_allows_everything():
+    store = ACLStore(enabled=True)
+    token = store.bootstrap()
+    assert store.allowed(token.secret_id, "default", "submit-job")
+    assert store.allowed(token.secret_id, "any-ns", "node:write")
+
+
+def test_policy_capabilities():
+    store = ACLStore(enabled=True)
+    store.upsert_policy(
+        Policy.from_dict(
+            "readonly",
+            {"namespaces": {"default": {"policy": "read"}},
+             "node": "read"},
+        )
+    )
+    token = store.create_token(Token(policies=["readonly"]))
+    sid = token.secret_id
+    assert store.allowed(sid, "default", "read-job")
+    assert not store.allowed(sid, "default", "submit-job")
+    assert store.allowed(sid, "default", "node:read")
+    assert not store.allowed(sid, "default", "node:write")
+    # other namespaces: nothing granted
+    assert not store.allowed(sid, "other", "read-job")
+
+
+def test_policy_glob_namespaces():
+    store = ACLStore(enabled=True)
+    store.upsert_policy(
+        Policy.from_dict(
+            "web",
+            {
+                "namespaces": {
+                    "web-*": {"capabilities": ["submit-job", "read-job"]}
+                }
+            },
+        )
+    )
+    token = store.create_token(Token(policies=["web"]))
+    assert store.allowed(token.secret_id, "web-frontend", "submit-job")
+    assert not store.allowed(token.secret_id, "api", "submit-job")
+
+
+def test_deny_policy_wins():
+    store = ACLStore(enabled=True)
+    store.upsert_policy(
+        Policy.from_dict(
+            "deny-default",
+            {"namespaces": {"default": {"policy": "deny"}}},
+        )
+    )
+    token = store.create_token(Token(policies=["deny-default"]))
+    assert not store.allowed(token.secret_id, "default", "read-job")
+
+
+def test_unknown_token_denied():
+    store = ACLStore(enabled=True)
+    assert not store.allowed("bogus-secret", "default", "read-job")
+
+
+def test_anonymous_denied_by_default():
+    store = ACLStore(enabled=True)
+    assert not store.allowed("", "default", "submit-job")
+
+
+@pytest.fixture
+def acl_api():
+    server = Server(num_schedulers=1, seed=44, acl_enabled=True)
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    yield server, base
+    http.stop()
+    server.stop()
+
+
+def _req(base, method, path, body=None, token=None):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    if token:
+        req.add_header("X-Nomad-Token", token)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"null")
+
+
+def test_http_acl_enforcement(acl_api):
+    server, base = acl_api
+    # anonymous job submission is denied
+    from nomad_tpu.api.codec import job_to_dict
+
+    job_payload = {"Job": job_to_dict(mock.job(id="acl-test"))}
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _req(base, "POST", "/v1/jobs", job_payload)
+    assert exc.value.code == 403
+
+    # bootstrap a management token
+    boot = _req(base, "POST", "/v1/acl/bootstrap")
+    mgmt = boot["SecretID"]
+
+    # management token may submit
+    resp = _req(base, "POST", "/v1/jobs", job_payload, token=mgmt)
+    assert resp["EvalID"]
+
+    # create a read-only policy + client token
+    _req(
+        base, "POST", "/v1/acl/policy/readonly",
+        {"Rules": {"namespaces": {"default": {"policy": "read"}}}},
+        token=mgmt,
+    )
+    tok = _req(
+        base, "POST", "/v1/acl/tokens",
+        {"Name": "reader", "Policies": ["readonly"]},
+        token=mgmt,
+    )
+    reader = tok["SecretID"]
+
+    # reader can list jobs but not submit
+    jobs = _req(base, "GET", "/v1/jobs", token=reader)
+    assert any(j["ID"] == "acl-test" for j in jobs)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _req(base, "POST", "/v1/jobs", job_payload, token=reader)
+    assert exc.value.code == 403
