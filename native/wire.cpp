@@ -1,0 +1,567 @@
+/* Implementation of the nomad-tpu wire codec + TCP bridge (see wire.h). */
+#include "wire.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace {
+
+/* ----------------------------------------------------------------------
+ * JSON value model
+ * -------------------------------------------------------------------- */
+
+struct JValue;
+using JArray = std::vector<JValue>;
+using JPair = std::pair<std::string, JValue>;
+using JObject = std::vector<JPair>;
+
+struct JValue {
+  enum Kind { NUL, BOOL, INT, FLOAT, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;
+  JArray arr;
+  JObject obj;
+};
+
+/* ----------------------------------------------------------------------
+ * JSON parsing (recursive descent)
+ * -------------------------------------------------------------------- */
+
+struct JParser {
+  const char *p;
+  const char *end;
+  bool ok = true;
+
+  explicit JParser(const char *text)
+      : p(text), end(text + strlen(text)) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool literal(const char *lit) {
+    size_t n = strlen(lit);
+    if ((size_t)(end - p) >= n && strncmp(p, lit, n) == 0) {
+      p += n;
+      return true;
+    }
+    return false;
+  }
+
+  JValue parse_value() {
+    skip_ws();
+    JValue v;
+    if (p >= end) {
+      ok = false;
+      return v;
+    }
+    switch (*p) {
+      case 'n':
+        ok = literal("null");
+        return v;
+      case 't':
+        ok = literal("true");
+        v.kind = JValue::BOOL;
+        v.b = true;
+        return v;
+      case 'f':
+        ok = literal("false");
+        v.kind = JValue::BOOL;
+        v.b = false;
+        return v;
+      case '"':
+        v.kind = JValue::STR;
+        v.s = parse_string();
+        return v;
+      case '[': {
+        ++p;
+        v.kind = JValue::ARR;
+        skip_ws();
+        if (p < end && *p == ']') {
+          ++p;
+          return v;
+        }
+        while (ok) {
+          v.arr.push_back(parse_value());
+          skip_ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == ']') {
+            ++p;
+            break;
+          }
+          ok = false;
+        }
+        return v;
+      }
+      case '{': {
+        ++p;
+        v.kind = JValue::OBJ;
+        skip_ws();
+        if (p < end && *p == '}') {
+          ++p;
+          return v;
+        }
+        while (ok) {
+          skip_ws();
+          if (p >= end || *p != '"') {
+            ok = false;
+            break;
+          }
+          std::string key = parse_string();
+          skip_ws();
+          if (p >= end || *p != ':') {
+            ok = false;
+            break;
+          }
+          ++p;
+          v.obj.emplace_back(std::move(key), parse_value());
+          skip_ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == '}') {
+            ++p;
+            break;
+          }
+          ok = false;
+        }
+        return v;
+      }
+      default:
+        return parse_number();
+    }
+  }
+
+  std::string parse_string() {
+    std::string out;
+    ++p; /* opening quote */
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out.push_back('\n'); break;
+          case 't': out.push_back('\t'); break;
+          case 'r': out.push_back('\r'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case '/': out.push_back('/'); break;
+          case '\\': out.push_back('\\'); break;
+          case '"': out.push_back('"'); break;
+          case 'u': {
+            if (p + 4 < end) {
+              unsigned code = 0;
+              sscanf(p + 1, "%4x", &code);
+              p += 4;
+              /* UTF-8 encode the BMP code point */
+              if (code < 0x80) {
+                out.push_back((char)code);
+              } else if (code < 0x800) {
+                out.push_back((char)(0xC0 | (code >> 6)));
+                out.push_back((char)(0x80 | (code & 0x3F)));
+              } else {
+                out.push_back((char)(0xE0 | (code >> 12)));
+                out.push_back((char)(0x80 | ((code >> 6) & 0x3F)));
+                out.push_back((char)(0x80 | (code & 0x3F)));
+              }
+            }
+            break;
+          }
+          default: out.push_back(*p);
+        }
+        ++p;
+      } else {
+        out.push_back(*p++);
+      }
+    }
+    if (p < end) ++p; /* closing quote */
+    return out;
+  }
+
+  JValue parse_number() {
+    JValue v;
+    const char *start = p;
+    bool is_float = false;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    while (p < end &&
+           ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+            *p == 'E' || *p == '-' || *p == '+')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') is_float = true;
+      ++p;
+    }
+    if (p == start) {
+      ok = false;
+      return v;
+    }
+    std::string num(start, p - start);
+    if (is_float) {
+      v.kind = JValue::FLOAT;
+      v.f = atof(num.c_str());
+    } else {
+      v.kind = JValue::INT;
+      v.i = strtoll(num.c_str(), nullptr, 10);
+    }
+    return v;
+  }
+};
+
+/* ----------------------------------------------------------------------
+ * JSON serialization
+ * -------------------------------------------------------------------- */
+
+void json_escape(const std::string &s, std::string &out) {
+  out.push_back('"');
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(c);
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+void to_json(const JValue &v, std::string &out) {
+  switch (v.kind) {
+    case JValue::NUL: out += "null"; break;
+    case JValue::BOOL: out += v.b ? "true" : "false"; break;
+    case JValue::INT: {
+      char buf[32];
+      snprintf(buf, sizeof buf, "%lld", (long long)v.i);
+      out += buf;
+      break;
+    }
+    case JValue::FLOAT: {
+      char buf[64];
+      if (std::isfinite(v.f)) {
+        snprintf(buf, sizeof buf, "%.17g", v.f);
+      } else {
+        snprintf(buf, sizeof buf, "null");
+      }
+      out += buf;
+      break;
+    }
+    case JValue::STR: json_escape(v.s, out); break;
+    case JValue::ARR: {
+      out.push_back('[');
+      for (size_t i = 0; i < v.arr.size(); ++i) {
+        if (i) out.push_back(',');
+        to_json(v.arr[i], out);
+      }
+      out.push_back(']');
+      break;
+    }
+    case JValue::OBJ: {
+      out.push_back('{');
+      for (size_t i = 0; i < v.obj.size(); ++i) {
+        if (i) out.push_back(',');
+        json_escape(v.obj[i].first, out);
+        out.push_back(':');
+        to_json(v.obj[i].second, out);
+      }
+      out.push_back('}');
+      break;
+    }
+  }
+}
+
+/* ----------------------------------------------------------------------
+ * Wire encoding (msgpack-compatible wide forms)
+ * -------------------------------------------------------------------- */
+
+void put_u32(std::vector<uint8_t> &out, uint32_t v) {
+  out.push_back((v >> 24) & 0xFF);
+  out.push_back((v >> 16) & 0xFF);
+  out.push_back((v >> 8) & 0xFF);
+  out.push_back(v & 0xFF);
+}
+
+void put_u64(std::vector<uint8_t> &out, uint64_t v) {
+  for (int shift = 56; shift >= 0; shift -= 8)
+    out.push_back((v >> shift) & 0xFF);
+}
+
+void encode_value(const JValue &v, std::vector<uint8_t> &out) {
+  switch (v.kind) {
+    case JValue::NUL: out.push_back(0xc0); break;
+    case JValue::BOOL: out.push_back(v.b ? 0xc3 : 0xc2); break;
+    case JValue::INT:
+      out.push_back(0xd3);
+      put_u64(out, (uint64_t)v.i);
+      break;
+    case JValue::FLOAT: {
+      out.push_back(0xcb);
+      uint64_t bits;
+      memcpy(&bits, &v.f, sizeof bits);
+      put_u64(out, bits);
+      break;
+    }
+    case JValue::STR:
+      out.push_back(0xdb);
+      put_u32(out, (uint32_t)v.s.size());
+      out.insert(out.end(), v.s.begin(), v.s.end());
+      break;
+    case JValue::ARR:
+      out.push_back(0xdd);
+      put_u32(out, (uint32_t)v.arr.size());
+      for (const auto &item : v.arr) encode_value(item, out);
+      break;
+    case JValue::OBJ:
+      out.push_back(0xdf);
+      put_u32(out, (uint32_t)v.obj.size());
+      for (const auto &kv : v.obj) {
+        JValue key;
+        key.kind = JValue::STR;
+        key.s = kv.first;
+        encode_value(key, out);
+        encode_value(kv.second, out);
+      }
+      break;
+  }
+}
+
+/* ----------------------------------------------------------------------
+ * Wire decoding
+ * -------------------------------------------------------------------- */
+
+struct WireReader {
+  const uint8_t *p;
+  const uint8_t *end;
+  bool ok = true;
+
+  uint32_t u32() {
+    if (end - p < 4) {
+      ok = false;
+      return 0;
+    }
+    uint32_t v = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+                 ((uint32_t)p[2] << 8) | p[3];
+    p += 4;
+    return v;
+  }
+
+  uint64_t u64() {
+    if (end - p < 8) {
+      ok = false;
+      return 0;
+    }
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+    p += 8;
+    return v;
+  }
+
+  JValue decode() {
+    JValue v;
+    if (p >= end) {
+      ok = false;
+      return v;
+    }
+    uint8_t tag = *p++;
+    switch (tag) {
+      case 0xc0: return v;
+      case 0xc2: v.kind = JValue::BOOL; v.b = false; return v;
+      case 0xc3: v.kind = JValue::BOOL; v.b = true; return v;
+      case 0xd3: v.kind = JValue::INT; v.i = (int64_t)u64(); return v;
+      case 0xcb: {
+        v.kind = JValue::FLOAT;
+        uint64_t bits = u64();
+        memcpy(&v.f, &bits, sizeof v.f);
+        return v;
+      }
+      case 0xdb: {
+        v.kind = JValue::STR;
+        uint32_t n = u32();
+        if ((size_t)(end - p) < n) {
+          ok = false;
+          return v;
+        }
+        v.s.assign((const char *)p, n);
+        p += n;
+        return v;
+      }
+      case 0xc6: { /* bin32 decoded as string */
+        v.kind = JValue::STR;
+        uint32_t n = u32();
+        if ((size_t)(end - p) < n) {
+          ok = false;
+          return v;
+        }
+        v.s.assign((const char *)p, n);
+        p += n;
+        return v;
+      }
+      case 0xdd: {
+        v.kind = JValue::ARR;
+        uint32_t n = u32();
+        for (uint32_t i = 0; i < n && ok; ++i)
+          v.arr.push_back(decode());
+        return v;
+      }
+      case 0xdf: {
+        v.kind = JValue::OBJ;
+        uint32_t n = u32();
+        for (uint32_t i = 0; i < n && ok; ++i) {
+          JValue key = decode();
+          JValue val = decode();
+          v.obj.emplace_back(std::move(key.s), std::move(val));
+        }
+        return v;
+      }
+      default:
+        ok = false;
+        return v;
+    }
+  }
+};
+
+char *dup_string(const std::string &s) {
+  char *out = (char *)malloc(s.size() + 1);
+  if (out) memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+int read_exact(int fd, uint8_t *buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, buf + got, n - got);
+    if (r <= 0) return -1;
+    got += (size_t)r;
+  }
+  return 0;
+}
+
+int write_exact(int fd, const uint8_t *buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t w = write(fd, buf + sent, n - sent);
+    if (w <= 0) return -1;
+    sent += (size_t)w;
+  }
+  return 0;
+}
+
+}  // namespace
+
+/* ----------------------------------------------------------------------
+ * C API
+ * -------------------------------------------------------------------- */
+
+extern "C" {
+
+int nw_encode_json(const char *json, uint8_t **out, size_t *out_len) {
+  if (!json || !out || !out_len) return -1;
+  JParser parser(json);
+  JValue v = parser.parse_value();
+  parser.skip_ws();
+  if (!parser.ok || parser.p != parser.end) return -2;
+  std::vector<uint8_t> buf;
+  encode_value(v, buf);
+  *out = (uint8_t *)malloc(buf.size());
+  if (!*out) return -3;
+  memcpy(*out, buf.data(), buf.size());
+  *out_len = buf.size();
+  return 0;
+}
+
+int nw_decode_to_json(const uint8_t *data, size_t len, char **json_out) {
+  if (!data || !json_out) return -1;
+  WireReader reader{data, data + len};
+  JValue v = reader.decode();
+  if (!reader.ok || reader.p != reader.end) return -2;
+  std::string out;
+  to_json(v, out);
+  *json_out = dup_string(out);
+  return *json_out ? 0 : -3;
+}
+
+int nw_connect(const char *host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    struct hostent *he = gethostbyname(host);
+    if (!he) {
+      close(fd);
+      return -2;
+    }
+    memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof addr.sin_addr);
+  }
+  if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
+    close(fd);
+    return -3;
+  }
+  return fd;
+}
+
+int nw_close(int fd) { return close(fd); }
+
+int nw_call_json(int fd, const char *method, const char *body_json,
+                 char **response_json) {
+  if (fd < 0 || !method || !body_json || !response_json) return -1;
+
+  /* build [method, body] */
+  JParser parser(body_json);
+  JValue body = parser.parse_value();
+  parser.skip_ws();
+  if (!parser.ok || parser.p != parser.end) return -2;
+
+  std::vector<uint8_t> payload;
+  payload.push_back(0xdd); /* array32 */
+  put_u32(payload, 2);
+  JValue m;
+  m.kind = JValue::STR;
+  m.s = method;
+  encode_value(m, payload);
+  encode_value(body, payload);
+
+  std::vector<uint8_t> frame;
+  put_u32(frame, (uint32_t)payload.size());
+  frame.insert(frame.end(), payload.begin(), payload.end());
+  if (write_exact(fd, frame.data(), frame.size()) != 0) return -4;
+
+  uint8_t lenbuf[4];
+  if (read_exact(fd, lenbuf, 4) != 0) return -5;
+  uint32_t resp_len = ((uint32_t)lenbuf[0] << 24) |
+                      ((uint32_t)lenbuf[1] << 16) |
+                      ((uint32_t)lenbuf[2] << 8) | lenbuf[3];
+  if (resp_len > (64u << 20)) return -6; /* 64 MiB sanity cap */
+  std::vector<uint8_t> resp(resp_len);
+  if (resp_len && read_exact(fd, resp.data(), resp_len) != 0) return -5;
+
+  return nw_decode_to_json(resp.data(), resp.size(), response_json);
+}
+
+void nw_free(void *ptr) { free(ptr); }
+
+const char *nw_version(void) { return "nomad-tpu-wire/0.1.0"; }
+
+}  /* extern "C" */

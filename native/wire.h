@@ -1,0 +1,59 @@
+/* nomad-tpu wire protocol: compact binary codec + framed RPC bridge.
+ *
+ * This is the cross-language seam of the framework (the role msgpack-RPC
+ * over yamux plays in the reference, nomad/rpc.go:335, and the go-plugin
+ * gRPC boundary plays for plugins, plugins/base/plugin.go).  A control
+ * plane written in any language (Go via cgo, C, C++, Rust) loads this
+ * library to talk to the TPU scheduler service:
+ *
+ *   int fd = nw_connect("127.0.0.1", 4647);
+ *   char *resp = NULL;
+ *   nw_call_json(fd, "TPUScheduler.ScoreBatch", request_json, &resp);
+ *   ...
+ *   nw_free(resp);
+ *   nw_close(fd);
+ *
+ * Encoding: a msgpack-compatible subset using the wide fixed forms only
+ * (nil c0, false c2, true c3, int64 d3, float64 cb, str32 db, bin32 c6,
+ * array32 dd, map32 df), all big-endian.  Frames on the socket are
+ * u32(big-endian) length + payload, where payload = array32[method_str,
+ * body].  The JSON entry points convert to/from this encoding so callers
+ * never build wire values by hand.
+ */
+#ifndef NOMAD_TPU_WIRE_H
+#define NOMAD_TPU_WIRE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Encode a JSON document into wire bytes.  Returns 0 on success; the
+ * output buffer is malloc'd and must be released with nw_free. */
+int nw_encode_json(const char *json, uint8_t **out, size_t *out_len);
+
+/* Decode wire bytes back into a JSON document (malloc'd). */
+int nw_decode_to_json(const uint8_t *data, size_t len, char **json_out);
+
+/* TCP bridge. */
+int nw_connect(const char *host, int port);
+int nw_close(int fd);
+
+/* One RPC round trip: sends [method, body_json-as-wire], receives the
+ * response frame and returns it as JSON.  Returns 0 on success, negative
+ * errno-style codes on failure. */
+int nw_call_json(int fd, const char *method, const char *body_json,
+                 char **response_json);
+
+void nw_free(void *ptr);
+
+/* Library version for fingerprinting. */
+const char *nw_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NOMAD_TPU_WIRE_H */

@@ -1,0 +1,179 @@
+"""TPU scheduler bridge service.
+
+The process seam of BASELINE.json's north star: an external control plane
+(the reference's Go scheduling worker, loading native/libnomadwire.so as
+its cgo shim) dispatches evaluations to this service over the framed wire
+protocol, and the service answers with placement decisions computed by
+the batched score kernel — leaving the caller's eval broker, plan applier
+and replication machinery untouched.
+
+RPC surface (method -> body -> response):
+
+  TPUScheduler.Ping      {}                      -> {"ok": true, ...}
+  TPUScheduler.ScoreBatch
+      {"evals": [{"eval_id": ..., "job_id": ..., "seed": int,
+                  "count": int, "cpu": int, "memory_mb": int,
+                  "disk_mb": int}, ...]}
+      -> {"results": [{"eval_id": ..., "nodes": [node_id, ...]}, ...]}
+
+Each eval's `seed` drives the shuffled visit order exactly as the
+in-process schedulers do, so decisions remain bit-identical regardless of
+which side of the bridge asks.
+"""
+from __future__ import annotations
+
+import math
+import random
+import socket
+import socketserver
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..wire import decode, encode, recv_frame, send_frame
+from ..sched.feasible import shuffle_permutation
+
+
+class BridgeService:
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        self.store = server.store
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    try:
+                        frame = recv_frame(self.request)
+                    except (ConnectionError, ValueError, OSError):
+                        return
+                    if frame is None:
+                        return
+                    try:
+                        method, body = decode(frame)
+                        response = outer.dispatch(method, body)
+                    except Exception as exc:  # noqa: BLE001
+                        response = {"error": f"{type(exc).__name__}: {exc}"}
+                    try:
+                        send_frame(self.request, encode(response))
+                    except OSError:
+                        return
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.tcp = TCP((host, port), Handler)
+        self.port = self.tcp.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.tcp.serve_forever, name="tpu-bridge", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.tcp.shutdown()
+        self.tcp.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+
+    def dispatch(self, method: str, body: Dict) -> Dict:
+        if method == "TPUScheduler.Ping":
+            return {
+                "ok": True,
+                "nodes": len(self.store.nodes),
+                "arena": self.store.node_table.capacity,
+            }
+        if method == "TPUScheduler.ScoreBatch":
+            return self.score_batch(body)
+        return {"error": f"unknown method {method!r}"}
+
+    # ------------------------------------------------------------------
+
+    def score_batch(self, body: Dict) -> Dict:
+        """Run a batch of simple binpack evals through the batched kernel
+        (ops/batch.py) against the live node table."""
+        from ..ops.batch import batch_plan_picks_shared
+
+        evals = body.get("evals") or []
+        if not evals:
+            return {"results": []}
+
+        table = self.store.node_table
+        C = table.capacity
+        ready_rows = [
+            row
+            for node_id, row in table.row_of.items()
+            if table.eligible[row]
+        ]
+        n_cand = len(ready_rows)
+        if n_cand == 0:
+            return {
+                "results": [
+                    {"eval_id": e.get("eval_id", ""), "nodes": []}
+                    for e in evals
+                ]
+            }
+        base_rows = np.asarray(sorted(ready_rows), dtype=np.int32)
+        present = set(base_rows.tolist())
+        rest = np.asarray(
+            [r for r in range(C) if r not in present], dtype=np.int32
+        )
+        feasible = np.zeros(C, dtype=bool)
+        feasible[base_rows] = True
+
+        limit = max(2, math.ceil(math.log2(n_cand)))
+        max_picks = max(int(e.get("count", 1)) for e in evals)
+
+        perms = np.empty((len(evals), C), dtype=np.int32)
+        asks = np.zeros((len(evals), 3))
+        counts = np.zeros(len(evals), np.int32)
+        for k, e in enumerate(evals):
+            rng = random.Random(int(e.get("seed", 0)))
+            order = shuffle_permutation(rng, n_cand)
+            perms[k, :n_cand] = base_rows[order]
+            perms[k, n_cand:] = rest
+            asks[k] = (
+                float(e.get("cpu", 100)),
+                float(e.get("memory_mb", 300)),
+                float(e.get("disk_mb", 300)),
+            )
+            counts[k] = int(e.get("count", 1))
+
+        rows = np.asarray(
+            batch_plan_picks_shared(
+                table.cpu_total,
+                table.mem_total,
+                table.disk_total,
+                feasible,
+                table.cpu_used,
+                table.mem_used,
+                table.disk_used,
+                perms,
+                asks[:, 0],
+                asks[:, 1],
+                asks[:, 2],
+                counts,
+                np.full(len(evals), limit, np.int32),
+                np.int32(n_cand),
+                int(max_picks),
+            )
+        )
+
+        results = []
+        for k, e in enumerate(evals):
+            chosen = [
+                table.node_ids[r]
+                for r in rows[k, : counts[k]]
+                if r >= 0
+            ]
+            results.append(
+                {"eval_id": e.get("eval_id", ""), "nodes": chosen}
+            )
+        return {"results": results}

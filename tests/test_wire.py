@@ -1,0 +1,158 @@
+"""Wire protocol + native bridge tests.
+
+Builds native/libnomadwire.so with g++ (skipped if unavailable) and
+verifies: codec roundtrips, byte-identical encoding between the C++ and
+Python codecs, and an end-to-end RPC through the native bridge into the
+TPU scheduler service.
+"""
+import json
+import socket
+import subprocess
+
+import pytest
+
+from nomad_tpu import mock, wire
+from nomad_tpu.server import Server
+from nomad_tpu.server.bridge_service import BridgeService
+
+NATIVE_DIR = wire._NATIVE_PATH.rsplit("/", 1)[0]
+
+
+@pytest.fixture(scope="module")
+def native():
+    try:
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.CalledProcessError) as exc:
+        pytest.skip(f"native toolchain unavailable: {exc}")
+    return wire.NativeWire()
+
+
+SAMPLES = [
+    None,
+    True,
+    False,
+    0,
+    -1,
+    2**40,
+    -(2**40),
+    3.5,
+    -0.125,
+    "",
+    "hello",
+    "uniçode ☃",
+    [],
+    [1, 2, 3],
+    {"a": 1, "b": [True, None, "x"], "c": {"nested": 2.5}},
+    {"evals": [{"eval_id": "e1", "count": 3, "cpu": 500}]},
+]
+
+
+@pytest.mark.parametrize("value", SAMPLES)
+def test_python_codec_roundtrip(value):
+    assert wire.decode(wire.encode(value)) == value
+
+
+def test_python_codec_bytes():
+    assert wire.decode(wire.encode(b"\x00\xff")) == b"\x00\xff"
+
+
+@pytest.mark.parametrize("value", SAMPLES)
+def test_native_codec_matches_python(native, value):
+    encoded_cpp = native.encode_json(value)
+    encoded_py = wire.encode(value)
+    assert encoded_cpp == encoded_py, (
+        f"codec divergence for {value!r}:\n"
+        f" cpp={encoded_cpp.hex()}\n py={encoded_py.hex()}"
+    )
+    assert native.decode_json(encoded_py) == value
+
+
+def test_native_version(native):
+    assert native.version().startswith("nomad-tpu-wire/")
+
+
+@pytest.fixture
+def bridge():
+    server = Server(num_schedulers=0, seed=55)
+    server.start()
+    for _ in range(10):
+        server.register_node(mock.node())
+    service = BridgeService(server, port=0)
+    service.start()
+    yield server, service
+    service.stop()
+    server.stop()
+
+
+def test_bridge_ping_python_client(bridge):
+    _server, service = bridge
+    sock = socket.create_connection(("127.0.0.1", service.port))
+    try:
+        resp = wire.call(sock, "TPUScheduler.Ping", {})
+        assert resp["ok"] is True
+        assert resp["nodes"] == 10
+    finally:
+        sock.close()
+
+
+def test_bridge_score_batch_python_client(bridge):
+    _server, service = bridge
+    sock = socket.create_connection(("127.0.0.1", service.port))
+    try:
+        resp = wire.call(
+            sock,
+            "TPUScheduler.ScoreBatch",
+            {
+                "evals": [
+                    {"eval_id": "e1", "seed": 7, "count": 3,
+                     "cpu": 500, "memory_mb": 256},
+                    {"eval_id": "e2", "seed": 8, "count": 2,
+                     "cpu": 200, "memory_mb": 128},
+                ]
+            },
+        )
+    finally:
+        sock.close()
+    results = {r["eval_id"]: r["nodes"] for r in resp["results"]}
+    assert len(results["e1"]) == 3
+    assert len(results["e2"]) == 2
+    # anti-affinity spreads one eval's picks over distinct nodes
+    assert len(set(results["e1"])) == 3
+
+
+def test_bridge_end_to_end_native_client(native, bridge):
+    """The full seam: C++ shim -> framed wire -> Python service ->
+    batched kernel -> C++ -> caller."""
+    _server, service = bridge
+    fd = native.connect("127.0.0.1", service.port)
+    try:
+        resp = native.call_json(fd, "TPUScheduler.Ping", {})
+        assert resp["ok"] is True
+        resp = native.call_json(
+            fd,
+            "TPUScheduler.ScoreBatch",
+            {
+                "evals": [
+                    {"eval_id": "native-1", "seed": 3, "count": 4,
+                     "cpu": 300, "memory_mb": 128}
+                ]
+            },
+        )
+        assert len(resp["results"][0]["nodes"]) == 4
+    finally:
+        native.close(fd)
+
+
+def test_bridge_unknown_method(bridge):
+    _server, service = bridge
+    sock = socket.create_connection(("127.0.0.1", service.port))
+    try:
+        resp = wire.call(sock, "Nope.Nope", {})
+        assert "error" in resp
+    finally:
+        sock.close()
