@@ -199,6 +199,43 @@ class APIHandler(BaseHTTPRequestHandler):
             )
             return True
 
+        m = re.fullmatch(r"/v1/job/([^/]+)/plan", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            body = self._body()
+            raw_job = body.get("Job") or body.get("job") or body
+            job = job_from_dict(raw_job)
+            job.id = m.group(1)
+            self._respond(
+                srv.plan_job(job, diff=body.get("Diff", True))
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/dispatch", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("dispatch-job", ns)
+            body = self._body()
+            child = srv.dispatch_job(
+                ns,
+                m.group(1),
+                meta=body.get("Meta") or body.get("meta"),
+                payload=(body.get("Payload") or "").encode() or None,
+            )
+            self._respond({"DispatchedJobID": child.id})
+            return True
+
+        m = re.fullmatch(r"/v1/client/fs/logs/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("read-logs", ns)
+            task = q.get("task", "")
+            kind = q.get("type", "stdout")
+            try:
+                data = srv.read_task_log(m.group(1), task, kind)
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond({"Data": data.decode("utf-8", "replace")})
+            return True
+
         m = re.fullmatch(r"/v1/job/([^/]+)/periodic/force", path)
         if m and method in ("POST", "PUT"):
             self._check_acl("submit-job", ns)

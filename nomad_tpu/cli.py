@@ -162,6 +162,56 @@ def cmd_job_status(args) -> None:
         )
 
 
+def cmd_job_plan(args) -> None:
+    path = args.file
+    if path.endswith(".json"):
+        with open(path) as f:
+            raw = json.load(f)
+        payload = raw.get("Job") or raw.get("job") or raw
+        from .api.codec import job_from_dict, job_to_dict
+
+        job = job_from_dict(payload)
+    else:
+        from . import jobspec
+        job = jobspec.parse_file(path)
+    from .api.codec import job_to_dict
+
+    resp = _request(
+        "POST", f"/v1/job/{job.id}/plan", {"Job": job_to_dict(job)}
+    )
+    diff = resp.get("Diff") or {}
+    print(f"Job: {job.id!r} ({diff.get('Type', 'Added')})")
+    for tg, changes in (resp.get("Annotations") or {}).items():
+        parts = ", ".join(
+            f"{k.lower()} {v}" for k, v in changes.items() if v
+        )
+        print(f"  group {tg!r}: {parts or 'no changes'}")
+    failed = resp.get("FailedTGAllocs") or {}
+    for tg, metric in failed.items():
+        print(f"  WARNING group {tg!r} would fail placement: {metric}")
+
+
+def cmd_job_dispatch(args) -> None:
+    meta = {}
+    for item in args.meta or []:
+        key, _, value = item.partition("=")
+        meta[key] = value
+    resp = _request(
+        "POST", f"/v1/job/{args.job_id}/dispatch", {"Meta": meta}
+    )
+    print(f"==> Dispatched {resp['DispatchedJobID']}")
+
+
+def cmd_alloc_logs(args) -> None:
+    kind = "stderr" if args.stderr else "stdout"
+    resp = _request(
+        "GET",
+        f"/v1/client/fs/logs/{args.alloc_id}?task={args.task}"
+        f"&type={kind}",
+    )
+    sys.stdout.write(resp.get("Data", ""))
+
+
 def cmd_job_stop(args) -> None:
     purge = "?purge=true" if args.purge else ""
     resp = _request("DELETE", f"/v1/job/{args.job_id}{purge}")
@@ -334,6 +384,13 @@ def build_parser() -> argparse.ArgumentParser:
     jr = job_sub.add_parser("run")
     jr.add_argument("file")
     jr.set_defaults(fn=cmd_job_run)
+    jp = job_sub.add_parser("plan")
+    jp.add_argument("file")
+    jp.set_defaults(fn=cmd_job_plan)
+    jd = job_sub.add_parser("dispatch")
+    jd.add_argument("job_id")
+    jd.add_argument("-meta", action="append", dest="meta")
+    jd.set_defaults(fn=cmd_job_dispatch)
     js = job_sub.add_parser("status")
     js.add_argument("job_id", nargs="?")
     js.set_defaults(fn=cmd_job_status)
@@ -372,6 +429,11 @@ def build_parser() -> argparse.ArgumentParser:
     als = alloc_sub.add_parser("status")
     als.add_argument("alloc_id")
     als.set_defaults(fn=cmd_alloc_status)
+    all_ = alloc_sub.add_parser("logs")
+    all_.add_argument("-stderr", action="store_true", dest="stderr")
+    all_.add_argument("alloc_id")
+    all_.add_argument("task")
+    all_.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval")
     ev_sub = ev.add_subparsers(dest="eval_cmd", required=True)

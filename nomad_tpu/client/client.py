@@ -66,6 +66,8 @@ class Client:
     def start(self) -> None:
         self._restore()
         self.server.register_node(self.node)
+        if hasattr(self.server, "register_client"):
+            self.server.register_client(self.node.id, self)
         self._stop.clear()
         for target, name in (
             (self._heartbeat_loop, "client-heartbeat"),

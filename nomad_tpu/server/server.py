@@ -51,6 +51,39 @@ from .worker import Worker
 DEFAULT_HEARTBEAT_TTL = 30.0
 
 
+class _PlanRecorder:
+    """Records scheduler output without committing (dry-run planner)."""
+
+    def __init__(self, store: StateStore) -> None:
+        self.store = store
+        self.plans = []
+        self.evals = []
+
+    def submit_plan(self, plan):
+        from ..structs import PlanResult
+
+        self.plans.append(plan)
+        # report everything as committed so the scheduler completes
+        result = PlanResult(
+            node_update=plan.node_update,
+            node_allocation=plan.node_allocation,
+            node_preemptions=plan.node_preemptions,
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+            alloc_index=self.store.latest_index(),
+        )
+        return result, None
+
+    def update_eval(self, ev):
+        self.evals.append(ev)
+
+    def create_eval(self, ev):
+        self.evals.append(ev)
+
+    def reblock_eval(self, ev):
+        self.evals.append(ev)
+
+
 class Server:
     def __init__(
         self,
@@ -310,6 +343,195 @@ class Server:
         return evals
 
     # -- client-side alloc updates (reference node_endpoint.go:1065) ----
+
+    # -- job plan: dry-run an eval without committing
+    # (reference nomad/job_endpoint.go Plan + scheduler/annotate.go) ----
+
+    def plan_job(self, job: Job, diff: bool = True) -> Dict:
+        """Run the scheduler against a snapshot with plan submission
+        rejected, returning the would-be changes per task group."""
+        from ..sched.generic_sched import BatchScheduler, ServiceScheduler
+        from ..sched.system_sched import SystemScheduler
+        from ..sched.testing import Harness
+        from ..structs import EVAL_TRIGGER_JOB_REGISTER
+
+        self._validate_job(job)
+        # stage the updated job in a shadow store view: we reuse the live
+        # store but restore the previous job version afterwards
+        prev = self.store.job_by_id(job.namespace, job.id)
+        self.store.upsert_job(job)
+        try:
+            recorder = _PlanRecorder(self.store)
+            ev = Evaluation(
+                namespace=job.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                job_id=job.id,
+                annotate_plan=True,
+                status=EVAL_STATUS_PENDING,
+            )
+            factory = {
+                "service": ServiceScheduler,
+                "batch": BatchScheduler,
+                "system": SystemScheduler,
+            }[job.type]
+            scheduler = factory(
+                self.store.snapshot(), recorder, seed=0
+            )
+            scheduler.process(ev)
+            annotations = {}
+            if recorder.plans and recorder.plans[-1].annotations:
+                raw = recorder.plans[-1].annotations.get(
+                    "desired_tg_updates", {}
+                )
+                annotations = {
+                    tg: {
+                        "Place": du.place,
+                        "Stop": du.stop,
+                        "Migrate": du.migrate,
+                        "InPlaceUpdate": du.in_place_update,
+                        "DestructiveUpdate": du.destructive_update,
+                        "Canary": du.canary,
+                        "Ignore": du.ignore,
+                    }
+                    for tg, du in raw.items()
+                }
+            failed = {}
+            for e in recorder.evals:
+                for tg, metric in (e.failed_tg_allocs or {}).items():
+                    failed[tg] = {
+                        "NodesEvaluated": metric.nodes_evaluated,
+                        "NodesFiltered": metric.nodes_filtered,
+                        "NodesExhausted": metric.nodes_exhausted,
+                        "ConstraintFiltered": metric.constraint_filtered,
+                        "DimensionExhausted": metric.dimension_exhausted,
+                    }
+            return {
+                "Annotations": annotations,
+                "FailedTGAllocs": failed,
+                "Diff": self._job_diff(prev, job) if diff else None,
+            }
+        finally:
+            # roll the staged job back
+            if prev is not None:
+                versions = self.store.job_versions.get(
+                    (job.namespace, job.id), []
+                )
+                if versions and versions[0] is job:
+                    versions.pop(0)
+                self.store.jobs[(job.namespace, job.id)] = prev
+            else:
+                self.store.jobs.pop((job.namespace, job.id), None)
+                self.store.job_versions.pop(
+                    (job.namespace, job.id), None
+                )
+
+    @staticmethod
+    def _job_diff(old: Optional[Job], new: Job) -> Dict:
+        """Field-level diff summary (reference nomad/structs/diff.go,
+        condensed to the fields the plan UX shows)."""
+        if old is None:
+            return {"Type": "Added"}
+        changes = {}
+        for attr in ("type", "priority", "datacenters"):
+            a, b = getattr(old, attr), getattr(new, attr)
+            if a != b:
+                changes[attr] = {"Old": a, "New": b}
+        old_groups = {tg.name: tg for tg in old.task_groups}
+        new_groups = {tg.name: tg for tg in new.task_groups}
+        group_changes = {}
+        for name in old_groups.keys() | new_groups.keys():
+            og, ng = old_groups.get(name), new_groups.get(name)
+            if og is None:
+                group_changes[name] = {"Type": "Added"}
+            elif ng is None:
+                group_changes[name] = {"Type": "Deleted"}
+            elif og != ng:
+                entry = {"Type": "Edited"}
+                if og.count != ng.count:
+                    entry["Count"] = {"Old": og.count, "New": ng.count}
+                group_changes[name] = entry
+        if group_changes:
+            changes["TaskGroups"] = group_changes
+        return {"Type": "Edited" if changes else "None", **changes}
+
+    # -- parameterized jobs (reference nomad/job_endpoint.go Dispatch) --
+
+    def dispatch_job(
+        self,
+        namespace: str,
+        job_id: str,
+        meta: Optional[Dict[str, str]] = None,
+        payload: Optional[bytes] = None,
+    ) -> Job:
+        from dataclasses import replace as _replace
+
+        parent = self.store.job_by_id(namespace, job_id)
+        if parent is None:
+            raise KeyError(job_id)
+        if not parent.is_parameterized():
+            raise ValueError(f"job {job_id!r} is not parameterized")
+        spec = parent.parameterized or {}
+        required = set(spec.get("meta_required", ()))
+        optional = set(spec.get("meta_optional", ()))
+        meta = dict(meta or {})
+        missing = required - set(meta)
+        if missing:
+            raise ValueError(f"missing required meta: {sorted(missing)}")
+        unexpected = set(meta) - required - optional
+        if unexpected:
+            raise ValueError(
+                f"unpermitted meta keys: {sorted(unexpected)}"
+            )
+        if payload and spec.get("payload") == "forbidden":
+            raise ValueError("payload is forbidden for this job")
+        if not payload and spec.get("payload") == "required":
+            raise ValueError("payload is required for this job")
+
+        from ..structs import new_id
+
+        child = _replace(parent)
+        child.id = f"{parent.id}/dispatch-{new_id()[:8]}"
+        child.name = child.id
+        child.parent_id = parent.id
+        child.parameterized = None
+        child.meta = {**parent.meta, **meta}
+        self.register_job(child)
+        return child
+
+    # -- client registry for log/fs proxying (reference
+    # nomad/client_rpc.go persistent connections) -----------------------
+
+    def register_client(self, node_id: str, client) -> None:
+        if not hasattr(self, "_clients"):
+            self._clients = {}
+        self._clients[node_id] = client
+
+    def read_task_log(
+        self, alloc_id: str, task: str, kind: str = "stdout",
+        max_bytes: int = 64 * 1024,
+    ) -> bytes:
+        """(reference client fs/logs endpoints via server proxy)"""
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(alloc_id)
+        client = getattr(self, "_clients", {}).get(alloc.node_id)
+        if client is None:
+            raise KeyError(f"no client connection for {alloc.node_id}")
+        import os
+
+        path = os.path.join(
+            client.data_dir, "allocs", alloc_id, f"{task}.{kind}"
+        )
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                return f.read()
+        except OSError:
+            return b""
 
     def update_allocs_from_client(self, updates: List[Allocation]) -> None:
         """Client pushes alloc status changes; terminal transitions free

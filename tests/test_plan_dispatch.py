@@ -1,0 +1,132 @@
+"""job plan (dry-run), parameterized dispatch and log-proxy tests
+(reference model: nomad/job_endpoint_test.go Plan/Dispatch,
+client fs endpoint tests).
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client
+from nomad_tpu.server import Server
+from nomad_tpu.structs import Task
+
+
+def wait_until(cond, timeout=10.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def server():
+    s = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=66)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_plan_new_job_annotations(server):
+    for _ in range(3):
+        server.register_node(mock.node())
+    job = mock.job(id="planme")
+    job.task_groups[0].count = 3
+    result = server.plan_job(job)
+    assert result["Diff"]["Type"] == "Added"
+    assert result["Annotations"]["web"]["Place"] == 3
+    # dry run: nothing committed
+    assert not server.store.allocs_by_job("default", "planme")
+    assert server.store.job_by_id("default", "planme") is None
+
+
+def test_plan_update_shows_destructive(server):
+    for _ in range(3):
+        server.register_node(mock.node())
+    job = mock.job(id="upd")
+    job.task_groups[0].count = 2
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+
+    job2 = mock.job(id="upd")
+    job2.task_groups[0].count = 2
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+    result = server.plan_job(job2)
+    ann = result["Annotations"]["web"]
+    assert ann["DestructiveUpdate"] == 2
+    assert result["Diff"]["Type"] == "Edited"
+    # live job untouched
+    assert server.store.job_by_id("default", "upd").task_groups[0].tasks[
+        0
+    ].config == {"command": "/bin/date"}
+
+
+def test_plan_reports_failed_placements(server):
+    # no nodes: everything fails
+    job = mock.job(id="nofit")
+    result = server.plan_job(job)
+    assert "web" in result["FailedTGAllocs"]
+    assert not server.store.evals_by_job("default", "nofit")
+
+
+def test_dispatch_parameterized_job(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    parent = mock.batch_job(id="batcher")
+    parent.task_groups[0].count = 1
+    parent.parameterized = {
+        "meta_required": ["input"],
+        "meta_optional": ["verbose"],
+    }
+    server.register_job(parent)
+    # parent creates no eval
+    assert not server.store.evals_by_job("default", "batcher")
+
+    with pytest.raises(ValueError):
+        server.dispatch_job("default", "batcher", meta={})
+    with pytest.raises(ValueError):
+        server.dispatch_job(
+            "default", "batcher", meta={"input": "x", "bogus": "y"}
+        )
+
+    child = server.dispatch_job(
+        "default", "batcher", meta={"input": "s3://bucket"}
+    )
+    assert child.parent_id == "batcher"
+    assert child.meta["input"] == "s3://bucket"
+    assert server.drain_to_idle(10)
+    assert server.store.allocs_by_job("default", child.id)
+
+
+def test_alloc_log_proxy(server, tmp_path):
+    client = Client(
+        server,
+        node=mock.node(),
+        data_dir=str(tmp_path),
+        fingerprint=False,
+        drivers=["raw_exec", "mock_driver", "exec"],
+    )
+    client.start()
+    try:
+        job = mock.job(id="logger")
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0] = Task(
+            name="speak",
+            driver="raw_exec",
+            config={
+                "command": "/bin/sh",
+                "args": ["-c", "echo hello-from-task; sleep 30"],
+            },
+        )
+        server.register_job(job)
+        assert server.drain_to_idle(10)
+        allocs = server.store.allocs_by_job("default", "logger")
+        assert wait_until(
+            lambda: b"hello-from-task"
+            in server.read_task_log(allocs[0].id, "speak", "stdout"),
+            timeout=10,
+        )
+    finally:
+        client.stop()
