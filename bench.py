@@ -23,7 +23,10 @@ import time
 import numpy as np
 
 from nomad_tpu import mock
-from nomad_tpu.ops.batch import batch_plan_picks_shared
+from nomad_tpu.ops.batch import (
+    batch_plan_picks_shared,
+    chained_plan_picks_shared,
+)
 from nomad_tpu.sched.feasible import shuffle_permutation
 from nomad_tpu.sched.generic_sched import ServiceScheduler
 from nomad_tpu.sched.testing import Harness
@@ -248,6 +251,29 @@ def bench_batched(h, check_against=None):
         f"tpu-batch: {BATCH_ROUNDS * BATCH_E} evals, {n_placed} "
         f"placements in {dt:.2f}s -> {rate:.1f} placements/s "
         f"({per_eval_ms:.2f} ms/eval amortized)"
+    )
+
+    # chained (serially-equivalent) variant: the production pipeline's
+    # launch shape; timed for reference
+    t0 = time.time()
+    for i in range(BATCH_ROUNDS):
+        ids = list(range(i * BATCH_E, (i + 1) * BATCH_E))
+        E = len(ids)
+        np.asarray(chained_plan_picks_shared(
+            *dev_cols,
+            perms_for(ids),
+            np.full(E, 500.0),
+            np.full(E, 256.0),
+            np.full(E, 300.0),
+            np.full(E, TG_COUNT, np.int32),
+            np.full(E, limit, np.int32),
+            np.int32(n_cand),
+            TG_COUNT,
+        ))
+    dt_chained = time.time() - t0
+    log(
+        f"tpu-batch-chained (serially-equivalent): "
+        f"{n_placed / dt_chained:.1f} placements/s"
     )
 
     if check_against:

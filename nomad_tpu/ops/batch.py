@@ -91,26 +91,29 @@ def _walk(s, f, perm, offset, limit, n_candidates):
     return chosen_row, pulls
 
 
-@functools.partial(
-    jax.jit, static_argnames=("n_picks", "spread_fit")
-)
-def plan_picks(
+def _run_picks(
     cpu_total,
     mem_total,
     disk_total,
+    used0,  # (cpu_used, mem_used, disk_used) starting columns
     inp: BatchInputs,
     n_candidates,
     n_picks: int,
-    spread_fit: bool = False,
+    spread_fit: bool,
+    wanted=None,  # i32 scalar: picks actually desired (<= n_picks);
+                  # surplus scan steps are inert so a batch can share one
+                  # static scan length without phantom placements
 ):
-    """P sequential placements for one eval; returns rows i32[P]
-    (NO_NODE when placement failed)."""
+    """Inner pick scan; returns (rows i32[P], final used columns)."""
+    if wanted is None:
+        wanted = jnp.asarray(n_picks, jnp.int32)
     dtype = cpu_total.dtype
     safe_cpu = jnp.where(cpu_total > 0, cpu_total, 1.0)
     safe_mem = jnp.where(mem_total > 0, mem_total, 1.0)
 
-    def step(carry, _):
+    def step(carry, pick_idx):
         cpu_used, mem_used, disk_used, collisions, excl, offset = carry
+        active = pick_idx < wanted
         cpu_after = cpu_used + inp.ask_cpu
         mem_after = mem_used + inp.ask_mem
         disk_after = disk_used + inp.ask_disk
@@ -152,6 +155,8 @@ def plan_picks(
         row, pulls = _walk(
             final, feasible, inp.perm, offset, inp.limit, n_candidates
         )
+        row = jnp.where(active, row, NO_NODE)
+        pulls = jnp.where(active, pulls, 0)
         ok = row != NO_NODE
         safe_row = jnp.where(ok, row, 0)
         upd = lambda arr, delta: arr.at[safe_row].add(
@@ -177,14 +182,165 @@ def plan_picks(
         ), row
 
     carry0 = (
-        inp.base_cpu_used,
-        inp.base_mem_used,
-        inp.base_disk_used,
+        used0[0],
+        used0[1],
+        used0[2],
         inp.base_collisions,
         jnp.zeros_like(inp.feasible),
         jnp.asarray(0, jnp.int32),
     )
-    _, rows = jax.lax.scan(step, carry0, None, length=n_picks)
+    final, rows = jax.lax.scan(
+        step, carry0, jnp.arange(n_picks, dtype=jnp.int32)
+    )
+    return rows, (final[0], final[1], final[2])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_picks", "spread_fit")
+)
+def plan_picks(
+    cpu_total,
+    mem_total,
+    disk_total,
+    inp: BatchInputs,
+    n_candidates,
+    n_picks: int,
+    spread_fit: bool = False,
+):
+    """P sequential placements for one eval; returns rows i32[P]
+    (NO_NODE when placement failed)."""
+    rows, _used = _run_picks(
+        cpu_total,
+        mem_total,
+        disk_total,
+        (inp.base_cpu_used, inp.base_mem_used, inp.base_disk_used),
+        inp,
+        n_candidates,
+        n_picks,
+        spread_fit,
+    )
+    return rows
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_picks", "spread_fit")
+)
+def chained_plan_picks(
+    cpu_total,
+    mem_total,
+    disk_total,
+    batch: BatchInputs,  # leading axis E
+    n_candidates,  # i32[E]
+    n_picks: int,
+    spread_fit: bool = False,
+    wanted=None,  # i32[E]: per-eval pick counts (<= n_picks)
+):
+    """E evals x P picks in ONE launch, *serially equivalent*: a
+    lax.scan over the evals carries the proposed-usage columns forward,
+    so eval k scores against the state left by evals 0..k-1 — exactly
+    what the sequential worker loop produces when each plan commits
+    before the next eval runs.  One device round trip amortizes over the
+    whole batch (the point, on tunneled accelerators) while decisions
+    stay bit-identical to serial execution.
+
+    Anti-affinity collision and distinct-hosts state reset per eval
+    (they are per-job; the broker's JobID dedup guarantees no two evals
+    in flight share a job).  Returns rows i32[E, P]."""
+    E = batch.perm.shape[0]
+    nc = jnp.broadcast_to(jnp.asarray(n_candidates, jnp.int32), (E,))
+    if wanted is None:
+        wanted = jnp.full((E,), n_picks, jnp.int32)
+
+    def eval_step(used, xs):
+        b, n, w = xs
+        rows, used_next = _run_picks(
+            cpu_total,
+            mem_total,
+            disk_total,
+            used,
+            b,
+            n,
+            n_picks,
+            spread_fit,
+            wanted=w,
+        )
+        return used_next, rows
+
+    used0 = (
+        batch.base_cpu_used[0],
+        batch.base_mem_used[0],
+        batch.base_disk_used[0],
+    )
+    _final, rows = jax.lax.scan(eval_step, used0, (batch, nc, wanted))
+    return rows
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_picks", "spread_fit")
+)
+def chained_plan_picks_shared(
+    cpu_total,
+    mem_total,
+    disk_total,
+    feasible,  # bool[C] shared static mask
+    base_cpu_used,  # f[C] shared snapshot usage
+    base_mem_used,
+    base_disk_used,
+    perms,  # i32[E, C]
+    ask_cpu,  # f[E]
+    ask_mem,
+    ask_disk,
+    desired_count,  # i32[E]
+    limit,  # i32[E]
+    n_candidates,
+    n_picks: int,
+    spread_fit: bool = False,
+):
+    """Serially-equivalent chained planner with shared node columns:
+    the production dispatch shape — only E x C walk orders and per-eval
+    scalars ship per launch, usage chains across evals in-kernel."""
+    C = cpu_total.shape[0]
+    zeros_i = jnp.zeros(C, jnp.int32)
+    zeros_b = jnp.zeros(C, dtype=bool)
+    zeros_f = jnp.zeros(C, cpu_total.dtype)
+
+    def eval_step(used, xs):
+        perm, a_cpu, a_mem, a_disk, desired, lim = xs
+        inp = BatchInputs(
+            feasible=feasible,
+            base_cpu_used=used[0],
+            base_mem_used=used[1],
+            base_disk_used=used[2],
+            base_collisions=zeros_i,
+            penalty=zeros_b,
+            affinity_score=zeros_f,
+            perm=perm,
+            ask_cpu=a_cpu,
+            ask_mem=a_mem,
+            ask_disk=a_disk,
+            desired_count=desired,
+            limit=lim,
+            distinct_hosts=jnp.asarray(False),
+        )
+        rows, used_next = _run_picks(
+            cpu_total,
+            mem_total,
+            disk_total,
+            used,
+            inp,
+            jnp.asarray(n_candidates, jnp.int32),
+            n_picks,
+            spread_fit,
+            wanted=desired,
+        )
+        return used_next, rows
+
+    used0 = (base_cpu_used, base_mem_used, base_disk_used)
+    _final, rows = jax.lax.scan(
+        eval_step,
+        used0,
+        (perms, ask_cpu, ask_mem, ask_disk, desired_count, limit),
+    )
     return rows
 
 
@@ -256,20 +412,22 @@ def batch_plan_picks(
     mem_total,
     disk_total,
     batch: BatchInputs,  # leading axis E on every field
-    n_candidates,
+    n_candidates,  # scalar or per-eval i32[E] (walk rotation modulus)
     n_picks: int,
     spread_fit: bool = False,
 ):
     """E independent evals x P picks in one launch; returns rows
     i32[E, P]."""
+    E = batch.perm.shape[0]
+    nc = jnp.broadcast_to(jnp.asarray(n_candidates, jnp.int32), (E,))
     return jax.vmap(
-        lambda b: plan_picks(
+        lambda b, n: plan_picks(
             cpu_total,
             mem_total,
             disk_total,
             b,
-            n_candidates,
+            n,
             n_picks,
             spread_fit,
         )
-    )(batch)
+    )(batch, nc)

@@ -1,0 +1,359 @@
+"""Batched evaluation pipeline: the production integration of the
+(evals x nodes x picks) kernel.
+
+The per-eval TPU path pays one device round trip per placement, which is
+ruinous when the accelerator sits behind a high-latency link (SURVEY.md
+section 7.3).  The BatchWorker instead:
+
+1. drains up to E compatible evals from the broker in one gulp,
+2. *prescores* them in a single `batch_plan_picks` launch — every eval's
+   full pick sequence, with in-kernel plan-delta accumulation and the
+   same seeded visit orders the sequential path would use,
+3. runs each eval through the ordinary GenericScheduler so all control
+   flow (reconciler, blocked evals, retries, plan bookkeeping, status
+   writes) stays in one implementation — but with a `PrescoredStack`
+   whose `select` answers from the precomputed rows after exact host
+   verification (ports/fit) of each winner,
+4. falls back to the normal scheduler for any eval whose shape deviates
+   from what was prescored (stops, penalties, preferred nodes, multi
+   task groups, spreads, preemption retries, verification mismatches).
+
+Because the kernel reproduces the sequential selection exactly
+(ops/batch.py), prescored evals produce bit-identical plans; the
+fallback guarantees correctness for everything else.
+"""
+from __future__ import annotations
+
+import math
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.batch import BatchInputs, chained_plan_picks
+from ..ops.constraints import MaskCompiler
+from ..sched.feasible import shuffle_permutation
+from ..sched.generic_sched import GenericScheduler
+from ..sched.rank import BinPackIterator, RankedNode
+from ..sched.stack import compute_visit_limit
+from ..sched.tpu_stack import _SingleNodeSource
+from ..sched.util import ready_nodes_in_dcs
+from ..structs import CONSTRAINT_DISTINCT_HOSTS, Evaluation, Job, TaskGroup
+from .worker import Worker
+
+BATCH_MAX = 64
+BATCH_WAIT_S = 0.005
+
+
+class _Deviation(Exception):
+    """The eval's control flow left the prescored fast path."""
+
+
+class PrescoredStack:
+    """Stack whose select() replays a precomputed pick sequence."""
+
+    def __init__(self, ctx, job: Job, tg_name: str, rows: List[int],
+                 table) -> None:
+        self.ctx = ctx
+        self.job = job
+        self.tg_name = tg_name
+        self.rows = rows
+        self.table = table
+        self.cursor = 0
+
+    def set_nodes(self, nodes) -> None:
+        # single-node set_nodes comes from inplace-update probing, which
+        # the batch path does not prescore
+        if len(nodes) <= 1:
+            raise _Deviation("inplace probe")
+
+    def set_job(self, job: Job) -> None:
+        if job.id != self.job.id or job.version != self.job.version:
+            raise _Deviation("job changed")
+
+    def select(self, tg: TaskGroup, options=None) -> Optional[RankedNode]:
+        if tg.name != self.tg_name:
+            raise _Deviation("unexpected task group")
+        if options is not None and (
+            options.penalty_node_ids
+            or options.preferred_nodes
+            or options.preempt
+        ):
+            raise _Deviation("select options need the sequential path")
+        if self.cursor >= len(self.rows):
+            raise _Deviation("prescored picks exhausted")
+        row = self.rows[self.cursor]
+        self.cursor += 1
+        if row < 0:
+            return None
+        node_id = self.table.node_ids[row]
+        node = self.ctx.state.node_by_id(node_id)
+        if node is None:
+            raise _Deviation("node vanished")
+        ranked = RankedNode(node=node)
+        source = _SingleNodeSource(ranked)
+        algorithm = (
+            self.ctx.state.scheduler_config().effective_scheduler_algorithm()
+        )
+        binpack = BinPackIterator(
+            self.ctx, source, False, self.job.priority, algorithm
+        )
+        binpack.set_job(self.job)
+        binpack.set_task_group(tg)
+        option = binpack.next()
+        if option is None:
+            raise _Deviation("winner failed exact verification")
+        return option
+
+
+class BatchWorker(Worker):
+    """Worker that drains and prescores evals in batches."""
+
+    def __init__(self, server, **kwargs) -> None:
+        super().__init__(server, **kwargs)
+        self.batch_max = BATCH_MAX
+        self.prescored = 0
+        self.fallbacks = 0
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            batch: List[Tuple[Evaluation, str]] = []
+            ev, token = self.server.broker.dequeue(
+                self.schedulers, timeout=0.1
+            )
+            if ev is None:
+                continue
+            batch.append((ev, token))
+            while len(batch) < self.batch_max:
+                ev, token = self.server.broker.dequeue(
+                    self.schedulers, timeout=BATCH_WAIT_S
+                )
+                if ev is None:
+                    break
+                batch.append((ev, token))
+            self._process_batch(batch)
+
+    # ------------------------------------------------------------------
+
+    def _process_batch(self, batch: List[Tuple[Evaluation, str]]) -> None:
+        """Process the drained evals in queue order, prescoring each
+        contiguous run of batchable evals in one chained kernel launch
+        so the outcome is exactly what the serial worker loop would
+        produce."""
+        run: List[Tuple[Evaluation, str, Job, TaskGroup]] = []
+        for ev, token in batch:
+            job = self.store.job_by_id(ev.namespace, ev.job_id)
+            if self._batchable(ev, job):
+                run.append((ev, token, job, job.task_groups[0]))
+                continue
+            self._flush_run(run)
+            run = []
+            self._process_sequential(ev, token)
+        self._flush_run(run)
+
+    def _flush_run(self, run) -> None:
+        if not run:
+            return
+        snap = self.store.snapshot()
+        prescored_rows: Dict[str, List[int]] = {}
+        try:
+            prescored_rows = self._prescore(snap, run)
+        except Exception:  # noqa: BLE001
+            prescored_rows = {}
+        for ev, token, job, tg in run:
+            rows = prescored_rows.get(ev.id)
+            if rows is None:
+                self._process_sequential(ev, token)
+                continue
+            try:
+                self._process_prescored(ev, token, job, tg, rows)
+                self.prescored += 1
+            except _Deviation:
+                self.fallbacks += 1
+                self._process_sequential(ev, token)
+            except Exception:  # noqa: BLE001
+                self._nack_quietly(ev, token)
+
+    def _process_sequential(self, ev, token) -> None:
+        try:
+            self.process_eval(ev, token)
+        except Exception:  # noqa: BLE001
+            self._nack_quietly(ev, token)
+
+    def _nack_quietly(self, ev, token) -> None:
+        try:
+            self.server.broker.nack(ev.id, token)
+        except ValueError:
+            pass
+
+    # ------------------------------------------------------------------
+
+    def _batchable(self, ev: Evaluation, job: Optional[Job]) -> bool:
+        if job is None or job.stopped():
+            return False
+        if ev.type not in ("service", "batch"):
+            return False
+        if len(job.task_groups) != 1:
+            return False
+        tg = job.task_groups[0]
+        if tg.spreads or job.spreads:
+            return False
+        if tg.networks or any(t.resources.networks for t in tg.tasks):
+            return False
+        if any(t.resources.devices for t in tg.tasks):
+            return False
+        if any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in list(job.constraints) + list(tg.constraints)
+        ):
+            # supported by the kernel but interacts with existing allocs
+            # through job-level collision sets; keep on the exact path
+            return False
+        if tg.ephemeral_disk.sticky:
+            return False
+        # existing non-terminal allocs may trigger stops/updates or
+        # reschedule penalties in the reconciler; prescoring assumes a
+        # pure place-only outcome
+        allocs = self.store.allocs_by_job(ev.namespace, ev.job_id)
+        if any(not a.terminal_status() for a in allocs):
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+
+    def _prescore(self, snap, prescorable) -> Dict[str, List[int]]:
+        table = snap.node_table
+        C = table.capacity
+        compiler = MaskCompiler(table)
+
+        per_eval: List[BatchInputs] = []
+        n_cands: List[int] = []
+        max_picks = 1
+        for ev, _token, job, tg in prescorable:
+            nodes, _by_dc = ready_nodes_in_dcs(snap, job.datacenters)
+            n_cand = len(nodes)
+            rng = random.Random(self.seed)
+            order = shuffle_permutation(rng, n_cand)
+            rows = np.asarray(
+                [table.row_of[n.id] for n in nodes], dtype=np.int32
+            )
+            present = set(rows.tolist())
+            perm = np.concatenate(
+                [
+                    rows[order],
+                    np.asarray(
+                        [r for r in range(C) if r not in present],
+                        dtype=np.int32,
+                    ),
+                ]
+            )
+            feasible = np.zeros(C, dtype=bool)
+            feasible[rows] = True
+            feasible &= table.active & table.eligible
+            for constraint in list(job.constraints) + [
+                c
+                for c in tg.constraints
+            ] + [c for t in tg.tasks for c in t.constraints]:
+                m = compiler.constraint_mask(constraint)
+                if m is not None:
+                    feasible &= m
+            for task in tg.tasks:
+                col = table.column(f"driver.{task.driver}")
+                feasible &= col.codes != -1
+
+            affinities = (
+                list(job.affinities)
+                + list(tg.affinities)
+                + [a for t in tg.tasks for a in t.affinities]
+            )
+            total, sum_w = compiler.affinity_score_vector(affinities)
+            aff_vec = total / sum_w if sum_w else np.zeros(C)
+
+            limit = compute_visit_limit(n_cand, ev.type == "batch")
+            if affinities:
+                limit = 2**31 - 1
+
+            max_picks = max(max_picks, tg.count)
+            n_cands.append(n_cand)
+            per_eval.append(
+                BatchInputs(
+                    feasible=feasible,
+                    base_cpu_used=table.cpu_used,
+                    base_mem_used=table.mem_used,
+                    base_disk_used=table.disk_used,
+                    base_collisions=np.zeros(C, np.int32),
+                    penalty=np.zeros(C, dtype=bool),
+                    affinity_score=aff_vec,
+                    perm=perm,
+                    ask_cpu=np.float64(
+                        sum(t.resources.cpu for t in tg.tasks)
+                    ),
+                    ask_mem=np.float64(
+                        sum(t.resources.memory_mb for t in tg.tasks)
+                    ),
+                    ask_disk=np.float64(tg.ephemeral_disk.size_mb),
+                    desired_count=np.int32(tg.count),
+                    limit=np.int32(limit),
+                    distinct_hosts=np.bool_(False),
+                )
+            )
+
+        stacked = BatchInputs(
+            *[
+                np.stack([getattr(e, f) for e in per_eval])
+                for f in BatchInputs._fields
+            ]
+        )
+        rows_out = np.asarray(
+            chained_plan_picks(
+                table.cpu_total,
+                table.mem_total,
+                table.disk_total,
+                stacked,
+                np.asarray(n_cands, np.int32),
+                int(max_picks),
+                wanted=np.asarray(
+                    [tg.count for _e, _t, _j, tg in prescorable],
+                    np.int32,
+                ),
+            )
+        )
+        out: Dict[str, List[int]] = {}
+        for k, (ev, _token, _job, tg) in enumerate(prescorable):
+            out[ev.id] = [int(r) for r in rows_out[k, : tg.count]]
+        return out
+
+    # ------------------------------------------------------------------
+
+    def _process_prescored(
+        self, ev: Evaluation, token: str, job: Job, tg: TaskGroup,
+        rows: List[int],
+    ) -> None:
+        snap = self.store.snapshot_min_index(
+            max(ev.modify_index, ev.snapshot_index), timeout=5.0
+        )
+        ev.snapshot_index = snap.index
+        outer = self
+
+        class _Factory:
+            def __call__(self, state, planner, batch, use_tpu=None,
+                         seed=None):
+                sched = GenericScheduler(
+                    state, planner, batch=batch, use_tpu=False, seed=seed
+                )
+                def make_stack():
+                    return PrescoredStack(
+                        sched.ctx, job, tg.name, rows, snap.node_table
+                    )
+                sched._make_stack = make_stack
+                return sched
+
+        scheduler = _Factory()(
+            snap, self, ev.type == "batch", seed=self.seed
+        )
+        scheduler.process(ev)
+        self.evals_processed += 1
+        self.server.broker.ack(ev.id, token)

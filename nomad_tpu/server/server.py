@@ -92,6 +92,7 @@ class Server:
         seed: Optional[int] = None,
         nack_timeout: float = 60.0,
         acl_enabled: bool = False,
+        batch_pipeline: bool = False,
     ) -> None:
         from ..acl import ACLStore
         from ..telemetry import Metrics
@@ -105,9 +106,17 @@ class Server:
         self.applier = PlanApplier(
             self.store, self.plan_queue, self.blocked, self.metrics
         )
-        self.workers: List[Worker] = [
-            Worker(self, seed=seed) for _ in range(num_schedulers)
-        ]
+        if batch_pipeline:
+            from .batch_worker import BatchWorker
+
+            self.workers: List[Worker] = [
+                BatchWorker(self, seed=seed)
+                for _ in range(num_schedulers)
+            ]
+        else:
+            self.workers = [
+                Worker(self, seed=seed) for _ in range(num_schedulers)
+            ]
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = Drainer(self)
         self.periodic = PeriodicDispatcher(self)

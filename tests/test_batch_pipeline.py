@@ -1,0 +1,134 @@
+"""Batched eval pipeline tests: the prescored path must produce plans
+identical to the sequential scheduler and fall back safely.
+"""
+import copy
+import random
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import compute_node_class
+
+
+def make_nodes(n, seed=0):
+    rng = random.Random(seed)
+    nodes = []
+    for _ in range(n):
+        node = mock.node()
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def make_jobs(n, seed=1):
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(n):
+        job = mock.job(id=f"batch-pipe-{i}")
+        job.task_groups[0].count = rng.randint(1, 5)
+        job.task_groups[0].tasks[0].resources.cpu = rng.choice([200, 500])
+        jobs.append(job)
+    return jobs
+
+
+def placements(server, job_id):
+    return sorted(
+        (a.name, a.node_id)
+        for a in server.store.allocs_by_job("default", job_id)
+        if not a.terminal_status()
+    )
+
+
+def test_batch_pipeline_matches_sequential():
+    nodes = make_nodes(20)
+    jobs = make_jobs(8)
+
+    seq = Server(num_schedulers=1, seed=99)
+    bat = Server(num_schedulers=1, seed=99, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(15)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(30)
+
+        for job in jobs:
+            assert placements(seq, job.id) == placements(bat, job.id), (
+                f"divergence for {job.id}"
+            )
+        worker = bat.workers[0]
+        assert worker.prescored > 0
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_fallback_for_complex_evals():
+    """Evals the prescorer cannot handle still complete correctly."""
+    from nomad_tpu.structs import Spread, SpreadTarget
+
+    server = Server(num_schedulers=1, seed=7, batch_pipeline=True)
+    server.start()
+    try:
+        for node in make_nodes(10, seed=3):
+            server.register_node(node)
+        # spread job: not batchable
+        job = mock.job(id="spready")
+        job.task_groups[0].count = 4
+        job.spreads = [Spread(attribute="${node.datacenter}", weight=50)]
+        server.register_job(job)
+        assert server.drain_to_idle(15)
+        assert len(placements(server, "spready")) == 4
+
+        # scale-up of an existing job: not batchable (live allocs)
+        job2 = mock.job(id="grower")
+        job2.task_groups[0].count = 2
+        server.register_job(job2)
+        assert server.drain_to_idle(15)
+        job3 = mock.job(id="grower")
+        job3.task_groups[0].count = 4
+        server.register_job(job3)
+        assert server.drain_to_idle(15)
+        assert len(placements(server, "grower")) == 4
+    finally:
+        server.stop()
+
+
+def test_batch_pipeline_blocked_eval_on_exhaustion():
+    server = Server(num_schedulers=1, seed=8, batch_pipeline=True)
+    server.start()
+    try:
+        node = mock.node()
+        node.node_resources.cpu = 1000
+        node.node_resources.memory_mb = 1024
+        node.computed_class = compute_node_class(node)
+        server.register_node(node)
+        job = mock.job(id="toolarge")
+        job.task_groups[0].count = 5
+        job.task_groups[0].tasks[0].resources.cpu = 400
+        server.register_job(job)
+        assert server.drain_to_idle(15)
+
+        def settled():
+            placed = placements(server, "toolarge")
+            return (
+                0 < len(placed) < 5
+                and server.blocked.blocked_count() >= 1
+            )
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not settled():
+            time.sleep(0.05)
+        assert settled()
+    finally:
+        server.stop()
