@@ -589,6 +589,25 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond({})
             return True
 
+        if path == "/v1/operator/snapshot/save" and method in ("POST", "PUT"):
+            self._check_acl("operator:write")
+            body = self._body()
+            from ..server.snapshot import save_snapshot
+
+            save_snapshot(srv, body["Path"])
+            self._respond({"Saved": body["Path"]})
+            return True
+
+        if path == "/v1/operator/snapshot/restore" and method in ("POST", "PUT"):
+            self._check_acl("operator:write")
+            body = self._body()
+            from ..server.snapshot import restore_snapshot
+
+            index = restore_snapshot(srv, body["Path"])
+            srv.restore_evals()
+            self._respond({"Index": index})
+            return True
+
         if path == "/v1/system/gc" and method in ("POST", "PUT"):
             self._check_acl("operator:write")
             srv.force_gc()

@@ -79,18 +79,50 @@ def _table(rows, headers) -> None:
 def cmd_agent(args) -> None:
     from .api.http import start_http_server
     from .client import Client
+    from .config import AgentConfig, load_config
     from .server import Server
 
-    server = Server(num_schedulers=args.num_schedulers)
-    server.start()
-    http = start_http_server(server, port=args.http_port)
-    print(f"==> nomad-tpu agent started; HTTP on :{http.port}")
-    clients = []
+    cfg = load_config(args.config) if args.config else AgentConfig()
     if args.dev:
-        client = Client(server, include_tpu_fingerprint=True)
+        cfg.client.enabled = True
+    if args.num_schedulers is not None:
+        cfg.server.num_schedulers = args.num_schedulers
+    if args.http_port is not None:
+        cfg.http.port = args.http_port
+
+    server = Server(
+        num_schedulers=cfg.server.num_schedulers,
+        heartbeat_ttl=cfg.server.heartbeat_ttl_s,
+        seed=cfg.server.seed,
+        acl_enabled=cfg.acl.enabled,
+        batch_pipeline=cfg.server.batch_pipeline,
+    )
+    server.start()
+    http = start_http_server(server, host=cfg.http.host, port=cfg.http.port)
+    print(f"==> nomad-tpu agent started; HTTP on :{http.port}")
+    bridge = None
+    if cfg.bridge_port is not None:
+        from .server.bridge_service import BridgeService
+
+        bridge = BridgeService(server, port=cfg.bridge_port)
+        bridge.start()
+        print(f"==> TPU bridge on :{bridge.port}")
+    clients = []
+    if cfg.client.enabled:
+        from .structs import Node
+
+        node = Node(datacenter=cfg.datacenter, name=cfg.name)
+        client = Client(
+            server,
+            node=node,
+            data_dir=cfg.data_dir,
+            drivers=cfg.client.drivers,
+            heartbeat_interval=cfg.client.heartbeat_interval_s,
+            include_tpu_fingerprint=cfg.client.include_tpu_fingerprint,
+        )
         client.start()
         clients.append(client)
-        print(f"==> dev client node {client.node.id[:8]} registered")
+        print(f"==> client node {client.node.id[:8]} registered")
     try:
         while True:
             time.sleep(1)
@@ -99,6 +131,8 @@ def cmd_agent(args) -> None:
     finally:
         for c in clients:
             c.stop()
+        if bridge is not None:
+            bridge.stop()
         http.stop()
         server.stop()
 
@@ -335,6 +369,19 @@ def cmd_deployment(args) -> None:
         print("==> Deployment failed")
 
 
+def cmd_operator_snapshot(args) -> None:
+    if args.action == "save":
+        resp = _request(
+            "POST", "/v1/operator/snapshot/save", {"Path": args.path}
+        )
+        print(f"==> Snapshot saved to {resp['Saved']}")
+    else:
+        resp = _request(
+            "POST", "/v1/operator/snapshot/restore", {"Path": args.path}
+        )
+        print(f"==> Snapshot restored (index {resp['Index']})")
+
+
 def cmd_operator_scheduler(args) -> None:
     if args.action == "get-config":
         print(
@@ -373,10 +420,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     agent = sub.add_parser("agent")
     agent.add_argument("-dev", action="store_true", dest="dev")
-    agent.add_argument("-http-port", type=int, default=4646,
+    agent.add_argument("-http-port", type=int, default=None,
                        dest="http_port")
-    agent.add_argument("-num-schedulers", type=int, default=2,
+    agent.add_argument("-num-schedulers", type=int, default=None,
                        dest="num_schedulers")
+    agent.add_argument("-config", default=None, dest="config")
     agent.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job")
@@ -455,6 +503,10 @@ def build_parser() -> argparse.ArgumentParser:
                       default=None)
     osch.add_argument("-tpu", choices=["true", "false"], default=None)
     osch.set_defaults(fn=cmd_operator_scheduler)
+    osnap = op_sub.add_parser("snapshot")
+    osnap.add_argument("action", choices=["save", "restore"])
+    osnap.add_argument("path")
+    osnap.set_defaults(fn=cmd_operator_snapshot)
 
     system = sub.add_parser("system")
     system.add_argument("action", choices=["gc"])

@@ -1,0 +1,139 @@
+"""Agent configuration (reference command/agent/config.go +
+config_parse.go): HCL or JSON config files merged with defaults and
+flags.
+
+    # agent.hcl
+    data_dir = "/var/lib/nomad-tpu"
+    server {
+      enabled        = true
+      num_schedulers = 4
+      batch_pipeline = true
+      heartbeat_ttl  = "30s"
+    }
+    client {
+      enabled = true
+      drivers = ["exec", "raw_exec", "mock_driver"]
+    }
+    http { port = 4646 }
+    acl { enabled = false }
+    telemetry { prometheus = true }
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ServerConfig:
+    enabled: bool = True
+    num_schedulers: int = 2
+    batch_pipeline: bool = False
+    heartbeat_ttl_s: float = 30.0
+    seed: Optional[int] = None
+
+
+@dataclass
+class ClientConfig:
+    enabled: bool = False
+    drivers: List[str] = field(
+        default_factory=lambda: ["exec", "raw_exec", "mock_driver"]
+    )
+    include_tpu_fingerprint: bool = True
+    heartbeat_interval_s: float = 10.0
+
+
+@dataclass
+class HTTPConfig:
+    host: str = "127.0.0.1"
+    port: int = 4646
+
+
+@dataclass
+class ACLConfig:
+    enabled: bool = False
+
+
+@dataclass
+class AgentConfig:
+    data_dir: str = ""
+    name: str = ""
+    datacenter: str = "dc1"
+    region: str = "global"
+    server: ServerConfig = field(default_factory=ServerConfig)
+    client: ClientConfig = field(default_factory=ClientConfig)
+    http: HTTPConfig = field(default_factory=HTTPConfig)
+    acl: ACLConfig = field(default_factory=ACLConfig)
+    bridge_port: Optional[int] = None
+
+
+def _duration_s(value, default: float) -> float:
+    if value is None:
+        return default
+    if isinstance(value, (int, float)):
+        return float(value)
+    total = 0.0
+    for num, unit in re.findall(r"([\d.]+)(h|m|s|ms)", str(value)):
+        total += float(num) * {"h": 3600, "m": 60, "s": 1, "ms": 0.001}[
+            unit
+        ]
+    return total if total else default
+
+
+def _first(value, default=None):
+    if isinstance(value, list):
+        return value[0] if value else default
+    return value if value is not None else default
+
+
+def config_from_dict(raw: Dict) -> AgentConfig:
+    cfg = AgentConfig()
+    cfg.data_dir = raw.get("data_dir", "")
+    cfg.name = raw.get("name", "")
+    cfg.datacenter = raw.get("datacenter", "dc1")
+    cfg.region = raw.get("region", "global")
+
+    server = _first(raw.get("server"), {}) or {}
+    cfg.server = ServerConfig(
+        enabled=bool(server.get("enabled", True)),
+        num_schedulers=int(server.get("num_schedulers", 2)),
+        batch_pipeline=bool(server.get("batch_pipeline", False)),
+        heartbeat_ttl_s=_duration_s(server.get("heartbeat_ttl"), 30.0),
+        seed=server.get("seed"),
+    )
+    client = _first(raw.get("client"), {}) or {}
+    cfg.client = ClientConfig(
+        enabled=bool(client.get("enabled", False)),
+        drivers=client.get("drivers")
+        or ["exec", "raw_exec", "mock_driver"],
+        include_tpu_fingerprint=bool(
+            client.get("include_tpu_fingerprint", True)
+        ),
+        heartbeat_interval_s=_duration_s(
+            client.get("heartbeat_interval"), 10.0
+        ),
+    )
+    http = _first(raw.get("http"), {}) or {}
+    cfg.http = HTTPConfig(
+        host=http.get("host", "127.0.0.1"),
+        port=int(http.get("port", 4646)),
+    )
+    acl = _first(raw.get("acl"), {}) or {}
+    cfg.acl = ACLConfig(enabled=bool(acl.get("enabled", False)))
+    if raw.get("bridge_port") is not None:
+        cfg.bridge_port = int(raw["bridge_port"])
+    return cfg
+
+
+def load_config(path: str) -> AgentConfig:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return config_from_dict(json.loads(text))
+    # reuse the jobspec HCL machinery for the config dialect
+    from .jobspec import _Parser, _tokenize
+
+    tree = _Parser(_tokenize(text)).parse_body(stop=None)
+    return config_from_dict(tree)

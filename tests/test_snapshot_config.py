@@ -1,0 +1,125 @@
+"""Operator snapshot + agent config tests (reference model:
+helper/snapshot tests, command/agent/config_parse_test.go).
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.config import load_config
+from nomad_tpu.server import Server
+from nomad_tpu.server.snapshot import restore_snapshot, save_snapshot
+
+
+def test_snapshot_roundtrip(tmp_path):
+    src = Server(num_schedulers=1, seed=77)
+    src.start()
+    try:
+        for _ in range(3):
+            src.register_node(mock.node())
+        job = mock.job(id="snapjob")
+        job.task_groups[0].count = 3
+        src.register_job(job)
+        assert src.drain_to_idle(10)
+        src.acls.enabled = True
+        token = src.acls.bootstrap()
+        path = str(tmp_path / "state.snap")
+        save_snapshot(src, path)
+    finally:
+        src.stop()
+
+    dst = Server(num_schedulers=1, seed=77)
+    index = restore_snapshot(dst, path)
+    assert index > 0
+    dst.start()
+    try:
+        assert len(list(dst.store.iter_nodes())) == 3
+        assert dst.store.job_by_id("default", "snapjob") is not None
+        allocs = dst.store.allocs_by_job("default", "snapjob")
+        assert len(allocs) == 3
+        # node table usage rebuilt
+        row = dst.store.node_table.row_of[allocs[0].node_id]
+        assert dst.store.node_table.cpu_used[row] > 0
+        # ACLs restored
+        assert dst.acls.enabled
+        assert dst.acls.resolve(token.secret_id).management
+        # the restored control plane still schedules
+        job2 = mock.job(id="post-restore")
+        job2.task_groups[0].count = 1
+        dst.register_job(job2)
+        assert dst.drain_to_idle(10)
+        assert dst.store.allocs_by_job("default", "post-restore")
+    finally:
+        dst.stop()
+
+
+def test_snapshot_restores_pending_evals(tmp_path):
+    src = Server(num_schedulers=0, seed=1)  # no workers: evals stay pending
+    src.start()
+    try:
+        src.register_node(mock.node())
+        job = mock.job(id="pending")
+        src.register_job(job)
+        path = str(tmp_path / "state.snap")
+        save_snapshot(src, path)
+    finally:
+        src.stop()
+
+    dst = Server(num_schedulers=1, seed=1)
+    restore_snapshot(dst, path)
+    dst.start()  # restore_evals re-enqueues the pending eval
+    try:
+        assert dst.drain_to_idle(10)
+        assert dst.store.allocs_by_job("default", "pending")
+    finally:
+        dst.stop()
+
+
+HCL_CONFIG = """
+data_dir   = "/tmp/nomad-tpu-test"
+datacenter = "dc7"
+
+server {
+  enabled        = true
+  num_schedulers = 4
+  batch_pipeline = true
+  heartbeat_ttl  = "45s"
+}
+
+client {
+  enabled = true
+  drivers = ["mock_driver"]
+}
+
+http {
+  port = 5646
+}
+
+acl { enabled = true }
+"""
+
+
+def test_load_hcl_config(tmp_path):
+    p = tmp_path / "agent.hcl"
+    p.write_text(HCL_CONFIG)
+    cfg = load_config(str(p))
+    assert cfg.data_dir == "/tmp/nomad-tpu-test"
+    assert cfg.datacenter == "dc7"
+    assert cfg.server.num_schedulers == 4
+    assert cfg.server.batch_pipeline is True
+    assert cfg.server.heartbeat_ttl_s == 45.0
+    assert cfg.client.enabled is True
+    assert cfg.client.drivers == ["mock_driver"]
+    assert cfg.http.port == 5646
+    assert cfg.acl.enabled is True
+
+
+def test_load_json_config(tmp_path):
+    p = tmp_path / "agent.json"
+    p.write_text(
+        '{"server": {"num_schedulers": 8}, "http": {"port": 7000}}'
+    )
+    cfg = load_config(str(p))
+    assert cfg.server.num_schedulers == 8
+    assert cfg.http.port == 7000
+    assert cfg.client.enabled is False
