@@ -215,6 +215,7 @@ def bench_batched(h, check_against=None):
     log(f"  compile+warmup {time.time()-t0:.1f}s")
 
     all_placements = {}
+    eval_latencies = []
     t0 = time.time()
     # pipeline: dispatch is async, so assemble batch k+1 while the device
     # runs batch k; only the result fetch synchronizes
@@ -222,8 +223,9 @@ def bench_batched(h, check_against=None):
         list(range(i * BATCH_E, (i + 1) * BATCH_E))
         for i in range(BATCH_ROUNDS)
     ]
-    inflight = None  # (eval_indexes, device rows)
+    inflight = None  # (eval_indexes, device rows, dispatch time)
     for batch_ids in batches:
+        t_dispatch = time.time()
         perms = perms_for(batch_ids)
         E = len(batch_ids)
         rows_dev = batch_plan_picks_shared(
@@ -238,19 +240,27 @@ def bench_batched(h, check_against=None):
             TG_COUNT,
         )
         if inflight is not None:
-            prev_ids, prev_rows = inflight
+            prev_ids, prev_rows, prev_t = inflight
             all_placements.update(translate(prev_ids, np.asarray(prev_rows)))
-        inflight = (batch_ids, rows_dev)
-    prev_ids, prev_rows = inflight
+            eval_latencies.extend(
+                [(time.time() - prev_t) * 1000.0] * len(prev_ids)
+            )
+        inflight = (batch_ids, rows_dev, t_dispatch)
+    prev_ids, prev_rows, prev_t = inflight
     all_placements.update(translate(prev_ids, np.asarray(prev_rows)))
+    eval_latencies.extend([(time.time() - prev_t) * 1000.0] * len(prev_ids))
     dt = time.time() - t0
     n_placed = sum(len(p) for p in all_placements.values())
     rate = n_placed / dt
     per_eval_ms = dt / (BATCH_ROUNDS * BATCH_E) * 1000
+    lat = np.sort(np.asarray(eval_latencies))
+    p50 = float(lat[int(0.50 * (len(lat) - 1))])
+    p99 = float(lat[int(0.99 * (len(lat) - 1))])
     log(
         f"tpu-batch: {BATCH_ROUNDS * BATCH_E} evals, {n_placed} "
         f"placements in {dt:.2f}s -> {rate:.1f} placements/s "
-        f"({per_eval_ms:.2f} ms/eval amortized)"
+        f"({per_eval_ms:.2f} ms/eval amortized; eval latency "
+        f"p50={p50:.1f}ms p99={p99:.1f}ms)"
     )
 
     # chained (serially-equivalent) variant: the production pipeline's
@@ -290,7 +300,7 @@ def bench_batched(h, check_against=None):
             f"tpu-batch decision check vs oracle: {matched} identical, "
             f"{mismatched} divergent"
         )
-    return rate
+    return rate, p50, p99
 
 
 def main():
@@ -326,7 +336,7 @@ def main():
     check = {
         i: oracle_placements[i] for i in range(CHECK_EVALS)
     }
-    batch_rate = bench_batched(h, check)
+    batch_rate, p50, p99 = bench_batched(h, check)
 
     print(
         json.dumps(
@@ -335,6 +345,8 @@ def main():
                 "value": round(batch_rate, 1),
                 "unit": "placements/s",
                 "vs_baseline": round(batch_rate / oracle_rate, 2),
+                "p99_eval_latency_ms": round(p99, 1),
+                "p50_eval_latency_ms": round(p50, 1),
             }
         )
     )

@@ -454,6 +454,32 @@ class APIHandler(BaseHTTPRequestHandler):
                 self._respond({"Updated": True})
                 return True
 
+        if path == "/v1/catalog/services" and method == "GET":
+            self._respond(srv.catalog.services())
+            return True
+
+        m = re.fullmatch(r"/v1/catalog/service/([^/]+)", path)
+        if m and method == "GET":
+            healthy = q.get("passing", "false") == "true"
+            self._respond(
+                [
+                    {
+                        "Service": i.service,
+                        "AllocID": i.alloc_id,
+                        "NodeID": i.node_id,
+                        "Task": i.task,
+                        "Address": i.address,
+                        "Port": i.port,
+                        "Tags": i.tags,
+                        "Healthy": i.healthy,
+                    }
+                    for i in srv.catalog.instances(
+                        m.group(1), healthy_only=healthy
+                    )
+                ]
+            )
+            return True
+
         if path == "/v1/status/leader" and method == "GET":
             self._respond("local")
             return True

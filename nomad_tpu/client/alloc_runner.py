@@ -26,7 +26,11 @@ class AllocRunner:
         data_dir: str = "",
         on_update: Optional[Callable[[Allocation], None]] = None,
         drivers: Optional[Dict[str, object]] = None,
+        secrets=None,
+        catalog=None,
     ) -> None:
+        self.secrets = secrets
+        self.catalog = catalog
         self.alloc = alloc
         self.on_update = on_update
         self._lock = threading.Lock()
@@ -69,6 +73,8 @@ class AllocRunner:
                 env={**env, "NOMAD_TASK_NAME": task.name},
                 on_state_change=self._on_task_state,
                 driver=driver,
+                secrets=secrets,
+                catalog=catalog,
             )
 
     # ------------------------------------------------------------------

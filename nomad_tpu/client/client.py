@@ -39,7 +39,9 @@ class Client:
         drivers: Optional[List[str]] = None,
         fingerprint: bool = True,
         include_tpu_fingerprint: bool = False,
+        secrets=None,
     ) -> None:
+        self.secrets = secrets
         self.server = server
         self.node = node or Node()
         self.data_dir = data_dir
@@ -72,6 +74,7 @@ class Client:
         for target, name in (
             (self._heartbeat_loop, "client-heartbeat"),
             (self._watch_allocs_loop, "client-watch"),
+            (self._check_loop, "client-checks"),
         ):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
@@ -138,6 +141,8 @@ class Client:
                     data_dir=self.data_dir,
                     on_update=self._push_alloc_update,
                     drivers=self.drivers,
+                    secrets=self.secrets,
+                    catalog=getattr(self.server, "catalog", None),
                 )
                 self.alloc_runners[alloc_id] = runner
                 runner.run()
@@ -153,6 +158,63 @@ class Client:
             alloc.namespace, alloc.job_id
         )
         self.server.update_allocs_from_client([update])
+
+    def _check_loop(self) -> None:
+        """Evaluate tcp/http service checks for running allocs and feed
+        results to the catalog (reference command/agent/consul checks +
+        client check watcher)."""
+        import socket as _socket
+        import urllib.request as _urlreq
+
+        while not self._stop.wait(2.0):
+            catalog = getattr(self.server, "catalog", None)
+            if catalog is None:
+                continue
+            with self._lock:
+                runners = list(self.alloc_runners.values())
+            for runner in runners:
+                if runner.is_terminal():
+                    continue
+                for tr in runner.task_runners.values():
+                    for service in tr.task.services:
+                        for check in service.checks:
+                            passing = self._run_check(
+                                check, runner.alloc, _socket, _urlreq
+                            )
+                            if passing is None:
+                                continue
+                            catalog.set_check_status(
+                                runner.alloc.id,
+                                tr.task.name,
+                                service.name,
+                                passing,
+                            )
+
+    @staticmethod
+    def _run_check(check, alloc, _socket, _urlreq):
+        ctype = check.get("type")
+        if ctype == "tcp":
+            address = check.get("address", "127.0.0.1")
+            port = int(check.get("port", 0))
+            if not port:
+                return None
+            try:
+                with _socket.create_connection(
+                    (address, port), timeout=1.0
+                ):
+                    return True
+            except OSError:
+                return False
+        if ctype == "http":
+            url = check.get("url") or check.get("path", "")
+            if not url.startswith("http"):
+                return None
+            try:
+                with _urlreq.urlopen(url, timeout=2.0) as resp:
+                    return 200 <= resp.status < 300
+            except Exception:  # noqa: BLE001
+                return False
+        return None
 
     # ------------------------------------------------------------------
     # local persistence (reference client/state/)

@@ -61,7 +61,11 @@ class TaskRunner:
         env: Optional[Dict[str, str]] = None,
         on_state_change: Optional[Callable[[str, TaskState], None]] = None,
         driver: Optional[DriverPlugin] = None,
+        secrets=None,
+        catalog=None,
     ) -> None:
+        self.secrets = secrets
+        self.catalog = catalog
         self.alloc_id = alloc_id
         self.task = task
         self.alloc_dir = alloc_dir
@@ -101,6 +105,29 @@ class TaskRunner:
     def run(self) -> None:
         """Start/wait/restart loop (reference task_runner.go:446 Run)."""
         try:
+            # render template blocks into the alloc dir before the first
+            # start (reference taskrunner/template hook)
+            if self.task.templates and self.alloc_dir:
+                from .templates import render_task_templates
+
+                try:
+                    render_task_templates(
+                        self.task.templates,
+                        self.alloc_dir,
+                        env={**self.env, **self.task.env},
+                        meta=self.task.meta,
+                        secrets=self.secrets,
+                        catalog=self.catalog,
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    self.exit_result = TaskExitResult(
+                        exit_code=-1, err=str(exc)
+                    )
+                    self._set_state(
+                        TASK_STATE_DEAD, failed=True,
+                        event="Template Failed",
+                    )
+                    return
             while not self._kill.is_set():
                 cfg = TaskConfig(
                     id=self.task_id,

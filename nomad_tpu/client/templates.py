@@ -1,0 +1,142 @@
+"""Task template rendering + secret access.
+
+The reference integrates consul-template (task template hook renders
+files with Consul keys / Vault secrets before start, re-rendering on
+change) and derives Vault tokens server-side (nomad/vault.go).  The
+nomad-tpu analogs:
+
+* `SecretsProvider` — the secret-backend seam.  `StaticSecretsProvider`
+  (in-memory) and `FileSecretsProvider` (directory of JSON documents,
+  the "dev server" shape) ship in-tree; a real Vault client can slot in
+  behind the same two methods.
+* `render_template` — the template dialect: `{{ env "NAME" }}`,
+  `{{ meta "key" }}`, `{{ secret "path" "field" }}`,
+  `{{ key "path" }}` (whole secret document as JSON) and
+  `{{ service "name" }}` (comma-joined healthy `addr:port` list from the
+  service catalog).
+* The task-runner template hook writes rendered files into the alloc
+  dir before the driver starts (reference taskrunner/template/).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Protocol
+
+
+class SecretsProvider(Protocol):
+    def read(self, path: str) -> Optional[Dict[str, Any]]:
+        ...
+
+
+class StaticSecretsProvider:
+    def __init__(self, secrets: Optional[Dict[str, Dict]] = None) -> None:
+        self.secrets = secrets or {}
+
+    def read(self, path: str) -> Optional[Dict[str, Any]]:
+        return self.secrets.get(path)
+
+
+class FileSecretsProvider:
+    """Secrets as JSON files under a root directory: secret path a/b/c
+    maps to <root>/a/b/c.json."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    def read(self, path: str) -> Optional[Dict[str, Any]]:
+        safe = os.path.normpath(path).lstrip("/")
+        if safe.startswith(".."):
+            return None
+        full = os.path.join(self.root, safe + ".json")
+        try:
+            with open(full) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+_TEMPLATE_RE = re.compile(
+    r"\{\{\s*(env|meta|secret|key|service)\s+((?:\"[^\"]*\"\s*)+)\}\}"
+)
+
+
+class TemplateError(Exception):
+    pass
+
+
+def render_template(
+    text: str,
+    env: Optional[Dict[str, str]] = None,
+    meta: Optional[Dict[str, str]] = None,
+    secrets: Optional[SecretsProvider] = None,
+    catalog=None,
+) -> str:
+    env = env or {}
+    meta = meta or {}
+
+    def sub(match: re.Match) -> str:
+        fn = match.group(1)
+        args = re.findall(r"\"([^\"]*)\"", match.group(2))
+        if fn == "env":
+            return env.get(args[0], "")
+        if fn == "meta":
+            return meta.get(args[0], "")
+        if fn == "secret":
+            if secrets is None:
+                raise TemplateError("no secrets provider configured")
+            doc = secrets.read(args[0])
+            if doc is None:
+                raise TemplateError(f"unknown secret {args[0]!r}")
+            if len(args) > 1:
+                if args[1] not in doc:
+                    raise TemplateError(
+                        f"secret {args[0]!r} has no field {args[1]!r}"
+                    )
+                return str(doc[args[1]])
+            return json.dumps(doc)
+        if fn == "key":
+            if secrets is None:
+                raise TemplateError("no secrets provider configured")
+            doc = secrets.read(args[0])
+            return json.dumps(doc) if doc is not None else ""
+        if fn == "service":
+            if catalog is None:
+                return ""
+            instances = catalog.instances(args[0], healthy_only=True)
+            return ",".join(
+                f"{i.address or 'localhost'}:{i.port}"
+                for i in instances
+            )
+        raise TemplateError(f"unknown template function {fn!r}")
+
+    return _TEMPLATE_RE.sub(sub, text)
+
+
+def render_task_templates(
+    templates: List[Dict[str, Any]],
+    alloc_dir: str,
+    env: Dict[str, str],
+    meta: Dict[str, str],
+    secrets: Optional[SecretsProvider],
+    catalog=None,
+) -> List[str]:
+    """Render a task's template blocks into the alloc dir; returns the
+    written paths.  Template block shape: {"destination": "local/x.conf",
+    "data": "..."} (reference structs.go Template)."""
+    written = []
+    for template in templates:
+        destination = template.get("destination", "")
+        data = template.get("data", "")
+        if not destination:
+            continue
+        rendered = render_template(
+            data, env=env, meta=meta, secrets=secrets, catalog=catalog
+        )
+        path = os.path.join(alloc_dir, destination)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(rendered)
+        written.append(path)
+    return written

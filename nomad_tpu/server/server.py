@@ -120,6 +120,9 @@ class Server:
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = Drainer(self)
         self.periodic = PeriodicDispatcher(self)
+        from .services import ServiceCatalog
+
+        self.catalog = ServiceCatalog(self)
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._running = False

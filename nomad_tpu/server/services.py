@@ -1,0 +1,134 @@
+"""Service catalog: registration + discovery for job services.
+
+The reference delegates service registration to Consul
+(command/agent/consul/ syncs task services into the Consul catalog;
+clients register/deregister as allocs start and stop).  nomad-tpu carries
+the catalog in-framework: a store watcher keeps it in sync with
+allocation state, and the HTTP API exposes discovery
+(/v1/catalog/services, /v1/catalog/service/<name>).
+
+An instance is healthy when its allocation is running; check definitions
+(tcp/http) are evaluated by the client's check runner and fold into
+health via `set_check_status`.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import (
+    ALLOC_CLIENT_STATUS_RUNNING,
+    Allocation,
+)
+
+
+@dataclass
+class ServiceInstance:
+    service: str
+    alloc_id: str
+    node_id: str
+    job_id: str
+    task: str
+    address: str = ""
+    port: int = 0
+    tags: List[str] = field(default_factory=list)
+    healthy: bool = True
+    checks_passing: bool = True
+
+
+class ServiceCatalog:
+    def __init__(self, server) -> None:
+        self.server = server
+        self.store = server.store
+        self._lock = threading.Lock()
+        # service name -> {alloc_id/task -> instance}
+        self._services: Dict[str, Dict[str, ServiceInstance]] = {}
+        # external check results: (alloc_id, task, service) -> bool
+        self._check_status: Dict[Tuple[str, str, str], bool] = {}
+        self.store.add_watcher(self._on_change)
+
+    # ------------------------------------------------------------------
+
+    def _on_change(self, table: str, _index: int) -> None:
+        if table == "allocs":
+            self.sync()
+
+    def sync(self) -> None:
+        """Rebuild the catalog from allocation state (reference
+        command/agent/consul/client.go sync loop, push-based there)."""
+        with self._lock:
+            fresh: Dict[str, Dict[str, ServiceInstance]] = {}
+            for alloc in self.store.allocs.values():
+                if alloc.terminal_status():
+                    continue
+                job = alloc.job or self.store.job_by_id(
+                    alloc.namespace, alloc.job_id
+                )
+                if job is None:
+                    continue
+                tg = job.lookup_task_group(alloc.task_group)
+                if tg is None:
+                    continue
+                node = self.store.node_by_id(alloc.node_id)
+                address = ""
+                if node is not None and node.node_resources.networks:
+                    address = node.node_resources.networks[0].ip
+                running = (
+                    alloc.client_status == ALLOC_CLIENT_STATUS_RUNNING
+                )
+                port_by_label = {}
+                if alloc.allocated_resources is not None:
+                    for p in alloc.allocated_resources.shared.ports:
+                        port_by_label[p.label] = p.value
+                    for tr in alloc.allocated_resources.tasks.values():
+                        for net in tr.networks:
+                            for p in list(net.reserved_ports) + list(
+                                net.dynamic_ports
+                            ):
+                                port_by_label[p.label] = p.value
+                for task in tg.tasks:
+                    for service in task.services:
+                        if not service.name:
+                            continue
+                        key = f"{alloc.id}/{task.name}"
+                        checks_ok = self._check_status.get(
+                            (alloc.id, task.name, service.name), True
+                        )
+                        inst = ServiceInstance(
+                            service=service.name,
+                            alloc_id=alloc.id,
+                            node_id=alloc.node_id,
+                            job_id=alloc.job_id,
+                            task=task.name,
+                            address=address,
+                            port=port_by_label.get(
+                                service.port_label, 0
+                            ),
+                            tags=list(service.tags),
+                            healthy=running and checks_ok,
+                            checks_passing=checks_ok,
+                        )
+                        fresh.setdefault(service.name, {})[key] = inst
+            self._services = fresh
+
+    # ------------------------------------------------------------------
+
+    def set_check_status(
+        self, alloc_id: str, task: str, service: str, passing: bool
+    ) -> None:
+        self._check_status[(alloc_id, task, service)] = passing
+        self.sync()
+
+    def services(self) -> List[str]:
+        with self._lock:
+            return sorted(self._services)
+
+    def instances(
+        self, name: str, healthy_only: bool = False
+    ) -> List[ServiceInstance]:
+        with self._lock:
+            out = list(self._services.get(name, {}).values())
+        if healthy_only:
+            out = [i for i in out if i.healthy]
+        return sorted(out, key=lambda i: (i.alloc_id, i.task))

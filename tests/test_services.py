@@ -1,0 +1,240 @@
+"""Service catalog + template/secrets tests (reference model:
+command/agent/consul tests, taskrunner/template tests).
+"""
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client
+from nomad_tpu.client.templates import (
+    FileSecretsProvider,
+    StaticSecretsProvider,
+    TemplateError,
+    render_template,
+)
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    AllocatedSharedResources,
+    AssignedPortData,
+    Service,
+    Task,
+)
+
+
+def wait_until(cond, timeout=10.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# templates / secrets
+# ---------------------------------------------------------------------------
+
+
+def test_render_env_and_meta():
+    out = render_template(
+        'addr={{ env "ADDR" }} region={{ meta "region" }}',
+        env={"ADDR": "1.2.3.4"},
+        meta={"region": "us"},
+    )
+    assert out == "addr=1.2.3.4 region=us"
+
+
+def test_render_secrets():
+    secrets = StaticSecretsProvider(
+        {"db/creds": {"user": "app", "password": "hunter2"}}
+    )
+    out = render_template(
+        'u={{ secret "db/creds" "user" }} p={{ secret "db/creds" "password" }}',
+        secrets=secrets,
+    )
+    assert out == "u=app p=hunter2"
+    with pytest.raises(TemplateError):
+        render_template('{{ secret "nope" "x" }}', secrets=secrets)
+    with pytest.raises(TemplateError):
+        render_template('{{ secret "db/creds" "nope" }}', secrets=secrets)
+
+
+def test_file_secrets_provider(tmp_path):
+    d = tmp_path / "db"
+    d.mkdir()
+    (d / "creds.json").write_text(json.dumps({"user": "filed"}))
+    provider = FileSecretsProvider(str(tmp_path))
+    assert provider.read("db/creds")["user"] == "filed"
+    assert provider.read("../etc/passwd") is None
+    assert provider.read("missing") is None
+
+
+# ---------------------------------------------------------------------------
+# catalog
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def server():
+    s = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=88)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _service_job(job_id="svc", port_label="http", count=2):
+    job = mock.job(id=job_id)
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0] = Task(
+        name="web",
+        driver="mock_driver",
+        config={"run_for": -1},
+        services=[
+            Service(name="web-api", port_label=port_label, tags=["v1"])
+        ],
+    )
+    return job
+
+
+def test_catalog_tracks_running_allocs(server):
+    for _ in range(3):
+        server.register_node(mock.node())
+    client = None
+    job = _service_job()
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    server.catalog.sync()
+    # allocs pending: registered but unhealthy
+    instances = server.catalog.instances("web-api")
+    assert len(instances) == 2
+    assert all(not i.healthy for i in instances)
+
+    # mark running -> healthy
+    allocs = server.store.allocs_by_job("default", job.id)
+    for a in allocs:
+        a.client_status = "running"
+        # give one a port
+        if a.allocated_resources:
+            a.allocated_resources.shared.ports = [
+                AssignedPortData(label="http", value=8080)
+            ]
+    server.store.upsert_allocs(allocs)
+    server.catalog.sync()
+    healthy = server.catalog.instances("web-api", healthy_only=True)
+    assert len(healthy) == 2
+    assert any(i.port == 8080 for i in healthy)
+    assert server.catalog.services() == ["web-api"]
+
+    # stop -> deregistered
+    server.deregister_job("default", job.id)
+    assert server.drain_to_idle(10)
+    server.catalog.sync()
+    assert server.catalog.instances("web-api") == []
+
+
+def test_catalog_check_status_folds_into_health(server):
+    server.register_node(mock.node())
+    job = _service_job(count=1)
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    allocs = server.store.allocs_by_job("default", job.id)
+    for a in allocs:
+        a.client_status = "running"
+    server.store.upsert_allocs(allocs)
+    server.catalog.sync()
+    assert server.catalog.instances("web-api", healthy_only=True)
+    server.catalog.set_check_status(
+        allocs[0].id, "web", "web-api", False
+    )
+    assert not server.catalog.instances("web-api", healthy_only=True)
+
+
+def test_tcp_check_runner(server):
+    # a real listening socket the check can hit
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    try:
+        job = mock.job(id="checked")
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0] = Task(
+            name="web",
+            driver="mock_driver",
+            config={"run_for": -1},
+            services=[
+                Service(
+                    name="checked-svc",
+                    checks=[{"type": "tcp", "port": port}],
+                )
+            ],
+        )
+        client = Client(
+            server, node=mock.node(), fingerprint=False
+        )
+        client.start()
+        try:
+            server.register_job(job)
+            assert server.drain_to_idle(10)
+            assert wait_until(
+                lambda: server.catalog.instances(
+                    "checked-svc", healthy_only=True
+                ),
+                timeout=10,
+            )
+            # kill the listener: check fails, instance goes unhealthy
+            listener.close()
+            assert wait_until(
+                lambda: not server.catalog.instances(
+                    "checked-svc", healthy_only=True
+                ),
+                timeout=10,
+            )
+        finally:
+            client.stop()
+    finally:
+        try:
+            listener.close()
+        except OSError:
+            pass
+
+
+def test_template_rendering_into_alloc_dir(server, tmp_path):
+    secrets = StaticSecretsProvider({"app/conf": {"token": "s3cr3t"}})
+    client = Client(
+        server,
+        node=mock.node(),
+        data_dir=str(tmp_path),
+        fingerprint=False,
+        secrets=secrets,
+    )
+    client.start()
+    try:
+        job = mock.job(id="templated")
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0] = Task(
+            name="web",
+            driver="mock_driver",
+            config={"run_for": -1},
+            templates=[
+                {
+                    "destination": "local/app.conf",
+                    "data": 'token={{ secret "app/conf" "token" }}\n'
+                            'alloc={{ env "NOMAD_ALLOC_ID" }}\n',
+                }
+            ],
+        )
+        server.register_job(job)
+        assert server.drain_to_idle(10)
+        allocs = server.store.allocs_by_job("default", "templated")
+        path = tmp_path / "allocs" / allocs[0].id / "local" / "app.conf"
+        assert wait_until(lambda: path.exists(), timeout=10)
+        content = path.read_text()
+        assert "token=s3cr3t" in content
+        assert f"alloc={allocs[0].id}" in content
+    finally:
+        client.stop()
