@@ -1,0 +1,24 @@
+"""Raft consensus for the replicated control plane.
+
+The reference replicates its state machine with hashicorp/raft
+(nomad/server.go:105-109, nomad/raft_rpc.go) and applies committed log
+entries through the FSM dispatch (nomad/fsm.go:180).  This package is a
+self-contained Raft implementation with the same shape: a replicated
+log, leader election with randomized timeouts, AppendEntries/RequestVote
+/InstallSnapshot RPCs over a pluggable transport, log compaction via FSM
+snapshots, and a leadership-observer channel that drives the
+establish/revoke-leadership lifecycle (nomad/leader.go:54
+monitorLeadership).
+"""
+from .log import LogEntry, RaftLog
+from .node import RaftNode, NotLeaderError
+from .transport import InmemTransport, TransportError
+
+__all__ = [
+    "LogEntry",
+    "RaftLog",
+    "RaftNode",
+    "NotLeaderError",
+    "InmemTransport",
+    "TransportError",
+]

@@ -1,0 +1,122 @@
+"""Replicated log storage (reference: raft-boltdb log store,
+nomad/server.go:105-109).
+
+In-memory list with a compaction offset; the snapshot path truncates the
+prefix once the FSM has captured state through an index.  Entries are
+(index, term, kind, data) where data is an opaque serialized command —
+the raft core never interprets it (reference fsm.go owns decode).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+KIND_COMMAND = 0
+KIND_NOOP = 1  # barrier entry appended on leadership (raft LogNoop)
+
+
+@dataclass
+class LogEntry:
+    index: int
+    term: int
+    kind: int = KIND_COMMAND
+    data: bytes = b""
+
+
+class RaftLog:
+    """Compactable in-memory log.  Index 0 is the null sentinel; the
+    first real entry has index 1 (matching hashicorp/raft)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._entries: List[LogEntry] = []
+        # index/term of the entry just before self._entries[0]
+        self._snapshot_index = 0
+        self._snapshot_term = 0
+
+    # -- reads ----------------------------------------------------------
+
+    def last_index(self) -> int:
+        with self._lock:
+            if self._entries:
+                return self._entries[-1].index
+            return self._snapshot_index
+
+    def last_term(self) -> int:
+        with self._lock:
+            if self._entries:
+                return self._entries[-1].term
+            return self._snapshot_term
+
+    def term_at(self, index: int) -> Optional[int]:
+        """Term of the entry at index, or None if not present (compacted
+        away or beyond the end).  Index 0 always has term 0."""
+        with self._lock:
+            if index == 0:
+                return 0
+            if index == self._snapshot_index:
+                return self._snapshot_term
+            entry = self._get(index)
+            return entry.term if entry is not None else None
+
+    def _get(self, index: int) -> Optional[LogEntry]:
+        pos = index - self._snapshot_index - 1
+        if 0 <= pos < len(self._entries):
+            return self._entries[pos]
+        return None
+
+    def get(self, index: int) -> Optional[LogEntry]:
+        with self._lock:
+            return self._get(index)
+
+    def entries_from(self, index: int, limit: int = 512) -> List[LogEntry]:
+        """Entries with log index >= index (up to limit)."""
+        with self._lock:
+            pos = index - self._snapshot_index - 1
+            if pos < 0:
+                return []  # compacted; caller must fall back to snapshot
+            return list(self._entries[pos : pos + limit])
+
+    @property
+    def snapshot_index(self) -> int:
+        with self._lock:
+            return self._snapshot_index
+
+    @property
+    def snapshot_term(self) -> int:
+        with self._lock:
+            return self._snapshot_term
+
+    # -- writes ---------------------------------------------------------
+
+    def append(self, entry: LogEntry) -> None:
+        with self._lock:
+            assert entry.index == self.last_index() + 1
+            self._entries.append(entry)
+
+    def truncate_from(self, index: int) -> None:
+        """Drop entries with log index >= index (conflict resolution,
+        AppendEntries receiver step 3)."""
+        with self._lock:
+            pos = index - self._snapshot_index - 1
+            if pos < len(self._entries):
+                del self._entries[max(pos, 0) :]
+
+    def compact_through(self, index: int, term: int) -> None:
+        """Discard entries with log index <= index after an FSM snapshot
+        covers them."""
+        with self._lock:
+            if index <= self._snapshot_index:
+                return
+            keep = index - self._snapshot_index
+            del self._entries[:keep]
+            self._snapshot_index = index
+            self._snapshot_term = term
+
+    def reset_to_snapshot(self, index: int, term: int) -> None:
+        """Discard the whole log after installing a snapshot."""
+        with self._lock:
+            self._entries.clear()
+            self._snapshot_index = index
+            self._snapshot_term = term

@@ -1,0 +1,568 @@
+"""Raft consensus core (leader election, log replication, commitment,
+FSM apply, snapshot/compaction).
+
+Plays the role hashicorp/raft plays for the reference server
+(nomad/server.go:105 setupRaft, nomad/fsm.go Apply/Snapshot/Restore).
+The FSM contract matches: apply(bytes) -> result for committed entries,
+snapshot() -> bytes / restore(bytes) for compaction and catch-up.
+Leadership changes surface through an observer callback, which the
+server layer uses the way the reference uses the raft leaderCh
+(nomad/leader.go:54 monitorLeadership -> establish/revokeLeadership).
+"""
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .log import KIND_COMMAND, KIND_NOOP, LogEntry, RaftLog
+from .transport import TransportError
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: Optional[str]) -> None:
+        super().__init__(f"not the leader (leader hint: {leader})")
+        self.leader = leader
+
+
+class _Future:
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+    def resolve(self, result) -> None:
+        self.result = result
+        self._event.set()
+
+    def fail(self, error: Exception) -> None:
+        self.error = error
+        self._event.set()
+
+    def wait(self, timeout: float):
+        if not self._event.wait(timeout):
+            raise TimeoutError("raft apply timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class RaftNode:
+    """One consensus participant.  Peers are a static configuration
+    (the reference bootstraps from config/serf join;
+    nomad/server.go:1355 bootstrapExpect)."""
+
+    def __init__(
+        self,
+        addr: str,
+        peers: List[str],
+        transport,
+        fsm,
+        election_timeout: float = 0.15,
+        heartbeat_interval: float = 0.04,
+        snapshot_threshold: int = 2048,
+        on_leadership: Optional[Callable[[bool, int], None]] = None,
+    ) -> None:
+        self.addr = addr
+        self.peers = [p for p in peers if p != addr]
+        self.transport = transport
+        self.fsm = fsm
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.snapshot_threshold = snapshot_threshold
+        self.on_leadership = on_leadership
+
+        self._lock = threading.RLock()
+        self.state = FOLLOWER
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log = RaftLog()
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+
+        # leader volatile state
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._futures: Dict[int, _Future] = {}
+
+        # retained FSM snapshot for follower catch-up
+        self._snapshot_data: Optional[bytes] = None
+
+        self._deadline = 0.0  # election deadline (monotonic)
+        self._wake = threading.Event()
+        self._apply_cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._applied_since_snapshot = 0
+        # ordered leadership notifications (the reference's raft
+        # leaderCh is an ordered channel; firing callbacks from
+        # detached threads could deliver up/down out of order)
+        self._notify_q: "queue.Queue" = queue.Queue()
+
+        transport.register(addr, self._handle_rpc)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._reset_election_deadline()
+        for name, target in (
+            ("raft-driver", self._driver),
+            ("raft-apply", self._apply_loop),
+            ("raft-notify", self._notify_loop),
+        ):
+            t = threading.Thread(
+                target=target, name=f"{name}@{self.addr}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        with self._lock:
+            was_leader = self.state == LEADER
+            self.state = FOLLOWER
+            for fut in self._futures.values():
+                fut.fail(NotLeaderError(None))
+            self._futures.clear()
+            if was_leader:
+                self._notify_q.put((False, self.current_term))
+        self._notify_q.put(None)  # notifier drain sentinel
+        self._stop.set()
+        self._wake.set()
+        with self._lock:
+            self._apply_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+        self.transport.deregister(self.addr)
+
+    def _notify_loop(self) -> None:
+        """Single dispatcher so up/down events arrive in order."""
+        while True:
+            item = self._notify_q.get()
+            if item is None:
+                return
+            if self.on_leadership:
+                try:
+                    self.on_leadership(*item)
+                except Exception:  # noqa: BLE001 — observer fault
+                    pass
+
+    # -- public API -----------------------------------------------------
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leader_hint(self) -> Optional[str]:
+        with self._lock:
+            return self.addr if self.state == LEADER else self.leader_id
+
+    def apply(self, data: bytes, timeout: float = 5.0):
+        """Append a command, replicate, and return the FSM's apply result
+        once committed (reference nomad/rpc.go:742 raftApply)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            index = self.log.last_index() + 1
+            self.log.append(
+                LogEntry(index, self.current_term, KIND_COMMAND, data)
+            )
+            fut = _Future()
+            self._futures[index] = fut
+        self._wake.set()  # replicate now
+        return fut.wait(timeout)
+
+    def barrier(self, timeout: float = 5.0) -> None:
+        """Commit a no-op to confirm leadership / flush the pipeline."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            index = self.log.last_index() + 1
+            self.log.append(
+                LogEntry(index, self.current_term, KIND_NOOP, b"")
+            )
+            fut = _Future()
+            self._futures[index] = fut
+        self._wake.set()
+        fut.wait(timeout)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "term": self.current_term,
+                "last_log_index": self.log.last_index(),
+                "commit_index": self.commit_index,
+                "applied_index": self.last_applied,
+                "leader": self.leader_hint(),
+                "snapshot_index": self.log.snapshot_index,
+            }
+
+    # -- driver thread --------------------------------------------------
+
+    def _reset_election_deadline(self) -> None:
+        jitter = random.uniform(1.0, 2.0)
+        self._deadline = time.monotonic() + self.election_timeout * jitter
+
+    def _driver(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                state = self.state
+            if state == LEADER:
+                self._replicate_all()
+                self._wake.wait(self.heartbeat_interval)
+                self._wake.clear()
+            else:
+                wait = self._deadline - time.monotonic()
+                if wait > 0:
+                    self._wake.wait(min(wait, 0.02))
+                    self._wake.clear()
+                    continue
+                self._run_election()
+
+    # -- election -------------------------------------------------------
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            term = self.current_term
+            self.voted_for = self.addr
+            self.leader_id = None
+            last_index = self.log.last_index()
+            last_term = self.log.last_term()
+        self._reset_election_deadline()
+
+        votes = 1
+        for peer in self.peers:
+            try:
+                resp = self.transport.rpc(
+                    self.addr,
+                    peer,
+                    "request_vote",
+                    {
+                        "term": term,
+                        "candidate": self.addr,
+                        "last_log_index": last_index,
+                        "last_log_term": last_term,
+                    },
+                )
+            except TransportError:
+                continue
+            if resp["term"] > term:
+                self._step_down(resp["term"])
+                return
+            if resp.get("granted"):
+                votes += 1
+
+        with self._lock:
+            if self.state != CANDIDATE or self.current_term != term:
+                return
+            if votes * 2 > len(self.peers) + 1:
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        # called with lock held
+        self.state = LEADER
+        self.leader_id = self.addr
+        next_idx = self.log.last_index() + 1
+        self._next_index = {p: next_idx for p in self.peers}
+        self._match_index = {p: 0 for p in self.peers}
+        # barrier no-op so entries from prior terms commit promptly
+        # (raft §5.4.2; hashicorp/raft LogNoop on leadership)
+        self.log.append(
+            LogEntry(next_idx, self.current_term, KIND_NOOP, b"")
+        )
+        self._notify_q.put((True, self.current_term))
+        self._wake.set()
+
+    def _step_down(self, term: int) -> None:
+        """Become a follower for `term`.  No-op if we have since moved
+        to a higher term (so a racing caller can never demote a leader
+        legitimately elected at a newer term)."""
+        with self._lock:
+            if term < self.current_term:
+                return
+            if term > self.current_term:
+                self.current_term = term
+                self.voted_for = None
+            if self.state == LEADER:
+                for fut in self._futures.values():
+                    fut.fail(NotLeaderError(self.leader_id))
+                self._futures.clear()
+                self._notify_q.put((False, self.current_term))
+            self.state = FOLLOWER
+        self._reset_election_deadline()
+
+    # -- replication (leader) ------------------------------------------
+
+    def _replicate_all(self) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            term = self.current_term
+            commit = self.commit_index
+        for peer in self.peers:
+            self._replicate_one(peer, term, commit)
+        self._advance_commit()
+
+    def _replicate_one(self, peer: str, term: int, commit: int) -> None:
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            next_idx = self._next_index.get(peer, 1)
+            snap_idx = self.log.snapshot_index
+            if next_idx <= snap_idx:
+                snapshot = (
+                    self._snapshot_data,
+                    snap_idx,
+                    self.log.snapshot_term,
+                )
+            else:
+                snapshot = None
+                prev_index = next_idx - 1
+                prev_term = self.log.term_at(prev_index)
+                entries = self.log.entries_from(next_idx)
+
+        if snapshot is not None:
+            data, s_idx, s_term = snapshot
+            try:
+                resp = self.transport.rpc(
+                    self.addr,
+                    peer,
+                    "install_snapshot",
+                    {
+                        "term": term,
+                        "leader": self.addr,
+                        "last_included_index": s_idx,
+                        "last_included_term": s_term,
+                        "data": data,
+                    },
+                )
+            except TransportError:
+                return
+            if resp["term"] > term:
+                self._step_down(resp["term"])
+                return
+            with self._lock:
+                self._next_index[peer] = s_idx + 1
+                self._match_index[peer] = max(
+                    self._match_index.get(peer, 0), s_idx
+                )
+            return
+
+        if prev_term is None:
+            return  # compacted concurrently; next tick sends snapshot
+        try:
+            resp = self.transport.rpc(
+                self.addr,
+                peer,
+                "append_entries",
+                {
+                    "term": term,
+                    "leader": self.addr,
+                    "prev_log_index": prev_index,
+                    "prev_log_term": prev_term,
+                    "entries": [
+                        (e.index, e.term, e.kind, e.data) for e in entries
+                    ],
+                    "leader_commit": commit,
+                },
+            )
+        except TransportError:
+            return
+        if resp["term"] > term:
+            self._step_down(resp["term"])
+            return
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            if resp.get("success"):
+                if entries:
+                    self._match_index[peer] = entries[-1].index
+                    self._next_index[peer] = entries[-1].index + 1
+            else:
+                # back off; use the follower's conflict hint when given
+                hint = resp.get("conflict_index")
+                self._next_index[peer] = max(
+                    1, hint if hint else self._next_index[peer] - 1
+                )
+
+    def _advance_commit(self) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            matches = sorted(
+                [self.log.last_index()]
+                + [self._match_index.get(p, 0) for p in self.peers]
+            )
+            # the highest index a strict majority has replicated
+            # (ascending order: position n-majority = (n-1)//2)
+            majority_idx = matches[(len(matches) - 1) // 2]
+            if (
+                majority_idx > self.commit_index
+                and self.log.term_at(majority_idx) == self.current_term
+            ):
+                self.commit_index = majority_idx
+                self._apply_cv.notify_all()
+
+    # -- apply loop -----------------------------------------------------
+
+    def _apply_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                while (
+                    self.last_applied >= self.commit_index
+                    and not self._stop.is_set()
+                ):
+                    self._apply_cv.wait(timeout=0.1)
+                if self._stop.is_set():
+                    return
+                index = self.last_applied + 1
+                entry = self.log.get(index)
+                fut = self._futures.pop(index, None)
+            if entry is None:
+                # compacted under us (only possible after restore)
+                with self._lock:
+                    self.last_applied = max(
+                        self.last_applied, self.log.snapshot_index
+                    )
+                continue
+            result = None
+            error = None
+            if entry.kind == KIND_COMMAND:
+                try:
+                    result = self.fsm.apply(entry.data)
+                except Exception as exc:  # noqa: BLE001
+                    error = exc
+            with self._lock:
+                self.last_applied = index
+                self._applied_since_snapshot += 1
+                should_snap = (
+                    self._applied_since_snapshot >= self.snapshot_threshold
+                )
+            if fut is not None:
+                if error is not None:
+                    fut.fail(error)
+                else:
+                    fut.resolve(result)
+            if should_snap:
+                self._take_snapshot()
+
+    def _take_snapshot(self) -> None:
+        """FSM snapshot + log compaction (reference fsm.go Snapshot,
+        snapshotsRetained=2 nomad/server.go:64)."""
+        data = self.fsm.snapshot()
+        with self._lock:
+            index = self.last_applied
+            term = self.log.term_at(index)
+            if term is None:
+                return
+            self._snapshot_data = data
+            self.log.compact_through(index, term)
+            self._applied_since_snapshot = 0
+
+    # -- RPC handlers ---------------------------------------------------
+
+    def _handle_rpc(self, method: str, payload: dict) -> dict:
+        if method == "request_vote":
+            return self._on_request_vote(payload)
+        if method == "append_entries":
+            return self._on_append_entries(payload)
+        if method == "install_snapshot":
+            return self._on_install_snapshot(payload)
+        raise ValueError(f"unknown raft rpc {method!r}")
+
+    def _on_request_vote(self, p: dict) -> dict:
+        with self._lock:
+            higher = p["term"] > self.current_term
+        if higher:
+            self._step_down(p["term"])
+        with self._lock:
+            # re-check under the lock: the term may have moved on while
+            # stepping down (a racing local election)
+            if p["term"] < self.current_term:
+                return {"term": self.current_term, "granted": False}
+            up_to_date = (
+                p["last_log_term"],
+                p["last_log_index"],
+            ) >= (self.log.last_term(), self.log.last_index())
+            if up_to_date and self.voted_for in (None, p["candidate"]):
+                self.voted_for = p["candidate"]
+                self._reset_election_deadline()
+                return {"term": self.current_term, "granted": True}
+            return {"term": self.current_term, "granted": False}
+
+    def _on_append_entries(self, p: dict) -> dict:
+        with self._lock:
+            if p["term"] < self.current_term:
+                return {"term": self.current_term, "success": False}
+            demote = p["term"] > self.current_term or self.state != FOLLOWER
+        if demote:
+            self._step_down(p["term"])
+        with self._lock:
+            # re-check: a racing election may have moved past p's term
+            if p["term"] < self.current_term:
+                return {"term": self.current_term, "success": False}
+            self.leader_id = p["leader"]
+            self._reset_election_deadline()
+
+            prev_index = p["prev_log_index"]
+            prev_term = p["prev_log_term"]
+            local_term = self.log.term_at(prev_index)
+            if local_term is None or local_term != prev_term:
+                # consistency check failed; hint where our log ends
+                return {
+                    "term": self.current_term,
+                    "success": False,
+                    "conflict_index": min(
+                        self.log.last_index() + 1, prev_index
+                    ),
+                }
+            for index, term, kind, data in p["entries"]:
+                existing_term = self.log.term_at(index)
+                if existing_term is not None:
+                    if existing_term == term:
+                        continue
+                    self.log.truncate_from(index)
+                    # any futures beyond this point died with the old
+                    # leader; followers hold none
+                if index == self.log.last_index() + 1:
+                    self.log.append(LogEntry(index, term, kind, data))
+            if p["leader_commit"] > self.commit_index:
+                self.commit_index = min(
+                    p["leader_commit"], self.log.last_index()
+                )
+                self._apply_cv.notify_all()
+            return {"term": self.current_term, "success": True}
+
+    def _on_install_snapshot(self, p: dict) -> dict:
+        with self._lock:
+            if p["term"] < self.current_term:
+                return {"term": self.current_term}
+            demote = p["term"] > self.current_term or self.state != FOLLOWER
+        if demote:
+            self._step_down(p["term"])
+        with self._lock:
+            if p["term"] < self.current_term:
+                return {"term": self.current_term}
+            self.leader_id = p["leader"]
+            self._reset_election_deadline()
+            idx = p["last_included_index"]
+            if idx <= self.log.snapshot_index:
+                return {"term": self.current_term}
+            self.fsm.restore(p["data"])
+            self.log.reset_to_snapshot(idx, p["last_included_term"])
+            self._snapshot_data = p["data"]
+            self.commit_index = max(self.commit_index, idx)
+            self.last_applied = idx
+            self._applied_since_snapshot = 0
+            return {"term": self.current_term}

@@ -1,0 +1,97 @@
+"""Raft RPC transports.
+
+InmemTransport mirrors hashicorp/raft's InmemTransport (what
+nomad.TestServer clusters use, nomad/testing.go:44): a process-local
+registry of nodes, synchronous delivery, and partition controls for
+failure-injection tests.  The same handler surface can be served over
+the framed TCP wire protocol (nomad_tpu/wire.py) for cross-process
+clusters — the reference's RaftLayer multiplexes raft traffic over the
+server's single RPC port (nomad/raft_rpc.go).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+
+class TransportError(Exception):
+    """Delivery failure (peer down, partitioned, or timeout)."""
+
+
+Handler = Callable[[str, dict], dict]
+
+
+class InmemTransport:
+    """Shared in-process message bus.  One instance per test cluster;
+    every node registers its RPC handler under its address."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._handlers: Dict[str, Handler] = {}
+        self._down: set = set()
+        self._partitions: set = set()  # frozenset({a, b}) pairs
+
+    def register(self, addr: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers[addr] = handler
+
+    def deregister(self, addr: str) -> None:
+        with self._lock:
+            self._handlers.pop(addr, None)
+
+    # -- failure injection ---------------------------------------------
+
+    def set_down(self, addr: str, down: bool = True) -> None:
+        with self._lock:
+            if down:
+                self._down.add(addr)
+            else:
+                self._down.discard(addr)
+
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._partitions.add(frozenset((a, b)))
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None) -> None:
+        """heal() clears everything; heal(a) removes every partition
+        involving a; heal(a, b) removes just that pair."""
+        with self._lock:
+            if a is None:
+                self._partitions.clear()
+                self._down.clear()
+            elif b is None:
+                self._partitions = {
+                    p for p in self._partitions if a not in p
+                }
+                self._down.discard(a)
+            else:
+                self._partitions.discard(frozenset((a, b)))
+
+    def isolate(self, addr: str) -> None:
+        """Partition addr from every other registered node."""
+        with self._lock:
+            for other in self._handlers:
+                if other != addr:
+                    self._partitions.add(frozenset((addr, other)))
+
+    # -- delivery -------------------------------------------------------
+
+    def _check(self, src: str, dst: str) -> Handler:
+        with self._lock:
+            if dst in self._down or src in self._down:
+                raise TransportError(f"{dst} unreachable")
+            if frozenset((src, dst)) in self._partitions:
+                raise TransportError(f"{src} partitioned from {dst}")
+            handler = self._handlers.get(dst)
+        if handler is None:
+            raise TransportError(f"no handler for {dst}")
+        return handler
+
+    def rpc(self, src: str, dst: str, method: str, payload: dict) -> dict:
+        handler = self._check(src, dst)
+        try:
+            return handler(method, payload)
+        except TransportError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — remote fault
+            raise TransportError(f"remote error from {dst}: {exc}") from exc

@@ -1,0 +1,217 @@
+"""Raft core tests: election, replication, failover, partitions,
+snapshot catch-up (the consensus behaviors the reference gets from
+hashicorp/raft and exercises via in-process clusters,
+nomad/testing.go:44 + leader_test.go)."""
+import pickle
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.raft import (
+    InmemTransport,
+    NotLeaderError,
+    RaftNode,
+)
+
+
+class KVFSM:
+    def __init__(self):
+        self.data = {}
+        self.lock = threading.Lock()
+        self.applied = []
+
+    def apply(self, raw):
+        cmd = pickle.loads(raw)
+        with self.lock:
+            self.data[cmd["k"]] = cmd["v"]
+            self.applied.append(cmd)
+        return cmd["v"]
+
+    def snapshot(self):
+        with self.lock:
+            return pickle.dumps(self.data)
+
+    def restore(self, raw):
+        with self.lock:
+            self.data = pickle.loads(raw)
+
+
+def make_cluster(n=3, snapshot_threshold=2048):
+    transport = InmemTransport()
+    addrs = [f"s{i}" for i in range(n)]
+    nodes = []
+    for addr in addrs:
+        fsm = KVFSM()
+        node = RaftNode(
+            addr,
+            addrs,
+            transport,
+            fsm,
+            election_timeout=0.1,
+            heartbeat_interval=0.02,
+            snapshot_threshold=snapshot_threshold,
+        )
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    return transport, nodes
+
+
+def wait_for_leader(nodes, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [n for n in nodes if n.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no single leader elected")
+
+
+def wait_until(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def shutdown(nodes):
+    for n in nodes:
+        n.stop()
+
+
+def put(node, k, v):
+    return node.apply(pickle.dumps({"k": k, "v": v}))
+
+
+def test_single_leader_elected():
+    _, nodes = make_cluster(3)
+    try:
+        leader = wait_for_leader(nodes)
+        # stable: still the only leader shortly after
+        time.sleep(0.3)
+        assert [n for n in nodes if n.is_leader()] == [leader]
+        for n in nodes:
+            assert n.leader_hint() == leader.addr
+    finally:
+        shutdown(nodes)
+
+
+def test_apply_replicates_to_all():
+    _, nodes = make_cluster(3)
+    try:
+        leader = wait_for_leader(nodes)
+        assert put(leader, "a", 1) == 1
+        assert put(leader, "b", 2) == 2
+        wait_until(
+            lambda: all(
+                n.fsm.data == {"a": 1, "b": 2} for n in nodes
+            ),
+            msg="replication to all followers",
+        )
+    finally:
+        shutdown(nodes)
+
+
+def test_apply_on_follower_raises_with_hint():
+    _, nodes = make_cluster(3)
+    try:
+        leader = wait_for_leader(nodes)
+        follower = next(n for n in nodes if n is not leader)
+        with pytest.raises(NotLeaderError) as exc:
+            put(follower, "x", 1)
+        assert exc.value.leader == leader.addr
+    finally:
+        shutdown(nodes)
+
+
+def test_leader_failure_elects_new_and_preserves_log():
+    transport, nodes = make_cluster(3)
+    try:
+        leader = wait_for_leader(nodes)
+        put(leader, "a", 1)
+        leader.stop()
+        transport.set_down(leader.addr)
+        rest = [n for n in nodes if n is not leader]
+        new_leader = wait_for_leader(rest)
+        assert new_leader is not leader
+        put(new_leader, "b", 2)
+        wait_until(
+            lambda: all(
+                n.fsm.data == {"a": 1, "b": 2} for n in rest
+            ),
+            msg="post-failover replication",
+        )
+    finally:
+        shutdown([n for n in nodes if n._threads])
+
+
+def test_partitioned_leader_steps_down_and_converges():
+    transport, nodes = make_cluster(3)
+    try:
+        leader = wait_for_leader(nodes)
+        put(leader, "a", 1)
+        transport.isolate(leader.addr)
+        rest = [n for n in nodes if n is not leader]
+        new_leader = wait_for_leader(rest)
+        put(new_leader, "b", 2)
+        # writes on the stale leader cannot commit
+        with pytest.raises((TimeoutError, NotLeaderError)):
+            leader.apply(
+                pickle.dumps({"k": "stale", "v": 9}), timeout=0.5
+            )
+        transport.heal()
+        wait_until(
+            lambda: not leader.is_leader(),
+            msg="stale leader stepping down",
+        )
+        wait_until(
+            lambda: all(
+                n.fsm.data.get("b") == 2
+                and "stale" not in n.fsm.data
+                for n in nodes
+            ),
+            msg="convergence after heal",
+        )
+    finally:
+        shutdown(nodes)
+
+
+def test_snapshot_compaction_and_follower_catchup():
+    transport, nodes = make_cluster(3, snapshot_threshold=20)
+    try:
+        leader = wait_for_leader(nodes)
+        follower = next(n for n in nodes if n is not leader)
+        transport.set_down(follower.addr)
+        for i in range(60):
+            put(leader, f"k{i}", i)
+        wait_until(
+            lambda: leader.log.snapshot_index > 0,
+            msg="leader log compaction",
+        )
+        transport.set_down(follower.addr, down=False)
+        wait_until(
+            lambda: follower.fsm.data.get("k59") == 59,
+            msg="follower catch-up via snapshot",
+        )
+        assert follower.log.snapshot_index > 0
+    finally:
+        shutdown(nodes)
+
+
+def test_single_node_cluster_self_elects():
+    transport = InmemTransport()
+    fsm = KVFSM()
+    node = RaftNode(
+        "solo", ["solo"], transport, fsm,
+        election_timeout=0.05, heartbeat_interval=0.02,
+    )
+    node.start()
+    try:
+        wait_until(node.is_leader, msg="self election")
+        assert node.apply(pickle.dumps({"k": "a", "v": 1})) == 1
+        assert fsm.data == {"a": 1}
+    finally:
+        node.stop()
