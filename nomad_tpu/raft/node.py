@@ -108,6 +108,18 @@ class RaftNode:
 
         transport.register(addr, self._handle_rpc)
 
+    def _rpc(self, peer: str, method: str, payload: dict) -> dict:
+        """Peer RPC with any failure normalized to TransportError, so
+        a faulty peer can never crash the driver thread."""
+        try:
+            return self.transport.rpc(self.addr, peer, method, payload)
+        except TransportError:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            raise TransportError(
+                f"peer {peer} rpc {method} failed: {exc}"
+            ) from exc
+
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
@@ -244,8 +256,7 @@ class RaftNode:
         votes = 1
         for peer in self.peers:
             try:
-                resp = self.transport.rpc(
-                    self.addr,
+                resp = self._rpc(
                     peer,
                     "request_vote",
                     {
@@ -335,8 +346,7 @@ class RaftNode:
         if snapshot is not None:
             data, s_idx, s_term = snapshot
             try:
-                resp = self.transport.rpc(
-                    self.addr,
+                resp = self._rpc(
                     peer,
                     "install_snapshot",
                     {
@@ -362,8 +372,7 @@ class RaftNode:
         if prev_term is None:
             return  # compacted concurrently; next tick sends snapshot
         try:
-            resp = self.transport.rpc(
-                self.addr,
+            resp = self._rpc(
                 peer,
                 "append_entries",
                 {

@@ -88,10 +88,9 @@ class InmemTransport:
         return handler
 
     def rpc(self, src: str, dst: str, method: str, payload: dict) -> dict:
+        """Deliver one RPC.  TransportError covers delivery failures
+        only; application exceptions from the remote handler propagate
+        with their real type (in-process calls — the reference's
+        net/rpc likewise round-trips typed server errors)."""
         handler = self._check(src, dst)
-        try:
-            return handler(method, payload)
-        except TransportError:
-            raise
-        except Exception as exc:  # noqa: BLE001 — remote fault
-            raise TransportError(f"remote error from {dst}: {exc}") from exc
+        return handler(method, payload)

@@ -1,0 +1,341 @@
+"""Replicated multi-server control plane (reference nomad/server.go +
+nomad/leader.go + nomad/rpc.go forwarding).
+
+Each ClusterServer owns a local StateStore applied to exclusively by the
+raft FSM; the Server machinery on top sees a ReplicatedStore whose write
+methods propose FSM commands through the raft log (reference
+nomad/rpc.go:742 raftApply) and whose reads hit local state.  Leadership
+changes from raft drive establishLeadership/revokeLeadership exactly as
+the reference's monitorLeadership loop does (leader.go:54,222): the eval
+broker, plan applier, scheduling workers, deployment watcher, drainer,
+periodic dispatcher and heartbeat timers run only on the leader.
+
+Writes issued on a follower forward to the leader transparently at the
+store-write level (reference rpc.go:509 forward), so the HTTP/API layer
+works unchanged on any server.
+"""
+from __future__ import annotations
+
+import pickle
+import time
+from typing import List, Optional
+
+from ..acl import ACLStore, Token
+from ..raft import InmemTransport, NotLeaderError, RaftNode
+from ..raft.transport import TransportError
+from ..state.store import StateStore
+from .fsm import ServerFSM, encode_command
+from .server import Server
+
+_RAFT_METHODS = {"request_vote", "append_entries", "install_snapshot"}
+
+
+class ReplicatedStore:
+    """StateStore facade: reads are local, writes go through raft.
+
+    Mirrors the split in the reference where endpoint reads use the
+    local memdb and writes call raftApply (e.g. node_endpoint.go,
+    job_endpoint.go).
+    """
+
+    def __init__(self, local: StateStore, raft_apply) -> None:
+        self.local = local
+        self._raft_apply = raft_apply
+
+    def __getattr__(self, name):
+        return getattr(self.local, name)
+
+    # -- replicated write surface (FSM command per method) -------------
+
+    def upsert_node(self, node):
+        return self._raft_apply("upsert_node", (node,))
+
+    def delete_node(self, node_id):
+        return self._raft_apply("delete_node", (node_id,))
+
+    def update_node_status(self, node_id, status, now=None):
+        # timestamps are fixed by the proposer so every replica's FSM
+        # applies the identical value
+        return self._raft_apply(
+            "update_node_status",
+            (node_id, status, time.time() if now is None else now),
+        )
+
+    def update_node_eligibility(self, node_id, eligibility):
+        return self._raft_apply(
+            "update_node_eligibility", (node_id, eligibility)
+        )
+
+    def update_node_drain(self, node_id, drain, strategy=None):
+        return self._raft_apply(
+            "update_node_drain", (node_id, drain, strategy)
+        )
+
+    def upsert_job(self, job, keep_versions: int = 6):
+        return self._raft_apply("upsert_job", (job, keep_versions))
+
+    def delete_job(self, namespace, job_id):
+        return self._raft_apply("delete_job", (namespace, job_id))
+
+    def upsert_evals(self, evals, now=None):
+        return self._raft_apply(
+            "upsert_evals", (evals, time.time() if now is None else now)
+        )
+
+    def delete_eval(self, eval_id):
+        return self._raft_apply("delete_eval", (eval_id,))
+
+    def upsert_allocs(self, allocs):
+        return self._raft_apply("upsert_allocs", (allocs,))
+
+    def upsert_deployment(self, deployment):
+        return self._raft_apply("upsert_deployment", (deployment,))
+
+    def set_scheduler_config(self, config):
+        return self._raft_apply("set_scheduler_config", (config,))
+
+    def upsert_plan_results(self, result, eval_id):
+        return self._raft_apply("upsert_plan_results", (result, eval_id))
+
+
+class ReplicatedACLStore:
+    """ACL writes through raft; resolution against local state
+    (reference: ACL tables live in the same raft FSM, fsm.go
+    ACLPolicyUpsert/ACLTokenUpsert)."""
+
+    def __init__(self, local: ACLStore, raft_apply) -> None:
+        self.local = local
+        self._raft_apply = raft_apply
+
+    def __getattr__(self, name):
+        return getattr(self.local, name)
+
+    def bootstrap(self) -> Token:
+        # generate on the caller, replicate the concrete token (token
+        # IDs are random; the FSM must stay deterministic)
+        token = Token(name="Bootstrap Token", type="management")
+        return self._raft_apply("acl_bootstrap", (token,))
+
+    def upsert_policy(self, policy):
+        return self._raft_apply("acl_upsert_policy", (policy,))
+
+    def delete_policy(self, name):
+        return self._raft_apply("acl_delete_policy", (name,))
+
+    def create_token(self, token):
+        return self._raft_apply("acl_create_token", (token,))
+
+    def delete_token(self, accessor_id):
+        return self._raft_apply("acl_delete_token", (accessor_id,))
+
+
+class ClusterServer(Server):
+    """A Server participating in a raft-replicated cluster."""
+
+    def __init__(
+        self,
+        addr: str,
+        peers: List[str],
+        transport: Optional[InmemTransport] = None,
+        region: str = "global",
+        election_timeout: float = 0.15,
+        heartbeat_interval: float = 0.04,
+        snapshot_threshold: int = 2048,
+        acl_enabled: bool = False,
+        **kwargs,
+    ) -> None:
+        self.addr = addr
+        self.region = region
+        self.transport = transport or InmemTransport()
+        local_store = StateStore()
+        local_acls = ACLStore(enabled=acl_enabled)
+        self.fsm = ServerFSM(local_store, local_acls)
+        self.raft = RaftNode(
+            addr,
+            peers,
+            self.transport,
+            self.fsm,
+            election_timeout=election_timeout,
+            heartbeat_interval=heartbeat_interval,
+            snapshot_threshold=snapshot_threshold,
+            on_leadership=self._on_leadership,
+        )
+        # the server machinery sees the replicated facades
+        super().__init__(
+            store=ReplicatedStore(local_store, self._raft_apply),
+            acls=ReplicatedACLStore(local_acls, self._raft_apply),
+            acl_enabled=acl_enabled,
+            **kwargs,
+        )
+        # take over the transport slot: raft RPCs pass through, plus a
+        # leader-forwarding channel (reference nomad/rpc.go: one port,
+        # multiplexed raft + RPC)
+        self.transport.register(addr, self._handle_cluster_rpc)
+
+    # -- raft plumbing --------------------------------------------------
+
+    def _raft_apply(self, kind: str, args: tuple):
+        """Propose a command; on a follower, forward to the leader
+        (reference rpc.go:509 forward + rpc.go:742 raftApply)."""
+        data = encode_command(kind, args)
+        try:
+            return self.raft.apply(data)
+        except NotLeaderError as exc:
+            leader = exc.leader or self.raft.leader_hint()
+            if leader is None:
+                raise
+            resp = self.transport.rpc(
+                self.addr, leader, "fsm_apply", {"data": data}
+            )
+            return pickle.loads(resp["result"])
+
+    def _handle_cluster_rpc(self, method: str, payload: dict) -> dict:
+        if method in _RAFT_METHODS:
+            return self.raft._handle_rpc(method, payload)
+        if method == "fsm_apply":
+            result = self.raft.apply(payload["data"])
+            return {"result": pickle.dumps(result)}
+        if method == "server_call":
+            fn = getattr(self, payload["op"])
+            args, kw = pickle.loads(payload["args"])
+            return {"result": pickle.dumps(fn(*args, **kw))}
+        raise ValueError(f"unknown cluster rpc {method!r}")
+
+    def remote_call(self, op: str, *args, **kw):
+        """Invoke a Server API method on the current leader
+        (reference: endpoint forwarding for non-store operations)."""
+        return self._leader_route(op, *args, **kw)
+
+    def _leader_route(self, op: str, *args, **kw):
+        """Run a Server API method on the leader (reference
+        rpc.go:509 forward): locally when we are the leader, otherwise
+        over the transport."""
+        if self.is_leader():
+            return getattr(Server, op)(self, *args, **kw)
+        leader = self.raft.leader_hint()
+        if leader is None:
+            raise NotLeaderError(None)
+        resp = self.transport.rpc(
+            self.addr, leader, "server_call",
+            {"op": op, "args": pickle.dumps((args, kw))},
+        )
+        return pickle.loads(resp["result"])
+
+    def on_eval_update(self, ev) -> None:
+        """Eval routing happens on the leader only (reference
+        fsm.go:715); a restarted/late leader recovers anything missed
+        via restore_evals."""
+        if self.is_leader():
+            super().on_eval_update(ev)
+        else:
+            try:
+                self._leader_route("route_eval", ev.id)
+            except (NotLeaderError, TransportError):
+                pass  # next election's restore_evals picks it up
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    def _on_leadership(self, is_leader: bool, term: int) -> None:
+        if not is_leader:
+            self.revoke_leadership()
+            return
+        # make sure every committed entry is applied locally before the
+        # leader services read state (reference leader.go
+        # establishLeadership barrier); retry while we hold leadership —
+        # giving up would leave an elected leader with its services off
+        while self._running and self.raft.is_leader():
+            try:
+                self.raft.barrier(timeout=5.0)
+            except (TimeoutError, TransportError):
+                continue
+            except NotLeaderError:
+                return
+            self.establish_leadership()
+            return
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self.raft.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self.raft.stop()
+        self.revoke_leadership()
+        for timer in self._heartbeat_timers.values():
+            timer.cancel()
+
+
+# Public Server API methods that must execute on the leader — their
+# side effects (eval routing into the broker, heartbeat TTL timers,
+# blocked-eval unblocking) only exist there.  Calling any of these on a
+# follower transparently forwards, so the HTTP/API layer genuinely
+# works unchanged on any server (reference rpc.go:509 forward).
+_LEADER_API = (
+    "register_job",
+    "deregister_job",
+    "dispatch_job",
+    "plan_job",
+    "register_node",
+    "heartbeat",
+    "update_node_status",
+    "update_node_drain",
+    "update_node_eligibility",
+    "update_allocs_from_client",
+    "force_gc",
+    "route_eval",
+)
+
+
+def _make_forwarder(op):
+    def method(self, *args, **kw):
+        return self._leader_route(op, *args, **kw)
+
+    method.__name__ = op
+    method.__qualname__ = f"ClusterServer.{op}"
+    method.__doc__ = f"Leader-forwarded Server.{op} (rpc.go:509 forward)."
+    return method
+
+
+for _op in _LEADER_API:
+    setattr(ClusterServer, _op, _make_forwarder(_op))
+
+
+class TestCluster:
+    """Boots N in-process ClusterServers on a shared transport — the
+    shape of the reference's nomad.TestServer + TestJoin clusters
+    (nomad/testing.go:44)."""
+
+    __test__ = False  # not a pytest class despite the name
+
+    def __init__(self, n: int = 3, **server_kwargs) -> None:
+        self.transport = InmemTransport()
+        addrs = [f"server-{i}" for i in range(n)]
+        self.servers = [
+            ClusterServer(
+                addr, addrs, self.transport, **server_kwargs
+            )
+            for addr in addrs
+        ]
+
+    def start(self) -> None:
+        for s in self.servers:
+            s.start()
+
+    def stop(self) -> None:
+        for s in self.servers:
+            s.stop()
+
+    def wait_for_leader(self, timeout: float = 5.0) -> ClusterServer:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [s for s in self.servers if s.is_leader()]
+            if len(leaders) == 1 and leaders[0]._leader_established:
+                return leaders[0]
+            time.sleep(0.02)
+        raise AssertionError("no established leader")
+
+    def followers(self) -> List[ClusterServer]:
+        return [s for s in self.servers if not s.is_leader()]

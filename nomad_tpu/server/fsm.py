@@ -1,0 +1,229 @@
+"""Replicated state-machine command layer (reference nomad/fsm.go).
+
+Every control-plane write is a typed command applied through this
+dispatch — when the server runs replicated, commands arrive through the
+raft log and every server applies the same stream to its local
+StateStore/ACLStore (reference fsm.go:180 Apply over ~40 MessageTypes);
+in single-process mode the Server applies them directly.  Commands are
+pickled (kind, args) tuples: self-describing like the reference's
+msgpack-encoded requests, and the round-trip gives each replica its own
+object graph (no cross-server aliasing).
+
+Eval routing (broker enqueue on EvalUpdate, fsm.go:715) deliberately
+stays OUT of the FSM here: the API layer routes evals on the leader
+after the apply returns, and a newly-elected leader recovers pending
+evals from state via restore_evals (reference leader.go:352) — same
+at-least-once outcome without followers needing a broker.
+"""
+from __future__ import annotations
+
+import gzip
+import pickle
+from typing import Optional, Tuple
+
+from ..state.store import StateStore
+
+SNAPSHOT_VERSION = 1
+
+
+def encode_command(kind: str, args: tuple) -> bytes:
+    return pickle.dumps((kind, args), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_command(raw: bytes) -> Tuple[str, tuple]:
+    return pickle.loads(raw)
+
+
+def state_payload(store: StateStore, acls) -> dict:
+    """Capture the full replicated state (reference fsm.go Snapshot:
+    every table is persisted)."""
+    with store._lock:
+        payload = {
+            "version": SNAPSHOT_VERSION,
+            "index": store.latest_index(),
+            "table_indexes": dict(store._table_index),
+            "nodes": list(store.nodes.values()),
+            "jobs": list(store.jobs.values()),
+            "job_versions": {
+                k: list(v) for k, v in store.job_versions.items()
+            },
+            "allocs": list(store.allocs.values()),
+            "evals": list(store.evals.values()),
+            "deployments": list(store.deployments.values()),
+            "scheduler_config": store.scheduler_config,
+        }
+    if acls is not None:
+        payload["acl_policies"] = list(acls.policies.values())
+        payload["acl_tokens"] = list(acls.tokens_by_accessor.values())
+        payload["acl_enabled"] = acls.enabled
+    return payload
+
+
+def install_payload(store: StateStore, acls, payload: dict) -> int:
+    """Replace local state with a snapshot payload (reference fsm.go
+    Restore).  Secondary indexes and the columnar node table are
+    derived state and get rebuilt."""
+    if payload.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"unsupported snapshot version {payload.get('version')}"
+        )
+    from ..state.node_table import NodeTable
+
+    with store._lock:
+        store.nodes.clear()
+        store.jobs.clear()
+        store.job_versions.clear()
+        store.allocs.clear()
+        store.evals.clear()
+        store.deployments.clear()
+        store._allocs_by_node.clear()
+        store._allocs_by_job.clear()
+        store._allocs_by_eval.clear()
+        store._evals_by_job.clear()
+        store._deployments_by_job.clear()
+        # the columnar mirror is derived state: rebuild it from scratch
+        # so rows/usage from pre-snapshot nodes can't survive
+        store.node_table = NodeTable()
+
+        for node in payload["nodes"]:
+            store.nodes[node.id] = node
+            store.node_table.upsert_node(node)
+        for job in payload["jobs"]:
+            store.jobs[(job.namespace, job.id)] = job
+        for key, versions in payload["job_versions"].items():
+            store.job_versions[key] = versions
+        for alloc in payload["allocs"]:
+            store.allocs[alloc.id] = alloc
+            store._allocs_by_node[alloc.node_id].add(alloc.id)
+            store._allocs_by_job[(alloc.namespace, alloc.job_id)].add(
+                alloc.id
+            )
+            if alloc.eval_id:
+                store._allocs_by_eval[alloc.eval_id].add(alloc.id)
+        # recompute usage for every node (not just those with allocs in
+        # the snapshot — a node whose allocs all stopped must read zero)
+        for node_id in store.nodes:
+            store.node_table.update_node_usage(
+                node_id, store._live_usage_for_node(node_id)
+            )
+        for ev in payload["evals"]:
+            store.evals[ev.id] = ev
+            store._evals_by_job[(ev.namespace, ev.job_id)].add(ev.id)
+        for d in payload["deployments"]:
+            store.deployments[d.id] = d
+            store._deployments_by_job[(d.namespace, d.job_id)].add(d.id)
+        store.scheduler_config = payload["scheduler_config"]
+        store._index = payload["index"]
+        store._table_index.clear()
+        store._table_index.update(payload.get("table_indexes", {}))
+        store._watch_cond.notify_all()
+
+    if acls is not None and "acl_enabled" in payload:
+        acls.enabled = payload["acl_enabled"]
+        acls.policies.clear()
+        acls.tokens_by_accessor.clear()
+        acls.tokens_by_secret.clear()
+        for policy in payload.get("acl_policies", ()):
+            acls.upsert_policy(policy)
+        for token in payload.get("acl_tokens", ()):
+            acls.tokens_by_accessor[token.accessor_id] = token
+            acls.tokens_by_secret[token.secret_id] = token
+    return payload["index"]
+
+
+class ServerFSM:
+    """Applies committed commands to the local store (the raft FSM).
+
+    Pure state mutation, deterministic from the command stream — every
+    replica that applies the same log prefix holds identical state and
+    identical modify indexes.
+    """
+
+    def __init__(self, store: StateStore, acls=None) -> None:
+        self.store = store
+        self.acls = acls
+
+    # raft FSM contract -------------------------------------------------
+
+    def apply(self, raw: bytes):
+        kind, args = decode_command(raw)
+        return self.dispatch(kind, args)
+
+    def snapshot(self) -> bytes:
+        return gzip.compress(
+            pickle.dumps(
+                state_payload(self.store, self.acls),
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        )
+
+    def restore(self, raw: bytes) -> None:
+        install_payload(
+            self.store, self.acls, pickle.loads(gzip.decompress(raw))
+        )
+
+    # command dispatch (reference fsm.go:197-277) -----------------------
+
+    def dispatch(self, kind: str, args: tuple):
+        handler = getattr(self, f"_apply_{kind}", None)
+        if handler is None:
+            raise ValueError(f"unknown FSM command {kind!r}")
+        return handler(*args)
+
+    def _apply_upsert_node(self, node):
+        return self.store.upsert_node(node)
+
+    def _apply_delete_node(self, node_id):
+        return self.store.delete_node(node_id)
+
+    def _apply_update_node_status(self, node_id, status, now=None):
+        return self.store.update_node_status(node_id, status, now)
+
+    def _apply_update_node_eligibility(self, node_id, eligibility):
+        return self.store.update_node_eligibility(node_id, eligibility)
+
+    def _apply_update_node_drain(self, node_id, drain, strategy):
+        return self.store.update_node_drain(node_id, drain, strategy)
+
+    def _apply_upsert_job(self, job, keep_versions=6):
+        return self.store.upsert_job(job, keep_versions)
+
+    def _apply_delete_job(self, namespace, job_id):
+        return self.store.delete_job(namespace, job_id)
+
+    def _apply_upsert_evals(self, evals, now=None):
+        return self.store.upsert_evals(evals, now)
+
+    def _apply_delete_eval(self, eval_id):
+        return self.store.delete_eval(eval_id)
+
+    def _apply_upsert_allocs(self, allocs):
+        return self.store.upsert_allocs(allocs)
+
+    def _apply_upsert_deployment(self, deployment):
+        return self.store.upsert_deployment(deployment)
+
+    def _apply_set_scheduler_config(self, config):
+        return self.store.set_scheduler_config(config)
+
+    def _apply_upsert_plan_results(self, result, eval_id):
+        return self.store.upsert_plan_results(result, eval_id)
+
+    # ACL commands ------------------------------------------------------
+
+    def _apply_acl_upsert_policy(self, policy):
+        self.acls.upsert_policy(policy)
+
+    def _apply_acl_delete_policy(self, name):
+        self.acls.delete_policy(name)
+
+    def _apply_acl_create_token(self, token):
+        return self.acls.create_token(token)
+
+    def _apply_acl_delete_token(self, accessor_id):
+        self.acls.delete_token(accessor_id)
+
+    def _apply_acl_bootstrap(self, token):
+        self.acls.tokens_by_accessor[token.accessor_id] = token
+        self.acls.tokens_by_secret[token.secret_id] = token
+        return token

@@ -93,12 +93,19 @@ class Server:
         nack_timeout: float = 60.0,
         acl_enabled: bool = False,
         batch_pipeline: bool = False,
+        store: Optional[StateStore] = None,
+        acls=None,
     ) -> None:
         from ..acl import ACLStore
         from ..telemetry import Metrics
 
-        self.store = StateStore()
-        self.acls = ACLStore(enabled=acl_enabled)
+        # store/acls are injectable so a replicated cluster can hand in
+        # raft-backed facades (server/cluster.py); default is the
+        # single-process direct store
+        self.store = store if store is not None else StateStore()
+        self.acls = acls if acls is not None else ACLStore(
+            enabled=acl_enabled
+        )
         self.metrics = Metrics()
         self.broker = EvalBroker(nack_timeout=nack_timeout)
         self.blocked = BlockedEvals(self.broker)
@@ -126,35 +133,66 @@ class Server:
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._running = False
+        self._leader_established = False
+        self._leader_lock = threading.Lock()
 
     # -- lifecycle (reference leader.go:222 establishLeadership) -------
 
     def start(self) -> None:
-        self.broker.set_enabled(True)
-        self.blocked.set_enabled(True)
-        self.plan_queue.set_enabled(True)
-        self.applier.start()
-        for worker in self.workers:
-            worker.start()
-        self.deployment_watcher.start()
-        self.drainer.start()
-        self.periodic.start()
+        """Single-process mode: this server is always the leader."""
         self._running = True
-        self.restore_evals()
+        self.establish_leadership()
 
     def stop(self) -> None:
         self._running = False
-        self.periodic.stop()
-        self.deployment_watcher.stop()
-        self.drainer.stop()
-        for worker in self.workers:
-            worker.stop()
-        self.applier.stop()
+        self.revoke_leadership()
         for timer in self._heartbeat_timers.values():
             timer.cancel()
-        self.plan_queue.set_enabled(False)
-        self.blocked.set_enabled(False)
-        self.broker.set_enabled(False)
+
+    def establish_leadership(self) -> None:
+        """Enable the leader-only services (reference leader.go:222):
+        eval broker, blocked evals, plan queue/applier, scheduling
+        workers, deployment watcher, drainer, periodic dispatcher,
+        heartbeat timers; then restore evals from state."""
+        with self._leader_lock:
+            if self._leader_established:
+                return
+            self.broker.set_enabled(True)
+            self.blocked.set_enabled(True)
+            self.plan_queue.set_enabled(True)
+            self.applier.start()
+            for worker in self.workers:
+                worker.start()
+            self.deployment_watcher.start()
+            self.drainer.start()
+            self.periodic.start()
+            self._leader_established = True
+            # re-arm heartbeat TTLs for every known node (reference
+            # heartbeat.go initializeHeartbeatTimers on leadership)
+            for node in self.store.iter_nodes():
+                if node.status != NODE_STATUS_DOWN:
+                    self._reset_heartbeat(node.id)
+            self.restore_evals()
+
+    def revoke_leadership(self) -> None:
+        """Disable leader-only services (reference leader.go
+        revokeLeadership on leadership loss)."""
+        with self._leader_lock:
+            if not self._leader_established:
+                return
+            self._leader_established = False
+            self.periodic.stop()
+            self.deployment_watcher.stop()
+            self.drainer.stop()
+            for worker in self.workers:
+                worker.stop()
+            self.applier.stop()
+            for timer in self._heartbeat_timers.values():
+                timer.cancel()
+            self._heartbeat_timers.clear()
+            self.plan_queue.set_enabled(False)
+            self.blocked.set_enabled(False)
+            self.broker.set_enabled(False)
 
     def restore_evals(self) -> None:
         """Re-enqueue non-terminal evals from state after (re)start
@@ -172,6 +210,14 @@ class Server:
             self.broker.enqueue(ev)
         elif ev.should_block():
             self.blocked.block(ev)
+
+    def route_eval(self, eval_id: str) -> None:
+        """Route a persisted eval into the broker/blocked tracker by id
+        (the forwarding target for evals created away from the
+        leader)."""
+        ev = self.store.eval_by_id(eval_id)
+        if ev is not None:
+            self.on_eval_update(ev)
 
     # -- job API (reference nomad/job_endpoint.go Register:349) ---------
 
@@ -262,7 +308,9 @@ class Server:
         timer = self._heartbeat_timers.pop(node_id, None)
         if timer is not None:
             timer.cancel()
-        if not self._running:
+        # TTL timers are a leader-only service (reference heartbeat.go
+        # runs on the leader; followers forward Node.UpdateStatus)
+        if not (self._running and self._leader_established):
             return
         timer = threading.Timer(
             self.heartbeat_ttl, self._heartbeat_expired, [node_id]
@@ -368,76 +416,62 @@ class Server:
         from ..structs import EVAL_TRIGGER_JOB_REGISTER
 
         self._validate_job(job)
-        # stage the updated job in a shadow store view: we reuse the live
-        # store but restore the previous job version afterwards
+        # run against a snapshot with the new job overlaid — the store
+        # itself is never touched, so a replicated store can't diverge
         prev = self.store.job_by_id(job.namespace, job.id)
-        self.store.upsert_job(job)
-        try:
-            recorder = _PlanRecorder(self.store)
-            ev = Evaluation(
-                namespace=job.namespace,
-                priority=job.priority,
-                type=job.type,
-                triggered_by=EVAL_TRIGGER_JOB_REGISTER,
-                job_id=job.id,
-                annotate_plan=True,
-                status=EVAL_STATUS_PENDING,
+        recorder = _PlanRecorder(self.store)
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            annotate_plan=True,
+            status=EVAL_STATUS_PENDING,
+        )
+        factory = {
+            "service": ServiceScheduler,
+            "batch": BatchScheduler,
+            "system": SystemScheduler,
+        }[job.type]
+        if job.version == 0 and prev is not None:
+            job.version = prev.version + 1
+        snap = self.store.snapshot()
+        snap.override_job(job)
+        scheduler = factory(snap, recorder, seed=0)
+        scheduler.process(ev)
+        annotations = {}
+        if recorder.plans and recorder.plans[-1].annotations:
+            raw = recorder.plans[-1].annotations.get(
+                "desired_tg_updates", {}
             )
-            factory = {
-                "service": ServiceScheduler,
-                "batch": BatchScheduler,
-                "system": SystemScheduler,
-            }[job.type]
-            scheduler = factory(
-                self.store.snapshot(), recorder, seed=0
-            )
-            scheduler.process(ev)
-            annotations = {}
-            if recorder.plans and recorder.plans[-1].annotations:
-                raw = recorder.plans[-1].annotations.get(
-                    "desired_tg_updates", {}
-                )
-                annotations = {
-                    tg: {
-                        "Place": du.place,
-                        "Stop": du.stop,
-                        "Migrate": du.migrate,
-                        "InPlaceUpdate": du.in_place_update,
-                        "DestructiveUpdate": du.destructive_update,
-                        "Canary": du.canary,
-                        "Ignore": du.ignore,
-                    }
-                    for tg, du in raw.items()
+            annotations = {
+                tg: {
+                    "Place": du.place,
+                    "Stop": du.stop,
+                    "Migrate": du.migrate,
+                    "InPlaceUpdate": du.in_place_update,
+                    "DestructiveUpdate": du.destructive_update,
+                    "Canary": du.canary,
+                    "Ignore": du.ignore,
                 }
-            failed = {}
-            for e in recorder.evals:
-                for tg, metric in (e.failed_tg_allocs or {}).items():
-                    failed[tg] = {
-                        "NodesEvaluated": metric.nodes_evaluated,
-                        "NodesFiltered": metric.nodes_filtered,
-                        "NodesExhausted": metric.nodes_exhausted,
-                        "ConstraintFiltered": metric.constraint_filtered,
-                        "DimensionExhausted": metric.dimension_exhausted,
-                    }
-            return {
-                "Annotations": annotations,
-                "FailedTGAllocs": failed,
-                "Diff": self._job_diff(prev, job) if diff else None,
+                for tg, du in raw.items()
             }
-        finally:
-            # roll the staged job back
-            if prev is not None:
-                versions = self.store.job_versions.get(
-                    (job.namespace, job.id), []
-                )
-                if versions and versions[0] is job:
-                    versions.pop(0)
-                self.store.jobs[(job.namespace, job.id)] = prev
-            else:
-                self.store.jobs.pop((job.namespace, job.id), None)
-                self.store.job_versions.pop(
-                    (job.namespace, job.id), None
-                )
+        failed = {}
+        for e in recorder.evals:
+            for tg, metric in (e.failed_tg_allocs or {}).items():
+                failed[tg] = {
+                    "NodesEvaluated": metric.nodes_evaluated,
+                    "NodesFiltered": metric.nodes_filtered,
+                    "NodesExhausted": metric.nodes_exhausted,
+                    "ConstraintFiltered": metric.constraint_filtered,
+                    "DimensionExhausted": metric.dimension_exhausted,
+                }
+        return {
+            "Annotations": annotations,
+            "FailedTGAllocs": failed,
+            "Diff": self._job_diff(prev, job) if diff else None,
+        }
 
     @staticmethod
     def _job_diff(old: Optional[Job], new: Job) -> Dict:

@@ -2,11 +2,10 @@
 /v1/operator/snapshot, and the FSM Snapshot/Restore paths in
 nomad/fsm.go).
 
-Serializes the full state-machine contents — nodes, jobs (+versions),
-allocations, evaluations, deployments, scheduler config, ACL policies and
-tokens — to a single file, and restores a server from it.  The columnar
-node table and all secondary indexes are rebuilt on restore (they are
-derived state, like the reference's memdb indexes).
+Thin file wrapper over the FSM's state payload helpers (server/fsm.py
+state_payload/install_payload) — the operator snapshot and the raft
+snapshot are the same serialization, exactly as the reference's
+operator snapshot is a raft snapshot in a file.
 
 Format: a gzip'd pickle of plain dataclass trees with a version header.
 The wire-format stability story mirrors the reference: snapshots are for
@@ -19,6 +18,8 @@ import gzip
 import pickle
 from typing import TYPE_CHECKING
 
+from .fsm import install_payload, state_payload
+
 if TYPE_CHECKING:  # pragma: no cover
     from .server import Server
 
@@ -26,24 +27,9 @@ SNAPSHOT_VERSION = 1
 
 
 def save_snapshot(server: "Server", path: str) -> None:
-    store = server.store
-    with store._lock:
-        payload = {
-            "version": SNAPSHOT_VERSION,
-            "index": store.latest_index(),
-            "nodes": list(store.nodes.values()),
-            "jobs": list(store.jobs.values()),
-            "job_versions": {
-                k: list(v) for k, v in store.job_versions.items()
-            },
-            "allocs": list(store.allocs.values()),
-            "evals": list(store.evals.values()),
-            "deployments": list(store.deployments.values()),
-            "scheduler_config": store.scheduler_config,
-            "acl_policies": list(server.acls.policies.values()),
-            "acl_tokens": list(server.acls.tokens_by_accessor.values()),
-            "acl_enabled": server.acls.enabled,
-        }
+    local_store = getattr(server.store, "local", server.store)
+    local_acls = getattr(server.acls, "local", server.acls)
+    payload = state_payload(local_store, local_acls)
     with gzip.open(path, "wb") as f:
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
 
@@ -55,56 +41,6 @@ def restore_snapshot(server: "Server", path: str) -> int:
     (reference leader.go restoreEvals)."""
     with gzip.open(path, "rb") as f:
         payload = pickle.load(f)
-    if payload.get("version") != SNAPSHOT_VERSION:
-        raise ValueError(
-            f"unsupported snapshot version {payload.get('version')}"
-        )
-    store = server.store
-    with store._lock:
-        store.nodes.clear()
-        store.jobs.clear()
-        store.job_versions.clear()
-        store.allocs.clear()
-        store.evals.clear()
-        store.deployments.clear()
-        store._allocs_by_node.clear()
-        store._allocs_by_job.clear()
-        store._allocs_by_eval.clear()
-        store._evals_by_job.clear()
-        store._deployments_by_job.clear()
-
-        for node in payload["nodes"]:
-            store.nodes[node.id] = node
-            store.node_table.upsert_node(node)
-        for job in payload["jobs"]:
-            store.jobs[(job.namespace, job.id)] = job
-        for key, versions in payload["job_versions"].items():
-            store.job_versions[key] = versions
-        for alloc in payload["allocs"]:
-            store.allocs[alloc.id] = alloc
-            store._allocs_by_node[alloc.node_id].add(alloc.id)
-            store._allocs_by_job[(alloc.namespace, alloc.job_id)].add(
-                alloc.id
-            )
-            if alloc.eval_id:
-                store._allocs_by_eval[alloc.eval_id].add(alloc.id)
-        for node_id in {a.node_id for a in payload["allocs"]}:
-            store.node_table.update_node_usage(
-                node_id, store._live_usage_for_node(node_id)
-            )
-        for ev in payload["evals"]:
-            store.evals[ev.id] = ev
-            store._evals_by_job[(ev.namespace, ev.job_id)].add(ev.id)
-        for d in payload["deployments"]:
-            store.deployments[d.id] = d
-            store._deployments_by_job[(d.namespace, d.job_id)].add(d.id)
-        store.scheduler_config = payload["scheduler_config"]
-        store._index = payload["index"]
-
-    server.acls.enabled = payload.get("acl_enabled", False)
-    for policy in payload.get("acl_policies", ()):
-        server.acls.upsert_policy(policy)
-    for token in payload.get("acl_tokens", ()):
-        server.acls.tokens_by_accessor[token.accessor_id] = token
-        server.acls.tokens_by_secret[token.secret_id] = token
-    return payload["index"]
+    local_store = getattr(server.store, "local", server.store)
+    local_acls = getattr(server.acls, "local", server.acls)
+    return install_payload(local_store, local_acls, payload)

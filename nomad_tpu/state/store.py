@@ -146,13 +146,17 @@ class StateStore:
                 self.node_table.delete_node(node_id)
             return self._bump("nodes")
 
-    def update_node_status(self, node_id: str, status: str) -> int:
+    def update_node_status(
+        self, node_id: str, status: str, now: Optional[float] = None
+    ) -> int:
+        # `now` is stamped by the proposer so a replicated command
+        # stream applies identically on every server (FSM determinism)
         with self._lock:
             node = self.nodes.get(node_id)
             if node is None:
                 raise KeyError(node_id)
             node.status = status
-            node.status_updated_at = time.time()
+            node.status_updated_at = time.time() if now is None else now
             node.modify_index = self._index + 1
             self.node_table.upsert_node(node)
             return self._bump("nodes")
@@ -240,7 +244,11 @@ class StateStore:
     # evals
     # ------------------------------------------------------------------
 
-    def upsert_evals(self, evals: List[Evaluation]) -> int:
+    def upsert_evals(
+        self, evals: List[Evaluation], now: Optional[float] = None
+    ) -> int:
+        if now is None:
+            now = time.time()
         with self._lock:
             for ev in evals:
                 existing = self.evals.get(ev.id)
@@ -249,7 +257,7 @@ class StateStore:
                 else:
                     ev.create_index = self._index + 1
                 ev.modify_index = self._index + 1
-                ev.modify_time = time.time()
+                ev.modify_time = now
                 self.evals[ev.id] = ev
                 self._evals_by_job[(ev.namespace, ev.job_id)].add(ev.id)
             return self._bump("evals")
@@ -468,6 +476,13 @@ class StateSnapshot:
     def __init__(self, store: StateStore, index: int) -> None:
         self._store = store
         self.index = index
+        self._job_override: Optional[Job] = None
+
+    def override_job(self, job: Job) -> None:
+        """Overlay a not-yet-committed job version on this view (used
+        by the plan dry-run so staging never touches the store —
+        reference nomad/job_endpoint.go Plan runs on a snapshot)."""
+        self._job_override = job
 
     # the scheduler-facing read surface
     def nodes(self) -> List[Node]:
@@ -477,6 +492,9 @@ class StateSnapshot:
         return self._store.node_by_id(node_id)
 
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        ov = self._job_override
+        if ov is not None and (ov.namespace, ov.id) == (namespace, job_id):
+            return ov
         return self._store.job_by_id(namespace, job_id)
 
     def job_by_version(self, namespace: str, job_id: str, version: int):

@@ -1,0 +1,147 @@
+"""Replicated control-plane tests: an in-process 3-server raft cluster
+scheduling real jobs (the shape of the reference's nomad.TestServer +
+TestJoin integration tests, nomad/testing.go:44, leader_test.go)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.cluster import TestCluster
+from nomad_tpu.structs import SchedulerConfiguration
+
+
+def wait_until(pred, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def cluster():
+    c = TestCluster(3, heartbeat_ttl=60.0)
+    c.start()
+    yield c
+    c.stop()
+
+
+def register_capacity(server, n_nodes=3):
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for node in nodes:
+        server.register_node(node)
+    return nodes
+
+
+def test_job_schedules_and_replicates(cluster):
+    leader = cluster.wait_for_leader()
+    register_capacity(leader)
+    job = mock.job(id="web")
+    leader.register_job(job)
+    assert leader.drain_to_idle(timeout=10.0)
+    allocs = leader.store.allocs_by_job("default", "web")
+    assert len(allocs) == job.task_groups[0].count
+    # every follower's local store converges to the same allocations
+    for f in cluster.followers():
+        wait_until(
+            lambda f=f: {
+                a.id for a in f.fsm.store.allocs_by_job("default", "web")
+            }
+            == {a.id for a in allocs},
+            msg=f"alloc replication to {f.addr}",
+        )
+        # and the same modify indexes (deterministic FSM application);
+        # allow the in-flight tail of the log to land first
+        wait_until(
+            lambda f=f: f.fsm.store.latest_index()
+            == leader.fsm.store.latest_index(),
+            msg=f"index convergence on {f.addr}",
+        )
+
+
+def test_write_via_follower_forwards_to_leader(cluster):
+    leader = cluster.wait_for_leader()
+    register_capacity(leader)
+    follower = cluster.followers()[0]
+    job = mock.job(id="fwd")
+    # the plain API call on a follower forwards to the leader, which
+    # creates AND routes the eval (broker only runs there)
+    follower.register_job(job)
+    assert leader.drain_to_idle(timeout=10.0)
+    assert len(leader.store.allocs_by_job("default", "fwd")) == 10
+
+    # heartbeats through a follower arm the leader's TTL timers
+    node = mock.node()
+    follower.register_node(node)
+    follower.heartbeat(node.id)
+    assert node.id in leader._heartbeat_timers
+    assert node.id not in follower._heartbeat_timers
+
+
+def test_leader_failover_keeps_scheduling(cluster):
+    leader = cluster.wait_for_leader()
+    nodes = register_capacity(leader)
+    job = mock.job(id="before")
+    leader.register_job(job)
+    assert leader.drain_to_idle(timeout=10.0)
+
+    # kill the leader outright
+    leader.stop()
+    cluster.transport.set_down(leader.addr)
+    rest = [s for s in cluster.servers if s is not leader]
+    new_leader = None
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        est = [s for s in rest if s.is_leader() and s._leader_established]
+        if est:
+            new_leader = est[0]
+            break
+        time.sleep(0.02)
+    assert new_leader is not None, "no new leader established"
+
+    # the replicated state survived: old allocs visible
+    assert len(new_leader.store.allocs_by_job("default", "before")) == 10
+    # and the new leader schedules new work
+    job2 = mock.job(id="after")
+    new_leader.register_job(job2)
+    assert new_leader.drain_to_idle(timeout=10.0)
+    assert len(new_leader.store.allocs_by_job("default", "after")) == 10
+
+
+def test_scheduler_config_replicates(cluster):
+    leader = cluster.wait_for_leader()
+    cfg = SchedulerConfiguration(scheduler_algorithm="spread")
+    leader.store.set_scheduler_config(cfg)
+    for f in cluster.followers():
+        wait_until(
+            lambda f=f: f.fsm.store.get_scheduler_config().scheduler_algorithm
+            == "spread",
+            msg="config replication",
+        )
+
+
+def test_follower_has_no_leader_services(cluster):
+    leader = cluster.wait_for_leader()
+    for f in cluster.followers():
+        assert not f._leader_established
+        assert not f.broker.enabled
+    assert leader._leader_established
+    assert leader.broker.enabled
+
+
+def test_acl_replication(cluster):
+    leader = cluster.wait_for_leader()
+    from nomad_tpu.acl import Policy
+
+    token = leader.acls.bootstrap()
+    policy = Policy.from_dict(
+        "readonly", {"namespace": {"default": {"policy": "read"}}}
+    )
+    leader.acls.upsert_policy(policy)
+    for f in cluster.followers():
+        wait_until(
+            lambda f=f: "readonly" in f.fsm.acls.policies
+            and token.accessor_id in f.fsm.acls.tokens_by_accessor,
+            msg="acl replication",
+        )
