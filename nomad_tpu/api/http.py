@@ -481,7 +481,35 @@ class APIHandler(BaseHTTPRequestHandler):
             return True
 
         if path == "/v1/status/leader" and method == "GET":
-            self._respond("local")
+            raft = getattr(srv, "raft", None)
+            self._respond(
+                raft.leader_hint() if raft is not None else "local"
+            )
+            return True
+
+        if path == "/v1/agent/members" and method == "GET":
+            gossip = getattr(srv, "gossip", None)
+            self._respond(
+                {
+                    "ServerName": getattr(srv, "addr", "local"),
+                    "ServerRegion": getattr(srv, "region", "global"),
+                    "Members": gossip.member_list() if gossip else [
+                        {"Name": "local", "Addr": "local",
+                         "Status": "alive", "Region": "global",
+                         "Role": "server", "Incarnation": 0}
+                    ],
+                }
+            )
+            return True
+
+        if path == "/v1/regions" and method == "GET":
+            gossip = getattr(srv, "gossip", None)
+            if gossip is None:
+                self._respond([getattr(srv, "region", "global")])
+            else:
+                self._respond(
+                    sorted({m.region for m in gossip.alive_members()})
+                )
             return True
 
         if path == "/v1/agent/self" and method == "GET":

@@ -261,6 +261,24 @@ def cmd_job_scale(args) -> None:
     print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
 
 
+def cmd_server_members(args) -> None:
+    """(reference command/server_members.go)"""
+    info = _request("GET", "/v1/agent/members")
+    _table(
+        [
+            (
+                m["Name"],
+                m["Region"],
+                m["Role"],
+                m["Status"],
+                m["Incarnation"],
+            )
+            for m in info["Members"]
+        ],
+        ["Name", "Region", "Role", "Status", "Incarnation"],
+    )
+
+
 def cmd_node_status(args) -> None:
     if not args.node_id:
         nodes = _request("GET", "/v1/nodes")
@@ -451,6 +469,11 @@ def build_parser() -> argparse.ArgumentParser:
     jsc.add_argument("group")
     jsc.add_argument("count", type=int)
     jsc.set_defaults(fn=cmd_job_scale)
+
+    server = sub.add_parser("server")
+    server_sub = server.add_subparsers(dest="server_cmd", required=True)
+    sm = server_sub.add_parser("members")
+    sm.set_defaults(fn=cmd_server_members)
 
     node = sub.add_parser("node")
     node_sub = node.add_subparsers(dest="node_cmd", required=True)

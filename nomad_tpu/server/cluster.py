@@ -25,6 +25,7 @@ from ..raft import InmemTransport, NotLeaderError, RaftNode
 from ..raft.transport import TransportError
 from ..state.store import StateStore
 from .fsm import ServerFSM, encode_command
+from .membership import Gossip
 from .server import Server
 
 _RAFT_METHODS = {"request_vote", "append_entries", "install_snapshot"}
@@ -167,9 +168,18 @@ class ClusterServer(Server):
             acl_enabled=acl_enabled,
             **kwargs,
         )
+        # gossip membership across servers and regions (reference
+        # nomad/serf.go; WAN pool gives region federation its routes)
+        self.gossip = Gossip(
+            addr,
+            addr,
+            self.transport,
+            region=region,
+            on_event=self._on_member_event,
+        )
         # take over the transport slot: raft RPCs pass through, plus a
         # leader-forwarding channel (reference nomad/rpc.go: one port,
-        # multiplexed raft + RPC)
+        # multiplexed raft + RPC + serf)
         self.transport.register(addr, self._handle_cluster_rpc)
 
     # -- raft plumbing --------------------------------------------------
@@ -192,6 +202,8 @@ class ClusterServer(Server):
     def _handle_cluster_rpc(self, method: str, payload: dict) -> dict:
         if method in _RAFT_METHODS:
             return self.raft._handle_rpc(method, payload)
+        if method.startswith("gossip_"):
+            return self.gossip.handle(method, payload)
         if method == "fsm_apply":
             result = self.raft.apply(payload["data"])
             return {"result": pickle.dumps(result)}
@@ -199,7 +211,46 @@ class ClusterServer(Server):
             fn = getattr(self, payload["op"])
             args, kw = pickle.loads(payload["args"])
             return {"result": pickle.dumps(fn(*args, **kw))}
+        if method == "region_call":
+            # a request that entered through another region's servers
+            # (reference rpc.go:645 forwardRegion lands it here)
+            args, kw = pickle.loads(payload["args"])
+            result = self._leader_route(payload["op"], *args, **kw)
+            return {"result": pickle.dumps(result)}
         raise ValueError(f"unknown cluster rpc {method!r}")
+
+    # -- membership / federation ---------------------------------------
+
+    def join(self, seed_addr: str) -> int:
+        """Join the gossip pool via any known server (serf join)."""
+        return self.gossip.join(seed_addr)
+
+    def server_members(self):
+        return self.gossip.member_list()
+
+    def _on_member_event(self, kind: str, member) -> None:
+        # (reference serf.go nodeJoin/nodeFailed -> reconcile); raft
+        # peers are static config here, so membership drives routing
+        # tables and the agent members view only
+        if hasattr(self, "metrics"):
+            self.metrics.incr(f"serf.{kind}")
+
+    def forward_region(self, region: str, op: str, *args, **kw):
+        """Route an API call to a server in another region (reference
+        rpc.go:645 forwardRegion: pick a random known server there)."""
+        if region == self.region:
+            return self._leader_route(op, *args, **kw)
+        import random as _random
+
+        members = self.gossip.members_in_region(region)
+        if not members:
+            raise KeyError(f"no path to region {region!r}")
+        target = _random.choice(members)
+        resp = self.transport.rpc(
+            self.addr, target.addr, "region_call",
+            {"op": op, "args": pickle.dumps((args, kw))},
+        )
+        return pickle.loads(resp["result"])
 
     def remote_call(self, op: str, *args, **kw):
         """Invoke a Server API method on the current leader
@@ -258,11 +309,15 @@ class ClusterServer(Server):
 
     def start(self) -> None:
         self._running = True
+        self.gossip.start()
         self.raft.start()
 
     def stop(self) -> None:
         self._running = False
         self.raft.stop()
+        # graceful departure: broadcast LEFT so peers don't gossip a
+        # failure (serf Leave vs. a detected member-failed)
+        self.gossip.leave()
         self.revoke_leadership()
         for timer in self._heartbeat_timers.values():
             timer.cancel()
@@ -303,6 +358,18 @@ for _op in _LEADER_API:
     setattr(ClusterServer, _op, _make_forwarder(_op))
 
 
+def _register_job_federated(self, job):
+    """Jobs carry a region (structs.Job.Region); a submission landing
+    in the wrong region hops to the right one first (reference
+    job_endpoint.go forwarding via rpc.go:645)."""
+    if job.region and job.region != self.region:
+        return self.forward_region(job.region, "register_job", job)
+    return self._leader_route("register_job", job)
+
+
+ClusterServer.register_job = _register_job_federated
+
+
 class TestCluster:
     """Boots N in-process ClusterServers on a shared transport — the
     shape of the reference's nomad.TestServer + TestJoin clusters
@@ -310,12 +377,20 @@ class TestCluster:
 
     __test__ = False  # not a pytest class despite the name
 
-    def __init__(self, n: int = 3, **server_kwargs) -> None:
-        self.transport = InmemTransport()
-        addrs = [f"server-{i}" for i in range(n)]
+    def __init__(
+        self,
+        n: int = 3,
+        transport: Optional[InmemTransport] = None,
+        region: str = "global",
+        name_prefix: str = "server",
+        **server_kwargs,
+    ) -> None:
+        self.transport = transport or InmemTransport()
+        addrs = [f"{name_prefix}-{i}" for i in range(n)]
         self.servers = [
             ClusterServer(
-                addr, addrs, self.transport, **server_kwargs
+                addr, addrs, self.transport, region=region,
+                **server_kwargs,
             )
             for addr in addrs
         ]
@@ -323,6 +398,10 @@ class TestCluster:
     def start(self) -> None:
         for s in self.servers:
             s.start()
+        # gossip-join everyone through the first server (TestJoin)
+        seed = self.servers[0]
+        for s in self.servers[1:]:
+            s.join(seed.addr)
 
     def stop(self) -> None:
         for s in self.servers:

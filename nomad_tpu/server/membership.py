@@ -1,0 +1,369 @@
+"""SWIM-style gossip membership (reference: hashicorp/serf + memberlist,
+wired in nomad/serf.go and nomad/server.go:174 setupSerf).
+
+Implements the memberlist failure-detector loop the reference gets from
+SWIM: periodic random probes, indirect probes through k peers on a
+miss, suspicion with refutation by incarnation number, and piggybacked
+membership updates on every message.  Servers across regions join one
+pool (the reference's WAN serf), giving region federation its routing
+table (`members_in_region`) and the agent its `server members` view.
+
+Events (member-join / member-failed / member-leave) surface through a
+callback, the way the reference pumps serf events into reconcileCh.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..raft.transport import TransportError
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+LEFT = "left"
+
+# precedence for equal incarnation numbers (memberlist semantics:
+# a claim can only be overridden by a higher incarnation or a
+# "stronger" state at the same incarnation)
+_PRECEDENCE = {ALIVE: 0, SUSPECT: 1, DEAD: 2, LEFT: 3}
+
+
+@dataclass
+class Member:
+    name: str
+    addr: str
+    region: str = "global"
+    role: str = "server"
+    incarnation: int = 0
+    status: str = ALIVE
+    status_time: float = field(default_factory=time.monotonic)
+
+    def record(self) -> Tuple:
+        return (
+            self.name,
+            self.addr,
+            self.region,
+            self.role,
+            self.incarnation,
+            self.status,
+        )
+
+
+class Gossip:
+    """One gossip participant.  Does not own a transport slot — the
+    owner routes `gossip_*` RPC methods to handle() (the reference
+    multiplexes serf onto the same listener as everything else)."""
+
+    def __init__(
+        self,
+        name: str,
+        addr: str,
+        transport,
+        region: str = "global",
+        role: str = "server",
+        probe_interval: float = 0.15,
+        suspicion_timeout: float = 0.8,
+        indirect_probes: int = 2,
+        on_event: Optional[Callable[[str, Member], None]] = None,
+    ) -> None:
+        self.name = name
+        self.addr = addr
+        self.transport = transport
+        self.region = region
+        self.probe_interval = probe_interval
+        self.suspicion_timeout = suspicion_timeout
+        self.indirect_probes = indirect_probes
+        self.on_event = on_event
+
+        self._lock = threading.RLock()
+        self.members: Dict[str, Member] = {
+            name: Member(name, addr, region, role)
+        }
+        self._probe_ring: List[str] = []
+        self._round = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"gossip@{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def leave(self) -> None:
+        """Graceful departure (serf Leave): broadcast LEFT so peers
+        don't mark us failed."""
+        with self._lock:
+            me = self.members[self.name]
+            me.incarnation += 1
+            me.status = LEFT
+            records = [me.record()]
+        for peer in self._alive_peers():
+            try:
+                self.transport.rpc(
+                    self.addr, peer.addr, "gossip_ping",
+                    {"from": self.name, "updates": records},
+                )
+            except TransportError:
+                pass
+        self.stop()
+
+    # -- joining --------------------------------------------------------
+
+    def join(self, seed_addr: str) -> int:
+        """Join a pool via any existing member (serf Join).  Returns
+        the number of members learned."""
+        resp = self.transport.rpc(
+            self.addr,
+            seed_addr,
+            "gossip_join",
+            {"records": self._records()},
+        )
+        before = len(self.members)
+        self._merge(resp["records"])
+        return len(self.members) - before
+
+    # -- views ----------------------------------------------------------
+
+    def _records(self) -> List[Tuple]:
+        with self._lock:
+            return [m.record() for m in self.members.values()]
+
+    def alive_members(self) -> List[Member]:
+        with self._lock:
+            return [
+                m for m in self.members.values() if m.status == ALIVE
+            ]
+
+    def members_in_region(self, region: str) -> List[Member]:
+        return [
+            m for m in self.alive_members() if m.region == region
+        ]
+
+    def member_list(self) -> List[Dict]:
+        with self._lock:
+            return [
+                {
+                    "Name": m.name,
+                    "Addr": m.addr,
+                    "Region": m.region,
+                    "Role": m.role,
+                    "Status": m.status,
+                    "Incarnation": m.incarnation,
+                }
+                for m in sorted(
+                    self.members.values(), key=lambda m: m.name
+                )
+            ]
+
+    def _alive_peers(self) -> List[Member]:
+        with self._lock:
+            return [
+                m
+                for m in self.members.values()
+                if m.name != self.name and m.status in (ALIVE, SUSPECT)
+            ]
+
+    # -- probe loop (SWIM failure detector) -----------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._probe_once()
+            self._expire_suspects()
+            self._round += 1
+            # reconnect sweep (memberlist's dead-node push/pull): every
+            # few rounds, ping one DEAD member so a healed partition
+            # can't leave the pool permanently split — a symmetric
+            # partition makes BOTH sides mark the other dead, and
+            # without this nobody would ever talk across again
+            if self._round % 3 == 0:
+                self._reconnect_probe()
+            self._stop.wait(self.probe_interval)
+
+    def _reconnect_probe(self) -> None:
+        with self._lock:
+            dead = [
+                m for m in self.members.values() if m.status == DEAD
+            ]
+        if not dead:
+            return
+        target = random.choice(dead)
+        # a live target sees the DEAD rumor in our piggyback, refutes
+        # with a higher incarnation in its reply, and _merge revives it
+        self._ping(target.addr)
+
+    def _next_probe_target(self) -> Optional[Member]:
+        with self._lock:
+            candidates = [m.name for m in self._alive_peers()]
+            if not candidates:
+                return None
+            # randomized round-robin ring (SWIM's probe ordering)
+            self._probe_ring = [
+                n for n in self._probe_ring if n in candidates
+            ]
+            if not self._probe_ring:
+                self._probe_ring = candidates
+                random.shuffle(self._probe_ring)
+            name = self._probe_ring.pop()
+            return self.members.get(name)
+
+    def _probe_once(self) -> None:
+        target = self._next_probe_target()
+        if target is None:
+            return
+        if self._ping(target.addr):
+            self._mark(target.name, ALIVE, target.incarnation)
+            return
+        # indirect probes through k random other peers (SWIM ping-req)
+        others = [
+            m for m in self._alive_peers() if m.name != target.name
+        ]
+        random.shuffle(others)
+        for relay in others[: self.indirect_probes]:
+            try:
+                resp = self.transport.rpc(
+                    self.addr,
+                    relay.addr,
+                    "gossip_ping_req",
+                    {
+                        "from": self.name,
+                        "target": target.addr,
+                        "updates": self._gossip_payload(),
+                    },
+                )
+                if resp.get("ack"):
+                    self._merge(resp.get("updates", ()))
+                    self._mark(target.name, ALIVE, target.incarnation)
+                    return
+            except TransportError:
+                continue
+        self._suspect(target.name)
+
+    def _ping(self, addr: str) -> bool:
+        try:
+            resp = self.transport.rpc(
+                self.addr,
+                addr,
+                "gossip_ping",
+                {"from": self.name, "updates": self._gossip_payload()},
+            )
+            self._merge(resp.get("updates", ()))
+            return bool(resp.get("ack"))
+        except TransportError:
+            return False
+
+    def _gossip_payload(self) -> List[Tuple]:
+        # full-state piggyback: pools are O(servers), not O(nodes), so
+        # shipping the whole view every ping is cheap and converges fast
+        return self._records()
+
+    # -- state merging ---------------------------------------------------
+
+    def _mark(self, name: str, status: str, incarnation: int) -> None:
+        """Direct observation (an ack from the member itself) clears a
+        local suspicion at the same incarnation."""
+        with self._lock:
+            m = self.members.get(name)
+            if m is None:
+                return
+            if (
+                status == ALIVE
+                and m.status == SUSPECT
+                and incarnation >= m.incarnation
+            ):
+                m.status = ALIVE
+                m.status_time = time.monotonic()
+
+    def _suspect(self, name: str) -> None:
+        with self._lock:
+            m = self.members.get(name)
+            if m is None or m.status != ALIVE:
+                return
+            m.status = SUSPECT
+            m.status_time = time.monotonic()
+
+    def _expire_suspects(self) -> None:
+        events = []
+        with self._lock:
+            now = time.monotonic()
+            for m in self.members.values():
+                if (
+                    m.status == SUSPECT
+                    and now - m.status_time > self.suspicion_timeout
+                ):
+                    m.status = DEAD
+                    m.status_time = now
+                    events.append(("member-failed", m))
+        for kind, m in events:
+            self._emit(kind, m)
+
+    def _emit(self, kind: str, member: Member) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(kind, member)
+            except Exception:  # noqa: BLE001 — observer fault
+                pass
+
+    def _merge(self, records) -> None:
+        events = []
+        with self._lock:
+            for name, addr, region, role, inc, status in records:
+                if name == self.name:
+                    # refutation (SWIM): if the pool thinks we're gone,
+                    # outbid the rumor with a higher incarnation
+                    me = self.members[self.name]
+                    if status in (SUSPECT, DEAD) and inc >= me.incarnation:
+                        me.incarnation = inc + 1
+                        me.status = ALIVE
+                    continue
+                cur = self.members.get(name)
+                if cur is None:
+                    m = Member(name, addr, region, role, inc, status)
+                    self.members[name] = m
+                    if status == ALIVE:
+                        events.append(("member-join", m))
+                    continue
+                if inc > cur.incarnation or (
+                    inc == cur.incarnation
+                    and _PRECEDENCE[status] > _PRECEDENCE[cur.status]
+                ):
+                    old_status = cur.status
+                    cur.incarnation = inc
+                    cur.status = status
+                    cur.status_time = time.monotonic()
+                    cur.addr, cur.region, cur.role = addr, region, role
+                    if status == ALIVE and old_status != ALIVE:
+                        events.append(("member-join", cur))
+                    elif status == DEAD and old_status != DEAD:
+                        events.append(("member-failed", cur))
+                    elif status == LEFT and old_status != LEFT:
+                        events.append(("member-leave", cur))
+        for kind, m in events:
+            self._emit(kind, m)
+
+    # -- inbound handlers ------------------------------------------------
+
+    def handle(self, method: str, payload: dict) -> dict:
+        if method == "gossip_ping":
+            self._merge(payload.get("updates", ()))
+            return {"ack": True, "updates": self._gossip_payload()}
+        if method == "gossip_ping_req":
+            # probe the target on behalf of the requester
+            ok = self._ping(payload["target"])
+            return {"ack": ok, "updates": self._gossip_payload()}
+        if method == "gossip_join":
+            self._merge(payload.get("records", ()))
+            return {"records": self._records()}
+        raise ValueError(f"unknown gossip rpc {method!r}")

@@ -1,0 +1,178 @@
+"""Gossip membership + region federation tests (reference: serf
+membership in nomad/serf.go, region forwarding in nomad/rpc.go:645)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft.transport import InmemTransport
+from nomad_tpu.server.cluster import TestCluster
+from nomad_tpu.server.membership import ALIVE, DEAD, LEFT, Gossip
+
+
+def wait_until(pred, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def make_pool(n, transport=None, **kw):
+    transport = transport or InmemTransport()
+    pool = []
+    for i in range(n):
+        g = Gossip(f"g{i}", f"g{i}", transport, **kw)
+        transport.register(g.addr, lambda m, p, g=g: g.handle(m, p))
+        pool.append(g)
+    for g in pool:
+        g.start()
+    for g in pool[1:]:
+        g.join(pool[0].addr)
+    return transport, pool
+
+
+def test_pool_converges_to_full_membership():
+    _, pool = make_pool(4)
+    try:
+        wait_until(
+            lambda: all(len(g.alive_members()) == 4 for g in pool),
+            msg="membership convergence",
+        )
+        for g in pool:
+            assert sorted(m.name for m in g.alive_members()) == [
+                "g0", "g1", "g2", "g3",
+            ]
+    finally:
+        for g in pool:
+            g.stop()
+
+
+def test_failed_member_detected():
+    transport, pool = make_pool(4, suspicion_timeout=0.4)
+    events = []
+    for g in pool:
+        g.on_event = lambda kind, m, g=g: events.append(
+            (g.name, kind, m.name)
+        )
+    try:
+        wait_until(
+            lambda: all(len(g.alive_members()) == 4 for g in pool)
+        )
+        victim = pool[-1]
+        victim.stop()
+        transport.set_down(victim.addr)
+        rest = pool[:-1]
+        wait_until(
+            lambda: all(
+                g.members[victim.name].status == DEAD for g in rest
+            ),
+            msg="failure detection",
+        )
+        assert any(
+            kind == "member-failed" and name == victim.name
+            for _, kind, name in events
+        )
+    finally:
+        for g in pool[:-1]:
+            g.stop()
+
+
+def test_graceful_leave_is_not_a_failure():
+    _, pool = make_pool(3)
+    try:
+        wait_until(
+            lambda: all(len(g.alive_members()) == 3 for g in pool)
+        )
+        leaver = pool[-1]
+        leaver.leave()
+        rest = pool[:-1]
+        wait_until(
+            lambda: all(
+                g.members[leaver.name].status == LEFT for g in rest
+            ),
+            msg="leave propagation",
+        )
+    finally:
+        for g in pool[:-1]:
+            g.stop()
+
+
+def test_refutation_revives_falsely_suspected_member():
+    transport, pool = make_pool(3, suspicion_timeout=0.3)
+    try:
+        wait_until(
+            lambda: all(len(g.alive_members()) == 3 for g in pool)
+        )
+        victim = pool[-1]
+        # partition victim briefly so peers mark it dead
+        transport.isolate(victim.addr)
+        wait_until(
+            lambda: pool[0].members[victim.name].status == DEAD,
+            msg="false death",
+        )
+        transport.heal()
+        wait_until(
+            lambda: all(
+                g.members[victim.name].status == ALIVE for g in pool
+            ),
+            msg="refutation",
+        )
+        # the refuted incarnation outbids the death rumor
+        assert victim.members[victim.name].incarnation > 0
+    finally:
+        for g in pool:
+            g.stop()
+
+
+@pytest.fixture
+def federation():
+    transport = InmemTransport()
+    east = TestCluster(
+        3, transport=transport, region="east", name_prefix="east",
+        heartbeat_ttl=60.0,
+    )
+    west = TestCluster(
+        3, transport=transport, region="west", name_prefix="west",
+        heartbeat_ttl=60.0,
+    )
+    east.start()
+    west.start()
+    # WAN join: bridge the two regional pools
+    east.servers[0].join(west.servers[0].addr)
+    yield east, west
+    east.stop()
+    west.stop()
+
+
+def test_cross_region_job_submission(federation):
+    east, west = federation
+    east_leader = east.wait_for_leader()
+    west_leader = west.wait_for_leader()
+    wait_until(
+        lambda: len(east_leader.gossip.members_in_region("west")) == 3,
+        msg="WAN membership convergence",
+    )
+    for _ in range(3):
+        west_leader.register_node(mock.node())
+
+    job = mock.job(id="west-job")
+    job.region = "west"
+    # submitted via an EAST server: must hop to west and schedule there
+    east.servers[1].register_job(job)
+    assert west_leader.drain_to_idle(timeout=10.0)
+    assert len(west_leader.store.allocs_by_job("default", "west-job")) == 10
+    assert east_leader.store.job_by_id("default", "west-job") is None
+
+
+def test_regions_listing(federation):
+    east, west = federation
+    leader = east.wait_for_leader()
+    wait_until(
+        lambda: {m.region for m in leader.gossip.alive_members()}
+        == {"east", "west"},
+        msg="region discovery",
+    )
+    members = leader.server_members()
+    assert len(members) == 6
