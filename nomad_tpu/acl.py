@@ -30,7 +30,15 @@ from .structs import new_id
 # namespace capability sets (reference acl/policy.go:19-60)
 NAMESPACE_CAPABILITIES = {
     "deny": set(),
-    "read": {"list-jobs", "read-job", "read-logs", "read-fs"},
+    "read": {
+        "list-jobs",
+        "read-job",
+        "read-logs",
+        "read-fs",
+        "read-job-scaling",
+        "list-scaling-policies",
+        "read-scaling-policy",
+    },
     "write": {
         "list-jobs",
         "read-job",
@@ -41,6 +49,9 @@ NAMESPACE_CAPABILITIES = {
         "alloc-exec",
         "alloc-lifecycle",
         "scale-job",
+        "read-job-scaling",
+        "list-scaling-policies",
+        "read-scaling-policy",
     },
 }
 

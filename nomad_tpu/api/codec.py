@@ -27,6 +27,7 @@ from ..structs import (
     SpreadTarget,
     Task,
     TaskGroup,
+    ScalingPolicy,
     UpdateStrategy,
     VolumeRequest,
 )
@@ -67,6 +68,39 @@ def eval_to_dict(ev: Evaluation) -> Dict:
 
 def deployment_to_dict(d: Deployment) -> Dict:
     return _clean(d)
+
+
+def scaling_policy_to_dict(p) -> Dict:
+    return {
+        "ID": p.id,
+        "Type": p.type,
+        "Target": dict(p.target),
+        "Min": p.min,
+        "Max": p.max,
+        "Policy": dict(p.policy),
+        "Enabled": p.enabled,
+        "CreateIndex": p.create_index,
+        "ModifyIndex": p.modify_index,
+    }
+
+
+def scaling_policy_stub(p) -> Dict:
+    d = scaling_policy_to_dict(p)
+    d.pop("Policy")
+    return d
+
+
+def scaling_event_to_dict(e) -> Dict:
+    return {
+        "Time": e.time,
+        "Count": e.count,
+        "PreviousCount": e.previous_count,
+        "Message": e.message,
+        "Error": e.error,
+        "EvalID": e.eval_id,
+        "Meta": dict(e.meta),
+        "CreateIndex": e.create_index,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -262,6 +296,14 @@ def _task_group(raw) -> TaskGroup:
             type=_get(v, "type", "Type", default="host"),
             source=_get(v, "source", "Source", default=""),
             read_only=bool(_get(v, "read_only", "ReadOnly", default=False)),
+        )
+    sc = _get(raw, "scaling", "Scaling")
+    if sc:
+        tg.scaling = ScalingPolicy(
+            min=int(_get(sc, "min", "Min", default=1)),
+            max=int(_get(sc, "max", "Max", default=0)),
+            policy=_get(sc, "policy", "Policy", default={}) or {},
+            enabled=bool(_get(sc, "enabled", "Enabled", default=True)),
         )
     return tg
 

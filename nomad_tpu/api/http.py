@@ -28,6 +28,9 @@ from .codec import (
     job_from_dict,
     job_to_dict,
     node_to_dict,
+    scaling_event_to_dict,
+    scaling_policy_stub,
+    scaling_policy_to_dict,
 )
 
 
@@ -68,12 +71,19 @@ class APIHandler(BaseHTTPRequestHandler):
         self._respond({"error": message}, code)
 
     def _check_acl(self, capability: str, namespace: str = "default"):
+        self._check_acl_any((capability,), namespace)
+
+    def _check_acl_any(self, capabilities, namespace: str = "default"):
+        """Pass if the token holds ANY of the capabilities (reference
+        endpoints often accept e.g. scale-job OR submit-job)."""
         srv = self.server_ref
         acls = getattr(srv, "acls", None)
         if acls is None or not acls.enabled:
             return
         token = self.headers.get("X-Nomad-Token", "")
-        if not acls.allowed(token, namespace, capability):
+        if not any(
+            acls.allowed(token, namespace, c) for c in capabilities
+        ):
             raise HTTPError(403, "Permission denied")
 
     # -- dispatch -------------------------------------------------------
@@ -248,19 +258,88 @@ class APIHandler(BaseHTTPRequestHandler):
 
         m = re.fullmatch(r"/v1/job/([^/]+)/scale", path)
         if m and method in ("POST", "PUT"):
-            self._check_acl("submit-job", ns)
+            # reference nomad/job_endpoint.go Job.Scale; count=None is
+            # the autoscaler status-report path (event only)
+            self._check_acl_any(("scale-job", "submit-job"), ns)
             body = self._body()
+            target = body.get("Target", {}) or {}
+            group = target.get("Group") or body.get("group")
+            count = body.get("Count", body.get("count"))
+            try:
+                ev, _event = srv.scale_job(
+                    ns,
+                    m.group(1),
+                    group,
+                    count=count,
+                    message=body.get("Message", ""),
+                    error=bool(body.get("Error", False)),
+                    meta=body.get("Meta") or {},
+                    policy_override=bool(body.get("PolicyOverride", False)),
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond({"EvalID": ev.id if ev else ""})
+            return True
+
+        if m and method == "GET":
+            # JobScaleStatusResponse (reference job_endpoint.go
+            # ScaleStatus): per-group desired/placed/running counts +
+            # retained scaling events
+            self._check_acl_any(("read-job-scaling", "read-job"), ns)
             job = store.job_by_id(ns, m.group(1))
             if job is None:
                 raise HTTPError(404, "job not found")
-            group = body.get("Target", {}).get("Group") or body.get("group")
-            count = body.get("Count") or body.get("count")
-            tg = job.lookup_task_group(group)
-            if tg is None:
-                raise HTTPError(400, f"unknown group {group!r}")
-            tg.count = int(count)
-            ev = srv.register_job(job)
-            self._respond({"EvalID": ev.id if ev else ""})
+            events = store.scaling_events_for_job(ns, job.id)
+            live_by_group: Dict[str, list] = {}
+            for a in store.allocs_by_job(ns, job.id):
+                if not a.terminal_status():
+                    live_by_group.setdefault(a.task_group, []).append(a)
+            groups = {}
+            for tg in job.task_groups:
+                allocs = live_by_group.get(tg.name, [])
+                groups[tg.name] = {
+                    "Desired": tg.count,
+                    "Placed": len(allocs),
+                    "Running": sum(
+                        1 for a in allocs if a.client_status == "running"
+                    ),
+                    "Events": [
+                        scaling_event_to_dict(e)
+                        for e in events.get(tg.name, [])
+                    ],
+                }
+            self._respond(
+                {
+                    "JobID": job.id,
+                    "Namespace": job.namespace,
+                    "JobStopped": job.stop,
+                    "TaskGroups": groups,
+                }
+            )
+            return True
+
+        if path == "/v1/scaling/policies" and method == "GET":
+            # listing is scoped to the ACL-checked namespace; no
+            # cross-namespace enumeration
+            self._check_acl("list-scaling-policies", ns)
+            pols = store.iter_scaling_policies(
+                namespace=ns, job_id=q.get("job")
+            )
+            self._respond(
+                [scaling_policy_stub(p) for p in pols]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/scaling/policy/([^/]+)", path)
+        if m and method == "GET":
+            pol = store.scaling_policy_by_id(m.group(1))
+            if pol is None:
+                raise HTTPError(404, "scaling policy not found")
+            # authorize against the namespace the policy lives in
+            self._check_acl(
+                "read-scaling-policy", pol.target_tuple()[0] or ns
+            )
+            self._respond(scaling_policy_to_dict(pol))
             return True
 
         if path == "/v1/nodes" and method == "GET":

@@ -261,6 +261,33 @@ def cmd_job_scale(args) -> None:
     print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
 
 
+def cmd_scaling_policies(args) -> None:
+    """(reference command/scaling_policy_list.go)"""
+    path = "/v1/scaling/policies"
+    if getattr(args, "job_id", None):
+        path += f"?job={args.job_id}"
+    pols = _request("GET", path)
+    _table(
+        [
+            (
+                p["ID"][:8],
+                p["Enabled"],
+                p["Type"],
+                p["Target"].get("Job", ""),
+                p["Target"].get("Group", ""),
+            )
+            for p in pols
+        ],
+        ("ID", "Enabled", "Type", "Job", "Group"),
+    )
+
+
+def cmd_scaling_policy_info(args) -> None:
+    """(reference command/scaling_policy_info.go)"""
+    p = _request("GET", f"/v1/scaling/policy/{args.policy_id}")
+    print(json.dumps(p, indent=2))
+
+
 def cmd_server_members(args) -> None:
     """(reference command/server_members.go)"""
     info = _request("GET", "/v1/agent/members")
@@ -469,6 +496,15 @@ def build_parser() -> argparse.ArgumentParser:
     jsc.add_argument("group")
     jsc.add_argument("count", type=int)
     jsc.set_defaults(fn=cmd_job_scale)
+
+    scaling = sub.add_parser("scaling")
+    scaling_sub = scaling.add_subparsers(dest="scaling_cmd", required=True)
+    scp = scaling_sub.add_parser("policies")
+    scp.add_argument("-job", dest="job_id", default=None)
+    scp.set_defaults(fn=cmd_scaling_policies)
+    sci = scaling_sub.add_parser("policy")
+    sci.add_argument("policy_id")
+    sci.set_defaults(fn=cmd_scaling_policy_info)
 
     server = sub.add_parser("server")
     server_sub = server.add_subparsers(dest="server_cmd", required=True)

@@ -407,6 +407,14 @@ def _group_dict(body: Dict) -> Dict:
         }
     if vols:
         out["volumes"] = vols
+    sc = _first(body.get("scaling"))
+    if sc:
+        out["scaling"] = {
+            "min": int(sc.get("min", 1)),
+            "max": int(sc.get("max", 0)),
+            "enabled": bool(sc.get("enabled", True)),
+            "policy": _first(sc.get("policy"), {}) or {},
+        }
     return out
 
 

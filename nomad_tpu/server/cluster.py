@@ -92,6 +92,11 @@ class ReplicatedStore:
     def upsert_deployment(self, deployment):
         return self._raft_apply("upsert_deployment", (deployment,))
 
+    def upsert_scaling_event(self, namespace, job_id, group, event):
+        return self._raft_apply(
+            "upsert_scaling_event", (namespace, job_id, group, event)
+        )
+
     def set_scheduler_config(self, config):
         return self._raft_apply("set_scheduler_config", (config,))
 
@@ -341,6 +346,7 @@ _LEADER_API = (
     "update_allocs_from_client",
     "force_gc",
     "route_eval",
+    "scale_job",
 )
 
 
@@ -361,10 +367,23 @@ for _op in _LEADER_API:
 def _register_job_federated(self, job):
     """Jobs carry a region (structs.Job.Region); a submission landing
     in the wrong region hops to the right one first (reference
-    job_endpoint.go forwarding via rpc.go:645)."""
-    if job.region and job.region != self.region:
-        return self.forward_region(job.region, "register_job", job)
-    return self._leader_route("register_job", job)
+    job_endpoint.go forwarding via rpc.go:645).  A job that never
+    named a region (the struct default) resolves to the receiving
+    server's region, as the reference agent does, unless the default
+    region actually exists in the federation."""
+    from ..structs import DEFAULT_REGION
+
+    region = job.region
+    if (
+        region == DEFAULT_REGION
+        and region != self.region
+        and not self.gossip.members_in_region(region)
+    ):
+        region = self.region
+    if not region or region == self.region:
+        job.region = self.region
+        return self._leader_route("register_job", job)
+    return self.forward_region(region, "register_job", job)
 
 
 ClusterServer.register_job = _register_job_federated

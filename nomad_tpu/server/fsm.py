@@ -51,6 +51,11 @@ def state_payload(store: StateStore, acls) -> dict:
             "evals": list(store.evals.values()),
             "deployments": list(store.deployments.values()),
             "scheduler_config": store.scheduler_config,
+            "scaling_policies": list(store.scaling_policies.values()),
+            "scaling_events": {
+                k: {g: list(evs) for g, evs in v.items()}
+                for k, v in store.scaling_events.items()
+            },
         }
     if acls is not None:
         payload["acl_policies"] = list(acls.policies.values())
@@ -113,6 +118,16 @@ def install_payload(store: StateStore, acls, payload: dict) -> int:
             store.deployments[d.id] = d
             store._deployments_by_job[(d.namespace, d.job_id)].add(d.id)
         store.scheduler_config = payload["scheduler_config"]
+        store.scaling_policies.clear()
+        store._scaling_by_target.clear()
+        store.scaling_events.clear()
+        for pol in payload.get("scaling_policies", ()):
+            store.scaling_policies[pol.id] = pol
+            store._scaling_by_target[pol.target_tuple()] = pol.id
+        for key, per_group in payload.get("scaling_events", {}).items():
+            store.scaling_events[key] = {
+                g: list(evs) for g, evs in per_group.items()
+            }
         store._index = payload["index"]
         store._table_index.clear()
         store._table_index.update(payload.get("table_indexes", {}))
@@ -199,6 +214,11 @@ class ServerFSM:
 
     def _apply_upsert_allocs(self, allocs):
         return self.store.upsert_allocs(allocs)
+
+    def _apply_upsert_scaling_event(self, namespace, job_id, group, event):
+        return self.store.upsert_scaling_event(
+            namespace, job_id, group, event
+        )
 
     def _apply_upsert_deployment(self, deployment):
         return self.store.upsert_deployment(deployment)

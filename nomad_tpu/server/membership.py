@@ -80,6 +80,7 @@ class Gossip:
         self.on_event = on_event
 
         self._lock = threading.RLock()
+        self._leaving = False
         self.members: Dict[str, Member] = {
             name: Member(name, addr, region, role)
         }
@@ -106,6 +107,7 @@ class Gossip:
         """Graceful departure (serf Leave): broadcast LEFT so peers
         don't mark us failed."""
         with self._lock:
+            self._leaving = True
             me = self.members[self.name]
             me.incarnation += 1
             me.status = LEFT
@@ -322,9 +324,17 @@ class Gossip:
             for name, addr, region, role, inc, status in records:
                 if name == self.name:
                     # refutation (SWIM): if the pool thinks we're gone,
-                    # outbid the rumor with a higher incarnation
+                    # outbid the rumor with a higher incarnation.  A
+                    # stale LEFT from a previous process lifetime is
+                    # refuted too (rejoin after graceful leave), but not
+                    # while we're actually leaving.
                     me = self.members[self.name]
-                    if status in (SUSPECT, DEAD) and inc >= me.incarnation:
+                    refutable = (SUSPECT, DEAD) if self._leaving else (
+                        SUSPECT,
+                        DEAD,
+                        LEFT,
+                    )
+                    if status in refutable and inc >= me.incarnation:
                         me.incarnation = inc + 1
                         me.status = ALIVE
                     continue
@@ -360,7 +370,9 @@ class Gossip:
             self._merge(payload.get("updates", ()))
             return {"ack": True, "updates": self._gossip_payload()}
         if method == "gossip_ping_req":
-            # probe the target on behalf of the requester
+            # probe the target on behalf of the requester; the
+            # requester piggybacks rumors exactly like a direct ping
+            self._merge(payload.get("updates", ()))
             ok = self._ping(payload["target"])
             return {"ack": ok, "updates": self._gossip_payload()}
         if method == "gossip_join":

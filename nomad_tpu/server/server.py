@@ -264,6 +264,65 @@ class Server:
         self.on_eval_update(ev)
         return ev
 
+    def scale_job(
+        self,
+        namespace: str,
+        job_id: str,
+        group: str,
+        count=None,
+        message: str = "",
+        error: bool = False,
+        meta=None,
+        policy_override: bool = False,
+    ):
+        """Scale one task group's count and record a scaling event
+        (reference nomad/job_endpoint.go Job.Scale).  ``count=None``
+        records the event without changing the job — the autoscaler's
+        status-report path."""
+        import copy
+
+        from ..structs import ScalingEvent
+
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job {job_id!r} not found")
+        # never mutate the store-resident object: it is also the
+        # newest entry in the version history
+        job = copy.deepcopy(job)
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise ValueError(f"unknown task group {group!r}")
+        ev = None
+        previous = tg.count
+        if count is not None:
+            count = int(count)
+            pol = self.store.scaling_policy_by_target(
+                namespace, job_id, group
+            )
+            if pol is not None and not policy_override:
+                if count < pol.min:
+                    raise ValueError(
+                        f"group count {count} below scaling policy "
+                        f"minimum {pol.min}"
+                    )
+                if pol.max and count > pol.max:
+                    raise ValueError(
+                        f"group count {count} above scaling policy "
+                        f"maximum {pol.max}"
+                    )
+            tg.count = count
+            ev = self.register_job(job)
+        event = ScalingEvent(
+            count=count,
+            previous_count=previous,
+            message=message,
+            error=error,
+            eval_id=ev.id if ev else None,
+            meta=dict(meta or {}),
+        )
+        self.store.upsert_scaling_event(namespace, job_id, group, event)
+        return ev, event
+
     def _validate_job(self, job: Job) -> None:
         if not job.id:
             raise ValueError("missing job ID")

@@ -41,6 +41,9 @@ from ..structs import (
     Node,
     Plan,
     PlanResult,
+    JOB_TRACKED_SCALING_EVENTS,
+    ScalingEvent,
+    ScalingPolicy,
     SchedulerConfiguration,
     compute_node_class,
 )
@@ -60,6 +63,14 @@ class StateStore:
         self.evals: Dict[str, Evaluation] = {}
         self.deployments: Dict[str, Deployment] = {}
         self.scheduler_config = SchedulerConfiguration()
+
+        # autoscaling (reference state tables scaling_policy /
+        # scaling_event, nomad/state/schema.go:795,847)
+        self.scaling_policies: Dict[str, "ScalingPolicy"] = {}
+        self._scaling_by_target: Dict[Tuple[str, str, str], str] = {}
+        self.scaling_events: Dict[
+            Tuple[str, str], Dict[str, List["ScalingEvent"]]
+        ] = defaultdict(dict)
 
         # secondary indexes
         self._allocs_by_node: Dict[str, set] = defaultdict(set)
@@ -217,6 +228,7 @@ class StateStore:
             versions = self.job_versions[key]
             versions.insert(0, job)
             del versions[keep_versions:]
+            self._sync_scaling_policies(job)
             return self._bump("jobs")
 
     def delete_job(self, namespace: str, job_id: str) -> int:
@@ -224,6 +236,8 @@ class StateStore:
             key = (namespace, job_id)
             self.jobs.pop(key, None)
             self.job_versions.pop(key, None)
+            self._drop_scaling_policies(namespace, job_id)
+            self.scaling_events.pop(key, None)
             return self._bump("jobs")
 
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
@@ -239,6 +253,94 @@ class StateStore:
 
     def iter_jobs(self) -> Iterable[Job]:
         return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # scaling policies + events (reference state_store.go
+    # UpsertScalingPolicies / UpsertScalingEvent; policies live/die with
+    # their job, nomad/state/state_store.go job upsert path)
+    # ------------------------------------------------------------------
+
+    def _sync_scaling_policies(self, job: Job) -> None:
+        """Derive scaling policies from the job's task-group scaling
+        stanzas.  Policy ids are stable across job versions: an update
+        to a group keeps the policy id keyed by (ns, job, group)."""
+        live_targets = set()
+        for tg in job.task_groups:
+            pol = getattr(tg, "scaling", None)
+            if pol is None:
+                continue
+            pol.canonicalize_for(job, tg.name)
+            target = pol.target_tuple()
+            live_targets.add(target)
+            existing_id = self._scaling_by_target.get(target)
+            if existing_id is not None:
+                pol.id = existing_id
+                pol.create_index = self.scaling_policies[
+                    existing_id
+                ].create_index
+            else:
+                pol.create_index = self._index + 1
+            pol.modify_index = self._index + 1
+            self.scaling_policies[pol.id] = pol
+            self._scaling_by_target[target] = pol.id
+        # drop policies for groups removed from the job
+        for target, pid in list(self._scaling_by_target.items()):
+            ns, jid, _group = target
+            if (ns, jid) == (job.namespace, job.id) and (
+                target not in live_targets
+            ):
+                del self._scaling_by_target[target]
+                self.scaling_policies.pop(pid, None)
+
+    def _drop_scaling_policies(self, namespace: str, job_id: str) -> None:
+        for target, pid in list(self._scaling_by_target.items()):
+            if (target[0], target[1]) == (namespace, job_id):
+                del self._scaling_by_target[target]
+                self.scaling_policies.pop(pid, None)
+
+    def scaling_policy_by_id(self, policy_id: str) -> Optional[ScalingPolicy]:
+        return self.scaling_policies.get(policy_id)
+
+    def scaling_policy_by_target(
+        self, namespace: str, job_id: str, group: str
+    ) -> Optional[ScalingPolicy]:
+        pid = self._scaling_by_target.get((namespace, job_id, group))
+        return self.scaling_policies.get(pid) if pid else None
+
+    def iter_scaling_policies(
+        self, namespace: Optional[str] = None, job_id: Optional[str] = None
+    ) -> List[ScalingPolicy]:
+        out = []
+        for pol in self.scaling_policies.values():
+            ns, jid, _ = pol.target_tuple()
+            if namespace is not None and ns != namespace:
+                continue
+            if job_id is not None and jid != job_id:
+                continue
+            out.append(pol)
+        return out
+
+    def upsert_scaling_event(
+        self, namespace: str, job_id: str, group: str, event: ScalingEvent
+    ) -> int:
+        with self._lock:
+            event.create_index = self._index + 1
+            events = self.scaling_events[(namespace, job_id)].setdefault(
+                group, []
+            )
+            events.insert(0, event)
+            del events[JOB_TRACKED_SCALING_EVENTS:]
+            return self._bump("scaling_event")
+
+    def scaling_events_for_job(
+        self, namespace: str, job_id: str
+    ) -> Dict[str, List[ScalingEvent]]:
+        return {
+            g: list(evs)
+            for g, evs in self.scaling_events.get(
+                (namespace, job_id), {}
+            ).items()
+        }
 
     # ------------------------------------------------------------------
     # evals

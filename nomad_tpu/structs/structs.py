@@ -519,6 +519,61 @@ class Task:
     meta: Dict[str, str] = field(default_factory=dict)
 
 
+SCALING_POLICY_TYPE_HORIZONTAL = "horizontal"
+
+# how many scaling events are retained per task group
+# (reference structs.go JobTrackedScalingEvents)
+JOB_TRACKED_SCALING_EVENTS = 20
+
+
+@dataclass
+class ScalingPolicy:
+    """Autoscaling bounds + opaque autoscaler policy attached to a task
+    group (reference structs.go ScalingPolicy / scaling stanza;
+    state table `scaling_policy`, nomad/state/schema.go:795)."""
+
+    id: str = field(default_factory=new_id)
+    type: str = SCALING_POLICY_TYPE_HORIZONTAL
+    target: Dict[str, str] = field(default_factory=dict)
+    min: int = 1
+    max: int = 0
+    policy: Dict[str, Any] = field(default_factory=dict)
+    enabled: bool = True
+    create_index: int = 0
+    modify_index: int = 0
+
+    def target_tuple(self) -> Tuple[str, str, str]:
+        return (
+            self.target.get("Namespace", ""),
+            self.target.get("Job", ""),
+            self.target.get("Group", ""),
+        )
+
+    def canonicalize_for(self, job: "Job", group: str) -> None:
+        """Stamp the policy's target from its owning job/group
+        (reference structs.go ScalingPolicy.TargetTaskGroup)."""
+        self.target = {
+            "Namespace": job.namespace,
+            "Job": job.id,
+            "Group": group,
+        }
+
+
+@dataclass
+class ScalingEvent:
+    """One scaling action or autoscaler status report
+    (reference structs.go ScalingEvent)."""
+
+    time: float = field(default_factory=time.time)
+    count: Optional[int] = None
+    previous_count: int = 0
+    message: str = ""
+    error: bool = False
+    eval_id: Optional[str] = None
+    meta: Dict[str, Any] = field(default_factory=dict)
+    create_index: int = 0
+
+
 @dataclass
 class TaskGroup:
     """(reference structs.go TaskGroup:5495)"""
@@ -538,6 +593,7 @@ class TaskGroup:
     ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
     meta: Dict[str, str] = field(default_factory=dict)
     stop_after_client_disconnect_s: Optional[float] = None
+    scaling: Optional[ScalingPolicy] = None
 
 
 @dataclass

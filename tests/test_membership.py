@@ -99,6 +99,45 @@ def test_graceful_leave_is_not_a_failure():
             g.stop()
 
 
+def test_rejoin_after_graceful_leave():
+    """A member that left can come back with a fresh incarnation 0 and
+    refute the stale LEFT rumor (serf rejoin semantics)."""
+    transport, pool = make_pool(3)
+    reborn = None
+    try:
+        wait_until(
+            lambda: all(len(g.alive_members()) == 3 for g in pool)
+        )
+        leaver = pool[-1]
+        leaver.leave()
+        leaver.stop()
+        rest = pool[:-1]
+        wait_until(
+            lambda: all(
+                g.members[leaver.name].status == LEFT for g in rest
+            ),
+            msg="leave propagation",
+        )
+        # same name/addr, brand-new process: incarnation restarts at 0
+        reborn = Gossip(leaver.name, leaver.addr, transport)
+        transport.register(
+            reborn.addr, lambda m, p: reborn.handle(m, p)
+        )
+        reborn.start()
+        reborn.join(pool[0].addr)
+        wait_until(
+            lambda: all(
+                g.members[leaver.name].status == ALIVE for g in rest
+            ),
+            msg="rejoin refutes stale LEFT",
+        )
+    finally:
+        for g in pool[:-1]:
+            g.stop()
+        if reborn is not None:
+            reborn.stop()
+
+
 def test_refutation_revives_falsely_suspected_member():
     transport, pool = make_pool(3, suspicion_timeout=0.3)
     try:
@@ -164,6 +203,23 @@ def test_cross_region_job_submission(federation):
     assert west_leader.drain_to_idle(timeout=10.0)
     assert len(west_leader.store.allocs_by_job("default", "west-job")) == 10
     assert east_leader.store.job_by_id("default", "west-job") is None
+
+
+def test_default_region_job_resolves_to_local_region(federation):
+    """A job that never named a region (struct default "global") must
+    register in the receiving server's region, not fail with
+    'no path to region' (reference: agent resolves empty region)."""
+    east, west = federation
+    east_leader = east.wait_for_leader()
+    for _ in range(2):
+        east_leader.register_node(mock.node())
+    job = mock.job(id="regionless-job")
+    assert job.region == "global"
+    east.servers[1].register_job(job)
+    assert east_leader.drain_to_idle(timeout=10.0)
+    stored = east_leader.store.job_by_id("default", "regionless-job")
+    assert stored is not None
+    assert stored.region == "east"
 
 
 def test_regions_listing(federation):

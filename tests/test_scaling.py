@@ -1,0 +1,239 @@
+"""Scaling policy + scaling event tests (reference model:
+nomad/job_endpoint.go Job.Scale / ScaleStatus,
+nomad/state/state_store.go scaling_policy tables,
+command/scaling_policy_list.go).
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import jobspec, mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.server import Server
+from nomad_tpu.server.fsm import install_payload, state_payload
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import ScalingPolicy
+
+
+def make_scaled_job(min_=1, max_=5, count=2):
+    j = mock.job()
+    j.task_groups[0].scaling = ScalingPolicy(
+        min=min_, max=max_, policy={"cooldown": "1m"}
+    )
+    j.task_groups[0].count = count
+    return j
+
+
+@pytest.fixture
+def srv():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=7)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_policy_derived_on_register(srv):
+    j = make_scaled_job()
+    srv.register_job(j)
+    pols = srv.store.iter_scaling_policies()
+    assert len(pols) == 1
+    p = pols[0]
+    assert p.target == {
+        "Namespace": j.namespace,
+        "Job": j.id,
+        "Group": j.task_groups[0].name,
+    }
+    assert p.min == 1 and p.max == 5
+    assert srv.store.scaling_policy_by_id(p.id) is p
+    assert (
+        srv.store.scaling_policy_by_target(
+            j.namespace, j.id, j.task_groups[0].name
+        )
+        is p
+    )
+
+
+def test_policy_id_stable_across_job_updates(srv):
+    j = make_scaled_job()
+    srv.register_job(j)
+    pid = srv.store.iter_scaling_policies()[0].id
+    j2 = make_scaled_job(max_=10)
+    j2.id = j.id
+    srv.register_job(j2)
+    pols = srv.store.iter_scaling_policies()
+    assert len(pols) == 1
+    assert pols[0].id == pid
+    assert pols[0].max == 10
+
+
+def test_policy_dies_with_job(srv):
+    j = make_scaled_job()
+    srv.register_job(j)
+    assert srv.store.iter_scaling_policies()
+    srv.deregister_job(j.namespace, j.id, purge=True)
+    assert not srv.store.iter_scaling_policies()
+
+
+def test_scale_within_bounds_creates_eval_and_event(srv):
+    j = make_scaled_job()
+    srv.register_job(j)
+    group = j.task_groups[0].name
+    ev, event = srv.scale_job(
+        j.namespace, j.id, group, count=4, message="scale up"
+    )
+    assert ev is not None
+    assert event.count == 4 and event.previous_count == 2
+    assert event.eval_id == ev.id
+    job = srv.store.job_by_id(j.namespace, j.id)
+    assert job.lookup_task_group(group).count == 4
+    events = srv.store.scaling_events_for_job(j.namespace, j.id)
+    assert [e.count for e in events[group]] == [4]
+
+
+def test_scale_outside_bounds_rejected(srv):
+    j = make_scaled_job(min_=2, max_=3)
+    srv.register_job(j)
+    group = j.task_groups[0].name
+    with pytest.raises(ValueError):
+        srv.scale_job(j.namespace, j.id, group, count=9)
+    with pytest.raises(ValueError):
+        srv.scale_job(j.namespace, j.id, group, count=1)
+    # policy override bypasses bounds (reference PolicyOverride)
+    ev, _ = srv.scale_job(
+        j.namespace, j.id, group, count=9, policy_override=True
+    )
+    assert ev is not None
+
+
+def test_scale_event_only_when_count_none(srv):
+    j = make_scaled_job()
+    srv.register_job(j)
+    group = j.task_groups[0].name
+    before = srv.store.job_by_id(j.namespace, j.id).modify_index
+    ev, event = srv.scale_job(
+        j.namespace, j.id, group, message="autoscaler: at target",
+    )
+    assert ev is None and event.count is None
+    # the job itself is untouched
+    assert srv.store.job_by_id(j.namespace, j.id).modify_index == before
+    events = srv.store.scaling_events_for_job(j.namespace, j.id)
+    assert events[group][0].message == "autoscaler: at target"
+
+
+def test_event_retention_cap(srv):
+    from nomad_tpu.structs import JOB_TRACKED_SCALING_EVENTS, ScalingEvent
+
+    j = make_scaled_job()
+    srv.register_job(j)
+    group = j.task_groups[0].name
+    for i in range(JOB_TRACKED_SCALING_EVENTS + 5):
+        srv.store.upsert_scaling_event(
+            j.namespace, j.id, group, ScalingEvent(message=f"e{i}")
+        )
+    events = srv.store.scaling_events_for_job(j.namespace, j.id)[group]
+    assert len(events) == JOB_TRACKED_SCALING_EVENTS
+    # newest first
+    assert events[0].message == f"e{JOB_TRACKED_SCALING_EVENTS + 4}"
+
+
+def test_scaling_survives_snapshot_roundtrip(srv):
+    j = make_scaled_job()
+    srv.register_job(j)
+    group = j.task_groups[0].name
+    srv.scale_job(j.namespace, j.id, group, count=3, message="up")
+    payload = state_payload(srv.store, None)
+    fresh = StateStore()
+    install_payload(fresh, None, payload)
+    pols = fresh.iter_scaling_policies()
+    assert len(pols) == 1 and pols[0].max == 5
+    assert fresh.scaling_policy_by_target(j.namespace, j.id, group)
+    events = fresh.scaling_events_for_job(j.namespace, j.id)
+    assert events[group][0].count == 3
+
+
+HCL_SCALED = """
+job "horizontal" {
+  group "web" {
+    count = 2
+    scaling {
+      enabled = true
+      min = 1
+      max = 8
+      policy {
+        cooldown = "2m"
+      }
+    }
+    task "t" {
+      driver = "mock_driver"
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+"""
+
+
+def test_jobspec_scaling_block():
+    job = jobspec.parse(HCL_SCALED)
+    sc = job.task_groups[0].scaling
+    assert sc is not None
+    assert sc.min == 1 and sc.max == 8 and sc.enabled
+    assert sc.policy.get("cooldown") == "2m"
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _post(base, path, body, method="POST"):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def api():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=33)
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    yield server, base
+    http.stop()
+    server.stop()
+
+
+def test_scaling_http_surface(api):
+    server, base = api
+    j = make_scaled_job()
+    server.register_job(j)
+    group = j.task_groups[0].name
+
+    pols = _get(base, "/v1/scaling/policies")
+    assert len(pols) == 1
+    assert pols[0]["Target"]["Group"] == group
+    assert "Policy" not in pols[0]  # list returns stubs
+
+    pol = _get(base, f"/v1/scaling/policy/{pols[0]['ID']}")
+    assert pol["Policy"] == {"cooldown": "1m"}
+    assert pol["Min"] == 1 and pol["Max"] == 5
+
+    resp = _post(
+        base,
+        f"/v1/job/{j.id}/scale",
+        {"Target": {"Group": group}, "Count": 3, "Message": "via api"},
+    )
+    assert resp["EvalID"]
+
+    status = _get(base, f"/v1/job/{j.id}/scale")
+    assert status["JobID"] == j.id
+    tg = status["TaskGroups"][group]
+    assert tg["Desired"] == 3
+    assert tg["Events"][0]["Count"] == 3
+    assert tg["Events"][0]["Message"] == "via api"
