@@ -38,6 +38,8 @@ NAMESPACE_CAPABILITIES = {
         "read-job-scaling",
         "list-scaling-policies",
         "read-scaling-policy",
+        "csi-list-volume",
+        "csi-read-volume",
     },
     "write": {
         "list-jobs",
@@ -52,6 +54,10 @@ NAMESPACE_CAPABILITIES = {
         "read-job-scaling",
         "list-scaling-policies",
         "read-scaling-policy",
+        "csi-list-volume",
+        "csi-read-volume",
+        "csi-write-volume",
+        "csi-mount-volume",
     },
 }
 

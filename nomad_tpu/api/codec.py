@@ -70,6 +70,75 @@ def deployment_to_dict(d: Deployment) -> Dict:
     return _clean(d)
 
 
+def csi_volume_to_dict(v) -> Dict:
+    return {
+        "ID": v.id,
+        "Namespace": v.namespace,
+        "Name": v.name,
+        "ExternalID": v.external_id,
+        "PluginID": v.plugin_id,
+        "AccessMode": v.access_mode,
+        "AttachmentMode": v.attachment_mode,
+        "Schedulable": v.schedulable,
+        "ReadAllocs": dict(v.read_claims),
+        "WriteAllocs": dict(v.write_claims),
+        "Parameters": dict(v.parameters),
+        "Context": dict(v.context),
+        "CreateIndex": v.create_index,
+        "ModifyIndex": v.modify_index,
+    }
+
+
+def csi_volume_stub(v) -> Dict:
+    return {
+        "ID": v.id,
+        "Namespace": v.namespace,
+        "Name": v.name,
+        "PluginID": v.plugin_id,
+        "AccessMode": v.access_mode,
+        "AttachmentMode": v.attachment_mode,
+        "Schedulable": v.schedulable,
+        "CurrentReaders": len(v.read_claims),
+        "CurrentWriters": len(v.write_claims),
+    }
+
+
+def csi_volume_from_dict(raw: Dict):
+    from ..structs import CSIVolume
+
+    return CSIVolume(
+        id=_get(raw, "id", "ID", default=""),
+        # empty so callers can fall back to the request namespace
+        namespace=_get(raw, "namespace", "Namespace", default=""),
+        name=_get(raw, "name", "Name", default=""),
+        external_id=_get(raw, "external_id", "ExternalID", default=""),
+        plugin_id=_get(raw, "plugin_id", "PluginID", default=""),
+        access_mode=_get(
+            raw, "access_mode", "AccessMode",
+            default="single-node-writer",
+        ),
+        attachment_mode=_get(
+            raw, "attachment_mode", "AttachmentMode",
+            default="file-system",
+        ),
+        schedulable=bool(
+            _get(raw, "schedulable", "Schedulable", default=True)
+        ),
+        secrets=_get(raw, "secrets", "Secrets", default={}) or {},
+        parameters=_get(raw, "parameters", "Parameters", default={}) or {},
+        context=_get(raw, "context", "Context", default={}) or {},
+    )
+
+
+def csi_plugin_to_dict(p) -> Dict:
+    return {
+        "ID": p.id,
+        "NodesHealthy": p.nodes_healthy,
+        "NodesExpected": p.nodes_expected,
+        "NodeIDs": list(p.node_ids),
+    }
+
+
 def scaling_policy_to_dict(p) -> Dict:
     return {
         "ID": p.id,

@@ -28,6 +28,10 @@ from .codec import (
     job_from_dict,
     job_to_dict,
     node_to_dict,
+    csi_plugin_to_dict,
+    csi_volume_from_dict,
+    csi_volume_stub,
+    csi_volume_to_dict,
     scaling_event_to_dict,
     scaling_policy_stub,
     scaling_policy_to_dict,
@@ -489,6 +493,73 @@ class APIHandler(BaseHTTPRequestHandler):
                 m.group(1), bool(body.get("Pause", True))
             )
             self._respond({})
+            return True
+
+        # -- CSI volumes (reference command/agent/csi_endpoint.go) -----
+
+        if path == "/v1/volumes" and method == "GET":
+            self._check_acl("csi-list-volume", ns)
+            vols = store.iter_csi_volumes(namespace=ns)
+            self._respond([csi_volume_stub(v) for v in vols])
+            return True
+
+        m = re.fullmatch(r"/v1/volume/csi/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("csi-read-volume", ns)
+            vol = store.csi_volume_by_id(ns, m.group(1))
+            if vol is None:
+                raise HTTPError(404, "volume not found")
+            self._respond(csi_volume_to_dict(vol))
+            return True
+
+        if m and method in ("POST", "PUT"):
+            self._check_acl("csi-write-volume", ns)
+            body = self._body()
+            batch = body.get("Volumes")
+            for raw in batch or [body]:
+                vol = csi_volume_from_dict(raw)
+                if not vol.id:
+                    if batch:
+                        # the path id can only name ONE volume
+                        raise HTTPError(
+                            400, "volumes in a batch require an ID"
+                        )
+                    vol.id = m.group(1)
+                if not vol.plugin_id:
+                    raise HTTPError(400, "volume requires PluginID")
+                vol.namespace = vol.namespace or ns
+                store.upsert_csi_volume(vol)
+            self._respond({})
+            return True
+
+        if m and method == "DELETE":
+            self._check_acl("csi-write-volume", ns)
+            try:
+                store.deregister_csi_volume(
+                    ns, m.group(1), force=q.get("force") == "true"
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond({})
+            return True
+
+        if path == "/v1/plugins" and method == "GET":
+            self._check_acl("csi-list-volume", ns)
+            self._respond(
+                [
+                    csi_plugin_to_dict(p)
+                    for p in store.csi_plugins().values()
+                ]
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/plugin/csi/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("csi-read-volume", ns)
+            p = store.csi_plugins().get(m.group(1))
+            if p is None:
+                raise HTTPError(404, "plugin not found")
+            self._respond(csi_plugin_to_dict(p))
             return True
 
         if path == "/v1/operator/scheduler/configuration":

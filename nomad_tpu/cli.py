@@ -76,6 +76,12 @@ def _table(rows, headers) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _dev_csi_plugin():
+    from .client.csi import FakeCSIPlugin
+
+    return FakeCSIPlugin()
+
+
 def cmd_agent(args) -> None:
     from .api.http import start_http_server
     from .client import Client
@@ -119,6 +125,12 @@ def cmd_agent(args) -> None:
             drivers=cfg.client.drivers,
             heartbeat_interval=cfg.client.heartbeat_interval_s,
             include_tpu_fingerprint=cfg.client.include_tpu_fingerprint,
+            # dev mode ships an in-process CSI plugin so the volume
+            # flow is drivable out of the box (reference -dev ships
+            # the mock driver for the same reason)
+            csi_plugins=(
+                {"csi-dev": _dev_csi_plugin()} if args.dev else None
+            ),
         )
         client.start()
         clients.append(client)
@@ -259,6 +271,61 @@ def cmd_job_scale(args) -> None:
         {"Target": {"Group": args.group}, "Count": args.count},
     )
     print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_volume_register(args) -> None:
+    """(reference command/volume_register.go; accepts a JSON volume
+    spec file)"""
+    with open(args.file) as fh:
+        spec = json.load(fh)
+    vol_id = spec.get("ID") or spec.get("id")
+    if not vol_id:
+        print("error: volume spec requires an ID", file=sys.stderr)
+        raise SystemExit(1)
+    resp = _request("POST", f"/v1/volume/csi/{vol_id}", spec)
+    print(f"==> Volume {vol_id} registered")
+
+
+def cmd_volume_status(args) -> None:
+    """(reference command/volume_status.go)"""
+    if getattr(args, "volume_id", None):
+        v = _request("GET", f"/v1/volume/csi/{args.volume_id}")
+        print(json.dumps(v, indent=2))
+        return
+    vols = _request("GET", "/v1/volumes")
+    _table(
+        [
+            (
+                v["ID"],
+                v["Name"],
+                v["PluginID"],
+                v["Schedulable"],
+                v["AccessMode"],
+                f"{v['CurrentReaders']}r/{v['CurrentWriters']}w",
+            )
+            for v in vols
+        ],
+        ("ID", "Name", "Plugin", "Schedulable", "Access", "Claims"),
+    )
+
+
+def cmd_volume_deregister(args) -> None:
+    """(reference command/volume_deregister.go)"""
+    force = "?force=true" if args.force else ""
+    _request("DELETE", f"/v1/volume/csi/{args.volume_id}{force}")
+    print(f"==> Volume {args.volume_id} deregistered")
+
+
+def cmd_plugin_status(args) -> None:
+    """(reference command/plugin_status.go)"""
+    plugins = _request("GET", "/v1/plugins")
+    _table(
+        [
+            (p["ID"], f"{p['NodesHealthy']}/{p['NodesExpected']}")
+            for p in plugins
+        ],
+        ("ID", "Nodes Healthy"),
+    )
 
 
 def cmd_scaling_policies(args) -> None:
@@ -496,6 +563,24 @@ def build_parser() -> argparse.ArgumentParser:
     jsc.add_argument("group")
     jsc.add_argument("count", type=int)
     jsc.set_defaults(fn=cmd_job_scale)
+
+    volume = sub.add_parser("volume")
+    volume_sub = volume.add_subparsers(dest="volume_cmd", required=True)
+    vr = volume_sub.add_parser("register")
+    vr.add_argument("file")
+    vr.set_defaults(fn=cmd_volume_register)
+    vs = volume_sub.add_parser("status")
+    vs.add_argument("volume_id", nargs="?", default=None)
+    vs.set_defaults(fn=cmd_volume_status)
+    vd = volume_sub.add_parser("deregister")
+    vd.add_argument("volume_id")
+    vd.add_argument("-force", dest="force", action="store_true")
+    vd.set_defaults(fn=cmd_volume_deregister)
+
+    plugin = sub.add_parser("plugin")
+    plugin_sub = plugin.add_subparsers(dest="plugin_cmd", required=True)
+    ps = plugin_sub.add_parser("status")
+    ps.set_defaults(fn=cmd_plugin_status)
 
     scaling = sub.add_parser("scaling")
     scaling_sub = scaling.add_subparsers(dest="scaling_cmd", required=True)

@@ -28,9 +28,13 @@ class AllocRunner:
         drivers: Optional[Dict[str, object]] = None,
         secrets=None,
         catalog=None,
+        csi_manager=None,
+        csi_resolver=None,
     ) -> None:
         self.secrets = secrets
         self.catalog = catalog
+        self.csi_manager = csi_manager
+        self.csi_resolver = csi_resolver
         self.alloc = alloc
         self.on_update = on_update
         self._lock = threading.Lock()
@@ -81,13 +85,62 @@ class AllocRunner:
 
     def run(self) -> None:
         self.alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+        if not self._csi_mount():
+            return
         for tr in self.task_runners.values():
             tr.start()
+
+    def _csi_mount(self) -> bool:
+        """Stage+publish requested CSI volumes before any task starts
+        (reference client/allocrunner/csi_hook.go).  A mount failure
+        fails the whole alloc, which triggers rescheduling."""
+        if self.csi_manager is None:
+            return True
+        from .csi import CSIPluginError
+
+        for req in self.tg.volumes.values():
+            if req.type != "csi":
+                continue
+            vol = None
+            if self.csi_resolver is not None:
+                vol = self.csi_resolver(self.alloc.namespace, req.source)
+            try:
+                if vol is None:
+                    raise CSIPluginError(
+                        f"unknown CSI volume {req.source!r}"
+                    )
+                self.csi_manager.mount_volume(
+                    vol.plugin_id,
+                    vol.id,
+                    self.alloc.id,
+                    req.read_only,
+                    access_mode=vol.access_mode,
+                    attachment_mode=vol.attachment_mode,
+                )
+            except CSIPluginError:
+                self.csi_manager.unmount_all(self.alloc.id)
+                with self._lock:
+                    self.alloc.client_status = (
+                        ALLOC_CLIENT_STATUS_FAILED
+                    )
+                if self.on_update:
+                    self.on_update(self.alloc)
+                return False
+        return True
 
     def _on_task_state(self, task_name: str, state: TaskState) -> None:
         with self._lock:
             self.alloc.task_states[task_name] = state
             self._sync_client_status()
+            all_dead = all(
+                tr.state.state == TASK_STATE_DEAD
+                for tr in self.task_runners.values()
+            )
+        # unmount only once every task is down (a failed sibling must
+        # not rip the volume out from under still-running tasks), and
+        # outside the lock — plugin RPCs can be slow
+        if all_dead and self.csi_manager is not None:
+            self.csi_manager.unmount_all(self.alloc.id)
         if self.on_update is not None:
             self.on_update(self.alloc)
 
@@ -122,6 +175,8 @@ class AllocRunner:
             self._destroyed = True
         for tr in self.task_runners.values():
             tr.kill()
+        if self.csi_manager is not None:
+            self.csi_manager.unmount_all(self.alloc.id)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         ok = True

@@ -40,6 +40,7 @@ class Client:
         fingerprint: bool = True,
         include_tpu_fingerprint: bool = False,
         secrets=None,
+        csi_plugins=None,
     ) -> None:
         self.secrets = secrets
         self.server = server
@@ -56,6 +57,13 @@ class Client:
                 self.node, include_tpu=include_tpu_fingerprint
             )
         fingerprint_drivers(self.node, self.drivers)
+        from .csi import CSIManager
+
+        self.csi_manager = CSIManager(
+            data_dir=os.path.join(data_dir, "csi") if data_dir else "",
+            plugins=csi_plugins,
+        )
+        self.csi_manager.fingerprint_node(self.node)
 
         self.alloc_runners: Dict[str, AllocRunner] = {}
         self._known_alloc_index: Dict[str, int] = {}
@@ -143,6 +151,10 @@ class Client:
                     drivers=self.drivers,
                     secrets=self.secrets,
                     catalog=getattr(self.server, "catalog", None),
+                    csi_manager=self.csi_manager,
+                    csi_resolver=lambda ns, vid: (
+                        self.server.store.csi_volume_by_id(ns, vid)
+                    ),
                 )
                 self.alloc_runners[alloc_id] = runner
                 runner.run()

@@ -1,0 +1,206 @@
+"""Client-side CSI: plugin clients and the volume mount manager.
+
+Plays the role of the reference's CSI client stack:
+`plugins/csi/` (the gRPC client talking to external CSI plugins, with
+`plugins/csi/fake` for tests) and `client/pluginmanager/csimanager/`
+(per-volume stage/publish orchestration + node fingerprinting).  The
+plugin protocol here is an in-process interface rather than gRPC — the
+seam is identical (probe / stage / publish / unpublish / unstage), so a
+process-boundary client can slot in behind it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class CSIPluginError(Exception):
+    pass
+
+
+class CSIPluginClient:
+    """The node-plugin RPC surface (reference plugins/csi/client.go:
+    NodeStageVolume/NodePublishVolume/... over gRPC)."""
+
+    def probe(self) -> bool:
+        raise NotImplementedError
+
+    def node_stage_volume(
+        self, volume_id: str, staging_path: str,
+        access_mode: str, attachment_mode: str,
+    ) -> None:
+        raise NotImplementedError
+
+    def node_publish_volume(
+        self, volume_id: str, staging_path: str, target_path: str,
+        read_only: bool,
+    ) -> None:
+        raise NotImplementedError
+
+    def node_unpublish_volume(
+        self, volume_id: str, target_path: str
+    ) -> None:
+        raise NotImplementedError
+
+    def node_unstage_volume(
+        self, volume_id: str, staging_path: str
+    ) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class FakeCSIPlugin(CSIPluginClient):
+    """Scriptable plugin for tests (reference plugins/csi/fake):
+    records every call and can inject failures per operation."""
+
+    healthy: bool = True
+    fail_stage: bool = False
+    fail_publish: bool = False
+    calls: List[Tuple[str, str]] = field(default_factory=list)
+    staged: Dict[str, str] = field(default_factory=dict)
+    published: Dict[str, str] = field(default_factory=dict)
+
+    def probe(self) -> bool:
+        self.calls.append(("probe", ""))
+        return self.healthy
+
+    def node_stage_volume(
+        self, volume_id, staging_path, access_mode, attachment_mode
+    ) -> None:
+        self.calls.append(("stage", volume_id))
+        if self.fail_stage:
+            raise CSIPluginError(f"stage failed for {volume_id}")
+        self.staged[volume_id] = staging_path
+
+    def node_publish_volume(
+        self, volume_id, staging_path, target_path, read_only
+    ) -> None:
+        self.calls.append(("publish", volume_id))
+        if self.fail_publish:
+            raise CSIPluginError(f"publish failed for {volume_id}")
+        self.published[volume_id] = target_path
+
+    def node_unpublish_volume(self, volume_id, target_path) -> None:
+        self.calls.append(("unpublish", volume_id))
+        self.published.pop(volume_id, None)
+
+    def node_unstage_volume(self, volume_id, staging_path) -> None:
+        self.calls.append(("unstage", volume_id))
+        self.staged.pop(volume_id, None)
+
+
+@dataclass
+class MountInfo:
+    volume_id: str
+    plugin_id: str
+    staging_path: str
+    target_path: str
+
+
+class CSIManager:
+    """Stages/publishes CSI volumes for allocations and fingerprints
+    plugin health onto the node (reference
+    client/pluginmanager/csimanager/volume.go MountVolume)."""
+
+    def __init__(
+        self,
+        data_dir: str = "",
+        plugins: Optional[Dict[str, CSIPluginClient]] = None,
+    ) -> None:
+        self.data_dir = data_dir or "/tmp/nomad-tpu-csi"
+        self.plugins: Dict[str, CSIPluginClient] = dict(plugins or {})
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # (alloc_id, volume_id) -> MountInfo
+        self._mounts: Dict[Tuple[str, str], MountInfo] = {}
+        # keys with a stage/publish in flight — plugin RPCs can be
+        # slow, so they run outside the lock
+        self._inflight: set = set()
+
+    def fingerprint_node(self, node) -> None:
+        """Publish plugin health into Node.csi_node_plugins (reference
+        client/pluginmanager/csimanager/fingerprint.go)."""
+        for pid, plugin in self.plugins.items():
+            try:
+                node.csi_node_plugins[pid] = bool(plugin.probe())
+            except Exception:  # noqa: BLE001 — unhealthy on error
+                node.csi_node_plugins[pid] = False
+
+    def mount_volume(
+        self,
+        plugin_id: str,
+        volume_id: str,
+        alloc_id: str,
+        read_only: bool,
+        access_mode: str = "single-node-writer",
+        attachment_mode: str = "file-system",
+    ) -> MountInfo:
+        plugin = self.plugins.get(plugin_id)
+        if plugin is None:
+            raise CSIPluginError(f"no CSI plugin {plugin_id!r} on node")
+        staging = os.path.join(
+            self.data_dir, "staging", plugin_id, volume_id
+        )
+        target = os.path.join(
+            self.data_dir, "per-alloc", alloc_id, volume_id
+        )
+        key = (alloc_id, volume_id)
+        with self._cond:
+            while key in self._inflight:
+                self._cond.wait()
+            existing = self._mounts.get(key)
+            if existing is not None:
+                return existing
+            self._inflight.add(key)
+        try:
+            plugin.node_stage_volume(
+                volume_id, staging, access_mode, attachment_mode
+            )
+            plugin.node_publish_volume(
+                volume_id, staging, target, read_only
+            )
+            info = MountInfo(volume_id, plugin_id, staging, target)
+            with self._cond:
+                self._mounts[key] = info
+            return info
+        finally:
+            with self._cond:
+                self._inflight.discard(key)
+                self._cond.notify_all()
+
+    def unmount_volume(self, volume_id: str, alloc_id: str) -> None:
+        key = (alloc_id, volume_id)
+        with self._cond:
+            while key in self._inflight:
+                self._cond.wait()
+            info = self._mounts.pop(key, None)
+            if info is None:
+                return
+            # decide about unstage while the table is consistent
+            last_user = not any(
+                vid == volume_id for (_a, vid) in self._mounts
+            )
+        plugin = self.plugins.get(info.plugin_id)
+        if plugin is None:
+            return
+        try:
+            plugin.node_unpublish_volume(volume_id, info.target_path)
+        finally:
+            if last_user:
+                plugin.node_unstage_volume(volume_id, info.staging_path)
+
+    def unmount_all(self, alloc_id: str) -> None:
+        with self._cond:
+            vols = [v for (a, v) in self._mounts if a == alloc_id]
+        for v in vols:
+            self.unmount_volume(v, alloc_id)
+
+    def mounts_for_alloc(self, alloc_id: str) -> List[MountInfo]:
+        with self._lock:
+            return [
+                info
+                for (a, _v), info in self._mounts.items()
+                if a == alloc_id
+            ]

@@ -66,6 +66,21 @@ def node(**overrides) -> Node:
     return n
 
 
+def csi_volume(plugin_id: str = "ebs0", **overrides):
+    """(reference mock.go CSIVolume)"""
+    from .structs import CSIVolume
+
+    i = next(_counter)
+    v = CSIVolume(
+        id=f"vol-{i}",
+        name=f"vol-{i}",
+        plugin_id=plugin_id,
+    )
+    for key, value in overrides.items():
+        setattr(v, key, value)
+    return v
+
+
 def nvidia_node(**overrides) -> Node:
     """(reference mock.go:114 NvidiaNode)"""
     n = node(**overrides)

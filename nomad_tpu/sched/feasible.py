@@ -235,22 +235,34 @@ class HostVolumeChecker:
 
 
 class CSIVolumeChecker:
-    """Simplified CSI feasibility: the node must run a healthy instance of
-    the plugin backing each requested CSI volume
-    (reference feasible.go:194)."""
+    """CSI feasibility (reference feasible.go:194 CSIVolumeChecker):
+    each requested volume must be registered, schedulable, have claim
+    capacity for the requested access, and the node must run a healthy
+    instance of the plugin backing it."""
 
     def __init__(self, ctx: EvalContext) -> None:
         self.ctx = ctx
-        self.plugin_ids: List[str] = []
+        self.namespace = "default"
+        self.requests: List[VolumeRequest] = []
+
+    def set_namespace(self, namespace: str) -> None:
+        self.namespace = namespace
 
     def set_volumes(self, volumes: Dict[str, VolumeRequest]) -> None:
-        self.plugin_ids = [
-            req.source for req in volumes.values() if req.type == "csi"
+        self.requests = [
+            req for req in volumes.values() if req.type == "csi"
         ]
 
     def feasible(self, option: Node) -> bool:
-        for plugin_id in self.plugin_ids:
-            if not option.csi_node_plugins.get(plugin_id, False):
+        for req in self.requests:
+            vol = self.ctx.state.csi_volume_by_id(
+                self.namespace, req.source
+            )
+            if (
+                vol is None
+                or not vol.claimable(req.read_only)
+                or not option.csi_node_plugins.get(vol.plugin_id, False)
+            ):
                 self.ctx.metrics.filter_node(
                     option, FILTER_CONSTRAINT_CSI_VOLUMES
                 )

@@ -149,6 +149,7 @@ class GenericStack:
             return
         self.job_version = job.version
         self.job_constraint.set_constraints(job.constraints)
+        self.task_group_csi_volumes.set_namespace(job.namespace)
         self.distinct_hosts_constraint.set_job(job)
         self.distinct_property_constraint.set_job(job)
         self.bin_pack.set_job(job)
@@ -256,6 +257,7 @@ class SystemStack:
 
     def set_job(self, job: Job) -> None:
         self.job_constraint.set_constraints(job.constraints)
+        self.task_group_csi_volumes.set_namespace(job.namespace)
         self.distinct_property_constraint.set_job(job)
         self.bin_pack.set_job(job)
         self.ctx.eligibility.set_job(job)

@@ -236,6 +236,9 @@ class TPUGenericStack:
         )
 
         mask = candidate_mask & static_mask & self.table.active
+        csi_mask = self._csi_feasibility(tg)
+        if csi_mask is not None:
+            mask &= csi_mask
         if self._extra_excluded_rows:
             mask[list(self._extra_excluded_rows)] = False
 
@@ -365,6 +368,26 @@ class TPUGenericStack:
 
     # ------------------------------------------------------------------
 
+    def _csi_feasibility(self, tg: TaskGroup) -> Optional[np.ndarray]:
+        """Dynamic CSI mask (reference feasible.go:194): resolve each
+        requested volume to its plugin column; a missing/unclaimable
+        volume rules out every node.  Not cached — claims move with
+        every plan apply."""
+        reqs = [r for r in tg.volumes.values() if r.type == "csi"]
+        if not reqs:
+            return None
+        out = np.ones(self.table.capacity, dtype=bool)
+        for req in reqs:
+            vol = self.ctx.state.csi_volume_by_id(
+                self.job.namespace, req.source
+            )
+            if vol is None or not vol.claimable(req.read_only):
+                out[:] = False
+                return out
+            col = self.table.column(f"csi.{vol.plugin_id}")
+            out &= col.codes != -1
+        return out
+
     def _static_feasibility(self, tg: TaskGroup) -> np.ndarray:
         key = (self.job.id, self.job.version, tg.name, self.table.generation)
         cached = self._static_mask_cache.get(key)
@@ -394,9 +417,8 @@ class TPUGenericStack:
                 else:
                     rw_code = col.interner.lookup("rw")
                     mask &= col.codes == rw_code
-            elif req.type == "csi":
-                col = self.table.column(f"csi.{req.source}")
-                mask &= col.codes != -1
+            # csi is handled dynamically in select(): volume records
+            # and claim capacity change without a table-generation bump
         if tg.networks:
             mode = tg.networks[0].mode or "host"
             if mode != "host":

@@ -97,6 +97,19 @@ class ReplicatedStore:
             "upsert_scaling_event", (namespace, job_id, group, event)
         )
 
+    def upsert_csi_volume(self, volume):
+        return self._raft_apply("upsert_csi_volume", (volume,))
+
+    def deregister_csi_volume(self, namespace, volume_id, force=False):
+        return self._raft_apply(
+            "deregister_csi_volume", (namespace, volume_id, force)
+        )
+
+    def release_csi_claims_for_alloc(self, alloc_id):
+        return self._raft_apply(
+            "release_csi_claims_for_alloc", (alloc_id,)
+        )
+
     def set_scheduler_config(self, config):
         return self._raft_apply("set_scheduler_config", (config,))
 

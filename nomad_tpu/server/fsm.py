@@ -51,6 +51,7 @@ def state_payload(store: StateStore, acls) -> dict:
             "evals": list(store.evals.values()),
             "deployments": list(store.deployments.values()),
             "scheduler_config": store.scheduler_config,
+            "csi_volumes": list(store.csi_volumes.values()),
             "scaling_policies": list(store.scaling_policies.values()),
             "scaling_events": {
                 k: {g: list(evs) for g, evs in v.items()}
@@ -118,6 +119,9 @@ def install_payload(store: StateStore, acls, payload: dict) -> int:
             store.deployments[d.id] = d
             store._deployments_by_job[(d.namespace, d.job_id)].add(d.id)
         store.scheduler_config = payload["scheduler_config"]
+        store.csi_volumes.clear()
+        for vol in payload.get("csi_volumes", ()):
+            store.csi_volumes[(vol.namespace, vol.id)] = vol
         store.scaling_policies.clear()
         store._scaling_by_target.clear()
         store.scaling_events.clear()
@@ -214,6 +218,15 @@ class ServerFSM:
 
     def _apply_upsert_allocs(self, allocs):
         return self.store.upsert_allocs(allocs)
+
+    def _apply_upsert_csi_volume(self, volume):
+        return self.store.upsert_csi_volume(volume)
+
+    def _apply_deregister_csi_volume(self, namespace, volume_id, force=False):
+        return self.store.deregister_csi_volume(namespace, volume_id, force)
+
+    def _apply_release_csi_claims_for_alloc(self, alloc_id):
+        return self.store.release_csi_claims_for_alloc(alloc_id)
 
     def _apply_upsert_scaling_event(self, namespace, job_id, group, event):
         return self.store.upsert_scaling_event(

@@ -102,6 +102,8 @@ def evaluate_plan(
                 result.deployment = None
                 result.deployment_updates = []
                 break
+    if not _verify_csi_claims(store, result):
+        partial = True
     if partial:
         result.refresh_index = store.latest_index()
         # a partial commit must not carry deployment mutations computed
@@ -109,6 +111,63 @@ def evaluate_plan(
         result.deployment = None
         result.deployment_updates = []
     return result, not partial
+
+
+def _verify_csi_claims(store: StateStore, result: PlanResult) -> bool:
+    """Drop placements whose CSI volume claims cannot all be satisfied
+    (the applier is the claim's linearization point: feasibility ran
+    against claim-free snapshots, so two optimistic placements can race
+    for the last writer slot — the loser is rejected here and its eval
+    refreshed, exactly like a node-capacity conflict)."""
+    import copy
+
+    sim: Dict[Tuple[str, str], object] = {}
+    ok = True
+    for node_id in sorted(result.node_allocation):
+        kept = []
+        for alloc in result.node_allocation[node_id]:
+            job = alloc.job or store.job_by_id(
+                alloc.namespace, alloc.job_id
+            )
+            tg = job.lookup_task_group(alloc.task_group) if job else None
+            reqs = [
+                r
+                for r in (tg.volumes.values() if tg else ())
+                if r.type == "csi"
+            ]
+            fits = True
+            claimed = []
+            for req in reqs:
+                key = (alloc.namespace, req.source)
+                vol = sim.get(key)
+                if vol is None:
+                    vol = store.csi_volume_by_id(*key)
+                    if vol is not None:
+                        vol = copy.deepcopy(vol)
+                        sim[key] = vol
+                if vol is None:
+                    fits = False
+                    break
+                if alloc.id in vol.read_claims or (
+                    alloc.id in vol.write_claims
+                ):
+                    continue
+                if not vol.claimable(req.read_only):
+                    fits = False
+                    break
+                claimed.append((vol, req.read_only))
+            if fits:
+                for vol, read_only in claimed:
+                    vol.claim(alloc.id, alloc.node_id, read_only)
+                kept.append(alloc)
+            else:
+                ok = False
+        if len(kept) != len(result.node_allocation[node_id]):
+            if kept:
+                result.node_allocation[node_id] = kept
+            else:
+                del result.node_allocation[node_id]
+    return ok
 
 
 class PlanApplier:

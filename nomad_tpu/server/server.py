@@ -42,6 +42,7 @@ from ..structs import (
 from .blocked_evals import BlockedEvals
 from .deployment_watcher import DeploymentWatcher
 from .drainer import Drainer
+from .volume_watcher import VolumeWatcher
 from .eval_broker import EvalBroker
 from .periodic import PeriodicDispatcher
 from .plan_apply import PlanApplier
@@ -127,6 +128,7 @@ class Server:
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = Drainer(self)
         self.periodic = PeriodicDispatcher(self)
+        self.volume_watcher = VolumeWatcher(self)
         from .services import ServiceCatalog
 
         self.catalog = ServiceCatalog(self)
@@ -166,6 +168,7 @@ class Server:
             self.deployment_watcher.start()
             self.drainer.start()
             self.periodic.start()
+            self.volume_watcher.start()
             self._leader_established = True
             # re-arm heartbeat TTLs for every known node (reference
             # heartbeat.go initializeHeartbeatTimers on leadership)
@@ -184,6 +187,7 @@ class Server:
             self.periodic.stop()
             self.deployment_watcher.stop()
             self.drainer.stop()
+            self.volume_watcher.stop()
             for worker in self.workers:
                 worker.stop()
             self.applier.stop()

@@ -1,0 +1,73 @@
+"""CSI volume watcher — releases volume claims as their allocations
+reach terminal state.
+
+Plays the role of the reference's leader-only volume watcher
+(`nomad/volumewatcher/volumes_watcher.go`): there, a per-volume goroutine
+follows the volume via blocking queries and unpublishes/releases claims
+once claiming allocs are terminal.  Here claims live directly on the
+`CSIVolume` record (alloc id -> node id), so the watcher is a single
+sweep: any claim whose alloc is gone or terminal is dropped, which
+immediately restores claim capacity for blocked placements.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class VolumeWatcher:
+    def __init__(self, server, interval: float = 0.1) -> None:
+        self.server = server
+        self.store = server.store
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="volume-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync()
+            except Exception:  # noqa: BLE001 — keep the watcher alive
+                pass
+
+    def sync(self) -> int:
+        """One reconciliation sweep; returns how many allocs had claims
+        released (testing hook — the background loop calls this)."""
+        released = 0
+        for vol in list(self.store.csi_volumes.values()):
+            for alloc_id in list(vol.read_claims) + list(vol.write_claims):
+                alloc = self.store.alloc_by_id(alloc_id)
+                # release only once the CLIENT is done with the volume
+                # (reference releases after node unpublish completes):
+                # client-terminal, never handed to a client (stopped
+                # while still pending), or gone from state entirely
+                done = (
+                    alloc is None
+                    or alloc.client_terminal_status()
+                    or (
+                        alloc.terminal_status()
+                        and alloc.client_status
+                        == "pending"
+                    )
+                )
+                if done:
+                    # the facade raft-applies on clusters; idempotent
+                    self.store.release_csi_claims_for_alloc(alloc_id)
+                    released += 1
+        if released:
+            # freed claim capacity can unblock evals the same way node
+            # capacity does (reference volumewatcher -> blocked evals)
+            self.server.blocked.unblock_all(self.store.latest_index())
+        return released

@@ -31,6 +31,8 @@ from ..structs import (
     ALLOC_CLIENT_STATUS_FAILED,
     ALLOC_CLIENT_STATUS_LOST,
     ALLOC_DESIRED_STOP,
+    CSIPlugin,
+    CSIVolume,
     Deployment,
     Evaluation,
     Job,
@@ -63,6 +65,10 @@ class StateStore:
         self.evals: Dict[str, Evaluation] = {}
         self.deployments: Dict[str, Deployment] = {}
         self.scheduler_config = SchedulerConfiguration()
+
+        # CSI volumes keyed (namespace, id) (reference state table
+        # csi_volumes, nomad/state/schema.go)
+        self.csi_volumes: Dict[Tuple[str, str], CSIVolume] = {}
 
         # autoscaling (reference state tables scaling_policy /
         # scaling_event, nomad/state/schema.go:795,847)
@@ -343,6 +349,106 @@ class StateStore:
         }
 
     # ------------------------------------------------------------------
+    # CSI volumes (reference state_store.go CSIVolumeRegister/
+    # CSIVolumeClaim/CSIVolumeDeregister; plugin health is a derived
+    # view over node fingerprints)
+    # ------------------------------------------------------------------
+
+    def upsert_csi_volume(self, volume: CSIVolume) -> int:
+        with self._lock:
+            key = (volume.namespace, volume.id)
+            existing = self.csi_volumes.get(key)
+            if existing is not None:
+                volume.create_index = existing.create_index
+                # claims survive a re-register (reference: volume
+                # updates cannot drop live claims)
+                volume.read_claims = dict(existing.read_claims)
+                volume.write_claims = dict(existing.write_claims)
+            else:
+                volume.create_index = self._index + 1
+            volume.modify_index = self._index + 1
+            self.csi_volumes[key] = volume
+            return self._bump("csi_volumes")
+
+    def deregister_csi_volume(
+        self, namespace: str, volume_id: str, force: bool = False
+    ) -> int:
+        with self._lock:
+            vol = self.csi_volumes.get((namespace, volume_id))
+            if vol is None:
+                raise KeyError(f"volume {volume_id!r} not found")
+            if vol.in_use() and not force:
+                raise ValueError(
+                    f"volume {volume_id!r} has active claims"
+                )
+            del self.csi_volumes[(namespace, volume_id)]
+            return self._bump("csi_volumes")
+
+    def csi_volume_by_id(
+        self, namespace: str, volume_id: str
+    ) -> Optional[CSIVolume]:
+        return self.csi_volumes.get((namespace, volume_id))
+
+    def iter_csi_volumes(
+        self, namespace: Optional[str] = None
+    ) -> List[CSIVolume]:
+        return [
+            v
+            for v in self.csi_volumes.values()
+            if namespace is None or v.namespace == namespace
+        ]
+
+    def claim_csi_volume(
+        self,
+        namespace: str,
+        volume_id: str,
+        alloc_id: str,
+        node_id: str,
+        read_only: bool,
+    ) -> int:
+        with self._lock:
+            vol = self.csi_volumes.get((namespace, volume_id))
+            if vol is None:
+                raise KeyError(f"volume {volume_id!r} not found")
+            if alloc_id not in vol.read_claims and (
+                alloc_id not in vol.write_claims
+            ):
+                if not vol.claimable(read_only):
+                    raise ValueError(
+                        f"volume {volume_id!r} is not claimable "
+                        f"({vol.access_mode})"
+                    )
+                vol.claim(alloc_id, node_id, read_only)
+            vol.modify_index = self._index + 1
+            return self._bump("csi_volumes")
+
+    def release_csi_claims_for_alloc(self, alloc_id: str) -> Optional[int]:
+        """Drop every claim held by one alloc (the volume watcher's
+        write path, reference volumewatcher/volumes_watcher.go)."""
+        with self._lock:
+            hit = False
+            for vol in self.csi_volumes.values():
+                if vol.release(alloc_id):
+                    vol.modify_index = self._index + 1
+                    hit = True
+            if not hit:
+                return None
+            return self._bump("csi_volumes")
+
+    def csi_plugins(self) -> Dict[str, CSIPlugin]:
+        """Aggregate per-plugin health from node fingerprints."""
+        with self._lock:
+            plugins: Dict[str, CSIPlugin] = {}
+            for node in self.nodes.values():
+                for pid, healthy in node.csi_node_plugins.items():
+                    p = plugins.setdefault(pid, CSIPlugin(id=pid))
+                    p.nodes_expected += 1
+                    if healthy:
+                        p.nodes_healthy += 1
+                        p.node_ids.append(node.id)
+            return plugins
+
+    # ------------------------------------------------------------------
     # evals
     # ------------------------------------------------------------------
 
@@ -526,6 +632,13 @@ class StateStore:
             for allocs in result.node_allocation.values():
                 updates.extend(allocs)
             self._upsert_allocs_locked(updates)
+            # claim CSI volumes for the placements in this plan (the
+            # serialized applier is the claim's linearization point;
+            # reference claims via CSIVolume.Claim from the client's
+            # csi_hook, released by the volume watcher either way)
+            for allocs in result.node_allocation.values():
+                for alloc in allocs:
+                    self._claim_csi_for_alloc_locked(alloc)
             if result.deployment is not None:
                 d = result.deployment
                 existing = self.deployments.get(d.id)
@@ -541,6 +654,25 @@ class StateStore:
                     d.status_description = upd.status_description
                     d.modify_index = self._index + 1
             return self._bump("allocs", "deployments")
+
+    def _claim_csi_for_alloc_locked(self, alloc: Allocation) -> None:
+        job = alloc.job or self.job_by_id(alloc.namespace, alloc.job_id)
+        if job is None:
+            return
+        tg = job.lookup_task_group(alloc.task_group)
+        if tg is None:
+            return
+        for req in tg.volumes.values():
+            if req.type != "csi":
+                continue
+            vol = self.csi_volumes.get((alloc.namespace, req.source))
+            if vol is None:
+                continue
+            if alloc.id in vol.read_claims or alloc.id in vol.write_claims:
+                continue
+            if vol.claimable(req.read_only):
+                vol.claim(alloc.id, alloc.node_id, req.read_only)
+                vol.modify_index = self._index + 1
 
     # ------------------------------------------------------------------
     # job status derivation (reference state_store.go setJobStatus)
@@ -628,6 +760,16 @@ class StateSnapshot:
 
     def scheduler_config(self) -> SchedulerConfiguration:
         return self._store.get_scheduler_config()
+
+    def csi_volume_by_id(
+        self, namespace: str, volume_id: str
+    ) -> Optional[CSIVolume]:
+        return self._store.csi_volume_by_id(namespace, volume_id)
+
+    def iter_csi_volumes(
+        self, namespace: Optional[str] = None
+    ) -> List[CSIVolume]:
+        return self._store.iter_csi_volumes(namespace)
 
     @property
     def node_table(self) -> NodeTable:

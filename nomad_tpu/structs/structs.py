@@ -485,6 +485,98 @@ class VolumeRequest:
     read_only: bool = False
 
 
+# CSI access/attachment modes (reference nomad/structs/csi.go)
+CSI_ACCESS_SINGLE_NODE_READER = "single-node-reader-only"
+CSI_ACCESS_SINGLE_NODE_WRITER = "single-node-writer"
+CSI_ACCESS_MULTI_NODE_READER = "multi-node-reader-only"
+CSI_ACCESS_MULTI_NODE_SINGLE_WRITER = "multi-node-single-writer"
+CSI_ACCESS_MULTI_NODE_MULTI_WRITER = "multi-node-multi-writer"
+
+CSI_ATTACHMENT_FILE_SYSTEM = "file-system"
+CSI_ATTACHMENT_BLOCK_DEVICE = "block-device"
+
+_CSI_SINGLE_NODE_MODES = (
+    CSI_ACCESS_SINGLE_NODE_READER,
+    CSI_ACCESS_SINGLE_NODE_WRITER,
+)
+
+
+@dataclass
+class CSIVolume:
+    """An externally-provisioned volume managed by a CSI plugin
+    (reference nomad/structs/csi.go CSIVolume; state table
+    nomad/state/schema.go csi_volumes).  Claims map alloc id -> node id
+    so the watcher can release claims as allocs die."""
+
+    id: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    name: str = ""
+    external_id: str = ""
+    plugin_id: str = ""
+    access_mode: str = CSI_ACCESS_SINGLE_NODE_WRITER
+    attachment_mode: str = CSI_ATTACHMENT_FILE_SYSTEM
+    read_claims: Dict[str, str] = field(default_factory=dict)
+    write_claims: Dict[str, str] = field(default_factory=dict)
+    schedulable: bool = True
+    secrets: Dict[str, str] = field(default_factory=dict)
+    parameters: Dict[str, str] = field(default_factory=dict)
+    context: Dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def write_free(self) -> bool:
+        """Can another writer claim this volume?
+        (reference csi.go WriteFreeClaims)"""
+        if self.access_mode in (
+            CSI_ACCESS_SINGLE_NODE_READER,
+            CSI_ACCESS_MULTI_NODE_READER,
+        ):
+            return False
+        if self.access_mode == CSI_ACCESS_MULTI_NODE_MULTI_WRITER:
+            return True
+        return len(self.write_claims) == 0
+
+    def claimable(self, read_only: bool) -> bool:
+        if not self.schedulable:
+            return False
+        if read_only:
+            # single-node modes serialize on one node; modeled as one
+            # outstanding claim set like the reference's ReadFreeClaims
+            if self.access_mode in _CSI_SINGLE_NODE_MODES:
+                return not self.write_claims
+            return True
+        return self.write_free()
+
+    def claim(self, alloc_id: str, node_id: str, read_only: bool) -> None:
+        if read_only:
+            self.read_claims[alloc_id] = node_id
+        else:
+            self.write_claims[alloc_id] = node_id
+
+    def release(self, alloc_id: str) -> bool:
+        hit = False
+        if self.read_claims.pop(alloc_id, None) is not None:
+            hit = True
+        if self.write_claims.pop(alloc_id, None) is not None:
+            hit = True
+        return hit
+
+    def in_use(self) -> bool:
+        return bool(self.read_claims or self.write_claims)
+
+
+@dataclass
+class CSIPlugin:
+    """Aggregated plugin health view, derived from node fingerprints
+    (reference nomad/structs/csi.go CSIPlugin; the reference keeps a
+    csi_plugins table, here it is computed from the node table)."""
+
+    id: str = ""
+    nodes_healthy: int = 0
+    nodes_expected: int = 0
+    node_ids: List[str] = field(default_factory=list)
+
+
 @dataclass
 class Lifecycle:
     hook: str = ""  # prestart | poststart | poststop

@@ -1,0 +1,338 @@
+"""CSI volume subsystem tests (reference model:
+nomad/state/state_store_test.go CSIVolume cases,
+nomad/volumewatcher/volumes_watcher_test.go,
+scheduler/feasible_test.go CSIVolumeChecker,
+client csi_hook / plugins/csi/fake usage).
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.client.csi import CSIManager, FakeCSIPlugin
+from nomad_tpu.server import Server
+from nomad_tpu.server.fsm import install_payload, state_payload
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    CSI_ACCESS_MULTI_NODE_MULTI_WRITER,
+    CSI_ACCESS_MULTI_NODE_READER,
+    CSIVolume,
+    VolumeRequest,
+)
+
+
+def wait_until(cond, timeout=10.0, interval=0.03, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    raise AssertionError(f"timeout: {msg or 'condition'}")
+
+
+def csi_job(vol_id, read_only=False, count=1, **overrides):
+    j = mock.job(**overrides)
+    j.task_groups[0].count = count
+    j.task_groups[0].volumes["data"] = VolumeRequest(
+        name="data", type="csi", source=vol_id, read_only=read_only
+    )
+    return j
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def test_volume_register_claims_survive_reregister():
+    s = StateStore()
+    v = mock.csi_volume()
+    s.upsert_csi_volume(v)
+    s.claim_csi_volume(v.namespace, v.id, "alloc1", "node1", False)
+    v2 = CSIVolume(id=v.id, plugin_id="ebs0", name="renamed")
+    s.upsert_csi_volume(v2)
+    got = s.csi_volume_by_id("default", v.id)
+    assert got.name == "renamed"
+    assert got.write_claims == {"alloc1": "node1"}
+
+
+def test_volume_deregister_blocked_by_claims():
+    s = StateStore()
+    v = mock.csi_volume()
+    s.upsert_csi_volume(v)
+    s.claim_csi_volume(v.namespace, v.id, "alloc1", "node1", False)
+    with pytest.raises(ValueError):
+        s.deregister_csi_volume(v.namespace, v.id)
+    s.deregister_csi_volume(v.namespace, v.id, force=True)
+    assert s.csi_volume_by_id(v.namespace, v.id) is None
+
+
+def test_single_node_writer_capacity():
+    v = mock.csi_volume()
+    assert v.claimable(read_only=False)
+    v.claim("a1", "n1", read_only=False)
+    assert not v.claimable(read_only=False)
+    # multi-writer mode never runs out
+    v2 = mock.csi_volume(access_mode=CSI_ACCESS_MULTI_NODE_MULTI_WRITER)
+    v2.claim("a1", "n1", read_only=False)
+    assert v2.claimable(read_only=False)
+    # reader-only mode rejects writers outright
+    v3 = mock.csi_volume(access_mode=CSI_ACCESS_MULTI_NODE_READER)
+    assert not v3.claimable(read_only=False)
+    assert v3.claimable(read_only=True)
+
+
+def test_csi_plugins_derived_from_nodes():
+    s = StateStore()
+    n1 = mock.node()
+    n1.csi_node_plugins["ebs0"] = True
+    n2 = mock.node()
+    n2.csi_node_plugins["ebs0"] = False
+    s.upsert_node(n1)
+    s.upsert_node(n2)
+    plugins = s.csi_plugins()
+    assert plugins["ebs0"].nodes_expected == 2
+    assert plugins["ebs0"].nodes_healthy == 1
+    assert plugins["ebs0"].node_ids == [n1.id]
+
+
+def test_csi_snapshot_roundtrip():
+    s = StateStore()
+    v = mock.csi_volume()
+    s.upsert_csi_volume(v)
+    s.claim_csi_volume(v.namespace, v.id, "alloc1", "node1", False)
+    fresh = StateStore()
+    install_payload(fresh, None, state_payload(s, None))
+    got = fresh.csi_volume_by_id(v.namespace, v.id)
+    assert got is not None and got.write_claims == {"alloc1": "node1"}
+
+
+# ---------------------------------------------------------------------------
+# scheduling
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def srv():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=11)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_placement_requires_healthy_plugin(srv):
+    plugin_nodes = []
+    for i in range(2):
+        n = mock.node()
+        n.csi_node_plugins["ebs0"] = True
+        plugin_nodes.append(n.id)
+        srv.register_node(n)
+    for i in range(2):
+        srv.register_node(mock.node())
+    vol = mock.csi_volume(
+        plugin_id="ebs0",
+        access_mode=CSI_ACCESS_MULTI_NODE_MULTI_WRITER,
+    )
+    srv.store.upsert_csi_volume(vol)
+
+    j = csi_job(vol.id, count=2)
+    srv.register_job(j)
+    assert srv.drain_to_idle(timeout=10.0)
+    allocs = srv.store.allocs_by_job(j.namespace, j.id)
+    assert len(allocs) == 2
+    assert {a.node_id for a in allocs} <= set(plugin_nodes)
+    # the plan applier claimed the volume for the placements
+    got = srv.store.csi_volume_by_id(vol.namespace, vol.id)
+    assert set(got.write_claims) == {a.id for a in allocs}
+
+
+def test_plan_apply_rejects_oversubscribed_writer(srv):
+    """count=2 on a single-node-writer volume: the applier is the
+    claim linearization point — only one placement commits, the other
+    is rejected like a node-capacity conflict."""
+    for _ in range(2):
+        n = mock.node()
+        n.csi_node_plugins["ebs0"] = True
+        srv.register_node(n)
+    vol = mock.csi_volume(plugin_id="ebs0")
+    srv.store.upsert_csi_volume(vol)
+
+    j = csi_job(vol.id, count=2)
+    srv.register_job(j)
+    assert srv.drain_to_idle(timeout=10.0)
+    allocs = [
+        a
+        for a in srv.store.allocs_by_job(j.namespace, j.id)
+        if not a.terminal_status()
+    ]
+    assert len(allocs) == 1
+    got = srv.store.csi_volume_by_id(vol.namespace, vol.id)
+    assert set(got.write_claims) == {allocs[0].id}
+
+
+def test_unregistered_volume_blocks_eval(srv):
+    n = mock.node()
+    n.csi_node_plugins["ebs0"] = True
+    srv.register_node(n)
+    j = csi_job("nope")
+    ev = srv.register_job(j)
+    assert srv.drain_to_idle(timeout=10.0)
+    assert not srv.store.allocs_by_job(j.namespace, j.id)
+
+
+def test_write_claim_capacity_blocks_second_writer_until_release(srv):
+    n = mock.node()
+    n.csi_node_plugins["ebs0"] = True
+    srv.register_node(n)
+    vol = mock.csi_volume(plugin_id="ebs0")
+    srv.store.upsert_csi_volume(vol)
+
+    j1 = csi_job(vol.id, id="writer-1")
+    srv.register_job(j1)
+    assert srv.drain_to_idle(timeout=10.0)
+    assert len(srv.store.allocs_by_job(j1.namespace, j1.id)) == 1
+
+    # single-node-writer is fully claimed: writer-2 can't place
+    j2 = csi_job(vol.id, id="writer-2")
+    srv.register_job(j2)
+    assert srv.drain_to_idle(timeout=10.0)
+    assert not srv.store.allocs_by_job(j2.namespace, j2.id)
+
+    # stop writer-1 -> watcher releases the claim -> writer-2 places
+    srv.deregister_job(j1.namespace, j1.id)
+    wait_until(
+        lambda: srv.drain_to_idle(timeout=1.0)
+        and len(
+            [
+                a
+                for a in srv.store.allocs_by_job(
+                    j2.namespace, j2.id
+                )
+                if not a.terminal_status()
+            ]
+        )
+        == 1,
+        timeout=15.0,
+        msg="writer-2 placed after claim release",
+    )
+    got = srv.store.csi_volume_by_id(vol.namespace, vol.id)
+    a2 = [
+        a
+        for a in srv.store.allocs_by_job(j2.namespace, j2.id)
+        if not a.terminal_status()
+    ]
+    assert set(got.write_claims) == {a2[0].id}
+
+
+# ---------------------------------------------------------------------------
+# client csimanager + fake plugin
+# ---------------------------------------------------------------------------
+
+
+def test_csimanager_mount_unmount(tmp_path):
+    plugin = FakeCSIPlugin()
+    mgr = CSIManager(data_dir=str(tmp_path), plugins={"ebs0": plugin})
+    info = mgr.mount_volume("ebs0", "vol1", "alloc1", False)
+    assert plugin.staged["vol1"] == info.staging_path
+    assert plugin.published["vol1"] == info.target_path
+    # second alloc on same volume: staged once, published twice
+    mgr.mount_volume("ebs0", "vol1", "alloc2", True)
+    mgr.unmount_volume("vol1", "alloc1")
+    # still staged: alloc2 holds it
+    assert "vol1" in plugin.staged
+    mgr.unmount_volume("vol1", "alloc2")
+    assert "vol1" not in plugin.staged
+    assert "vol1" not in plugin.published
+
+
+def test_fingerprint_reports_health():
+    healthy = FakeCSIPlugin()
+    broken = FakeCSIPlugin(healthy=False)
+    mgr = CSIManager(plugins={"ok": healthy, "bad": broken})
+    n = mock.node()
+    mgr.fingerprint_node(n)
+    assert n.csi_node_plugins == {"ok": True, "bad": False}
+
+
+def test_mount_failure_fails_alloc(tmp_path, srv):
+    from nomad_tpu.client.alloc_runner import AllocRunner
+
+    n = mock.node()
+    srv.register_node(n)
+    vol = mock.csi_volume(plugin_id="ebs0")
+    srv.store.upsert_csi_volume(vol)
+    j = csi_job(vol.id)
+    alloc = mock.alloc(job=j, task_group=j.task_groups[0].name)
+
+    plugin = FakeCSIPlugin(fail_stage=True)
+    mgr = CSIManager(data_dir=str(tmp_path), plugins={"ebs0": plugin})
+    runner = AllocRunner(
+        alloc,
+        csi_manager=mgr,
+        csi_resolver=lambda ns, vid: srv.store.csi_volume_by_id(ns, vid),
+    )
+    runner.run()
+    assert alloc.client_status == "failed"
+    assert not mgr.mounts_for_alloc(alloc.id)
+
+
+# ---------------------------------------------------------------------------
+# HTTP + CLI surface
+# ---------------------------------------------------------------------------
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _req(base, path, body=None, method="POST"):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def api():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=5)
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    yield server, base
+    http.stop()
+    server.stop()
+
+
+def test_csi_http_surface(api):
+    server, base = api
+    n = mock.node()
+    n.csi_node_plugins["ebs0"] = True
+    server.register_node(n)
+
+    _req(
+        base,
+        "/v1/volume/csi/vol-web",
+        {"ID": "vol-web", "PluginID": "ebs0", "Name": "web-data"},
+        method="PUT",
+    )
+    vols = _get(base, "/v1/volumes")
+    assert len(vols) == 1 and vols[0]["ID"] == "vol-web"
+
+    vol = _get(base, "/v1/volume/csi/vol-web")
+    assert vol["PluginID"] == "ebs0"
+    assert vol["AccessMode"] == "single-node-writer"
+
+    plugins = _get(base, "/v1/plugins")
+    assert plugins[0]["ID"] == "ebs0"
+    assert plugins[0]["NodesHealthy"] == 1
+
+    _req(base, "/v1/volume/csi/vol-web", method="DELETE")
+    assert _get(base, "/v1/volumes") == []
