@@ -30,6 +30,9 @@ class AllocRunner:
         catalog=None,
         csi_manager=None,
         csi_resolver=None,
+        node=None,
+        region: str = "global",
+        prev_watcher=None,
     ) -> None:
         self.secrets = secrets
         self.catalog = catalog
@@ -37,6 +40,7 @@ class AllocRunner:
         self.csi_resolver = csi_resolver
         self.alloc = alloc
         self.on_update = on_update
+        self.prev_watcher = prev_watcher
         self._lock = threading.Lock()
         self.task_runners: Dict[str, TaskRunner] = {}
         self._destroyed = False
@@ -52,6 +56,15 @@ class AllocRunner:
         alloc_dir = (
             os.path.join(data_dir, "allocs", alloc.id) if data_dir else ""
         )
+        # allocdir layout (client/allocdir): shared alloc/ + per-task
+        # local/secrets/tmp, built lazily in run()
+        self.alloc_dir_obj = None
+        if data_dir:
+            from .allocdir import AllocDir
+
+            self.alloc_dir_obj = AllocDir(
+                os.path.join(data_dir, "allocs"), alloc.id
+            )
         env = {
             "NOMAD_ALLOC_ID": alloc.id,
             "NOMAD_ALLOC_NAME": alloc.name,
@@ -68,6 +81,17 @@ class AllocRunner:
             driver = None
             if drivers is not None:
                 driver = drivers.get(task.driver)
+            task_dir = None
+            task_env = None
+            if self.alloc_dir_obj is not None:
+                from .taskenv import Builder
+
+                task_dir = self.alloc_dir_obj.new_task_dir(task.name)
+                b = Builder().set_alloc(alloc, job, tg)
+                if node is not None:
+                    b.set_node(node, region)
+                b.set_task(task, task_dir)
+                task_env = b.build()
             self.task_runners[task.name] = TaskRunner(
                 alloc_id=alloc.id,
                 task=task,
@@ -79,12 +103,41 @@ class AllocRunner:
                 driver=driver,
                 secrets=secrets,
                 catalog=catalog,
+                task_dir=task_dir,
+                task_env=task_env,
             )
 
     # ------------------------------------------------------------------
 
     def run(self) -> None:
         self.alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+        if self.alloc_dir_obj is not None:
+            self.alloc_dir_obj.build()
+        # wait for + migrate from the previous allocation (reference
+        # allocrunner migrate hook via client/allocwatcher); the wait
+        # happens off-thread so the client's watch loop never blocks
+        if self.prev_watcher is not None:
+            threading.Thread(
+                target=self._wait_prev_then_start,
+                name=f"allocwatch-{self.alloc.id[:8]}",
+                daemon=True,
+            ).start()
+            return
+        self._start_tasks()
+
+    def _wait_prev_then_start(self) -> None:
+        while not self.prev_watcher.wait(timeout=0.25):
+            with self._lock:
+                if self._destroyed:
+                    return
+        with self._lock:
+            if self._destroyed:
+                return
+        if self.alloc_dir_obj is not None:
+            self.prev_watcher.migrate(self.alloc_dir_obj)
+        self._start_tasks()
+
+    def _start_tasks(self) -> None:
         if not self._csi_mount():
             return
         for tr in self.task_runners.values():

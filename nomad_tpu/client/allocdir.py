@@ -1,0 +1,150 @@
+"""Allocation directory layout (reference client/allocdir/alloc_dir.go,
+task_dir.go).
+
+Layout under the client data dir::
+
+    allocs/<alloc_id>/
+        alloc/              shared dir, all tasks of the group
+            data/           persisted across in-place restarts, migrated
+                            when EphemeralDisk.migrate is set
+            logs/           rotated task stdout/stderr (logmon target)
+            tmp/
+        <task>/
+            local/          task-private scratch (NOMAD_TASK_DIR)
+            secrets/        rendered secrets (NOMAD_SECRETS_DIR)
+            tmp/
+
+The reference chroots/binds these on Linux (alloc_dir_linux.go); here the
+layout + lifecycle + migration semantics are kept and isolation is the
+driver's concern.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List, Optional
+
+SHARED_ALLOC_NAME = "alloc"
+SHARED_DATA_DIR = "data"
+SHARED_LOGS_DIR = "logs"
+TASK_LOCAL = "local"
+TASK_SECRETS = "secrets"
+TMP_DIR = "tmp"
+
+
+class TaskDir:
+    """Per-task view of an alloc dir (reference allocdir/task_dir.go)."""
+
+    def __init__(self, alloc_path: str, task_name: str) -> None:
+        self.task_name = task_name
+        self.dir = os.path.join(alloc_path, task_name)
+        self.local_dir = os.path.join(self.dir, TASK_LOCAL)
+        self.secrets_dir = os.path.join(self.dir, TASK_SECRETS)
+        self.tmp_dir = os.path.join(self.dir, TMP_DIR)
+        self.shared_alloc_dir = os.path.join(alloc_path, SHARED_ALLOC_NAME)
+        self.log_dir = os.path.join(self.shared_alloc_dir, SHARED_LOGS_DIR)
+
+    def build(self) -> None:
+        for d in (self.local_dir, self.secrets_dir, self.tmp_dir):
+            os.makedirs(d, exist_ok=True)
+
+
+class AllocDir:
+    """One allocation's directory tree (reference allocdir/alloc_dir.go:
+    Build, Destroy, Move, Snapshot)."""
+
+    def __init__(self, base_dir: str, alloc_id: str) -> None:
+        self.alloc_id = alloc_id
+        self.alloc_dir = os.path.join(base_dir, alloc_id)
+        self.shared_dir = os.path.join(self.alloc_dir, SHARED_ALLOC_NAME)
+        self.data_dir = os.path.join(self.shared_dir, SHARED_DATA_DIR)
+        self.log_dir = os.path.join(self.shared_dir, SHARED_LOGS_DIR)
+        self.task_dirs: Dict[str, TaskDir] = {}
+        self.built = False
+
+    def new_task_dir(self, task_name: str) -> TaskDir:
+        td = TaskDir(self.alloc_dir, task_name)
+        self.task_dirs[task_name] = td
+        return td
+
+    def build(self) -> None:
+        for d in (
+            self.data_dir,
+            self.log_dir,
+            os.path.join(self.shared_dir, TMP_DIR),
+        ):
+            os.makedirs(d, exist_ok=True)
+        for td in self.task_dirs.values():
+            td.build()
+        self.built = True
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)
+        self.built = False
+
+    # -- migration (reference alloc_dir.go Move, used by the
+    # previous-alloc watcher for sticky ephemeral disks) ---------------
+
+    def move_from(self, other: "AllocDir") -> None:
+        """Move the sticky pieces of a previous allocation's dir into
+        this one: the shared data dir and each task's local dir."""
+        self.build()
+        _move_contents(other.data_dir, self.data_dir)
+        for name, td in self.task_dirs.items():
+            prev = other.task_dirs.get(name) or TaskDir(
+                other.alloc_dir, name
+            )
+            if os.path.isdir(prev.local_dir):
+                _move_contents(prev.local_dir, td.local_dir)
+
+    # -- accounting (reference client/gc.go + allocdir stats) ----------
+
+    def disk_usage_bytes(self) -> int:
+        total = 0
+        for root, _dirs, files in os.walk(self.alloc_dir):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+        return total
+
+    def list_files(self, rel: str = "") -> List[str]:
+        """Relative listing for the fs API (reference client fs
+        endpoint)."""
+        base = os.path.join(self.alloc_dir, rel) if rel else self.alloc_dir
+        out: List[str] = []
+        for root, _dirs, files in os.walk(base):
+            for f in files:
+                out.append(
+                    os.path.relpath(os.path.join(root, f), self.alloc_dir)
+                )
+        return sorted(out)
+
+
+def _move_contents(src: str, dst: str) -> None:
+    if not os.path.isdir(src):
+        return
+    os.makedirs(dst, exist_ok=True)
+    for entry in os.listdir(src):
+        s = os.path.join(src, entry)
+        d = os.path.join(dst, entry)
+        try:
+            shutil.move(s, d)
+        except (OSError, shutil.Error):
+            pass
+
+
+def find_alloc_dir(base_dir: str, alloc_id: str) -> Optional[AllocDir]:
+    """Reopen an existing alloc dir (client restart / migration)."""
+    path = os.path.join(base_dir, alloc_id)
+    if not os.path.isdir(path):
+        return None
+    ad = AllocDir(base_dir, alloc_id)
+    for entry in os.listdir(path):
+        if entry == SHARED_ALLOC_NAME:
+            continue
+        if os.path.isdir(os.path.join(path, entry)):
+            ad.new_task_dir(entry)
+    ad.built = True
+    return ad

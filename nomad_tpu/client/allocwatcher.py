@@ -1,0 +1,169 @@
+"""Previous-allocation watcher + ephemeral disk migration
+(reference client/allocwatcher/alloc_watcher.go).
+
+An allocation that replaces another (``alloc.previous_allocation``, set
+by the reconciler for reschedules and drains) must wait for its
+predecessor to terminate before starting, and — when the task group's
+``ephemeral_disk`` sets ``sticky``/``migrate`` — inherit the
+predecessor's shared data dir and task local dirs.
+
+Two cases, as in the reference:
+
+* **local** (``localPrevAlloc``): the previous alloc ran on this node;
+  wait on the local runner, then move dirs with ``AllocDir.move_from``.
+* **remote** (``remotePrevAlloc``): it ran elsewhere; poll the servers
+  until the alloc is terminal.  Data migration then pulls a snapshot
+  through the server's fs proxy — modeled here as a pluggable
+  ``fetch_snapshot`` callable so transports can evolve independently.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .allocdir import AllocDir, find_alloc_dir
+
+
+class NoopPrevAlloc:
+    """Placeholder when there is no previous alloc to wait for."""
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return True
+
+    def migrate(self, dest: AllocDir) -> bool:
+        return False
+
+
+class PrevAllocWatcher:
+    def __init__(
+        self,
+        prev_alloc_id: str,
+        sticky: bool = False,
+        migrate: bool = False,
+        # local case
+        prev_runner=None,
+        alloc_base_dir: str = "",
+        # remote case
+        poll_terminal: Optional[Callable[[str], bool]] = None,
+        fetch_snapshot: Optional[Callable[[str, AllocDir], bool]] = None,
+        poll_interval: float = 0.1,
+    ) -> None:
+        self.prev_alloc_id = prev_alloc_id
+        self.sticky = sticky
+        self.migrate_data = migrate
+        self.prev_runner = prev_runner
+        self.alloc_base_dir = alloc_base_dir
+        self.poll_terminal = poll_terminal
+        self.fetch_snapshot = fetch_snapshot
+        self.poll_interval = poll_interval
+        self._waited = threading.Event()
+
+    @property
+    def is_local(self) -> bool:
+        return self.prev_runner is not None
+
+    # ------------------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the previous alloc is terminal
+        (reference alloc_watcher.go Wait)."""
+        if self.prev_runner is not None:
+            # a runner that failed before starting tasks (e.g. CSI
+            # mount) is terminal without its task waits ever firing
+            term = getattr(self.prev_runner, "is_terminal", None)
+            if callable(term) and term():
+                self._waited.set()
+                return True
+            ok = self.prev_runner.wait(timeout)
+            if ok:
+                self._waited.set()
+            return ok
+        if self.poll_terminal is None:
+            self._waited.set()
+            return True
+        deadline = None
+        if timeout is not None:
+            import time as _time
+
+            deadline = _time.monotonic() + timeout
+        while True:
+            if self.poll_terminal(self.prev_alloc_id):
+                self._waited.set()
+                return True
+            import time as _time
+
+            if deadline is not None and _time.monotonic() >= deadline:
+                return False
+            _time.sleep(self.poll_interval)
+
+    # ------------------------------------------------------------------
+
+    def migrate(self, dest: AllocDir) -> bool:
+        """Move/fetch the sticky data into `dest`
+        (reference alloc_watcher.go Migrate).  Returns True if any data
+        was migrated."""
+        if not (self.sticky or self.migrate_data):
+            return False
+        if not self._waited.is_set():
+            # refuse to copy from a still-running alloc
+            return False
+        # local data first: the runner's own dir, else whatever is
+        # still on disk under the alloc base dir
+        prev_dir = None
+        if self.prev_runner is not None:
+            prev_dir = getattr(self.prev_runner, "alloc_dir_obj", None)
+        if prev_dir is None and self.alloc_base_dir:
+            prev_dir = find_alloc_dir(
+                self.alloc_base_dir, self.prev_alloc_id
+            )
+        if prev_dir is not None:
+            dest.move_from(prev_dir)
+            return True
+        # nothing local: remote pull (reference remotePrevAlloc
+        # Migrate streaming the snapshot through the servers)
+        if self.fetch_snapshot is not None and self.migrate_data:
+            return self.fetch_snapshot(self.prev_alloc_id, dest)
+        return False
+
+
+def watcher_for_alloc(
+    alloc,
+    local_runners,
+    alloc_base_dir: str = "",
+    poll_terminal: Optional[Callable[[str], bool]] = None,
+    fetch_snapshot: Optional[Callable[[str, AllocDir], bool]] = None,
+):
+    """Build the right watcher for an alloc
+    (reference allocwatcher.NewAllocWatcher factory)."""
+    prev_id = alloc.previous_allocation
+    if not prev_id:
+        return NoopPrevAlloc()
+    tg = (
+        alloc.job.lookup_task_group(alloc.task_group)
+        if alloc.job is not None
+        else None
+    )
+    disk = tg.ephemeral_disk if tg is not None else None
+    sticky = bool(disk and disk.sticky)
+    migrate = bool(disk and disk.migrate)
+    prev_runner = (
+        local_runners.get(prev_id)
+        if local_runners is not None
+        else None
+    )
+    if prev_runner is not None:
+        return PrevAllocWatcher(
+            prev_id,
+            sticky=sticky,
+            migrate=migrate,
+            prev_runner=prev_runner,
+            alloc_base_dir=alloc_base_dir,
+        )
+    return PrevAllocWatcher(
+        prev_id,
+        sticky=sticky,
+        migrate=migrate,
+        alloc_base_dir=alloc_base_dir if sticky or migrate else "",
+        poll_terminal=poll_terminal,
+        fetch_snapshot=fetch_snapshot,
+    )

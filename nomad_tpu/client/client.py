@@ -65,9 +65,30 @@ class Client:
         )
         self.csi_manager.fingerprint_node(self.node)
 
+        # alloc-dir GC (reference client/gc.go) + disconnect stopper
+        # (reference client/heartbeatstop.go)
+        from .gc import AllocGarbageCollector
+        from .heartbeatstop import HeartbeatStopper
+
+        self.gc = AllocGarbageCollector(
+            alloc_base_dir=(
+                os.path.join(data_dir, "allocs") if data_dir else ""
+            ),
+            destroy_fn=self._gc_destroy_alloc,
+        )
+        self.heartbeat_stopper = HeartbeatStopper(
+            stop_alloc_fn=self._stop_alloc_local,
+            # never fire between two healthy heartbeats: an alloc's
+            # stop_after window can't be shorter than the time it takes
+            # to learn the servers are really gone
+            min_grace=2.0 * heartbeat_interval,
+        )
+
         self.alloc_runners: Dict[str, AllocRunner] = {}
         self._known_alloc_index: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        # reentrant: GC destroy callbacks fire under the watch loop's
+        # critical section and need to mutate the runner map
+        self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -79,6 +100,7 @@ class Client:
         if hasattr(self.server, "register_client"):
             self.server.register_client(self.node.id, self)
         self._stop.clear()
+        self.heartbeat_stopper.start()
         for target, name in (
             (self._heartbeat_loop, "client-heartbeat"),
             (self._watch_allocs_loop, "client-watch"),
@@ -90,6 +112,7 @@ class Client:
 
     def stop(self) -> None:
         self._stop.set()
+        self.heartbeat_stopper.stop()
         for t in self._threads:
             t.join(timeout=2.0)
         for runner in self.alloc_runners.values():
@@ -102,8 +125,36 @@ class Client:
         while not self._stop.wait(self.heartbeat_interval):
             try:
                 self.server.heartbeat(self.node.id)
+                self.heartbeat_stopper.note_heartbeat_ok()
             except KeyError:
                 self.server.register_node(self.node)
+                self.heartbeat_stopper.note_heartbeat_ok()
+            except Exception:  # noqa: BLE001
+                # unreachable servers: the stopper's clock keeps aging
+                pass
+
+    def _stop_alloc_local(self, alloc_id: str) -> None:
+        """Kill an alloc locally after server contact loss exceeds its
+        stop_after_client_disconnect (heartbeatstop.go)."""
+        with self._lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is not None:
+            runner.destroy()
+
+    def _gc_destroy_alloc(self, alloc_id: str) -> None:
+        """GC callback: tear down the runner (if any) and its dir."""
+        from .allocdir import AllocDir
+
+        with self._lock:
+            runner = self.alloc_runners.pop(alloc_id, None)
+            self._known_alloc_index.pop(alloc_id, None)
+        if runner is not None:
+            runner.destroy()
+        if self.data_dir:
+            ad = getattr(runner, "alloc_dir_obj", None) or AllocDir(
+                os.path.join(self.data_dir, "allocs"), alloc_id
+            )
+            ad.destroy()
 
     def _watch_allocs_loop(self) -> None:
         """(reference client.go:1961 watchAllocations)"""
@@ -144,6 +195,29 @@ class Client:
                     )
                 if alloc.job is None:
                     continue
+                # GC room + previous-alloc watcher (allocwatcher.py);
+                # the predecessor is exempt from GC until its sticky
+                # data has a chance to migrate
+                self.gc.make_room_for(
+                    1,
+                    exclude=(
+                        {alloc.previous_allocation}
+                        if alloc.previous_allocation
+                        else None
+                    ),
+                )
+                from .allocwatcher import watcher_for_alloc
+
+                prev_watcher = watcher_for_alloc(
+                    alloc,
+                    self.alloc_runners,
+                    alloc_base_dir=(
+                        os.path.join(self.data_dir, "allocs")
+                        if self.data_dir
+                        else ""
+                    ),
+                    poll_terminal=self._alloc_terminal_on_server,
+                )
                 runner = AllocRunner(
                     alloc,
                     data_dir=self.data_dir,
@@ -155,10 +229,25 @@ class Client:
                     csi_resolver=lambda ns, vid: (
                         self.server.store.csi_volume_by_id(ns, vid)
                     ),
+                    node=self.node,
+                    prev_watcher=prev_watcher,
                 )
                 self.alloc_runners[alloc_id] = runner
+                self.heartbeat_stopper.allocation_hook(alloc)
                 runner.run()
+            # feed the GC: terminal runners + live count
+            live = 0
+            for alloc_id, runner in self.alloc_runners.items():
+                if runner.is_terminal():
+                    self.gc.mark_terminal(alloc_id)
+                else:
+                    live += 1
+            self.gc.set_live_count(live)
         self._persist()
+
+    def _alloc_terminal_on_server(self, alloc_id: str) -> bool:
+        a = self.server.store.alloc_by_id(alloc_id)
+        return a is None or a.terminal_status()
 
     def _push_alloc_update(self, alloc: Allocation) -> None:
         """(reference client.go allocSync -> Node.UpdateAlloc)"""

@@ -28,6 +28,15 @@ class TaskConfig:
     config: Dict[str, Any] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
     alloc_dir: str = ""
+    # task working dir (TaskDir.local_dir) when the allocdir layout is
+    # built; falls back to alloc_dir otherwise
+    task_dir: str = ""
+    # when set, drivers pump stdout/stderr through logmon rotators in
+    # this directory instead of flat files (reference LogConfig,
+    # structs.go; client/logmon)
+    logs_dir: str = ""
+    log_max_files: int = 10
+    log_max_file_size_mb: int = 10
     resources: Optional[object] = None
 
 

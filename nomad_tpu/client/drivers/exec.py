@@ -36,9 +36,35 @@ class RawExecDriver(DriverPlugin):
         return [command] + list(args)
 
     def _popen(self, cfg: TaskConfig, argv) -> subprocess.Popen:
-        cwd = cfg.alloc_dir or None
+        cwd = cfg.task_dir or cfg.alloc_dir or None
         env = dict(os.environ)
         env.update(cfg.env or {})
+        return self._spawn(cfg, argv, cwd, env)
+
+    def _spawn(self, cfg: TaskConfig, argv, cwd, env) -> subprocess.Popen:
+        """Shared spawn path: logmon-rotated logs when a logs dir is
+        configured (reference client/logmon), flat files otherwise."""
+        if cwd:
+            os.makedirs(cwd, exist_ok=True)
+        if cfg.logs_dir:
+            from ..logmon import LogMon
+
+            proc = subprocess.Popen(
+                argv, cwd=cwd, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True,
+            )
+            lm = LogMon(
+                cfg.logs_dir, cfg.name,
+                max_files=cfg.log_max_files,
+                max_file_size_mb=cfg.log_max_file_size_mb,
+            )
+            lm.pump(proc.stdout, "stdout")
+            lm.pump(proc.stderr, "stderr")
+            # closed by the exit waiter once the pumps drain, so
+            # restart loops don't leak rotator fds
+            proc._logmon = lm
+            return proc
         stdout = subprocess.DEVNULL
         stderr = subprocess.DEVNULL
         if cfg.alloc_dir:
@@ -65,6 +91,10 @@ class RawExecDriver(DriverPlugin):
 
         def waiter():
             code = proc.wait()
+            lm = getattr(proc, "_logmon", None)
+            if lm is not None:
+                lm.wait(2.0)
+                lm.close()
             if code < 0:
                 handle.set_exit(TaskExitResult(exit_code=0, signal=-code))
             else:
@@ -138,20 +168,7 @@ class ExecDriver(RawExecDriver):
 
     def _popen(self, cfg: TaskConfig, argv) -> subprocess.Popen:
         # restricted environment: only the task's own env plus PATH
-        cwd = cfg.alloc_dir or None
+        cwd = cfg.task_dir or cfg.alloc_dir or None
         env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
         env.update(cfg.env or {})
-        stdout = subprocess.DEVNULL
-        stderr = subprocess.DEVNULL
-        if cfg.alloc_dir:
-            os.makedirs(cfg.alloc_dir, exist_ok=True)
-            stdout = open(
-                os.path.join(cfg.alloc_dir, f"{cfg.name}.stdout"), "ab"
-            )
-            stderr = open(
-                os.path.join(cfg.alloc_dir, f"{cfg.name}.stderr"), "ab"
-            )
-        return subprocess.Popen(
-            argv, cwd=cwd, env=env, stdout=stdout, stderr=stderr,
-            start_new_session=True,
-        )
+        return self._spawn(cfg, argv, cwd, env)

@@ -1,0 +1,182 @@
+"""Client-side allocation garbage collection (reference client/gc.go).
+
+Terminal alloc runners are tracked in an LRU-by-termination-time heap;
+GC destroys their alloc dirs when any of the reference's triggers fire
+(gc.go:AllocCounter + MakeRoomFor):
+
+* more than ``max_allocs`` total allocs exist on the client,
+* available disk in the alloc mount drops below ``disk_usable_mb``
+  or usage rises above ``disk_usage_threshold`` percent,
+* an explicit ``collect_all`` (the ``/v1/client/gc`` surface).
+
+New placements call ``make_room_for`` first, mirroring how the
+reference GCs before building the next alloc dir.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+DEFAULT_MAX_ALLOCS = 50
+DEFAULT_DISK_USAGE_THRESHOLD = 80.0  # percent
+DEFAULT_MIN_USABLE_MB = 100
+
+
+class AllocGarbageCollector:
+    def __init__(
+        self,
+        alloc_base_dir: str = "",
+        max_allocs: int = DEFAULT_MAX_ALLOCS,
+        disk_usage_threshold: float = DEFAULT_DISK_USAGE_THRESHOLD,
+        min_usable_mb: int = DEFAULT_MIN_USABLE_MB,
+        destroy_fn: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.alloc_base_dir = alloc_base_dir
+        self.max_allocs = max_allocs
+        self.disk_usage_threshold = disk_usage_threshold
+        self.min_usable_mb = min_usable_mb
+        self.destroy_fn = destroy_fn
+        self._lock = threading.Lock()
+        self._heap: List[Tuple[float, int, str]] = []
+        self._entries: Dict[str, float] = {}
+        self._live = 0
+        self._counter = itertools.count()
+
+    # ------------------------------------------------------------------
+
+    def set_live_count(self, n: int) -> None:
+        with self._lock:
+            self._live = n
+
+    def mark_terminal(self, alloc_id: str) -> None:
+        """(reference gc.go MarkForCollection)"""
+        with self._lock:
+            if alloc_id in self._entries:
+                return
+            ts = time.time()
+            self._entries[alloc_id] = ts
+            heapq.heappush(
+                self._heap, (ts, next(self._counter), alloc_id)
+            )
+
+    def remove(self, alloc_id: str) -> None:
+        with self._lock:
+            self._entries.pop(alloc_id, None)
+
+    def num_marked(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # ------------------------------------------------------------------
+
+    def _pop_oldest(self, exclude=None) -> Optional[str]:
+        skipped: List[Tuple[float, int, str]] = []
+        found: Optional[str] = None
+        with self._lock:
+            while self._heap:
+                entry = heapq.heappop(self._heap)
+                alloc_id = entry[2]
+                if alloc_id not in self._entries:
+                    continue
+                if exclude and alloc_id in exclude:
+                    skipped.append(entry)
+                    continue
+                del self._entries[alloc_id]
+                found = alloc_id
+                break
+            for entry in skipped:
+                heapq.heappush(self._heap, entry)
+        return found
+
+    def _destroy(self, alloc_id: str) -> None:
+        if self.destroy_fn is not None:
+            self.destroy_fn(alloc_id)
+        elif self.alloc_base_dir:
+            shutil.rmtree(
+                os.path.join(self.alloc_base_dir, alloc_id),
+                ignore_errors=True,
+            )
+
+    def _disk_stats(self) -> Optional[Tuple[float, float]]:
+        """(used_percent, usable_mb) of the alloc mount, or None."""
+        if not self.alloc_base_dir or not os.path.isdir(
+            self.alloc_base_dir
+        ):
+            return None
+        try:
+            st = os.statvfs(self.alloc_base_dir)
+        except OSError:
+            return None
+        total = st.f_blocks * st.f_frsize
+        avail = st.f_bavail * st.f_frsize
+        if total <= 0:
+            return None
+        used_pct = 100.0 * (total - avail) / total
+        return used_pct, avail / (1024 * 1024)
+
+    # ------------------------------------------------------------------
+
+    def collect(self, alloc_id: str) -> bool:
+        """GC one specific terminal alloc (reference gc.go Collect)."""
+        with self._lock:
+            present = alloc_id in self._entries
+            if present:
+                del self._entries[alloc_id]
+        if present:
+            self._destroy(alloc_id)
+        return present
+
+    def collect_all(self) -> int:
+        """(reference gc.go CollectAll, the /v1/client/gc path)"""
+        n = 0
+        while True:
+            alloc_id = self._pop_oldest()
+            if alloc_id is None:
+                return n
+            self._destroy(alloc_id)
+            n += 1
+
+    def make_room_for(self, new_allocs: int = 1, exclude=None) -> int:
+        """GC until the client can take `new_allocs` more
+        (reference gc.go MakeRoomFor).  `exclude` protects allocs that
+        must survive (e.g. a migration predecessor)."""
+        n = 0
+        while True:
+            with self._lock:
+                total = self._live + len(self._entries)
+            if total + new_allocs <= self.max_allocs:
+                break
+            alloc_id = self._pop_oldest(exclude)
+            if alloc_id is None:
+                break
+            self._destroy(alloc_id)
+            n += 1
+        n += self._gc_for_disk(exclude)
+        return n
+
+    def _gc_for_disk(self, exclude=None) -> int:
+        n = 0
+        while True:
+            stats = self._disk_stats()
+            if stats is None:
+                return n
+            used_pct, usable_mb = stats
+            if (
+                used_pct < self.disk_usage_threshold
+                and usable_mb > self.min_usable_mb
+            ):
+                return n
+            alloc_id = self._pop_oldest(exclude)
+            if alloc_id is None:
+                return n
+            self._destroy(alloc_id)
+            n += 1
+
+    def periodic(self) -> int:
+        """One periodic pass (reference gc.go run loop body)."""
+        return self.make_room_for(0)

@@ -1,0 +1,99 @@
+"""Stop allocs after losing contact with servers
+(reference client/heartbeatstop.go:43-60).
+
+Task groups can set ``stop_after_client_disconnect``; when the client's
+last successful heartbeat is older than an alloc's configured timeout,
+the alloc is stopped locally even though no server told us to — the
+servers will independently mark it lost and reschedule it, and this
+prevents a split-brain double-run when the partition heals.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class HeartbeatStopper:
+    def __init__(
+        self,
+        stop_alloc_fn: Callable[[str], None],
+        check_interval: float = 1.0,
+        min_grace: float = 0.0,
+    ) -> None:
+        self.stop_alloc_fn = stop_alloc_fn
+        self.check_interval = check_interval
+        # floor on the effective timeout: an alloc must never be
+        # stopped between two healthy heartbeats (reference
+        # heartbeatstop.go watches the server-assigned TTL; callers
+        # pass ~2x their heartbeat interval)
+        self.min_grace = min_grace
+        self._lock = threading.Lock()
+        # alloc_id -> stop_after seconds
+        self._watched: Dict[str, float] = {}
+        self._last_ok = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+
+    def allocation_hook(self, alloc) -> None:
+        """Track an alloc if its group opts in
+        (reference heartbeatstop.go allocHook)."""
+        if alloc.should_client_stop():
+            tg = alloc.job.lookup_task_group(alloc.task_group)
+            with self._lock:
+                self._watched[alloc.id] = float(
+                    tg.stop_after_client_disconnect_s or 0.0
+                )
+
+    def remove(self, alloc_id: str) -> None:
+        with self._lock:
+            self._watched.pop(alloc_id, None)
+
+    def note_heartbeat_ok(self) -> None:
+        with self._lock:
+            self._last_ok = time.time()
+
+    # ------------------------------------------------------------------
+
+    def expired(self) -> Dict[str, float]:
+        """Allocs whose stop_after has elapsed since the last good
+        heartbeat."""
+        now = time.time()
+        with self._lock:
+            since = now - self._last_ok
+            return {
+                alloc_id: timeout
+                for alloc_id, timeout in self._watched.items()
+                if since > max(timeout, self.min_grace)
+            }
+
+    def check_once(self) -> int:
+        stopped = 0
+        for alloc_id in list(self.expired()):
+            self.remove(alloc_id)
+            try:
+                self.stop_alloc_fn(alloc_id)
+                stopped += 1
+            except Exception:  # noqa: BLE001
+                pass
+        return stopped
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="heartbeat-stop", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval):
+            self.check_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

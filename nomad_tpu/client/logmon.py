@@ -1,0 +1,203 @@
+"""Task log rotation (reference client/logmon/logmon.go +
+client/lib/fifo + logging/logrotator).
+
+The reference runs a separate ``logmon`` go-plugin process per task that
+pumps the task's stdout/stderr FIFOs into size-rotated files under the
+alloc's shared ``logs/`` dir.  Here the same pump-into-rotator design
+runs as threads: drivers hand us pipe file objects and we stream them
+into ``<logs>/<task>.{stdout,stderr}.N`` with ``LogConfig``-equivalent
+max-file-size / max-files limits (structs.go LogConfig: 10 files x
+10 MiB default).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import BinaryIO, List, Optional
+
+DEFAULT_MAX_FILES = 10
+DEFAULT_MAX_FILE_SIZE_MB = 10
+
+
+class FileRotator:
+    """Size-based rotating writer (reference logging/rotator.go).
+
+    Files are named ``<base>.<idx>`` with monotonically increasing idx;
+    once ``max_files`` exist the oldest is deleted.
+    """
+
+    def __init__(
+        self,
+        dir_path: str,
+        base_name: str,
+        max_files: int = DEFAULT_MAX_FILES,
+        max_file_size_bytes: int = DEFAULT_MAX_FILE_SIZE_MB * 1024 * 1024,
+    ) -> None:
+        self.dir = dir_path
+        self.base = base_name
+        self.max_files = max(1, max_files)
+        self.max_bytes = max(1, max_file_size_bytes)
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._idx = self._latest_index()
+        self._fh: Optional[BinaryIO] = None
+        self._size = 0
+        self._open_current()
+
+    # ------------------------------------------------------------------
+
+    def _path(self, idx: int) -> str:
+        return os.path.join(self.dir, f"{self.base}.{idx}")
+
+    def _latest_index(self) -> int:
+        best = 0
+        prefix = self.base + "."
+        try:
+            entries = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for entry in entries:
+            if entry.startswith(prefix):
+                try:
+                    best = max(best, int(entry[len(prefix):]))
+                except ValueError:
+                    pass
+        return best
+
+    def _open_current(self) -> None:
+        path = self._path(self._idx)
+        self._fh = open(path, "ab")
+        self._size = self._fh.tell()
+
+    def _rotate(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        self._idx += 1
+        self._open_current()
+        # prune beyond max_files
+        floor = self._idx - self.max_files + 1
+        for idx in range(max(0, floor - 8), floor):
+            try:
+                os.unlink(self._path(idx))
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+
+    def write(self, data: bytes) -> int:
+        with self._lock:
+            if self._fh is None:
+                self._open_current()
+            remaining = data
+            while remaining:
+                space = self.max_bytes - self._size
+                if space <= 0:
+                    self._rotate()
+                    space = self.max_bytes
+                chunk = remaining[:space]
+                self._fh.write(chunk)
+                self._size += len(chunk)
+                remaining = remaining[len(chunk):]
+            self._fh.flush()
+            return len(data)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def existing_files(self) -> List[str]:
+        prefix = self.base + "."
+        try:
+            names = [
+                n for n in os.listdir(self.dir) if n.startswith(prefix)
+            ]
+        except OSError:
+            return []
+        return sorted(
+            names, key=lambda n: int(n[len(prefix):])
+        )
+
+
+class LogMon:
+    """Per-task stdout+stderr rotators plus pipe pumps
+    (reference logmon.Start: creates the two rotators and wires FIFOs)."""
+
+    def __init__(
+        self,
+        log_dir: str,
+        task_name: str,
+        max_files: int = DEFAULT_MAX_FILES,
+        max_file_size_mb: int = DEFAULT_MAX_FILE_SIZE_MB,
+    ) -> None:
+        size = max_file_size_mb * 1024 * 1024
+        self.stdout = FileRotator(
+            log_dir, f"{task_name}.stdout", max_files, size
+        )
+        self.stderr = FileRotator(
+            log_dir, f"{task_name}.stderr", max_files, size
+        )
+        self._pumps: List[threading.Thread] = []
+
+    def pump(self, stream: BinaryIO, which: str = "stdout") -> None:
+        """Stream a pipe into the matching rotator until EOF."""
+        rot = self.stdout if which == "stdout" else self.stderr
+
+        # partial reads so live output lands before the task exits —
+        # BufferedReader.read(n) would block for the full n bytes
+        read = getattr(stream, "read1", stream.read)
+
+        def run() -> None:
+            try:
+                while True:
+                    chunk = read(4096)
+                    if not chunk:
+                        break
+                    rot.write(chunk)
+            except (OSError, ValueError):
+                pass
+            finally:
+                try:
+                    stream.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self._pumps.append(t)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        for t in self._pumps:
+            t.join(timeout)
+
+    def close(self) -> None:
+        self.stdout.close()
+        self.stderr.close()
+
+
+def read_task_log(
+    log_dir: str, task_name: str, kind: str = "stdout",
+    max_bytes: int = 64 * 1024,
+) -> bytes:
+    """Tail the logical log across rotated files, newest last
+    (reference client fs logs endpoint semantics)."""
+    rot_prefix = f"{task_name}.{kind}."
+    try:
+        names = [
+            n for n in os.listdir(log_dir) if n.startswith(rot_prefix)
+        ]
+    except OSError:
+        return b""
+    names.sort(key=lambda n: int(n[len(rot_prefix):]))
+    out = b""
+    for name in reversed(names):
+        try:
+            with open(os.path.join(log_dir, name), "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        out = data + out
+        if len(out) >= max_bytes:
+            break
+    return out[-max_bytes:]

@@ -63,12 +63,18 @@ class TaskRunner:
         driver: Optional[DriverPlugin] = None,
         secrets=None,
         catalog=None,
+        task_dir=None,
+        task_env=None,
     ) -> None:
         self.secrets = secrets
         self.catalog = catalog
         self.alloc_id = alloc_id
         self.task = task
         self.alloc_dir = alloc_dir
+        # allocdir layout (client/allocdir) + resolved env
+        # (client/taskenv); optional — tests drive runners bare
+        self.task_dir = task_dir
+        self.task_env = task_env
         self.env = env or {}
         self.driver = driver or new_driver(task.driver)
         self.restarts = RestartTracker(restart_policy, batch)
@@ -129,13 +135,30 @@ class TaskRunner:
                     )
                     return
             while not self._kill.is_set():
+                config = dict(self.task.config)
+                env = {**self.env, **self.task.env}
+                if self.task_env is not None:
+                    # ${...} interpolation over driver config
+                    # (reference taskenv ParseAndReplace on the config);
+                    # builder values win over the legacy flat env —
+                    # they carry the allocdir-layout paths
+                    config = self.task_env.replace_all(config)
+                    env = {**env, **self.task_env.all()}
                 cfg = TaskConfig(
                     id=self.task_id,
                     name=self.task.name,
                     alloc_id=self.alloc_id,
-                    config=dict(self.task.config),
-                    env={**self.env, **self.task.env},
+                    config=config,
+                    env=env,
                     alloc_dir=self.alloc_dir,
+                    task_dir=(
+                        self.task_dir.local_dir if self.task_dir else ""
+                    ),
+                    logs_dir=(
+                        self.task_dir.log_dir if self.task_dir else ""
+                    ),
+                    log_max_files=self.task.log_max_files,
+                    log_max_file_size_mb=self.task.log_max_file_size_mb,
                     resources=self.task.resources,
                 )
                 try:
@@ -201,6 +224,10 @@ class TaskRunner:
 
     def kill(self) -> None:
         self._kill.set()
+        # a runner killed before start() would otherwise never signal
+        # done and wedge anything waiting on it
+        if self._thread is None:
+            self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)

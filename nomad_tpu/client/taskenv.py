@@ -1,0 +1,162 @@
+"""Task environment construction + interpolation
+(reference client/taskenv/env.go).
+
+Builds the full ``NOMAD_*`` environment a task sees and interpolates
+``${...}`` references in arbitrary strings (task config values, template
+bodies, service names) against that environment plus node attributes —
+the client-side counterpart of the scheduler's constraint target
+resolution (reference client/taskenv/env.go:ParseAndReplace).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+_VAR_RE = re.compile(r"\$\{([^}]+)\}")
+
+
+def _clean(name: str) -> str:
+    """Env-var-safe key (reference helper/envvars)."""
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+class TaskEnv:
+    """Immutable resolved environment (reference taskenv.TaskEnv)."""
+
+    def __init__(self, env: Dict[str, str], node_attrs: Dict[str, str]):
+        self.env = env
+        self.node_attrs = node_attrs
+
+    def all(self) -> Dict[str, str]:
+        return dict(self.env)
+
+    def replace(self, s: str) -> str:
+        """Interpolate ``${...}`` occurrences.  Recognized forms:
+        ``${NOMAD_*}`` / ``${env.X}`` (the task env), ``${node.*}`` /
+        ``${attr.*}`` / ``${meta.*}`` (node attributes, same namespace
+        as scheduler constraints, feasible.go:713 resolveTarget).
+        Unknown references resolve to the empty string, matching the
+        reference's behavior for missing attributes."""
+
+        def sub(m: re.Match) -> str:
+            key = m.group(1).strip()
+            if key.startswith("env."):
+                return self.env.get(key[4:], "")
+            if (
+                key.startswith("node.")
+                or key.startswith("attr.")
+                or key.startswith("meta.")
+            ):
+                return self.node_attrs.get(key, "")
+            return self.env.get(key, "")
+
+        return _VAR_RE.sub(sub, s)
+
+    def replace_all(self, obj):
+        """Deep-interpolate strings in dict/list/str config trees."""
+        if isinstance(obj, str):
+            return self.replace(obj)
+        if isinstance(obj, dict):
+            return {k: self.replace_all(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [self.replace_all(v) for v in obj]
+        return obj
+
+
+class Builder:
+    """Assembles a TaskEnv from alloc/task/node context
+    (reference taskenv.Builder; setters mirror setAlloc/setTask/setNode).
+    """
+
+    def __init__(self) -> None:
+        self.env: Dict[str, str] = {}
+        self.node_attrs: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+
+    def set_alloc(self, alloc, job=None, tg=None) -> "Builder":
+        job = job or alloc.job
+        self.env["NOMAD_ALLOC_ID"] = alloc.id
+        self.env["NOMAD_SHORT_ALLOC_ID"] = alloc.id[:8]
+        self.env["NOMAD_ALLOC_NAME"] = alloc.name
+        self.env["NOMAD_ALLOC_INDEX"] = str(alloc.index())
+        self.env["NOMAD_GROUP_NAME"] = alloc.task_group
+        self.env["NOMAD_NAMESPACE"] = alloc.namespace
+        if job is not None:
+            self.env["NOMAD_JOB_ID"] = job.id
+            self.env["NOMAD_JOB_NAME"] = job.name
+            if job.parent_id:
+                self.env["NOMAD_JOB_PARENT_ID"] = job.parent_id
+            tg = tg or job.lookup_task_group(alloc.task_group)
+            # job < group < task meta precedence, NOMAD_META_<key> forms
+            meta = dict(job.meta)
+            if tg is not None:
+                meta.update(tg.meta)
+            self._set_meta(meta)
+        return self
+
+    def set_task(self, task, task_dir=None) -> "Builder":
+        self.env["NOMAD_TASK_NAME"] = task.name
+        if task.resources is not None:
+            self.env["NOMAD_CPU_LIMIT"] = str(task.resources.cpu)
+            self.env["NOMAD_MEMORY_LIMIT"] = str(task.resources.memory_mb)
+            self._set_networks(task.resources.networks)
+        self._set_meta(task.meta)
+        for k, v in task.env.items():
+            self.env[k] = v
+        if task_dir is not None:
+            self.env["NOMAD_ALLOC_DIR"] = task_dir.shared_alloc_dir
+            self.env["NOMAD_TASK_DIR"] = task_dir.local_dir
+            self.env["NOMAD_SECRETS_DIR"] = task_dir.secrets_dir
+        return self
+
+    def set_node(self, node, region: str = "global") -> "Builder":
+        self.env["NOMAD_DC"] = node.datacenter
+        self.env["NOMAD_REGION"] = region
+        # constraint-style namespace (feasible.go resolveTarget)
+        self.node_attrs["node.unique.id"] = node.id
+        self.node_attrs["node.unique.name"] = node.name
+        self.node_attrs["node.datacenter"] = node.datacenter
+        self.node_attrs["node.region"] = region
+        self.node_attrs["node.class"] = node.node_class
+        for k, v in node.attributes.items():
+            self.node_attrs[f"attr.{k}"] = str(v)
+        for k, v in node.meta.items():
+            self.node_attrs[f"meta.{k}"] = str(v)
+        return self
+
+    def set_ports(self, port_map: Dict[str, int], ip: str = "127.0.0.1"):
+        """Explicit port assignments (post-placement NetworkIndex offer:
+        structs/network.py) → NOMAD_{ADDR,IP,HOST_PORT,PORT}_<label>."""
+        for label, port in port_map.items():
+            lab = _clean(label)
+            self.env[f"NOMAD_IP_{lab}"] = ip
+            self.env[f"NOMAD_PORT_{lab}"] = str(port)
+            self.env[f"NOMAD_HOST_PORT_{lab}"] = str(port)
+            self.env[f"NOMAD_ADDR_{lab}"] = f"{ip}:{port}"
+        return self
+
+    def set_vault_token(self, token: str) -> "Builder":
+        if token:
+            self.env["VAULT_TOKEN"] = token
+        return self
+
+    # ------------------------------------------------------------------
+
+    def _set_networks(self, networks) -> None:
+        for net in networks:
+            ip = net.ip or "127.0.0.1"
+            for port in list(net.reserved_ports) + list(net.dynamic_ports):
+                if not port.label:
+                    continue
+                value = port.value or port.to
+                if value:
+                    self.set_ports({port.label: value}, ip=ip)
+
+    def _set_meta(self, meta: Dict[str, str]) -> None:
+        for k, v in meta.items():
+            self.env[f"NOMAD_META_{_clean(k)}"] = str(v)
+            self.env[f"NOMAD_META_{_clean(k).upper()}"] = str(v)
+
+    def build(self) -> TaskEnv:
+        return TaskEnv(dict(self.env), dict(self.node_attrs))

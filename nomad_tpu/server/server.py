@@ -630,6 +630,16 @@ class Server:
             raise KeyError(f"no client connection for {alloc.node_id}")
         import os
 
+        # rotated logs first (client/logmon layout under alloc/logs/),
+        # then the flat legacy path
+        from ..client.logmon import read_task_log as _read_rotated
+
+        log_dir = os.path.join(
+            client.data_dir, "allocs", alloc_id, "alloc", "logs"
+        )
+        data = _read_rotated(log_dir, task, kind, max_bytes)
+        if data:
+            return data
         path = os.path.join(
             client.data_dir, "allocs", alloc_id, f"{task}.{kind}"
         )

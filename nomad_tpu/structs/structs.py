@@ -609,6 +609,11 @@ class Task:
     artifacts: List[Dict[str, Any]] = field(default_factory=list)
     templates: List[Dict[str, Any]] = field(default_factory=list)
     meta: Dict[str, str] = field(default_factory=dict)
+    dispatch_payload_file: str = ""
+    # LogConfig (reference structs.go LogConfig: MaxFiles,
+    # MaxFileSizeMB; consumed by client/logmon)
+    log_max_files: int = 10
+    log_max_file_size_mb: int = 10
 
 
 SCALING_POLICY_TYPE_HORIZONTAL = "horizontal"
