@@ -45,6 +45,12 @@ def _clean(value: Any) -> Any:
         return {str(k): _clean(v) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
         return [_clean(v) for v in value]
+    if isinstance(value, bytes):
+        # dispatch payloads ride the API base64-encoded, as in the
+        # reference's api.Job Payload field
+        import base64
+
+        return base64.b64encode(value).decode()
     return value
 
 
@@ -443,5 +449,27 @@ def job_from_dict(raw: Dict) -> Job:
                 _get(per, "prohibit_overlap", "ProhibitOverlap",
                      default=False)
             ),
+        )
+    param = _get(raw, "parameterized", "ParameterizedJob", "Parameterized")
+    if param:
+        job.parameterized = {
+            "payload": _get(param, "payload", "Payload", default=""),
+            "meta_required": _get(
+                param, "meta_required", "MetaRequired", default=[]
+            )
+            or [],
+            "meta_optional": _get(
+                param, "meta_optional", "MetaOptional", default=[]
+            )
+            or [],
+        }
+    payload = _get(raw, "payload", "Payload")
+    if payload:
+        import base64
+
+        job.payload = (
+            base64.b64decode(payload)
+            if isinstance(payload, str)
+            else bytes(payload)
         )
     return job

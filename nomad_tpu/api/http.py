@@ -229,11 +229,19 @@ class APIHandler(BaseHTTPRequestHandler):
         if m and method in ("POST", "PUT"):
             self._check_acl("dispatch-job", ns)
             body = self._body()
+            # Payload arrives base64-encoded (api.Job Payload contract)
+            import base64
+
+            raw_payload = body.get("Payload") or ""
+            try:
+                payload = base64.b64decode(raw_payload) or None
+            except (ValueError, TypeError):
+                raise HTTPError(400, "Payload must be base64")
             child = srv.dispatch_job(
                 ns,
                 m.group(1),
                 meta=body.get("Meta") or body.get("meta"),
-                payload=(body.get("Payload") or "").encode() or None,
+                payload=payload,
             )
             self._respond({"DispatchedJobID": child.id})
             return True

@@ -33,6 +33,7 @@ class AllocRunner:
         node=None,
         region: str = "global",
         prev_watcher=None,
+        device_manager=None,
     ) -> None:
         self.secrets = secrets
         self.catalog = catalog
@@ -41,6 +42,7 @@ class AllocRunner:
         self.alloc = alloc
         self.on_update = on_update
         self.prev_watcher = prev_watcher
+        self.device_manager = device_manager
         self._lock = threading.Lock()
         self.task_runners: Dict[str, TaskRunner] = {}
         self._destroyed = False
@@ -91,7 +93,31 @@ class AllocRunner:
                 if node is not None:
                     b.set_node(node, region)
                 b.set_task(task, task_dir)
+                # group-level port offers (AllocatedSharedResources)
+                if alloc.allocated_resources is not None:
+                    for p in alloc.allocated_resources.shared.ports:
+                        b.set_ports(
+                            {p.label: p.value},
+                            ip=p.host_ip or "127.0.0.1",
+                        )
                 task_env = b.build()
+            # device reservations -> env pinning (devices.py; reference
+            # taskrunner/device_hook.go)
+            extra_env = {}
+            if (
+                self.device_manager is not None
+                and alloc.allocated_resources is not None
+            ):
+                tr_res = alloc.allocated_resources.tasks.get(task.name)
+                for dev in tr_res.devices if tr_res else ():
+                    try:
+                        spec = self.device_manager.reserve(
+                            alloc.id, dev.vendor, dev.type, dev.name,
+                            dev.device_ids,
+                        )
+                        extra_env.update(spec.envs)
+                    except KeyError:
+                        pass
             self.task_runners[task.name] = TaskRunner(
                 alloc_id=alloc.id,
                 task=task,
@@ -105,6 +131,8 @@ class AllocRunner:
                 catalog=catalog,
                 task_dir=task_dir,
                 task_env=task_env,
+                payload=(job.payload if job is not None else b""),
+                extra_env=extra_env,
             )
 
     # ------------------------------------------------------------------
@@ -126,15 +154,22 @@ class AllocRunner:
         self._start_tasks()
 
     def _wait_prev_then_start(self) -> None:
-        while not self.prev_watcher.wait(timeout=0.25):
+        try:
+            while not self.prev_watcher.wait(timeout=0.25):
+                with self._lock:
+                    if self._destroyed:
+                        return
             with self._lock:
                 if self._destroyed:
                     return
-        with self._lock:
-            if self._destroyed:
-                return
-        if self.alloc_dir_obj is not None:
-            self.prev_watcher.migrate(self.alloc_dir_obj)
+            if self.alloc_dir_obj is not None:
+                self.prev_watcher.migrate(self.alloc_dir_obj)
+        finally:
+            # releases the predecessor's GC pin whether or not the
+            # migration ran (client.py sets on_done)
+            on_done = getattr(self.prev_watcher, "on_done", None)
+            if on_done is not None:
+                on_done()
         self._start_tasks()
 
     def _start_tasks(self) -> None:
@@ -230,6 +265,8 @@ class AllocRunner:
             tr.kill()
         if self.csi_manager is not None:
             self.csi_manager.unmount_all(self.alloc.id)
+        if self.device_manager is not None:
+            self.device_manager.free(self.alloc.id)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         ok = True

@@ -57,6 +57,15 @@ class Client:
                 self.node, include_tpu=include_tpu_fingerprint
             )
         fingerprint_drivers(self.node, self.drivers)
+        # device plugins (devices.py; reference client/devicemanager)
+        from .devices import DeviceManager, TPUDevicePlugin
+
+        self.device_manager = DeviceManager(
+            plugins=(
+                [TPUDevicePlugin()] if include_tpu_fingerprint else []
+            )
+        )
+        self.device_manager.fingerprint_node(self.node)
         from .csi import CSIManager
 
         self.csi_manager = CSIManager(
@@ -218,6 +227,13 @@ class Client:
                     ),
                     poll_terminal=self._alloc_terminal_on_server,
                 )
+                # pin the predecessor until migration has had its shot
+                if alloc.previous_allocation:
+                    prev_id = alloc.previous_allocation
+                    self.gc.protect(prev_id)
+                    prev_watcher.on_done = (
+                        lambda pid=prev_id: self.gc.unprotect(pid)
+                    )
                 runner = AllocRunner(
                     alloc,
                     data_dir=self.data_dir,
@@ -231,6 +247,7 @@ class Client:
                     ),
                     node=self.node,
                     prev_watcher=prev_watcher,
+                    device_manager=self.device_manager,
                 )
                 self.alloc_runners[alloc_id] = runner
                 self.heartbeat_stopper.allocation_hook(alloc)

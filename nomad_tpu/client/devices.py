@@ -1,0 +1,195 @@
+"""Device plugin framework + manager (reference plugins/device/device.go
+DevicePlugin: Fingerprint stream, Reserve, Stats; client/devicemanager/
+manager.go; devices/gpu/nvidia as the canonical plugin).
+
+TPU-native: the flagship plugin fingerprints attached TPU/accelerator
+chips through JAX (the nvml analog, devices/gpu/nvidia/device.go:88) and
+its Reserve hands back the env pinning a task to its reserved chips
+(``JAX_VISIBLE_DEVICES``/``TPU_VISIBLE_CHIPS``) the way the nvidia
+plugin returns ``CUDA_VISIBLE_DEVICES``.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs import Node, NodeDeviceResource
+
+
+@dataclass
+class ReservationSpec:
+    """What Reserve returns (reference device.proto ContainerReservation:
+    env + mounts + devices)."""
+
+    envs: Dict[str, str] = field(default_factory=dict)
+    mounts: List[Dict[str, str]] = field(default_factory=list)
+    devices: List[Dict[str, str]] = field(default_factory=list)
+
+
+class DevicePlugin:
+    """Plugin surface (reference plugins/device/device.go:DevicePlugin).
+    """
+
+    vendor = ""
+    type = ""
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        """Detected device groups + attributes."""
+        raise NotImplementedError
+
+    def reserve(self, device_ids: List[str]) -> ReservationSpec:
+        """Claim instances for a task; returns env/mount specs."""
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        """instance id -> stats map (reference Stats stream)."""
+        return {}
+
+
+class TPUDevicePlugin(DevicePlugin):
+    """Accelerator plugin backed by JAX (devices/gpu/nvidia analog)."""
+
+    vendor = "google"
+    type = "tpu"
+
+    def __init__(self) -> None:
+        self._devices = None
+
+    def _detect(self):
+        if self._devices is None:
+            try:
+                import jax
+
+                self._devices = [
+                    d for d in jax.devices() if d.platform != "cpu"
+                ]
+            except Exception:  # noqa: BLE001
+                self._devices = []
+        return self._devices
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        devices = self._detect()
+        by_kind: Dict[str, List] = {}
+        for d in devices:
+            by_kind.setdefault(d.device_kind, []).append(d)
+        out = []
+        for kind, devs in by_kind.items():
+            out.append(
+                NodeDeviceResource(
+                    vendor=self.vendor,
+                    type=self.type,
+                    name=kind.replace(" ", "-").lower(),
+                    instance_ids=[str(d.id) for d in devs],
+                    attributes={
+                        "platform": devs[0].platform,
+                        "count": str(len(devs)),
+                    },
+                )
+            )
+        return out
+
+    def reserve(self, device_ids: List[str]) -> ReservationSpec:
+        ids = ",".join(device_ids)
+        return ReservationSpec(
+            envs={
+                "JAX_VISIBLE_DEVICES": ids,
+                "TPU_VISIBLE_CHIPS": ids,
+            }
+        )
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        devices = self._detect()
+        out: Dict[str, Dict[str, float]] = {}
+        for d in devices:
+            stats: Dict[str, float] = {}
+            try:
+                mem = d.memory_stats()
+                stats["bytes_in_use"] = float(mem.get("bytes_in_use", 0))
+                stats["bytes_limit"] = float(
+                    mem.get("bytes_limit", 0)
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            out[str(d.id)] = stats
+        return out
+
+
+class DeviceManager:
+    """Client-side device plugin lifecycle + reservation bookkeeping
+    (reference client/devicemanager/manager.go + the instance tracking
+    the task runner's device hook relies on)."""
+
+    def __init__(self, plugins: Optional[List[DevicePlugin]] = None):
+        self.plugins: List[DevicePlugin] = (
+            plugins if plugins is not None else [TPUDevicePlugin()]
+        )
+        self._lock = threading.Lock()
+        # (vendor, type, name) -> plugin
+        self._routes: Dict = {}
+        # alloc_id -> list[(plugin, ids)]
+        self._reservations: Dict[str, List] = {}
+
+    def fingerprint_node(self, node: Node) -> None:
+        """Fold every plugin's device groups into the node
+        (reference devicemanager fingerprint fan-in)."""
+        with self._lock:
+            for plugin in self.plugins:
+                try:
+                    groups = plugin.fingerprint()
+                except Exception:  # noqa: BLE001
+                    continue
+                for g in groups:
+                    self._routes[(g.vendor, g.type, g.name)] = plugin
+                    existing = [
+                        d
+                        for d in node.node_resources.devices
+                        if d.id() == g.id()
+                    ]
+                    if existing:
+                        existing[0].instance_ids = g.instance_ids
+                        existing[0].attributes.update(g.attributes)
+                    else:
+                        node.node_resources.devices.append(g)
+
+    def reserve(
+        self,
+        alloc_id: str,
+        vendor: str,
+        dev_type: str,
+        name: str,
+        device_ids: List[str],
+    ) -> ReservationSpec:
+        with self._lock:
+            plugin = self._routes.get((vendor, dev_type, name))
+        if plugin is None:
+            raise KeyError(
+                f"no device plugin for {vendor}/{dev_type}/{name}"
+            )
+        spec = plugin.reserve(device_ids)
+        with self._lock:
+            self._reservations.setdefault(alloc_id, []).append(
+                (plugin, list(device_ids))
+            )
+        return spec
+
+    def free(self, alloc_id: str) -> None:
+        with self._lock:
+            self._reservations.pop(alloc_id, None)
+
+    def reserved_ids(self, alloc_id: str) -> List[str]:
+        with self._lock:
+            out: List[str] = []
+            for _plugin, ids in self._reservations.get(alloc_id, []):
+                out.extend(ids)
+            return out
+
+    def all_stats(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        out = {}
+        for plugin in self.plugins:
+            key = f"{plugin.vendor}/{plugin.type}"
+            try:
+                out[key] = plugin.stats()
+            except Exception:  # noqa: BLE001
+                out[key] = {}
+        return out

@@ -11,11 +11,17 @@ from typing import Dict, Type
 from .base import DriverHandle, DriverPlugin, TaskExitResult
 from .mock import MockDriver
 from .exec import ExecDriver, RawExecDriver
+from .java import JavaDriver
+from .qemu import QemuDriver
+from .docker import DockerDriver
 
 BUILTIN_DRIVERS: Dict[str, Type[DriverPlugin]] = {
     "mock_driver": MockDriver,
     "exec": ExecDriver,
     "raw_exec": RawExecDriver,
+    "java": JavaDriver,
+    "qemu": QemuDriver,
+    "docker": DockerDriver,
 }
 
 
@@ -35,4 +41,7 @@ __all__ = [
     "MockDriver",
     "ExecDriver",
     "RawExecDriver",
+    "JavaDriver",
+    "QemuDriver",
+    "DockerDriver",
 ]

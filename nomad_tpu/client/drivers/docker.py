@@ -1,0 +1,180 @@
+"""Docker container driver (reference drivers/docker/driver.go).
+
+Runs containers through the docker CLI as the portable seam (the
+reference talks to dockerd's API socket; the lifecycle mapping is the
+same): ``start`` = ``docker run`` with name/env/volume/port wiring,
+``stop`` = ``docker stop -t <kill_timeout>``, ``destroy`` =
+``docker rm -f``.  Fingerprint probes the daemon and reports the driver
+unhealthy when unreachable, so placement simply skips docker tasks on
+nodes without a daemon (feasibility via DriverChecker).
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from .base import (
+    DriverHandle,
+    DriverPlugin,
+    TaskConfig,
+    TaskExitResult,
+)
+
+
+class _ContainerHandle(DriverHandle):
+    def __init__(self, task_id: str, container: str) -> None:
+        super().__init__(task_id)
+        self.container = container
+
+
+class DockerDriver(DriverPlugin):
+    name = "docker"
+
+    def __init__(self) -> None:
+        self._docker = shutil.which("docker")
+        self.handles: Dict[str, _ContainerHandle] = {}
+        self._daemon_ok: Optional[bool] = None
+
+    # ------------------------------------------------------------------
+
+    def _daemon_reachable(self) -> bool:
+        if self._daemon_ok is None:
+            if not self._docker:
+                self._daemon_ok = False
+            else:
+                try:
+                    out = subprocess.run(
+                        [self._docker, "version", "--format",
+                         "{{.Server.Version}}"],
+                        capture_output=True, text=True, timeout=5,
+                    )
+                    self._daemon_ok = out.returncode == 0
+                    self._server_version = (out.stdout or "").strip()
+                except (OSError, subprocess.TimeoutExpired):
+                    self._daemon_ok = False
+        return bool(self._daemon_ok)
+
+    def fingerprint(self) -> Dict[str, str]:
+        if not self._daemon_reachable():
+            return {f"driver.{self.name}": "0"}
+        attrs = {f"driver.{self.name}": "1"}
+        if getattr(self, "_server_version", ""):
+            attrs[f"driver.{self.name}.version"] = self._server_version
+        return attrs
+
+    # ------------------------------------------------------------------
+
+    def _run_argv(self, cfg: TaskConfig, container: str):
+        image = cfg.config.get("image", "")
+        if not image:
+            raise ValueError("docker driver requires image in config")
+        argv = [self._docker, "run", "--rm", "--name", container]
+        for k, v in (cfg.env or {}).items():
+            argv += ["-e", f"{k}={v}"]
+        if cfg.resources is not None:
+            argv += ["--memory", f"{cfg.resources.memory_mb}m"]
+        if cfg.alloc_dir:
+            argv += ["-v", f"{cfg.alloc_dir}:/alloc"]
+        for vol in cfg.config.get("volumes", []) or []:
+            argv += ["-v", vol]
+        port_map = cfg.config.get("port_map", {}) or {}
+        for guest, host in port_map.items():
+            argv += ["-p", f"{host}:{guest}"]
+        argv.append(image)
+        command = cfg.config.get("command", "")
+        if command:
+            argv.append(command)
+        argv += list(cfg.config.get("args", []))
+        return argv
+
+    def start_task(self, cfg: TaskConfig) -> DriverHandle:
+        if not self._daemon_reachable():
+            raise RuntimeError("docker daemon not reachable on this node")
+        container = f"nomad-{cfg.id}".replace("/", "-")
+        argv = self._run_argv(cfg, container)
+        proc = subprocess.Popen(
+            argv,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        handle = _ContainerHandle(cfg.id, container)
+        handle.proc = proc
+        self.handles[cfg.id] = handle
+
+        def waiter():
+            code = proc.wait()
+            handle.set_exit(TaskExitResult(exit_code=code))
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return handle
+
+    def wait_task(self, task_id, timeout=None):
+        handle = self.handles.get(task_id)
+        if handle is None:
+            return TaskExitResult(err="unknown task")
+        return handle.wait(timeout)
+
+    def stop_task(self, task_id, timeout=5.0, signal="SIGTERM"):
+        handle = self.handles.get(task_id)
+        if handle is None or not handle.is_running():
+            return
+        try:
+            subprocess.run(
+                [self._docker, "stop", "-t", str(int(timeout)),
+                 handle.container],
+                capture_output=True, timeout=timeout + 10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    def destroy_task(self, task_id, force=False):
+        handle = self.handles.get(task_id)
+        if handle is not None and handle.is_running():
+            if not force:
+                raise RuntimeError("task is still running")
+            try:
+                subprocess.run(
+                    [self._docker, "rm", "-f", handle.container],
+                    capture_output=True, timeout=30,
+                )
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        self.handles.pop(task_id, None)
+
+    def inspect_task(self, task_id):
+        return self.handles.get(task_id)
+
+    def recover_task(self, task_id, handle_state) -> bool:
+        container = handle_state.get("container", "")
+        if not container or not self._daemon_reachable():
+            return False
+        try:
+            out = subprocess.run(
+                [self._docker, "inspect", "--format",
+                 "{{.State.Running}}", container],
+                capture_output=True, text=True, timeout=5,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if out.returncode != 0 or "true" not in out.stdout:
+            return False
+        handle = _ContainerHandle(task_id, container)
+        self.handles[task_id] = handle
+
+        def poll():
+            code = 0
+            try:
+                out = subprocess.run(
+                    [self._docker, "wait", container],
+                    capture_output=True, text=True, timeout=None,
+                )
+                code = int((out.stdout or "0").strip() or 0)
+            except (OSError, ValueError):
+                pass
+            handle.set_exit(TaskExitResult(exit_code=code))
+
+        threading.Thread(target=poll, daemon=True).start()
+        return True

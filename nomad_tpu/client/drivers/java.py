@@ -1,0 +1,65 @@
+"""Java task driver (reference drivers/java/driver.go).
+
+Runs ``java [jvm_options] -jar <jar_path> [args]`` or
+``java [jvm_options] -cp <class_path> <class> [args]`` through the
+shared subprocess executor.  Fingerprint probes the local JVM
+(reference java/driver.go Fingerprint parsing ``java -version``) and
+reports the driver unhealthy when none is found.
+"""
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+from typing import Dict
+
+from .base import TaskConfig
+from .exec import RawExecDriver
+
+_VERSION_RE = re.compile(r'version "([^"]+)"')
+
+
+class JavaDriver(RawExecDriver):
+    name = "java"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._java = shutil.which("java")
+
+    def fingerprint(self) -> Dict[str, str]:
+        if not self._java:
+            return {f"driver.{self.name}": "0"}
+        attrs = {f"driver.{self.name}": "1"}
+        try:
+            out = subprocess.run(
+                [self._java, "-version"],
+                capture_output=True, text=True, timeout=10,
+            )
+            # JVMs print the banner on stderr
+            m = _VERSION_RE.search(out.stderr or out.stdout or "")
+            if m:
+                attrs[f"driver.{self.name}.version"] = m.group(1)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        return attrs
+
+    def _build_command(self, cfg: TaskConfig):
+        if not self._java:
+            raise RuntimeError("java runtime not found on this node")
+        argv = [self._java]
+        argv += list(cfg.config.get("jvm_options", []))
+        jar = cfg.config.get("jar_path", "")
+        klass = cfg.config.get("class", "")
+        if jar:
+            argv += ["-jar", jar]
+        elif klass:
+            cp = cfg.config.get("class_path", "")
+            if cp:
+                argv += ["-cp", cp]
+            argv.append(klass)
+        else:
+            raise ValueError(
+                "java driver requires jar_path or class in config"
+            )
+        argv += list(cfg.config.get("args", []))
+        return argv

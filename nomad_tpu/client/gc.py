@@ -46,6 +46,9 @@ class AllocGarbageCollector:
         self._entries: Dict[str, float] = {}
         self._live = 0
         self._counter = itertools.count()
+        # allocs pinned against collection (migration predecessors
+        # whose sticky data hasn't been pulled yet); refcounted
+        self._protected: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
 
@@ -68,6 +71,22 @@ class AllocGarbageCollector:
         with self._lock:
             self._entries.pop(alloc_id, None)
 
+    def protect(self, alloc_id: str) -> None:
+        """Pin an alloc against GC until unprotect (e.g. while a
+        successor still needs its sticky ephemeral-disk data)."""
+        with self._lock:
+            self._protected[alloc_id] = (
+                self._protected.get(alloc_id, 0) + 1
+            )
+
+    def unprotect(self, alloc_id: str) -> None:
+        with self._lock:
+            n = self._protected.get(alloc_id, 0) - 1
+            if n <= 0:
+                self._protected.pop(alloc_id, None)
+            else:
+                self._protected[alloc_id] = n
+
     def num_marked(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -83,7 +102,9 @@ class AllocGarbageCollector:
                 alloc_id = entry[2]
                 if alloc_id not in self._entries:
                     continue
-                if exclude and alloc_id in exclude:
+                if (exclude and alloc_id in exclude) or (
+                    alloc_id in self._protected
+                ):
                     skipped.append(entry)
                     continue
                 del self._entries[alloc_id]

@@ -1,0 +1,94 @@
+"""Artifact fetching (reference
+client/allocrunner/taskrunner/getter/getter.go, which wraps go-getter).
+
+Each task artifact is ``{"source": ..., "destination": ..., "mode":
+"any|file|dir", "options": {"checksum": "sha256:<hex>"}}``.  Supported
+schemes: ``file://`` and bare local paths (copy), ``http(s)://`` via
+urllib.  Downloads land under the task's local dir unless `destination`
+is absolute-ish; checksum mismatches fail the fetch, which the task
+runner surfaces as a failed-setup task event exactly like the
+reference's artifact hook.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.parse
+import urllib.request
+from typing import Dict, List
+
+
+class ArtifactError(Exception):
+    pass
+
+
+def _verify_checksum(path: str, spec: str) -> None:
+    """`spec` is "<algo>:<hexdigest>" (go-getter checksum option)."""
+    try:
+        algo, want = spec.split(":", 1)
+        h = hashlib.new(algo)
+    except ValueError as exc:
+        raise ArtifactError(f"bad checksum spec {spec!r}") from exc
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(65536), b""):
+            h.update(chunk)
+    if h.hexdigest() != want.lower():
+        raise ArtifactError(
+            f"checksum mismatch for {path}: got {h.hexdigest()}, "
+            f"want {want}"
+        )
+
+
+def fetch_artifact(artifact: Dict, task_local_dir: str) -> str:
+    """Fetch one artifact into the task dir; returns the landed path."""
+    source = artifact.get("source", "")
+    if not source:
+        raise ArtifactError("artifact has no source")
+    dest_rel = artifact.get("destination", "") or "local"
+    # destinations are always sandboxed under the task local dir
+    # (reference getter.go getDestination rejects escapes)
+    root = os.path.realpath(task_local_dir)
+    dest_dir = os.path.realpath(os.path.join(task_local_dir, dest_rel))
+    if dest_dir != root and not dest_dir.startswith(root + os.sep):
+        raise ArtifactError(
+            f"artifact destination {dest_rel!r} escapes the task dir"
+        )
+    os.makedirs(dest_dir, exist_ok=True)
+
+    parsed = urllib.parse.urlparse(source)
+    checksum = (artifact.get("options") or {}).get("checksum", "")
+
+    if parsed.scheme in ("http", "https"):
+        name = os.path.basename(parsed.path) or "artifact"
+        out = os.path.join(dest_dir, name)
+        try:
+            with urllib.request.urlopen(source, timeout=30) as resp:
+                with open(out, "wb") as f:
+                    shutil.copyfileobj(resp, f)
+        except Exception as exc:  # noqa: BLE001
+            raise ArtifactError(
+                f"failed to download {source}: {exc}"
+            ) from exc
+    elif parsed.scheme in ("", "file"):
+        src = parsed.path if parsed.scheme == "file" else source
+        if not os.path.exists(src):
+            raise ArtifactError(f"artifact source {src} not found")
+        if os.path.isdir(src):
+            out = os.path.join(dest_dir, os.path.basename(src.rstrip("/")))
+            shutil.copytree(src, out, dirs_exist_ok=True)
+        else:
+            out = os.path.join(dest_dir, os.path.basename(src))
+            shutil.copy2(src, out)
+    else:
+        raise ArtifactError(
+            f"unsupported artifact scheme {parsed.scheme!r}"
+        )
+
+    if checksum and os.path.isfile(out):
+        _verify_checksum(out, checksum)
+    return out
+
+
+def fetch_all(artifacts: List[Dict], task_local_dir: str) -> List[str]:
+    return [fetch_artifact(a, task_local_dir) for a in artifacts]

@@ -185,7 +185,10 @@ def read_task_log(
     rot_prefix = f"{task_name}.{kind}."
     try:
         names = [
-            n for n in os.listdir(log_dir) if n.startswith(rot_prefix)
+            n
+            for n in os.listdir(log_dir)
+            if n.startswith(rot_prefix)
+            and n[len(rot_prefix):].isdigit()
         ]
     except OSError:
         return b""

@@ -65,6 +65,8 @@ class TaskRunner:
         catalog=None,
         task_dir=None,
         task_env=None,
+        payload: bytes = b"",
+        extra_env: Optional[Dict[str, str]] = None,
     ) -> None:
         self.secrets = secrets
         self.catalog = catalog
@@ -75,6 +77,10 @@ class TaskRunner:
         # (client/taskenv); optional — tests drive runners bare
         self.task_dir = task_dir
         self.task_env = task_env
+        # dispatch payload blob (structs.go DispatchPayloadConfig) +
+        # env injected by device reservations (devices.py)
+        self.payload = payload
+        self.extra_env = extra_env or {}
         self.env = env or {}
         self.driver = driver or new_driver(task.driver)
         self.restarts = RestartTracker(restart_policy, batch)
@@ -111,6 +117,10 @@ class TaskRunner:
     def run(self) -> None:
         """Start/wait/restart loop (reference task_runner.go:446 Run)."""
         try:
+            # pre-start hooks, in the reference's taskrunner hook order:
+            # dispatch_payload -> artifacts -> template
+            if not self._prestart_hooks():
+                return
             # render template blocks into the alloc dir before the first
             # start (reference taskrunner/template hook)
             if self.task.templates and self.alloc_dir:
@@ -136,7 +146,7 @@ class TaskRunner:
                     return
             while not self._kill.is_set():
                 config = dict(self.task.config)
-                env = {**self.env, **self.task.env}
+                env = {**self.env, **self.task.env, **self.extra_env}
                 if self.task_env is not None:
                     # ${...} interpolation over driver config
                     # (reference taskenv ParseAndReplace on the config);
@@ -201,6 +211,41 @@ class TaskRunner:
                     return
         finally:
             self._done.set()
+
+    def _prestart_hooks(self) -> bool:
+        """Dispatch-payload + artifact hooks (reference
+        taskrunner/dispatch_hook.go, artifact_hook.go).  Returns False
+        when setup failed and the task must not start."""
+        base = (
+            self.task_dir.local_dir
+            if self.task_dir is not None
+            else self.alloc_dir
+        )
+        if self.payload and self.task.dispatch_payload_file and base:
+            import os
+
+            path = os.path.join(base, self.task.dispatch_payload_file)
+            os.makedirs(os.path.dirname(path) or base, exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(self.payload)
+        if self.task.artifacts and base:
+            from .getter import ArtifactError, fetch_all
+
+            artifacts = self.task.artifacts
+            if self.task_env is not None:
+                artifacts = self.task_env.replace_all(artifacts)
+            try:
+                fetch_all(artifacts, base)
+            except ArtifactError as exc:
+                self.exit_result = TaskExitResult(
+                    exit_code=-1, err=str(exc)
+                )
+                self._set_state(
+                    TASK_STATE_DEAD, failed=True,
+                    event="Failed Artifact Download",
+                )
+                return False
+        return True
 
     def _maybe_restart(self, result: TaskExitResult) -> bool:
         delay = self.restarts.next_restart(result)

@@ -606,6 +606,7 @@ class Server:
         child.parent_id = parent.id
         child.parameterized = None
         child.meta = {**parent.meta, **meta}
+        child.payload = bytes(payload or b"")
         self.register_job(child)
         return child
 

@@ -718,6 +718,9 @@ class Job:
     spreads: List[Spread] = field(default_factory=list)
     periodic: Optional[Periodic] = None
     parameterized: Optional[Dict[str, Any]] = None
+    # dispatch input blob (reference structs.go Job.Payload, written to
+    # tasks via DispatchPayloadConfig at structs.go DispatchPayload)
+    payload: bytes = b""
     parent_id: str = ""
     all_at_once: bool = False
     update: Optional[UpdateStrategy] = None

@@ -1,0 +1,293 @@
+"""Driver plugins (java/qemu/docker), artifact getter, device plugin
+framework (reference drivers/java, drivers/qemu, drivers/docker,
+taskrunner/getter, plugins/device + client/devicemanager).
+"""
+import hashlib
+import os
+import shutil
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.devices import (
+    DeviceManager,
+    DevicePlugin,
+    ReservationSpec,
+)
+from nomad_tpu.client.drivers import (
+    BUILTIN_DRIVERS,
+    DockerDriver,
+    JavaDriver,
+    QemuDriver,
+    new_driver,
+)
+from nomad_tpu.client.drivers.base import TaskConfig
+from nomad_tpu.client.getter import ArtifactError, fetch_all, fetch_artifact
+from nomad_tpu.structs import Node, NodeDeviceResource
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def test_all_reference_drivers_registered():
+    for name in ("mock_driver", "exec", "raw_exec", "java", "qemu",
+                 "docker"):
+        assert name in BUILTIN_DRIVERS
+        assert new_driver(name) is not None
+
+
+def test_java_driver_fingerprint_gates_on_jvm():
+    d = JavaDriver()
+    fp = d.fingerprint()
+    if shutil.which("java"):
+        assert fp["driver.java"] == "1"
+    else:
+        assert fp["driver.java"] == "0"
+        with pytest.raises(RuntimeError):
+            d._build_command(
+                TaskConfig(config={"jar_path": "/x.jar"})
+            )
+
+
+def test_java_driver_command_shapes():
+    d = JavaDriver()
+    d._java = "/usr/bin/java"  # force-detect for argv assembly
+    argv = d._build_command(
+        TaskConfig(
+            config={
+                "jar_path": "app.jar",
+                "jvm_options": ["-Xmx64m"],
+                "args": ["serve"],
+            }
+        )
+    )
+    assert argv == ["/usr/bin/java", "-Xmx64m", "-jar", "app.jar",
+                    "serve"]
+    argv = d._build_command(
+        TaskConfig(
+            config={"class": "Main", "class_path": "lib/*"}
+        )
+    )
+    assert argv == ["/usr/bin/java", "-cp", "lib/*", "Main"]
+    with pytest.raises(ValueError):
+        d._build_command(TaskConfig(config={}))
+
+
+def test_qemu_driver_command_shapes(tmp_path):
+    d = QemuDriver()
+    d._qemu = "/usr/bin/qemu-system-x86_64"
+    cfg = TaskConfig(
+        config={
+            "image_path": "vm.qcow2",
+            "port_map": {"22": 2222},
+        },
+        task_dir=str(tmp_path),
+    )
+    cfg.resources = mock.job().task_groups[0].tasks[0].resources
+    argv = d._build_command(cfg)
+    assert argv[0] == "/usr/bin/qemu-system-x86_64"
+    assert f"file={tmp_path}/vm.qcow2,format=qcow2" in argv
+    assert any("hostfwd=tcp::2222-:22" in a for a in argv)
+    with pytest.raises(ValueError):
+        d._build_command(TaskConfig(config={}))
+
+
+def test_docker_driver_gates_on_daemon():
+    d = DockerDriver()
+    fp = d.fingerprint()
+    if not d._daemon_reachable():
+        assert fp["driver.docker"] == "0"
+        with pytest.raises(RuntimeError):
+            d.start_task(TaskConfig(id="t", config={"image": "alpine"}))
+
+
+def test_docker_run_argv():
+    d = DockerDriver()
+    d._docker = "/usr/bin/docker"
+    cfg = TaskConfig(
+        id="t1",
+        env={"FOO": "bar"},
+        alloc_dir="/data/a1",
+        config={
+            "image": "redis:6",
+            "command": "redis-server",
+            "args": ["--port", "6380"],
+            "port_map": {"6380": 16380},
+        },
+    )
+    argv = d._run_argv(cfg, "nomad-t1")
+    assert argv[:4] == ["/usr/bin/docker", "run", "--rm", "--name"]
+    assert "redis:6" in argv
+    assert "-e" in argv and "FOO=bar" in argv
+    assert "-v" in argv and "/data/a1:/alloc" in argv
+    assert "-p" in argv and "16380:6380" in argv
+    assert argv[-3:] == ["redis-server", "--port", "6380"]
+
+
+# ---------------------------------------------------------------------------
+# artifact getter
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_local_file_with_checksum(tmp_path):
+    src = tmp_path / "artifact.bin"
+    src.write_bytes(b"payload-data")
+    digest = hashlib.sha256(b"payload-data").hexdigest()
+    dest = tmp_path / "local"
+    out = fetch_artifact(
+        {
+            "source": str(src),
+            "options": {"checksum": f"sha256:{digest}"},
+        },
+        str(dest),
+    )
+    assert os.path.exists(out)
+
+    with pytest.raises(ArtifactError):
+        fetch_artifact(
+            {
+                "source": str(src),
+                "options": {"checksum": "sha256:" + "0" * 64},
+            },
+            str(dest),
+        )
+
+
+def test_fetch_directory_and_missing(tmp_path):
+    srcdir = tmp_path / "bundle"
+    srcdir.mkdir()
+    (srcdir / "a.txt").write_text("a")
+    dest = tmp_path / "local"
+    out = fetch_all([{"source": str(srcdir)}], str(dest))
+    assert os.path.exists(os.path.join(out[0], "a.txt"))
+    with pytest.raises(ArtifactError):
+        fetch_artifact({"source": str(tmp_path / "nope")}, str(dest))
+
+
+def test_fetch_rejects_escaping_destination(tmp_path):
+    src = tmp_path / "x"
+    src.write_text("x")
+    with pytest.raises(ArtifactError):
+        fetch_artifact(
+            {"source": str(src), "destination": "../../etc"},
+            str(tmp_path / "local"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# device plugin framework
+# ---------------------------------------------------------------------------
+
+
+class FakeGPUPlugin(DevicePlugin):
+    vendor = "acme"
+    type = "gpu"
+
+    def fingerprint(self):
+        return [
+            NodeDeviceResource(
+                vendor="acme", type="gpu", name="a100",
+                instance_ids=["g0", "g1"],
+                attributes={"memory_mb": 40960},
+            )
+        ]
+
+    def reserve(self, device_ids):
+        return ReservationSpec(
+            envs={"ACME_VISIBLE_DEVICES": ",".join(device_ids)}
+        )
+
+    def stats(self):
+        return {"g0": {"util": 0.5}, "g1": {"util": 0.0}}
+
+
+def test_device_manager_fingerprint_and_reserve():
+    node = Node()
+    dm = DeviceManager(plugins=[FakeGPUPlugin()])
+    dm.fingerprint_node(node)
+    devs = node.node_resources.devices
+    assert len(devs) == 1 and devs[0].name == "a100"
+    assert devs[0].instance_ids == ["g0", "g1"]
+
+    spec = dm.reserve("alloc1", "acme", "gpu", "a100", ["g1"])
+    assert spec.envs["ACME_VISIBLE_DEVICES"] == "g1"
+    assert dm.reserved_ids("alloc1") == ["g1"]
+    dm.free("alloc1")
+    assert dm.reserved_ids("alloc1") == []
+
+    with pytest.raises(KeyError):
+        dm.reserve("a2", "nvidia", "gpu", "v100", ["x"])
+
+    stats = dm.all_stats()
+    assert stats["acme/gpu"]["g0"]["util"] == 0.5
+
+
+def test_device_manager_refingerprint_updates_in_place():
+    node = Node()
+    dm = DeviceManager(plugins=[FakeGPUPlugin()])
+    dm.fingerprint_node(node)
+    dm.fingerprint_node(node)
+    assert len(node.node_resources.devices) == 1
+
+
+# ---------------------------------------------------------------------------
+# dispatch payload end-to-end
+# ---------------------------------------------------------------------------
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_dispatch_payload_written_to_task_dir(tmp_path):
+    from nomad_tpu.client import Client
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs import Task
+
+    srv = Server()
+    srv.start()
+    cli = Client(
+        srv, node=Node(), data_dir=str(tmp_path),
+        heartbeat_interval=5.0,
+    )
+    cli.start()
+    try:
+        job = mock.job(id="etl")
+        job.type = "batch"
+        job.parameterized = {"payload": "required"}
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0] = Task(
+            name="consume",
+            driver="raw_exec",
+            dispatch_payload_file="input.json",
+            config={
+                "command": "/bin/sh",
+                "args": ["-c", "cat input.json"],
+            },
+        )
+        srv.register_job(job)
+        child = srv.dispatch_job(
+            "default", "etl", payload=b'{"rows": 3}'
+        )
+        assert child.payload == b'{"rows": 3}'
+        assert wait_until(
+            lambda: any(
+                a.client_status == "complete"
+                for a in srv.store.allocs_by_job("default", child.id)
+            )
+        ), "dispatched alloc did not complete"
+        alloc = srv.store.allocs_by_job("default", child.id)[0]
+        out = srv.read_task_log(alloc.id, "consume", "stdout")
+        assert b'{"rows": 3}' in out
+    finally:
+        cli.stop()
+        srv.stop()
