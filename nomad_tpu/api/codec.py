@@ -450,6 +450,37 @@ def job_from_dict(raw: Dict) -> Job:
                      default=False)
             ),
         )
+    mr = _get(raw, "multiregion", "Multiregion")
+    if mr:
+        from ..structs import (
+            Multiregion,
+            MultiregionRegion,
+            MultiregionStrategy,
+        )
+
+        strat = _get(mr, "strategy", "Strategy") or {}
+        job.multiregion = Multiregion(
+            strategy=MultiregionStrategy(
+                max_parallel=int(
+                    _get(strat, "max_parallel", "MaxParallel", default=0)
+                ),
+                on_failure=_get(
+                    strat, "on_failure", "OnFailure", default=""
+                ),
+            ),
+            regions=[
+                MultiregionRegion(
+                    name=_get(r, "name", "Name", default=""),
+                    count=int(_get(r, "count", "Count", default=0)),
+                    datacenters=_get(
+                        r, "datacenters", "Datacenters", default=[]
+                    )
+                    or [],
+                    meta=_get(r, "meta", "Meta", default={}) or {},
+                )
+                for r in _get(mr, "regions", "Regions", default=[]) or []
+            ],
+        )
     param = _get(raw, "parameterized", "ParameterizedJob", "Parameterized")
     if param:
         job.parameterized = {

@@ -234,7 +234,9 @@ class APIHandler(BaseHTTPRequestHandler):
 
             raw_payload = body.get("Payload") or ""
             try:
-                payload = base64.b64decode(raw_payload) or None
+                payload = (
+                    base64.b64decode(raw_payload, validate=True) or None
+                )
             except (ValueError, TypeError):
                 raise HTTPError(400, "Payload must be base64")
             child = srv.dispatch_job(

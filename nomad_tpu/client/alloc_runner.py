@@ -43,6 +43,7 @@ class AllocRunner:
         self.on_update = on_update
         self.prev_watcher = prev_watcher
         self.device_manager = device_manager
+        self._setup_error: str = ""
         self._lock = threading.Lock()
         self.task_runners: Dict[str, TaskRunner] = {}
         self._destroyed = False
@@ -102,7 +103,9 @@ class AllocRunner:
                         )
                 task_env = b.build()
             # device reservations -> env pinning (devices.py; reference
-            # taskrunner/device_hook.go)
+            # taskrunner/device_hook.go).  A reservation that cannot be
+            # honored fails the alloc in run(); starting unpinned would
+            # let the task grab devices reserved by its neighbors.
             extra_env = {}
             if (
                 self.device_manager is not None
@@ -116,8 +119,10 @@ class AllocRunner:
                             dev.device_ids,
                         )
                         extra_env.update(spec.envs)
-                    except KeyError:
-                        pass
+                    except KeyError as exc:
+                        self._setup_error = (
+                            f"device reservation failed: {exc}"
+                        )
             self.task_runners[task.name] = TaskRunner(
                 alloc_id=alloc.id,
                 task=task,
@@ -173,6 +178,14 @@ class AllocRunner:
         self._start_tasks()
 
     def _start_tasks(self) -> None:
+        if self._setup_error:
+            with self._lock:
+                self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+            if self.device_manager is not None:
+                self.device_manager.free(self.alloc.id)
+            if self.on_update:
+                self.on_update(self.alloc)
+            return
         if not self._csi_mount():
             return
         for tr in self.task_runners.values():

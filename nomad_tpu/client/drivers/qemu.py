@@ -69,11 +69,16 @@ class QemuDriver(RawExecDriver):
         cpus = max(1, int(cfg.config.get("cpus", 1)))
         if cfg.resources is not None:
             mem_mb = max(1, int(cfg.resources.memory_mb))
+        # machine type must match the emulated arch ("pc" is x86-only;
+        # aarch64 boards use "virt")
+        machine = cfg.config.get(
+            "machine",
+            "pc" if "x86" in os.path.basename(self._qemu) else "virt",
+        )
+        accel = cfg.config.get("accelerator", "tcg")
         argv = [
             self._qemu,
-            "-machine", "type=pc,accel=" + cfg.config.get(
-                "accelerator", "tcg"
-            ),
+            "-machine", f"type={machine},accel={accel}",
             "-m", f"{mem_mb}M",
             "-smp", str(cpus),
             "-drive", f"file={image},format=qcow2",

@@ -85,7 +85,12 @@ def fetch_artifact(artifact: Dict, task_local_dir: str) -> str:
             f"unsupported artifact scheme {parsed.scheme!r}"
         )
 
-    if checksum and os.path.isfile(out):
+    if checksum:
+        if not os.path.isfile(out):
+            raise ArtifactError(
+                f"checksum requested but {out} is not a regular file "
+                "(directories cannot be checksummed)"
+            )
         _verify_checksum(out, checksum)
     return out
 

@@ -224,8 +224,22 @@ class TaskRunner:
         if self.payload and self.task.dispatch_payload_file and base:
             import os
 
-            path = os.path.join(base, self.task.dispatch_payload_file)
-            os.makedirs(os.path.dirname(path) or base, exist_ok=True)
+            root = os.path.realpath(base)
+            path = os.path.realpath(
+                os.path.join(base, self.task.dispatch_payload_file)
+            )
+            # same sandbox rule as artifact destinations (getter.py)
+            if path != root and not path.startswith(root + os.sep):
+                self.exit_result = TaskExitResult(
+                    exit_code=-1,
+                    err="dispatch_payload_file escapes the task dir",
+                )
+                self._set_state(
+                    TASK_STATE_DEAD, failed=True,
+                    event="Failed Payload Write",
+                )
+                return False
+            os.makedirs(os.path.dirname(path) or root, exist_ok=True)
             with open(path, "wb") as f:
                 f.write(self.payload)
         if self.task.artifacts and base:

@@ -450,6 +450,31 @@ def parse(text: str) -> Job:
             "spec": per.get("cron", per.get("spec", "")),
             "prohibit_overlap": bool(per.get("prohibit_overlap", False)),
         }
+    par = _first(body.get("parameterized"))
+    if par:
+        job_dict["parameterized"] = {
+            "payload": par.get("payload", ""),
+            "meta_required": par.get("meta_required", []) or [],
+            "meta_optional": par.get("meta_optional", []) or [],
+        }
+    mr = _first(body.get("multiregion"))
+    if mr:
+        strat = _first(mr.get("strategy"), {}) or {}
+        job_dict["multiregion"] = {
+            "strategy": {
+                "max_parallel": int(strat.get("max_parallel", 0)),
+                "on_failure": strat.get("on_failure", ""),
+            },
+            "regions": [
+                {
+                    "name": r.get("__label__", r.get("name", "")),
+                    "count": int(r.get("count", 0)),
+                    "datacenters": r.get("datacenters", []) or [],
+                    "meta": _first(r.get("meta"), {}) or {},
+                }
+                for r in _all(mr.get("region"))
+            ],
+        }
     return job_from_dict(job_dict)
 
 

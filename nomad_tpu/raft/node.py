@@ -169,6 +169,30 @@ class RaftNode:
 
     # -- public API -----------------------------------------------------
 
+    def add_peer(self, addr: str) -> None:
+        """Membership change: add a voter (the autopilot/join seam;
+        single-step config change, not joint consensus — safe here
+        because changes are serialized through the leader)."""
+        with self._lock:
+            if addr == self.addr or addr in self.peers:
+                return
+            self.peers.append(addr)
+            if self.state == LEADER:
+                self._next_index[addr] = self.log.last_index() + 1
+                self._match_index[addr] = 0
+        self._wake.set()
+
+    def remove_peer(self, addr: str) -> None:
+        """Membership change: drop a dead voter (reference
+        autopilot RemoveFailedServer path)."""
+        with self._lock:
+            if addr not in self.peers:
+                return
+            self.peers.remove(addr)
+            self._next_index.pop(addr, None)
+            self._match_index.pop(addr, None)
+        self._wake.set()
+
     def is_leader(self) -> bool:
         with self._lock:
             return self.state == LEADER

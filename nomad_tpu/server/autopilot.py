@@ -1,0 +1,146 @@
+"""Autopilot: automated server-fleet hygiene (reference
+nomad/autopilot.go, which delegates to consul/autopilot: dead-server
+cleanup, health tracking, failure-tolerance stats).
+
+The leader periodically reconciles gossip membership against the raft
+configuration: servers gossip marks failed/left get removed from the
+raft peer set — but only while a quorum of the original configuration
+stays intact, so a partition can never talk the leader into shrinking
+below safety (reference autopilot.go pruneDeadServers' quorum check).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class AutopilotConfig:
+    """(reference structs.go AutopilotConfig; operator API surface)"""
+
+    cleanup_dead_servers: bool = True
+    last_contact_threshold_s: float = 0.2
+    max_trailing_logs: int = 250
+    server_stabilization_time_s: float = 10.0
+    enable_redundancy_zones: bool = False
+    disable_upgrade_migration: bool = False
+
+
+@dataclass
+class ServerHealth:
+    """(reference autopilot ServerHealth)"""
+
+    id: str = ""
+    name: str = ""
+    address: str = ""
+    healthy: bool = True
+    voter: bool = True
+    last_contact_s: float = 0.0
+    last_index: int = 0
+    stable_since: float = 0.0
+
+
+class Autopilot:
+    def __init__(
+        self,
+        cluster,
+        config: Optional[AutopilotConfig] = None,
+        check_interval: float = 1.0,
+    ) -> None:
+        self.cluster = cluster
+        self.config = config or AutopilotConfig()
+        self.check_interval = check_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.removed: List[str] = []
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="autopilot", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval):
+            try:
+                if self.cluster.is_leader():
+                    self.prune_dead_servers()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+
+    def _members_by_status(self) -> Dict[str, List]:
+        out: Dict[str, List] = {"alive": [], "dead": [], "left": []}
+        for m in self.cluster.gossip.all_members():
+            out.setdefault(m.status, []).append(m)
+        return out
+
+    def prune_dead_servers(self) -> List[str]:
+        """Remove failed/left servers from the raft configuration when
+        quorum is preserved (reference autopilot.go pruneDeadServers).
+        Returns the addresses removed this pass."""
+        if not self.config.cleanup_dead_servers:
+            return []
+        raft = self.cluster.raft
+        peers = set(raft.peers) | {raft.addr}
+        members = self._members_by_status()
+        dead = [
+            m.addr
+            for m in members["dead"] + members["left"]
+            if m.addr in peers and m.addr != raft.addr
+        ]
+        if not dead:
+            return []
+        # quorum guard: the reference refuses to remove more than
+        # (peers-1)/2 — removal must leave a majority of the original
+        # configuration alive
+        if len(dead) > (len(peers) - 1) // 2:
+            return []
+        removed = []
+        for addr in dead:
+            self.cluster.broadcast_peer_removal(addr)
+            removed.append(addr)
+        self.removed.extend(removed)
+        return removed
+
+    # ------------------------------------------------------------------
+
+    def server_health(self) -> List[ServerHealth]:
+        """(reference operator autopilot health endpoint)"""
+        raft = self.cluster.raft
+        statuses = {
+            m.addr: m.status
+            for m in self.cluster.gossip.all_members()
+        }
+        out = []
+        for addr in [raft.addr] + list(raft.peers):
+            out.append(
+                ServerHealth(
+                    id=addr,
+                    name=addr,
+                    address=addr,
+                    healthy=statuses.get(addr, "alive") == "alive",
+                    voter=True,
+                )
+            )
+        return out
+
+    def stats(self) -> Dict:
+        health = self.server_health()
+        healthy = sum(1 for h in health if h.healthy)
+        return {
+            "Healthy": healthy == len(health),
+            "NumServers": len(health),
+            "NumHealthy": healthy,
+            "FailureTolerance": max(0, (healthy - 1) // 2),
+        }

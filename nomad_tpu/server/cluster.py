@@ -67,6 +67,9 @@ class ReplicatedStore:
             "update_node_eligibility", (node_id, eligibility)
         )
 
+    def upsert_node_events(self, node_id, events):
+        return self._raft_apply("upsert_node_events", (node_id, events))
+
     def update_node_drain(self, node_id, drain, strategy=None):
         return self._raft_apply(
             "update_node_drain", (node_id, drain, strategy)
@@ -199,6 +202,10 @@ class ClusterServer(Server):
         # leader-forwarding channel (reference nomad/rpc.go: one port,
         # multiplexed raft + RPC + serf)
         self.transport.register(addr, self._handle_cluster_rpc)
+        # dead-server cleanup (reference nomad/autopilot.go)
+        from .autopilot import Autopilot
+
+        self.autopilot = Autopilot(self)
 
     # -- raft plumbing --------------------------------------------------
 
@@ -235,7 +242,27 @@ class ClusterServer(Server):
             args, kw = pickle.loads(payload["args"])
             result = self._leader_route(payload["op"], *args, **kw)
             return {"result": pickle.dumps(result)}
+        if method == "remove_peer":
+            # autopilot config change fanned out by the leader
+            self.raft.remove_peer(payload["peer"])
+            return {}
         raise ValueError(f"unknown cluster rpc {method!r}")
+
+    def broadcast_peer_removal(self, peer: str) -> None:
+        """Autopilot removal: drop the dead server from every live
+        member's raft configuration (the reference replicates the
+        config change through raft; here the leader fans it out and
+        rejoining servers resync from the leader's snapshot)."""
+        self.raft.remove_peer(peer)
+        for m in self.gossip.alive_members():
+            if m.addr in (self.addr, peer):
+                continue
+            try:
+                self.transport.rpc(
+                    self.addr, m.addr, "remove_peer", {"peer": peer}
+                )
+            except TransportError:
+                pass
 
     # -- membership / federation ---------------------------------------
 
@@ -329,9 +356,11 @@ class ClusterServer(Server):
         self._running = True
         self.gossip.start()
         self.raft.start()
+        self.autopilot.start()
 
     def stop(self) -> None:
         self._running = False
+        self.autopilot.stop()
         self.raft.stop()
         # graceful departure: broadcast LEFT so peers don't gossip a
         # failure (serf Leave vs. a detected member-failed)

@@ -281,6 +281,22 @@ class DeploymentWatcher:
         if job is not None:
             self._create_eval(d, job)
 
+    # -- multiregion hooks (reference deploymentwatcher/
+    # multiregion_oss.go: cross-region rollout coordination is an
+    # enterprise feature; OSS carries the spec and runs the local
+    # region's deployment, with these hooks as no-ops) ----------------
+
+    def next_region(self, deployment_id: str, status: str) -> None:
+        """Called when the local region's deployment finishes; would
+        unblock the next region in the multiregion strategy."""
+
+    def run_deployment(self, deployment_id: str) -> None:
+        """Would transition a multiregion deployment out of 'pending'
+        once its turn arrives."""
+
+    def pause_deployments_for_job(self, namespace: str, job_id: str):
+        """Would pause sibling-region deployments on fail_all."""
+
     def _latest_stable_version(self, job):
         versions = self.store.job_versions.get(
             (job.namespace, job.id), []

@@ -204,6 +204,9 @@ class ServerFSM:
     def _apply_update_node_drain(self, node_id, drain, strategy):
         return self.store.update_node_drain(node_id, drain, strategy)
 
+    def _apply_upsert_node_events(self, node_id, events):
+        return self.store.upsert_node_events(node_id, events)
+
     def _apply_upsert_job(self, job, keep_versions=6):
         return self.store.upsert_job(job, keep_versions)
 

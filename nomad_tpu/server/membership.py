@@ -154,6 +154,11 @@ class Gossip:
             m for m in self.alive_members() if m.region == region
         ]
 
+    def all_members(self) -> List[Member]:
+        """Every known member regardless of status (autopilot input)."""
+        with self._lock:
+            return list(self.members.values())
+
     def member_list(self) -> List[Dict]:
         with self._lock:
             return [

@@ -132,6 +132,15 @@ class Server:
         from .services import ServiceCatalog
 
         self.catalog = ServiceCatalog(self)
+        # raft-index <-> wall-clock witness on every state mutation
+        # (reference fsm.go Apply -> timetable.Witness)
+        from .timetable import TimeTable
+
+        self.timetable = TimeTable()
+        # ReplicatedStore forwards add_watcher to its local store
+        self.store.add_watcher(
+            lambda _table, index: self.timetable.witness(index)
+        )
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._running = False
@@ -227,6 +236,7 @@ class Server:
 
     def register_job(self, job: Job) -> Evaluation:
         self._validate_job(job)
+        self._interpolate_multiregion(job)
         self.store.upsert_job(job)
         if job.is_periodic() or job.is_parameterized():
             # launched by the periodic dispatcher / dispatch call instead
@@ -243,6 +253,31 @@ class Server:
         self.store.upsert_evals([ev])
         self.on_eval_update(ev)
         return ev
+
+    def _interpolate_multiregion(self, job: Job) -> None:
+        """Specialize a multiregion job for the region it landed in
+        (reference job_endpoint_hooks.go jobImpliedConstraints +
+        multiregion hook: the local region's count/datacenters/meta
+        override the job-wide defaults; cross-region deployment
+        coordination itself is the enterprise no-op,
+        deploymentwatcher/multiregion_oss.go)."""
+        if job.multiregion is None:
+            return
+        region = job.multiregion.region(
+            getattr(self, "region", job.region) or job.region
+        )
+        if region is None:
+            return
+        job.region = region.name
+        if region.datacenters:
+            job.datacenters = list(region.datacenters)
+        if region.meta:
+            job.meta = {**job.meta, **region.meta}
+        if region.count:
+            # region count takes precedence over the group count
+            # (reference multiregion docs for the region stanza)
+            for tg in job.task_groups:
+                tg.count = region.count
 
     def deregister_job(
         self, namespace: str, job_id: str, purge: bool = False
@@ -349,9 +384,14 @@ class Server:
     # -- node API (reference nomad/node_endpoint.go) --------------------
 
     def register_node(self, node: Node) -> None:
+        first_seen = self.store.node_by_id(node.id) is None
         if node.status == "initializing":
             node.status = NODE_STATUS_READY
         self.store.upsert_node(node)
+        self._emit_node_event(
+            node.id,
+            "Node registered" if first_seen else "Node re-registered",
+        )
         self._reset_heartbeat(node.id)
         self.blocked.unblock(
             node.computed_class, self.store.latest_index()
@@ -390,8 +430,34 @@ class Server:
         except KeyError:
             pass
 
+    def _emit_node_event(
+        self, node_id: str, message: str, subsystem: str = "Cluster"
+    ) -> None:
+        """(reference node_endpoint.go emitting NodeEvents via
+        UpsertNodeEventsType raft entries)"""
+        from ..structs import NodeEvent
+
+        try:
+            self.store.upsert_node_events(
+                node_id,
+                [NodeEvent(message=message, subsystem=subsystem)],
+            )
+        except KeyError:
+            pass
+
     def update_node_status(self, node_id: str, status: str) -> None:
+        prev = self.store.node_by_id(node_id)
+        prev_status = prev.status if prev is not None else ""
         self.store.update_node_status(node_id, status)
+        if status != prev_status:
+            self._emit_node_event(
+                node_id,
+                (
+                    "Node heartbeat missed"
+                    if status == NODE_STATUS_DOWN
+                    else f"Node status changed to {status}"
+                ),
+            )
         node = self.store.node_by_id(node_id)
         if status == NODE_STATUS_READY:
             self._reset_heartbeat(node_id)
@@ -404,12 +470,20 @@ class Server:
         self, node_id: str, drain: bool, strategy=None
     ) -> None:
         self.store.update_node_drain(node_id, drain, strategy)
+        self._emit_node_event(
+            node_id,
+            "Node drain strategy set" if drain else "Node drain complete",
+            subsystem="Drain",
+        )
         self._create_node_evals(node_id)
 
     def update_node_eligibility(
         self, node_id: str, eligibility: str
     ) -> None:
         self.store.update_node_eligibility(node_id, eligibility)
+        self._emit_node_event(
+            node_id, f"Node marked {eligibility}", subsystem="Cluster"
+        )
         node = self.store.node_by_id(node_id)
         if eligibility == "eligible":
             self.blocked.unblock(

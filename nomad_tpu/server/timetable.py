@@ -1,0 +1,71 @@
+"""Raft-index <-> wall-clock mapping (reference nomad/timetable.go).
+
+The core GC scheduler needs "what raft index was current N hours ago"
+to turn time thresholds into index cutoffs.  The table witnesses
+(index, time) pairs at a fixed granularity and answers nearest-index /
+nearest-time queries; entries beyond the retention limit roll off.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+DEFAULT_GRANULARITY_S = 60.0
+DEFAULT_LIMIT_S = 72 * 3600.0
+
+
+class TimeTable:
+    def __init__(
+        self,
+        granularity_s: float = DEFAULT_GRANULARITY_S,
+        limit_s: float = DEFAULT_LIMIT_S,
+    ) -> None:
+        self.granularity_s = granularity_s
+        self.limit_s = limit_s
+        self._lock = threading.Lock()
+        # newest first (reference timetable.go table ordering)
+        self._table: List[Tuple[int, float]] = []
+
+    def witness(self, index: int, when: Optional[float] = None) -> None:
+        """Record that `index` was current at `when`
+        (reference timetable.go Witness)."""
+        when = time.time() if when is None else when
+        with self._lock:
+            if self._table and (
+                when - self._table[0][1] < self.granularity_s
+            ):
+                return
+            self._table.insert(0, (index, when))
+            # expire entries past the retention limit
+            cutoff = when - self.limit_s
+            while self._table and self._table[-1][1] < cutoff:
+                self._table.pop()
+
+    def nearest_index(self, when: float) -> int:
+        """Largest witnessed index at-or-before `when`, 0 if none
+        (reference timetable.go NearestIndex)."""
+        with self._lock:
+            for index, ts in self._table:
+                if ts <= when:
+                    return index
+        return 0
+
+    def nearest_time(self, index: int) -> float:
+        """Time of the oldest witness at-or-after `index`, 0 if none
+        (reference timetable.go NearestTime)."""
+        with self._lock:
+            for idx, ts in self._table:
+                if idx <= index:
+                    return ts
+        return 0.0
+
+    # snapshot support (reference fsm.go persists the table)
+
+    def serialize(self) -> List[Tuple[int, float]]:
+        with self._lock:
+            return list(self._table)
+
+    def deserialize(self, table: List[Tuple[int, float]]) -> None:
+        with self._lock:
+            self._table = [(int(i), float(t)) for i, t in table]

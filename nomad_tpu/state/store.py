@@ -206,6 +206,20 @@ class StateStore:
             self.node_table.upsert_node(node)
             return self._bump("nodes")
 
+    def upsert_node_events(self, node_id: str, events) -> int:
+        """Append to a node's bounded event history (reference
+        state_store.go UpsertNodeEvents, fsm.go:247
+        UpsertNodeEventsType)."""
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise KeyError(node_id)
+            for ev in events:
+                ev.create_index = self._index + 1
+                node.add_event(ev)
+            node.modify_index = self._index + 1
+            return self._bump("nodes")
+
     def node_by_id(self, node_id: str) -> Optional[Node]:
         return self.nodes.get(node_id)
 

@@ -367,6 +367,22 @@ class HostVolumeInfo:
 
 
 @dataclass
+class NodeEvent:
+    """An entry in a node's event history (reference structs.go
+    NodeEvent; emitted via UpsertNodeEventsType, fsm.go:247)."""
+
+    message: str = ""
+    subsystem: str = "Cluster"
+    details: Dict[str, str] = field(default_factory=dict)
+    timestamp: float = field(default_factory=time.time)
+    create_index: int = 0
+
+
+# retained events per node (reference structs.go maxNodeEvents = 10)
+MAX_NODE_EVENTS = 10
+
+
+@dataclass
 class Node:
     """(reference structs.go Node:1720)"""
 
@@ -391,8 +407,17 @@ class Node:
     drain_strategy: Optional[DrainStrategy] = None
     computed_class: str = ""
     status_updated_at: float = 0.0
+    events: List[NodeEvent] = field(default_factory=list)
     create_index: int = 0
     modify_index: int = 0
+
+    def add_event(self, event: "NodeEvent") -> None:
+        """Append to the bounded event history (reference
+        state_store.go appendNodeEvents caps at maxNodeEvents)."""
+        self.events.append(event)
+        if len(self.events) > MAX_NODE_EVENTS:
+            # the first (registration) event is always retained
+            del self.events[1:len(self.events) - MAX_NODE_EVENTS + 1]
 
     def ready(self) -> bool:
         """(reference structs.go Node.Ready)"""
@@ -702,6 +727,43 @@ class Periodic:
 
 
 @dataclass
+class MultiregionStrategy:
+    """(reference structs.go MultiregionStrategy:4645)"""
+
+    max_parallel: int = 0
+    on_failure: str = ""  # "", fail_all, fail_local
+
+
+@dataclass
+class MultiregionRegion:
+    """(reference structs.go MultiregionRegion:4650)"""
+
+    name: str = ""
+    count: int = 0
+    datacenters: List[str] = field(default_factory=list)
+    meta: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Multiregion:
+    """Multi-region deployment spec (reference structs.go
+    Multiregion:4597; the OSS deployment watcher carries the spec and
+    runs the region-local rollout — cross-region coordination hooks
+    live in deploymentwatcher/multiregion_oss.go and are no-ops)."""
+
+    strategy: MultiregionStrategy = field(
+        default_factory=MultiregionStrategy
+    )
+    regions: List[MultiregionRegion] = field(default_factory=list)
+
+    def region(self, name: str) -> Optional[MultiregionRegion]:
+        for r in self.regions:
+            if r.name == name:
+                return r
+        return None
+
+
+@dataclass
 class Job:
     """(reference structs.go Job:3748)"""
 
@@ -717,6 +779,7 @@ class Job:
     affinities: List[Affinity] = field(default_factory=list)
     spreads: List[Spread] = field(default_factory=list)
     periodic: Optional[Periodic] = None
+    multiregion: Optional[Multiregion] = None
     parameterized: Optional[Dict[str, Any]] = None
     # dispatch input blob (reference structs.go Job.Payload, written to
     # tasks via DispatchPayloadConfig at structs.go DispatchPayload)

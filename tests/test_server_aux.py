@@ -1,0 +1,291 @@
+"""Server auxiliary subsystems: TimeTable, autopilot dead-server
+cleanup, node events, multiregion job handling (reference
+nomad/timetable.go, nomad/autopilot.go, structs NodeEvent/fsm.go:247,
+structs.go Multiregion + deploymentwatcher/multiregion_oss.go).
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.autopilot import Autopilot, AutopilotConfig
+from nomad_tpu.server.cluster import TestCluster
+from nomad_tpu.server.timetable import TimeTable
+from nomad_tpu.structs import (
+    Multiregion,
+    MultiregionRegion,
+    MultiregionStrategy,
+    Node,
+)
+
+
+def wait_until(pred, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# timetable
+# ---------------------------------------------------------------------------
+
+
+def test_timetable_witness_and_lookup():
+    tt = TimeTable(granularity_s=1.0, limit_s=100.0)
+    tt.witness(10, 1000.0)
+    tt.witness(20, 1010.0)
+    tt.witness(30, 1020.0)
+    assert tt.nearest_index(1015.0) == 20
+    assert tt.nearest_index(1020.0) == 30
+    assert tt.nearest_index(999.0) == 0
+    assert tt.nearest_time(20) == 1010.0
+    assert tt.nearest_time(5) == 0.0
+
+
+def test_timetable_granularity_coalesces():
+    tt = TimeTable(granularity_s=60.0)
+    tt.witness(1, 1000.0)
+    tt.witness(2, 1001.0)  # within granularity: dropped
+    assert tt.nearest_index(2000.0) == 1
+
+
+def test_timetable_retention_rolls_off():
+    tt = TimeTable(granularity_s=1.0, limit_s=10.0)
+    tt.witness(1, 1000.0)
+    tt.witness(2, 1020.0)  # 1000.0 is now past the 10s limit
+    assert tt.nearest_index(1005.0) == 0
+
+
+def test_timetable_roundtrip():
+    tt = TimeTable(granularity_s=1.0)
+    tt.witness(5, 1000.0)
+    tt2 = TimeTable()
+    tt2.deserialize(tt.serialize())
+    assert tt2.nearest_index(1001.0) == 5
+
+
+def test_server_witnesses_state_mutations():
+    srv = Server()
+    srv.start()
+    try:
+        srv.register_node(mock.node())
+        assert srv.timetable.nearest_index(time.time() + 1) > 0
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# node events
+# ---------------------------------------------------------------------------
+
+
+def test_node_events_emitted_on_lifecycle():
+    srv = Server(heartbeat_ttl=60.0)
+    srv.start()
+    try:
+        node = mock.node()
+        srv.register_node(node)
+        stored = srv.store.node_by_id(node.id)
+        assert any(
+            "registered" in e.message for e in stored.events
+        )
+        srv.update_node_drain(node.id, True)
+        stored = srv.store.node_by_id(node.id)
+        assert any(e.subsystem == "Drain" for e in stored.events)
+        srv.update_node_status(node.id, "down")
+        stored = srv.store.node_by_id(node.id)
+        assert any(
+            "heartbeat missed" in e.message for e in stored.events
+        )
+        assert all(e.create_index > 0 for e in stored.events)
+    finally:
+        srv.stop()
+
+
+def test_node_event_history_is_bounded():
+    from nomad_tpu.structs import MAX_NODE_EVENTS, NodeEvent
+
+    node = Node()
+    node.add_event(NodeEvent(message="Node registered"))
+    for i in range(25):
+        node.add_event(NodeEvent(message=f"e{i}"))
+    assert len(node.events) == MAX_NODE_EVENTS
+    # the registration event is pinned
+    assert node.events[0].message == "Node registered"
+    assert node.events[-1].message == "e24"
+
+
+# ---------------------------------------------------------------------------
+# autopilot
+# ---------------------------------------------------------------------------
+
+
+def test_autopilot_prunes_dead_server():
+    c = TestCluster(3, heartbeat_ttl=60.0)
+    c.start()
+    try:
+        leader = c.wait_for_leader()
+        victim = c.followers()[0]
+        # hard-kill: no graceful leave, gossip must detect the failure
+        victim.raft.stop()
+        victim.gossip.stop()
+        for s in c.servers:
+            if s.addr != victim.addr:
+                c.transport.partition(victim.addr, s.addr)
+        wait_until(
+            lambda: any(
+                m.addr == victim.addr and m.status in ("dead", "left")
+                for m in leader.gossip.all_members()
+            ),
+            timeout=20.0,
+            msg="gossip marks victim failed",
+        )
+        removed = leader.autopilot.prune_dead_servers()
+        assert victim.addr in removed
+        assert victim.addr not in leader.raft.peers
+        # the other follower also dropped it
+        other = [
+            s for s in c.followers() if s.addr != victim.addr
+        ][0]
+        assert victim.addr not in other.raft.peers
+        stats = leader.autopilot.stats()
+        assert stats["NumServers"] == 2
+    finally:
+        c.stop()
+
+
+def test_autopilot_respects_quorum_guard():
+    """With 2 of 3 dead, removal would exceed (n-1)/2: refuse."""
+
+    from types import SimpleNamespace
+
+    class FakeGossip:
+        def all_members(self):
+            return [
+                SimpleNamespace(addr="a", status="alive"),
+                SimpleNamespace(addr="b", status="dead"),
+                SimpleNamespace(addr="c", status="dead"),
+            ]
+
+    class FakeRaft:
+        addr = "a"
+        peers = ["b", "c"]
+
+    class FakeCluster:
+        gossip = FakeGossip()
+        raft = FakeRaft()
+
+        def is_leader(self):
+            return True
+
+        def broadcast_peer_removal(self, addr):
+            raise AssertionError("must not remove")
+
+    ap = Autopilot(FakeCluster())
+    assert ap.prune_dead_servers() == []
+
+
+def test_autopilot_disabled_by_config():
+    class FakeCluster:
+        def is_leader(self):
+            return True
+
+    ap = Autopilot(
+        FakeCluster(),
+        config=AutopilotConfig(cleanup_dead_servers=False),
+    )
+    assert ap.prune_dead_servers() == []
+
+
+# ---------------------------------------------------------------------------
+# multiregion
+# ---------------------------------------------------------------------------
+
+
+def test_multiregion_jobspec_parse():
+    from nomad_tpu.jobspec import parse
+
+    job = parse(
+        """
+        job "global-web" {
+          datacenters = ["dc1"]
+          multiregion {
+            strategy {
+              max_parallel = 1
+              on_failure = "fail_all"
+            }
+            region "west" {
+              count = 2
+              datacenters = ["us-west-1"]
+            }
+            region "east" {
+              count = 3
+              datacenters = ["us-east-1"]
+              meta { tier = "primary" }
+            }
+          }
+          group "web" {
+            count = 1
+            task "srv" {
+              driver = "mock_driver"
+            }
+          }
+        }
+        """
+    )
+    assert job.multiregion is not None
+    assert job.multiregion.strategy.max_parallel == 1
+    assert job.multiregion.strategy.on_failure == "fail_all"
+    assert [r.name for r in job.multiregion.regions] == ["west", "east"]
+    east = job.multiregion.region("east")
+    assert east.count == 3
+    assert east.meta == {"tier": "primary"}
+
+
+def test_multiregion_register_interpolates_local_region():
+    srv = Server()
+    srv.region = "east"
+    srv.start()
+    try:
+        node = mock.node(datacenter="us-east-1")
+        srv.register_node(node)
+        job = mock.job(id="mr")
+        job.multiregion = Multiregion(
+            strategy=MultiregionStrategy(max_parallel=1),
+            regions=[
+                MultiregionRegion(
+                    name="west", count=1, datacenters=["us-west-1"]
+                ),
+                MultiregionRegion(
+                    name="east", count=2, datacenters=["us-east-1"],
+                    meta={"tier": "primary"},
+                ),
+            ],
+        )
+        srv.register_job(job)
+        stored = srv.store.job_by_id("default", "mr")
+        assert stored.region == "east"
+        assert stored.datacenters == ["us-east-1"]
+        assert stored.meta.get("tier") == "primary"
+        assert all(tg.count == 2 for tg in stored.task_groups)
+    finally:
+        srv.stop()
+
+
+def test_multiregion_codec_roundtrip():
+    from nomad_tpu.api.codec import job_from_dict, job_to_dict
+
+    job = mock.job(id="mr2")
+    job.multiregion = Multiregion(
+        strategy=MultiregionStrategy(max_parallel=2, on_failure="fail_local"),
+        regions=[MultiregionRegion(name="west", count=4)],
+    )
+    raw = job_to_dict(job)
+    back = job_from_dict(raw)
+    assert back.multiregion.strategy.max_parallel == 2
+    assert back.multiregion.regions[0].name == "west"
+    assert back.multiregion.regions[0].count == 4
