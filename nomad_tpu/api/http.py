@@ -183,6 +183,98 @@ class APIHandler(BaseHTTPRequestHandler):
                 self._respond({"EvalID": ev.id if ev else ""})
                 return True
 
+        if path == "/v1/jobs/parse" and method in ("POST", "PUT"):
+            # HCL -> canonical JSON job (reference jobs_endpoint.go
+            # /v1/jobs/parse)
+            self._check_acl("submit-job", ns)
+            from ..jobspec import ParseError, parse as parse_hcl
+
+            body = self._body()
+            try:
+                job = parse_hcl(body.get("JobHCL", ""))
+            except ParseError as exc:
+                raise HTTPError(400, str(exc))
+            self._respond(job_to_dict(job))
+            return True
+
+        if path == "/v1/validate/job" and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            body = self._body()
+            raw_job = body.get("Job") or body.get("job") or body
+            try:
+                job = job_from_dict(raw_job)
+                srv.validate_job(job)
+            except (ValueError, KeyError) as exc:
+                self._respond(
+                    {
+                        "Error": str(exc),
+                        "ValidationErrors": [str(exc)],
+                        "Warnings": "",
+                    }
+                )
+                return True
+            self._respond({"ValidationErrors": [], "Warnings": ""})
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/versions", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            versions = store.versions_of_job(ns, m.group(1))
+            if not versions:
+                raise HTTPError(404, "job not found")
+            self._respond(
+                {
+                    "Versions": [job_to_dict(j) for j in versions],
+                    "Diffs": [],
+                }
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/revert", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            body = self._body()
+            try:
+                ev = srv.revert_job(
+                    ns,
+                    m.group(1),
+                    int(body.get("JobVersion", 0)),
+                    enforce_prior_version=body.get(
+                        "EnforcePriorVersion"
+                    ),
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
+            self._respond({"EvalID": ev.id if ev else ""})
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/stable", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            body = self._body()
+            try:
+                srv.set_job_stability(
+                    ns,
+                    m.group(1),
+                    int(body.get("JobVersion", 0)),
+                    bool(body.get("Stable", True)),
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond({"Index": store.latest_index()})
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/summary", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            try:
+                self._respond(srv.job_summary(ns, m.group(1)))
+            except KeyError:
+                raise HTTPError(404, "job not found")
+            return True
+
         m = re.fullmatch(r"/v1/job/([^/]+)/evaluations", path)
         if m and method == "GET":
             self._check_acl("read-job", ns)
@@ -232,7 +324,8 @@ class APIHandler(BaseHTTPRequestHandler):
             # Payload arrives base64-encoded (api.Job Payload contract)
             import base64
 
-            raw_payload = body.get("Payload") or ""
+            # tolerate line-wrapped base64 (Go's decoder skips \r\n)
+            raw_payload = "".join((body.get("Payload") or "").split())
             try:
                 payload = (
                     base64.b64decode(raw_payload, validate=True) or None
@@ -447,6 +540,50 @@ class APIHandler(BaseHTTPRequestHandler):
             if alloc is None:
                 raise HTTPError(404, "alloc not found")
             self._respond(alloc_to_dict(alloc))
+            return True
+
+        m = re.fullmatch(r"/v1/allocation/([^/]+)/stop", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("submit-job", ns)
+            try:
+                ev = srv.stop_alloc(m.group(1))
+            except KeyError:
+                raise HTTPError(404, "alloc not found")
+            self._respond({"EvalID": ev.id if ev else ""})
+            return True
+
+        m = re.fullmatch(
+            r"/v1/client/allocation/([^/]+)/restart", path
+        )
+        if m and method in ("POST", "PUT"):
+            self._check_acl("alloc-lifecycle", ns)
+            body = self._body()
+            try:
+                srv.restart_alloc(
+                    m.group(1), body.get("TaskName", "")
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond({})
+            return True
+
+        m = re.fullmatch(
+            r"/v1/client/allocation/([^/]+)/signal", path
+        )
+        if m and method in ("POST", "PUT"):
+            self._check_acl("alloc-lifecycle", ns)
+            body = self._body()
+            try:
+                srv.signal_alloc(
+                    m.group(1),
+                    body.get("Signal", "SIGTERM"),
+                    body.get("TaskName", body.get("Task", "")),
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
+            self._respond({})
             return True
 
         if path == "/v1/evaluations" and method == "GET":
@@ -681,6 +818,130 @@ class APIHandler(BaseHTTPRequestHandler):
                         "blocked": srv.blocked.stats,
                         "plan_queue": srv.plan_queue.stats,
                     },
+                }
+            )
+            return True
+
+        if path == "/v1/agent/monitor" and method == "GET":
+            # log tail with a resumable cursor (reference
+            # command/agent/monitor streaming; poll with ?index=<seq>)
+            self._check_acl("agent:read")
+            after = int(q.get("index", "-1"))
+            wait_s = min(float(q.get("wait", "0")), 10.0)
+            lines, seq = srv.log_monitor.tail(after=after, wait=wait_s)
+            self._respond({"Lines": lines, "Index": seq})
+            return True
+
+        m = re.fullmatch(r"/v1/agent/pprof/([a-z]+)", path)
+        if m and method == "GET":
+            # python analogs of the go pprof profiles
+            # (command/agent/http.go:303)
+            self._check_acl("agent:read")
+            from ..monitor import runtime_profile, thread_dump
+
+            profile = m.group(1)
+            if profile in ("goroutine", "threadcreate"):
+                self._respond({"Profile": thread_dump()})
+                return True
+            if profile in ("heap", "allocs"):
+                self._respond(runtime_profile())
+                return True
+            raise HTTPError(404, f"unknown profile {profile!r}")
+
+        if path == "/v1/operator/autopilot/configuration":
+            self._check_acl("operator:read")
+            ap = getattr(srv, "autopilot", None)
+            if ap is None:
+                raise HTTPError(
+                    404, "autopilot requires a clustered server"
+                )
+            if method == "GET":
+                c = ap.config
+                self._respond(
+                    {
+                        "CleanupDeadServers": c.cleanup_dead_servers,
+                        "LastContactThreshold": (
+                            c.last_contact_threshold_s
+                        ),
+                        "MaxTrailingLogs": c.max_trailing_logs,
+                        "ServerStabilizationTime": (
+                            c.server_stabilization_time_s
+                        ),
+                    }
+                )
+                return True
+            if method in ("POST", "PUT"):
+                # replicated write (raft), like scheduler config
+                self._check_acl("operator:write")
+                body = self._body()
+                import dataclasses as _dc
+
+                new_cfg = _dc.replace(ap.config)
+                if "CleanupDeadServers" in body:
+                    new_cfg.cleanup_dead_servers = bool(
+                        body["CleanupDeadServers"]
+                    )
+                if "MaxTrailingLogs" in body:
+                    new_cfg.max_trailing_logs = int(
+                        body["MaxTrailingLogs"]
+                    )
+                if "LastContactThreshold" in body:
+                    new_cfg.last_contact_threshold_s = float(
+                        body["LastContactThreshold"]
+                    )
+                store.set_autopilot_config(new_cfg)
+                self._respond({"Updated": True})
+                return True
+
+        if path == "/v1/operator/autopilot/health" and method == "GET":
+            self._check_acl("operator:read")
+            ap = getattr(srv, "autopilot", None)
+            if ap is None:
+                raise HTTPError(
+                    404, "autopilot requires a clustered server"
+                )
+            stats = ap.stats()
+            self._respond(
+                {
+                    **stats,
+                    "Servers": [
+                        {
+                            "ID": h.id,
+                            "Name": h.name,
+                            "Address": h.address,
+                            "Healthy": h.healthy,
+                            "Voter": h.voter,
+                        }
+                        for h in ap.server_health()
+                    ],
+                }
+            )
+            return True
+
+        if path == "/v1/operator/raft/configuration" and method == "GET":
+            self._check_acl("operator:read")
+            raft = getattr(srv, "raft", None)
+            if raft is None:
+                # single-process server: itself is the whole config
+                self._respond(
+                    {"Servers": [], "Index": store.latest_index()}
+                )
+                return True
+            leader_addr = (
+                raft.addr if raft.is_leader() else raft.leader_hint()
+            )
+            self._respond(
+                {
+                    "Servers": [
+                        {
+                            "ID": addr,
+                            "Address": addr,
+                            "Leader": addr == leader_addr,
+                            "Voter": True,
+                        }
+                        for addr in [raft.addr] + list(raft.peers)
+                    ],
+                    "Index": store.latest_index(),
                 }
             )
             return True

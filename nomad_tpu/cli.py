@@ -258,6 +258,141 @@ def cmd_alloc_logs(args) -> None:
     sys.stdout.write(resp.get("Data", ""))
 
 
+def cmd_job_history(args) -> None:
+    data = _request("GET", f"/v1/job/{args.job_id}/versions")
+    rows = [
+        (
+            j["version"],
+            "true" if j.get("stable") else "false",
+            time.strftime(
+                "%Y-%m-%d %H:%M:%S",
+                time.localtime(j.get("submit_time", 0)),
+            ),
+        )
+        for j in data.get("Versions", [])
+    ]
+    _table(rows, ["Version", "Stable", "Submit Date"])
+
+
+def cmd_job_revert(args) -> None:
+    resp = _request(
+        "POST",
+        f"/v1/job/{args.job_id}/revert",
+        {"JobVersion": int(args.version)},
+    )
+    print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_job_inspect(args) -> None:
+    job = _request("GET", f"/v1/job/{args.job_id}")
+    print(json.dumps(job, indent=2, sort_keys=True))
+
+
+def cmd_job_validate(args) -> None:
+    if args.file.endswith(".json"):
+        with open(args.file) as f:
+            raw = json.load(f)
+        payload = {"Job": raw.get("Job") or raw.get("job") or raw}
+    else:
+        with open(args.file) as f:
+            parsed = _request(
+                "POST", "/v1/jobs/parse", {"JobHCL": f.read()}
+            )
+        payload = {"Job": parsed}
+    resp = _request("POST", "/v1/validate/job", payload)
+    errors = resp.get("ValidationErrors") or []
+    if errors:
+        for e in errors:
+            print(f"Error: {e}", file=sys.stderr)
+        sys.exit(1)
+    print("Job validation successful")
+
+
+def cmd_alloc_restart(args) -> None:
+    _request(
+        "POST",
+        f"/v1/client/allocation/{args.alloc_id}/restart",
+        {"TaskName": args.task or ""},
+    )
+    print(f"==> Restarted allocation {args.alloc_id[:8]}")
+
+
+def cmd_alloc_signal(args) -> None:
+    _request(
+        "POST",
+        f"/v1/client/allocation/{args.alloc_id}/signal",
+        {"Signal": args.signal, "TaskName": args.task or ""},
+    )
+    print(f"==> Sent {args.signal} to allocation {args.alloc_id[:8]}")
+
+
+def cmd_alloc_stop(args) -> None:
+    resp = _request(
+        "POST", f"/v1/allocation/{args.alloc_id}/stop", {}
+    )
+    print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_monitor(args) -> None:
+    """Follow the agent's logs (reference `nomad monitor`)."""
+    index = -1
+    try:
+        while True:
+            resp = _request(
+                "GET", f"/v1/agent/monitor?index={index}&wait=2"
+            )
+            for line in resp.get("Lines", []):
+                print(line)
+            index = resp.get("Index", index)
+            if not args.follow:
+                break
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_operator_autopilot(args) -> None:
+    if args.action == "get-config":
+        cfg = _request("GET", "/v1/operator/autopilot/configuration")
+        for k, v in cfg.items():
+            print(f"{k} = {v}")
+    elif args.action == "set-config":
+        body = {}
+        if args.cleanup_dead_servers is not None:
+            body["CleanupDeadServers"] = (
+                args.cleanup_dead_servers == "true"
+            )
+        _request(
+            "POST", "/v1/operator/autopilot/configuration", body
+        )
+        print("Configuration updated!")
+    elif args.action == "health":
+        h = _request("GET", "/v1/operator/autopilot/health")
+        print(
+            f"Healthy = {h['Healthy']}  Servers = {h['NumServers']}  "
+            f"FailureTolerance = {h['FailureTolerance']}"
+        )
+        _table(
+            [
+                (s["Name"], s["Address"],
+                 "alive" if s["Healthy"] else "failed",
+                 s["Voter"])
+                for s in h.get("Servers", [])
+            ],
+            ["Name", "Address", "Health", "Voter"],
+        )
+
+
+def cmd_operator_raft(args) -> None:
+    cfg = _request("GET", "/v1/operator/raft/configuration")
+    _table(
+        [
+            (s["ID"], s["Address"], s["Leader"], s["Voter"])
+            for s in cfg.get("Servers", [])
+        ],
+        ["ID", "Address", "Leader", "Voter"],
+    )
+
+
 def cmd_job_stop(args) -> None:
     purge = "?purge=true" if args.purge else ""
     resp = _request("DELETE", f"/v1/job/{args.job_id}{purge}")
@@ -563,6 +698,19 @@ def build_parser() -> argparse.ArgumentParser:
     jsc.add_argument("group")
     jsc.add_argument("count", type=int)
     jsc.set_defaults(fn=cmd_job_scale)
+    jh = job_sub.add_parser("history")
+    jh.add_argument("job_id")
+    jh.set_defaults(fn=cmd_job_history)
+    jrev = job_sub.add_parser("revert")
+    jrev.add_argument("job_id")
+    jrev.add_argument("version", type=int)
+    jrev.set_defaults(fn=cmd_job_revert)
+    jin = job_sub.add_parser("inspect")
+    jin.add_argument("job_id")
+    jin.set_defaults(fn=cmd_job_inspect)
+    jv = job_sub.add_parser("validate")
+    jv.add_argument("file")
+    jv.set_defaults(fn=cmd_job_validate)
 
     volume = sub.add_parser("volume")
     volume_sub = volume.add_subparsers(dest="volume_cmd", required=True)
@@ -626,6 +774,18 @@ def build_parser() -> argparse.ArgumentParser:
     all_.add_argument("alloc_id")
     all_.add_argument("task")
     all_.set_defaults(fn=cmd_alloc_logs)
+    alr = alloc_sub.add_parser("restart")
+    alr.add_argument("alloc_id")
+    alr.add_argument("task", nargs="?", default="")
+    alr.set_defaults(fn=cmd_alloc_restart)
+    alsg = alloc_sub.add_parser("signal")
+    alsg.add_argument("-s", dest="signal", default="SIGTERM")
+    alsg.add_argument("alloc_id")
+    alsg.add_argument("task", nargs="?", default="")
+    alsg.set_defaults(fn=cmd_alloc_signal)
+    alst = alloc_sub.add_parser("stop")
+    alst.add_argument("alloc_id")
+    alst.set_defaults(fn=cmd_alloc_stop)
 
     ev = sub.add_parser("eval")
     ev_sub = ev.add_subparsers(dest="eval_cmd", required=True)
@@ -651,6 +811,25 @@ def build_parser() -> argparse.ArgumentParser:
     osnap.add_argument("action", choices=["save", "restore"])
     osnap.add_argument("path")
     osnap.set_defaults(fn=cmd_operator_snapshot)
+    oap = op_sub.add_parser("autopilot")
+    oap.add_argument(
+        "action", choices=["get-config", "set-config", "health"]
+    )
+    oap.add_argument(
+        "-cleanup-dead-servers", dest="cleanup_dead_servers",
+        choices=["true", "false"], default=None,
+    )
+    oap.set_defaults(fn=cmd_operator_autopilot)
+    oraft = op_sub.add_parser("raft")
+    oraft.add_argument("action", choices=["list-peers"])
+    oraft.set_defaults(fn=cmd_operator_raft)
+
+    mon = sub.add_parser("monitor")
+    mon.add_argument(
+        "-no-follow", action="store_false", dest="follow",
+        default=True,
+    )
+    mon.set_defaults(fn=cmd_monitor)
 
     system = sub.add_parser("system")
     system.add_argument("action", choices=["gc"])

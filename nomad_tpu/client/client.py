@@ -381,6 +381,39 @@ class Client:
 
     # ------------------------------------------------------------------
 
+    def restart_alloc(self, alloc_id: str, task: str = "") -> None:
+        """Restart one task or every task of an alloc in place
+        (reference client/allocrunner Restart; the task runner's
+        restart loop picks the process back up)."""
+        with self._lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(alloc_id)
+        if task and task not in runner.task_runners:
+            raise KeyError(f"unknown task {task!r}")
+        for name, tr in runner.task_runners.items():
+            if task and name != task:
+                continue
+            tr.restart()
+
+    def signal_alloc(
+        self, alloc_id: str, signal: str = "SIGTERM", task: str = ""
+    ) -> None:
+        """(reference client/allocrunner Signal)"""
+        with self._lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(alloc_id)
+        if task and task not in runner.task_runners:
+            raise KeyError(f"unknown task {task!r}")
+        for name, tr in runner.task_runners.items():
+            if task and name != task:
+                continue
+            try:
+                tr.driver.signal_task(tr.task_id, signal)
+            except NotImplementedError:
+                pass
+
     def running_allocs(self) -> List[str]:
         with self._lock:
             return [

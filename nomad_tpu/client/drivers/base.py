@@ -91,6 +91,11 @@ class DriverPlugin:
     def destroy_task(self, task_id: str, force: bool = False) -> None:
         raise NotImplementedError
 
+    def signal_task(self, task_id: str, signal: str = "SIGTERM") -> None:
+        """Deliver a signal without the stop escalation
+        (reference DriverPlugin.SignalTask)."""
+        raise NotImplementedError
+
     def inspect_task(self, task_id: str) -> Optional[DriverHandle]:
         raise NotImplementedError
 

@@ -130,6 +130,19 @@ class DockerDriver(DriverPlugin):
         except (OSError, subprocess.TimeoutExpired):
             pass
 
+    def signal_task(self, task_id, signal="SIGTERM"):
+        handle = self.handles.get(task_id)
+        if handle is None or not handle.is_running():
+            return
+        try:
+            subprocess.run(
+                [self._docker, "kill", "-s", signal.replace("SIG", ""),
+                 handle.container],
+                capture_output=True, timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
     def destroy_task(self, task_id, force=False):
         handle = self.handles.get(task_id)
         if handle is not None and handle.is_running():

@@ -133,6 +133,20 @@ class RawExecDriver(DriverPlugin):
             self.stop_task(task_id, timeout=0.5, signal="SIGKILL")
         self.handles.pop(task_id, None)
 
+    def signal_task(self, task_id, signal="SIGTERM"):
+        handle = self.handles.get(task_id)
+        if handle is None or not handle.is_running():
+            return
+        name = signal if signal.startswith("SIG") else f"SIG{signal}"
+        try:
+            sig = _signal.Signals[name]
+        except KeyError:
+            raise ValueError(f"invalid signal {signal!r}")
+        try:
+            os.killpg(os.getpgid(handle.proc.pid), sig)
+        except ProcessLookupError:
+            pass
+
     def inspect_task(self, task_id):
         return self.handles.get(task_id)
 

@@ -82,6 +82,11 @@ class MockDriver(DriverPlugin):
                 time.sleep(kill_after)
             handle.set_exit(TaskExitResult(exit_code=0, signal=15))
 
+    def signal_task(self, task_id, signal="SIGTERM"):
+        # recorded so tests can assert delivery (fault injection)
+        self.signals = getattr(self, "signals", [])
+        self.signals.append((task_id, signal))
+
     def destroy_task(self, task_id, force=False):
         self.stop_task(task_id)
         self.handles.pop(task_id, None)

@@ -69,11 +69,14 @@ class QemuDriver(RawExecDriver):
         cpus = max(1, int(cfg.config.get("cpus", 1)))
         if cfg.resources is not None:
             mem_mb = max(1, int(cfg.resources.memory_mb))
-        # machine type must match the emulated arch ("pc" is x86-only;
-        # aarch64 boards use "virt")
+        # machine type must match the emulated arch: "pc" for x86
+        # (including qemu-kvm spellings), "virt" for arm/riscv boards
+        binary = os.path.basename(self._qemu)
         machine = cfg.config.get(
             "machine",
-            "pc" if "x86" in os.path.basename(self._qemu) else "virt",
+            "virt"
+            if any(a in binary for a in ("aarch64", "arm", "riscv"))
+            else "pc",
         )
         accel = cfg.config.get("accelerator", "tcg")
         argv = [

@@ -88,6 +88,10 @@ class TaskRunner:
         self.on_state_change = on_state_change
         self.task_id = f"{alloc_id[:8]}-{task.name}"
         self._kill = threading.Event()
+        # user-initiated restart in flight: the next task exit loops
+        # straight back to start without charging the restart policy
+        # (reference taskrunner Restart() vs. restart tracker)
+        self._user_restart = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.exit_result: Optional[TaskExitResult] = None
@@ -207,6 +211,12 @@ class TaskRunner:
                     return
 
                 self.exit_result = result
+                if self._user_restart.is_set():
+                    self._user_restart.clear()
+                    self._set_state(
+                        TASK_STATE_PENDING, event="Restart Signaled"
+                    )
+                    continue
                 if not self._maybe_restart(result):
                     return
         finally:
@@ -280,6 +290,16 @@ class TaskRunner:
         return True
 
     # ------------------------------------------------------------------
+
+    def restart(self) -> None:
+        """User-initiated in-place restart: stop the process; the run
+        loop relaunches it without consuming restart-policy attempts."""
+        if not self.is_running():
+            return
+        self._user_restart.set()
+        self.driver.stop_task(
+            self.task_id, timeout=self.task.kill_timeout_s
+        )
 
     def kill(self) -> None:
         self._kill.set()

@@ -1,0 +1,120 @@
+"""Agent monitoring surfaces (reference command/agent/monitor/monitor.go
+live log streaming + command/agent/http.go:303 /v1/agent/pprof).
+
+* ``LogMonitor`` — a ring-buffer logging handler; ``/v1/agent/monitor``
+  serves its tail and clients long-poll with an offset cursor, the
+  in-process shape of the reference's hclog SinkAdapter streaming.
+* ``thread_dump`` / ``runtime_profile`` — the Python analogs of the
+  goroutine and heap pprof endpoints (threads via
+  ``sys._current_frames``, memory via ``gc`` stats).
+"""
+from __future__ import annotations
+
+import gc as _gc
+import logging
+import sys
+import threading
+import traceback
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_BUFFER_LINES = 512
+
+
+class LogMonitor(logging.Handler):
+    """Ring buffer of formatted log lines with a monotonically
+    increasing cursor, so pollers can resume where they left off."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_BUFFER_LINES,
+        level: int = logging.INFO,
+    ) -> None:
+        super().__init__(level)
+        self.setFormatter(
+            logging.Formatter(
+                "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+            )
+        )
+        self._lock2 = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+        self._next_seq = 0
+        self._cv = threading.Condition(self._lock2)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:  # noqa: BLE001
+            return
+        with self._cv:
+            self._buf.append((self._next_seq, line))
+            self._next_seq += 1
+            self._cv.notify_all()
+
+    def write_line(self, line: str) -> None:
+        """Direct injection for components not routed through
+        `logging` (the agent's own lifecycle messages)."""
+        with self._cv:
+            self._buf.append((self._next_seq, line))
+            self._next_seq += 1
+            self._cv.notify_all()
+
+    def tail(
+        self,
+        after: int = -1,
+        wait: float = 0.0,
+    ) -> Tuple[List[str], int]:
+        """Lines with seq > after; blocks up to `wait` seconds when
+        nothing new is available (the long-poll used by
+        /v1/agent/monitor).  Returns (lines, newest_seq)."""
+        with self._cv:
+            if wait > 0 and not any(
+                seq > after for seq, _line in self._buf
+            ):
+                self._cv.wait(wait)
+            lines = [line for seq, line in self._buf if seq > after]
+            return lines, self._next_seq - 1
+
+    def install(self, logger_name: str = "") -> "LogMonitor":
+        lg = logging.getLogger(logger_name)
+        lg.addHandler(self)
+        # without this, INFO records die at the root's WARNING default
+        # before any handler sees them
+        if lg.getEffectiveLevel() > self.level:
+            lg.setLevel(self.level)
+        return self
+
+    def uninstall(self, logger_name: str = "") -> None:
+        logging.getLogger(logger_name).removeHandler(self)
+
+
+def thread_dump() -> str:
+    """All thread stacks (the goroutine-pprof analog)."""
+    frames = sys._current_frames()
+    names: Dict[int, str] = {
+        t.ident: t.name for t in threading.enumerate()
+    }
+    out = []
+    for ident, frame in frames.items():
+        out.append(
+            f"thread {ident} ({names.get(ident, 'unknown')}):"
+        )
+        out.extend(
+            line.rstrip()
+            for line in traceback.format_stack(frame)
+        )
+        out.append("")
+    return "\n".join(out)
+
+
+def runtime_profile() -> Dict:
+    """Allocator/GC counters (the heap-pprof analog)."""
+    counts = _gc.get_count()
+    stats = _gc.get_stats()
+    return {
+        "Threads": threading.active_count(),
+        "GCCounts": list(counts),
+        "GCCollections": [s.get("collections", 0) for s in stats],
+        "GCCollected": [s.get("collected", 0) for s in stats],
+        "Objects": len(_gc.get_objects()),
+    }

@@ -49,11 +49,21 @@ class Autopilot:
         check_interval: float = 1.0,
     ) -> None:
         self.cluster = cluster
-        self.config = config or AutopilotConfig()
+        self._default_config = config or AutopilotConfig()
         self.check_interval = check_interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.removed: List[str] = []
+
+    @property
+    def config(self) -> AutopilotConfig:
+        """Operator-set config from replicated state when present
+        (reference: AutopilotConfig lives in raft, operator_endpoint.go
+        AutopilotSetConfiguration), else the compiled-in defaults."""
+        store = getattr(self.cluster, "store", None)
+        get = getattr(store, "get_autopilot_config", None)
+        stored = get() if callable(get) else None
+        return stored or self._default_config
 
     # ------------------------------------------------------------------
 

@@ -75,6 +75,11 @@ class ReplicatedStore:
             "update_node_drain", (node_id, drain, strategy)
         )
 
+    def set_job_stability(self, namespace, job_id, version, stable):
+        return self._raft_apply(
+            "set_job_stability", (namespace, job_id, version, stable)
+        )
+
     def upsert_job(self, job, keep_versions: int = 6):
         return self._raft_apply("upsert_job", (job, keep_versions))
 
@@ -112,6 +117,9 @@ class ReplicatedStore:
         return self._raft_apply(
             "release_csi_claims_for_alloc", (alloc_id,)
         )
+
+    def set_autopilot_config(self, config):
+        return self._raft_apply("set_autopilot_config", (config,))
 
     def set_scheduler_config(self, config):
         return self._raft_apply("set_scheduler_config", (config,))

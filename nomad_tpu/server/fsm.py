@@ -51,6 +51,7 @@ def state_payload(store: StateStore, acls) -> dict:
             "evals": list(store.evals.values()),
             "deployments": list(store.deployments.values()),
             "scheduler_config": store.scheduler_config,
+            "autopilot_config": store.autopilot_config,
             "csi_volumes": list(store.csi_volumes.values()),
             "scaling_policies": list(store.scaling_policies.values()),
             "scaling_events": {
@@ -119,6 +120,7 @@ def install_payload(store: StateStore, acls, payload: dict) -> int:
             store.deployments[d.id] = d
             store._deployments_by_job[(d.namespace, d.job_id)].add(d.id)
         store.scheduler_config = payload["scheduler_config"]
+        store.autopilot_config = payload.get("autopilot_config")
         store.csi_volumes.clear()
         for vol in payload.get("csi_volumes", ()):
             store.csi_volumes[(vol.namespace, vol.id)] = vol
@@ -210,6 +212,11 @@ class ServerFSM:
     def _apply_upsert_job(self, job, keep_versions=6):
         return self.store.upsert_job(job, keep_versions)
 
+    def _apply_set_job_stability(self, namespace, job_id, version, stable):
+        return self.store.set_job_stability(
+            namespace, job_id, version, stable
+        )
+
     def _apply_delete_job(self, namespace, job_id):
         return self.store.delete_job(namespace, job_id)
 
@@ -241,6 +248,9 @@ class ServerFSM:
 
     def _apply_set_scheduler_config(self, config):
         return self.store.set_scheduler_config(config)
+
+    def _apply_set_autopilot_config(self, config):
+        return self.store.set_autopilot_config(config)
 
     def _apply_upsert_plan_results(self, result, eval_id):
         return self.store.upsert_plan_results(result, eval_id)

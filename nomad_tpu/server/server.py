@@ -134,6 +134,11 @@ class Server:
         self.catalog = ServiceCatalog(self)
         # raft-index <-> wall-clock witness on every state mutation
         # (reference fsm.go Apply -> timetable.Witness)
+        # live log tail for /v1/agent/monitor (reference
+        # command/agent/monitor); captures the nomad_tpu logger tree
+        from ..monitor import LogMonitor
+
+        self.log_monitor = LogMonitor().install("nomad_tpu")
         from .timetable import TimeTable
 
         self.timetable = TimeTable()
@@ -159,6 +164,9 @@ class Server:
         self.revoke_leadership()
         for timer in self._heartbeat_timers.values():
             timer.cancel()
+        # detach the monitor handler or stopped servers pile up on the
+        # shared logger and keep buffering every record
+        self.log_monitor.uninstall("nomad_tpu")
 
     def establish_leadership(self) -> None:
         """Enable the leader-only services (reference leader.go:222):
@@ -279,6 +287,157 @@ class Server:
             for tg in job.task_groups:
                 tg.count = region.count
 
+    def revert_job(
+        self,
+        namespace: str,
+        job_id: str,
+        job_version: int,
+        enforce_prior_version: Optional[int] = None,
+    ) -> Evaluation:
+        """Re-register a historical version as the newest one
+        (reference job_endpoint.go Job.Revert)."""
+        import copy as _copy
+
+        current = self.store.job_by_id(namespace, job_id)
+        if current is None:
+            raise KeyError(job_id)
+        if enforce_prior_version is not None and (
+            current.version != enforce_prior_version
+        ):
+            raise ValueError(
+                f"current version is {current.version}, not "
+                f"{enforce_prior_version}"
+            )
+        if job_version == current.version:
+            raise ValueError(
+                "cannot revert to the current version"
+            )
+        target = self.store.job_by_version(
+            namespace, job_id, job_version
+        )
+        if target is None:
+            raise KeyError(
+                f"job {job_id!r} has no version {job_version}"
+            )
+        # deep copy: never mutate the store-resident history entry
+        # (register-time interpolation writes into task groups)
+        reverted = _copy.deepcopy(target)
+        reverted.stop = False
+        return self.register_job(reverted)
+
+    def set_job_stability(
+        self, namespace: str, job_id: str, version: int, stable: bool
+    ) -> None:
+        """(reference job_endpoint.go Job.Stable)"""
+        self.store.set_job_stability(namespace, job_id, version, stable)
+
+    def job_summary(self, namespace: str, job_id: str) -> Dict:
+        """Per-task-group alloc rollup (reference structs.go JobSummary,
+        maintained incrementally in state_store.go; derived on read
+        here, same shape)."""
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(job_id)
+        groups: Dict[str, Dict[str, int]] = {
+            tg.name: {
+                "Queued": 0, "Complete": 0, "Failed": 0,
+                "Running": 0, "Starting": 0, "Lost": 0,
+            }
+            for tg in job.task_groups
+        }
+        for a in self.store.allocs_by_job(namespace, job_id):
+            g = groups.setdefault(
+                a.task_group,
+                {
+                    "Queued": 0, "Complete": 0, "Failed": 0,
+                    "Running": 0, "Starting": 0, "Lost": 0,
+                },
+            )
+            cs = a.client_status
+            if cs == "running":
+                g["Running"] += 1
+            elif cs == "complete":
+                g["Complete"] += 1
+            elif cs == "failed":
+                g["Failed"] += 1
+            elif cs == "lost":
+                g["Lost"] += 1
+            elif a.desired_status == "run":
+                g["Starting"] += 1
+        # queued = asks the blocked machinery is still holding
+        for ev in self.store.evals_by_job(namespace, job_id):
+            for tg_name, n in (ev.queued_allocations or {}).items():
+                if tg_name in groups and ev.status == "blocked":
+                    groups[tg_name]["Queued"] = max(
+                        groups[tg_name]["Queued"], n
+                    )
+        return {
+            "JobID": job_id,
+            "Namespace": namespace,
+            "Summary": groups,
+            "Children": {
+                "Pending": 0,
+                "Running": sum(
+                    1
+                    for j in self.store.iter_jobs()
+                    if j.parent_id == job_id and not j.stopped()
+                ),
+                "Dead": sum(
+                    1
+                    for j in self.store.iter_jobs()
+                    if j.parent_id == job_id and j.stopped()
+                ),
+            },
+        }
+
+    def stop_alloc(self, alloc_id: str) -> Optional[Evaluation]:
+        """User-initiated alloc stop: desired=stop + reschedule eval
+        (reference alloc_endpoint.go Alloc.Stop)."""
+        from dataclasses import replace as _replace
+
+        from ..structs import ALLOC_DESIRED_STOP, EVAL_TRIGGER_ALLOC_STOP
+
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(alloc_id)
+        stopped = _replace(alloc)
+        stopped.desired_status = ALLOC_DESIRED_STOP
+        self.store.upsert_allocs([stopped])
+        ev = Evaluation(
+            namespace=alloc.namespace,
+            priority=alloc.job.priority if alloc.job else 50,
+            type=alloc.job.type if alloc.job else "service",
+            triggered_by=EVAL_TRIGGER_ALLOC_STOP,
+            job_id=alloc.job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.store.upsert_evals([ev])
+        self.on_eval_update(ev)
+        return ev
+
+    def restart_alloc(self, alloc_id: str, task: str = "") -> None:
+        """Proxy a restart to the owning client (reference
+        client_alloc_endpoint.go Allocations.Restart)."""
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(alloc_id)
+        client = getattr(self, "_clients", {}).get(alloc.node_id)
+        if client is None:
+            raise KeyError(f"no client connection for {alloc.node_id}")
+        client.restart_alloc(alloc_id, task)
+
+    def signal_alloc(
+        self, alloc_id: str, signal: str = "SIGTERM", task: str = ""
+    ) -> None:
+        """(reference client_alloc_endpoint.go Allocations.Signal)"""
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(alloc_id)
+        client = getattr(self, "_clients", {}).get(alloc.node_id)
+        if client is None:
+            raise KeyError(f"no client connection for {alloc.node_id}")
+        client.signal_alloc(alloc_id, signal, task)
+
     def deregister_job(
         self, namespace: str, job_id: str, purge: bool = False
     ) -> Optional[Evaluation]:
@@ -361,6 +520,11 @@ class Server:
         )
         self.store.upsert_scaling_event(namespace, job_id, group, event)
         return ev, event
+
+    def validate_job(self, job: Job) -> None:
+        """Public validation surface (reference Job.Validate RPC
+        backing /v1/validate/job)."""
+        self._validate_job(job)
 
     def _validate_job(self, job: Job) -> None:
         if not job.id:

@@ -65,6 +65,8 @@ class StateStore:
         self.evals: Dict[str, Evaluation] = {}
         self.deployments: Dict[str, Deployment] = {}
         self.scheduler_config = SchedulerConfiguration()
+        # autopilot operator config; None = compiled-in defaults
+        self.autopilot_config = None
 
         # CSI volumes keyed (namespace, id) (reference state table
         # csi_volumes, nomad/state/schema.go)
@@ -270,6 +272,24 @@ class StateStore:
             if j.version == version:
                 return j
         return None
+
+    def versions_of_job(
+        self, namespace: str, job_id: str
+    ) -> List[Job]:
+        """All retained versions, newest first (reference
+        state_store.go JobVersionsByID)."""
+        return list(self.job_versions.get((namespace, job_id), []))
+
+    def set_job_stability(
+        self, namespace: str, job_id: str, version: int, stable: bool
+    ) -> int:
+        """(reference state_store.go UpdateJobStability)"""
+        with self._lock:
+            job = self.job_by_version(namespace, job_id, version)
+            if job is None:
+                raise KeyError(f"job {job_id!r} version {version}")
+            job.stable = stable
+            return self._bump("jobs")
 
     def iter_jobs(self) -> Iterable[Job]:
         return list(self.jobs.values())
@@ -620,6 +640,16 @@ class StateStore:
     # ------------------------------------------------------------------
     # scheduler config
     # ------------------------------------------------------------------
+
+    def get_autopilot_config(self):
+        return self.autopilot_config
+
+    def set_autopilot_config(self, config) -> int:
+        """(reference state_store.go AutopilotSetConfig; operator
+        endpoint writes it through raft)"""
+        with self._lock:
+            self.autopilot_config = config
+            return self._bump("autopilot-config")
 
     def get_scheduler_config(self) -> SchedulerConfiguration:
         return self.scheduler_config

@@ -1,0 +1,364 @@
+"""Extended API/CLI surface: job versions/revert/stable/summary,
+jobs/parse, validate, alloc lifecycle, agent monitor + pprof, operator
+autopilot/raft (reference job_endpoint.go Revert/Stable, jobs parse
+endpoint, alloc_endpoint.go Stop, client_alloc_endpoint.go
+Restart/Signal, command/agent/monitor, nomad/operator_endpoint.go).
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.server import Server
+
+
+def wait_until(cond, timeout=10.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def api():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=7)
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    yield server, base
+    http.stop()
+    server.stop()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _post(base, path, body, method="POST"):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# job versions / revert / stable / summary
+# ---------------------------------------------------------------------------
+
+
+def test_job_versions_and_revert(api):
+    server, base = api
+    server.register_node(mock.node())
+    job = mock.job(id="vweb")
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+
+    # v1: bump priority
+    from dataclasses import replace
+
+    v1 = replace(job, priority=80)
+    server.register_job(v1)
+    assert server.drain_to_idle(10)
+
+    versions = _get(base, "/v1/job/vweb/versions")["Versions"]
+    assert [v["version"] for v in versions] == [1, 0]
+
+    # mark v0 stable, then revert to it
+    _post(base, "/v1/job/vweb/stable",
+          {"JobVersion": 0, "Stable": True})
+    assert server.store.job_by_version("default", "vweb", 0).stable
+
+    resp = _post(base, "/v1/job/vweb/revert", {"JobVersion": 0})
+    assert resp["EvalID"]
+    cur = server.store.job_by_id("default", "vweb")
+    assert cur.version == 2
+    assert cur.priority == job.priority  # v0 settings restored
+
+    # reverting to the current version is a 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, "/v1/job/vweb/revert", {"JobVersion": 2})
+    assert exc.value.code == 400
+
+
+def test_job_summary(api):
+    server, base = api
+    server.register_node(mock.node())
+    job = mock.job(id="sweb")
+    job.task_groups[0].count = 2
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    s = _get(base, "/v1/job/sweb/summary")
+    assert s["JobID"] == "sweb"
+    tg = job.task_groups[0].name
+    total = sum(s["Summary"][tg].values())
+    assert total == 2
+
+
+# ---------------------------------------------------------------------------
+# parse + validate
+# ---------------------------------------------------------------------------
+
+
+def test_jobs_parse_endpoint(api):
+    _server, base = api
+    hcl = """
+    job "parsed" {
+      datacenters = ["dc1"]
+      group "g" {
+        count = 4
+        task "t" { driver = "mock_driver" }
+      }
+    }
+    """
+    parsed = _post(base, "/v1/jobs/parse", {"JobHCL": hcl})
+    assert parsed["id"] == "parsed"
+    assert parsed["task_groups"][0]["count"] == 4
+
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, "/v1/jobs/parse", {"JobHCL": "job {{{"})
+    assert exc.value.code == 400
+
+
+def test_validate_job_endpoint(api):
+    _server, base = api
+    good = {"Job": {"ID": "ok", "TaskGroups": [
+        {"Name": "g", "Count": 1,
+         "Tasks": [{"Name": "t", "Driver": "mock_driver"}]}]}}
+    resp = _post(base, "/v1/validate/job", good)
+    assert resp["ValidationErrors"] == []
+
+    bad = {"Job": {"ID": "", "TaskGroups": []}}
+    resp = _post(base, "/v1/validate/job", bad)
+    assert resp["ValidationErrors"]
+
+
+# ---------------------------------------------------------------------------
+# alloc lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_stop_endpoint(api):
+    server, base = api
+    server.register_node(mock.node())
+    job = mock.job(id="stoppable")
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    alloc = server.store.allocs_by_job("default", "stoppable")[0]
+    resp = _post(base, f"/v1/allocation/{alloc.id}/stop", {})
+    assert resp["EvalID"]
+    stored = server.store.alloc_by_id(alloc.id)
+    assert stored.desired_status == "stop"
+
+
+def test_alloc_restart_and_signal_proxy(api, tmp_path):
+    from nomad_tpu.client import Client
+    from nomad_tpu.structs import Node, Task
+
+    server, base = api
+    cli = Client(
+        server, node=Node(), data_dir=str(tmp_path),
+        heartbeat_interval=5.0,
+    )
+    cli.start()
+    try:
+        job = mock.job(id="sigjob")
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0] = Task(
+            name="sleeper",
+            driver="mock_driver",
+            config={"run_for": 60},
+        )
+        server.register_job(job)
+        assert server.drain_to_idle(10)
+        allocs = server.store.allocs_by_job("default", "sigjob")
+        assert wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in server.store.allocs_by_job(
+                    "default", "sigjob"
+                )
+            )
+        )
+        alloc_id = allocs[0].id
+        _post(
+            base,
+            f"/v1/client/allocation/{alloc_id}/signal",
+            {"Signal": "SIGHUP", "TaskName": "sleeper"},
+        )
+        driver = cli.drivers["mock_driver"]
+        assert wait_until(
+            lambda: any(
+                sig == "SIGHUP"
+                for _tid, sig in getattr(driver, "signals", [])
+            )
+        ), "signal not delivered to driver"
+        # restart: kills the running mock task; the runner restarts it
+        _post(
+            base,
+            f"/v1/client/allocation/{alloc_id}/restart",
+            {"TaskName": "sleeper"},
+        )
+        # 404 for unknown alloc
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base, "/v1/client/allocation/nope/restart", {})
+        assert exc.value.code == 404
+    finally:
+        cli.stop()
+
+
+# ---------------------------------------------------------------------------
+# agent monitor + pprof
+# ---------------------------------------------------------------------------
+
+
+def test_agent_monitor_tail(api):
+    server, base = api
+    server.log_monitor.write_line("hello-from-monitor")
+    resp = _get(base, "/v1/agent/monitor")
+    assert any("hello-from-monitor" in l for l in resp["Lines"])
+    seq = resp["Index"]
+    # nothing new after the cursor
+    resp2 = _get(base, f"/v1/agent/monitor?index={seq}")
+    assert resp2["Lines"] == []
+    server.log_monitor.write_line("second")
+    resp3 = _get(base, f"/v1/agent/monitor?index={seq}")
+    assert resp3["Lines"] == ["second"]
+
+
+def test_agent_monitor_captures_logging(api):
+    import logging
+
+    server, base = api
+    logging.getLogger("nomad_tpu.test").info("via-logging-%d", 42)
+    resp = _get(base, "/v1/agent/monitor")
+    assert any("via-logging-42" in l for l in resp["Lines"])
+
+
+def test_pprof_analogs(api):
+    _server, base = api
+    prof = _get(base, "/v1/agent/pprof/goroutine")
+    assert "thread" in prof["Profile"]
+    heap = _get(base, "/v1/agent/pprof/heap")
+    assert heap["Threads"] >= 1
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(base, "/v1/agent/pprof/bogus")
+    assert exc.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# operator autopilot / raft
+# ---------------------------------------------------------------------------
+
+
+def test_operator_autopilot_requires_cluster(api):
+    _server, base = api
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(base, "/v1/operator/autopilot/configuration")
+    assert exc.value.code == 404
+
+
+def test_operator_endpoints_on_cluster():
+    from nomad_tpu.server.cluster import TestCluster
+
+    c = TestCluster(3, heartbeat_ttl=60.0)
+    c.start()
+    http = None
+    try:
+        leader = c.wait_for_leader()
+        http = start_http_server(leader, port=0)
+        base = f"http://127.0.0.1:{http.port}"
+        cfg = _get(base, "/v1/operator/autopilot/configuration")
+        assert cfg["CleanupDeadServers"] is True
+        _post(
+            base,
+            "/v1/operator/autopilot/configuration",
+            {"CleanupDeadServers": False},
+        )
+        assert leader.autopilot.config.cleanup_dead_servers is False
+
+        health = _get(base, "/v1/operator/autopilot/health")
+        assert health["NumServers"] == 3
+        assert health["Healthy"] is True
+
+        raftcfg = _get(base, "/v1/operator/raft/configuration")
+        assert len(raftcfg["Servers"]) == 3
+        assert sum(1 for s in raftcfg["Servers"] if s["Leader"]) == 1
+    finally:
+        if http is not None:
+            http.stop()
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_job_history_revert_and_monitor(api, monkeypatch, capsys):
+    from nomad_tpu.cli import main
+
+    server, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    server.register_node(mock.node())
+    job = mock.job(id="cliweb")
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    from dataclasses import replace
+
+    server.register_job(replace(job, priority=90))
+    assert server.drain_to_idle(10)
+
+    main(["job", "history", "cliweb"])
+    out = capsys.readouterr().out
+    assert "Version" in out and "1" in out
+
+    main(["job", "revert", "cliweb", "0"])
+    out = capsys.readouterr().out
+    assert "Evaluation" in out
+
+    main(["job", "inspect", "cliweb"])
+    out = capsys.readouterr().out
+    assert '"id": "cliweb"' in out
+
+    server.log_monitor.write_line("cli-monitor-line")
+    main(["monitor", "-no-follow"])
+    out = capsys.readouterr().out
+    assert "cli-monitor-line" in out
+
+    main(["operator", "raft", "list-peers"])
+    out = capsys.readouterr().out
+    assert "Address" in out
+
+
+def test_cli_alloc_lifecycle(api, monkeypatch, capsys):
+    from nomad_tpu.cli import main
+
+    server, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    server.register_node(mock.node())
+    job = mock.job(id="clialloc")
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    alloc = server.store.allocs_by_job("default", "clialloc")[0]
+    main(["alloc", "stop", alloc.id])
+    out = capsys.readouterr().out
+    assert "Evaluation" in out
+    assert (
+        server.store.alloc_by_id(alloc.id).desired_status == "stop"
+    )
