@@ -126,6 +126,19 @@ class APIHandler(BaseHTTPRequestHandler):
         store = srv.store
         ns = q.get("namespace", "default")
 
+        if path in ("/ui", "/ui/index.html", "") and method == "GET":
+            # built-in single-page UI (the reference ships an Ember
+            # app under ui/; same /v1 data)
+            from .ui import UI_HTML
+
+            body = UI_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return True
+
         if path == "/v1/jobs":
             if method == "GET":
                 self._check_acl("read-job", ns)
@@ -567,6 +580,77 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond({})
             return True
 
+        m = re.fullmatch(r"/v1/client/allocation/([^/]+)/exec", path)
+        if m and method in ("POST", "PUT"):
+            # one-shot exec in the task context (reference
+            # command/alloc_exec.go; the reference streams over a
+            # websocket, this returns the collected output)
+            self._check_acl("alloc-exec", ns)
+            body = self._body()
+            argv = body.get("Cmd") or body.get("Command") or []
+            if isinstance(argv, str):
+                argv = [argv]
+            if not argv:
+                raise HTTPError(400, "missing command")
+            try:
+                code, output = srv.exec_alloc(
+                    m.group(1),
+                    body.get("Task", body.get("TaskName", "")),
+                    argv,
+                    timeout=float(body.get("Timeout", 30.0)),
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond(
+                {
+                    "ExitCode": code,
+                    "Output": output.decode("utf-8", "replace"),
+                }
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/client/fs/ls/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("read-fs", ns)
+            try:
+                self._respond(
+                    srv.list_alloc_files(
+                        m.group(1), q.get("path", "")
+                    )
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            return True
+
+        m = re.fullmatch(r"/v1/client/fs/cat/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("read-fs", ns)
+            try:
+                data, truncated = srv.read_alloc_file(
+                    m.group(1), q.get("path", "")
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond(
+                {
+                    "Data": data.decode("utf-8", "replace"),
+                    "Truncated": truncated,
+                }
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/node/([^/]+)/purge", path)
+        if m and method in ("POST", "PUT"):
+            self._check_acl("node:write")
+            try:
+                evals = srv.purge_node(m.group(1))
+            except KeyError:
+                raise HTTPError(404, "node not found")
+            self._respond(
+                {"EvalIDs": [e.id for e in evals]}
+            )
+            return True
+
         m = re.fullmatch(
             r"/v1/client/allocation/([^/]+)/signal", path
         )
@@ -888,6 +972,10 @@ class APIHandler(BaseHTTPRequestHandler):
                 if "LastContactThreshold" in body:
                     new_cfg.last_contact_threshold_s = float(
                         body["LastContactThreshold"]
+                    )
+                if "ServerStabilizationTime" in body:
+                    new_cfg.server_stabilization_time_s = float(
+                        body["ServerStabilizationTime"]
                     )
                 store.set_autopilot_config(new_cfg)
                 self._respond({"Updated": True})

@@ -1,0 +1,87 @@
+"""Minimal built-in web UI (the parity nod to the reference's Ember app
+under ui/ — same data, one self-contained page against the /v1 API).
+Served at /ui by the HTTP server."""
+
+UI_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>nomad-tpu</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font-family: system-ui, sans-serif; margin: 2rem;
+         max-width: 72rem; }
+  h1 { font-size: 1.3rem; }
+  h2 { font-size: 1.05rem; margin-top: 1.6rem; }
+  table { border-collapse: collapse; width: 100%; font-size: .85rem; }
+  th, td { text-align: left; padding: .3rem .6rem;
+           border-bottom: 1px solid #8884; }
+  code { font-size: .8rem; }
+  .ok  { color: #2a9d2a; }
+  .bad { color: #d43a3a; }
+  #err { color: #d43a3a; }
+</style>
+</head>
+<body>
+<h1>nomad-tpu <small id="leader"></small></h1>
+<div id="err"></div>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Allocations</h2><table id="allocs"></table>
+<script>
+async function j(p) {
+  const r = await fetch(p);
+  if (!r.ok) throw new Error(p + ": " + r.status);
+  return r.json();
+}
+function esc(v) {
+  return String(v ?? "").replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;",
+  })[c]);
+}
+function row(cells, tag) {
+  return "<tr>" + cells.map(c => `<${tag||"td"}>${c}</${tag||"td"}>`)
+    .join("") + "</tr>";
+}
+function code(v) { return `<code>${esc(v).slice(0, 8)}</code>`; }
+function badge(s, good) {
+  return `<span class="${good.includes(s) ? "ok" : "bad"}">` +
+    esc(s) + "</span>";
+}
+async function refresh() {
+  try {
+    const [jobs, nodes, allocs, leader] = await Promise.all([
+      j("/v1/jobs"), j("/v1/nodes"), j("/v1/allocations"),
+      j("/v1/status/leader"),
+    ]);
+    document.getElementById("leader").textContent =
+      "leader: " + JSON.stringify(leader);
+    document.getElementById("jobs").innerHTML =
+      row(["ID","Type","Priority","Status"], "th") +
+      jobs.map(x => row([esc(x.ID), esc(x.Type), esc(x.Priority),
+        badge(x.Status, ["running","complete"])])).join("");
+    document.getElementById("nodes").innerHTML =
+      row(["ID","Name","DC","Status","Eligibility"], "th") +
+      nodes.map(x => row([
+        code(x.ID), esc(x.Name),
+        esc(x.Datacenter), badge(x.Status, ["ready"]),
+        esc(x.SchedulingEligibility)])).join("");
+    document.getElementById("allocs").innerHTML =
+      row(["ID","Job","Group","Node","Desired","Client"], "th") +
+      allocs.map(x => row([
+        code(x.id), esc(x.job_id),
+        esc(x.task_group), code(x.node_id),
+        esc(x.desired_status),
+        badge(x.client_status, ["running","complete"])])).join("");
+    document.getElementById("err").textContent = "";
+  } catch (e) {
+    document.getElementById("err").textContent = String(e);
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -25,6 +25,7 @@ import os
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional
 
@@ -331,6 +332,47 @@ def cmd_alloc_stop(args) -> None:
         "POST", f"/v1/allocation/{args.alloc_id}/stop", {}
     )
     print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_alloc_exec(args) -> None:
+    resp = _request(
+        "POST",
+        f"/v1/client/allocation/{args.alloc_id}/exec",
+        {
+            "Task": args.task or "",
+            "Cmd": args.cmd,
+        },
+    )
+    sys.stdout.write(resp.get("Output", ""))
+    sys.exit(int(resp.get("ExitCode", 0)))
+
+
+def cmd_alloc_fs(args) -> None:
+    path = args.path or ""
+    if args.cat:
+        resp = _request(
+            "GET",
+            f"/v1/client/fs/cat/{args.alloc_id}?path="
+            + urllib.parse.quote(path),
+        )
+        sys.stdout.write(resp.get("Data", ""))
+        return
+    entries = _request(
+        "GET",
+        f"/v1/client/fs/ls/{args.alloc_id}?path="
+        + urllib.parse.quote(path),
+    )
+    _table(
+        [
+            (
+                "d" if e["IsDir"] else "-",
+                e["Size"],
+                e["Name"],
+            )
+            for e in entries
+        ],
+        ["Mode", "Size", "Name"],
+    )
 
 
 def cmd_monitor(args) -> None:
@@ -786,6 +828,17 @@ def build_parser() -> argparse.ArgumentParser:
     alst = alloc_sub.add_parser("stop")
     alst.add_argument("alloc_id")
     alst.set_defaults(fn=cmd_alloc_stop)
+    alex = alloc_sub.add_parser("exec")
+    alex.add_argument("-task", dest="task", default="")
+    alex.add_argument("alloc_id")
+    # REMAINDER so the command's own flags (e.g. sh -c) pass through
+    alex.add_argument("cmd", nargs=argparse.REMAINDER)
+    alex.set_defaults(fn=cmd_alloc_exec)
+    alfs = alloc_sub.add_parser("fs")
+    alfs.add_argument("-cat", action="store_true", dest="cat")
+    alfs.add_argument("alloc_id")
+    alfs.add_argument("path", nargs="?", default="")
+    alfs.set_defaults(fn=cmd_alloc_fs)
 
     ev = sub.add_parser("eval")
     ev_sub = ev.add_subparsers(dest="eval_cmd", required=True)

@@ -414,6 +414,80 @@ class Client:
             except NotImplementedError:
                 pass
 
+    def exec_alloc(
+        self,
+        alloc_id: str,
+        task: str,
+        argv: List[str],
+        timeout: float = 30.0,
+    ):
+        """Run a command in a task's context (reference
+        client_alloc_endpoint.go Allocations.Exec backing
+        `nomad alloc exec`).  Returns (exit_code, output_bytes)."""
+        with self._lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(alloc_id)
+        tr = runner.task_runners.get(task)
+        if tr is None:
+            raise KeyError(f"unknown task {task!r}")
+        env = tr.task_env.all() if tr.task_env is not None else dict(
+            tr.env
+        )
+        cwd = tr.task_dir.local_dir if tr.task_dir is not None else ""
+        return tr.driver.exec_task(
+            tr.task_id, argv, timeout=timeout, env=env, cwd=cwd
+        )
+
+    def _alloc_fs_root(self, alloc_id: str) -> str:
+        if not self.data_dir:
+            raise KeyError("client has no data dir")
+        root = os.path.join(self.data_dir, "allocs", alloc_id)
+        if not os.path.isdir(root):
+            raise KeyError(alloc_id)
+        return root
+
+    def _alloc_fs_resolve(self, alloc_id: str, rel: str) -> str:
+        """Containment check shared by ls/cat (reference client fs
+        endpoints refuse to escape the alloc dir)."""
+        from .getter import contained_path
+
+        return contained_path(self._alloc_fs_root(alloc_id), rel)
+
+    def list_alloc_files(self, alloc_id: str, rel: str = ""):
+        """(reference client fs ls endpoint)"""
+        base = self._alloc_fs_resolve(alloc_id, rel)
+        if not os.path.isdir(base):
+            raise KeyError(rel)
+        out = []
+        for name in sorted(os.listdir(base)):
+            full = os.path.join(base, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            out.append(
+                {
+                    "Name": name,
+                    "IsDir": os.path.isdir(full),
+                    "Size": st.st_size,
+                    "ModTime": st.st_mtime,
+                }
+            )
+        return out
+
+    def read_alloc_file(
+        self, alloc_id: str, rel: str, max_bytes: int = 256 * 1024
+    ):
+        """(reference client fs cat/readat endpoints)
+        Returns (data, truncated)."""
+        path = self._alloc_fs_resolve(alloc_id, rel)
+        if not os.path.isfile(path):
+            raise KeyError(rel)
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            return f.read(max_bytes), size > max_bytes
+
     def running_allocs(self) -> List[str]:
         with self._lock:
             return [

@@ -96,6 +96,19 @@ class DriverPlugin:
         (reference DriverPlugin.SignalTask)."""
         raise NotImplementedError
 
+    def exec_task(
+        self,
+        task_id: str,
+        argv,
+        timeout: float = 30.0,
+        env=None,
+        cwd: str = "",
+    ):
+        """Run a command in the task's context; returns
+        (exit_code, combined_output_bytes) (reference
+        DriverPlugin.ExecTask backing `nomad alloc exec`)."""
+        raise NotImplementedError
+
     def inspect_task(self, task_id: str) -> Optional[DriverHandle]:
         raise NotImplementedError
 

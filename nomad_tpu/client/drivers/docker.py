@@ -130,6 +130,23 @@ class DockerDriver(DriverPlugin):
         except (OSError, subprocess.TimeoutExpired):
             pass
 
+    def exec_task(self, task_id, argv, timeout=30.0, env=None, cwd=""):
+        handle = self.handles.get(task_id)
+        if handle is None:
+            raise KeyError(f"unknown task {task_id!r}")
+        try:
+            out = subprocess.run(
+                [self._docker, "exec", handle.container] + list(argv),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            return 124, b"exec timed out"
+        except OSError as exc:
+            return 127, str(exc).encode()
+        return out.returncode, out.stdout or b""
+
     def signal_task(self, task_id, signal="SIGTERM"):
         handle = self.handles.get(task_id)
         if handle is None or not handle.is_running():

@@ -133,6 +133,26 @@ class RawExecDriver(DriverPlugin):
             self.stop_task(task_id, timeout=0.5, signal="SIGKILL")
         self.handles.pop(task_id, None)
 
+    def exec_task(self, task_id, argv, timeout=30.0, env=None, cwd=""):
+        if task_id not in self.handles:
+            raise KeyError(f"unknown task {task_id!r}")
+        run_env = dict(os.environ)
+        run_env.update(env or {})
+        try:
+            out = subprocess.run(
+                list(argv),
+                cwd=cwd or None,
+                env=run_env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            return 124, b"exec timed out"
+        except OSError as exc:
+            return 127, str(exc).encode()
+        return out.returncode, out.stdout or b""
+
     def signal_task(self, task_id, signal="SIGTERM"):
         handle = self.handles.get(task_id)
         if handle is None or not handle.is_running():

@@ -82,6 +82,11 @@ class MockDriver(DriverPlugin):
                 time.sleep(kill_after)
             handle.set_exit(TaskExitResult(exit_code=0, signal=15))
 
+    def exec_task(self, task_id, argv, timeout=30.0, env=None, cwd=""):
+        if task_id not in self.handles:
+            raise KeyError(f"unknown task {task_id!r}")
+        return 0, ("mock exec: " + " ".join(argv)).encode()
+
     def signal_task(self, task_id, signal="SIGTERM"):
         # recorded so tests can assert delivery (fault injection)
         self.signals = getattr(self, "signals", [])

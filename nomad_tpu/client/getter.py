@@ -23,6 +23,17 @@ class ArtifactError(Exception):
     pass
 
 
+def contained_path(root: str, rel: str) -> str:
+    """Resolve `rel` under `root`, refusing escapes — the shared
+    sandbox check for artifact destinations, dispatch payload files and
+    the alloc fs API.  Raises ValueError on escape."""
+    real_root = os.path.realpath(root)
+    p = os.path.realpath(os.path.join(root, rel.lstrip("/")))
+    if p != real_root and not p.startswith(real_root + os.sep):
+        raise ValueError(f"path {rel!r} escapes {root!r}")
+    return p
+
+
 def _verify_checksum(path: str, spec: str) -> None:
     """`spec` is "<algo>:<hexdigest>" (go-getter checksum option)."""
     try:
@@ -48,9 +59,9 @@ def fetch_artifact(artifact: Dict, task_local_dir: str) -> str:
     dest_rel = artifact.get("destination", "") or "local"
     # destinations are always sandboxed under the task local dir
     # (reference getter.go getDestination rejects escapes)
-    root = os.path.realpath(task_local_dir)
-    dest_dir = os.path.realpath(os.path.join(task_local_dir, dest_rel))
-    if dest_dir != root and not dest_dir.startswith(root + os.sep):
+    try:
+        dest_dir = contained_path(task_local_dir, dest_rel)
+    except ValueError:
         raise ArtifactError(
             f"artifact destination {dest_rel!r} escapes the task dir"
         )

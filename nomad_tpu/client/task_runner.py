@@ -234,12 +234,13 @@ class TaskRunner:
         if self.payload and self.task.dispatch_payload_file and base:
             import os
 
-            root = os.path.realpath(base)
-            path = os.path.realpath(
-                os.path.join(base, self.task.dispatch_payload_file)
-            )
-            # same sandbox rule as artifact destinations (getter.py)
-            if path != root and not path.startswith(root + os.sep):
+            from .getter import contained_path
+
+            try:
+                path = contained_path(
+                    base, self.task.dispatch_payload_file
+                )
+            except ValueError:
                 self.exit_result = TaskExitResult(
                     exit_code=-1,
                     err="dispatch_payload_file escapes the task dir",
@@ -249,7 +250,7 @@ class TaskRunner:
                     event="Failed Payload Write",
                 )
                 return False
-            os.makedirs(os.path.dirname(path) or root, exist_ok=True)
+            os.makedirs(os.path.dirname(path) or base, exist_ok=True)
             with open(path, "wb") as f:
                 f.write(self.payload)
         if self.task.artifacts and base:

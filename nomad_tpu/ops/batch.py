@@ -53,42 +53,73 @@ class BatchInputs(NamedTuple):
     distinct_hosts: jnp.ndarray  # bool scalar
 
 
-def _walk(s, f, perm, offset, limit, n_candidates):
-    """Rotated limited-walk over perm order; returns
-    (chosen_row, pulls)."""
-    n = perm.shape[0]
-    idx = jnp.mod(jnp.arange(n) + offset, n_candidates)
-    idx = jnp.where(jnp.arange(n) < n_candidates, idx, jnp.arange(n))
-    rolled = perm[idx]
-    sr = s[rolled]
-    fr = f[rolled]
+def _rotated_prefix(cs, c_off, total, in_wrap, is_tail):
+    """Inclusive count of set entries at-or-before each position in
+    *walk order*, from the inclusive permuted-order cumsum `cs`.
 
-    bad = fr & (sr <= SKIP_THRESHOLD)
-    bad_rank = jnp.cumsum(bad.astype(jnp.int32))
+    Walk order is the permuted order rotated left by `offset` within
+    the candidate region; `in_wrap` marks positions < offset (they walk
+    after the pre-wrap segment), `is_tail` the padding region past
+    n_candidates (never rotated, walks last, and carries no set
+    entries)."""
+    pre = jnp.where(in_wrap, cs + (total - c_off), cs - c_off)
+    return jnp.where(is_tail, total, pre)
+
+
+def _walk(s_p, f_p, offset, limit, n_candidates):
+    """The reference's rotating limited-walk selection, evaluated
+    entirely in permuted space (no per-step gathers — the rotation is
+    closed-form prefix arithmetic; see ops/score.py for the walk
+    semantics being emulated).  `s_p`/`f_p` are score/feasibility in
+    permuted order.  Returns (win_pos, any_emitted, pulls) where
+    win_pos indexes the permuted arrays."""
+    n = s_p.shape[0]
+    pos = jnp.arange(n)
+    is_tail = pos >= n_candidates
+    in_wrap = pos < offset
+    # walk position of each permuted index (tail walks last, in place)
+    wp = jnp.where(
+        is_tail, pos, jnp.mod(pos - offset + n_candidates, n_candidates)
+    )
+
+    def rot(b):
+        # b has no support in the tail (every mask is ANDed with f_p),
+        # so the full-array total equals the candidate-region total
+        cs = jnp.cumsum(b.astype(jnp.int32))
+        total = cs[-1]
+        c_off = jnp.where(offset > 0, cs[offset - 1], 0)
+        return (
+            _rotated_prefix(cs, c_off, total, in_wrap, is_tail), total
+        )
+
+    bad = f_p & (s_p <= SKIP_THRESHOLD)
+    bad_rank, _ = rot(bad)
     diverted = bad & (bad_rank <= MAX_SKIP)
-    nd = fr & ~diverted
-    nd_cum = jnp.cumsum(nd.astype(jnp.int32))
-    nd_count = nd_cum[-1]
-    n_div = jnp.sum(diverted.astype(jnp.int32))
-    div_rank = jnp.cumsum(diverted.astype(jnp.int32)) - 1
+    nd = f_p & ~diverted
+    nd_incl, nd_count = rot(nd)
+    div_incl, n_div = rot(diverted)
+    div_rank = div_incl - 1
     div_order = jnp.where(n_div == 2, 1 - div_rank, div_rank)
-    emit_order = jnp.where(nd, nd_cum - 1, nd_count + div_order)
-    emitted = fr & (emit_order < limit)
+    emit_order = jnp.where(nd, nd_incl - 1, nd_count + div_order)
+    emitted = f_p & (emit_order < limit)
 
-    neg_inf = jnp.asarray(-jnp.inf, dtype=sr.dtype)
-    masked = jnp.where(emitted, sr, neg_inf)
+    neg_inf = jnp.asarray(-jnp.inf, dtype=s_p.dtype)
+    masked = jnp.where(emitted, s_p, neg_inf)
     best = jnp.max(masked)
     candidates = emitted & (masked == best)
     order_key = jnp.where(
         candidates, emit_order, jnp.asarray(2**31 - 1, jnp.int32)
     )
     win = jnp.argmin(order_key)
-    chosen_row = jnp.where(jnp.any(emitted), rolled[win], NO_NODE)
+    any_emitted = jnp.any(emitted)
 
     limit_reached = nd_count >= limit
-    lth_pos = jnp.argmax(nd_cum >= limit)
-    pulls = jnp.where(limit_reached, lth_pos + 1, n_candidates)
-    return chosen_row, pulls
+    big = jnp.asarray(2**31 - 1, jnp.int32)
+    lth_wp = jnp.min(
+        jnp.where(nd & (nd_incl == limit), wp, big)
+    )
+    pulls = jnp.where(limit_reached, lth_wp + 1, n_candidates)
+    return win, any_emitted, pulls
 
 
 def _run_picks(
@@ -104,12 +135,30 @@ def _run_picks(
                   # surplus scan steps are inert so a batch can share one
                   # static scan length without phantom placements
 ):
-    """Inner pick scan; returns (rows i32[P], final used columns)."""
+    """Inner pick scan; returns (rows i32[P], final used columns).
+
+    All per-pick state lives in PERMUTED space: every input column is
+    gathered through `inp.perm` exactly once up front, and each scan
+    step is purely elementwise + cumsum + reductions (the rotated walk
+    is closed-form prefix arithmetic in `_walk`).  TPU gathers are the
+    expensive op here — hoisting them out of the step turned a
+    ~0.54 ms/eval·pick kernel into a bandwidth-bound one."""
     if wanted is None:
         wanted = jnp.asarray(n_picks, jnp.int32)
     dtype = cpu_total.dtype
-    safe_cpu = jnp.where(cpu_total > 0, cpu_total, 1.0)
-    safe_mem = jnp.where(mem_total > 0, mem_total, 1.0)
+    perm = inp.perm
+
+    def take(col):
+        return jnp.take(col, perm)
+
+    cpu_total_p = take(cpu_total)
+    mem_total_p = take(mem_total)
+    disk_total_p = take(disk_total)
+    feas_p = take(inp.feasible)
+    penalty_p = take(inp.penalty)
+    aff_p = take(inp.affinity_score)
+    safe_cpu = jnp.where(cpu_total_p > 0, cpu_total_p, 1.0)
+    safe_mem = jnp.where(mem_total_p > 0, mem_total_p, 1.0)
 
     def step(carry, pick_idx):
         cpu_used, mem_used, disk_used, collisions, excl, offset = carry
@@ -118,11 +167,11 @@ def _run_picks(
         mem_after = mem_used + inp.ask_mem
         disk_after = disk_used + inp.ask_disk
         fit = (
-            (cpu_after <= cpu_total)
-            & (mem_after <= mem_total)
-            & (disk_after <= disk_total)
+            (cpu_after <= cpu_total_p)
+            & (mem_after <= mem_total_p)
+            & (disk_after <= disk_total_p)
         )
-        feasible = inp.feasible & fit & ~excl
+        feasible = feas_p & fit & ~excl
 
         free_cpu = 1.0 - cpu_after / safe_cpu
         free_mem = 1.0 - mem_after / safe_mem
@@ -145,31 +194,31 @@ def _run_picks(
         )
         score_sum = score_sum + anti
         count = count + has_coll.astype(dtype)
-        score_sum = score_sum - inp.penalty.astype(dtype)
-        count = count + inp.penalty.astype(dtype)
-        has_aff = inp.affinity_score != 0.0
-        score_sum = score_sum + jnp.where(has_aff, inp.affinity_score, 0.0)
+        score_sum = score_sum - penalty_p.astype(dtype)
+        count = count + penalty_p.astype(dtype)
+        has_aff = aff_p != 0.0
+        score_sum = score_sum + jnp.where(has_aff, aff_p, 0.0)
         count = count + has_aff.astype(dtype)
         final = score_sum / count
 
-        row, pulls = _walk(
-            final, feasible, inp.perm, offset, inp.limit, n_candidates
+        win, any_emitted, pulls = _walk(
+            final, feasible, offset, inp.limit, n_candidates
         )
-        row = jnp.where(active, row, NO_NODE)
+        ok = active & any_emitted
+        row = jnp.where(ok, perm[win], NO_NODE)
         pulls = jnp.where(active, pulls, 0)
-        ok = row != NO_NODE
-        safe_row = jnp.where(ok, row, 0)
-        upd = lambda arr, delta: arr.at[safe_row].add(
+        safe_win = jnp.where(ok, win, 0)
+        upd = lambda arr, delta: arr.at[safe_win].add(
             jnp.where(ok, delta, jnp.zeros_like(delta))
         )
         cpu_used = upd(cpu_used, inp.ask_cpu)
         mem_used = upd(mem_used, inp.ask_mem)
         disk_used = upd(disk_used, inp.ask_disk)
-        collisions = collisions.at[safe_row].add(
+        collisions = collisions.at[safe_win].add(
             jnp.where(ok, 1, 0)
         )
-        excl = excl.at[safe_row].set(
-            jnp.where(ok & inp.distinct_hosts, True, excl[safe_row])
+        excl = excl.at[safe_win].set(
+            jnp.where(ok & inp.distinct_hosts, True, excl[safe_win])
         )
         offset = jnp.mod(offset + pulls, n_candidates)
         return (
@@ -182,17 +231,33 @@ def _run_picks(
         ), row
 
     carry0 = (
-        used0[0],
-        used0[1],
-        used0[2],
-        inp.base_collisions,
-        jnp.zeros_like(inp.feasible),
+        take(used0[0]),
+        take(used0[1]),
+        take(used0[2]),
+        take(inp.base_collisions),
+        jnp.zeros_like(feas_p),
         jnp.asarray(0, jnp.int32),
     )
-    final, rows = jax.lax.scan(
+    _final, rows = jax.lax.scan(
         step, carry0, jnp.arange(n_picks, dtype=jnp.int32)
     )
-    return rows, (final[0], final[1], final[2])
+    # node-space final usage for the chained (serially-equivalent)
+    # variant: apply the P placement deltas onto the node-space bases
+    ok_rows = rows != NO_NODE
+    safe_rows = jnp.where(ok_rows, rows, 0)
+
+    def back(base_col, ask):
+        delta = jnp.where(
+            ok_rows, jnp.broadcast_to(ask, rows.shape), 0.0
+        ).astype(base_col.dtype)
+        return base_col.at[safe_rows].add(delta)
+
+    used_out = (
+        back(used0[0], inp.ask_cpu),
+        back(used0[1], inp.ask_mem),
+        back(used0[2], inp.ask_disk),
+    )
+    return rows, used_out
 
 
 @functools.partial(

@@ -376,6 +376,7 @@ class ClusterServer(Server):
         self.revoke_leadership()
         for timer in self._heartbeat_timers.values():
             timer.cancel()
+        self.log_monitor.uninstall("nomad_tpu")
 
 
 # Public Server API methods that must execute on the leader — their
@@ -397,6 +398,9 @@ _LEADER_API = (
     "force_gc",
     "route_eval",
     "scale_job",
+    "revert_job",
+    "stop_alloc",
+    "purge_node",
 )
 
 

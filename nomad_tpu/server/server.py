@@ -418,25 +418,63 @@ class Server:
     def restart_alloc(self, alloc_id: str, task: str = "") -> None:
         """Proxy a restart to the owning client (reference
         client_alloc_endpoint.go Allocations.Restart)."""
-        alloc = self.store.alloc_by_id(alloc_id)
-        if alloc is None:
-            raise KeyError(alloc_id)
-        client = getattr(self, "_clients", {}).get(alloc.node_id)
-        if client is None:
-            raise KeyError(f"no client connection for {alloc.node_id}")
-        client.restart_alloc(alloc_id, task)
+        self._client_for_alloc(alloc_id).restart_alloc(alloc_id, task)
 
     def signal_alloc(
         self, alloc_id: str, signal: str = "SIGTERM", task: str = ""
     ) -> None:
         """(reference client_alloc_endpoint.go Allocations.Signal)"""
+        self._client_for_alloc(alloc_id).signal_alloc(
+            alloc_id, signal, task
+        )
+
+    def _client_for_alloc(self, alloc_id: str):
         alloc = self.store.alloc_by_id(alloc_id)
         if alloc is None:
             raise KeyError(alloc_id)
         client = getattr(self, "_clients", {}).get(alloc.node_id)
         if client is None:
             raise KeyError(f"no client connection for {alloc.node_id}")
-        client.signal_alloc(alloc_id, signal, task)
+        return client
+
+    def exec_alloc(
+        self,
+        alloc_id: str,
+        task: str,
+        argv,
+        timeout: float = 30.0,
+    ):
+        """(reference command/alloc_exec.go streaming exec, proxied
+        server -> client; one-shot request/response here)"""
+        return self._client_for_alloc(alloc_id).exec_alloc(
+            alloc_id, task, list(argv), timeout
+        )
+
+    def list_alloc_files(self, alloc_id: str, rel: str = ""):
+        return self._client_for_alloc(alloc_id).list_alloc_files(
+            alloc_id, rel
+        )
+
+    def read_alloc_file(self, alloc_id: str, rel: str):
+        """Returns (data, truncated) from the owning client."""
+        return self._client_for_alloc(alloc_id).read_alloc_file(
+            alloc_id, rel
+        )
+
+    def purge_node(self, node_id: str) -> List[Evaluation]:
+        """Remove a node from state entirely (reference
+        node_endpoint.go Node.Deregister, PUT /v1/node/:id/purge);
+        evals fan out for every job that had allocs there."""
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            raise KeyError(node_id)
+        timer = self._heartbeat_timers.pop(node_id, None)
+        if timer is not None:
+            timer.cancel()
+        # delete first so the fanned-out evals schedule against a
+        # state where the node is already gone
+        self.store.delete_node(node_id)
+        return self._create_node_evals(node_id)
 
     def deregister_job(
         self, namespace: str, job_id: str, purge: bool = False

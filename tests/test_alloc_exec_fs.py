@@ -1,0 +1,191 @@
+"""Alloc exec + fs proxying, node purge, built-in UI (reference
+command/alloc_exec.go, client fs endpoints, node_endpoint.go
+Node.Deregister, ui/).
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.client import Client
+from nomad_tpu.server import Server
+from nomad_tpu.structs import Node, Task
+
+
+def wait_until(cond, timeout=10.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        ct = resp.headers.get("Content-Type", "")
+        data = resp.read()
+        return json.loads(data) if "json" in ct else data
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def stack(tmp_path):
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=11)
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    cli = Client(
+        server, node=Node(), data_dir=str(tmp_path),
+        heartbeat_interval=5.0,
+    )
+    cli.start()
+    yield server, cli, base
+    cli.stop()
+    http.stop()
+    server.stop()
+
+
+def _run_job(server, job_id, config=None, driver="raw_exec"):
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0] = Task(
+        name="main",
+        driver=driver,
+        config=config
+        or {"command": "/bin/sh", "args": ["-c", "sleep 30"]},
+    )
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    assert wait_until(
+        lambda: any(
+            a.client_status == "running"
+            for a in server.store.allocs_by_job("default", job_id)
+        )
+    ), f"{job_id} never running"
+    return server.store.allocs_by_job("default", job_id)[0]
+
+
+def test_alloc_exec_runs_in_task_context(stack):
+    server, _cli, base = stack
+    alloc = _run_job(server, "execjob")
+    resp = _post(
+        base,
+        f"/v1/client/allocation/{alloc.id}/exec",
+        {"Task": "main", "Cmd": ["/bin/sh", "-c",
+                                 "echo ctx=$NOMAD_ALLOC_ID; pwd"]},
+    )
+    assert resp["ExitCode"] == 0
+    assert f"ctx={alloc.id}" in resp["Output"]
+    # cwd is the task's local dir
+    assert "/main/local" in resp["Output"]
+
+    # unknown task -> 404
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(
+            base,
+            f"/v1/client/allocation/{alloc.id}/exec",
+            {"Task": "nope", "Cmd": ["true"]},
+        )
+    assert exc.value.code == 404
+
+
+def test_alloc_exec_nonzero_exit(stack):
+    server, _cli, base = stack
+    alloc = _run_job(server, "execrc")
+    resp = _post(
+        base,
+        f"/v1/client/allocation/{alloc.id}/exec",
+        {"Task": "main", "Cmd": ["/bin/sh", "-c", "exit 3"]},
+    )
+    assert resp["ExitCode"] == 3
+
+
+def test_alloc_fs_ls_and_cat(stack):
+    server, _cli, base = stack
+    alloc = _run_job(
+        server,
+        "fsjob",
+        config={
+            "command": "/bin/sh",
+            "args": [
+                "-c",
+                "echo file-content > \"$NOMAD_TASK_DIR/out.txt\"; "
+                "sleep 30",
+            ],
+        },
+    )
+    assert wait_until(
+        lambda: any(
+            e["Name"] == "out.txt"
+            for e in server.list_alloc_files(
+                alloc.id, "main/local"
+            )
+        )
+    )
+    entries = _get(base, f"/v1/client/fs/ls/{alloc.id}?path=")
+    names = [e["Name"] for e in entries]
+    assert "alloc" in names and "main" in names
+    data = _get(
+        base,
+        f"/v1/client/fs/cat/{alloc.id}?path=main/local/out.txt",
+    )
+    assert data["Data"].strip() == "file-content"
+    # escapes rejected (400 from ValueError)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(
+            base,
+            f"/v1/client/fs/cat/{alloc.id}?path=../../etc/passwd",
+        )
+    assert exc.value.code == 400
+
+
+def test_node_purge(stack):
+    server, cli, base = stack
+    alloc = _run_job(server, "purgejob")
+    node_id = cli.node.id
+    resp = _post(base, f"/v1/node/{node_id}/purge", {})
+    assert resp["EvalIDs"]
+    assert server.store.node_by_id(node_id) is None
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, f"/v1/node/{node_id}/purge", {})
+    assert exc.value.code == 404
+
+
+def test_ui_served(stack):
+    _server, _cli, base = stack
+    html = _get(base, "/ui").decode()
+    assert "<title>nomad-tpu</title>" in html
+    assert "/v1/jobs" in html
+
+
+def test_cli_alloc_exec_and_fs(stack, monkeypatch, capsys):
+    from nomad_tpu.cli import main
+
+    server, _cli, base = stack
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    alloc = _run_job(server, "cliexec")
+    with pytest.raises(SystemExit) as exc:
+        main(["alloc", "exec", "-task", "main", alloc.id,
+              "/bin/sh", "-c", "echo from-exec"])
+    assert exc.value.code == 0
+    assert "from-exec" in capsys.readouterr().out
+
+    main(["alloc", "fs", alloc.id])
+    out = capsys.readouterr().out
+    assert "alloc" in out and "main" in out
