@@ -424,6 +424,45 @@ def cmd_operator_autopilot(args) -> None:
         )
 
 
+def cmd_operator_debug(args) -> None:
+    """Collect a diagnostic bundle (reference `nomad operator debug`:
+    pprof profiles, agent info, metrics, recent logs into an archive)."""
+    import tarfile
+    import tempfile
+
+    captures = {
+        "agent-self.json": ("GET", "/v1/agent/self"),
+        "members.json": ("GET", "/v1/agent/members"),
+        "metrics.json": ("GET", "/v1/metrics"),
+        "monitor.json": ("GET", "/v1/agent/monitor"),
+        "pprof-goroutine.json": ("GET", "/v1/agent/pprof/goroutine"),
+        "pprof-heap.json": ("GET", "/v1/agent/pprof/heap"),
+        "jobs.json": ("GET", "/v1/jobs"),
+        "nodes.json": ("GET", "/v1/nodes"),
+        "scheduler-config.json": (
+            "GET", "/v1/operator/scheduler/configuration"
+        ),
+    }
+    out_path = args.output or "nomad-debug.tar.gz"
+    with tempfile.TemporaryDirectory() as td:
+        names = []
+        for name, (method, path) in captures.items():
+            try:
+                data = _request(method, path)
+            except SystemExit:
+                # endpoint unavailable (e.g. cluster-only): skip
+                continue
+            p = os.path.join(td, name)
+            with open(p, "w") as f:
+                json.dump(data, f, indent=2)
+            names.append((p, name))
+        with tarfile.open(out_path, "w:gz") as tar:
+            for p, name in names:
+                tar.add(p, arcname=f"nomad-debug/{name}")
+    print(f"==> Wrote debug bundle to {out_path} "
+          f"({len(names)} captures)")
+
+
 def cmd_operator_raft(args) -> None:
     cfg = _request("GET", "/v1/operator/raft/configuration")
     _table(
@@ -876,6 +915,9 @@ def build_parser() -> argparse.ArgumentParser:
     oraft = op_sub.add_parser("raft")
     oraft.add_argument("action", choices=["list-peers"])
     oraft.set_defaults(fn=cmd_operator_raft)
+    odbg = op_sub.add_parser("debug")
+    odbg.add_argument("-output", dest="output", default="")
+    odbg.set_defaults(fn=cmd_operator_debug)
 
     mon = sub.add_parser("monitor")
     mon.add_argument(

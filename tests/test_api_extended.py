@@ -362,3 +362,20 @@ def test_cli_alloc_lifecycle(api, monkeypatch, capsys):
     assert (
         server.store.alloc_by_id(alloc.id).desired_status == "stop"
     )
+
+
+def test_cli_operator_debug(api, monkeypatch, capsys, tmp_path):
+    import tarfile
+
+    from nomad_tpu.cli import main
+
+    server, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    out = str(tmp_path / "bundle.tar.gz")
+    main(["operator", "debug", "-output", out])
+    assert "Wrote debug bundle" in capsys.readouterr().out
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+    assert "nomad-debug/agent-self.json" in names
+    assert "nomad-debug/pprof-goroutine.json" in names
+    assert "nomad-debug/metrics.json" in names
