@@ -38,6 +38,24 @@ from .codec import (
 )
 
 
+# data GET endpoints eligible for ?index= blocking queries
+_BLOCKING_PREFIXES = (
+    "/v1/jobs",
+    "/v1/job/",
+    "/v1/nodes",
+    "/v1/node/",
+    "/v1/allocations",
+    "/v1/allocation/",
+    "/v1/evaluations",
+    "/v1/evaluation/",
+    "/v1/deployments",
+    "/v1/deployment/",
+    "/v1/volumes",
+    "/v1/volume/",
+    "/v1/catalog/",
+)
+
+
 class HTTPError(Exception):
     def __init__(self, code: int, message: str) -> None:
         super().__init__(message)
@@ -68,6 +86,9 @@ class APIHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        index = getattr(self, "_reply_index", None)
+        if index is not None:
+            self.send_header("X-Nomad-Index", str(index))
         self.end_headers()
         self.wfile.write(data)
 
@@ -109,6 +130,45 @@ class APIHandler(BaseHTTPRequestHandler):
         path = url.path.rstrip("/")
         query = {k: v[0] for k, v in parse_qs(url.query).items()}
         try:
+            # blocking queries (reference rpc.go:780 blockingRPC): a GET
+            # with ?index=N long-polls until the state advances past N
+            # (or the wait expires), then responds with fresh data; the
+            # X-Nomad-Index response header feeds the next poll.
+            # Restricted to known data endpoints, and — with ACLs on —
+            # to requests whose token resolves, so unauthenticated or
+            # bogus requests can't pin server threads for the wait.
+            if (
+                method == "GET"
+                and "index" in query
+                and path.startswith(_BLOCKING_PREFIXES)
+            ):
+                acls = getattr(self.server_ref, "acls", None)
+                authed = not (acls is not None and acls.enabled) or (
+                    acls.resolve(
+                        self.headers.get("X-Nomad-Token", "")
+                    )
+                    is not None
+                )
+                try:
+                    min_index = int(query["index"]) + 1
+                    wait_s = min(
+                        float(query.get("wait", "5")), 60.0
+                    )
+                except ValueError:
+                    raise HTTPError(400, "bad index/wait")
+                if authed:
+                    self.server_ref.store.wait_for_index(
+                        min_index, timeout=wait_s
+                    )
+            # capture the reply index BEFORE the handler reads state:
+            # a concurrent write between read and respond must re-wake
+            # the next poll rather than be skipped past
+            try:
+                self._reply_index = (
+                    self.server_ref.store.latest_index()
+                )
+            except Exception:  # noqa: BLE001
+                self._reply_index = None
             handled = self._route(method, path, query)
             if not handled:
                 self._error(404, f"no handler for {method} {path}")

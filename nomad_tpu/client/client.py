@@ -48,10 +48,15 @@ class Client:
         self.data_dir = data_dir
         self.heartbeat_interval = heartbeat_interval
         self.watch_interval = watch_interval
-        self.drivers = {
-            name: new_driver(name)
-            for name in (drivers or list(BUILTIN_DRIVERS))
-        }
+        # a dict maps driver name -> instance (e.g. ExternalDriver
+        # plugin processes); a list names builtin drivers
+        if isinstance(drivers, dict):
+            self.drivers = dict(drivers)
+        else:
+            self.drivers = {
+                name: new_driver(name)
+                for name in (drivers or list(BUILTIN_DRIVERS))
+            }
         if fingerprint:
             run_fingerprinters(
                 self.node, include_tpu=include_tpu_fingerprint
@@ -127,6 +132,14 @@ class Client:
         for runner in self.alloc_runners.values():
             runner.destroy()
         self._persist()
+        # external plugin drivers own subprocesses/sockets
+        for driver in self.drivers.values():
+            shutdown = getattr(driver, "shutdown", None)
+            if callable(shutdown):
+                try:
+                    shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,374 @@
+"""External (out-of-process) driver plugins (reference plugins/base/
+plugin.go + plugins/drivers: every driver is a separate go-plugin
+process speaking gRPC over a unix socket).
+
+Same topology here, over the framed wire protocol (nomad_tpu/wire.py —
+the seam native/wire.{h,cpp} implements in C++, so plugins can be
+written in any language that frames msgpack-compatible messages):
+
+* **Host side** — `ExternalDriver` launches the plugin command, reads
+  the go-plugin-style handshake line ``1|1|unix|<socket path>|wire``
+  from its stdout, connects, and proxies the `DriverPlugin` surface as
+  wire calls.
+* **Plugin side** — `serve_plugin(driver)` wraps any in-process
+  `DriverPlugin` implementation as a plugin process: binds the socket,
+  prints the handshake, and dispatches calls.  `python -m
+  nomad_tpu.client.drivers.external <driver>` serves a builtin driver
+  this way (the loopback equivalent of go-plugin's internal drivers —
+  and the test fixture).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Any, Dict, Optional
+
+from ...wire import call, decode, encode, recv_frame, send_frame
+from .base import (
+    DriverHandle,
+    DriverPlugin,
+    RecoverableError,
+    TaskConfig,
+    TaskExitResult,
+)
+
+HANDSHAKE_CORE = 1
+HANDSHAKE_PROTO = 1
+
+
+def _cfg_to_wire(cfg: TaskConfig) -> Dict[str, Any]:
+    res = None
+    if cfg.resources is not None:
+        res = {
+            "cpu": getattr(cfg.resources, "cpu", 0),
+            "memory_mb": getattr(cfg.resources, "memory_mb", 0),
+            "disk_mb": getattr(cfg.resources, "disk_mb", 0),
+        }
+    return {
+        "id": cfg.id,
+        "name": cfg.name,
+        "alloc_id": cfg.alloc_id,
+        "config": cfg.config,
+        "env": cfg.env,
+        "alloc_dir": cfg.alloc_dir,
+        "task_dir": cfg.task_dir,
+        "logs_dir": cfg.logs_dir,
+        "log_max_files": cfg.log_max_files,
+        "log_max_file_size_mb": cfg.log_max_file_size_mb,
+        "resources": res,
+    }
+
+
+def _cfg_from_wire(raw: Dict[str, Any]) -> TaskConfig:
+    cfg = TaskConfig(
+        id=raw.get("id", ""),
+        name=raw.get("name", ""),
+        alloc_id=raw.get("alloc_id", ""),
+        config=raw.get("config") or {},
+        env=raw.get("env") or {},
+        alloc_dir=raw.get("alloc_dir", ""),
+        task_dir=raw.get("task_dir", ""),
+        logs_dir=raw.get("logs_dir", ""),
+        log_max_files=int(raw.get("log_max_files", 10)),
+        log_max_file_size_mb=int(raw.get("log_max_file_size_mb", 10)),
+    )
+    res = raw.get("resources")
+    if res:
+        from ...structs import Resources
+
+        cfg.resources = Resources(
+            cpu=int(res.get("cpu", 0)),
+            memory_mb=int(res.get("memory_mb", 0)),
+            disk_mb=int(res.get("disk_mb", 0)),
+        )
+    return cfg
+
+
+def _result_to_wire(r: Optional[TaskExitResult]):
+    if r is None:
+        return None
+    return {
+        "exit_code": r.exit_code,
+        "signal": r.signal,
+        "oom_killed": r.oom_killed,
+        "err": r.err,
+    }
+
+
+def _result_from_wire(raw) -> Optional[TaskExitResult]:
+    if raw is None:
+        return None
+    return TaskExitResult(
+        exit_code=int(raw.get("exit_code", 0)),
+        signal=int(raw.get("signal", 0)),
+        oom_killed=bool(raw.get("oom_killed", False)),
+        err=raw.get("err"),
+    )
+
+
+class ExternalDriver(DriverPlugin):
+    """Proxy to a driver plugin process (reference plugins/drivers
+    gRPC client; lifecycle per go-plugin: spawn, handshake, dial)."""
+
+    name = "external"
+
+    HANDSHAKE_TIMEOUT = 10.0
+    # slack past the logical call timeout before declaring the stream
+    # dead; the protocol has no request IDs, so a timed-out call
+    # poisons the connection (a late reply would answer the wrong
+    # request otherwise)
+    CALL_GRACE = 15.0
+
+    def __init__(self, plugin_cmd, name: str = "") -> None:
+        if name:
+            self.name = name
+        self._lock = threading.Lock()
+        self._broken = False
+        self.proc = subprocess.Popen(
+            list(plugin_cmd),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        line = self._read_handshake(plugin_cmd)
+        parts = line.split("|")
+        if len(parts) != 5 or parts[2] != "unix":
+            self.proc.kill()
+            raise RuntimeError(
+                f"bad plugin handshake from {plugin_cmd!r}: {line!r}"
+            )
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(30.0 + self.CALL_GRACE)
+        self.sock.connect(parts[3])
+
+    def _read_handshake(self, plugin_cmd) -> str:
+        """Bounded handshake read (go-plugin kills plugins that don't
+        handshake in time)."""
+        result: Dict[str, str] = {}
+
+        def read():
+            result["line"] = (
+                self.proc.stdout.readline() or ""
+            ).strip()
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(self.HANDSHAKE_TIMEOUT)
+        if t.is_alive():
+            self.proc.kill()
+            raise RuntimeError(
+                f"plugin {plugin_cmd!r} did not handshake within "
+                f"{self.HANDSHAKE_TIMEOUT}s"
+            )
+        return result.get("line", "")
+
+    def _call(
+        self, method: str, body: Any, timeout: Optional[float] = 30.0
+    ) -> Any:
+        with self._lock:
+            if self._broken:
+                raise RuntimeError(
+                    "plugin connection is poisoned by an earlier "
+                    "timeout; restart the plugin"
+                )
+            self.sock.settimeout(
+                None if timeout is None else timeout + self.CALL_GRACE
+            )
+            try:
+                resp = call(self.sock, method, body)
+            except socket.timeout:
+                self._broken = True
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                raise RuntimeError(
+                    f"plugin call {method} timed out; connection "
+                    "poisoned"
+                )
+        if isinstance(resp, dict) and resp.get("error"):
+            err = resp["error"]
+            if resp.get("recoverable"):
+                raise RecoverableError(err)
+            raise RuntimeError(err)
+        return resp
+
+    # -- DriverPlugin surface ------------------------------------------
+
+    def fingerprint(self) -> Dict[str, str]:
+        return self._call("Fingerprint", {}) or {}
+
+    def start_task(self, cfg: TaskConfig) -> DriverHandle:
+        self._call("StartTask", _cfg_to_wire(cfg))
+        return DriverHandle(cfg.id)
+
+    def wait_task(self, task_id, timeout=None):
+        return _result_from_wire(
+            self._call(
+                "WaitTask",
+                {"task_id": task_id, "timeout": timeout},
+                timeout=timeout,
+            )
+        )
+
+    def stop_task(self, task_id, timeout=5.0, signal="SIGTERM"):
+        self._call(
+            "StopTask",
+            {"task_id": task_id, "timeout": timeout, "signal": signal},
+            timeout=timeout + 10.0,
+        )
+
+    def destroy_task(self, task_id, force=False):
+        self._call(
+            "DestroyTask", {"task_id": task_id, "force": force}
+        )
+
+    def signal_task(self, task_id, signal="SIGTERM"):
+        self._call(
+            "SignalTask", {"task_id": task_id, "signal": signal}
+        )
+
+    def exec_task(self, task_id, argv, timeout=30.0, env=None, cwd=""):
+        resp = self._call(
+            "ExecTask",
+            {
+                "task_id": task_id,
+                "argv": list(argv),
+                "timeout": timeout,
+                "env": env or {},
+                "cwd": cwd,
+            },
+            timeout=timeout,
+        )
+        return int(resp["exit_code"]), bytes(
+            resp.get("output", b"") or b""
+        )
+
+    def inspect_task(self, task_id):
+        raise NotImplementedError
+
+    def recover_task(self, task_id, handle_state) -> bool:
+        return bool(
+            self._call(
+                "RecoverTask",
+                {"task_id": task_id, "handle_state": handle_state},
+            )
+        )
+
+    def shutdown(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.proc.terminate()
+        try:
+            self.proc.wait(5.0)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# plugin side
+# ---------------------------------------------------------------------------
+
+
+def serve_plugin(driver: DriverPlugin, socket_path: str = "") -> None:
+    """Serve a DriverPlugin over the wire protocol; prints the
+    handshake and blocks (reference plugins/base/plugin.go Serve)."""
+    socket_path = socket_path or os.path.join(
+        tempfile.mkdtemp(prefix="nomad-plugin-"), "plugin.sock"
+    )
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(socket_path)
+    srv.listen(4)
+    print(
+        f"{HANDSHAKE_CORE}|{HANDSHAKE_PROTO}|unix|{socket_path}|wire",
+        flush=True,
+    )
+
+    def handle(conn):
+        while True:
+            frame = recv_frame(conn)
+            if frame is None:
+                return
+            method, body = decode(frame)
+            try:
+                result = _dispatch(driver, method, body)
+            except RecoverableError as exc:
+                result = {"error": str(exc), "recoverable": True}
+            except Exception as exc:  # noqa: BLE001
+                result = {"error": f"{type(exc).__name__}: {exc}"}
+            send_frame(conn, encode(result))
+
+    while True:
+        conn, _addr = srv.accept()
+        threading.Thread(
+            target=handle, args=(conn,), daemon=True
+        ).start()
+
+
+def _dispatch(driver: DriverPlugin, method: str, body: Dict):
+    if method == "Fingerprint":
+        return driver.fingerprint()
+    if method == "StartTask":
+        driver.start_task(_cfg_from_wire(body))
+        return {}
+    if method == "WaitTask":
+        return _result_to_wire(
+            driver.wait_task(body["task_id"], body.get("timeout"))
+        )
+    if method == "StopTask":
+        driver.stop_task(
+            body["task_id"],
+            timeout=body.get("timeout", 5.0),
+            signal=body.get("signal", "SIGTERM"),
+        )
+        return {}
+    if method == "DestroyTask":
+        driver.destroy_task(
+            body["task_id"], force=body.get("force", False)
+        )
+        return {}
+    if method == "SignalTask":
+        driver.signal_task(
+            body["task_id"], body.get("signal", "SIGTERM")
+        )
+        return {}
+    if method == "ExecTask":
+        code, output = driver.exec_task(
+            body["task_id"],
+            body.get("argv") or [],
+            timeout=body.get("timeout", 30.0),
+            env=body.get("env") or {},
+            cwd=body.get("cwd", ""),
+        )
+        return {"exit_code": code, "output": output}
+    if method == "RecoverTask":
+        return driver.recover_task(
+            body["task_id"], body.get("handle_state") or {}
+        )
+    raise ValueError(f"unknown plugin method {method!r}")
+
+
+def main(argv=None) -> None:
+    """``python -m nomad_tpu.client.drivers.external <builtin>`` —
+    serve a builtin driver as an external plugin process."""
+    from . import new_driver
+
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) != 1:
+        print(
+            "usage: python -m nomad_tpu.client.drivers.external "
+            "<driver-name>",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    serve_plugin(new_driver(args[0]))
+
+
+if __name__ == "__main__":
+    main()

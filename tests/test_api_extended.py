@@ -379,3 +379,55 @@ def test_cli_operator_debug(api, monkeypatch, capsys, tmp_path):
     assert "nomad-debug/agent-self.json" in names
     assert "nomad-debug/pprof-goroutine.json" in names
     assert "nomad-debug/metrics.json" in names
+
+
+def test_blocking_queries(api):
+    import threading
+
+    server, base = api
+    server.register_node(mock.node())
+    # non-blocking when the index is already stale
+    req = urllib.request.urlopen(
+        base + "/v1/jobs?index=0&wait=5", timeout=10
+    )
+    assert req.headers.get("X-Nomad-Index") is not None
+    idx = int(req.headers["X-Nomad-Index"])
+    req.read()
+
+    # blocks until a write advances the state
+    got = {}
+
+    def poll():
+        t0 = time.monotonic()
+        r = urllib.request.urlopen(
+            base + f"/v1/jobs?index={idx}&wait=10", timeout=20
+        )
+        got["dt"] = time.monotonic() - t0
+        got["jobs"] = json.loads(r.read())
+        got["index"] = int(r.headers["X-Nomad-Index"])
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.3)
+    server.register_job(mock.job(id="blockjob"))
+    t.join(15)
+    assert not t.is_alive()
+    assert got["dt"] >= 0.25  # actually waited
+    assert got["index"] > idx
+    assert any(j["ID"] == "blockjob" for j in got["jobs"])
+
+    # wait expiry returns current data; background scheduling may
+    # advance the index concurrently, which also legitimately wakes it
+    server.drain_to_idle(10)
+    r0 = urllib.request.urlopen(base + "/v1/jobs", timeout=10)
+    idx2 = int(r0.headers["X-Nomad-Index"])
+    r0.read()
+    t0 = time.monotonic()
+    r = urllib.request.urlopen(
+        base + f"/v1/jobs?index={idx2}&wait=0.4", timeout=10
+    )
+    dt = time.monotonic() - t0
+    woke_index = int(r.headers["X-Nomad-Index"])
+    r.read()
+    assert dt < 5.0
+    assert dt >= 0.3 or woke_index > idx2
