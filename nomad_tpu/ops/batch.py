@@ -33,6 +33,23 @@ import jax.numpy as jnp
 from .score import MAX_SKIP, NO_NODE, SKIP_THRESHOLD
 
 
+class SpreadInputs(NamedTuple):
+    """Percent-target spread state for the in-kernel carry (reference
+    spread.go:163 boost; the use counts that shift between picks are a
+    small per-value vector updated by one-hot scatter each step).
+
+    Shapes: S spread stanzas x (V+1) value slots; slot V is the penalty
+    slot (missing attribute, or value with no target and no implicit
+    "*") scoring a flat -1.0.  Even-spread mode (spread.go:178) stays on
+    the exact host path."""
+
+    codes: jnp.ndarray  # i32[S, C] value slot per node (V = penalty)
+    desired: jnp.ndarray  # f[S, V+1] desired count per slot
+    used0: jnp.ndarray  # f[S, V+1] combined use at snapshot
+    weight: jnp.ndarray  # f[S] weight / sum(|weights|)
+    active: jnp.ndarray  # bool[S] (padding rows are inert)
+
+
 class BatchInputs(NamedTuple):
     """Per-eval inputs (leading axis E when vmapped); node columns are
     shared."""
@@ -134,6 +151,7 @@ def _run_picks(
     wanted=None,  # i32 scalar: picks actually desired (<= n_picks);
                   # surplus scan steps are inert so a batch can share one
                   # static scan length without phantom placements
+    spread: "SpreadInputs" = None,
 ):
     """Inner pick scan; returns (rows i32[P], final used columns).
 
@@ -160,8 +178,29 @@ def _run_picks(
     safe_cpu = jnp.where(cpu_total_p > 0, cpu_total_p, 1.0)
     safe_mem = jnp.where(mem_total_p > 0, mem_total_p, 1.0)
 
+    if spread is not None:
+        # small-vocab lookups as one-hot matmuls (MXU-friendly; avoids
+        # per-step gathers): desired/penalty per node are static,
+        # used-per-node recomputes from the (S, V+1) carry each step
+        _S, V1 = spread.desired.shape
+        codes_sp = jnp.take(spread.codes, perm, axis=1)  # (S, C)
+        onehot_p = jax.nn.one_hot(codes_sp, V1, dtype=dtype)
+        desired_node = jnp.einsum(
+            "scv,sv->sc", onehot_p, spread.desired
+        )
+        penalty_node = codes_sp == (V1 - 1)
+        safe_desired = jnp.where(desired_node != 0, desired_node, 1.0)
+
     def step(carry, pick_idx):
-        cpu_used, mem_used, disk_used, collisions, excl, offset = carry
+        if spread is not None:
+            (
+                cpu_used, mem_used, disk_used, collisions, excl,
+                offset, spread_used,
+            ) = carry
+        else:
+            cpu_used, mem_used, disk_used, collisions, excl, offset = (
+                carry
+            )
         active = pick_idx < wanted
         cpu_after = cpu_used + inp.ask_cpu
         mem_after = mem_used + inp.ask_mem
@@ -199,6 +238,26 @@ def _run_picks(
         has_aff = aff_p != 0.0
         score_sum = score_sum + jnp.where(has_aff, aff_p, 0.0)
         count = count + has_aff.astype(dtype)
+        if spread is not None:
+            # boost per stanza: ((desired - (used+1)) / desired) * w,
+            # -1.0 on the penalty slot (spread.py next()); appended to
+            # the score list only when the total is non-zero
+            used_node = jnp.einsum(
+                "scv,sv->sc", onehot_p, spread_used
+            )
+            frac = (desired_node - (used_node + 1.0)) / safe_desired
+            contrib = jnp.where(
+                penalty_node,
+                jnp.asarray(-1.0, dtype),
+                frac * spread.weight[:, None],
+            )
+            contrib = jnp.where(
+                spread.active[:, None], contrib, 0.0
+            )
+            spread_total = jnp.sum(contrib, axis=0)
+            has_spread = spread_total != 0.0
+            score_sum = score_sum + spread_total
+            count = count + has_spread.astype(dtype)
         final = score_sum / count
 
         win, any_emitted, pulls = _walk(
@@ -221,6 +280,15 @@ def _run_picks(
             jnp.where(ok & inp.distinct_hosts, True, excl[safe_win])
         )
         offset = jnp.mod(offset + pulls, n_candidates)
+        if spread is not None:
+            # the placed node's value slot gains one use per stanza
+            spread_used = spread_used + jnp.where(
+                ok, onehot_p[:, safe_win, :], 0.0
+            )
+            return (
+                cpu_used, mem_used, disk_used, collisions, excl,
+                offset, spread_used,
+            ), row
         return (
             cpu_used,
             mem_used,
@@ -238,6 +306,8 @@ def _run_picks(
         jnp.zeros_like(feas_p),
         jnp.asarray(0, jnp.int32),
     )
+    if spread is not None:
+        carry0 = carry0 + (spread.used0.astype(dtype),)
     _final, rows = jax.lax.scan(
         step, carry0, jnp.arange(n_picks, dtype=jnp.int32)
     )
@@ -271,6 +341,7 @@ def plan_picks(
     n_candidates,
     n_picks: int,
     spread_fit: bool = False,
+    spread: SpreadInputs = None,
 ):
     """P sequential placements for one eval; returns rows i32[P]
     (NO_NODE when placement failed)."""
@@ -283,6 +354,7 @@ def plan_picks(
         n_candidates,
         n_picks,
         spread_fit,
+        spread=spread,
     )
     return rows
 
@@ -299,6 +371,7 @@ def chained_plan_picks(
     n_picks: int,
     spread_fit: bool = False,
     wanted=None,  # i32[E]: per-eval pick counts (<= n_picks)
+    spread: SpreadInputs = None,  # leading axis E on every field
 ):
     """E evals x P picks in ONE launch, *serially equivalent*: a
     lax.scan over the evals carries the proposed-usage columns forward,
@@ -316,6 +389,26 @@ def chained_plan_picks(
     if wanted is None:
         wanted = jnp.full((E,), n_picks, jnp.int32)
 
+    used0 = (
+        batch.base_cpu_used[0],
+        batch.base_mem_used[0],
+        batch.base_disk_used[0],
+    )
+    if spread is not None:
+
+        def eval_step_s(used, xs):
+            b, n, w, s = xs
+            rows, used_next = _run_picks(
+                cpu_total, mem_total, disk_total, used, b, n,
+                n_picks, spread_fit, wanted=w, spread=s,
+            )
+            return used_next, rows
+
+        _final, rows = jax.lax.scan(
+            eval_step_s, used0, (batch, nc, wanted, spread)
+        )
+        return rows
+
     def eval_step(used, xs):
         b, n, w = xs
         rows, used_next = _run_picks(
@@ -331,11 +424,6 @@ def chained_plan_picks(
         )
         return used_next, rows
 
-    used0 = (
-        batch.base_cpu_used[0],
-        batch.base_mem_used[0],
-        batch.base_disk_used[0],
-    )
     _final, rows = jax.lax.scan(eval_step, used0, (batch, nc, wanted))
     return rows
 
@@ -480,11 +568,19 @@ def batch_plan_picks(
     n_candidates,  # scalar or per-eval i32[E] (walk rotation modulus)
     n_picks: int,
     spread_fit: bool = False,
+    spread: SpreadInputs = None,  # leading axis E on every field
 ):
     """E independent evals x P picks in one launch; returns rows
     i32[E, P]."""
     E = batch.perm.shape[0]
     nc = jnp.broadcast_to(jnp.asarray(n_candidates, jnp.int32), (E,))
+    if spread is not None:
+        return jax.vmap(
+            lambda b, n, s: plan_picks(
+                cpu_total, mem_total, disk_total, b, n,
+                n_picks, spread_fit, spread=s,
+            )
+        )(batch, nc, spread)
     return jax.vmap(
         lambda b, n: plan_picks(
             cpu_total,

@@ -193,6 +193,42 @@ class MaskCompiler:
 
     # ------------------------------------------------------------------
 
+    def spread_kernel_inputs(
+        self,
+        attribute: str,
+        desired_counts: Dict[str, float],
+        combined_use: Dict[str, int],
+    ):
+        """Columns for the in-kernel spread carry (ops/batch.py
+        SpreadInputs): per-node value slot codes, desired count and
+        initial use per slot.  The last slot is the penalty slot
+        (missing attribute / value with no target and no implicit "*"),
+        matching spread_boost_vector's -1.0 semantics."""
+        C = self.table.capacity
+        key = target_column_key(attribute) or ""
+        if key == "":
+            # non-interpolatable attribute: every node is a penalty
+            codes = np.zeros(C, dtype=np.int32)
+            return codes, np.zeros(1), np.zeros(1)
+        col = self.table.column(key)
+        vocab = col.interner.values
+        V = len(vocab)
+        slot_of = np.full(V + 1, V, dtype=np.int32)
+        desired = np.zeros(V + 1, dtype=np.float64)
+        used0 = np.zeros(V + 1, dtype=np.float64)
+        for i, value in enumerate(vocab):
+            d = desired_counts.get(value)
+            if d is None:
+                d = desired_counts.get("*")
+            if d is None:
+                continue  # stays on the penalty slot
+            slot_of[i] = i
+            desired[i] = d
+            used0[i] = float(combined_use.get(value, 0))
+        node_codes = np.where(col.codes >= 0, col.codes, V)
+        codes = slot_of[node_codes]
+        return codes, desired, used0
+
     def spread_boost_vector(
         self,
         attribute: str,

@@ -17,6 +17,35 @@ from .rank import RankedNode
 IMPLICIT_TARGET = "*"
 
 
+def compute_spread_info(spreads, total_count: int):
+    """Attribute-keyed desired counts + weights (reference
+    spread.go:232 computeSpreadInfo).  Later stanzas overwrite earlier
+    ones per attribute — reference behavior when job- and group-level
+    spreads share an attribute — while every stanza's weight counts
+    toward the sum.  Returns (infos, sum_weights)."""
+    infos: Dict[str, dict] = {}
+    sum_weights = 0
+    for spread in spreads:
+        desired_counts: Dict[str, float] = {}
+        sum_desired = 0.0
+        for target in spread.targets:
+            desired = (float(target.percent) / 100.0) * float(
+                total_count
+            )
+            desired_counts[target.value] = desired
+            sum_desired += desired
+        if 0 < sum_desired < float(total_count):
+            desired_counts[IMPLICIT_TARGET] = (
+                float(total_count) - sum_desired
+            )
+        infos[spread.attribute] = {
+            "weight": spread.weight,
+            "desired_counts": desired_counts,
+        }
+        sum_weights += spread.weight
+    return infos, sum_weights
+
+
 class SpreadIterator:
     def __init__(self, ctx: EvalContext, source) -> None:
         self.ctx = ctx
@@ -113,23 +142,9 @@ class SpreadIterator:
 
     def _compute_spread_info(self, tg: TaskGroup) -> None:
         """(reference spread.go:232 computeSpreadInfo)"""
-        infos: Dict[str, dict] = {}
-        total_count = tg.count
         combined = list(tg.spreads) + list(self.job_spreads)
-        for spread in combined:
-            desired_counts: Dict[str, float] = {}
-            sum_desired = 0.0
-            for target in spread.targets:
-                desired = (float(target.percent) / 100.0) * float(total_count)
-                desired_counts[target.value] = desired
-                sum_desired += desired
-            if 0 < sum_desired < float(total_count):
-                desired_counts[IMPLICIT_TARGET] = float(total_count) - sum_desired
-            infos[spread.attribute] = {
-                "weight": spread.weight,
-                "desired_counts": desired_counts,
-            }
-            self.sum_spread_weights += spread.weight
+        infos, sum_weights = compute_spread_info(combined, tg.count)
+        self.sum_spread_weights += sum_weights
         self.tg_spread_info[tg.name] = infos
 
 

@@ -564,25 +564,9 @@ class TPUGenericStack:
                 pset.set_target_attribute(spread.attribute, tg.name)
                 psets.append(pset)
             self._spread_psets[tg.name] = psets
-            info: Dict[str, Dict] = {}
-            total_count = tg.count
-            sum_weights = 0
-            for spread in combined:
-                desired: Dict[str, float] = {}
-                sum_desired = 0.0
-                for target in spread.targets:
-                    dc = (float(target.percent) / 100.0) * float(
-                        total_count
-                    )
-                    desired[target.value] = dc
-                    sum_desired += dc
-                if 0 < sum_desired < float(total_count):
-                    desired["*"] = float(total_count) - sum_desired
-                info[spread.attribute] = {
-                    "weight": spread.weight,
-                    "desired_counts": desired,
-                }
-                sum_weights += spread.weight
+            from .spread import compute_spread_info
+
+            info, sum_weights = compute_spread_info(combined, tg.count)
             self._spread_info[tg.name] = info
             self._sum_spread_weights = sum_weights
         else:

@@ -199,7 +199,12 @@ class BatchWorker(Worker):
         if len(job.task_groups) != 1:
             return False
         tg = job.task_groups[0]
-        if tg.spreads or job.spreads:
+        # percent-target spreads run in-kernel (SpreadInputs carry);
+        # even-spread mode (no targets) stays on the exact path
+        if any(
+            not sp.targets
+            for sp in list(tg.spreads) + list(job.spreads)
+        ):
             return False
         if tg.networks or any(t.resources.networks for t in tg.tasks):
             return False
@@ -231,6 +236,8 @@ class BatchWorker(Worker):
 
         per_eval: List[BatchInputs] = []
         n_cands: List[int] = []
+        # per eval: list of (codes, desired, used0, weight_frac) or None
+        spread_per_eval: List[Optional[list]] = []
         max_picks = 1
         for ev, _token, job, tg in prescorable:
             nodes, _by_dc = ready_nodes_in_dcs(snap, job.datacenters)
@@ -272,8 +279,41 @@ class BatchWorker(Worker):
             total, sum_w = compiler.affinity_score_vector(affinities)
             aff_vec = total / sum_w if sum_w else np.zeros(C)
 
+            # percent-target spreads -> in-kernel carry inputs.  The
+            # info map is attribute-keyed (shared compute_spread_info,
+            # spread.go:232): when job- and group-level stanzas share
+            # an attribute, every pset scores with the overwrite
+            # winner's desired/weight — exactly like SpreadIterator.
+            combined_spreads = list(tg.spreads) + list(job.spreads)
+            eval_spreads = None
+            if combined_spreads:
+                from ..sched.spread import compute_spread_info
+
+                info, spread_sum_w = compute_spread_info(
+                    combined_spreads, tg.count
+                )
+                spread_sum_w = spread_sum_w or 1
+                eval_spreads = []
+                # one kernel stanza per pset (job-level first, then
+                # group-level — spread.py set_task_group ordering)
+                for sp in list(job.spreads) + list(tg.spreads):
+                    attr_info = info[sp.attribute]
+                    codes, desired, used0 = (
+                        compiler.spread_kernel_inputs(
+                            sp.attribute,
+                            attr_info["desired_counts"],
+                            {},
+                        )
+                    )
+                    eval_spreads.append(
+                        (codes, desired, used0,
+                         float(attr_info["weight"])
+                         / float(spread_sum_w))
+                    )
+            spread_per_eval.append(eval_spreads)
+
             limit = compute_visit_limit(n_cand, ev.type == "batch")
-            if affinities:
+            if affinities or combined_spreads:
                 limit = 2**31 - 1
 
             max_picks = max(max_picks, tg.count)
@@ -307,6 +347,46 @@ class BatchWorker(Worker):
                 for f in BatchInputs._fields
             ]
         )
+        spread_stack = None
+        if any(s for s in spread_per_eval):
+            from ..ops.batch import SpreadInputs
+
+            E = len(per_eval)
+            S = max(len(s or ()) for s in spread_per_eval)
+            V1 = max(
+                (
+                    len(d)
+                    for s in spread_per_eval
+                    for (_c, d, _u, _w) in (s or ())
+                ),
+                default=1,
+            )
+            s_codes = np.zeros((E, S, C), np.int32)
+            s_desired = np.zeros((E, S, V1))
+            s_used0 = np.zeros((E, S, V1))
+            s_weight = np.zeros((E, S))
+            s_active = np.zeros((E, S), dtype=bool)
+            for k, s in enumerate(spread_per_eval):
+                for j, (c, d, u, w) in enumerate(s or ()):
+                    # this eval's penalty slot moves to the shared
+                    # V1-1 slot under padding
+                    pen = len(d) - 1
+                    s_codes[k, j] = np.where(c == pen, V1 - 1, c)
+                    s_desired[k, j, : pen] = d[:-1]
+                    s_used0[k, j, : pen] = u[:-1]
+                    s_weight[k, j] = w
+                    s_active[k, j] = True
+            spread_stack = SpreadInputs(
+                codes=s_codes,
+                desired=s_desired,
+                used0=s_used0,
+                weight=s_weight,
+                active=s_active,
+            )
+        spread_fit = (
+            snap.scheduler_config().effective_scheduler_algorithm()
+            == "spread"
+        )
         rows_out = np.asarray(
             chained_plan_picks(
                 table.cpu_total,
@@ -315,10 +395,12 @@ class BatchWorker(Worker):
                 stacked,
                 np.asarray(n_cands, np.int32),
                 int(max_picks),
+                spread_fit=spread_fit,
                 wanted=np.asarray(
                     [tg.count for _e, _t, _j, tg in prescorable],
                     np.int32,
                 ),
+                spread=spread_stack,
             )
         )
         out: Dict[str, List[int]] = {}

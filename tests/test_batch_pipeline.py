@@ -132,3 +132,161 @@ def test_batch_pipeline_blocked_eval_on_exhaustion():
         assert settled()
     finally:
         server.stop()
+
+
+def test_batch_pipeline_spread_in_kernel_matches_sequential():
+    """Percent-target spread jobs run through the in-kernel carry and
+    produce placements identical to the sequential scheduler
+    (spread.go:163 boost semantics, SpreadInputs in ops/batch.py)."""
+    from nomad_tpu.structs import Affinity, Spread, SpreadTarget
+
+    rng = random.Random(5)
+    nodes = []
+    for i in range(24):
+        node = mock.node()
+        node.datacenter = rng.choice(["dc1", "dc2", "dc3"])
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+
+    def spread_job(i):
+        job = mock.job(id=f"spread-{i}")
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = 6
+        tg.tasks[0].resources.cpu = 300
+        job.spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=60,
+                targets=[
+                    SpreadTarget(value="dc1", percent=50),
+                    SpreadTarget(value="dc2", percent=30),
+                    # dc3 via the implicit "*" remainder
+                ],
+            )
+        ]
+        if i % 2:
+            job.affinities = [
+                Affinity(
+                    ltarget="${node.datacenter}",
+                    operand="=",
+                    rtarget="dc2",
+                    weight=40,
+                )
+            ]
+        return job
+
+    jobs = [spread_job(i) for i in range(6)]
+    # plus interleaved plain jobs: mixed batches must stack correctly
+    plain = make_jobs(3, seed=9)
+
+    seq = Server(num_schedulers=1, seed=42)
+    bat = Server(num_schedulers=1, seed=42, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        for job in jobs + plain:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(20)
+        for job in jobs + plain:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(40)
+
+        for job in jobs + plain:
+            assert placements(seq, job.id) == placements(bat, job.id), (
+                f"divergence for {job.id}"
+            )
+        worker = bat.workers[0]
+        assert worker.prescored >= len(jobs) + len(plain), (
+            f"spread jobs fell back: prescored={worker.prescored} "
+            f"fallbacks={worker.fallbacks}"
+        )
+        # distribution sanity: dc1 got the most (50% target)
+        by_dc = {}
+        node_dc = {n.id: n.datacenter for n in nodes}
+        for _name, node_id in placements(bat, "spread-0"):
+            by_dc[node_dc[node_id]] = by_dc.get(node_dc[node_id], 0) + 1
+        assert by_dc.get("dc1", 0) >= max(by_dc.values()) - 1
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_even_spread_still_falls_back():
+    from nomad_tpu.structs import Spread
+
+    server = Server(num_schedulers=1, seed=7, batch_pipeline=True)
+    server.start()
+    try:
+        for node in make_nodes(8, seed=3):
+            server.register_node(node)
+        job = mock.job(id="even-spread")
+        job.task_groups[0].count = 4
+        # no targets -> even-spread mode -> exact path
+        job.spreads = [
+            Spread(attribute="${node.datacenter}", weight=50)
+        ]
+        server.register_job(job)
+        assert server.drain_to_idle(15)
+        assert len(placements(server, "even-spread")) == 4
+    finally:
+        server.stop()
+
+
+def test_batch_pipeline_duplicate_spread_attribute_matches():
+    """Job- and group-level spreads on the same attribute: the
+    attribute-keyed info map double-applies the overwrite winner
+    (reference computeSpreadInfo semantics) — the kernel must match."""
+    from nomad_tpu.structs import Spread, SpreadTarget
+
+    rng = random.Random(11)
+    nodes = []
+    for _ in range(18):
+        node = mock.node()
+        node.datacenter = rng.choice(["dc1", "dc2", "dc3"])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+
+    job = mock.job(id="dup-spread")
+    job.datacenters = ["dc1", "dc2", "dc3"]
+    tg = job.task_groups[0]
+    tg.count = 6
+    job.spreads = [
+        Spread(
+            attribute="${node.datacenter}",
+            weight=80,
+            targets=[SpreadTarget(value="dc1", percent=70)],
+        )
+    ]
+    tg.spreads = [
+        Spread(
+            attribute="${node.datacenter}",
+            weight=20,
+            targets=[SpreadTarget(value="dc2", percent=60)],
+        )
+    ]
+
+    seq = Server(num_schedulers=1, seed=13)
+    bat = Server(num_schedulers=1, seed=13, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(20)
+        bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(20)
+        assert placements(seq, "dup-spread") == placements(
+            bat, "dup-spread"
+        )
+        assert bat.workers[0].prescored >= 1
+    finally:
+        seq.stop()
+        bat.stop()
