@@ -32,15 +32,28 @@ class _ContainerHandle(DriverHandle):
 class DockerDriver(DriverPlugin):
     name = "docker"
 
+    # how long a daemon probe result stays fresh; the reference
+    # re-fingerprints drivers periodically so a daemon that starts or
+    # dies after agent boot flips the node's driver attribute
+    PROBE_TTL = 30.0
+
     def __init__(self) -> None:
         self._docker = shutil.which("docker")
         self.handles: Dict[str, _ContainerHandle] = {}
         self._daemon_ok: Optional[bool] = None
+        self._probed_at = 0.0
 
     # ------------------------------------------------------------------
 
     def _daemon_reachable(self) -> bool:
-        if self._daemon_ok is None:
+        import time
+
+        now = time.monotonic()
+        if (
+            self._daemon_ok is None
+            or now - self._probed_at >= self.PROBE_TTL
+        ):
+            self._probed_at = now
             if not self._docker:
                 self._daemon_ok = False
             else:

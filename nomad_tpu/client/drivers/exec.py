@@ -133,10 +133,15 @@ class RawExecDriver(DriverPlugin):
             self.stop_task(task_id, timeout=0.5, signal="SIGKILL")
         self.handles.pop(task_id, None)
 
+    def _exec_base_env(self) -> Dict[str, str]:
+        # raw_exec tasks run with the host environment, so exec
+        # sessions into them do too (ExecDriver restricts this)
+        return dict(os.environ)
+
     def exec_task(self, task_id, argv, timeout=30.0, env=None, cwd=""):
         if task_id not in self.handles:
             raise KeyError(f"unknown task {task_id!r}")
-        run_env = dict(os.environ)
+        run_env = self._exec_base_env()
         run_env.update(env or {})
         try:
             out = subprocess.run(
@@ -206,3 +211,9 @@ class ExecDriver(RawExecDriver):
         env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
         env.update(cfg.env or {})
         return self._spawn(cfg, argv, cwd, env)
+
+    def _exec_base_env(self) -> Dict[str, str]:
+        # alloc exec runs under the same restricted env as the task
+        # itself — never the agent's os.environ (which may carry
+        # secrets); mirrors _popen's policy
+        return {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}

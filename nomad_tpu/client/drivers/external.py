@@ -206,14 +206,29 @@ class ExternalDriver(DriverPlugin):
         self._call("StartTask", _cfg_to_wire(cfg))
         return DriverHandle(cfg.id)
 
+    # wait_task(timeout=None) polls in bounded slices: the wire
+    # protocol is single-in-flight under _lock, so one unbounded
+    # WaitTask would block stop/signal/exec for every task on the
+    # plugin; slicing releases the lock between polls
+    WAIT_SLICE = 1.0
+
     def wait_task(self, task_id, timeout=None):
-        return _result_from_wire(
-            self._call(
-                "WaitTask",
-                {"task_id": task_id, "timeout": timeout},
-                timeout=timeout,
+        if timeout is not None:
+            return _result_from_wire(
+                self._call(
+                    "WaitTask",
+                    {"task_id": task_id, "timeout": timeout},
+                    timeout=timeout,
+                )
             )
-        )
+        while True:
+            raw = self._call(
+                "WaitTask",
+                {"task_id": task_id, "timeout": self.WAIT_SLICE},
+                timeout=self.WAIT_SLICE,
+            )
+            if raw is not None:
+                return _result_from_wire(raw)
 
     def stop_task(self, task_id, timeout=5.0, signal="SIGTERM"):
         self._call(

@@ -14,6 +14,7 @@ from typing import List, Optional
 
 KIND_COMMAND = 0
 KIND_NOOP = 1  # barrier entry appended on leadership (raft LogNoop)
+KIND_CONFIG = 2  # membership change (raft LogConfiguration)
 
 
 @dataclass

@@ -11,13 +11,14 @@ server layer uses the way the reference uses the raft leaderCh
 """
 from __future__ import annotations
 
+import pickle
 import queue
 import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from .log import KIND_COMMAND, KIND_NOOP, LogEntry, RaftLog
+from .log import KIND_COMMAND, KIND_CONFIG, KIND_NOOP, LogEntry, RaftLog
 from .transport import TransportError
 
 FOLLOWER = "follower"
@@ -94,6 +95,11 @@ class RaftNode:
 
         # retained FSM snapshot for follower catch-up
         self._snapshot_data: Optional[bytes] = None
+        self._snapshot_config: Optional[List[str]] = None
+        # newest config entry appended this leadership (None = none
+        # pending; config changes chain off it, not the applied set)
+        self._proposed_members: Optional[List[str]] = None
+        self._removed = False  # this server was removed from the config
 
         self._deadline = 0.0  # election deadline (monotonic)
         self._wake = threading.Event()
@@ -170,9 +176,11 @@ class RaftNode:
     # -- public API -----------------------------------------------------
 
     def add_peer(self, addr: str) -> None:
-        """Membership change: add a voter (the autopilot/join seam;
+        """Local membership change: add a voter (bootstrap/join seam;
         single-step config change, not joint consensus — safe here
-        because changes are serialized through the leader)."""
+        because changes are serialized through the leader).  For a
+        running cluster, prefer add_server which commits the change
+        through the replicated log."""
         with self._lock:
             if addr == self.addr or addr in self.peers:
                 return
@@ -183,8 +191,8 @@ class RaftNode:
         self._wake.set()
 
     def remove_peer(self, addr: str) -> None:
-        """Membership change: drop a dead voter (reference
-        autopilot RemoveFailedServer path)."""
+        """Local membership change: drop a dead voter.  For a running
+        cluster, prefer remove_server (replicated)."""
         with self._lock:
             if addr not in self.peers:
                 return
@@ -192,6 +200,105 @@ class RaftNode:
             self._next_index.pop(addr, None)
             self._match_index.pop(addr, None)
         self._wake.set()
+
+    # -- replicated membership changes ---------------------------------
+
+    def _membership(self) -> List[str]:
+        """Full voter set (lock held)."""
+        return sorted(set(self.peers) | {self.addr})
+
+    def _propose_config(self, mutate, timeout: float):
+        """Append a configuration entry; the new member list is derived
+        under the lock from the *latest proposed* configuration (the
+        most recent config entry in the log, committed or not), so
+        concurrent single-server changes chain instead of reverting
+        each other — matching hashicorp/raft's rule that the newest
+        config entry in the log is the one in effect."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            base = (
+                self._proposed_members
+                if self._proposed_members is not None
+                else self._membership()
+            )
+            members = mutate(list(base))
+            if members is None:
+                return None  # no-op against the latest config
+            members = sorted(set(members))
+            index = self.log.last_index() + 1
+            self.log.append(
+                LogEntry(
+                    index,
+                    self.current_term,
+                    KIND_CONFIG,
+                    pickle.dumps(members),
+                )
+            )
+            self._proposed_members = members
+            fut = _Future()
+            self._futures[index] = fut
+        self._wake.set()
+        return fut.wait(timeout)
+
+    def add_server(self, addr: str, timeout: float = 5.0) -> None:
+        """Replicated membership change: commit a new voter through the
+        log so every replica converges on the same configuration
+        (reference: serf join -> raft.AddVoter on the leader)."""
+
+        def mutate(base):
+            if addr in base:
+                return None
+            return base + [addr]
+
+        self._propose_config(mutate, timeout)
+
+    def remove_server(self, addr: str, timeout: float = 5.0) -> None:
+        """Replicated membership change: drop a voter through the log
+        (reference nomad/autopilot.go dead-server cleanup applies
+        raft.RemoveServer, a replicated config change).  Removing the
+        leader itself commits the change and then steps down, as
+        hashicorp/raft does."""
+
+        def mutate(base):
+            if addr not in base:
+                return None
+            return [m for m in base if m != addr]
+
+        self._propose_config(mutate, timeout)
+
+    def _apply_membership(self, members: List[str]) -> None:
+        """Install a committed configuration (lock held)."""
+        if self._proposed_members == sorted(members):
+            self._proposed_members = None
+        if self.addr not in members:
+            # we were removed: stop counting ourselves toward quorum
+            # and never campaign again (reference: removed servers shut
+            # down; a leader steps down on self-removal)
+            self.peers = []
+            self._next_index.clear()
+            self._match_index.clear()
+            self._removed = True
+            self._deadline = float("inf")
+            if self.state == LEADER:
+                for fut in self._futures.values():
+                    fut.fail(NotLeaderError(None))
+                self._futures.clear()
+                self._notify_q.put((False, self.current_term))
+            self.state = FOLLOWER
+            return
+        new_peers = [m for m in members if m != self.addr]
+        if self.state == LEADER:
+            nxt = self.log.last_index() + 1
+            for p in new_peers:
+                if p not in self.peers:
+                    self._next_index[p] = nxt
+                    self._match_index[p] = 0
+        for p in self.peers:
+            if p not in new_peers:
+                self._next_index.pop(p, None)
+                self._match_index.pop(p, None)
+        self.peers = new_peers
 
     def is_leader(self) -> bool:
         with self._lock:
@@ -268,6 +375,9 @@ class RaftNode:
 
     def _run_election(self) -> None:
         with self._lock:
+            if self._removed:
+                self._deadline = float("inf")
+                return
             self.state = CANDIDATE
             self.current_term += 1
             term = self.current_term
@@ -308,6 +418,7 @@ class RaftNode:
         # called with lock held
         self.state = LEADER
         self.leader_id = self.addr
+        self._proposed_members = None
         next_idx = self.log.last_index() + 1
         self._next_index = {p: next_idx for p in self.peers}
         self._match_index = {p: 0 for p in self.peers}
@@ -335,7 +446,9 @@ class RaftNode:
                 self._futures.clear()
                 self._notify_q.put((False, self.current_term))
             self.state = FOLLOWER
-        self._reset_election_deadline()
+            self._proposed_members = None
+        if not self._removed:
+            self._reset_election_deadline()
 
     # -- replication (leader) ------------------------------------------
 
@@ -360,6 +473,7 @@ class RaftNode:
                     self._snapshot_data,
                     snap_idx,
                     self.log.snapshot_term,
+                    self._snapshot_config,
                 )
             else:
                 snapshot = None
@@ -368,7 +482,7 @@ class RaftNode:
                 entries = self.log.entries_from(next_idx)
 
         if snapshot is not None:
-            data, s_idx, s_term = snapshot
+            data, s_idx, s_term, s_config = snapshot
             try:
                 resp = self._rpc(
                     peer,
@@ -379,6 +493,7 @@ class RaftNode:
                         "last_included_index": s_idx,
                         "last_included_term": s_term,
                         "data": data,
+                        "config": s_config,
                     },
                 )
             except TransportError:
@@ -476,6 +591,9 @@ class RaftNode:
                     result = self.fsm.apply(entry.data)
                 except Exception as exc:  # noqa: BLE001
                     error = exc
+            elif entry.kind == KIND_CONFIG:
+                with self._lock:
+                    self._apply_membership(pickle.loads(entry.data))
             with self._lock:
                 self.last_applied = index
                 self._applied_since_snapshot += 1
@@ -500,6 +618,9 @@ class RaftNode:
             if term is None:
                 return
             self._snapshot_data = data
+            # membership as of the applied index, so a catching-up
+            # follower restores the config along with the FSM state
+            self._snapshot_config = self._membership()
             self.log.compact_through(index, term)
             self._applied_since_snapshot = 0
 
@@ -595,6 +716,9 @@ class RaftNode:
             self.fsm.restore(p["data"])
             self.log.reset_to_snapshot(idx, p["last_included_term"])
             self._snapshot_data = p["data"]
+            if p.get("config"):
+                self._apply_membership(p["config"])
+                self._snapshot_config = list(p["config"])
             self.commit_index = max(self.commit_index, idx)
             self.last_applied = idx
             self._applied_since_snapshot = 0

@@ -118,8 +118,10 @@ class Autopilot:
             return []
         removed = []
         for addr in dead:
-            self.cluster.broadcast_peer_removal(addr)
-            removed.append(addr)
+            # only report removals that actually committed; a failed
+            # config change is retried on the next pass
+            if self.cluster.broadcast_peer_removal(addr) is not False:
+                removed.append(addr)
         self.removed.extend(removed)
         return removed
 
@@ -134,12 +136,18 @@ class Autopilot:
         }
         out = []
         for addr in [raft.addr] + list(raft.peers):
+            # a configured raft peer that gossip has never seen is not
+            # healthy — it has yet to join (the reference requires a
+            # serf member + passing health to count a server healthy)
+            status = "alive" if addr == raft.addr else statuses.get(
+                addr, "failed"
+            )
             out.append(
                 ServerHealth(
                     id=addr,
                     name=addr,
                     address=addr,
-                    healthy=statuses.get(addr, "alive") == "alive",
+                    healthy=status == "alive",
                     voter=True,
                 )
             )

@@ -250,27 +250,19 @@ class ClusterServer(Server):
             args, kw = pickle.loads(payload["args"])
             result = self._leader_route(payload["op"], *args, **kw)
             return {"result": pickle.dumps(result)}
-        if method == "remove_peer":
-            # autopilot config change fanned out by the leader
-            self.raft.remove_peer(payload["peer"])
-            return {}
         raise ValueError(f"unknown cluster rpc {method!r}")
 
-    def broadcast_peer_removal(self, peer: str) -> None:
-        """Autopilot removal: drop the dead server from every live
-        member's raft configuration (the reference replicates the
-        config change through raft; here the leader fans it out and
-        rejoining servers resync from the leader's snapshot)."""
-        self.raft.remove_peer(peer)
-        for m in self.gossip.alive_members():
-            if m.addr in (self.addr, peer):
-                continue
-            try:
-                self.transport.rpc(
-                    self.addr, m.addr, "remove_peer", {"peer": peer}
-                )
-            except TransportError:
-                pass
+    def broadcast_peer_removal(self, peer: str) -> bool:
+        """Autopilot removal: commit the config change through the raft
+        log so every member — including ones temporarily unreachable —
+        converges on the same peer set when it applies the entry
+        (reference applies raft.RemoveServer through the log).
+        Returns whether the change committed."""
+        try:
+            self.raft.remove_server(peer)
+            return True
+        except (NotLeaderError, TimeoutError, TransportError):
+            return False  # retried by the next autopilot pass
 
     # -- membership / federation ---------------------------------------
 

@@ -215,3 +215,62 @@ def test_single_node_cluster_self_elects():
         assert fsm.data == {"a": 1}
     finally:
         node.stop()
+
+
+def test_replicated_config_change_converges():
+    """remove_server commits a KIND_CONFIG entry; every live node
+    applies the same membership (the behavior the reference gets from
+    raft.RemoveServer through the replicated log)."""
+    _, nodes = make_cluster(5)
+    try:
+        leader = wait_for_leader(nodes)
+        victim = next(n for n in nodes if n is not leader)
+        victim.stop()
+        leader.remove_server(victim.addr)
+        live = [n for n in nodes if n is not victim]
+        wait_until(
+            lambda: all(
+                victim.addr not in n.peers for n in live
+            ),
+            msg="all live nodes drop the removed peer",
+        )
+        # the shrunken cluster still commits
+        assert put(leader, "after", 1) == 1
+    finally:
+        shutdown([n for n in nodes if n._threads])
+
+
+def test_config_change_survives_snapshot_install():
+    """A follower that catches up via install_snapshot receives the
+    membership recorded at snapshot time."""
+    transport, nodes = make_cluster(3, snapshot_threshold=8)
+    # joins while the lagger is partitioned; election timeout is huge
+    # so it stays a passive voter until the leader contacts it
+    extra = RaftNode(
+        "s-extra", [], transport, KVFSM(),
+        election_timeout=1000.0, heartbeat_interval=0.02,
+        snapshot_threshold=8,
+    )
+    extra.start()
+    nodes.append(extra)
+    try:
+        leader = wait_for_leader(nodes[:3])
+        lagger = next(n for n in nodes[:3] if n is not leader)
+        for peer in nodes[:3]:
+            if peer is not lagger:
+                transport.partition(lagger.addr, peer.addr)
+        leader.add_server(extra.addr)
+        for i in range(20):  # force compaction past the config entry
+            put(leader, f"k{i}", i)
+        wait_until(
+            lambda: leader.log.snapshot_index > 0,
+            msg="leader compacts",
+        )
+        for peer in nodes:
+            transport.heal(lagger.addr, peer.addr)
+        wait_until(
+            lambda: extra.addr in lagger.peers,
+            msg="lagger learns the added server from the snapshot",
+        )
+    finally:
+        shutdown(nodes)

@@ -147,11 +147,16 @@ def test_autopilot_prunes_dead_server():
         removed = leader.autopilot.prune_dead_servers()
         assert victim.addr in removed
         assert victim.addr not in leader.raft.peers
-        # the other follower also dropped it
+        # the config change replicates through the log; the other
+        # follower drops the peer when it applies the entry
         other = [
             s for s in c.followers() if s.addr != victim.addr
         ][0]
-        assert victim.addr not in other.raft.peers
+        wait_until(
+            lambda: victim.addr not in other.raft.peers,
+            timeout=5.0,
+            msg="follower applies the replicated config change",
+        )
         stats = leader.autopilot.stats()
         assert stats["NumServers"] == 2
     finally:
