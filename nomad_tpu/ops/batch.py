@@ -33,6 +33,15 @@ import jax.numpy as jnp
 from .score import MAX_SKIP, NO_NODE, SKIP_THRESHOLD
 
 
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """Next power of two >= n: launch-shape bucketing so jit traces
+    stay cached across varying pick/row counts."""
+    v = max(floor, 1)
+    while v < n:
+        v *= 2
+    return v
+
+
 class SpreadInputs(NamedTuple):
     """Percent-target spread state for the in-kernel carry (reference
     spread.go:163 boost; the use counts that shift between picks are a
@@ -48,6 +57,37 @@ class SpreadInputs(NamedTuple):
     used0: jnp.ndarray  # f[S, V+1] combined use at snapshot
     weight: jnp.ndarray  # f[S] weight / sum(|weights|)
     active: jnp.ndarray  # bool[S] (padding rows are inert)
+
+
+class StepDeltas(NamedTuple):
+    """Per-pick plan mutations for steady-state evals (leading axis E
+    when chained).  The sequential path interleaves plan edits with
+    selects inside computePlacements (generic_sched.go:468): a
+    destructive update stops its previous alloc *just before* its
+    replacement is scored, and each reschedule penalizes the nodes in
+    its own previous alloc's history (generic_sched.go:642
+    getSelectOptions).  These are those edits, expressed as in-kernel
+    deltas applied at the top of pick k's scan step."""
+
+    evict_rows: jnp.ndarray  # i32[P] node row stopped before pick k (-1 none)
+    evict_cpu: jnp.ndarray  # f[P] signed usage delta (negative)
+    evict_mem: jnp.ndarray  # f[P]
+    evict_disk: jnp.ndarray  # f[P]
+    evict_coll: jnp.ndarray  # i32[P] anti-affinity collision delta
+    penalty_rows: jnp.ndarray  # i32[P, K] penalized node rows (-1 pad)
+
+
+class PreDeltas(NamedTuple):
+    """Per-eval pre-placement plan state (leading axis E when chained):
+    usage freed by lost/stopped allocs and shifted by in-place updates,
+    applied to the chained usage columns before the eval's first pick —
+    the plan-eviction half of ProposedAllocs (context.go:120).  Rows are
+    padded with row 0 / delta 0."""
+
+    rows: jnp.ndarray  # i32[R]
+    cpu: jnp.ndarray  # f[R] signed deltas
+    mem: jnp.ndarray  # f[R]
+    disk: jnp.ndarray  # f[R]
 
 
 class BatchInputs(NamedTuple):
@@ -152,6 +192,7 @@ def _run_picks(
                   # surplus scan steps are inert so a batch can share one
                   # static scan length without phantom placements
     spread: "SpreadInputs" = None,
+    deltas: "StepDeltas" = None,
 ):
     """Inner pick scan; returns (rows i32[P], final used columns).
 
@@ -192,16 +233,42 @@ def _run_picks(
         safe_desired = jnp.where(desired_node != 0, desired_node, 1.0)
 
     def step(carry, pick_idx):
+        cpu_used = carry["cpu"]
+        mem_used = carry["mem"]
+        disk_used = carry["disk"]
+        collisions = carry["coll"]
+        excl = carry["excl"]
+        offset = carry["off"]
+        dead = carry["dead"]
         if spread is not None:
-            (
-                cpu_used, mem_used, disk_used, collisions, excl,
-                offset, spread_used,
-            ) = carry
-        else:
-            cpu_used, mem_used, disk_used, collisions, excl, offset = (
-                carry
+            spread_used = carry["spread"]
+        # once a pick fails, later picks for the eval are inert: the
+        # sequential path coalesces subsequent placements for a task
+        # group after its first failure (generic_sched.go:482)
+        active = (pick_idx < wanted) & ~dead
+        penalty_vec = penalty_p
+        app = jnp.asarray(False)
+        if deltas is not None:
+            erow = deltas.evict_rows[pick_idx]
+            epos = jnp.argmax(perm == erow)
+            app = active & (erow >= 0)
+            zf = jnp.asarray(0.0, dtype)
+            cpu_used = cpu_used.at[epos].add(
+                jnp.where(app, deltas.evict_cpu[pick_idx], zf)
             )
-        active = pick_idx < wanted
+            mem_used = mem_used.at[epos].add(
+                jnp.where(app, deltas.evict_mem[pick_idx], zf)
+            )
+            disk_used = disk_used.at[epos].add(
+                jnp.where(app, deltas.evict_disk[pick_idx], zf)
+            )
+            collisions = collisions.at[epos].add(
+                jnp.where(app, deltas.evict_coll[pick_idx], 0)
+            )
+            prow = deltas.penalty_rows[pick_idx]  # (K,)
+            penalty_vec = penalty_vec | jnp.any(
+                perm[:, None] == prow[None, :], axis=1
+            )
         cpu_after = cpu_used + inp.ask_cpu
         mem_after = mem_used + inp.ask_mem
         disk_after = disk_used + inp.ask_disk
@@ -233,8 +300,8 @@ def _run_picks(
         )
         score_sum = score_sum + anti
         count = count + has_coll.astype(dtype)
-        score_sum = score_sum - penalty_p.astype(dtype)
-        count = count + penalty_p.astype(dtype)
+        score_sum = score_sum - penalty_vec.astype(dtype)
+        count = count + penalty_vec.astype(dtype)
         has_aff = aff_p != 0.0
         score_sum = score_sum + jnp.where(has_aff, aff_p, 0.0)
         count = count + has_aff.astype(dtype)
@@ -260,12 +327,13 @@ def _run_picks(
             count = count + has_spread.astype(dtype)
         final = score_sum / count
 
-        win, any_emitted, pulls = _walk(
+        win, any_emitted, step_pulls = _walk(
             final, feasible, offset, inp.limit, n_candidates
         )
         ok = active & any_emitted
+        dead = dead | (active & ~any_emitted)
         row = jnp.where(ok, perm[win], NO_NODE)
-        pulls = jnp.where(active, pulls, 0)
+        pulls = jnp.where(active, step_pulls, 0)
         safe_win = jnp.where(ok, win, 0)
         upd = lambda arr, delta: arr.at[safe_win].add(
             jnp.where(ok, delta, jnp.zeros_like(delta))
@@ -280,35 +348,34 @@ def _run_picks(
             jnp.where(ok & inp.distinct_hosts, True, excl[safe_win])
         )
         offset = jnp.mod(offset + pulls, n_candidates)
+        out = {
+            "cpu": cpu_used,
+            "mem": mem_used,
+            "disk": disk_used,
+            "coll": collisions,
+            "excl": excl,
+            "off": offset,
+            "dead": dead,
+        }
         if spread is not None:
             # the placed node's value slot gains one use per stanza
-            spread_used = spread_used + jnp.where(
+            out["spread"] = spread_used + jnp.where(
                 ok, onehot_p[:, safe_win, :], 0.0
             )
-            return (
-                cpu_used, mem_used, disk_used, collisions, excl,
-                offset, spread_used,
-            ), row
-        return (
-            cpu_used,
-            mem_used,
-            disk_used,
-            collisions,
-            excl,
-            offset,
-        ), row
+        return out, (row, app, pulls)
 
-    carry0 = (
-        take(used0[0]),
-        take(used0[1]),
-        take(used0[2]),
-        take(inp.base_collisions),
-        jnp.zeros_like(feas_p),
-        jnp.asarray(0, jnp.int32),
-    )
+    carry0 = {
+        "cpu": take(used0[0]),
+        "mem": take(used0[1]),
+        "disk": take(used0[2]),
+        "coll": take(inp.base_collisions),
+        "excl": jnp.zeros_like(feas_p),
+        "off": jnp.asarray(0, jnp.int32),
+        "dead": jnp.asarray(False),
+    }
     if spread is not None:
-        carry0 = carry0 + (spread.used0.astype(dtype),)
-    _final, rows = jax.lax.scan(
+        carry0["spread"] = spread.used0.astype(dtype)
+    _final, (rows, eapps, pulls) = jax.lax.scan(
         step, carry0, jnp.arange(n_picks, dtype=jnp.int32)
     )
     # node-space final usage for the chained (serially-equivalent)
@@ -322,12 +389,21 @@ def _run_picks(
         ).astype(base_col.dtype)
         return base_col.at[safe_rows].add(delta)
 
-    used_out = (
-        back(used0[0], inp.ask_cpu),
-        back(used0[1], inp.ask_mem),
-        back(used0[2], inp.ask_disk),
-    )
-    return rows, used_out
+    used_cpu = back(used0[0], inp.ask_cpu)
+    used_mem = back(used0[1], inp.ask_mem)
+    used_disk = back(used0[2], inp.ask_disk)
+    if deltas is not None:
+        # applied per-pick evictions also shift the chained columns
+        safe_er = jnp.where(eapps, deltas.evict_rows, 0)
+
+        def back_evict(col, dvals):
+            d = jnp.where(eapps, dvals, 0.0).astype(col.dtype)
+            return col.at[safe_er].add(d)
+
+        used_cpu = back_evict(used_cpu, deltas.evict_cpu)
+        used_mem = back_evict(used_mem, deltas.evict_mem)
+        used_disk = back_evict(used_disk, deltas.evict_disk)
+    return rows, (used_cpu, used_mem, used_disk), pulls
 
 
 @functools.partial(
@@ -342,10 +418,11 @@ def plan_picks(
     n_picks: int,
     spread_fit: bool = False,
     spread: SpreadInputs = None,
+    deltas: StepDeltas = None,
 ):
     """P sequential placements for one eval; returns rows i32[P]
     (NO_NODE when placement failed)."""
-    rows, _used = _run_picks(
+    rows, _used, _pulls = _run_picks(
         cpu_total,
         mem_total,
         disk_total,
@@ -355,8 +432,44 @@ def plan_picks(
         n_picks,
         spread_fit,
         spread=spread,
+        deltas=deltas,
     )
     return rows
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_picks", "spread_fit")
+)
+def plan_picks_full(
+    cpu_total,
+    mem_total,
+    disk_total,
+    inp: BatchInputs,
+    n_candidates,
+    n_picks: int,
+    spread_fit: bool = False,
+):
+    """Like plan_picks but also returns per-pick pull counts so the
+    caller can mirror the rotating offset (select.go source position).
+    Starting rotation is folded into `inp.perm` by the caller.  Used by
+    the TPUGenericStack look-ahead: one launch pre-computes the whole
+    placement loop of a task group instead of one device round trip per
+    placement (generic_sched.go:468 computePlacements).
+
+    Returns ONE stacked i32[2, P] array ([rows; pulls]) so the host
+    pays a single device->host sync — each fetch is a full round trip
+    on tunneled accelerators."""
+    rows, _used, pulls = _run_picks(
+        cpu_total,
+        mem_total,
+        disk_total,
+        (inp.base_cpu_used, inp.base_mem_used, inp.base_disk_used),
+        inp,
+        n_candidates,
+        n_picks,
+        spread_fit,
+    )
+    return jnp.stack([rows.astype(jnp.int32), pulls.astype(jnp.int32)])
 
 
 @functools.partial(
@@ -372,6 +485,8 @@ def chained_plan_picks(
     spread_fit: bool = False,
     wanted=None,  # i32[E]: per-eval pick counts (<= n_picks)
     spread: SpreadInputs = None,  # leading axis E on every field
+    deltas: StepDeltas = None,  # leading axis E on every field
+    pre: PreDeltas = None,  # leading axis E on every field
 ):
     """E evals x P picks in ONE launch, *serially equivalent*: a
     lax.scan over the evals carries the proposed-usage columns forward,
@@ -380,6 +495,13 @@ def chained_plan_picks(
     before the next eval runs.  One device round trip amortizes over the
     whole batch (the point, on tunneled accelerators) while decisions
     stay bit-identical to serial execution.
+
+    Steady-state evals additionally carry `pre` (usage freed by
+    lost/stopped allocs + in-place update shifts, applied before the
+    eval's first pick) and `deltas` (per-pick destructive-update
+    evictions + reschedule penalty rows), so the chain reflects every
+    plan mutation the sequential scheduler would commit — not just
+    placements.
 
     Anti-affinity collision and distinct-hosts state reset per eval
     (they are per-job; the broker's JobID dedup guarantees no two evals
@@ -394,37 +516,42 @@ def chained_plan_picks(
         batch.base_mem_used[0],
         batch.base_disk_used[0],
     )
-    if spread is not None:
-
-        def eval_step_s(used, xs):
-            b, n, w, s = xs
-            rows, used_next = _run_picks(
-                cpu_total, mem_total, disk_total, used, b, n,
-                n_picks, spread_fit, wanted=w, spread=s,
-            )
-            return used_next, rows
-
-        _final, rows = jax.lax.scan(
-            eval_step_s, used0, (batch, nc, wanted, spread)
-        )
-        return rows
 
     def eval_step(used, xs):
-        b, n, w = xs
-        rows, used_next = _run_picks(
-            cpu_total,
-            mem_total,
-            disk_total,
-            used,
-            b,
-            n,
-            n_picks,
-            spread_fit,
-            wanted=w,
+        b, n, w, s, d, p = xs
+        if p is not None:
+            used = (
+                used[0].at[p.rows].add(p.cpu.astype(used[0].dtype)),
+                used[1].at[p.rows].add(p.mem.astype(used[1].dtype)),
+                used[2].at[p.rows].add(p.disk.astype(used[2].dtype)),
+            )
+        rows, used_next, _pulls = _run_picks(
+            cpu_total, mem_total, disk_total, used, b, n,
+            n_picks, spread_fit, wanted=w, spread=s, deltas=d,
         )
         return used_next, rows
 
-    _final, rows = jax.lax.scan(eval_step, used0, (batch, nc, wanted))
+    # xs entries that are None are threaded as static Nones via a
+    # wrapper (lax.scan xs must be arrays): build per-variant closures
+    def make_xs():
+        parts = [batch, nc, wanted]
+        pattern = []
+        for x in (spread, deltas, pre):
+            pattern.append(x is not None)
+            if x is not None:
+                parts.append(x)
+        return tuple(parts), pattern
+
+    xs_arrays, pattern = make_xs()
+
+    def eval_step_packed(used, xs):
+        it = iter(xs[3:])
+        s = next(it) if pattern[0] else None
+        d = next(it) if pattern[1] else None
+        p = next(it) if pattern[2] else None
+        return eval_step(used, (xs[0], xs[1], xs[2], s, d, p))
+
+    _final, rows = jax.lax.scan(eval_step_packed, used0, xs_arrays)
     return rows
 
 
@@ -475,7 +602,7 @@ def chained_plan_picks_shared(
             limit=lim,
             distinct_hosts=jnp.asarray(False),
         )
-        rows, used_next = _run_picks(
+        rows, used_next, _pulls = _run_picks(
             cpu_total,
             mem_total,
             disk_total,

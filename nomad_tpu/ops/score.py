@@ -191,6 +191,20 @@ def score_and_select(inp: ScoreInputs, spread_fit: bool = False):
 
 
 @functools.partial(jax.jit, static_argnames=("spread_fit",))
+def score_and_select_packed(inp: ScoreInputs, spread_fit: bool = False):
+    """score_and_select with all outputs packed into ONE i32[2] array
+    ([chosen_row, pulls]) so the host pays a single device->host sync
+    per select — each fetch is a full round trip on tunneled
+    accelerators."""
+    chosen_row, _best, _n, pulls = score_and_select(
+        inp, spread_fit=spread_fit
+    )
+    return jnp.stack(
+        [chosen_row.astype(jnp.int32), pulls.astype(jnp.int32)]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("spread_fit",))
 def score_all(inp: ScoreInputs, spread_fit: bool = False):
     """Scores + feasibility only (system stack / diagnostics)."""
     feasible, final = _score_vectors(inp, spread_fit)

@@ -37,8 +37,13 @@ from typing import Dict, List, Optional, Set, Tuple
 import jax
 import numpy as np
 
+from ..ops.batch import BatchInputs, plan_picks_full, pow2_bucket
 from ..ops.constraints import MaskCompiler
-from ..ops.score import NO_NODE, ScoreInputs, make_perm, score_and_select
+from ..ops.score import (
+    NO_NODE,
+    ScoreInputs,
+    score_and_select_packed,
+)
 from ..structs import (
     CONSTRAINT_DISTINCT_HOSTS,
     CONSTRAINT_DISTINCT_PROPERTY,
@@ -58,6 +63,9 @@ from .stack import (
 )
 
 INT32_MAX = 2**31 - 1
+LOOKAHEAD_MAX = 128  # picks pre-computed per launch
+
+_LA_MISS = object()  # look-ahead cache miss sentinel
 
 
 class _SingleNodeSource:
@@ -102,6 +110,15 @@ class TPUGenericStack:
         # position across selects (feasible.go:75) so consecutive
         # placements continue round-robin through the shuffled list
         self._offset = 0
+        # look-ahead pick cache: one plan_picks_full launch pre-computes
+        # the whole placement loop of a task group (VERDICT r1 item 5 —
+        # one device round trip per placement is ruinous on a tunnel)
+        self._la_rows: Optional[List[int]] = None
+        self._la_pulls: List[int] = []
+        self._la_idx = 0
+        self._la_key: Optional[Tuple] = None
+        self._la_counts: Tuple[int, int, int] = (0, 0, 0)
+        self._la_generation = -1
 
     # ------------------------------------------------------------------
 
@@ -127,6 +144,7 @@ class TPUGenericStack:
         self.perm = np.asarray(perm, dtype=np.int32)
         self.limit = compute_visit_limit(len(nodes), self.batch)
         self._offset = 0
+        self._la_rows = None
         if self._shadow is not None:
             self._shadow.source.set_nodes(self.shuffled_nodes)
             self._shadow.limit.set_limit(self.limit)
@@ -136,6 +154,7 @@ class TPUGenericStack:
             return
         self.job = job
         self.ctx.eligibility.set_job(job)
+        self._la_rows = None
         self._static_mask_cache.clear()
         self._affinity_cache.clear()
         self._spread_psets.clear()
@@ -192,7 +211,76 @@ class TPUGenericStack:
 
         self.ctx.reset()
         self._extra_excluded_rows = set()
+        out = self._lookahead_serve(tg, options)
+        if out is not _LA_MISS:
+            return out
         return self._select_vectorized(tg, options)
+
+    # ------------------------------------------------------------------
+
+    def _plan_counts(self) -> Tuple[int, int, int]:
+        p = self.ctx.plan
+        return (
+            sum(len(v) for v in p.node_update.values()),
+            sum(len(v) for v in p.node_allocation.values()),
+            sum(len(v) for v in p.node_preemptions.values()),
+        )
+
+    def _lookahead_serve(self, tg: TaskGroup, options):
+        """Answer a select from the pre-computed pick cache when the
+        scheduler's state advanced exactly as the kernel modelled it:
+        same task group and job version, plan grown only by our own
+        placements, plain select options.  Each served winner still
+        passes exact host verification."""
+        if self._la_rows is None:
+            return _LA_MISS
+        if options is not None and (
+            options.penalty_node_ids
+            or options.preferred_nodes
+            or options.preempt
+        ):
+            self._la_rows = None
+            return _LA_MISS
+        if self._la_key != (
+            tg.name, self.job.version if self.job else None
+        ):
+            self._la_rows = None
+            return _LA_MISS
+        if self.table.generation != self._la_generation:
+            self._la_rows = None
+            return _LA_MISS
+        nu, na, npre = self._plan_counts()
+        enu, ena, enpre = self._la_counts
+        if nu != enu or npre != enpre or na != ena + self._la_idx:
+            self._la_rows = None
+            return _LA_MISS
+        if self._la_idx >= len(self._la_rows):
+            self._la_rows = None
+            return _LA_MISS
+        row = self._la_rows[self._la_idx]
+        pulls = self._la_pulls[self._la_idx]
+        n_cand = len(self.candidate_rows)
+        if row == NO_NODE:
+            self._la_idx += 1
+            if n_cand:
+                self._offset = (self._offset + pulls) % n_cand
+            self._populate_class_eligibility(
+                tg, self._static_feasibility(tg)
+            )
+            self._la_rows = None  # scheduler coalesces after a failure
+            return None
+        node_id = self.table.node_ids[row]
+        option = self._verify_winner(node_id, tg)
+        if option is None:
+            # count-mask admitted a node exact assignment rejects:
+            # poison it and relaunch from current state
+            self._extra_excluded_rows.add(row)
+            self._la_rows = None
+            return _LA_MISS
+        self._la_idx += 1
+        if n_cand:
+            self._offset = (self._offset + pulls) % n_cand
+        return option
 
     # ------------------------------------------------------------------
 
@@ -293,6 +381,67 @@ class TPUGenericStack:
             [cand[off:], cand[:off], rest]
         ).astype(np.int32)
 
+        spread_fit_alg = (
+            self.ctx.state.scheduler_config().effective_scheduler_algorithm()
+            == "spread"
+        )
+        # look-ahead: when the remaining placement loop is plain (no
+        # penalties/spreads/distinct_property), pre-compute the whole
+        # pick sequence in ONE launch; subsequent selects answer from
+        # the cache (generic_sched.go:468 computePlacements loop)
+        use_lookahead = (
+            tg.count > 1
+            and n_cand > 1
+            and not has_spreads
+            and (options is None or not options.penalty_node_ids)
+            and not any(
+                c.operand == CONSTRAINT_DISTINCT_PROPERTY
+                for c in list(self.job.constraints) + list(tg.constraints)
+            )
+        )
+        if use_lookahead:
+            P = min(LOOKAHEAD_MAX, int(tg.count))
+            binp = BatchInputs(
+                feasible=mask,
+                base_cpu_used=self.table.cpu_used + d_cpu,
+                base_mem_used=self.table.mem_used + d_mem,
+                base_disk_used=self.table.disk_used + d_disk,
+                base_collisions=collisions,
+                penalty=penalty,
+                affinity_score=affinity_vec,
+                perm=rotated,
+                ask_cpu=np.float64(ask_cpu),
+                ask_mem=np.float64(ask_mem),
+                ask_disk=np.float64(ask_disk),
+                desired_count=np.int32(tg.count),
+                limit=np.int32(limit),
+                distinct_hosts=np.bool_(job_distinct or tg_distinct),
+            )
+            packed = jax.device_get(
+                plan_picks_full(
+                    self.table.cpu_total,
+                    self.table.mem_total,
+                    self.table.disk_total,
+                    binp,
+                    np.int32(n_cand),
+                    pow2_bucket(P),
+                    spread_fit=spread_fit_alg,
+                )
+            )
+            la_rows, la_pulls = packed[0], packed[1]
+            self._la_rows = [int(r) for r in la_rows[:P]]
+            self._la_pulls = [int(p) for p in la_pulls[:P]]
+            self._la_idx = 0
+            self._la_key = (tg.name, self.job.version)
+            self._la_counts = self._plan_counts()
+            self._la_generation = self.table.generation
+            out = self._lookahead_serve(tg, options)
+            if out is not _LA_MISS:
+                return out
+            # first pick failed exact verification: rebuild with the
+            # poisoned row excluded
+            return self._select_vectorized(tg, options)
+
         inputs = ScoreInputs(
             cpu_total=self.table.cpu_total,
             mem_total=self.table.mem_total,
@@ -313,17 +462,15 @@ class TPUGenericStack:
             limit=np.asarray(limit, np.int32),
             n_candidates=np.asarray(n_cand, np.int32),
         )
-        spread_fit = (
-            self.ctx.state.scheduler_config().effective_scheduler_algorithm()
-            == "spread"
-        )
+        spread_fit = spread_fit_alg
 
         while True:
-            outs = score_and_select(inputs, spread_fit=spread_fit)
             # one device->host sync for all outputs: device round trips
             # dominate per-select latency on tunneled hardware
-            chosen_row, _score, _n, pulls = jax.device_get(outs)
-            chosen_row = int(chosen_row)
+            packed = jax.device_get(
+                score_and_select_packed(inputs, spread_fit=spread_fit)
+            )
+            chosen_row, pulls = int(packed[0]), int(packed[1])
             if chosen_row == NO_NODE:
                 if n_cand:
                     self._offset = (self._offset + int(pulls)) % n_cand
@@ -655,15 +802,233 @@ class TPUGenericStack:
                 elig.set_task_group_eligibility(ok, tg.name, klass)
 
 
-class TPUSystemStack(SystemStack):
-    """System stack on the vectorized backend.
+class TPUSystemStack:
+    """Vectorized system stack (reference stack.go:182-318 SystemStack,
+    system_sched.go:54).
 
-    The system scheduler calls select once per node
-    (system_sched.go:computePlacements); scoring one node vectorially
-    gains nothing, so the oracle SystemStack is reused as-is.  The
-    batched system path (score every node for the job in one kernel) is
-    provided by ops/batch.py for the eval-stream pipeline.
+    The system scheduler scores *every* feasible node for the job — no
+    visit limit — which makes the feasibility chain the dominant cost
+    at fleet scale: the oracle walks every node through every checker.
+    Here the whole constraint surface compiles ONCE per (job, task
+    group, table generation) into columnar masks with first-failure
+    attribution (the order FeasibilityWrapper runs its checkers), so a
+    select on node n is a mask lookup; only *placed* nodes run the
+    exact single-node binpack chain (ports, devices, preemption,
+    AllocsFit, scoring — rank.go:188), host-side, exactly as the
+    reference does per visited node.
+
+    Known metric-string divergence (placements identical): the oracle
+    attributes nodes of a memoized-ineligible computed class to
+    "computed class ineligible" after the first; the mask path always
+    names the concrete failing constraint.
     """
 
     def __init__(self, ctx: EvalContext, seed=None) -> None:
-        super().__init__(ctx)
+        from .rank import (
+            PreemptionScoringIterator,
+            ScoreNormalizationIterator,
+        )
+
+        self.ctx = ctx
+        self.table = ctx.state.node_table
+        self.compiler = MaskCompiler(self.table)
+        self.job: Optional[Job] = None
+        self.node: Optional[Node] = None
+        # (job.version, tg.name, generation) -> list[(mask, attribution)]
+        # in FeasibilityWrapper checker order + the combined mask
+        self._mask_cache: Dict[Tuple, Tuple] = {}
+        self._pset_cache: Dict[str, List] = {}
+        self._elig_done: Set[Tuple] = set()
+        # the exact per-node chain tail, built once and re-fed per
+        # select exactly as the oracle SystemStack reuses its iterators
+        config = ctx.state.scheduler_config()
+        self._source = _SingleNodeSource(None)
+        self._binpack = BinPackIterator(
+            ctx,
+            self._source,
+            config.preemption_config.system_scheduler_enabled,
+            0,
+            config.effective_scheduler_algorithm(),
+        )
+        scorer = PreemptionScoringIterator(ctx, self._binpack)
+        self._norm = ScoreNormalizationIterator(ctx, scorer)
+
+    # ------------------------------------------------------------------
+
+    def set_nodes(self, base_nodes: List[Node]) -> None:
+        # the system scheduler feeds one node per select
+        # (system_sched.go computePlacements); only that node is kept
+        self.node = base_nodes[0] if base_nodes else None
+
+    def set_job(self, job: Job) -> None:
+        if self.job is not None and self.job.version == job.version:
+            return
+        self.job = job
+        self.ctx.eligibility.set_job(job)
+        self._binpack.set_job(job)
+        self._mask_cache.clear()
+        self._pset_cache.clear()
+        self._elig_done.clear()
+
+    # ------------------------------------------------------------------
+
+    def _checks(self, tg: TaskGroup):
+        """Ordered (mask, attribution) pairs mirroring the wrapper's
+        checker order (feasible.go FeasibilityWrapper: job constraints;
+        drivers, tg constraints, host volumes, devices, network; CSI),
+        plus the combined AND of all masks."""
+        key = (self.job.version, tg.name, self.table.generation)
+        cached = self._mask_cache.get(key)
+        if cached is not None:
+            return cached
+        from .feasible import (
+            FILTER_CONSTRAINT_DEVICES,
+            FILTER_CONSTRAINT_DRIVERS,
+            FILTER_CONSTRAINT_HOST_VOLUMES,
+        )
+
+        C = self.table.capacity
+        checks: List[Tuple[np.ndarray, str]] = []
+
+        for constraint in self.job.constraints:
+            m = self.compiler.constraint_mask(constraint)
+            if m is not None:
+                checks.append((m, str(constraint)))
+        constraints, drivers = task_group_constraints(tg)
+        driver_mask = np.ones(C, dtype=bool)
+        for driver in drivers:
+            col = self.table.column(f"driver.{driver}")
+            driver_mask &= col.codes != -1
+        checks.append((driver_mask, FILTER_CONSTRAINT_DRIVERS))
+        for constraint in constraints:
+            m = self.compiler.constraint_mask(constraint)
+            if m is not None:
+                checks.append((m, str(constraint)))
+        for name, req in tg.volumes.items():
+            if req.type != "host":
+                continue
+            col = self.table.column(f"hostvol.{req.source}")
+            if req.read_only:
+                m = col.codes != -1
+            else:
+                rw_code = col.interner.lookup("rw")
+                m = col.codes == rw_code
+            checks.append((m, FILTER_CONSTRAINT_HOST_VOLUMES))
+        device_reqs = [
+            req for task in tg.tasks for req in task.resources.devices
+        ]
+        dev_mask = self.compiler.device_feasibility(device_reqs)
+        if dev_mask is not None:
+            checks.append((dev_mask, FILTER_CONSTRAINT_DEVICES))
+        if tg.networks:
+            mode = tg.networks[0].mode or "host"
+            if mode != "host":
+                col = self.table.column(f"netmode.{mode}")
+                checks.append((col.codes != -1, "missing network"))
+
+        combined = np.ones(C, dtype=bool)
+        for m, _label in checks:
+            combined &= m
+        cached = (checks, combined)
+        self._mask_cache[key] = cached
+        return cached
+
+    def _csi_check(self, tg: TaskGroup) -> Optional[Tuple[np.ndarray, str]]:
+        reqs = [r for r in tg.volumes.values() if r.type == "csi"]
+        if not reqs:
+            return None
+        out = np.ones(self.table.capacity, dtype=bool)
+        for req in reqs:
+            vol = self.ctx.state.csi_volume_by_id(
+                self.job.namespace, req.source
+            )
+            if vol is None or not vol.claimable(req.read_only):
+                out[:] = False
+                break
+            col = self.table.column(f"csi.{vol.plugin_id}")
+            out &= col.codes != -1
+        return out, "missing CSI plugins"
+
+    def _distinct_property_psets(self, tg: TaskGroup) -> List:
+        psets = self._pset_cache.get(tg.name)
+        if psets is None:
+            psets = []
+            for c in self.job.constraints:
+                if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
+                    pset = PropertySet(self.ctx, self.job)
+                    pset.set_constraint(c, "")
+                    psets.append(pset)
+            for c in tg.constraints:
+                if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
+                    pset = PropertySet(self.ctx, self.job)
+                    pset.set_constraint(c, tg.name)
+                    psets.append(pset)
+            self._pset_cache[tg.name] = psets
+        else:
+            for pset in psets:
+                pset.populate_proposed()
+        return psets
+
+    def _populate_eligibility(
+        self, tg: TaskGroup, combined: np.ndarray
+    ) -> None:
+        """Class eligibility for blocked-eval unblocking, derived from
+        the masks (context.go:190)."""
+        key = (self.job.version, tg.name, self.table.generation)
+        if key in self._elig_done:
+            return
+        self._elig_done.add(key)
+        elig = self.ctx.eligibility
+        col = self.table.column("node.computed_class")
+        active = self.table.active
+        for code, klass in enumerate(col.interner.values):
+            rows = (col.codes == code) & active
+            if not rows.any():
+                continue
+            ok = bool((rows & combined).any())
+            if not elig.job_escaped:
+                elig.set_job_eligibility(ok, klass)
+            if not elig.tg_escaped.get(tg.name, False):
+                elig.set_task_group_eligibility(ok, tg.name, klass)
+
+    # ------------------------------------------------------------------
+
+    def select(
+        self, tg: TaskGroup, options: Optional[SelectOptions] = None
+    ) -> Optional[RankedNode]:
+        self.ctx.reset()
+        node = self.node
+        if node is None:
+            return None
+        row = self.table.row_of.get(node.id)
+        if row is None:
+            return None
+        metrics = self.ctx.metrics
+        metrics.evaluate_node()
+
+        checks, combined = self._checks(tg)
+        self._populate_eligibility(tg, combined)
+        if not combined[row]:
+            for mask, label in checks:
+                if not mask[row]:
+                    metrics.filter_node(node, label)
+                    return None
+        csi = self._csi_check(tg)
+        if csi is not None and not csi[0][row]:
+            metrics.filter_node(node, csi[1])
+            return None
+        for pset in self._distinct_property_psets(tg):
+            ok, reason = pset.satisfies_distinct_properties(
+                node, tg.name
+            )
+            if not ok:
+                metrics.filter_node(node, reason)
+                return None
+
+        # exact per-node placement: ports/devices/preemption/fit +
+        # scoring through the oracle chain tail (binpack -> preemption
+        # scoring -> normalization), identical to SystemStack
+        self._source.ranked = RankedNode(node=node)
+        self._source.done = False
+        self._binpack.set_task_group(tg)
+        return self._norm.next()

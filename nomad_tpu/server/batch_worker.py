@@ -6,17 +6,26 @@ ruinous when the accelerator sits behind a high-latency link (SURVEY.md
 section 7.3).  The BatchWorker instead:
 
 1. drains up to E compatible evals from the broker in one gulp,
-2. *prescores* them in a single `batch_plan_picks` launch — every eval's
-   full pick sequence, with in-kernel plan-delta accumulation and the
-   same seeded visit orders the sequential path would use,
-3. runs each eval through the ordinary GenericScheduler so all control
+2. runs a host-side *simulation pre-pass* per eval — the same
+   reconciler the scheduler will run (reference generic_sched.go:332
+   computeJobAllocs) — predicting the stops, in-place updates,
+   destructive evictions, reschedule penalties and placement count,
+3. *prescores* the whole run in a single `chained_plan_picks` launch:
+   every eval's full pick sequence with in-kernel plan-delta
+   accumulation (pre-placement usage deltas, per-pick destructive
+   evictions, per-pick penalty rows, failure coalescing) and the same
+   seeded visit orders the sequential path would use,
+4. runs each eval through the ordinary GenericScheduler so all control
    flow (reconciler, blocked evals, retries, plan bookkeeping, status
    writes) stays in one implementation — but with a `PrescoredStack`
    whose `select` answers from the precomputed rows after exact host
-   verification (ports/fit) of each winner,
-4. falls back to the normal scheduler for any eval whose shape deviates
-   from what was prescored (stops, penalties, preferred nodes, multi
-   task groups, spreads, preemption retries, verification mismatches).
+   verification (fit) of each winner; in-place update probes delegate
+   to an inner oracle stack,
+5. falls back to the normal scheduler for any eval whose shape deviates
+   from what was prescored (networks, devices, sticky disk, multi
+   task groups, preemption retries, option mismatches, verification
+   mismatches), re-prescoring the rest of the run on a fresh snapshot
+   whenever a deviation or failed pick makes the chained state suspect.
 
 Because the kernel reproduces the sequential selection exactly
 (ops/batch.py), prescored evals produce bit-identical plans; the
@@ -24,68 +33,134 @@ fallback guarantees correctness for everything else.
 """
 from __future__ import annotations
 
-import math
 import random
-import threading
-from typing import Dict, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 import numpy as np
 
-from ..ops.batch import BatchInputs, chained_plan_picks
+from ..ops.batch import (
+    BatchInputs,
+    PreDeltas,
+    StepDeltas,
+    chained_plan_picks,
+    pow2_bucket as _pow2,
+)
 from ..ops.constraints import MaskCompiler
 from ..sched.feasible import shuffle_permutation
 from ..sched.generic_sched import GenericScheduler
 from ..sched.rank import BinPackIterator, RankedNode
-from ..sched.stack import compute_visit_limit
+from ..sched.stack import GenericStack, compute_visit_limit
 from ..sched.tpu_stack import _SingleNodeSource
 from ..sched.util import ready_nodes_in_dcs
-from ..structs import CONSTRAINT_DISTINCT_HOSTS, Evaluation, Job, TaskGroup
+from ..structs import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    CONSTRAINT_DISTINCT_HOSTS,
+    Evaluation,
+    Job,
+    TaskGroup,
+)
 from .worker import Worker
 
 BATCH_MAX = 64
 BATCH_WAIT_S = 0.005
+MAX_PENALTY_NODES = 8  # per-pick penalty row slots in StepDeltas
+MAX_PRE_ROWS = 512  # pre-placement delta rows before falling back
 
 
 class _Deviation(Exception):
     """The eval's control flow left the prescored fast path."""
 
 
+@dataclass
+class _Sim:
+    """Predicted pre-placement outcome of one eval (the simulation
+    pre-pass's mirror of computeJobAllocs up to the select calls)."""
+
+    placements: int
+    penalties: List[FrozenSet[str]] = field(default_factory=list)
+    # pre-placement usage deltas: row -> [cpu, mem, disk]
+    pre: Dict[int, List[float]] = field(default_factory=dict)
+    # per-pick destructive evictions (aligned with placements)
+    evict_rows: List[int] = field(default_factory=list)
+    evict_res: List[Tuple[float, float, float]] = field(
+        default_factory=list
+    )
+    evict_coll: List[int] = field(default_factory=list)
+    base_collisions: Optional[np.ndarray] = None
+    # the shuffled walk order the sequential stack would use for the
+    # placement set_nodes — captured from the sim ctx's rng AFTER the
+    # reconciler's single-node probes consumed their draws
+    order: Optional[np.ndarray] = None
+
+
 class PrescoredStack:
-    """Stack whose select() replays a precomputed pick sequence."""
+    """Stack whose select() replays a precomputed pick sequence.
+
+    In-place update probes (generic_alloc_update_fn's single-node
+    set_nodes + select, reference util.go:849) delegate to an inner
+    oracle GenericStack, so the update/destructive decision is exact;
+    full-node-set selects answer from the kernel rows after exact
+    verification of each winner."""
 
     def __init__(self, ctx, job: Job, tg_name: str, rows: List[int],
-                 table) -> None:
+                 table, penalties: List[FrozenSet[str]],
+                 inner: GenericStack) -> None:
         self.ctx = ctx
         self.job = job
         self.tg_name = tg_name
         self.rows = rows
         self.table = table
+        self.penalties = penalties
+        self.inner = inner
         self.cursor = 0
+        self.probing = False
+        self.saw_failed_row = False
 
     def set_nodes(self, nodes) -> None:
-        # single-node set_nodes comes from inplace-update probing, which
-        # the batch path does not prescore
+        # single-node set_nodes comes from inplace-update probing;
+        # answer those exactly through the inner oracle stack
         if len(nodes) <= 1:
-            raise _Deviation("inplace probe")
+            self.probing = True
+            self.inner.set_nodes(nodes)
+        else:
+            self.probing = False
 
     def set_job(self, job: Job) -> None:
         if job.id != self.job.id or job.version != self.job.version:
             raise _Deviation("job changed")
+        self.inner.set_job(job)
 
     def select(self, tg: TaskGroup, options=None) -> Optional[RankedNode]:
+        if self.probing:
+            return self.inner.select(tg, options)
         if tg.name != self.tg_name:
             raise _Deviation("unexpected task group")
-        if options is not None and (
-            options.penalty_node_ids
-            or options.preferred_nodes
-            or options.preempt
-        ):
-            raise _Deviation("select options need the sequential path")
+        if options is not None and options.preempt:
+            raise _Deviation("preemption retry needs the sequential path")
+        if options is not None and options.preferred_nodes:
+            raise _Deviation("preferred nodes need the sequential path")
         if self.cursor >= len(self.rows):
             raise _Deviation("prescored picks exhausted")
+        expected = (
+            self.penalties[self.cursor]
+            if self.cursor < len(self.penalties)
+            else frozenset()
+        )
+        got = frozenset(
+            options.penalty_node_ids
+        ) if options is not None and options.penalty_node_ids else (
+            frozenset()
+        )
+        if got != expected:
+            raise _Deviation("penalty set mismatch")
         row = self.rows[self.cursor]
         self.cursor += 1
         if row < 0:
+            # prescored failure: the scheduler coalesces the rest, and
+            # the chain's post-failure state is suspect (a destructive
+            # eviction staged for this pick gets popped sequentially)
+            self.saw_failed_row = True
             return None
         node_id = self.table.node_ids[row]
         node = self.ctx.state.node_by_id(node_id)
@@ -155,27 +230,60 @@ class BatchWorker(Worker):
         self._flush_run(run)
 
     def _flush_run(self, run) -> None:
-        if not run:
-            return
-        snap = self.store.snapshot()
-        prescored_rows: Dict[str, List[int]] = {}
-        try:
-            prescored_rows = self._prescore(snap, run)
-        except Exception:  # noqa: BLE001
-            prescored_rows = {}
-        for ev, token, job, tg in run:
-            rows = prescored_rows.get(ev.id)
-            if rows is None:
-                self._process_sequential(ev, token)
+        idx = 0
+        while idx < len(run):
+            snap = self.store.snapshot()
+            # simulate the longest prefix we can model in the kernel
+            sims: List[_Sim] = []
+            j = idx
+            while j < len(run):
+                ev, _token, job, tg = run[j]
+                try:
+                    sim = self._simulate(snap, ev, job, tg)
+                except Exception:  # noqa: BLE001
+                    sim = None
+                if sim is None:
+                    break
+                sims.append(sim)
+                j += 1
+            if not sims:
+                self._process_sequential(run[idx][0], run[idx][1])
+                idx += 1
                 continue
             try:
-                self._process_prescored(ev, token, job, tg, rows)
-                self.prescored += 1
-            except _Deviation:
-                self.fallbacks += 1
-                self._process_sequential(ev, token)
+                rows_map = self._prescore(snap, run[idx:j], sims)
             except Exception:  # noqa: BLE001
-                self._nack_quietly(ev, token)
+                rows_map = {}
+            k = idx
+            rescore = False
+            while k < j and not rescore:
+                ev, token, job, tg = run[k]
+                sim = sims[k - idx]
+                rows = rows_map.get(ev.id)
+                if rows is None:
+                    self._process_sequential(ev, token)
+                    k += 1
+                    continue
+                try:
+                    clean = self._process_prescored(
+                        ev, token, job, tg, rows, sim
+                    )
+                    self.prescored += 1
+                    k += 1
+                    if not clean:
+                        # a prescored pick failed: the chained state
+                        # past this eval is suspect — re-prescore
+                        rescore = True
+                except _Deviation:
+                    self.fallbacks += 1
+                    self._process_sequential(ev, token)
+                    k += 1
+                    rescore = True
+                except Exception:  # noqa: BLE001
+                    self._nack_quietly(ev, token)
+                    k += 1
+                    rescore = True
+            idx = k
 
     def _process_sequential(self, ev, token) -> None:
         try:
@@ -219,17 +327,179 @@ class BatchWorker(Worker):
             return False
         if tg.ephemeral_disk.sticky:
             return False
-        # existing non-terminal allocs may trigger stops/updates or
-        # reschedule penalties in the reconciler; prescoring assumes a
-        # pure place-only outcome
-        allocs = self.store.allocs_by_job(ev.namespace, ev.job_id)
-        if any(not a.terminal_status() for a in allocs):
-            return False
         return True
 
     # ------------------------------------------------------------------
 
-    def _prescore(self, snap, prescorable) -> Dict[str, List[int]]:
+    def _simulate(self, snap, ev: Evaluation, job: Job,
+                  tg: TaskGroup) -> Optional[_Sim]:
+        """Host-side mirror of computeJobAllocs up to (not including)
+        the select calls (reference generic_sched.go:332): runs the
+        real reconciler on the prescore snapshot and extracts the plan
+        mutations the kernel must model.  Returns None when the eval's
+        shape cannot be prescored."""
+        from ..sched.context import EvalContext
+        from ..sched.reconcile import AllocReconciler
+        from ..sched.util import (
+            generic_alloc_update_fn,
+            tainted_nodes,
+            update_non_terminal_allocs_to_lost,
+        )
+
+        batch = ev.type == "batch"
+        plan = ev.make_plan(job)
+        deployment = None
+        if not batch:
+            deployment = snap.latest_deployment_by_job(
+                ev.namespace, ev.job_id
+            )
+        ctx = EvalContext(snap, plan, seed=self.seed)
+        stack = GenericStack(batch, ctx)
+        stack.set_job(job)
+
+        allocs = snap.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(snap, allocs)
+        update_non_terminal_allocs_to_lost(plan, tainted, allocs)
+
+        reconciler = AllocReconciler(
+            generic_alloc_update_fn(ctx, stack, ev.id),
+            batch,
+            ev.job_id,
+            job,
+            deployment,
+            allocs,
+            tainted,
+            ev.id,
+        )
+        results = reconciler.compute()
+        for stop in results.stop:
+            plan.append_stopped_alloc(
+                stop.alloc, stop.status_description, stop.client_status
+            )
+
+        has_existing = any(not a.terminal_status() for a in allocs)
+        if (list(tg.spreads) or list(job.spreads)) and (
+            has_existing or plan.node_update
+        ):
+            # steady-state spread needs the propertyset's existing/
+            # cleared-use bookkeeping; keep it on the exact path
+            return None
+
+        sim = _Sim(placements=0)
+        table = snap.node_table
+
+        def add_pre(node_id: str, c: float, m: float, d: float) -> None:
+            row = table.row_of.get(node_id)
+            if row is None:
+                return
+            acc = sim.pre.setdefault(row, [0.0, 0.0, 0.0])
+            acc[0] += c
+            acc[1] += m
+            acc[2] += d
+
+        evicted_ids = set()
+        for node_id, stops in plan.node_update.items():
+            for a in stops:
+                if a.id in evicted_ids:
+                    continue
+                evicted_ids.add(a.id)
+                orig = snap.alloc_by_id(a.id)
+                if orig is None or orig.terminal_status():
+                    continue  # not counted in usage columns
+                r = orig.comparable_resources()
+                add_pre(node_id, -r.cpu, -r.memory_mb, -r.disk_mb)
+
+        for update in list(results.inplace_update) + list(
+            results.attribute_updates.values()
+        ):
+            orig = snap.alloc_by_id(update.id)
+            if orig is None or orig.terminal_status():
+                continue
+            old = orig.comparable_resources()
+            new = update.comparable_resources()
+            add_pre(
+                update.node_id,
+                new.cpu - old.cpu,
+                new.memory_mb - old.memory_mb,
+                new.disk_mb - old.disk_mb,
+            )
+
+        if len(sim.pre) > MAX_PRE_ROWS:
+            return None
+
+        # anti-affinity base: proposed same-job+tg allocs per node at
+        # pre-placement time (rank.go:474 collision count)
+        coll = np.zeros(table.capacity, dtype=np.int32)
+        for a in allocs:
+            if a.terminal_status() or a.id in evicted_ids:
+                continue
+            if a.job_id == job.id and a.task_group == tg.name:
+                row = table.row_of.get(a.node_id)
+                if row is not None:
+                    coll[row] += 1
+        sim.base_collisions = coll
+
+        placements = list(results.destructive_update) + list(
+            results.place
+        )
+        for missing in placements:
+            p_tg = missing.task_group
+            if p_tg.name != tg.name:
+                return None
+            prev = missing.previous_alloc
+            if prev is not None and p_tg.ephemeral_disk.sticky:
+                return None  # preferred-node path
+
+            stop_prev, _desc = missing.stop_previous_alloc()
+            e_row, e_res, e_coll = -1, (0.0, 0.0, 0.0), 0
+            if stop_prev and prev is not None and (
+                prev.id not in evicted_ids
+            ):
+                evicted_ids.add(prev.id)
+                orig = snap.alloc_by_id(prev.id)
+                if orig is not None and not orig.terminal_status():
+                    row = table.row_of.get(prev.node_id)
+                    if row is not None:
+                        r = orig.comparable_resources()
+                        e_row = row
+                        e_res = (
+                            -float(r.cpu),
+                            -float(r.memory_mb),
+                            -float(r.disk_mb),
+                        )
+                        if (
+                            prev.job_id == job.id
+                            and prev.task_group == tg.name
+                        ):
+                            e_coll = -1
+            sim.evict_rows.append(e_row)
+            sim.evict_res.append(e_res)
+            sim.evict_coll.append(e_coll)
+
+            pen = set()
+            if prev is not None:
+                if prev.client_status == ALLOC_CLIENT_STATUS_FAILED:
+                    pen.add(prev.node_id)
+                if prev.reschedule_tracker is not None:
+                    for event in prev.reschedule_tracker.events:
+                        pen.add(event.prev_node_id)
+            if len(pen) > MAX_PENALTY_NODES:
+                return None
+            sim.penalties.append(frozenset(pen))
+
+        sim.placements = len(placements)
+        # the stateful ctx rng has now consumed exactly the draws the
+        # sequential path would have (one per in-place probe's
+        # set_nodes); the next draw is the placement shuffle
+        nodes, _by_dc = ready_nodes_in_dcs(snap, job.datacenters)
+        sim.order = shuffle_permutation(ctx.rng, len(nodes))
+        return sim
+
+    # ------------------------------------------------------------------
+
+    def _prescore(
+        self, snap, prescorable, sims: List[_Sim]
+    ) -> Dict[str, List[int]]:
         table = snap.node_table
         C = table.capacity
         compiler = MaskCompiler(table)
@@ -239,11 +509,15 @@ class BatchWorker(Worker):
         # per eval: list of (codes, desired, used0, weight_frac) or None
         spread_per_eval: List[Optional[list]] = []
         max_picks = 1
-        for ev, _token, job, tg in prescorable:
+        for (ev, _token, job, tg), sim in zip(prescorable, sims):
             nodes, _by_dc = ready_nodes_in_dcs(snap, job.datacenters)
             n_cand = len(nodes)
-            rng = random.Random(self.seed)
-            order = shuffle_permutation(rng, n_cand)
+            if sim.order is not None and len(sim.order) == n_cand:
+                order = sim.order
+            else:
+                order = shuffle_permutation(
+                    random.Random(self.seed), n_cand
+                )
             rows = np.asarray(
                 [table.row_of[n.id] for n in nodes], dtype=np.int32
             )
@@ -316,7 +590,7 @@ class BatchWorker(Worker):
             if affinities or combined_spreads:
                 limit = 2**31 - 1
 
-            max_picks = max(max_picks, tg.count)
+            max_picks = max(max_picks, sim.placements)
             n_cands.append(n_cand)
             per_eval.append(
                 BatchInputs(
@@ -324,7 +598,11 @@ class BatchWorker(Worker):
                     base_cpu_used=table.cpu_used,
                     base_mem_used=table.mem_used,
                     base_disk_used=table.disk_used,
-                    base_collisions=np.zeros(C, np.int32),
+                    base_collisions=(
+                        sim.base_collisions
+                        if sim.base_collisions is not None
+                        else np.zeros(C, np.int32)
+                    ),
                     penalty=np.zeros(C, dtype=bool),
                     affinity_score=aff_vec,
                     perm=perm,
@@ -347,11 +625,59 @@ class BatchWorker(Worker):
                 for f in BatchInputs._fields
             ]
         )
+        E = len(per_eval)
+        # bucket dynamic shapes so jit traces stay cached across batches
+        P = _pow2(max_picks)
+        K = MAX_PENALTY_NODES
+
+        deltas = None
+        if any(
+            s.evict_rows or any(s.penalties) for s in sims
+        ):
+            d_rows = np.full((E, P), -1, np.int32)
+            d_cpu = np.zeros((E, P))
+            d_mem = np.zeros((E, P))
+            d_disk = np.zeros((E, P))
+            d_coll = np.zeros((E, P), np.int32)
+            d_pen = np.full((E, P, K), -1, np.int32)
+            for k, sim in enumerate(sims):
+                for p, row in enumerate(sim.evict_rows):
+                    d_rows[k, p] = row
+                    d_cpu[k, p], d_mem[k, p], d_disk[k, p] = (
+                        sim.evict_res[p]
+                    )
+                    d_coll[k, p] = sim.evict_coll[p]
+                for p, pen in enumerate(sim.penalties):
+                    for i, nid in enumerate(sorted(pen)):
+                        d_pen[k, p, i] = table.row_of.get(nid, -1)
+            deltas = StepDeltas(
+                evict_rows=d_rows,
+                evict_cpu=d_cpu,
+                evict_mem=d_mem,
+                evict_disk=d_disk,
+                evict_coll=d_coll,
+                penalty_rows=d_pen,
+            )
+
+        pre = None
+        if any(s.pre for s in sims):
+            R = _pow2(max(len(s.pre) for s in sims))
+            p_rows = np.zeros((E, R), np.int32)
+            p_cpu = np.zeros((E, R))
+            p_mem = np.zeros((E, R))
+            p_disk = np.zeros((E, R))
+            for k, sim in enumerate(sims):
+                for i, (row, acc) in enumerate(sorted(sim.pre.items())):
+                    p_rows[k, i] = row
+                    p_cpu[k, i], p_mem[k, i], p_disk[k, i] = acc
+            pre = PreDeltas(
+                rows=p_rows, cpu=p_cpu, mem=p_mem, disk=p_disk
+            )
+
         spread_stack = None
         if any(s for s in spread_per_eval):
             from ..ops.batch import SpreadInputs
 
-            E = len(per_eval)
             S = max(len(s or ()) for s in spread_per_eval)
             V1 = max(
                 (
@@ -394,31 +720,37 @@ class BatchWorker(Worker):
                 table.disk_total,
                 stacked,
                 np.asarray(n_cands, np.int32),
-                int(max_picks),
+                int(P),
                 spread_fit=spread_fit,
                 wanted=np.asarray(
-                    [tg.count for _e, _t, _j, tg in prescorable],
-                    np.int32,
+                    [s.placements for s in sims], np.int32
                 ),
                 spread=spread_stack,
+                deltas=deltas,
+                pre=pre,
             )
         )
         out: Dict[str, List[int]] = {}
-        for k, (ev, _token, _job, tg) in enumerate(prescorable):
-            out[ev.id] = [int(r) for r in rows_out[k, : tg.count]]
+        for k, (ev, _token, _job, _tg) in enumerate(prescorable):
+            out[ev.id] = [
+                int(r) for r in rows_out[k, : sims[k].placements]
+            ]
         return out
 
     # ------------------------------------------------------------------
 
     def _process_prescored(
         self, ev: Evaluation, token: str, job: Job, tg: TaskGroup,
-        rows: List[int],
-    ) -> None:
+        rows: List[int], sim: _Sim,
+    ) -> bool:
+        """Replay one prescored eval through the real scheduler.
+        Returns False when the chained kernel state past this eval is
+        suspect (a prescored pick failed)."""
         snap = self.store.snapshot_min_index(
             max(ev.modify_index, ev.snapshot_index), timeout=5.0
         )
         ev.snapshot_index = snap.index
-        outer = self
+        made = []
 
         class _Factory:
             def __call__(self, state, planner, batch, use_tpu=None,
@@ -426,10 +758,21 @@ class BatchWorker(Worker):
                 sched = GenericScheduler(
                     state, planner, batch=batch, use_tpu=False, seed=seed
                 )
+
                 def make_stack():
-                    return PrescoredStack(
-                        sched.ctx, job, tg.name, rows, snap.node_table
+                    if made:
+                        # a plan-submit retry re-runs _process_once
+                        # against refreshed state; the prescored rows
+                        # are stale there
+                        raise _Deviation("scheduler retry")
+                    inner = GenericStack(batch, sched.ctx)
+                    stack = PrescoredStack(
+                        sched.ctx, job, tg.name, rows,
+                        snap.node_table, sim.penalties, inner,
                     )
+                    made.append(stack)
+                    return stack
+
                 sched._make_stack = make_stack
                 return sched
 
@@ -439,3 +782,4 @@ class BatchWorker(Worker):
         scheduler.process(ev)
         self.evals_processed += 1
         self.server.broker.ack(ev.id, token)
+        return not (made and made[0].saw_failed_row)

@@ -1,0 +1,208 @@
+"""TPUSystemStack parity: the vectorized system stack must produce
+plans identical to the oracle SystemStack, and beat it at fleet scale
+(VERDICT r1 item 4; reference scheduler/system_sched.go:54,
+stack.go:182-318 — system jobs score every feasible node, no visit
+limit, which makes the per-node checker chain the dominant cost)."""
+import random
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.sched.system_sched import SystemScheduler
+from nomad_tpu.sched.testing import Harness
+from nomad_tpu.structs import Constraint, compute_node_class
+
+
+def build_fleet(h, n, seed=3):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.datacenter = rng.choice(["dc1", "dc2"])
+        node.node_class = rng.choice(["web", "db", "cache"])
+        node.attributes["kernel.version"] = rng.choice(
+            ["4.19", "5.4", "5.10"]
+        )
+        node.meta["rack"] = f"r{rng.randrange(8)}"
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+        h.store.upsert_node(node)
+    return nodes
+
+
+def system_job(jid, count_constraints=True):
+    job = mock.system_job(id=jid)
+    job.datacenters = ["dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 128
+    if count_constraints:
+        job.constraints = [
+            Constraint(
+                ltarget="${node.class}", operand="=", rtarget="web"
+            ),
+            Constraint(
+                ltarget="${attr.kernel.version}",
+                operand="version",
+                rtarget=">= 5.0",
+            ),
+        ]
+    return job
+
+
+def run(h, job, use_tpu, seed=11):
+    h.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id, type="system")
+    h.process(SystemScheduler, ev, use_tpu=use_tpu, seed=seed)
+    plan = h.plans[-1]
+    placed = sorted(
+        (a.name, a.node_id)
+        for v in plan.node_allocation.values()
+        for a in v
+    )
+    return plan, placed
+
+
+def plan_view(h):
+    return [
+        (
+            sorted(
+                (a.name, a.node_id)
+                for v in p.node_allocation.values()
+                for a in v
+            ),
+            sorted(
+                (a.name, a.node_id, a.desired_status)
+                for v in p.node_update.values()
+                for a in v
+            ),
+        )
+        for p in h.plans
+    ]
+
+
+def test_system_parity_constrained_fleet():
+    ha = Harness()
+    hb = Harness()
+    seed_nodes = build_fleet(ha, 120, seed=5)
+    for n in seed_nodes:
+        hb.store.upsert_node(n)
+
+    _plan_a, placed_a = run(ha, system_job("sys-a"), use_tpu=False)
+    _plan_b, placed_b = run(hb, system_job("sys-a"), use_tpu=True)
+    assert placed_a == placed_b
+    assert len(placed_a) > 0
+    # only class=web kernel>=5.0 nodes got an alloc
+    by_id = {n.id: n for n in seed_nodes}
+    for _name, nid in placed_b:
+        assert by_id[nid].node_class == "web"
+        assert by_id[nid].attributes["kernel.version"] != "4.19"
+    # evals/blocked bookkeeping identical
+    assert len(ha.evals) == len(hb.evals)
+
+
+def test_system_parity_unconstrained_and_exhausted():
+    """Unconstrained system job: places everywhere with capacity;
+    exhausted nodes produce identical blocked-eval behavior."""
+    ha = Harness()
+    hb = Harness()
+    rng = random.Random(9)
+    for i in range(40):
+        node = mock.node()
+        node.node_resources.cpu = 150 if i % 5 == 0 else 4000
+        node.node_resources.memory_mb = 8192
+        node.computed_class = compute_node_class(node)
+        ha.store.upsert_node(node)
+        hb.store.upsert_node(node)
+
+    job = system_job("sys-x", count_constraints=False)
+    job.task_groups[0].tasks[0].resources.cpu = 200  # too big for 150
+    _pa, placed_a = run(ha, job, use_tpu=False)
+    _pb, placed_b = run(hb, system_job("sys-x", False), use_tpu=True)
+    # tweak: second harness must see identical job definition
+    assert placed_a == placed_b
+    assert len(ha.evals) == len(hb.evals)
+    assert plan_view(ha) == plan_view(hb)
+
+
+def test_system_parity_update_and_node_down():
+    """Steady state: job update (destructive) + node down produce
+    identical stops and replacements."""
+    ha = Harness()
+    hb = Harness()
+    nodes = build_fleet(ha, 60, seed=13)
+    for n in nodes:
+        hb.store.upsert_node(n)
+
+    for h in (ha, hb):
+        _plan, placed = run(h, system_job("sys-u"), use_tpu=h is hb)
+        # apply placements so the update pass sees live allocs
+        assert len(placed) > 0
+
+    # job update: changed env forces destructive update
+    for h, tpu in ((ha, False), (hb, True)):
+        job2 = system_job("sys-u")
+        job2.version = 1
+        job2.task_groups[0].tasks[0].env = {"V": "2"}
+        run(h, job2, use_tpu=tpu)
+
+    assert plan_view(ha) == plan_view(hb)
+
+
+def heavy_system_job(jid):
+    """Constraint-heavy system job: the shape where the per-node
+    checker walk dominates (regex/version/meta checks per node)."""
+    job = system_job(jid)
+    job.constraints += [
+        Constraint(
+            ltarget="${meta.rack}", operand="regexp", rtarget="^r[0-6]$"
+        ),
+        Constraint(
+            ltarget="${node.datacenter}",
+            operand="set_contains_any",
+            rtarget="dc1,dc2",
+        ),
+        Constraint(ltarget="${attr.kernel.version}", operand="is_set"),
+    ]
+    return job
+
+
+@pytest.mark.slow
+def test_system_vectorized_faster_at_scale():
+    """The point of the vectorized stack: at fleet scale one mask pass
+    beats walking every node through the checker chain."""
+    ha = Harness()
+    hb = Harness()
+    nodes = build_fleet(ha, 3000, seed=21)
+    for n in nodes:
+        hb.store.upsert_node(n)
+
+    # warm both: columns interned, regex caches populated
+    run(ha, heavy_system_job("sys-warm"), use_tpu=False)
+    run(hb, heavy_system_job("sys-warm"), use_tpu=True)
+
+    # best-of-3 to shrug off CI scheduling noise
+    t_oracle = t_tpu = float("inf")
+    placed_a = placed_b = None
+    for i in range(3):
+        start = time.perf_counter()
+        _pa, placed_a = run(
+            ha, heavy_system_job(f"sys-big-{i}"), use_tpu=False
+        )
+        t_oracle = min(t_oracle, time.perf_counter() - start)
+        start = time.perf_counter()
+        _pb, placed_b = run(
+            hb, heavy_system_job(f"sys-big-{i}"), use_tpu=True
+        )
+        t_tpu = min(t_tpu, time.perf_counter() - start)
+
+    assert placed_a == placed_b
+    assert len(placed_a) > 100
+    # generous margin to keep CI stable
+    assert t_tpu < t_oracle, (
+        f"vectorized system stack slower: {t_tpu:.3f}s vs oracle "
+        f"{t_oracle:.3f}s"
+    )
