@@ -131,7 +131,9 @@ def _walk(s_p, f_p, offset, limit, n_candidates):
     permuted order.  Returns (win_pos, any_emitted, pulls) where
     win_pos indexes the permuted arrays."""
     n = s_p.shape[0]
-    pos = jnp.arange(n)
+    # int32 throughout: under x64 a default arange is int64, which
+    # would promote `pulls` and break the int32 offset scan carry
+    pos = jnp.arange(n, dtype=jnp.int32)
     is_tail = pos >= n_candidates
     in_wrap = pos < offset
     # walk position of each permuted index (tail walks last, in place)
@@ -552,6 +554,104 @@ def chained_plan_picks(
         return eval_step(used, (xs[0], xs[1], xs[2], s, d, p))
 
     _final, rows = jax.lax.scan(eval_step_packed, used0, xs_arrays)
+    return rows
+
+
+class ChainInputs(NamedTuple):
+    """Per-eval inputs for the production chained launch (leading axis
+    E).  Unlike BatchInputs this carries NO copies of the shared node
+    columns: the snapshot usage chains through the scan carry and the
+    totals are closure inputs, so host assembly ships only what actually
+    differs per eval (~5x less host->device traffic at E=64)."""
+
+    feasible: jnp.ndarray  # bool[E, C]
+    perm: jnp.ndarray  # i32[E, C]
+    ask_cpu: jnp.ndarray  # f[E]
+    ask_mem: jnp.ndarray  # f[E]
+    ask_disk: jnp.ndarray  # f[E]
+    desired_count: jnp.ndarray  # i32[E]
+    limit: jnp.ndarray  # i32[E]
+    distinct_hosts: jnp.ndarray  # bool[E]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_picks", "spread_fit")
+)
+def chained_plan_picks_cols(
+    cpu_total,
+    mem_total,
+    disk_total,
+    used0_cpu,  # f[C] snapshot usage (shared; the chain carries deltas)
+    used0_mem,
+    used0_disk,
+    batch: ChainInputs,
+    n_candidates,  # i32[E]
+    n_picks: int,
+    spread_fit: bool = False,
+    wanted=None,  # i32[E]
+    coll0=None,  # i32[E, C] anti-affinity base (None = zeros)
+    affinity=None,  # f[E, C] (None = zeros)
+    spread: SpreadInputs = None,  # leading axis E
+    deltas: StepDeltas = None,  # leading axis E
+    pre: PreDeltas = None,  # leading axis E
+):
+    """Serially-equivalent chained planner over shared node columns —
+    the BatchWorker's production launch.  Semantics identical to
+    `chained_plan_picks`; only the input layout differs."""
+    E = batch.perm.shape[0]
+    C = cpu_total.shape[0]
+    nc = jnp.broadcast_to(jnp.asarray(n_candidates, jnp.int32), (E,))
+    if wanted is None:
+        wanted = jnp.full((E,), n_picks, jnp.int32)
+    zeros_i = jnp.zeros(C, jnp.int32)
+    zeros_b = jnp.zeros(C, dtype=bool)
+    zeros_f = jnp.zeros(C, cpu_total.dtype)
+
+    parts = [batch, nc, wanted]
+    pattern = []
+    for x in (coll0, affinity, spread, deltas, pre):
+        pattern.append(x is not None)
+        if x is not None:
+            parts.append(x)
+
+    def eval_step(used, xs):
+        it = iter(xs[3:])
+        b = xs[0]
+        coll = next(it) if pattern[0] else zeros_i
+        aff = next(it) if pattern[1] else zeros_f
+        s = next(it) if pattern[2] else None
+        d = next(it) if pattern[3] else None
+        p = next(it) if pattern[4] else None
+        if p is not None:
+            used = (
+                used[0].at[p.rows].add(p.cpu.astype(used[0].dtype)),
+                used[1].at[p.rows].add(p.mem.astype(used[1].dtype)),
+                used[2].at[p.rows].add(p.disk.astype(used[2].dtype)),
+            )
+        inp = BatchInputs(
+            feasible=b.feasible,
+            base_cpu_used=used[0],
+            base_mem_used=used[1],
+            base_disk_used=used[2],
+            base_collisions=coll,
+            penalty=zeros_b,
+            affinity_score=aff,
+            perm=b.perm,
+            ask_cpu=b.ask_cpu,
+            ask_mem=b.ask_mem,
+            ask_disk=b.ask_disk,
+            desired_count=b.desired_count,
+            limit=b.limit,
+            distinct_hosts=b.distinct_hosts,
+        )
+        rows, used_next, _pulls = _run_picks(
+            cpu_total, mem_total, disk_total, used, inp, xs[1],
+            n_picks, spread_fit, wanted=xs[2], spread=s, deltas=d,
+        )
+        return used_next, rows
+
+    used0 = (used0_cpu, used0_mem, used0_disk)
+    _final, rows = jax.lax.scan(eval_step, used0, tuple(parts))
     return rows
 
 

@@ -33,17 +33,20 @@ fallback guarantees correctness for everything else.
 """
 from __future__ import annotations
 
+import logging
 import random
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
+LOG = logging.getLogger("nomad_tpu.server.batch_worker")
+
 import numpy as np
 
 from ..ops.batch import (
-    BatchInputs,
+    ChainInputs,
     PreDeltas,
     StepDeltas,
-    chained_plan_picks,
+    chained_plan_picks_cols,
     pow2_bucket as _pow2,
 )
 from ..ops.constraints import MaskCompiler
@@ -190,6 +193,28 @@ class BatchWorker(Worker):
         self.batch_max = BATCH_MAX
         self.prescored = 0
         self.fallbacks = 0
+        self.errors = 0
+        # host-assembly caches keyed by the node table's topology
+        # generation (usage churn does NOT invalidate them): candidate
+        # row layout per datacenter set, and static feasibility /
+        # affinity vectors per job signature
+        self._cand_cache: Dict[tuple, tuple] = {}
+        self._mask_cache: Dict[tuple, np.ndarray] = {}
+        # stage timings (seconds, cumulative) — surfaced through
+        # /v1/metrics so a production operator can see where batch time
+        # goes and whether the fast path is actually being taken
+        self.timings = {
+            "simulate": 0.0,
+            "prescore": 0.0,
+            "replay": 0.0,
+            "sequential": 0.0,
+        }
+
+    def _observe(self, stage: str, dt: float) -> None:
+        self.timings[stage] += dt
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.add_sample(f"batch_worker.{stage}", dt * 1000.0)
 
     # ------------------------------------------------------------------
 
@@ -209,7 +234,15 @@ class BatchWorker(Worker):
                 if ev is None:
                     break
                 batch.append((ev, token))
-            self._process_batch(batch)
+            try:
+                self._process_batch(batch)
+            except Exception:  # noqa: BLE001
+                # a crash here would silently kill the worker thread and
+                # strand every queued eval — log, nack, keep running
+                self.errors += 1
+                LOG.exception("batch processing crashed")
+                for ev, token in batch:
+                    self._nack_quietly(ev, token)
 
     # ------------------------------------------------------------------
 
@@ -230,10 +263,13 @@ class BatchWorker(Worker):
         self._flush_run(run)
 
     def _flush_run(self, run) -> None:
+        import time as _time
+
         idx = 0
         while idx < len(run):
             snap = self.store.snapshot()
             # simulate the longest prefix we can model in the kernel
+            t0 = _time.monotonic()
             sims: List[_Sim] = []
             j = idx
             while j < len(run):
@@ -241,19 +277,35 @@ class BatchWorker(Worker):
                 try:
                     sim = self._simulate(snap, ev, job, tg)
                 except Exception:  # noqa: BLE001
+                    # a broken simulation falls back to the exact path,
+                    # but silently eating it would demote the fast path
+                    # to 0% prescore with no signal — count and log
+                    self.errors += 1
+                    LOG.warning(
+                        "simulate failed for eval %s", ev.id,
+                        exc_info=True,
+                    )
                     sim = None
                 if sim is None:
                     break
                 sims.append(sim)
                 j += 1
+            self._observe("simulate", _time.monotonic() - t0)
             if not sims:
                 self._process_sequential(run[idx][0], run[idx][1])
                 idx += 1
                 continue
+            t0 = _time.monotonic()
             try:
                 rows_map = self._prescore(snap, run[idx:j], sims)
             except Exception:  # noqa: BLE001
+                self.errors += 1
+                LOG.warning(
+                    "prescore failed for %d evals", len(sims),
+                    exc_info=True,
+                )
                 rows_map = {}
+            self._observe("prescore", _time.monotonic() - t0)
             k = idx
             rescore = False
             while k < j and not rescore:
@@ -264,10 +316,12 @@ class BatchWorker(Worker):
                     self._process_sequential(ev, token)
                     k += 1
                     continue
+                t0 = _time.monotonic()
                 try:
                     clean = self._process_prescored(
                         ev, token, job, tg, rows, sim
                     )
+                    self._observe("replay", _time.monotonic() - t0)
                     self.prescored += 1
                     k += 1
                     if not clean:
@@ -280,16 +334,25 @@ class BatchWorker(Worker):
                     k += 1
                     rescore = True
                 except Exception:  # noqa: BLE001
+                    self.errors += 1
+                    LOG.warning(
+                        "prescored replay failed for eval %s", ev.id,
+                        exc_info=True,
+                    )
                     self._nack_quietly(ev, token)
                     k += 1
                     rescore = True
             idx = k
 
     def _process_sequential(self, ev, token) -> None:
+        import time as _time
+
+        t0 = _time.monotonic()
         try:
             self.process_eval(ev, token)
         except Exception:  # noqa: BLE001
             self._nack_quietly(ev, token)
+        self._observe("sequential", _time.monotonic() - t0)
 
     def _nack_quietly(self, ev, token) -> None:
         try:
@@ -487,6 +550,8 @@ class BatchWorker(Worker):
                 return None
             sim.penalties.append(frozenset(pen))
 
+        if len(placements) > 64:
+            return None  # over the largest supported pick bucket
         sim.placements = len(placements)
         # the stateful ctx rng has now consumed exactly the draws the
         # sequential path would have (one per in-place probe's
@@ -497,6 +562,183 @@ class BatchWorker(Worker):
 
     # ------------------------------------------------------------------
 
+    def _inert_inputs(self, table) -> ChainInputs:
+        """A padding eval: wanted=0 makes every pick step a no-op, so
+        the chained carry passes through unchanged.  Padding the eval
+        axis to a fixed bucket keeps the jit trace cache small (one
+        trace per (E_bucket, P_bucket) pair instead of one per run
+        length)."""
+        C = table.capacity
+        return ChainInputs(
+            feasible=np.zeros(C, dtype=bool),
+            perm=np.arange(C, dtype=np.int32),
+            ask_cpu=np.float64(0.0),
+            ask_mem=np.float64(0.0),
+            ask_disk=np.float64(0.0),
+            desired_count=np.int32(1),
+            limit=np.int32(1),
+            distinct_hosts=np.bool_(False),
+        )
+
+    def warm_shapes(
+        self, e_buckets=(8, BATCH_MAX), p_buckets=(16,)
+    ) -> None:
+        """Pre-compile the chained kernel for the common launch shapes
+        so the first production batches don't pay the jit compile (the
+        bench and server startup call this outside any timed region)."""
+        table = self.store.node_table
+        C = table.capacity
+        inert = self._inert_inputs(table)
+        for e in e_buckets:
+            for p in p_buckets:
+                stacked = ChainInputs(
+                    *[
+                        np.stack([getattr(inert, f)] * e)
+                        for f in ChainInputs._fields
+                    ]
+                )
+                for extras in (
+                    {},
+                    # steady-state variant: anti-affinity bases and
+                    # affinity vectors present
+                    {
+                        "coll0": np.zeros((e, C), np.int32),
+                        "affinity": np.zeros((e, C)),
+                    },
+                ):
+                    np.asarray(
+                        chained_plan_picks_cols(
+                            table.cpu_total,
+                            table.mem_total,
+                            table.disk_total,
+                            table.cpu_used,
+                            table.mem_used,
+                            table.disk_used,
+                            stacked,
+                            np.full(e, 1, np.int32),
+                            int(p),
+                            spread_fit=False,
+                            wanted=np.zeros(e, np.int32),
+                            deltas=self._zero_deltas(e, p),
+                            pre=self._zero_pre(e),
+                            **extras,
+                        )
+                    )
+
+    @staticmethod
+    def _zero_deltas(E: int, P: int) -> StepDeltas:
+        return StepDeltas(
+            evict_rows=np.full((E, P), -1, np.int32),
+            evict_cpu=np.zeros((E, P)),
+            evict_mem=np.zeros((E, P)),
+            evict_disk=np.zeros((E, P)),
+            evict_coll=np.zeros((E, P), np.int32),
+            penalty_rows=np.full(
+                (E, P, MAX_PENALTY_NODES), -1, np.int32
+            ),
+        )
+
+    @staticmethod
+    def _zero_pre(E: int, R: int = 1) -> PreDeltas:
+        return PreDeltas(
+            rows=np.zeros((E, R), np.int32),
+            cpu=np.zeros((E, R)),
+            mem=np.zeros((E, R)),
+            disk=np.zeros((E, R)),
+        )
+
+    # -- host-assembly caches ------------------------------------------
+
+    def _candidates(self, snap, datacenters) -> tuple:
+        """(nodes, rows, rest) for a datacenter set, cached per node-
+        topology generation — usage-only changes (every plan commit)
+        keep the cache warm."""
+        table = snap.node_table
+        gen = table.topo_generation
+        key = (gen, tuple(datacenters))
+        hit = self._cand_cache.get(key)
+        if hit is not None:
+            return hit
+        if len(self._cand_cache) > 64 or (
+            self._cand_cache
+            and next(iter(self._cand_cache))[0] != gen
+        ):
+            self._cand_cache.clear()
+        nodes, _by_dc = ready_nodes_in_dcs(snap, datacenters)
+        rows = np.asarray(
+            [table.row_of[n.id] for n in nodes], dtype=np.int32
+        )
+        present = np.zeros(table.capacity, dtype=bool)
+        present[rows] = True
+        rest = np.nonzero(~present)[0].astype(np.int32)
+        out = (nodes, rows, rest)
+        self._cand_cache[key] = out
+        return out
+
+    @staticmethod
+    def _job_signature(job: Job, tg: TaskGroup) -> tuple:
+        cons = tuple(
+            (c.ltarget, c.operand, c.rtarget)
+            for c in list(job.constraints)
+            + list(tg.constraints)
+            + [c for t in tg.tasks for c in t.constraints]
+        )
+        affs = tuple(
+            (a.ltarget, a.operand, a.rtarget, a.weight)
+            for a in list(job.affinities)
+            + list(tg.affinities)
+            + [a for t in tg.tasks for a in t.affinities]
+        )
+        drivers = tuple(sorted({t.driver for t in tg.tasks}))
+        return (cons, affs, drivers, tuple(job.datacenters))
+
+    def _static_vectors(
+        self, snap, job: Job, tg: TaskGroup, rows: np.ndarray
+    ) -> tuple:
+        """(feasible bool[C], affinity f[C]) for a job spec, cached per
+        (topology generation, job signature)."""
+        table = snap.node_table
+        gen = table.topo_generation
+        key = (gen,) + self._job_signature(job, tg)
+        hit = self._mask_cache.get(key)
+        if hit is not None:
+            return hit
+        # bounded: one (bool[C], f64[C]) pair per distinct job spec —
+        # cap the count so thousands of one-off specs on a long-lived
+        # stable topology can't accumulate hundreds of MB
+        if len(self._mask_cache) > 256 or (
+            self._mask_cache
+            and next(iter(self._mask_cache))[0] != gen
+        ):
+            self._mask_cache.clear()
+        compiler = MaskCompiler(table)
+        feasible = np.zeros(table.capacity, dtype=bool)
+        feasible[rows] = True
+        feasible &= table.active & table.eligible
+        for constraint in list(job.constraints) + list(
+            tg.constraints
+        ) + [c for t in tg.tasks for c in t.constraints]:
+            m = compiler.constraint_mask(constraint)
+            if m is not None:
+                feasible &= m
+        for task in tg.tasks:
+            col = table.column(f"driver.{task.driver}")
+            feasible = feasible & (col.codes != -1)
+        affinities = (
+            list(job.affinities)
+            + list(tg.affinities)
+            + [a for t in tg.tasks for a in t.affinities]
+        )
+        total, sum_w = compiler.affinity_score_vector(affinities)
+        aff_vec = (
+            total / sum_w if sum_w else np.zeros(table.capacity)
+        )
+        out = (feasible, aff_vec)
+        self._mask_cache[key] = out
+        return out
+
+    # ------------------------------------------------------------------
+
     def _prescore(
         self, snap, prescorable, sims: List[_Sim]
     ) -> Dict[str, List[int]]:
@@ -504,13 +746,17 @@ class BatchWorker(Worker):
         C = table.capacity
         compiler = MaskCompiler(table)
 
-        per_eval: List[BatchInputs] = []
+        per_eval: List[ChainInputs] = []
+        aff_rows: List[Optional[np.ndarray]] = []
+        coll_rows: List[Optional[np.ndarray]] = []
         n_cands: List[int] = []
         # per eval: list of (codes, desired, used0, weight_frac) or None
         spread_per_eval: List[Optional[list]] = []
         max_picks = 1
         for (ev, _token, job, tg), sim in zip(prescorable, sims):
-            nodes, _by_dc = ready_nodes_in_dcs(snap, job.datacenters)
+            nodes, rows, rest = self._candidates(
+                snap, job.datacenters
+            )
             n_cand = len(nodes)
             if sim.order is not None and len(sim.order) == n_cand:
                 order = sim.order
@@ -518,40 +764,10 @@ class BatchWorker(Worker):
                 order = shuffle_permutation(
                     random.Random(self.seed), n_cand
                 )
-            rows = np.asarray(
-                [table.row_of[n.id] for n in nodes], dtype=np.int32
+            perm = np.concatenate([rows[order], rest])
+            feasible, aff_vec = self._static_vectors(
+                snap, job, tg, rows
             )
-            present = set(rows.tolist())
-            perm = np.concatenate(
-                [
-                    rows[order],
-                    np.asarray(
-                        [r for r in range(C) if r not in present],
-                        dtype=np.int32,
-                    ),
-                ]
-            )
-            feasible = np.zeros(C, dtype=bool)
-            feasible[rows] = True
-            feasible &= table.active & table.eligible
-            for constraint in list(job.constraints) + [
-                c
-                for c in tg.constraints
-            ] + [c for t in tg.tasks for c in t.constraints]:
-                m = compiler.constraint_mask(constraint)
-                if m is not None:
-                    feasible &= m
-            for task in tg.tasks:
-                col = table.column(f"driver.{task.driver}")
-                feasible &= col.codes != -1
-
-            affinities = (
-                list(job.affinities)
-                + list(tg.affinities)
-                + [a for t in tg.tasks for a in t.affinities]
-            )
-            total, sum_w = compiler.affinity_score_vector(affinities)
-            aff_vec = total / sum_w if sum_w else np.zeros(C)
 
             # percent-target spreads -> in-kernel carry inputs.  The
             # info map is attribute-keyed (shared compute_spread_info,
@@ -586,25 +802,27 @@ class BatchWorker(Worker):
                     )
             spread_per_eval.append(eval_spreads)
 
+            has_affinities = bool(
+                list(job.affinities)
+                or list(tg.affinities)
+                or any(t.affinities for t in tg.tasks)
+            )
             limit = compute_visit_limit(n_cand, ev.type == "batch")
-            if affinities or combined_spreads:
+            if has_affinities or combined_spreads:
                 limit = 2**31 - 1
 
             max_picks = max(max_picks, sim.placements)
             n_cands.append(n_cand)
+            aff_rows.append(aff_vec if has_affinities else None)
+            coll_rows.append(
+                sim.base_collisions
+                if sim.base_collisions is not None
+                and sim.base_collisions.any()
+                else None
+            )
             per_eval.append(
-                BatchInputs(
+                ChainInputs(
                     feasible=feasible,
-                    base_cpu_used=table.cpu_used,
-                    base_mem_used=table.mem_used,
-                    base_disk_used=table.disk_used,
-                    base_collisions=(
-                        sim.base_collisions
-                        if sim.base_collisions is not None
-                        else np.zeros(C, np.int32)
-                    ),
-                    penalty=np.zeros(C, dtype=bool),
-                    affinity_score=aff_vec,
                     perm=perm,
                     ask_cpu=np.float64(
                         sum(t.resources.cpu for t in tg.tasks)
@@ -619,73 +837,84 @@ class BatchWorker(Worker):
                 )
             )
 
-        stacked = BatchInputs(
+        # bucket dynamic shapes so jit traces stay cached across
+        # batches: the pick and eval axes pad to fixed buckets, and
+        # deltas/pre ship always (zero-filled when absent).  coll0/
+        # affinity/spread remain optional trace variants — warm_shapes
+        # pre-compiles the coll0+affinity one; spread batches bucket
+        # their (S, V1) axes to powers of two below to bound variants
+        E_real = len(per_eval)
+        # two eval-axis buckets only (a small-batch/latency shape and
+        # the full-batch shape) so the device sees at most two compiled
+        # programs per pick bucket
+        E = 8 if E_real <= 8 else BATCH_MAX
+        P = 16 if max_picks <= 16 else _pow2(max_picks)
+        K = MAX_PENALTY_NODES
+        if E > E_real:
+            inert = self._inert_inputs(table)
+            per_eval.extend([inert] * (E - E_real))
+            n_cands.extend([1] * (E - E_real))
+            spread_per_eval.extend([None] * (E - E_real))
+            aff_rows.extend([None] * (E - E_real))
+            coll_rows.extend([None] * (E - E_real))
+
+        stacked = ChainInputs(
             *[
                 np.stack([getattr(e, f) for e in per_eval])
-                for f in BatchInputs._fields
+                for f in ChainInputs._fields
             ]
         )
-        E = len(per_eval)
-        # bucket dynamic shapes so jit traces stay cached across batches
-        P = _pow2(max_picks)
-        K = MAX_PENALTY_NODES
+        coll0 = None
+        if any(c is not None for c in coll_rows):
+            coll0 = np.zeros((E, C), np.int32)
+            for k, c in enumerate(coll_rows):
+                if c is not None:
+                    coll0[k] = c
+        affinity = None
+        if any(a is not None for a in aff_rows):
+            affinity = np.zeros((E, C))
+            for k, a in enumerate(aff_rows):
+                if a is not None:
+                    affinity[k] = a
 
-        deltas = None
-        if any(
-            s.evict_rows or any(s.penalties) for s in sims
-        ):
-            d_rows = np.full((E, P), -1, np.int32)
-            d_cpu = np.zeros((E, P))
-            d_mem = np.zeros((E, P))
-            d_disk = np.zeros((E, P))
-            d_coll = np.zeros((E, P), np.int32)
-            d_pen = np.full((E, P, K), -1, np.int32)
-            for k, sim in enumerate(sims):
-                for p, row in enumerate(sim.evict_rows):
-                    d_rows[k, p] = row
-                    d_cpu[k, p], d_mem[k, p], d_disk[k, p] = (
-                        sim.evict_res[p]
+        deltas = self._zero_deltas(E, P)
+        for k, sim in enumerate(sims):
+            for p, row in enumerate(sim.evict_rows):
+                deltas.evict_rows[k, p] = row
+                (
+                    deltas.evict_cpu[k, p],
+                    deltas.evict_mem[k, p],
+                    deltas.evict_disk[k, p],
+                ) = sim.evict_res[p]
+                deltas.evict_coll[k, p] = sim.evict_coll[p]
+            for p, pen in enumerate(sim.penalties):
+                for i, nid in enumerate(sorted(pen)):
+                    deltas.penalty_rows[k, p, i] = table.row_of.get(
+                        nid, -1
                     )
-                    d_coll[k, p] = sim.evict_coll[p]
-                for p, pen in enumerate(sim.penalties):
-                    for i, nid in enumerate(sorted(pen)):
-                        d_pen[k, p, i] = table.row_of.get(nid, -1)
-            deltas = StepDeltas(
-                evict_rows=d_rows,
-                evict_cpu=d_cpu,
-                evict_mem=d_mem,
-                evict_disk=d_disk,
-                evict_coll=d_coll,
-                penalty_rows=d_pen,
-            )
 
-        pre = None
-        if any(s.pre for s in sims):
-            R = _pow2(max(len(s.pre) for s in sims))
-            p_rows = np.zeros((E, R), np.int32)
-            p_cpu = np.zeros((E, R))
-            p_mem = np.zeros((E, R))
-            p_disk = np.zeros((E, R))
-            for k, sim in enumerate(sims):
-                for i, (row, acc) in enumerate(sorted(sim.pre.items())):
-                    p_rows[k, i] = row
-                    p_cpu[k, i], p_mem[k, i], p_disk[k, i] = acc
-            pre = PreDeltas(
-                rows=p_rows, cpu=p_cpu, mem=p_mem, disk=p_disk
-            )
+        R = _pow2(max((len(s.pre) for s in sims), default=1), floor=1)
+        pre = self._zero_pre(E, R)
+        for k, sim in enumerate(sims):
+            for i, (row, acc) in enumerate(sorted(sim.pre.items())):
+                pre.rows[k, i] = row
+                pre.cpu[k, i], pre.mem[k, i], pre.disk[k, i] = acc
 
         spread_stack = None
         if any(s for s in spread_per_eval):
             from ..ops.batch import SpreadInputs
 
-            S = max(len(s or ()) for s in spread_per_eval)
-            V1 = max(
-                (
-                    len(d)
-                    for s in spread_per_eval
-                    for (_c, d, _u, _w) in (s or ())
+            S = _pow2(max(len(s or ()) for s in spread_per_eval))
+            V1 = _pow2(
+                max(
+                    (
+                        len(d)
+                        for s in spread_per_eval
+                        for (_c, d, _u, _w) in (s or ())
+                    ),
+                    default=1,
                 ),
-                default=1,
+                floor=2,
             )
             s_codes = np.zeros((E, S, C), np.int32)
             s_desired = np.zeros((E, S, V1))
@@ -713,18 +942,23 @@ class BatchWorker(Worker):
             snap.scheduler_config().effective_scheduler_algorithm()
             == "spread"
         )
+        wanted = np.zeros(E, np.int32)
+        wanted[:E_real] = [s.placements for s in sims]
         rows_out = np.asarray(
-            chained_plan_picks(
+            chained_plan_picks_cols(
                 table.cpu_total,
                 table.mem_total,
                 table.disk_total,
+                table.cpu_used,
+                table.mem_used,
+                table.disk_used,
                 stacked,
                 np.asarray(n_cands, np.int32),
                 int(P),
                 spread_fit=spread_fit,
-                wanted=np.asarray(
-                    [s.placements for s in sims], np.int32
-                ),
+                wanted=wanted,
+                coll0=coll0,
+                affinity=affinity,
                 spread=spread_stack,
                 deltas=deltas,
                 pre=pre,

@@ -64,8 +64,19 @@ class DeploymentWatcher:
     # ------------------------------------------------------------------
 
     def _run(self) -> None:
+        # blocking-query style: sweep when state changed; when idle,
+        # wake only for progress-deadline checks (reference watchers
+        # block on state via blocking queries, deployments_watcher.go)
+        last = -1
+        last_deadline_check = 0.0
         while not self._stop.wait(self.interval):
             try:
+                idx = self.store.latest_index()
+                now = time.monotonic()
+                if idx == last and now - last_deadline_check < 1.0:
+                    continue
+                last = idx
+                last_deadline_check = now
                 for deployment in list(self.store.deployments.values()):
                     if deployment.active():
                         self._watch_one(deployment)

@@ -46,10 +46,26 @@ class Drainer:
     # ------------------------------------------------------------------
 
     def _run(self) -> None:
+        # the draining set is recomputed only when the nodes table
+        # changes (a full node scan per 100ms tick is O(cluster) of
+        # pure Python — at 10k nodes it starves the scheduler of the
+        # GIL); alloc-driven migration progress re-checks the cached
+        # set every tick
+        last_nodes = -1
+        draining: list = []
         while not self._stop.wait(self.interval):
             try:
-                for node in list(self.store.iter_nodes()):
-                    if node.drain:
+                idx = self.store.table_index("nodes")
+                if idx != last_nodes:
+                    last_nodes = idx
+                    draining = [
+                        n.id
+                        for n in self.store.iter_nodes()
+                        if n.drain
+                    ]
+                for node_id in draining:
+                    node = self.store.node_by_id(node_id)
+                    if node is not None and node.drain:
                         self._drain_node(node)
             except Exception:  # noqa: BLE001
                 pass

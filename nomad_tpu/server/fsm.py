@@ -138,6 +138,10 @@ def install_payload(store: StateStore, acls, payload: dict) -> int:
         store._table_index.clear()
         store._table_index.update(payload.get("table_indexes", {}))
         store._watch_cond.notify_all()
+        # delta-level consumers (service catalog) must resync: the
+        # restore wrote the alloc table wholesale without per-alloc
+        # notifications
+        store._notify_alloc_watchers(None)
 
     if acls is not None and "acl_enabled" in payload:
         acls.enabled = payload["acl_enabled"]

@@ -187,6 +187,9 @@ class Server:
             self.periodic.start()
             self.volume_watcher.start()
             self._leader_established = True
+            # rebuild the service catalog once from restored state; all
+            # steady-state maintenance is incremental per alloc delta
+            self.catalog.sync()
             # re-arm heartbeat TTLs for every known node (reference
             # heartbeat.go initializeHeartbeatTimers on leadership)
             for node in self.store.iter_nodes():

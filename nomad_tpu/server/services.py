@@ -46,71 +46,98 @@ class ServiceCatalog:
         self._services: Dict[str, Dict[str, ServiceInstance]] = {}
         # external check results: (alloc_id, task, service) -> bool
         self._check_status: Dict[Tuple[str, str, str], bool] = {}
-        self.store.add_watcher(self._on_change)
+        # reverse index for incremental removal: alloc -> (service, key)
+        self._by_alloc: Dict[str, List[Tuple[str, str]]] = {}
+        self.store.add_alloc_watcher(self.update_allocs)
 
     # ------------------------------------------------------------------
 
-    def _on_change(self, table: str, _index: int) -> None:
-        if table == "allocs":
-            self.sync()
-
     def sync(self) -> None:
-        """Rebuild the catalog from allocation state (reference
-        command/agent/consul/client.go sync loop, push-based there)."""
+        """Full rebuild from allocation state (used on startup/restore;
+        steady-state maintenance is incremental via `update_allocs` —
+        the reference's consul sync is likewise push-based per alloc,
+        command/agent/consul/client.go)."""
         with self._lock:
-            fresh: Dict[str, Dict[str, ServiceInstance]] = {}
-            for alloc in self.store.allocs.values():
-                if alloc.terminal_status():
-                    continue
-                job = alloc.job or self.store.job_by_id(
-                    alloc.namespace, alloc.job_id
-                )
-                if job is None:
-                    continue
-                tg = job.lookup_task_group(alloc.task_group)
-                if tg is None:
-                    continue
-                node = self.store.node_by_id(alloc.node_id)
-                address = ""
-                if node is not None and node.node_resources.networks:
-                    address = node.node_resources.networks[0].ip
-                running = (
-                    alloc.client_status == ALLOC_CLIENT_STATUS_RUNNING
-                )
-                port_by_label = {}
-                if alloc.allocated_resources is not None:
-                    for p in alloc.allocated_resources.shared.ports:
-                        port_by_label[p.label] = p.value
-                    for tr in alloc.allocated_resources.tasks.values():
-                        for net in tr.networks:
-                            for p in list(net.reserved_ports) + list(
-                                net.dynamic_ports
-                            ):
-                                port_by_label[p.label] = p.value
-                for task in tg.tasks:
-                    for service in task.services:
-                        if not service.name:
-                            continue
-                        key = f"{alloc.id}/{task.name}"
-                        checks_ok = self._check_status.get(
-                            (alloc.id, task.name, service.name), True
-                        )
-                        inst = ServiceInstance(
-                            service=service.name,
-                            alloc_id=alloc.id,
-                            node_id=alloc.node_id,
-                            job_id=alloc.job_id,
-                            task=task.name,
-                            address=address,
-                            port=port_by_label.get(
-                                service.port_label, 0
-                            ),
-                            tags=list(service.tags),
-                            healthy=running and checks_ok,
-                            checks_passing=checks_ok,
-                        )
-                        fresh.setdefault(service.name, {})[key] = inst
-            self._services = fresh
+            self._services = {}
+            self._by_alloc = {}
+            self._update_locked(list(self.store.allocs.values()))
+
+    def update_allocs(self, allocs) -> None:
+        """Incremental catalog maintenance for exactly the allocations a
+        state write touched — O(delta), not O(alloc table).  ``None``
+        means the table was replaced wholesale (snapshot restore):
+        rebuild."""
+        if allocs is None:
+            self.sync()
+            return
+        with self._lock:
+            self._update_locked(allocs)
+
+    def _update_locked(self, allocs) -> None:
+        for alloc in allocs:
+            # drop this alloc's existing registrations, then re-add
+            for service_name, key in self._by_alloc.pop(alloc.id, ()):
+                insts = self._services.get(service_name)
+                if insts is not None:
+                    insts.pop(key, None)
+                    if not insts:
+                        self._services.pop(service_name, None)
+            if alloc.terminal_status():
+                continue
+            job = alloc.job or self.store.job_by_id(
+                alloc.namespace, alloc.job_id
+            )
+            if job is None:
+                continue
+            tg = job.lookup_task_group(alloc.task_group)
+            if tg is None:
+                continue
+            node = self.store.node_by_id(alloc.node_id)
+            address = ""
+            if node is not None and node.node_resources.networks:
+                address = node.node_resources.networks[0].ip
+            running = (
+                alloc.client_status == ALLOC_CLIENT_STATUS_RUNNING
+            )
+            port_by_label = {}
+            if alloc.allocated_resources is not None:
+                for p in alloc.allocated_resources.shared.ports:
+                    port_by_label[p.label] = p.value
+                for tr in alloc.allocated_resources.tasks.values():
+                    for net in tr.networks:
+                        for p in list(net.reserved_ports) + list(
+                            net.dynamic_ports
+                        ):
+                            port_by_label[p.label] = p.value
+            entries = []
+            for task in tg.tasks:
+                for service in task.services:
+                    if not service.name:
+                        continue
+                    key = f"{alloc.id}/{task.name}"
+                    checks_ok = self._check_status.get(
+                        (alloc.id, task.name, service.name), True
+                    )
+                    inst = ServiceInstance(
+                        service=service.name,
+                        alloc_id=alloc.id,
+                        node_id=alloc.node_id,
+                        job_id=alloc.job_id,
+                        task=task.name,
+                        address=address,
+                        port=port_by_label.get(
+                            service.port_label, 0
+                        ),
+                        tags=list(service.tags),
+                        healthy=running and checks_ok,
+                        checks_passing=checks_ok,
+                    )
+                    self._services.setdefault(service.name, {})[
+                        key
+                    ] = inst
+                    entries.append((service.name, key))
+            if entries:
+                self._by_alloc[alloc.id] = entries
 
     # ------------------------------------------------------------------
 

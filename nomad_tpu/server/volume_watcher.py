@@ -36,8 +36,17 @@ class VolumeWatcher:
             self._thread.join(timeout=2.0)
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+        # claims only change when state changes: block on the store's
+        # change condition (the in-proc blocking-query primitive)
+        # instead of sweeping on a fixed interval
+        last = -1
+        while not self._stop.is_set():
             try:
+                idx = self.store.wait_for_change(last, timeout=0.5)
+                if idx == last:
+                    continue
+                last = idx
+                self._stop.wait(self.interval)  # debounce bursts
                 self.sync()
             except Exception:  # noqa: BLE001 — keep the watcher alive
                 pass

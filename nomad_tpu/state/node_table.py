@@ -112,6 +112,11 @@ class NodeTable:
         self.device_used: Dict[Tuple[int, Tuple[str, str, str]], int] = {}
 
         self.generation = 0  # bumped on any mutation; device cache key
+        # bumped only on node join/leave/attribute/eligibility changes —
+        # NOT on usage updates — so per-jobspec candidate/mask caches
+        # survive plan commits (usage changes every apply; topology
+        # changes orders of magnitude less often)
+        self.topo_generation = 0
 
     # ------------------------------------------------------------------
     # arena management
@@ -219,6 +224,7 @@ class NodeTable:
         if groups or row in self.device_groups:
             self.device_groups[row] = groups
         self.generation += 1
+        self.topo_generation += 1
         return row
 
     def delete_node(self, node_id: str) -> None:
@@ -234,6 +240,7 @@ class NodeTable:
             self._nodes_cache.pop(node_id, None)
         self._free_rows.append(row)
         self.generation += 1
+        self.topo_generation += 1
 
     def update_node_usage(
         self, node_id: str, usage: Tuple[int, int, int]

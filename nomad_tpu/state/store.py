@@ -93,6 +93,9 @@ class StateStore:
         # change notification for blocking queries
         self._watch_cond = threading.Condition(self._lock)
         self._watchers: List[Callable[[str, int], None]] = []
+        self._alloc_watchers: List[
+            Callable[[List[Allocation]], None]
+        ] = []
 
     # ------------------------------------------------------------------
     # index plumbing
@@ -117,6 +120,34 @@ class StateStore:
     def add_watcher(self, cb: Callable[[str, int], None]) -> None:
         with self._lock:
             self._watchers.append(cb)
+
+    def add_alloc_watcher(
+        self, cb: Callable[[Optional[List[Allocation]]], None]
+    ) -> None:
+        """Delta-level watcher: called with exactly the allocations each
+        write touched, so consumers (service catalog) can update
+        incrementally instead of rescanning the whole alloc table.
+        A ``None`` delta means the alloc table was replaced wholesale
+        (snapshot restore) — consumers must resync from scratch."""
+        with self._lock:
+            self._alloc_watchers.append(cb)
+
+    def wait_for_change(
+        self, last_index: int, timeout: float = 1.0
+    ) -> int:
+        """Block until the store index advances past ``last_index`` or
+        the timeout elapses; returns the current index.  This is the
+        blocking-query primitive the leader-side watchers poll with
+        (reference nomad/rpc.go:780 blockingRPC), replacing fixed-rate
+        full-table sweeps."""
+        deadline = time.monotonic() + timeout
+        with self._watch_cond:
+            while self._index <= last_index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._watch_cond.wait(remaining)
+            return self._index
 
     def wait_for_index(self, index: int, timeout: float = 5.0) -> bool:
         """Block until the store has advanced to at least ``index``
@@ -156,7 +187,28 @@ class StateStore:
             node.modify_index = self._index + 1
             self.nodes[node.id] = node
             self.node_table.upsert_node(node)
-            return self._bump("nodes")
+            index = self._bump("nodes")
+            # a changed node address must refresh the catalog entries of
+            # allocs already running there (their instances captured the
+            # old address when the alloc was last written)
+            if (
+                existing is not None
+                and self._alloc_watchers
+                and self._node_address(existing)
+                != self._node_address(node)
+            ):
+                touched = [
+                    self.allocs[aid]
+                    for aid in self._allocs_by_node.get(node.id, ())
+                    if aid in self.allocs
+                ]
+                self._notify_alloc_watchers(touched)
+            return index
+
+    @staticmethod
+    def _node_address(node: Node) -> str:
+        nets = node.node_resources.networks
+        return nets[0].ip if nets else ""
 
     def delete_node(self, node_id: str) -> int:
         with self._lock:
@@ -528,7 +580,20 @@ class StateStore:
     def upsert_allocs(self, allocs: List[Allocation]) -> int:
         with self._lock:
             self._upsert_allocs_locked(allocs)
-            return self._bump("allocs")
+            index = self._bump("allocs")
+            self._notify_alloc_watchers(allocs)
+            return index
+
+    def _notify_alloc_watchers(self, allocs: List[Allocation]) -> None:
+        """Called under self._lock so concurrent writers deliver deltas
+        in commit order (out-of-order delivery would let a stale live
+        version of an alloc overwrite its terminal update in the
+        catalog).  Callbacks must only use the store's lock-free read
+        surface.  ``allocs=None`` signals a wholesale table replacement
+        (snapshot restore)."""
+        if allocs or allocs is None:
+            for cb in self._alloc_watchers:
+                cb(allocs)
 
     def _upsert_allocs_locked(self, allocs: List[Allocation]) -> None:
         for alloc in allocs:
@@ -697,7 +762,9 @@ class StateStore:
                     d.status = upd.status
                     d.status_description = upd.status_description
                     d.modify_index = self._index + 1
-            return self._bump("allocs", "deployments")
+            index = self._bump("allocs", "deployments")
+            self._notify_alloc_watchers(updates)
+            return index
 
     def _claim_csi_for_alloc_locked(self, alloc: Allocation) -> None:
         job = alloc.job or self.job_by_id(alloc.namespace, alloc.job_id)

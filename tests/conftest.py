@@ -4,13 +4,24 @@ real multi-chip hardware and parity tests are bit-exact against the
 float64 host oracle (SURVEY.md section 7.3)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard-set (not setdefault): shells that export JAX_PLATFORMS=axon for
+# the tunneled TPU must not leak into the test suite — the suite's
+# parity contract is the x64 CPU backend with a virtual 8-device mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
+# a TPU-tunnel sitecustomize may have already forced
+# jax_platforms="axon,cpu" via jax.config at interpreter start, which
+# overrides the env var above — force the config back before any
+# backend initializes, or every kernel call in the suite silently
+# targets the tunneled TPU (and hangs the suite when the tunnel drops)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 import random  # noqa: E402
 
 import pytest  # noqa: E402
