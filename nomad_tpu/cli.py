@@ -89,6 +89,25 @@ def cmd_agent(args) -> None:
     from .config import AgentConfig, load_config
     from .server import Server
 
+    if getattr(args, "server_addr", None):
+        # networked cluster-server mode: delegate to the netagent
+        # entrypoint (framed-TCP raft/gossip/forwarding + HTTP API)
+        if args.dev or args.config or args.num_schedulers is not None:
+            raise SystemExit(
+                "-server-addr does not support -dev/-config/"
+                "-num-schedulers yet; configure via netagent flags"
+            )
+        from .server.netagent import main as netagent_main
+
+        argv = [
+            "--addr", args.server_addr,
+            "--peers", args.peers or args.server_addr,
+            "--http-port", str(args.http_port or 0),
+        ]
+        if args.join:
+            argv += ["--join", args.join]
+        raise SystemExit(netagent_main(argv))
+
     cfg = load_config(args.config) if args.config else AgentConfig()
     if args.dev:
         cfg.client.enabled = True
@@ -748,6 +767,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     agent = sub.add_parser("agent")
     agent.add_argument("-dev", action="store_true", dest="dev")
+    agent.add_argument(
+        "-server-addr", default=None, dest="server_addr",
+        help="host:port RPC bind — runs a TCP cluster server "
+        "(multi-process control plane; see nomad_tpu.server.netagent)",
+    )
+    agent.add_argument(
+        "-peers", default=None, dest="peers",
+        help="comma-separated raft peer addresses incl. self",
+    )
+    agent.add_argument(
+        "-join", default=None, dest="join",
+        help="gossip seed address of a live server",
+    )
     agent.add_argument("-http-port", type=int, default=None,
                        dest="http_port")
     agent.add_argument("-num-schedulers", type=int, default=None,

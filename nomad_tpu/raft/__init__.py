@@ -12,6 +12,7 @@ monitorLeadership).
 """
 from .log import LogEntry, RaftLog
 from .node import RaftNode, NotLeaderError
+from .tcp import TcpTransport
 from .transport import InmemTransport, TransportError
 
 __all__ = [
@@ -20,5 +21,6 @@ __all__ = [
     "RaftNode",
     "NotLeaderError",
     "InmemTransport",
+    "TcpTransport",
     "TransportError",
 ]

@@ -1,0 +1,307 @@
+"""Framed-TCP transport: the cross-process counterpart of
+InmemTransport.
+
+One listener per registered local address serves every RPC family the
+cluster multiplexes over a single port — raft (`request_vote`,
+`append_entries`, `install_snapshot`), gossip (`gossip_*`), leader
+forwarding (`fsm_apply`, `server_call`, `region_call`) — exactly the
+reference's single-port design (nomad/rpc.go:250 multiplexes raft, RPC
+and serf on one listener; nomad/raft_rpc.go layers raft on it).
+
+Frames carry the wire codec from nomad_tpu/wire.py (shared with the
+native library, byte-identical in C++ and Python), shaped as
+``[method, src, payload]`` with an ``["ok", resp] | ["err", type,
+detail, message]`` reply envelope, so typed errors — notably
+NotLeaderError with its leader hint — survive the hop and follower
+forwarding behaves identically in-process and across machines.
+
+Failure behavior: dial/read timeouts raise TransportError fast, and a
+circuit breaker remembers unreachable peers for a short window so the
+leader's serial replication tick cannot stall behind one dead follower
+(the reference gets the same property from per-follower replication
+goroutines + pool timeouts, helper/pool/pool.go)."""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import wire
+from .transport import TransportError
+
+Handler = Callable[[str, dict], dict]
+
+CONNECT_TIMEOUT = 0.5
+CALL_TIMEOUT = 5.0
+BREAKER_WINDOW = 1.0  # seconds an unreachable peer fails fast
+
+
+def _not_leader_error():
+    from .node import NotLeaderError
+
+    return NotLeaderError
+
+
+_ERR_TYPES = {
+    "KeyError": KeyError,
+    "ValueError": ValueError,
+    "TimeoutError": TimeoutError,
+    "TransportError": TransportError,
+}
+
+
+class TcpTransport:
+    """InmemTransport-compatible transport over framed TCP sockets.
+
+    Addresses are ``host:port`` strings.  A process typically registers
+    ONE local address (its server) but the API allows several (tests).
+    Client connections are pooled per destination and safe for
+    concurrent use — each call checks a free connection out of the
+    pool."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._listeners: Dict[str, "_Listener"] = {}
+        self._pools: Dict[str, List[socket.socket]] = {}
+        self._breaker: Dict[str, float] = {}  # addr -> retry-after ts
+        self.call_timeout = CALL_TIMEOUT
+
+    # -- server side ---------------------------------------------------
+
+    def register(self, addr: str, handler: Handler) -> None:
+        """Re-registering an address swaps the handler in place
+        (ClusterServer registers raft, then gossip, then its combined
+        dispatcher on the same port — with InmemTransport that's a dict
+        overwrite, so the listener must survive re-registration)."""
+        host, port = _split(addr)
+        with self._lock:
+            existing = self._listeners.get(addr)
+            if existing is not None:
+                existing.handler = handler
+                return
+        listener = _Listener(addr, host, port, handler)
+        with self._lock:
+            self._listeners[addr] = listener
+        listener.start()
+
+    def deregister(self, addr: str) -> None:
+        with self._lock:
+            listener = self._listeners.pop(addr, None)
+        if listener is not None:
+            listener.close()
+
+    def close(self) -> None:
+        with self._lock:
+            listeners = list(self._listeners.values())
+            self._listeners.clear()
+            pools = list(self._pools.values())
+            self._pools.clear()
+        for listener in listeners:
+            listener.close()
+        for pool in pools:
+            for sock in pool:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    # -- client side ---------------------------------------------------
+
+    def rpc(self, src: str, dst: str, method: str, payload: dict) -> dict:
+        now = time.monotonic()
+        retry_after = self._breaker.get(dst, 0.0)
+        if now < retry_after:
+            raise TransportError(f"{dst} unreachable (breaker open)")
+        frame = wire.encode([method, src, payload])  # before checkout:
+        # an unencodable payload must not leak a pooled socket
+        sock, pooled = self._checkout(dst)
+        raw, err = self._exchange(sock, frame)
+        if err is not None and pooled:
+            # the pooled connection may simply be stale (peer
+            # restarted); retry once on a fresh dial before declaring
+            # the peer unreachable
+            sock, _ = self._checkout(dst)
+            raw, err = self._exchange(sock, frame)
+        if err is not None:
+            self._breaker[dst] = time.monotonic() + BREAKER_WINDOW
+            raise TransportError(f"rpc to {dst} failed: {err}")
+        self._checkin(dst, sock)
+        reply = wire.decode(raw)
+        if reply[0] == "ok":
+            return reply[1]
+        _kind, type_name, detail, message = reply
+        if type_name == "NotLeaderError":
+            raise _not_leader_error()(detail or None)
+        exc_type = _ERR_TYPES.get(type_name, RuntimeError)
+        raise exc_type(message)
+
+    def _exchange(self, sock, frame):
+        """One request/response on a connection; returns (raw, error).
+        The socket is closed on any failure."""
+        try:
+            sock.settimeout(self.call_timeout)  # before send: a large
+            # frame (install_snapshot) must not run under the short
+            # connect timeout
+            wire.send_frame(sock, frame)
+            raw = wire.recv_frame(sock)
+        except (OSError, ValueError) as exc:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return None, exc
+        if raw is None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return None, ConnectionError("connection closed mid-call")
+        return raw, None
+
+    def _checkout(self, dst: str):
+        """Returns (socket, came_from_pool)."""
+        with self._lock:
+            pool = self._pools.get(dst)
+            if pool:
+                return pool.pop(), True
+        host, port = _split(dst)
+        try:
+            sock = socket.create_connection(
+                (host, port), timeout=CONNECT_TIMEOUT
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError as exc:
+            self._breaker[dst] = time.monotonic() + BREAKER_WINDOW
+            raise TransportError(f"dial {dst} failed: {exc}") from exc
+        self._breaker.pop(dst, None)
+        return sock, False
+
+    def _checkin(self, dst: str, sock: socket.socket) -> None:
+        self._breaker.pop(dst, None)
+        with self._lock:
+            pool = self._pools.setdefault(dst, [])
+            if len(pool) < 8:
+                pool.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class _Listener:
+    def __init__(
+        self, addr: str, host: str, port: int, handler: Handler
+    ) -> None:
+        self.addr = addr
+        self.handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._conn_lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def start(self) -> None:
+        t = threading.Thread(
+            target=self._accept_loop,
+            name=f"tcp-accept-{self.addr}",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
+    def close(self) -> None:
+        """Closes the accept socket AND every live accepted connection,
+        so the port is actually re-bindable afterwards and no serve
+        thread stays parked in recv forever."""
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_conn,
+                args=(conn,),
+                name=f"tcp-conn-{self.addr}",
+                daemon=True,
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                raw = wire.recv_frame(conn)
+                if raw is None:
+                    return
+                method, _src, payload = wire.decode(raw)
+                try:
+                    resp = self.handler(method, payload)
+                    reply = ["ok", resp]
+                except Exception as exc:  # noqa: BLE001 — typed envelope
+                    reply = _error_envelope(exc)
+                try:
+                    out = wire.encode(reply)
+                except TypeError as exc:
+                    # a handler returned a non-wire-safe value; answer
+                    # with an error envelope instead of killing the
+                    # connection (which would stall the caller for the
+                    # whole call timeout)
+                    out = wire.encode(_error_envelope(exc))
+                wire.send_frame(conn, out)
+        except (OSError, ValueError):
+            pass
+        finally:
+            with self._conn_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def _error_envelope(exc: Exception) -> list:
+    type_name = type(exc).__name__
+    detail = None
+    if type_name == "NotLeaderError":
+        detail = getattr(exc, "leader", None)
+    return ["err", type_name, detail, str(exc)]
+
+
+def _split(addr: str) -> Tuple[str, int]:
+    host, _sep, port = addr.rpartition(":")
+    if not host:
+        raise ValueError(f"address {addr!r} is not host:port")
+    return host, int(port)

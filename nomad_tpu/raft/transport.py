@@ -3,10 +3,10 @@
 InmemTransport mirrors hashicorp/raft's InmemTransport (what
 nomad.TestServer clusters use, nomad/testing.go:44): a process-local
 registry of nodes, synchronous delivery, and partition controls for
-failure-injection tests.  The same handler surface can be served over
-the framed TCP wire protocol (nomad_tpu/wire.py) for cross-process
-clusters — the reference's RaftLayer multiplexes raft traffic over the
-server's single RPC port (nomad/raft_rpc.go).
+failure-injection tests.  The same handler surface is served over
+framed TCP by TcpTransport (nomad_tpu/raft/tcp.py) for cross-process
+clusters — the reference's RaftLayer likewise multiplexes raft traffic
+over the server's single RPC port (nomad/raft_rpc.go).
 """
 from __future__ import annotations
 

@@ -1,0 +1,91 @@
+"""Networked cluster-server entrypoint: one OS process = one server.
+
+Boots a ClusterServer whose raft/gossip/forwarding RPCs travel over the
+framed-TCP transport (nomad_tpu/raft/tcp.py) and serves the HTTP API —
+the cross-process deployment shape of the reference agent in server
+mode (command/agent: one process, one RPC port multiplexing raft + RPC
++ serf, plus the HTTP API).
+
+Usage (what tests/test_cluster_tcp.py drives):
+
+    python -m nomad_tpu.server.netagent \
+        --addr 127.0.0.1:7101 \
+        --peers 127.0.0.1:7101,127.0.0.1:7102,127.0.0.1:7103 \
+        --http-port 8101 [--join 127.0.0.1:7102]
+
+Prints ``READY addr=<addr> http=<port>`` on stdout once the RPC
+listener and HTTP API are up, then runs until SIGTERM/SIGINT.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="nomad-tpu-server")
+    parser.add_argument("--addr", required=True, help="host:port RPC bind")
+    parser.add_argument(
+        "--peers", required=True,
+        help="comma-separated raft peer addresses (including self)",
+    )
+    parser.add_argument("--http-port", type=int, default=0)
+    parser.add_argument("--http-host", default="127.0.0.1")
+    parser.add_argument("--region", default="global")
+    parser.add_argument(
+        "--join", default="",
+        help="gossip seed address (any live server)",
+    )
+    parser.add_argument(
+        "--election-timeout", type=float, default=0.6,
+        help="raft election timeout seconds (network default is "
+        "longer than the in-process default: dial timeouts must fit "
+        "inside it)",
+    )
+    parser.add_argument(
+        "--heartbeat-interval", type=float, default=0.15
+    )
+    args = parser.parse_args(argv)
+
+    from ..api.http import start_http_server
+    from ..raft.tcp import TcpTransport
+    from .cluster import ClusterServer
+
+    transport = TcpTransport()
+    server = ClusterServer(
+        args.addr,
+        [p for p in args.peers.split(",") if p],
+        transport,
+        region=args.region,
+        election_timeout=args.election_timeout,
+        heartbeat_interval=args.heartbeat_interval,
+    )
+    server.start()
+    if args.join:
+        try:
+            server.join(args.join)
+        except Exception as exc:  # noqa: BLE001 — seed may lag behind
+            print(f"join {args.join} failed: {exc}", file=sys.stderr)
+    http = start_http_server(
+        server, host=args.http_host, port=args.http_port
+    )
+    print(f"READY addr={args.addr} http={http.port}", flush=True)
+
+    stop = threading.Event()
+
+    def _terminate(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    stop.wait()
+    http.stop()
+    server.stop()
+    transport.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
