@@ -46,6 +46,10 @@ from nomad_tpu.structs import (
     compute_node_class,
 )
 
+# this sandbox's scheduler can park a timed wait far past its timeout;
+# the broker's opt-in notify watchdog bounds the damage
+os.environ.setdefault("NOMAD_TPU_BROKER_WATCHDOG", "1")
+
 N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 N_ALLOCS = int(os.environ.get("BENCH_ALLOCS", 100_000))
 TG_COUNT = 10  # placements per eval

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional
 class ServerConfig:
     enabled: bool = True
     num_schedulers: int = 2
-    batch_pipeline: bool = False
+    batch_pipeline: bool = True
     heartbeat_ttl_s: float = 30.0
     seed: Optional[int] = None
 
@@ -99,7 +99,7 @@ def config_from_dict(raw: Dict) -> AgentConfig:
     cfg.server = ServerConfig(
         enabled=bool(server.get("enabled", True)),
         num_schedulers=int(server.get("num_schedulers", 2)),
-        batch_pipeline=bool(server.get("batch_pipeline", False)),
+        batch_pipeline=bool(server.get("batch_pipeline", True)),
         heartbeat_ttl_s=_duration_s(server.get("heartbeat_ttl"), 30.0),
         seed=server.get("seed"),
     )

@@ -30,7 +30,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .score import MAX_SKIP, NO_NODE, SKIP_THRESHOLD
+from .score import MAX_SKIP, NO_NODE, SKIP_THRESHOLD, _pow10 as _pow10_f32
 
 
 def pow2_bucket(n: int, floor: int = 1) -> int:
@@ -50,11 +50,25 @@ class SpreadInputs(NamedTuple):
     Shapes: S spread stanzas x (V+1) value slots; slot V is the penalty
     slot (missing attribute, or value with no target and no implicit
     "*") scoring a flat -1.0.  Even-spread mode (spread.go:178) stays on
-    the exact host path."""
+    the exact host path.
+
+    The per-pick used count reproduces propertySet.GetCombinedUseMap
+    (reference propertyset.go): used = max(0, existing + proposed -
+    cleared'), where `existing0` counts the job's live allocs at the
+    snapshot, `proposed` starts at `proposed0` — in-place/attribute
+    updates enter plan.NodeAllocation before any select, so the
+    reference counts those allocs BOTH as existing and as proposed —
+    and accumulates in-kernel placements, `cleared` starts at
+    `cleared0` (plan stops staged before the first pick) and grows as
+    per-pick destructive evictions land, and cleared' applies the
+    PopulateProposed quirk — a value with both proposed and cleared>1
+    counts one fewer cleared."""
 
     codes: jnp.ndarray  # i32[S, C] value slot per node (V = penalty)
     desired: jnp.ndarray  # f[S, V+1] desired count per slot
-    used0: jnp.ndarray  # f[S, V+1] combined use at snapshot
+    used0: jnp.ndarray  # f[S, V+1] existing (live) use at snapshot
+    proposed0: jnp.ndarray  # f[S, V+1] plan placements staged pre-pick
+    cleared0: jnp.ndarray  # f[S, V+1] pre-staged plan stops per slot
     weight: jnp.ndarray  # f[S] weight / sum(|weights|)
     active: jnp.ndarray  # bool[S] (padding rows are inert)
 
@@ -224,7 +238,7 @@ def _run_picks(
     if spread is not None:
         # small-vocab lookups as one-hot matmuls (MXU-friendly; avoids
         # per-step gathers): desired/penalty per node are static,
-        # used-per-node recomputes from the (S, V+1) carry each step
+        # used-per-node recomputes from the (S, V+1) carries each step
         _S, V1 = spread.desired.shape
         codes_sp = jnp.take(spread.codes, perm, axis=1)  # (S, C)
         onehot_p = jax.nn.one_hot(codes_sp, V1, dtype=dtype)
@@ -233,17 +247,18 @@ def _run_picks(
         )
         penalty_node = codes_sp == (V1 - 1)
         safe_desired = jnp.where(desired_node != 0, desired_node, 1.0)
+        spread_existing = spread.used0.astype(dtype)  # (S, V+1)
 
     def step(carry, pick_idx):
         cpu_used = carry["cpu"]
         mem_used = carry["mem"]
         disk_used = carry["disk"]
         collisions = carry["coll"]
-        excl = carry["excl"]
         offset = carry["off"]
         dead = carry["dead"]
         if spread is not None:
-            spread_used = carry["spread"]
+            spread_prop = carry["spread_prop"]
+            spread_clr = carry["spread_clr"]
         # once a pick fails, later picks for the eval are inert: the
         # sequential path coalesces subsequent placements for a task
         # group after its first failure (generic_sched.go:482)
@@ -271,6 +286,16 @@ def _run_picks(
             penalty_vec = penalty_vec | jnp.any(
                 perm[:, None] == prow[None, :], axis=1
             )
+            if spread is not None:
+                # the evicted alloc's value slot gains one cleared use
+                # (its stop is staged into plan.node_update just before
+                # this pick — propertyset counts it as cleared)
+                evict_slot = spread.codes[:, jnp.maximum(erow, 0)]
+                spread_clr = spread_clr + jnp.where(
+                    app,
+                    jax.nn.one_hot(evict_slot, V1, dtype=dtype),
+                    0.0,
+                )
         cpu_after = cpu_used + inp.ask_cpu
         mem_after = mem_used + inp.ask_mem
         disk_after = disk_used + inp.ask_disk
@@ -279,13 +304,21 @@ def _run_picks(
             & (mem_after <= mem_total_p)
             & (disk_after <= disk_total_p)
         )
-        feasible = feas_p & fit & ~excl
+        # distinct_hosts: a node is infeasible while any proposed alloc
+        # of the job occupies it (feasible.go:470 DistinctHostsIterator).
+        # For the single-task-group jobs the batch path admits, the
+        # anti-affinity collision carry IS the proposed-allocs-per-node
+        # count: existing live allocs at the snapshot, +1 per pick,
+        # -1 per staged destructive eviction — so the mask is just
+        # collisions == 0.
+        feasible = feas_p & fit & ~(
+            inp.distinct_hosts & (collisions > 0)
+        )
 
         free_cpu = 1.0 - cpu_after / safe_cpu
         free_mem = 1.0 - mem_after / safe_mem
-        base = jnp.power(jnp.asarray(10.0, dtype), free_cpu) + jnp.power(
-            jnp.asarray(10.0, dtype), free_mem
-        )
+        # canonical f32-rounded exponential (structs/funcs.py _pow10)
+        base = _pow10_f32(free_cpu, dtype) + _pow10_f32(free_mem, dtype)
         if spread_fit:
             fitness = jnp.clip(base - 2.0, 0.0, 18.0)
         else:
@@ -310,9 +343,17 @@ def _run_picks(
         if spread is not None:
             # boost per stanza: ((desired - (used+1)) / desired) * w,
             # -1.0 on the penalty slot (spread.py next()); appended to
-            # the score list only when the total is non-zero
+            # the score list only when the total is non-zero.  Combined
+            # use reproduces GetCombinedUseMap incl. the
+            # PopulateProposed cleared-decrement quirk.
+            clr_adj = spread_clr - jnp.where(
+                (spread_prop > 0) & (spread_clr > 1), 1.0, 0.0
+            )
+            combined = jnp.maximum(
+                0.0, spread_existing + spread_prop - clr_adj
+            )
             used_node = jnp.einsum(
-                "scv,sv->sc", onehot_p, spread_used
+                "scv,sv->sc", onehot_p, combined
             )
             frac = (desired_node - (used_node + 1.0)) / safe_desired
             contrib = jnp.where(
@@ -346,24 +387,22 @@ def _run_picks(
         collisions = collisions.at[safe_win].add(
             jnp.where(ok, 1, 0)
         )
-        excl = excl.at[safe_win].set(
-            jnp.where(ok & inp.distinct_hosts, True, excl[safe_win])
-        )
         offset = jnp.mod(offset + pulls, n_candidates)
         out = {
             "cpu": cpu_used,
             "mem": mem_used,
             "disk": disk_used,
             "coll": collisions,
-            "excl": excl,
             "off": offset,
             "dead": dead,
         }
         if spread is not None:
-            # the placed node's value slot gains one use per stanza
-            out["spread"] = spread_used + jnp.where(
+            # the placed node's value slot gains one proposed use per
+            # stanza
+            out["spread_prop"] = spread_prop + jnp.where(
                 ok, onehot_p[:, safe_win, :], 0.0
             )
+            out["spread_clr"] = spread_clr
         return out, (row, app, pulls)
 
     carry0 = {
@@ -371,12 +410,12 @@ def _run_picks(
         "mem": take(used0[1]),
         "disk": take(used0[2]),
         "coll": take(inp.base_collisions),
-        "excl": jnp.zeros_like(feas_p),
         "off": jnp.asarray(0, jnp.int32),
         "dead": jnp.asarray(False),
     }
     if spread is not None:
-        carry0["spread"] = spread.used0.astype(dtype)
+        carry0["spread_prop"] = spread.proposed0.astype(dtype)
+        carry0["spread_clr"] = spread.cleared0.astype(dtype)
     _final, (rows, eapps, pulls) = jax.lax.scan(
         step, carry0, jnp.arange(n_picks, dtype=jnp.int32)
     )

@@ -197,25 +197,33 @@ class MaskCompiler:
         self,
         attribute: str,
         desired_counts: Dict[str, float],
-        combined_use: Dict[str, int],
+        existing_use: Dict[str, int],
+        cleared_use: Optional[Dict[str, int]] = None,
+        proposed_use: Optional[Dict[str, int]] = None,
     ):
         """Columns for the in-kernel spread carry (ops/batch.py
-        SpreadInputs): per-node value slot codes, desired count and
-        initial use per slot.  The last slot is the penalty slot
-        (missing attribute / value with no target and no implicit "*"),
-        matching spread_boost_vector's -1.0 semantics."""
+        SpreadInputs): per-node value slot codes, desired count,
+        existing / pre-staged proposed / pre-staged cleared use per
+        slot.  The last slot is the penalty slot (missing attribute /
+        value with no target and no implicit "*"), matching
+        spread_boost_vector's -1.0 semantics."""
         C = self.table.capacity
+        cleared_use = cleared_use or {}
+        proposed_use = proposed_use or {}
         key = target_column_key(attribute) or ""
         if key == "":
             # non-interpolatable attribute: every node is a penalty
             codes = np.zeros(C, dtype=np.int32)
-            return codes, np.zeros(1), np.zeros(1)
+            z = np.zeros(1)
+            return codes, z, z, z, z
         col = self.table.column(key)
         vocab = col.interner.values
         V = len(vocab)
         slot_of = np.full(V + 1, V, dtype=np.int32)
         desired = np.zeros(V + 1, dtype=np.float64)
         used0 = np.zeros(V + 1, dtype=np.float64)
+        proposed0 = np.zeros(V + 1, dtype=np.float64)
+        cleared0 = np.zeros(V + 1, dtype=np.float64)
         for i, value in enumerate(vocab):
             d = desired_counts.get(value)
             if d is None:
@@ -224,10 +232,12 @@ class MaskCompiler:
                 continue  # stays on the penalty slot
             slot_of[i] = i
             desired[i] = d
-            used0[i] = float(combined_use.get(value, 0))
+            used0[i] = float(existing_use.get(value, 0))
+            proposed0[i] = float(proposed_use.get(value, 0))
+            cleared0[i] = float(cleared_use.get(value, 0))
         node_codes = np.where(col.codes >= 0, col.codes, V)
         codes = slot_of[node_codes]
-        return codes, desired, used0
+        return codes, desired, used0, proposed0, cleared0
 
     def spread_boost_vector(
         self,

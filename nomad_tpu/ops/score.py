@@ -33,6 +33,14 @@ SKIP_THRESHOLD = 0.0  # (reference stack.go:13)
 NO_NODE = -1
 
 
+def _pow10(x, dtype):
+    """Canonical 10^x for fitness scoring: f64 pow rounded through
+    float32 so host and accelerator implementations agree bit-for-bit
+    (see structs/funcs.py _pow10)."""
+    raw = jnp.power(jnp.asarray(10.0, dtype), x)
+    return raw.astype(jnp.float32).astype(dtype)
+
+
 class ScoreInputs(NamedTuple):
     """Arena-shaped kernel inputs.  All float arrays share one dtype
     (f64 for bit-parity tests on CPU, f32 on TPU).  `perm` is the rotated
@@ -77,9 +85,11 @@ def _score_vectors(inp: ScoreInputs, spread_fit: bool):
     safe_mem_total = jnp.where(inp.mem_total > 0, inp.mem_total, 1.0)
     free_cpu = 1.0 - cpu_after / safe_cpu_total
     free_mem = 1.0 - mem_after / safe_mem_total
-    base = jnp.power(
-        jnp.asarray(10.0, dtype), free_cpu
-    ) + jnp.power(jnp.asarray(10.0, dtype), free_mem)
+    # the fitness exponential is DEFINED at float32 precision (see
+    # structs/funcs.py _pow10): host libm and XLA pow disagree by 1 f64
+    # ulp on ~5% of inputs, so both sides round the pow through f32 and
+    # continue in the working dtype
+    base = _pow10(free_cpu, dtype) + _pow10(free_mem, dtype)
     if spread_fit:
         fitness = jnp.clip(base - 2.0, 0.0, 18.0)
     else:

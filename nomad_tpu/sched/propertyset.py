@@ -156,9 +156,23 @@ class PropertySet:
     def _count(
         self, allocs: List[Allocation], into: Dict[str, int]
     ) -> None:
-        for alloc in allocs:
-            node = self.ctx.state.node_by_id(alloc.node_id)
-            value, ok = get_property(node, self.target_attribute)
-            if not ok:
-                continue
-            into[value] = into.get(value, 0) + 1
+        for value, n in count_values_by_property(
+            self.ctx.state, self.target_attribute, allocs
+        ).items():
+            into[value] = into.get(value, 0) + n
+
+
+def count_values_by_property(
+    state, attribute: str, allocs: List[Allocation]
+) -> Dict[str, int]:
+    """Allocs per value of their node's property (reference
+    propertyset.go _count) — the single counting implementation shared
+    by PropertySet and the batch worker's in-kernel spread inputs."""
+    out: Dict[str, int] = {}
+    for alloc in allocs:
+        node = state.node_by_id(alloc.node_id)
+        value, ok = get_property(node, attribute)
+        if not ok:
+            continue
+        out[value] = out.get(value, 0) + 1
+    return out

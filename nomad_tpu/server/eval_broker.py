@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+from collections import deque
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -71,6 +72,12 @@ class EvalBroker:
         # delayed evals: (wait_until, n, eval)
         self._delayed: List[Tuple[float, int, Evaluation]] = []
         self._delivery_count: Dict[str, int] = {}
+        self._ticker: Optional[threading.Thread] = None
+        self.ticks = 0
+        # tiny event ring for post-mortem debugging (eval id prefix,
+        # action, monotonic ts) — cheap, and invaluable when an eval
+        # "disappears" between enqueue and ack
+        self.events: "deque" = deque(maxlen=128)
         self.stats = {
             "total_ready": 0,
             "total_unacked": 0,
@@ -82,11 +89,40 @@ class EvalBroker:
     # ------------------------------------------------------------------
 
     def set_enabled(self, enabled: bool) -> None:
+        import os
+
         with self._lock:
             self._enabled = enabled
             if not enabled:
                 self.flush()
             self._lock.notify_all()
+            if (
+                enabled
+                and self._ticker is None
+                and os.environ.get("NOMAD_TPU_BROKER_WATCHDOG") == "1"
+            ):
+                # opt-in watchdog: timed Condition waits have been
+                # observed to park far past their timeout under some
+                # sandboxed schedulers (a 5ms wait sleeping 10s+ with
+                # the GIL free, no lock holder, and no clock step).  A
+                # periodic notify_all wakes any such waiter, bounding
+                # the damage of one anomalous timed wait.  Off by
+                # default — production brokers should not pay 20 Hz
+                # wakeups for a host pathology they don't have.
+                self._ticker = threading.Thread(
+                    target=self._tick, name="broker-ticker", daemon=True
+                )
+                self._ticker.start()
+
+    def _tick(self) -> None:
+        while True:
+            time.sleep(0.05)
+            with self._lock:
+                self.ticks += 1
+                if not self._enabled and not self._unack:
+                    self._ticker = None
+                    return
+                self._lock.notify_all()
 
     @property
     def enabled(self) -> bool:
@@ -116,10 +152,11 @@ class EvalBroker:
             self._lock.notify_all()
 
     def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        self.events.append((time.monotonic(), "enq", ev.id[:6], queue))
         if not self._enabled:
             return
         if ev.id in self._unack or any(
-            ev.id is q_ev.id
+            ev.id == q_ev.id
             for q in self._ready.values()
             for _, _, q_ev in q.heap
         ):
@@ -166,6 +203,7 @@ class EvalBroker:
                     timer.start()
                     self._unack[ev.id] = (ev, token, timer)
                     self.stats["total_unacked"] += 1
+                    self.events.append((time.monotonic(), "deq", ev.id[:6], token[:6]))
                     return ev, token
                 if not self._enabled:
                     return None, ""
@@ -211,6 +249,7 @@ class EvalBroker:
             timer.cancel()
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
+            self.events.append((time.monotonic(), "ack", eval_id[:6], ""))
             self._delivery_count.pop(eval_id, None)
             job_key = (ev.namespace, ev.job_id)
             if self._job_evals.get(job_key) == eval_id:
@@ -233,6 +272,7 @@ class EvalBroker:
             timer.cancel()
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
+            self.events.append((time.monotonic(), "nack", eval_id[:6], ""))
             job_key = (ev.namespace, ev.job_id)
             if self._job_evals.get(job_key) == eval_id:
                 del self._job_evals[job_key]

@@ -93,7 +93,11 @@ class Server:
         seed: Optional[int] = None,
         nack_timeout: float = 60.0,
         acl_enabled: bool = False,
-        batch_pipeline: bool = False,
+        # the batched TPU pipeline is the default scheduling path; it
+        # falls back per eval to the exact sequential scheduler for
+        # shapes the kernel doesn't model (networks/devices/multi-TG/
+        # sticky), with prescore-rate + fallback counters in /v1/metrics
+        batch_pipeline: bool = True,
         store: Optional[StateStore] = None,
         acls=None,
     ) -> None:

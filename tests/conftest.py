@@ -13,15 +13,24 @@ os.environ["XLA_FLAGS"] = (
     + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+# cold kernel compiles block (instead of falling back to the
+# sequential path while compiling in the background) so prescore-rate
+# assertions are deterministic
+os.environ["NOMAD_TPU_SYNC_COMPILE"] = "1"
+# this sandbox's scheduler can park a timed wait far past its timeout;
+# the broker's opt-in notify watchdog bounds the damage
+os.environ["NOMAD_TPU_BROKER_WATCHDOG"] = "1"
 
-# a TPU-tunnel sitecustomize may have already forced
-# jax_platforms="axon,cpu" via jax.config at interpreter start, which
-# overrides the env var above — force the config back before any
-# backend initializes, or every kernel call in the suite silently
+# a TPU-tunnel sitecustomize may have already imported jax at
+# interpreter start (before the env vars above took effect) and forced
+# jax_platforms="axon,cpu" — force the config back via jax.config,
+# which works post-import, or every kernel call in the suite silently
 # targets the tunneled TPU (and hangs the suite when the tunnel drops)
+# and, worse, runs f32 instead of the x64 the parity contract needs
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 import random  # noqa: E402
 
 import pytest  # noqa: E402
@@ -57,3 +66,4 @@ def heterogeneous_cluster(
         harness.store.upsert_node(n)
         nodes.append(n)
     return nodes
+

@@ -47,7 +47,7 @@ def test_batch_pipeline_matches_sequential():
     nodes = make_nodes(20)
     jobs = make_jobs(8)
 
-    seq = Server(num_schedulers=1, seed=99)
+    seq = Server(num_schedulers=1, seed=99, batch_pipeline=False)
     bat = Server(num_schedulers=1, seed=99, batch_pipeline=True)
     seq.start()
     bat.start()
@@ -182,7 +182,7 @@ def test_batch_pipeline_spread_in_kernel_matches_sequential():
     # plus interleaved plain jobs: mixed batches must stack correctly
     plain = make_jobs(3, seed=9)
 
-    seq = Server(num_schedulers=1, seed=42)
+    seq = Server(num_schedulers=1, seed=42, batch_pipeline=False)
     bat = Server(num_schedulers=1, seed=42, batch_pipeline=True)
     seq.start()
     bat.start()
@@ -271,7 +271,7 @@ def test_batch_pipeline_duplicate_spread_attribute_matches():
         )
     ]
 
-    seq = Server(num_schedulers=1, seed=13)
+    seq = Server(num_schedulers=1, seed=13, batch_pipeline=False)
     bat = Server(num_schedulers=1, seed=13, batch_pipeline=True)
     seq.start()
     bat.start()
@@ -307,7 +307,7 @@ def test_batch_pipeline_steady_state_churn_matches_sequential():
             delay_s=0.0, unlimited=True
         )
 
-    seq = Server(num_schedulers=1, seed=77)
+    seq = Server(num_schedulers=1, seed=77, batch_pipeline=False)
     bat = Server(num_schedulers=1, seed=77, batch_pipeline=True)
     seq.start()
     bat.start()
@@ -338,6 +338,12 @@ def test_batch_pipeline_steady_state_churn_matches_sequential():
                 nj = mock.job(id=f"churn-new-{k}")
                 nj.task_groups[0].count = 2
                 server.register_job(nj)
+            # drain BEFORE the node dies: a node-down racing an
+            # in-flight eval gives the two servers legitimately
+            # different interleavings (whether the eval's snapshot sees
+            # the node ready is timing), and bit-identity is only
+            # defined per interleaving
+            assert server.drain_to_idle(30)
             # a node dies: its allocs go lost and reschedule
             server.update_node_status(nodes[3].id, "down")
 
@@ -385,6 +391,129 @@ def test_batch_pipeline_steady_state_churn_matches_sequential():
         assert rate > 0.8, (
             f"steady-state prescore rate too low: {worker.prescored}/"
             f"{total} = {rate:.2f}"
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_distinct_hosts_matches_sequential():
+    """distinct_hosts jobs prescore (the kernel's collision carry IS
+    the proposed-allocs-per-node count for single-TG jobs) and match
+    the sequential scheduler bit for bit — including a scale-up where
+    existing allocs exclude their nodes (feasible.go:470)."""
+    import copy
+
+    from nomad_tpu.structs import Constraint
+
+    nodes = make_nodes(12, seed=31)
+
+    def dh_job(count):
+        job = mock.job(id="dh-job")
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.tasks[0].resources.cpu = 200
+        job.constraints = list(job.constraints) + [
+            Constraint(operand="distinct_hosts")
+        ]
+        return job
+
+    seq = Server(num_schedulers=1, seed=41, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=41, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        for srv in (seq, bat):
+            srv.register_job(dh_job(5))
+            assert srv.drain_to_idle(20)
+        assert placements(seq, "dh-job") == placements(bat, "dh-job")
+        # all on distinct nodes
+        node_ids = [n for _, n in placements(bat, "dh-job")]
+        assert len(set(node_ids)) == 5
+
+        # scale up: existing allocs must exclude their nodes
+        for srv in (seq, bat):
+            srv.register_job(dh_job(9))
+            assert srv.drain_to_idle(20)
+        assert placements(seq, "dh-job") == placements(bat, "dh-job")
+        node_ids = [n for _, n in placements(bat, "dh-job")]
+        assert len(node_ids) == 9 and len(set(node_ids)) == 9
+        worker = bat.workers[0]
+        assert worker.prescored >= 1, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_steady_state_spread_matches_sequential():
+    """Scale-ups and reschedules of percent-target spread jobs stay on
+    the prescored path: the kernel's existing/cleared carries reproduce
+    propertySet.GetCombinedUseMap (propertyset.go) including the
+    PopulateProposed cleared-decrement quirk."""
+    import copy
+
+    from nomad_tpu.structs import Spread, SpreadTarget
+
+    rng = random.Random(51)
+    nodes = []
+    for _ in range(18):
+        node = mock.node()
+        node.datacenter = rng.choice(["dc1", "dc2", "dc3"])
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+
+    def spread_job(count, cpu=250):
+        job = mock.job(id="ss-spread")
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.tasks[0].resources.cpu = cpu
+        job.spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=70,
+                targets=[
+                    SpreadTarget(value="dc1", percent=60),
+                    SpreadTarget(value="dc2", percent=20),
+                ],
+            )
+        ]
+        return job
+
+    seq = Server(num_schedulers=1, seed=61, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=61, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        # initial placement, then a scale-up (existing allocs feed
+        # used0), then a destructive update (cpu bump -> evictions feed
+        # the cleared carry per pick)
+        node_dc = {n.id: n.datacenter for n in nodes}
+        for count, cpu in ((4, 250), (9, 250), (9, 400)):
+            for srv in (seq, bat):
+                srv.register_job(spread_job(count, cpu))
+                assert srv.drain_to_idle(25)
+            ps = placements(seq, "ss-spread")
+            pb = placements(bat, "ss-spread")
+            assert ps == pb, (
+                f"divergence at count={count} cpu={cpu}: "
+                f"seq={[(n, node_dc[i]) for n, i in ps]} "
+                f"bat={[(n, node_dc[i]) for n, i in pb]} "
+                f"prescored={bat.workers[0].prescored} "
+                f"fallbacks={bat.workers[0].fallbacks}"
+            )
+        worker = bat.workers[0]
+        assert worker.prescored >= 2, (
+            worker.prescored, worker.fallbacks, worker.errors,
         )
     finally:
         seq.stop()

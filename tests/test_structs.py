@@ -45,8 +45,17 @@ def test_score_fit_formula():
     u = util(2000, 4096)
     free_cpu = 1 - 2000 / node.node_resources.cpu
     free_mem = 1 - 4096 / node.node_resources.memory_mb
+    # the framework defines the fitness exponential at f32 precision
+    # (structs/funcs.py _pow10) so host and accelerator agree
+    # bit-for-bit; the raw-f64 reference value is matched to f32 eps
     expected = 20.0 - (10**free_cpu + 10**free_mem)
-    assert abs(score_fit_binpack(node, u) - expected) < 1e-12
+    assert abs(score_fit_binpack(node, u) - expected) < 1e-6
+    import numpy as np
+
+    exact = 20.0 - float(
+        np.float32(10.0**free_cpu) + np.float32(10.0**free_mem)
+    )
+    assert score_fit_binpack(node, u) == exact
 
 
 def test_allocs_fit_dimensions():
