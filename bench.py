@@ -49,6 +49,11 @@ from nomad_tpu.structs import (
 # this sandbox's scheduler can park a timed wait far past its timeout;
 # the broker's opt-in notify watchdog bounds the damage
 os.environ.setdefault("NOMAD_TPU_BROKER_WATCHDOG", "1")
+# block on cold kernel compiles instead of falling back: the bench
+# measures steady-state throughput, and for unlimited-walk shapes
+# (spread/affinity at 5k nodes) a sequential fallback eval costs ~25s —
+# far more than the compile it is dodging
+os.environ.setdefault("NOMAD_TPU_SYNC_COMPILE", "1")
 
 N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 N_ALLOCS = int(os.environ.get("BENCH_ALLOCS", 100_000))
@@ -380,8 +385,358 @@ def bench_kernel_only():
     return results
 
 
+# ---------------------------------------------------------------------------
+# BASELINE configs 2-5 (each through the real pipeline on both sides)
+# ---------------------------------------------------------------------------
+
+
+def _mk_server(batch_pipeline, seed=SEED_BASE, tpu_select=False):
+    from nomad_tpu.server import Server
+
+    server = Server(
+        num_schedulers=1,
+        seed=seed,
+        batch_pipeline=batch_pipeline,
+        heartbeat_ttl=1e9,
+    )
+    if tpu_select:
+        cfg = server.store.get_scheduler_config()
+        cfg.tpu_scheduler_enabled = True
+        server.store.set_scheduler_config(cfg)
+    return server
+
+
+def _run_jobs(server, jobs, drain=300.0):
+    """Register jobs, wait for drain; returns (wall, placements map)."""
+    t0 = time.time()
+    for job in jobs:
+        server.register_job(job)
+    ok = server.drain_to_idle(timeout=drain)
+    dt = time.time() - t0
+    if not ok:
+        log("  WARNING: did not drain")
+    out = {}
+    n = 0
+    for job in jobs:
+        p = job_placements(server.store, job.id)
+        out[job.id] = p
+        n += len(p)
+    return dt, out, n
+
+
+def _compare(label, build_nodes, build_jobs, n_oracle_jobs=None,
+             tpu_select=False, prefill=None):
+    """Generic config runner: same node set + job stream through an
+    oracle server and a batch-pipeline server; returns the result dict."""
+    results = {}
+    placements_by_side = {}
+    for side, batchy in (("oracle", False), ("tpu", True)):
+        server = _mk_server(batchy, tpu_select=tpu_select and batchy)
+        try:
+            for node in build_nodes():
+                server.store.upsert_node(node)
+            if prefill is not None:
+                prefill(server.store)
+            server.start()
+            if batchy:
+                server.workers[0].warm_shapes()
+            jobs = build_jobs()
+            if side == "oracle" and n_oracle_jobs:
+                jobs = jobs[:n_oracle_jobs]
+            dt, pmap, n = _run_jobs(server, jobs)
+            rate = n / dt if dt else 0.0
+            results[side] = rate
+            placements_by_side[side] = pmap
+            log(f"{label} {side}: {n} placements in {dt:.2f}s -> {rate:.1f}/s")
+        finally:
+            server.stop()
+    o_p, t_p = placements_by_side["oracle"], placements_by_side["tpu"]
+    common = [k for k in o_p if k in t_p]
+    same = sum(1 for k in common if o_p[k] == t_p[k])
+    parity_ok = same == len(common)
+    log(f"{label} parity: {same}/{len(common)}")
+    return {
+        "placements_per_sec": round(results["tpu"], 1),
+        "oracle_placements_per_sec": round(results["oracle"], 1),
+        "vs_baseline": round(results["tpu"] / results["oracle"], 2)
+        if results["oracle"] and parity_ok
+        else 0.0,
+        "parity": f"{same}/{len(common)}",
+    }
+
+
+def config2_batch():
+    """Batch scheduler: 1k queued allocs over 1k nodes (BASELINE #2)."""
+    n_nodes = int(os.environ.get("BENCH_C2_NODES", 1000))
+    n_jobs = int(os.environ.get("BENCH_C2_JOBS", 100))
+
+    def nodes():
+        rng = random.Random(11)
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"c2-node-{i:05d}")
+            n.node_resources.cpu = rng.choice([8000, 16000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def jobs():
+        out = []
+        for i in range(n_jobs):
+            job = mock.job(id=f"c2-{i}")
+            job.type = "batch"
+            job.task_groups[0].count = 10
+            job.task_groups[0].tasks[0].resources.cpu = 300
+            out.append(job)
+        return out
+
+    return _compare("config2-batch-1k/1k", nodes, jobs)
+
+
+def config3_spread_affinity():
+    """Spread + node-affinity across 3 DCs, 5k nodes (BASELINE #3).
+    The oracle walks EVERY candidate per pick here (spread/affinity
+    disable the log2 visit limit, stack.go:164) — the regime the
+    vectorized kernel is built for."""
+    from nomad_tpu.structs import Affinity, Spread, SpreadTarget
+
+    n_nodes = int(os.environ.get("BENCH_C3_NODES", 5000))
+    n_jobs = int(os.environ.get("BENCH_C3_JOBS", 48))
+    n_oracle = int(os.environ.get("BENCH_C3_ORACLE_JOBS", 4))
+
+    def nodes():
+        rng = random.Random(13)
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"c3-node-{i:05d}")
+            n.datacenter = rng.choice(["dc1", "dc2", "dc3"])
+            n.node_resources.cpu = rng.choice([8000, 16000, 32000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def jobs():
+        out = []
+        for i in range(n_jobs):
+            job = mock.job(id=f"c3-{i}")
+            job.datacenters = ["dc1", "dc2", "dc3"]
+            tg = job.task_groups[0]
+            tg.count = 6
+            tg.tasks[0].resources.cpu = 300
+            job.spreads = [
+                Spread(
+                    attribute="${node.datacenter}",
+                    weight=60,
+                    targets=[
+                        SpreadTarget(value="dc1", percent=50),
+                        SpreadTarget(value="dc2", percent=30),
+                    ],
+                )
+            ]
+            job.affinities = [
+                Affinity(
+                    ltarget="${node.datacenter}",
+                    operand="=",
+                    rtarget="dc2",
+                    weight=35,
+                )
+            ]
+            out.append(job)
+        return out
+
+    return _compare(
+        "config3-spread-affinity-5k", nodes, jobs,
+        n_oracle_jobs=n_oracle,
+    )
+
+
+def config4_system_devices_preemption():
+    """System job + GPU device constraint + preemption, 10k nodes
+    (BASELINE #4).  System evals run through the sequential worker on
+    both sides; the tpu side selects with TPUSystemStack (vectorized
+    fleet scoring) via the runtime scheduler-config toggle."""
+    from nomad_tpu.structs import PreemptionConfig
+
+    n_nodes = int(os.environ.get("BENCH_C4_NODES", 10000))
+    gpu_every = 10  # 10% of the fleet has GPUs
+
+    def nodes():
+        rng = random.Random(17)
+        out = []
+        for i in range(n_nodes):
+            if i % gpu_every == 0:
+                n = mock.nvidia_node(id=f"c4-node-{i:05d}")
+            else:
+                n = mock.node(id=f"c4-node-{i:05d}")
+            n.node_resources.cpu = rng.choice([8000, 16000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def prefill(store):
+        # low-priority filler on the GPU nodes so preemption has work
+        filler = mock.job(id="c4-filler")
+        filler.priority = 10
+        store.upsert_job(filler)
+        allocs = []
+        rng = random.Random(19)
+        for i in range(n_nodes // gpu_every):
+            node_id = f"c4-node-{i * gpu_every:05d}"
+            allocs.append(
+                Allocation(
+                    namespace="default",
+                    job_id="c4-filler",
+                    job=filler,
+                    task_group="web",
+                    name=alloc_name("c4-filler", "web", i),
+                    node_id=node_id,
+                    allocated_resources=AllocatedResources(
+                        tasks={
+                            "web": AllocatedTaskResources(
+                                cpu=rng.choice([6000, 7000]),
+                                memory_mb=8192,
+                            )
+                        },
+                        shared=AllocatedSharedResources(disk_mb=100),
+                    ),
+                    client_status="running",
+                )
+            )
+        store.upsert_allocs(allocs)
+        cfg = store.get_scheduler_config()
+        cfg.preemption_config = PreemptionConfig(
+            system_scheduler_enabled=True
+        )
+        store.set_scheduler_config(cfg)
+
+    def jobs():
+        from nomad_tpu.structs import RequestedDevice
+
+        job = mock.system_job(id="c4-system")
+        job.priority = 80
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.cpu = 4000
+        tg.tasks[0].resources.memory_mb = 4096
+        # device ask restricts the fleet to the GPU nodes and
+        # exercises the DeviceChecker mask + device assignment
+        tg.tasks[0].resources.devices = [
+            RequestedDevice(name="nvidia/gpu", count=1)
+        ]
+        return [job]
+
+    return _compare(
+        "config4-system-gpu-preempt-10k", nodes, jobs,
+        tpu_select=True, prefill=prefill,
+    )
+
+
+def config5_c2m_replay():
+    """C2M-style mixed service+batch replay at 10k nodes (BASELINE #5).
+    Container scale is set by BENCH_C5_ALLOCS (default 200k resident
+    allocs — a 10x-scaled-down C2M so the bench fits host memory; the
+    stream shape matches: mixed types, steady churn)."""
+    n_nodes = int(os.environ.get("BENCH_C5_NODES", 10000))
+    n_allocs = int(os.environ.get("BENCH_C5_ALLOCS", 200_000))
+    n_jobs = int(os.environ.get("BENCH_C5_JOBS", 192))
+    n_oracle = int(os.environ.get("BENCH_C5_ORACLE_JOBS", 24))
+
+    def nodes():
+        rng = random.Random(23)
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"c5-node-{i:05d}")
+            n.node_resources.cpu = rng.choice([16000, 32000])
+            n.node_resources.memory_mb = rng.choice([32768, 65536])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def prefill(store):
+        filler = mock.job(id="c5-filler")
+        store.upsert_job(filler)
+        rng = random.Random(29)
+        allocs = []
+        for i in range(n_allocs):
+            allocs.append(
+                Allocation(
+                    namespace="default",
+                    job_id="c5-filler",
+                    job=filler,
+                    task_group="web",
+                    name=alloc_name("c5-filler", "web", i),
+                    node_id=f"c5-node-{rng.randrange(n_nodes):05d}",
+                    allocated_resources=AllocatedResources(
+                        tasks={
+                            "web": AllocatedTaskResources(
+                                cpu=rng.choice([100, 200]),
+                                memory_mb=rng.choice([128, 256]),
+                            )
+                        },
+                        shared=AllocatedSharedResources(disk_mb=50),
+                    ),
+                    client_status="running",
+                )
+            )
+        store.upsert_allocs(allocs)
+
+    def jobs():
+        rng = random.Random(31)
+        out = []
+        for i in range(n_jobs):
+            job = mock.job(id=f"c5-{i}")
+            if i % 3 == 2:
+                job.type = "batch"
+            job.task_groups[0].count = rng.choice([5, 10, 20])
+            job.task_groups[0].tasks[0].resources.cpu = rng.choice(
+                [200, 400]
+            )
+            out.append(job)
+        return out
+
+    return _compare(
+        "config5-c2m-replay", nodes, jobs, n_oracle_jobs=n_oracle,
+    )
+
+
+def _share_classes(nodes):
+    cache = {}
+    for n in nodes:
+        key = (
+            n.node_resources.cpu,
+            n.node_resources.memory_mb,
+            n.datacenter,
+            bool(n.node_resources.devices),
+        )
+        if key not in cache:
+            cache[key] = compute_node_class(n)
+        n.computed_class = cache[key]
+
+
+WITH_CONFIGS = os.environ.get("BENCH_CONFIGS", "1") == "1"
+
+
+def bench_configs():
+    out = {}
+    for name, fn in (
+        ("config2_batch_1k", config2_batch),
+        ("config3_spread_affinity_5k", config3_spread_affinity),
+        ("config4_system_gpu_preempt_10k", config4_system_devices_preemption),
+        ("config5_c2m_replay", config5_c2m_replay),
+    ):
+        try:
+            out[name] = fn()
+        except Exception as exc:  # noqa: BLE001
+            log(f"{name} FAILED: {exc!r}")
+            out[name] = {"error": repr(exc)}
+    return out
+
+
 def main():
     oracle_rate, tpu_rate, p50, p99, same = bench_e2e()
+    configs = bench_configs() if WITH_CONFIGS else {}
     kernel = bench_kernel_only() if WITH_KERNEL else {}
 
     n_check = min(E2E_ORACLE_JOBS, E2E_JOBS)
@@ -409,9 +764,16 @@ def main():
                 "kernel_chained_placements_per_sec": round(
                     kernel.get("kernel-chained", 0.0), 1
                 ),
+                "configs": configs,
             }
         )
     )
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # hard-exit: daemon threads may sit inside XLA calls (background
+    # compiles) and CPython teardown then aborts with "FATAL: exception
+    # not rethrown"; the JSON is already out
+    os._exit(0)
 
 
 if __name__ == "__main__":

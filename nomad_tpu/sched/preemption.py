@@ -1,15 +1,22 @@
 """Preemption candidate selection (reference scheduler/preemption.go).
 
-Host-side: greedy distance-based picking with cross-alloc dependencies is
-inherently sequential (preemption.go:218-251), so it stays on the host; the
-TPU path vectorizes only the *scoring* of preemption outcomes
-(rank.go:732 PreemptionScoringIterator -> ops/score.py) and calls into
-this module once a node actually needs evictions.
+The greedy pick with cross-alloc dependencies is inherently sequential
+(preemption.go:218-251) and stays on the host, but its inner scan —
+`basicResourceDistance` + the max_parallel penalty over every remaining
+candidate, re-evaluated per pick — is pure arithmetic over a (k x 3)
+candidate resource matrix and runs vectorized
+(`preemption_distances`).  The TPU select path evaluates preemption
+only for nodes whose vectorized fit mask failed AND whose preemptible
+resource sum covers the shortfall (tpu_stack._preempt_select), so the
+per-node greedy runs on a small surviving set instead of the whole
+walk being delegated to a shadow oracle.
 """
 from __future__ import annotations
 
 import math
 from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from ..structs import (
     AllocatedResources,
@@ -49,6 +56,32 @@ def score_for_task_group(
     if max_parallel > 0 and num_preempted >= max_parallel:
         penalty = float((num_preempted + 1) - max_parallel) * MAX_PARALLEL_PENALTY
     return basic_resource_distance(ask, used) + penalty
+
+
+def preemption_distances(
+    needed: ComparableResources,
+    res_matrix: np.ndarray,  # f64[k, 3] candidate (cpu, mem, disk)
+    max_parallel: np.ndarray,  # i64[k]
+    num_preempted: np.ndarray,  # i64[k]
+) -> np.ndarray:
+    """Vectorized `score_for_task_group` over k candidates: the
+    distance arithmetic of preemption.go:608 + the max_parallel penalty
+    of preemption.go:773, one fused pass instead of a Python loop per
+    candidate per pick."""
+    coords = np.zeros_like(res_matrix)
+    ask = np.asarray(
+        [needed.cpu, needed.memory_mb, needed.disk_mb], dtype=np.float64
+    )
+    nz = ask > 0
+    coords[:, nz] = (ask[nz] - res_matrix[:, nz]) / ask[nz]
+    dist = np.sqrt(np.sum(coords * coords, axis=1))
+    over = (max_parallel > 0) & (num_preempted >= max_parallel)
+    penalty = np.where(
+        over,
+        (num_preempted + 1 - max_parallel) * MAX_PARALLEL_PENALTY,
+        0.0,
+    )
+    return dist + penalty
 
 
 class Preemptor:
@@ -128,20 +161,39 @@ class Preemptor:
 
         for _priority, allocs in groups:
             allocs = list(allocs)
-            while allocs and not met:
-                best_distance = math.inf
-                best_index = -1
-                for index, alloc in enumerate(allocs):
-                    distance = score_for_task_group(
-                        needed,
-                        self.alloc_resources[alloc.id],
-                        self.alloc_max_parallel[alloc.id],
-                        self._num_preemptions(alloc),
-                    )
-                    if distance < best_distance:
-                        best_distance = distance
-                        best_index = index
-                closest = allocs.pop(best_index)
+            # candidate resource matrix + penalty inputs, built once per
+            # priority group; the greedy loop scores every remaining
+            # candidate in one vectorized pass per pick
+            res = np.asarray(
+                [
+                    [
+                        self.alloc_resources[a.id].cpu,
+                        self.alloc_resources[a.id].memory_mb,
+                        self.alloc_resources[a.id].disk_mb,
+                    ]
+                    for a in allocs
+                ],
+                dtype=np.float64,
+            ).reshape(len(allocs), 3)
+            maxp = np.asarray(
+                [self.alloc_max_parallel[a.id] for a in allocs],
+                dtype=np.int64,
+            )
+            # current_preemptions is fixed for the duration of the
+            # greedy loop (set_preemptions is the only mutator)
+            nump = np.asarray(
+                [self._num_preemptions(a) for a in allocs],
+                dtype=np.int64,
+            )
+            alive = np.ones(len(allocs), dtype=bool)
+            while alive.any() and not met:
+                distances = preemption_distances(
+                    needed, res, maxp, nump
+                )
+                distances[~alive] = math.inf
+                best_index = int(np.argmin(distances))
+                alive[best_index] = False
+                closest = allocs[best_index]
                 closest_resources = self.alloc_resources[closest.id]
                 available.add(closest_resources)
                 met, _dim = available.superset(asked)

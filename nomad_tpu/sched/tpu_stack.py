@@ -19,10 +19,14 @@ Division of labor per SURVEY.md section 7:
   the kernel re-runs: the recheck loop the reference performs in the plan
   applier (plan_apply.go:629), pulled forward.
 
-Preemption mode (`options.preempt`) delegates to a shadow oracle stack
-sharing this eval's context and the *same* shuffled visit order — greedy
-preemption picking is inherently sequential (preemption.go:218) and rare,
-so it stays host-side, bit-identical by construction.
+Preemption mode (`options.preempt`) keeps the same vectorized walk:
+fit masks + scores for every node come from the shared vector math, and
+only nodes whose fit failed get the exact per-node preemption
+evaluation (oracle BinPackIterator with evict=True, its greedy inner
+scan vectorized in sched/preemption.py), whose exact scores — binpack
+after eviction plus the logistic net-priority term (rank.go:714) — are
+spliced into the score vector before the limited-walk emulation picks
+the winner (SURVEY section 7.1 step 5).
 
 Known divergence from the oracle (documented, intentional): when a
 computed class is memoized eligible but a transient availability check
@@ -65,6 +69,21 @@ from .stack import (
 INT32_MAX = 2**31 - 1
 LOOKAHEAD_MAX = 128  # picks pre-computed per launch
 
+import jax.numpy as jnp  # noqa: E402
+
+from ..ops.score import _limited_walk_argmax  # noqa: E402
+
+
+@jax.jit
+def _walk_only(feasible, scores, perm, limit, n_candidates):
+    """The limited-walk emulation over a host-assembled score vector
+    (preemption mode: exact per-node preemption scores are spliced in
+    host-side; the walk semantics must stay identical to the plain
+    path's kernel)."""
+    return _limited_walk_argmax(
+        feasible, scores, perm, limit, n_candidates
+    )
+
 _LA_MISS = object()  # look-ahead cache miss sentinel
 
 
@@ -104,7 +123,6 @@ class TPUGenericStack:
         self._spread_psets: Dict[str, List[PropertySet]] = {}
         self._spread_info: Dict[str, Dict] = {}
         self._sum_spread_weights = 0
-        self._shadow: Optional[GenericStack] = None
         self._extra_excluded_rows: Set[int] = set()
         # rotating pull offset: the reference StaticIterator keeps its
         # position across selects (feasible.go:75) so consecutive
@@ -145,9 +163,6 @@ class TPUGenericStack:
         self.limit = compute_visit_limit(len(nodes), self.batch)
         self._offset = 0
         self._la_rows = None
-        if self._shadow is not None:
-            self._shadow.source.set_nodes(self.shuffled_nodes)
-            self._shadow.limit.set_limit(self.limit)
 
     def set_job(self, job: Job) -> None:
         if self.job is not None and self.job.version == job.version:
@@ -160,17 +175,16 @@ class TPUGenericStack:
         self._spread_psets.clear()
         self._spread_info.clear()
         self._sum_spread_weights = 0
-        if self._shadow is not None:
-            self._shadow.set_job(job)
 
     # ------------------------------------------------------------------
 
     def select(
         self, tg: TaskGroup, options: Optional[SelectOptions] = None
     ) -> Optional[RankedNode]:
-        if options is not None and options.preempt:
-            return self._shadow_select(tg, options)
-
+        # preferred nodes (sticky ephemeral disk) compose WITH preempt
+        # mode: the oracle tries the preferred node first — with
+        # eviction when preempt is set (stack.py SetNodes + select) —
+        # so the narrowing must happen before the preempt branch
         if options is not None and options.preferred_nodes:
             original_rows = self.candidate_rows
             original_perm = self.perm
@@ -208,6 +222,9 @@ class TPUGenericStack:
             if option is not None:
                 return option
             return self.select(tg, options_new)
+
+        if options is not None and options.preempt:
+            return self._preempt_select(tg, options)
 
         self.ctx.reset()
         self._extra_excluded_rows = set()
@@ -284,27 +301,259 @@ class TPUGenericStack:
 
     # ------------------------------------------------------------------
 
-    def _shadow_select(self, tg, options):
-        """Preemption path: oracle chain over the identical visit order."""
-        if self._shadow is None:
-            self._shadow = GenericStack(self.batch, self.ctx)
-            if self.job is not None:
-                # force through the version fast-path check
-                self._shadow.job_version = None
-                self._shadow.set_job(self.job)
-            self._shadow.source.set_nodes(self.shuffled_nodes)
-            self._shadow.limit.set_limit(self.limit)
-        # shadow select must not re-shuffle: bypass its set_nodes, and
-        # keep the rotating offset in sync with the vectorized walk
-        self._shadow.source.nodes = self.shuffled_nodes
-        self._shadow.source.offset = self._offset
-        self._shadow.source.seen = 0
-        self._shadow.limit.set_limit(self.limit)
-        option = self._shadow.select(tg, options)
-        n = len(self.shuffled_nodes)
-        if n:
-            self._offset = self._shadow.source.offset % n
-        return option
+    def _preempt_select(self, tg, options):
+        """Vectorized preemption-mode select (SURVEY §7.1 step 5).
+
+        The normal-fit mask + scores come from the same vectorized
+        scoring as the plain path; only nodes whose fit FAILED and
+        whose preemptible resource sum covers the shortfall get the
+        exact per-node evaluation (oracle BinPackIterator with
+        evict=True, whose inner greedy uses the vectorized
+        `preemption_distances`).  Their exact scores — binpack after
+        eviction + the logistic net-priority term (rank.go:714) — are
+        spliced into the score vector before the same limited-walk
+        emulation picks the winner, so decisions stay bit-identical to
+        the sequential chain without delegating the walk to a shadow
+        oracle.
+
+        Known edge divergence: a node whose cpu/mem/disk fit but whose
+        ports/devices are exhausted by preemptible allocs initially
+        carries its non-evict score in the walk; the verify-retry loop
+        corrects it to the evict score only if it wins a round.  If the
+        corrected (higher) score would have beaten the winner the
+        oracle can pick it where this path does not — detecting such
+        nodes up-front would need the exact per-node evaluation for
+        every port-constrained node, which is the cost this design
+        avoids."""
+        from ..structs.funcs import net_priority as _net_priority
+        from ..structs.funcs import preemption_score
+
+        C = self.table.capacity
+        self.ctx.reset()
+        static_mask = self._static_feasibility(tg)
+        candidate_mask = np.zeros(C, dtype=bool)
+        candidate_mask[self.candidate_rows] = True
+        d_cpu, d_mem, d_disk, collisions, job_rows, job_tg_rows = (
+            self._plan_adjusted_state(tg)
+        )
+        mask = candidate_mask & static_mask & self.table.active
+        csi_mask = self._csi_feasibility(tg)
+        if csi_mask is not None:
+            mask &= csi_mask
+        # NOTE: _extra_excluded_rows (exact non-evict rejections from
+        # the preceding plain select) are deliberately NOT applied —
+        # the oracle's preempt pass re-evaluates those nodes with
+        # eviction, and so does the verify-retry loop below
+        job_distinct = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in self.job.constraints
+        )
+        tg_distinct = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in tg.constraints
+        )
+        if job_distinct:
+            mask[list(job_rows)] = False
+        elif tg_distinct:
+            mask[list(job_tg_rows)] = False
+        mask &= self._distinct_property_mask(tg)
+
+        penalty = np.zeros(C, dtype=bool)
+        if options is not None and options.penalty_node_ids:
+            for node_id in options.penalty_node_ids:
+                row = self.table.row_of.get(node_id)
+                if row is not None:
+                    penalty[row] = True
+        affinity_vec = self._affinity_vector(tg)
+        spread_vec, has_spreads = self._spread_vector(tg)
+        has_affinities = bool(
+            list(self.job.affinities)
+            or list(tg.affinities)
+            or any(t.affinities for t in tg.tasks)
+        )
+        limit = (
+            INT32_MAX if (has_affinities or has_spreads) else self.limit
+        )
+        ask_cpu = float(sum(t.resources.cpu for t in tg.tasks))
+        ask_mem = float(sum(t.resources.memory_mb for t in tg.tasks))
+        ask_disk = float(tg.ephemeral_disk.size_mb)
+
+        used_cpu = self.table.cpu_used + d_cpu
+        used_mem = self.table.mem_used + d_mem
+        used_disk = self.table.disk_used + d_disk
+        fit = (
+            (used_cpu + ask_cpu <= self.table.cpu_total)
+            & (used_mem + ask_mem <= self.table.mem_total)
+            & (used_disk + ask_disk <= self.table.disk_total)
+        )
+
+        # exact scores for normally-fitting nodes (same math as the
+        # kernel: canonical f32-rounded pow, identical append order)
+        scores = np.full(C, -np.inf)
+        feasible = mask & fit
+        preempt_options: dict = {}
+        # vector fitness for fitting nodes
+        safe_cpu = np.where(
+            self.table.cpu_total > 0, self.table.cpu_total, 1.0
+        )
+        safe_mem = np.where(
+            self.table.mem_total > 0, self.table.mem_total, 1.0
+        )
+        free_cpu = 1.0 - (used_cpu + ask_cpu) / safe_cpu
+        free_mem = 1.0 - (used_mem + ask_mem) / safe_mem
+        base = np.float32(10.0**free_cpu).astype(np.float64) + np.float32(
+            10.0**free_mem
+        ).astype(np.float64)
+        spread_fit_alg = (
+            self.ctx.state.scheduler_config().effective_scheduler_algorithm()
+            == "spread"
+        )
+        if spread_fit_alg:
+            fitness = np.clip(base - 2.0, 0.0, 18.0)
+        else:
+            fitness = np.clip(20.0 - base, 0.0, 18.0)
+
+        def combine(row, first_terms):
+            terms = list(first_terms)
+            if collisions[row] > 0:
+                terms.append(
+                    -(float(collisions[row]) + 1.0) / float(tg.count)
+                )
+            if penalty[row]:
+                terms.append(-1.0)
+            if affinity_vec[row] != 0.0:
+                terms.append(float(affinity_vec[row]))
+            if spread_vec[row] != 0.0:
+                terms.append(float(spread_vec[row]))
+            return terms
+
+        for row in np.nonzero(feasible)[0]:
+            scores[row] = float(
+                np.mean(combine(row, [fitness[row] / 18.0]))
+            )
+
+        # preemption evaluation for masked nodes that did NOT fit.
+        # Cheap shortfall pre-filter first: a node whose preemptible
+        # allocs (priority <= job.priority - delta, other jobs) cannot
+        # cover the resource shortfall can never preempt its way to
+        # feasibility — skip the exact evaluation
+        # (preemption.go:666 filterAndGroupPreemptibleAllocs criteria).
+        from ..structs import PREEMPTION_PRIORITY_DELTA
+
+        for row in np.nonzero(mask & ~fit)[0]:
+            node_id = self.table.node_ids[row]
+            short_cpu = used_cpu[row] + ask_cpu - self.table.cpu_total[row]
+            short_mem = used_mem[row] + ask_mem - self.table.mem_total[row]
+            short_disk = (
+                used_disk[row] + ask_disk - self.table.disk_total[row]
+            )
+            pre_cpu = pre_mem = pre_disk = 0.0
+            for alloc in self.ctx.proposed_allocs(node_id):
+                if alloc.job is None:
+                    continue
+                if (alloc.namespace, alloc.job_id) == (
+                    self.job.namespace, self.job.id,
+                ):
+                    continue
+                if (
+                    self.job.priority - alloc.job.priority
+                    < PREEMPTION_PRIORITY_DELTA
+                ):
+                    continue
+                r = alloc.comparable_resources()
+                pre_cpu += r.cpu
+                pre_mem += r.memory_mb
+                pre_disk += r.disk_mb
+            if (
+                pre_cpu < short_cpu
+                or pre_mem < short_mem
+                or pre_disk < short_disk
+            ):
+                continue  # provably cannot free enough
+            option = self._verify_winner(node_id, tg, evict=True)
+            if option is None or option.preempted_allocs is None:
+                continue  # no viable preemption set: stays infeasible
+            # exact score: the single-node chain's appended scores
+            # (binpack after eviction, device affinity) + the shared
+            # soft terms + the logistic preemption term, mean-combined
+            terms = combine(row, list(option.scores))
+            netp = _net_priority(
+                [
+                    a.job.priority
+                    for a in option.preempted_allocs
+                    if a.job is not None
+                ]
+            )
+            pre_score = preemption_score(netp)
+            option.scores.append(pre_score)
+            terms.append(pre_score)
+            self.ctx.metrics.score_node(
+                option.node, "preemption", pre_score
+            )
+            scores[row] = float(np.mean(terms))
+            feasible[row] = True
+            preempt_options[row] = option
+
+        # identical limited-walk emulation as the plain path, with the
+        # plain path's poison-and-rerun loop: a fitting winner that
+        # fails exact verification (ports/devices) gets the evict=True
+        # evaluation — the oracle's binpack in preempt mode can
+        # device/port-preempt such a node — before being masked out
+        n_cand = len(self.candidate_rows)
+        cand = self.perm[:n_cand]
+        rest = self.perm[n_cand:]
+        off = self._offset % n_cand if n_cand else 0
+        rotated = np.concatenate(
+            [cand[off:], cand[:off], rest]
+        ).astype(np.int32)
+        while True:
+            chosen_row, _best, _n, pulls = jax.device_get(
+                _walk_only(
+                    jnp.asarray(feasible),
+                    jnp.asarray(scores),
+                    jnp.asarray(rotated),
+                    jnp.asarray(limit, jnp.int32),
+                    jnp.asarray(n_cand, jnp.int32),
+                )
+            )
+            chosen_row, pulls = int(chosen_row), int(pulls)
+            if chosen_row == NO_NODE:
+                if n_cand:
+                    self._offset = (self._offset + pulls) % n_cand
+                self._populate_class_eligibility(tg, static_mask)
+                return None
+            if chosen_row in preempt_options:
+                if n_cand:
+                    self._offset = (self._offset + pulls) % n_cand
+                return preempt_options[chosen_row]
+            node_id = self.table.node_ids[chosen_row]
+            option = self._verify_winner(node_id, tg)
+            if option is not None:
+                if n_cand:
+                    self._offset = (self._offset + pulls) % n_cand
+                return option
+            # exact-only dimensions failed: try with eviction
+            option = self._verify_winner(node_id, tg, evict=True)
+            if option is not None and option.preempted_allocs:
+                terms = combine(chosen_row, list(option.scores))
+                netp = _net_priority(
+                    [
+                        a.job.priority
+                        for a in option.preempted_allocs
+                        if a.job is not None
+                    ]
+                )
+                pre_score = preemption_score(netp)
+                option.scores.append(pre_score)
+                terms.append(pre_score)
+                self.ctx.metrics.score_node(
+                    option.node, "preemption", pre_score
+                )
+                scores[chosen_row] = float(np.mean(terms))
+                preempt_options[chosen_row] = option
+                continue  # re-walk with the corrected score
+            feasible[chosen_row] = False
+            scores[chosen_row] = -np.inf
 
     # ------------------------------------------------------------------
 
@@ -494,10 +743,12 @@ class TPUGenericStack:
     # ------------------------------------------------------------------
 
     def _verify_winner(
-        self, node_id: str, tg: TaskGroup
+        self, node_id: str, tg: TaskGroup, evict: bool = False
     ) -> Optional[RankedNode]:
         """Exact port/device assignment + fit for the winning node via the
-        oracle binpack step (rank.py BinPackIterator)."""
+        oracle binpack step (rank.py BinPackIterator); with evict=True
+        the chain also runs the exact preemption evaluation and attaches
+        preempted_allocs."""
         node = self.ctx.state.node_by_id(node_id)
         if node is None:
             return None
@@ -507,7 +758,7 @@ class TPUGenericStack:
             self.ctx.state.scheduler_config().effective_scheduler_algorithm()
         )
         binpack = BinPackIterator(
-            self.ctx, source, False, self.job.priority, algorithm
+            self.ctx, source, evict, self.job.priority, algorithm
         )
         binpack.set_job(self.job)
         binpack.set_task_group(tg)

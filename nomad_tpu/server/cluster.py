@@ -366,8 +366,7 @@ class ClusterServer(Server):
         # failure (serf Leave vs. a detected member-failed)
         self.gossip.leave()
         self.revoke_leadership()
-        for timer in self._heartbeat_timers.values():
-            timer.cancel()
+        self._heartbeat_deadlines.clear()
         self.log_monitor.uninstall("nomad_tpu")
 
 

@@ -61,8 +61,11 @@ class EvalBroker:
         self._enabled = False
 
         self._ready: Dict[str, _ReadyQueue] = {}
-        # eval id -> (eval, token, deadline, timer)
-        self._unack: Dict[str, Tuple[Evaluation, str, threading.Timer]] = {}
+        # eval id -> (eval, token, monotonic redelivery deadline).
+        # ONE sweeper thread redelivers expired deliveries — a
+        # threading.Timer per dequeue is an OS thread per in-flight
+        # eval, which under load is thousands of short-lived threads
+        self._unack: Dict[str, Tuple[Evaluation, str, float]] = {}
         # (namespace, job_id) -> outstanding eval id
         self._job_evals: Dict[Tuple[str, str], str] = {}
         # (namespace, job_id) -> heap of waiting evals (priority desc,
@@ -96,41 +99,53 @@ class EvalBroker:
             if not enabled:
                 self.flush()
             self._lock.notify_all()
-            if (
-                enabled
-                and self._ticker is None
-                and os.environ.get("NOMAD_TPU_BROKER_WATCHDOG") == "1"
-            ):
-                # opt-in watchdog: timed Condition waits have been
-                # observed to park far past their timeout under some
-                # sandboxed schedulers (a 5ms wait sleeping 10s+ with
-                # the GIL free, no lock holder, and no clock step).  A
-                # periodic notify_all wakes any such waiter, bounding
-                # the damage of one anomalous timed wait.  Off by
-                # default — production brokers should not pay 20 Hz
-                # wakeups for a host pathology they don't have.
+            if enabled and self._ticker is None:
+                # the redelivery sweeper: expires unacked deliveries
+                # past their nack deadline and promotes delayed evals.
+                # With NOMAD_TPU_BROKER_WATCHDOG=1 it also notify_all()s
+                # every tick — a workaround for sandboxed schedulers
+                # that park timed Condition waits far past their
+                # timeout (a 5ms wait observed sleeping 10s+ with the
+                # GIL free, no lock holder, and no clock step).
                 self._ticker = threading.Thread(
-                    target=self._tick, name="broker-ticker", daemon=True
+                    target=self._tick, name="broker-sweeper", daemon=True
                 )
                 self._ticker.start()
 
     def _tick(self) -> None:
+        import os
+
+        watchdog = os.environ.get("NOMAD_TPU_BROKER_WATCHDOG") == "1"
         while True:
             time.sleep(0.05)
+            expired: List[Tuple[str, str]] = []
             with self._lock:
                 self.ticks += 1
                 if not self._enabled and not self._unack:
                     self._ticker = None
                     return
-                self._lock.notify_all()
+                now = time.monotonic()
+                expired = [
+                    (eval_id, token)
+                    for eval_id, (_ev, token, deadline) in (
+                        self._unack.items()
+                    )
+                    if deadline <= now
+                ]
+                self._promote_delayed_locked()
+                if watchdog:
+                    self._lock.notify_all()
+            for eval_id, token in expired:
+                try:
+                    self.nack(eval_id, token)
+                except ValueError:
+                    pass  # acked/nacked concurrently
 
     @property
     def enabled(self) -> bool:
         return self._enabled
 
     def flush(self) -> None:
-        for _, _, timer in self._unack.values():
-            timer.cancel()
         self._ready.clear()
         self._unack.clear()
         self._job_evals.clear()
@@ -196,12 +211,9 @@ class EvalBroker:
                 ev = self._pop_ready_locked(schedulers)
                 if ev is not None:
                     token = new_id()
-                    timer = threading.Timer(
-                        self.nack_timeout, self._nack_expired, [ev.id, token]
+                    self._unack[ev.id] = (
+                        ev, token, time.monotonic() + self.nack_timeout,
                     )
-                    timer.daemon = True
-                    timer.start()
-                    self._unack[ev.id] = (ev, token, timer)
                     self.stats["total_unacked"] += 1
                     self.events.append((time.monotonic(), "deq", ev.id[:6], token[:6]))
                     return ev, token
@@ -245,8 +257,7 @@ class EvalBroker:
             entry = self._unack.get(eval_id)
             if entry is None or entry[1] != token:
                 raise ValueError(f"token mismatch for eval {eval_id}")
-            ev, _, timer = entry
-            timer.cancel()
+            ev, _, _deadline = entry
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
             self.events.append((time.monotonic(), "ack", eval_id[:6], ""))
@@ -268,8 +279,7 @@ class EvalBroker:
             entry = self._unack.get(eval_id)
             if entry is None or entry[1] != token:
                 raise ValueError(f"token mismatch for eval {eval_id}")
-            ev, _, timer = entry
-            timer.cancel()
+            ev, _, _deadline = entry
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
             self.events.append((time.monotonic(), "nack", eval_id[:6], ""))
@@ -284,12 +294,6 @@ class EvalBroker:
             else:
                 self._enqueue_locked(ev, ev.type)
             self._lock.notify_all()
-
-    def _nack_expired(self, eval_id: str, token: str) -> None:
-        try:
-            self.nack(eval_id, token)
-        except ValueError:
-            pass
 
     # ------------------------------------------------------------------
 

@@ -151,7 +151,14 @@ class Server:
             lambda _table, index: self.timetable.witness(index)
         )
         self.heartbeat_ttl = heartbeat_ttl
-        self._heartbeat_timers: Dict[str, threading.Timer] = {}
+        # node id -> monotonic expiry deadline.  ONE sweeper thread
+        # serves every TTL — a threading.Timer per node is an OS thread
+        # per node, which at 10k nodes means 10k live threads (the
+        # reference's per-node timers are Go runtime timers, not
+        # threads; the Python translation must not be thread-per-node)
+        self._heartbeat_deadlines: Dict[str, float] = {}
+        self._heartbeat_sweeper: Optional[threading.Thread] = None
+        self._sweeper_lock = threading.Lock()
         self._running = False
         self._leader_established = False
         self._leader_lock = threading.Lock()
@@ -166,8 +173,7 @@ class Server:
     def stop(self) -> None:
         self._running = False
         self.revoke_leadership()
-        for timer in self._heartbeat_timers.values():
-            timer.cancel()
+        self._heartbeat_deadlines.clear()
         # detach the monitor handler or stopped servers pile up on the
         # shared logger and keep buffering every record
         self.log_monitor.uninstall("nomad_tpu")
@@ -215,9 +221,7 @@ class Server:
             for worker in self.workers:
                 worker.stop()
             self.applier.stop()
-            for timer in self._heartbeat_timers.values():
-                timer.cancel()
-            self._heartbeat_timers.clear()
+            self._heartbeat_deadlines.clear()
             self.plan_queue.set_enabled(False)
             self.blocked.set_enabled(False)
             self.broker.set_enabled(False)
@@ -475,9 +479,7 @@ class Server:
         node = self.store.node_by_id(node_id)
         if node is None:
             raise KeyError(node_id)
-        timer = self._heartbeat_timers.pop(node_id, None)
-        if timer is not None:
-            timer.cancel()
+        self._heartbeat_deadlines.pop(node_id, None)
         # delete first so the fanned-out evals schedule against a
         # state where the node is already gone
         self.store.delete_node(node_id)
@@ -617,19 +619,46 @@ class Server:
         self._reset_heartbeat(node_id)
 
     def _reset_heartbeat(self, node_id: str) -> None:
-        timer = self._heartbeat_timers.pop(node_id, None)
-        if timer is not None:
-            timer.cancel()
-        # TTL timers are a leader-only service (reference heartbeat.go
-        # runs on the leader; followers forward Node.UpdateStatus)
+        # TTL deadlines are a leader-only service (reference
+        # heartbeat.go runs on the leader; followers forward
+        # Node.UpdateStatus)
         if not (self._running and self._leader_established):
+            self._heartbeat_deadlines.pop(node_id, None)
             return
-        timer = threading.Timer(
-            self.heartbeat_ttl, self._heartbeat_expired, [node_id]
+        self._heartbeat_deadlines[node_id] = (
+            time.monotonic() + self.heartbeat_ttl
         )
-        timer.daemon = True
-        timer.start()
-        self._heartbeat_timers[node_id] = timer
+        with self._sweeper_lock:
+            if self._heartbeat_sweeper is None or not (
+                self._heartbeat_sweeper.is_alive()
+            ):
+                self._heartbeat_sweeper = threading.Thread(
+                    target=self._sweep_heartbeats,
+                    name="heartbeat-sweeper",
+                    daemon=True,
+                )
+                self._heartbeat_sweeper.start()
+
+    def _sweep_heartbeats(self) -> None:
+        while self._running:
+            interval = max(
+                0.02, min(0.5, self.heartbeat_ttl / 5.0)
+            )
+            time.sleep(interval)
+            if not self._leader_established:
+                continue
+            now = time.monotonic()
+            expired = [
+                node_id
+                for node_id, deadline in list(
+                    self._heartbeat_deadlines.items()
+                )
+                if deadline <= now
+            ]
+            for node_id in expired:
+                if self._heartbeat_deadlines.pop(node_id, None) is None:
+                    continue
+                self._heartbeat_expired(node_id)
 
     def _heartbeat_expired(self, node_id: str) -> None:
         """Missed TTL: node goes down, evals fan out

@@ -75,8 +75,8 @@ def test_write_via_follower_forwards_to_leader(cluster):
     node = mock.node()
     follower.register_node(node)
     follower.heartbeat(node.id)
-    assert node.id in leader._heartbeat_timers
-    assert node.id not in follower._heartbeat_timers
+    assert node.id in leader._heartbeat_deadlines
+    assert node.id not in follower._heartbeat_deadlines
 
 
 def test_leader_failover_keeps_scheduling(cluster):

@@ -298,3 +298,78 @@ def test_preemption_parity(harness):
     )
     assert o_pre == t_pre
     assert o_pre  # something actually got preempted
+
+
+def test_preemption_parity_mixed_fleet(harness):
+    """Vectorized preemption select (SURVEY 7.1 step 5): a fleet mixing
+    free nodes, preemptible nodes (several priority tiers), and
+    hopeless nodes (high-priority occupants the shortfall filter must
+    skip) — winners AND preemption sets must match the oracle chain
+    bit for bit."""
+    import random as _random
+
+    rng = _random.Random(3)
+    nodes = []
+    for i in range(12):
+        n = mock.node()
+        n.node_resources.cpu = 2000
+        n.node_resources.memory_mb = 2048
+        n.computed_class = compute_node_class(n)
+        nodes.append(n)
+        harness.store.upsert_node(n)
+
+    # fill 9 of 12 nodes with occupants at different priorities:
+    # pri 20 (preemptible), pri 75 (not preemptible vs pri-80 job)
+    for tier, (pri, count) in enumerate(((20, 5), (75, 4))):
+        occ = mock.job(id=f"occ-{tier}")
+        occ.priority = pri
+        occ.task_groups[0].count = count
+        occ.task_groups[0].tasks[0].resources.cpu = 1500
+        occ.task_groups[0].tasks[0].resources.memory_mb = 1600
+        harness.store.upsert_job(occ)
+        ev0 = mock.evaluation(job_id=occ.id, priority=pri)
+        harness.process(ServiceScheduler, ev0, use_tpu=False, seed=tier)
+
+    harness.store.set_scheduler_config(
+        SchedulerConfiguration(
+            preemption_config=PreemptionConfig(
+                service_scheduler_enabled=True
+            )
+        )
+    )
+    high = mock.job(id="high")
+    high.priority = 80
+    high.task_groups[0].count = 6
+    high.task_groups[0].tasks[0].resources.cpu = 1200
+    high.task_groups[0].tasks[0].resources.memory_mb = 1000
+    harness.store.upsert_job(high)
+    ev = mock.evaluation(job_id=high.id, priority=80)
+
+    harness.reject_plan = True
+    harness.process(ServiceScheduler, ev, use_tpu=False, seed=9)
+    oracle_plan = harness.plans[-1]
+    o_place = sorted(
+        (a.name, a.node_id)
+        for v in oracle_plan.node_allocation.values()
+        for a in v
+    )
+    o_pre = sorted(
+        a.id
+        for v in oracle_plan.node_preemptions.values()
+        for a in v
+    )
+    harness.process(ServiceScheduler, ev, use_tpu=True, seed=9)
+    tpu_plan = harness.plans[-1]
+    t_place = sorted(
+        (a.name, a.node_id)
+        for v in tpu_plan.node_allocation.values()
+        for a in v
+    )
+    t_pre = sorted(
+        a.id
+        for v in tpu_plan.node_preemptions.values()
+        for a in v
+    )
+    assert o_place == t_place
+    assert o_pre == t_pre
+    assert o_pre, "scenario must actually exercise preemption"
