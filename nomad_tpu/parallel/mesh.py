@@ -11,13 +11,15 @@ equivalents are two mesh axes:
   chips, the honest analog of sequence/context parallelism for a cluster
   scheduler (SURVEY.md section 5 "long-context").
 
-Scoring is embarrassingly parallel along ``nodes``; the only cross-shard
+Scoring is embarrassingly parallel along ``nodes``: the only cross-shard
 communication is an all-gather of the per-node score/feasibility vectors
-(f32 + bool per node — tens of KB at 10k nodes, ICI-cheap) before the
-selection walk, which every device then computes identically (replicated,
-deterministic).  This keeps the walk bit-identical to the single-chip
-path while the O(N x terms) scoring work and the node-column residency
-scale with the mesh.
+(f64 + bool per node — tens of KB at 10k nodes, ICI-cheap) plus O(devices)
+walk carries.  In the production chained planner (sharded_chained_plan)
+the selection walk itself is ALSO sharded along the permuted axis —
+local cumsums with an exchanged per-shard carry (parallel scan), pmin/
+pmax winner reductions — so per-device FLOPs genuinely scale ~1/devices
+(asserted via compiled cost analysis in tests/test_parallel.py) while
+decisions stay bit-identical to the single-chip kernel.
 """
 from __future__ import annotations
 
@@ -132,6 +134,309 @@ def sharded_score_and_select(mesh: Mesh, spread_fit: bool = False):
         return _limited_walk_argmax(
             feasible, final, inp.perm, inp.limit, inp.n_candidates
         )
+
+    return _run
+
+
+def _sharded_walk(final_full, feas_full, perm, off, lim, nc,
+                  shard, n_dev, shard_size):
+    """The rotating limited-walk selection with the O(C) math sharded
+    along the PERM axis: each device walks its contiguous slice of the
+    permuted ordering; global prefix counts come from a local cumsum
+    plus an exchanged per-shard carry (classic parallel scan), and the
+    winner/pulls reductions exchange only O(devices) scalars.  Decisions
+    are bit-identical to ops/batch._walk."""
+    from ..ops.score import MAX_SKIP, NO_NODE, SKIP_THRESHOLD
+
+    big = jnp.asarray(2**31 - 1, jnp.int32)
+    lo = shard * shard_size
+    pos_l = lo + jnp.arange(shard_size, dtype=jnp.int32)
+    perm_l = jax.lax.dynamic_slice_in_dim(perm, lo, shard_size)
+    s_l = final_full[perm_l]
+    f_l = feas_full[perm_l]
+    is_tail = pos_l >= nc
+    in_wrap = pos_l < off
+    wp_l = jnp.where(
+        is_tail, pos_l, jnp.mod(pos_l - off + nc, nc)
+    )
+
+    off_shard = (off - 1) // shard_size
+    off_local = jnp.mod(off - 1, shard_size)
+
+    def rot(b_l):
+        local_cs = jnp.cumsum(b_l.astype(jnp.int32))
+        sums = jax.lax.all_gather(local_cs[-1], "nodes")  # (D,)
+        carry = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(sums)[:-1]]
+        )[shard]
+        cs_l = local_cs + carry
+        total = jnp.sum(sums)
+        own = shard == off_shard
+        c_off_val = jax.lax.psum(
+            jnp.where(own, jnp.take(cs_l, off_local), 0), "nodes"
+        )
+        c_off = jnp.where(off > 0, c_off_val, 0)
+        pre = jnp.where(in_wrap, cs_l + (total - c_off), cs_l - c_off)
+        return jnp.where(is_tail, total, pre), total
+
+    bad = f_l & (s_l <= SKIP_THRESHOLD)
+    bad_rank, _ = rot(bad)
+    diverted = bad & (bad_rank <= MAX_SKIP)
+    nd = f_l & ~diverted
+    nd_incl, nd_count = rot(nd)
+    div_incl, n_div = rot(diverted)
+    div_rank = div_incl - 1
+    div_order = jnp.where(n_div == 2, 1 - div_rank, div_rank)
+    emit_order = jnp.where(nd, nd_incl - 1, nd_count + div_order)
+    emitted = f_l & (emit_order < lim)
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype=s_l.dtype)
+    masked = jnp.where(emitted, s_l, neg_inf)
+    best = jax.lax.pmax(jnp.max(masked), "nodes")
+    candidates = emitted & (masked == best)
+    order_key = jnp.where(candidates, emit_order, big)
+    local_win = jnp.argmin(order_key)
+    local_key = jnp.take(order_key, local_win)
+    gmin = jax.lax.pmin(local_key, "nodes")
+    win_pos = jax.lax.pmin(
+        jnp.where(
+            local_key == gmin,
+            (lo + local_win).astype(jnp.int32),
+            big,
+        ),
+        "nodes",
+    )
+    any_emitted = jax.lax.pmax(jnp.any(emitted), "nodes")
+
+    limit_reached = nd_count >= lim
+    lth_wp = jax.lax.pmin(
+        jnp.min(jnp.where(nd & (nd_incl == lim), wp_l, big)),
+        "nodes",
+    )
+    pulls = jnp.where(limit_reached, lth_wp + 1, nc)
+    row = jnp.where(any_emitted, perm[win_pos], NO_NODE)
+    return row, any_emitted, pulls
+
+
+def sharded_chained_plan(mesh: Mesh, n_picks: int,
+                         spread_fit: bool = False):
+    """The production chained planner with REAL node-axis sharding:
+    every per-pick quantity that is O(nodes) — fit masks, fitness,
+    anti-affinity, penalties, usage scatter — is computed on the
+    device's own node shard (O(C/devices) FLOPs per device), and only
+    the per-pick score/feasibility vectors are all-gathered over ICI
+    for the replicated limited-walk selection (f64+bool per node, tens
+    of KB at 10k nodes).  Serially equivalent across evals exactly like
+    `chained_plan_picks_cols`: the sharded usage columns carry forward
+    through the eval scan.
+
+    Scope: the non-spread production shapes (spread batches use the
+    single-device variant).  Decisions are bit-identical to the
+    unsharded kernel — the walk consumes the same score vector in the
+    same order.
+
+    Returns ``run(cpu_total, mem_total, disk_total, used0_cpu,
+    used0_mem, used0_disk, feasible[E,C], perm[E,C], asks..., wanted,
+    limits, n_candidates, coll0[E,C], deltas, pre) -> rows[E,P]``.
+    """
+    from ..ops.batch import PreDeltas, StepDeltas
+    from ..ops.score import NO_NODE
+
+    n_dev = mesh.devices.size
+    col = P("nodes")
+
+    in_specs = (
+        col, col, col,            # totals
+        col, col, col,            # used0
+        P(None, "nodes"),         # feasible [E, C]
+        P(),                      # perm [E, C] replicated (global ids)
+        P(), P(), P(),            # asks [E]
+        P(),                      # desired_count [E]
+        P(),                      # limit [E]
+        P(),                      # wanted [E]
+        P(),                      # n_candidates [E]
+        P(),                      # distinct_hosts [E]
+        P(None, "nodes"),         # coll0 [E, C]
+        P(None, "nodes"),         # affinity [E, C]
+        StepDeltas(               # leading axis E, row-space
+            evict_rows=P(), evict_cpu=P(), evict_mem=P(),
+            evict_disk=P(), evict_coll=P(), penalty_rows=P(),
+        ),
+        PreDeltas(rows=P(), cpu=P(), mem=P(), disk=P()),
+    )
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=P(),
+    )
+    def _run(
+        cpu_total, mem_total, disk_total,
+        used0_cpu, used0_mem, used0_disk,
+        feasible_all, perm_all,
+        ask_cpu, ask_mem, ask_disk,
+        desired_count, limits, wanted, n_candidates,
+        distinct_hosts, coll0_all, affinity_all, deltas, pre,
+    ):
+        shard = jax.lax.axis_index("nodes")
+        shard_size = cpu_total.shape[0]
+        lo = shard * shard_size
+
+        safe_cpu = jnp.where(cpu_total > 0, cpu_total, 1.0)
+        safe_mem = jnp.where(mem_total > 0, mem_total, 1.0)
+        dtype = cpu_total.dtype
+
+        def local_scatter(colv, row, delta, pred):
+            idx = row - lo
+            ok = pred & (idx >= 0) & (idx < shard_size)
+            safe = jnp.clip(idx, 0, shard_size - 1)
+            return colv.at[safe].add(
+                jnp.where(ok, delta, jnp.zeros_like(delta))
+            )
+
+        def eval_step(used, xs):
+            (feas_l, perm, a_cpu, a_mem, a_disk, desired, lim, w,
+             nc, dh, coll_l, aff_l, d, p) = xs
+            cpu_u, mem_u, disk_u = used
+            # pre-placement deltas (row space, applied to local shard)
+            def apply_pre(colv, vals):
+                out = colv
+                # R is small; scan-free loop unrolled by XLA
+                def body(i, acc):
+                    return local_scatter(
+                        acc, p.rows[i], vals[i].astype(acc.dtype),
+                        jnp.asarray(True),
+                    )
+                return jax.lax.fori_loop(
+                    0, p.rows.shape[0], body, out
+                )
+            cpu_u = apply_pre(cpu_u, p.cpu)
+            mem_u = apply_pre(mem_u, p.mem)
+            disk_u = apply_pre(disk_u, p.disk)
+
+            def pick_step(carry, k):
+                cpu_c, mem_c, disk_c, coll_c, pen_c, off, dead = carry
+                active = (k < w) & ~dead
+                erow = d.evict_rows[k]
+                app = active & (erow >= 0)
+                cpu_c = local_scatter(
+                    cpu_c, erow, d.evict_cpu[k].astype(dtype), app
+                )
+                mem_c = local_scatter(
+                    mem_c, erow, d.evict_mem[k].astype(dtype), app
+                )
+                disk_c = local_scatter(
+                    disk_c, erow, d.evict_disk[k].astype(dtype), app
+                )
+                coll_c = local_scatter(
+                    coll_c, erow, d.evict_coll[k], app
+                )
+                prow = d.penalty_rows[k]  # (K,) global rows
+                local_rows = lo + jnp.arange(shard_size)
+                pen_now = pen_c | jnp.any(
+                    local_rows[:, None] == prow[None, :], axis=1
+                )
+                # local scoring (O(C/devices))
+                cpu_after = cpu_c + a_cpu
+                mem_after = mem_c + a_mem
+                disk_after = disk_c + a_disk
+                fit = (
+                    (cpu_after <= cpu_total)
+                    & (mem_after <= mem_total)
+                    & (disk_after <= disk_total)
+                )
+                # distinct_hosts via the collision carry, as in the
+                # unsharded kernel
+                feas = feas_l & fit & ~(dh & (coll_c > 0))
+                free_cpu = 1.0 - cpu_after / safe_cpu
+                free_mem = 1.0 - mem_after / safe_mem
+                base = (
+                    jnp.power(jnp.asarray(10.0, dtype), free_cpu)
+                    .astype(jnp.float32).astype(dtype)
+                    + jnp.power(jnp.asarray(10.0, dtype), free_mem)
+                    .astype(jnp.float32).astype(dtype)
+                )
+                if spread_fit:
+                    fitness = jnp.clip(base - 2.0, 0.0, 18.0)
+                else:
+                    fitness = jnp.clip(20.0 - base, 0.0, 18.0)
+                score_sum = fitness / 18.0
+                count = jnp.ones_like(score_sum)
+                has_coll = coll_c > 0
+                anti = jnp.where(
+                    has_coll,
+                    -(coll_c.astype(dtype) + 1.0)
+                    / desired.astype(dtype),
+                    0.0,
+                )
+                score_sum = score_sum + anti
+                count = count + has_coll.astype(dtype)
+                score_sum = score_sum - pen_now.astype(dtype)
+                count = count + pen_now.astype(dtype)
+                has_aff = aff_l != 0.0
+                score_sum = score_sum + jnp.where(has_aff, aff_l, 0.0)
+                count = count + has_aff.astype(dtype)
+                final_l = score_sum / count
+
+                # the ONLY cross-shard traffic: the per-node score +
+                # feasibility vectors (for the permuted re-slice) and
+                # O(devices) walk carries
+                final = jax.lax.all_gather(
+                    final_l, "nodes", axis=0, tiled=True
+                )
+                feas_full = jax.lax.all_gather(
+                    feas, "nodes", axis=0, tiled=True
+                )
+                win_row, any_emitted, pulls = _sharded_walk(
+                    final, feas_full, perm, off, lim, nc,
+                    shard, n_dev, shard_size,
+                )
+                ok = active & any_emitted
+                dead = dead | (active & ~any_emitted)
+                row = jnp.where(ok, win_row, NO_NODE)
+                cpu_c = local_scatter(
+                    cpu_c, row, jnp.asarray(a_cpu, dtype), ok
+                )
+                mem_c = local_scatter(
+                    mem_c, row, jnp.asarray(a_mem, dtype), ok
+                )
+                disk_c = local_scatter(
+                    disk_c, row, jnp.asarray(a_disk, dtype), ok
+                )
+                coll_c = local_scatter(
+                    coll_c, row, jnp.asarray(1, jnp.int32), ok
+                )
+                off = jnp.mod(
+                    off + jnp.where(active, pulls, 0), nc
+                )
+                return (
+                    cpu_c, mem_c, disk_c, coll_c, pen_c, off, dead
+                ), row
+
+            carry0 = (
+                cpu_u, mem_u, disk_u, coll_l,
+                jnp.zeros(shard_size, dtype=bool),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(False),
+            )
+            (cpu_f, mem_f, disk_f, _c, _p, _o, _d), rows = (
+                jax.lax.scan(
+                    pick_step, carry0,
+                    jnp.arange(n_picks, dtype=jnp.int32),
+                )
+            )
+            return (cpu_f, mem_f, disk_f), rows
+
+        used0 = (used0_cpu, used0_mem, used0_disk)
+        _final, rows = jax.lax.scan(
+            eval_step,
+            used0,
+            (
+                feasible_all, perm_all, ask_cpu, ask_mem, ask_disk,
+                desired_count, limits, wanted, n_candidates,
+                distinct_hosts, coll0_all, affinity_all, deltas, pre,
+            ),
+        )
+        return rows
 
     return _run
 

@@ -392,7 +392,9 @@ class TPUGenericStack:
         scores = np.full(C, -np.inf)
         feasible = mask & fit
         preempt_options: dict = {}
-        # vector fitness for fitting nodes
+        # vector fitness for fitting nodes (canonical f32-rounded pow)
+        from ..structs.funcs import pow10_np
+
         safe_cpu = np.where(
             self.table.cpu_total > 0, self.table.cpu_total, 1.0
         )
@@ -401,9 +403,7 @@ class TPUGenericStack:
         )
         free_cpu = 1.0 - (used_cpu + ask_cpu) / safe_cpu
         free_mem = 1.0 - (used_mem + ask_mem) / safe_mem
-        base = np.float32(10.0**free_cpu).astype(np.float64) + np.float32(
-            10.0**free_mem
-        ).astype(np.float64)
+        base = pow10_np(free_cpu) + pow10_np(free_mem)
         spread_fit_alg = (
             self.ctx.state.scheduler_config().effective_scheduler_algorithm()
             == "spread"
@@ -427,10 +427,31 @@ class TPUGenericStack:
                 terms.append(float(spread_vec[row]))
             return terms
 
-        for row in np.nonzero(feasible)[0]:
-            scores[row] = float(
-                np.mean(combine(row, [fitness[row] / 18.0]))
-            )
+        # vectorized mean-combine for fitting nodes (same term order
+        # and append conditions as the kernel: ops/batch.py step)
+        has_coll = collisions > 0
+        anti_v = np.where(
+            has_coll,
+            -(collisions.astype(np.float64) + 1.0) / float(tg.count),
+            0.0,
+        )
+        has_aff = affinity_vec != 0.0
+        has_spread = spread_vec != 0.0
+        sum_v = (
+            fitness / 18.0
+            + anti_v
+            - penalty.astype(np.float64)
+            + np.where(has_aff, affinity_vec, 0.0)
+            + np.where(has_spread, spread_vec, 0.0)
+        )
+        count_v = (
+            1.0
+            + has_coll.astype(np.float64)
+            + penalty.astype(np.float64)
+            + has_aff.astype(np.float64)
+            + has_spread.astype(np.float64)
+        )
+        scores[feasible] = (sum_v / count_v)[feasible]
 
         # preemption evaluation for masked nodes that did NOT fit.
         # Cheap shortfall pre-filter first: a node whose preemptible

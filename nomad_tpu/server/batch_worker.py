@@ -229,6 +229,27 @@ class BatchWorker(Worker):
         self._compiling: set = set()
         self._compile_failed: set = set()
         self._compile_lock = threading.Lock()
+        # node-axis device mesh: with NOMAD_TPU_MESH=1 and >1 device
+        # the prescore launches shard the node columns so per-device
+        # FLOPs scale ~1/devices (parallel/mesh.py
+        # sharded_chained_plan)
+        import os as _os
+
+        self._mesh = None
+        self._sharded_runners: Dict[tuple, object] = {}
+        # opt-in: virtual CPU meshes make every launch slower (the
+        # sharding tests cover parity); real multi-chip TPU deployments
+        # set NOMAD_TPU_MESH=1
+        if _os.environ.get("NOMAD_TPU_MESH") == "1":
+            try:
+                import jax as _jax
+
+                if len(_jax.devices()) > 1:
+                    from ..parallel.mesh import make_mesh
+
+                    self._mesh = make_mesh(eval_axis=1)
+            except Exception:  # noqa: BLE001 — mesh is an optimization
+                self._mesh = None
         # stage timings (seconds, cumulative) — surfaced through
         # /v1/metrics so a production operator can see where batch time
         # goes and whether the fast path is actually being taken
@@ -238,6 +259,19 @@ class BatchWorker(Worker):
             "replay": 0.0,
             "sequential": 0.0,
         }
+
+    def _sharded_runner(self, n_picks: int, spread_fit: bool):
+        key = (n_picks, spread_fit)
+        runner = self._sharded_runners.get(key)
+        if runner is None:
+            from ..parallel.mesh import sharded_chained_plan
+
+            runner = sharded_chained_plan(
+                self._mesh, n_picks, spread_fit
+            )
+            runner.__name__ = f"sharded_chained_{n_picks}_{spread_fit}"
+            self._sharded_runners[key] = runner
+        return runner
 
     def _observe(self, stage: str, dt: float) -> None:
         self.timings[stage] += dt
@@ -1060,13 +1094,53 @@ class BatchWorker(Worker):
             deltas=deltas,
             pre=pre,
         )
-        if not self._launch_ready(args, kwargs):
+        use_mesh = (
+            self._mesh is not None
+            and spread_stack is None
+            and C % self._mesh.devices.size == 0
+        )
+        if use_mesh:
+            runner = self._sharded_runner(int(P), spread_fit)
+            sh_args = (
+                table.cpu_total,
+                table.mem_total,
+                table.disk_total,
+                table.cpu_used,
+                table.mem_used,
+                table.disk_used,
+                stacked.feasible,
+                stacked.perm,
+                stacked.ask_cpu,
+                stacked.ask_mem,
+                stacked.ask_disk,
+                stacked.desired_count,
+                stacked.limit,
+                wanted,
+                np.asarray(n_cands, np.int32),
+                stacked.distinct_hosts,
+                coll0
+                if coll0 is not None
+                else np.zeros((E, C), np.int32),
+                affinity
+                if affinity is not None
+                else np.zeros((E, C)),
+                deltas,
+                pre,
+            )
+            if not self._launch_ready(sh_args, {}, fn=runner):
+                self._count("cold_shape_fallbacks")
+                return {}
+            rows_out = np.asarray(runner(*sh_args))
+        elif not self._launch_ready(args, kwargs):
             # first sighting of this launch shape: an XLA compile takes
             # seconds and must not stall the scheduling pipeline —
             # compile in the background, schedule these evals exactly
             self._count("cold_shape_fallbacks")
             return {}
-        rows_out = np.asarray(chained_plan_picks_cols(*args, **kwargs))
+        else:
+            rows_out = np.asarray(
+                chained_plan_picks_cols(*args, **kwargs)
+            )
         out: Dict[str, List[int]] = {}
         for k, (ev, _token, _job, _tg) in enumerate(prescorable):
             out[ev.id] = [
@@ -1086,7 +1160,7 @@ class BatchWorker(Worker):
             for l in leaves
         )
 
-    def _launch_ready(self, args, kwargs) -> bool:
+    def _launch_ready(self, args, kwargs, fn=None) -> bool:
         """Whether this launch shape has a compiled executable.  A new
         shape kicks off a background compile and returns False — the
         caller falls back to the exact sequential path until the
@@ -1099,7 +1173,11 @@ class BatchWorker(Worker):
 
         if os.environ.get("NOMAD_TPU_SYNC_COMPILE") == "1":
             return True
-        sig = self._launch_signature(args, kwargs)
+        if fn is None:
+            fn = chained_plan_picks_cols
+        sig = (getattr(fn, "__name__", str(fn)),) + (
+            self._launch_signature(args, kwargs)
+        )
         with self._compile_lock:
             if sig in self._compiled:
                 return True
@@ -1113,7 +1191,7 @@ class BatchWorker(Worker):
         def compile_in_background():
             ok = True
             try:
-                np.asarray(chained_plan_picks_cols(*args, **kwargs))
+                np.asarray(fn(*args, **kwargs))
             except Exception:  # noqa: BLE001
                 ok = False
                 LOG.exception("background kernel compile failed")

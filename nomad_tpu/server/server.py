@@ -656,8 +656,10 @@ class Server:
                 if deadline <= now
             ]
             for node_id in expired:
-                if self._heartbeat_deadlines.pop(node_id, None) is None:
-                    continue
+                current = self._heartbeat_deadlines.get(node_id)
+                if current is None or current > now:
+                    continue  # heartbeated (refreshed) since the scan
+                self._heartbeat_deadlines.pop(node_id, None)
                 self._heartbeat_expired(node_id)
 
     def _heartbeat_expired(self, node_id: str) -> None:

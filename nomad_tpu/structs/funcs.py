@@ -121,6 +121,13 @@ def _pow10(x: float) -> float:
     return float(np.float32(math.pow(10.0, x)))
 
 
+def pow10_np(x: "np.ndarray") -> "np.ndarray":
+    """Vectorized canonical 10^x (same f32 rounding as _pow10) for
+    numpy score paths that must stay bit-identical to the scalar host
+    and jnp kernel implementations."""
+    return np.float32(np.power(10.0, x)).astype(np.float64)
+
+
 def score_fit_binpack(node: Node, util: ComparableResources) -> float:
     """Bin-packing fitness in [0, 18]: ``20 - (10^freeCpu + 10^freeRam)``
     ("BestFit v3"; reference funcs.go:175 ScoreFitBinPack)."""

@@ -151,3 +151,234 @@ def test_sharded_batch_matches_single_device():
     run = sharded_batch_plan(mesh, n_candidates=200, n_picks=P_)
     rows2 = np.asarray(run(cpu_total, mem_total, disk_total, batch))
     assert (rows1 == rows2).all()
+
+
+def test_sharded_chained_plan_matches_unsharded():
+    """sharded_chained_plan (node-axis sharded production launch) must
+    produce bit-identical rows to chained_plan_picks_cols for the same
+    inputs, including steady-state deltas, pre-placement rows,
+    distinct_hosts, affinities and failure coalescing."""
+    import numpy as np
+
+    from nomad_tpu.ops.batch import (
+        ChainInputs,
+        PreDeltas,
+        StepDeltas,
+        chained_plan_picks_cols,
+    )
+    from nomad_tpu.parallel import make_mesh
+    from nomad_tpu.parallel.mesh import sharded_chained_plan
+
+    rng = np.random.default_rng(17)
+    C, E, P, K, R = 128, 4, 8, 4, 2
+    cpu_total = rng.choice([4000.0, 8000.0], C)
+    mem_total = rng.choice([8192.0, 16384.0], C)
+    disk_total = np.full(C, 100_000.0)
+    used_cpu = rng.integers(0, 2000, C).astype(np.float64)
+    used_mem = rng.integers(0, 4096, C).astype(np.float64)
+    used_disk = np.zeros(C)
+
+    n_cand = 120
+    feasible = np.zeros((E, C), dtype=bool)
+    perms = np.zeros((E, C), np.int32)
+    for e in range(E):
+        feasible[e, :n_cand] = rng.random(n_cand) > 0.1
+        perms[e] = np.concatenate(
+            [rng.permutation(n_cand), np.arange(n_cand, C)]
+        )
+    coll0 = (rng.random((E, C)) > 0.9).astype(np.int32)
+    affinity = np.where(rng.random((E, C)) > 0.8, 0.35, 0.0)
+    deltas = StepDeltas(
+        evict_rows=np.where(
+            rng.random((E, P)) > 0.7,
+            rng.integers(0, n_cand, (E, P)),
+            -1,
+        ).astype(np.int32),
+        evict_cpu=np.full((E, P), -500.0),
+        evict_mem=np.full((E, P), -256.0),
+        evict_disk=np.zeros((E, P)),
+        evict_coll=np.zeros((E, P), np.int32),
+        penalty_rows=np.where(
+            rng.random((E, P, K)) > 0.8,
+            rng.integers(0, n_cand, (E, P, K)),
+            -1,
+        ).astype(np.int32),
+    )
+    pre = PreDeltas(
+        rows=rng.integers(0, n_cand, (E, R)).astype(np.int32),
+        cpu=np.full((E, R), -100.0),
+        mem=np.full((E, R), -128.0),
+        disk=np.zeros((E, R)),
+    )
+    asks = (
+        np.full(E, 500.0),
+        np.full(E, 256.0),
+        np.full(E, 300.0),
+    )
+    desired = np.full(E, 5, np.int32)
+    limits = np.full(E, 7, np.int32)
+    wanted = np.asarray([5, 3, 5, 0], np.int32)
+    ncands = np.full(E, n_cand, np.int32)
+    dh = np.asarray([False, True, False, False])
+
+    stacked = ChainInputs(
+        feasible=feasible,
+        perm=perms,
+        ask_cpu=asks[0],
+        ask_mem=asks[1],
+        ask_disk=asks[2],
+        desired_count=desired,
+        limit=limits,
+        distinct_hosts=dh,
+    )
+    ref = np.asarray(
+        chained_plan_picks_cols(
+            cpu_total, mem_total, disk_total,
+            used_cpu, used_mem, used_disk,
+            stacked, ncands, P,
+            wanted=wanted, coll0=coll0, affinity=affinity,
+            deltas=deltas, pre=pre,
+        )
+    )
+    mesh = make_mesh(8, eval_axis=1)
+    run = sharded_chained_plan(mesh, P)
+    got = np.asarray(
+        run(
+            cpu_total, mem_total, disk_total,
+            used_cpu, used_mem, used_disk,
+            feasible, perms, *asks, desired, limits, wanted,
+            ncands, dh, coll0, affinity, deltas, pre,
+        )
+    )
+    assert np.array_equal(ref, got), (ref, got)
+
+
+def test_sharded_chained_plan_flops_scale_with_devices():
+    """Per-device FLOPs of the sharded launch must scale ~1/devices
+    (the VERDICT r2 item 6 acceptance: scoring work is node-sharded,
+    only the walk over the gathered score vector is replicated)."""
+    import numpy as np
+
+    from nomad_tpu.ops.batch import PreDeltas, StepDeltas
+    from nomad_tpu.parallel import make_mesh
+    from nomad_tpu.parallel.mesh import sharded_chained_plan
+
+    C, E, P, K, R = 1024, 2, 4, 2, 1
+    n_cand = C - 8
+
+    def build_args():
+        rng = np.random.default_rng(3)
+        perms = np.stack(
+            [
+                np.concatenate(
+                    [rng.permutation(n_cand), np.arange(n_cand, C)]
+                )
+                for _ in range(E)
+            ]
+        ).astype(np.int32)
+        feas = np.ones((E, C), dtype=bool)
+        return (
+            np.full(C, 8000.0), np.full(C, 16384.0),
+            np.full(C, 100_000.0),
+            np.zeros(C), np.zeros(C), np.zeros(C),
+            feas, perms,
+            np.full(E, 500.0), np.full(E, 256.0), np.full(E, 300.0),
+            np.full(E, P, np.int32), np.full(E, 9, np.int32),
+            np.full(E, P, np.int32), np.full(E, n_cand, np.int32),
+            np.zeros(E, dtype=bool),
+            np.zeros((E, C), np.int32), np.zeros((E, C)),
+            StepDeltas(
+                evict_rows=np.full((E, P), -1, np.int32),
+                evict_cpu=np.zeros((E, P)),
+                evict_mem=np.zeros((E, P)),
+                evict_disk=np.zeros((E, P)),
+                evict_coll=np.zeros((E, P), np.int32),
+                penalty_rows=np.full((E, P, K), -1, np.int32),
+            ),
+            PreDeltas(
+                rows=np.zeros((E, R), np.int32),
+                cpu=np.zeros((E, R)), mem=np.zeros((E, R)),
+                disk=np.zeros((E, R)),
+            ),
+        )
+
+    def flops(n_dev):
+        mesh = make_mesh(n_dev, eval_axis=1)
+        run = sharded_chained_plan(mesh, P)
+        # run.__wrapped__ is the jitted fn; lower+compile for analysis
+        lowered = run.lower(*build_args())
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+
+    f1 = flops(1)
+    f8 = flops(8)
+    # cost_analysis reports per-device flops for SPMD programs; the
+    # node-sharded scoring should shrink ~8x, with the replicated walk
+    # keeping a floor — require at least 3x
+    assert f8 > 0 and f1 > 0
+    assert f1 / f8 >= 3.0, f"flops did not scale: f1={f1} f8={f8}"
+
+
+def test_batch_worker_sharded_prescore_matches_sequential(monkeypatch):
+    """With NOMAD_TPU_MESH=1 the BatchWorker shards its chained
+    prescore launches over the 8-device node mesh; placements must stay
+    bit-identical to the sequential scheduler."""
+    import copy
+    import random as _random
+
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs import compute_node_class
+
+    monkeypatch.setenv("NOMAD_TPU_MESH", "1")
+
+    rng = _random.Random(71)
+    nodes = []
+    for _ in range(24):
+        node = mock.node()
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    jobs = []
+    for i in range(6):
+        job = mock.job(id=f"mesh-{i}")
+        job.task_groups[0].count = rng.randint(1, 5)
+        job.task_groups[0].tasks[0].resources.cpu = rng.choice(
+            [200, 500]
+        )
+        jobs.append(job)
+
+    seq = Server(num_schedulers=1, seed=83, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=83, batch_pipeline=True)
+    assert bat.workers[0]._mesh is not None
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(20)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(60)
+
+        def placements(server, job_id):
+            return sorted(
+                (a.name, a.node_id)
+                for a in server.store.allocs_by_job("default", job_id)
+                if not a.terminal_status()
+            )
+
+        for job in jobs:
+            assert placements(seq, job.id) == placements(
+                bat, job.id
+            ), f"mesh divergence for {job.id}"
+        assert bat.workers[0].prescored > 0
+    finally:
+        seq.stop()
+        bat.stop()
