@@ -762,6 +762,27 @@ class StateStore:
                     d.status = upd.status
                     d.status_description = upd.status_description
                     d.modify_index = self._index + 1
+            # record canary placements on the deployment state so later
+            # reconcile passes (watcher evals, re-registers) recognize
+            # them instead of double-placing canaries / stopping old
+            # allocs (reference state_store.go updateDeploymentWithAlloc
+            # appending to DeploymentState.PlacedCanaries)
+            for allocs in result.node_allocation.values():
+                for alloc in allocs:
+                    if not (
+                        alloc.deployment_id
+                        and alloc.deployment_status is not None
+                        and alloc.deployment_status.canary
+                    ):
+                        continue
+                    d = self.deployments.get(alloc.deployment_id)
+                    if d is None:
+                        continue
+                    ds = d.task_groups.get(alloc.task_group)
+                    if ds is not None and (
+                        alloc.id not in ds.placed_canaries
+                    ):
+                        ds.placed_canaries.append(alloc.id)
             index = self._bump("allocs", "deployments")
             self._notify_alloc_watchers(updates)
             return index
