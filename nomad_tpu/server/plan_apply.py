@@ -1,18 +1,30 @@
-"""Serialized plan applier (reference nomad/plan_apply.go).
+"""Pipelined plan applier (reference nomad/plan_apply.go).
 
-A single thread pops plans from the queue, re-verifies every touched node
-against current state (evaluateNodePlan:629 re-runs AllocsFit), commits
-the feasible subset (partial commits set a refresh index so the submitting
-worker retries on fresh state), and applies results through the store's
-plan-results write path.  The reference pipelines verification of plan
-N+1 against an optimistic snapshot while plan N's raft apply is in flight
-(plan_apply.go:45-70); with an in-process store the apply is a dict write,
-so the pipeline bubble the reference hides does not exist here — the
-applier stays strictly serial, preserving the correctness contract.
+Plans dequeue in priority order, every touched node is re-verified
+against current state (evaluateNodePlan:629 re-runs AllocsFit), and the
+feasible subset commits through the store's plan-results write path
+(partial commits set a refresh index so the submitting worker retries on
+fresh state).  Two reference mechanisms are reproduced:
+
+* **Pipelining** (plan_apply.go:45-70): a verifier thread checks plan
+  N+1 against an *optimistic* view — base state plus the results of
+  plans that are verified but whose (possibly raft-replicated) apply is
+  still in flight — while a second thread commits plan N.  Commits stay
+  strictly ordered; only verification overlaps the apply latency, which
+  matters exactly when the store is a raft facade with real replication
+  RTTs (server/cluster.py).  If an apply fails, the overlay epoch bumps
+  and any staged result is re-verified against real state before it may
+  commit, so optimism never leaks into the log.
+* **EvaluatePool** (plan_apply_pool.go:18): per-node verification fans
+  out across a thread pool (size cores/2) when a plan touches enough
+  nodes to pay for the dispatch.
 """
 from __future__ import annotations
 
+import os
+import queue as _queue
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from ..state.store import StateStore
@@ -24,6 +36,112 @@ from ..structs import (
     PlanResult,
     allocs_fit,
 )
+
+
+def _csi_requests(store, alloc: Allocation):
+    """(request, (namespace, source)) pairs for an alloc's CSI volume
+    requests — the one shared lookup walk behind both optimistic and
+    commit-time claim verification."""
+    job = alloc.job or store.job_by_id(alloc.namespace, alloc.job_id)
+    tg = job.lookup_task_group(alloc.task_group) if job else None
+    for req in tg.volumes.values() if tg else ():
+        if req.type == "csi":
+            yield req, (alloc.namespace, req.source)
+
+
+def _claim_verdict(vol, alloc: Allocation, read_only: bool) -> str:
+    """'held' if the alloc already claims the volume, 'free' if a new
+    claim would fit, 'full' otherwise.  Single source of truth for the
+    claim rules both verification passes apply."""
+    if vol is None:
+        return "full"
+    if alloc.id in vol.read_claims or alloc.id in vol.write_claims:
+        return "held"
+    return "free" if vol.claimable(read_only) else "full"
+
+
+class OptimisticState:
+    """Base store + verified-but-uncommitted PlanResults, the view the
+    verifier uses while earlier applies are in flight (reference
+    plan_apply.go:45-70 — the leader's optimistic snapshot carries plan
+    N's results while plan N's raft future is outstanding).
+
+    Every overlay is applied idempotently by alloc id, so a result that
+    commits mid-verification (and thus shows up in both the base store
+    and the overlay) is counted once.
+    """
+
+    def __init__(self, store: StateStore, results: List[PlanResult]) -> None:
+        self._store = store
+        self._results = results
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        by_id = {a.id: a for a in self._store.allocs_by_node(node_id)}
+        for result in self._results:
+            for alloc in result.node_update.get(node_id, ()):
+                by_id[alloc.id] = alloc
+            for alloc in result.node_preemptions.get(node_id, ()):
+                by_id[alloc.id] = alloc
+            for alloc in result.node_allocation.get(node_id, ()):
+                by_id[alloc.id] = alloc
+        return list(by_id.values())
+
+    def csi_volume_by_id(self, namespace: str, volume_id: str):
+        vol = self._store.csi_volume_by_id(namespace, volume_id)
+        if vol is None or not self._results:
+            return vol
+        import copy
+
+        vol = copy.deepcopy(vol)
+        for result in self._results:
+            for node_allocs in result.node_allocation.values():
+                for alloc in node_allocs:
+                    for req, key in _csi_requests(self._store, alloc):
+                        if key != (namespace, volume_id):
+                            continue
+                        if _claim_verdict(
+                            vol, alloc, req.read_only
+                        ) == "free":
+                            vol.claim(
+                                alloc.id, alloc.node_id, req.read_only
+                            )
+        return vol
+
+
+class EvaluatePool:
+    """Per-node plan verification fan-out (reference
+    plan_apply_pool.go:18 EvaluatePool, sized cores/2)."""
+
+    # below this many nodes the dispatch overhead beats the win
+    MIN_FANOUT = 4
+
+    def __init__(self, workers: Optional[int] = None) -> None:
+        self.workers = workers or max(1, (os.cpu_count() or 2) // 2)
+        self.closed = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.workers, thread_name_prefix="plan-eval"
+        )
+
+    def evaluate_nodes(
+        self, store, plan: Plan, node_ids: List[str]
+    ) -> Dict[str, Tuple[bool, str]]:
+        if len(node_ids) < self.MIN_FANOUT:
+            return {
+                nid: evaluate_node_plan(store, plan, nid)
+                for nid in node_ids
+            }
+        futures = {
+            nid: self._pool.submit(evaluate_node_plan, store, plan, nid)
+            for nid in node_ids
+        }
+        return {nid: fut.result() for nid, fut in futures.items()}
+
+    def shutdown(self) -> None:
+        self.closed = True
+        self._pool.shutdown(wait=False)
 
 
 def evaluate_node_plan(
@@ -62,10 +180,12 @@ def evaluate_node_plan(
 
 
 def evaluate_plan(
-    store: StateStore, plan: Plan
+    store: StateStore, plan: Plan, pool: Optional[EvaluatePool] = None
 ) -> Tuple[PlanResult, bool]:
     """Verify the plan per node; returns (result, fully_committed)
-    (reference plan_apply.go:400 evaluatePlan)."""
+    (reference plan_apply.go:400 evaluatePlan).  With a pool, per-node
+    checks fan out concurrently (plan_apply.go:437
+    evaluatePlanPlacements + EvaluatePool)."""
     result = PlanResult(
         node_update={},
         node_allocation={},
@@ -78,9 +198,16 @@ def evaluate_plan(
         | set(plan.node_allocation)
         | set(plan.node_preemptions)
     )
+    verdicts: Optional[Dict[str, Tuple[bool, str]]] = None
+    if pool is not None and not plan.all_at_once:
+        verdicts = pool.evaluate_nodes(store, plan, sorted(node_ids))
     partial = False
     for node_id in sorted(node_ids):
-        fit, _reason = evaluate_node_plan(store, plan, node_id)
+        fit, _reason = (
+            verdicts[node_id]
+            if verdicts is not None
+            else evaluate_node_plan(store, plan, node_id)
+        )
         if fit:
             if plan.node_update.get(node_id):
                 result.node_update[node_id] = plan.node_update[node_id]
@@ -126,36 +253,21 @@ def _verify_csi_claims(store: StateStore, result: PlanResult) -> bool:
     for node_id in sorted(result.node_allocation):
         kept = []
         for alloc in result.node_allocation[node_id]:
-            job = alloc.job or store.job_by_id(
-                alloc.namespace, alloc.job_id
-            )
-            tg = job.lookup_task_group(alloc.task_group) if job else None
-            reqs = [
-                r
-                for r in (tg.volumes.values() if tg else ())
-                if r.type == "csi"
-            ]
             fits = True
             claimed = []
-            for req in reqs:
-                key = (alloc.namespace, req.source)
+            for req, key in _csi_requests(store, alloc):
                 vol = sim.get(key)
                 if vol is None:
                     vol = store.csi_volume_by_id(*key)
                     if vol is not None:
                         vol = copy.deepcopy(vol)
                         sim[key] = vol
-                if vol is None:
+                verdict = _claim_verdict(vol, alloc, req.read_only)
+                if verdict == "full":
                     fits = False
                     break
-                if alloc.id in vol.read_claims or (
-                    alloc.id in vol.write_claims
-                ):
-                    continue
-                if not vol.claimable(req.read_only):
-                    fits = False
-                    break
-                claimed.append((vol, req.read_only))
+                if verdict == "free":
+                    claimed.append((vol, req.read_only))
             if fits:
                 for vol, read_only in claimed:
                     vol.claim(alloc.id, alloc.node_id, read_only)
@@ -171,48 +283,198 @@ def _verify_csi_claims(store: StateStore, result: PlanResult) -> bool:
 
 
 class PlanApplier:
-    """The single apply thread + capacity-change fanout to blocked
-    evals."""
+    """Verifier + committer pipeline with capacity-change fanout to
+    blocked evals.  Commits are strictly serialized and ordered; the
+    verifier runs one (or two, counting the staged slot) plans ahead
+    against an `OptimisticState` overlay."""
 
     def __init__(
-        self, store: StateStore, plan_queue, blocked=None, metrics=None
+        self,
+        store: StateStore,
+        plan_queue,
+        blocked=None,
+        metrics=None,
+        pool: Optional[EvaluatePool] = None,
     ) -> None:
         self.store = store
         self.plan_queue = plan_queue
         self.blocked = blocked
         self.metrics = metrics
+        self.pool = pool if pool is not None else EvaluatePool()
+        # _stop and _staged are REPLACED on every start(): a committer
+        # from a previous leadership term that outlived stop()'s join
+        # timeout (e.g. blocked >2s in a raft apply) keeps its own
+        # generation's event+queue and can never race the new threads
+        # for staged plans or observe the cleared stop flag
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._verify_thread: Optional[threading.Thread] = None
+        self._commit_thread: Optional[threading.Thread] = None
+        # staged slot between verify and commit: depth 1 keeps at most
+        # two optimistic results outstanding (one staged, one verifying)
+        self._staged: _queue.Queue = _queue.Queue(maxsize=1)
+        self._lock = threading.Lock()
+        self._inflight: List[PlanResult] = []
+        self._epoch = 0  # bumped when an apply fails
         self.applied = 0
+        self.overlap_verifies = 0  # verifications that ran on an overlay
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="plan-applier", daemon=True
+        # re-entrant after stop() (leadership can be re-established,
+        # reference leader.go:222): fresh stop event + staged queue per
+        # generation, fresh pool, no stale staged results
+        self._flush_staged()
+        self._stop = threading.Event()
+        self._staged = _queue.Queue(maxsize=1)
+        if self.pool.closed:
+            self.pool = EvaluatePool(self.pool.workers)
+        with self._lock:
+            self._inflight = []
+        self._verify_thread = threading.Thread(
+            target=self._verify_loop,
+            args=(self._stop, self._staged),
+            name="plan-verifier",
+            daemon=True,
         )
-        self._thread.start()
+        self._commit_thread = threading.Thread(
+            target=self._commit_loop,
+            args=(self._stop, self._staged),
+            name="plan-applier",
+            daemon=True,
+        )
+        self._verify_thread.start()
+        self._commit_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        for t in (self._verify_thread, self._commit_thread):
+            if t is not None:
+                t.join(timeout=2.0)
+        self._flush_staged()
+        self.pool.shutdown()
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
+    def _flush_staged(self) -> None:
+        while True:
+            try:
+                pending, _r, _f, _e = self._staged.get_nowait()
+                pending.respond(None, RuntimeError("plan queue flushed"))
+            except _queue.Empty:
+                return
+
+    # ------------------------------------------------------------------
+    # stage 1: verification (overlapped with stage-2 commits)
+    # ------------------------------------------------------------------
+
+    def _verify_loop(self, stop: threading.Event,
+                     staged_q: _queue.Queue) -> None:
+        while not stop.is_set():
             pending = self.plan_queue.dequeue(timeout=0.1)
             if pending is None:
                 continue
+            import time as _time
+
+            start = _time.monotonic()
+            with self._lock:
+                overlay = list(self._inflight)
+                epoch = self._epoch
+            state = (
+                OptimisticState(self.store, overlay)
+                if overlay
+                else self.store
+            )
             try:
-                result = self.apply(pending.plan)
-                pending.respond(result, None)
+                result, full = evaluate_plan(state, pending.plan, self.pool)
             except Exception as exc:  # noqa: BLE001
                 pending.respond(None, exc)
+                continue
+            if overlay:
+                self.overlap_verifies += 1
+                if self.metrics is not None:
+                    self.metrics.incr("plan.overlap_verify")
+            if self.metrics is not None:
+                # (reference plan_apply.go:401 plan.evaluate timing)
+                self.metrics.add_sample(
+                    "plan.evaluate", (_time.monotonic() - start) * 1000.0
+                )
+            with self._lock:
+                self._inflight.append(result)
+            # blocks while the committer still holds an earlier plan:
+            # that wait IS the pipeline bubble the overlap hides
+            staged = False
+            while not stop.is_set():
+                try:
+                    staged_q.put(
+                        (pending, result, full, epoch), timeout=0.1
+                    )
+                    staged = True
+                    break
+                except _queue.Full:
+                    continue
+            if not staged:
+                # shutdown raced the hand-off: fail fast like every
+                # other flush path instead of leaving the submitter
+                # to hit its wait timeout
+                with self._lock:
+                    self._remove_inflight_locked(result)
+                pending.respond(
+                    None, RuntimeError("plan queue flushed")
+                )
 
-    def apply(self, plan: Plan) -> PlanResult:
+    # ------------------------------------------------------------------
+    # stage 2: ordered commit
+    # ------------------------------------------------------------------
+
+    def _commit_loop(self, stop: threading.Event,
+                     staged_q: _queue.Queue) -> None:
+        while not stop.is_set():
+            try:
+                pending, result, full, epoch = staged_q.get(
+                    timeout=0.1
+                )
+            except _queue.Empty:
+                continue
+            try:
+                with self._lock:
+                    stale = epoch != self._epoch
+                if stale:
+                    # an earlier apply failed after this plan was
+                    # verified optimistically: re-verify on real state
+                    result2, full = evaluate_plan(
+                        self.store, pending.plan, self.pool
+                    )
+                    with self._lock:
+                        for i, r in enumerate(self._inflight):
+                            if r is result:
+                                self._inflight[i] = result2
+                                break
+                        # the re-verification may have changed this
+                        # result's effect, so verifications that used
+                        # the old one are invalid too: bump the epoch
+                        # so they also re-verify before committing
+                        self._epoch += 1
+                    result = result2
+                self._commit(pending.plan, result, full)
+                with self._lock:
+                    self._remove_inflight_locked(result)
+                pending.respond(result, None)
+            except Exception as exc:  # noqa: BLE001
+                # bump + remove under ONE lock acquisition, so the
+                # verifier can never snapshot the new epoch together
+                # with an overlay still containing the failed result
+                with self._lock:
+                    self._epoch += 1
+                    self._remove_inflight_locked(result)
+                pending.respond(None, exc)
+
+    def _remove_inflight_locked(self, result: PlanResult) -> None:
+        for i, r in enumerate(self._inflight):
+            if r is result:
+                del self._inflight[i]
+                break
+
+    def _commit(self, plan: Plan, result: PlanResult, full: bool) -> None:
         import time as _time
 
         start = _time.monotonic()
-        result, _full = evaluate_plan(self.store, plan)
         if (
             result.node_update
             or result.node_allocation
@@ -230,8 +492,14 @@ class PlanApplier:
                 "plan.apply", (_time.monotonic() - start) * 1000.0
             )
             self.metrics.incr("plan.applied")
-            if not _full:
+            if not full:
                 self.metrics.incr("plan.partial_commit")
+
+    def apply(self, plan: Plan) -> PlanResult:
+        """Synchronous verify+commit (test/tooling path; production
+        traffic flows through the two pipeline threads)."""
+        result, full = evaluate_plan(self.store, plan, self.pool)
+        self._commit(plan, result, full)
         return result
 
     def _notify_capacity_change(self, result: PlanResult, index: int) -> None:

@@ -185,6 +185,163 @@ def test_evaluate_plan_stops_always_fit():
 
 
 # ---------------------------------------------------------------------------
+# plan applier pipelining (reference plan_apply.go:45-70 + EvaluatePool)
+# ---------------------------------------------------------------------------
+
+
+class _SlowStore:
+    """Store facade with injected apply/read latency, standing in for a
+    raft-replicated store (server/cluster.py) whose plan commits pay a
+    replication round trip."""
+
+    def __init__(self, store, apply_latency=0.0, read_latency=0.0,
+                 fail_applies=0):
+        self._store = store
+        self.apply_latency = apply_latency
+        self.read_latency = read_latency
+        self.fail_applies = fail_applies
+        self.applies = 0
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def allocs_by_node(self, node_id):
+        if self.read_latency:
+            time.sleep(self.read_latency)
+        return self._store.allocs_by_node(node_id)
+
+    def upsert_plan_results(self, result, eval_id=""):
+        if self.apply_latency:
+            time.sleep(self.apply_latency)
+        self.applies += 1
+        if self.applies <= self.fail_applies:
+            raise RuntimeError("injected apply failure")
+        return self._store.upsert_plan_results(result, eval_id)
+
+
+def _pipelined_applier(slow):
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+
+    pq = PlanQueue()
+    pq.set_enabled(True)
+    applier = PlanApplier(slow, pq)
+    applier.start()
+    return pq, applier
+
+
+def test_plan_apply_pipelines_verification_with_apply_latency():
+    """With apply latency L and verify latency V, the pipelined applier
+    overlaps plan N+1's verification with plan N's apply: total wall
+    time approaches V + K*L instead of the serial K*(V+L)."""
+    store = StateStore()
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        store.upsert_node(n)
+    V, L, K = 0.15, 0.25, 4
+    slow = _SlowStore(store, apply_latency=L, read_latency=V)
+    pq, applier = _pipelined_applier(slow)
+    try:
+        pendings = [
+            pq.enqueue(
+                Plan(node_allocation={n.id: [mock.alloc(node_id=n.id)]})
+            )
+            for n in nodes
+        ]
+        t0 = time.monotonic()
+        results = [p.wait(timeout=10) for p in pendings]
+        elapsed = time.monotonic() - t0
+    finally:
+        applier.stop()
+    assert all(r.node_allocation for r in results)
+    # verification of later plans ran while earlier applies were in
+    # flight (the overlay path)
+    assert applier.overlap_verifies >= 2
+    # serial floor is K*(V+L) = 1.6s; pipelined ~ V + K*L = 1.15s
+    assert elapsed < K * (V + L) - 0.2, elapsed
+
+
+def test_plan_apply_overlap_sees_inflight_placements():
+    """Optimistic verification must count verified-but-uncommitted
+    placements: two plans racing for one slot commit exactly one."""
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(n)
+    big1, big2 = mock.alloc(node_id=n.id), mock.alloc(node_id=n.id)
+    big1.allocated_resources = _resources(3000, 6000)
+    big2.allocated_resources = _resources(3000, 6000)
+    slow = _SlowStore(store, apply_latency=0.2)
+    pq, applier = _pipelined_applier(slow)
+    try:
+        p1 = pq.enqueue(Plan(node_allocation={n.id: [big1]}))
+        p2 = pq.enqueue(Plan(node_allocation={n.id: [big2]}))
+        r1 = p1.wait(timeout=5)
+        r2 = p2.wait(timeout=5)
+    finally:
+        applier.stop()
+    assert r1.node_allocation
+    assert not r2.node_allocation
+    assert r2.refresh_index > 0
+    live = [
+        a for a in store.allocs_by_node(n.id) if not a.terminal_status()
+    ]
+    assert len(live) == 1
+
+
+def test_plan_apply_failure_invalidates_optimistic_verifications():
+    """If plan N's apply fails after plan N+1 was verified against its
+    overlay, N+1 re-verifies on real state before committing — the slot
+    N would have taken is genuinely free again."""
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(n)
+    big1, big2 = mock.alloc(node_id=n.id), mock.alloc(node_id=n.id)
+    big1.allocated_resources = _resources(3000, 6000)
+    big2.allocated_resources = _resources(3000, 6000)
+    slow = _SlowStore(store, apply_latency=0.2, fail_applies=1)
+    pq, applier = _pipelined_applier(slow)
+    try:
+        p1 = pq.enqueue(Plan(node_allocation={n.id: [big1]}))
+        p2 = pq.enqueue(Plan(node_allocation={n.id: [big2]}))
+        with pytest.raises(RuntimeError):
+            p1.wait(timeout=5)
+        r2 = p2.wait(timeout=5)
+    finally:
+        applier.stop()
+    assert r2.node_allocation, "plan 2 must win the freed slot"
+    live = [
+        a for a in store.allocs_by_node(n.id) if not a.terminal_status()
+    ]
+    assert [a.id for a in live] == [big2.id]
+
+
+def test_evaluate_pool_matches_serial():
+    from nomad_tpu.server.plan_apply import EvaluatePool
+
+    store = StateStore()
+    nodes = [mock.node() for _ in range(10)]
+    for n in nodes:
+        store.upsert_node(n)
+    # fill half the nodes so the pool must reject those placements
+    for n in nodes[::2]:
+        filler = mock.alloc(node_id=n.id)
+        filler.allocated_resources = _resources(3900, 7900)
+        store.upsert_allocs([filler])
+    plan = Plan(
+        node_allocation={
+            n.id: [mock.alloc(node_id=n.id)] for n in nodes
+        }
+    )
+    pool = EvaluatePool(workers=4)
+    serial, full_s = evaluate_plan(store, plan)
+    pooled, full_p = evaluate_plan(store, plan, pool)
+    pool.shutdown()
+    assert full_s == full_p
+    assert set(serial.node_allocation) == set(pooled.node_allocation)
+    assert serial.node_allocation == pooled.node_allocation
+
+
+# ---------------------------------------------------------------------------
 # full server loop
 # ---------------------------------------------------------------------------
 
