@@ -202,11 +202,45 @@ class RawExecDriver(DriverPlugin):
         return True
 
 
+class _ExecutorTaskHandle(DriverHandle):
+    """Handle for a task owned by a separate executor process."""
+
+    def __init__(self, task_id: str, client, pid: int) -> None:
+        super().__init__(task_id)
+        self.client = client
+        self.pid = pid
+
+
 class ExecDriver(RawExecDriver):
+    """Isolated exec driver: each task runs under its own **executor
+    process** (client/executor.py) with chroot into the task sandbox,
+    a private mount namespace, and cgroup cpu/memory limits — the
+    reference's libcontainer executor topology
+    (drivers/shared/executor/executor_linux.go; drivers/exec).  The
+    executor outlives driver restarts; reattach records let
+    `recover_task` re-adopt running tasks.  Without root the executor
+    process still runs (the reference keeps its executor for raw_exec
+    too) but chroot/cgroups degrade to no-ops; NOMAD_TPU_EXEC_ISOLATION=0
+    forces the in-process restricted-env spawn.
+    """
+
     name = "exec"
 
+    def __init__(self) -> None:
+        super().__init__()
+        self._clients: Dict[str, object] = {}
+
+    @staticmethod
+    def _use_executor() -> bool:
+        import sys
+
+        return (
+            sys.platform == "linux"
+            and os.environ.get("NOMAD_TPU_EXEC_ISOLATION", "1") != "0"
+        )
+
     def _popen(self, cfg: TaskConfig, argv) -> subprocess.Popen:
-        # restricted environment: only the task's own env plus PATH
+        # fallback path: restricted environment, in-process spawn
         cwd = cfg.task_dir or cfg.alloc_dir or None
         env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
         env.update(cfg.env or {})
@@ -217,3 +251,183 @@ class ExecDriver(RawExecDriver):
         # itself — never the agent's os.environ (which may carry
         # secrets); mirrors _popen's policy
         return {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+
+    # -- executor-backed path ------------------------------------------
+
+    def _log_spec(self, cfg: TaskConfig) -> Dict[str, object]:
+        """Log destination part of the launch spec: rotated logmon
+        pumping in the executor when a logs dir is configured, flat
+        files otherwise (mirrors _spawn's policy)."""
+        if cfg.logs_dir:
+            os.makedirs(cfg.logs_dir, exist_ok=True)
+            return {
+                "logs_dir": cfg.logs_dir,
+                "log_name": cfg.name,
+                "log_max_files": cfg.log_max_files,
+                "log_max_file_size_mb": cfg.log_max_file_size_mb,
+            }
+        if cfg.alloc_dir:
+            return {
+                "stdout_path": os.path.join(
+                    cfg.alloc_dir, f"{cfg.name}.stdout"
+                ),
+                "stderr_path": os.path.join(
+                    cfg.alloc_dir, f"{cfg.name}.stderr"
+                ),
+            }
+        return {}
+
+    def start_task(self, cfg: TaskConfig) -> DriverHandle:
+        if not self._use_executor():
+            return super().start_task(cfg)
+        from .. import executor as ex
+
+        argv = self._build_command(cfg)
+        env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+        env.update(cfg.env or {})
+        chroot = ""
+        populate = None
+        if (
+            os.geteuid() == 0
+            and cfg.task_dir
+            and cfg.config.get("chroot", True)
+        ):
+            chroot = cfg.task_dir
+            # default: read-only bind mounts of the system dirs in a
+            # private mount ns (reference exec driver's default chroot
+            # of /bin /etc /lib /lib64 /sbin /usr); a chroot_env map
+            # falls back to hardlink population
+            populate = cfg.config.get("chroot_env") or "bind"
+        res = cfg.resources
+        spec = {
+            "task_id": cfg.id,
+            "argv": argv,
+            "cwd": cfg.task_dir or cfg.alloc_dir or "",
+            "env": env,
+            "chroot": chroot,
+            "chroot_populate": populate,
+            "cpu_shares": getattr(res, "cpu", 0) if res else 0,
+            "memory_mb": getattr(res, "memory_mb", 0) if res else 0,
+            **self._log_spec(cfg),
+        }
+        client = ex.ExecutorClient.spawn()
+        try:
+            info = client.launch(spec)
+        except Exception as exc:
+            client.shutdown()
+            raise RuntimeError(f"failed to start task: {exc}") from exc
+        handle = _ExecutorTaskHandle(cfg.id, client, info["pid"])
+        self.handles[cfg.id] = handle  # type: ignore[assignment]
+        self._clients[cfg.id] = client
+        ex.save_reattach(cfg.id, client.socket_path, info["pid"])
+        self._adopt(handle)
+        return handle
+
+    def _adopt(self, handle: _ExecutorTaskHandle) -> None:
+        def waiter():
+            try:
+                raw = handle.client.wait(handle.task_id, None)
+            except (RuntimeError, OSError):
+                handle.set_exit(
+                    TaskExitResult(err="executor connection lost")
+                )
+                return
+            handle.set_exit(
+                TaskExitResult(
+                    exit_code=int(raw.get("exit_code", 0)),
+                    signal=int(raw.get("signal", 0)),
+                    oom_killed=bool(raw.get("oom_killed", False)),
+                    err=raw.get("err"),
+                )
+            )
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def stop_task(self, task_id, timeout=5.0, signal="SIGTERM"):
+        client = self._clients.get(task_id)
+        if client is None:
+            return super().stop_task(task_id, timeout, signal)
+        sig = signal if signal.startswith("SIG") else f"SIG{signal}"
+        try:
+            client.stop(task_id, timeout=timeout, sig=sig)
+        except (RuntimeError, OSError):
+            pass
+
+    def signal_task(self, task_id, signal="SIGTERM"):
+        client = self._clients.get(task_id)
+        if client is None:
+            return super().signal_task(task_id, signal)
+        name = signal if signal.startswith("SIG") else f"SIG{signal}"
+        # validate client-side so invalid signals still raise like the
+        # in-process path; only wire failures are swallowed
+        try:
+            _signal.Signals[name]
+        except KeyError:
+            raise ValueError(f"invalid signal {signal!r}")
+        try:
+            client.signal(task_id, name)
+        except (RuntimeError, OSError):
+            pass
+
+    def destroy_task(self, task_id, force=False):
+        client = self._clients.get(task_id)
+        if client is None:
+            return super().destroy_task(task_id, force)
+        from .. import executor as ex
+
+        handle = self.handles.get(task_id)
+        if handle is not None and handle.is_running() and not force:
+            raise RuntimeError("task is still running")
+        try:
+            client.destroy(task_id, force=force)
+        except (RuntimeError, OSError):
+            # the executor is unreachable; before discarding every
+            # path to the task, make sure its process tree is dead so
+            # a live task can't leak unmanaged
+            if handle is not None and handle.is_running():
+                try:
+                    os.killpg(
+                        os.getpgid(handle.pid), _signal.SIGKILL
+                    )
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+        client.shutdown()
+        ex.drop_reattach(task_id)
+        self._clients.pop(task_id, None)
+        self.handles.pop(task_id, None)
+
+    def task_stats(self, task_id):
+        client = self._clients.get(task_id)
+        if client is None:
+            return {}
+        try:
+            return client.stats(task_id)
+        except (RuntimeError, OSError):
+            return {}
+
+    def recover_task(self, task_id, handle_state):
+        if not self._use_executor():
+            return super().recover_task(task_id, handle_state)
+        from .. import executor as ex
+
+        rec = ex.load_reattach(task_id)
+        if rec is None:
+            return super().recover_task(task_id, handle_state)
+        try:
+            client = ex.ExecutorClient.reconnect(rec["socket"])
+            tasks = {t["task_id"]: t for t in client.list_tasks()}
+        except (RuntimeError, OSError):
+            ex.drop_reattach(task_id)
+            return False
+        if task_id not in tasks:
+            client.shutdown()
+            ex.drop_reattach(task_id)
+            return False
+        handle = _ExecutorTaskHandle(
+            task_id, client, tasks[task_id]["pid"]
+        )
+        self.handles[task_id] = handle  # type: ignore[assignment]
+        self._clients[task_id] = client
+        # running or already exited: wait() answers either way
+        self._adopt(handle)
+        return True
