@@ -242,7 +242,7 @@ class ExecDriver(RawExecDriver):
     def _popen(self, cfg: TaskConfig, argv) -> subprocess.Popen:
         # fallback path: restricted environment, in-process spawn
         cwd = cfg.task_dir or cfg.alloc_dir or None
-        env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+        env = self._exec_base_env()
         env.update(cfg.env or {})
         return self._spawn(cfg, argv, cwd, env)
 
@@ -283,7 +283,7 @@ class ExecDriver(RawExecDriver):
         from .. import executor as ex
 
         argv = self._build_command(cfg)
-        env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+        env = self._exec_base_env()
         env.update(cfg.env or {})
         chroot = ""
         populate = None

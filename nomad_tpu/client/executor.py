@@ -46,12 +46,31 @@ from ..wire import call, decode, encode, recv_frame, send_frame
 
 HANDSHAKE = "1|1|unix|{path}|wire"
 
-# where drivers persist reattach records (reference: client state DB's
-# driver handle blobs)
+def _default_state_dir() -> str:
+    """Reattach-record dir (reference: client state DB's driver handle
+    blobs).  Never a predictable world-writable path: root uses /run,
+    everyone else their home dir, both created 0700 and ownership-
+    checked before any record is trusted."""
+    if os.geteuid() == 0 and os.path.isdir("/run"):
+        return "/run/nomad-tpu/executors"
+    return os.path.join(
+        os.path.expanduser("~"), ".nomad_tpu", "executors"
+    )
+
+
 STATE_DIR = os.environ.get(
-    "NOMAD_TPU_EXECUTOR_STATE",
-    os.path.join(tempfile.gettempdir(), "nomad-tpu-executors"),
+    "NOMAD_TPU_EXECUTOR_STATE", _default_state_dir()
 )
+
+
+def _state_dir_trusted(path: str) -> bool:
+    """Reject a records dir another user could have planted: it must
+    belong to us and admit no group/other writes."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    return st.st_uid == os.geteuid() and not (st.st_mode & 0o022)
 
 CGROUP_ROOT = "/sys/fs/cgroup"
 CGROUP_PARENT = "nomad_tpu"
@@ -172,6 +191,11 @@ def _enter_bind_sandbox(chroot: str, binds: List[str]) -> None:
             # best-effort read-only remount of the bind
             _mount(b"none", target, b"",
                    MS_BIND | MS_REMOUNT | MS_RDONLY | MS_REC)
+    # tasks need real device nodes (/dev/null, /dev/urandom, ...):
+    # bind the host /dev read-write (reference libcontainer creates
+    # the default device set in the rootfs)
+    _mount(b"/dev", os.path.join(chroot, "dev").encode(), b"",
+           MS_BIND | MS_REC)
     _mount(b"proc", os.path.join(chroot, "proc").encode(), b"proc", 0)
     os.chroot(chroot)
     os.chdir("/")
@@ -248,9 +272,29 @@ class CgroupSlice:
             os.path.join(CGROUP_ROOT, "cgroup.controllers")
         )
 
+    @staticmethod
+    def _enable_v2_controllers() -> None:
+        """cgroup v2 leaves only expose memory.max/cpu.weight when every
+        ancestor delegates the controllers via cgroup.subtree_control."""
+        for parent in (
+            CGROUP_ROOT,
+            os.path.join(CGROUP_ROOT, CGROUP_PARENT),
+        ):
+            ctl = os.path.join(parent, "cgroup.subtree_control")
+            try:
+                with open(ctl, "w") as f:
+                    f.write("+memory +cpu")
+            except OSError:
+                pass
+
     def create(self) -> bool:
         try:
             if self.v2:
+                os.makedirs(
+                    os.path.join(CGROUP_ROOT, CGROUP_PARENT),
+                    exist_ok=True,
+                )
+                self._enable_v2_controllers()
                 path = os.path.join(
                     CGROUP_ROOT, CGROUP_PARENT, self.task_id
                 )
@@ -395,12 +439,19 @@ class Executor:
             "chroot": False, "cgroups": False, "mount_ns": False,
         }
 
+        can_unshare = os.geteuid() == 0 and hasattr(os, "unshare")
         chroot = spec.get("chroot") or ""
         binds: List[str] = []
         if chroot and os.geteuid() == 0:
             populate = spec.get("chroot_populate")
             if populate == "bind" or populate is None:
-                binds = prepare_bind_sandbox(chroot)
+                if not can_unshare:
+                    # without a private mount namespace the binds would
+                    # land in the HOST mount table and outlive the
+                    # task: refuse the sandbox rather than pollute
+                    chroot = ""
+                else:
+                    binds = prepare_bind_sandbox(chroot)
             elif populate == "auto":
                 build_chroot(chroot, link_command_env(chroot, argv[0]))
             elif isinstance(populate, dict) and populate:
@@ -423,9 +474,7 @@ class Executor:
             else:
                 cgroup = None
 
-        want_mnt_ns = bool(spec.get("mount_ns", True)) and (
-            os.geteuid() == 0 and hasattr(os, "unshare")
-        )
+        want_mnt_ns = bool(spec.get("mount_ns", True)) and can_unshare
         isolation["mount_ns"] = want_mnt_ns
 
         stdout = stderr = subprocess.DEVNULL
@@ -451,13 +500,20 @@ class Executor:
             # fork→exec window, the libcontainer init analog
             if cgroup is not None:
                 cgroup.enroll_self()
+            in_ns = False
             if want_mnt_ns:
-                try:
-                    os.unshare(os.CLONE_NEWNS)
-                except OSError:
-                    pass
+                # fail closed for bind sandboxes: if we can't enter a
+                # private namespace the binds would pollute the host,
+                # so the raise below aborts the launch instead
+                os.unshare(os.CLONE_NEWNS)
+                in_ns = True
             if chroot:
                 if binds:
+                    if not in_ns:
+                        raise OSError(
+                            "bind sandbox requires a private mount "
+                            "namespace"
+                        )
                     _enter_bind_sandbox(chroot, binds)
                 else:
                     os.chroot(chroot)
@@ -803,12 +859,16 @@ class ExecutorClient:
 
 
 def save_reattach(task_id: str, socket_path: str, pid: int) -> None:
-    os.makedirs(STATE_DIR, exist_ok=True)
+    os.makedirs(STATE_DIR, mode=0o700, exist_ok=True)
+    if not _state_dir_trusted(STATE_DIR):
+        return
     with open(os.path.join(STATE_DIR, f"{task_id}.json"), "w") as f:
         json.dump({"socket": socket_path, "pid": pid}, f)
 
 
 def load_reattach(task_id: str) -> Optional[Dict[str, Any]]:
+    if not _state_dir_trusted(STATE_DIR):
+        return None
     try:
         with open(os.path.join(STATE_DIR, f"{task_id}.json")) as f:
             return json.load(f)

@@ -125,7 +125,15 @@ class ReplicatedStore:
         return self._raft_apply("set_scheduler_config", (config,))
 
     def upsert_plan_results(self, result, eval_id):
-        return self._raft_apply("upsert_plan_results", (result, eval_id))
+        # stops/preemptions replicate as AllocationDiffs; every
+        # replica's FSM denormalizes against its own state (reference
+        # plan_apply.go:324 normalizePlan)
+        from .fsm import normalize_plan_result
+
+        return self._raft_apply(
+            "upsert_plan_results",
+            (normalize_plan_result(result), eval_id),
+        )
 
 
 class ReplicatedACLStore:

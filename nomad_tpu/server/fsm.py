@@ -30,6 +30,96 @@ def encode_command(kind: str, args: tuple) -> bytes:
     return pickle.dumps((kind, args), protocol=pickle.HIGHEST_PROTOCOL)
 
 
+def normalize_plan_result(result):
+    """Wire-efficient form of a PlanResult: stopped/preempted allocs
+    shrink to AllocationDiffs — an id plus the mutated status fields —
+    instead of full Job-bearing Allocation graphs (reference
+    plan_apply.go:324-344 normalizePlan + Plan.NormalizeAllocations).
+    Placements stay whole: they carry state replicas don't have yet."""
+    from ..structs import AllocationDiff, PlanResult
+
+    if result.normalized:
+        return result
+
+    def diffs(allocs):
+        return [
+            AllocationDiff(
+                id=a.id,
+                desired_status=a.desired_status,
+                desired_description=a.desired_description,
+                client_status=a.client_status,
+                followup_eval_id=a.followup_eval_id,
+                preempted_by_allocation=a.preempted_by_allocation,
+            )
+            for a in allocs
+        ]
+
+    return PlanResult(
+        node_update={
+            nid: diffs(allocs)
+            for nid, allocs in result.node_update.items()
+        },
+        node_allocation=result.node_allocation,
+        node_preemptions={
+            nid: diffs(allocs)
+            for nid, allocs in result.node_preemptions.items()
+        },
+        deployment=result.deployment,
+        deployment_updates=result.deployment_updates,
+        refresh_index=result.refresh_index,
+        alloc_index=result.alloc_index,
+        normalized=True,
+    )
+
+
+def denormalize_plan_result(store: StateStore, result):
+    """Reconstitute full stop/preemption allocs from AllocationDiffs
+    against the replica's own state (reference fsm.go ApplyPlanResults
+    -> state DenormalizeAllocationSlice).  Diffs whose alloc no longer
+    exists are dropped — the stop already won."""
+    from dataclasses import replace
+
+    from ..structs import PlanResult
+
+    if not result.normalized:
+        return result
+
+    def expand(diff_lists):
+        out = {}
+        for nid, diff_list in diff_lists.items():
+            allocs = []
+            for d in diff_list:
+                existing = store.alloc_by_id(d.id)
+                if existing is None:
+                    continue
+                alloc = replace(existing)
+                alloc.desired_status = d.desired_status
+                alloc.desired_description = d.desired_description
+                if d.client_status:
+                    alloc.client_status = d.client_status
+                if d.followup_eval_id:
+                    alloc.followup_eval_id = d.followup_eval_id
+                if d.preempted_by_allocation:
+                    alloc.preempted_by_allocation = (
+                        d.preempted_by_allocation
+                    )
+                allocs.append(alloc)
+            if allocs:
+                out[nid] = allocs
+        return out
+
+    return PlanResult(
+        node_update=expand(result.node_update),
+        node_allocation=result.node_allocation,
+        node_preemptions=expand(result.node_preemptions),
+        deployment=result.deployment,
+        deployment_updates=result.deployment_updates,
+        refresh_index=result.refresh_index,
+        alloc_index=result.alloc_index,
+        normalized=False,
+    )
+
+
 def decode_command(raw: bytes) -> Tuple[str, tuple]:
     return pickle.loads(raw)
 
@@ -257,6 +347,8 @@ class ServerFSM:
         return self.store.set_autopilot_config(config)
 
     def _apply_upsert_plan_results(self, result, eval_id):
+        if getattr(result, "normalized", False):
+            result = denormalize_plan_result(self.store, result)
         return self.store.upsert_plan_results(result, eval_id)
 
     # ACL commands ------------------------------------------------------

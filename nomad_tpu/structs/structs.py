@@ -1204,6 +1204,22 @@ class Plan:
 
 
 @dataclass
+class AllocationDiff:
+    """Minimal wire form of a stopped/preempted allocation: just the
+    fields the FSM needs to apply the stop against its local copy
+    (reference structs.go AllocationDiff + Plan.NormalizeAllocations,
+    nomad/plan_apply.go:324-344 — stops/evictions replicate as diffs,
+    not full Job-bearing alloc structs)."""
+
+    id: str = ""
+    desired_status: str = ""
+    desired_description: str = ""
+    client_status: str = ""
+    followup_eval_id: str = ""
+    preempted_by_allocation: str = ""
+
+
+@dataclass
 class PlanResult:
     """(reference structs.go PlanResult:9988)"""
 
@@ -1214,6 +1230,9 @@ class PlanResult:
     deployment_updates: List["DeploymentStatusUpdate"] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
+    # True when node_update/node_preemptions hold AllocationDiffs that
+    # must be denormalized against state before applying
+    normalized: bool = False
 
     def is_full_commit(self, plan: Plan) -> bool:
         expected = sum(len(v) for v in plan.node_allocation.values())

@@ -168,8 +168,10 @@ def test_executor_bind_sandbox_full_system_readonly(client, tmp_path):
                 "/bin/sh",
                 "-c",
                 # /bin/ls is a real binary (not a builtin): proves the
-                # full system tree is visible inside the sandbox
+                # full system tree is visible inside the sandbox; the
+                # >/dev/null redirect also needs a real device node
                 f"ls /usr/bin >/dev/null && echo BINDOK;"
+                f" test -c /dev/null && echo DEVOK;"
                 f" test -e {marker} && echo VISIBLE || echo HIDDEN;"
                 f" touch /usr/bin/nope 2>/dev/null && echo RW || echo RO",
             ],
@@ -183,6 +185,7 @@ def test_executor_bind_sandbox_full_system_readonly(client, tmp_path):
     assert res["exit_code"] == 0
     got = open(out).read()
     assert "BINDOK" in got and "HIDDEN" in got and "RO" in got, got
+    assert "DEVOK" in got, got
     client.destroy("tb")
     # the mounts died with the task's namespace: host-side the sandbox
     # mount points are plain empty dirs
