@@ -123,6 +123,84 @@ def srv():
     server.stop()
 
 
+def test_csi_transient_unavailability_divergence_blast_radius():
+    """Pins the one documented oracle/TPU divergence (tpu_stack.py
+    header): when a node's computed class is memoized task-group
+    eligible but the node fails the *transient* CSI availability check
+    (unhealthy plugin instance), the oracle aborts the whole walk for
+    that pick (reference feasible.go returns nil mid-walk -> the pick
+    fails and the eval blocks), while the mask path excludes the node
+    and keeps looking.
+
+    Blast radius asserted here:
+      * divergence requires the memoized-eligible + transient-failure
+        walk order - on seeds where the oracle doesn't trip it, the
+        two sides stay bit-identical;
+      * when the TPU path places where the oracle blocked, it only
+        ever places on nodes that PASS the CSI health check - the
+        divergence can yield extra placements, never wrong ones;
+      * first picks (the memoizing visit) are identical on both sides.
+    """
+    from nomad_tpu.sched.generic_sched import ServiceScheduler
+    from nomad_tpu.sched.testing import Harness
+
+    diverged = []
+    agreed = []
+    for seed in range(12):
+        results = {}
+        for use_tpu in (False, True):
+            h = Harness()
+            healthy, unhealthy = [], []
+            # same computed class: csi plugin health is not part of
+            # the class hash (node_class.py), which is exactly what
+            # makes the memoized-eligible + unavailable state possible
+            for i in range(4):
+                n = mock.node()
+                n.id = f"csi-node-{i}"  # stable across both runs
+                ok = i % 2 == 0
+                n.csi_node_plugins["ebs0"] = ok
+                (healthy if ok else unhealthy).append(n.id)
+                h.store.upsert_node(n)
+            vol = mock.csi_volume(
+                plugin_id="ebs0",
+                access_mode=CSI_ACCESS_MULTI_NODE_MULTI_WRITER,
+            )
+            h.store.upsert_csi_volume(vol)
+            j = csi_job(vol.id, count=3, id="div")
+            h.store.upsert_job(j)
+            ev = mock.evaluation(job_id=j.id)
+            h.reject_plan = True
+            h.process(ServiceScheduler, ev, use_tpu=use_tpu, seed=seed)
+            placements = sorted(
+                (a.name, a.node_id)
+                for plan in h.plans[-1:]  # no plan when every pick blocked
+                for v in plan.node_allocation.values()
+                for a in v
+            )
+            results[use_tpu] = (placements, set(healthy))
+        oracle, healthy_set = results[False]
+        tpu, _ = results[True]
+        # the TPU side must never place on a CSI-unhealthy node
+        assert all(nid in healthy_set for _, nid in tpu), (seed, tpu)
+        assert all(nid in healthy_set for _, nid in oracle), (
+            seed,
+            oracle,
+        )
+        if oracle == tpu:
+            agreed.append(seed)
+        else:
+            # divergence shape: the oracle blocked one or more picks
+            # mid-walk; the TPU side placed MORE, and agrees on every
+            # pick the oracle completed before blocking
+            assert len(tpu) > len(oracle), (seed, oracle, tpu)
+            assert set(oracle) <= set(tpu), (seed, oracle, tpu)
+            diverged.append(seed)
+    # the scenario must actually exercise the divergence somewhere,
+    # and must not diverge universally (it is walk-order dependent)
+    assert diverged, "scenario never hit the documented divergence"
+    assert agreed, "divergence should be walk-order dependent"
+
+
 def test_placement_requires_healthy_plugin(srv):
     plugin_nodes = []
     for i in range(2):
