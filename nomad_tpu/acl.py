@@ -227,6 +227,9 @@ class ACLStore:
                     raise ValueError(f"unknown policy {p!r}")
             self.tokens_by_secret[token.secret_id] = token
             self.tokens_by_accessor[token.accessor_id] = token
+            # upsert path: a token update must drop the cached ACL or
+            # stripped policies keep being honored until restart
+            self._cache.pop(token.secret_id, None)
         return token
 
     def delete_token(self, accessor_id: str) -> None:

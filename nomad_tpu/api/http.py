@@ -426,6 +426,36 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond({"Data": data.decode("utf-8", "replace")})
             return True
 
+        m = re.fullmatch(r"/v1/job/([^/]+)/evaluate", path)
+        if m and method in ("POST", "PUT"):
+            # force a fresh evaluation (reference nomad/job_endpoint.go
+            # Job.Evaluate; command/job_eval.go)
+            self._check_acl("submit-job", ns)
+            job = store.job_by_id(ns, m.group(1))
+            if job is None:
+                raise HTTPError(404, "job not found")
+            if job.is_periodic() or job.is_parameterized():
+                # templates never evaluate directly (reference
+                # job_endpoint.go Evaluate rejects both)
+                raise HTTPError(
+                    400,
+                    "can't evaluate periodic/parameterized job",
+                )
+            from ..structs import Evaluation
+
+            ev = Evaluation(
+                namespace=job.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by="job-eval",
+                job_id=job.id,
+                status="pending",
+            )
+            store.upsert_evals([ev])
+            srv.on_eval_update(ev)
+            self._respond({"EvalID": ev.id})
+            return True
+
         m = re.fullmatch(r"/v1/job/([^/]+)/periodic/force", path)
         if m and method in ("POST", "PUT"):
             self._check_acl("submit-job", ns)
@@ -1205,11 +1235,64 @@ class APIHandler(BaseHTTPRequestHandler):
                 )
                 return True
 
+        if path == "/v1/acl/token/self" and method == "GET":
+            token = srv.acls.tokens_by_secret.get(
+                self.headers.get("X-Nomad-Token", "")
+            )
+            if token is None:
+                raise HTTPError(403, "no token supplied or unknown")
+            self._respond(
+                {
+                    "AccessorID": token.accessor_id,
+                    "Name": token.name,
+                    "Type": token.type,
+                    "Policies": token.policies,
+                }
+            )
+            return True
+
         m = re.fullmatch(r"/v1/acl/token/([^/]+)", path)
         if m and method == "DELETE":
             self._check_acl("operator:write")
             srv.acls.delete_token(m.group(1))
             self._respond({})
+            return True
+        if m and method == "GET":
+            self._check_acl("operator:read")
+            token = srv.acls.tokens_by_accessor.get(m.group(1))
+            if token is None:
+                raise HTTPError(404, "token not found")
+            self._respond(
+                {
+                    "AccessorID": token.accessor_id,
+                    "Name": token.name,
+                    "Type": token.type,
+                    "Policies": token.policies,
+                }
+            )
+            return True
+        if m and method in ("POST", "PUT"):
+            self._check_acl("operator:write")
+            token = srv.acls.tokens_by_accessor.get(m.group(1))
+            if token is None:
+                raise HTTPError(404, "token not found")
+            body = self._body()
+            import copy as _copy
+
+            updated = _copy.copy(token)
+            if "Name" in body:
+                updated.name = body["Name"]
+            if "Policies" in body:
+                updated.policies = body["Policies"] or []
+            if "Type" in body:
+                updated.type = body["Type"]
+            # create_token upserts by accessor/secret id and routes
+            # through raft on replicated clusters
+            try:
+                srv.acls.create_token(updated)
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
+            self._respond({"AccessorID": updated.accessor_id})
             return True
 
         if path == "/v1/operator/snapshot/save" and method in ("POST", "PUT"):
@@ -1235,6 +1318,83 @@ class APIHandler(BaseHTTPRequestHandler):
             self._check_acl("operator:write")
             srv.force_gc()
             self._respond({})
+            return True
+
+        if path == "/v1/system/reconcile/summaries" and method in (
+            "POST",
+            "PUT",
+        ):
+            # recompute every job's derived status/summary (reference
+            # nomad/system_endpoint.go ReconcileJobSummaries); routes
+            # through the store (raft on replicated clusters) so all
+            # replicas converge and blocking queries wake
+            self._check_acl("operator:write")
+            store.reconcile_job_summaries()
+            self._respond({})
+            return True
+
+        # -- namespaces (reference nomad/namespace_endpoint +
+        # state table; OSS'd in 1.0) --------------------------------
+
+        if path == "/v1/namespaces" and method == "GET":
+            self._check_acl_any(("read-job", "list-jobs"), ns)
+            self._respond(
+                [
+                    {
+                        "Name": n.name,
+                        "Description": n.description,
+                        "CreateIndex": n.create_index,
+                        "ModifyIndex": n.modify_index,
+                    }
+                    for n in store.iter_namespaces()
+                ]
+            )
+            return True
+
+        if path in ("/v1/namespaces", "/v1/namespace") and method in (
+            "POST",
+            "PUT",
+        ):
+            self._check_acl("operator:write")
+            body = self._body()
+            from ..structs import Namespace
+
+            namespace = Namespace(
+                name=body.get("Name", ""),
+                description=body.get("Description", ""),
+            )
+            try:
+                index = store.upsert_namespace(namespace)
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
+            self._respond({"Index": index})
+            return True
+
+        m = re.fullmatch(r"/v1/namespace/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl_any(("read-job", "list-jobs"), m.group(1))
+            n = store.namespace_by_name(m.group(1))
+            if n is None:
+                raise HTTPError(404, "namespace not found")
+            self._respond(
+                {
+                    "Name": n.name,
+                    "Description": n.description,
+                    "CreateIndex": n.create_index,
+                    "ModifyIndex": n.modify_index,
+                }
+            )
+            return True
+
+        if m and method == "DELETE":
+            self._check_acl("operator:write")
+            try:
+                index = store.delete_namespace(m.group(1))
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
+            self._respond({"Index": index})
             return True
 
         return False

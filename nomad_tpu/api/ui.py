@@ -1,6 +1,13 @@
-"""Minimal built-in web UI (the parity nod to the reference's Ember app
-under ui/ — same data, one self-contained page against the /v1 API).
-Served at /ui by the HTTP server."""
+"""Built-in web UI (the parity nod to the reference's Ember app under
+ui/ — same data, one self-contained page against the /v1 API).
+
+Live updates ride the API's blocking queries: each list view long-polls
+its endpoint with ?index=N&wait=30 (reference rpc.go:780 blockingRPC;
+the Ember UI's live updates poll the same way) and re-renders only when
+the X-Nomad-Index advances.  Hash routes provide drill-down detail:
+#/jobs, #/job/<id>, #/nodes, #/node/<id>, #/allocs, #/alloc/<id>.
+Served at /ui by the HTTP server.
+"""
 
 UI_HTML = """<!DOCTYPE html>
 <html lang="en">
@@ -10,30 +17,30 @@ UI_HTML = """<!DOCTYPE html>
 <style>
   :root { color-scheme: light dark; }
   body { font-family: system-ui, sans-serif; margin: 2rem;
-         max-width: 72rem; }
+         max-width: 76rem; }
   h1 { font-size: 1.3rem; }
   h2 { font-size: 1.05rem; margin-top: 1.6rem; }
+  nav a { margin-right: 1rem; }
   table { border-collapse: collapse; width: 100%; font-size: .85rem; }
   th, td { text-align: left; padding: .3rem .6rem;
            border-bottom: 1px solid #8884; }
-  code { font-size: .8rem; }
+  code, pre { font-size: .8rem; }
+  pre { background: #8881; padding: .6rem; overflow-x: auto; }
   .ok  { color: #2a9d2a; }
   .bad { color: #d43a3a; }
   #err { color: #d43a3a; }
+  #live { font-size: .75rem; opacity: .6; }
 </style>
 </head>
 <body>
-<h1>nomad-tpu <small id="leader"></small></h1>
+<h1>nomad-tpu <small id="leader"></small> <span id="live"></span></h1>
+<nav>
+  <a href="#/jobs">Jobs</a><a href="#/nodes">Nodes</a
+  ><a href="#/allocs">Allocations</a>
+</nav>
 <div id="err"></div>
-<h2>Jobs</h2><table id="jobs"></table>
-<h2>Nodes</h2><table id="nodes"></table>
-<h2>Allocations</h2><table id="allocs"></table>
+<div id="view"></div>
 <script>
-async function j(p) {
-  const r = await fetch(p);
-  if (!r.ok) throw new Error(p + ": " + r.status);
-  return r.json();
-}
 function esc(v) {
   return String(v ?? "").replace(/[&<>"']/g, c => ({
     "&": "&amp;", "<": "&lt;", ">": "&gt;",
@@ -44,43 +51,152 @@ function row(cells, tag) {
   return "<tr>" + cells.map(c => `<${tag||"td"}>${c}</${tag||"td"}>`)
     .join("") + "</tr>";
 }
+function link(href, text) {
+  // href is attacker-influenced (job ids): escape for the attribute
+  return `<a href="#${esc(href)}">${text}</a>`;
+}
 function code(v) { return `<code>${esc(v).slice(0, 8)}</code>`; }
 function badge(s, good) {
   return `<span class="${good.includes(s) ? "ok" : "bad"}">` +
     esc(s) + "</span>";
 }
-async function refresh() {
-  try {
-    const [jobs, nodes, allocs, leader] = await Promise.all([
-      j("/v1/jobs"), j("/v1/nodes"), j("/v1/allocations"),
-      j("/v1/status/leader"),
-    ]);
-    document.getElementById("leader").textContent =
-      "leader: " + JSON.stringify(leader);
-    document.getElementById("jobs").innerHTML =
-      row(["ID","Type","Priority","Status"], "th") +
-      jobs.map(x => row([esc(x.ID), esc(x.Type), esc(x.Priority),
-        badge(x.Status, ["running","complete"])])).join("");
-    document.getElementById("nodes").innerHTML =
-      row(["ID","Name","DC","Status","Eligibility"], "th") +
-      nodes.map(x => row([
-        code(x.ID), esc(x.Name),
-        esc(x.Datacenter), badge(x.Status, ["ready"]),
-        esc(x.SchedulingEligibility)])).join("");
-    document.getElementById("allocs").innerHTML =
-      row(["ID","Job","Group","Node","Desired","Client"], "th") +
-      allocs.map(x => row([
-        code(x.id), esc(x.job_id),
-        esc(x.task_group), code(x.node_id),
-        esc(x.desired_status),
-        badge(x.client_status, ["running","complete"])])).join("");
-    document.getElementById("err").textContent = "";
-  } catch (e) {
-    document.getElementById("err").textContent = String(e);
+async function j(p) {
+  const r = await fetch(p);
+  if (!r.ok) throw new Error(p + ": " + r.status);
+  return r.json();
+}
+
+// ---- blocking-query live poller -----------------------------------
+// one generation per route; switching routes abandons the old loop
+let generation = 0;
+async function livePoll(path, render) {
+  const gen = generation;
+  let index = 0;
+  while (gen === generation) {
+    try {
+      const url = index
+        ? `${path}${path.includes("?") ? "&" : "?"}index=${index}&wait=30`
+        : path;
+      const r = await fetch(url);
+      if (!r.ok) throw new Error(path + ": " + r.status);
+      const next = parseInt(r.headers.get("X-Nomad-Index") || "0");
+      const data = await r.json();
+      if (gen !== generation) return;
+      render(data);
+      document.getElementById("err").textContent = "";
+      document.getElementById("live").textContent =
+        "live (index " + next + ")";
+      index = next || index;
+      if (!next) await new Promise(res => setTimeout(res, 2000));
+    } catch (e) {
+      if (gen !== generation) return;
+      document.getElementById("err").textContent = String(e);
+      await new Promise(res => setTimeout(res, 2000));
+    }
   }
 }
-refresh();
-setInterval(refresh, 2000);
+function view(html) { document.getElementById("view").innerHTML = html; }
+
+// ---- views ---------------------------------------------------------
+function jobsView() {
+  view('<h2>Jobs</h2><table id="t"></table>');
+  livePoll("/v1/jobs", jobs => {
+    document.getElementById("t").innerHTML =
+      row(["ID","Type","Priority","Status"], "th") +
+      jobs.map(x => row([link("/job/" + x.ID, esc(x.ID)), esc(x.Type),
+        esc(x.Priority),
+        badge(x.Status, ["running","complete"])])).join("");
+  });
+}
+function nodesView() {
+  view('<h2>Nodes</h2><table id="t"></table>');
+  livePoll("/v1/nodes", nodes => {
+    document.getElementById("t").innerHTML =
+      row(["ID","Name","DC","Status","Eligibility"], "th") +
+      nodes.map(x => row([
+        link("/node/" + x.ID, code(x.ID)), esc(x.Name),
+        esc(x.Datacenter), badge(x.Status, ["ready"]),
+        esc(x.SchedulingEligibility)])).join("");
+  });
+}
+function allocRows(allocs) {
+  return row(["ID","Job","Group","Node","Desired","Client"], "th") +
+    allocs.map(x => row([
+      link("/alloc/" + x.id, code(x.id)),
+      link("/job/" + x.job_id, esc(x.job_id)),
+      esc(x.task_group),
+      link("/node/" + x.node_id, code(x.node_id)),
+      esc(x.desired_status),
+      badge(x.client_status, ["running","complete"])])).join("");
+}
+function allocsView() {
+  view('<h2>Allocations</h2><table id="t"></table>');
+  livePoll("/v1/allocations", allocs => {
+    document.getElementById("t").innerHTML = allocRows(allocs);
+  });
+}
+function jobView(id) {
+  view(`<h2>Job ${esc(id)}</h2><pre id="d"></pre>
+    <h2>Allocations</h2><table id="a"></table>
+    <h2>Evaluations</h2><table id="e"></table>
+    <h2>Deployments</h2><table id="dep"></table>`);
+  j(`/v1/job/${id}`).then(job => {
+    document.getElementById("d").textContent =
+      JSON.stringify(job, null, 1).slice(0, 4000);
+  }).catch(() => {});
+  j(`/v1/job/${id}/evaluations`).then(evs => {
+    document.getElementById("e").innerHTML =
+      row(["ID","TriggeredBy","Status"], "th") +
+      evs.map(x => row([code(x.id), esc(x.triggered_by),
+        badge(x.status, ["complete"])])).join("");
+  }).catch(() => {});
+  j(`/v1/job/${id}/deployments`).then(ds => {
+    document.getElementById("dep").innerHTML =
+      row(["ID","Version","Status"], "th") +
+      ds.map(x => row([code(x.id), esc(x.job_version),
+        badge(x.status, ["successful","running"])])).join("");
+  }).catch(() => {});
+  livePoll(`/v1/job/${id}/allocations`, allocs => {
+    document.getElementById("a").innerHTML = allocRows(allocs);
+  });
+}
+function nodeView(id) {
+  view(`<h2>Node ${esc(id).slice(0,8)}</h2><pre id="d"></pre>
+    <h2>Allocations</h2><table id="a"></table>`);
+  j(`/v1/node/${id}`).then(n => {
+    document.getElementById("d").textContent =
+      JSON.stringify(n, null, 1).slice(0, 4000);
+  }).catch(() => {});
+  livePoll(`/v1/node/${id}/allocations`, allocs => {
+    document.getElementById("a").innerHTML = allocRows(allocs);
+  });
+}
+function allocView(id) {
+  view(`<h2>Allocation ${esc(id).slice(0,8)}</h2><pre id="d"></pre>`);
+  livePoll(`/v1/allocation/${id}`, a => {
+    document.getElementById("d").textContent =
+      JSON.stringify(a, null, 1).slice(0, 8000);
+  });
+}
+
+// ---- router --------------------------------------------------------
+function route() {
+  generation += 1;
+  const h = location.hash || "#/jobs";
+  let m;
+  if ((m = h.match(/^#\\/job\\/(.+)$/))) return jobView(m[1]);
+  if ((m = h.match(/^#\\/node\\/(.+)$/))) return nodeView(m[1]);
+  if ((m = h.match(/^#\\/alloc\\/(.+)$/))) return allocView(m[1]);
+  if (h === "#/nodes") return nodesView();
+  if (h === "#/allocs") return allocsView();
+  return jobsView();
+}
+window.addEventListener("hashchange", route);
+j("/v1/status/leader").then(l => {
+  document.getElementById("leader").textContent =
+    "leader: " + JSON.stringify(l);
+}).catch(() => {});
+route();
 </script>
 </body>
 </html>

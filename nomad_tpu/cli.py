@@ -133,6 +133,26 @@ def cmd_agent(args) -> None:
         bridge = BridgeService(server, port=cfg.bridge_port)
         bridge.start()
         print(f"==> TPU bridge on :{bridge.port}")
+    # external Consul/Vault (reference command/agent: consul sync +
+    # vault client wiring; opt-in by configured address)
+    secrets = None
+    if cfg.consul.address:
+        from .external import ConsulClient, ConsulSyncer
+
+        syncer = ConsulSyncer(
+            server.catalog,
+            ConsulClient(cfg.consul.address, cfg.consul.token),
+        )
+        syncer.attach(server.store)
+        syncer.sync()
+        print(f"==> consul sync to {cfg.consul.address}")
+    if cfg.vault.address:
+        from .external import VaultClient, VaultSecretsProvider
+
+        secrets = VaultSecretsProvider(
+            VaultClient(cfg.vault.address, cfg.vault.token)
+        )
+        print(f"==> vault secrets from {cfg.vault.address}")
     clients = []
     if cfg.client.enabled:
         from .structs import Node
@@ -145,6 +165,7 @@ def cmd_agent(args) -> None:
             drivers=cfg.client.drivers,
             heartbeat_interval=cfg.client.heartbeat_interval_s,
             include_tpu_fingerprint=cfg.client.include_tpu_fingerprint,
+            secrets=secrets,
             # dev mode ships an in-process CSI plugin so the volume
             # flow is drivable out of the box (reference -dev ships
             # the mock driver for the same reason)
@@ -708,12 +729,28 @@ def cmd_deployment(args) -> None:
                 ],
                 ["ID", "Job", "Status"],
             )
+    elif args.action == "list":
+        ds = _request("GET", "/v1/deployments")
+        _table(
+            [(d["id"][:8], d["job_id"][:20], d["status"]) for d in ds],
+            ["ID", "Job", "Status"],
+        )
     elif args.action == "promote":
         _request("POST", f"/v1/deployment/promote/{args.id}", {})
         print("==> Deployment promoted")
     elif args.action == "fail":
         _request("POST", f"/v1/deployment/fail/{args.id}", {})
         print("==> Deployment failed")
+    elif args.action == "pause":
+        _request(
+            "POST", f"/v1/deployment/pause/{args.id}", {"Pause": True}
+        )
+        print("==> Deployment paused")
+    elif args.action == "resume":
+        _request(
+            "POST", f"/v1/deployment/pause/{args.id}", {"Pause": False}
+        )
+        print("==> Deployment resumed")
 
 
 def cmd_operator_snapshot(args) -> None:
@@ -722,11 +759,211 @@ def cmd_operator_snapshot(args) -> None:
             "POST", "/v1/operator/snapshot/save", {"Path": args.path}
         )
         print(f"==> Snapshot saved to {resp['Saved']}")
+    elif args.action == "inspect":
+        # local file inspection, no API round trip (reference
+        # command/operator_snapshot_inspect.go)
+        import gzip
+        import pickle
+
+        with open(args.path, "rb") as f:
+            raw = f.read()
+        try:
+            payload = pickle.loads(gzip.decompress(raw))
+        except OSError:
+            payload = pickle.loads(raw)
+        print(f"Version       = {payload.get('version')}")
+        print(f"Index         = {payload.get('index')}")
+        for table in (
+            "nodes", "jobs", "allocs", "evals", "deployments",
+            "csi_volumes", "scaling_policies", "namespaces",
+            "acl_policies", "acl_tokens",
+        ):
+            if table in payload:
+                print(f"{table:<14}= {len(payload[table])}")
     else:
         resp = _request(
             "POST", "/v1/operator/snapshot/restore", {"Path": args.path}
         )
         print(f"==> Snapshot restored (index {resp['Index']})")
+
+
+def cmd_namespace(args) -> None:
+    if args.ns_cmd == "list":
+        nss = _request("GET", "/v1/namespaces")
+        _table(
+            [(n["Name"], n["Description"]) for n in nss],
+            ["Name", "Description"],
+        )
+    elif args.ns_cmd in ("status", "inspect"):
+        n = _request("GET", f"/v1/namespace/{args.name}")
+        if args.ns_cmd == "inspect":
+            print(json.dumps(n, indent=2))
+        else:
+            print(f"Name        = {n['Name']}")
+            print(f"Description = {n['Description']}")
+    elif args.ns_cmd == "apply":
+        _request(
+            "POST",
+            "/v1/namespaces",
+            {"Name": args.name, "Description": args.description or ""},
+        )
+        print(f'==> Namespace "{args.name}" applied')
+    elif args.ns_cmd == "delete":
+        _request("DELETE", f"/v1/namespace/{args.name}")
+        print(f'==> Namespace "{args.name}" deleted')
+
+
+def cmd_acl(args) -> None:
+    if args.acl_cmd == "bootstrap":
+        resp = _request("POST", "/v1/acl/bootstrap", {})
+        print(f"Accessor ID = {resp['AccessorID']}")
+        print(f"Secret ID   = {resp['SecretID']}")
+        print(f"Type        = {resp.get('Type', 'management')}")
+        return
+    if args.acl_cmd == "policy":
+        if args.action == "list":
+            ps = _request("GET", "/v1/acl/policies")
+            _table([(p["Name"],) for p in ps], ["Name"])
+        elif args.action == "info":
+            print(
+                json.dumps(
+                    _request("GET", f"/v1/acl/policy/{args.name}"),
+                    indent=2,
+                )
+            )
+        elif args.action == "apply":
+            with open(args.file) as f:
+                rules = json.load(f)
+            _request("POST", f"/v1/acl/policy/{args.name}", rules)
+            print(f'==> Policy "{args.name}" applied')
+        elif args.action == "delete":
+            _request("DELETE", f"/v1/acl/policy/{args.name}")
+            print(f'==> Policy "{args.name}" deleted')
+        return
+    # token family
+    if args.action == "list":
+        ts = _request("GET", "/v1/acl/tokens")
+        _table(
+            [
+                (
+                    t["AccessorID"][:8],
+                    t["Name"],
+                    t["Type"],
+                    ",".join(t.get("Policies") or []),
+                )
+                for t in ts
+            ],
+            ["Accessor", "Name", "Type", "Policies"],
+        )
+    elif args.action == "create":
+        resp = _request(
+            "POST",
+            "/v1/acl/tokens",
+            {
+                "Name": args.name or "",
+                "Type": args.type,
+                "Policies": args.policy or [],
+            },
+        )
+        print(f"Accessor ID = {resp['AccessorID']}")
+        print(f"Secret ID   = {resp['SecretID']}")
+    elif args.action == "info":
+        print(
+            json.dumps(
+                _request("GET", f"/v1/acl/token/{args.accessor}"),
+                indent=2,
+            )
+        )
+    elif args.action == "self":
+        print(json.dumps(_request("GET", "/v1/acl/token/self"), indent=2))
+    elif args.action == "update":
+        body = {}
+        if args.name:
+            body["Name"] = args.name
+        if args.policy:
+            body["Policies"] = args.policy
+        _request("POST", f"/v1/acl/token/{args.accessor}", body)
+        print(f"==> Token {args.accessor[:8]} updated")
+    elif args.action == "delete":
+        _request("DELETE", f"/v1/acl/token/{args.accessor}")
+        print(f"==> Token {args.accessor[:8]} deleted")
+
+
+def cmd_job_deployments(args) -> None:
+    ds = _request("GET", f"/v1/job/{args.job_id}/deployments")
+    _table(
+        [
+            (d["id"][:8], d.get("job_version", 0), d["status"])
+            for d in ds
+        ],
+        ["ID", "Job Version", "Status"],
+    )
+
+
+def cmd_job_eval(args) -> None:
+    resp = _request("POST", f"/v1/job/{args.job_id}/evaluate", {})
+    print(f"==> Created eval {resp['EvalID']}")
+
+
+def cmd_job_promote(args) -> None:
+    ds = _request("GET", f"/v1/job/{args.job_id}/deployments")
+    live = [d for d in ds if d["status"] == "running"]
+    if not live:
+        print("No running deployment to promote", file=sys.stderr)
+        sys.exit(1)
+    _request("POST", f"/v1/deployment/promote/{live[0]['id']}", {})
+    print(f"==> Promoted deployment {live[0]['id'][:8]}")
+
+
+def cmd_job_periodic(args) -> None:
+    resp = _request(
+        "POST", f"/v1/job/{args.job_id}/periodic/force", {}
+    )
+    print(f"==> Forced launch: {resp['JobID']}")
+
+
+EXAMPLE_JOB_HCL = '''job "example" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "cache" {
+    count = 1
+
+    task "redis" {
+      driver = "exec"
+
+      config {
+        command = "/usr/bin/redis-server"
+        args    = ["--port", "6379"]
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+    }
+  }
+}
+'''
+
+
+def cmd_job_init(args) -> None:
+    path = args.filename or "example.nomad"
+    if os.path.exists(path):
+        print(f"File {path!r} already exists", file=sys.stderr)
+        sys.exit(1)
+    with open(path, "w") as f:
+        f.write(EXAMPLE_JOB_HCL)
+    print(f"==> Example job file written to {path}")
+
+
+def cmd_system(args) -> None:
+    if args.action == "gc":
+        _request("POST", "/v1/system/gc", {})
+        print("==> GC triggered")
+    elif args.action == "reconcile":
+        _request("POST", "/v1/system/reconcile/summaries", {})
+        print("==> Job summaries reconciled")
 
 
 def cmd_operator_scheduler(args) -> None:
@@ -824,6 +1061,22 @@ def build_parser() -> argparse.ArgumentParser:
     jv = job_sub.add_parser("validate")
     jv.add_argument("file")
     jv.set_defaults(fn=cmd_job_validate)
+    jdep = job_sub.add_parser("deployments")
+    jdep.add_argument("job_id")
+    jdep.set_defaults(fn=cmd_job_deployments)
+    jev = job_sub.add_parser("eval")
+    jev.add_argument("job_id")
+    jev.set_defaults(fn=cmd_job_eval)
+    jpr = job_sub.add_parser("promote")
+    jpr.add_argument("job_id")
+    jpr.set_defaults(fn=cmd_job_promote)
+    jpf = job_sub.add_parser("periodic")
+    jpf.add_argument("periodic_action", choices=["force"])
+    jpf.add_argument("job_id")
+    jpf.set_defaults(fn=cmd_job_periodic)
+    jini = job_sub.add_parser("init")
+    jini.add_argument("filename", nargs="?", default="")
+    jini.set_defaults(fn=cmd_job_init)
 
     volume = sub.add_parser("volume")
     volume_sub = volume.add_subparsers(dest="volume_cmd", required=True)
@@ -918,10 +1171,62 @@ def build_parser() -> argparse.ArgumentParser:
     evs.set_defaults(fn=cmd_eval_status)
 
     dep = sub.add_parser("deployment")
-    dep.add_argument("action",
-                     choices=["status", "promote", "fail"])
+    dep.add_argument(
+        "action",
+        choices=["status", "list", "promote", "fail", "pause", "resume"],
+    )
     dep.add_argument("id", nargs="?")
     dep.set_defaults(fn=cmd_deployment)
+
+    nsp = sub.add_parser("namespace")
+    nsp_sub = nsp.add_subparsers(dest="ns_cmd", required=True)
+    nsl = nsp_sub.add_parser("list")
+    nsl.set_defaults(fn=cmd_namespace)
+    for name in ("status", "inspect", "delete"):
+        sp = nsp_sub.add_parser(name)
+        sp.add_argument("name")
+        sp.set_defaults(fn=cmd_namespace)
+    nsa = nsp_sub.add_parser("apply")
+    nsa.add_argument("-description", dest="description", default="")
+    nsa.add_argument("name")
+    nsa.set_defaults(fn=cmd_namespace)
+
+    acl = sub.add_parser("acl")
+    acl_sub = acl.add_subparsers(dest="acl_cmd", required=True)
+    aclb = acl_sub.add_parser("bootstrap")
+    aclb.set_defaults(fn=cmd_acl)
+    aclp = acl_sub.add_parser("policy")
+    aclp_sub = aclp.add_subparsers(dest="action", required=True)
+    app_ = aclp_sub.add_parser("apply")
+    app_.add_argument("name")
+    app_.add_argument("file")
+    app_.set_defaults(fn=cmd_acl)
+    apl = aclp_sub.add_parser("list")
+    apl.set_defaults(fn=cmd_acl)
+    for name in ("info", "delete"):
+        sp = aclp_sub.add_parser(name)
+        sp.add_argument("name")
+        sp.set_defaults(fn=cmd_acl)
+    aclt = acl_sub.add_parser("token")
+    aclt_sub = aclt.add_subparsers(dest="action", required=True)
+    atc = aclt_sub.add_parser("create")
+    atc.add_argument("-name", dest="name", default="")
+    atc.add_argument("-type", dest="type", default="client")
+    atc.add_argument("-policy", action="append", dest="policy")
+    atc.set_defaults(fn=cmd_acl)
+    atl = aclt_sub.add_parser("list")
+    atl.set_defaults(fn=cmd_acl)
+    ats = aclt_sub.add_parser("self")
+    ats.set_defaults(fn=cmd_acl)
+    for name in ("info", "delete"):
+        sp = aclt_sub.add_parser(name)
+        sp.add_argument("accessor")
+        sp.set_defaults(fn=cmd_acl)
+    atu = aclt_sub.add_parser("update")
+    atu.add_argument("-name", dest="name", default="")
+    atu.add_argument("-policy", action="append", dest="policy")
+    atu.add_argument("accessor")
+    atu.set_defaults(fn=cmd_acl)
 
     op = sub.add_parser("operator")
     op_sub = op.add_subparsers(dest="op_cmd", required=True)
@@ -932,7 +1237,9 @@ def build_parser() -> argparse.ArgumentParser:
     osch.add_argument("-tpu", choices=["true", "false"], default=None)
     osch.set_defaults(fn=cmd_operator_scheduler)
     osnap = op_sub.add_parser("snapshot")
-    osnap.add_argument("action", choices=["save", "restore"])
+    osnap.add_argument(
+        "action", choices=["save", "restore", "inspect"]
+    )
     osnap.add_argument("path")
     osnap.set_defaults(fn=cmd_operator_snapshot)
     oap = op_sub.add_parser("autopilot")
@@ -959,8 +1266,51 @@ def build_parser() -> argparse.ArgumentParser:
     mon.set_defaults(fn=cmd_monitor)
 
     system = sub.add_parser("system")
-    system.add_argument("action", choices=["gc"])
-    system.set_defaults(fn=cmd_system_gc)
+    system.add_argument("action", choices=["gc", "reconcile"])
+    system.add_argument(
+        "target", nargs="?", choices=["summaries"], default="summaries"
+    )
+    system.set_defaults(fn=cmd_system)
+
+    # top-level aliases (reference registers e.g. "run" -> job run,
+    # "status" -> job status; command/commands.go)
+    tr = sub.add_parser("run")
+    tr.add_argument("file")
+    tr.set_defaults(fn=cmd_job_run)
+    tp = sub.add_parser("plan")
+    tp.add_argument("file")
+    tp.set_defaults(fn=cmd_job_plan)
+    tst = sub.add_parser("status")
+    tst.add_argument("job_id", nargs="?")
+    tst.set_defaults(fn=cmd_job_status)
+    tstop = sub.add_parser("stop")
+    tstop.add_argument("-purge", action="store_true", dest="purge")
+    tstop.add_argument("job_id")
+    tstop.set_defaults(fn=cmd_job_stop)
+    tv = sub.add_parser("validate")
+    tv.add_argument("file")
+    tv.set_defaults(fn=cmd_job_validate)
+    ti = sub.add_parser("init")
+    ti.add_argument("filename", nargs="?", default="")
+    ti.set_defaults(fn=cmd_job_init)
+    tl = sub.add_parser("logs")
+    tl.add_argument("-stderr", action="store_true", dest="stderr")
+    tl.add_argument("alloc_id")
+    tl.add_argument("task")
+    tl.set_defaults(fn=cmd_alloc_logs)
+    tex = sub.add_parser("exec")
+    tex.add_argument("-task", dest="task", default="")
+    tex.add_argument("alloc_id")
+    tex.add_argument("cmd", nargs=argparse.REMAINDER)
+    tex.set_defaults(fn=cmd_alloc_exec)
+    tin = sub.add_parser("inspect")
+    tin.add_argument("job_id")
+    tin.set_defaults(fn=cmd_job_inspect)
+    tfs = sub.add_parser("fs")
+    tfs.add_argument("-cat", action="store_true", dest="cat")
+    tfs.add_argument("alloc_id")
+    tfs.add_argument("path", nargs="?", default="")
+    tfs.set_defaults(fn=cmd_alloc_fs)
 
     version = sub.add_parser("version")
     version.set_defaults(fn=cmd_version)

@@ -108,6 +108,15 @@ class ReplicatedStore:
     def upsert_csi_volume(self, volume):
         return self._raft_apply("upsert_csi_volume", (volume,))
 
+    def upsert_namespace(self, ns):
+        return self._raft_apply("upsert_namespace", (ns,))
+
+    def reconcile_job_summaries(self):
+        return self._raft_apply("reconcile_job_summaries", ())
+
+    def delete_namespace(self, name):
+        return self._raft_apply("delete_namespace", (name,))
+
     def deregister_csi_volume(self, namespace, volume_id, force=False):
         return self._raft_apply(
             "deregister_csi_volume", (namespace, volume_id, force)

@@ -143,6 +143,7 @@ def state_payload(store: StateStore, acls) -> dict:
             "scheduler_config": store.scheduler_config,
             "autopilot_config": store.autopilot_config,
             "csi_volumes": list(store.csi_volumes.values()),
+            "namespaces": list(store.namespaces.values()),
             "scaling_policies": list(store.scaling_policies.values()),
             "scaling_events": {
                 k: {g: list(evs) for g, evs in v.items()}
@@ -214,6 +215,16 @@ def install_payload(store: StateStore, acls, payload: dict) -> int:
         store.csi_volumes.clear()
         for vol in payload.get("csi_volumes", ()):
             store.csi_volumes[(vol.namespace, vol.id)] = vol
+        store.namespaces.clear()
+        for ns in payload.get("namespaces", ()):
+            store.namespaces[ns.name] = ns
+        if "default" not in store.namespaces:
+            from ..structs import Namespace
+
+            store.namespaces["default"] = Namespace(
+                name="default",
+                description="Default shared namespace",
+            )
         store.scaling_policies.clear()
         store._scaling_by_target.clear()
         store.scaling_events.clear()
@@ -339,6 +350,15 @@ class ServerFSM:
 
     def _apply_upsert_deployment(self, deployment):
         return self.store.upsert_deployment(deployment)
+
+    def _apply_upsert_namespace(self, ns):
+        return self.store.upsert_namespace(ns)
+
+    def _apply_reconcile_job_summaries(self):
+        return self.store.reconcile_job_summaries()
+
+    def _apply_delete_namespace(self, name):
+        return self.store.delete_namespace(name)
 
     def _apply_set_scheduler_config(self, config):
         return self.store.set_scheduler_config(config)

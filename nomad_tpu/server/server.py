@@ -591,6 +591,13 @@ class Server:
                 )
         if job.type not in ("service", "batch", "system"):
             raise ValueError(f"invalid job type {job.type!r}")
+        if (
+            job.namespace != "default"
+            and self.store.namespace_by_name(job.namespace) is None
+        ):
+            raise ValueError(
+                f"namespace {job.namespace!r} does not exist"
+            )
 
     # -- node API (reference nomad/node_endpoint.go) --------------------
 

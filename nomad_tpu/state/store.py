@@ -40,6 +40,7 @@ from ..structs import (
     JOB_STATUS_PENDING,
     JOB_STATUS_RUNNING,
     JOB_TYPE_SYSTEM,
+    Namespace,
     Node,
     Plan,
     PlanResult,
@@ -71,6 +72,14 @@ class StateStore:
         # CSI volumes keyed (namespace, id) (reference state table
         # csi_volumes, nomad/state/schema.go)
         self.csi_volumes: Dict[Tuple[str, str], CSIVolume] = {}
+
+        # namespaces (reference state table namespaces); "default"
+        # always exists
+        self.namespaces: Dict[str, "Namespace"] = {
+            "default": Namespace(
+                name="default", description="Default shared namespace"
+            )
+        }
 
         # autoscaling (reference state tables scaling_policy /
         # scaling_event, nomad/state/schema.go:795,847)
@@ -439,6 +448,64 @@ class StateStore:
     # CSIVolumeClaim/CSIVolumeDeregister; plugin health is a derived
     # view over node fingerprints)
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # namespaces (reference state_store.go UpsertNamespaces/
+    # DeleteNamespaces; table nomad/state/schema.go)
+    # ------------------------------------------------------------------
+
+    def upsert_namespace(self, ns: Namespace) -> int:
+        ns.validate()
+        with self._lock:
+            existing = self.namespaces.get(ns.name)
+            if existing is None:
+                ns.create_index = self._index + 1
+            else:
+                ns.create_index = existing.create_index
+            ns.modify_index = self._index + 1
+            self.namespaces[ns.name] = ns
+            return self._bump("namespaces")
+
+    def delete_namespace(self, name: str) -> int:
+        with self._lock:
+            if name == "default":
+                raise ValueError(
+                    "default namespace can not be deleted"
+                )
+            if name not in self.namespaces:
+                raise KeyError(f"namespace {name!r} does not exist")
+            # non-empty namespaces refuse deletion (reference
+            # nomad/state namespace deletion checks jobs + volumes)
+            jobs = [j for (n, _), j in self.jobs.items() if n == name]
+            vols = [
+                v for (n, _), v in self.csi_volumes.items() if n == name
+            ]
+            if jobs or vols:
+                raise ValueError(
+                    f"namespace {name!r} has {len(jobs)} jobs and "
+                    f"{len(vols)} volumes; delete them first"
+                )
+            del self.namespaces[name]
+            return self._bump("namespaces")
+
+    def reconcile_job_summaries(self) -> int:
+        """Recompute every job's derived status under the lock
+        (reference nomad/system_endpoint.go ReconcileJobSummaries →
+        raft ReconcileJobSummariesRequestType); bumps the jobs index so
+        blocking queries wake."""
+        with self._lock:
+            for (ns, job_id), job in self.jobs.items():
+                job.status = self.derive_job_status(ns, job_id)
+            return self._bump("jobs")
+
+    def namespace_by_name(self, name: str) -> Optional[Namespace]:
+        return self.namespaces.get(name)
+
+    def iter_namespaces(self) -> List[Namespace]:
+        with self._lock:
+            return sorted(
+                self.namespaces.values(), key=lambda n: n.name
+            )
 
     def upsert_csi_volume(self, volume: CSIVolume) -> int:
         with self._lock:

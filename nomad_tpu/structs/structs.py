@@ -649,6 +649,27 @@ JOB_TRACKED_SCALING_EVENTS = 20
 
 
 @dataclass
+class Namespace:
+    """A job namespace (reference nomad/structs Namespace — OSS'd in
+    1.0; the 0.13 CLI already ships the command family).  Jobs, CSI
+    volumes, and ACL capabilities scope to one."""
+
+    name: str = "default"
+    description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def validate(self) -> None:
+        import re as _re
+
+        if not _re.fullmatch(r"[a-zA-Z0-9-]{1,128}", self.name):
+            raise ValueError(
+                "invalid namespace name (alphanumeric + dashes, "
+                "max 128 chars)"
+            )
+
+
+@dataclass
 class ScalingPolicy:
     """Autoscaling bounds + opaque autoscaler policy attached to a task
     group (reference structs.go ScalingPolicy / scaling stanza;

@@ -431,3 +431,130 @@ def test_blocking_queries(api):
     r.read()
     assert dt < 5.0
     assert dt >= 0.3 or woke_index > idx2
+
+
+# ---------------------------------------------------------------------------
+# namespaces (reference nomad/namespace_endpoint; OSS'd in 1.0)
+# ---------------------------------------------------------------------------
+
+
+def test_namespace_lifecycle(api, monkeypatch, capsys):
+    from nomad_tpu.cli import main
+
+    server, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+
+    # default always present
+    nss = _get(base, "/v1/namespaces")
+    assert [n["Name"] for n in nss] == ["default"]
+
+    main(["namespace", "apply", "-description", "web team", "prod"])
+    assert "applied" in capsys.readouterr().out
+    n = _get(base, "/v1/namespace/prod")
+    assert n["Description"] == "web team"
+
+    main(["namespace", "list"])
+    out = capsys.readouterr().out
+    assert "prod" in out and "default" in out
+
+    # jobs in an unknown namespace are rejected; known ones accepted
+    bad = mock.job(id="nsjob")
+    bad.namespace = "ghost"
+    with pytest.raises(ValueError):
+        server.register_job(bad)
+    ok = mock.job(id="nsjob")
+    ok.namespace = "prod"
+    server.register_job(ok)
+
+    # a namespace with jobs refuses deletion
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, "/v1/namespace/prod", {}, method="DELETE")
+    assert exc.value.code == 400
+
+    server.deregister_job("prod", "nsjob", purge=True)
+    main(["namespace", "delete", "prod"])
+    assert "deleted" in capsys.readouterr().out
+    assert [n["Name"] for n in _get(base, "/v1/namespaces")] == [
+        "default"
+    ]
+
+    # default namespace can never be deleted
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, "/v1/namespace/default", {}, method="DELETE")
+    assert exc.value.code == 400
+
+
+def test_acl_token_info_self_update(api):
+    server, base = api
+    # bootstrap management token
+    boot = _post(base, "/v1/acl/bootstrap", {})
+    assert boot["SecretID"]
+    created = _post(
+        base, "/v1/acl/tokens", {"Name": "t1", "Type": "client"}
+    )
+    acc = created["AccessorID"]
+
+    info = _get(base, f"/v1/acl/token/{acc}")
+    assert info["Name"] == "t1"
+
+    _post(base, f"/v1/acl/token/{acc}", {"Name": "renamed"})
+    assert _get(base, f"/v1/acl/token/{acc}")["Name"] == "renamed"
+
+    # token self resolves the caller's own token
+    req = urllib.request.Request(
+        base + "/v1/acl/token/self",
+        headers={"X-Nomad-Token": created["SecretID"]},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        me = json.loads(resp.read())
+    assert me["AccessorID"] == acc
+
+
+def test_cli_new_commands_smoke(api, monkeypatch, capsys, tmp_path):
+    from nomad_tpu.cli import main
+
+    server, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    server.register_node(mock.node())
+    job = mock.job(id="smoke")
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+
+    # top-level aliases
+    main(["status"])
+    assert "smoke" in capsys.readouterr().out
+    main(["status", "smoke"])
+    assert "smoke" in capsys.readouterr().out
+
+    # job eval forces a fresh evaluation
+    main(["job", "eval", "smoke"])
+    out = capsys.readouterr().out
+    assert "Created eval" in out
+    assert server.drain_to_idle(10)
+
+    # job deployments
+    main(["job", "deployments", "smoke"])
+    capsys.readouterr()
+
+    # deployment list
+    main(["deployment", "list"])
+    capsys.readouterr()
+
+    # job init writes the example file
+    target = tmp_path / "example.nomad"
+    main(["job", "init", str(target)])
+    assert "Example job" in capsys.readouterr().out
+    assert target.exists()
+
+    # system reconcile summaries
+    main(["system", "reconcile", "summaries"])
+    assert "reconciled" in capsys.readouterr().out
+
+    # operator snapshot save + inspect
+    snap = tmp_path / "state.snap"
+    main(["operator", "snapshot", "save", str(snap)])
+    capsys.readouterr()
+    main(["operator", "snapshot", "inspect", str(snap)])
+    out = capsys.readouterr().out
+    assert "Index" in out and "jobs" in out

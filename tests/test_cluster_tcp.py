@@ -223,14 +223,14 @@ def _wait_leader(servers, timeout=15):
 # ---------------------------------------------------------------------------
 
 
-def _http_get(port, path, timeout=2.0):
+def _http_get(port, path, timeout=10.0):
     with urllib.request.urlopen(
         f"http://127.0.0.1:{port}{path}", timeout=timeout
     ) as resp:
         return json.loads(resp.read().decode())
 
 
-def _http_post(port, path, payload, timeout=5.0):
+def _http_post(port, path, payload, timeout=15.0):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}{path}",
         data=json.dumps(payload).encode(),
