@@ -57,6 +57,22 @@ class ACLConfig:
 
 
 @dataclass
+class ConsulConfig:
+    """(reference nomad/structs/config/consul.go)"""
+
+    address: str = ""  # empty = in-framework catalog only
+    token: str = ""
+
+
+@dataclass
+class VaultConfig:
+    """(reference nomad/structs/config/vault.go)"""
+
+    address: str = ""  # empty = local secrets providers only
+    token: str = ""
+
+
+@dataclass
 class AgentConfig:
     data_dir: str = ""
     name: str = ""
@@ -66,6 +82,8 @@ class AgentConfig:
     client: ClientConfig = field(default_factory=ClientConfig)
     http: HTTPConfig = field(default_factory=HTTPConfig)
     acl: ACLConfig = field(default_factory=ACLConfig)
+    consul: ConsulConfig = field(default_factory=ConsulConfig)
+    vault: VaultConfig = field(default_factory=VaultConfig)
     bridge_port: Optional[int] = None
 
 
@@ -122,6 +140,16 @@ def config_from_dict(raw: Dict) -> AgentConfig:
     )
     acl = _first(raw.get("acl"), {}) or {}
     cfg.acl = ACLConfig(enabled=bool(acl.get("enabled", False)))
+    consul = _first(raw.get("consul"), {}) or {}
+    cfg.consul = ConsulConfig(
+        address=consul.get("address", ""),
+        token=consul.get("token", ""),
+    )
+    vault = _first(raw.get("vault"), {}) or {}
+    cfg.vault = VaultConfig(
+        address=vault.get("address", ""),
+        token=vault.get("token", ""),
+    )
     if raw.get("bridge_port") is not None:
         cfg.bridge_port = int(raw["bridge_port"])
     return cfg

@@ -1,0 +1,325 @@
+"""External Consul / Vault integration.
+
+The reference registers task services with a real Consul agent
+(command/agent/consul/client.go: agent-API register/deregister with
+checks, keyed by a stable service id) and derives per-task Vault tokens
+server-side (nomad/vault.go: the server holds a management token and
+creates renewable child tokens scoped to the task's policies;
+client/vaultclient renews them).  This module is the same seam over
+plain HTTP:
+
+* `ConsulClient` — Consul agent API (service register/deregister/list,
+  KV get/put).
+* `ConsulSyncer` — mirrors the in-framework ServiceCatalog to an
+  external Consul agent: hooks the store's alloc watcher and pushes
+  incremental register/deregister calls, exactly the push-per-alloc
+  shape the reference's sync loop settles into.
+* `VaultClient` — token derivation (auth/token/create), renewal,
+  revocation, and KV reads.
+* `VaultSecretsProvider` — plugs VaultClient into the template
+  engine's SecretsProvider protocol, so `{{ secret "kv/web" "user" }}`
+  templates read through a real Vault.
+
+All network use is opt-in: nothing here runs unless an address is
+configured (`consul { address = ... }` / `vault { address = ... }` in
+the agent config), and every call degrades to a logged failure rather
+than wedging task startup — the reference treats Consul/Vault outages
+the same way (fingerprint flips, tasks gate on recovery).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+LOG = logging.getLogger("nomad_tpu.external")
+
+
+class ExternalError(Exception):
+    pass
+
+
+def _http(
+    method: str,
+    url: str,
+    body: Optional[Any] = None,
+    headers: Optional[Dict[str, str]] = None,
+    timeout: float = 10.0,
+    raw_body: Optional[bytes] = None,
+) -> Any:
+    data = raw_body
+    if body is not None and data is None:
+        data = json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return json.loads(raw) if raw else None
+    except urllib.error.HTTPError as exc:
+        raise ExternalError(
+            f"{method} {url}: HTTP {exc.code} {exc.read()[:200]!r}"
+        ) from exc
+    except urllib.error.URLError as exc:
+        raise ExternalError(f"{method} {url}: {exc.reason}") from exc
+
+
+# ---------------------------------------------------------------------------
+# Consul
+# ---------------------------------------------------------------------------
+
+
+class ConsulClient:
+    """Consul agent HTTP API subset (reference
+    command/agent/consul/client.go + api.Agent)."""
+
+    def __init__(self, address: str, token: str = "") -> None:
+        self.address = address.rstrip("/")
+        self.token = token
+
+    def _headers(self) -> Dict[str, str]:
+        return {"X-Consul-Token": self.token} if self.token else {}
+
+    def register_service(
+        self,
+        service_id: str,
+        name: str,
+        address: str = "",
+        port: int = 0,
+        tags: Optional[List[str]] = None,
+        checks: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
+        payload: Dict[str, Any] = {
+            "ID": service_id,
+            "Name": name,
+            "Tags": tags or [],
+        }
+        if address:
+            payload["Address"] = address
+        if port:
+            payload["Port"] = port
+        if checks:
+            payload["Checks"] = checks
+        _http(
+            "PUT",
+            f"{self.address}/v1/agent/service/register",
+            payload,
+            self._headers(),
+        )
+
+    def deregister_service(self, service_id: str) -> None:
+        _http(
+            "PUT",
+            f"{self.address}/v1/agent/service/deregister/"
+            + urllib.parse.quote(service_id),
+            None,
+            self._headers(),
+        )
+
+    def services(self) -> Dict[str, Any]:
+        return (
+            _http(
+                "GET",
+                f"{self.address}/v1/agent/services",
+                None,
+                self._headers(),
+            )
+            or {}
+        )
+
+    def kv_get(self, key: str) -> Optional[str]:
+        try:
+            out = _http(
+                "GET",
+                f"{self.address}/v1/kv/{urllib.parse.quote(key)}?raw=true",
+                None,
+                self._headers(),
+            )
+        except ExternalError:
+            return None
+        return out if isinstance(out, str) else json.dumps(out)
+
+    def kv_put(self, key: str, value: str) -> None:
+        _http(
+            "PUT",
+            f"{self.address}/v1/kv/{urllib.parse.quote(key)}",
+            headers=self._headers(),
+            raw_body=value.encode(),
+        )
+
+
+def _service_id(inst) -> str:
+    """Stable Consul service id for a catalog instance — the reference
+    uses a nomad-prefixed hash of alloc/task/service
+    (command/agent/consul/client.go makeAllocServiceID)."""
+    return f"_nomad-task-{inst.alloc_id}-{inst.task}-{inst.service}"
+
+
+class ConsulSyncer:
+    """Mirror the in-framework catalog into an external Consul agent.
+
+    Hooks the same alloc-watcher feed the ServiceCatalog consumes;
+    failures log and retry on the next alloc event rather than wedging
+    the scheduler or client."""
+
+    def __init__(self, catalog, consul: ConsulClient) -> None:
+        self.catalog = catalog
+        self.consul = consul
+        self._lock = threading.Lock()
+        self._registered: Dict[str, str] = {}  # service_id -> alloc
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sync(self) -> None:
+        instances = [
+            inst
+            for name in self.catalog.services()
+            for inst in self.catalog.instances(name)
+        ]
+        want: Dict[str, Any] = {_service_id(i): i for i in instances}
+        with self._lock:
+            for sid in list(self._registered):
+                if sid not in want:
+                    try:
+                        self.consul.deregister_service(sid)
+                    except ExternalError as exc:
+                        # keep tracking: retried on the next sync so a
+                        # consul blip can't strand a stale registration
+                        LOG.warning("consul deregister %s: %s", sid, exc)
+                        continue
+                    self._registered.pop(sid, None)
+            for sid, inst in want.items():
+                if sid in self._registered:
+                    continue
+                try:
+                    self.consul.register_service(
+                        sid,
+                        inst.service,
+                        address=inst.address,
+                        port=inst.port,
+                        tags=list(inst.tags),
+                    )
+                    self._registered[sid] = inst.alloc_id
+                except ExternalError as exc:
+                    LOG.warning("consul register %s: %s", sid, exc)
+
+    def attach(self, store) -> None:
+        """Alloc watchers fire under the store lock, so the callback
+        only flags; the HTTP round trips run on this syncer's own
+        thread — a slow or dead Consul can never stall state writes."""
+        self._thread = threading.Thread(
+            target=self._run, name="consul-syncer", daemon=True
+        )
+        self._thread.start()
+        store.add_alloc_watcher(lambda _allocs: self._dirty.set())
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._dirty.wait(timeout=0.5):
+                self._dirty.clear()
+                try:
+                    self.sync()
+                except Exception as exc:  # noqa: BLE001
+                    LOG.warning("consul sync: %s", exc)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# Vault
+# ---------------------------------------------------------------------------
+
+
+class VaultClient:
+    """Vault HTTP API subset (reference nomad/vault.go vaultClient:
+    derive child tokens for tasks from the server's token, renew,
+    revoke; client/vaultclient renews on the node)."""
+
+    def __init__(self, address: str, token: str = "") -> None:
+        self.address = address.rstrip("/")
+        self.token = token
+
+    def _headers(self) -> Dict[str, str]:
+        return {"X-Vault-Token": self.token} if self.token else {}
+
+    def derive_token(
+        self,
+        policies: List[str],
+        ttl: str = "72h",
+        renewable: bool = True,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        """Create a child token (reference vault.go CreateToken: role-
+        scoped, renewable, per-task metadata for audit)."""
+        out = _http(
+            "POST",
+            f"{self.address}/v1/auth/token/create",
+            {
+                "policies": policies,
+                "ttl": ttl,
+                "renewable": renewable,
+                "display_name": "nomad-task",
+                "meta": metadata or {},
+            },
+            self._headers(),
+        )
+        auth = (out or {}).get("auth") or {}
+        if not auth.get("client_token"):
+            raise ExternalError("vault returned no client_token")
+        return auth
+
+    def renew_self(self, token: str) -> Dict[str, Any]:
+        out = _http(
+            "POST",
+            f"{self.address}/v1/auth/token/renew-self",
+            {},
+            {"X-Vault-Token": token},
+        )
+        return (out or {}).get("auth") or {}
+
+    def revoke(self, token: str) -> None:
+        _http(
+            "POST",
+            f"{self.address}/v1/auth/token/revoke",
+            {"token": token},
+            self._headers(),
+        )
+
+    def read_secret(self, path: str) -> Optional[Dict[str, Any]]:
+        try:
+            out = _http(
+                "GET",
+                f"{self.address}/v1/{path.lstrip('/')}",
+                None,
+                self._headers(),
+            )
+        except ExternalError:
+            return None
+        data = (out or {}).get("data")
+        # KV v2 nests the payload one level deeper
+        if isinstance(data, dict) and set(data) >= {"data", "metadata"}:
+            return data["data"]
+        return data
+
+
+class VaultSecretsProvider:
+    """SecretsProvider (client/templates.py protocol) backed by a real
+    Vault — templates render `{{ secret "kv/web" "user" }}` through
+    the external API, matching the reference's consul-template
+    integration (taskrunner/template_hook)."""
+
+    def __init__(self, vault: VaultClient) -> None:
+        self.vault = vault
+
+    def read(self, path: str) -> Optional[Dict[str, Any]]:
+        return self.vault.read_secret(path)

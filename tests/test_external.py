@@ -1,0 +1,232 @@
+"""External Consul/Vault integration tests against fake local HTTP
+servers (reference model: command/agent/consul/*_test.go uses a local
+testutil consul; nomad/vault_test.go a mock vault).
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.external import (
+    ConsulClient,
+    ConsulSyncer,
+    ExternalError,
+    VaultClient,
+    VaultSecretsProvider,
+)
+
+
+class _FakeConsul(BaseHTTPRequestHandler):
+    services = {}
+
+    def _reply(self, body=None, code=200):
+        data = json.dumps(body).encode() if body is not None else b""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_PUT(self):
+        if self.path == "/v1/agent/service/register":
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            type(self).services[payload["ID"]] = payload
+            return self._reply({})
+        if self.path.startswith("/v1/agent/service/deregister/"):
+            sid = self.path.rsplit("/", 1)[1]
+            type(self).services.pop(sid, None)
+            return self._reply({})
+        if self.path.startswith("/v1/kv/"):
+            length = int(self.headers.get("Content-Length", 0))
+            key = self.path[len("/v1/kv/"):]
+            type(self).services.setdefault("_kv", {})[key] = (
+                self.rfile.read(length).decode()
+            )
+            return self._reply(True)
+        self._reply({}, 404)
+
+    def do_GET(self):
+        if self.path == "/v1/agent/services":
+            return self._reply(type(self).services)
+        if self.path.startswith("/v1/kv/"):
+            key = self.path[len("/v1/kv/"):].split("?")[0]
+            val = type(self).services.get("_kv", {}).get(key)
+            if val is None:
+                return self._reply(None, 404)
+            return self._reply(val)
+        self._reply({}, 404)
+
+    def log_message(self, *a):
+        pass
+
+
+class _FakeVault(BaseHTTPRequestHandler):
+    tokens = {}
+    secrets = {"secret/web": {"user": "admin", "pass": "hunter2"}}
+    revoked = []
+
+    def _reply(self, body=None, code=200):
+        data = json.dumps(body).encode() if body is not None else b""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(length) or b"{}")
+        if self.path == "/v1/auth/token/create":
+            if self.headers.get("X-Vault-Token") != "root":
+                return self._reply({"errors": ["permission denied"]}, 403)
+            tok = f"s.child{len(type(self).tokens)}"
+            type(self).tokens[tok] = payload
+            return self._reply(
+                {
+                    "auth": {
+                        "client_token": tok,
+                        "policies": payload.get("policies", []),
+                        "lease_duration": 3600,
+                        "renewable": True,
+                    }
+                }
+            )
+        if self.path == "/v1/auth/token/renew-self":
+            tok = self.headers.get("X-Vault-Token", "")
+            if tok not in type(self).tokens:
+                return self._reply({"errors": ["bad token"]}, 403)
+            return self._reply(
+                {"auth": {"client_token": tok, "lease_duration": 3600}}
+            )
+        if self.path == "/v1/auth/token/revoke":
+            type(self).revoked.append(payload.get("token"))
+            return self._reply({})
+        self._reply({}, 404)
+
+    def do_GET(self):
+        path = self.path.lstrip("/").removeprefix("v1/")
+        if path in type(self).secrets:
+            return self._reply({"data": type(self).secrets[path]})
+        self._reply({"errors": ["not found"]}, 404)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def consul():
+    _FakeConsul.services = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeConsul)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+@pytest.fixture
+def vault():
+    _FakeVault.tokens = {}
+    _FakeVault.revoked = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeVault)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_consul_register_deregister(consul):
+    c = ConsulClient(consul)
+    c.register_service(
+        "svc-1", "web", address="10.0.0.1", port=8080, tags=["v1"]
+    )
+    assert "svc-1" in c.services()
+    assert c.services()["svc-1"]["Port"] == 8080
+    c.deregister_service("svc-1")
+    assert "svc-1" not in c.services()
+
+
+def test_consul_kv(consul):
+    c = ConsulClient(consul)
+    c.kv_put("app/config", "hello")
+    assert c.kv_get("app/config") == "hello"
+    assert c.kv_get("missing") is None
+
+
+def test_consul_syncer_mirrors_catalog(consul):
+    from nomad_tpu.server import Server
+
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=3)
+    try:
+        syncer = ConsulSyncer(server.catalog, ConsulClient(consul))
+        syncer.attach(server.store)
+
+        node = mock.node()
+        server.store.upsert_node(node)
+        job = mock.job(id="websvc")
+        from nomad_tpu.structs import Service
+
+        job.task_groups[0].tasks[0].services = [
+            Service(name="frontend", port_label="http")
+        ]
+        server.store.upsert_job(job)
+        alloc = mock.alloc(node_id=node.id)
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.client_status = "running"
+        server.store.upsert_allocs([alloc])
+        server.catalog.sync()
+        syncer.sync()
+
+        c = ConsulClient(consul)
+        regs = c.services()
+        assert any(
+            v["Name"] == "frontend" for v in regs.values() if isinstance(v, dict) and "Name" in v
+        ), regs
+
+        # stopping the alloc deregisters on the next sync
+        alloc.desired_status = "stop"
+        alloc.client_status = "complete"
+        server.store.upsert_allocs([alloc])
+        server.catalog.sync()
+        syncer.sync()
+        regs = c.services()
+        assert not any(
+            isinstance(v, dict) and v.get("Name") == "frontend"
+            for v in regs.values()
+        )
+    finally:
+        server.stop()
+
+
+def test_vault_token_lifecycle(vault):
+    v = VaultClient(vault, token="root")
+    auth = v.derive_token(["web-policy"], metadata={"task": "t1"})
+    assert auth["client_token"].startswith("s.child")
+    assert auth["policies"] == ["web-policy"]
+
+    renewed = v.renew_self(auth["client_token"])
+    assert renewed["lease_duration"] == 3600
+
+    v.revoke(auth["client_token"])
+    assert auth["client_token"] in _FakeVault.revoked
+
+
+def test_vault_derive_requires_valid_token(vault):
+    v = VaultClient(vault, token="wrong")
+    with pytest.raises(ExternalError):
+        v.derive_token(["p"])
+
+
+def test_vault_secrets_provider_renders_templates(vault):
+    provider = VaultSecretsProvider(VaultClient(vault, token="root"))
+    data = provider.read("secret/web")
+    assert data == {"user": "admin", "pass": "hunter2"}
+    assert provider.read("secret/missing") is None
+
+    from nomad_tpu.client.templates import render_template
+
+    out = render_template(
+        'user={{ secret "secret/web" "user" }}', secrets=provider
+    )
+    assert out == "user=admin"
