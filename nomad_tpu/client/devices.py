@@ -58,14 +58,12 @@ class TPUDevicePlugin(DevicePlugin):
 
     def _detect(self):
         if self._devices is None:
-            try:
-                import jax
+            from .fingerprint import bounded_jax_devices
 
-                self._devices = [
-                    d for d in jax.devices() if d.platform != "cpu"
-                ]
-            except Exception:  # noqa: BLE001
-                self._devices = []
+            devices = bounded_jax_devices()
+            self._devices = [
+                d for d in (devices or []) if d.platform != "cpu"
+            ]
         return self._devices
 
     def fingerprint(self) -> List[NodeDeviceResource]:

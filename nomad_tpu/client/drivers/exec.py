@@ -310,6 +310,15 @@ class ExecDriver(RawExecDriver):
             "memory_mb": getattr(res, "memory_mb", 0) if res else 0,
             **self._log_spec(cfg),
         }
+        # a restart reuses the task id: reap the previous executor
+        # before spawning the replacement, or every restart leaks one
+        prev = self._clients.pop(cfg.id, None)
+        if prev is not None:
+            try:
+                prev.destroy(cfg.id, force=True)
+            except (RuntimeError, OSError):
+                pass
+            prev.shutdown()
         client = ex.ExecutorClient.spawn()
         try:
             info = client.launch(spec)

@@ -721,17 +721,23 @@ def serve(socket_path: str = "") -> None:
             return {}
         raise ValueError(f"unknown method {method!r}")
 
+    conns: set = set()
+
     def handle(conn: socket.socket) -> None:
-        while True:
-            frame = recv_frame(conn)
-            if frame is None:
-                return
-            method, body = decode(frame)
-            try:
-                result = dispatch(method, body)
-            except Exception as exc:  # noqa: BLE001
-                result = {"error": f"{type(exc).__name__}: {exc}"}
-            send_frame(conn, encode(result))
+        conns.add(conn)
+        try:
+            while True:
+                frame = recv_frame(conn)
+                if frame is None:
+                    return
+                method, body = decode(frame)
+                try:
+                    result = dispatch(method, body)
+                except Exception as exc:  # noqa: BLE001
+                    result = {"error": f"{type(exc).__name__}: {exc}"}
+                send_frame(conn, encode(result))
+        finally:
+            conns.discard(conn)
 
     def acceptor() -> None:
         while not shutdown.is_set():
@@ -743,7 +749,27 @@ def serve(socket_path: str = "") -> None:
                 target=handle, args=(conn,), daemon=True
             ).start()
 
+    def idle_reaper() -> None:
+        # self-exit when no driver is attached AND no task is running:
+        # done tasks with a vanished client must not leak executor
+        # processes, while a live task keeps the executor up for
+        # reattach (reference: go-plugin kills executors whose tasks
+        # died; reattach keeps them only while the task lives)
+        idle_since: Optional[float] = None
+        while not shutdown.is_set():
+            time.sleep(2.0)
+            busy = bool(conns) or any(
+                not t.done.is_set() for t in list(ex.tasks.values())
+            )
+            if busy:
+                idle_since = None
+            elif idle_since is None:
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since > 15.0:
+                shutdown.set()
+
     threading.Thread(target=acceptor, daemon=True).start()
+    threading.Thread(target=idle_reaper, daemon=True).start()
     while not shutdown.is_set():
         shutdown.wait(0.2)
     srv.close()

@@ -9,7 +9,7 @@ from __future__ import annotations
 import os
 import platform
 import socket
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..structs import Node, NodeDeviceResource, NodeResources
 
@@ -75,14 +75,41 @@ def fingerprint_host(node: Node) -> None:
         node.name = socket.gethostname()
 
 
+def bounded_jax_devices(timeout_s: Optional[float] = None):
+    """`jax.devices()` with a deadline.  On shared/tunneled
+    accelerators the enumeration can block indefinitely while another
+    process holds the chip; callers (node fingerprint, TPU device
+    plugin) must not wedge the client agent on it.  Returns None on
+    timeout/failure — a node that registers CPU-only stays CPU-only
+    until restart, which is the accepted trade for registering at
+    all."""
+    import threading
+
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("NOMAD_TPU_FINGERPRINT_TIMEOUT_S", "20")
+        )
+    box: Dict[str, List] = {}
+
+    def enumerate_devices() -> None:
+        try:
+            import jax
+
+            box["devices"] = jax.devices()
+        except Exception:  # noqa: BLE001
+            pass
+
+    t = threading.Thread(target=enumerate_devices, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return box.get("devices")
+
+
 def fingerprint_tpu(node: Node) -> None:
     """Detect attached accelerators via JAX; import is deferred and
     failures are non-fatal so CPU-only clients fingerprint cleanly."""
-    try:
-        import jax
-
-        devices = jax.devices()
-    except Exception:  # noqa: BLE001
+    devices = bounded_jax_devices()
+    if devices is None:
         return
     by_kind: Dict[str, List] = {}
     for d in devices:

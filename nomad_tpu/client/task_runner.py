@@ -220,6 +220,13 @@ class TaskRunner:
                 if not self._maybe_restart(result):
                     return
         finally:
+            # terminal teardown: release driver-side task resources
+            # (reference taskrunner DestroyTask in the cleanup hooks);
+            # executor-backed drivers shut their per-task executor here
+            try:
+                self.driver.destroy_task(self.task_id, force=True)
+            except Exception:  # noqa: BLE001
+                pass
             self._done.set()
 
     def _prestart_hooks(self) -> bool:

@@ -286,7 +286,7 @@ def test_exec_driver_reattach_across_restart(tmp_path):
         task_dir=str(task_dir),
         config={
             "command": "/bin/sh",
-            "args": ["-c", "sleep 5"],
+            "args": ["-c", "sleep 120"],
             "chroot": False,
         },
     )
