@@ -958,6 +958,27 @@ class APIHandler(BaseHTTPRequestHandler):
             )
             return True
 
+        if path == "/v1/agent/join" and method in ("POST", "PUT"):
+            # runtime cluster join (reference command/agent
+            # /v1/agent/join -> srv.Join via serf)
+            self._check_acl("agent:write")
+            addr = q.get("address") or (self._body() or {}).get(
+                "address", ""
+            )
+            if not addr:
+                raise HTTPError(400, "missing address")
+            join = getattr(srv, "join", None)
+            if join is None:
+                raise HTTPError(
+                    400, "this agent is not a cluster server"
+                )
+            try:
+                n = join(addr)
+            except Exception as exc:  # noqa: BLE001
+                raise HTTPError(500, f"join failed: {exc}")
+            self._respond({"num_joined": int(n or 0)})
+            return True
+
         if path == "/v1/agent/members" and method == "GET":
             gossip = getattr(srv, "gossip", None)
             self._respond(

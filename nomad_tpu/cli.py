@@ -957,6 +957,18 @@ def cmd_job_init(args) -> None:
     print(f"==> Example job file written to {path}")
 
 
+def cmd_server_join(args) -> None:
+    resp = _request(
+        "POST", "/v1/agent/join", {"address": args.address}
+    )
+    print(f"==> Joined {resp.get('num_joined', 0)} server(s)")
+
+
+def cmd_node_config(args) -> None:
+    n = _request("GET", f"/v1/node/{args.node_id}")
+    print(json.dumps(n, indent=2))
+
+
 def cmd_system(args) -> None:
     if args.action == "gc":
         _request("POST", "/v1/system/gc", {})
@@ -1109,6 +1121,9 @@ def build_parser() -> argparse.ArgumentParser:
     server_sub = server.add_subparsers(dest="server_cmd", required=True)
     sm = server_sub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+    sj = server_sub.add_parser("join")
+    sj.add_argument("address")
+    sj.set_defaults(fn=cmd_server_join)
 
     node = sub.add_parser("node")
     node_sub = node.add_subparsers(dest="node_cmd", required=True)
@@ -1123,6 +1138,9 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="deadline")
     nd.add_argument("node_id")
     nd.set_defaults(fn=cmd_node_drain)
+    nc = node_sub.add_parser("config")
+    nc.add_argument("node_id")
+    nc.set_defaults(fn=cmd_node_config)
     ne = node_sub.add_parser("eligibility")
     ne_group = ne.add_mutually_exclusive_group(required=True)
     ne_group.add_argument("-enable", action="store_true", dest="enable")

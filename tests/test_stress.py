@@ -1,0 +1,255 @@
+"""Concurrency stress tests with invariant checks — the framework's
+race-detection tooling (SURVEY §5: the reference leans on go test
+-race; Python has no tsan, so these tests drive the hot shared
+structures from many threads and assert the invariants that a race
+would break).
+"""
+import random
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import EvalBroker, Server
+from nomad_tpu.server.plan_apply import PlanApplier
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    AllocatedResources,
+    AllocatedTaskResources,
+    Plan,
+    allocs_fit,
+)
+
+
+def _resources(cpu, mem):
+    return AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu=cpu, memory_mb=mem)}
+    )
+
+
+def test_broker_concurrent_producers_consumers():
+    """Storm the broker from both sides: every eval must be delivered
+    and acked exactly once; nacks redeliver; nothing deadlocks."""
+    broker = EvalBroker(nack_timeout=5.0)
+    broker.set_enabled(True)
+    N_PRODUCERS, EVALS_EACH, N_CONSUMERS = 4, 50, 4
+    total = N_PRODUCERS * EVALS_EACH
+    acked = []
+    acked_lock = threading.Lock()
+    stop = threading.Event()
+
+    def producer(p):
+        for i in range(EVALS_EACH):
+            # distinct job ids so JobID dedup doesn't serialize the test
+            broker.enqueue(
+                mock.evaluation(job_id=f"job-{p}-{i}", priority=(i % 3) * 40)
+            )
+
+    def consumer(c):
+        rng = random.Random(c)
+        while not stop.is_set():
+            ev, token = broker.dequeue(["service"], timeout=0.2)
+            if ev is None:
+                continue
+            if rng.random() < 0.1:
+                broker.nack(ev.id, token)  # redelivered later
+                continue
+            with acked_lock:
+                acked.append(ev.id)
+            broker.ack(ev.id, token)
+
+    producers = [
+        threading.Thread(target=producer, args=(p,))
+        for p in range(N_PRODUCERS)
+    ]
+    consumers = [
+        threading.Thread(target=consumer, args=(c,), daemon=True)
+        for c in range(N_CONSUMERS)
+    ]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and len(acked) < total:
+        time.sleep(0.05)
+    stop.set()
+    for t in consumers:
+        t.join(timeout=2)
+    assert len(acked) == total
+    assert len(set(acked)) == total, "an eval was delivered-acked twice"
+
+
+def test_pipelined_applier_never_overcommits_under_storm():
+    """Many submitters race conflicting plans through the pipelined
+    applier (optimistic overlay + epoch invalidation): after the dust
+    settles, every node's live allocations must still fit — the
+    invariant the serialized applier exists to protect."""
+    store = StateStore()
+    nodes = [mock.node() for _ in range(6)]
+    for n in nodes:
+        store.upsert_node(n)
+    pq = PlanQueue()
+    pq.set_enabled(True)
+    applier = PlanApplier(store, pq)
+    applier.start()
+    N_THREADS, PLANS_EACH = 6, 15
+    results = []
+    res_lock = threading.Lock()
+
+    def submitter(s):
+        rng = random.Random(s)
+        for i in range(PLANS_EACH):
+            node = rng.choice(nodes)
+            alloc = mock.alloc(node_id=node.id)
+            # big enough that only ~2 fit per node: plenty of conflicts
+            alloc.allocated_resources = _resources(1500, 3000)
+            plan = Plan(
+                node_allocation={node.id: [alloc]},
+                priority=rng.choice([30, 50, 70]),
+            )
+            try:
+                pending = pq.enqueue(plan)
+                result = pending.wait(timeout=30)
+                with res_lock:
+                    results.append(result)
+            except (RuntimeError, TimeoutError) as exc:
+                with res_lock:
+                    results.append(exc)
+
+    threads = [
+        threading.Thread(target=submitter, args=(s,))
+        for s in range(N_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    applier.stop()
+    assert all(not t.is_alive() for t in threads), "submitter hung"
+    assert len(results) == N_THREADS * PLANS_EACH
+    assert not any(isinstance(r, Exception) for r in results), [
+        r for r in results if isinstance(r, Exception)
+    ][:3]
+    # THE invariant: no node is overcommitted
+    for n in nodes:
+        live = [
+            a for a in store.allocs_by_node(n.id)
+            if not a.terminal_status()
+        ]
+        fit, dim, _ = allocs_fit(n, live)
+        assert fit, f"node {n.id[:8]} overcommitted ({dim})"
+    committed = sum(
+        1 for r in results if r.node_allocation
+    )
+    rejected = sum(
+        1 for r in results if not r.node_allocation
+    )
+    # both outcomes must occur, or the conflict scenario didn't happen
+    assert committed >= 6
+    assert rejected >= 1, "storm produced no conflicts; weaken resources"
+
+
+def test_store_blocking_queries_with_concurrent_writes():
+    """Readers long-poll while writers churn: indexes observed by any
+    reader are monotonic and every write eventually wakes waiters."""
+    store = StateStore()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            store.upsert_node(mock.node())
+            i += 1
+            time.sleep(0.002)
+
+    def reader(r):
+        last = 0
+        while not stop.is_set():
+            woke = store.wait_for_index(last + 1, timeout=0.5)
+            idx = store.latest_index()
+            if idx < last:
+                errors.append(f"index went backwards {last}->{idx}")
+                return
+            if woke and idx <= last:
+                errors.append(
+                    f"woken without progress at {last} (idx {idx})"
+                )
+                return
+            last = idx
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] + [
+        threading.Thread(target=reader, args=(r,)) for r in range(4)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+
+
+@pytest.mark.slow
+def test_server_concurrent_job_registration_storm():
+    """Register jobs from many threads against a live server; every
+    job either fully places or produces a blocked eval — nothing is
+    lost and the final allocation set fits every node."""
+    server = Server(num_schedulers=2, heartbeat_ttl=60.0, seed=5)
+    server.start()
+    try:
+        for _ in range(8):
+            server.register_node(mock.node())
+        N_THREADS, JOBS_EACH = 4, 6
+        errors = []
+
+        def register(tid):
+            for i in range(JOBS_EACH):
+                job = mock.job(id=f"storm-{tid}-{i}")
+                job.task_groups[0].count = 2
+                try:
+                    server.register_job(job)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+        threads = [
+            threading.Thread(target=register, args=(t,))
+            for t in range(N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert server.drain_to_idle(30)
+        total_placed = 0
+        for tid in range(N_THREADS):
+            for i in range(JOBS_EACH):
+                allocs = [
+                    a
+                    for a in server.store.allocs_by_job(
+                        "default", f"storm-{tid}-{i}"
+                    )
+                    if not a.terminal_status()
+                ]
+                evs = server.store.evals_by_job(
+                    "default", f"storm-{tid}-{i}"
+                )
+                assert allocs or any(
+                    e.status == "blocked" for e in evs
+                ), f"job storm-{tid}-{i} vanished"
+                total_placed += len(allocs)
+        for n in server.store.iter_nodes():
+            live = [
+                a
+                for a in server.store.allocs_by_node(n.id)
+                if not a.terminal_status()
+            ]
+            fit, dim, _ = allocs_fit(n, live)
+            assert fit, f"node overcommitted ({dim})"
+        assert total_placed > 0
+    finally:
+        server.stop()
