@@ -294,6 +294,57 @@ def _resources(raw) -> Resources:
     )
 
 
+def _service(raw):
+    from ..structs import ConnectUpstream, ConsulConnect, Service
+
+    connect = None
+    cn = _get(raw, "connect", "Connect")
+    if cn:
+        connect = ConsulConnect(
+            native=bool(_get(cn, "native", "Native", default=False)),
+            sidecar_service=bool(
+                _get(cn, "sidecar_service", "SidecarService",
+                     default=False)
+            ),
+            upstreams=[
+                ConnectUpstream(
+                    destination_name=_get(
+                        u, "destination_name", "DestinationName",
+                        default="",
+                    ),
+                    local_bind_port=int(
+                        _get(
+                            u, "local_bind_port", "LocalBindPort",
+                            default=0,
+                        )
+                    ),
+                )
+                for u in _get(cn, "upstreams", "Upstreams", default=[])
+                or []
+            ],
+        )
+    return Service(
+        name=_get(raw, "name", "Name", default=""),
+        port_label=str(
+            _get(raw, "port_label", "PortLabel", "Port", default="")
+        ),
+        tags=_get(raw, "tags", "Tags", default=[]) or [],
+        checks=_get(raw, "checks", "Checks", default=[]) or [],
+        connect=connect,
+    )
+
+
+def _lifecycle(raw):
+    from ..structs import Lifecycle
+
+    if not raw:
+        return None
+    return Lifecycle(
+        hook=_get(raw, "hook", "Hook", default=""),
+        sidecar=bool(_get(raw, "sidecar", "Sidecar", default=False)),
+    )
+
+
 def _task(raw) -> Task:
     return Task(
         name=_get(raw, "name", "Name", default=""),
@@ -303,6 +354,11 @@ def _task(raw) -> Task:
         resources=_resources(_get(raw, "resources", "Resources")),
         constraints=_constraints(_get(raw, "constraints", "Constraints")),
         affinities=_affinities(_get(raw, "affinities", "Affinities")),
+        services=[
+            _service(s)
+            for s in _get(raw, "services", "Services", default=[]) or []
+        ],
+        lifecycle=_lifecycle(_get(raw, "lifecycle", "Lifecycle")),
         leader=bool(_get(raw, "leader", "Leader", default=False)),
         kill_timeout_s=float(
             _get(raw, "kill_timeout_s", "KillTimeout", default=5.0)

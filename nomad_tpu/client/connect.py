@@ -1,0 +1,129 @@
+"""Connect sidecar proxy: a minimal L4 forwarder standing in for the
+reference's Envoy sidecar (nomad's connect integration injects a
+"connect-proxy-<service>" task bootstrapped into Envoy; drivers/docker
++ envoybootstrap task-runner hook).
+
+Run as a task:  ``python -m nomad_tpu.client.connect
+--upstream web:9991 --upstream db:9992 [--inbound 8443:8080]``
+
+* Each ``--upstream dest:port`` listens on 127.0.0.1:port and forwards
+  every connection to the address in ``$NOMAD_CONNECT_TARGET_<DEST>``
+  (resolved from the service catalog by the task runner at launch,
+  exactly where the reference resolves upstreams into Envoy config).
+  App tasks reach the upstream via ``$NOMAD_UPSTREAM_ADDR_<DEST>`` =
+  ``127.0.0.1:<port>``, the same env contract the reference exposes.
+* ``--inbound listen:target`` accepts mesh traffic and forwards to the
+  local service port.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import socket
+import sys
+import threading
+
+
+def env_key(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9]", "_", name).upper()
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    """One direction; on EOF propagate a half-close (SHUT_WR on dst)
+    so the opposite direction keeps flowing — a client that shuts its
+    write side still gets the full response."""
+    try:
+        while True:
+            data = src.recv(65536)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        try:
+            dst.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+
+def _handle(conn: socket.socket, out: socket.socket) -> None:
+    a = threading.Thread(target=_pump, args=(conn, out), daemon=True)
+    b = threading.Thread(target=_pump, args=(out, conn), daemon=True)
+    a.start()
+    b.start()
+    a.join()
+    b.join()
+    for s in (conn, out):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def _serve(listen_port: int, target: str) -> None:
+    host, _, port = target.rpartition(":")
+    addr = (host or "127.0.0.1", int(port))
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", listen_port))
+    srv.listen(64)
+
+    def accept_loop() -> None:
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                out = socket.create_connection(addr, timeout=10)
+            except OSError:
+                conn.close()
+                continue
+            threading.Thread(
+                target=_handle, args=(conn, out), daemon=True
+            ).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="nomad-tpu-connect")
+    p.add_argument(
+        "--upstream", action="append", default=[],
+        help="dest:local_bind_port",
+    )
+    p.add_argument(
+        "--inbound", action="append", default=[],
+        help="listen_port:local_service_port",
+    )
+    args = p.parse_args(argv)
+    bound = 0
+    for spec in args.upstream:
+        dest, _, port = spec.rpartition(":")
+        target = os.environ.get(f"NOMAD_CONNECT_TARGET_{env_key(dest)}")
+        if not target:
+            # fail the task (all-or-nothing): the restart loop relaunches
+            # us and the task runner re-resolves from the catalog — the
+            # eventual-consistency analog of Envoy's dynamic re-resolution
+            print(
+                f"upstream {dest!r} not resolvable yet; exiting for "
+                "restart-retry",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        _serve(int(port), target)
+        bound += 1
+    for spec in args.inbound:
+        listen, _, local = spec.partition(":")
+        _serve(int(listen), f"127.0.0.1:{local}")
+        bound += 1
+    if not bound:
+        print("nothing to proxy", file=sys.stderr)
+        sys.exit(1)
+    threading.Event().wait()  # park forever; the driver stops us
+
+
+if __name__ == "__main__":
+    main()

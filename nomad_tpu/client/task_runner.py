@@ -158,6 +158,50 @@ class TaskRunner:
                     # they carry the allocdir-layout paths
                     config = self.task_env.replace_all(config)
                     env = {**env, **self.task_env.all()}
+                # connect sidecar: resolve upstream targets from the
+                # service catalog at launch (reference: upstreams are
+                # rendered into the Envoy bootstrap at sidecar start)
+                if config.get("connect_upstreams") is not None:
+                    # the in-tree proxy runs `python -m
+                    # nomad_tpu.client.connect` from the task dir:
+                    # use THIS client's interpreter + package (the
+                    # server that injected the task may live on a
+                    # different host/venv in networked clusters)
+                    import os as _os
+                    import sys as _sys
+
+                    import nomad_tpu as _pkg
+
+                    config["command"] = _sys.executable
+                    _root = _os.path.dirname(
+                        _os.path.dirname(_pkg.__file__)
+                    )
+                    _prev = env.get(
+                        "PYTHONPATH",
+                        _os.environ.get("PYTHONPATH", ""),
+                    )
+                    env["PYTHONPATH"] = (
+                        f"{_root}{_os.pathsep}{_prev}"
+                        if _prev
+                        else _root
+                    )
+                for item in config.get("connect_upstreams") or []:
+                    dest, _port = item[0], item[1]
+                    # brief launch-time wait: the upstream's alloc is
+                    # usually seconds behind; blocking here beats
+                    # bouncing the proxy through restart backoff
+                    deadline = time.time() + 10.0
+                    target = self._resolve_upstream(dest)
+                    while not target and time.time() < deadline:
+                        if self._kill.wait(0.25):
+                            break
+                        target = self._resolve_upstream(dest)
+                    if target:
+                        from .connect import env_key
+
+                        env[
+                            f"NOMAD_CONNECT_TARGET_{env_key(dest)}"
+                        ] = target
                 cfg = TaskConfig(
                     id=self.task_id,
                     name=self.task.name,
@@ -228,6 +272,20 @@ class TaskRunner:
             except Exception:  # noqa: BLE001
                 pass
             self._done.set()
+
+    def _resolve_upstream(self, dest: str) -> str:
+        """First healthy instance of a service, as host:port (reference
+        resolves upstreams through Consul's catalog)."""
+        if self.catalog is None:
+            return ""
+        try:
+            instances = self.catalog.instances(dest, healthy_only=True)
+        except Exception:  # noqa: BLE001
+            return ""
+        for inst in instances:
+            if inst.port:
+                return f"{inst.address or '127.0.0.1'}:{inst.port}"
+        return ""
 
     def _prestart_hooks(self) -> bool:
         """Dispatch-payload + artifact hooks (reference

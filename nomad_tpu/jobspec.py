@@ -328,10 +328,49 @@ def _task_dict(body: Dict) -> Dict:
         },
         "constraints": _constraint_dicts(body),
         "affinities": _affinity_dicts(body),
+        "services": [_service_dict(s) for s in _all(body.get("service"))],
         "leader": bool(body.get("leader", False)),
         "kill_timeout_s": _duration_s(body.get("kill_timeout"), 5.0),
         "meta": _first(body.get("meta"), {}) or {},
     }
+
+
+def _service_dict(body: Dict) -> Dict:
+    """service stanza incl. connect (reference jobspec/parse_service.go
+    + parse for connect/sidecar_service/proxy/upstreams)."""
+    out = {
+        "name": body.get("__label__", body.get("name", "")),
+        "port_label": str(body.get("port", "")),
+        "tags": body.get("tags", []) or [],
+        "checks": [
+            {
+                "type": c.get("type", "tcp"),
+                "name": c.get("__label__", c.get("name", "")),
+                "path": c.get("path", ""),
+                "interval_s": _duration_s(c.get("interval"), 10.0),
+                "timeout_s": _duration_s(c.get("timeout"), 2.0),
+            }
+            for c in _all(body.get("check"))
+        ],
+    }
+    connect = _first(body.get("connect"))
+    if connect:
+        sidecar = _first(connect.get("sidecar_service"))
+        proxy = _first(sidecar.get("proxy")) if sidecar else None
+        out["connect"] = {
+            "native": bool(connect.get("native", False)),
+            "sidecar_service": sidecar is not None,
+            "upstreams": [
+                {
+                    "destination_name": u.get("destination_name", ""),
+                    "local_bind_port": int(
+                        u.get("local_bind_port", 0)
+                    ),
+                }
+                for u in _all((proxy or {}).get("upstreams"))
+            ],
+        }
+    return out
 
 
 def _update_dict(body: Dict) -> Dict:

@@ -255,6 +255,7 @@ class Server:
 
     def register_job(self, job: Job) -> Evaluation:
         self._validate_job(job)
+        self._inject_connect_sidecars(job)
         self._interpolate_multiregion(job)
         self.store.upsert_job(job)
         if job.is_periodic() or job.is_parameterized():
@@ -272,6 +273,87 @@ class Server:
         self.store.upsert_evals([ev])
         self.on_eval_update(ev)
         return ev
+
+    def _inject_connect_sidecars(self, job: Job) -> None:
+        """Connect admission hook (reference job_endpoint_hooks.go
+        jobImplicitConstraints + the connect hook's sidecar injection:
+        each service with connect.sidecar_service gets a
+        'connect-proxy-<service>' task; upstream addresses surface to
+        the group's tasks as NOMAD_UPSTREAM_ADDR_<dest>, the
+        reference's env contract).  Our proxy is the in-tree L4
+        forwarder (client/connect.py) instead of Envoy."""
+        import sys as _sys
+
+        from ..structs import Lifecycle, Resources, Task
+
+        for tg in job.task_groups:
+            upstreams = []  # (dest, local_bind_port), deduped
+            seen_up = set()
+            sidecars = []  # service names needing a proxy
+            for task in tg.tasks:
+                for svc in getattr(task, "services", None) or []:
+                    cn = svc.connect
+                    if cn is None or cn.native:
+                        continue
+                    if cn.sidecar_service:
+                        sidecars.append(svc.name)
+                    for up in cn.upstreams:
+                        if up.local_bind_port <= 0:
+                            raise ValueError(
+                                f"connect upstream "
+                                f"{up.destination_name!r} requires a "
+                                "positive local_bind_port"
+                            )
+                        key = (
+                            up.destination_name, up.local_bind_port
+                        )
+                        if key in seen_up:
+                            continue
+                        seen_up.add(key)
+                        upstreams.append(key)
+            if not sidecars and not upstreams:
+                continue
+            existing = {t.name for t in tg.tasks}
+            proxy_name = (
+                f"connect-proxy-{sidecars[0]}"
+                if sidecars
+                else "connect-proxy"
+            )
+            # expose the upstream binds to every app task (reference
+            # taskenv: NOMAD_UPSTREAM_ADDR_<dest>=127.0.0.1:<port>)
+            from ..client.connect import env_key
+
+            for task in tg.tasks:
+                for dest, port in upstreams:
+                    task.env.setdefault(
+                        f"NOMAD_UPSTREAM_ADDR_{env_key(dest)}",
+                        f"127.0.0.1:{port}",
+                    )
+            if proxy_name in existing:
+                continue  # idempotent across re-registers
+            argv = []
+            for dest, port in upstreams:
+                argv += ["--upstream", f"{dest}:{port}"]
+            if not argv and sidecars:
+                # inbound-only sidecar: nothing to bind in the lite
+                # proxy; skip injecting a no-op task
+                continue
+            tg.tasks.append(
+                Task(
+                    name=proxy_name,
+                    driver="raw_exec",
+                    config={
+                        "command": _sys.executable,
+                        "args": ["-m", "nomad_tpu.client.connect"]
+                        + argv,
+                        "connect_upstreams": [
+                            [dest, port] for dest, port in upstreams
+                        ],
+                    },
+                    resources=Resources(cpu=100, memory_mb=64),
+                    lifecycle=Lifecycle(hook="prestart", sidecar=True),
+                )
+            )
 
     def _interpolate_multiregion(self, job: Job) -> None:
         """Specialize a multiregion job for the region it landed in

@@ -125,8 +125,13 @@ class ServiceCatalog:
                         job_id=alloc.job_id,
                         task=task.name,
                         address=address,
-                        port=port_by_label.get(
-                            service.port_label, 0
+                        # label lookup, falling back to literal static
+                        # ports (reference: numeric port labels)
+                        port=port_by_label.get(service.port_label, 0)
+                        or (
+                            int(service.port_label)
+                            if str(service.port_label).isdigit()
+                            else 0
                         ),
                         tags=list(service.tags),
                         healthy=running and checks_ok,

@@ -609,11 +609,30 @@ class Lifecycle:
 
 
 @dataclass
+class ConnectUpstream:
+    """(reference structs.go ConsulUpstream)"""
+
+    destination_name: str = ""
+    local_bind_port: int = 0
+
+
+@dataclass
+class ConsulConnect:
+    """Service-mesh stanza (reference structs.go ConsulConnect:
+    sidecar_service + proxy upstreams; native mode skips the proxy)."""
+
+    native: bool = False
+    sidecar_service: bool = False
+    upstreams: List[ConnectUpstream] = field(default_factory=list)
+
+
+@dataclass
 class Service:
     name: str = ""
     port_label: str = ""
     tags: List[str] = field(default_factory=list)
     checks: List[Dict[str, Any]] = field(default_factory=list)
+    connect: Optional[ConsulConnect] = None
 
 
 @dataclass
