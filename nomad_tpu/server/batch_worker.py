@@ -449,8 +449,19 @@ class BatchWorker(Worker):
             for sp in list(tg.spreads) + list(job.spreads)
         ):
             return False
-        if tg.networks or any(t.resources.networks for t in tg.tasks):
-            return False
+        # host-mode network asks ARE batchable: the kernel scores
+        # port-blind, and the winner's exact BinPack verification
+        # (PrescoredStack.select) runs the full NetworkIndex port
+        # assignment — a port-exhausted winner deviates to the
+        # sequential path, so plans stay bit-identical and the common
+        # case (dynamic ports, no contention) keeps the fast path.
+        # Non-host modes gate on NetworkChecker feasibility the kernel
+        # doesn't model, so they stay sequential.
+        for nw in list(tg.networks) + [
+            n for t in tg.tasks for n in t.resources.networks
+        ]:
+            if (nw.mode or "host") != "host":
+                return False
         if any(t.resources.devices for t in tg.tasks):
             return False
         # distinct_hosts IS batchable: for single-TG jobs the kernel's

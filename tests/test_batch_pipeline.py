@@ -518,3 +518,104 @@ def test_batch_pipeline_steady_state_spread_matches_sequential():
     finally:
         seq.stop()
         bat.stop()
+
+
+def test_batch_pipeline_network_jobs_match_sequential():
+    """Host-mode dynamic-port jobs ride the fast path: the kernel is
+    port-blind but the winner's exact verification assigns real ports,
+    so plans match the sequential worker bit-for-bit."""
+    from nomad_tpu.structs import NetworkResource, Port
+
+    nodes = make_nodes(12, seed=31)
+    jobs = make_jobs(4, seed=32)
+    for j in jobs:
+        j.task_groups[0].networks = [
+            NetworkResource(
+                dynamic_ports=[Port("http"), Port("admin")]
+            )
+        ]
+
+    seq = Server(num_schedulers=1, seed=55, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=55, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(20)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(40)
+        for job in jobs:
+            assert placements(seq, job.id) == placements(bat, job.id)
+        # the network jobs actually used the fast path
+        worker = bat.workers[0]
+        assert worker.prescored >= 1, (
+            worker.prescored,
+            worker.fallbacks,
+        )
+        # placed allocs carry real port assignments
+        some = [
+            a
+            for a in bat.store.allocs_by_job("default", jobs[0].id)
+            if not a.terminal_status()
+        ]
+        assert some
+        for a in some:
+            ports = a.allocated_resources.shared.ports
+            assert {p.label for p in ports} == {"http", "admin"}
+            assert all(p.value > 0 for p in ports)
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_static_port_contention_identical():
+    """Static-port exhaustion: the kernel may pick a port-full node,
+    the winner verification rejects it, and the eval deviates to the
+    sequential path — outcomes stay identical, including the blocked
+    eval when nothing fits."""
+    from nomad_tpu.structs import NetworkResource, Port
+
+    nodes = make_nodes(3, seed=41)
+
+    def static_job(jid, count):
+        job = mock.job(id=jid)
+        job.task_groups[0].count = count
+        job.task_groups[0].tasks[0].resources.cpu = 100
+        job.task_groups[0].networks = [
+            NetworkResource(reserved_ports=[Port("svc", 8080)])
+        ]
+        return job
+
+    seq = Server(num_schedulers=1, seed=66, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=66, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        for server in (seq, bat):
+            server.register_job(static_job("port-a", 3))
+        assert seq.drain_to_idle(20)
+        assert bat.drain_to_idle(20)
+        assert placements(seq, "port-a") == placements(bat, "port-a")
+        assert len(placements(bat, "port-a")) == 3
+        # every node's 8080 is now taken: the second job must block on
+        # both servers
+        for server in (seq, bat):
+            server.register_job(static_job("port-b", 1))
+        assert seq.drain_to_idle(20)
+        assert bat.drain_to_idle(20)
+        assert placements(seq, "port-b") == placements(bat, "port-b")
+        assert placements(bat, "port-b") == []
+        for server in (seq, bat):
+            evs = server.store.evals_by_job("default", "port-b")
+            assert any(e.status == "blocked" for e in evs)
+    finally:
+        seq.stop()
+        bat.stop()
