@@ -449,18 +449,24 @@ class BatchWorker(Worker):
             for sp in list(tg.spreads) + list(job.spreads)
         ):
             return False
-        # host-mode network asks ARE batchable: the kernel scores
-        # port-blind, and the winner's exact BinPack verification
-        # (PrescoredStack.select) runs the full NetworkIndex port
-        # assignment — a port-exhausted winner deviates to the
-        # sequential path, so plans stay bit-identical and the common
-        # case (dynamic ports, no contention) keeps the fast path.
-        # Non-host modes gate on NetworkChecker feasibility the kernel
-        # doesn't model, so they stay sequential.
+        # host-mode DYNAMIC-port asks are batchable: binpack never
+        # skips a node for a dynamic-only ask (the per-node range is
+        # thousands of ports), so the sequential walk window is
+        # port-independent and the kernel's port-blind scoring stays
+        # bit-identical; the winner's exact BinPack verification
+        # (PrescoredStack.select) still assigns the real ports.
+        # Reserved/static ports stay sequential: a port-collided node
+        # is skipped by binpack WITHOUT consuming a limit slot
+        # (rank.py continue), an asymmetry the kernel's window
+        # arithmetic cannot see — winner-only verification would miss
+        # divergent windows. Non-host modes gate on NetworkChecker
+        # feasibility the kernel doesn't model either.
         for nw in list(tg.networks) + [
             n for t in tg.tasks for n in t.resources.networks
         ]:
             if (nw.mode or "host") != "host":
+                return False
+            if nw.reserved_ports:
                 return False
         if any(t.resources.devices for t in tg.tasks):
             return False

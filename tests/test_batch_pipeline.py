@@ -574,10 +574,10 @@ def test_batch_pipeline_network_jobs_match_sequential():
 
 
 def test_batch_pipeline_static_port_contention_identical():
-    """Static-port exhaustion: the kernel may pick a port-full node,
-    the winner verification rejects it, and the eval deviates to the
-    sequential path — outcomes stay identical, including the blocked
-    eval when nothing fits."""
+    """Reserved-port jobs take the sequential path (a port-collided
+    node is skipped by binpack without consuming a walk-limit slot —
+    an asymmetry the kernel can't see), and outcomes stay identical,
+    including the blocked eval when every node's port is taken."""
     from nomad_tpu.structs import NetworkResource, Port
 
     nodes = make_nodes(3, seed=41)
