@@ -743,6 +743,8 @@ def _preflight() -> None:
     import threading
 
     total_s = float(os.environ.get("BENCH_PREFLIGHT_S", 600))
+    if total_s <= 0:
+        return  # explicit opt-out
     deadline = time.monotonic() + total_s
     box: dict = {}
 
@@ -762,6 +764,11 @@ def _preflight() -> None:
                     jax.jit(lambda a: a + 1)(jnp.ones(8)).sum()
                 )
                 return
+            except ImportError as exc:
+                # permanent: no amount of waiting installs jax
+                box["err"] = f"{type(exc).__name__}: {exc}"
+                box["fatal"] = True
+                return
             except Exception as exc:  # noqa: BLE001
                 box["err"] = f"{type(exc).__name__}: {exc}"
                 time.sleep(10.0)
@@ -773,6 +780,9 @@ def _preflight() -> None:
             if logged or "err" in box:
                 log("preflight: device ok after retrying")
             return
+        if box.get("fatal"):
+            log(f"preflight: fatal: {box['err']}")
+            sys.exit(2)
         if not logged and time.monotonic() > deadline - total_s + 45:
             log("preflight: device init slow/blocked; waiting")
             logged = True

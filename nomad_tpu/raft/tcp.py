@@ -25,12 +25,47 @@ from __future__ import annotations
 import socket
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import wire
 from .transport import TransportError
 
 Handler = Callable[[str, dict], dict]
+
+
+@dataclass
+class TLSConfig:
+    """Mutual-TLS material for the cluster transport (reference
+    helper/tlsutil/config.go: verify_incoming + verify_outgoing with a
+    shared CA — every server presents a cert and verifies its peer's).
+    """
+
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+
+    def server_context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        ctx.verify_mode = ssl.CERT_REQUIRED  # verify_incoming
+        return ctx
+
+    def client_context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        # server certs are issued per-cluster, not per-hostname:
+        # authentication is the CA + cert requirement, like the
+        # reference's region-wildcard server names
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED  # verify_outgoing
+        return ctx
 
 CONNECT_TIMEOUT = 0.5
 CALL_TIMEOUT = 5.0
@@ -60,12 +95,14 @@ class TcpTransport:
     concurrent use — each call checks a free connection out of the
     pool."""
 
-    def __init__(self) -> None:
+    def __init__(self, tls: Optional[TLSConfig] = None) -> None:
         self._lock = threading.Lock()
         self._listeners: Dict[str, "_Listener"] = {}
         self._pools: Dict[str, List[socket.socket]] = {}
         self._breaker: Dict[str, float] = {}  # addr -> retry-after ts
         self.call_timeout = CALL_TIMEOUT
+        self.tls = tls
+        self._client_ctx = tls.client_context() if tls else None
 
     # -- server side ---------------------------------------------------
 
@@ -80,7 +117,7 @@ class TcpTransport:
             if existing is not None:
                 existing.handler = handler
                 return
-        listener = _Listener(addr, host, port, handler)
+        listener = _Listener(addr, host, port, handler, tls=self.tls)
         with self._lock:
             self._listeners[addr] = listener
         listener.start()
@@ -171,6 +208,8 @@ class TcpTransport:
                 (host, port), timeout=CONNECT_TIMEOUT
             )
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._client_ctx is not None:
+                sock = self._client_ctx.wrap_socket(sock)
         except OSError as exc:
             self._breaker[dst] = time.monotonic() + BREAKER_WINDOW
             raise TransportError(f"dial {dst} failed: {exc}") from exc
@@ -192,10 +231,16 @@ class TcpTransport:
 
 class _Listener:
     def __init__(
-        self, addr: str, host: str, port: int, handler: Handler
+        self,
+        addr: str,
+        host: str,
+        port: int,
+        handler: Handler,
+        tls: Optional[TLSConfig] = None,
     ) -> None:
         self.addr = addr
         self.handler = handler
+        self._server_ctx = tls.server_context() if tls else None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -247,8 +292,19 @@ class _Listener:
             except OSError:
                 return  # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._conn_lock:
-                self._conns.append(conn)
+            if self._server_ctx is not None:
+                # handshake on the serve thread, not here: a client
+                # that never handshakes must not stall the accept loop
+                t = threading.Thread(
+                    target=self._serve_tls,
+                    args=(conn,),
+                    name=f"tcp-tls-{self.addr}",
+                    daemon=True,
+                )
+                t.start()
+                continue
+            if not self._track(conn):
+                continue
             t = threading.Thread(
                 target=self._serve_conn,
                 args=(conn,),
@@ -256,6 +312,40 @@ class _Listener:
                 daemon=True,
             )
             t.start()
+
+    def _serve_tls(self, raw_conn: socket.socket) -> None:
+        import ssl
+
+        try:
+            raw_conn.settimeout(5.0)
+            conn = self._server_ctx.wrap_socket(
+                raw_conn, server_side=True
+            )
+            conn.settimeout(None)
+        except (ssl.SSLError, OSError):
+            # bad cert / plaintext client: drop it
+            try:
+                raw_conn.close()
+            except OSError:
+                pass
+            return
+        if not self._track(conn):
+            return
+        self._serve_conn(conn)
+
+    def _track(self, conn: socket.socket) -> bool:
+        """Register a live connection, or close it when the listener
+        already shut down — the append must never race past close()'s
+        sweep (the TLS handshake widens that window to seconds)."""
+        with self._conn_lock:
+            if not self._stop.is_set():
+                self._conns.append(conn)
+                return True
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return False
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:

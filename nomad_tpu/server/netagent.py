@@ -47,13 +47,29 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--heartbeat-interval", type=float, default=0.15
     )
+    parser.add_argument(
+        "--tls-ca", default="",
+        help="CA bundle for mutual-TLS server<->server RPC "
+        "(reference helper/tlsutil; requires --tls-cert/--tls-key)",
+    )
+    parser.add_argument("--tls-cert", default="")
+    parser.add_argument("--tls-key", default="")
     args = parser.parse_args(argv)
 
     from ..api.http import start_http_server
-    from ..raft.tcp import TcpTransport
+    from ..raft.tcp import TcpTransport, TLSConfig
     from .cluster import ClusterServer
 
-    transport = TcpTransport()
+    tls = None
+    if args.tls_ca or args.tls_cert or args.tls_key:
+        if not (args.tls_ca and args.tls_cert and args.tls_key):
+            parser.error("--tls-ca, --tls-cert and --tls-key go together")
+        tls = TLSConfig(
+            ca_file=args.tls_ca,
+            cert_file=args.tls_cert,
+            key_file=args.tls_key,
+        )
+    transport = TcpTransport(tls=tls)
     server = ClusterServer(
         args.addr,
         [p for p in args.peers.split(",") if p],
