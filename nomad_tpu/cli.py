@@ -291,12 +291,30 @@ def cmd_job_dispatch(args) -> None:
 
 def cmd_alloc_logs(args) -> None:
     kind = "stderr" if args.stderr else "stdout"
-    resp = _request(
-        "GET",
+    path = (
         f"/v1/client/fs/logs/{args.alloc_id}?task={args.task}"
-        f"&type={kind}",
+        f"&type={kind}"
     )
-    sys.stdout.write(resp.get("Data", ""))
+    data = _request("GET", path).get("Data", "")
+    sys.stdout.write(data)
+    if not getattr(args, "follow", False):
+        return
+    # -f: tail by polling and printing the delta (reference streams
+    # frames over a chunked connection; same observable behavior)
+    sys.stdout.flush()
+    printed = len(data)
+    try:
+        while True:
+            time.sleep(0.5)
+            data = _request("GET", path).get("Data", "")
+            if len(data) < printed:
+                printed = 0  # rotated: restart from the top of file
+            if len(data) > printed:
+                sys.stdout.write(data[printed:])
+                sys.stdout.flush()
+                printed = len(data)
+    except KeyboardInterrupt:
+        pass
 
 
 def cmd_job_history(args) -> None:
@@ -1155,6 +1173,7 @@ def build_parser() -> argparse.ArgumentParser:
     als.set_defaults(fn=cmd_alloc_status)
     all_ = alloc_sub.add_parser("logs")
     all_.add_argument("-stderr", action="store_true", dest="stderr")
+    all_.add_argument("-f", action="store_true", dest="follow")
     all_.add_argument("alloc_id")
     all_.add_argument("task")
     all_.set_defaults(fn=cmd_alloc_logs)
@@ -1313,6 +1332,7 @@ def build_parser() -> argparse.ArgumentParser:
     ti.set_defaults(fn=cmd_job_init)
     tl = sub.add_parser("logs")
     tl.add_argument("-stderr", action="store_true", dest="stderr")
+    tl.add_argument("-f", action="store_true", dest="follow")
     tl.add_argument("alloc_id")
     tl.add_argument("task")
     tl.set_defaults(fn=cmd_alloc_logs)
