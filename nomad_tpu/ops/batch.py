@@ -71,6 +71,10 @@ class SpreadInputs(NamedTuple):
     cleared0: jnp.ndarray  # f[S, V+1] pre-staged plan stops per slot
     weight: jnp.ndarray  # f[S] weight / sum(|weights|)
     active: jnp.ndarray  # bool[S] (padding rows are inert)
+    # even-spread mode (no targets, reference spread.go:178): min/max
+    # balance boost over the observed use map, UNWEIGHTED (the oracle
+    # adds evenSpreadScoreBoost without the weight fraction)
+    even: jnp.ndarray = None  # bool[S]
 
 
 class StepDeltas(NamedTuple):
@@ -356,11 +360,64 @@ def _run_picks(
                 "scv,sv->sc", onehot_p, combined
             )
             frac = (desired_node - (used_node + 1.0)) / safe_desired
-            contrib = jnp.where(
-                penalty_node,
-                jnp.asarray(-1.0, dtype),
-                frac * spread.weight[:, None],
+            pct_contrib = frac * spread.weight[:, None]
+            if spread.even is not None:
+                # even mode (spread.py even_spread_score_boost):
+                # map membership is existing∪proposed BEFORE the
+                # cleared subtraction (a value zeroed by cleared stays
+                # in the map; cleared-only values never enter)
+                V1_ = combined.shape[-1]
+                value_slot = (
+                    jnp.arange(V1_) < (V1_ - 1)
+                )  # excl. penalty
+                present = (
+                    (spread_existing + spread_prop) > 0
+                ) & value_slot
+                has_map = present.any(axis=-1)  # (S,)
+                big = jnp.asarray(jnp.inf, dtype)
+                min_c = jnp.min(
+                    jnp.where(present, combined, big), axis=-1
+                )
+                max_c = jnp.max(
+                    jnp.where(present, combined, -big), axis=-1
+                )
+                min_b = min_c[:, None]
+                max_b = max_c[:, None]
+                safe_min = jnp.where(min_b > 0, min_b, 1.0)
+                delta_boost = jnp.where(
+                    min_b == 0.0, -1.0, (min_b - used_node) / safe_min
+                )
+                even_val = jnp.where(
+                    used_node != min_b,
+                    delta_boost,
+                    jnp.where(
+                        min_b == max_b,
+                        -1.0,
+                        jnp.where(
+                            min_b == 0.0,
+                            1.0,
+                            (max_b - min_b) / safe_min,
+                        ),
+                    ),
+                )
+                # an empty use map short-circuits to 0.0 BEFORE the
+                # missing-attribute penalty (spread.py boost order)
+                even_full = jnp.where(
+                    has_map[:, None],
+                    jnp.where(
+                        penalty_node, jnp.asarray(-1.0, dtype), even_val
+                    ),
+                    0.0,
+                )
+            pct_full = jnp.where(
+                penalty_node, jnp.asarray(-1.0, dtype), pct_contrib
             )
+            if spread.even is not None:
+                contrib = jnp.where(
+                    spread.even[:, None], even_full, pct_full
+                )
+            else:
+                contrib = pct_full
             contrib = jnp.where(
                 spread.active[:, None], contrib, 0.0
             )

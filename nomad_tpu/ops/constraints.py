@@ -225,11 +225,16 @@ class MaskCompiler:
         proposed0 = np.zeros(V + 1, dtype=np.float64)
         cleared0 = np.zeros(V + 1, dtype=np.float64)
         for i, value in enumerate(vocab):
-            d = desired_counts.get(value)
-            if d is None:
-                d = desired_counts.get("*")
-            if d is None:
-                continue  # stays on the penalty slot
+            if desired_counts is None:
+                # even-spread mode (no targets): every observed value
+                # gets a slot; desired is unused
+                d = 0.0
+            else:
+                d = desired_counts.get(value)
+                if d is None:
+                    d = desired_counts.get("*")
+                if d is None:
+                    continue  # stays on the penalty slot
             slot_of[i] = i
             desired[i] = d
             used0[i] = float(existing_use.get(value, 0))

@@ -442,13 +442,9 @@ class BatchWorker(Worker):
         if len(job.task_groups) != 1:
             return False
         tg = job.task_groups[0]
-        # percent-target spreads run in-kernel (SpreadInputs carry);
-        # even-spread mode (no targets) stays on the exact path
-        if any(
-            not sp.targets
-            for sp in list(tg.spreads) + list(job.spreads)
-        ):
-            return False
+        # both spread modes run in-kernel: percent targets via the
+        # desired/used carry, even mode (no targets) via min/max over
+        # the observed use map (ops/batch.py even_full)
         # host-mode DYNAMIC-port asks are batchable: binpack never
         # skips a node for a dynamic-only ask (the per-node range is
         # thousands of ports), so the sequential walk window is
@@ -571,6 +567,37 @@ class BatchWorker(Worker):
                 sim.spread_proposed[sp.attribute] = _count_values(
                     snap, sp.attribute, staged
                 )
+            # even-mode guard: the oracle's min/max loop reproduces the
+            # reference's zero-reset idiom (spread.py:162 "if min_count
+            # == 0 or v < min_count"), whose result depends on map
+            # iteration order once a use-map value sits at count 0.
+            # That only happens when cleared zeroes a present value —
+            # so evals whose even stanzas start with a zeroed value, or
+            # that stage destructive evictions (cleared can grow
+            # mid-chain), take the exact sequential path.
+            from ..sched.spread import compute_spread_info as _csi
+
+            infos, _w = _csi(combined_spreads, tg.count)
+            has_even = any(
+                not infos[sp.attribute]["desired_counts"]
+                for sp in combined_spreads
+            )
+            if has_even:
+                # cleared grows mid-chain only via per-pick
+                # destructive evictions; pre-staged stops are static
+                # and covered by the value-level zero check below
+                if results.destructive_update:
+                    return None
+                for sp in combined_spreads:
+                    if infos[sp.attribute]["desired_counts"]:
+                        continue
+                    ex = sim.spread_existing[sp.attribute]
+                    pr = sim.spread_proposed[sp.attribute]
+                    cl = sim.spread_cleared[sp.attribute]
+                    for value in set(ex) | set(pr):
+                        raw = ex.get(value, 0) + pr.get(value, 0)
+                        if raw > 0 and raw - cl.get(value, 0) <= 0:
+                            return None
 
         def add_pre(node_id: str, c: float, m: float, d: float) -> None:
             row = table.row_of.get(node_id)
@@ -919,10 +946,18 @@ class BatchWorker(Worker):
                 # group-level — spread.py set_task_group ordering)
                 for sp in list(job.spreads) + list(tg.spreads):
                     attr_info = info[sp.attribute]
+                    # mode follows the MERGED per-attribute info like
+                    # the sequential SpreadIterator ("if not
+                    # desired_counts"): duplicate attributes with
+                    # mixed target presence score in the overwrite
+                    # winner's mode on BOTH paths
+                    even = not attr_info["desired_counts"]
                     codes, desired, used0, prop0, cleared0 = (
                         compiler.spread_kernel_inputs(
                             sp.attribute,
-                            attr_info["desired_counts"],
+                            None
+                            if even
+                            else attr_info["desired_counts"],
                             sim.spread_existing.get(
                                 sp.attribute, {}
                             ),
@@ -934,8 +969,14 @@ class BatchWorker(Worker):
                     )
                     eval_spreads.append(
                         (codes, desired, used0, prop0, cleared0,
-                         float(attr_info["weight"])
-                         / float(spread_sum_w))
+                         # even boosts are UNWEIGHTED (spread.py adds
+                         # even_spread_score_boost without the weight
+                         # fraction)
+                         0.0
+                         if even
+                         else float(attr_info["weight"])
+                         / float(spread_sum_w),
+                         even)
                     )
             spread_per_eval.append(eval_spreads)
 
@@ -1051,7 +1092,7 @@ class BatchWorker(Worker):
                     (
                         len(d)
                         for s in spread_per_eval
-                        for (_c, d, _u, _p, _cl, _w) in (s or ())
+                        for (_c, d, _u, _p, _cl, _w, _e) in (s or ())
                     ),
                     default=1,
                 ),
@@ -1064,8 +1105,11 @@ class BatchWorker(Worker):
             s_cleared0 = np.zeros((E, S, V1))
             s_weight = np.zeros((E, S))
             s_active = np.zeros((E, S), dtype=bool)
+            s_even = np.zeros((E, S), dtype=bool)
             for k, s in enumerate(spread_per_eval):
-                for j, (c, d, u, p0, cl, w) in enumerate(s or ()):
+                for j, (c, d, u, p0, cl, w, ev_mode) in enumerate(
+                    s or ()
+                ):
                     # this eval's penalty slot moves to the shared
                     # V1-1 slot under padding
                     pen = len(d) - 1
@@ -1076,6 +1120,7 @@ class BatchWorker(Worker):
                     s_cleared0[k, j, : pen] = cl[:-1]
                     s_weight[k, j] = w
                     s_active[k, j] = True
+                    s_even[k, j] = ev_mode
             spread_stack = SpreadInputs(
                 codes=s_codes,
                 desired=s_desired,
@@ -1084,6 +1129,9 @@ class BatchWorker(Worker):
                 cleared0=s_cleared0,
                 weight=s_weight,
                 active=s_active,
+                # None keeps percent-only workloads on the cheaper
+                # kernel path (the even math never traces)
+                even=s_even if s_even.any() else None,
             )
         spread_fit = (
             snap.scheduler_config().effective_scheduler_algorithm()

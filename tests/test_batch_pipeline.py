@@ -217,25 +217,117 @@ def test_batch_pipeline_spread_in_kernel_matches_sequential():
         bat.stop()
 
 
-def test_batch_pipeline_even_spread_still_falls_back():
+def test_batch_pipeline_even_spread_in_kernel_matches():
+    """Even-spread mode (no targets) runs in-kernel: min/max balance
+    boosts over the observed use map, bit-identical to the sequential
+    SpreadIterator (spread.py even_spread_score_boost)."""
+    import random as _random
+
     from nomad_tpu.structs import Spread
 
-    server = Server(num_schedulers=1, seed=7, batch_pipeline=True)
-    server.start()
-    try:
-        for node in make_nodes(8, seed=3):
-            server.register_node(node)
-        job = mock.job(id="even-spread")
-        job.task_groups[0].count = 4
-        # no targets -> even-spread mode -> exact path
+    nodes = make_nodes(12, seed=3)
+    rng = _random.Random(5)
+    for n in nodes:
+        n.datacenter = rng.choice(["dc1", "dc2", "dc3"])
+        n.computed_class = compute_node_class(n)
+
+    def even_job(i, count):
+        job = mock.job(
+            id=f"even-{i}", datacenters=["dc1", "dc2", "dc3"]
+        )
+        job.task_groups[0].count = count
         job.spreads = [
             Spread(attribute="${node.datacenter}", weight=50)
         ]
-        server.register_job(job)
-        assert server.drain_to_idle(15)
-        assert len(placements(server, "even-spread")) == 4
+        return job
+
+    seq = Server(num_schedulers=1, seed=7, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=7, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        jobs = [even_job(i, 3 + i) for i in range(4)]
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(30)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(60)
+        for job in jobs:
+            assert placements(seq, job.id) == placements(bat, job.id), (
+                job.id
+            )
+        worker = bat.workers[0]
+        assert worker.prescored >= 1, (
+            worker.prescored, worker.fallbacks,
+        )
+        # scale-up: steady-state even-spread (live allocs feed the
+        # use map) stays identical too
+        for server in (seq, bat):
+            grown = even_job(0, 8)
+            grown.version = 1
+            server.register_job(grown)
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "even-0") == placements(bat, "even-0")
     finally:
-        server.stop()
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_mixed_percent_and_even_spreads_match():
+    """A job mixing a percent-target stanza with an even stanza on a
+    different attribute exercises both kernel paths at once."""
+    import random as _random
+
+    from nomad_tpu.structs import Spread, SpreadTarget
+
+    nodes = make_nodes(12, seed=9)
+    rng = _random.Random(11)
+    for n in nodes:
+        n.datacenter = rng.choice(["dc1", "dc2"])
+        n.attributes["rack"] = rng.choice(["r0", "r1", "r2"])
+        n.computed_class = compute_node_class(n)
+
+    def mixed_job(count):
+        job = mock.job(id="mixed", datacenters=["dc1", "dc2"])
+        job.task_groups[0].count = count
+        job.spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=60,
+                targets=[
+                    SpreadTarget(value="dc1", percent=70),
+                    SpreadTarget(value="dc2", percent=30),
+                ],
+            ),
+            Spread(attribute="${attr.rack}", weight=40),
+        ]
+        return job
+
+    seq = Server(num_schedulers=1, seed=13, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=13, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        seq.register_job(mixed_job(6))
+        assert seq.drain_to_idle(30)
+        bat.register_job(mixed_job(6))
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "mixed") == placements(bat, "mixed")
+        worker = bat.workers[0]
+        assert worker.prescored >= 1, (
+            worker.prescored, worker.fallbacks,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
 
 
 def test_batch_pipeline_duplicate_spread_attribute_matches():
@@ -616,6 +708,90 @@ def test_batch_pipeline_static_port_contention_identical():
         for server in (seq, bat):
             evs = server.store.evals_by_job("default", "port-b")
             assert any(e.status == "blocked" for e in evs)
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_even_mode_edge_cases_match():
+    """Review regressions: (a) duplicate attribute with mixed target
+    presence follows the merged info's mode on both paths; (b) an
+    even-spread job whose update stages destructive evictions (cleared
+    can zero a use-map value, where the oracle's zero-reset min/max
+    idiom is iteration-order dependent) falls back to the exact path —
+    outcomes identical either way."""
+    import random as _random
+
+    from nomad_tpu.structs import Spread, SpreadTarget
+
+    nodes = make_nodes(10, seed=17)
+    rng = _random.Random(19)
+    for n in nodes:
+        n.datacenter = rng.choice(["dc1", "dc2"])
+        n.computed_class = compute_node_class(n)
+
+    # (a) tg stanza has targets, job stanza (overwrite winner) does
+    # not -> sequential scores BOTH psets in even mode
+    def dup_job(count):
+        job = mock.job(id="dup-mode", datacenters=["dc1", "dc2"])
+        job.task_groups[0].count = count
+        job.task_groups[0].spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=70,
+                targets=[SpreadTarget(value="dc1", percent=80)],
+            )
+        ]
+        job.spreads = [
+            Spread(attribute="${node.datacenter}", weight=30)
+        ]
+        return job
+
+    seq = Server(num_schedulers=1, seed=23, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=23, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        seq.register_job(dup_job(5))
+        assert seq.drain_to_idle(30)
+        bat.register_job(dup_job(5))
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "dup-mode") == placements(
+            bat, "dup-mode"
+        )
+
+        # (b) destructive update on an even-spread job: new config
+        # forces stop+replace; batch must fall back yet match
+        def even_destr(version):
+            job = mock.job(id="even-destr", datacenters=["dc1", "dc2"])
+            job.task_groups[0].count = 4
+            job.spreads = [
+                Spread(attribute="${node.datacenter}", weight=50)
+            ]
+            if version:
+                job.task_groups[0].tasks[0].config = {
+                    "command": "/bin/true"
+                }
+                job.version = version
+            return job
+
+        for server in (seq, bat):
+            server.register_job(even_destr(0))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "even-destr") == placements(
+            bat, "even-destr"
+        )
+        for server in (seq, bat):
+            server.register_job(even_destr(1))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "even-destr") == placements(
+            bat, "even-destr"
+        )
     finally:
         seq.stop()
         bat.stop()
