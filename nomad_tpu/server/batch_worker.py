@@ -210,6 +210,9 @@ class BatchWorker(Worker):
 
     def __init__(self, server, **kwargs) -> None:
         super().__init__(server, **kwargs)
+        # fallback evals are the shapes batching didn't cover: the
+        # exact host stack beats per-pick device round trips there
+        self.host_fallback = True
         self.batch_max = BATCH_MAX
         self.prescored = 0
         self.fallbacks = 0

@@ -29,6 +29,13 @@ class Worker:
         self.store: StateStore = server.store
         self.schedulers = schedulers or ["service", "batch", "system", "_core"]
         self.seed = seed
+        # when True, sequential eval processing uses the exact host
+        # stack even with the TPU scheduler enabled.  The BatchWorker
+        # sets it: its fallbacks are precisely the shapes where
+        # batching didn't apply, and a per-select device round trip
+        # per pick loses to the host oracle there (decisions are
+        # bit-identical either way)
+        self.host_fallback = False
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -93,7 +100,10 @@ class Worker:
         metrics = getattr(self.server, "metrics", None)
         scheduler = new_scheduler(
             ev.type, snap, self, seed=self.seed,
-            use_tpu=self.store.get_scheduler_config().tpu_scheduler_enabled,
+            use_tpu=(
+                self.store.get_scheduler_config().tpu_scheduler_enabled
+                and not self.host_fallback
+            ),
         )
         import time as _time
 
