@@ -100,9 +100,13 @@ class Worker:
         metrics = getattr(self.server, "metrics", None)
         scheduler = new_scheduler(
             ev.type, snap, self, seed=self.seed,
+            # host_fallback only demotes the per-pick generic TPU
+            # stack; system evals keep TPUSystemStack — one whole-fleet
+            # launch per eval, measured faster than the host chain at
+            # scale (tests/test_system_tpu.py)
             use_tpu=(
                 self.store.get_scheduler_config().tpu_scheduler_enabled
-                and not self.host_fallback
+                and (ev.type == "system" or not self.host_fallback)
             ),
         )
         import time as _time
