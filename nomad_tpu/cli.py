@@ -769,6 +769,17 @@ def cmd_deployment(args) -> None:
             "POST", f"/v1/deployment/pause/{args.id}", {"Pause": False}
         )
         print("==> Deployment resumed")
+    elif args.action == "unblock":
+        # multiregion deployment coordination is the enterprise no-op
+        # in the reference OSS tree (deploymentwatcher/
+        # multiregion_oss.go); the command exists for surface parity
+        print(
+            "Error: deployment unblock applies to multiregion "
+            "deployments, which follow the OSS no-op coordination "
+            "(deployments never enter the blocked state)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 def cmd_operator_snapshot(args) -> None:
@@ -985,6 +996,16 @@ def cmd_server_join(args) -> None:
 def cmd_node_config(args) -> None:
     n = _request("GET", f"/v1/node/{args.node_id}")
     print(json.dumps(n, indent=2))
+
+
+def cmd_operator_keygen(args) -> None:
+    # 32 random bytes, base64 (reference command/operator_keygen.go);
+    # usable as cluster key material (e.g. seeding TLS cert passphrases
+    # or gossip keys in external tooling)
+    import base64
+    import secrets
+
+    print(base64.b64encode(secrets.token_bytes(32)).decode())
 
 
 def cmd_system(args) -> None:
@@ -1210,7 +1231,10 @@ def build_parser() -> argparse.ArgumentParser:
     dep = sub.add_parser("deployment")
     dep.add_argument(
         "action",
-        choices=["status", "list", "promote", "fail", "pause", "resume"],
+        choices=[
+            "status", "list", "promote", "fail", "pause", "resume",
+            "unblock",
+        ],
     )
     dep.add_argument("id", nargs="?")
     dep.set_defaults(fn=cmd_deployment)
@@ -1291,6 +1315,8 @@ def build_parser() -> argparse.ArgumentParser:
     oraft = op_sub.add_parser("raft")
     oraft.add_argument("action", choices=["list-peers"])
     oraft.set_defaults(fn=cmd_operator_raft)
+    okg = op_sub.add_parser("keygen")
+    okg.set_defaults(fn=cmd_operator_keygen)
     odbg = op_sub.add_parser("debug")
     odbg.add_argument("-output", dest="output", default="")
     odbg.set_defaults(fn=cmd_operator_debug)
