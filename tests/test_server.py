@@ -389,18 +389,31 @@ def test_server_blocked_eval_unblocks_on_capacity(server):
     ]
     assert len(placed) < 2
     assert server.blocked.blocked_count() >= 1
-    # add capacity: blocked eval re-runs and completes the job
+    # add capacity: blocked eval re-runs and completes the job.
+    # unblock -> enqueue -> schedule is asynchronous; poll rather than
+    # racing a single fixed sleep against a loaded machine
     big = mock.node()
     server.register_node(big)
-    assert server.drain_to_idle(10)
-    time.sleep(0.2)
-    server.drain_to_idle(10)
-    placed = [
-        a
-        for a in server.store.allocs_by_job(job.namespace, job.id)
-        if not a.terminal_status()
-    ]
-    assert len(placed) == 2
+
+    def fully_placed():
+        server.drain_to_idle(10)
+        return (
+            len(
+                [
+                    a
+                    for a in server.store.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                    if not a.terminal_status()
+                ]
+            )
+            == 2
+        )
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not fully_placed():
+        time.sleep(0.1)
+    assert fully_placed()
 
 
 def test_server_node_down_reschedules(server):
