@@ -1008,6 +1008,46 @@ def cmd_operator_keygen(args) -> None:
     print(base64.b64encode(secrets.token_bytes(32)).decode())
 
 
+def cmd_status(args) -> None:
+    """Generic status: dispatch an identifier to the right family by
+    prefix search (reference command/status.go resolves jobs, allocs,
+    nodes, evals, deployments through the search endpoint)."""
+    if not args.job_id:
+        return cmd_job_status(args)
+    ident = args.job_id
+    matches = _request(
+        "GET", f"/v1/search?prefix={urllib.parse.quote(ident)}&context=all"
+    ).get("Matches", {})
+    for context, handler in (
+        ("jobs", cmd_job_status),
+        ("allocs", None),
+        ("nodes", None),
+        ("evals", None),
+        ("deployments", None),
+    ):
+        hits = matches.get(context) or []
+        if ident in hits or (len(hits) == 1 and hits[0].startswith(ident)):
+            full = ident if ident in hits else hits[0]
+            if context == "jobs":
+                args.job_id = full
+                return cmd_job_status(args)
+            if context == "allocs":
+                args.alloc_id = full
+                return cmd_alloc_status(args)
+            if context == "nodes":
+                args.node_id = full
+                return cmd_node_status(args)
+            if context == "evals":
+                args.eval_id = full
+                return cmd_eval_status(args)
+            if context == "deployments":
+                args.action, args.id = "status", full
+                return cmd_deployment(args)
+    # fall through: treat as a job id (matches reference behavior of
+    # erroring with the most likely family)
+    return cmd_job_status(args)
+
+
 def cmd_system(args) -> None:
     if args.action == "gc":
         _request("POST", "/v1/system/gc", {})
@@ -1345,7 +1385,7 @@ def build_parser() -> argparse.ArgumentParser:
     tp.set_defaults(fn=cmd_job_plan)
     tst = sub.add_parser("status")
     tst.add_argument("job_id", nargs="?")
-    tst.set_defaults(fn=cmd_job_status)
+    tst.set_defaults(fn=cmd_status)
     tstop = sub.add_parser("stop")
     tstop.add_argument("-purge", action="store_true", dest="purge")
     tstop.add_argument("job_id")
