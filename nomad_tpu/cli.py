@@ -1080,6 +1080,10 @@ def cmd_system_gc(args) -> None:
     print("==> GC triggered")
 
 
+def cmd_agent_info(args) -> None:
+    print(json.dumps(_request("GET", "/v1/agent/self"), indent=2))
+
+
 def cmd_version(args) -> None:
     from . import __version__
 
@@ -1415,6 +1419,9 @@ def build_parser() -> argparse.ArgumentParser:
     tfs.add_argument("alloc_id")
     tfs.add_argument("path", nargs="?", default="")
     tfs.set_defaults(fn=cmd_alloc_fs)
+
+    ai = sub.add_parser("agent-info")
+    ai.set_defaults(fn=cmd_agent_info)
 
     version = sub.add_parser("version")
     version.set_defaults(fn=cmd_version)
