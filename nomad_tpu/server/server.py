@@ -882,6 +882,11 @@ class Server:
         from ..structs import EVAL_TRIGGER_JOB_REGISTER
 
         self._validate_job(job)
+        # same admission hooks as register: the dry-run must predict
+        # the job as it would actually be stored (connect sidecars
+        # included), or `nomad plan` under-reports the placements
+        self._inject_connect_sidecars(job)
+        self._interpolate_multiregion(job)
         # run against a snapshot with the new job overlaid — the store
         # itself is never touched, so a replicated store can't diverge
         prev = self.store.job_by_id(job.namespace, job.id)
