@@ -213,7 +213,13 @@ class BatchWorker(Worker):
         # fallback evals are the shapes batching didn't cover: the
         # exact host stack beats per-pick device round trips there
         self.host_fallback = True
-        self.batch_max = BATCH_MAX
+        # tunable per deployment: larger launches amortize dispatch
+        # (throughput), smaller ones cut per-eval service latency
+        import os as _os_
+
+        self.batch_max = int(
+            _os_.environ.get("NOMAD_TPU_BATCH_MAX", BATCH_MAX)
+        )
         self.prescored = 0
         self.fallbacks = 0
         self.errors = 0
