@@ -214,12 +214,25 @@ class BatchWorker(Worker):
         # exact host stack beats per-pick device round trips there
         self.host_fallback = True
         # tunable per deployment: larger launches amortize dispatch
-        # (throughput), smaller ones cut per-eval service latency
-        import os as _os_
+        # (throughput), smaller ones cut per-eval service latency.
+        # Clamped to [1, BATCH_MAX]: the prescore eval-axis buckets
+        # (and warmed compile shapes) top out at BATCH_MAX, so a
+        # larger value would only overflow the stacked inputs and
+        # demote every big batch to the sequential path
+        import os as _os
 
-        self.batch_max = int(
-            _os_.environ.get("NOMAD_TPU_BATCH_MAX", BATCH_MAX)
-        )
+        try:
+            requested = int(
+                _os.environ.get("NOMAD_TPU_BATCH_MAX", BATCH_MAX)
+            )
+        except ValueError:
+            LOG.warning(
+                "invalid NOMAD_TPU_BATCH_MAX=%r; using %d",
+                _os.environ.get("NOMAD_TPU_BATCH_MAX"),
+                BATCH_MAX,
+            )
+            requested = BATCH_MAX
+        self.batch_max = max(1, min(BATCH_MAX, requested))
         self.prescored = 0
         self.fallbacks = 0
         self.errors = 0
@@ -242,8 +255,6 @@ class BatchWorker(Worker):
         # the prescore launches shard the node columns so per-device
         # FLOPs scale ~1/devices (parallel/mesh.py
         # sharded_chained_plan)
-        import os as _os
-
         self._mesh = None
         self._sharded_runners: Dict[tuple, object] = {}
         # opt-in: virtual CPU meshes make every launch slower (the
