@@ -697,6 +697,34 @@ def cmd_node_drain(args) -> None:
         f"==> Node {args.node_id[:8]} drain "
         f"{'enabled' if args.enable else 'disabled'}"
     )
+    if not (args.enable and getattr(args, "monitor", False)):
+        return
+    # -monitor: follow until every alloc has migrated off the node
+    # (reference command/node_drain.go monitorDrain)
+    seen = set()
+    while True:
+        allocs = _request(
+            "GET", f"/v1/node/{args.node_id}/allocations"
+        )
+        live = [
+            a
+            for a in allocs
+            if a.get("desired_status") == "run"
+            and a.get("client_status") in ("pending", "running")
+        ]
+        for a in allocs:
+            key = (a["id"], a.get("desired_status"))
+            if key not in seen and a.get("desired_status") != "run":
+                seen.add(key)
+                print(
+                    f"    alloc {a['id'][:8]} ({a.get('job_id')}) "
+                    f"-> {a.get('desired_status')}"
+                )
+        node = _request("GET", f"/v1/node/{args.node_id}")
+        if not live and not node.get("Drain", False):
+            print("==> Drain complete")
+            return
+        time.sleep(1.0)
 
 
 def cmd_node_eligibility(args) -> None:
@@ -1219,6 +1247,7 @@ def build_parser() -> argparse.ArgumentParser:
     nd_group.add_argument("-disable", action="store_false", dest="enable")
     nd.add_argument("-deadline", type=float, default=3600.0,
                     dest="deadline")
+    nd.add_argument("-monitor", action="store_true", dest="monitor")
     nd.add_argument("node_id")
     nd.set_defaults(fn=cmd_node_drain)
     nc = node_sub.add_parser("config")
