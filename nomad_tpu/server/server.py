@@ -341,11 +341,17 @@ class Server:
             tg.tasks.append(
                 Task(
                     name=proxy_name,
-                    driver="raw_exec",
+                    # exec (executor-backed): the proxy survives agent
+                    # restarts via reattach records instead of
+                    # orphaning on SIGKILL; chroot off — the proxy
+                    # imports this framework from the client's own
+                    # package path, which a sandbox wouldn't see
+                    driver="exec",
                     config={
                         "command": _sys.executable,
                         "args": ["-m", "nomad_tpu.client.connect"]
                         + argv,
+                        "chroot": False,
                         "connect_upstreams": [
                             [dest, port] for dest, port in upstreams
                         ],
