@@ -745,6 +745,14 @@ def _preflight() -> None:
     total_s = float(os.environ.get("BENCH_PREFLIGHT_S", 600))
     if total_s <= 0:
         return  # explicit opt-out
+    # exclusive accelerator lock FIRST: a second jax process against
+    # the single-chip tunnel wedges the session for everyone (that is
+    # how round 3 lost its benchmark) — block here instead
+    from nomad_tpu.device_lock import ensure_device_lock
+
+    if not ensure_device_lock("bench.py"):
+        log("preflight: accelerator lock busy past deadline; aborting")
+        sys.exit(2)
     deadline = time.monotonic() + total_s
     box: dict = {}
 

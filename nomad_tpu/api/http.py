@@ -1358,7 +1358,33 @@ class APIHandler(BaseHTTPRequestHandler):
         # state table; OSS'd in 1.0) --------------------------------
 
         if path == "/v1/namespaces" and method == "GET":
-            self._check_acl_any(("read-job", "list-jobs"), ns)
+            # filtered by the token's per-namespace capabilities
+            # (reference namespace_endpoint.go ListNamespaces): a
+            # token scoped to one namespace must not learn the
+            # names/descriptions of the others; management sees all
+            acls = getattr(srv, "acls", None)
+            acl = (
+                acls.resolve(self.headers.get("X-Nomad-Token", ""))
+                if acls is not None and acls.enabled
+                else None
+            )
+
+            def ns_visible(name: str) -> bool:
+                if acls is None or not acls.enabled:
+                    return True
+                if acl is None:
+                    return False
+                return any(
+                    acl.allow_namespace_operation(name, c)
+                    for c in ("read-job", "list-jobs")
+                )
+
+            visible = [
+                n for n in store.iter_namespaces()
+                if ns_visible(n.name)
+            ]
+            if acls is not None and acls.enabled and not visible:
+                raise HTTPError(403, "Permission denied")
             self._respond(
                 [
                     {
@@ -1367,7 +1393,7 @@ class APIHandler(BaseHTTPRequestHandler):
                         "CreateIndex": n.create_index,
                         "ModifyIndex": n.modify_index,
                     }
-                    for n in store.iter_namespaces()
+                    for n in visible
                 ]
             )
             return True

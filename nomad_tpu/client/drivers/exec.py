@@ -298,6 +298,26 @@ class ExecDriver(RawExecDriver):
             # of /bin /etc /lib /lib64 /sbin /usr); a chroot_env map
             # falls back to hardlink population
             populate = cfg.config.get("chroot_env") or "bind"
+        # task-dir contract mounts: the chroot is rooted at the task's
+        # local dir, so the shared alloc dir (a sibling) and the
+        # secrets dir must be bind-mounted in, and the local dir
+        # itself appears at /local (reference alloc_dir_linux.go
+        # mountSharedDir; the executor remaps NOMAD_*_DIR to match)
+        task_mounts = []
+        if chroot:
+            from ..allocdir import SHARED_ALLOC_NAME, TASK_SECRETS
+
+            task_base = os.path.dirname(chroot)
+            task_mounts = [
+                [
+                    os.path.join(
+                        os.path.dirname(task_base), SHARED_ALLOC_NAME
+                    ),
+                    "alloc",
+                ],
+                [chroot, "local"],
+                [os.path.join(task_base, TASK_SECRETS), "secrets"],
+            ]
         res = cfg.resources
         spec = {
             "task_id": cfg.id,
@@ -306,6 +326,7 @@ class ExecDriver(RawExecDriver):
             "env": env,
             "chroot": chroot,
             "chroot_populate": populate,
+            "task_mounts": task_mounts,
             "cpu_shares": getattr(res, "cpu", 0) if res else 0,
             "memory_mb": getattr(res, "memory_mb", 0) if res else 0,
             **self._log_spec(cfg),
@@ -319,6 +340,10 @@ class ExecDriver(RawExecDriver):
             except (RuntimeError, OSError):
                 pass
             prev.shutdown()
+        # a reused task id must not inherit the previous run's
+        # persisted exit: recovery would report the STALE status for a
+        # run that was actually lost mid-flight
+        ex.drop_exit_record(cfg.id)
         client = ex.ExecutorClient.spawn()
         try:
             info = client.launch(spec)
@@ -422,14 +447,39 @@ class ExecDriver(RawExecDriver):
         rec = ex.load_reattach(task_id)
         if rec is None:
             return super().recover_task(task_id, handle_state)
+
+        def recovered_exit() -> bool:
+            # executor gone (it self-reaps 15s after the last task
+            # finishes) but the task's exit was persisted: report the
+            # REAL status instead of 'lost' so a finished batch task
+            # is never re-run
+            raw = ex.load_exit_record(task_id)
+            if raw is None:
+                return False
+            handle = DriverHandle(task_id)
+            self.handles[task_id] = handle  # type: ignore[assignment]
+            handle.set_exit(
+                TaskExitResult(
+                    exit_code=int(raw.get("exit_code", 0)),
+                    signal=int(raw.get("signal", 0)),
+                    oom_killed=bool(raw.get("oom_killed", False)),
+                )
+            )
+            ex.drop_reattach(task_id)
+            return True
+
         try:
             client = ex.ExecutorClient.reconnect(rec["socket"])
             tasks = {t["task_id"]: t for t in client.list_tasks()}
         except (RuntimeError, OSError):
+            if recovered_exit():
+                return True
             ex.drop_reattach(task_id)
             return False
         if task_id not in tasks:
             client.shutdown()
+            if recovered_exit():
+                return True
             ex.drop_reattach(task_id)
             return False
         handle = _ExecutorTaskHandle(

@@ -40,7 +40,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..wire import call, decode, encode, recv_frame, send_frame
 
@@ -181,7 +181,30 @@ def prepare_bind_sandbox(dest: str) -> List[str]:
     return binds
 
 
-def _enter_bind_sandbox(chroot: str, binds: List[str]) -> None:
+def _mount_task_dirs(
+    chroot: str, mounts: List[Tuple[str, str]]
+) -> None:
+    """Bind the task-dir contract dirs (shared alloc, local, secrets)
+    read-write into the sandbox so NOMAD_ALLOC_DIR/NOMAD_TASK_DIR/
+    NOMAD_SECRETS_DIR resolve in-chroot (the reference bind-mounts the
+    alloc dir into the chroot — alloc_dir_linux.go mountSharedDir).
+    A failed bind ABORTS the launch: the parent already remapped the
+    env vars to the in-chroot paths, so proceeding would silently
+    write shared data into a private dir."""
+    for host, rel in mounts:
+        target = os.path.join(chroot, rel).encode()
+        err = _mount(host.encode(), target, b"", MS_BIND)
+        if err != 0:
+            raise OSError(
+                err, f"bind {host} -> /{rel} failed", host
+            )
+
+
+def _enter_bind_sandbox(
+    chroot: str,
+    binds: List[str],
+    task_mounts: Optional[List[Tuple[str, str]]] = None,
+) -> None:
     """Child-side (post-unshare(NEWNS), pre-exec): make mounts
     private, bind the system dirs read-only, mount /proc, chroot."""
     _mount(b"none", b"/", b"", MS_REC | MS_PRIVATE)
@@ -197,6 +220,8 @@ def _enter_bind_sandbox(chroot: str, binds: List[str]) -> None:
     _mount(b"/dev", os.path.join(chroot, "dev").encode(), b"",
            MS_BIND | MS_REC)
     _mount(b"proc", os.path.join(chroot, "proc").encode(), b"proc", 0)
+    if task_mounts:
+        _mount_task_dirs(chroot, task_mounts)
     os.chroot(chroot)
     os.chdir("/")
 
@@ -460,6 +485,32 @@ class Executor:
         else:
             chroot = ""
 
+        # task-dir contract: bind the shared alloc/local/secrets dirs
+        # into the sandbox and remap the NOMAD_*_DIR env vars to the
+        # in-chroot paths, so artifacts/templates/shared-data work
+        # under the default chroot (reference alloc_dir_linux.go
+        # mountSharedDir + taskenv's in-chroot paths)
+        task_mounts: List[Tuple[str, str]] = []
+        if (
+            chroot
+            and can_unshare
+            and bool(spec.get("mount_ns", True))
+        ):
+            env_for_rel = {
+                "alloc": "NOMAD_ALLOC_DIR",
+                "local": "NOMAD_TASK_DIR",
+                "secrets": "NOMAD_SECRETS_DIR",
+            }
+            for host, rel in spec.get("task_mounts") or []:
+                rel = str(rel).strip("/")
+                if not host or not os.path.isdir(host):
+                    continue
+                os.makedirs(os.path.join(chroot, rel), exist_ok=True)
+                task_mounts.append((host, rel))
+                var = env_for_rel.get(rel)
+                if var and var in env:
+                    env[var] = "/" + rel
+
         cgroup: Optional[CgroupSlice] = None
         if spec.get("use_cgroups", True) and (
             spec.get("cpu_shares") or spec.get("memory_mb")
@@ -514,8 +565,11 @@ class Executor:
                             "bind sandbox requires a private mount "
                             "namespace"
                         )
-                    _enter_bind_sandbox(chroot, binds)
+                    _enter_bind_sandbox(chroot, binds, task_mounts)
                 else:
+                    if task_mounts and in_ns:
+                        _mount(b"none", b"/", b"", MS_REC | MS_PRIVATE)
+                        _mount_task_dirs(chroot, task_mounts)
                     os.chroot(chroot)
                     os.chdir("/")
             lim = spec.get("rlimit_nofile")
@@ -579,6 +633,11 @@ class Executor:
             if task.cgroup is not None:
                 # OOM kill shows up as SIGKILL + memory events
                 task.exit["oom_killed"] = self._was_oom(task)
+            # persist the exit beside the reattach record BEFORE
+            # signalling completion: if the executor self-reaps while
+            # the client is down, recovery still reports the real
+            # status instead of 'lost'
+            save_exit_record(task_id, task.exit)
             task.done.set()
 
         threading.Thread(target=waiter, daemon=True).start()
@@ -793,11 +852,17 @@ class ExecutorClient:
 
     @classmethod
     def spawn(cls) -> "ExecutorClient":
+        # the supervisor itself never touches jax: keep it off the
+        # exclusive accelerator session (a leftover executor holding
+        # the tunneled chip is how round 3 lost its benchmark)
+        from ..device_lock import scrub_accelerator_env
+
         proc = subprocess.Popen(
             [sys.executable, "-m", "nomad_tpu.client.executor"],
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
             text=True,
+            env=scrub_accelerator_env(),
         )
         line = (proc.stdout.readline() or "").strip()
         parts = line.split("|")
@@ -905,6 +970,44 @@ def load_reattach(task_id: str) -> Optional[Dict[str, Any]]:
 def drop_reattach(task_id: str) -> None:
     try:
         os.unlink(os.path.join(STATE_DIR, f"{task_id}.json"))
+    except OSError:
+        pass
+    drop_exit_record(task_id)
+
+
+def save_exit_record(task_id: str, exit: Dict[str, Any]) -> None:
+    """Persist a finished task's exit status beside the reattach
+    record.  The executor self-reaps 15s after its last task finishes;
+    a client restart slower than that must still report the REAL exit
+    (a completed batch task re-run as 'lost' runs twice)."""
+    os.makedirs(STATE_DIR, mode=0o700, exist_ok=True)
+    if not _state_dir_trusted(STATE_DIR):
+        return
+    path = os.path.join(STATE_DIR, f"{task_id}.exit.json")
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(exit, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def load_exit_record(task_id: str) -> Optional[Dict[str, Any]]:
+    if not _state_dir_trusted(STATE_DIR):
+        return None
+    try:
+        with open(
+            os.path.join(STATE_DIR, f"{task_id}.exit.json")
+        ) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def drop_exit_record(task_id: str) -> None:
+    try:
+        os.unlink(os.path.join(STATE_DIR, f"{task_id}.exit.json"))
     except OSError:
         pass
 

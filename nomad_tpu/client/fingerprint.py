@@ -93,6 +93,19 @@ def bounded_jax_devices(timeout_s: Optional[float] = None):
 
     def enumerate_devices() -> None:
         try:
+            # exclusive accelerator lock before backend init: a
+            # second jax process wedges a tunneled single-chip
+            # session.  The wait is bounded by THIS enumeration's
+            # deadline — the orphaned thread must not acquire the
+            # process-lifetime lock long after the caller gave up
+            # (the node registered CPU-only; holding the chip then
+            # starves every other process of it)
+            from ..device_lock import ensure_device_lock
+
+            if not ensure_device_lock(
+                "client fingerprint", wait_s=timeout_s
+            ):
+                return
             import jax
 
             box["devices"] = jax.devices()

@@ -185,6 +185,12 @@ class TaskRunner:
                         if _prev
                         else _root
                     )
+                    # sidecar proxies never need an accelerator: keep
+                    # them off the exclusive single-chip session (a
+                    # leftover helper holding it wedges the tunnel)
+                    from ..device_lock import scrub_accelerator_env
+
+                    env = scrub_accelerator_env(env)
                 for item in config.get("connect_upstreams") or []:
                     dest, _port = item[0], item[1]
                     # brief launch-time wait: the upstream's alloc is

@@ -1,0 +1,189 @@
+"""Cross-process accelerator lock.
+
+A tunneled single-chip TPU session is process-exclusive: two jax
+processes initializing the backend concurrently wedge the tunnel for
+everyone (including future processes — the stale session can outlive
+both).  Round 3 lost its whole benchmark to exactly that.
+
+``ensure_device_lock()`` takes an exclusive ``flock`` on a well-known
+lockfile *before* jax backend init and holds it for the life of the
+process, so a second launch **blocks** (with a log line saying whose
+pid holds the chip) instead of corrupting the session.
+
+The lock is only taken when a real accelerator may be in play:
+``JAX_PLATFORMS=cpu`` (the test suite's virtual-mesh mode) skips it —
+CPU backends are not exclusive and tests may run in parallel.
+
+Env knobs:
+  NOMAD_TPU_DEVICE_LOCK       lockfile path (default
+                              /tmp/nomad_tpu_device.lock)
+  NOMAD_TPU_DEVICE_LOCK_WAIT  seconds to wait before giving up
+                              (default: block forever); 0 disables
+                              the lock entirely (expert override)
+"""
+from __future__ import annotations
+
+import errno
+import logging
+import os
+import threading
+import time
+
+LOG = logging.getLogger("nomad_tpu.device_lock")
+
+_LOCK_PATH_ENV = "NOMAD_TPU_DEVICE_LOCK"
+_LOCK_WAIT_ENV = "NOMAD_TPU_DEVICE_LOCK_WAIT"
+_DEFAULT_PATH = "/tmp/nomad_tpu_device.lock"
+
+_state_lock = threading.Lock()
+_held_fd: int | None = None
+
+
+def _needs_lock() -> bool:
+    """Lock only when JAX_PLATFORMS explicitly names a non-CPU
+    backend (tunneled single-chip deployments always set it, e.g.
+    ``axon``).  Unset or cpu-only means no exclusive session is in
+    play: a server agent and a client agent sharing a CPU-only box
+    must not serialize on (or deadlock over) a process-lifetime
+    lock.  Bare-metal TPU without the var fails fast via libtpu's
+    own process-exclusivity check rather than wedging a tunnel."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if not plats:
+        return False
+    return not set(p.strip() for p in plats.split(",")) <= {"cpu"}
+
+
+def scrub_accelerator_env(
+    base: dict | None = None,
+) -> dict:
+    """Environment for task-runtime subprocesses (executors, sidecar
+    proxies, logmon): force the CPU backend and drop the tunnel-plugin
+    activation vars, so a helper process can never claim the exclusive
+    single-chip session.  Round 3's tunnel wedge traces to exactly
+    this — a leftover test executor held the chip for hours because
+    the site-wide plugin registration runs in every python process."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    for var in (
+        "PALLAS_AXON_POOL_IPS",
+        "AXON_POOL_SVC_OVERRIDE",
+        "PALLAS_AXON_REMOTE_COMPILE",
+    ):
+        env.pop(var, None)
+    return env
+
+
+def ensure_device_lock(
+    what: str = "jax backend", wait_s: float | None = None
+) -> bool:
+    """Acquire (once per process) the exclusive accelerator lock.
+
+    ``wait_s``: seconds to wait before giving up (callers with their
+    own deadline, e.g. the client fingerprint, pass theirs); None
+    defers to NOMAD_TPU_DEVICE_LOCK_WAIT, default block-forever.
+
+    Returns True when the lock is held (or intentionally skipped for a
+    CPU-only backend / expert opt-out), False when a bounded wait
+    expired.  Idempotent and thread-safe; the fd is held until process
+    exit so the OS releases it even on a crash."""
+    global _held_fd
+    if not _needs_lock():
+        return True
+    wait_env = os.environ.get(_LOCK_WAIT_ENV)
+    if wait_env is not None:
+        try:
+            env_wait = float(wait_env)
+        except ValueError:
+            env_wait = -1.0
+        if env_wait == 0:
+            return True  # explicit opt-out
+        if wait_s is None:
+            wait_s = env_wait
+    if wait_s is None:
+        wait_s = -1.0  # block forever
+    with _state_lock:
+        if _held_fd is not None:
+            return True
+        import fcntl
+
+        path = os.environ.get(_LOCK_PATH_ENV, _DEFAULT_PATH)
+        try:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o666)
+            try:
+                # the 0o666 mode is masked by umask on create: open
+                # it up so a different-uid process can take the lock
+                # later instead of crashing on PermissionError
+                os.fchmod(fd, 0o666)
+            except OSError:
+                pass
+        except OSError as exc:
+            # a lockfile we cannot open (foreign owner + restrictive
+            # mode) must degrade to a loud warning, not a crash in
+            # the middle of scheduler construction
+            LOG.warning(
+                "accelerator lockfile %s unusable (%s); proceeding "
+                "WITHOUT cross-process exclusion",
+                path,
+                exc,
+            )
+            return True
+        deadline = (
+            time.monotonic() + wait_s if wait_s > 0 else None
+        )
+        logged = False
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError as exc:
+                if exc.errno not in (errno.EAGAIN, errno.EACCES):
+                    raise
+                if not logged:
+                    holder = ""
+                    try:
+                        holder = os.read(fd, 64).decode(
+                            "ascii", "replace"
+                        ).strip()
+                        os.lseek(fd, 0, os.SEEK_SET)
+                    except OSError:
+                        pass
+                    LOG.warning(
+                        "accelerator lock %s held%s; waiting for %s "
+                        "(a second jax process would wedge the "
+                        "single-chip tunnel)",
+                        path,
+                        f" by {holder}" if holder else "",
+                        what,
+                    )
+                    logged = True
+                if deadline is not None and time.monotonic() > deadline:
+                    os.close(fd)
+                    return False
+                time.sleep(0.5)
+        try:
+            os.ftruncate(fd, 0)
+            os.write(
+                fd, f"pid={os.getpid()} what={what}\n".encode()
+            )
+        except OSError:
+            pass
+        _held_fd = fd
+        if logged:
+            LOG.warning("accelerator lock acquired after waiting")
+        return True
+
+
+def release_device_lock() -> None:
+    """Release early (normally unnecessary — process exit releases)."""
+    global _held_fd
+    with _state_lock:
+        if _held_fd is None:
+            return
+        import fcntl
+
+        try:
+            fcntl.flock(_held_fd, fcntl.LOCK_UN)
+            os.close(_held_fd)
+        except OSError:
+            pass
+        _held_fd = None

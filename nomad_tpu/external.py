@@ -168,6 +168,12 @@ class ConsulSyncer:
     failures log and retry on the next alloc event rather than wedging
     the scheduler or client."""
 
+    # quiet-cluster safety net: a register/deregister that failed
+    # during a Consul outage must not stay stale until the next alloc
+    # change — retry on a timer (the reference's syncer runs a
+    # periodic sync loop, command/agent/consul/client.go Run)
+    RESYNC_INTERVAL_S = 30.0
+
     def __init__(self, catalog, consul: ConsulClient) -> None:
         self.catalog = catalog
         self.consul = consul
@@ -176,6 +182,7 @@ class ConsulSyncer:
         self._dirty = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_sync_failed = False
 
     def sync(self) -> None:
         instances = [
@@ -184,6 +191,7 @@ class ConsulSyncer:
             for inst in self.catalog.instances(name)
         ]
         want: Dict[str, Any] = {_service_id(i): i for i in instances}
+        failed = False
         with self._lock:
             for sid in list(self._registered):
                 if sid not in want:
@@ -193,6 +201,7 @@ class ConsulSyncer:
                         # keep tracking: retried on the next sync so a
                         # consul blip can't strand a stale registration
                         LOG.warning("consul deregister %s: %s", sid, exc)
+                        failed = True
                         continue
                     self._registered.pop(sid, None)
             for sid, inst in want.items():
@@ -209,6 +218,8 @@ class ConsulSyncer:
                     self._registered[sid] = inst.alloc_id
                 except ExternalError as exc:
                     LOG.warning("consul register %s: %s", sid, exc)
+                    failed = True
+            self._last_sync_failed = failed
 
     def attach(self, store) -> None:
         """Alloc watchers fire under the store lock, so the callback
@@ -221,13 +232,27 @@ class ConsulSyncer:
         store.add_alloc_watcher(lambda _allocs: self._dirty.set())
 
     def _run(self) -> None:
+        import time as _time
+
+        last = _time.monotonic()
         while not self._stop.is_set():
-            if self._dirty.wait(timeout=0.5):
+            fired = self._dirty.wait(timeout=0.5)
+            elapsed = _time.monotonic() - last
+            # failed syncs retry on a short delay (not every tick — a
+            # down Consul shouldn't be hammered), clean ones on the
+            # periodic interval
+            due = elapsed >= (
+                2.0 if self._last_sync_failed
+                else self.RESYNC_INTERVAL_S
+            )
+            if fired or due:
                 self._dirty.clear()
+                last = _time.monotonic()
                 try:
                     self.sync()
                 except Exception as exc:  # noqa: BLE001
                     LOG.warning("consul sync: %s", exc)
+                    self._last_sync_failed = True
 
     def stop(self) -> None:
         self._stop.set()

@@ -44,6 +44,12 @@ class TLSConfig:
     ca_file: str = ""
     cert_file: str = ""
     key_file: str = ""
+    # role-pinned server identity, e.g. "server.global.nomad"
+    # (reference tlsutil verify_server_hostname): when set, outgoing
+    # connections require the peer's cert to carry this name, so a
+    # CA-signed CLIENT cert cannot impersonate a server.  Empty keeps
+    # the r3 behavior: any CA-signed cert is a full cluster peer.
+    server_name: str = ""
 
     def server_context(self):
         import ssl
@@ -60,11 +66,10 @@ class TLSConfig:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
         ctx.load_cert_chain(self.cert_file, self.key_file)
         ctx.load_verify_locations(self.ca_file)
-        # server certs are issued per-cluster, not per-hostname:
-        # authentication is the CA + cert requirement, like the
-        # reference's region-wildcard server names
-        ctx.check_hostname = False
-        ctx.verify_mode = ssl.CERT_REQUIRED  # verify_outgoing
+        # verify_outgoing: the CA + cert requirement authenticate the
+        # peer; verify_server_hostname additionally pins the role name
+        ctx.check_hostname = bool(self.server_name)
+        ctx.verify_mode = ssl.CERT_REQUIRED
         return ctx
 
 CONNECT_TIMEOUT = 0.5
@@ -209,7 +214,14 @@ class TcpTransport:
             )
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if self._client_ctx is not None:
-                sock = self._client_ctx.wrap_socket(sock)
+                sock = self._client_ctx.wrap_socket(
+                    sock,
+                    server_hostname=(
+                        self.tls.server_name
+                        if self.tls and self.tls.server_name
+                        else None
+                    ),
+                )
         except OSError as exc:
             self._breaker[dst] = time.monotonic() + BREAKER_WINDOW
             raise TransportError(f"dial {dst} failed: {exc}") from exc

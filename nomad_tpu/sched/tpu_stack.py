@@ -108,6 +108,11 @@ class TPUGenericStack:
     def __init__(
         self, batch: bool, ctx: EvalContext, seed: Optional[int] = None
     ) -> None:
+        # exclusive accelerator lock before any backend init (no-op on
+        # CPU-only): two jax processes wedge a tunneled chip session
+        from ..device_lock import ensure_device_lock
+
+        ensure_device_lock("tpu stack")
         self.batch = batch
         self.ctx = ctx
         self.table = ctx.state.node_table

@@ -210,6 +210,12 @@ class BatchWorker(Worker):
 
     def __init__(self, server, **kwargs) -> None:
         super().__init__(server, **kwargs)
+        # exclusive accelerator lock before any backend init: a second
+        # jax process against a tunneled single-chip session wedges it
+        # for every future process (no-op on CPU-only backends)
+        from ..device_lock import ensure_device_lock
+
+        ensure_device_lock("batch worker")
         # fallback evals are the shapes batching didn't cover: the
         # exact host stack beats per-pick device round trips there
         self.host_fallback = True

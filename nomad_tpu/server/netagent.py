@@ -54,6 +54,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--tls-cert", default="")
     parser.add_argument("--tls-key", default="")
+    parser.add_argument(
+        "--tls-server-name", default="",
+        help="pin outgoing connections to this server identity, e.g. "
+        "server.global.nomad (reference verify_server_hostname): a "
+        "CA-signed client cert then cannot impersonate a server",
+    )
     args = parser.parse_args(argv)
 
     from ..api.http import start_http_server
@@ -68,6 +74,7 @@ def main(argv=None) -> int:
             ca_file=args.tls_ca,
             cert_file=args.tls_cert,
             key_file=args.tls_key,
+            server_name=args.tls_server_name,
         )
     transport = TcpTransport(tls=tls)
     server = ClusterServer(

@@ -139,3 +139,49 @@ def test_http_acl_enforcement(acl_api):
     with pytest.raises(urllib.error.HTTPError) as exc:
         _req(base, "POST", "/v1/jobs", job_payload, token=reader)
     assert exc.value.code == 403
+
+
+def test_namespace_list_filtered_by_token_scope(acl_api):
+    """GET /v1/namespaces returns only namespaces the token has a
+    capability for (reference namespace_endpoint.go ListNamespaces):
+    a token scoped to one namespace must not learn the others'
+    names/descriptions (ADVICE r3)."""
+    server, base = acl_api
+    boot = _req(base, "POST", "/v1/acl/bootstrap")
+    mgmt = boot["SecretID"]
+    for name in ("team-a", "team-b"):
+        _req(
+            base, "POST", "/v1/namespaces",
+            {"Name": name, "Description": f"{name} workloads"},
+            token=mgmt,
+        )
+    # management sees everything
+    names = {
+        n["Name"]
+        for n in _req(base, "GET", "/v1/namespaces", token=mgmt)
+    }
+    assert {"default", "team-a", "team-b"} <= names
+
+    # a token scoped to team-a sees ONLY team-a
+    _req(
+        base, "POST", "/v1/acl/policy/team-a-read",
+        {"Rules": {"namespaces": {"team-a": {"policy": "read"}}}},
+        token=mgmt,
+    )
+    tok = _req(
+        base, "POST", "/v1/acl/tokens",
+        {"Name": "scoped", "Policies": ["team-a-read"]},
+        token=mgmt,
+    )
+    scoped = {
+        n["Name"]
+        for n in _req(
+            base, "GET", "/v1/namespaces", token=tok["SecretID"]
+        )
+    }
+    assert scoped == {"team-a"}
+
+    # an anonymous/unknown token gets a 403, not the full list
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _req(base, "GET", "/v1/namespaces")
+    assert exc.value.code == 403

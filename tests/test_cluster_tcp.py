@@ -415,6 +415,72 @@ def _make_ca_and_certs(tmp_path, names=("server",), rogue=False):
     return str(ca_crt), out
 
 
+def test_tls_server_name_pins_role(tmp_path):
+    """verify_server_hostname analog: with TLSConfig.server_name set,
+    a CA-signed cert WITHOUT the server SAN is rejected on outgoing
+    connections (cert-role confusion, ADVICE r3) while a proper
+    server cert still works."""
+    import subprocess
+
+    from nomad_tpu.raft.tcp import TcpTransport, TLSConfig
+    from nomad_tpu.raft.transport import TransportError
+
+    def run(*argv):
+        subprocess.run(argv, check=True, capture_output=True)
+
+    ca_key, ca_crt = tmp_path / "ca.key", tmp_path / "ca.crt"
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+        "-subj", "/CN=nomad-ca")
+
+    def issue(name, san=None):
+        key = tmp_path / f"{name}.key"
+        csr = tmp_path / f"{name}.csr"
+        crt = tmp_path / f"{name}.crt"
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(csr),
+            "-subj", f"/CN={name}")
+        ext = tmp_path / f"{name}.ext"
+        ext.write_text(
+            f"subjectAltName=DNS:{san}\n" if san else
+            "basicConstraints=CA:FALSE\n"
+        )
+        run("openssl", "x509", "-req", "-in", str(csr),
+            "-CA", str(ca_crt), "-CAkey", str(ca_key),
+            "-CAcreateserial", "-out", str(crt), "-days", "1",
+            "-extfile", str(ext))
+        return str(crt), str(key)
+
+    server_crt = issue("server", san="server.global.nomad")
+    client_crt = issue("client")  # CA-signed but no server SAN
+
+    pin = "server.global.nomad"
+    proper = TcpTransport(tls=TLSConfig(
+        ca_file=str(ca_crt), cert_file=server_crt[0],
+        key_file=server_crt[1], server_name=pin))
+    addr = f"127.0.0.1:{free_port()}"
+    proper.register(addr, lambda m, p: {"ok": True})
+
+    imposter = TcpTransport(tls=TLSConfig(
+        ca_file=str(ca_crt), cert_file=client_crt[0],
+        key_file=client_crt[1], server_name=pin))
+    imposter_addr = f"127.0.0.1:{free_port()}"
+    imposter.register(imposter_addr, lambda m, p: {"ok": True})
+    try:
+        # server->server with the right SAN: fine
+        caller = TcpTransport(tls=TLSConfig(
+            ca_file=str(ca_crt), cert_file=server_crt[0],
+            key_file=server_crt[1], server_name=pin))
+        assert caller.rpc("a", addr, "ping", {})["ok"] is True
+        # dialing a peer that presents the CLIENT cert: rejected
+        with pytest.raises(TransportError):
+            caller.rpc("a", imposter_addr, "ping", {})
+        caller.close()
+    finally:
+        proper.close()
+        imposter.close()
+
+
 def test_tls_transport_roundtrip_and_rejection(tmp_path):
     from nomad_tpu.raft.tcp import TcpTransport, TLSConfig
     from nomad_tpu.raft.transport import TransportError

@@ -192,6 +192,60 @@ def test_executor_bind_sandbox_full_system_readonly(client, tmp_path):
     assert os.listdir(os.path.join(croot, "usr")) == []
 
 
+@pytest.mark.skipif(not IS_ROOT, reason="bind sandbox needs root")
+def test_executor_task_dir_contract_in_chroot(client, tmp_path):
+    """The task-dir env contract resolves inside the sandbox: the
+    shared alloc dir is bind-mounted at /alloc, the env vars are
+    remapped in-chroot, and writes land in the host's shared dir
+    (reference alloc_dir_linux.go mountSharedDir)."""
+    alloc = tmp_path / "a1"
+    shared = alloc / "alloc" / "data"
+    local = alloc / "web" / "local"
+    secrets = alloc / "web" / "secrets"
+    for d in (shared, local, secrets):
+        d.mkdir(parents=True)
+    (secrets / "token").write_text("s3cret")
+    out = str(tmp_path / "out.txt")
+    info = client.launch(
+        {
+            "task_id": "td",
+            "argv": [
+                "/bin/sh",
+                "-c",
+                'echo "$NOMAD_ALLOC_DIR $NOMAD_TASK_DIR '
+                '$NOMAD_SECRETS_DIR";'
+                ' echo hi > "$NOMAD_ALLOC_DIR/data/shared.txt";'
+                ' cat "$NOMAD_SECRETS_DIR/token"',
+            ],
+            "env": {
+                "NOMAD_ALLOC_DIR": str(alloc / "alloc"),
+                "NOMAD_TASK_DIR": str(local),
+                "NOMAD_SECRETS_DIR": str(secrets),
+                "PATH": "/bin:/usr/bin",
+            },
+            "chroot": str(local),
+            "chroot_populate": "bind",
+            "task_mounts": [
+                [str(alloc / "alloc"), "alloc"],
+                [str(local), "local"],
+                [str(secrets), "secrets"],
+            ],
+            "stdout_path": out,
+        }
+    )
+    assert info["isolation"]["chroot"]
+    res = client.wait("td", timeout=10)
+    assert res["exit_code"] == 0
+    got = open(out).read()
+    # env remapped to in-chroot paths
+    assert got.splitlines()[0] == "/alloc /local /secrets", got
+    # the secrets bind resolved
+    assert "s3cret" in got
+    # the write through /alloc landed in the HOST shared dir
+    assert (shared / "shared.txt").read_text().strip() == "hi"
+    client.destroy("td")
+
+
 def test_executor_rotates_logs(client, tmp_path):
     """With a logs dir, the executor pumps output through size-rotated
     logmon files instead of one unbounded flat file."""
@@ -268,6 +322,39 @@ def test_exec_driver_runs_chrooted_task(tmp_path):
     with open(tmp_path / "main.stdout") as f:
         assert "HIDDEN" in f.read()
     d.destroy_task("chroot-task", force=True)
+
+
+def test_recover_reports_real_exit_after_executor_reaped(tmp_path):
+    """Executor self-reaped (15s idle) before the client came back:
+    recovery must report the persisted exit status, not 'lost' — a
+    finished batch task must never be re-run (ADVICE r3)."""
+    from nomad_tpu.client import executor as ex
+    from nomad_tpu.client.drivers import ExecDriver
+
+    d = ExecDriver()
+    cfg = TaskConfig(
+        id="reap-task",
+        name="main",
+        alloc_dir=str(tmp_path),
+        task_dir=str(tmp_path),
+        config={"command": "/bin/sh", "args": ["-c", "exit 7"]},
+        resources=Resources(cpu=100, memory_mb=64),
+    )
+    handle = d.start_task(cfg)
+    res = handle.wait(timeout=10)
+    assert res is not None and res.exit_code == 7
+    # simulate the idle self-reap racing a slow client restart: the
+    # executor dies, the reattach record stays
+    client = d._clients["reap-task"]
+    client.proc.kill()
+    client.proc.wait()
+    d2 = ExecDriver()
+    assert d2.recover_task(
+        "reap-task", {"pid": handle.pid}
+    ), "recovery must succeed from the persisted exit record"
+    res2 = d2.handles["reap-task"].wait(timeout=5)
+    assert res2 is not None and res2.exit_code == 7
+    assert ex.load_reattach("reap-task") is None
 
 
 def test_exec_driver_reattach_across_restart(tmp_path):

@@ -199,6 +199,62 @@ def test_consul_syncer_mirrors_catalog(consul):
         server.stop()
 
 
+def test_consul_syncer_retries_after_outage_without_alloc_change():
+    """A register that fails during a Consul outage is retried by the
+    periodic resync even on a quiet cluster (ADVICE r3: the external
+    catalog must not stay stale until the next alloc event)."""
+    import threading
+
+    class _Inst:
+        alloc_id = "a1"
+        task = "t"
+        service = "frontend"
+        address = "10.0.0.1"
+        port = 80
+        tags = ()
+
+    class _Catalog:
+        def services(self):
+            return ["frontend"]
+
+        def instances(self, name):
+            return [_Inst()]
+
+    class _FlakyConsul:
+        def __init__(self):
+            self.down = True
+            self.registered = {}
+            self.synced = threading.Event()
+
+        def register_service(self, sid, name, address, port, tags):
+            if self.down:
+                raise ExternalError("consul unreachable")
+            self.registered[sid] = name
+            self.synced.set()
+
+        def deregister_service(self, sid):
+            self.registered.pop(sid, None)
+
+    consul = _FlakyConsul()
+    syncer = ConsulSyncer(_Catalog(), consul)
+    # first sync during the outage: fails, flags for retry
+    syncer.sync()
+    assert syncer._last_sync_failed and not consul.registered
+    # consul recovers; NO alloc event fires — run the loop
+    consul.down = False
+    syncer._thread = threading.Thread(
+        target=syncer._run, daemon=True
+    )
+    syncer._thread.start()
+    try:
+        assert consul.synced.wait(
+            10.0
+        ), "periodic resync must register after recovery"
+        assert consul.registered
+    finally:
+        syncer.stop()
+
+
 def test_vault_token_lifecycle(vault):
     v = VaultClient(vault, token="root")
     auth = v.derive_token(["web-policy"], metadata={"task": "t1"})
