@@ -77,6 +77,32 @@ class SpreadInputs(NamedTuple):
     even: jnp.ndarray = None  # bool[S]
 
 
+class TGInputs(NamedTuple):
+    """Per-pick task-group routing for multi-task-group evals.
+
+    The sequential scheduler iterates every task group's placements
+    within ONE eval (reference generic_sched.go:468 computePlacements:
+    destructive updates then places, each carrying its own task
+    group), with the stack's rotating walk offset persisting across
+    groups and failure coalescing applying PER GROUP.  The kernel
+    models that with per-pick routing: pick k selects group slot
+    ``tg_idx[k]``'s feasibility/affinity/collision columns and its own
+    ask/limit scalars, while the walk offset and usage columns stay a
+    single carry.  Single-task-group callers normalize to T=1 with
+    tg_idx==0 — the arithmetic is identical to the historical
+    single-group kernel."""
+
+    tg_idx: jnp.ndarray  # i32[P] group slot per pick
+    feasible: jnp.ndarray  # bool[T, C] static feasibility per group
+    affinity: jnp.ndarray  # f[T, C]
+    coll0: jnp.ndarray  # i32[T, C] anti-affinity base per group
+    ask_cpu: jnp.ndarray  # f[P] per-pick resource ask
+    ask_mem: jnp.ndarray  # f[P]
+    ask_disk: jnp.ndarray  # f[P]
+    desired_count: jnp.ndarray  # i32[P] group count for anti score
+    limit: jnp.ndarray  # i32[P] walk visit limit per pick
+
+
 class StepDeltas(NamedTuple):
     """Per-pick plan mutations for steady-state evals (leading axis E
     when chained).  The sequential path interleaves plan edits with
@@ -213,6 +239,7 @@ def _run_picks(
                   # static scan length without phantom placements
     spread: "SpreadInputs" = None,
     deltas: "StepDeltas" = None,
+    tg: "TGInputs" = None,
 ):
     """Inner pick scan; returns (rows i32[P], final used columns).
 
@@ -221,7 +248,12 @@ def _run_picks(
     step is purely elementwise + cumsum + reductions (the rotated walk
     is closed-form prefix arithmetic in `_walk`).  TPU gathers are the
     expensive op here — hoisting them out of the step turned a
-    ~0.54 ms/eval·pick kernel into a bandwidth-bound one."""
+    ~0.54 ms/eval·pick kernel into a bandwidth-bound one.
+
+    Internally the scan runs in per-pick/per-group space (see
+    TGInputs): single-task-group callers (``tg is None``) normalize to
+    T=1 with every pick routed to slot 0 — numerically identical to
+    the historical single-group kernel."""
     if wanted is None:
         wanted = jnp.asarray(n_picks, jnp.int32)
     dtype = cpu_total.dtype
@@ -230,12 +262,28 @@ def _run_picks(
     def take(col):
         return jnp.take(col, perm)
 
+    if tg is None:
+        tg = TGInputs(
+            tg_idx=jnp.zeros(n_picks, jnp.int32),
+            feasible=inp.feasible[None],
+            affinity=inp.affinity_score[None],
+            coll0=inp.base_collisions[None],
+            ask_cpu=jnp.broadcast_to(inp.ask_cpu, (n_picks,)),
+            ask_mem=jnp.broadcast_to(inp.ask_mem, (n_picks,)),
+            ask_disk=jnp.broadcast_to(inp.ask_disk, (n_picks,)),
+            desired_count=jnp.broadcast_to(
+                inp.desired_count, (n_picks,)
+            ),
+            limit=jnp.broadcast_to(inp.limit, (n_picks,)),
+        )
+    T = tg.feasible.shape[0]
+
     cpu_total_p = take(cpu_total)
     mem_total_p = take(mem_total)
     disk_total_p = take(disk_total)
-    feas_p = take(inp.feasible)
+    feas_tp = jnp.take(tg.feasible, perm, axis=1)  # (T, C)
     penalty_p = take(inp.penalty)
-    aff_p = take(inp.affinity_score)
+    aff_tp = jnp.take(tg.affinity, perm, axis=1)  # (T, C)
     safe_cpu = jnp.where(cpu_total_p > 0, cpu_total_p, 1.0)
     safe_mem = jnp.where(mem_total_p > 0, mem_total_p, 1.0)
 
@@ -257,16 +305,18 @@ def _run_picks(
         cpu_used = carry["cpu"]
         mem_used = carry["mem"]
         disk_used = carry["disk"]
-        collisions = carry["coll"]
+        collisions = carry["coll"]  # (T, C) per-group carry
         offset = carry["off"]
-        dead = carry["dead"]
+        dead = carry["dead"]  # (T,) per-group coalescing
         if spread is not None:
             spread_prop = carry["spread_prop"]
             spread_clr = carry["spread_clr"]
-        # once a pick fails, later picks for the eval are inert: the
-        # sequential path coalesces subsequent placements for a task
-        # group after its first failure (generic_sched.go:482)
-        active = (pick_idx < wanted) & ~dead
+        t = tg.tg_idx[pick_idx]
+        # once a pick fails, later picks for ITS task group are inert:
+        # the sequential path coalesces subsequent placements per task
+        # group after its first failure (generic_sched.go:482); other
+        # groups' picks continue
+        active = (pick_idx < wanted) & ~dead[t]
         penalty_vec = penalty_p
         app = jnp.asarray(False)
         if deltas is not None:
@@ -283,7 +333,7 @@ def _run_picks(
             disk_used = disk_used.at[epos].add(
                 jnp.where(app, deltas.evict_disk[pick_idx], zf)
             )
-            collisions = collisions.at[epos].add(
+            collisions = collisions.at[t, epos].add(
                 jnp.where(app, deltas.evict_coll[pick_idx], 0)
             )
             prow = deltas.penalty_rows[pick_idx]  # (K,)
@@ -300,9 +350,13 @@ def _run_picks(
                     jax.nn.one_hot(evict_slot, V1, dtype=dtype),
                     0.0,
                 )
-        cpu_after = cpu_used + inp.ask_cpu
-        mem_after = mem_used + inp.ask_mem
-        disk_after = disk_used + inp.ask_disk
+        ask_cpu_k = tg.ask_cpu[pick_idx]
+        ask_mem_k = tg.ask_mem[pick_idx]
+        ask_disk_k = tg.ask_disk[pick_idx]
+        coll_t = collisions[t]  # this pick's group's collision row
+        cpu_after = cpu_used + ask_cpu_k
+        mem_after = mem_used + ask_mem_k
+        disk_after = disk_used + ask_disk_k
         fit = (
             (cpu_after <= cpu_total_p)
             & (mem_after <= mem_total_p)
@@ -310,13 +364,16 @@ def _run_picks(
         )
         # distinct_hosts: a node is infeasible while any proposed alloc
         # of the job occupies it (feasible.go:470 DistinctHostsIterator).
-        # For the single-task-group jobs the batch path admits, the
-        # anti-affinity collision carry IS the proposed-allocs-per-node
-        # count: existing live allocs at the snapshot, +1 per pick,
-        # -1 per staged destructive eviction — so the mask is just
-        # collisions == 0.
-        feasible = feas_p & fit & ~(
-            inp.distinct_hosts & (collisions > 0)
+        # For the single-task-group jobs the batch path admits the
+        # collision carry IS the proposed-allocs-per-node count:
+        # existing live allocs at the snapshot, +1 per pick, -1 per
+        # staged destructive eviction — summing the group axis keeps
+        # that exact (every job alloc lives in exactly one group;
+        # multi-group jobs WITH distinct_hosts stay on the sequential
+        # path host-side).
+        occupancy = collisions.sum(axis=0)
+        feasible = feas_tp[t] & fit & ~(
+            inp.distinct_hosts & (occupancy > 0)
         )
 
         free_cpu = 1.0 - cpu_after / safe_cpu
@@ -330,19 +387,20 @@ def _run_picks(
         score_sum = fitness / 18.0
         count = jnp.ones_like(score_sum)
 
-        has_coll = collisions > 0
+        has_coll = coll_t > 0
         anti = jnp.where(
             has_coll,
-            -(collisions.astype(dtype) + 1.0)
-            / inp.desired_count.astype(dtype),
+            -(coll_t.astype(dtype) + 1.0)
+            / tg.desired_count[pick_idx].astype(dtype),
             0.0,
         )
         score_sum = score_sum + anti
         count = count + has_coll.astype(dtype)
         score_sum = score_sum - penalty_vec.astype(dtype)
         count = count + penalty_vec.astype(dtype)
-        has_aff = aff_p != 0.0
-        score_sum = score_sum + jnp.where(has_aff, aff_p, 0.0)
+        aff_k = aff_tp[t]
+        has_aff = aff_k != 0.0
+        score_sum = score_sum + jnp.where(has_aff, aff_k, 0.0)
         count = count + has_aff.astype(dtype)
         if spread is not None:
             # boost per stanza: ((desired - (used+1)) / desired) * w,
@@ -428,20 +486,20 @@ def _run_picks(
         final = score_sum / count
 
         win, any_emitted, step_pulls = _walk(
-            final, feasible, offset, inp.limit, n_candidates
+            final, feasible, offset, tg.limit[pick_idx], n_candidates
         )
         ok = active & any_emitted
-        dead = dead | (active & ~any_emitted)
+        dead = dead.at[t].set(dead[t] | (active & ~any_emitted))
         row = jnp.where(ok, perm[win], NO_NODE)
         pulls = jnp.where(active, step_pulls, 0)
         safe_win = jnp.where(ok, win, 0)
         upd = lambda arr, delta: arr.at[safe_win].add(
             jnp.where(ok, delta, jnp.zeros_like(delta))
         )
-        cpu_used = upd(cpu_used, inp.ask_cpu)
-        mem_used = upd(mem_used, inp.ask_mem)
-        disk_used = upd(disk_used, inp.ask_disk)
-        collisions = collisions.at[safe_win].add(
+        cpu_used = upd(cpu_used, ask_cpu_k)
+        mem_used = upd(mem_used, ask_mem_k)
+        disk_used = upd(disk_used, ask_disk_k)
+        collisions = collisions.at[t, safe_win].add(
             jnp.where(ok, 1, 0)
         )
         offset = jnp.mod(offset + pulls, n_candidates)
@@ -466,9 +524,9 @@ def _run_picks(
         "cpu": take(used0[0]),
         "mem": take(used0[1]),
         "disk": take(used0[2]),
-        "coll": take(inp.base_collisions),
+        "coll": jnp.take(tg.coll0, perm, axis=1),  # (T, C)
         "off": jnp.asarray(0, jnp.int32),
-        "dead": jnp.asarray(False),
+        "dead": jnp.zeros((T,), dtype=bool),
     }
     if spread is not None:
         carry0["spread_prop"] = spread.proposed0.astype(dtype)
@@ -487,9 +545,9 @@ def _run_picks(
         ).astype(base_col.dtype)
         return base_col.at[safe_rows].add(delta)
 
-    used_cpu = back(used0[0], inp.ask_cpu)
-    used_mem = back(used0[1], inp.ask_mem)
-    used_disk = back(used0[2], inp.ask_disk)
+    used_cpu = back(used0[0], tg.ask_cpu)
+    used_mem = back(used0[1], tg.ask_mem)
+    used_disk = back(used0[2], tg.ask_disk)
     if deltas is not None:
         # applied per-pick evictions also shift the chained columns
         safe_er = jnp.where(eapps, deltas.evict_rows, 0)
@@ -658,16 +716,23 @@ class ChainInputs(NamedTuple):
     E).  Unlike BatchInputs this carries NO copies of the shared node
     columns: the snapshot usage chains through the scan carry and the
     totals are closure inputs, so host assembly ships only what actually
-    differs per eval (~5x less host->device traffic at E=64)."""
+    differs per eval (~5x less host->device traffic at E=64).
 
-    feasible: jnp.ndarray  # bool[E, C]
+    The group axis T (usually 1) and the per-pick routing fields carry
+    multi-task-group evals: pick k uses group slot ``tg_idx[:, k]``'s
+    feasibility row and its own ask/count/limit scalars, mirroring
+    computePlacements' per-group iteration within one eval (reference
+    generic_sched.go:468)."""
+
+    feasible: jnp.ndarray  # bool[E, T, C]
     perm: jnp.ndarray  # i32[E, C]
-    ask_cpu: jnp.ndarray  # f[E]
-    ask_mem: jnp.ndarray  # f[E]
-    ask_disk: jnp.ndarray  # f[E]
-    desired_count: jnp.ndarray  # i32[E]
-    limit: jnp.ndarray  # i32[E]
+    ask_cpu: jnp.ndarray  # f[E, P]
+    ask_mem: jnp.ndarray  # f[E, P]
+    ask_disk: jnp.ndarray  # f[E, P]
+    desired_count: jnp.ndarray  # i32[E, P]
+    limit: jnp.ndarray  # i32[E, P]
     distinct_hosts: jnp.ndarray  # bool[E]
+    tg_idx: jnp.ndarray  # i32[E, P]
 
 
 @functools.partial(
@@ -685,8 +750,8 @@ def chained_plan_picks_cols(
     n_picks: int,
     spread_fit: bool = False,
     wanted=None,  # i32[E]
-    coll0=None,  # i32[E, C] anti-affinity base (None = zeros)
-    affinity=None,  # f[E, C] (None = zeros)
+    coll0=None,  # i32[E, T, C] anti-affinity base (None = zeros)
+    affinity=None,  # f[E, T, C] (None = zeros)
     spread: SpreadInputs = None,  # leading axis E
     deltas: StepDeltas = None,  # leading axis E
     pre: PreDeltas = None,  # leading axis E
@@ -696,12 +761,13 @@ def chained_plan_picks_cols(
     `chained_plan_picks`; only the input layout differs."""
     E = batch.perm.shape[0]
     C = cpu_total.shape[0]
+    T = batch.feasible.shape[1]
     nc = jnp.broadcast_to(jnp.asarray(n_candidates, jnp.int32), (E,))
     if wanted is None:
         wanted = jnp.full((E,), n_picks, jnp.int32)
-    zeros_i = jnp.zeros(C, jnp.int32)
+    zeros_ti = jnp.zeros((T, C), jnp.int32)
     zeros_b = jnp.zeros(C, dtype=bool)
-    zeros_f = jnp.zeros(C, cpu_total.dtype)
+    zeros_tf = jnp.zeros((T, C), cpu_total.dtype)
 
     parts = [batch, nc, wanted]
     pattern = []
@@ -713,8 +779,8 @@ def chained_plan_picks_cols(
     def eval_step(used, xs):
         it = iter(xs[3:])
         b = xs[0]
-        coll = next(it) if pattern[0] else zeros_i
-        aff = next(it) if pattern[1] else zeros_f
+        coll = next(it) if pattern[0] else zeros_ti
+        aff = next(it) if pattern[1] else zeros_tf
         s = next(it) if pattern[2] else None
         d = next(it) if pattern[3] else None
         p = next(it) if pattern[4] else None
@@ -724,25 +790,39 @@ def chained_plan_picks_cols(
                 used[1].at[p.rows].add(p.mem.astype(used[1].dtype)),
                 used[2].at[p.rows].add(p.disk.astype(used[2].dtype)),
             )
-        inp = BatchInputs(
+        tg_in = TGInputs(
+            tg_idx=b.tg_idx,
             feasible=b.feasible,
-            base_cpu_used=used[0],
-            base_mem_used=used[1],
-            base_disk_used=used[2],
-            base_collisions=coll,
-            penalty=zeros_b,
-            affinity_score=aff,
-            perm=b.perm,
+            affinity=aff,
+            coll0=coll,
             ask_cpu=b.ask_cpu,
             ask_mem=b.ask_mem,
             ask_disk=b.ask_disk,
             desired_count=b.desired_count,
             limit=b.limit,
+        )
+        # the BatchInputs carrier only supplies perm/penalty/
+        # distinct_hosts here — group-routed fields ride in tg_in
+        inp = BatchInputs(
+            feasible=b.feasible[0],
+            base_cpu_used=used[0],
+            base_mem_used=used[1],
+            base_disk_used=used[2],
+            base_collisions=coll[0],
+            penalty=zeros_b,
+            affinity_score=aff[0],
+            perm=b.perm,
+            ask_cpu=b.ask_cpu[0],
+            ask_mem=b.ask_mem[0],
+            ask_disk=b.ask_disk[0],
+            desired_count=b.desired_count[0],
+            limit=b.limit[0],
             distinct_hosts=b.distinct_hosts,
         )
         rows, used_next, _pulls = _run_picks(
             cpu_total, mem_total, disk_total, used, inp, xs[1],
             n_picks, spread_fit, wanted=xs[2], spread=s, deltas=d,
+            tg=tg_in,
         )
         return used_next, rows
 

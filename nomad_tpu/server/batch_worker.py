@@ -100,6 +100,12 @@ class _Sim:
         default_factory=list
     )
     evict_coll: List[int] = field(default_factory=list)
+    # task-group routing: the ordered distinct groups this eval
+    # places, and each pick's slot into that list (the sequential
+    # path iterates groups within one eval — generic_sched.go:468)
+    tgs: List[TaskGroup] = field(default_factory=list)
+    pick_tg: List[int] = field(default_factory=list)
+    # anti-affinity base per group slot: [T, C] (None when all zero)
     base_collisions: Optional[np.ndarray] = None
     # the shuffled walk order the sequential stack would use for the
     # placement set_nodes — captured from the sim ctx's rng AFTER the
@@ -124,21 +130,31 @@ class PrescoredStack:
     set_nodes + select, reference util.go:849) delegate to an inner
     oracle GenericStack, so the update/destructive decision is exact;
     full-node-set selects answer from the kernel rows after exact
-    verification of each winner."""
+    verification of each winner.
 
-    def __init__(self, ctx, job: Job, tg_name: str, rows: List[int],
-                 table, penalties: List[FrozenSet[str]],
-                 inner: GenericStack) -> None:
+    Multi-task-group evals: the pick sequence carries each pick's
+    group name (computePlacements iterates groups within one eval).
+    Failure coalescing is per group — after a group's first failed
+    pick the scheduler stops selecting for it, so the cursor silently
+    consumes that group's remaining picks when another group selects."""
+
+    def __init__(self, ctx, job: Job, pick_tgs: List[str],
+                 rows: List[int], table,
+                 penalties: List[FrozenSet[str]],
+                 inner: GenericStack,
+                 evict_rows: Optional[List[int]] = None) -> None:
         self.ctx = ctx
         self.job = job
-        self.tg_name = tg_name
+        self.pick_tgs = pick_tgs
         self.rows = rows
         self.table = table
         self.penalties = penalties
         self.inner = inner
+        self.evict_rows = evict_rows or []
         self.cursor = 0
         self.probing = False
         self.saw_failed_row = False
+        self.failed_tgs: set = set()
 
     def set_nodes(self, nodes) -> None:
         # single-node set_nodes comes from inplace-update probing;
@@ -157,14 +173,21 @@ class PrescoredStack:
     def select(self, tg: TaskGroup, options=None) -> Optional[RankedNode]:
         if self.probing:
             return self.inner.select(tg, options)
-        if tg.name != self.tg_name:
-            raise _Deviation("unexpected task group")
         if options is not None and options.preempt:
             raise _Deviation("preemption retry needs the sequential path")
         if options is not None and options.preferred_nodes:
             raise _Deviation("preferred nodes need the sequential path")
+        # skip picks of groups the scheduler has coalesced (their
+        # first failure means no further selects for that group)
+        while (
+            self.cursor < len(self.pick_tgs)
+            and self.pick_tgs[self.cursor] in self.failed_tgs
+        ):
+            self.cursor += 1
         if self.cursor >= len(self.rows):
             raise _Deviation("prescored picks exhausted")
+        if tg.name != self.pick_tgs[self.cursor]:
+            raise _Deviation("unexpected task group")
         expected = (
             self.penalties[self.cursor]
             if self.cursor < len(self.penalties)
@@ -178,12 +201,31 @@ class PrescoredStack:
         if got != expected:
             raise _Deviation("penalty set mismatch")
         row = self.rows[self.cursor]
+        pick = self.cursor
         self.cursor += 1
         if row < 0:
-            # prescored failure: the scheduler coalesces the rest, and
-            # the chain's post-failure state is suspect (a destructive
-            # eviction staged for this pick gets popped sequentially)
+            # prescored failure: the chain's state past this eval is
+            # suspect (the caller re-prescores).  Within THIS eval the
+            # kernel's per-group dead carry keeps the other groups'
+            # remaining picks exact — UNLESS the failed pick staged a
+            # destructive eviction, which the sequential path pops
+            # back out of the plan (generic_sched.py:402) while the
+            # kernel kept its delta applied
             self.saw_failed_row = True
+            self.failed_tgs.add(tg.name)
+            staged_evict = (
+                pick < len(self.evict_rows)
+                and self.evict_rows[pick] >= 0
+            )
+            more_other_tg = any(
+                t not in self.failed_tgs
+                for t in self.pick_tgs[self.cursor:]
+            )
+            if staged_evict and more_other_tg:
+                raise _Deviation(
+                    "failed pick staged an eviction; remaining "
+                    "groups' rows are suspect"
+                )
             return None
         node_id = self.table.node_ids[row]
         node = self.ctx.state.node_by_id(node_id)
@@ -349,11 +391,11 @@ class BatchWorker(Worker):
         contiguous run of batchable evals in one chained kernel launch
         so the outcome is exactly what the serial worker loop would
         produce."""
-        run: List[Tuple[Evaluation, str, Job, TaskGroup]] = []
+        run: List[Tuple[Evaluation, str, Job]] = []
         for ev, token in batch:
             job = self.store.job_by_id(ev.namespace, ev.job_id)
             if self._batchable(ev, job):
-                run.append((ev, token, job, job.task_groups[0]))
+                run.append((ev, token, job))
                 continue
             self._flush_run(run)
             run = []
@@ -371,9 +413,9 @@ class BatchWorker(Worker):
             sims: List[_Sim] = []
             j = idx
             while j < len(run):
-                ev, _token, job, tg = run[j]
+                ev, _token, job = run[j]
                 try:
-                    sim = self._simulate(snap, ev, job, tg)
+                    sim = self._simulate(snap, ev, job)
                 except Exception:  # noqa: BLE001
                     # a broken simulation falls back to the exact path,
                     # but silently eating it would demote the fast path
@@ -407,7 +449,7 @@ class BatchWorker(Worker):
             k = idx
             rescore = False
             while k < j and not rescore:
-                ev, token, job, tg = run[k]
+                ev, token, job = run[k]
                 sim = sims[k - idx]
                 rows = rows_map.get(ev.id)
                 if rows is None:
@@ -417,7 +459,7 @@ class BatchWorker(Worker):
                 t0 = _time.monotonic()
                 try:
                     clean = self._process_prescored(
-                        ev, token, job, tg, rows, sim
+                        ev, token, job, rows, sim
                     )
                     self._observe("replay", _time.monotonic() - t0)
                     self._count("prescored")
@@ -465,44 +507,62 @@ class BatchWorker(Worker):
             return False
         if ev.type not in ("service", "batch"):
             return False
-        if len(job.task_groups) != 1:
-            return False
-        tg = job.task_groups[0]
-        # both spread modes run in-kernel: percent targets via the
-        # desired/used carry, even mode (no targets) via min/max over
-        # the observed use map (ops/batch.py even_full)
-        # host-mode DYNAMIC-port asks are batchable: binpack never
-        # skips a node for a dynamic-only ask (the per-node range is
-        # thousands of ports), so the sequential walk window is
-        # port-independent and the kernel's port-blind scoring stays
-        # bit-identical; the winner's exact BinPack verification
-        # (PrescoredStack.select) still assigns the real ports.
-        # Reserved/static ports stay sequential: a port-collided node
-        # is skipped by binpack WITHOUT consuming a limit slot
-        # (rank.py continue), an asymmetry the kernel's window
-        # arithmetic cannot see — winner-only verification would miss
-        # divergent windows. Non-host modes gate on NetworkChecker
-        # feasibility the kernel doesn't model either.
-        for nw in list(tg.networks) + [
-            n for t in tg.tasks for n in t.resources.networks
-        ]:
-            if (nw.mode or "host") != "host":
+        multi_tg = len(job.task_groups) > 1
+        if multi_tg:
+            # the per-pick group routing (ops/batch.py TGInputs)
+            # covers plain multi-group jobs; spreads stay sequential
+            # there (each group's propertyset filters its own allocs —
+            # a per-group carry the kernel doesn't model yet) and so
+            # does distinct_hosts (the job-wide occupancy would need
+            # base counts for groups with no picks this eval)
+            if list(job.spreads) or any(
+                tg.spreads for tg in job.task_groups
+            ):
                 return False
-            if nw.reserved_ports:
+            if any(
+                c.operand == CONSTRAINT_DISTINCT_HOSTS
+                for c in list(job.constraints)
+                + [c for tg in job.task_groups for c in tg.constraints]
+            ):
                 return False
-        if any(t.resources.devices for t in tg.tasks):
-            return False
-        # distinct_hosts IS batchable: for single-TG jobs the kernel's
-        # collision carry equals the proposed-allocs-per-node count, so
-        # the mask is exact (ops/batch.py feasibility)
-        if tg.ephemeral_disk.sticky:
-            return False
+        for tg in job.task_groups:
+            # both spread modes run in-kernel: percent targets via the
+            # desired/used carry, even mode (no targets) via min/max
+            # over the observed use map (ops/batch.py even_full)
+            # host-mode DYNAMIC-port asks are batchable: binpack never
+            # skips a node for a dynamic-only ask (the per-node range
+            # is thousands of ports), so the sequential walk window is
+            # port-independent and the kernel's port-blind scoring
+            # stays bit-identical; the winner's exact BinPack
+            # verification (PrescoredStack.select) still assigns the
+            # real ports.
+            # Reserved/static ports stay sequential: a port-collided
+            # node is skipped by binpack WITHOUT consuming a limit
+            # slot (rank.py continue), an asymmetry the kernel's
+            # window arithmetic cannot see — winner-only verification
+            # would miss divergent windows. Non-host modes gate on
+            # NetworkChecker feasibility the kernel doesn't model
+            # either.
+            for nw in list(tg.networks) + [
+                n for t in tg.tasks for n in t.resources.networks
+            ]:
+                if (nw.mode or "host") != "host":
+                    return False
+                if nw.reserved_ports:
+                    return False
+            if any(t.resources.devices for t in tg.tasks):
+                return False
+            # distinct_hosts IS batchable for single-TG jobs: the
+            # kernel's collision carry equals the proposed-allocs-
+            # per-node count, so the mask is exact
+            if tg.ephemeral_disk.sticky:
+                return False
         return True
 
     # ------------------------------------------------------------------
 
-    def _simulate(self, snap, ev: Evaluation, job: Job,
-                  tg: TaskGroup) -> Optional[_Sim]:
+    def _simulate(self, snap, ev: Evaluation,
+                  job: Job) -> Optional[_Sim]:
         """Host-side mirror of computeJobAllocs up to (not including)
         the select calls (reference generic_sched.go:332): runs the
         real reconciler on the prescore snapshot and extracts the plan
@@ -550,7 +610,12 @@ class BatchWorker(Worker):
         sim = _Sim(placements=0)
         table = snap.node_table
 
+        # spreads only reach here for single-group jobs (_batchable
+        # keeps multi-group + spread evals on the sequential path)
+        tg = job.task_groups[0]
         combined_spreads = list(tg.spreads) + list(job.spreads)
+        if len(job.task_groups) > 1:
+            combined_spreads = []
         if combined_spreads:
             # propertyset bookkeeping for the in-kernel spread carry
             # (propertyset.go): existing = live allocs of the job
@@ -664,25 +729,37 @@ class BatchWorker(Worker):
         if len(sim.pre) > MAX_PRE_ROWS:
             return None
 
-        # anti-affinity base: proposed same-job+tg allocs per node at
-        # pre-placement time (rank.go:474 collision count)
-        coll = np.zeros(table.capacity, dtype=np.int32)
-        for a in allocs:
-            if a.terminal_status() or a.id in evicted_ids:
-                continue
-            if a.job_id == job.id and a.task_group == tg.name:
-                row = table.row_of.get(a.node_id)
-                if row is not None:
-                    coll[row] += 1
-        sim.base_collisions = coll
-
         placements = list(results.destructive_update) + list(
             results.place
         )
+        # ordered distinct groups this eval places (pick k routes to
+        # group slot pick_tg[k] in the kernel)
+        tg_slot: Dict[str, int] = {}
+        for missing in placements:
+            name = missing.task_group.name
+            if name not in tg_slot:
+                tg_slot[name] = len(sim.tgs)
+                sim.tgs.append(missing.task_group)
+            sim.pick_tg.append(tg_slot[name])
+
+        # anti-affinity base: proposed same-job+group allocs per node
+        # at pre-placement time (rank.go:474 collision count), one row
+        # per group slot
+        coll = np.zeros(
+            (max(1, len(sim.tgs)), table.capacity), dtype=np.int32
+        )
+        for a in allocs:
+            if a.terminal_status() or a.id in evicted_ids:
+                continue
+            slot = tg_slot.get(a.task_group)
+            if a.job_id == job.id and slot is not None:
+                row = table.row_of.get(a.node_id)
+                if row is not None:
+                    coll[slot, row] += 1
+        sim.base_collisions = coll
+
         for missing in placements:
             p_tg = missing.task_group
-            if p_tg.name != tg.name:
-                return None
             prev = missing.previous_alloc
             if prev is not None and p_tg.ephemeral_disk.sticky:
                 return None  # preferred-node path
@@ -706,7 +783,7 @@ class BatchWorker(Worker):
                         )
                         if (
                             prev.job_id == job.id
-                            and prev.task_group == tg.name
+                            and prev.task_group == p_tg.name
                         ):
                             e_coll = -1
             sim.evict_rows.append(e_row)
@@ -736,78 +813,92 @@ class BatchWorker(Worker):
 
     # ------------------------------------------------------------------
 
-    def _inert_inputs(self, table) -> ChainInputs:
-        """A padding eval: wanted=0 makes every pick step a no-op, so
-        the chained carry passes through unchanged.  Padding the eval
-        axis to a fixed bucket keeps the jit trace cache small (one
-        trace per (E_bucket, P_bucket) pair instead of one per run
-        length)."""
+    def _inert_inputs(self, table, P: int = 16,
+                      T: int = 1) -> ChainInputs:
+        """A single inert eval in the stacked layout (E axis absent):
+        wanted=0 makes every pick step a no-op, so the chained carry
+        passes through unchanged.  Used by warm_shapes; production
+        padding rows are built directly in _prescore."""
         C = table.capacity
         return ChainInputs(
-            feasible=np.zeros(C, dtype=bool),
+            feasible=np.zeros((T, C), dtype=bool),
             perm=np.arange(C, dtype=np.int32),
-            ask_cpu=np.float64(0.0),
-            ask_mem=np.float64(0.0),
-            ask_disk=np.float64(0.0),
-            desired_count=np.int32(1),
-            limit=np.int32(1),
+            ask_cpu=np.zeros(P),
+            ask_mem=np.zeros(P),
+            ask_disk=np.zeros(P),
+            desired_count=np.ones(P, np.int32),
+            limit=np.ones(P, np.int32),
             distinct_hosts=np.bool_(False),
+            tg_idx=np.zeros(P, np.int32),
         )
 
     def warm_shapes(
-        self, e_buckets=(8, BATCH_MAX), p_buckets=(16,)
+        self, e_buckets=(8, BATCH_MAX), p_buckets=(16,),
+        t_buckets=(1, 2),
     ) -> None:
         """Pre-compile the chained kernel for the common launch shapes
         so the first production batches don't pay the jit compile (the
-        bench and server startup call this outside any timed region)."""
+        bench and server startup call this outside any timed region).
+        T buckets cover the single-group shape and the first multi-
+        task-group bucket (T=2 — jobs with 2 groups; 3-4-group jobs
+        pad to T=4 and compile on first sighting)."""
         table = self.store.node_table
         C = table.capacity
-        inert = self._inert_inputs(table)
         for e in e_buckets:
             for p in p_buckets:
-                stacked = ChainInputs(
-                    *[
-                        np.stack([getattr(inert, f)] * e)
-                        for f in ChainInputs._fields
-                    ]
-                )
-                for extras in (
-                    {},
-                    # steady-state variant: anti-affinity bases and
-                    # affinity vectors present
-                    {
-                        "coll0": np.zeros((e, C), np.int32),
-                        "affinity": np.zeros((e, C)),
-                    },
-                ):
-                    args = (
-                        table.cpu_total,
-                        table.mem_total,
-                        table.disk_total,
-                        table.cpu_used,
-                        table.mem_used,
-                        table.disk_used,
-                        stacked,
-                        np.full(e, 1, np.int32),
-                        int(p),
+                for t in t_buckets:
+                    inert = self._inert_inputs(
+                        table, P=int(p), T=int(t)
                     )
-                    kwargs = dict(
-                        spread_fit=False,
-                        wanted=np.zeros(e, np.int32),
-                        coll0=None,
-                        affinity=None,
-                        spread=None,
-                        deltas=self._zero_deltas(e, p),
-                        pre=self._zero_pre(e),
+                    stacked = ChainInputs(
+                        *[
+                            np.stack([getattr(inert, f)] * e)
+                            for f in ChainInputs._fields
+                        ]
                     )
-                    kwargs.update(extras)
-                    np.asarray(
-                        chained_plan_picks_cols(*args, **kwargs)
-                    )
-                    with self._compile_lock:
-                        self._compiled.add(
-                            self._launch_signature(args, kwargs)
+                    for extras in (
+                        {},
+                        # steady-state variant: anti-affinity bases
+                        # and affinity vectors present
+                        {
+                            "coll0": np.zeros((e, t, C), np.int32),
+                            "affinity": np.zeros((e, t, C)),
+                        },
+                    ):
+                        args = (
+                            table.cpu_total,
+                            table.mem_total,
+                            table.disk_total,
+                            table.cpu_used,
+                            table.mem_used,
+                            table.disk_used,
+                            stacked,
+                            np.full(e, 1, np.int32),
+                            int(p),
                         )
+                        kwargs = dict(
+                            spread_fit=False,
+                            wanted=np.zeros(e, np.int32),
+                            coll0=None,
+                            affinity=None,
+                            spread=None,
+                            deltas=self._zero_deltas(e, p),
+                            pre=self._zero_pre(e),
+                        )
+                        kwargs.update(extras)
+                        np.asarray(
+                            chained_plan_picks_cols(*args, **kwargs)
+                        )
+                        with self._compile_lock:
+                            # must match _launch_ready's lookup key
+                            # (fn-name prefix included), or warmed
+                            # shapes are never recognized
+                            self._compiled.add(
+                                ("chained_plan_picks_cols",)
+                                + self._launch_signature(
+                                    args, kwargs
+                                )
+                            )
 
     @staticmethod
     def _zero_deltas(E: int, P: int) -> StepDeltas:
@@ -930,14 +1021,15 @@ class BatchWorker(Worker):
         C = table.capacity
         compiler = MaskCompiler(table)
 
-        per_eval: List[ChainInputs] = []
-        aff_rows: List[Optional[np.ndarray]] = []
-        coll_rows: List[Optional[np.ndarray]] = []
+        # per-eval assembly in group-routed form: feasibility/affinity/
+        # collision bases per group slot [T, C], asks/limits per pick
+        per_eval: List[dict] = []
         n_cands: List[int] = []
         # per eval: list of (codes, desired, used0, weight_frac) or None
         spread_per_eval: List[Optional[list]] = []
         max_picks = 1
-        for (ev, _token, job, tg), sim in zip(prescorable, sims):
+        max_tgs = 1
+        for (ev, _token, job), sim in zip(prescorable, sims):
             nodes, rows, rest = self._candidates(
                 snap, job.datacenters
             )
@@ -949,9 +1041,26 @@ class BatchWorker(Worker):
                     random.Random(self.seed), n_cand
                 )
             perm = np.concatenate([rows[order], rest])
-            feasible, aff_vec = self._static_vectors(
-                snap, job, tg, rows
-            )
+            tgs = sim.tgs or [job.task_groups[0]]
+            tg = tgs[0]
+            max_tgs = max(max_tgs, len(tgs))
+            feas_t = []
+            aff_t = []
+            has_aff_t = []
+            for g in tgs:
+                feasible_g, aff_vec_g = self._static_vectors(
+                    snap, job, g, rows
+                )
+                feas_t.append(feasible_g)
+                aff_t.append(aff_vec_g)
+                has_aff_t.append(
+                    bool(
+                        list(job.affinities)
+                        or list(g.affinities)
+                        or any(t.affinities for t in g.tasks)
+                    )
+                )
+            has_aff_any = any(has_aff_t)
 
             # percent-target spreads -> in-kernel carry inputs.  The
             # info map is attribute-keyed (shared compute_spread_info,
@@ -1006,48 +1115,73 @@ class BatchWorker(Worker):
                     )
             spread_per_eval.append(eval_spreads)
 
-            has_affinities = bool(
-                list(job.affinities)
-                or list(tg.affinities)
-                or any(t.affinities for t in tg.tasks)
-            )
             distinct_hosts = any(
                 c.operand == CONSTRAINT_DISTINCT_HOSTS
-                for c in list(job.constraints) + list(tg.constraints)
+                for c in list(job.constraints)
+                + [c for g in tgs for c in g.constraints]
             )
-            limit = compute_visit_limit(n_cand, ev.type == "batch")
-            if has_affinities or combined_spreads:
-                limit = 2**31 - 1
+            base_limit = compute_visit_limit(
+                n_cand, ev.type == "batch"
+            )
+            # per-group visit limits: affinities (or spreads) lift the
+            # walk cap for that group's selects (stack.py limit rules)
+            limits_t = [
+                2**31 - 1
+                if has_aff_g or combined_spreads
+                else base_limit
+                for has_aff_g in has_aff_t
+            ]
 
             max_picks = max(max_picks, sim.placements)
             n_cands.append(n_cand)
-            aff_rows.append(aff_vec if has_affinities else None)
-            coll_rows.append(
-                sim.base_collisions
-                if sim.base_collisions is not None
-                and sim.base_collisions.any()
-                else None
-            )
+            pick_tg = sim.pick_tg or [0] * sim.placements
             per_eval.append(
-                ChainInputs(
-                    feasible=feasible,
+                dict(
+                    feasible=np.stack(feas_t),  # [T, C]
+                    affinity=(
+                        np.stack(aff_t) if has_aff_any else None
+                    ),
+                    coll0=(
+                        sim.base_collisions
+                        if sim.base_collisions is not None
+                        and sim.base_collisions.any()
+                        else None
+                    ),
                     perm=perm,
-                    ask_cpu=np.float64(
-                        sum(t.resources.cpu for t in tg.tasks)
-                    ),
-                    ask_mem=np.float64(
-                        sum(t.resources.memory_mb for t in tg.tasks)
-                    ),
-                    ask_disk=np.float64(tg.ephemeral_disk.size_mb),
-                    desired_count=np.int32(tg.count),
-                    limit=np.int32(limit),
-                    distinct_hosts=np.bool_(distinct_hosts),
+                    pick_tg=pick_tg,
+                    ask_cpu=[
+                        float(
+                            sum(
+                                t.resources.cpu
+                                for t in tgs[s].tasks
+                            )
+                        )
+                        for s in pick_tg
+                    ],
+                    ask_mem=[
+                        float(
+                            sum(
+                                t.resources.memory_mb
+                                for t in tgs[s].tasks
+                            )
+                        )
+                        for s in pick_tg
+                    ],
+                    ask_disk=[
+                        float(tgs[s].ephemeral_disk.size_mb)
+                        for s in pick_tg
+                    ],
+                    desired_count=[
+                        int(tgs[s].count) for s in pick_tg
+                    ],
+                    limit=[int(limits_t[s]) for s in pick_tg],
+                    distinct_hosts=bool(distinct_hosts),
                 )
             )
 
         # bucket dynamic shapes so jit traces stay cached across
-        # batches: the pick and eval axes pad to fixed buckets, and
-        # deltas/pre ship always (zero-filled when absent).  coll0/
+        # batches: the pick, eval and group axes pad to fixed buckets,
+        # and deltas/pre ship always (zero-filled when absent).  coll0/
         # affinity/spread remain optional trace variants — warm_shapes
         # pre-compiles the coll0+affinity one; spread batches bucket
         # their (S, V1) axes to powers of two below to bound variants
@@ -1057,33 +1191,59 @@ class BatchWorker(Worker):
         # programs per pick bucket
         E = 8 if E_real <= 8 else BATCH_MAX
         P = 16 if max_picks <= 16 else _pow2(max_picks)
+        T = _pow2(max_tgs)
         K = MAX_PENALTY_NODES
         if E > E_real:
-            inert = self._inert_inputs(table)
-            per_eval.extend([inert] * (E - E_real))
             n_cands.extend([1] * (E - E_real))
             spread_per_eval.extend([None] * (E - E_real))
-            aff_rows.extend([None] * (E - E_real))
-            coll_rows.extend([None] * (E - E_real))
 
+        # stack into the kernel layout, padding the T and P axes
+        def _pad_picks(vals, fill, dtype):
+            out = np.full((E, P), fill, dtype)
+            for k, e in enumerate(per_eval):
+                v = vals(e)
+                out[k, : len(v)] = v
+            return out
+
+        feasible_s = np.zeros((E, T, C), dtype=bool)
+        for k, e in enumerate(per_eval):
+            feasible_s[k, : e["feasible"].shape[0]] = e["feasible"]
+        perm_s = np.tile(
+            np.arange(C, dtype=np.int32), (E, 1)
+        )
+        for k, e in enumerate(per_eval):
+            perm_s[k] = e["perm"]
         stacked = ChainInputs(
-            *[
-                np.stack([getattr(e, f) for e in per_eval])
-                for f in ChainInputs._fields
-            ]
+            feasible=feasible_s,
+            perm=perm_s,
+            ask_cpu=_pad_picks(lambda e: e["ask_cpu"], 0.0, float),
+            ask_mem=_pad_picks(lambda e: e["ask_mem"], 0.0, float),
+            ask_disk=_pad_picks(lambda e: e["ask_disk"], 0.0, float),
+            desired_count=_pad_picks(
+                lambda e: e["desired_count"], 1, np.int32
+            ),
+            limit=_pad_picks(lambda e: e["limit"], 1, np.int32),
+            distinct_hosts=np.array(
+                [e["distinct_hosts"] for e in per_eval]
+                + [False] * (E - E_real),
+                dtype=bool,
+            ),
+            tg_idx=_pad_picks(lambda e: e["pick_tg"], 0, np.int32),
         )
         coll0 = None
-        if any(c is not None for c in coll_rows):
-            coll0 = np.zeros((E, C), np.int32)
-            for k, c in enumerate(coll_rows):
-                if c is not None:
-                    coll0[k] = c
+        if any(e["coll0"] is not None for e in per_eval):
+            coll0 = np.zeros((E, T, C), np.int32)
+            for k, e in enumerate(per_eval):
+                if e["coll0"] is not None:
+                    coll0[k, : e["coll0"].shape[0]] = e["coll0"]
         affinity = None
-        if any(a is not None for a in aff_rows):
-            affinity = np.zeros((E, C))
-            for k, a in enumerate(aff_rows):
-                if a is not None:
-                    affinity[k] = a
+        if any(e["affinity"] is not None for e in per_eval):
+            affinity = np.zeros((E, T, C))
+            for k, e in enumerate(per_eval):
+                if e["affinity"] is not None:
+                    affinity[k, : e["affinity"].shape[0]] = (
+                        e["affinity"]
+                    )
 
         deltas = self._zero_deltas(E, P)
         for k, sim in enumerate(sims):
@@ -1188,9 +1348,14 @@ class BatchWorker(Worker):
         use_mesh = (
             self._mesh is not None
             and spread_stack is None
+            and T == 1
             and C % self._mesh.devices.size == 0
         )
         if use_mesh:
+            # single-group batches only: the sharded runner keeps the
+            # historical per-eval scalar layout, which the T=1 slices
+            # reproduce exactly (per-pick values are constant within a
+            # single-group eval)
             runner = self._sharded_runner(int(P), spread_fit)
             sh_args = (
                 table.cpu_total,
@@ -1199,20 +1364,20 @@ class BatchWorker(Worker):
                 table.cpu_used,
                 table.mem_used,
                 table.disk_used,
-                stacked.feasible,
+                stacked.feasible[:, 0],
                 stacked.perm,
-                stacked.ask_cpu,
-                stacked.ask_mem,
-                stacked.ask_disk,
-                stacked.desired_count,
-                stacked.limit,
+                stacked.ask_cpu[:, 0],
+                stacked.ask_mem[:, 0],
+                stacked.ask_disk[:, 0],
+                stacked.desired_count[:, 0],
+                stacked.limit[:, 0],
                 wanted,
                 np.asarray(n_cands, np.int32),
                 stacked.distinct_hosts,
-                coll0
+                coll0[:, 0]
                 if coll0 is not None
                 else np.zeros((E, C), np.int32),
-                affinity
+                affinity[:, 0]
                 if affinity is not None
                 else np.zeros((E, C)),
                 deltas,
@@ -1233,7 +1398,7 @@ class BatchWorker(Worker):
                 chained_plan_picks_cols(*args, **kwargs)
             )
         out: Dict[str, List[int]] = {}
-        for k, (ev, _token, _job, _tg) in enumerate(prescorable):
+        for k, (ev, _token, _job) in enumerate(prescorable):
             out[ev.id] = [
                 int(r) for r in rows_out[k, : sims[k].placements]
             ]
@@ -1302,7 +1467,7 @@ class BatchWorker(Worker):
     # ------------------------------------------------------------------
 
     def _process_prescored(
-        self, ev: Evaluation, token: str, job: Job, tg: TaskGroup,
+        self, ev: Evaluation, token: str, job: Job,
         rows: List[int], sim: _Sim,
     ) -> bool:
         """Replay one prescored eval through the real scheduler.
@@ -1313,6 +1478,9 @@ class BatchWorker(Worker):
         )
         ev.snapshot_index = snap.index
         made = []
+        pick_tgs = [
+            sim.tgs[s].name for s in sim.pick_tg
+        ] if sim.pick_tg else []
 
         class _Factory:
             def __call__(self, state, planner, batch, use_tpu=None,
@@ -1329,8 +1497,9 @@ class BatchWorker(Worker):
                         raise _Deviation("scheduler retry")
                     inner = GenericStack(batch, sched.ctx)
                     stack = PrescoredStack(
-                        sched.ctx, job, tg.name, rows,
+                        sched.ctx, job, pick_tgs, rows,
                         snap.node_table, sim.penalties, inner,
+                        evict_rows=sim.evict_rows,
                     )
                     made.append(stack)
                     return stack

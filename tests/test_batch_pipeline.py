@@ -795,3 +795,272 @@ def test_batch_pipeline_even_mode_edge_cases_match():
     finally:
         seq.stop()
         bat.stop()
+
+
+def test_batch_pipeline_multi_task_group_matches_sequential():
+    """Multi-task-group jobs run the prescored path (per-pick group
+    routing, ops/batch.py TGInputs) bit-identically to the sequential
+    scheduler: the walk offset continues across groups within one eval
+    (reference generic_sched.go:468 computePlacements iterating task
+    groups), asks/feasibility/anti-affinity are per group."""
+    import dataclasses
+
+    from nomad_tpu.structs import Task, TaskGroup
+
+    def add_group(job, name, count, cpu, mem, driver="mock_driver"):
+        tg0 = job.task_groups[0]
+        tg = TaskGroup(
+            name=name,
+            count=count,
+            restart_policy=tg0.restart_policy,
+            reschedule_policy=tg0.reschedule_policy,
+            tasks=[
+                Task(
+                    name=f"{name}-task",
+                    driver=driver,
+                    resources=dataclasses.replace(
+                        tg0.tasks[0].resources,
+                        cpu=cpu,
+                        memory_mb=mem,
+                    ),
+                )
+            ],
+            ephemeral_disk=tg0.ephemeral_disk,
+        )
+        job.task_groups.append(tg)
+
+    def make_stream():
+        rng = random.Random(7)
+        jobs = []
+        for i in range(10):
+            job = mock.job(id=f"mtg-{i}")
+            job.task_groups[0].count = rng.randint(1, 4)
+            job.task_groups[0].tasks[0].resources.cpu = rng.choice(
+                [200, 500]
+            )
+            if i % 3 != 2:  # mixed stream: mostly multi-group
+                add_group(
+                    job, "api", rng.randint(1, 3),
+                    rng.choice([300, 700]), 512,
+                )
+            if i % 4 == 1:  # three groups
+                add_group(job, "cache", 2, 250, 256)
+            jobs.append(job)
+        return jobs
+
+    nodes = make_nodes(24, seed=5)
+    seq = Server(num_schedulers=1, seed=41, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=41, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        jobs = make_stream()
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(30)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(60)
+
+        for job in jobs:
+            assert placements(seq, job.id) == placements(
+                bat, job.id
+            ), f"divergence for {job.id}"
+        worker = bat.workers[0]
+        total = worker.prescored + worker.fallbacks
+        assert total > 0
+        rate = worker.prescored / total
+        assert rate > 0.8, (
+            f"multi-group stream prescore rate too low: "
+            f"{worker.prescored}/{total}"
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_multi_tg_failure_coalescing_matches():
+    """Per-group failure coalescing: a group whose ask exceeds every
+    node fails while its sibling group keeps placing — bit-identical
+    to the sequential path (generic_sched.go:482 coalesces failures
+    PER task group)."""
+    import dataclasses
+
+    from nomad_tpu.structs import Task, TaskGroup
+
+    nodes = make_nodes(12, seed=9)
+    seq = Server(num_schedulers=1, seed=13, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=13, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        def giant_job():
+            job = mock.job(id="mtg-fail")
+            tg0 = job.task_groups[0]
+            tg0.count = 3
+            tg0.tasks[0].resources.cpu = 300
+            giant = TaskGroup(
+                name="giant",
+                count=2,
+                restart_policy=tg0.restart_policy,
+                reschedule_policy=tg0.reschedule_policy,
+                tasks=[
+                    Task(
+                        name="giant-task",
+                        driver="mock_driver",
+                        resources=dataclasses.replace(
+                            tg0.tasks[0].resources,
+                            cpu=50_000,  # no node fits
+                            memory_mb=512,
+                        ),
+                    )
+                ],
+                ephemeral_disk=tg0.ephemeral_disk,
+            )
+            # giant placed between web groups in the placement stream
+            job.task_groups.append(giant)
+            return job
+
+        for server in (seq, bat):
+            server.register_job(giant_job())
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "mtg-fail") == placements(
+            bat, "mtg-fail"
+        )
+        # the web group placed, the giant group failed on both paths
+        seq_evals = seq.store.evals_by_job("default", "mtg-fail")
+        bat_evals = bat.store.evals_by_job("default", "mtg-fail")
+        def failed_tgs(evs):
+            return sorted(
+                {
+                    name
+                    for e in evs
+                    for name in (e.failed_tg_allocs or {})
+                }
+            )
+        assert failed_tgs(seq_evals) == failed_tgs(bat_evals)
+        assert "giant" in failed_tgs(bat_evals)
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_multi_tg_steady_state_matches():
+    """Steady-state multi-group churn (version bump -> destructive
+    updates across BOTH groups in one eval) stays bit-identical and
+    prescored."""
+    import dataclasses
+
+    from nomad_tpu.structs import Task, TaskGroup
+
+    nodes = make_nodes(20, seed=11)
+    seq = Server(num_schedulers=1, seed=23, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=23, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        def versioned(version):
+            job = mock.job(id="mtg-churn", type="batch")
+            tg0 = job.task_groups[0]
+            tg0.count = 3
+            tg0.tasks[0].resources.cpu = 400
+            api = TaskGroup(
+                name="api",
+                count=2,
+                restart_policy=tg0.restart_policy,
+                reschedule_policy=tg0.reschedule_policy,
+                tasks=[
+                    Task(
+                        name="api-task",
+                        driver="mock_driver",
+                        resources=dataclasses.replace(
+                            tg0.tasks[0].resources,
+                            cpu=600,
+                            memory_mb=512,
+                        ),
+                    )
+                ],
+                ephemeral_disk=tg0.ephemeral_disk,
+            )
+            job.task_groups.append(api)
+            if version:
+                for tg in job.task_groups:
+                    tg.tasks[0].config = {"command": "/bin/true"}
+                job.version = version
+            return job
+
+        for server in (seq, bat):
+            server.register_job(versioned(0))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "mtg-churn") == placements(
+            bat, "mtg-churn"
+        )
+        # destructive update across both groups in one eval
+        for server in (seq, bat):
+            server.register_job(versioned(1))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "mtg-churn") == placements(
+            bat, "mtg-churn"
+        )
+        assert bat.workers[0].prescored >= 2, (
+            bat.workers[0].prescored,
+            bat.workers[0].fallbacks,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_warm_shapes_are_recognized_by_launch_gate(monkeypatch):
+    """warm_shapes must register signatures under the same key
+    _launch_ready looks up (fn-name prefix included) — otherwise every
+    pre-warmed shape still counts a cold_shape_fallback on first
+    production sighting and the warm-up is defeated."""
+    monkeypatch.delenv("NOMAD_TPU_SYNC_COMPILE", raising=False)
+    bat = Server(num_schedulers=1, seed=3, batch_pipeline=True)
+    bat.start()
+    try:
+        bat.register_node(mock.node())
+        worker = bat.workers[0]
+        worker.warm_shapes(
+            e_buckets=(8,), p_buckets=(16,), t_buckets=(1,)
+        )
+        table = bat.store.node_table
+        inert = worker._inert_inputs(table, P=16, T=1)
+        import numpy as np
+        stacked = type(inert)(
+            *[
+                np.stack([getattr(inert, f)] * 8)
+                for f in type(inert)._fields
+            ]
+        )
+        args = (
+            table.cpu_total, table.mem_total, table.disk_total,
+            table.cpu_used, table.mem_used, table.disk_used,
+            stacked, np.full(8, 1, np.int32), 16,
+        )
+        kwargs = dict(
+            spread_fit=False, wanted=np.zeros(8, np.int32),
+            coll0=None, affinity=None, spread=None,
+            deltas=worker._zero_deltas(8, 16),
+            pre=worker._zero_pre(8),
+        )
+        assert worker._launch_ready(args, kwargs), (
+            "pre-warmed launch shape not recognized"
+        )
+    finally:
+        bat.stop()

@@ -221,22 +221,26 @@ def test_sharded_chained_plan_matches_unsharded():
     ncands = np.full(E, n_cand, np.int32)
     dh = np.asarray([False, True, False, False])
 
+    # the cols kernel takes the group-routed layout (T=1, per-pick
+    # scalars broadcast); the sharded runner keeps per-eval scalars
     stacked = ChainInputs(
-        feasible=feasible,
+        feasible=feasible[:, None],
         perm=perms,
-        ask_cpu=asks[0],
-        ask_mem=asks[1],
-        ask_disk=asks[2],
-        desired_count=desired,
-        limit=limits,
+        ask_cpu=np.tile(asks[0][:, None], (1, P)),
+        ask_mem=np.tile(asks[1][:, None], (1, P)),
+        ask_disk=np.tile(asks[2][:, None], (1, P)),
+        desired_count=np.tile(desired[:, None], (1, P)),
+        limit=np.tile(limits[:, None], (1, P)),
         distinct_hosts=dh,
+        tg_idx=np.zeros((E, P), np.int32),
     )
     ref = np.asarray(
         chained_plan_picks_cols(
             cpu_total, mem_total, disk_total,
             used_cpu, used_mem, used_disk,
             stacked, ncands, P,
-            wanted=wanted, coll0=coll0, affinity=affinity,
+            wanted=wanted, coll0=coll0[:, None],
+            affinity=affinity[:, None],
             deltas=deltas, pre=pre,
         )
     )
