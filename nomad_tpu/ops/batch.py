@@ -103,6 +103,24 @@ class TGInputs(NamedTuple):
     limit: jnp.ndarray  # i32[P] walk visit limit per pick
 
 
+class PortInputs(NamedTuple):
+    """Static (reserved) host-port occupancy for the chain.
+
+    The reference's binpack skips a port-collided node WITHOUT
+    consuming a walk-limit slot (rank.go network path `continue`) —
+    identical to an infeasible node in the walk arithmetic, so the
+    kernel folds collision into the per-pick feasibility mask.  The Q
+    axis enumerates the distinct static ports asked across the batch;
+    occupancy chains across evals like the usage columns (a placement
+    with static ports blocks those ports for every later pick/eval).
+    Port RELEASES (stops/evictions freeing an asked port) are gated to
+    the sequential path host-side — modeling only occupation keeps the
+    carry monotone and exact for everything admitted."""
+
+    ask: jnp.ndarray  # bool[T, Q] port slots this group's ask needs
+    used0: jnp.ndarray  # bool[Q, C] occupied at snapshot (node space)
+
+
 class StepDeltas(NamedTuple):
     """Per-pick plan mutations for steady-state evals (leading axis E
     when chained).  The sequential path interleaves plan edits with
@@ -240,6 +258,8 @@ def _run_picks(
     spread: "SpreadInputs" = None,
     deltas: "StepDeltas" = None,
     tg: "TGInputs" = None,
+    port_ask=None,  # bool[T, Q] (PortInputs.ask)
+    port_used=None,  # bool[Q, C] node-space occupancy at eval start
 ):
     """Inner pick scan; returns (rows i32[P], final used columns).
 
@@ -284,6 +304,9 @@ def _run_picks(
     feas_tp = jnp.take(tg.feasible, perm, axis=1)  # (T, C)
     penalty_p = take(inp.penalty)
     aff_tp = jnp.take(tg.affinity, perm, axis=1)  # (T, C)
+    ports_on = port_ask is not None
+    if ports_on:
+        ports_p0 = jnp.take(port_used, perm, axis=1)  # (Q, C)
     safe_cpu = jnp.where(cpu_total_p > 0, cpu_total_p, 1.0)
     safe_mem = jnp.where(mem_total_p > 0, mem_total_p, 1.0)
 
@@ -375,6 +398,16 @@ def _run_picks(
         feasible = feas_tp[t] & fit & ~(
             inp.distinct_hosts & (occupancy > 0)
         )
+        if ports_on:
+            # static-port collision: skipped WITHOUT consuming a
+            # walk-limit slot (rank.go network path `continue`) —
+            # exactly how the walk treats infeasible nodes
+            ask_t_ports = port_ask[t]  # (Q,)
+            ports_c = carry["ports"]
+            collide = jnp.any(
+                ports_c & ask_t_ports[:, None], axis=0
+            )
+            feasible = feasible & ~collide
 
         free_cpu = 1.0 - cpu_after / safe_cpu
         free_mem = 1.0 - mem_after / safe_mem
@@ -511,6 +544,15 @@ def _run_picks(
             "off": offset,
             "dead": dead,
         }
+        if ports_on:
+            # the winner occupies its group's static ports for every
+            # later pick (and, chained, every later eval)
+            win_mask = ok & (
+                jnp.arange(ports_c.shape[1]) == safe_win
+            )
+            out["ports"] = ports_c | (
+                ask_t_ports[:, None] & win_mask[None, :]
+            )
         if spread is not None:
             # the placed node's value slot gains one proposed use per
             # stanza
@@ -528,6 +570,8 @@ def _run_picks(
         "off": jnp.asarray(0, jnp.int32),
         "dead": jnp.zeros((T,), dtype=bool),
     }
+    if ports_on:
+        carry0["ports"] = ports_p0
     if spread is not None:
         carry0["spread_prop"] = spread.proposed0.astype(dtype)
         carry0["spread_clr"] = spread.cleared0.astype(dtype)
@@ -559,6 +603,22 @@ def _run_picks(
         used_cpu = back_evict(used_cpu, deltas.evict_cpu)
         used_mem = back_evict(used_mem, deltas.evict_mem)
         used_disk = back_evict(used_disk, deltas.evict_disk)
+    if ports_on:
+        # node-space occupancy for the chain carry: every successful
+        # pick's row gains its group's static ports
+        ask_rows = port_ask[tg.tg_idx]  # (P, Q)
+        hit = (ok_rows[:, None] & ask_rows).astype(jnp.int32)
+        onehot_rows = (
+            safe_rows[:, None]
+            == jnp.arange(port_used.shape[1])[None, :]
+        ).astype(jnp.int32)  # (P, C)
+        added = (
+            jnp.einsum("pq,pc->qc", hit, onehot_rows) > 0
+        )
+        port_used_out = port_used | added
+        return rows, (used_cpu, used_mem, used_disk), pulls, (
+            port_used_out
+        )
     return rows, (used_cpu, used_mem, used_disk), pulls
 
 
@@ -755,6 +815,8 @@ def chained_plan_picks_cols(
     spread: SpreadInputs = None,  # leading axis E
     deltas: StepDeltas = None,  # leading axis E
     pre: PreDeltas = None,  # leading axis E
+    port_ask=None,  # bool[E, T, Q] static-port slots per group
+    port_used0=None,  # bool[Q, C] occupancy at the chain snapshot
 ):
     """Serially-equivalent chained planner over shared node columns —
     the BatchWorker's production launch.  Semantics identical to
@@ -768,15 +830,20 @@ def chained_plan_picks_cols(
     zeros_ti = jnp.zeros((T, C), jnp.int32)
     zeros_b = jnp.zeros(C, dtype=bool)
     zeros_tf = jnp.zeros((T, C), cpu_total.dtype)
+    ports_on = port_ask is not None
 
     parts = [batch, nc, wanted]
     pattern = []
-    for x in (coll0, affinity, spread, deltas, pre):
+    for x in (coll0, affinity, spread, deltas, pre, port_ask):
         pattern.append(x is not None)
         if x is not None:
             parts.append(x)
 
-    def eval_step(used, xs):
+    def eval_step(carry, xs):
+        if ports_on:
+            used, ports = carry
+        else:
+            used, ports = carry, None
         it = iter(xs[3:])
         b = xs[0]
         coll = next(it) if pattern[0] else zeros_ti
@@ -784,6 +851,7 @@ def chained_plan_picks_cols(
         s = next(it) if pattern[2] else None
         d = next(it) if pattern[3] else None
         p = next(it) if pattern[4] else None
+        pa = next(it) if pattern[5] else None
         if p is not None:
             used = (
                 used[0].at[p.rows].add(p.cpu.astype(used[0].dtype)),
@@ -819,6 +887,13 @@ def chained_plan_picks_cols(
             limit=b.limit[0],
             distinct_hosts=b.distinct_hosts,
         )
+        if ports_on:
+            rows, used_next, _pulls, ports_next = _run_picks(
+                cpu_total, mem_total, disk_total, used, inp, xs[1],
+                n_picks, spread_fit, wanted=xs[2], spread=s,
+                deltas=d, tg=tg_in, port_ask=pa, port_used=ports,
+            )
+            return (used_next, ports_next), rows
         rows, used_next, _pulls = _run_picks(
             cpu_total, mem_total, disk_total, used, inp, xs[1],
             n_picks, spread_fit, wanted=xs[2], spread=s, deltas=d,
@@ -827,7 +902,8 @@ def chained_plan_picks_cols(
         return used_next, rows
 
     used0 = (used0_cpu, used0_mem, used0_disk)
-    _final, rows = jax.lax.scan(eval_step, used0, tuple(parts))
+    carry0 = (used0, port_used0) if ports_on else used0
+    _final, rows = jax.lax.scan(eval_step, carry0, tuple(parts))
     return rows
 
 

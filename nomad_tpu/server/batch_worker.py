@@ -107,6 +107,12 @@ class _Sim:
     pick_tg: List[int] = field(default_factory=list)
     # anti-affinity base per group slot: [T, C] (None when all zero)
     base_collisions: Optional[np.ndarray] = None
+    # static host ports asked per group slot (kernel collision mask)
+    asked_ports: List[FrozenSet[int]] = field(default_factory=list)
+    # host ports freed by this eval's staged stops/evictions — if any
+    # intersects an asked port in the run, the chain past that point
+    # is gated to the sequential path (the kernel carry is monotone)
+    released_ports: FrozenSet[int] = frozenset()
     # the shuffled walk order the sequential stack would use for the
     # placement set_nodes — captured from the sim ctx's rng AFTER the
     # reconciler's single-node probes consumed their draws
@@ -287,10 +293,12 @@ class BatchWorker(Worker):
         self.cold_shape_fallbacks = 0
         # host-assembly caches keyed by the node table's topology
         # generation (usage churn does NOT invalidate them): candidate
-        # row layout per datacenter set, and static feasibility /
-        # affinity vectors per job signature
+        # row layout per datacenter set, static feasibility /
+        # affinity vectors per job signature, and node-level reserved-
+        # port columns per port
         self._cand_cache: Dict[tuple, tuple] = {}
         self._mask_cache: Dict[tuple, np.ndarray] = {}
+        self._port_col_cache: Dict[tuple, np.ndarray] = {}
         # cold-compile shield: launch signatures known to be compiled.
         # A first-seen shape is compiled on a background thread while
         # the affected evals take the exact sequential path, so an XLA
@@ -431,6 +439,29 @@ class BatchWorker(Worker):
                 sims.append(sim)
                 j += 1
             self._observe("simulate", _time.monotonic() - t0)
+            # static-port release gate: the kernel's occupancy carry
+            # is monotone (placements occupy; releases are not
+            # modeled), so an eval whose staged stops/evictions free
+            # a port that it or any LATER chained eval asks must end
+            # the chain — the freed port commits to the store before
+            # the next chain's snapshot rebuilds occupancy exactly
+            cut = len(sims)
+            suffix_asks: set = set()
+            for i2 in range(len(sims) - 1, -1, -1):
+                own = (
+                    set().union(*sims[i2].asked_ports)
+                    if sims[i2].asked_ports
+                    else set()
+                )
+                rel = sims[i2].released_ports
+                if rel and rel & own:
+                    cut = i2  # its own picks see the stale mask
+                elif rel and rel & suffix_asks:
+                    cut = i2 + 1  # keep it; later evals re-chain
+                suffix_asks |= own
+            if cut < len(sims):
+                sims = sims[:cut]
+                j = idx + cut
             if not sims:
                 self._process_sequential(run[idx][0], run[idx][1])
                 idx += 1
@@ -536,20 +567,28 @@ class BatchWorker(Worker):
             # stays bit-identical; the winner's exact BinPack
             # verification (PrescoredStack.select) still assigns the
             # real ports.
-            # Reserved/static ports stay sequential: a port-collided
-            # node is skipped by binpack WITHOUT consuming a limit
-            # slot (rank.py continue), an asymmetry the kernel's
-            # window arithmetic cannot see — winner-only verification
-            # would miss divergent windows. Non-host modes gate on
-            # NetworkChecker feasibility the kernel doesn't model
-            # either.
+            # Reserved/static ports run in-kernel as a walk-slot-
+            # neutral collision mask (ops/batch.py PortInputs): a
+            # port-collided node is skipped by binpack WITHOUT
+            # consuming a limit slot (rank.py continue) — identical
+            # to infeasibility in the walk arithmetic.  Exceptions
+            # that stay sequential: static asks INSIDE the dynamic
+            # range (an in-chain dynamic assignment could collide
+            # invisibly, and a non-winner divergence would shift the
+            # walk window past what winner verification can catch)
+            # and port releases intersecting asked ports (gated in
+            # _flush_run).  Non-host modes gate on NetworkChecker
+            # feasibility the kernel doesn't model.
+            from ..structs.network import MIN_DYNAMIC_PORT
+
             for nw in list(tg.networks) + [
                 n for t in tg.tasks for n in t.resources.networks
             ]:
                 if (nw.mode or "host") != "host":
                     return False
-                if nw.reserved_ports:
-                    return False
+                for p in nw.reserved_ports:
+                    if p.value >= MIN_DYNAMIC_PORT:
+                        return False
             if any(t.resources.devices for t in tg.tasks):
                 return False
             # distinct_hosts IS batchable for single-TG jobs: the
@@ -804,6 +843,47 @@ class BatchWorker(Worker):
         if len(placements) > 64:
             return None  # over the largest supported pick bucket
         sim.placements = len(placements)
+
+        # static-port bookkeeping for the kernel's collision mask:
+        # asked ports per group slot, and ports this eval's staged
+        # stops/evictions would free (gated in _flush_run — the
+        # kernel's occupancy carry is monotone)
+        for g in sim.tgs:
+            ports = set()
+            # mirror the binpack ask EXACTLY: only tg.networks[0] and
+            # each task's networks[0] are ever assigned (rank.py
+            # group/task network paths); extra declared networks are
+            # ignored by the sequential scheduler and must not
+            # over-constrain the kernel mask
+            asks = []
+            if g.networks:
+                asks.append(g.networks[0])
+            for t in g.tasks:
+                if t.resources.networks:
+                    asks.append(t.resources.networks[0])
+            for nw in asks:
+                for p in nw.reserved_ports:
+                    if p.value:
+                        ports.add(p.value)
+            sim.asked_ports.append(frozenset(ports))
+        released = set()
+        for aid in evicted_ids:
+            orig = snap.alloc_by_id(aid)
+            if (
+                orig is None
+                or orig.terminal_status()
+                or orig.allocated_resources is None
+            ):
+                continue
+            for p in orig.allocated_resources.shared.ports:
+                if p.value:
+                    released.add(p.value)
+            for tr in orig.allocated_resources.tasks.values():
+                for net in tr.networks:
+                    for p in net.reserved_ports:
+                        if p.value:
+                            released.add(p.value)
+        sim.released_ports = frozenset(released)
         # the stateful ctx rng has now consumed exactly the draws the
         # sequential path would have (one per in-place probe's
         # set_nodes); the next draw is the placement shuffle
@@ -1011,6 +1091,48 @@ class BatchWorker(Worker):
         out = (feasible, aff_vec)
         self._mask_cache[key] = out
         return out
+
+    def _node_reserved_port_column(self, snap, port: int) -> np.ndarray:
+        """bool[C]: nodes whose OWN reservations hold `port` (node
+        networks' reserved_ports + reserved_resources.reserved_ports —
+        the node half of NetworkIndex.set_node).  Cached per topology
+        generation; alloc churn never touches node reservations."""
+        table = snap.node_table
+        gen = table.topo_generation
+        key = (gen, port)
+        hit = self._port_col_cache.get(key)
+        if hit is not None:
+            return hit
+        if len(self._port_col_cache) > 256 or (
+            self._port_col_cache
+            and next(iter(self._port_col_cache))[0] != gen
+        ):
+            self._port_col_cache.clear()
+        col = np.zeros(table.capacity, dtype=bool)
+        for node_id, row in table.row_of.items():
+            node = snap.node_by_id(node_id)
+            if node is None:
+                continue
+            if port in node.reserved_resources.reserved_ports:
+                col[row] = True
+                continue
+            # NetworkIndex reserves each net's ports under that net's
+            # OWN ip, but assign_ports only consults the DEFAULT ip
+            # (node_ips[0] — the first network's) — a secondary
+            # network's reservation never collides in the sequential
+            # path, so it must not collide here either
+            nets = node.node_resources.networks
+            default_ip = (
+                (nets[0].ip or "0.0.0.0") if nets else "0.0.0.0"
+            )
+            for net in nets:
+                if (net.ip or "0.0.0.0") != default_ip:
+                    continue
+                if any(p.value == port for p in net.reserved_ports):
+                    col[row] = True
+                    break
+        self._port_col_cache[key] = col
+        return col
 
     # ------------------------------------------------------------------
 
@@ -1245,6 +1367,36 @@ class BatchWorker(Worker):
                         e["affinity"]
                     )
 
+        # static-port collision inputs: slot axis Q enumerates the
+        # distinct asked ports across the batch; occupancy at the
+        # snapshot comes from the store's live-port index plus node-
+        # level reservations (ops/batch.py PortInputs)
+        all_ports = sorted(
+            {p for s in sims for fs in s.asked_ports for p in fs}
+        )
+        port_ask_arr = None
+        port_used0 = None
+        if all_ports:
+            Q = _pow2(len(all_ports), floor=2)
+            slot = {p: q for q, p in enumerate(all_ports)}
+            port_ask_arr = np.zeros((E, T, Q), dtype=bool)
+            for k, s in enumerate(sims):
+                for t_i, fs in enumerate(s.asked_ports):
+                    for p in fs:
+                        port_ask_arr[k, t_i, slot[p]] = True
+            port_used0 = np.zeros((Q, C), dtype=bool)
+            for p, q in slot.items():
+                for node_id, cnt in snap.live_port_nodes(
+                    p
+                ).items():
+                    if cnt > 0:
+                        row = table.row_of.get(node_id)
+                        if row is not None:
+                            port_used0[q, row] = True
+                port_used0[q] |= self._node_reserved_port_column(
+                    snap, p
+                )
+
         deltas = self._zero_deltas(E, P)
         for k, sim in enumerate(sims):
             for p, row in enumerate(sim.evict_rows):
@@ -1344,11 +1496,14 @@ class BatchWorker(Worker):
             spread=spread_stack,
             deltas=deltas,
             pre=pre,
+            port_ask=port_ask_arr,
+            port_used0=port_used0,
         )
         use_mesh = (
             self._mesh is not None
             and spread_stack is None
             and T == 1
+            and port_ask_arr is None
             and C % self._mesh.devices.size == 0
         )
         if use_mesh:

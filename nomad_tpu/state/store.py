@@ -98,6 +98,11 @@ class StateStore:
 
         # columnar mirror of the node table + per-node live-usage columns
         self.node_table = NodeTable()
+        # live allocated static host ports: port -> {node_id: count},
+        # plus the reverse map so per-node refresh never scans the
+        # whole port dict
+        self._ports_live: Dict[int, Dict[str, int]] = {}
+        self._ports_by_node: Dict[str, set] = {}
 
         # change notification for blocking queries
         self._watch_cond = threading.Condition(self._lock)
@@ -685,6 +690,9 @@ class StateStore:
                 self.node_table.update_node_usage(
                     alloc.node_id, self._live_usage_for_node(alloc.node_id)
                 )
+            # port occupancy follows the same lifecycle, but also
+            # shifts when an update re-offers ports on the same node
+            self._refresh_port_index(alloc.node_id)
 
     def _live_usage_for_node(self, node_id: str):
         cpu = mem = disk = 0
@@ -697,6 +705,53 @@ class StateStore:
             mem += c.memory_mb
             disk += c.disk_mb
         return cpu, mem, disk
+
+    def _refresh_port_index(self, node_id: str) -> None:
+        """Per-node recount of live allocated static host ports, from
+        both group-level offers (shared.ports) and task-level network
+        offers (tasks[*].networks — rank.py assign_network stores them
+        there, never in shared.ports).  Keyed port -> {node_id: count}
+        so the batch prescorer can build per-port occupancy columns
+        without scanning the whole alloc set (reference builds a
+        NetworkIndex per candidate node lazily — rank.go network
+        path; the kernel needs all nodes up front).  Dynamic-range
+        ports are skipped: static asks in that range are gated to the
+        sequential path, so the index is never queried for them."""
+        from ..structs.network import MIN_DYNAMIC_PORT
+
+        for port in self._ports_by_node.pop(node_id, ()):
+            nodes = self._ports_live.get(port)
+            if nodes is not None:
+                nodes.pop(node_id, None)
+                if not nodes:
+                    del self._ports_live[port]
+        held: set = set()
+        for aid in self._allocs_by_node.get(node_id, ()):
+            a = self.allocs[aid]
+            if a.terminal_status() or a.allocated_resources is None:
+                continue
+            values = [
+                p.value
+                for p in a.allocated_resources.shared.ports
+            ]
+            for tr in a.allocated_resources.tasks.values():
+                for net in tr.networks:
+                    values.extend(
+                        p.value for p in net.reserved_ports
+                    )
+            for value in values:
+                if not value or value >= MIN_DYNAMIC_PORT:
+                    continue
+                by_node = self._ports_live.setdefault(value, {})
+                by_node[node_id] = by_node.get(node_id, 0) + 1
+                held.add(value)
+        if held:
+            self._ports_by_node[node_id] = held
+
+    def live_port_nodes(self, port: int) -> Dict[str, int]:
+        """node_id -> live alloc count holding `port` (empty when
+        free everywhere)."""
+        return self._ports_live.get(port, {})
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
         return self.allocs.get(alloc_id)
@@ -941,6 +996,9 @@ class StateSnapshot:
 
     def allocs_by_node_terminal(self, node_id: str, terminal: bool):
         return self._store.allocs_by_node_terminal(node_id, terminal)
+
+    def live_port_nodes(self, port: int) -> Dict[str, int]:
+        return self._store.live_port_nodes(port)
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
         return self._store.alloc_by_id(alloc_id)

@@ -1064,3 +1064,185 @@ def test_warm_shapes_are_recognized_by_launch_gate(monkeypatch):
         )
     finally:
         bat.stop()
+
+
+def test_batch_pipeline_static_ports_match_sequential():
+    """Reserved/static host ports run the prescored path with the
+    kernel's walk-slot-neutral collision mask (ops/batch.py
+    PortInputs): contended static ports produce placements
+    bit-identical to the sequential scheduler (rank.go network path
+    skips collided nodes without consuming a walk-limit slot)."""
+    from nomad_tpu.structs import NetworkResource, Port
+
+    nodes = make_nodes(10, seed=3)
+    seq = Server(num_schedulers=1, seed=77, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=77, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        # three jobs fighting over :8080 (each instance needs the
+        # port exclusively per node) + one uncontended + one portless
+        jobs = []
+        for i in range(3):
+            job = mock.job(id=f"port-{i}")
+            tg = job.task_groups[0]
+            tg.count = 3
+            tg.tasks[0].resources.cpu = 200
+            tg.networks = [
+                NetworkResource(
+                    mode="host",
+                    reserved_ports=[Port(label="http", value=8080)],
+                )
+            ]
+            jobs.append(job)
+        other = mock.job(id="port-other")
+        other.task_groups[0].count = 2
+        other.task_groups[0].networks = [
+            NetworkResource(
+                mode="host",
+                reserved_ports=[Port(label="admin", value=9443)],
+            )
+        ]
+        jobs.append(other)
+        plain = mock.job(id="port-plain")
+        plain.task_groups[0].count = 2
+        jobs.append(plain)
+
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(30)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(60)
+
+        for job in jobs:
+            assert placements(seq, job.id) == placements(
+                bat, job.id
+            ), f"divergence for {job.id}"
+        # :8080 really is exclusive per node
+        holders = [
+            a.node_id
+            for i in range(3)
+            for a in bat.store.allocs_by_job(
+                "default", f"port-{i}"
+            )
+            if not a.terminal_status()
+        ]
+        assert len(holders) == len(set(holders)), holders
+        worker = bat.workers[0]
+        assert worker.prescored >= 3, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_static_port_exhaustion_and_release():
+    """Port exhaustion fails identically on both paths, and a port
+    released by stopping a job is reusable afterwards (the release
+    gate in _flush_run keeps the monotone kernel carry exact)."""
+    from nomad_tpu.structs import NetworkResource, Port
+
+    nodes = make_nodes(4, seed=21)
+    seq = Server(num_schedulers=1, seed=31, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=31, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        def port_job(jid, count):
+            job = mock.job(id=jid)
+            tg = job.task_groups[0]
+            tg.count = count
+            tg.tasks[0].resources.cpu = 100
+            tg.networks = [
+                NetworkResource(
+                    mode="host",
+                    reserved_ports=[Port(label="p", value=7070)],
+                )
+            ]
+            return job
+
+        # 6 asks onto 4 nodes: 4 place, 2 fail/block identically
+        for server in (seq, bat):
+            server.register_job(port_job("exh", 6))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "exh") == placements(bat, "exh")
+        assert len(placements(bat, "exh")) == 4
+
+        # stop the job; the ports free; a new job reuses them
+        for server in (seq, bat):
+            server.deregister_job("default", "exh")
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        for server in (seq, bat):
+            server.register_job(port_job("reuse", 3))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        assert placements(seq, "reuse") == placements(bat, "reuse")
+        assert len(placements(bat, "reuse")) == 3
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_task_level_static_ports_match():
+    """Task-level network asks store their offers in
+    tasks[*].networks (never shared.ports) — the port index and the
+    kernel mask must see them (code-review r4 finding)."""
+    from nomad_tpu.structs import NetworkResource, Port
+
+    nodes = make_nodes(6, seed=2)
+    seq = Server(num_schedulers=1, seed=19, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=19, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        def task_port_job(jid, count):
+            job = mock.job(id=jid)
+            tg = job.task_groups[0]
+            tg.count = count
+            tg.tasks[0].resources.cpu = 100
+            tg.tasks[0].resources.networks = [
+                NetworkResource(
+                    mode="host",
+                    reserved_ports=[Port(label="t", value=6060)],
+                )
+            ]
+            return job
+
+        # first job occupies 6060 on 3 nodes via TASK-level offers;
+        # the second (separate batch) must see those occupations
+        for server in (seq, bat):
+            server.register_job(task_port_job("tport-a", 3))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        for server in (seq, bat):
+            server.register_job(task_port_job("tport-b", 3))
+        assert seq.drain_to_idle(30)
+        assert bat.drain_to_idle(60)
+        for jid in ("tport-a", "tport-b"):
+            assert placements(seq, jid) == placements(bat, jid), jid
+        holders = [
+            a.node_id
+            for jid in ("tport-a", "tport-b")
+            for a in bat.store.allocs_by_job("default", jid)
+            if not a.terminal_status()
+        ]
+        assert len(holders) == 6 and len(set(holders)) == 6, holders
+    finally:
+        seq.stop()
+        bat.stop()
