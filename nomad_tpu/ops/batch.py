@@ -121,6 +121,24 @@ class PortInputs(NamedTuple):
     used0: jnp.ndarray  # bool[Q, C] occupied at snapshot (node space)
 
 
+class DeviceInputs(NamedTuple):
+    """Device-capacity accounting for the chain (SURVEY §7.3:
+    capacity-count masks on device, exact host-side assignment).
+
+    The D axis enumerates the batch's distinct device-ask signatures
+    (each = a set of matching device-group codes).  Free instance
+    counts chain across evals like usage columns; a pick is feasible
+    only where every asked signature has enough free instances, and
+    the winner consumes its group's asked counts.  Pooled counting is
+    exact because the host admits only batches whose signatures are
+    identical-or-disjoint (overlapping-but-different matched sets gate
+    to the sequential path), and instance releases (evictions freeing
+    asked devices) cut the chain host-side — the carry is monotone."""
+
+    ask: jnp.ndarray  # i32[T, D] instances needed per signature
+    free0: jnp.ndarray  # i32[D, C] free instances at snapshot
+
+
 class StepDeltas(NamedTuple):
     """Per-pick plan mutations for steady-state evals (leading axis E
     when chained).  The sequential path interleaves plan edits with
@@ -220,7 +238,15 @@ def _walk(s_p, f_p, offset, limit, n_candidates):
     nd_incl, nd_count = rot(nd)
     div_incl, n_div = rot(diverted)
     div_rank = div_incl - 1
-    div_order = jnp.where(n_div == 2, 1 - div_rank, div_rank)
+    # two-diverted replay reversal happens only when a non-diverted
+    # emission preceded the replay: the replayed head then re-enters
+    # the skip loop and is re-appended behind its sibling
+    # (select.py next()).  With NO good nodes the source exhausts
+    # inside the first skip loop and the tail _next_option returns
+    # the diverted nodes in ORIGINAL order.
+    div_order = jnp.where(
+        (n_div == 2) & (nd_count > 0), 1 - div_rank, div_rank
+    )
     emit_order = jnp.where(nd, nd_incl - 1, nd_count + div_order)
     emitted = f_p & (emit_order < limit)
 
@@ -260,6 +286,8 @@ def _run_picks(
     tg: "TGInputs" = None,
     port_ask=None,  # bool[T, Q] (PortInputs.ask)
     port_used=None,  # bool[Q, C] node-space occupancy at eval start
+    dev_ask=None,  # i32[T, D] (DeviceInputs.ask)
+    dev_free=None,  # i32[D, C] node-space free counts at eval start
 ):
     """Inner pick scan; returns (rows i32[P], final used columns).
 
@@ -307,6 +335,9 @@ def _run_picks(
     ports_on = port_ask is not None
     if ports_on:
         ports_p0 = jnp.take(port_used, perm, axis=1)  # (Q, C)
+    devs_on = dev_ask is not None
+    if devs_on:
+        devs_p0 = jnp.take(dev_free, perm, axis=1)  # (D, C)
     safe_cpu = jnp.where(cpu_total_p > 0, cpu_total_p, 1.0)
     safe_mem = jnp.where(mem_total_p > 0, mem_total_p, 1.0)
 
@@ -408,6 +439,19 @@ def _run_picks(
                 ports_c & ask_t_ports[:, None], axis=0
             )
             feasible = feasible & ~collide
+        if devs_on:
+            # device capacity: feasible only where every ASKED
+            # signature still has enough free instances (the
+            # DeviceChecker runs pre-binpack, so shortage is plain
+            # infeasibility in the walk arithmetic).  Unasked slots
+            # (ask 0) must not couple the pick to unrelated pools
+            ask_t_dev = dev_ask[t]  # (D,)
+            devs_c = carry["dev"]
+            feasible = feasible & jnp.all(
+                (ask_t_dev[:, None] == 0)
+                | (devs_c >= ask_t_dev[:, None]),
+                axis=0,
+            )
 
         free_cpu = 1.0 - cpu_after / safe_cpu
         free_mem = 1.0 - mem_after / safe_mem
@@ -553,6 +597,10 @@ def _run_picks(
             out["ports"] = ports_c | (
                 ask_t_ports[:, None] & win_mask[None, :]
             )
+        if devs_on:
+            out["dev"] = devs_c.at[:, safe_win].add(
+                jnp.where(ok, -ask_t_dev, 0)
+            )
         if spread is not None:
             # the placed node's value slot gains one proposed use per
             # stanza
@@ -572,6 +620,8 @@ def _run_picks(
     }
     if ports_on:
         carry0["ports"] = ports_p0
+    if devs_on:
+        carry0["dev"] = devs_p0
     if spread is not None:
         carry0["spread_prop"] = spread.proposed0.astype(dtype)
         carry0["spread_clr"] = spread.cleared0.astype(dtype)
@@ -603,22 +653,30 @@ def _run_picks(
         used_cpu = back_evict(used_cpu, deltas.evict_cpu)
         used_mem = back_evict(used_mem, deltas.evict_mem)
         used_disk = back_evict(used_disk, deltas.evict_disk)
-    if ports_on:
-        # node-space occupancy for the chain carry: every successful
-        # pick's row gains its group's static ports
-        ask_rows = port_ask[tg.tg_idx]  # (P, Q)
-        hit = (ok_rows[:, None] & ask_rows).astype(jnp.int32)
+    if ports_on or devs_on:
+        # node-space carries for the chain: every successful pick's
+        # row gains its group's static ports / loses its group's
+        # asked device instances
         onehot_rows = (
             safe_rows[:, None]
-            == jnp.arange(port_used.shape[1])[None, :]
+            == jnp.arange(used_cpu.shape[0])[None, :]
         ).astype(jnp.int32)  # (P, C)
-        added = (
-            jnp.einsum("pq,pc->qc", hit, onehot_rows) > 0
-        )
-        port_used_out = port_used | added
-        return rows, (used_cpu, used_mem, used_disk), pulls, (
-            port_used_out
-        )
+        extras = {}
+        if ports_on:
+            ask_rows = port_ask[tg.tg_idx]  # (P, Q)
+            hit = (ok_rows[:, None] & ask_rows).astype(jnp.int32)
+            extras["ports"] = port_used | (
+                jnp.einsum("pq,pc->qc", hit, onehot_rows) > 0
+            )
+        if devs_on:
+            dev_rows = dev_ask[tg.tg_idx]  # (P, D)
+            consumed = jnp.einsum(
+                "pd,pc->dc",
+                jnp.where(ok_rows[:, None], dev_rows, 0),
+                onehot_rows,
+            )
+            extras["dev"] = dev_free - consumed
+        return rows, (used_cpu, used_mem, used_disk), pulls, extras
     return rows, (used_cpu, used_mem, used_disk), pulls
 
 
@@ -817,6 +875,8 @@ def chained_plan_picks_cols(
     pre: PreDeltas = None,  # leading axis E
     port_ask=None,  # bool[E, T, Q] static-port slots per group
     port_used0=None,  # bool[Q, C] occupancy at the chain snapshot
+    dev_ask=None,  # i32[E, T, D] device instances asked per group
+    dev_free0=None,  # i32[D, C] free instances at the chain snapshot
 ):
     """Serially-equivalent chained planner over shared node columns —
     the BatchWorker's production launch.  Semantics identical to
@@ -831,19 +891,18 @@ def chained_plan_picks_cols(
     zeros_b = jnp.zeros(C, dtype=bool)
     zeros_tf = jnp.zeros((T, C), cpu_total.dtype)
     ports_on = port_ask is not None
+    devs_on = dev_ask is not None
 
     parts = [batch, nc, wanted]
     pattern = []
-    for x in (coll0, affinity, spread, deltas, pre, port_ask):
+    for x in (coll0, affinity, spread, deltas, pre, port_ask,
+              dev_ask):
         pattern.append(x is not None)
         if x is not None:
             parts.append(x)
 
     def eval_step(carry, xs):
-        if ports_on:
-            used, ports = carry
-        else:
-            used, ports = carry, None
+        used, ports, devs = carry
         it = iter(xs[3:])
         b = xs[0]
         coll = next(it) if pattern[0] else zeros_ti
@@ -852,6 +911,7 @@ def chained_plan_picks_cols(
         d = next(it) if pattern[3] else None
         p = next(it) if pattern[4] else None
         pa = next(it) if pattern[5] else None
+        da = next(it) if pattern[6] else None
         if p is not None:
             used = (
                 used[0].at[p.rows].add(p.cpu.astype(used[0].dtype)),
@@ -887,22 +947,27 @@ def chained_plan_picks_cols(
             limit=b.limit[0],
             distinct_hosts=b.distinct_hosts,
         )
-        if ports_on:
-            rows, used_next, _pulls, ports_next = _run_picks(
+        if ports_on or devs_on:
+            rows, used_next, _pulls, extras = _run_picks(
                 cpu_total, mem_total, disk_total, used, inp, xs[1],
                 n_picks, spread_fit, wanted=xs[2], spread=s,
                 deltas=d, tg=tg_in, port_ask=pa, port_used=ports,
+                dev_ask=da, dev_free=devs,
             )
-            return (used_next, ports_next), rows
+            return (
+                used_next,
+                extras.get("ports"),
+                extras.get("dev"),
+            ), rows
         rows, used_next, _pulls = _run_picks(
             cpu_total, mem_total, disk_total, used, inp, xs[1],
             n_picks, spread_fit, wanted=xs[2], spread=s, deltas=d,
             tg=tg_in,
         )
-        return used_next, rows
+        return (used_next, None, None), rows
 
     used0 = (used0_cpu, used0_mem, used0_disk)
-    carry0 = (used0, port_used0) if ports_on else used0
+    carry0 = (used0, port_used0, dev_free0)
     _final, rows = jax.lax.scan(eval_step, carry0, tuple(parts))
     return rows
 

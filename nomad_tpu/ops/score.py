@@ -166,8 +166,13 @@ def _limited_walk_argmax(
     nd_rank = nd_cum - 1
     n_div = jnp.sum(diverted.astype(jnp.int32))
     div_rank = jnp.cumsum(diverted.astype(jnp.int32)) - 1
-    # two-diverted replay reversal (see docstring)
-    div_order = jnp.where(n_div == 2, 1 - div_rank, div_rank)
+    # two-diverted replay reversal (see docstring) — only when a
+    # non-diverted emission preceded the replay; with no good nodes
+    # the source exhausts inside the first skip loop and the tail
+    # _next_option replays in ORIGINAL order (select.py next())
+    div_order = jnp.where(
+        (n_div == 2) & (nd_count > 0), 1 - div_rank, div_rank
+    )
     emit_order = jnp.where(nd, nd_rank, nd_count + div_order)
     emitted = f & (emit_order < limit)
 

@@ -186,7 +186,11 @@ def _sharded_walk(final_full, feas_full, perm, off, lim, nc,
     nd_incl, nd_count = rot(nd)
     div_incl, n_div = rot(diverted)
     div_rank = div_incl - 1
-    div_order = jnp.where(n_div == 2, 1 - div_rank, div_rank)
+    # reversal only when a non-diverted emission preceded the replay
+    # (see ops/score.py _limited_walk_argmax)
+    div_order = jnp.where(
+        (n_div == 2) & (nd_count > 0), 1 - div_rank, div_rank
+    )
     emit_order = jnp.where(nd, nd_incl - 1, nd_count + div_order)
     emitted = f_l & (emit_order < lim)
 

@@ -89,12 +89,33 @@ class DelayedRescheduleInfo:
 def new_alloc_matrix(
     job: Optional[Job], allocs: List[Allocation]
 ) -> Dict[str, Dict[str, Allocation]]:
+    """Group -> {alloc id -> alloc}, in CANONICAL group order: the
+    job's task_group order first, then orphaned groups sorted by name.
+    The reference iterates this matrix in Go map order (random), which
+    makes multi-group placement order — and, because the stack's walk
+    offset persists across groups, placement OUTCOMES — nondeterministic
+    across runs.  A deterministic order is required for this build's
+    bit-identity contract (sequential vs batched paths, and test
+    reproducibility across servers whose alloc ids differ)."""
     m: Dict[str, Dict[str, Allocation]] = {}
-    for alloc in allocs:
-        m.setdefault(alloc.task_group, {})[alloc.id] = alloc
     if job is not None:
         for tg in job.task_groups:
             m.setdefault(tg.name, {})
+    for alloc in sorted(allocs, key=lambda a: a.id):
+        m.setdefault(alloc.task_group, {})[alloc.id] = alloc
+    # orphaned groups (allocs of groups no longer in the job) were
+    # appended in sorted-alloc order above; re-key them into name
+    # order for full determinism
+    if job is not None:
+        job_names = [tg.name for tg in job.task_groups]
+        orphans = sorted(
+            name for name in m if name not in job_names
+        )
+        if orphans:
+            m = {
+                **{n: m[n] for n in job_names},
+                **{n: m[n] for n in orphans},
+            }
     return m
 
 

@@ -113,6 +113,15 @@ class _Sim:
     # intersects an asked port in the run, the chain past that point
     # is gated to the sequential path (the kernel carry is monotone)
     released_ports: FrozenSet[int] = frozenset()
+    # device asks per group slot: matched-code-set -> instance count
+    # (ops/batch.py DeviceInputs; pooled counting is exact only for
+    # identical-or-disjoint sets — overlap gates in _flush_run)
+    asked_devices: List[Dict[FrozenSet[int], int]] = field(
+        default_factory=list
+    )
+    # (vendor, type, name) keys of device instances this eval's
+    # staged stops/evictions would free
+    released_device_keys: FrozenSet[tuple] = frozenset()
     # the shuffled walk order the sequential stack would use for the
     # placement set_nodes — captured from the sim ctx's rng AFTER the
     # reconciler's single-node probes consumed their draws
@@ -299,6 +308,7 @@ class BatchWorker(Worker):
         self._cand_cache: Dict[tuple, tuple] = {}
         self._mask_cache: Dict[tuple, np.ndarray] = {}
         self._port_col_cache: Dict[tuple, np.ndarray] = {}
+        self._dev_codes_cache: Dict[tuple, FrozenSet[int]] = {}
         # cold-compile shield: launch signatures known to be compiled.
         # A first-seen shape is compiled on a background thread while
         # the affected evals take the exact sequential path, so an XLA
@@ -439,26 +449,116 @@ class BatchWorker(Worker):
                 sims.append(sim)
                 j += 1
             self._observe("simulate", _time.monotonic() - t0)
-            # static-port release gate: the kernel's occupancy carry
-            # is monotone (placements occupy; releases are not
-            # modeled), so an eval whose staged stops/evictions free
-            # a port that it or any LATER chained eval asks must end
-            # the chain — the freed port commits to the store before
-            # the next chain's snapshot rebuilds occupancy exactly
+            # port/device chain gates: the kernel's occupancy carries
+            # are monotone (placements occupy/consume; releases are
+            # not modeled) and device pooling is exact only for
+            # identical-or-disjoint ask signatures.  An eval whose
+            # staged releases hit a port/device asked at-or-after it,
+            # or whose device signatures overlap earlier ones without
+            # matching, ends the chain — committed state rebuilds the
+            # carries exactly for the next chain.
             cut = len(sims)
+            table_ = snap.node_table
+            any_dev = any(
+                cs for s in sims for d in s.asked_devices for cs in d
+            )
+            key_codes: Dict[tuple, set] = {}
+            if any_dev:
+                # one scan of the sig interner per flush (not per
+                # eval): (vendor, type, name) -> codes
+                for code, sig in table_._device_sig_meta.items():
+                    key_codes.setdefault(
+                        (sig[0], sig[1], sig[2]), set()
+                    ).add(code)
             suffix_asks: set = set()
+            suffix_dev_codes: set = set()
             for i2 in range(len(sims) - 1, -1, -1):
+                s2 = sims[i2]
                 own = (
-                    set().union(*sims[i2].asked_ports)
-                    if sims[i2].asked_ports
+                    set().union(*s2.asked_ports)
+                    if s2.asked_ports
                     else set()
                 )
-                rel = sims[i2].released_ports
+                own_dev_sets = {
+                    cs
+                    for d in s2.asked_devices
+                    for cs in d
+                }
+                own_dev_codes = (
+                    set().union(*own_dev_sets)
+                    if own_dev_sets
+                    else set()
+                )
+                rel = s2.released_ports
                 if rel and rel & own:
                     cut = i2  # its own picks see the stale mask
                 elif rel and rel & suffix_asks:
                     cut = i2 + 1  # keep it; later evals re-chain
+                if s2.released_device_keys and (
+                    own_dev_codes or suffix_dev_codes
+                ):
+                    rel_codes = set()
+                    for key in s2.released_device_keys:
+                        rel_codes |= key_codes.get(key, set())
+                    if rel_codes & own_dev_codes:
+                        cut = min(cut, i2)
+                    elif rel_codes & suffix_dev_codes:
+                        cut = min(cut, i2 + 1)
                 suffix_asks |= own
+                suffix_dev_codes |= own_dev_codes
+            # forward device gates: pooled free-count accounting is
+            # exact only when (a) distinct signatures in one chain are
+            # pairwise identical-or-disjoint, (b) every asked code's
+            # (vendor, type, name) key is unambiguous (one code — an
+            # attr-changed re-registration mints a second code whose
+            # key-granularity reservations can't be attributed), and
+            # (c) no node carries TWO groups of one signature (the
+            # sequential allocator must satisfy a request from a
+            # SINGLE group — device.py — so a pooled per-node count
+            # would over-admit)
+            seen_sets: set = set()
+            for i2 in range(min(cut, len(sims))):
+                eval_sets = {
+                    cs
+                    for d in sims[i2].asked_devices
+                    for cs in d
+                }
+                bad = any(
+                    cs & other
+                    for cs in eval_sets
+                    for other in (seen_sets | eval_sets)
+                    if other != cs
+                )
+                if not bad:
+                    for cs in eval_sets - seen_sets:
+                        keys = {
+                            table_.device_sig_key(c) for c in cs
+                        }
+                        if any(
+                            len(key_codes.get(k, ())) > 1
+                            for k in keys
+                        ):
+                            bad = True
+                            break
+                        for _row, groups in (
+                            table_.device_groups.items()
+                        ):
+                            if (
+                                sum(
+                                    1
+                                    for code, _n in groups
+                                    if code in cs
+                                )
+                                > 1
+                            ):
+                                bad = True
+                                break
+                        if bad:
+                            break
+                if bad:
+                    cut = min(cut, i2)
+                    break
+                seen_sets |= eval_sets
             if cut < len(sims):
                 sims = sims[:cut]
                 j = idx + cut
@@ -589,8 +689,23 @@ class BatchWorker(Worker):
                 for p in nw.reserved_ports:
                     if p.value >= MIN_DYNAMIC_PORT:
                         return False
-            if any(t.resources.devices for t in tg.tasks):
-                return False
+            # device asks run in-kernel: capacity-count masks over a
+            # chained free-instance carry (ops/batch.py DeviceInputs);
+            # overlapping ask signatures and instance releases gate
+            # per-batch in _flush_run.  Device AFFINITIES stay
+            # sequential — the device allocator's match fraction
+            # becomes a node score component (rank.py:321) the
+            # kernel doesn't model
+            for t in tg.tasks:
+                for req in t.resources.devices:
+                    if req.affinities:
+                        return False
+                    # count<=0 is rejected by the sequential
+                    # allocator on every node (device.py invalid
+                    # request) — the kernel would treat it as
+                    # trivially satisfiable and deviate every time
+                    if req.count <= 0:
+                        return False
             # distinct_hosts IS batchable for single-TG jobs: the
             # kernel's collision carry equals the proposed-allocs-
             # per-node count, so the mask is exact
@@ -866,7 +981,21 @@ class BatchWorker(Worker):
                     if p.value:
                         ports.add(p.value)
             sim.asked_ports.append(frozenset(ports))
+            # device asks: matched-code sets per request (constraint
+            # filtering included), counts pooled per set
+            dev_asks: Dict[FrozenSet[int], int] = {}
+            reqs = [
+                req for t in g.tasks for req in t.resources.devices
+            ]
+            if reqs:
+                for req in reqs:
+                    codes = self._device_request_codes(table, req)
+                    dev_asks[codes] = dev_asks.get(codes, 0) + int(
+                        req.count
+                    )
+            sim.asked_devices.append(dev_asks)
         released = set()
+        released_dev = set()
         for aid in evicted_ids:
             orig = snap.alloc_by_id(aid)
             if (
@@ -883,7 +1012,12 @@ class BatchWorker(Worker):
                     for p in net.reserved_ports:
                         if p.value:
                             released.add(p.value)
+                for dv in tr.devices:
+                    released_dev.add(
+                        (dv.vendor, dv.type, dv.name)
+                    )
         sim.released_ports = frozenset(released)
+        sim.released_device_keys = frozenset(released_dev)
         # the stateful ctx rng has now consumed exactly the draws the
         # sequential path would have (one per in-place probe's
         # set_nodes); the next draw is the placement shuffle
@@ -1091,6 +1225,31 @@ class BatchWorker(Worker):
         out = (feasible, aff_vec)
         self._mask_cache[key] = out
         return out
+
+    def _device_request_codes(self, table, req) -> FrozenSet[int]:
+        """Matched device-sig codes for a request (name + constraint
+        filtering), cached by the sig interner's length — it is
+        append-only, so a grown interner only ever ADDS candidate
+        codes (avoids an O(sigs) scan per request per eval)."""
+        cons_sig = tuple(
+            (c.ltarget, c.operand, c.rtarget)
+            for c in req.constraints
+        )
+        key = (len(table.device_sigs), req.name, cons_sig)
+        hit = self._dev_codes_cache.get(key)
+        if hit is not None:
+            return hit
+        if len(self._dev_codes_cache) > 256:
+            self._dev_codes_cache.clear()
+        compiler = MaskCompiler(table)
+        codes = frozenset(
+            code
+            for code in range(len(table.device_sigs))
+            if table.device_sig_matches(code, req.name)
+            and compiler._device_sig_meets_constraints(code, req)
+        )
+        self._dev_codes_cache[key] = codes
+        return codes
 
     def _node_reserved_port_column(self, snap, port: int) -> np.ndarray:
         """bool[C]: nodes whose OWN reservations hold `port` (node
@@ -1397,6 +1556,51 @@ class BatchWorker(Worker):
                     snap, p
                 )
 
+        # device-capacity inputs: slot axis D enumerates the batch's
+        # distinct matched-code sets (identical-or-disjoint per the
+        # _flush_run gate); free counts = group totals minus live
+        # reservations (ops/batch.py DeviceInputs)
+        all_dev_sets = sorted(
+            {
+                cs
+                for s in sims
+                for d in s.asked_devices
+                for cs in d
+            },
+            key=sorted,
+        )
+        dev_ask_arr = None
+        dev_free0 = None
+        if all_dev_sets:
+            D = _pow2(len(all_dev_sets), floor=1)
+            dslot = {cs: di for di, cs in enumerate(all_dev_sets)}
+            dev_ask_arr = np.zeros((E, T, D), np.int32)
+            for k, s in enumerate(sims):
+                for t_i, asks in enumerate(s.asked_devices):
+                    for cs, count in asks.items():
+                        dev_ask_arr[k, t_i, dslot[cs]] = count
+            dev_free0 = np.zeros((D, C), np.int32)
+            for cs, di in dslot.items():
+                has_cs = np.zeros(C, dtype=bool)
+                for row, groups in table.device_groups.items():
+                    for code, count in groups:
+                        if code in cs:
+                            dev_free0[di, row] += count
+                            has_cs[row] = True
+                # live reservations from the unified table index —
+                # subtracted ONLY on rows that actually carry a cs
+                # group (a key-granularity reservation on a node
+                # whose group code is outside the set must not drive
+                # the pool negative and poison unrelated picks)
+                keys = {
+                    table.device_sig_key(code) for code in cs
+                }
+                for (row, key), count in (
+                    table.device_used.items()
+                ):
+                    if key in keys and has_cs[row]:
+                        dev_free0[di, row] -= count
+
         deltas = self._zero_deltas(E, P)
         for k, sim in enumerate(sims):
             for p, row in enumerate(sim.evict_rows):
@@ -1498,12 +1702,15 @@ class BatchWorker(Worker):
             pre=pre,
             port_ask=port_ask_arr,
             port_used0=port_used0,
+            dev_ask=dev_ask_arr,
+            dev_free0=dev_free0,
         )
         use_mesh = (
             self._mesh is not None
             and spread_stack is None
             and T == 1
             and port_ask_arr is None
+            and dev_ask_arr is None
             and C % self._mesh.devices.size == 0
         )
         if use_mesh:

@@ -236,6 +236,9 @@ class NodeTable:
         self.cpu_used[row] = self.mem_used[row] = self.disk_used[row] = 0.0
         self.node_ids[row] = None
         self.device_groups.pop(row, None)
+        # a reused row must not inherit phantom device reservations
+        for key in [k for k in self.device_used if k[0] == row]:
+            del self.device_used[key]
         if hasattr(self, "_nodes_cache"):
             self._nodes_cache.pop(node_id, None)
         self._free_rows.append(row)
@@ -262,6 +265,12 @@ class NodeTable:
             [self.row_of[nid] for nid in node_ids if nid in self.row_of],
             dtype=np.int32,
         )
+
+    def device_sig_key(self, code: int) -> tuple:
+        """(vendor, type, name) of a device-sig code — the key shape
+        AllocatedDeviceResource records carry."""
+        sig = self._device_sig_meta[code]
+        return (sig[0], sig[1], sig[2])
 
     def device_sig_matches(self, code: int, ask_name: str) -> bool:
         """Whether an interned device-group signature matches a device ask

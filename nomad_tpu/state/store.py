@@ -725,6 +725,17 @@ class StateStore:
                 nodes.pop(node_id, None)
                 if not nodes:
                     del self._ports_live[port]
+        # device reservations live in ONE index — the node table's
+        # device_used, read by the per-select mask (MaskCompiler.
+        # device_feasibility / device_count_columns) and the batch
+        # kernel's free columns alike
+        row = self.node_table.row_of.get(node_id)
+        if row is not None:
+            for key in [
+                k for k in self.node_table.device_used
+                if k[0] == row
+            ]:
+                del self.node_table.device_used[key]
         held: set = set()
         for aid in self._allocs_by_node.get(node_id, ()):
             a = self.allocs[aid]
@@ -739,6 +750,16 @@ class StateStore:
                     values.extend(
                         p.value for p in net.reserved_ports
                     )
+                if row is not None:
+                    for dv in tr.devices:
+                        key = (
+                            row,
+                            (dv.vendor, dv.type, dv.name),
+                        )
+                        self.node_table.device_used[key] = (
+                            self.node_table.device_used.get(key, 0)
+                            + len(dv.device_ids)
+                        )
             for value in values:
                 if not value or value >= MIN_DYNAMIC_PORT:
                     continue

@@ -1246,3 +1246,125 @@ def test_batch_pipeline_task_level_static_ports_match():
     finally:
         seq.stop()
         bat.stop()
+
+
+def test_batch_pipeline_device_asks_match_sequential():
+    """Device asks run the prescored path with chained free-instance
+    accounting (ops/batch.py DeviceInputs): GPU jobs place
+    bit-identically to the sequential scheduler, capacity is consumed
+    across chained evals, and exhaustion fails identically."""
+    from nomad_tpu.structs import RequestedDevice
+
+    nodes = make_nodes(8, seed=6)
+    gpu_nodes = [mock.nvidia_node() for _ in range(3)]  # 4 GPUs each
+    seq = Server(num_schedulers=1, seed=55, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=55, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes + gpu_nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        def gpu_job(jid, count, gpus):
+            job = mock.job(id=jid)
+            tg = job.task_groups[0]
+            tg.count = count
+            tg.tasks[0].resources.cpu = 100
+            tg.tasks[0].resources.devices = [
+                RequestedDevice(name="gpu", count=gpus)
+            ]
+            return job
+
+        # 3 jobs x 2 instances x 2 GPUs each = 12 GPUs = exactly the
+        # cluster's capacity; a 4th job must fail/block
+        jobs = [gpu_job(f"gpu-{i}", 2, 2) for i in range(3)]
+        jobs.append(gpu_job("gpu-over", 1, 2))
+        plain = mock.job(id="gpu-plain")
+        plain.task_groups[0].count = 2
+        jobs.append(plain)
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(30)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(60)
+
+        for job in jobs:
+            assert placements(seq, job.id) == placements(
+                bat, job.id
+            ), f"divergence for {job.id}"
+        # every GPU alloc landed on a GPU node, never more than
+        # capacity per node
+        gpu_ids = {n.id for n in gpu_nodes}
+        per_node: dict = {}
+        for i in range(3):
+            for a in bat.store.allocs_by_job(
+                "default", f"gpu-{i}"
+            ):
+                if a.terminal_status():
+                    continue
+                assert a.node_id in gpu_ids
+                per_node[a.node_id] = per_node.get(
+                    a.node_id, 0
+                ) + 2
+        assert all(v <= 4 for v in per_node.values()), per_node
+        worker = bat.workers[0]
+        assert worker.prescored >= 3, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_all_bad_scores_replay_original_order():
+    """When EVERY feasible node scores below the skip threshold (e.g.
+    heavy anti-affinity on a small feasible set), the oracle's
+    LimitIterator exhausts the source inside the first skip loop and
+    replays the diverted nodes in ORIGINAL order — the two-diverted
+    reversal quirk applies only when a good emission preceded the
+    replay (select.py next()).  Regression for the walk divergence
+    found via device asks (kernel picked B where the oracle
+    alternates A/B)."""
+    import numpy as np
+
+    from nomad_tpu.ops.batch import (
+        ChainInputs,
+        chained_plan_picks_cols,
+    )
+
+    C, E, P, T = 6, 1, 4, 1
+    cpu_total = np.full(C, 4000.0)
+    mem_total = np.full(C, 8192.0)
+    disk_total = np.full(C, 100000.0)
+    used_cpu = np.zeros(C)
+    used_mem = np.zeros(C)
+    used_disk = np.zeros(C)
+    used_cpu[[0, 4]] = 100.0
+    used_mem[[0, 4]] = 256.0
+    feas = np.zeros((E, T, C), bool)
+    feas[0, 0, [0, 4]] = True
+    stacked = ChainInputs(
+        feasible=feas,
+        perm=np.arange(C, dtype=np.int32)[None, :],
+        ask_cpu=np.full((E, P), 100.0),
+        ask_mem=np.full((E, P), 256.0),
+        ask_disk=np.full((E, P), 300.0),
+        desired_count=np.full((E, P), 4, np.int32),
+        limit=np.full((E, P), 3, np.int32),
+        distinct_hosts=np.zeros(E, bool),
+        tg_idx=np.zeros((E, P), np.int32),
+    )
+    rows = np.asarray(
+        chained_plan_picks_cols(
+            cpu_total, mem_total, disk_total,
+            used_cpu, used_mem, used_disk,
+            stacked, np.full(E, C, np.int32), P,
+            wanted=np.full(E, 4, np.int32),
+        )
+    )
+    # picks 2/3: both nodes carry one collision (anti-penalty pushes
+    # both below the threshold); the walk must emit them in ORIGINAL
+    # shuffle order, alternating exactly like the sequential path
+    assert rows[0].tolist() == [0, 4, 0, 4], rows[0]

