@@ -430,6 +430,7 @@ def _compare(label, build_nodes, build_jobs, n_oracle_jobs=None,
     oracle server and a batch-pipeline server; returns the result dict."""
     results = {}
     placements_by_side = {}
+    pipeline_stats = {}
     for side, batchy in (("oracle", False), ("tpu", True)):
         server = _mk_server(batchy, tpu_select=tpu_select and batchy)
         try:
@@ -447,6 +448,18 @@ def _compare(label, build_nodes, build_jobs, n_oracle_jobs=None,
             rate = n / dt if dt else 0.0
             results[side] = rate
             placements_by_side[side] = pmap
+            if batchy:
+                w = server.workers[0]
+                covered = w.prescored + w.fallbacks
+                pipeline_stats = {
+                    "prescored": w.prescored,
+                    "fallbacks": w.fallbacks,
+                    "cold_shape_fallbacks": w.cold_shape_fallbacks,
+                    "mesh_used": w.mesh_used,
+                    "fallback_rate": round(
+                        w.fallbacks / covered, 3
+                    ) if covered else 0.0,
+                }
             log(f"{label} {side}: {n} placements in {dt:.2f}s -> {rate:.1f}/s")
         finally:
             server.stop()
@@ -462,6 +475,7 @@ def _compare(label, build_nodes, build_jobs, n_oracle_jobs=None,
         if results["oracle"] and parity_ok
         else 0.0,
         "parity": f"{same}/{len(common)}",
+        **pipeline_stats,
     }
 
 

@@ -1147,6 +1147,19 @@ class APIHandler(BaseHTTPRequestHandler):
 
         if path == "/v1/metrics" and method == "GET":
             metrics = getattr(srv, "metrics", None)
+            if q.get("format") == "prometheus":
+                # scrape format (reference /v1/metrics?format=prometheus)
+                body = (
+                    metrics.prometheus_text() if metrics else ""
+                ).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return True
             self._respond(metrics.dump() if metrics else {})
             return True
 

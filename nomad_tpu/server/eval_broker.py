@@ -301,8 +301,17 @@ class EvalBroker:
         entry = self._unack.get(eval_id)
         return entry[1] if entry else None
 
-    def ready_count(self) -> int:
-        return sum(len(q) for q in self._ready.values())
+    def ready_count(self, schedulers=None) -> int:
+        """Ready evals, optionally filtered to scheduler types — the
+        BatchWorker's adaptive batch sizing reads this as the backlog
+        signal (saturated: batch for throughput; keeping up: batch
+        for latency)."""
+        with self._lock:
+            return sum(
+                len(q)
+                for name, q in self._ready.items()
+                if schedulers is None or name in schedulers
+            )
 
     def failed(self) -> List[Evaluation]:
         q = self._ready.get(FAILED_QUEUE)

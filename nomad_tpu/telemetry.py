@@ -13,27 +13,51 @@ from typing import Dict, List, Optional, Tuple
 
 
 class _Summary:
-    __slots__ = ("count", "total", "min", "max")
+    __slots__ = ("count", "total", "min", "max", "_ring", "_ring_pos")
+
+    # sliding window for percentile estimates: large enough for a
+    # stable p99 over recent traffic, small enough to stay O(1) memory
+    RING = 2048
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = 0.0
+        self._ring: List[float] = []
+        self._ring_pos = 0
 
     def add(self, value: float) -> None:
         self.count += 1
         self.total += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
+        if len(self._ring) < self.RING:
+            self._ring.append(value)
+        else:
+            self._ring[self._ring_pos] = value
+            self._ring_pos = (self._ring_pos + 1) % self.RING
+
+    def _percentile(self, ordered: List[float], q: float) -> float:
+        if not ordered:
+            return 0.0
+        idx = min(
+            len(ordered) - 1, int(round(q * (len(ordered) - 1)))
+        )
+        return ordered[idx]
 
     def snapshot(self) -> Dict:
+        ordered = sorted(self._ring)
         return {
             "count": self.count,
             "sum": self.total,
             "mean": self.total / self.count if self.count else 0.0,
             "min": self.min if self.count else 0.0,
             "max": self.max,
+            # percentiles over the sliding window (last RING samples)
+            "p50": self._percentile(ordered, 0.50),
+            "p90": self._percentile(ordered, 0.90),
+            "p99": self._percentile(ordered, 0.99),
         }
 
 
@@ -94,4 +118,12 @@ class Metrics:
                 lines.append(f"# TYPE {base} summary")
                 lines.append(f"{base}_count {snap['count']}")
                 lines.append(f"{base}_sum {snap['sum']}")
+                for q, key in (
+                    ("0.5", "p50"),
+                    ("0.9", "p90"),
+                    ("0.99", "p99"),
+                ):
+                    lines.append(
+                        f'{base}{{quantile="{q}"}} {snap[key]}'
+                    )
         return "\n".join(lines) + "\n"

@@ -1368,3 +1368,116 @@ def test_batch_pipeline_all_bad_scores_replay_original_order():
     # both below the threshold); the walk must emit them in ORIGINAL
     # shuffle order, alternating exactly like the sequential path
     assert rows[0].tolist() == [0, 4, 0, 4], rows[0]
+
+
+def test_batch_worker_exports_pipeline_metrics():
+    """BatchWorker exports prescored/fallback/mesh-used counters and
+    eval-latency percentiles via /v1/metrics (VERDICT r3 weak #7: the
+    north-star latency metric must be visible to an operator, not just
+    the bench)."""
+    import json
+    import urllib.request
+
+    from nomad_tpu.api import start_http_server
+
+    bat = Server(num_schedulers=1, seed=9, batch_pipeline=True)
+    bat.start()
+    http = start_http_server(bat, port=0)
+    try:
+        for node in make_nodes(8, seed=1):
+            bat.register_node(node)
+        for job in make_jobs(6, seed=2):
+            bat.register_job(job)
+        assert bat.drain_to_idle(30)
+        base = f"http://127.0.0.1:{http.port}"
+        with urllib.request.urlopen(
+            base + "/v1/metrics", timeout=10
+        ) as resp:
+            dump = json.loads(resp.read())
+        counters = dump["counters"]
+        assert counters.get("batch_worker.prescored", 0) > 0, (
+            counters
+        )
+        # fallback/mesh counters exist (possibly zero on this stream)
+        lat = dump["samples"].get("batch_worker.eval_latency_ms")
+        assert lat is not None and lat["count"] > 0, dump["samples"]
+        assert "p50" in lat and "p99" in lat
+        assert lat["p99"] >= lat["p50"] > 0.0
+        # prometheus rendering carries the quantiles too
+        with urllib.request.urlopen(
+            base + "/v1/metrics?format=prometheus", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        assert 'batch_worker_eval_latency_ms{quantile="0.99"}' in text
+    finally:
+        http.stop()
+        bat.stop()
+
+
+def test_adaptive_batch_cap_tracks_latency_and_backlog():
+    """The adaptive gulp size closes the loop from measured launch/
+    replay latency: small batches when keeping up and the full-batch
+    estimate blows the budget, full batches under saturation (VERDICT
+    r3 #2)."""
+    bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
+    try:
+        worker = bat.workers[0]
+        # keeping up + fast launches: full batch fits the budget
+        worker._launch_ewma = {8: 20.0, worker.batch_max: 60.0}
+        worker._replay_ewma_ms = 1.0
+        assert worker._adaptive_cap() == worker.batch_max
+
+        # keeping up + slow full-batch launches: drop to the small
+        # bucket to bound the last eval's latency
+        worker._launch_ewma = {8: 40.0, worker.batch_max: 400.0}
+        worker._replay_ewma_ms = 5.0
+        assert worker._adaptive_cap() == 8
+
+        # saturation: backlog >= a full batch -> throughput wins
+        class _Broker:
+            def ready_count(self, schedulers):
+                return worker.batch_max + 5
+
+        real = bat.broker
+        bat.broker = _Broker()
+        try:
+            assert worker._adaptive_cap() == worker.batch_max
+        finally:
+            bat.broker = real
+
+        # explicit opt-out
+        worker.latency_budget_ms = 0.0
+        worker._launch_ewma = {8: 9999.0, worker.batch_max: 9999.0}
+        assert worker._adaptive_cap() == worker.batch_max
+    finally:
+        bat.stop()
+
+
+def test_adaptive_cap_respects_operator_ceiling(monkeypatch):
+    """With NOMAD_TPU_BATCH_MAX below the small bucket, the adaptive
+    cap must never exceed the operator's ceiling, and launch EWMAs
+    keyed by trace bucket still drive the decision for non-default
+    ceilings (code-review r4 findings)."""
+    monkeypatch.setenv("NOMAD_TPU_BATCH_MAX", "4")
+    bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
+    try:
+        worker = bat.workers[0]
+        assert worker.batch_max == 4
+        worker._launch_ewma = {8: 10.0}
+        worker._replay_ewma_ms = 1.0
+        assert worker._adaptive_cap() <= 4
+    finally:
+        bat.stop()
+    monkeypatch.setenv("NOMAD_TPU_BATCH_MAX", "32")
+    bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
+    try:
+        worker = bat.workers[0]
+        from nomad_tpu.server.batch_worker import BATCH_MAX
+
+        # large-gulp launches are recorded under the TRACE bucket
+        # (module BATCH_MAX); a slow one must downsize a 32 gulp
+        worker._launch_ewma = {8: 40.0, BATCH_MAX: 400.0}
+        worker._replay_ewma_ms = 5.0
+        assert worker._adaptive_cap() == 8
+    finally:
+        bat.stop()
