@@ -95,6 +95,148 @@ class APIHandler(BaseHTTPRequestHandler):
     def _error(self, code: int, message: str) -> None:
         self._respond({"error": message}, code)
 
+    def _stream_chunked(
+        self, frames, content_type: str = "application/octet-stream"
+    ) -> None:
+        """HTTP/1.1 chunked streaming: one chunk per yielded bytes
+        value, until the generator ends or the consumer disconnects
+        (the streaming-transport analog of the reference's yamux
+        frames for logs -f / agent monitor)."""
+        import select as _select
+        import socket as _socket
+
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Nomad-Stream", "chunked")
+        self.end_headers()
+        try:
+            for data in frames:
+                if not data:
+                    # idle tick: a consumer that hung up must not pin
+                    # this thread for the stream's max lifetime — a
+                    # readable socket that yields no bytes is EOF
+                    r, _w, _x = _select.select(
+                        [self.connection], [], [], 0
+                    )
+                    if r:
+                        try:
+                            peek = self.connection.recv(
+                                1, _socket.MSG_PEEK
+                            )
+                        except OSError:
+                            return
+                        if not peek:
+                            return
+                    continue
+                self.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                )
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.close_connection = True
+
+    def _serve_exec_websocket(self, handle) -> None:
+        """Bridge an ExecStreamHandle onto the upgraded connection:
+        inbound frames carry stdin/tty_size, outbound frames carry
+        stdout/stderr and the final exited/result."""
+        import base64 as _b64
+        import queue as _queue
+        import threading as _threading
+
+        from . import ws as _ws
+
+        if not _ws.server_handshake(self):
+            raise HTTPError(400, "websocket handshake failed")
+        self.close_connection = True
+        sock = self.connection
+        done = _threading.Event()
+        # one writer at a time: the reader thread answers PINGs on
+        # the same socket the output pump writes to — interleaved
+        # sendalls would corrupt the frame stream
+        send_lock = _threading.Lock()
+
+        def send(op, payload) -> None:
+            with send_lock:
+                _ws.write_frame(sock, op, payload)
+
+        def reader() -> None:
+            try:
+                while not done.is_set():
+                    frame = _ws.read_frame(self.rfile)
+                    op, payload = frame
+                    if op == _ws.OP_CLOSE:
+                        handle.terminate()
+                        return
+                    if op == _ws.OP_PING:
+                        send(_ws.OP_PONG, payload)
+                        continue
+                    try:
+                        msg = json.loads(payload.decode("utf-8"))
+                    except ValueError:
+                        continue
+                    stdin = msg.get("stdin") or {}
+                    if stdin.get("data"):
+                        handle.write_stdin(
+                            _b64.b64decode(stdin["data"])
+                        )
+                    if stdin.get("close"):
+                        handle.close_stdin()
+                    tty = msg.get("tty_size") or {}
+                    if tty:
+                        handle.resize(
+                            int(tty.get("height", 0)),
+                            int(tty.get("width", 0)),
+                        )
+            except (ConnectionError, OSError, ValueError):
+                handle.terminate()
+
+        _threading.Thread(target=reader, daemon=True).start()
+        try:
+            while True:
+                try:
+                    event = handle.read_event(timeout=0.25)
+                except _queue.Empty:
+                    continue
+                if event is None:
+                    break
+                stream, data = event
+                send(
+                    _ws.OP_TEXT,
+                    json.dumps(
+                        {
+                            stream: {
+                                "data": _b64.b64encode(
+                                    data
+                                ).decode("ascii")
+                            }
+                        }
+                    ).encode("utf-8"),
+                )
+            try:
+                code = handle.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — report, don't hang
+                handle.terminate()
+                code = -1
+            send(
+                _ws.OP_TEXT,
+                json.dumps(
+                    {
+                        "exited": True,
+                        "result": {"exit_code": code},
+                    }
+                ).encode("utf-8"),
+            )
+            send(_ws.OP_CLOSE, b"")
+        except (ConnectionError, OSError):
+            handle.terminate()
+        finally:
+            done.set()
+
     def _check_acl(self, capability: str, namespace: str = "default"):
         self._check_acl_any((capability,), namespace)
 
@@ -419,6 +561,46 @@ class APIHandler(BaseHTTPRequestHandler):
             self._check_acl("read-logs", ns)
             task = q.get("task", "")
             kind = q.get("type", "stdout")
+            if q.get("follow") == "true":
+                # chunked live tail (reference client fs streaming
+                # for `alloc logs -f`); raw bytes, ends when the
+                # consumer disconnects.  Validate BEFORE the 200 —
+                # a typo'd alloc must 404, not stream emptiness
+                alloc_id = m.group(1)
+                try:
+                    first, cursor0 = srv.tail_task_log(
+                        alloc_id, task, kind, None
+                    )
+                except KeyError as exc:
+                    raise HTTPError(404, str(exc))
+
+                def frames():
+                    import time as _time
+
+                    cursor = cursor0
+                    if first:
+                        yield first
+                    idle = 0.0
+                    max_idle = float(q.get("max_idle", "3600"))
+                    while idle < max_idle:
+                        try:
+                            data, cursor = srv.tail_task_log(
+                                alloc_id, task, kind, cursor
+                            )
+                        except KeyError:
+                            return
+                        if data:
+                            idle = 0.0
+                            yield data
+                        else:
+                            idle += 0.25
+                            _time.sleep(0.25)
+                            yield b""  # liveness probe tick
+
+                self._stream_chunked(
+                    frames(), "application/octet-stream"
+                )
+                return True
             try:
                 data = srv.read_task_log(m.group(1), task, kind)
             except KeyError as exc:
@@ -671,6 +853,32 @@ class APIHandler(BaseHTTPRequestHandler):
             return True
 
         m = re.fullmatch(r"/v1/client/allocation/([^/]+)/exec", path)
+        if (
+            m
+            and method == "GET"
+            and "websocket"
+            in self.headers.get("Upgrade", "").lower()
+        ):
+            # interactive exec over a websocket (reference
+            # command/alloc_exec.go + api/allocations_exec.go frame
+            # shapes: stdin/stdout/stderr data b64, tty_size, exited)
+            self._check_acl("alloc-exec", ns)
+            task = q.get("task", "")
+            try:
+                argv = json.loads(q.get("command", "[]"))
+            except ValueError:
+                raise HTTPError(400, "bad command encoding")
+            if not argv:
+                raise HTTPError(400, "missing command")
+            try:
+                handle = srv.exec_alloc_stream(
+                    m.group(1), task, argv
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._serve_exec_websocket(handle)
+            return True
+
         if m and method in ("POST", "PUT"):
             # one-shot exec in the task context (reference
             # command/alloc_exec.go; the reference streams over a
@@ -1018,9 +1226,35 @@ class APIHandler(BaseHTTPRequestHandler):
             return True
 
         if path == "/v1/agent/monitor" and method == "GET":
+            self._check_acl("agent:read")
+            if q.get("follow") == "true":
+                # chunked live stream of agent log lines (reference
+                # command/agent/monitor websocket stream); one JSON
+                # line per log record
+                def monitor_frames():
+                    import time as _time
+
+                    seq = int(q.get("index", "-1"))
+                    deadline = _time.monotonic() + float(
+                        q.get("max_s", "3600")
+                    )
+                    while _time.monotonic() < deadline:
+                        lines, seq = srv.log_monitor.tail(
+                            after=seq, wait=1.0
+                        )
+                        if not lines:
+                            yield b""  # liveness probe tick
+                        for line in lines:
+                            yield (
+                                json.dumps({"Line": line}) + "\n"
+                            ).encode("utf-8")
+
+                self._stream_chunked(
+                    monitor_frames(), "application/json"
+                )
+                return True
             # log tail with a resumable cursor (reference
             # command/agent/monitor streaming; poll with ?index=<seq>)
-            self._check_acl("agent:read")
             after = int(q.get("index", "-1"))
             wait_s = min(float(q.get("wait", "0")), 10.0)
             lines, seq = srv.log_monitor.tail(after=after, wait=wait_s)

@@ -1,0 +1,187 @@
+"""Minimal WebSocket (RFC 6455) framing for the streaming endpoints.
+
+The reference multiplexes raw yamux streams for `alloc exec`
+(interactive stdin/stdout frames + terminal resize — reference
+nomad/rpc.go handleStreamingConn, command/alloc_exec.go) and serves
+them to the CLI over a websocket.  This build keeps the HTTP server as
+the single transport: the exec endpoint upgrades the connection and
+exchanges the same JSON frame shapes the reference API uses
+({"stdin": {"data": b64}}, {"stdout": {"data": b64}},
+{"tty_size": {...}}, {"exited": true, "result": {...}}).
+
+Only the subset both our server and CLI need: no extensions, no
+fragmentation of outgoing messages, text + binary + close/ping/pong
+handling, client masking per spec.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import socket
+import struct
+from typing import Optional, Tuple
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1(
+        (client_key + _GUID).encode("ascii")
+    ).digest()
+    return base64.b64encode(digest).decode("ascii")
+
+
+def server_handshake(handler) -> bool:
+    """Upgrade an http.server request to a websocket.  Returns True
+    when the 101 was sent; the caller then owns handler.connection."""
+    key = handler.headers.get("Sec-WebSocket-Key", "")
+    if not key:
+        return False
+    handler.send_response(101, "Switching Protocols")
+    handler.send_header("Upgrade", "websocket")
+    handler.send_header("Connection", "Upgrade")
+    handler.send_header("Sec-WebSocket-Accept", accept_key(key))
+    handler.end_headers()
+    handler.wfile.flush()
+    return True
+
+
+def _read_exact(sock_file, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock_file.read(n - len(buf))
+        if not chunk:
+            raise ConnectionError("websocket closed mid-frame")
+        buf += chunk
+    return buf
+
+
+# one frame (or fragment train) may not exceed this — a client-
+# supplied 2^63 length must not become a server-side allocation
+MAX_FRAME_BYTES = 16 * 1024 * 1024
+
+
+def read_frame(sock_file) -> Tuple[int, bytes]:
+    """Returns (opcode, payload).  Handles masking and 16/64-bit
+    lengths; coalesces continuation fragments."""
+    opcode = None
+    payload = b""
+    while True:
+        head = _read_exact(sock_file, 2)
+        fin = head[0] & 0x80
+        op = head[0] & 0x0F
+        masked = head[1] & 0x80
+        length = head[1] & 0x7F
+        if length == 126:
+            length = struct.unpack(
+                ">H", _read_exact(sock_file, 2)
+            )[0]
+        elif length == 127:
+            length = struct.unpack(
+                ">Q", _read_exact(sock_file, 8)
+            )[0]
+        if length + len(payload) > MAX_FRAME_BYTES:
+            raise ConnectionError(
+                f"websocket frame too large ({length} bytes)"
+            )
+        mask = _read_exact(sock_file, 4) if masked else b""
+        data = _read_exact(sock_file, length) if length else b""
+        if mask:
+            data = bytes(
+                b ^ mask[i % 4] for i, b in enumerate(data)
+            )
+        if op != OP_CONT:
+            opcode = op
+        payload += data
+        if fin:
+            return opcode, payload
+
+
+def write_frame(
+    sock, opcode: int, payload: bytes, mask: bool = False
+) -> None:
+    head = bytes([0x80 | opcode])
+    length = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if length < 126:
+        head += bytes([mask_bit | length])
+    elif length < 65536:
+        head += bytes([mask_bit | 126]) + struct.pack(">H", length)
+    else:
+        head += bytes([mask_bit | 127]) + struct.pack(">Q", length)
+    if mask:
+        key = os.urandom(4)
+        payload = bytes(
+            b ^ key[i % 4] for i, b in enumerate(payload)
+        )
+        head += key
+    sock.sendall(head + payload)
+
+
+class WebSocketClient:
+    """Tiny client for the CLI: connect, send/recv text frames."""
+
+    def __init__(self, host: str, port: int, path: str,
+                 headers: Optional[dict] = None) -> None:
+        self.sock = socket.create_connection((host, port), timeout=30)
+        key = base64.b64encode(os.urandom(16)).decode("ascii")
+        lines = [
+            f"GET {path} HTTP/1.1",
+            f"Host: {host}:{port}",
+            "Upgrade: websocket",
+            "Connection: Upgrade",
+            f"Sec-WebSocket-Key: {key}",
+            "Sec-WebSocket-Version: 13",
+        ]
+        for k, v in (headers or {}).items():
+            lines.append(f"{k}: {v}")
+        self.sock.sendall(
+            ("\r\n".join(lines) + "\r\n\r\n").encode("ascii")
+        )
+        self._file = self.sock.makefile("rb")
+        status = self._file.readline()
+        if b"101" not in status:
+            raise ConnectionError(
+                f"websocket upgrade refused: {status!r}"
+            )
+        while True:
+            line = self._file.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+
+    def send_text(self, text: str) -> None:
+        write_frame(
+            self.sock, OP_TEXT, text.encode("utf-8"), mask=True
+        )
+
+    def recv(self, timeout: Optional[float] = None):
+        """Returns (opcode, payload) or None on clean close."""
+        self.sock.settimeout(timeout)
+        try:
+            op, payload = read_frame(self._file)
+        except (ConnectionError, OSError):
+            return None
+        if op == OP_CLOSE:
+            return None
+        if op == OP_PING:
+            write_frame(self.sock, OP_PONG, payload, mask=True)
+            return self.recv(timeout)
+        return op, payload
+
+    def close(self) -> None:
+        try:
+            write_frame(self.sock, OP_CLOSE, b"", mask=True)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -111,6 +111,13 @@ def cmd_agent(args) -> None:
     cfg = load_config(args.config) if args.config else AgentConfig()
     if args.dev:
         cfg.client.enabled = True
+        if not cfg.data_dir:
+            # dev mode needs a real alloc-dir root or the fs/logs
+            # surface (alloc logs/fs/exec streaming) has nothing to
+            # serve (reference -dev defaults a temp data dir too)
+            import tempfile
+
+            cfg.data_dir = tempfile.mkdtemp(prefix="nomad-tpu-dev-")
     if args.num_schedulers is not None:
         cfg.server.num_schedulers = args.num_schedulers
     if args.http_port is not None:
@@ -126,6 +133,11 @@ def cmd_agent(args) -> None:
     server.start()
     http = start_http_server(server, host=cfg.http.host, port=cfg.http.port)
     print(f"==> nomad-tpu agent started; HTTP on :{http.port}")
+    # lifecycle lines feed /v1/agent/monitor (the logging handler only
+    # sees `logging` records, not stdout prints)
+    server.log_monitor.write_line(
+        f"agent started; HTTP on :{http.port}"
+    )
     bridge = None
     if cfg.bridge_port is not None:
         from .server.bridge_service import BridgeService
@@ -289,32 +301,45 @@ def cmd_job_dispatch(args) -> None:
     print(f"==> Dispatched {resp['DispatchedJobID']}")
 
 
+def _stream_get(path: str):
+    """GET a chunked streaming endpoint; yields raw byte frames
+    (urllib reads chunked transfer transparently)."""
+    url = _addr() + path
+    req = urllib.request.Request(url, method="GET")
+    token = os.environ.get("NOMAD_TOKEN")
+    if token:
+        req.add_header("X-Nomad-Token", token)
+    resp = urllib.request.urlopen(req, timeout=3600)
+    while True:
+        data = resp.read1(65536)
+        if not data:
+            return
+        yield data
+
+
 def cmd_alloc_logs(args) -> None:
     kind = "stderr" if args.stderr else "stdout"
     path = (
         f"/v1/client/fs/logs/{args.alloc_id}?task={args.task}"
         f"&type={kind}"
     )
-    data = _request("GET", path).get("Data", "")
-    sys.stdout.write(data)
     if not getattr(args, "follow", False):
+        data = _request("GET", path).get("Data", "")
+        sys.stdout.write(data)
         return
-    # -f: tail by polling and printing the delta (reference streams
-    # frames over a chunked connection; same observable behavior)
-    sys.stdout.flush()
-    printed = len(data)
+    # -f: live chunked stream from the server (reference client fs
+    # streaming frames)
     try:
-        while True:
-            time.sleep(0.5)
-            data = _request("GET", path).get("Data", "")
-            if len(data) < printed:
-                printed = 0  # rotated: restart from the top of file
-            if len(data) > printed:
-                sys.stdout.write(data[printed:])
-                sys.stdout.flush()
-                printed = len(data)
-    except KeyboardInterrupt:
+        for frame in _stream_get(path + "&follow=true"):
+            # raw bytes: a multibyte character straddling a chunk
+            # boundary must not be mangled by per-chunk decoding
+            sys.stdout.buffer.write(frame)
+            sys.stdout.buffer.flush()
+    except (KeyboardInterrupt, BrokenPipeError):
         pass
+    except urllib.error.HTTPError as exc:
+        print(f"Error ({exc.code}): {exc.reason}", file=sys.stderr)
+        sys.exit(1)
 
 
 def cmd_job_history(args) -> None:
@@ -393,6 +418,8 @@ def cmd_alloc_stop(args) -> None:
 
 
 def cmd_alloc_exec(args) -> None:
+    if getattr(args, "interactive", False):
+        sys.exit(_exec_interactive(args))
     resp = _request(
         "POST",
         f"/v1/client/allocation/{args.alloc_id}/exec",
@@ -403,6 +430,90 @@ def cmd_alloc_exec(args) -> None:
     )
     sys.stdout.write(resp.get("Output", ""))
     sys.exit(int(resp.get("ExitCode", 0)))
+
+
+def _exec_interactive(args) -> int:
+    """Live exec session over the websocket transport (reference
+    command/alloc_exec.go): stdin streams up, stdout/stderr stream
+    down, exit code propagates."""
+    import base64
+    import threading
+    import urllib.parse as _p
+
+    from .api.ws import WebSocketClient
+
+    addr = _p.urlparse(_addr())
+    path = (
+        f"/v1/client/allocation/{args.alloc_id}/exec"
+        f"?task={_p.quote(args.task or '')}"
+        f"&command={_p.quote(json.dumps(args.cmd))}"
+    )
+    headers = {}
+    token = os.environ.get("NOMAD_TOKEN")
+    if token:
+        headers["X-Nomad-Token"] = token
+    try:
+        ws = WebSocketClient(
+            addr.hostname, addr.port or 4646, path, headers
+        )
+    except (OSError, ConnectionError) as exc:
+        print(f"Error connecting: {exc}", file=sys.stderr)
+        return 1
+
+    def pump_stdin() -> None:
+        try:
+            while True:
+                data = sys.stdin.buffer.read1(4096)
+                if not data:
+                    ws.send_text(
+                        json.dumps({"stdin": {"close": True}})
+                    )
+                    return
+                ws.send_text(
+                    json.dumps(
+                        {
+                            "stdin": {
+                                "data": base64.b64encode(
+                                    data
+                                ).decode("ascii")
+                            }
+                        }
+                    )
+                )
+        except (OSError, ValueError):
+            pass
+
+    threading.Thread(target=pump_stdin, daemon=True).start()
+    code = 1
+    try:
+        while True:
+            got = ws.recv(timeout=3600)
+            if got is None:
+                break
+            _op, payload = got
+            try:
+                msg = json.loads(payload.decode("utf-8"))
+            except ValueError:
+                continue
+            for stream, out in (
+                ("stdout", sys.stdout),
+                ("stderr", sys.stderr),
+            ):
+                frame = msg.get(stream) or {}
+                if frame.get("data"):
+                    out.buffer.write(
+                        base64.b64decode(frame["data"])
+                    )
+                    out.flush()
+            if msg.get("exited"):
+                code = int(
+                    (msg.get("result") or {}).get("exit_code", 0)
+                )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ws.close()
+    return code
 
 
 def cmd_alloc_fs(args) -> None:
@@ -435,6 +546,28 @@ def cmd_alloc_fs(args) -> None:
 
 def cmd_monitor(args) -> None:
     """Follow the agent's logs (reference `nomad monitor`)."""
+    if args.follow:
+        # chunked live stream (reference agent monitor streaming)
+        try:
+            buf = b""
+            for frame in _stream_get(
+                "/v1/agent/monitor?follow=true"
+            ):
+                buf += frame
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    try:
+                        print(json.loads(line)["Line"])
+                    except (ValueError, KeyError):
+                        pass
+        except KeyboardInterrupt:
+            pass
+        except urllib.error.HTTPError as exc:
+            print(
+                f"Error ({exc.code}): {exc.reason}", file=sys.stderr
+            )
+            sys.exit(1)
+        return
     index = -1
     try:
         while True:
@@ -1285,6 +1418,10 @@ def build_parser() -> argparse.ArgumentParser:
     alst.set_defaults(fn=cmd_alloc_stop)
     alex = alloc_sub.add_parser("exec")
     alex.add_argument("-task", dest="task", default="")
+    alex.add_argument(
+        "-i", action="store_true", dest="interactive",
+        help="interactive session over the websocket stream",
+    )
     alex.add_argument("alloc_id")
     # REMAINDER so the command's own flags (e.g. sh -c) pass through
     alex.add_argument("cmd", nargs=argparse.REMAINDER)

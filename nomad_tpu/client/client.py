@@ -437,6 +437,23 @@ class Client:
         """Run a command in a task's context (reference
         client_alloc_endpoint.go Allocations.Exec backing
         `nomad alloc exec`).  Returns (exit_code, output_bytes)."""
+        tr, env, cwd = self._task_exec_context(alloc_id, task)
+        return tr.driver.exec_task(
+            tr.task_id, argv, timeout=timeout, env=env, cwd=cwd
+        )
+
+    def exec_alloc_stream(self, alloc_id: str, task: str, argv):
+        """Interactive exec handle in a task's context (reference
+        Allocations.Exec streaming — backs `alloc exec -i` over the
+        websocket transport)."""
+        tr, env, cwd = self._task_exec_context(alloc_id, task)
+        return tr.driver.exec_task_stream(
+            tr.task_id, argv, env=env, cwd=cwd
+        )
+
+    def _task_exec_context(self, alloc_id: str, task: str):
+        """(task runner, env, cwd) shared by the one-shot and
+        streaming exec paths."""
         with self._lock:
             runner = self.alloc_runners.get(alloc_id)
         if runner is None:
@@ -448,8 +465,21 @@ class Client:
             tr.env
         )
         cwd = tr.task_dir.local_dir if tr.task_dir is not None else ""
-        return tr.driver.exec_task(
-            tr.task_id, argv, timeout=timeout, env=env, cwd=cwd
+        return tr, env, cwd
+
+    def tail_task_log(
+        self, alloc_id: str, task: str, kind: str, cursor
+    ):
+        """One `logs -f` follow step: (appended bytes, new cursor)."""
+        import os as _os
+
+        from .logmon import follow_task_log
+
+        root = self._alloc_fs_root(alloc_id)
+        log_dir = _os.path.join(root, "alloc", "logs")
+        flat = _os.path.join(root, f"{task}.{kind}")
+        return follow_task_log(
+            log_dir, task, kind, cursor, flat_path=flat
         )
 
     def _alloc_fs_root(self, alloc_id: str) -> str:

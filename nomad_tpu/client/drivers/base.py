@@ -1,9 +1,88 @@
 """Driver plugin contract (reference plugins/drivers/driver.go:40)."""
 from __future__ import annotations
 
+import queue
+import subprocess
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
+
+
+class ExecStreamHandle:
+    """A live interactive command in a task's context (reference
+    ExecTaskStreaming): stdin accepts writes, stdout/stderr arrive as
+    (stream, bytes) events, exit is observable.
+
+    Pumped by two reader threads into one queue so the transport
+    bridge (websocket frames, tests) consumes a single ordered
+    stream; a None event means both outputs reached EOF."""
+
+    def __init__(self, argv, env=None, cwd: str = "") -> None:
+        self.proc = subprocess.Popen(
+            argv,
+            cwd=cwd or None,
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        self.events: "queue.Queue" = queue.Queue()
+        self._open_streams = 2
+
+        def pump(stream, name):
+            try:
+                while True:
+                    data = stream.read1(65536)
+                    if not data:
+                        break
+                    self.events.put((name, data))
+            except (OSError, ValueError):
+                pass
+            finally:
+                with self._lock:
+                    self._open_streams -= 1
+                    if self._open_streams == 0:
+                        self.events.put(None)
+
+        self._lock = threading.Lock()
+        for stream, name in (
+            (self.proc.stdout, "stdout"),
+            (self.proc.stderr, "stderr"),
+        ):
+            threading.Thread(
+                target=pump, args=(stream, name), daemon=True
+            ).start()
+
+    def write_stdin(self, data: bytes) -> None:
+        try:
+            self.proc.stdin.write(data)
+            self.proc.stdin.flush()
+        except (OSError, ValueError):
+            pass
+
+    def close_stdin(self) -> None:
+        try:
+            self.proc.stdin.close()
+        except (OSError, ValueError):
+            pass
+
+    def read_event(self, timeout: Optional[float] = None):
+        """(stream, bytes), or None once both outputs hit EOF, or
+        raises queue.Empty on timeout."""
+        return self.events.get(timeout=timeout)
+
+    def resize(self, height: int, width: int) -> None:
+        """Terminal resize — a no-op without a pty; kept so the
+        transport accepts the reference's tty_size frames."""
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        return self.proc.wait(timeout)
+
+    def terminate(self) -> None:
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
 
 
 @dataclass
@@ -108,6 +187,21 @@ class DriverPlugin:
         (exit_code, combined_output_bytes) (reference
         DriverPlugin.ExecTask backing `nomad alloc exec`)."""
         raise NotImplementedError
+
+    def exec_task_stream(
+        self,
+        task_id: str,
+        argv,
+        env=None,
+        cwd: str = "",
+    ) -> "ExecStreamHandle":
+        """Interactive exec in the task's context: a live handle with
+        stdin writes and streamed stdout/stderr (reference
+        DriverPlugin.ExecTaskStreaming backing `nomad alloc exec -i`
+        over the websocket transport)."""
+        if task_id not in getattr(self, "handles", {}):
+            raise KeyError(f"unknown task {task_id!r}")
+        return ExecStreamHandle(list(argv), env=env, cwd=cwd)
 
     def inspect_task(self, task_id: str) -> Optional[DriverHandle]:
         raise NotImplementedError

@@ -158,6 +158,17 @@ class RawExecDriver(DriverPlugin):
             return 127, str(exc).encode()
         return out.returncode, out.stdout or b""
 
+    def exec_task_stream(self, task_id, argv, env=None, cwd=""):
+        from .base import ExecStreamHandle
+
+        if task_id not in self.handles:
+            raise KeyError(f"unknown task {task_id!r}")
+        run_env = self._exec_base_env()
+        run_env.update(env or {})
+        return ExecStreamHandle(
+            list(argv), env=run_env, cwd=cwd or None
+        )
+
     def signal_task(self, task_id, signal="SIGTERM"):
         handle = self.handles.get(task_id)
         if handle is None or not handle.is_running():

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import BinaryIO, List, Optional
+from typing import BinaryIO, List, Optional, Tuple
 
 DEFAULT_MAX_FILES = 10
 DEFAULT_MAX_FILE_SIZE_MB = 10
@@ -204,3 +204,76 @@ def read_task_log(
         if len(out) >= max_bytes:
             break
     return out[-max_bytes:]
+
+
+def follow_task_log(
+    log_dir: str,
+    task_name: str,
+    kind: str,
+    cursor: Optional[Tuple[int, int]],
+    flat_path: str = "",
+    max_step_bytes: int = 256 * 1024,
+) -> Tuple[bytes, Tuple[int, int]]:
+    """One follow step: bytes appended since `cursor` and the new
+    cursor, for the streaming `alloc logs -f` transport (reference
+    client fs streaming frames).
+
+    The cursor is (rotation_index, offset) into the logmon layout;
+    when rotation advances, the remainder of the old file is drained
+    before moving to the new one.  A client whose task predates logmon
+    (flat `<task>.<kind>` files) follows `flat_path` with cursor
+    (-1, offset)."""
+    rot_prefix = f"{task_name}.{kind}."
+    try:
+        names = [
+            n
+            for n in os.listdir(log_dir)
+            if n.startswith(rot_prefix)
+            and n[len(rot_prefix):].isdigit()
+        ]
+    except OSError:
+        names = []
+    if not names:
+        # flat legacy layout
+        offset = cursor[1] if cursor and cursor[0] == -1 else 0
+        if not flat_path:
+            return b"", (-1, offset)
+        try:
+            with open(flat_path, "rb") as f:
+                f.seek(offset)
+                data = f.read(max_step_bytes)
+        except OSError:
+            return b"", (-1, offset)
+        return data, (-1, offset + len(data))
+
+    indexes = sorted(int(n[len(rot_prefix):]) for n in names)
+    if cursor is None or cursor[0] == -1 or cursor[0] not in indexes:
+        # start at the beginning of the oldest retained file; for an
+        # established cursor whose file was pruned this is still
+        # duplicate-free — retention only drops OLD files, so every
+        # retained index is strictly newer than anything already read
+        cursor = (indexes[0], 0)
+    idx, offset = cursor
+    out = b""
+    new_cursor = cursor
+    # bounded per step: a fresh follower attaching to a task with a
+    # full rotation window must not slurp the whole retained history
+    # into one buffer — the cursor resumes where this step stopped
+    budget = max_step_bytes
+    for i in indexes:
+        if i < idx:
+            continue
+        start = offset if i == idx else 0
+        path = os.path.join(log_dir, f"{rot_prefix}{i}")
+        try:
+            with open(path, "rb") as f:
+                f.seek(start)
+                data = f.read(budget)
+        except OSError:
+            data = b""
+        out += data
+        budget -= len(data)
+        new_cursor = (i, start + len(data))
+        if budget <= 0:
+            break
+    return out, new_cursor

