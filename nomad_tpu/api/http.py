@@ -1202,6 +1202,119 @@ class APIHandler(BaseHTTPRequestHandler):
             )
             return True
 
+        if path == "/v1/agent/force-leave" and method in (
+            "POST",
+            "PUT",
+        ):
+            # evict a failed server from gossip (reference
+            # agent_endpoint.go ForceLeave / `server force-leave`)
+            self._check_acl("agent:write")
+            name = q.get("node", "")
+            if not name:
+                raise HTTPError(400, "missing node")
+            gossip = getattr(srv, "gossip", None)
+            if gossip is None:
+                raise HTTPError(
+                    400, "agent is not running gossip"
+                )
+            gossip.force_leave(name)
+            self._respond({})
+            return True
+
+        m = re.fullmatch(r"/v1/volume/csi/([^/]+)/detach", path)
+        if m and method in ("POST", "PUT"):
+            # release a node's claims on a volume (reference
+            # csi_endpoint.go Unpublish / `volume detach`)
+            self._check_acl("csi-write-volume", ns)
+            node_id = q.get("node", "")
+            if not node_id:
+                raise HTTPError(400, "missing node")
+            try:
+                count = store.detach_csi_volume(
+                    ns, m.group(1), node_id
+                )
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond({"DetachedClaims": count})
+            return True
+
+        if path == "/v1/operator/raft/peer" and method == "DELETE":
+            # remove a raft peer (reference operator_endpoint.go
+            # RaftRemovePeerByAddress / `operator raft remove-peer`)
+            # — through the REPLICATED config change so every server
+            # agrees on the new membership, never the local-only
+            # remove_peer
+            self._check_acl("operator:write")
+            address = q.get("address", "")
+            if not address:
+                raise HTTPError(400, "missing address")
+            if hasattr(srv, "broadcast_peer_removal"):
+                if not srv.broadcast_peer_removal(address):
+                    raise HTTPError(
+                        500, "peer removal not acknowledged"
+                    )
+            else:
+                raft = getattr(srv, "raft", None)
+                if raft is None or not hasattr(
+                    raft, "remove_server"
+                ):
+                    raise HTTPError(
+                        400, "server is not running raft"
+                    )
+                raft.remove_server(address)
+            self._respond({})
+            return True
+
+        if path == "/v1/operator/license" and method == "GET":
+            # OSS parity: the license surface exists but the feature
+            # is Enterprise (reference OSS returns an error here)
+            raise HTTPError(
+                501, "license is a Nomad Enterprise feature"
+            )
+        if path == "/v1/operator/license" and method in (
+            "POST",
+            "PUT",
+        ):
+            raise HTTPError(
+                501, "license is a Nomad Enterprise feature"
+            )
+        if path.startswith("/v1/sentinel") or path.startswith(
+            "/v1/quota"
+        ):
+            # OSS parity (reference OSS: endpoints registered,
+            # feature gated to Enterprise)
+            raise HTTPError(
+                501,
+                "sentinel policies and quotas are Nomad "
+                "Enterprise features",
+            )
+
+        if path == "/v1/operator/keyring" and method == "GET":
+            self._check_acl("agent:read")
+            self._respond(srv.keyring.list())
+            return True
+        if path == "/v1/operator/keyring" and method in (
+            "POST",
+            "PUT",
+        ):
+            self._check_acl("agent:write")
+            body = self._body()
+            op = body.get("Operation", "install")
+            key = body.get("Key", "")
+            try:
+                if op == "install":
+                    srv.keyring.install(key)
+                elif op == "use":
+                    srv.keyring.use(key)
+                elif op == "remove":
+                    srv.keyring.remove(key)
+                else:
+                    raise HTTPError(400, f"unknown op {op!r}")
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
+            self._respond(srv.keyring.list())
+            return True
+
         if path == "/v1/regions" and method == "GET":
             gossip = getattr(srv, "gossip", None)
             if gossip is None:

@@ -655,6 +655,14 @@ def cmd_operator_debug(args) -> None:
 
 
 def cmd_operator_raft(args) -> None:
+    if getattr(args, "action", "list-peers") == "remove-peer":
+        _request(
+            "DELETE",
+            "/v1/operator/raft/peer?address="
+            + urllib.parse.quote(args.address or ""),
+        )
+        print(f"==> Removed raft peer {args.address}")
+        return
     cfg = _request("GET", "/v1/operator/raft/configuration")
     _table(
         [
@@ -663,6 +671,116 @@ def cmd_operator_raft(args) -> None:
         ],
         ["ID", "Address", "Leader", "Voter"],
     )
+
+
+def cmd_job_allocs(args) -> None:
+    """(reference command/job_allocs.go)"""
+    allocs = _request("GET", f"/v1/job/{args.job_id}/allocations")
+    if getattr(args, "json", False):
+        print(json.dumps(allocs, indent=2))
+        return
+    _table(
+        [
+            (
+                (a.get("ID") or a.get("id", ""))[:8],
+                (a.get("NodeID") or a.get("node_id", ""))[:8],
+                a.get("TaskGroup") or a.get("task_group", ""),
+                a.get("DesiredStatus")
+                or a.get("desired_status", ""),
+                a.get("ClientStatus")
+                or a.get("client_status", ""),
+            )
+            for a in allocs
+        ],
+        ["ID", "Node ID", "Task Group", "Desired", "Status"],
+    )
+
+
+def cmd_volume_detach(args) -> None:
+    """(reference command/volume_detach.go)"""
+    resp = _request(
+        "PUT",
+        f"/v1/volume/csi/{args.volume_id}/detach?node="
+        + urllib.parse.quote(args.node_id),
+        {},
+    )
+    print(
+        f"==> Detached {resp.get('DetachedClaims', 0)} claim(s) "
+        f"from {args.node_id[:8]}"
+    )
+
+
+def cmd_server_force_leave(args) -> None:
+    """(reference command/server_force_leave.go)"""
+    _request(
+        "PUT",
+        "/v1/agent/force-leave?node="
+        + urllib.parse.quote(args.name),
+        {},
+    )
+    print(f"==> Force-left {args.name}")
+
+
+def cmd_license(args) -> None:
+    """(reference command/license_get.go / license_put.go; OSS gates
+    the feature to Enterprise — surfacing the server's error is the
+    parity behavior)"""
+    if args.license_cmd == "get":
+        _request("GET", "/v1/operator/license")
+    else:
+        _request("PUT", "/v1/operator/license", {"License": ""})
+
+
+def cmd_enterprise_gate(args) -> None:
+    """sentinel/quota command families (reference registers them in
+    OSS builds; the feature itself is Enterprise-gated server-side)"""
+    family = args.family
+    _request("GET", f"/v1/{family}s" if family == "quota" else
+             "/v1/sentinel/policies")
+
+
+def cmd_keyring(args) -> None:
+    """(reference command/operator_keyring.go: -install/-use/-remove/
+    -list against the serf keyring)"""
+    if args.install:
+        resp = _request(
+            "PUT", "/v1/operator/keyring",
+            {"Operation": "install", "Key": args.install},
+        )
+    elif args.use:
+        resp = _request(
+            "PUT", "/v1/operator/keyring",
+            {"Operation": "use", "Key": args.use},
+        )
+    elif args.remove:
+        resp = _request(
+            "PUT", "/v1/operator/keyring",
+            {"Operation": "remove", "Key": args.remove},
+        )
+    else:
+        resp = _request("GET", "/v1/operator/keyring")
+    keys = resp.get("Keys", {})
+    primary = set(resp.get("PrimaryKeys", {}))
+    for key in keys:
+        marker = " (primary)" if key in primary else ""
+        print(f"{key}{marker}")
+
+
+def cmd_check(args) -> None:
+    """Agent health probe (reference command/check.go: exit 0 when
+    the agent answers)"""
+    _request("GET", "/v1/agent/self")
+    print("ok")
+
+
+def cmd_ui(args) -> None:
+    """(reference command/ui.go: print/open the web UI URL)"""
+    url = _addr() + "/ui/"
+    print(url)
+    if getattr(args, "open", False):
+        import webbrowser
+
+        webbrowser.open(url)
 
 
 def cmd_job_stop(args) -> None:
@@ -1333,6 +1451,10 @@ def build_parser() -> argparse.ArgumentParser:
     jini = job_sub.add_parser("init")
     jini.add_argument("filename", nargs="?", default="")
     jini.set_defaults(fn=cmd_job_init)
+    jal = job_sub.add_parser("allocs")
+    jal.add_argument("-json", action="store_true", dest="json")
+    jal.add_argument("job_id")
+    jal.set_defaults(fn=cmd_job_allocs)
 
     volume = sub.add_parser("volume")
     volume_sub = volume.add_subparsers(dest="volume_cmd", required=True)
@@ -1346,6 +1468,10 @@ def build_parser() -> argparse.ArgumentParser:
     vd.add_argument("volume_id")
     vd.add_argument("-force", dest="force", action="store_true")
     vd.set_defaults(fn=cmd_volume_deregister)
+    vdet = volume_sub.add_parser("detach")
+    vdet.add_argument("volume_id")
+    vdet.add_argument("node_id")
+    vdet.set_defaults(fn=cmd_volume_detach)
 
     plugin = sub.add_parser("plugin")
     plugin_sub = plugin.add_subparsers(dest="plugin_cmd", required=True)
@@ -1368,6 +1494,9 @@ def build_parser() -> argparse.ArgumentParser:
     sj = server_sub.add_parser("join")
     sj.add_argument("address")
     sj.set_defaults(fn=cmd_server_join)
+    sfl = server_sub.add_parser("force-leave")
+    sfl.add_argument("name")
+    sfl.set_defaults(fn=cmd_server_force_leave)
 
     node = sub.add_parser("node")
     node_sub = node.add_subparsers(dest="node_cmd", required=True)
@@ -1523,10 +1652,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     oap.set_defaults(fn=cmd_operator_autopilot)
     oraft = op_sub.add_parser("raft")
-    oraft.add_argument("action", choices=["list-peers"])
+    oraft.add_argument(
+        "action", choices=["list-peers", "remove-peer"]
+    )
+    oraft.add_argument(
+        "-peer-address", dest="address", default=""
+    )
     oraft.set_defaults(fn=cmd_operator_raft)
     okg = op_sub.add_parser("keygen")
     okg.set_defaults(fn=cmd_operator_keygen)
+    okr = op_sub.add_parser("keyring")
+    okr_group = okr.add_mutually_exclusive_group()
+    okr_group.add_argument("-install", dest="install", default="")
+    okr_group.add_argument("-use", dest="use", default="")
+    okr_group.add_argument("-remove", dest="remove", default="")
+    okr_group.add_argument(
+        "-list", action="store_true", dest="list_keys"
+    )
+    okr.set_defaults(fn=cmd_keyring)
     odbg = op_sub.add_parser("debug")
     odbg.add_argument("-output", dest="output", default="")
     odbg.set_defaults(fn=cmd_operator_debug)
@@ -1544,6 +1687,50 @@ def build_parser() -> argparse.ArgumentParser:
         "target", nargs="?", choices=["summaries"], default="summaries"
     )
     system.set_defaults(fn=cmd_system)
+
+    lic = sub.add_parser("license")
+    lic.add_argument("license_cmd", choices=["get", "put"])
+    lic.add_argument("file", nargs="?", default="")
+    lic.set_defaults(fn=cmd_license)
+
+    # sentinel/quota: registered like the reference OSS build; the
+    # server gates the features to Enterprise (command/commands.go
+    # registers them unconditionally)
+    sentinel = sub.add_parser("sentinel")
+    sentinel.add_argument(
+        "sentinel_cmd", choices=["apply", "delete", "list", "read"]
+    )
+    sentinel.add_argument("args", nargs=argparse.REMAINDER)
+    sentinel.set_defaults(fn=cmd_enterprise_gate, family="sentinel")
+    quota = sub.add_parser("quota")
+    quota.add_argument(
+        "quota_cmd",
+        choices=["apply", "delete", "init", "inspect", "list",
+                 "status"],
+    )
+    quota.add_argument("args", nargs=argparse.REMAINDER)
+    quota.set_defaults(fn=cmd_enterprise_gate, family="quota")
+
+    kg = sub.add_parser("keygen")
+    kg.set_defaults(fn=cmd_operator_keygen)
+    kr = sub.add_parser("keyring")
+    kr_group = kr.add_mutually_exclusive_group()
+    kr_group.add_argument("-install", dest="install", default="")
+    kr_group.add_argument("-use", dest="use", default="")
+    kr_group.add_argument("-remove", dest="remove", default="")
+    kr_group.add_argument(
+        "-list", action="store_true", dest="list_keys"
+    )
+    kr.set_defaults(fn=cmd_keyring)
+
+    chk = sub.add_parser("check")
+    chk.set_defaults(fn=cmd_check)
+    ui = sub.add_parser("ui")
+    ui.add_argument("-open", action="store_true", dest="open")
+    ui.set_defaults(fn=cmd_ui)
+    dbg = sub.add_parser("debug")
+    dbg.add_argument("-output", dest="output", default="")
+    dbg.set_defaults(fn=cmd_operator_debug)
 
     # top-level aliases (reference registers e.g. "run" -> job run,
     # "status" -> job status; command/commands.go)
@@ -1588,6 +1775,42 @@ def build_parser() -> argparse.ArgumentParser:
 
     ai = sub.add_parser("agent-info")
     ai.set_defaults(fn=cmd_agent_info)
+
+    # hyphenated legacy aliases (the reference registers both forms,
+    # command/commands.go: "node-status", "server-members", ...)
+    hns = sub.add_parser("node-status")
+    hns.add_argument("node_id", nargs="?")
+    hns.set_defaults(fn=cmd_node_status)
+    hnd = sub.add_parser("node-drain")
+    hnd_group = hnd.add_mutually_exclusive_group(required=True)
+    hnd_group.add_argument(
+        "-enable", action="store_true", dest="enable"
+    )
+    hnd_group.add_argument(
+        "-disable", action="store_false", dest="enable"
+    )
+    hnd.add_argument(
+        "-deadline", type=float, default=3600.0, dest="deadline"
+    )
+    hnd.add_argument(
+        "-monitor", action="store_true", dest="monitor"
+    )
+    hnd.add_argument("node_id")
+    hnd.set_defaults(fn=cmd_node_drain)
+    has = sub.add_parser("alloc-status")
+    has.add_argument("alloc_id")
+    has.set_defaults(fn=cmd_alloc_status)
+    hes = sub.add_parser("eval-status")
+    hes.add_argument("eval_id")
+    hes.set_defaults(fn=cmd_eval_status)
+    hsj = sub.add_parser("server-join")
+    hsj.add_argument("address")
+    hsj.set_defaults(fn=cmd_server_join)
+    hsm = sub.add_parser("server-members")
+    hsm.set_defaults(fn=cmd_server_members)
+    hsfl = sub.add_parser("server-force-leave")
+    hsfl.add_argument("name")
+    hsfl.set_defaults(fn=cmd_server_force_leave)
 
     version = sub.add_parser("version")
     version.set_defaults(fn=cmd_version)

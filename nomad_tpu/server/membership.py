@@ -143,6 +143,29 @@ class Gossip:
         with self._lock:
             return [m.record() for m in self.members.values()]
 
+    def force_leave(self, name: str) -> None:
+        """Operator eviction of a failed member (serf ForceLeave —
+        reference `server force-leave`): mark LEFT locally and gossip
+        it so peers stop probing the corpse."""
+        with self._lock:
+            member = self.members.get(name)
+            if member is None:
+                return
+            member.incarnation += 1
+            member.status = LEFT
+            records = [member.record()]
+        # the originating node fires the same member-leave event its
+        # peers will fire from _merge
+        self._emit("member-leave", member)
+        for peer in self._alive_peers():
+            try:
+                self.transport.rpc(
+                    self.addr, peer.addr, "gossip_ping",
+                    {"from": self.name, "updates": records},
+                )
+            except TransportError:
+                pass
+
     def alive_members(self) -> List[Member]:
         with self._lock:
             return [

@@ -85,6 +85,68 @@ class _PlanRecorder:
         self.evals.append(ev)
 
 
+class Keyring:
+    """Gossip encryption keyring (reference serf KeyManager backing
+    `operator keyring`): a set of installed base64 keys with one
+    primary.  Transport encryption itself rides mTLS in this build
+    (raft/tcp.py), so the keyring manages identities/rotation state.
+
+    Scope deviation: ops apply to the ADDRESSED agent only — the
+    reference broadcasts key changes through serf; here each server's
+    keyring is local state, so rotation tooling must address every
+    server (mTLS certs, not these keys, are what gates transport)."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._keys: list = []
+        self._primary: str = ""
+
+    @staticmethod
+    def _validate(key: str) -> str:
+        import base64 as _b64
+
+        try:
+            raw = _b64.b64decode(key, validate=True)
+        except Exception:
+            raise ValueError("key must be base64")
+        if len(raw) not in (16, 24, 32):
+            raise ValueError("key must decode to 16, 24 or 32 bytes")
+        return key
+
+    def install(self, key: str) -> None:
+        key = self._validate(key)
+        with self._lock:
+            if key not in self._keys:
+                self._keys.append(key)
+            if not self._primary:
+                self._primary = key
+
+    def use(self, key: str) -> None:
+        with self._lock:
+            if key not in self._keys:
+                raise ValueError("key is not installed")
+            self._primary = key
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            if key == self._primary:
+                raise ValueError("cannot remove the primary key")
+            if key not in self._keys:
+                raise ValueError("key is not installed")
+            self._keys.remove(key)
+
+    def list(self) -> dict:
+        with self._lock:
+            return {
+                "Keys": {k: 1 for k in self._keys},
+                "PrimaryKeys": (
+                    {self._primary: 1} if self._primary else {}
+                ),
+            }
+
+
 class Server:
     def __init__(
         self,
@@ -143,6 +205,9 @@ class Server:
         from ..monitor import LogMonitor
 
         self.log_monitor = LogMonitor().install("nomad_tpu")
+        # gossip encryption keyring (reference serf keyring backing
+        # `operator keyring` / `keyring`: install/use/remove/list)
+        self.keyring = Keyring()
         from .timetable import TimeTable
 
         self.timetable = TimeTable()

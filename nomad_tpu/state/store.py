@@ -580,6 +580,27 @@ class StateStore:
             vol.modify_index = self._index + 1
             return self._bump("csi_volumes")
 
+    def detach_csi_volume(
+        self, namespace: str, volume_id: str, node_id: str
+    ) -> int:
+        """Drop every claim a node holds on one volume (reference
+        csi_endpoint.go Unpublish backing `volume detach`).  Returns
+        the number of claims released."""
+        with self._lock:
+            vol = self.csi_volumes.get((namespace, volume_id))
+            if vol is None:
+                raise KeyError(f"volume {volume_id!r} not found")
+            released = 0
+            for claims in (vol.read_claims, vol.write_claims):
+                for alloc_id, claim_node in list(claims.items()):
+                    if claim_node == node_id:
+                        del claims[alloc_id]
+                        released += 1
+            if released:
+                vol.modify_index = self._index + 1
+                self._bump("csi_volumes")
+            return released
+
     def release_csi_claims_for_alloc(self, alloc_id: str) -> Optional[int]:
         """Drop every claim held by one alloc (the volume watcher's
         write path, reference volumewatcher/volumes_watcher.go)."""

