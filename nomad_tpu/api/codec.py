@@ -466,6 +466,65 @@ def _update_strategy(raw) -> UpdateStrategy:
     )
 
 
+def node_from_dict(raw: Dict) -> "Node":
+    """Inbound node registration payload -> Node (reference
+    api/nodes.go shapes; accepts both snake_case and CamelCase)."""
+    from ..structs import (
+        Node,
+        NodeReservedResources,
+        NodeResources,
+        compute_node_class,
+    )
+
+    res_raw = _get(raw, "node_resources", "NodeResources",
+                   default={}) or {}
+    reserved_raw = _get(
+        raw, "reserved_resources", "ReservedResources", default={}
+    ) or {}
+    node = Node(
+        id=_get(raw, "id", "ID", default=""),
+        name=_get(raw, "name", "Name", default=""),
+        datacenter=_get(
+            raw, "datacenter", "Datacenter", default="dc1"
+        ),
+        node_class=_get(raw, "node_class", "NodeClass", default=""),
+        attributes=_get(
+            raw, "attributes", "Attributes", default={}
+        ) or {},
+        drivers={
+            k: bool(v)
+            for k, v in (
+                _get(raw, "drivers", "Drivers", default={}) or {}
+            ).items()
+        },
+        node_resources=NodeResources(
+            cpu=int(_get(res_raw, "cpu", "Cpu", "CPU", default=0)),
+            memory_mb=int(
+                _get(res_raw, "memory_mb", "MemoryMB", default=0)
+            ),
+            disk_mb=int(
+                _get(res_raw, "disk_mb", "DiskMB", default=0)
+            ),
+        ),
+        reserved_resources=NodeReservedResources(
+            cpu=int(
+                _get(reserved_raw, "cpu", "Cpu", "CPU", default=0)
+            ),
+            memory_mb=int(
+                _get(
+                    reserved_raw, "memory_mb", "MemoryMB", default=0
+                )
+            ),
+            disk_mb=int(
+                _get(reserved_raw, "disk_mb", "DiskMB", default=0)
+            ),
+        ),
+        status=_get(raw, "status", "Status", default="ready"),
+    )
+    node.computed_class = compute_node_class(node)
+    return node
+
+
 def job_from_dict(raw: Dict) -> Job:
     job = Job(
         id=_get(raw, "id", "ID", default=""),

@@ -770,6 +770,59 @@ class APIHandler(BaseHTTPRequestHandler):
             )
             return True
 
+        if path == "/v1/node/register" and method in ("POST", "PUT"):
+            # remote node registration (reference Node.Register RPC;
+            # lets client agents attach to a networked cluster over
+            # the HTTP surface — forwarding routes it to the leader)
+            self._check_acl("node:write")
+            from .codec import node_from_dict
+
+            node = node_from_dict(
+                self._body().get("Node") or self._body()
+            )
+            if not node.id:
+                raise HTTPError(400, "missing node id")
+            srv.register_node(node)
+            self._respond(
+                {"HeartbeatTTL": getattr(srv, "heartbeat_ttl", 0)}
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/node/([^/]+)/heartbeat", path)
+        if m and method in ("POST", "PUT"):
+            # (reference Node.UpdateStatus keepalive)
+            self._check_acl("node:write")
+            try:
+                srv.heartbeat(m.group(1))
+            except KeyError as exc:
+                raise HTTPError(404, str(exc))
+            self._respond({})
+            return True
+
+        m = re.fullmatch(r"/v1/node/([^/]+)/allocs", path)
+        if m and method in ("POST", "PUT"):
+            # client pushes alloc status transitions (reference
+            # Node.UpdateAlloc)
+            self._check_acl("node:write")
+            body = self._body()
+            updates = []
+            for raw in body.get("Allocs") or []:
+                alloc = store.alloc_by_id(
+                    raw.get("ID") or raw.get("id", "")
+                )
+                if alloc is None:
+                    continue
+                status = raw.get("ClientStatus") or raw.get(
+                    "client_status"
+                )
+                if status:
+                    alloc.client_status = status
+                updates.append(alloc)
+            if updates:
+                srv.update_allocs_from_client(updates)
+            self._respond({"Updated": len(updates)})
+            return True
+
         m = re.fullmatch(r"/v1/node/([^/]+)/drain", path)
         if m and method in ("POST", "PUT"):
             self._check_acl("node:write")
