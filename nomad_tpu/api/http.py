@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+from dataclasses import replace as dc_replace
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -815,8 +816,13 @@ class APIHandler(BaseHTTPRequestHandler):
                 status = raw.get("ClientStatus") or raw.get(
                     "client_status"
                 )
+                # Never mutate the store's canonical object: the upsert
+                # computes was_live from the *existing* entry, so an
+                # in-place status write would make a live->terminal
+                # transition invisible (node usage keeps counting the
+                # dead alloc). Send a copy carrying the new status.
                 if status:
-                    alloc.client_status = status
+                    alloc = dc_replace(alloc, client_status=status)
                 updates.append(alloc)
             if updates:
                 srv.update_allocs_from_client(updates)
@@ -1776,8 +1782,9 @@ class APIHandler(BaseHTTPRequestHandler):
             # token scoped to one namespace must not learn the
             # names/descriptions of the others; management sees all
             acls = getattr(srv, "acls", None)
+            token_raw = self.headers.get("X-Nomad-Token", "")
             acl = (
-                acls.resolve(self.headers.get("X-Nomad-Token", ""))
+                acls.resolve(token_raw)
                 if acls is not None and acls.enabled
                 else None
             )
@@ -1796,7 +1803,15 @@ class APIHandler(BaseHTTPRequestHandler):
                 n for n in store.iter_namespaces()
                 if ns_visible(n.name)
             ]
-            if acls is not None and acls.enabled and not visible:
+            # A *resolved* token with zero visible namespaces gets [],
+            # not 403 (reference ListNamespaces only denies anonymous/
+            # invalid tokens) — narrowly-scoped automation must not see
+            # an error where an empty list is the honest answer.
+            if (
+                acls is not None
+                and acls.enabled
+                and (not token_raw or acl is None)
+            ):
                 raise HTTPError(403, "Permission denied")
             self._respond(
                 [

@@ -211,6 +211,15 @@ class Client:
                     continue
                 if alloc_id in self.alloc_runners:
                     continue
+                # detach from the store's canonical object (shared in
+                # single-binary mode): the runner writes client_status
+                # and task_states in place, and an in-place
+                # live->terminal write would defeat the upsert's
+                # was_live bookkeeping — the node would never free the
+                # completed alloc's capacity
+                alloc = _replace(
+                    alloc, task_states=dict(alloc.task_states)
+                )
                 if alloc.job is None:
                     alloc.job = self.server.store.job_by_id(
                         alloc.namespace, alloc.job_id

@@ -25,6 +25,16 @@ from .base import (
 )
 
 
+def _dur(value, default: float = 0.0) -> float:
+    """Duration config values arrive as numbers from test code but as
+    Go-style strings ("100ms", "10s", "1m") from HCL jobspecs — the
+    reference's mock driver declares them time.Duration
+    (drivers/mock/driver.go:101).  Delegates to the canonical parser."""
+    from ...config import _duration_s
+
+    return _duration_s(value, default)
+
+
 class MockDriver(DriverPlugin):
     name = "mock_driver"
 
@@ -35,7 +45,7 @@ class MockDriver(DriverPlugin):
     def start_task(self, cfg: TaskConfig) -> DriverHandle:
         conf = cfg.config
         if conf.get("start_block_for"):
-            time.sleep(float(conf["start_block_for"]))
+            time.sleep(_dur(conf["start_block_for"]))
         if conf.get("start_error"):
             if conf.get("start_error_recoverable"):
                 raise RecoverableError(conf["start_error"])
@@ -43,7 +53,7 @@ class MockDriver(DriverPlugin):
 
         handle = DriverHandle(cfg.id)
         self.handles[cfg.id] = handle
-        run_for = float(conf.get("run_for", 0))
+        run_for = _dur(conf.get("run_for"), 0.0)
         exit_code = int(conf.get("exit_code", 0))
         exit_signal = int(conf.get("exit_signal", 0))
 

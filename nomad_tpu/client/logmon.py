@@ -232,10 +232,35 @@ def follow_task_log(
             and n[len(rot_prefix):].isdigit()
         ]
     except OSError:
+        # a transient listdir failure (EACCES/ENFILE/NFS blip) is NOT
+        # "the rotated files vanished": converting an established
+        # cursor to the flat layout here would replay retained bytes
+        # once the directory reappears — hold position and retry
+        if cursor is not None:
+            return b"", cursor
         names = []
     if not names:
-        # flat legacy layout
-        offset = cursor[1] if cursor and cursor[0] == -1 else 0
+        # flat legacy layout.  A follower holding an established
+        # ROTATION cursor that lands here means the rotated files
+        # vanished mid-follow (task GC / restart) — restarting the
+        # flat file at offset 0 would replay bytes the consumer
+        # already saw, so resume at its current end instead.
+        if cursor and cursor[0] == -1:
+            offset = cursor[1]
+        elif cursor and cursor[0] >= 0:
+            # only migrate to the flat layout when a flat file actually
+            # exists; in the transient window where BOTH are gone, hold
+            # the rotation cursor unchanged — degrading to (-1, 0) here
+            # would replay a later-recreated rotation file from scratch
+            try:
+                offset = os.path.getsize(flat_path) if flat_path else None
+            except OSError:
+                offset = None
+            if offset is None:
+                return b"", cursor
+            return b"", (-1, offset)
+        else:
+            offset = 0
         if not flat_path:
             return b"", (-1, offset)
         try:
@@ -247,11 +272,29 @@ def follow_task_log(
         return data, (-1, offset + len(data))
 
     indexes = sorted(int(n[len(rot_prefix):]) for n in names)
+    if (
+        cursor is not None
+        and cursor[0] >= 0
+        and cursor[0] not in indexes
+        and indexes[-1] < cursor[0]
+    ):
+        # the retained indexes RESTARTED below an established cursor
+        # (restart recreated index 0 after GC): the follower can't
+        # distinguish a recreated index from one it already streamed,
+        # so replaying from the oldest retained file risks duplicate
+        # bytes — resume at the newest file's end and follow forward
+        path = os.path.join(log_dir, f"{rot_prefix}{indexes[-1]}")
+        try:
+            end = os.path.getsize(path)
+        except OSError:
+            end = 0
+        return b"", (indexes[-1], end)
     if cursor is None or cursor[0] == -1 or cursor[0] not in indexes:
         # start at the beginning of the oldest retained file; for an
-        # established cursor whose file was pruned this is still
-        # duplicate-free — retention only drops OLD files, so every
-        # retained index is strictly newer than anything already read
+        # established cursor whose file was pruned (indexes advanced
+        # PAST it) this is still duplicate-free — retention only drops
+        # OLD files, so every retained index is strictly newer than
+        # anything already read
         cursor = (indexes[0], 0)
     idx, offset = cursor
     out = b""

@@ -88,12 +88,23 @@ class AgentConfig:
 
 
 def _duration_s(value, default: float) -> float:
+    """Canonical Go-style duration parser ("1h30m", "10s", "100ms",
+    bare numbers).  The single shared implementation — jobspec and the
+    mock driver import this one; keeping copies in sync is how the
+    '100ms parses as 100 minutes' alternation bug happened."""
     if value is None:
         return default
     if isinstance(value, (int, float)):
         return float(value)
+    s = str(value).strip()
+    try:
+        return float(s)
+    except ValueError:
+        pass
     total = 0.0
-    for num, unit in re.findall(r"([\d.]+)(h|m|s|ms)", str(value)):
+    # 'ms' must precede 'm' in the alternation or "100ms" reads as
+    # 100 minutes
+    for num, unit in re.findall(r"(-?[\d.]+)(ms|h|m|s)", s):
         total += float(num) * {"h": 3600, "m": 60, "s": 1, "ms": 0.001}[
             unit
         ]

@@ -290,16 +290,12 @@ def _network_dicts(body: Dict) -> List[Dict]:
 
 
 def _duration_s(value, default: float) -> float:
-    """Parse 30, "30s", "5m", "1h30m"."""
-    if value is None:
-        return default
-    if isinstance(value, (int, float)):
-        return float(value)
-    total = 0.0
-    for num, unit in re.findall(r"([\d.]+)(h|m|s|ms)", str(value)):
-        mult = {"h": 3600, "m": 60, "s": 1, "ms": 0.001}[unit]
-        total += float(num) * mult
-    return total if total else default
+    """Parse 30, "30s", "5m", "1h30m" — delegates to the canonical
+    parser in config.py (single implementation; the old copy here had
+    the 'ms'-after-'m' alternation bug)."""
+    from .config import _duration_s as _parse
+
+    return _parse(value, default)
 
 
 def _task_dict(body: Dict) -> Dict:

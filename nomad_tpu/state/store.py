@@ -707,7 +707,10 @@ class StateStore:
             if alloc.eval_id:
                 self._allocs_by_eval[alloc.eval_id].add(alloc.id)
             is_live = not alloc.terminal_status()
-            if was_live != is_live or existing is None:
+            # existing is alloc: an aliasing caller mutated the stored
+            # object in place, so was_live is unknowable — recompute
+            # usage unconditionally rather than miss a live->terminal
+            if was_live != is_live or existing is None or existing is alloc:
                 self.node_table.update_node_usage(
                     alloc.node_id, self._live_usage_for_node(alloc.node_id)
                 )

@@ -185,3 +185,21 @@ def test_namespace_list_filtered_by_token_scope(acl_api):
     with pytest.raises(urllib.error.HTTPError) as exc:
         _req(base, "GET", "/v1/namespaces")
     assert exc.value.code == 403
+
+    # a VALID token whose policies grant no namespace capability gets
+    # an empty list, not 403 (reference ListNamespaces filters; only
+    # anonymous/invalid tokens are denied) — ADVICE r4
+    _req(
+        base, "POST", "/v1/acl/policy/node-only",
+        {"Rules": {"node": "read"}},
+        token=mgmt,
+    )
+    tok2 = _req(
+        base, "POST", "/v1/acl/tokens",
+        {"Name": "nodescope", "Policies": ["node-only"]},
+        token=mgmt,
+    )
+    assert (
+        _req(base, "GET", "/v1/namespaces", token=tok2["SecretID"])
+        == []
+    )

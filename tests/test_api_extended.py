@@ -635,3 +635,44 @@ def test_cli_long_tail_commands(api, monkeypatch, capsys):
         with _pytest.raises(SystemExit):
             main(argv)
         assert "Enterprise" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# client alloc-status push (ADVICE r4: no in-place store mutation)
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_status_push_frees_node_usage(api):
+    """POST /v1/node/<id>/allocs with a terminal ClientStatus must
+    release the alloc's cpu/mem from the serving server's node table:
+    the handler sends a COPY through the upsert so was_live is computed
+    against the pre-update store object (ADVICE r4 high)."""
+    server, base = api
+    node = mock.node()
+    server.register_node(node)
+    job = mock.job(id="pushjob")
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    alloc = server.store.allocs_by_job("default", "pushjob")[0]
+    row = server.store.node_table.row_of[alloc.node_id]
+    assert server.store.node_table.cpu_used[row] > 0
+
+    # mark running first (live -> live: usage unchanged)
+    _post(
+        base,
+        f"/v1/node/{alloc.node_id}/allocs",
+        {"Allocs": [{"ID": alloc.id, "ClientStatus": "running"}]},
+    )
+    assert server.store.node_table.cpu_used[row] > 0
+
+    # live -> terminal: usage must drop to zero on THIS server
+    _post(
+        base,
+        f"/v1/node/{alloc.node_id}/allocs",
+        {"Allocs": [{"ID": alloc.id, "ClientStatus": "complete"}]},
+    )
+    assert server.store.node_table.cpu_used[row] == 0
+    assert (
+        server.store.alloc_by_id(alloc.id).client_status == "complete"
+    )

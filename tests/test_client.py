@@ -253,6 +253,16 @@ def test_client_batch_job_completes(cluster):
         ),
         timeout=10,
     )
+    # natural completion must free the node's tracked capacity: the
+    # runner works on a DETACHED copy, so the upsert sees the
+    # live->terminal flip and zeroes usage (ADVICE r4 / review r5 —
+    # in-process aliasing defeated was_live before)
+    alloc = server.store.allocs_by_job(job.namespace, job.id)[0]
+    row = server.store.node_table.row_of[alloc.node_id]
+    assert wait_until(
+        lambda: server.store.node_table.cpu_used[row] == 0,
+        timeout=10,
+    )
 
 
 def test_client_failed_alloc_reschedules(cluster):

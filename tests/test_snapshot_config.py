@@ -123,3 +123,53 @@ def test_load_json_config(tmp_path):
     assert cfg.server.num_schedulers == 8
     assert cfg.http.port == 7000
     assert cfg.client.enabled is False
+
+
+def test_snapshot_restore_rebuilds_port_and_device_indexes(tmp_path):
+    """install_payload must clear + rebuild the derived static-port
+    occupancy indexes (_ports_live/_ports_by_node) and the node
+    table's device_used: phantom pre-restore entries would skew the
+    batch kernel's port_used0 columns and silently change winners vs
+    the serial walk (ADVICE r4 medium)."""
+    from nomad_tpu.structs import NetworkResource, Port
+
+    def static_job(jid):
+        job = mock.job(id=jid)
+        job.task_groups[0].count = 1
+        job.task_groups[0].networks = [
+            NetworkResource(reserved_ports=[Port("svc", 8080)])
+        ]
+        return job
+
+    src = Server(num_schedulers=1, seed=3)
+    src.start()
+    try:
+        src.register_node(mock.node())
+        src.register_job(static_job("portjob"))
+        assert src.drain_to_idle(10)
+        assert src.store._ports_live.get(8080)
+        path = str(tmp_path / "state.snap")
+        save_snapshot(src, path)
+    finally:
+        src.stop()
+
+    # dst carries PRE-restore state holding a DIFFERENT static port:
+    # a phantom that must not survive the restore
+    dst = Server(num_schedulers=1, seed=3)
+    dst.start()
+    try:
+        dst.register_node(mock.node())
+        dst.register_job(static_job("phantom"))
+        assert dst.drain_to_idle(10)
+        phantom_nodes = set(dst.store._ports_live.get(8080, ()))
+        assert phantom_nodes
+        restore_snapshot(dst, path)
+        live = dst.store._ports_live.get(8080, {})
+        # the snapshot's occupancy is present...
+        assert live
+        # ...and the pre-restore phantom node is gone
+        assert not (set(live) & phantom_nodes)
+        # _ports_by_node only references restored nodes
+        assert set(dst.store._ports_by_node) <= set(dst.store.nodes)
+    finally:
+        dst.stop()

@@ -223,3 +223,53 @@ def test_follow_task_log_bounded_steps_and_rotation(tmp_path):
         log_dir, "main", "stdout", cursor
     )
     assert data == b"fresh"
+
+
+def test_follow_task_log_rotation_restart_no_duplicates(tmp_path):
+    """When the retained rotation indexes RESTART below an established
+    cursor (task restart recreated index 0 after GC), the follower
+    resumes at the newest file's end instead of replaying from the
+    oldest retained file — the consumer must never see bytes twice
+    (ADVICE r4)."""
+    from nomad_tpu.client.logmon import follow_task_log
+
+    log_dir = str(tmp_path)
+    with open(tmp_path / "main.stdout.5", "wb") as f:
+        f.write(b"old-generation")
+    data, cursor = follow_task_log(log_dir, "main", "stdout", None)
+    assert data == b"old-generation"
+    assert cursor[0] == 5
+    # restart: old files GCed, a fresh index 0 appears with content
+    # the follower can't distinguish from already-streamed bytes
+    (tmp_path / "main.stdout.5").unlink()
+    # transient window where rotation files AND flat file are both
+    # gone: the cursor must hold position, not degrade to (-1, 0)
+    data, held = follow_task_log(log_dir, "main", "stdout", cursor)
+    assert data == b"" and held == cursor
+    with open(tmp_path / "main.stdout.0", "wb") as f:
+        f.write(b"maybe-already-seen")
+    data, cursor = follow_task_log(log_dir, "main", "stdout", cursor)
+    assert data == b""  # no replay
+    assert cursor == (0, len(b"maybe-already-seen"))
+    # bytes appended AFTER the resync do stream
+    with open(tmp_path / "main.stdout.0", "ab") as f:
+        f.write(b"+new")
+    data, cursor = follow_task_log(log_dir, "main", "stdout", cursor)
+    assert data == b"+new"
+
+    # rotation files vanishing entirely mid-follow (flat fallback):
+    # an established rotation cursor resumes at the flat file's end
+    (tmp_path / "main.stdout.0").unlink()
+    flat = tmp_path / "main.stdout"
+    flat.write_bytes(b"flat-history")
+    data, cursor = follow_task_log(
+        log_dir, "main", "stdout", cursor, flat_path=str(flat)
+    )
+    assert data == b""
+    assert cursor == (-1, len(b"flat-history"))
+    with flat.open("ab") as f:
+        f.write(b"!tail")
+    data, cursor = follow_task_log(
+        log_dir, "main", "stdout", cursor, flat_path=str(flat)
+    )
+    assert data == b"!tail"

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-long TPU recovery loop (VERDICT r4 item #1): the tunnel session
+# has been wedged since round 3; stale sessions expire on their own
+# schedule, so a single 600s preflight at bench time keeps missing the
+# window.  This loop retries a bounded bench attempt periodically for
+# the whole round, logs every attempt, and stops on the first success.
+#
+# Single-process discipline: each attempt runs bench.py which takes the
+# cross-process flock (nomad_tpu/device_lock.py) before backend init,
+# so an attempt can never overlap the driver's end-of-round bench run.
+set -u
+cd /root/repo
+LOG=bench_attempts_r05.log
+OUT=BENCH_r05_attempt.json
+SLEEP_S=${TPU_RETRY_SLEEP_S:-1500}
+PREFLIGHT_S=${TPU_RETRY_PREFLIGHT_S:-240}
+n=0
+while true; do
+  n=$((n + 1))
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  echo "[$ts] attempt $n: starting (preflight ${PREFLIGHT_S}s)" >> "$LOG"
+  BENCH_PREFLIGHT_S=$PREFLIGHT_S NOMAD_TPU_DEVICE_LOCK_WAIT=120 \
+    timeout 3600 python bench.py > /tmp/bench_try.out 2> /tmp/bench_try.err
+  rc=$?
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  tail_line=$(tail -n 1 /tmp/bench_try.err 2>/dev/null)
+  echo "[$ts] attempt $n: rc=$rc ${tail_line}" >> "$LOG"
+  if [ $rc -eq 0 ]; then
+    cp /tmp/bench_try.out "$OUT"
+    echo "[$ts] attempt $n: SUCCESS — result saved to $OUT" >> "$LOG"
+    exit 0
+  fi
+  sleep "$SLEEP_S"
+done
