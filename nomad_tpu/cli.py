@@ -61,6 +61,84 @@ def _request(
         sys.exit(1)
 
 
+class _TmplItem(dict):
+    """Mapping for -t templates: case-tolerant key lookup plus dotted
+    access inside ``{...}`` fields, so both ``{id}`` and ``{ID}`` hit
+    the same API field regardless of the endpoint's casing."""
+
+    def __missing__(self, key):
+        for k in (key.lower(), key.upper()):
+            if k in self:
+                return self[k]
+        lk = key.lower()
+        for k, v in self.items():
+            if str(k).lower() == lk:
+                return v
+        raise KeyError(key)
+
+    def __getitem__(self, key):
+        v = super().__getitem__(key) if key in self else self.__missing__(key)
+        return _wrap_tmpl(v)
+
+
+def _wrap_tmpl(v):
+    """Keep case-tolerance alive through nested containers: dicts wrap
+    as _TmplItem and lists wrap their dict elements, so
+    ``{TaskGroups[0][name]}`` resolves regardless of casing."""
+    if isinstance(v, dict):
+        return _TmplItem(v)
+    if isinstance(v, list):
+        return [_wrap_tmpl(x) for x in v]
+    return v
+
+
+def _render_template(template: str, item) -> str:
+    if not isinstance(item, dict):
+        return template.format(item)
+    return template.format_map(_TmplItem(item))
+
+
+def _emit(args, data) -> bool:
+    """Shared machine-readable output for status/list/inspect commands
+    (reference command/job_status.go:22-40 -json/-t flags +
+    command/helpers.go Format).  ``-json`` dumps the raw API payload;
+    ``-t`` renders a Python format-string per item (lists render one
+    line per element; ``{id}``/``{ID}`` are case-tolerant, nested
+    fields via ``{resources[cpu]}``).  Returns True when it handled
+    the output (the caller skips its human-readable rendering)."""
+    if getattr(args, "json", False):
+        print(json.dumps(data, indent=2, sort_keys=True, default=str))
+        return True
+    template = getattr(args, "template", None)
+    if template:
+        items = data if isinstance(data, list) else [data]
+        try:
+            for item in items:
+                print(_render_template(template, item))
+        except (KeyError, IndexError) as exc:
+            print(
+                f"Error rendering template: missing field {exc}",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        except (ValueError, TypeError, AttributeError) as exc:
+            # malformed template (unbalanced braces, bad conversion):
+            # a clean one-line error, not a traceback
+            print(
+                f"Error rendering template: {exc}", file=sys.stderr
+            )
+            sys.exit(1)
+        return True
+    return False
+
+
+def _add_fmt(parser) -> None:
+    """Register the -json / -t flags (every status/list/inspect
+    command takes both, mirroring reference-wide support)."""
+    parser.add_argument("-json", action="store_true", dest="json")
+    parser.add_argument("-t", dest="template", default=None)
+
+
 def _table(rows, headers) -> None:
     widths = [
         max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(h)
@@ -225,6 +303,8 @@ def cmd_job_run(args) -> None:
 def cmd_job_status(args) -> None:
     if not args.job_id:
         jobs = _request("GET", "/v1/jobs")
+        if _emit(args, jobs):
+            return
         if not jobs:
             print("No running jobs")
             return
@@ -237,6 +317,8 @@ def cmd_job_status(args) -> None:
         )
         return
     job = _request("GET", f"/v1/job/{args.job_id}")
+    if _emit(args, job):
+        return
     print(f"ID            = {job['id']}")
     print(f"Name          = {job['name']}")
     print(f"Type          = {job['type']}")
@@ -344,6 +426,8 @@ def cmd_alloc_logs(args) -> None:
 
 def cmd_job_history(args) -> None:
     data = _request("GET", f"/v1/job/{args.job_id}/versions")
+    if _emit(args, data.get("Versions", [])):
+        return
     rows = [
         (
             j["version"],
@@ -369,6 +453,8 @@ def cmd_job_revert(args) -> None:
 
 def cmd_job_inspect(args) -> None:
     job = _request("GET", f"/v1/job/{args.job_id}")
+    if _emit(args, job):
+        return
     print(json.dumps(job, indent=2, sort_keys=True))
 
 
@@ -586,6 +672,8 @@ def cmd_monitor(args) -> None:
 def cmd_operator_autopilot(args) -> None:
     if args.action == "get-config":
         cfg = _request("GET", "/v1/operator/autopilot/configuration")
+        if _emit(args, cfg):
+            return
         for k, v in cfg.items():
             print(f"{k} = {v}")
     elif args.action == "set-config":
@@ -600,6 +688,8 @@ def cmd_operator_autopilot(args) -> None:
         print("Configuration updated!")
     elif args.action == "health":
         h = _request("GET", "/v1/operator/autopilot/health")
+        if _emit(args, h):
+            return
         print(
             f"Healthy = {h['Healthy']}  Servers = {h['NumServers']}  "
             f"FailureTolerance = {h['FailureTolerance']}"
@@ -664,6 +754,8 @@ def cmd_operator_raft(args) -> None:
         print(f"==> Removed raft peer {args.address}")
         return
     cfg = _request("GET", "/v1/operator/raft/configuration")
+    if _emit(args, cfg.get("Servers", [])):
+        return
     _table(
         [
             (s["ID"], s["Address"], s["Leader"], s["Voter"])
@@ -676,8 +768,7 @@ def cmd_operator_raft(args) -> None:
 def cmd_job_allocs(args) -> None:
     """(reference command/job_allocs.go)"""
     allocs = _request("GET", f"/v1/job/{args.job_id}/allocations")
-    if getattr(args, "json", False):
-        print(json.dumps(allocs, indent=2))
+    if _emit(args, allocs):
         return
     _table(
         [
@@ -815,9 +906,13 @@ def cmd_volume_status(args) -> None:
     """(reference command/volume_status.go)"""
     if getattr(args, "volume_id", None):
         v = _request("GET", f"/v1/volume/csi/{args.volume_id}")
+        if _emit(args, v):
+            return
         print(json.dumps(v, indent=2))
         return
     vols = _request("GET", "/v1/volumes")
+    if _emit(args, vols):
+        return
     _table(
         [
             (
@@ -844,6 +939,8 @@ def cmd_volume_deregister(args) -> None:
 def cmd_plugin_status(args) -> None:
     """(reference command/plugin_status.go)"""
     plugins = _request("GET", "/v1/plugins")
+    if _emit(args, plugins):
+        return
     _table(
         [
             (p["ID"], f"{p['NodesHealthy']}/{p['NodesExpected']}")
@@ -859,6 +956,8 @@ def cmd_scaling_policies(args) -> None:
     if getattr(args, "job_id", None):
         path += f"?job={args.job_id}"
     pols = _request("GET", path)
+    if _emit(args, pols):
+        return
     _table(
         [
             (
@@ -877,12 +976,16 @@ def cmd_scaling_policies(args) -> None:
 def cmd_scaling_policy_info(args) -> None:
     """(reference command/scaling_policy_info.go)"""
     p = _request("GET", f"/v1/scaling/policy/{args.policy_id}")
+    if _emit(args, p):
+        return
     print(json.dumps(p, indent=2))
 
 
 def cmd_server_members(args) -> None:
     """(reference command/server_members.go)"""
     info = _request("GET", "/v1/agent/members")
+    if _emit(args, info["Members"]):
+        return
     _table(
         [
             (
@@ -901,6 +1004,8 @@ def cmd_server_members(args) -> None:
 def cmd_node_status(args) -> None:
     if not args.node_id:
         nodes = _request("GET", "/v1/nodes")
+        if _emit(args, nodes):
+            return
         _table(
             [
                 (
@@ -916,6 +1021,8 @@ def cmd_node_status(args) -> None:
         )
         return
     node = _request("GET", f"/v1/node/{args.node_id}")
+    if _emit(args, node):
+        return
     print(f"ID          = {node['id']}")
     print(f"Name        = {node['name']}")
     print(f"Datacenter  = {node['datacenter']}")
@@ -990,6 +1097,8 @@ def cmd_node_eligibility(args) -> None:
 
 def cmd_alloc_status(args) -> None:
     alloc = _request("GET", f"/v1/allocation/{args.alloc_id}")
+    if _emit(args, alloc):
+        return
     print(f"ID           = {alloc['id']}")
     print(f"Name         = {alloc['name']}")
     print(f"Node ID      = {alloc['node_id']}")
@@ -1003,6 +1112,8 @@ def cmd_alloc_status(args) -> None:
 
 def cmd_eval_status(args) -> None:
     ev = _request("GET", f"/v1/evaluation/{args.eval_id}")
+    if _emit(args, ev):
+        return
     print(f"ID           = {ev['id']}")
     print(f"Type         = {ev['type']}")
     print(f"TriggeredBy  = {ev['triggered_by']}")
@@ -1016,9 +1127,13 @@ def cmd_deployment(args) -> None:
     if args.action == "status":
         if args.id:
             d = _request("GET", f"/v1/deployment/{args.id}")
+            if _emit(args, d):
+                return
             print(json.dumps(d, indent=2))
         else:
             ds = _request("GET", "/v1/deployments")
+            if _emit(args, ds):
+                return
             _table(
                 [
                     (d["id"][:8], d["job_id"][:20], d["status"])
@@ -1028,6 +1143,8 @@ def cmd_deployment(args) -> None:
             )
     elif args.action == "list":
         ds = _request("GET", "/v1/deployments")
+        if _emit(args, ds):
+            return
         _table(
             [(d["id"][:8], d["job_id"][:20], d["status"]) for d in ds],
             ["ID", "Job", "Status"],
@@ -1098,12 +1215,16 @@ def cmd_operator_snapshot(args) -> None:
 def cmd_namespace(args) -> None:
     if args.ns_cmd == "list":
         nss = _request("GET", "/v1/namespaces")
+        if _emit(args, nss):
+            return
         _table(
             [(n["Name"], n["Description"]) for n in nss],
             ["Name", "Description"],
         )
     elif args.ns_cmd in ("status", "inspect"):
         n = _request("GET", f"/v1/namespace/{args.name}")
+        if _emit(args, n):
+            return
         if args.ns_cmd == "inspect":
             print(json.dumps(n, indent=2))
         else:
@@ -1131,14 +1252,14 @@ def cmd_acl(args) -> None:
     if args.acl_cmd == "policy":
         if args.action == "list":
             ps = _request("GET", "/v1/acl/policies")
+            if _emit(args, ps):
+                return
             _table([(p["Name"],) for p in ps], ["Name"])
         elif args.action == "info":
-            print(
-                json.dumps(
-                    _request("GET", f"/v1/acl/policy/{args.name}"),
-                    indent=2,
-                )
-            )
+            p = _request("GET", f"/v1/acl/policy/{args.name}")
+            if _emit(args, p):
+                return
+            print(json.dumps(p, indent=2))
         elif args.action == "apply":
             with open(args.file) as f:
                 rules = json.load(f)
@@ -1151,6 +1272,8 @@ def cmd_acl(args) -> None:
     # token family
     if args.action == "list":
         ts = _request("GET", "/v1/acl/tokens")
+        if _emit(args, ts):
+            return
         _table(
             [
                 (
@@ -1176,14 +1299,15 @@ def cmd_acl(args) -> None:
         print(f"Accessor ID = {resp['AccessorID']}")
         print(f"Secret ID   = {resp['SecretID']}")
     elif args.action == "info":
-        print(
-            json.dumps(
-                _request("GET", f"/v1/acl/token/{args.accessor}"),
-                indent=2,
-            )
-        )
+        t = _request("GET", f"/v1/acl/token/{args.accessor}")
+        if _emit(args, t):
+            return
+        print(json.dumps(t, indent=2))
     elif args.action == "self":
-        print(json.dumps(_request("GET", "/v1/acl/token/self"), indent=2))
+        t = _request("GET", "/v1/acl/token/self")
+        if _emit(args, t):
+            return
+        print(json.dumps(t, indent=2))
     elif args.action == "update":
         body = {}
         if args.name:
@@ -1199,6 +1323,8 @@ def cmd_acl(args) -> None:
 
 def cmd_job_deployments(args) -> None:
     ds = _request("GET", f"/v1/job/{args.job_id}/deployments")
+    if _emit(args, ds):
+        return
     _table(
         [
             (d["id"][:8], d.get("job_version", 0), d["status"])
@@ -1274,6 +1400,8 @@ def cmd_server_join(args) -> None:
 
 def cmd_node_config(args) -> None:
     n = _request("GET", f"/v1/node/{args.node_id}")
+    if _emit(args, n):
+        return
     print(json.dumps(n, indent=2))
 
 
@@ -1338,12 +1466,10 @@ def cmd_system(args) -> None:
 
 def cmd_operator_scheduler(args) -> None:
     if args.action == "get-config":
-        print(
-            json.dumps(
-                _request("GET", "/v1/operator/scheduler/configuration"),
-                indent=2,
-            )
-        )
+        cfg = _request("GET", "/v1/operator/scheduler/configuration")
+        if _emit(args, cfg):
+            return
+        print(json.dumps(cfg, indent=2))
     else:
         cfg = _request("GET", "/v1/operator/scheduler/configuration")
         if args.algorithm:
@@ -1360,7 +1486,10 @@ def cmd_system_gc(args) -> None:
 
 
 def cmd_agent_info(args) -> None:
-    print(json.dumps(_request("GET", "/v1/agent/self"), indent=2))
+    info = _request("GET", "/v1/agent/self")
+    if _emit(args, info):
+        return
+    print(json.dumps(info, indent=2))
 
 
 def cmd_version(args) -> None:
@@ -1412,6 +1541,7 @@ def build_parser() -> argparse.ArgumentParser:
     jd.set_defaults(fn=cmd_job_dispatch)
     js = job_sub.add_parser("status")
     js.add_argument("job_id", nargs="?")
+    _add_fmt(js)
     js.set_defaults(fn=cmd_job_status)
     jst = job_sub.add_parser("stop")
     jst.add_argument("-purge", action="store_true", dest="purge")
@@ -1424,6 +1554,7 @@ def build_parser() -> argparse.ArgumentParser:
     jsc.set_defaults(fn=cmd_job_scale)
     jh = job_sub.add_parser("history")
     jh.add_argument("job_id")
+    _add_fmt(jh)
     jh.set_defaults(fn=cmd_job_history)
     jrev = job_sub.add_parser("revert")
     jrev.add_argument("job_id")
@@ -1431,12 +1562,14 @@ def build_parser() -> argparse.ArgumentParser:
     jrev.set_defaults(fn=cmd_job_revert)
     jin = job_sub.add_parser("inspect")
     jin.add_argument("job_id")
+    _add_fmt(jin)
     jin.set_defaults(fn=cmd_job_inspect)
     jv = job_sub.add_parser("validate")
     jv.add_argument("file")
     jv.set_defaults(fn=cmd_job_validate)
     jdep = job_sub.add_parser("deployments")
     jdep.add_argument("job_id")
+    _add_fmt(jdep)
     jdep.set_defaults(fn=cmd_job_deployments)
     jev = job_sub.add_parser("eval")
     jev.add_argument("job_id")
@@ -1452,7 +1585,7 @@ def build_parser() -> argparse.ArgumentParser:
     jini.add_argument("filename", nargs="?", default="")
     jini.set_defaults(fn=cmd_job_init)
     jal = job_sub.add_parser("allocs")
-    jal.add_argument("-json", action="store_true", dest="json")
+    _add_fmt(jal)
     jal.add_argument("job_id")
     jal.set_defaults(fn=cmd_job_allocs)
 
@@ -1463,6 +1596,7 @@ def build_parser() -> argparse.ArgumentParser:
     vr.set_defaults(fn=cmd_volume_register)
     vs = volume_sub.add_parser("status")
     vs.add_argument("volume_id", nargs="?", default=None)
+    _add_fmt(vs)
     vs.set_defaults(fn=cmd_volume_status)
     vd = volume_sub.add_parser("deregister")
     vd.add_argument("volume_id")
@@ -1476,20 +1610,24 @@ def build_parser() -> argparse.ArgumentParser:
     plugin = sub.add_parser("plugin")
     plugin_sub = plugin.add_subparsers(dest="plugin_cmd", required=True)
     ps = plugin_sub.add_parser("status")
+    _add_fmt(ps)
     ps.set_defaults(fn=cmd_plugin_status)
 
     scaling = sub.add_parser("scaling")
     scaling_sub = scaling.add_subparsers(dest="scaling_cmd", required=True)
     scp = scaling_sub.add_parser("policies")
     scp.add_argument("-job", dest="job_id", default=None)
+    _add_fmt(scp)
     scp.set_defaults(fn=cmd_scaling_policies)
     sci = scaling_sub.add_parser("policy")
     sci.add_argument("policy_id")
+    _add_fmt(sci)
     sci.set_defaults(fn=cmd_scaling_policy_info)
 
     server = sub.add_parser("server")
     server_sub = server.add_subparsers(dest="server_cmd", required=True)
     sm = server_sub.add_parser("members")
+    _add_fmt(sm)
     sm.set_defaults(fn=cmd_server_members)
     sj = server_sub.add_parser("join")
     sj.add_argument("address")
@@ -1502,6 +1640,7 @@ def build_parser() -> argparse.ArgumentParser:
     node_sub = node.add_subparsers(dest="node_cmd", required=True)
     ns = node_sub.add_parser("status")
     ns.add_argument("node_id", nargs="?")
+    _add_fmt(ns)
     ns.set_defaults(fn=cmd_node_status)
     nd = node_sub.add_parser("drain")
     nd_group = nd.add_mutually_exclusive_group(required=True)
@@ -1514,6 +1653,7 @@ def build_parser() -> argparse.ArgumentParser:
     nd.set_defaults(fn=cmd_node_drain)
     nc = node_sub.add_parser("config")
     nc.add_argument("node_id")
+    _add_fmt(nc)
     nc.set_defaults(fn=cmd_node_config)
     ne = node_sub.add_parser("eligibility")
     ne_group = ne.add_mutually_exclusive_group(required=True)
@@ -1526,6 +1666,7 @@ def build_parser() -> argparse.ArgumentParser:
     alloc_sub = alloc.add_subparsers(dest="alloc_cmd", required=True)
     als = alloc_sub.add_parser("status")
     als.add_argument("alloc_id")
+    _add_fmt(als)
     als.set_defaults(fn=cmd_alloc_status)
     all_ = alloc_sub.add_parser("logs")
     all_.add_argument("-stderr", action="store_true", dest="stderr")
@@ -1565,6 +1706,7 @@ def build_parser() -> argparse.ArgumentParser:
     ev_sub = ev.add_subparsers(dest="eval_cmd", required=True)
     evs = ev_sub.add_parser("status")
     evs.add_argument("eval_id")
+    _add_fmt(evs)
     evs.set_defaults(fn=cmd_eval_status)
 
     dep = sub.add_parser("deployment")
@@ -1576,14 +1718,18 @@ def build_parser() -> argparse.ArgumentParser:
         ],
     )
     dep.add_argument("id", nargs="?")
+    _add_fmt(dep)
     dep.set_defaults(fn=cmd_deployment)
 
     nsp = sub.add_parser("namespace")
     nsp_sub = nsp.add_subparsers(dest="ns_cmd", required=True)
     nsl = nsp_sub.add_parser("list")
+    _add_fmt(nsl)
     nsl.set_defaults(fn=cmd_namespace)
     for name in ("status", "inspect", "delete"):
         sp = nsp_sub.add_parser(name)
+        if name != "delete":
+            _add_fmt(sp)
         sp.add_argument("name")
         sp.set_defaults(fn=cmd_namespace)
     nsa = nsp_sub.add_parser("apply")
@@ -1602,9 +1748,12 @@ def build_parser() -> argparse.ArgumentParser:
     app_.add_argument("file")
     app_.set_defaults(fn=cmd_acl)
     apl = aclp_sub.add_parser("list")
+    _add_fmt(apl)
     apl.set_defaults(fn=cmd_acl)
     for name in ("info", "delete"):
         sp = aclp_sub.add_parser(name)
+        if name == "info":
+            _add_fmt(sp)
         sp.add_argument("name")
         sp.set_defaults(fn=cmd_acl)
     aclt = acl_sub.add_parser("token")
@@ -1615,11 +1764,15 @@ def build_parser() -> argparse.ArgumentParser:
     atc.add_argument("-policy", action="append", dest="policy")
     atc.set_defaults(fn=cmd_acl)
     atl = aclt_sub.add_parser("list")
+    _add_fmt(atl)
     atl.set_defaults(fn=cmd_acl)
     ats = aclt_sub.add_parser("self")
+    _add_fmt(ats)
     ats.set_defaults(fn=cmd_acl)
     for name in ("info", "delete"):
         sp = aclt_sub.add_parser(name)
+        if name == "info":
+            _add_fmt(sp)
         sp.add_argument("accessor")
         sp.set_defaults(fn=cmd_acl)
     atu = aclt_sub.add_parser("update")
@@ -1635,6 +1788,7 @@ def build_parser() -> argparse.ArgumentParser:
     osch.add_argument("-algorithm", choices=["binpack", "spread"],
                       default=None)
     osch.add_argument("-tpu", choices=["true", "false"], default=None)
+    _add_fmt(osch)
     osch.set_defaults(fn=cmd_operator_scheduler)
     osnap = op_sub.add_parser("snapshot")
     osnap.add_argument(
@@ -1650,6 +1804,7 @@ def build_parser() -> argparse.ArgumentParser:
         "-cleanup-dead-servers", dest="cleanup_dead_servers",
         choices=["true", "false"], default=None,
     )
+    _add_fmt(oap)
     oap.set_defaults(fn=cmd_operator_autopilot)
     oraft = op_sub.add_parser("raft")
     oraft.add_argument(
@@ -1658,6 +1813,7 @@ def build_parser() -> argparse.ArgumentParser:
     oraft.add_argument(
         "-peer-address", dest="address", default=""
     )
+    _add_fmt(oraft)
     oraft.set_defaults(fn=cmd_operator_raft)
     okg = op_sub.add_parser("keygen")
     okg.set_defaults(fn=cmd_operator_keygen)
@@ -1742,6 +1898,7 @@ def build_parser() -> argparse.ArgumentParser:
     tp.set_defaults(fn=cmd_job_plan)
     tst = sub.add_parser("status")
     tst.add_argument("job_id", nargs="?")
+    _add_fmt(tst)
     tst.set_defaults(fn=cmd_status)
     tstop = sub.add_parser("stop")
     tstop.add_argument("-purge", action="store_true", dest="purge")
@@ -1766,6 +1923,7 @@ def build_parser() -> argparse.ArgumentParser:
     tex.set_defaults(fn=cmd_alloc_exec)
     tin = sub.add_parser("inspect")
     tin.add_argument("job_id")
+    _add_fmt(tin)
     tin.set_defaults(fn=cmd_job_inspect)
     tfs = sub.add_parser("fs")
     tfs.add_argument("-cat", action="store_true", dest="cat")
@@ -1774,12 +1932,14 @@ def build_parser() -> argparse.ArgumentParser:
     tfs.set_defaults(fn=cmd_alloc_fs)
 
     ai = sub.add_parser("agent-info")
+    _add_fmt(ai)
     ai.set_defaults(fn=cmd_agent_info)
 
     # hyphenated legacy aliases (the reference registers both forms,
     # command/commands.go: "node-status", "server-members", ...)
     hns = sub.add_parser("node-status")
     hns.add_argument("node_id", nargs="?")
+    _add_fmt(hns)
     hns.set_defaults(fn=cmd_node_status)
     hnd = sub.add_parser("node-drain")
     hnd_group = hnd.add_mutually_exclusive_group(required=True)
@@ -1799,14 +1959,17 @@ def build_parser() -> argparse.ArgumentParser:
     hnd.set_defaults(fn=cmd_node_drain)
     has = sub.add_parser("alloc-status")
     has.add_argument("alloc_id")
+    _add_fmt(has)
     has.set_defaults(fn=cmd_alloc_status)
     hes = sub.add_parser("eval-status")
     hes.add_argument("eval_id")
+    _add_fmt(hes)
     hes.set_defaults(fn=cmd_eval_status)
     hsj = sub.add_parser("server-join")
     hsj.add_argument("address")
     hsj.set_defaults(fn=cmd_server_join)
     hsm = sub.add_parser("server-members")
+    _add_fmt(hsm)
     hsm.set_defaults(fn=cmd_server_members)
     hsfl = sub.add_parser("server-force-leave")
     hsfl.add_argument("name")
