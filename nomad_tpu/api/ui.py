@@ -30,6 +30,19 @@ UI_HTML = """<!DOCTYPE html>
   .bad { color: #d43a3a; }
   #err { color: #d43a3a; }
   #live { font-size: .75rem; opacity: .6; }
+  .kv { display: grid; grid-template-columns: repeat(4, 1fr);
+        gap: .4rem 1rem; font-size: .85rem; margin: .6rem 0; }
+  .kv .k { opacity: .6; margin-right: .4rem; }
+  .tgsum { margin: .45rem 0; font-size: .85rem; }
+  .bar { display: flex; height: .6rem; border-radius: .3rem;
+         overflow: hidden; background: #8882; margin: .15rem 0;
+         max-width: 32rem; }
+  .seg.running  { background: #2a9d2a; }
+  .seg.starting { background: #7ec97e; }
+  .seg.queued   { background: #c9a227; }
+  .seg.complete { background: #4a7dbd; }
+  .seg.failed   { background: #d43a3a; }
+  .seg.lost     { background: #8a4ad4; }
 </style>
 </head>
 <body>
@@ -135,40 +148,182 @@ function allocsView() {
     document.getElementById("t").innerHTML = allocRows(allocs);
   });
 }
+// ---- job detail (the information of the reference's
+// ui/app/routes/jobs/job: header facts, per-group summary bar,
+// task-group resources, live allocs, deployment health, evals) ------
+function kvGrid(pairs) {
+  return '<div class="kv">' + pairs.map(([k, v]) =>
+    `<div><span class="k">${esc(k)}</span> ${v}</div>`).join("") +
+    "</div>";
+}
+function summaryBar(name, s) {
+  const states = [
+    ["Running", "running"], ["Starting", "starting"],
+    ["Queued", "queued"], ["Complete", "complete"],
+    ["Failed", "failed"], ["Lost", "lost"],
+  ];
+  const total = states.reduce((n, [k]) => n + (s[k] || 0), 0) || 1;
+  const segs = states.map(([k, cls]) => (s[k] || 0) ?
+    `<span class="seg ${cls}" style="width:${100 * s[k] / total}%"
+       title="${k}: ${s[k]}"></span>` : "").join("");
+  const counts = states.filter(([k]) => s[k])
+    .map(([k]) => `${k.toLowerCase()} ${s[k]}`).join(" · ");
+  return `<div class="tgsum"><b>${esc(name)}</b>
+    <div class="bar">${segs}</div>
+    <small>${esc(counts) || "no allocations"}</small></div>`;
+}
 function jobView(id) {
-  view(`<h2>Job ${esc(id)}</h2><pre id="d"></pre>
+  view(`<h2 id="jh">Job ${esc(id)}</h2><div id="facts"></div>
+    <h2>Task group summary</h2><div id="sum"></div>
+    <h2>Task groups</h2><table id="tg"></table>
     <h2>Allocations</h2><table id="a"></table>
-    <h2>Evaluations</h2><table id="e"></table>
-    <h2>Deployments</h2><table id="dep"></table>`);
+    <h2>Deployments</h2><table id="dep"></table>
+    <h2>Evaluations</h2><table id="e"></table>`);
   j(`/v1/job/${id}`).then(job => {
-    document.getElementById("d").textContent =
-      JSON.stringify(job, null, 1).slice(0, 4000);
-  }).catch(() => {});
-  j(`/v1/job/${id}/evaluations`).then(evs => {
-    document.getElementById("e").innerHTML =
-      row(["ID","TriggeredBy","Status"], "th") +
-      evs.map(x => row([code(x.id), esc(x.triggered_by),
-        badge(x.status, ["complete"])])).join("");
-  }).catch(() => {});
-  j(`/v1/job/${id}/deployments`).then(ds => {
-    document.getElementById("dep").innerHTML =
-      row(["ID","Version","Status"], "th") +
-      ds.map(x => row([code(x.id), esc(x.job_version),
-        badge(x.status, ["successful","running"])])).join("");
-  }).catch(() => {});
+    document.getElementById("jh").textContent =
+      `Job ${job.name || job.id}`;
+    document.getElementById("facts").innerHTML = kvGrid([
+      ["ID", `<code>${esc(job.id)}</code>`],
+      ["Status", badge(job.status, ["running", "complete"])],
+      ["Type", esc(job.type)],
+      ["Priority", esc(job.priority)],
+      ["Version", esc(job.version)],
+      ["Namespace", esc(job.namespace)],
+      ["Datacenters", esc((job.datacenters || []).join(", "))],
+      ["Stopped", esc(job.stop ? "yes" : "no")],
+    ]);
+    document.getElementById("tg").innerHTML =
+      row(["Group", "Count", "Tasks", "CPU (MHz)", "Memory (MiB)",
+           "Disk (MiB)"], "th") +
+      (job.task_groups || []).map(g => {
+        const cpu = (g.tasks || []).reduce(
+          (n, t) => n + ((t.resources || {}).cpu || 0), 0);
+        const mem = (g.tasks || []).reduce(
+          (n, t) => n + ((t.resources || {}).memory_mb || 0), 0);
+        const tasks = (g.tasks || [])
+          .map(t => `${esc(t.name)} (${esc(t.driver)})`).join(", ");
+        return row([esc(g.name), esc(g.count), tasks, esc(cpu),
+          esc(mem), esc((g.ephemeral_disk || {}).size_mb || 300)]);
+      }).join("");
+  }).catch(e => {
+    // render into the section itself: #err is cleared by any
+    // concurrently succeeding livePoll, which would hide this
+    document.getElementById("facts").innerHTML =
+      `<span class="bad">${esc(String(e))}</span>`;
+  });
+  // the summary + alloc tables ride blocking queries and stay live
+  livePoll(`/v1/job/${id}/summary`, s => {
+    const groups = s.Summary || s.summary || {};
+    document.getElementById("sum").innerHTML =
+      Object.entries(groups).map(([g, c]) => summaryBar(g, c)).join("")
+      || "<small>no task groups</small>";
+  });
   livePoll(`/v1/job/${id}/allocations`, allocs => {
     document.getElementById("a").innerHTML = allocRows(allocs);
   });
+  livePoll(`/v1/job/${id}/deployments`, ds => {
+    document.getElementById("dep").innerHTML =
+      row(["ID", "Version", "Status", "Group", "Desired", "Placed",
+           "Healthy", "Unhealthy", "Canaries"], "th") +
+      ds.flatMap(d => {
+        const groups = Object.entries(d.task_groups || {});
+        if (!groups.length) {
+          return [row([code(d.id), esc(d.job_version),
+            badge(d.status, ["successful", "running"]),
+            "", "", "", "", "", ""])];
+        }
+        return groups.map(([g, st]) => row([
+          code(d.id), esc(d.job_version),
+          badge(d.status, ["successful", "running"]), esc(g),
+          esc(st.desired_total), esc(st.placed_allocs),
+          esc(st.healthy_allocs), esc(st.unhealthy_allocs),
+          `${(st.placed_canaries || []).length}/${st.desired_canaries}`
+          + (st.promoted ? " promoted" : ""),
+        ]));
+      }).join("");
+  });
+  j(`/v1/job/${id}/evaluations`).then(evs => {
+    document.getElementById("e").innerHTML =
+      row(["ID", "TriggeredBy", "Status"], "th") +
+      evs.map(x => row([code(x.id), esc(x.triggered_by),
+        badge(x.status, ["complete"])])).join("");
+  }).catch(() => {});
+}
+// ---- node detail (the information of the reference's
+// ui/app/routes/clients/client: facts, resource utilization meters,
+// live allocs, attributes, devices, event history) ------------------
+function meter(label, used, total, unit) {
+  const pct = total ? Math.min(100, 100 * used / total) : 0;
+  return `<div class="tgsum"><b>${esc(label)}</b>
+    <div class="bar"><span class="seg running"
+      style="width:${pct}%"></span></div>
+    <small>${esc(Math.round(used))} / ${esc(Math.round(total))} ${
+      esc(unit)} (${Math.round(pct)}%)</small></div>`;
 }
 function nodeView(id) {
-  view(`<h2>Node ${esc(id).slice(0,8)}</h2><pre id="d"></pre>
-    <h2>Allocations</h2><table id="a"></table>`);
+  view(`<h2 id="nh">Node</h2><div id="facts"></div>
+    <h2>Resource utilization</h2><div id="res"></div>
+    <h2>Allocations</h2><table id="a"></table>
+    <h2>Events</h2><table id="ev"></table>
+    <h2>Devices</h2><table id="dv"></table>
+    <h2>Attributes</h2><table id="at"></table>`);
+  let totals = null, lastAllocs = null;
+  const renderMeters = allocs => {
+    if (allocs) lastAllocs = allocs;
+    if (!totals || !lastAllocs) return;
+    let cpu = 0, mem = 0, disk = 0;
+    for (const a of lastAllocs) {
+      if (["complete", "failed", "lost"].includes(a.client_status))
+        continue;
+      for (const t of Object.values(
+          (a.allocated_resources || {}).tasks || {})) {
+        cpu += t.cpu || 0; mem += t.memory_mb || 0;
+      }
+      disk += ((a.allocated_resources || {}).shared || {}).disk_mb
+        || 0;
+    }
+    document.getElementById("res").innerHTML =
+      meter("CPU", cpu, totals.cpu, "MHz") +
+      meter("Memory", mem, totals.memory_mb, "MiB") +
+      meter("Disk", disk, totals.disk_mb, "MiB");
+  };
   j(`/v1/node/${id}`).then(n => {
-    document.getElementById("d").textContent =
-      JSON.stringify(n, null, 1).slice(0, 4000);
-  }).catch(() => {});
+    document.getElementById("nh").textContent = `Node ${n.name}`;
+    document.getElementById("facts").innerHTML = kvGrid([
+      ["ID", `<code>${esc(n.id)}</code>`],
+      ["Status", badge(n.status, ["ready"])],
+      ["Datacenter", esc(n.datacenter)],
+      ["Class", esc(n.node_class || "<none>")],
+      ["Eligibility", esc(n.scheduling_eligibility)],
+      ["Drain", esc(n.drain ? "on" : "off")],
+      ["Host", esc((n.attributes || {})["unique.network.ip-address"]
+        || (n.attributes || {})["unique.hostname"] || "")],
+    ]);
+    totals = n.node_resources || {};
+    document.getElementById("ev").innerHTML =
+      row(["Time", "Subsystem", "Message"], "th") +
+      (n.events || []).slice().reverse().map(e => row([
+        esc(new Date(1000 * (e.timestamp || 0))
+          .toISOString().replace("T", " ").slice(0, 19)),
+        esc(e.subsystem), esc(e.message)])).join("");
+    document.getElementById("dv").innerHTML =
+      row(["Vendor", "Type", "Name", "Instances"], "th") +
+      ((n.node_resources || {}).devices || []).map(d => row([
+        esc(d.vendor), esc(d.type), esc(d.name),
+        esc((d.instance_ids || []).length)])).join("");
+    document.getElementById("at").innerHTML =
+      row(["Attribute", "Value"], "th") +
+      Object.entries(n.attributes || {}).sort()
+        .map(([k, v]) => row([esc(k), `<code>${esc(v)}</code>`]))
+        .join("");
+    renderMeters(null);  // meters from the livePoll's allocs
+  }).catch(e => {
+    document.getElementById("facts").innerHTML =
+      `<span class="bad">${esc(String(e))}</span>`;
+  });
   livePoll(`/v1/node/${id}/allocations`, allocs => {
     document.getElementById("a").innerHTML = allocRows(allocs);
+    renderMeters(allocs);
   });
 }
 function allocView(id) {

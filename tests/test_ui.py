@@ -1,0 +1,156 @@
+"""Web UI detail pages (parity target: the information of the
+reference's ui/app/routes/jobs/job and /clients/client routes,
+rendered from the /v1 API by the built-in single-page app).
+
+DOM-level: parse the served page's skeleton, assert the job/node
+detail views render every section container, and contract-test the
+exact endpoint payload shapes the page's JS consumes — a renamed API
+key breaks these tests, not just the browser.
+"""
+import json
+import re
+import urllib.request
+from html.parser import HTMLParser
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.api.ui import UI_HTML
+from nomad_tpu.server import Server
+from nomad_tpu.structs import Task
+
+
+@pytest.fixture(scope="module")
+def ui_world():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=21)
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    node = mock.node()
+    server.register_node(node)
+    job = mock.job(id="uijob")
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0] = Task(
+        name="web", driver="mock_driver", config={"run_for": -1}
+    )
+    from nomad_tpu.structs import UpdateStrategy
+
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1)
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    yield {"server": server, "base": base, "node_id": node.id}
+    http.stop()
+    server.stop()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        body = resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+    return body, ctype
+
+
+class _IdCollector(HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.ids = set()
+        self.tags = set()
+
+    def handle_starttag(self, tag, attrs):
+        self.tags.add(tag)
+        for k, v in attrs:
+            if k == "id":
+                self.ids.add(v)
+
+
+def test_ui_serves_html_skeleton(ui_world):
+    body, ctype = _get(ui_world["base"], "/ui")
+    assert "text/html" in ctype
+    dom = _IdCollector()
+    dom.feed(body.decode())
+    # top-level app containers
+    assert {"view", "err", "live", "leader"} <= dom.ids
+    assert {"nav", "script", "style"} <= dom.tags
+
+
+def test_job_detail_view_renders_all_sections():
+    """The jobView template must create every section container its
+    renderers write into (facts grid, summary bars, task groups,
+    allocs, deployments, evals)."""
+    m = re.search(r"function jobView\(id\) \{(.+?)\n\}", UI_HTML, re.S)
+    assert m, "jobView missing from UI"
+    body = m.group(1)
+    for section_id in ("facts", "sum", "tg", "a", "dep", "e"):
+        assert f'id="{section_id}"' in body
+    # live sections ride blocking queries, not one-shot fetches
+    for live_path in ("/summary", "/allocations", "/deployments"):
+        assert f"livePoll(`/v1/job/${{id}}{live_path}`" in body
+    # structured rendering, not a JSON dump
+    assert "JSON.stringify" not in body
+    assert "summaryBar" in body and "kvGrid" in body
+
+
+def test_node_detail_view_renders_all_sections():
+    m = re.search(r"function nodeView\(id\) \{(.+?)\n\}", UI_HTML, re.S)
+    assert m, "nodeView missing from UI"
+    body = m.group(1)
+    for section_id in ("facts", "res", "a", "ev", "dv", "at"):
+        assert f'id="{section_id}"' in body
+    assert "livePoll(`/v1/node/${id}/allocations`" in body
+    assert "JSON.stringify" not in body
+    assert "meter(" in body
+
+
+def test_job_endpoints_match_ui_contract(ui_world):
+    """Exact payload keys the jobView JS dereferences."""
+    base = ui_world["base"]
+    job = json.loads(_get(base, "/v1/job/uijob")[0])
+    for key in ("id", "name", "type", "priority", "version",
+                "namespace", "datacenters", "status", "task_groups"):
+        assert key in job, key
+    tg = job["task_groups"][0]
+    assert {"name", "count", "tasks"} <= set(tg)
+    assert {"name", "driver", "resources"} <= set(tg["tasks"][0])
+    assert {"cpu", "memory_mb"} <= set(tg["tasks"][0]["resources"])
+
+    s = json.loads(_get(base, "/v1/job/uijob/summary")[0])
+    assert "Summary" in s
+    counts = s["Summary"]["web"]
+    assert {"Running", "Queued", "Complete", "Failed", "Starting",
+            "Lost"} <= set(counts)
+    # no client attached: placed allocs count as Starting
+    assert counts["Running"] + counts["Starting"] == 2
+
+    allocs = json.loads(_get(base, "/v1/job/uijob/allocations")[0])
+    a = allocs[0]
+    for key in ("id", "job_id", "task_group", "node_id",
+                "desired_status", "client_status",
+                "allocated_resources"):
+        assert key in a, key
+    tasks = a["allocated_resources"]["tasks"]
+    assert all(
+        {"cpu", "memory_mb"} <= set(t) for t in tasks.values()
+    )
+
+    ds = json.loads(_get(base, "/v1/job/uijob/deployments")[0])
+    assert ds, "update-strategy job must produce a deployment"
+    d = ds[0]
+    assert {"id", "job_version", "status", "task_groups"} <= set(d)
+    st = d["task_groups"]["web"]
+    assert {"desired_total", "placed_allocs", "healthy_allocs",
+            "unhealthy_allocs", "desired_canaries",
+            "placed_canaries", "promoted"} <= set(st)
+
+
+def test_node_endpoints_match_ui_contract(ui_world):
+    base, node_id = ui_world["base"], ui_world["node_id"]
+    n = json.loads(_get(base, f"/v1/node/{node_id}")[0])
+    for key in ("id", "name", "datacenter", "status",
+                "scheduling_eligibility", "drain", "attributes",
+                "node_resources", "events"):
+        assert key in n, key
+    assert {"cpu", "memory_mb", "disk_mb"} <= set(n["node_resources"])
+    # registration event is recorded with the fields the UI renders
+    ev = n["events"][0]
+    assert {"message", "subsystem", "timestamp"} <= set(ev)
