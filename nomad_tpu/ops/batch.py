@@ -288,6 +288,8 @@ def _run_picks(
     port_used=None,  # bool[Q, C] node-space occupancy at eval start
     dev_ask=None,  # i32[T, D] (DeviceInputs.ask)
     dev_free=None,  # i32[D, C] node-space free counts at eval start
+    dev_aff=None,  # f[T, C] device-affinity score per node (static)
+    dev_aff_on=None,  # bool[T] ask has device affinities (weight != 0)
 ):
     """Inner pick scan; returns (rows i32[P], final used columns).
 
@@ -338,6 +340,8 @@ def _run_picks(
     devs_on = dev_ask is not None
     if devs_on:
         devs_p0 = jnp.take(dev_free, perm, axis=1)  # (D, C)
+    if dev_aff is not None:
+        dev_aff_p = jnp.take(dev_aff, perm, axis=1)  # (T, C)
     safe_cpu = jnp.where(cpu_total_p > 0, cpu_total_p, 1.0)
     safe_mem = jnp.where(mem_total_p > 0, mem_total_p, 1.0)
 
@@ -479,6 +483,16 @@ def _run_picks(
         has_aff = aff_k != 0.0
         score_sum = score_sum + jnp.where(has_aff, aff_k, 0.0)
         count = count + has_aff.astype(dtype)
+        if dev_aff is not None:
+            # device-affinity match fraction (rank.go:460): appended
+            # for EVERY scored node when the ask carries affinities
+            # with non-zero total weight — even a 0.0 value enters
+            # the mean, unlike the node-affinity component
+            d_on = dev_aff_on[t]
+            score_sum = score_sum + jnp.where(
+                d_on, dev_aff_p[t], 0.0
+            )
+            count = count + d_on.astype(dtype)
         if spread is not None:
             # boost per stanza: ((desired - (used+1)) / desired) * w,
             # -1.0 on the penalty slot (spread.py next()); appended to
@@ -877,6 +891,8 @@ def chained_plan_picks_cols(
     port_used0=None,  # bool[Q, C] occupancy at the chain snapshot
     dev_ask=None,  # i32[E, T, D] device instances asked per group
     dev_free0=None,  # i32[D, C] free instances at the chain snapshot
+    dev_aff=None,  # f[E, T, C] device-affinity score per node
+    dev_aff_on=None,  # bool[E, T]
 ):
     """Serially-equivalent chained planner over shared node columns —
     the BatchWorker's production launch.  Semantics identical to
@@ -895,8 +911,11 @@ def chained_plan_picks_cols(
 
     parts = [batch, nc, wanted]
     pattern = []
+    dev_aff_pair = (
+        (dev_aff, dev_aff_on) if dev_aff is not None else None
+    )
     for x in (coll0, affinity, spread, deltas, pre, port_ask,
-              dev_ask):
+              dev_ask, dev_aff_pair):
         pattern.append(x is not None)
         if x is not None:
             parts.append(x)
@@ -912,6 +931,9 @@ def chained_plan_picks_cols(
         p = next(it) if pattern[4] else None
         pa = next(it) if pattern[5] else None
         da = next(it) if pattern[6] else None
+        daff, daff_on = (
+            next(it) if pattern[7] else (None, None)
+        )
         if p is not None:
             used = (
                 used[0].at[p.rows].add(p.cpu.astype(used[0].dtype)),
@@ -948,28 +970,35 @@ def chained_plan_picks_cols(
             distinct_hosts=b.distinct_hosts,
         )
         if ports_on or devs_on:
-            rows, used_next, _pulls, extras = _run_picks(
+            rows, used_next, pulls, extras = _run_picks(
                 cpu_total, mem_total, disk_total, used, inp, xs[1],
                 n_picks, spread_fit, wanted=xs[2], spread=s,
                 deltas=d, tg=tg_in, port_ask=pa, port_used=ports,
-                dev_ask=da, dev_free=devs,
+                dev_ask=da, dev_free=devs, dev_aff=daff,
+                dev_aff_on=daff_on,
             )
             return (
                 used_next,
                 extras.get("ports"),
                 extras.get("dev"),
-            ), rows
-        rows, used_next, _pulls = _run_picks(
+            ), (rows, pulls)
+        rows, used_next, pulls = _run_picks(
             cpu_total, mem_total, disk_total, used, inp, xs[1],
             n_picks, spread_fit, wanted=xs[2], spread=s, deltas=d,
-            tg=tg_in,
+            tg=tg_in, dev_aff=daff, dev_aff_on=daff_on,
         )
-        return (used_next, None, None), rows
+        return (used_next, None, None), (rows, pulls)
 
     used0 = (used0_cpu, used0_mem, used0_disk)
     carry0 = (used0, port_used0, dev_free0)
-    _final, rows = jax.lax.scan(eval_step, carry0, tuple(parts))
-    return rows
+    _final, (rows, pulls) = jax.lax.scan(
+        eval_step, carry0, tuple(parts)
+    )
+    # pulls[E, P]: source-iterator consumption per pick — the host
+    # reconstructs the sequential walk offset at any pick from the
+    # running sum (preemption-retry passthrough seeds the oracle's
+    # StaticIterator offset with it)
+    return rows, pulls
 
 
 @functools.partial(

@@ -1362,7 +1362,7 @@ def test_batch_pipeline_all_bad_scores_replay_original_order():
             used_cpu, used_mem, used_disk,
             stacked, np.full(E, C, np.int32), P,
             wanted=np.full(E, 4, np.int32),
-        )
+        )[0]
     )
     # picks 2/3: both nodes carry one collision (anti-penalty pushes
     # both below the threshold); the walk must emit them in ORIGINAL
@@ -1480,4 +1480,317 @@ def test_adaptive_cap_respects_operator_ceiling(monkeypatch):
         worker._replay_ewma_ms = 5.0
         assert worker._adaptive_cap() == 8
     finally:
+        bat.stop()
+
+
+def test_batch_pipeline_device_affinities_match_sequential():
+    """Device AFFINITIES run the prescored path (r5): the allocator's
+    matched-weight fraction (reference rank.go:443-461) becomes a
+    static per-node kernel score column, exact because the chain gates
+    guarantee at most one matching group per node.  Jobs preferring
+    big-memory GPUs place bit-identically to the sequential scheduler
+    WITHOUT falling back."""
+    from nomad_tpu.structs import Affinity, NodeDeviceResource, RequestedDevice
+
+    nodes = make_nodes(6, seed=9)
+    big = [mock.nvidia_node() for _ in range(2)]  # memory=11169
+    small = []
+    for _ in range(2):
+        n = mock.node()
+        n.node_resources.devices = [
+            NodeDeviceResource(
+                vendor="nvidia",
+                type="gpu",
+                name="2070",
+                instance_ids=[mock.new_id() for _ in range(4)],
+                attributes={"memory": "8000"},
+            )
+        ]
+        n.computed_class = compute_node_class(n)
+        small.append(n)
+
+    seq = Server(num_schedulers=1, seed=77, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=77, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes + big + small:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        def aff_job(jid, count, weight):
+            job = mock.job(id=jid)
+            tg = job.task_groups[0]
+            tg.count = count
+            tg.tasks[0].resources.cpu = 100
+            tg.tasks[0].resources.devices = [
+                RequestedDevice(
+                    name="gpu",
+                    count=1,
+                    affinities=[
+                        Affinity(
+                            ltarget="${device.attr.memory}",
+                            rtarget="10000",
+                            operand=">=",
+                            weight=weight,
+                        )
+                    ],
+                )
+            ]
+            return job
+
+        jobs = [
+            aff_job("gaff-pos", 3, 75),   # prefers 11169-memory nodes
+            aff_job("gaff-neg", 2, -40),  # avoids them
+            aff_job("gaff-more", 4, 75),  # spills after big fills
+        ]
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(30)
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(60)
+
+        for job in jobs:
+            assert placements(seq, job.id) == placements(
+                bat, job.id
+            ), f"divergence for {job.id}"
+        # sanity: every alloc landed on a GPU-bearing node and the
+        # big-memory nodes got at least one positive-affinity pick
+        # (the affinity is soft — binpack + anti-affinity + the
+        # unlifted walk limit legitimately spread the rest)
+        gpu_ids = {n.id for n in big + small}
+        placed = [
+            a.node_id
+            for a in bat.store.allocs_by_job("default", "gaff-pos")
+            if not a.terminal_status()
+        ]
+        assert placed and set(placed) <= gpu_ids, placed
+        assert set(placed) & {n.id for n in big}, placed
+        worker = bat.workers[0]
+        assert worker.prescored >= 3, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_preemption_retry_matches_sequential():
+    """Preemption retries run from the prescored path (r5): when a
+    prescored pick fails and preemption is enabled, PrescoredStack
+    seeds the inner oracle with the recorded shuffle order and the
+    kernel's walk-offset (pulls) and hands the eval's remainder to it
+    — placements AND preempted-alloc sets must match the sequential
+    scheduler bit for bit, without a full-eval fallback."""
+    from nomad_tpu.structs import (
+        PreemptionConfig,
+        SchedulerConfiguration,
+    )
+
+    def small_node():
+        n = mock.node()
+        n.node_resources.cpu = 2000
+        n.node_resources.memory_mb = 2048
+        n.computed_class = compute_node_class(n)
+        return n
+
+    nodes = [small_node() for _ in range(6)]
+    seq = Server(num_schedulers=1, seed=91, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=91, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for server in (seq, bat):
+            for node in nodes:
+                server.register_node(copy.deepcopy(node))
+            server.store.set_scheduler_config(
+                SchedulerConfiguration(
+                    preemption_config=PreemptionConfig(
+                        service_scheduler_enabled=True
+                    )
+                )
+            )
+
+        # fill the whole fleet with low-priority occupants
+        low = mock.job(id="occ")
+        low.priority = 20
+        low.task_groups[0].count = 6
+        low.task_groups[0].tasks[0].resources.cpu = 1500
+        low.task_groups[0].tasks[0].resources.memory_mb = 1200
+        # then a high-priority job that can only place by preempting
+        high = mock.job(id="vip")
+        high.priority = 80
+        high.task_groups[0].count = 2
+        high.task_groups[0].tasks[0].resources.cpu = 1200
+        high.task_groups[0].tasks[0].resources.memory_mb = 1000
+
+        for server in (seq, bat):
+            server.register_job(copy.deepcopy(low))
+            assert server.drain_to_idle(30)
+            server.register_job(copy.deepcopy(high))
+            assert server.drain_to_idle(30)
+
+        assert placements(seq, "vip") == placements(bat, "vip")
+        assert len(placements(seq, "vip")) == 2
+
+        def preempted(server):
+            return sorted(
+                a.name
+                for a in server.store.allocs_by_job("default", "occ")
+                if a.desired_status == "evict"
+            )
+
+        assert preempted(seq) == preempted(bat)
+        assert preempted(bat)  # something actually got preempted
+
+        worker = bat.workers[0]
+        # the vip eval went through the prescored path and the
+        # preemption PASSTHROUGH engaged (not a full-eval fallback)
+        assert worker.prescored >= 2, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+        assert worker.preempt_passthroughs >= 1
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_preemption_mid_eval_offset():
+    """The passthrough seeds the oracle's rotating walk offset from
+    the kernel's pulls: picks that SUCCEED before the failing one
+    advance the walk, so the preempt retry (and later picks) must
+    start from the same rotation as the sequential run.  One node is
+    left free so pick 1 places normally and pick 2+ preempt."""
+    from nomad_tpu.structs import (
+        PreemptionConfig,
+        SchedulerConfiguration,
+    )
+
+    def small_node():
+        n = mock.node()
+        n.node_resources.cpu = 2000
+        n.node_resources.memory_mb = 2048
+        n.computed_class = compute_node_class(n)
+        return n
+
+    nodes = [small_node() for _ in range(8)]
+    seq = Server(num_schedulers=1, seed=23, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=23, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for server in (seq, bat):
+            for node in nodes:
+                server.register_node(copy.deepcopy(node))
+            server.store.set_scheduler_config(
+                SchedulerConfiguration(
+                    preemption_config=PreemptionConfig(
+                        service_scheduler_enabled=True,
+                        batch_scheduler_enabled=True,
+                    )
+                )
+            )
+
+        # occupants on 7 of 8 nodes (count=7 < fleet): one node stays
+        # free for the vip's first pick
+        low = mock.job(id="occ2")
+        low.priority = 10
+        low.task_groups[0].count = 7
+        low.task_groups[0].tasks[0].resources.cpu = 1500
+        low.task_groups[0].tasks[0].resources.memory_mb = 1200
+        vip = mock.job(id="vip2")
+        vip.priority = 90
+        vip.task_groups[0].count = 3
+        vip.task_groups[0].tasks[0].resources.cpu = 1200
+        vip.task_groups[0].tasks[0].resources.memory_mb = 900
+
+        for server in (seq, bat):
+            server.register_job(copy.deepcopy(low))
+            assert server.drain_to_idle(30)
+            server.register_job(copy.deepcopy(vip))
+            assert server.drain_to_idle(30)
+
+        assert placements(seq, "vip2") == placements(bat, "vip2")
+        assert len(placements(seq, "vip2")) == 3
+
+        def preempted(server):
+            return sorted(
+                a.name
+                for a in server.store.allocs_by_job(
+                    "default", "occ2"
+                )
+                if a.desired_status == "evict"
+            )
+
+        assert preempted(seq) == preempted(bat)
+        assert preempted(bat)
+        assert bat.workers[0].preempt_passthroughs >= 1
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_mixed_group_device_affinity():
+    """A multi-task-group job where only ONE group's device ask has
+    affinities must still prescore (regression: stacking [col, None]
+    raised and demoted the whole flush to the sequential path)."""
+    from nomad_tpu.structs import Affinity, RequestedDevice, TaskGroup, Task, Resources
+
+    nodes = make_nodes(4, seed=3)
+    gpus = [mock.nvidia_node() for _ in range(2)]
+    seq = Server(num_schedulers=1, seed=41, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=41, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes + gpus:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        job = mock.job(id="mixed-aff")
+        g1 = job.task_groups[0]
+        g1.count = 2
+        g1.tasks[0].resources.cpu = 100
+        g1.tasks[0].resources.devices = [
+            RequestedDevice(
+                name="gpu",
+                count=1,
+                affinities=[
+                    Affinity(
+                        ltarget="${device.attr.memory}",
+                        rtarget="10000",
+                        operand=">=",
+                        weight=60,
+                    )
+                ],
+            )
+        ]
+        job.task_groups.append(
+            TaskGroup(
+                name="plain",
+                count=2,
+                tasks=[
+                    Task(
+                        name="p",
+                        driver="mock_driver",
+                        resources=Resources(cpu=100, memory_mb=64),
+                    )
+                ],
+            )
+        )
+        seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(30)
+        bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(30)
+        assert placements(seq, "mixed-aff") == placements(
+            bat, "mixed-aff"
+        )
+        assert len(placements(bat, "mixed-aff")) == 4
+        worker = bat.workers[0]
+        assert worker.errors == 0, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+        assert worker.prescored >= 1
+    finally:
+        seq.stop()
         bat.stop()

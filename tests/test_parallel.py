@@ -242,7 +242,7 @@ def test_sharded_chained_plan_matches_unsharded():
             wanted=wanted, coll0=coll0[:, None],
             affinity=affinity[:, None],
             deltas=deltas, pre=pre,
-        )
+        )[0]
     )
     mesh = make_mesh(8, eval_axis=1)
     run = sharded_chained_plan(mesh, P)
