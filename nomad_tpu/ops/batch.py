@@ -139,6 +139,68 @@ class DeviceInputs(NamedTuple):
     free0: jnp.ndarray  # i32[D, C] free instances at snapshot
 
 
+def spread_contribution(
+    onehot, desired_node, penalty_node, safe_desired,
+    existing, prop, clr, weight, active, even, dtype,
+):
+    """Per-node spread score contribution for one pick — THE single
+    implementation shared by the unsharded step and the sharded
+    (shard_map) planner so the two can never drift (the parity
+    contract between them is bit-identity).  All inputs are in the
+    caller's node layout (permuted or shard-local); `existing/prop/
+    clr` are the replicated (S, V+1) carries; `even` is None when no
+    stanza uses even mode (skips tracing the min/max block).
+
+    Reproduces GetCombinedUseMap incl. the PopulateProposed
+    cleared-decrement quirk and spread.py's boost order (empty use
+    map short-circuits BEFORE the missing-attribute penalty)."""
+    clr_adj = clr - jnp.where((prop > 0) & (clr > 1), 1.0, 0.0)
+    combined = jnp.maximum(0.0, existing + prop - clr_adj)
+    used_node = jnp.einsum("scv,sv->sc", onehot, combined)
+    frac = (desired_node - (used_node + 1.0)) / safe_desired
+    pct_contrib = frac * weight[:, None]
+    pct_full = jnp.where(
+        penalty_node, jnp.asarray(-1.0, dtype), pct_contrib
+    )
+    if even is not None:
+        V1 = combined.shape[-1]
+        value_slot = jnp.arange(V1) < (V1 - 1)
+        present = ((existing + prop) > 0) & value_slot
+        has_map = present.any(axis=-1)
+        big = jnp.asarray(jnp.inf, dtype)
+        min_c = jnp.min(jnp.where(present, combined, big), axis=-1)
+        max_c = jnp.max(jnp.where(present, combined, -big), axis=-1)
+        min_b = min_c[:, None]
+        max_b = max_c[:, None]
+        safe_min = jnp.where(min_b > 0, min_b, 1.0)
+        delta_boost = jnp.where(
+            min_b == 0.0, -1.0, (min_b - used_node) / safe_min
+        )
+        even_val = jnp.where(
+            used_node != min_b,
+            delta_boost,
+            jnp.where(
+                min_b == max_b,
+                -1.0,
+                jnp.where(
+                    min_b == 0.0, 1.0, (max_b - min_b) / safe_min
+                ),
+            ),
+        )
+        even_full = jnp.where(
+            has_map[:, None],
+            jnp.where(
+                penalty_node, jnp.asarray(-1.0, dtype), even_val
+            ),
+            0.0,
+        )
+        contrib = jnp.where(even[:, None], even_full, pct_full)
+    else:
+        contrib = pct_full
+    contrib = jnp.where(active[:, None], contrib, 0.0)
+    return jnp.sum(contrib, axis=0)
+
+
 class StepDeltas(NamedTuple):
     """Per-pick plan mutations for steady-state evals (leading axis E
     when chained).  The sequential path interleaves plan edits with
@@ -495,82 +557,14 @@ def _run_picks(
             count = count + d_on.astype(dtype)
         if spread is not None:
             # boost per stanza: ((desired - (used+1)) / desired) * w,
-            # -1.0 on the penalty slot (spread.py next()); appended to
-            # the score list only when the total is non-zero.  Combined
-            # use reproduces GetCombinedUseMap incl. the
-            # PopulateProposed cleared-decrement quirk.
-            clr_adj = spread_clr - jnp.where(
-                (spread_prop > 0) & (spread_clr > 1), 1.0, 0.0
+            # -1.0 on the penalty slot (spread.py next()); appended
+            # to the score list only when the total is non-zero —
+            # shared implementation with the sharded planner
+            spread_total = spread_contribution(
+                onehot_p, desired_node, penalty_node, safe_desired,
+                spread_existing, spread_prop, spread_clr,
+                spread.weight, spread.active, spread.even, dtype,
             )
-            combined = jnp.maximum(
-                0.0, spread_existing + spread_prop - clr_adj
-            )
-            used_node = jnp.einsum(
-                "scv,sv->sc", onehot_p, combined
-            )
-            frac = (desired_node - (used_node + 1.0)) / safe_desired
-            pct_contrib = frac * spread.weight[:, None]
-            if spread.even is not None:
-                # even mode (spread.py even_spread_score_boost):
-                # map membership is existing∪proposed BEFORE the
-                # cleared subtraction (a value zeroed by cleared stays
-                # in the map; cleared-only values never enter)
-                V1_ = combined.shape[-1]
-                value_slot = (
-                    jnp.arange(V1_) < (V1_ - 1)
-                )  # excl. penalty
-                present = (
-                    (spread_existing + spread_prop) > 0
-                ) & value_slot
-                has_map = present.any(axis=-1)  # (S,)
-                big = jnp.asarray(jnp.inf, dtype)
-                min_c = jnp.min(
-                    jnp.where(present, combined, big), axis=-1
-                )
-                max_c = jnp.max(
-                    jnp.where(present, combined, -big), axis=-1
-                )
-                min_b = min_c[:, None]
-                max_b = max_c[:, None]
-                safe_min = jnp.where(min_b > 0, min_b, 1.0)
-                delta_boost = jnp.where(
-                    min_b == 0.0, -1.0, (min_b - used_node) / safe_min
-                )
-                even_val = jnp.where(
-                    used_node != min_b,
-                    delta_boost,
-                    jnp.where(
-                        min_b == max_b,
-                        -1.0,
-                        jnp.where(
-                            min_b == 0.0,
-                            1.0,
-                            (max_b - min_b) / safe_min,
-                        ),
-                    ),
-                )
-                # an empty use map short-circuits to 0.0 BEFORE the
-                # missing-attribute penalty (spread.py boost order)
-                even_full = jnp.where(
-                    has_map[:, None],
-                    jnp.where(
-                        penalty_node, jnp.asarray(-1.0, dtype), even_val
-                    ),
-                    0.0,
-                )
-            pct_full = jnp.where(
-                penalty_node, jnp.asarray(-1.0, dtype), pct_contrib
-            )
-            if spread.even is not None:
-                contrib = jnp.where(
-                    spread.even[:, None], even_full, pct_full
-                )
-            else:
-                contrib = pct_full
-            contrib = jnp.where(
-                spread.active[:, None], contrib, 0.0
-            )
-            spread_total = jnp.sum(contrib, axis=0)
             has_spread = spread_total != 0.0
             score_sum = score_sum + spread_total
             count = count + has_spread.astype(dtype)

@@ -223,7 +223,9 @@ def _sharded_walk(final_full, feas_full, perm, off, lim, nc,
 
 
 def sharded_chained_plan(mesh: Mesh, n_picks: int,
-                         spread_fit: bool = False):
+                         spread_fit: bool = False,
+                         with_spread: bool = False,
+                         spread_even: bool = False):
     """The production chained planner with REAL node-axis sharding:
     every per-pick quantity that is O(nodes) — fit masks, fitness,
     anti-affinity, penalties, usage scatter — is computed on the
@@ -234,16 +236,26 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
     `chained_plan_picks_cols`: the sharded usage columns carry forward
     through the eval scan.
 
-    Scope: the non-spread production shapes (spread batches use the
-    single-device variant).  Decisions are bit-identical to the
-    unsharded kernel — the walk consumes the same score vector in the
-    same order.
+    Scope: single-group shapes (no ports/devices in the sharded
+    variant).  ``with_spread=True`` adds the in-kernel spread carry
+    (VERDICT r4 #9: spread streams must exercise the multi-chip path):
+    the per-node spread contributions (percent AND even mode) compute
+    on each shard from its own codes slice, the small (S, V+1)
+    proposed/cleared carries stay replicated, and the winner's /
+    evictee's value-slot one-hots reduce over shards with one psum per
+    pick.  Decisions are bit-identical to the unsharded kernel — the
+    walk consumes the same score vector in the same order.
 
     Returns ``run(cpu_total, mem_total, disk_total, used0_cpu,
     used0_mem, used0_disk, feasible[E,C], perm[E,C], asks..., wanted,
     limits, n_candidates, coll0[E,C], deltas, pre) -> rows[E,P]``.
     """
-    from ..ops.batch import PreDeltas, StepDeltas
+    from ..ops.batch import (
+        PreDeltas,
+        SpreadInputs,
+        StepDeltas,
+        spread_contribution,
+    )
     from ..ops.score import NO_NODE
 
     n_dev = mesh.devices.size
@@ -268,6 +280,17 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
         ),
         PreDeltas(rows=P(), cpu=P(), mem=P(), disk=P()),
     )
+    if with_spread:
+        in_specs = in_specs + (
+            SpreadInputs(              # leading axis E
+                codes=P(None, None, "nodes"),  # [E, S, C]
+                desired=P(), used0=P(), proposed0=P(),
+                cleared0=P(), weight=P(), active=P(),
+                # percent-only batches pass even=None (skips tracing
+                # the min/max block, mirroring the unsharded kernel)
+                even=P() if spread_even else None,
+            ),
+        )
 
     @jax.jit
     @functools.partial(
@@ -280,7 +303,9 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
         ask_cpu, ask_mem, ask_disk,
         desired_count, limits, wanted, n_candidates,
         distinct_hosts, coll0_all, affinity_all, deltas, pre,
+        *spread_xs,
     ):
+        spread_all = spread_xs[0] if with_spread else None
         shard = jax.lax.axis_index("nodes")
         shard_size = cpu_total.shape[0]
         lo = shard * shard_size
@@ -299,8 +324,35 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
 
         def eval_step(used, xs):
             (feas_l, perm, a_cpu, a_mem, a_disk, desired, lim, w,
-             nc, dh, coll_l, aff_l, d, p) = xs
+             nc, dh, coll_l, aff_l, d, p) = xs[:14]
+            sp = xs[14] if with_spread else None
             cpu_u, mem_u, disk_u = used
+            if sp is not None:
+                # per-shard static spread state (mirrors the unsharded
+                # kernel's hoisted lookups, on this shard's codes)
+                dtype_s = cpu_total.dtype
+                _S, V1 = sp.desired.shape
+                onehot_l = jax.nn.one_hot(
+                    sp.codes, V1, dtype=dtype_s
+                )  # (S, Cl, V1)
+                desired_node_l = jnp.einsum(
+                    "scv,sv->sc", onehot_l, sp.desired
+                )
+                penalty_node_l = sp.codes == (V1 - 1)
+                safe_desired_l = jnp.where(
+                    desired_node_l != 0, desired_node_l, 1.0
+                )
+                spread_existing = sp.used0.astype(dtype_s)
+
+                def slot_onehot(row, pred):
+                    # the row's value-slot one-hot, reduced over
+                    # shards: the owner contributes, others zero
+                    idx = row - lo
+                    mine = pred & (idx >= 0) & (idx < shard_size)
+                    safe = jnp.clip(idx, 0, shard_size - 1)
+                    oh = onehot_l[:, safe, :]  # (S, V1)
+                    local = jnp.where(mine, oh, 0.0)
+                    return jax.lax.psum(local, "nodes")
             # pre-placement deltas (row space, applied to local shard)
             def apply_pre(colv, vals):
                 out = colv
@@ -318,10 +370,21 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
             disk_u = apply_pre(disk_u, p.disk)
 
             def pick_step(carry, k):
-                cpu_c, mem_c, disk_c, coll_c, pen_c, off, dead = carry
+                if sp is not None:
+                    (cpu_c, mem_c, disk_c, coll_c, pen_c, off,
+                     dead, spread_prop, spread_clr) = carry
+                else:
+                    (cpu_c, mem_c, disk_c, coll_c, pen_c, off,
+                     dead) = carry
+                    spread_prop = spread_clr = None
                 active = (k < w) & ~dead
                 erow = d.evict_rows[k]
                 app = active & (erow >= 0)
+                if sp is not None:
+                    # the evicted alloc's value slot gains one cleared
+                    # use BEFORE this pick scores (propertyset counts
+                    # the staged stop as cleared)
+                    spread_clr = spread_clr + slot_onehot(erow, app)
                 cpu_c = local_scatter(
                     cpu_c, erow, d.evict_cpu[k].astype(dtype), app
                 )
@@ -379,6 +442,22 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
                 has_aff = aff_l != 0.0
                 score_sum = score_sum + jnp.where(has_aff, aff_l, 0.0)
                 count = count + has_aff.astype(dtype)
+                if sp is not None:
+                    # spread boost per stanza on this shard's nodes —
+                    # the (S, V+1) carries are replicated, so the
+                    # combined-use math is collective-free; only the
+                    # winner/evictee one-hots psum (slot_onehot).
+                    # Shared implementation with the unsharded kernel
+                    # (spread_contribution) so the two cannot drift.
+                    spread_total_l = spread_contribution(
+                        onehot_l, desired_node_l, penalty_node_l,
+                        safe_desired_l, spread_existing,
+                        spread_prop, spread_clr, sp.weight,
+                        sp.active, sp.even, dtype,
+                    )
+                    has_spread = spread_total_l != 0.0
+                    score_sum = score_sum + spread_total_l
+                    count = count + has_spread.astype(dtype)
                 final_l = score_sum / count
 
                 # the ONLY cross-shard traffic: the per-node score +
@@ -412,6 +491,14 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
                 off = jnp.mod(
                     off + jnp.where(active, pulls, 0), nc
                 )
+                if sp is not None:
+                    # the placed node's value slot gains one proposed
+                    # use per stanza
+                    spread_prop = spread_prop + slot_onehot(row, ok)
+                    return (
+                        cpu_c, mem_c, disk_c, coll_c, pen_c, off,
+                        dead, spread_prop, spread_clr,
+                    ), row
                 return (
                     cpu_c, mem_c, disk_c, coll_c, pen_c, off, dead
                 ), row
@@ -422,24 +509,26 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
                 jnp.asarray(0, jnp.int32),
                 jnp.asarray(False),
             )
-            (cpu_f, mem_f, disk_f, _c, _p, _o, _d), rows = (
-                jax.lax.scan(
-                    pick_step, carry0,
-                    jnp.arange(n_picks, dtype=jnp.int32),
+            if sp is not None:
+                carry0 = carry0 + (
+                    sp.proposed0.astype(cpu_total.dtype),
+                    sp.cleared0.astype(cpu_total.dtype),
                 )
+            final_carry, rows = jax.lax.scan(
+                pick_step, carry0,
+                jnp.arange(n_picks, dtype=jnp.int32),
             )
-            return (cpu_f, mem_f, disk_f), rows
+            return (final_carry[0], final_carry[1], final_carry[2]), rows
 
         used0 = (used0_cpu, used0_mem, used0_disk)
-        _final, rows = jax.lax.scan(
-            eval_step,
-            used0,
-            (
-                feasible_all, perm_all, ask_cpu, ask_mem, ask_disk,
-                desired_count, limits, wanted, n_candidates,
-                distinct_hosts, coll0_all, affinity_all, deltas, pre,
-            ),
+        xs_all = (
+            feasible_all, perm_all, ask_cpu, ask_mem, ask_disk,
+            desired_count, limits, wanted, n_candidates,
+            distinct_hosts, coll0_all, affinity_all, deltas, pre,
         )
+        if with_spread:
+            xs_all = xs_all + (spread_all,)
+        _final, rows = jax.lax.scan(eval_step, used0, xs_all)
         return rows
 
     return _run

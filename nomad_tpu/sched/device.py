@@ -16,6 +16,28 @@ from .feasible import _resolve_device_target
 from .operators import check_affinity
 
 
+def matched_affinity_weight(
+    group, affinities, regex_cache, version_cache
+) -> Tuple[float, float]:
+    """(total |weight|, matched weight sum) of a device ask's
+    affinities against one device group (reference device.go:75-90) —
+    THE single implementation, shared by the sequential allocator and
+    the batch prescorer's static score column so the two can never
+    desynchronize."""
+    total = 0.0
+    matched = 0.0
+    for aff in affinities:
+        lval, lok = _resolve_device_target(aff.ltarget, group)
+        rval, rok = _resolve_device_target(aff.rtarget, group)
+        total += abs(float(aff.weight))
+        if check_affinity(
+            aff.operand, lval, rval, lok, rok,
+            regex_cache, version_cache,
+        ):
+            matched += float(aff.weight)
+    return total, matched
+
+
 class DeviceAllocator:
     def __init__(self, ctx, node: Node) -> None:
         self.ctx = ctx
@@ -60,18 +82,11 @@ class DeviceAllocator:
             choice_score = 0.0
             sum_matched = 0.0
             if ask.affinities:
-                total_weight = 0.0
-                for aff in ask.affinities:
-                    lval, lok = _resolve_device_target(aff.ltarget, group)
-                    rval, rok = _resolve_device_target(aff.rtarget, group)
-                    total_weight += abs(float(aff.weight))
-                    if not check_affinity(
-                        aff.operand, lval, rval, lok, rok,
-                        self.ctx.regex_cache, self.ctx.version_cache,
-                    ):
-                        continue
-                    choice_score += float(aff.weight)
-                    sum_matched += float(aff.weight)
+                total_weight, sum_matched = matched_affinity_weight(
+                    group, ask.affinities,
+                    self.ctx.regex_cache, self.ctx.version_cache,
+                )
+                choice_score = sum_matched
                 if total_weight:
                     choice_score /= total_weight
 

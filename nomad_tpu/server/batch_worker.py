@@ -429,14 +429,18 @@ class BatchWorker(Worker):
             "sequential": 0.0,
         }
 
-    def _sharded_runner(self, n_picks: int, spread_fit: bool):
-        key = (n_picks, spread_fit)
+    def _sharded_runner(self, n_picks: int, spread_fit: bool,
+                        with_spread: bool = False,
+                        spread_even: bool = False):
+        key = (n_picks, spread_fit, with_spread, spread_even)
         runner = self._sharded_runners.get(key)
         if runner is None:
             from ..parallel.mesh import sharded_chained_plan
 
             runner = sharded_chained_plan(
-                self._mesh, n_picks, spread_fit
+                self._mesh, n_picks, spread_fit,
+                with_spread=with_spread,
+                spread_even=spread_even,
             )
             runner.__name__ = f"sharded_chained_{n_picks}_{spread_fit}"
             self._sharded_runners[key] = runner
@@ -1436,8 +1440,7 @@ class BatchWorker(Worker):
             != table.topo_generation
         ):
             self._dev_aff_cache.clear()
-        from ..sched.feasible import _resolve_device_target
-        from ..sched.operators import check_affinity
+        from ..sched.device import matched_affinity_weight
         from ..structs import NodeDeviceResource
 
         total_w = 0.0
@@ -1456,20 +1459,10 @@ class BatchWorker(Worker):
                     vendor=sig[0], type=sig[1], name=sig[2],
                     attributes=dict(sig[3]),
                 )
-                s = 0.0
-                for aff in req.affinities:
-                    lval, lok = _resolve_device_target(
-                        aff.ltarget, group
-                    )
-                    rval, rok = _resolve_device_target(
-                        aff.rtarget, group
-                    )
-                    if check_affinity(
-                        aff.operand, lval, rval, lok, rok,
-                        compiler.regex_cache,
-                        compiler.version_cache,
-                    ):
-                        s += float(aff.weight)
+                _tw, s = matched_affinity_weight(
+                    group, req.affinities,
+                    compiler.regex_cache, compiler.version_cache,
+                )
                 matched[code] = s
             for row, groups in table.device_groups.items():
                 for code, _cnt in groups:
@@ -2004,7 +1997,6 @@ class BatchWorker(Worker):
         )
         use_mesh = (
             self._mesh is not None
-            and spread_stack is None
             and T == 1
             and port_ask_arr is None
             and dev_ask_arr is None
@@ -2015,8 +2007,19 @@ class BatchWorker(Worker):
             # single-group batches only: the sharded runner keeps the
             # historical per-eval scalar layout, which the T=1 slices
             # reproduce exactly (per-pick values are constant within a
-            # single-group eval)
-            runner = self._sharded_runner(int(P), spread_fit)
+            # single-group eval).  Spread batches route through the
+            # with_spread variant (VERDICT r4 #9) — the kernel carries
+            # the (S, V+1) spread state replicated and reduces only
+            # the winner/evictee slot one-hots over shards
+            spread_arg = spread_stack
+            runner = self._sharded_runner(
+                int(P), spread_fit,
+                with_spread=spread_arg is not None,
+                spread_even=(
+                    spread_arg is not None
+                    and spread_arg.even is not None
+                ),
+            )
             sh_args = (
                 table.cpu_total,
                 table.mem_total,
@@ -2043,6 +2046,8 @@ class BatchWorker(Worker):
                 deltas,
                 pre,
             )
+            if spread_arg is not None:
+                sh_args = sh_args + (spread_arg,)
             if not self._launch_ready(sh_args, {}, fn=runner):
                 self._count("cold_shape_fallbacks")
                 return {}
