@@ -68,6 +68,88 @@ def alloc_to_dict(alloc: Allocation) -> Dict:
     return d
 
 
+def dataclass_from_dict(cls, raw):
+    """Generic inverse of _clean for the wire structs: rebuild a
+    dataclass from its snake_case JSON form via type hints (List/
+    Dict/Optional/nested dataclasses).  Unknown keys are ignored so
+    additive wire fields never break older decoders; `job`/`metrics`
+    never ride the wire (_clean drops them) and decode to their
+    defaults."""
+    import typing
+
+    if raw is None or not dataclasses.is_dataclass(cls):
+        return raw
+
+    def thaw(hint, value):
+        if value is None:
+            return None
+        origin = typing.get_origin(hint)
+        if origin is typing.Union:
+            args = [
+                a
+                for a in typing.get_args(hint)
+                if a is not type(None)
+            ]
+            return thaw(args[0], value) if args else value
+        if origin in (list, List):
+            (item,) = typing.get_args(hint) or (Any,)
+            return [thaw(item, v) for v in value]
+        if origin in (dict, Dict):
+            args = typing.get_args(hint) or (Any, Any)
+            return {k: thaw(args[1], v) for k, v in value.items()}
+        if dataclasses.is_dataclass(hint) and isinstance(value, dict):
+            return dataclass_from_dict(hint, value)
+        if hint is float:
+            return float(value)
+        if hint is int:
+            return int(value)
+        if hint is bool:
+            return bool(value)
+        if hint is bytes and isinstance(value, str):
+            import base64
+
+            return base64.b64decode(value)
+        return value
+
+    import typing as _t
+
+    hints = _t.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in raw:
+            kwargs[f.name] = thaw(hints[f.name], raw[f.name])
+    return cls(**kwargs)
+
+
+def _snake_keys(value):
+    """Recursively normalize Go-style PascalCase keys to the structs'
+    snake_case field names so dataclass_from_dict matches them
+    (MemoryMB -> memory_mb, Vendor -> vendor).  snake_case keys pass
+    through untouched."""
+    import re as _re
+
+    if isinstance(value, dict):
+        out = {}
+        for k, v in value.items():
+            nk = k
+            if isinstance(k, str) and k and k[0].isupper():
+                nk = _re.sub(
+                    r"(?<=[a-z0-9])(?=[A-Z])", "_", k
+                ).lower()
+            out[nk] = _snake_keys(v)
+        return out
+    if isinstance(value, list):
+        return [_snake_keys(v) for v in value]
+    return value
+
+
+def alloc_from_dict(raw: Dict) -> Allocation:
+    """Wire form -> Allocation (full decode incl. task_states and
+    allocated_resources — what a remote client pushes and pulls;
+    reference api/allocations.go shapes in snake_case)."""
+    return dataclass_from_dict(Allocation, raw)
+
+
 def eval_to_dict(ev: Evaluation) -> Dict:
     return _clean(ev)
 
@@ -521,6 +603,20 @@ def node_from_dict(raw: Dict) -> "Node":
         ),
         status=_get(raw, "status", "Status", default="ready"),
     )
+    devs = _get(res_raw, "devices", "Devices", default=None)
+    if devs:
+        from ..structs import NodeDeviceResource
+
+        node.node_resources.devices = [
+            dataclass_from_dict(NodeDeviceResource, _snake_keys(d))
+            for d in devs
+        ]
+    nets = _get(res_raw, "networks", "Networks", default=None)
+    if nets:
+        node.node_resources.networks = [
+            dataclass_from_dict(NetworkResource, _snake_keys(n))
+            for n in nets
+        ]
     node.computed_class = compute_node_class(node)
     return node
 

@@ -789,6 +789,24 @@ class APIHandler(BaseHTTPRequestHandler):
             )
             return True
 
+        if path == "/v1/client/register" and method in (
+            "POST", "PUT",
+        ):
+            # a REMOTE client announces its callback endpoint; the
+            # server proxies fs/exec/logs for its allocs through it
+            # (reference nomad/client_rpc.go NodeRpc topology)
+            self._check_acl("node:write")
+            body = self._body()
+            node_id = body.get("NodeID") or body.get("node_id", "")
+            addr = body.get("Addr") or body.get("addr", "")
+            if not node_id or not addr:
+                raise HTTPError(400, "NodeID and Addr required")
+            from ..client.remote import HTTPClientProxy
+
+            srv.register_client(node_id, HTTPClientProxy(addr))
+            self._respond({})
+            return True
+
         m = re.fullmatch(r"/v1/node/([^/]+)/heartbeat", path)
         if m and method in ("POST", "PUT"):
             # (reference Node.UpdateStatus keepalive)
@@ -808,6 +826,39 @@ class APIHandler(BaseHTTPRequestHandler):
             body = self._body()
             updates = []
             for raw in body.get("Allocs") or []:
+                if "task_states" in raw or (
+                    "allocated_resources" in raw
+                ):
+                    # full wire-form update from a remote client.
+                    # Merge ONLY the client-owned fields onto the
+                    # server's canonical alloc: the client's copy of
+                    # desired_status/desired_transition/deployment_id
+                    # is stale by construction (a drain/preempt/stop
+                    # staged since its last pull must not be
+                    # reverted by a task-state push) — reference
+                    # Node.UpdateAlloc persists client state, never
+                    # scheduler intent
+                    from .codec import alloc_from_dict
+
+                    full = alloc_from_dict(raw)
+                    existing = store.alloc_by_id(full.id)
+                    if existing is None:
+                        continue
+                    updates.append(
+                        dc_replace(
+                            existing,
+                            client_status=full.client_status,
+                            client_description=(
+                                full.client_description
+                            ),
+                            task_states=full.task_states,
+                            deployment_status=(
+                                full.deployment_status
+                            ),
+                            modify_time=full.modify_time,
+                        )
+                    )
+                    continue
                 alloc = store.alloc_by_id(
                     raw.get("ID") or raw.get("id", "")
                 )

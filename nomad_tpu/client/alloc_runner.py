@@ -293,11 +293,20 @@ class AllocRunner:
     def task_state_snapshot(self) -> Dict[str, Dict]:
         """Persistable view for client restarts
         (reference client/state/state_database.go)."""
-        return {
-            name: {
+        out = {}
+        for name, tr in self.task_runners.items():
+            snap = {
                 "state": tr.state.state,
                 "failed": tr.state.failed,
                 "task_id": tr.task_id,
             }
-            for name, tr in self.task_runners.items()
-        }
+            # driver-specific reattach metadata (e.g. the docker
+            # container id) so recover_task has something to find
+            hs = getattr(tr.driver, "handle_state", None)
+            if hs is not None:
+                try:
+                    snap.update(hs(tr.task_id) or {})
+                except Exception:  # noqa: BLE001
+                    pass
+            out[name] = snap
+        return out

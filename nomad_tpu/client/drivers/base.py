@@ -206,6 +206,12 @@ class DriverPlugin:
     def inspect_task(self, task_id: str) -> Optional[DriverHandle]:
         raise NotImplementedError
 
+    def handle_state(self, task_id: str) -> Dict:
+        """Driver-specific reattach metadata persisted with the task
+        snapshot (e.g. docker's container id); {} when the driver has
+        nothing to reattach to."""
+        return {}
+
     def recover_task(self, task_id: str, handle_state: Dict) -> bool:
         """Reattach to a task after client restart
         (reference DriverPlugin.RecoverTask)."""

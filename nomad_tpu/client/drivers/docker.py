@@ -1,19 +1,28 @@
-"""Docker container driver (reference drivers/docker/driver.go).
+"""Docker container driver over the daemon's HTTP API
+(reference drivers/docker/driver.go + the docklog companion).
 
-Runs containers through the docker CLI as the portable seam (the
-reference talks to dockerd's API socket; the lifecycle mapping is the
-same): ``start`` = ``docker run`` with name/env/volume/port wiring,
-``stop`` = ``docker stop -t <kill_timeout>``, ``destroy`` =
-``docker rm -f``.  Fingerprint probes the daemon and reports the driver
-unhealthy when unreachable, so placement simply skips docker tasks on
-nodes without a daemon (feasibility via DriverChecker).
+Talks to dockerd's Engine API on the unix socket directly — create/
+start/stop/kill/wait/inspect/stats/exec ride
+``/containers/...``/``/exec/...`` exactly like the reference's
+go-dockerclient does; nothing shells out to the docker CLI.  Each
+started container gets a **docklog companion thread** (reference
+drivers/docker/docklog: a sidecar streaming the container's log
+endpoint) that demuxes the attach-stream frames into the task's
+logmon rotators, so `alloc logs`/`logs -f` read docker tasks through
+the exact same path as exec tasks.
+
+The socket path comes from ``DOCKER_HOST`` (``unix://...`` form) or
+defaults to ``/var/run/docker.sock``; tests point it at a mock daemon.
 """
 from __future__ import annotations
 
-import shutil
-import subprocess
+import http.client
+import json
+import os
+import socket
+import struct
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from .base import (
     DriverHandle,
@@ -21,6 +30,298 @@ from .base import (
     TaskConfig,
     TaskExitResult,
 )
+
+_API = "/v1.40"  # stable floor the calls below all exist in
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    """http.client over a unix domain socket (the Engine API's
+    default transport)."""
+
+    def __init__(self, sock_path: str, timeout=30.0) -> None:
+        super().__init__("localhost", timeout=timeout)
+        self._sock_path = sock_path
+
+    def connect(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._sock_path)
+        self.sock = s
+
+
+class DockerAPIError(RuntimeError):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"docker API {status}: {message}")
+        self.status = status
+
+
+class DockerAPI:
+    """Minimal Engine API client: the endpoints the driver lifecycle,
+    stats/events observability and the docklog companion need."""
+
+    def __init__(self, sock_path: str) -> None:
+        self.sock_path = sock_path
+
+    def _request(
+        self, method: str, path: str, body=None,
+        timeout: float = 30.0,
+    ):
+        conn = _UnixHTTPConnection(self.sock_path, timeout=timeout)
+        try:
+            data = (
+                json.dumps(body).encode()
+                if body is not None
+                else None
+            )
+            headers = {"Host": "docker"}
+            if data is not None:
+                headers["Content-Type"] = "application/json"
+            conn.request(
+                method, _API + path, body=data, headers=headers
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status >= 400:
+                msg = ""
+                try:
+                    msg = json.loads(raw).get("message", "")
+                except Exception:  # noqa: BLE001
+                    msg = raw.decode(errors="replace")[:200]
+                raise DockerAPIError(resp.status, msg)
+            if not raw:
+                return None
+            try:
+                return json.loads(raw)
+            except ValueError:
+                return raw
+        finally:
+            conn.close()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def version(self):
+        return self._request("GET", "/version", timeout=5.0)
+
+    def create_container(self, name: str, spec: dict) -> str:
+        out = self._request(
+            "POST", f"/containers/create?name={name}", spec
+        )
+        return out["Id"]
+
+    def start_container(self, cid: str) -> None:
+        self._request("POST", f"/containers/{cid}/start", {})
+
+    def stop_container(self, cid: str, timeout_s: int) -> None:
+        self._request(
+            "POST",
+            f"/containers/{cid}/stop?t={int(timeout_s)}",
+            timeout=timeout_s + 15.0,
+        )
+
+    def kill_container(self, cid: str, signal: str) -> None:
+        self._request(
+            "POST", f"/containers/{cid}/kill?signal={signal}"
+        )
+
+    def remove_container(self, cid: str, force: bool = True) -> None:
+        self._request(
+            "DELETE",
+            f"/containers/{cid}?force={'true' if force else 'false'}",
+        )
+
+    def wait_container(self, cid: str) -> int:
+        """Blocks until the container exits (long request, like the
+        reference's WaitContainer)."""
+        out = self._request(
+            "POST", f"/containers/{cid}/wait", timeout=86400.0
+        )
+        return int(out.get("StatusCode", 0))
+
+    def inspect_container(self, cid: str):
+        return self._request("GET", f"/containers/{cid}/json")
+
+    def pull_image(self, image: str) -> None:
+        """POST /images/create streams progress JSON; drain it."""
+        conn = _UnixHTTPConnection(self.sock_path, timeout=600.0)
+        try:
+            tag = "latest"
+            name = image
+            if ":" in image.rsplit("/", 1)[-1]:
+                name, tag = image.rsplit(":", 1)
+            conn.request(
+                "POST",
+                f"{_API}/images/create?fromImage={name}&tag={tag}",
+                headers={"Host": "docker"},
+            )
+            resp = conn.getresponse()
+            # the daemon reports pull failures as 200 + progress
+            # lines carrying errorDetail — scan, don't just drain
+            tail = b""
+            err = ""
+            while True:
+                chunk = resp.read(65536)
+                if not chunk:
+                    break
+                tail = (tail + chunk)[-65536:]
+            for line in tail.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("error") or rec.get("errorDetail"):
+                    err = rec.get("error") or str(
+                        rec["errorDetail"]
+                    )
+            if resp.status >= 400:
+                raise DockerAPIError(resp.status, "image pull failed")
+            if err:
+                raise DockerAPIError(500, f"image pull: {err}")
+        finally:
+            conn.close()
+
+    # -- observability -------------------------------------------------
+
+    def stats(self, cid: str):
+        """One-shot container stats (reference DriverStats)."""
+        return self._request(
+            "GET", f"/containers/{cid}/stats?stream=false"
+        )
+
+    def events(self, since: int, until: int):
+        """Container events in a window (reference TaskEvents)."""
+        raw = self._request(
+            "GET", f"/events?since={since}&until={until}",
+            timeout=10.0,
+        )
+        if isinstance(raw, (bytes, bytearray)):
+            out = []
+            for line in raw.splitlines():
+                if line.strip():
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+            return out
+        return [raw] if raw else []
+
+    # -- exec ----------------------------------------------------------
+
+    def exec_in_container(
+        self, cid: str, argv, timeout: float = 30.0
+    ) -> Tuple[int, bytes]:
+        out = self._request(
+            "POST",
+            f"/containers/{cid}/exec",
+            {
+                "AttachStdout": True,
+                "AttachStderr": True,
+                "Cmd": list(argv),
+            },
+        )
+        exec_id = out["Id"]
+        conn = _UnixHTTPConnection(self.sock_path, timeout=timeout)
+        try:
+            conn.request(
+                "POST",
+                f"{_API}/exec/{exec_id}/start",
+                body=json.dumps(
+                    {"Detach": False, "Tty": False}
+                ).encode(),
+                headers={
+                    "Host": "docker",
+                    "Content-Type": "application/json",
+                },
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        output = b"".join(
+            payload for _stream, payload in _demux_frames(raw)
+        )
+        ins = self._request("GET", f"/exec/{exec_id}/json")
+        return int(ins.get("ExitCode") or 0), output
+
+    # -- docklog -------------------------------------------------------
+
+    def stream_logs(self, cid: str, on_frame, stop_event) -> None:
+        """Follow the container's log endpoint and hand each demuxed
+        (stream, payload) frame to ``on_frame`` until EOF or stop —
+        the transport half of the docklog companion.
+
+        Reads BLOCK with no socket timeout: a timeout firing mid-chunk
+        would leave http.client's chunked-decoder state undefined and
+        mis-frame everything after.  Stop is delivered by closing the
+        socket from a watchdog thread instead."""
+        conn = _UnixHTTPConnection(self.sock_path, timeout=None)
+        closed = threading.Event()
+
+        def closer() -> None:
+            stop_event.wait()
+            if not closed.is_set():
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=closer, daemon=True).start()
+        try:
+            conn.request(
+                "GET",
+                f"{_API}/containers/{cid}/logs"
+                "?follow=true&stdout=true&stderr=true",
+                headers={"Host": "docker"},
+            )
+            resp = conn.getresponse()
+            buf = b""
+            while not stop_event.is_set():
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                frames, buf = _split_frames(buf)
+                for stream, payload in frames:
+                    on_frame(stream, payload)
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
+        finally:
+            closed.set()
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _split_frames(buf: bytes):
+    """Split complete attach-stream frames off the front of ``buf``
+    (Engine API stream format: 8-byte header = stream byte, 3 zero
+    bytes, u32 big-endian length)."""
+    frames = []
+    while len(buf) >= 8:
+        stream = buf[0]
+        (length,) = struct.unpack(">I", buf[4:8])
+        if len(buf) < 8 + length:
+            break
+        frames.append((stream, buf[8 : 8 + length]))
+        buf = buf[8 + length :]
+    return frames, buf
+
+
+def _demux_frames(raw: bytes):
+    frames, _rest = _split_frames(raw)
+    return frames
+
+
+def _docker_host() -> str:
+    host = os.environ.get("DOCKER_HOST", "")
+    if host.startswith("unix://"):
+        return host[len("unix://"):]
+    if host:
+        return host
+    return "/var/run/docker.sock"
 
 
 class _ContainerHandle(DriverHandle):
@@ -37,11 +338,13 @@ class DockerDriver(DriverPlugin):
     # dies after agent boot flips the node's driver attribute
     PROBE_TTL = 30.0
 
-    def __init__(self) -> None:
-        self._docker = shutil.which("docker")
+    def __init__(self, sock_path: Optional[str] = None) -> None:
+        self.api = DockerAPI(sock_path or _docker_host())
         self.handles: Dict[str, _ContainerHandle] = {}
         self._daemon_ok: Optional[bool] = None
         self._probed_at = 0.0
+        self._server_version = ""
+        self._docklogs: Dict[str, threading.Event] = {}
 
     # ------------------------------------------------------------------
 
@@ -54,72 +357,169 @@ class DockerDriver(DriverPlugin):
             or now - self._probed_at >= self.PROBE_TTL
         ):
             self._probed_at = now
-            if not self._docker:
+            try:
+                v = self.api.version()
+                self._daemon_ok = True
+                self._server_version = (v or {}).get("Version", "")
+            except Exception:  # noqa: BLE001
                 self._daemon_ok = False
-            else:
-                try:
-                    out = subprocess.run(
-                        [self._docker, "version", "--format",
-                         "{{.Server.Version}}"],
-                        capture_output=True, text=True, timeout=5,
-                    )
-                    self._daemon_ok = out.returncode == 0
-                    self._server_version = (out.stdout or "").strip()
-                except (OSError, subprocess.TimeoutExpired):
-                    self._daemon_ok = False
         return bool(self._daemon_ok)
 
     def fingerprint(self) -> Dict[str, str]:
         if not self._daemon_reachable():
             return {f"driver.{self.name}": "0"}
         attrs = {f"driver.{self.name}": "1"}
-        if getattr(self, "_server_version", ""):
+        if self._server_version:
             attrs[f"driver.{self.name}.version"] = self._server_version
         return attrs
 
     # ------------------------------------------------------------------
 
-    def _run_argv(self, cfg: TaskConfig, container: str):
+    def _container_spec(self, cfg: TaskConfig) -> dict:
         image = cfg.config.get("image", "")
         if not image:
             raise ValueError("docker driver requires image in config")
-        argv = [self._docker, "run", "--rm", "--name", container]
-        for k, v in (cfg.env or {}).items():
-            argv += ["-e", f"{k}={v}"]
-        if cfg.resources is not None:
-            argv += ["--memory", f"{cfg.resources.memory_mb}m"]
+        binds = []
         if cfg.alloc_dir:
-            argv += ["-v", f"{cfg.alloc_dir}:/alloc"]
-        for vol in cfg.config.get("volumes", []) or []:
-            argv += ["-v", vol]
-        port_map = cfg.config.get("port_map", {}) or {}
-        for guest, host in port_map.items():
-            argv += ["-p", f"{host}:{guest}"]
-        argv.append(image)
+            binds.append(f"{cfg.alloc_dir}:/alloc")
+        binds.extend(cfg.config.get("volumes", []) or [])
+        port_bindings = {}
+        for guest, host in (
+            cfg.config.get("port_map", {}) or {}
+        ).items():
+            port_bindings[f"{guest}/tcp"] = [
+                {"HostPort": str(host)}
+            ]
+        cmd = []
         command = cfg.config.get("command", "")
         if command:
-            argv.append(command)
-        argv += list(cfg.config.get("args", []))
-        return argv
+            cmd.append(command)
+        cmd.extend(cfg.config.get("args", []) or [])
+        spec = {
+            "Image": image,
+            "Env": [
+                f"{k}={v}" for k, v in (cfg.env or {}).items()
+            ],
+            "Labels": {
+                "nomad.task_id": cfg.id,
+                "nomad.alloc_id": cfg.alloc_id,
+            },
+            "HostConfig": {
+                "Binds": binds,
+                "PortBindings": port_bindings,
+                "AutoRemove": False,
+            },
+        }
+        if cmd:
+            spec["Cmd"] = cmd
+        if cfg.resources is not None and cfg.resources.memory_mb:
+            spec["HostConfig"]["Memory"] = (
+                int(cfg.resources.memory_mb) * 1024 * 1024
+            )
+        return spec
+
+    def _start_docklog(
+        self, task_id: str, task_name: str, cid: str,
+        log_dir: str, max_files: int, max_size_mb: int,
+    ) -> None:
+        """The docklog companion (reference drivers/docker/docklog):
+        stream the container's logs into the task's logmon rotators so
+        `alloc logs`/`logs -f` serve docker tasks like any other."""
+        from ..logmon import LogMon
+
+        if not log_dir:
+            return
+        lm = LogMon(
+            log_dir, task_name,
+            max_files=max_files,
+            max_file_size_mb=max_size_mb,
+        )
+        stop = threading.Event()
+        self._docklogs[task_id] = stop
+
+        def on_frame(stream: int, payload: bytes) -> None:
+            (lm.stderr if stream == 2 else lm.stdout).write(payload)
+
+        def run() -> None:
+            try:
+                self.api.stream_logs(cid, on_frame, stop)
+            finally:
+                lm.close()
+
+        threading.Thread(
+            target=run, name=f"docklog-{task_name}", daemon=True
+        ).start()
 
     def start_task(self, cfg: TaskConfig) -> DriverHandle:
         if not self._daemon_reachable():
-            raise RuntimeError("docker daemon not reachable on this node")
+            raise RuntimeError(
+                "docker daemon not reachable on this node"
+            )
         container = f"nomad-{cfg.id}".replace("/", "-")
-        argv = self._run_argv(cfg, container)
-        proc = subprocess.Popen(
-            argv,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-            start_new_session=True,
+        spec = self._container_spec(cfg)
+
+        def create():
+            try:
+                return self.api.create_container(container, spec)
+            except DockerAPIError as exc:
+                if exc.status == 409:
+                    # a previous run's exited container still holds
+                    # the name (restart loop): clear it and retry —
+                    # the CLI's --rm used to free the name on exit
+                    self.api.remove_container(
+                        container, force=True
+                    )
+                    return self.api.create_container(
+                        container, spec
+                    )
+                raise
+
+        try:
+            cid = create()
+        except DockerAPIError as exc:
+            if exc.status != 404:
+                raise
+            # image missing locally: pull then retry (reference
+            # driver's CreateImage path)
+            self.api.pull_image(spec["Image"])
+            cid = create()
+        self.api.start_container(cid)
+        handle = _ContainerHandle(cfg.id, cid)
+        log_dir = cfg.logs_dir or (
+            os.path.join(cfg.alloc_dir, "alloc", "logs")
+            if cfg.alloc_dir
+            else ""
         )
-        handle = _ContainerHandle(cfg.id, container)
-        handle.proc = proc
+        # persisted with the task snapshot so a restarted client can
+        # reattach the docklog companion, not just the wait loop
+        handle.docklog_state = {
+            "logs_dir": log_dir,
+            "task_name": cfg.name,
+            "log_max_files": cfg.log_max_files,
+            "log_max_file_size_mb": cfg.log_max_file_size_mb,
+        }
         self.handles[cfg.id] = handle
+        self._start_docklog(
+            cfg.id, cfg.name, cid, log_dir,
+            cfg.log_max_files, cfg.log_max_file_size_mb,
+        )
 
         def waiter():
-            code = proc.wait()
+            try:
+                code = self.api.wait_container(cid)
+            except Exception:  # noqa: BLE001
+                code = -1
+            stop = self._docklogs.pop(cfg.id, None)
+            if stop is not None:
+                stop.set()
             handle.set_exit(TaskExitResult(exit_code=code))
+            # emulate the CLI path's --rm: the exited container's
+            # logs already live in the rotators, so free the name and
+            # the disk for the restart loop
+            try:
+                self.api.remove_container(cid, force=True)
+            except (DockerAPIError, OSError):
+                pass
 
         threading.Thread(target=waiter, daemon=True).start()
         return handle
@@ -135,12 +535,10 @@ class DockerDriver(DriverPlugin):
         if handle is None or not handle.is_running():
             return
         try:
-            subprocess.run(
-                [self._docker, "stop", "-t", str(int(timeout)),
-                 handle.container],
-                capture_output=True, timeout=timeout + 10,
+            self.api.stop_container(
+                handle.container, int(timeout)
             )
-        except (OSError, subprocess.TimeoutExpired):
+        except (DockerAPIError, OSError):
             pass
 
     def exec_task(self, task_id, argv, timeout=30.0, env=None, cwd=""):
@@ -148,30 +546,31 @@ class DockerDriver(DriverPlugin):
         if handle is None:
             raise KeyError(f"unknown task {task_id!r}")
         try:
-            out = subprocess.run(
-                [self._docker, "exec", handle.container] + list(argv),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                timeout=timeout,
+            return self.api.exec_in_container(
+                handle.container, argv, timeout=timeout
             )
-        except subprocess.TimeoutExpired:
+        except (TimeoutError, socket.timeout):
             return 124, b"exec timed out"
-        except OSError as exc:
+        except (DockerAPIError, OSError) as exc:
             return 127, str(exc).encode()
-        return out.returncode, out.stdout or b""
 
     def signal_task(self, task_id, signal="SIGTERM"):
         handle = self.handles.get(task_id)
         if handle is None or not handle.is_running():
             return
         try:
-            subprocess.run(
-                [self._docker, "kill", "-s", signal.replace("SIG", ""),
-                 handle.container],
-                capture_output=True, timeout=10,
+            self.api.kill_container(
+                handle.container, signal.replace("SIG", "")
             )
-        except (OSError, subprocess.TimeoutExpired):
+        except (DockerAPIError, OSError):
             pass
+
+    def task_stats(self, task_id):
+        """One-shot stats from the daemon (reference TaskStats)."""
+        handle = self.handles.get(task_id)
+        if handle is None:
+            raise KeyError(f"unknown task {task_id!r}")
+        return self.api.stats(handle.container)
 
     def destroy_task(self, task_id, force=False):
         handle = self.handles.get(task_id)
@@ -179,45 +578,76 @@ class DockerDriver(DriverPlugin):
             if not force:
                 raise RuntimeError("task is still running")
             try:
-                subprocess.run(
-                    [self._docker, "rm", "-f", handle.container],
-                    capture_output=True, timeout=30,
+                self.api.remove_container(
+                    handle.container, force=True
                 )
-            except (OSError, subprocess.TimeoutExpired):
+            except (DockerAPIError, OSError):
                 pass
+        stop = self._docklogs.pop(task_id, None)
+        if stop is not None:
+            stop.set()
         self.handles.pop(task_id, None)
 
     def inspect_task(self, task_id):
         return self.handles.get(task_id)
+
+    def handle_state(self, task_id: str) -> Dict:
+        handle = self.handles.get(task_id)
+        if handle is None:
+            return {}
+        out = {"container": handle.container}
+        out.update(getattr(handle, "docklog_state", {}))
+        return out
 
     def recover_task(self, task_id, handle_state) -> bool:
         container = handle_state.get("container", "")
         if not container or not self._daemon_reachable():
             return False
         try:
-            out = subprocess.run(
-                [self._docker, "inspect", "--format",
-                 "{{.State.Running}}", container],
-                capture_output=True, text=True, timeout=5,
-            )
-        except (OSError, subprocess.TimeoutExpired):
+            ins = self.api.inspect_container(container)
+        except (DockerAPIError, OSError):
             return False
-        if out.returncode != 0 or "true" not in out.stdout:
+        if not (ins.get("State") or {}).get("Running"):
             return False
         handle = _ContainerHandle(task_id, container)
+        handle.docklog_state = {
+            k: handle_state[k]
+            for k in (
+                "logs_dir", "task_name", "log_max_files",
+                "log_max_file_size_mb",
+            )
+            if k in handle_state
+        }
         self.handles[task_id] = handle
+        # reattach the docklog companion too — without it a recovered
+        # task's logs silently stop flowing into the rotators
+        if handle.docklog_state.get("logs_dir"):
+            self._start_docklog(
+                task_id,
+                handle.docklog_state.get("task_name", "task"),
+                container,
+                handle.docklog_state["logs_dir"],
+                int(handle.docklog_state.get("log_max_files", 10)),
+                int(
+                    handle.docklog_state.get(
+                        "log_max_file_size_mb", 10
+                    )
+                ),
+            )
 
         def poll():
-            code = 0
             try:
-                out = subprocess.run(
-                    [self._docker, "wait", container],
-                    capture_output=True, text=True, timeout=None,
-                )
-                code = int((out.stdout or "0").strip() or 0)
-            except (OSError, ValueError):
-                pass
+                code = self.api.wait_container(container)
+            except Exception:  # noqa: BLE001
+                code = 0
+            stop = self._docklogs.pop(task_id, None)
+            if stop is not None:
+                stop.set()
             handle.set_exit(TaskExitResult(exit_code=code))
+            try:
+                self.api.remove_container(container, force=True)
+            except (DockerAPIError, OSError):
+                pass
 
         threading.Thread(target=poll, daemon=True).start()
         return True

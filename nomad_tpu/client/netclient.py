@@ -1,0 +1,90 @@
+"""Standalone client agent process: runs the full client runtime
+against a networked cluster over HTTP (the client half of the
+reference's `nomad agent -client -servers=...`).
+
+    python -m nomad_tpu.client.netclient \
+        --servers http://127.0.0.1:4646[,http://...] \
+        [--name NAME] [--data-dir DIR] [--drivers mock_driver,exec]
+
+Prints ``READY <node-id> <callback-port>`` once registered, then runs
+until SIGTERM/SIGINT.  Registration/heartbeats/alloc sync go to the
+servers (followers forward writes to the leader); the servers reach
+back through this process's callback endpoint for fs/exec/logs
+(client/remote.py)."""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="nomad-tpu-client")
+    p.add_argument(
+        "--servers", required=True,
+        help="comma-separated server HTTP addresses",
+    )
+    p.add_argument("--name", default="")
+    p.add_argument("--data-dir", default="", dest="data_dir")
+    p.add_argument(
+        "--drivers", default="mock_driver",
+        help="comma-separated builtin driver names",
+    )
+    p.add_argument(
+        "--heartbeat-interval", type=float, default=3.0,
+        dest="heartbeat_interval",
+    )
+    p.add_argument(
+        "--watch-interval", type=float, default=0.5,
+        dest="watch_interval",
+        help="alloc-watch poll period; remote polls ride HTTP, so "
+        "the in-process default (50ms) would hammer the servers",
+    )
+    p.add_argument(
+        "--callback-host", default="127.0.0.1",
+        dest="callback_host",
+    )
+    args = p.parse_args(argv)
+
+    from ..structs import Node
+    from .client import Client
+    from .fingerprint import run_fingerprinters
+    from .remote import RemoteServer
+
+    node = Node()
+    if args.name:
+        node.name = args.name
+    run_fingerprinters(node, include_tpu=False)
+
+    remote = RemoteServer(
+        args.servers.split(","), callback_host=args.callback_host
+    )
+    client = Client(
+        remote,
+        node=node,
+        data_dir=args.data_dir,
+        heartbeat_interval=args.heartbeat_interval,
+        watch_interval=args.watch_interval,
+        drivers=[d for d in args.drivers.split(",") if d],
+        fingerprint=False,
+    )
+    client.start()
+    port = remote._endpoint.port if remote._endpoint else 0
+    print(f"READY {node.id} {port}", flush=True)
+
+    stop = threading.Event()
+
+    def _sig(*_a):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    stop.wait()
+    client.stop()
+    remote.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

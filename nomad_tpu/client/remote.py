@@ -1,0 +1,433 @@
+"""Remote client agent plumbing: the client runtime attached to a
+networked cluster over the HTTP surface, mirroring the reference's
+client<->server topology (client/rpc.go: clients dial servers for
+registration/heartbeats/alloc sync; servers reach BACK through the
+client's own endpoint for fs/exec/logs — reference
+client/agent_endpoint.go + nomad/client_rpc.go NodeRpc).
+
+Three pieces:
+
+* :class:`RemoteServer` — what the in-process ``Client`` sees as its
+  "server": registration, heartbeats and alloc-status pushes become
+  HTTP calls with failover across the configured server addresses
+  (writes forward follower->leader server-side), and ``.store`` is a
+  :class:`RemoteStore` decoding the /v1 read surface back into
+  structs.
+* :class:`ClientEndpoint` — a small HTTP server ON the client that
+  exposes the server->client callback surface (restart/signal/exec/
+  log-tail/ls/cat) against the local ``Client`` object.
+* the server side registers an :class:`~nomad_tpu.api.http`
+  ``HTTPClientProxy`` for the node when the client announces its
+  callback address via POST /v1/client/register.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..api.codec import (
+    alloc_from_dict,
+    alloc_to_dict,
+    csi_volume_from_dict,
+    job_from_dict,
+    node_to_dict,
+)
+
+
+def _req(base: str, method: str, path: str, body=None, timeout=10.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw or b"null")
+
+
+class RemoteStore:
+    """Read-side proxy over /v1 for the client runtime.  Decodes the
+    snake_case wire forms back into structs; reads hit the first
+    reachable server (reads are locally served on any server; the
+    client tolerates follower lag exactly like the reference's
+    stale-read node paths)."""
+
+    def __init__(self, remote: "RemoteServer") -> None:
+        self._remote = remote
+
+    def allocs_by_node(self, node_id: str):
+        raw = self._remote._call(
+            "GET", f"/v1/node/{node_id}/allocations"
+        )
+        return [alloc_from_dict(a) for a in raw or []]
+
+    def alloc_by_id(self, alloc_id: str):
+        try:
+            raw = self._remote._call(
+                "GET", f"/v1/allocation/{alloc_id}"
+            )
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+        return alloc_from_dict(raw) if raw else None
+
+    def job_by_id(self, namespace: str, job_id: str):
+        try:
+            raw = self._remote._call(
+                "GET", f"/v1/job/{job_id}?namespace={namespace}"
+            )
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+        return job_from_dict(raw) if raw else None
+
+    def csi_volume_by_id(self, namespace: str, vol_id: str):
+        try:
+            raw = self._remote._call(
+                "GET",
+                f"/v1/volume/csi/{vol_id}?namespace={namespace}",
+            )
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+        return csi_volume_from_dict(raw) if raw else None
+
+
+class RemoteServer:
+    """The ``Client``'s server handle against a networked cluster.
+
+    Every call tries the configured servers in order and sticks with
+    the last one that answered (reference client/servers manager);
+    writes landing on a follower forward to the leader server-side."""
+
+    def __init__(self, servers: List[str],
+                 callback_host: str = "127.0.0.1") -> None:
+        self.servers = [s.rstrip("/") for s in servers]
+        self._preferred = 0
+        self.callback_host = callback_host
+        self._endpoint: Optional[ClientEndpoint] = None
+        self.catalog = None
+
+        self.store = RemoteStore(self)
+
+    # -- transport -----------------------------------------------------
+
+    def _call(self, method: str, path: str, body=None):
+        last: Optional[Exception] = None
+        n = len(self.servers)
+        for k in range(n):
+            i = (self._preferred + k) % n
+            try:
+                out = _req(self.servers[i], method, path, body)
+                self._preferred = i
+                return out
+            except urllib.error.HTTPError:
+                # the server answered: HTTP errors are REAL answers
+                # (404 etc.), not connectivity — don't failover
+                self._preferred = i
+                raise
+            except Exception as exc:  # noqa: BLE001
+                last = exc
+        raise ConnectionError(
+            f"no server reachable: {last!r}"
+        )
+
+    # -- the surface Client uses ---------------------------------------
+
+    def register_node(self, node) -> None:
+        self._call(
+            "POST", "/v1/node/register",
+            {"Node": node_to_dict(node)},
+        )
+
+    def heartbeat(self, node_id: str) -> None:
+        self._call("POST", f"/v1/node/{node_id}/heartbeat", {})
+
+    def update_allocs_from_client(self, updates) -> None:
+        if not updates:
+            return
+        node_id = updates[0].node_id
+        self._call(
+            "POST", f"/v1/node/{node_id}/allocs",
+            {"Allocs": [alloc_to_dict(a) for a in updates]},
+        )
+
+    def register_client(self, node_id: str, client) -> None:
+        """Start the callback endpoint and announce its address so
+        the servers can proxy fs/exec/logs to this client.  The
+        registry is per-server-process memory (not raft state), so
+        the announcement goes to EVERY configured server best-effort
+        — any of them may serve an fs/exec request for this node."""
+        if self._endpoint is None:
+            self._endpoint = ClientEndpoint(
+                client, host=self.callback_host
+            )
+            self._endpoint.start()
+        body = {
+            "NodeID": node_id,
+            "Addr": (
+                f"http://{self.callback_host}:"
+                f"{self._endpoint.port}"
+            ),
+        }
+        ok = 0
+        for base in self.servers:
+            try:
+                _req(base, "POST", "/v1/client/register", body)
+                ok += 1
+            except Exception:  # noqa: BLE001
+                continue
+        if not ok:
+            raise ConnectionError(
+                "no server accepted the client registration"
+            )
+
+    def stop(self) -> None:
+        if self._endpoint is not None:
+            self._endpoint.stop()
+            self._endpoint = None
+
+
+class ClientEndpoint:
+    """The client's own HTTP surface: what the servers call to reach
+    allocs on this node (reference client/agent_endpoint.go)."""
+
+    def __init__(self, client, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.client = client
+        self.host = host
+        self._httpd = ThreadingHTTPServer(
+            (host, port), self._make_handler()
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="client-endpoint",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def _make_handler(self):
+        client = self.client
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type", "application/json"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_POST(self):
+                try:
+                    body = self._body()
+                    if self.path == "/restart":
+                        client.restart_alloc(
+                            body["alloc_id"], body.get("task", "")
+                        )
+                        return self._json({})
+                    if self.path == "/signal":
+                        client.signal_alloc(
+                            body["alloc_id"],
+                            body.get("signal", "SIGTERM"),
+                            body.get("task", ""),
+                        )
+                        return self._json({})
+                    if self.path == "/exec":
+                        rc, out = client.exec_alloc(
+                            body["alloc_id"],
+                            body.get("task", ""),
+                            body.get("argv") or [],
+                            float(body.get("timeout", 30.0)),
+                        )
+                        return self._json(
+                            {
+                                "rc": rc,
+                                "output": base64.b64encode(
+                                    out
+                                ).decode(),
+                            }
+                        )
+                    if self.path == "/logs-tail":
+                        cursor = body.get("cursor")
+                        data, cur = client.tail_task_log(
+                            body["alloc_id"],
+                            body.get("task", ""),
+                            body.get("kind", "stdout"),
+                            tuple(cursor) if cursor else None,
+                        )
+                        return self._json(
+                            {
+                                "data": base64.b64encode(
+                                    data
+                                ).decode(),
+                                "cursor": list(cur),
+                            }
+                        )
+                    if self.path == "/read-task-log":
+                        data = client.read_task_log(
+                            body["alloc_id"],
+                            body.get("task", ""),
+                            body.get("kind", "stdout"),
+                            int(body.get("max_bytes", 65536)),
+                        )
+                        return self._json(
+                            {
+                                "data": base64.b64encode(
+                                    data
+                                ).decode()
+                            }
+                        )
+                    if self.path == "/ls":
+                        return self._json(
+                            client.list_alloc_files(
+                                body["alloc_id"],
+                                body.get("path", ""),
+                            )
+                        )
+                    if self.path == "/cat":
+                        data, trunc = client.read_alloc_file(
+                            body["alloc_id"], body.get("path", "")
+                        )
+                        return self._json(
+                            {
+                                "data": base64.b64encode(
+                                    data
+                                ).decode(),
+                                "truncated": trunc,
+                            }
+                        )
+                    return self._json(
+                        {"error": "not found"}, code=404
+                    )
+                except KeyError as exc:
+                    return self._json(
+                        {"error": str(exc)}, code=404
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    return self._json(
+                        {"error": repr(exc)}, code=500
+                    )
+
+        return Handler
+
+
+class HTTPClientProxy:
+    """Server-side handle to a REMOTE client: implements the same
+    surface an in-process ``Client`` registers, forwarding each call
+    to the client's callback endpoint (reference nomad/client_rpc.go
+    NodeRpc)."""
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr.rstrip("/")
+
+    def _post(self, path: str, body):
+        try:
+            return _req(self.addr, "POST", path, body)
+        except urllib.error.HTTPError as exc:
+            detail = ""
+            try:
+                detail = json.loads(exc.read()).get("error", "")
+            except Exception:  # noqa: BLE001
+                pass
+            if exc.code == 404:
+                raise KeyError(detail or "not found")
+            raise RuntimeError(detail or str(exc))
+
+    def restart_alloc(self, alloc_id: str, task: str = "") -> None:
+        self._post(
+            "/restart", {"alloc_id": alloc_id, "task": task}
+        )
+
+    def signal_alloc(
+        self, alloc_id: str, signal: str = "SIGTERM",
+        task: str = "",
+    ) -> None:
+        self._post(
+            "/signal",
+            {"alloc_id": alloc_id, "signal": signal, "task": task},
+        )
+
+    def exec_alloc(
+        self, alloc_id: str, task: str, argv, timeout: float = 30.0
+    ):
+        out = self._post(
+            "/exec",
+            {
+                "alloc_id": alloc_id,
+                "task": task,
+                "argv": list(argv),
+                "timeout": timeout,
+            },
+        )
+        return out["rc"], base64.b64decode(out["output"])
+
+    def exec_alloc_stream(self, alloc_id: str, task: str, argv):
+        raise KeyError(
+            "interactive exec requires a direct client connection"
+        )
+
+    def tail_task_log(
+        self, alloc_id: str, task: str, kind: str, cursor
+    ):
+        out = self._post(
+            "/logs-tail",
+            {
+                "alloc_id": alloc_id,
+                "task": task,
+                "kind": kind,
+                "cursor": list(cursor) if cursor else None,
+            },
+        )
+        return base64.b64decode(out["data"]), tuple(out["cursor"])
+
+    def read_task_log(
+        self, alloc_id: str, task: str, kind: str = "stdout",
+        max_bytes: int = 64 * 1024,
+    ) -> bytes:
+        out = self._post(
+            "/read-task-log",
+            {
+                "alloc_id": alloc_id,
+                "task": task,
+                "kind": kind,
+                "max_bytes": max_bytes,
+            },
+        )
+        return base64.b64decode(out["data"])
+
+    def list_alloc_files(self, alloc_id: str, rel: str = ""):
+        return self._post(
+            "/ls", {"alloc_id": alloc_id, "path": rel}
+        )
+
+    def read_alloc_file(self, alloc_id: str, rel: str):
+        out = self._post(
+            "/cat", {"alloc_id": alloc_id, "path": rel}
+        )
+        return base64.b64decode(out["data"]), out["truncated"]

@@ -48,6 +48,14 @@ def main(argv=None) -> int:
         "--heartbeat-interval", type=float, default=0.15
     )
     parser.add_argument(
+        "--heartbeat-ttl", type=float, default=None,
+        help="node liveness TTL seconds (missed heartbeats mark the "
+        "node down and reschedule its allocs); default 30",
+    )
+    parser.add_argument(
+        "--num-schedulers", type=int, default=None,
+    )
+    parser.add_argument(
         "--tls-ca", default="",
         help="CA bundle for mutual-TLS server<->server RPC "
         "(reference helper/tlsutil; requires --tls-cert/--tls-key)",
@@ -77,6 +85,11 @@ def main(argv=None) -> int:
             server_name=args.tls_server_name,
         )
     transport = TcpTransport(tls=tls)
+    extra = {}
+    if args.heartbeat_ttl is not None:
+        extra["heartbeat_ttl"] = args.heartbeat_ttl
+    if args.num_schedulers is not None:
+        extra["num_schedulers"] = args.num_schedulers
     server = ClusterServer(
         args.addr,
         [p for p in args.peers.split(",") if p],
@@ -84,6 +97,7 @@ def main(argv=None) -> int:
         region=args.region,
         election_timeout=args.election_timeout,
         heartbeat_interval=args.heartbeat_interval,
+        **extra,
     )
     server.start()
     if args.join:

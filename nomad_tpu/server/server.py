@@ -1122,6 +1122,11 @@ class Server:
         client = getattr(self, "_clients", {}).get(alloc.node_id)
         if client is None:
             raise KeyError(f"no client connection for {alloc.node_id}")
+        if hasattr(client, "read_task_log"):
+            # remote client proxy: the files live on ITS disk
+            return client.read_task_log(
+                alloc_id, task, kind, max_bytes
+            )
         import os
 
         # rotated logs first (client/logmon layout under alloc/logs/),

@@ -676,3 +676,41 @@ def test_alloc_status_push_frees_node_usage(api):
     assert (
         server.store.alloc_by_id(alloc.id).client_status == "complete"
     )
+
+
+def test_full_wire_alloc_update_preserves_server_intent(api):
+    """A remote client's full wire-form alloc push must merge only
+    the client-owned fields: a desired_status=stop staged by the
+    server after the client's last pull must survive the push
+    (review r5 — wholesale replace reverted drains/preemptions)."""
+    from nomad_tpu.api.codec import alloc_to_dict
+
+    server, base = api
+    server.register_node(mock.node())
+    job = mock.job(id="wirejob")
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    assert server.drain_to_idle(10)
+    alloc = server.store.allocs_by_job("default", "wirejob")[0]
+
+    # the client pulled this copy, then the server staged a stop
+    stale = alloc_to_dict(alloc)
+    stale["client_status"] = "running"
+    stale["task_states"] = {
+        "web": {"state": "running", "failed": False}
+    }
+    from dataclasses import replace as _rep
+
+    server.store.upsert_allocs(
+        [_rep(alloc, desired_status="stop")]
+    )
+
+    _post(
+        base,
+        f"/v1/node/{alloc.node_id}/allocs",
+        {"Allocs": [stale]},
+    )
+    after = server.store.alloc_by_id(alloc.id)
+    assert after.desired_status == "stop"  # intent preserved
+    assert after.client_status == "running"  # client state merged
+    assert after.task_states["web"].state == "running"

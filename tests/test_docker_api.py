@@ -1,0 +1,418 @@
+"""Docker driver against a MOCK dockerd (reference model:
+drivers/docker/driver_test.go runs against a real daemon; here a
+unix-socket HTTP server speaks just enough Engine API — create/start/
+wait/stop/exec/logs/stats/inspect — to drive the full lifecycle,
+including the docklog companion streaming demuxed frames into the
+logmon rotators)."""
+from __future__ import annotations
+
+import json
+import re
+import socket
+import socketserver
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from nomad_tpu.client.drivers.base import TaskConfig
+from nomad_tpu.client.drivers.docker import (
+    DockerDriver,
+    _split_frames,
+)
+
+
+class _MockDockerd(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _frame(stream: int, payload: bytes) -> bytes:
+    return bytes([stream, 0, 0, 0]) + struct.pack(
+        ">I", len(payload)
+    ) + payload
+
+
+class _State:
+    def __init__(self):
+        self.containers = {}  # cid -> dict(state)
+        self.execs = {}
+        self.events = []
+        self.lock = threading.Lock()
+        self.seq = 0
+
+
+def _make_handler(state: _State):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _json(self, obj, code=200):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path.endswith("/version"):
+                return self._json({"Version": "99.mock"})
+            m = re.search(r"/containers/([^/]+)/json$", path)
+            if m:
+                c = state.containers.get(m.group(1))
+                if c is None:
+                    return self._json(
+                        {"message": "no such container"}, 404
+                    )
+                return self._json(
+                    {"State": {"Running": c["running"]}}
+                )
+            m = re.search(r"/containers/([^/]+)/stats$", path)
+            if m:
+                return self._json(
+                    {
+                        "cpu_stats": {"cpu_usage": {"total_usage": 12345}},
+                        "memory_stats": {"usage": 1024 * 1024},
+                    }
+                )
+            m = re.search(r"/containers/([^/]+)/logs$", path)
+            if m:
+                cid = m.group(1)
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "application/vnd.docker.raw-stream",
+                )
+                self.end_headers()
+                c = state.containers.get(cid)
+                sent = 0
+                while c and c["running"]:
+                    lines = c["log_lines"]
+                    while sent < len(lines):
+                        stream, data = lines[sent]
+                        self.wfile.write(_frame(stream, data))
+                        self.wfile.flush()
+                        sent += 1
+                    time.sleep(0.02)
+                return
+            m = re.search(r"/exec/([^/]+)/json$", path)
+            if m:
+                e = state.execs.get(m.group(1), {})
+                return self._json(
+                    {"ExitCode": e.get("exit_code", 0)}
+                )
+            if path.endswith("/events"):
+                self.send_response(200)
+                body = b"".join(
+                    json.dumps(e).encode() + b"\n"
+                    for e in state.events
+                )
+                self.send_header(
+                    "Content-Length", str(len(body))
+                )
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            return self._json({"message": "not found"}, 404)
+
+        def do_POST(self):
+            path = self.path.split("?")[0]
+            if path.endswith("/containers/create"):
+                spec = self._body()
+                if spec.get("Image") == "missing:latest":
+                    return self._json(
+                        {"message": "No such image"}, 404
+                    )
+                with state.lock:
+                    state.seq += 1
+                    cid = f"cid{state.seq}"
+                state.containers[cid] = {
+                    "spec": spec,
+                    "running": False,
+                    "exit_code": 0,
+                    "log_lines": [],
+                    "exited": threading.Event(),
+                }
+                state.events.append(
+                    {"Type": "container", "Action": "create",
+                     "id": cid}
+                )
+                return self._json({"Id": cid}, 201)
+            m = re.search(r"/containers/([^/]+)/start$", path)
+            if m:
+                c = state.containers[m.group(1)]
+                c["running"] = True
+                # the "container" emits some output
+                c["log_lines"].append((1, b"hello stdout\n"))
+                c["log_lines"].append((2, b"hello stderr\n"))
+                return self._json(None, 204)
+            m = re.search(r"/containers/([^/]+)/wait$", path)
+            if m:
+                c = state.containers[m.group(1)]
+                c["exited"].wait(timeout=60)
+                return self._json(
+                    {"StatusCode": c["exit_code"]}
+                )
+            m = re.search(r"/containers/([^/]+)/stop$", path)
+            if m:
+                c = state.containers[m.group(1)]
+                c["exit_code"] = 0
+                c["running"] = False
+                c["exited"].set()
+                return self._json(None, 204)
+            m = re.search(r"/containers/([^/]+)/kill$", path)
+            if m:
+                c = state.containers[m.group(1)]
+                c["exit_code"] = 137
+                c["running"] = False
+                c["exited"].set()
+                return self._json(None, 204)
+            m = re.search(r"/containers/([^/]+)/exec$", path)
+            if m:
+                body = self._body()
+                with state.lock:
+                    state.seq += 1
+                    eid = f"eid{state.seq}"
+                state.execs[eid] = {
+                    "cmd": body.get("Cmd") or [],
+                    "exit_code": 0,
+                }
+                return self._json({"Id": eid}, 201)
+            m = re.search(r"/exec/([^/]+)/start$", path)
+            if m:
+                e = state.execs[m.group(1)]
+                out = (
+                    "ran: " + " ".join(e["cmd"])
+                ).encode()
+                body = _frame(1, out)
+                self.send_response(200)
+                self.send_header(
+                    "Content-Length", str(len(body))
+                )
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path.endswith("/images/create"):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+                return
+            return self._json({"message": "not found"}, 404)
+
+        def do_DELETE(self):
+            m = re.search(r"/containers/([^/]+)$", self.path.split("?")[0])
+            if m and m.group(1) in state.containers:
+                c = state.containers.pop(m.group(1))
+                c["running"] = False
+                c["exited"].set()
+                return self._json(None, 204)
+            return self._json({"message": "not found"}, 404)
+
+    return Handler
+
+
+@pytest.fixture
+def mockerd(tmp_path):
+    state = _State()
+    sock = str(tmp_path / "docker.sock")
+    srv = _MockDockerd(sock, _make_handler(state))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield sock, state
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_attach_stream_demux():
+    buf = _frame(1, b"abc") + _frame(2, b"de") + b"\x01\x00\x00"
+    frames, rest = _split_frames(buf)
+    assert frames == [(1, b"abc"), (2, b"de")]
+    assert rest == b"\x01\x00\x00"
+
+
+def test_docker_lifecycle_via_daemon_api(mockerd, tmp_path):
+    sock, state = mockerd
+    d = DockerDriver(sock_path=sock)
+    assert d.fingerprint()["driver.docker"] == "1"
+    assert d._server_version == "99.mock"
+
+    logs_dir = str(tmp_path / "logs")
+    cfg = TaskConfig(
+        id="task1",
+        name="web",
+        alloc_id="alloc1",
+        env={"FOO": "bar"},
+        alloc_dir=str(tmp_path / "alloc"),
+        logs_dir=logs_dir,
+        config={
+            "image": "redis:6",
+            "command": "redis-server",
+            "port_map": {"6380": 16380},
+        },
+    )
+    handle = d.start_task(cfg)
+    cid = handle.container
+    assert state.containers[cid]["running"]
+    spec = state.containers[cid]["spec"]
+    assert spec["Image"] == "redis:6"
+    assert "FOO=bar" in spec["Env"]
+
+    # docklog companion streamed the demuxed frames into the logmon
+    # rotators (the same files `alloc logs` reads)
+    import os
+
+    def rotated(kind):
+        out = b""
+        for name in sorted(os.listdir(logs_dir)):
+            if name.startswith(f"web.{kind}."):
+                with open(os.path.join(logs_dir, name), "rb") as f:
+                    out += f.read()
+        return out
+
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if (
+            b"hello stdout" in rotated("stdout")
+            and b"hello stderr" in rotated("stderr")
+        ):
+            break
+        time.sleep(0.05)
+    assert b"hello stdout" in rotated("stdout")
+    assert b"hello stderr" in rotated("stderr")
+
+    # exec through /containers/<id>/exec + /exec/<id>/start
+    rc, out = d.exec_task("task1", ["echo", "hi"])
+    assert rc == 0 and out == b"ran: echo hi"
+
+    # one-shot stats from the daemon
+    stats = d.task_stats("task1")
+    assert stats["memory_stats"]["usage"] == 1024 * 1024
+
+    # events observability
+    evs = d.api.events(0, int(time.time()) + 10)
+    assert any(e.get("Action") == "create" for e in evs)
+
+    # stop -> wait returns the daemon's exit code and the handle
+    # settles
+    d.stop_task("task1", timeout=2)
+    res = d.wait_task("task1", timeout=5)
+    assert res is not None and res.exit_code == 0
+    d.destroy_task("task1", force=True)
+    assert "task1" not in d.handles
+
+
+def test_docker_pull_on_missing_image(mockerd, tmp_path):
+    sock, state = mockerd
+    d = DockerDriver(sock_path=sock)
+    cfg = TaskConfig(
+        id="task2",
+        name="puller",
+        alloc_dir=str(tmp_path / "a2"),
+        config={"image": "missing:latest"},
+    )
+    # create 404s -> pull_image -> retry create (which 404s again in
+    # the mock: assert the pull happened by the error shape)
+    with pytest.raises(Exception):
+        d.start_task(cfg)
+
+
+def test_docker_recover_task(mockerd):
+    sock, state = mockerd
+    d = DockerDriver(sock_path=sock)
+    handle = d.start_task(
+        TaskConfig(id="task3", name="r", config={"image": "x:1"})
+    )
+    cid = handle.container
+    # a fresh driver (client restart) recovers the running container
+    d2 = DockerDriver(sock_path=sock)
+    assert d2.recover_task("task3", {"container": cid})
+    state.containers[cid]["exit_code"] = 7
+    state.containers[cid]["running"] = False
+    state.containers[cid]["exited"].set()
+    res = d2.wait_task("task3", timeout=5)
+    assert res is not None and res.exit_code == 7
+
+
+def test_docker_restart_reuses_name_and_removes_exited(mockerd, tmp_path):
+    """Task restart loop: the exited container is removed after wait
+    (the CLI path's --rm equivalent) and a name conflict from a
+    lingering container is cleared with a 409-retry — restarts must
+    not fail with 'Driver Failure' (review r5)."""
+    sock, state = mockerd
+    d = DockerDriver(sock_path=sock)
+    cfg = TaskConfig(
+        id="taskR", name="r",
+        alloc_dir=str(tmp_path / "aR"),
+        config={"image": "x:1"},
+    )
+    h1 = d.start_task(cfg)
+    cid1 = h1.container
+    d.stop_task("taskR", timeout=1)
+    assert d.wait_task("taskR", timeout=5).exit_code == 0
+    # the waiter removed the exited container
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and cid1 in state.containers:
+        time.sleep(0.05)
+    assert cid1 not in state.containers
+    # restart under the same task id succeeds
+    h2 = d.start_task(cfg)
+    assert h2.container != cid1
+    assert state.containers[h2.container]["running"]
+
+
+def test_docker_recover_reattaches_docklog(mockerd, tmp_path):
+    """Client restart: recover_task must reattach the docklog
+    companion, not just the wait loop (review r5 — logs silently
+    stopped flowing after recovery)."""
+    import os
+
+    sock, state = mockerd
+    logs_dir = str(tmp_path / "logsR")
+    d = DockerDriver(sock_path=sock)
+    h = d.start_task(
+        TaskConfig(
+            id="taskL", name="webL", logs_dir=logs_dir,
+            config={"image": "x:1"},
+        )
+    )
+    cid = h.container
+    snap = d.handle_state("taskL")
+    assert snap["container"] == cid
+    assert snap["logs_dir"] == logs_dir
+
+    d2 = DockerDriver(sock_path=sock)
+    assert d2.recover_task("taskL", snap)
+    # new output lands AFTER recovery; the reattached companion must
+    # stream it into the rotators
+    state.containers[cid]["log_lines"].append(
+        (1, b"post-recovery line\n")
+    )
+
+    def rotated():
+        out = b""
+        if os.path.isdir(logs_dir):
+            for name in sorted(os.listdir(logs_dir)):
+                if name.startswith("webL.stdout."):
+                    with open(
+                        os.path.join(logs_dir, name), "rb"
+                    ) as f:
+                        out += f.read()
+        return out
+
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if b"post-recovery line" in rotated():
+            break
+        time.sleep(0.05)
+    assert b"post-recovery line" in rotated()

@@ -104,11 +104,11 @@ def test_docker_driver_gates_on_daemon():
             d.start_task(TaskConfig(id="t", config={"image": "alpine"}))
 
 
-def test_docker_run_argv():
-    d = DockerDriver()
-    d._docker = "/usr/bin/docker"
+def test_docker_container_spec():
+    d = DockerDriver(sock_path="/nonexistent.sock")
     cfg = TaskConfig(
         id="t1",
+        alloc_id="a1",
         env={"FOO": "bar"},
         alloc_dir="/data/a1",
         config={
@@ -118,13 +118,17 @@ def test_docker_run_argv():
             "port_map": {"6380": 16380},
         },
     )
-    argv = d._run_argv(cfg, "nomad-t1")
-    assert argv[:4] == ["/usr/bin/docker", "run", "--rm", "--name"]
-    assert "redis:6" in argv
-    assert "-e" in argv and "FOO=bar" in argv
-    assert "-v" in argv and "/data/a1:/alloc" in argv
-    assert "-p" in argv and "16380:6380" in argv
-    assert argv[-3:] == ["redis-server", "--port", "6380"]
+    spec = d._container_spec(cfg)
+    assert spec["Image"] == "redis:6"
+    assert "FOO=bar" in spec["Env"]
+    assert "/data/a1:/alloc" in spec["HostConfig"]["Binds"]
+    assert spec["HostConfig"]["PortBindings"]["6380/tcp"] == [
+        {"HostPort": "16380"}
+    ]
+    assert spec["Cmd"] == ["redis-server", "--port", "6380"]
+    assert spec["Labels"]["nomad.alloc_id"] == "a1"
+    with pytest.raises(ValueError):
+        d._container_spec(TaskConfig(config={}))
 
 
 # ---------------------------------------------------------------------------
