@@ -816,6 +816,27 @@ def _preflight() -> None:
         f"({detail}) — likely a stale tunnel session; aborting "
         "instead of hanging"
     )
+    # round-long retry evidence (tools/tpu_retry_loop.sh): surface the
+    # attempt log so a failed bench records HOW MUCH recovery was
+    # attempted, not just this invocation's preflight
+    try:
+        import glob as _glob
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        candidates = sorted(
+            _glob.glob(os.path.join(here, "bench_attempts_*.log"))
+        )
+        if candidates:
+            with open(candidates[-1]) as fh:
+                lines = fh.read().splitlines()
+            log(
+                f"preflight: retry-loop attempt log "
+                f"({os.path.basename(candidates[-1])}, "
+                f"{len(lines)} lines, last 6): "
+                + " | ".join(lines[-6:])
+            )
+    except OSError:
+        pass
     sys.exit(2)
 
 

@@ -167,6 +167,38 @@ def cmd_agent(args) -> None:
     from .config import AgentConfig, load_config
     from .server import Server
 
+    if getattr(args, "client_mode", False):
+        # networked client mode (reference `agent -client
+        # -servers=...`): delegate to the netclient entrypoint —
+        # registration/heartbeats/alloc sync over HTTP, with the
+        # callback endpoint servers proxy fs/exec/logs through
+        servers = (
+            args.client_mode
+            if isinstance(args.client_mode, str)
+            else ""
+        ) or args.servers
+        if not servers:
+            raise SystemExit(
+                "-client requires -servers=<http addr,...>"
+            )
+        if (
+            args.dev
+            or args.config
+            or args.server_addr
+            or args.http_port is not None
+            or args.num_schedulers is not None
+        ):
+            raise SystemExit(
+                "-client does not combine with -dev/-config/"
+                "-server-addr/-http-port/-num-schedulers"
+            )
+        from .client.netclient import main as netclient_main
+
+        argv = ["--servers", servers]
+        if args.data_dir:
+            argv += ["--data-dir", args.data_dir]
+        raise SystemExit(netclient_main(argv))
+
     if getattr(args, "server_addr", None):
         # networked cluster-server mode: delegate to the netagent
         # entrypoint (framed-TCP raft/gossip/forwarding + HTTP API)
@@ -1520,6 +1552,20 @@ def build_parser() -> argparse.ArgumentParser:
         "-join", default=None, dest="join",
         help="gossip seed address of a live server",
     )
+    agent.add_argument(
+        "-client", nargs="?", const=True, default=False,
+        dest="client_mode", metavar="SERVERS",
+        help="run a standalone CLIENT agent; server addresses come "
+        "from -servers (reference agent -client -servers=...) or "
+        "inline as -client=ADDR[,ADDR]",
+    )
+    agent.add_argument(
+        "-servers", default="", dest="servers",
+        help="comma-separated server HTTP addresses for -client",
+    )
+    agent.add_argument(
+        "-data-dir", default="", dest="data_dir",
+    )
     agent.add_argument("-http-port", type=int, default=None,
                        dest="http_port")
     agent.add_argument("-num-schedulers", type=int, default=None,
@@ -1578,9 +1624,12 @@ def build_parser() -> argparse.ArgumentParser:
     jpr.add_argument("job_id")
     jpr.set_defaults(fn=cmd_job_promote)
     jpf = job_sub.add_parser("periodic")
-    jpf.add_argument("periodic_action", choices=["force"])
-    jpf.add_argument("job_id")
-    jpf.set_defaults(fn=cmd_job_periodic)
+    jpf_sub = jpf.add_subparsers(
+        dest="periodic_action", required=True
+    )
+    jpff = jpf_sub.add_parser("force")
+    jpff.add_argument("job_id")
+    jpff.set_defaults(fn=cmd_job_periodic)
     jini = job_sub.add_parser("init")
     jini.add_argument("filename", nargs="?", default="")
     jini.set_defaults(fn=cmd_job_init)
@@ -1710,16 +1759,21 @@ def build_parser() -> argparse.ArgumentParser:
     evs.set_defaults(fn=cmd_eval_status)
 
     dep = sub.add_parser("deployment")
-    dep.add_argument(
-        "action",
-        choices=[
-            "status", "list", "promote", "fail", "pause", "resume",
-            "unblock",
-        ],
-    )
-    dep.add_argument("id", nargs="?")
-    _add_fmt(dep)
-    dep.set_defaults(fn=cmd_deployment)
+    dep_sub = dep.add_subparsers(dest="action", required=True)
+    for name in (
+        "status", "list", "promote", "fail", "pause", "resume",
+        "unblock",
+    ):
+        dp = dep_sub.add_parser(name)
+        if name in ("status", "list"):
+            _add_fmt(dp)
+            dp.add_argument("id", nargs="?")
+        else:
+            # promote/fail/pause/resume/unblock act on ONE
+            # deployment: a missing id is a usage error, not a
+            # request to /v1/deployment/<action>/None
+            dp.add_argument("id")
+        dp.set_defaults(fn=cmd_deployment)
 
     nsp = sub.add_parser("namespace")
     nsp_sub = nsp.add_subparsers(dest="ns_cmd", required=True)
@@ -1791,30 +1845,34 @@ def build_parser() -> argparse.ArgumentParser:
     _add_fmt(osch)
     osch.set_defaults(fn=cmd_operator_scheduler)
     osnap = op_sub.add_parser("snapshot")
-    osnap.add_argument(
-        "action", choices=["save", "restore", "inspect"]
-    )
-    osnap.add_argument("path")
-    osnap.set_defaults(fn=cmd_operator_snapshot)
+    osnap_sub = osnap.add_subparsers(dest="action", required=True)
+    for name in ("save", "restore", "inspect"):
+        sp_p = osnap_sub.add_parser(name)
+        sp_p.add_argument("path")
+        sp_p.set_defaults(fn=cmd_operator_snapshot)
     oap = op_sub.add_parser("autopilot")
-    oap.add_argument(
-        "action", choices=["get-config", "set-config", "health"]
-    )
-    oap.add_argument(
-        "-cleanup-dead-servers", dest="cleanup_dead_servers",
-        choices=["true", "false"], default=None,
-    )
-    _add_fmt(oap)
-    oap.set_defaults(fn=cmd_operator_autopilot)
+    oap_sub = oap.add_subparsers(dest="action", required=True)
+    for name in ("get-config", "set-config", "health"):
+        ap_p = oap_sub.add_parser(name)
+        if name == "set-config":
+            ap_p.add_argument(
+                "-cleanup-dead-servers",
+                dest="cleanup_dead_servers",
+                choices=["true", "false"], default=None,
+            )
+        else:
+            _add_fmt(ap_p)
+        ap_p.set_defaults(fn=cmd_operator_autopilot)
     oraft = op_sub.add_parser("raft")
-    oraft.add_argument(
-        "action", choices=["list-peers", "remove-peer"]
-    )
-    oraft.add_argument(
+    oraft_sub = oraft.add_subparsers(dest="action", required=True)
+    orl = oraft_sub.add_parser("list-peers")
+    _add_fmt(orl)
+    orl.set_defaults(fn=cmd_operator_raft)
+    orr = oraft_sub.add_parser("remove-peer")
+    orr.add_argument(
         "-peer-address", dest="address", default=""
     )
-    _add_fmt(oraft)
-    oraft.set_defaults(fn=cmd_operator_raft)
+    orr.set_defaults(fn=cmd_operator_raft)
     okg = op_sub.add_parser("keygen")
     okg.set_defaults(fn=cmd_operator_keygen)
     okr = op_sub.add_parser("keyring")
@@ -1838,34 +1896,42 @@ def build_parser() -> argparse.ArgumentParser:
     mon.set_defaults(fn=cmd_monitor)
 
     system = sub.add_parser("system")
-    system.add_argument("action", choices=["gc", "reconcile"])
-    system.add_argument(
-        "target", nargs="?", choices=["summaries"], default="summaries"
-    )
-    system.set_defaults(fn=cmd_system)
+    system_sub = system.add_subparsers(dest="action", required=True)
+    sg = system_sub.add_parser("gc")
+    sg.set_defaults(fn=cmd_system)
+    sr = system_sub.add_parser("reconcile")
+    sr_sub = sr.add_subparsers(dest="target", required=False)
+    srs = sr_sub.add_parser("summaries")
+    srs.set_defaults(fn=cmd_system, target="summaries")
+    sr.set_defaults(fn=cmd_system, target="summaries")
 
     lic = sub.add_parser("license")
-    lic.add_argument("license_cmd", choices=["get", "put"])
-    lic.add_argument("file", nargs="?", default="")
-    lic.set_defaults(fn=cmd_license)
+    lic_sub = lic.add_subparsers(dest="license_cmd", required=True)
+    for name in ("get", "put"):
+        lp = lic_sub.add_parser(name)
+        lp.add_argument("file", nargs="?", default="")
+        lp.set_defaults(fn=cmd_license)
 
     # sentinel/quota: registered like the reference OSS build; the
     # server gates the features to Enterprise (command/commands.go
     # registers them unconditionally)
     sentinel = sub.add_parser("sentinel")
-    sentinel.add_argument(
-        "sentinel_cmd", choices=["apply", "delete", "list", "read"]
+    sentinel_sub = sentinel.add_subparsers(
+        dest="sentinel_cmd", required=True
     )
-    sentinel.add_argument("args", nargs=argparse.REMAINDER)
-    sentinel.set_defaults(fn=cmd_enterprise_gate, family="sentinel")
+    for name in ("apply", "delete", "list", "read"):
+        sn = sentinel_sub.add_parser(name)
+        sn.add_argument("args", nargs=argparse.REMAINDER)
+        sn.set_defaults(fn=cmd_enterprise_gate, family="sentinel")
     quota = sub.add_parser("quota")
-    quota.add_argument(
-        "quota_cmd",
-        choices=["apply", "delete", "init", "inspect", "list",
-                 "status"],
+    quota_sub = quota.add_subparsers(
+        dest="quota_cmd", required=True
     )
-    quota.add_argument("args", nargs=argparse.REMAINDER)
-    quota.set_defaults(fn=cmd_enterprise_gate, family="quota")
+    for name in ("apply", "delete", "init", "inspect", "list",
+                 "status"):
+        qp = quota_sub.add_parser(name)
+        qp.add_argument("args", nargs=argparse.REMAINDER)
+        qp.set_defaults(fn=cmd_enterprise_gate, family="quota")
 
     kg = sub.add_parser("keygen")
     kg.set_defaults(fn=cmd_operator_keygen)
@@ -1937,6 +2003,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     # hyphenated legacy aliases (the reference registers both forms,
     # command/commands.go: "node-status", "server-members", ...)
+    # deprecated alias for `node config` (reference commands.go:755
+    # registers client-config as the Old form of node config)
+    hcc = sub.add_parser("client-config")
+    _add_fmt(hcc)
+    hcc.add_argument("node_id")
+    hcc.set_defaults(fn=cmd_node_config)
     hns = sub.add_parser("node-status")
     hns.add_argument("node_id", nargs="?")
     _add_fmt(hns)

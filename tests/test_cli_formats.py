@@ -100,8 +100,8 @@ CASES = [
         None,
         "complete",
     ),
-    (lambda w: ["deployment", "-json", "list"], None, None),
-    (lambda w: ["deployment", "-json", "status"], None, None),
+    (lambda w: ["deployment", "list", "-json"], None, None),
+    (lambda w: ["deployment", "status", "-json"], None, None),
     (lambda w: ["namespace", "list", "-json"], None, None),
     (
         lambda w: ["namespace", "list", "-t", "{Name}"],
@@ -122,7 +122,7 @@ CASES = [
         None,
         None,
     ),
-    (lambda w: ["operator", "raft", "-json", "list-peers"], None, None),
+    (lambda w: ["operator", "raft", "list-peers", "-json"], None, None),
     (lambda w: ["agent-info", "-json"], None, None),
     (lambda w: ["volume", "status", "-json"], None, None),
     # hyphenated aliases carry the flags too
