@@ -841,6 +841,11 @@ def _preflight() -> None:
 
 
 def main():
+    from nomad_tpu.device_lock import align_jax_platforms
+
+    # honor an explicit CPU-only env even under a tunnel sitecustomize
+    # that pinned jax_platforms via config (config beats env)
+    align_jax_platforms()
     _preflight()
     oracle_rate, tpu_rate, p50, p99, same = bench_e2e()
     configs = bench_configs() if WITH_CONFIGS else {}

@@ -85,6 +85,9 @@ def bounded_jax_devices(timeout_s: Optional[float] = None):
     all."""
     import threading
 
+    from ..device_lock import align_jax_platforms
+
+    align_jax_platforms()
     if timeout_s is None:
         timeout_s = float(
             os.environ.get("NOMAD_TPU_FINGERPRINT_TIMEOUT_S", "20")

@@ -39,6 +39,13 @@ _state_lock = threading.Lock()
 _held_fd: int | None = None
 
 
+def _cpu_only(plats: str) -> bool:
+    """Whether a JAX_PLATFORMS value names ONLY the cpu backend —
+    the single parse shared by the lock gate and the config
+    alignment so the two can never disagree."""
+    return set(p.strip() for p in plats.split(",")) <= {"cpu"}
+
+
 def _needs_lock() -> bool:
     """Lock only when JAX_PLATFORMS explicitly names a non-CPU
     backend (tunneled single-chip deployments always set it, e.g.
@@ -50,7 +57,31 @@ def _needs_lock() -> bool:
     plats = os.environ.get("JAX_PLATFORMS", "")
     if not plats:
         return False
-    return not set(p.strip() for p in plats.split(",")) <= {"cpu"}
+    return not _cpu_only(plats)
+
+
+def align_jax_platforms() -> None:
+    """Make jax's CONFIG agree with an explicit ``JAX_PLATFORMS=cpu``.
+
+    A tunnel-plugin sitecustomize may pin ``jax_platforms`` via
+    ``jax.config`` at interpreter start, and config beats the env var
+    — so a process the operator explicitly marked CPU-only still
+    dials the tunneled accelerator the first time anything compiles
+    (background warm threads included), hanging on a wedged session
+    and adding contention that keeps it wedged.  Call before any jax
+    work in processes that honor the env contract."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if not plats:
+        return
+    if not _cpu_only(plats):
+        return  # only force the CPU-only case; never narrow axon
+    try:
+        import jax
+
+        if str(getattr(jax.config, "jax_platforms", "") or "") != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — alignment is best-effort
+        pass
 
 
 def scrub_accelerator_env(
@@ -88,6 +119,9 @@ def ensure_device_lock(
     exit so the OS releases it even on a crash."""
     global _held_fd
     if not _needs_lock():
+        # CPU-only by explicit env: also make jax's config agree so
+        # no background thread dials the tunnel anyway
+        align_jax_platforms()
         return True
     wait_env = os.environ.get(_LOCK_WAIT_ENV)
     if wait_env is not None:

@@ -30,7 +30,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..device_lock import align_jax_platforms
 from .score import MAX_SKIP, NO_NODE, SKIP_THRESHOLD, _pow10 as _pow10_f32
+
+# every kernel user funnels through this module: make jax's config
+# agree with an explicit JAX_PLATFORMS=cpu here so no code path can
+# dial a tunnel sitecustomize's pinned backend from a "CPU-only"
+# process (the config set at interpreter start beats the env var)
+align_jax_platforms()
 
 
 def pow2_bucket(n: int, floor: int = 1) -> int:

@@ -60,6 +60,9 @@ def make_mesh(
     """Build an (evals, nodes) mesh over the available devices.  When the
     default backend has fewer devices than requested, fall back to the
     CPU backend (virtual host devices for sharding tests)."""
+    from ..device_lock import align_jax_platforms
+
+    align_jax_platforms()
     devices = jax.devices(backend) if backend else jax.devices()
     if n_devices is not None and len(devices) < n_devices:
         try:
