@@ -69,6 +69,11 @@ function link(href, text) {
   return `<a href="#${esc(href)}">${text}</a>`;
 }
 function code(v) { return `<code>${esc(v).slice(0, 8)}</code>`; }
+function fmtTime(t, timeOnly) {
+  const s = new Date(1000 * (t || 0)).toISOString()
+    .replace("T", " ");
+  return esc(timeOnly ? s.slice(11, 19) : s.slice(0, 19));
+}
 function badge(s, good) {
   return `<span class="${good.includes(s) ? "ok" : "bad"}">` +
     esc(s) + "</span>";
@@ -303,8 +308,7 @@ function nodeView(id) {
     document.getElementById("ev").innerHTML =
       row(["Time", "Subsystem", "Message"], "th") +
       (n.events || []).slice().reverse().map(e => row([
-        esc(new Date(1000 * (e.timestamp || 0))
-          .toISOString().replace("T", " ").slice(0, 19)),
+        fmtTime(e.timestamp),
         esc(e.subsystem), esc(e.message)])).join("");
     document.getElementById("dv").innerHTML =
       row(["Vendor", "Type", "Name", "Instances"], "th") +
@@ -326,12 +330,123 @@ function nodeView(id) {
     renderMeters(allocs);
   });
 }
+// ---- allocation detail (the information of the reference's
+// ui/app/routes/allocations/allocation: facts, task states with
+// event history, allocated resources, live log tail) ---------------
 function allocView(id) {
-  view(`<h2>Allocation ${esc(id).slice(0,8)}</h2><pre id="d"></pre>`);
+  view(`<h2 id="ah">Allocation</h2><div id="facts"></div>
+    <h2>Tasks</h2><div id="tasks"></div>
+    <h2>Allocated resources</h2><table id="res"></table>
+    <h2>Logs <small id="logtask"></small></h2><pre id="logs"></pre>`);
+  let logTask = null;
   livePoll(`/v1/allocation/${id}`, a => {
-    document.getElementById("d").textContent =
-      JSON.stringify(a, null, 1).slice(0, 8000);
+    document.getElementById("ah").textContent =
+      `Allocation ${a.name || a.id.slice(0, 8)}`;
+    document.getElementById("facts").innerHTML = kvGrid([
+      ["ID", code(a.id)],
+      ["Job", link("/job/" + a.job_id, esc(a.job_id))],
+      ["Node", link("/node/" + a.node_id, code(a.node_id))],
+      ["Task Group", esc(a.task_group)],
+      ["Desired", esc(a.desired_status)],
+      ["Client", badge(a.client_status,
+        ["running", "complete"])],
+      ["Deployment", a.deployment_id
+        ? code(a.deployment_id) : ""],
+      ["Created", fmtTime(a.create_time)],
+    ]);
+    const states = a.task_states || {};
+    document.getElementById("tasks").innerHTML =
+      Object.entries(states).map(([name, st]) => {
+        const evs = (st.events || []).slice(-8).map(e =>
+          row([
+            fmtTime(e.time, true),
+            esc(e.type),
+            esc(e.display_message || e.message || ""),
+          ])
+        ).join("");
+        return `<div class="tgsum"><b>${esc(name)}</b> ${
+          badge(st.state, ["running"])}${
+          st.failed ? ' <span class="bad">failed</span>' : ""}
+          <table>${row(["Time", "Type", "Description"], "th")}${
+            evs}</table></div>`;
+      }).join("") || "<small>no task state yet</small>";
+    const tasks = (a.allocated_resources || {}).tasks || {};
+    const portsOf = nets => (nets || []).flatMap(nw =>
+      [...(nw.reserved_ports || []), ...(nw.dynamic_ports || [])]
+        .map(p => p.value).filter(Boolean));
+    const shared = (a.allocated_resources || {}).shared || {};
+    const sharedPorts = [
+      ...((shared.ports || []).map(p => p.value)),
+      ...portsOf(shared.networks),
+    ].filter(Boolean);
+    document.getElementById("res").innerHTML =
+      row(["Task", "CPU (MHz)", "Memory (MiB)", "Ports"], "th") +
+      Object.entries(tasks).map(([name, r]) => row([
+        esc(name), esc(r.cpu), esc(r.memory_mb),
+        esc(portsOf(r.networks).join(", ")),
+      ])).join("") +
+      (sharedPorts.length
+        ? row(["(group)", "", "",
+               esc(sharedPorts.join(", "))])
+        : "");
+    if (logTask === null) {
+      const names = Object.keys(states);
+      if (names.length) {
+        logTask = names[0];
+        document.getElementById("logtask").textContent =
+          `(${logTask} stdout)`;
+        tailLogs(id, logTask);
+      }
+    }
   });
+}
+async function tailLogs(allocId, task) {
+  // live chunked tail into the pre, bounded to the last ~16KB.
+  // The AbortController is tied to the route generation so a
+  // navigation kills the fetch even while read() is parked on an
+  // idle stream (otherwise each visit leaks a connection + a server
+  // thread until max_idle); the stream auto-reattaches if it ends
+  // while the view is still showing this alloc (task restarts).
+  const gen = generation;
+  const ctl = new AbortController();
+  const watchdog = setInterval(() => {
+    if (gen !== generation) {
+      clearInterval(watchdog);
+      ctl.abort();
+    }
+  }, 500);
+  try {
+    const r = await fetch(
+      `/v1/client/fs/logs/${allocId}?task=${
+        encodeURIComponent(task)}&type=stdout&follow=true`,
+      {signal: ctl.signal});
+    if (!r.ok || !r.body) return;
+    const reader = r.body.getReader();
+    const dec = new TextDecoder();
+    let text = "";
+    while (gen === generation) {
+      const {done, value} = await reader.read();
+      if (done || gen !== generation) break;
+      text = (text + dec.decode(value, {stream: true}))
+        .slice(-16384);
+      const pre = document.getElementById("logs");
+      if (!pre) break;
+      pre.textContent = text;
+    }
+    reader.cancel().catch(() => {});
+  } catch (e) { /* aborted, or alloc has no client connection */ }
+  finally {
+    clearInterval(watchdog);
+    ctl.abort();
+  }
+  if (gen === generation) {
+    // stream ended while still on this view (restart/GC/idle
+    // timeout): reattach after a beat rather than going silently
+    // stale under a still-ticking live indicator
+    setTimeout(() => {
+      if (gen === generation) tailLogs(allocId, task);
+    }, 2000);
+  }
 }
 
 // ---- router --------------------------------------------------------

@@ -154,3 +154,30 @@ def test_node_endpoints_match_ui_contract(ui_world):
     # registration event is recorded with the fields the UI renders
     ev = n["events"][0]
     assert {"message", "subsystem", "timestamp"} <= set(ev)
+
+
+def test_alloc_detail_view_renders_all_sections():
+    m = re.search(
+        r"function allocView\(id\) \{(.+?)\n\}", UI_HTML, re.S
+    )
+    assert m, "allocView missing from UI"
+    body = m.group(1)
+    for section_id in ("facts", "tasks", "res", "logs"):
+        assert f'id="{section_id}"' in body
+    assert "livePoll(`/v1/allocation/${id}`" in body
+    assert "JSON.stringify" not in body
+    # the live log tail rides the chunked follow endpoint
+    assert "tailLogs" in body
+    assert "/v1/client/fs/logs/" in UI_HTML
+
+
+def test_alloc_endpoint_matches_ui_contract(ui_world):
+    base = ui_world["base"]
+    allocs = json.loads(_get(base, "/v1/job/uijob/allocations")[0])
+    a = json.loads(
+        _get(base, f"/v1/allocation/{allocs[0]['id']}")[0]
+    )
+    for key in ("id", "name", "job_id", "node_id", "task_group",
+                "desired_status", "client_status", "task_states",
+                "create_time", "allocated_resources"):
+        assert key in a, key
