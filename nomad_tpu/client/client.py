@@ -193,6 +193,12 @@ class Client:
             a.id: a
             for a in self.server.store.allocs_by_node(self.node.id)
         }
+        # the remote store's watch call long-polls (up to ~20s), so a
+        # stopped client's parked poll can resolve AFTER stop()
+        # destroyed the runners and persisted state — acting on the
+        # result then would spawn orphaned tasks on a dead client
+        if self._stop.is_set():
+            return
         with self._lock:
             # removals / stops
             for alloc_id, runner in list(self.alloc_runners.items()):

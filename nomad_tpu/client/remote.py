@@ -39,7 +39,8 @@ from ..api.codec import (
 )
 
 
-def _req(base: str, method: str, path: str, body=None, timeout=10.0):
+def _req(base: str, method: str, path: str, body=None,
+         timeout=10.0, with_index=False):
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(
         base + path, data=data, method=method,
@@ -47,7 +48,11 @@ def _req(base: str, method: str, path: str, body=None, timeout=10.0):
     )
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         raw = resp.read()
-    return json.loads(raw or b"null")
+        idx = resp.headers.get("X-Nomad-Index") if with_index else None
+    payload = json.loads(raw or b"null")
+    if with_index:
+        return payload, (int(idx) if idx else 0)
+    return payload
 
 
 class RemoteStore:
@@ -59,11 +64,27 @@ class RemoteStore:
 
     def __init__(self, remote: "RemoteServer") -> None:
         self._remote = remote
+        # blocking-query cursor for the alloc watch (reference
+        # client.go watchAllocations rides blocking queries too): a
+        # long-poll with ?index=N&wait returns immediately on change
+        # and parks server-side otherwise — the client's 2/s tight
+        # poll becomes a handful of idle requests per minute
+        self._allocs_index = 0
 
     def allocs_by_node(self, node_id: str):
-        raw = self._remote._call(
-            "GET", f"/v1/node/{node_id}/allocations"
+        path = f"/v1/node/{node_id}/allocations"
+        if self._allocs_index:
+            path += f"?index={self._allocs_index}&wait=10"
+        # same transport as every other call: failover on
+        # connectivity, HTTPError is a real answer (no failover).
+        # Raft indexes are identical across replicas, so the cursor
+        # survives a server switch — a lagging follower just parks
+        # the poll until it catches up.
+        raw, idx = self._remote._call(
+            "GET", path, timeout=20.0, with_index=True
         )
+        if idx:
+            self._allocs_index = idx
         return [alloc_from_dict(a) for a in raw or []]
 
     def alloc_by_id(self, alloc_id: str):
@@ -120,13 +141,17 @@ class RemoteServer:
 
     # -- transport -----------------------------------------------------
 
-    def _call(self, method: str, path: str, body=None):
+    def _call(self, method: str, path: str, body=None,
+              timeout=10.0, with_index=False):
         last: Optional[Exception] = None
         n = len(self.servers)
         for k in range(n):
             i = (self._preferred + k) % n
             try:
-                out = _req(self.servers[i], method, path, body)
+                out = _req(
+                    self.servers[i], method, path, body,
+                    timeout=timeout, with_index=with_index,
+                )
                 self._preferred = i
                 return out
             except urllib.error.HTTPError:
