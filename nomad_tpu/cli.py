@@ -197,6 +197,8 @@ def cmd_agent(args) -> None:
         argv = ["--servers", servers]
         if args.data_dir:
             argv += ["--data-dir", args.data_dir]
+        if args.callback_host:
+            argv += ["--callback-host", args.callback_host]
         raise SystemExit(netclient_main(argv))
 
     if getattr(args, "server_addr", None):
@@ -1562,6 +1564,12 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_argument(
         "-servers", default="", dest="servers",
         help="comma-separated server HTTP addresses for -client",
+    )
+    agent.add_argument(
+        "-callback-host", default="", dest="callback_host",
+        help="address the SERVERS can reach this client on for "
+        "fs/exec/logs proxying (cross-host clients must set it; "
+        "default 127.0.0.1 only works same-box)",
     )
     agent.add_argument(
         "-data-dir", default="", dest="data_dir",

@@ -482,6 +482,40 @@ class Client:
         cwd = tr.task_dir.local_dir if tr.task_dir is not None else ""
         return tr, env, cwd
 
+    def read_task_log(
+        self, alloc_id: str, task: str, kind: str = "stdout",
+        max_bytes: int = 64 * 1024,
+    ) -> bytes:
+        """Last ``max_bytes`` of a task log from THIS client's disk
+        (rotated logmon layout first, flat legacy second) — the
+        non-follow read the server-side proxy forwards for
+        `alloc logs` on remote clients."""
+        import os as _os
+
+        from .logmon import read_task_log as _read_rotated
+
+        if not self.data_dir:
+            raise KeyError("client has no data dir")
+        # no existence check: the alloc dir appears moments after
+        # placement, and the in-process proxy semantics have always
+        # been "empty until the task writes" (callers poll)
+        root = _os.path.join(self.data_dir, "allocs", alloc_id)
+        data = _read_rotated(
+            _os.path.join(root, "alloc", "logs"), task, kind,
+            max_bytes,
+        )
+        if data:
+            return data
+        path = _os.path.join(root, f"{task}.{kind}")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, _os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                return f.read()
+        except OSError:
+            return b""
+
     def tail_task_log(
         self, alloc_id: str, task: str, kind: str, cursor
     ):

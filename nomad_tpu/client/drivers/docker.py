@@ -435,7 +435,8 @@ class DockerDriver(DriverPlugin):
             max_file_size_mb=max_size_mb,
         )
         stop = threading.Event()
-        self._docklogs[task_id] = stop
+        drained = threading.Event()
+        self._docklogs[task_id] = (stop, drained)
 
         def on_frame(stream: int, payload: bytes) -> None:
             (lm.stderr if stream == 2 else lm.stdout).write(payload)
@@ -445,10 +446,22 @@ class DockerDriver(DriverPlugin):
                 self.api.stream_logs(cid, on_frame, stop)
             finally:
                 lm.close()
+                drained.set()
 
         threading.Thread(
             target=run, name=f"docklog-{task_name}", daemon=True
         ).start()
+
+    def _finish_docklog(self, task_id: str) -> None:
+        """Give the companion a grace window to drain to EOF, then
+        stop it as a backstop (a wedged daemon connection must not
+        pin the waiter)."""
+        entry = self._docklogs.pop(task_id, None)
+        if entry is None:
+            return
+        stop, drained = entry
+        drained.wait(timeout=2.0)
+        stop.set()
 
     def start_task(self, cfg: TaskConfig) -> DriverHandle:
         if not self._daemon_reachable():
@@ -509,9 +522,11 @@ class DockerDriver(DriverPlugin):
                 code = self.api.wait_container(cid)
             except Exception:  # noqa: BLE001
                 code = -1
-            stop = self._docklogs.pop(cfg.id, None)
-            if stop is not None:
-                stop.set()
+            # drain the docklog BEFORE cutting it: the daemon closes
+            # the follow stream at container exit, so the companion
+            # reaches EOF on its own — stopping it immediately would
+            # drop the task's final buffered frames from the rotators
+            self._finish_docklog(cfg.id)
             handle.set_exit(TaskExitResult(exit_code=code))
             # emulate the CLI path's --rm: the exited container's
             # logs already live in the rotators, so free the name and
@@ -583,9 +598,9 @@ class DockerDriver(DriverPlugin):
                 )
             except (DockerAPIError, OSError):
                 pass
-        stop = self._docklogs.pop(task_id, None)
-        if stop is not None:
-            stop.set()
+        entry = self._docklogs.pop(task_id, None)
+        if entry is not None:
+            entry[0].set()
         self.handles.pop(task_id, None)
 
     def inspect_task(self, task_id):
@@ -640,9 +655,7 @@ class DockerDriver(DriverPlugin):
                 code = self.api.wait_container(container)
             except Exception:  # noqa: BLE001
                 code = 0
-            stop = self._docklogs.pop(task_id, None)
-            if stop is not None:
-                stop.set()
+            self._finish_docklog(task_id)
             handle.set_exit(TaskExitResult(exit_code=code))
             try:
                 self.api.remove_container(container, force=True)

@@ -135,6 +135,8 @@ class RemoteServer:
         self._preferred = 0
         self.callback_host = callback_host
         self._endpoint: Optional[ClientEndpoint] = None
+        self._announced_node = ""
+        self._last_announce = 0.0
         self.catalog = None
 
         self.store = RemoteStore(self)
@@ -174,7 +176,32 @@ class RemoteServer:
         )
 
     def heartbeat(self, node_id: str) -> None:
-        self._call("POST", f"/v1/node/{node_id}/heartbeat", {})
+        try:
+            self._call(
+                "POST", f"/v1/node/{node_id}/heartbeat", {}
+            )
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                # unknown node: surface the in-process contract
+                # (KeyError) so Client._heartbeat_loop re-registers
+                # instead of heartbeating into 404s forever
+                raise KeyError(node_id)
+            raise
+        # the callback registry is per-server-process MEMORY: a
+        # server restarted after our Client.start() has no proxy for
+        # this node until we re-announce.  Piggyback on the heartbeat
+        # cadence, cheaply.
+        import time as _time
+
+        if (
+            self._endpoint is not None
+            and self._announced_node
+            and _time.monotonic() - self._last_announce > 30.0
+        ):
+            try:
+                self.register_client(self._announced_node, None)
+            except Exception:  # noqa: BLE001 — next beat retries
+                pass
 
     def update_allocs_from_client(self, updates) -> None:
         if not updates:
@@ -196,6 +223,10 @@ class RemoteServer:
                 client, host=self.callback_host
             )
             self._endpoint.start()
+        import time as _time
+
+        self._announced_node = node_id
+        self._last_announce = _time.monotonic()
         body = {
             "NodeID": node_id,
             "Addr": (
