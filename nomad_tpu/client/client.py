@@ -105,6 +105,10 @@ class Client:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # periodic driver re-fingerprint cadence (reference
+        # FingerprintManager); tests shrink it
+        self.refingerprint_interval = 30.0
+        self._fingerprint_dirty = False
 
     # ------------------------------------------------------------------
 
@@ -144,6 +148,7 @@ class Client:
     # ------------------------------------------------------------------
 
     def _heartbeat_loop(self) -> None:
+        last_refingerprint = time.monotonic()
         while not self._stop.wait(self.heartbeat_interval):
             try:
                 self.server.heartbeat(self.node.id)
@@ -154,6 +159,65 @@ class Client:
             except Exception:  # noqa: BLE001
                 # unreachable servers: the stopper's clock keeps aging
                 pass
+            # periodic driver re-fingerprint (reference
+            # FingerprintManager runs fingerprinters on an interval
+            # and diffs into node updates): a docker daemon that
+            # starts or dies after agent boot flips the node's driver
+            # attributes so placement follows reality
+            now = time.monotonic()
+            if now - last_refingerprint >= (
+                self.refingerprint_interval
+            ):
+                last_refingerprint = now
+                self._refingerprint_drivers()
+
+    def _refingerprint_drivers(self) -> None:
+        """One re-fingerprint cycle.  Per-driver isolation (a raising
+        driver reads as dead, not as aborting the sweep), attribute
+        REPLACEMENT for the driver.* namespace (a dead daemon's stale
+        version keys don't linger), atomic dict swap (in-process
+        readers share this Node by reference), a recomputed
+        computed_class (class-keyed eligibility caches and blocked-
+        eval unblocking must see the new shape), and a dirty flag so
+        a failed register retries next cycle even when the attrs
+        didn't change again."""
+        from ..structs import compute_node_class
+
+        new_attrs: Dict[str, str] = {}
+        for name, driver in self.drivers.items():
+            try:
+                new_attrs.update(driver.fingerprint())
+                new_attrs.setdefault(f"driver.{name}", "1")
+            except Exception:  # noqa: BLE001
+                new_attrs[f"driver.{name}"] = "0"
+        old_attrs = {
+            k: v
+            for k, v in self.node.attributes.items()
+            if k.startswith("driver.")
+        }
+        if new_attrs == old_attrs and not self._fingerprint_dirty:
+            return
+        merged = {
+            k: v
+            for k, v in self.node.attributes.items()
+            if not k.startswith("driver.")
+        }
+        merged.update(new_attrs)
+        # single reference assignment: concurrent readers iterate
+        # either the old or the new dict, never a mutating one
+        self.node.attributes = merged
+        for name in self.drivers:
+            self.node.drivers[name] = (
+                new_attrs.get(f"driver.{name}") == "1"
+            )
+        self.node.computed_class = compute_node_class(self.node)
+        try:
+            self.server.register_node(self.node)
+            self._fingerprint_dirty = False
+        except Exception:  # noqa: BLE001
+            # delivery failed: retry next cycle even if nothing
+            # changes again (the local dict already holds the truth)
+            self._fingerprint_dirty = True
 
     def _stop_alloc_local(self, alloc_id: str) -> None:
         """Kill an alloc locally after server contact loss exceeds its

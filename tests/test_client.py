@@ -314,3 +314,56 @@ def test_client_stop_job_stops_tasks(cluster):
     assert wait_until(
         lambda: len(client.running_allocs()) == 0, timeout=10
     )
+
+
+def test_driver_refingerprint_updates_node(cluster):
+    """A driver whose daemon appears after boot flips the node's
+    driver attribute (and class hash) on the periodic re-fingerprint;
+    a driver that dies by RAISING reads as dead (reference
+    FingerprintManager interval + updateNodeFromFingerprint)."""
+    server, add_client = cluster
+    c = add_client(watch_interval=0.05)
+    c.heartbeat_interval = 0.05
+    c.refingerprint_interval = 0.1
+
+    class FlippyDriver:
+        name = "flippy"
+        healthy = False
+        boom = False
+
+        def fingerprint(self):
+            if self.boom:
+                raise RuntimeError("daemon gone")
+            if self.healthy:
+                return {
+                    "driver.flippy": "1",
+                    "driver.flippy.version": "9.9",
+                }
+            return {"driver.flippy": "0"}
+
+    drv = FlippyDriver()
+    c.drivers["flippy"] = drv
+    class_before = c.node.computed_class
+    drv.healthy = True
+
+    def attr(key):
+        n = server.store.node_by_id(c.node.id)
+        return n.attributes.get(key) if n else None
+
+    assert wait_until(
+        lambda: attr("driver.flippy") == "1", timeout=10
+    )
+    assert attr("driver.flippy.version") == "9.9"
+    # the class hash follows the attribute change so class-keyed
+    # eligibility caches and blocked-eval unblocking see a new shape
+    assert (
+        server.store.node_by_id(c.node.id).computed_class
+        != class_before
+    )
+    # a raising driver flips to dead AND its stale version key is
+    # dropped (attribute replacement, not merge)
+    drv.boom = True
+    assert wait_until(
+        lambda: attr("driver.flippy") == "0", timeout=10
+    )
+    assert attr("driver.flippy.version") is None
