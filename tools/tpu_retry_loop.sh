@@ -23,7 +23,9 @@ while true; do
     timeout 3600 python bench.py > /tmp/bench_try.out 2> /tmp/bench_try.err
   rc=$?
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  tail_line=$(tail -n 1 /tmp/bench_try.err 2>/dev/null)
+  # first matching diagnostic, NOT the raw tail — bench.py echoes this
+  # very log on failure and recording that would nest it recursively
+  tail_line=$(grep -m1 -E "unreachable|preflight: fatal|device ok"     /tmp/bench_try.err 2>/dev/null | head -c 160)
   echo "[$ts] attempt $n: rc=$rc ${tail_line}" >> "$LOG"
   if [ $rc -eq 0 ]; then
     cp /tmp/bench_try.out "$OUT"
