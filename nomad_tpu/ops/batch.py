@@ -359,6 +359,12 @@ def _run_picks(
     dev_free=None,  # i32[D, C] node-space free counts at eval start
     dev_aff=None,  # f[T, C] device-affinity score per node (static)
     dev_aff_on=None,  # bool[T] ask has device affinities (weight != 0)
+    occ_extra=None,  # i32[C] distinct_hosts occupancy from job groups
+                     # placing NOTHING this eval (their allocs are
+                     # outside the T axis but still block the node)
+    dh_tg=None,  # bool[T] GROUP-level distinct_hosts: block only on
+                 # the picking group's own allocs (feasible.py
+                 # _satisfies: job_collision AND task_collision)
 ):
     """Inner pick scan; returns (rows i32[P], final used columns).
 
@@ -411,6 +417,9 @@ def _run_picks(
         devs_p0 = jnp.take(dev_free, perm, axis=1)  # (D, C)
     if dev_aff is not None:
         dev_aff_p = jnp.take(dev_aff, perm, axis=1)  # (T, C)
+    occ_extra_p = (
+        jnp.take(occ_extra, perm) if occ_extra is not None else None
+    )
     safe_cpu = jnp.where(cpu_total_p > 0, cpu_total_p, 1.0)
     safe_mem = jnp.where(mem_total_p > 0, mem_total_p, 1.0)
 
@@ -489,19 +498,23 @@ def _run_picks(
             & (mem_after <= mem_total_p)
             & (disk_after <= disk_total_p)
         )
-        # distinct_hosts: a node is infeasible while any proposed alloc
-        # of the job occupies it (feasible.go:470 DistinctHostsIterator).
-        # For the single-task-group jobs the batch path admits the
-        # collision carry IS the proposed-allocs-per-node count:
-        # existing live allocs at the snapshot, +1 per pick, -1 per
-        # staged destructive eviction — summing the group axis keeps
-        # that exact (every job alloc lives in exactly one group;
-        # multi-group jobs WITH distinct_hosts stay on the sequential
-        # path host-side).
+        # distinct_hosts (feasible.go:470 DistinctHostsIterator,
+        # both scopes): the collision carries ARE the proposed-
+        # allocs-per-node counts — live allocs at the snapshot, +1
+        # per pick, -1 per staged destructive eviction.  JOB-level
+        # scope blocks on any proposed job alloc: the summed carries
+        # plus occ_extra (groups placing nothing this eval).
+        # GROUP-level scope blocks only on the picking group's own
+        # carry; multi-group jobs with ONLY group-level constraints
+        # ship dh_tg and leave inp.distinct_hosts False.
         occupancy = collisions.sum(axis=0)
+        if occ_extra_p is not None:
+            occupancy = occupancy + occ_extra_p
         feasible = feas_tp[t] & fit & ~(
             inp.distinct_hosts & (occupancy > 0)
         )
+        if dh_tg is not None:
+            feasible = feasible & ~(dh_tg[t] & (coll_t > 0))
         if ports_on:
             # static-port collision: skipped WITHOUT consuming a
             # walk-limit slot (rank.go network path `continue`) —
@@ -894,6 +907,8 @@ def chained_plan_picks_cols(
     dev_free0=None,  # i32[D, C] free instances at the chain snapshot
     dev_aff=None,  # f[E, T, C] device-affinity score per node
     dev_aff_on=None,  # bool[E, T]
+    occ0=None,  # i32[E, C] pickless-group distinct_hosts occupancy
+    dh_tg=None,  # bool[E, T] group-level distinct_hosts flags
 ):
     """Serially-equivalent chained planner over shared node columns —
     the BatchWorker's production launch.  Semantics identical to
@@ -916,7 +931,7 @@ def chained_plan_picks_cols(
         (dev_aff, dev_aff_on) if dev_aff is not None else None
     )
     for x in (coll0, affinity, spread, deltas, pre, port_ask,
-              dev_ask, dev_aff_pair):
+              dev_ask, dev_aff_pair, occ0, dh_tg):
         pattern.append(x is not None)
         if x is not None:
             parts.append(x)
@@ -935,6 +950,8 @@ def chained_plan_picks_cols(
         daff, daff_on = (
             next(it) if pattern[7] else (None, None)
         )
+        oc = next(it) if pattern[8] else None
+        dhg = next(it) if pattern[9] else None
         if p is not None:
             used = (
                 used[0].at[p.rows].add(p.cpu.astype(used[0].dtype)),
@@ -976,7 +993,7 @@ def chained_plan_picks_cols(
                 n_picks, spread_fit, wanted=xs[2], spread=s,
                 deltas=d, tg=tg_in, port_ask=pa, port_used=ports,
                 dev_ask=da, dev_free=devs, dev_aff=daff,
-                dev_aff_on=daff_on,
+                dev_aff_on=daff_on, occ_extra=oc, dh_tg=dhg,
             )
             return (
                 used_next,
@@ -987,6 +1004,7 @@ def chained_plan_picks_cols(
             cpu_total, mem_total, disk_total, used, inp, xs[1],
             n_picks, spread_fit, wanted=xs[2], spread=s, deltas=d,
             tg=tg_in, dev_aff=daff, dev_aff_on=daff_on,
+            occ_extra=oc, dh_tg=dhg,
         )
         return (used_next, None, None), (rows, pulls)
 

@@ -107,6 +107,9 @@ class _Sim:
     pick_tg: List[int] = field(default_factory=list)
     # anti-affinity base per group slot: [T, C] (None when all zero)
     base_collisions: Optional[np.ndarray] = None
+    # distinct_hosts occupancy from job groups placing NOTHING this
+    # eval: their live allocs block nodes but have no T-axis slot
+    occ_extra: Optional[np.ndarray] = None
     # static host ports asked per group slot (kernel collision mask)
     asked_ports: List[FrozenSet[int]] = field(default_factory=list)
     # host ports freed by this eval's staged stops/evictions — if any
@@ -815,12 +818,10 @@ class BatchWorker(Worker):
                 tg.spreads for tg in job.task_groups
             ):
                 return False
-            if any(
-                c.operand == CONSTRAINT_DISTINCT_HOSTS
-                for c in list(job.constraints)
-                + [c for tg in job.task_groups for c in tg.constraints]
-            ):
-                return False
+            # multi-TG + distinct_hosts runs in-kernel (r5): the
+            # job-wide occupancy sums the per-group collision carries
+            # PLUS an occ_extra column covering groups that place
+            # nothing this eval
         for tg in job.task_groups:
             # both spread modes run in-kernel: percent targets via the
             # desired/used carry, even mode (no targets) via min/max
@@ -1066,15 +1067,39 @@ class BatchWorker(Worker):
         coll = np.zeros(
             (max(1, len(sim.tgs)), table.capacity), dtype=np.int32
         )
+        occ_extra = np.zeros(table.capacity, dtype=np.int32)
         for a in allocs:
             if a.terminal_status() or a.id in evicted_ids:
                 continue
+            if a.job_id != job.id:
+                continue
             slot = tg_slot.get(a.task_group)
-            if a.job_id == job.id and slot is not None:
-                row = table.row_of.get(a.node_id)
-                if row is not None:
-                    coll[slot, row] += 1
+            row = table.row_of.get(a.node_id)
+            if row is None:
+                continue
+            if slot is not None:
+                coll[slot, row] += 1
+            else:
+                # a group placing nothing this eval: its allocs still
+                # occupy the node for distinct_hosts (the sequential
+                # DistinctHostsIterator counts ALL proposed job
+                # allocs, feasible.go:470)
+                occ_extra[row] += 1
         sim.base_collisions = coll
+        # ship the extra occupancy ONLY when a job-level
+        # distinct_hosts will read it: ordinary multi-TG scale-ups
+        # must not mint a new launch-shape variant (cold compile ->
+        # whole-batch sequential fallback) for an input the kernel
+        # would ignore
+        job_level_dh = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in job.constraints
+        )
+        sim.occ_extra = (
+            occ_extra
+            if job_level_dh and occ_extra.any()
+            else None
+        )
 
         for missing in placements:
             p_tg = missing.task_group
@@ -1660,11 +1685,34 @@ class BatchWorker(Worker):
                     )
             spread_per_eval.append(eval_spreads)
 
-            distinct_hosts = any(
+            # distinct_hosts scopes (feasible.py _satisfies): JOB-
+            # level blocks on any job alloc; GROUP-level only on the
+            # picking group's own.  Single-group jobs merge (group ==
+            # job there, and it keeps the historical trace shape);
+            # multi-group jobs split into the job-wide scalar and a
+            # per-group dh_tg vector
+            job_dh = any(
                 c.operand == CONSTRAINT_DISTINCT_HOSTS
-                for c in list(job.constraints)
-                + [c for g in tgs for c in g.constraints]
+                for c in job.constraints
             )
+            tg_dh = [
+                any(
+                    c.operand == CONSTRAINT_DISTINCT_HOSTS
+                    for c in g.constraints
+                )
+                for g in tgs
+            ]
+            if len(tgs) == 1:
+                distinct_hosts = job_dh or tg_dh[0]
+                dh_tg_vec = None
+            else:
+                distinct_hosts = job_dh
+                # job-wide blocking subsumes group-level
+                dh_tg_vec = (
+                    np.asarray(tg_dh, dtype=bool)
+                    if any(tg_dh) and not job_dh
+                    else None
+                )
             base_limit = compute_visit_limit(
                 n_cand, ev.type == "batch"
             )
@@ -1699,6 +1747,8 @@ class BatchWorker(Worker):
                         else None
                     ),
                     dev_aff_on=list(dev_aff_on_t),
+                    occ0=sim.occ_extra,
+                    dh_tg=dh_tg_vec,
                     coll0=(
                         sim.base_collisions
                         if sim.base_collisions is not None
@@ -1802,6 +1852,18 @@ class BatchWorker(Worker):
                     affinity[k, : e["affinity"].shape[0]] = (
                         e["affinity"]
                     )
+        occ0 = None
+        if any(e["occ0"] is not None for e in per_eval):
+            occ0 = np.zeros((E, C), np.int32)
+            for k, e in enumerate(per_eval):
+                if e["occ0"] is not None:
+                    occ0[k] = e["occ0"]
+        dh_tg = None
+        if any(e["dh_tg"] is not None for e in per_eval):
+            dh_tg = np.zeros((E, T), dtype=bool)
+            for k, e in enumerate(per_eval):
+                if e["dh_tg"] is not None:
+                    dh_tg[k, : len(e["dh_tg"])] = e["dh_tg"]
         dev_aff = None
         dev_aff_on = None
         if any(e["dev_aff"] is not None for e in per_eval):
@@ -1994,6 +2056,8 @@ class BatchWorker(Worker):
             dev_free0=dev_free0,
             dev_aff=dev_aff,
             dev_aff_on=dev_aff_on,
+            occ0=occ0,
+            dh_tg=dh_tg,
         )
         use_mesh = (
             self._mesh is not None
@@ -2001,6 +2065,8 @@ class BatchWorker(Worker):
             and port_ask_arr is None
             and dev_ask_arr is None
             and dev_aff is None
+            and occ0 is None
+            and dh_tg is None
             and C % self._mesh.devices.size == 0
         )
         if use_mesh:

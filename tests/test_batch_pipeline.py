@@ -1794,3 +1794,148 @@ def test_batch_pipeline_mixed_group_device_affinity():
     finally:
         seq.stop()
         bat.stop()
+
+
+def test_batch_pipeline_multi_tg_distinct_hosts():
+    """Multi-task-group jobs WITH distinct_hosts run the prescored
+    path (r5): the job-wide occupancy = per-group collision carries +
+    an occ_extra column for groups placing nothing this eval.  The
+    second eval (scaling ONE group) must see the other group's
+    existing allocs as occupied nodes, bit-identically to the
+    sequential scheduler."""
+    from nomad_tpu.structs import (
+        CONSTRAINT_DISTINCT_HOSTS,
+        Constraint,
+        Resources,
+        Task,
+        TaskGroup,
+    )
+
+    nodes = make_nodes(10, seed=5)
+    seq = Server(num_schedulers=1, seed=13, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=13, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        def dh_job(count_a, count_b):
+            job = mock.job(id="dh-multi")
+            job.constraints.append(
+                Constraint(operand=CONSTRAINT_DISTINCT_HOSTS)
+            )
+            ga = job.task_groups[0]
+            ga.name = "a"
+            ga.count = count_a
+            ga.tasks[0].resources.cpu = 100
+            job.task_groups.append(
+                TaskGroup(
+                    name="b",
+                    count=count_b,
+                    tasks=[
+                        Task(
+                            name="t",
+                            driver="mock_driver",
+                            resources=Resources(
+                                cpu=100, memory_mb=64
+                            ),
+                        )
+                    ],
+                )
+            )
+            return job
+
+        for server in (seq, bat):
+            server.register_job(copy.deepcopy(dh_job(3, 3)))
+            assert server.drain_to_idle(30)
+        assert placements(seq, "dh-multi") == placements(
+            bat, "dh-multi"
+        )
+        assert len(placements(bat, "dh-multi")) == 6
+        # scale ONLY group b: group a's allocs have no picks this
+        # eval and must still block their nodes (occ_extra)
+        for server in (seq, bat):
+            job2 = dh_job(3, 6)
+            job2.version = 1
+            server.register_job(copy.deepcopy(job2))
+            assert server.drain_to_idle(30)
+        p_seq = placements(seq, "dh-multi")
+        p_bat = placements(bat, "dh-multi")
+        assert p_seq == p_bat
+        assert len(p_bat) == 9
+        # distinct_hosts really held: no node carries two allocs
+        nodes_used = [n for _name, n in p_bat]
+        assert len(nodes_used) == len(set(nodes_used))
+        worker = bat.workers[0]
+        assert worker.prescored >= 2, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_batch_pipeline_group_level_distinct_hosts():
+    """GROUP-level distinct_hosts has per-group semantics (feasible.py
+    _satisfies: job AND task collision): group A's picks avoid only
+    A's own allocs while group B packs freely — the kernel's dh_tg
+    mask must reproduce the sequential scheduler bit for bit, NOT
+    job-wide blocking."""
+    from nomad_tpu.structs import (
+        CONSTRAINT_DISTINCT_HOSTS,
+        Constraint,
+        Resources,
+        Task,
+        TaskGroup,
+    )
+
+    nodes = make_nodes(4, seed=8)
+    seq = Server(num_schedulers=1, seed=19, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=19, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+
+        job = mock.job(id="dh-group")
+        ga = job.task_groups[0]
+        ga.name = "a"
+        ga.count = 4  # one per node: group-level distinct
+        ga.constraints.append(
+            Constraint(operand=CONSTRAINT_DISTINCT_HOSTS)
+        )
+        ga.tasks[0].resources.cpu = 100
+        job.task_groups.append(
+            TaskGroup(
+                name="b",
+                count=6,  # MORE than nodes: must co-locate with a's
+                tasks=[
+                    Task(
+                        name="t",
+                        driver="mock_driver",
+                        resources=Resources(cpu=100, memory_mb=64),
+                    )
+                ],
+            )
+        )
+        for server in (seq, bat):
+            server.register_job(copy.deepcopy(job))
+            assert server.drain_to_idle(30)
+        p_seq = placements(seq, "dh-group")
+        p_bat = placements(bat, "dh-group")
+        assert p_seq == p_bat
+        assert len(p_bat) == 10  # 4 + 6: B placed despite A's spread
+        # A's allocs really are one-per-node; B co-locates freely
+        a_nodes = [n for name, n in p_bat if ".a[" in name]
+        assert len(a_nodes) == len(set(a_nodes)) == 4
+        worker = bat.workers[0]
+        assert worker.prescored >= 1, (
+            worker.prescored, worker.fallbacks, worker.errors,
+        )
+    finally:
+        seq.stop()
+        bat.stop()
