@@ -82,6 +82,11 @@ class SpreadInputs(NamedTuple):
     # balance boost over the observed use map, UNWEIGHTED (the oracle
     # adds evenSpreadScoreBoost without the weight fraction)
     even: jnp.ndarray = None  # bool[S]
+    # owning group slot per stanza (propertysets are GROUP-scoped —
+    # propertyset.py:151 filters to one task group): pick k of group t
+    # scores with and updates ONLY slots where group == t.  None (the
+    # single-group trace) means every slot applies to every pick.
+    group: jnp.ndarray = None  # i32[S]
 
 
 class TGInputs(NamedTuple):
@@ -479,10 +484,16 @@ def _run_picks(
             if spread is not None:
                 # the evicted alloc's value slot gains one cleared use
                 # (its stop is staged into plan.node_update just before
-                # this pick — propertyset counts it as cleared)
+                # this pick — propertyset counts it as cleared).  A
+                # destructive eviction replaces an alloc of the PICKING
+                # group, so group-scoped slots of other groups are
+                # untouched
                 evict_slot = spread.codes[:, jnp.maximum(erow, 0)]
+                app_slot = jnp.asarray(app)
+                if spread.group is not None:
+                    app_slot = (app & (spread.group == t))[:, None]
                 spread_clr = spread_clr + jnp.where(
-                    app,
+                    app_slot,
                     jax.nn.one_hot(evict_slot, V1, dtype=dtype),
                     0.0,
                 )
@@ -579,11 +590,16 @@ def _run_picks(
             # boost per stanza: ((desired - (used+1)) / desired) * w,
             # -1.0 on the penalty slot (spread.py next()); appended
             # to the score list only when the total is non-zero —
-            # shared implementation with the sharded planner
+            # shared implementation with the sharded planner.  For
+            # multi-group evals only the picking group's slots score
+            # (group-scoped propertysets)
+            slot_active = spread.active
+            if spread.group is not None:
+                slot_active = slot_active & (spread.group == t)
             spread_total = spread_contribution(
                 onehot_p, desired_node, penalty_node, safe_desired,
                 spread_existing, spread_prop, spread_clr,
-                spread.weight, spread.active, spread.even, dtype,
+                spread.weight, slot_active, spread.even, dtype,
             )
             has_spread = spread_total != 0.0
             score_sum = score_sum + spread_total
@@ -631,9 +647,13 @@ def _run_picks(
             )
         if spread is not None:
             # the placed node's value slot gains one proposed use per
-            # stanza
+            # stanza — of the PICKING group only, when group-scoped
+            slot_ok = jnp.asarray(ok)
+            if spread.group is not None:
+                slot_ok = ok & (spread.group == t)
+                slot_ok = slot_ok[:, None]
             out["spread_prop"] = spread_prop + jnp.where(
-                ok, onehot_p[:, safe_win, :], 0.0
+                slot_ok, onehot_p[:, safe_win, :], 0.0
             )
             out["spread_clr"] = spread_clr
         return out, (row, app, pulls)

@@ -134,14 +134,14 @@ class _Sim:
     # candidate count
     replay_order: Optional[np.ndarray] = None
     replay_n_cand: int = 0
-    # propertyset state per spread attribute: value -> count
-    spread_existing: Dict[str, Dict[str, int]] = field(
+    # propertyset state per (group, spread attribute): value -> count
+    spread_existing: Dict[tuple, Dict[str, int]] = field(
         default_factory=dict
     )
-    spread_cleared: Dict[str, Dict[str, int]] = field(
+    spread_cleared: Dict[tuple, Dict[str, int]] = field(
         default_factory=dict
     )
-    spread_proposed: Dict[str, Dict[str, int]] = field(
+    spread_proposed: Dict[tuple, Dict[str, int]] = field(
         default_factory=dict
     )
 
@@ -806,22 +806,10 @@ class BatchWorker(Worker):
             return False
         if ev.type not in ("service", "batch"):
             return False
-        multi_tg = len(job.task_groups) > 1
-        if multi_tg:
-            # the per-pick group routing (ops/batch.py TGInputs)
-            # covers plain multi-group jobs; spreads stay sequential
-            # there (each group's propertyset filters its own allocs —
-            # a per-group carry the kernel doesn't model yet) and so
-            # does distinct_hosts (the job-wide occupancy would need
-            # base counts for groups with no picks this eval)
-            if list(job.spreads) or any(
-                tg.spreads for tg in job.task_groups
-            ):
-                return False
-            # multi-TG + distinct_hosts runs in-kernel (r5): the
-            # job-wide occupancy sums the per-group collision carries
-            # PLUS an occ_extra column covering groups that place
-            # nothing this eval
+        # multi-task-group jobs run in-kernel in full (r5): per-pick
+        # group routing (TGInputs), distinct_hosts in both scopes
+        # (occ_extra + dh_tg), and GROUP-scoped spread slots routed by
+        # SpreadInputs.group
         for tg in job.task_groups:
             # both spread modes run in-kernel: percent targets via the
             # desired/used carry, even mode (no targets) via min/max
@@ -929,52 +917,49 @@ class BatchWorker(Worker):
         sim = _Sim(placements=0)
         table = snap.node_table
 
-        # spreads only reach here for single-group jobs (_batchable
-        # keeps multi-group + spread evals on the sequential path)
-        tg = job.task_groups[0]
-        combined_spreads = list(tg.spreads) + list(job.spreads)
-        if len(job.task_groups) > 1:
-            combined_spreads = []
-        if combined_spreads:
-            # propertyset bookkeeping for the in-kernel spread carry
-            # (propertyset.go): existing = live allocs of the job
-            # (tg-filtered) per attribute value; cleared = the plan's
-            # staged stops per value (terminal ones included, matching
-            # _filter(stopping, filter_terminal=False)).  Per-pick
-            # destructive evictions extend cleared inside the kernel.
-            sim.spread_existing = {}
-            sim.spread_cleared = {}
-            sim.spread_proposed = {}
+        # spread propertyset bookkeeping, GROUP-scoped like the
+        # sequential SpreadIterator (propertyset.py:151 filters each
+        # pset to one task group; job-level stanzas get one pset PER
+        # group).  State is keyed (group, attribute); single-group
+        # jobs collapse to the historical shape.
+        for g in job.task_groups:
+            g_spreads = list(g.spreads) + list(job.spreads)
+            if not g_spreads:
+                continue
+            # existing = the job's live allocs of THIS group per
+            # attribute value; cleared = staged stops (terminal ones
+            # included, matching _filter(stopping,
+            # filter_terminal=False)); proposed = in-place/attribute
+            # updates entering plan.node_allocation before any select
+            # (generic_sched.py:287-294)
             live = [
                 a
                 for a in allocs
-                if not a.terminal_status() and a.task_group == tg.name
+                if not a.terminal_status()
+                and a.task_group == g.name
             ]
             stopping = [
                 a
                 for stops in plan.node_update.values()
                 for a in stops
-                if a.task_group == tg.name
+                if a.task_group == g.name
             ]
-            # in-place/attribute updates enter plan.node_allocation
-            # before any select (generic_sched.py:287-294) — the
-            # reference counts those allocs as proposed ON TOP of
-            # existing (populate_proposed reads the plan directly)
             staged = [
                 a
                 for a in list(results.inplace_update)
                 + list(results.attribute_updates.values())
-                if a.task_group == tg.name
+                if a.task_group == g.name
                 and not a.terminal_status()
             ]
-            for sp in combined_spreads:
-                sim.spread_existing[sp.attribute] = _count_values(
+            for sp in g_spreads:
+                key = (g.name, sp.attribute)
+                sim.spread_existing[key] = _count_values(
                     snap, sp.attribute, live
                 )
-                sim.spread_cleared[sp.attribute] = _count_values(
+                sim.spread_cleared[key] = _count_values(
                     snap, sp.attribute, stopping
                 )
-                sim.spread_proposed[sp.attribute] = _count_values(
+                sim.spread_proposed[key] = _count_values(
                     snap, sp.attribute, staged
                 )
             # even-mode guard: the oracle's min/max loop reproduces the
@@ -987,23 +972,21 @@ class BatchWorker(Worker):
             # mid-chain), take the exact sequential path.
             from ..sched.spread import compute_spread_info as _csi
 
-            infos, _w = _csi(combined_spreads, tg.count)
+            infos, _w = _csi(g_spreads, g.count)
             has_even = any(
                 not infos[sp.attribute]["desired_counts"]
-                for sp in combined_spreads
+                for sp in g_spreads
             )
             if has_even:
-                # cleared grows mid-chain only via per-pick
-                # destructive evictions; pre-staged stops are static
-                # and covered by the value-level zero check below
                 if results.destructive_update:
                     return None
-                for sp in combined_spreads:
+                for sp in g_spreads:
                     if infos[sp.attribute]["desired_counts"]:
                         continue
-                    ex = sim.spread_existing[sp.attribute]
-                    pr = sim.spread_proposed[sp.attribute]
-                    cl = sim.spread_cleared[sp.attribute]
+                    key = (g.name, sp.attribute)
+                    ex = sim.spread_existing[key]
+                    pr = sim.spread_proposed[key]
+                    cl = sim.spread_cleared[key]
                     for value in set(ex) | set(pr):
                         raw = ex.get(value, 0) + pr.get(value, 0)
                         if raw > 0 and raw - cl.get(value, 0) <= 0:
@@ -1637,19 +1620,28 @@ class BatchWorker(Worker):
             # spread.go:232): when job- and group-level stanzas share
             # an attribute, every pset scores with the overwrite
             # winner's desired/weight — exactly like SpreadIterator.
-            combined_spreads = list(tg.spreads) + list(job.spreads)
+            # kernel stanzas per (group slot, pset), group-scoped
+            # like the sequential SpreadIterator: each placing group
+            # gets its OWN slots for the job-level stanzas plus its
+            # group-level ones, with per-group desired counts
+            # (percent x THAT group's count) and per-group weight
+            # normalization (spread.py _compute_spread_info)
             eval_spreads = None
-            if combined_spreads:
+            for g_i, g in enumerate(tgs):
+                g_spreads = list(g.spreads) + list(job.spreads)
+                if not g_spreads:
+                    continue
                 from ..sched.spread import compute_spread_info
 
                 info, spread_sum_w = compute_spread_info(
-                    combined_spreads, tg.count
+                    g_spreads, g.count
                 )
                 spread_sum_w = spread_sum_w or 1
-                eval_spreads = []
-                # one kernel stanza per pset (job-level first, then
-                # group-level — spread.py set_task_group ordering)
-                for sp in list(job.spreads) + list(tg.spreads):
+                if eval_spreads is None:
+                    eval_spreads = []
+                # job-level first, then group-level (spread.py
+                # set_task_group ordering)
+                for sp in list(job.spreads) + list(g.spreads):
                     attr_info = info[sp.attribute]
                     # mode follows the MERGED per-attribute info like
                     # the sequential SpreadIterator ("if not
@@ -1657,19 +1649,16 @@ class BatchWorker(Worker):
                     # mixed target presence score in the overwrite
                     # winner's mode on BOTH paths
                     even = not attr_info["desired_counts"]
+                    key = (g.name, sp.attribute)
                     codes, desired, used0, prop0, cleared0 = (
                         compiler.spread_kernel_inputs(
                             sp.attribute,
                             None
                             if even
                             else attr_info["desired_counts"],
-                            sim.spread_existing.get(
-                                sp.attribute, {}
-                            ),
-                            sim.spread_cleared.get(sp.attribute, {}),
-                            sim.spread_proposed.get(
-                                sp.attribute, {}
-                            ),
+                            sim.spread_existing.get(key, {}),
+                            sim.spread_cleared.get(key, {}),
+                            sim.spread_proposed.get(key, {}),
                         )
                     )
                     eval_spreads.append(
@@ -1681,7 +1670,8 @@ class BatchWorker(Worker):
                          if even
                          else float(attr_info["weight"])
                          / float(spread_sum_w),
-                         even)
+                         even,
+                         g_i)
                     )
             spread_per_eval.append(eval_spreads)
 
@@ -1718,11 +1708,16 @@ class BatchWorker(Worker):
             )
             # per-group visit limits: affinities (or spreads) lift the
             # walk cap for that group's selects (stack.py limit rules)
+            # per-group limit lift (stack.py select: affinities or
+            # spreads disable the log2 visit cap); job-level spreads
+            # lift EVERY group's limit, group-level only their own
             limits_t = [
                 2**31 - 1
-                if has_aff_g or combined_spreads
+                if has_aff_t[s_i]
+                or list(job.spreads)
+                or list(tgs[s_i].spreads)
                 else base_limit
-                for has_aff_g in has_aff_t
+                for s_i in range(len(tgs))
             ]
 
             max_picks = max(max_picks, sim.placements)
@@ -1984,7 +1979,9 @@ class BatchWorker(Worker):
                     (
                         len(d)
                         for s in spread_per_eval
-                        for (_c, d, _u, _p, _cl, _w, _e) in (s or ())
+                        for (_c, d, _u, _p, _cl, _w, _e, _g) in (
+                            s or ()
+                        )
                     ),
                     default=1,
                 ),
@@ -1998,10 +1995,12 @@ class BatchWorker(Worker):
             s_weight = np.zeros((E, S))
             s_active = np.zeros((E, S), dtype=bool)
             s_even = np.zeros((E, S), dtype=bool)
+            s_group = np.zeros((E, S), np.int32)
+            multi_group_spread = False
             for k, s in enumerate(spread_per_eval):
-                for j, (c, d, u, p0, cl, w, ev_mode) in enumerate(
-                    s or ()
-                ):
+                for j, (
+                    c, d, u, p0, cl, w, ev_mode, g_i
+                ) in enumerate(s or ()):
                     # this eval's penalty slot moves to the shared
                     # V1-1 slot under padding
                     pen = len(d) - 1
@@ -2013,6 +2012,9 @@ class BatchWorker(Worker):
                     s_weight[k, j] = w
                     s_active[k, j] = True
                     s_even[k, j] = ev_mode
+                    s_group[k, j] = g_i
+                    if g_i:
+                        multi_group_spread = True
             spread_stack = SpreadInputs(
                 codes=s_codes,
                 desired=s_desired,
@@ -2024,6 +2026,9 @@ class BatchWorker(Worker):
                 # None keeps percent-only workloads on the cheaper
                 # kernel path (the even math never traces)
                 even=s_even if s_even.any() else None,
+                # group routing only traces when a multi-group
+                # spread eval is actually in the batch
+                group=s_group if multi_group_spread else None,
             )
         spread_fit = (
             snap.scheduler_config().effective_scheduler_algorithm()
