@@ -901,9 +901,6 @@ class ChainInputs(NamedTuple):
     tg_idx: jnp.ndarray  # i32[E, P]
 
 
-@functools.partial(
-    jax.jit, static_argnames=("n_picks", "spread_fit")
-)
 def chained_plan_picks_cols(
     cpu_total,
     mem_total,
@@ -929,10 +926,21 @@ def chained_plan_picks_cols(
     dev_aff_on=None,  # bool[E, T]
     occ0=None,  # i32[E, C] pickless-group distinct_hosts occupancy
     dh_tg=None,  # bool[E, T] group-level distinct_hosts flags
+    return_carry: bool = False,
 ):
     """Serially-equivalent chained planner over shared node columns —
     the BatchWorker's production launch.  Semantics identical to
-    `chained_plan_picks`; only the input layout differs."""
+    `chained_plan_picks`; only the input layout differs.
+
+    With ``return_carry=True`` the final scan carry — the chained
+    (cpu, mem, disk) usage columns plus the port-occupancy and
+    device-free carries (None when absent) — is returned as a third
+    output.  Splitting one E-eval chain into consecutive launches
+    whose carry-out feeds the next launch's ``used0_*``/``port_used0``/
+    ``dev_free0`` is bit-identical to the single launch (a lax.scan cut
+    at an eval boundary), which is what the BatchWorker's pipelined
+    prescore relies on: chunk N+1 dispatches against chunk N's
+    device-resident carry while the host replays chunk N-1."""
     E = batch.perm.shape[0]
     C = cpu_total.shape[0]
     T = batch.feasible.shape[1]
@@ -1030,14 +1038,62 @@ def chained_plan_picks_cols(
 
     used0 = (used0_cpu, used0_mem, used0_disk)
     carry0 = (used0, port_used0, dev_free0)
-    _final, (rows, pulls) = jax.lax.scan(
+    final, (rows, pulls) = jax.lax.scan(
         eval_step, carry0, tuple(parts)
     )
     # pulls[E, P]: source-iterator consumption per pick — the host
     # reconstructs the sequential walk offset at any pick from the
     # running sum (preemption-retry passthrough seeds the oracle's
     # StaticIterator offset with it)
+    if return_carry:
+        return rows, pulls, final
     return rows, pulls
+
+
+chained_plan_picks_cols = jax.jit(
+    chained_plan_picks_cols,
+    static_argnames=("n_picks", "spread_fit", "return_carry"),
+)
+
+_chained_cols_donated = None
+
+
+def chained_plan_picks_cols_donated():
+    """jit variant of `chained_plan_picks_cols` that donates the
+    chain-carry buffers (usage columns + port/device occupancy) so
+    back-to-back pipelined launches reuse device memory instead of
+    holding every in-flight chunk's carry live.  Created lazily: the
+    caller (BatchWorker) only selects it on non-CPU backends, where
+    donation is honored, and only when the inputs are the previous
+    launch's carry-out (never the persistent usage-column cache, which
+    must survive the launch)."""
+    global _chained_cols_donated
+    if _chained_cols_donated is None:
+        fn = jax.jit(
+            chained_plan_picks_cols.__wrapped__,
+            static_argnames=("n_picks", "spread_fit", "return_carry"),
+            donate_argnames=(
+                "used0_cpu",
+                "used0_mem",
+                "used0_disk",
+                "port_used0",
+                "dev_free0",
+            ),
+        )
+        # distinct name: the cold-compile shield keys signatures by
+        # fn name, and the donated executable compiles separately
+        fn.__name__ = "chained_plan_picks_cols_donated"
+        _chained_cols_donated = fn
+    return _chained_cols_donated
+
+
+@jax.jit
+def patch_rows(col, idx, vals):
+    """Scatter-patch dirty rows into a persistent device column:
+    ``col[idx] = vals`` with out-of-bounds indices DROPPED (padding
+    slots use idx == C; negative indices would wrap).  The delta-sync
+    primitive for the BatchWorker's device-resident usage mirror."""
+    return col.at[idx].set(vals, mode="drop")
 
 
 @functools.partial(

@@ -10,11 +10,19 @@ section 7.3).  The BatchWorker instead:
    reconciler the scheduler will run (reference generic_sched.go:332
    computeJobAllocs) — predicting the stops, in-place updates,
    destructive evictions, reschedule penalties and placement count,
-3. *prescores* the whole run in a single `chained_plan_picks` launch:
-   every eval's full pick sequence with in-kernel plan-delta
-   accumulation (pre-placement usage deltas, per-pick destructive
-   evictions, per-pick penalty rows, failure coalescing) and the same
-   seeded visit orders the sequential path would use,
+3. *prescores* the run through a three-stage pipeline — assemble
+   (host numpy staging into a chunk-aligned arena), launch
+   (non-blocking `chained_plan_picks_cols` dispatches of
+   PIPELINE_CHUNK-wide slices, each chained on the previous chunk's
+   device-resident carry), fetch (deferred device_get) — so chunk N
+   executes on device while the host replays chunk N-1.  Every eval's
+   full pick sequence runs with in-kernel plan-delta accumulation
+   (pre-placement usage deltas, per-pick destructive evictions,
+   per-pick penalty rows, failure coalescing) and the same seeded
+   visit orders the sequential path would use; the shared usage
+   columns come from a persistent device mirror delta-patched via the
+   store's dirty-row log (see docs/ARCHITECTURE.md "Prescore
+   pipeline"),
 4. runs each eval through the ordinary GenericScheduler so all control
    flow (reconciler, blocked evals, retries, plan bookkeeping, status
    writes) stays in one implementation — but with a `PrescoredStack`
@@ -36,6 +44,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
@@ -48,6 +57,8 @@ from ..ops.batch import (
     PreDeltas,
     StepDeltas,
     chained_plan_picks_cols,
+    chained_plan_picks_cols_donated,
+    patch_rows,
     pow2_bucket as _pow2,
 )
 from ..ops.constraints import MaskCompiler
@@ -70,10 +81,51 @@ BATCH_MAX = 64
 BATCH_WAIT_S = 0.005
 MAX_PENALTY_NODES = 8  # per-pick penalty row slots in StepDeltas
 MAX_PRE_ROWS = 512  # pre-placement delta rows before falling back
+# eval-axis width of one pipelined prescore launch: every run is
+# sliced into chunks of this size chained through the kernel's carry
+# output, so ALL production launches share ONE eval-axis trace bucket
+# (padding waste is < CHUNK evals per run instead of up to
+# BATCH_MAX - 1) and chunk N's device time overlaps chunk N-1's host
+# replay
+PIPELINE_CHUNK = 8
 
 
 class _Deviation(Exception):
     """The eval's control flow left the prescored fast path."""
+
+
+_LRU_MISS = object()
+
+
+class _LRUCache:
+    """Bounded mapping with least-recently-used eviction: get()
+    refreshes recency, put() evicts the coldest entry past capacity.
+    Replaces the clear-all-on-overflow host-assembly caches, where a
+    single one-off job spec used to evict every warm entry; stale-
+    generation entries (generations are part of each key) now simply
+    age out instead of forcing a flush."""
+
+    __slots__ = ("cap", "_d")
+
+    def __init__(self, cap: int) -> None:
+        self.cap = cap
+        self._d: dict = {}
+
+    def get(self, key):
+        value = self._d.pop(key, _LRU_MISS)
+        if value is _LRU_MISS:
+            return None
+        self._d[key] = value  # re-insert: now most recent
+        return value
+
+    def put(self, key, value) -> None:
+        self._d.pop(key, None)
+        self._d[key] = value
+        while len(self._d) > self.cap:
+            del self._d[next(iter(self._d))]
+
+    def __len__(self) -> int:
+        return len(self._d)
 
 
 def _count_values(snap, attribute: str, allocs) -> Dict[str, int]:
@@ -144,6 +196,43 @@ class _Sim:
     spread_proposed: Dict[tuple, Dict[str, int]] = field(
         default_factory=dict
     )
+
+
+@dataclass
+class _Assembled:
+    """One admitted chain's kernel inputs, staged host-side by
+    ``_assemble`` (the pipeline's first stage).  Every per-eval array
+    carries a leading eval axis of ``E`` rows — ``E_real`` real evals
+    padded up to a multiple of PIPELINE_CHUNK with inert rows
+    (wanted=0, n_cand=1) — so the launch stage can slice
+    PIPELINE_CHUNK-wide chunks that all share one trace bucket."""
+
+    E_real: int
+    E: int
+    P: int
+    T: int
+    stacked: ChainInputs
+    n_cands: np.ndarray  # i32[E]
+    wanted: np.ndarray  # i32[E]
+    spread_fit: bool
+    coll0: Optional[np.ndarray]
+    affinity: Optional[np.ndarray]
+    spread: Optional[object]  # SpreadInputs
+    deltas: StepDeltas
+    pre: PreDeltas
+    port_ask: Optional[np.ndarray]
+    port_used0: Optional[np.ndarray]
+    dev_ask: Optional[np.ndarray]
+    dev_free0: Optional[np.ndarray]
+    dev_aff: Optional[np.ndarray]
+    dev_aff_on: Optional[np.ndarray]
+    occ0: Optional[np.ndarray]
+    dh_tg: Optional[np.ndarray]
+    # shared node columns: host refs (mesh path) and the delta-patched
+    # device mirror (chunk path; None when the mesh path is taken)
+    host_cols: tuple = ()
+    dev_cols: Optional[tuple] = None
+    use_mesh: bool = False
 
 
 class PrescoredStack:
@@ -389,12 +478,40 @@ class BatchWorker(Worker):
         # generation (usage churn does NOT invalidate them): candidate
         # row layout per datacenter set, static feasibility /
         # affinity vectors per job signature, and node-level reserved-
-        # port columns per port
-        self._cand_cache: Dict[tuple, tuple] = {}
-        self._mask_cache: Dict[tuple, np.ndarray] = {}
-        self._port_col_cache: Dict[tuple, np.ndarray] = {}
-        self._dev_codes_cache: Dict[tuple, FrozenSet[int]] = {}
-        self._dev_aff_cache: Dict[tuple, tuple] = {}
+        # port columns per port.  Bounded LRUs: a one-off job spec
+        # evicts only the coldest entry, never the whole warm set
+        self._cand_cache = _LRUCache(64)
+        self._mask_cache = _LRUCache(256)
+        self._port_col_cache = _LRUCache(256)
+        self._dev_codes_cache = _LRUCache(256)
+        self._dev_aff_cache = _LRUCache(64)
+        # snapshot-delta input cache: device-resident mirror of the
+        # node table's totals + usage columns, patched per flush from
+        # the store's dirty-row log (store.usage_delta_since) instead
+        # of re-shipping all C rows.  {"key": (topo_gen, C),
+        # "gen": usage generation synced, "cols": 6 device arrays}
+        self._usage_cache: Optional[dict] = None
+        # serializes mirror syncs: the prescore-warmup thread
+        # (NOMAD_TPU_WARM_ON_START) and the worker thread both call
+        # _device_columns, and two interleaved delta syncs could
+        # record a generation whose rows one of them never patched
+        self._usage_cache_lock = threading.Lock()
+        self._input_cache_hits = 0
+        self._input_cache_misses = 0
+        # pipelined prescore: how many chunk launches may be in flight
+        # before the host blocks on the oldest one's fetch.  1 degrades
+        # to launch->fetch->replay per chunk (no overlap); 0/negative
+        # clamps to 1
+        try:
+            self.pipeline_depth = max(
+                1,
+                int(
+                    _os.environ.get("NOMAD_TPU_PIPELINE_DEPTH", 2)
+                ),
+            )
+        except ValueError:
+            self.pipeline_depth = 2
+        self._donate_carries: Optional[bool] = None
         # cold-compile shield: launch signatures known to be compiled.
         # A first-seen shape is compiled on a background thread while
         # the affected evals take the exact sequential path, so an XLA
@@ -424,10 +541,16 @@ class BatchWorker(Worker):
                 self._mesh = None
         # stage timings (seconds, cumulative) — surfaced through
         # /v1/metrics so a production operator can see where batch time
-        # goes and whether the fast path is actually being taken
+        # goes and whether the fast path is actually being taken.  The
+        # old opaque "prescore" stage is split into its pipeline
+        # stages: assemble (host numpy input staging), launch
+        # (non-blocking device dispatch) and fetch (time blocked
+        # waiting on device results — the part replay overlap hides)
         self.timings = {
             "simulate": 0.0,
-            "prescore": 0.0,
+            "assemble": 0.0,
+            "launch": 0.0,
+            "fetch": 0.0,
             "replay": 0.0,
             "sequential": 0.0,
         }
@@ -714,72 +837,208 @@ class BatchWorker(Worker):
                 self._process_sequential(run[idx][0], run[idx][1])
                 idx += 1
                 continue
+            # ---- prescore pipeline: assemble -> launch -> fetch ----
             t0 = _time.monotonic()
+            asm = None
             try:
-                rows_map = self._prescore(snap, run[idx:j], sims)
+                asm = self._assemble(snap, run[idx:j], sims)
             except Exception:  # noqa: BLE001
                 self._count("errors")
                 LOG.warning(
-                    "prescore failed for %d evals", len(sims),
-                    exc_info=True,
+                    "prescore assembly failed for %d evals",
+                    len(sims), exc_info=True,
                 )
-                rows_map = {}
-            launch_dt = _time.monotonic() - t0
-            self._observe("prescore", launch_dt)
-            if rows_map:
-                # feed the adaptive sizing loop: launch cost per E
-                # trace bucket (the compiled program is per bucket,
-                # so cost depends on the bucket, not the run length)
-                bucket = 8 if len(sims) <= 8 else BATCH_MAX
-                prev = self._launch_ewma.get(bucket)
-                ms = launch_dt * 1000.0
-                self._launch_ewma[bucket] = (
-                    ms if prev is None else 0.8 * prev + 0.2 * ms
-                )
+            self._observe("assemble", _time.monotonic() - t0)
             k = idx
             rescore = False
-            while k < j and not rescore:
-                ev, token, job = run[k]
-                sim = sims[k - idx]
-                entry = rows_map.get(ev.id)
-                if entry is None:
-                    self._process_sequential(ev, token)
-                    k += 1
-                    continue
+            pipe_wall = 0.0  # device-path blocking time for the run
+            launched_any = False
+            if asm is not None and asm.use_mesh:
                 t0 = _time.monotonic()
+                rows_arr = None
+                cold = False
                 try:
-                    clean = self._process_prescored(
-                        ev, token, job, entry["rows"], sim,
-                        pulls=entry.get("pulls"),
-                    )
-                    replay_dt = _time.monotonic() - t0
-                    self._observe("replay", replay_dt)
-                    self._replay_ewma_ms = (
-                        0.8 * self._replay_ewma_ms
-                        + 0.2 * replay_dt * 1000.0
-                    )
-                    self._count("prescored")
-                    self._sample_eval_latency(ev)
-                    k += 1
-                    if not clean:
-                        # a prescored pick failed: the chained state
-                        # past this eval is suspect — re-prescore
-                        rescore = True
-                except _Deviation:
-                    self._count("fallbacks")
-                    self._process_sequential(ev, token)
-                    k += 1
-                    rescore = True
+                    rows_arr = self._launch_mesh(asm)
+                    cold = rows_arr is None
                 except Exception:  # noqa: BLE001
                     self._count("errors")
                     LOG.warning(
-                        "prescored replay failed for eval %s", ev.id,
-                        exc_info=True,
+                        "sharded prescore failed for %d evals",
+                        len(sims), exc_info=True,
                     )
-                    self._nack_quietly(ev, token)
+                if cold:
+                    self._count("cold_shape_fallbacks")
+                dt = _time.monotonic() - t0
+                pipe_wall += dt
+                self._observe("fetch", dt)
+                if rows_arr is not None:
+                    launched_any = True
+                    for e in range(asm.E_real):
+                        if rescore:
+                            break
+                        ev, token, job = run[idx + e]
+                        sim = sims[e]
+                        rows = [
+                            int(r)
+                            for r in rows_arr[e, : sim.placements]
+                        ]
+                        # mesh launches don't surface pulls; preempt
+                        # retries deviate there
+                        ok = self._replay_one(
+                            ev, token, job, sim, rows, None
+                        )
+                        k += 1
+                        if not ok:
+                            rescore = True
+            elif asm is not None:
+                # chunked double-buffered launches: chunk N executes
+                # on device while the host replays chunk N-1's picks,
+                # and chunk N+1 chains on N's device-resident carry
+                # without a host round trip.  Splitting the eval scan
+                # at chunk boundaries is bit-identical to one launch.
+                chunks = [
+                    (s, s + PIPELINE_CHUNK)
+                    for s in range(0, asm.E, PIPELINE_CHUNK)
+                ]
+                pending = deque()
+                carry = None
+                ci = 0
+                stalled = False  # cold shape or launch/fetch failure
+                while (ci < len(chunks) or pending) and not rescore:
+                    while (
+                        not stalled
+                        and ci < len(chunks)
+                        and len(pending) < self.pipeline_depth
+                    ):
+                        c0, c1 = chunks[ci]
+                        t0 = _time.monotonic()
+                        handle = None
+                        try:
+                            handle = self._launch_chunk(
+                                asm, c0, c1, carry,
+                                check_ready=ci == 0,
+                            )
+                            if handle is None:
+                                self._count("cold_shape_fallbacks")
+                        except Exception:  # noqa: BLE001
+                            self._count("errors")
+                            LOG.warning(
+                                "prescore launch failed",
+                                exc_info=True,
+                            )
+                        dt = _time.monotonic() - t0
+                        pipe_wall += dt
+                        self._observe("launch", dt)
+                        if handle is None:
+                            stalled = True
+                            break
+                        launched_any = True
+                        carry = handle[2]
+                        pending.append(((c0, c1), handle))
+                        ci += 1
+                    if not pending:
+                        break
+                    (c0, c1), handle = pending.popleft()
+                    t0 = _time.monotonic()
+                    try:
+                        rows_arr, pulls_arr = self._fetch(handle)
+                    except Exception:  # noqa: BLE001
+                        self._count("errors")
+                        LOG.warning(
+                            "prescore fetch failed", exc_info=True
+                        )
+                        # later chunks chain on this chunk's carry, so
+                        # they share its failure: drop them and let the
+                        # exact path cover the rest of the run
+                        pending.clear()
+                        stalled = True
+                        self._observe(
+                            "fetch", _time.monotonic() - t0
+                        )
+                        continue
+                    dt = _time.monotonic() - t0
+                    pipe_wall += dt
+                    self._observe("fetch", dt)
+                    for e in range(c0, min(c1, asm.E_real)):
+                        if rescore:
+                            break
+                        ev, token, job = run[idx + e]
+                        sim = sims[e]
+                        rows = [
+                            int(r)
+                            for r in rows_arr[
+                                e - c0, : sim.placements
+                            ]
+                        ]
+                        pulls = [
+                            int(p)
+                            for p in pulls_arr[
+                                e - c0, : sim.placements
+                            ]
+                        ]
+                        ok = self._replay_one(
+                            ev, token, job, sim, rows, pulls
+                        )
+                        k += 1
+                        if not ok:
+                            rescore = True
+            if launched_any:
+                # feed the adaptive sizing loop: blocking device-path
+                # cost for a gulp of this size (launch dispatch plus
+                # the fetch waits replay overlap didn't hide)
+                bucket = 8 if len(sims) <= 8 else BATCH_MAX
+                prev = self._launch_ewma.get(bucket)
+                ms = pipe_wall * 1000.0
+                self._launch_ewma[bucket] = (
+                    ms if prev is None else 0.8 * prev + 0.2 * ms
+                )
+            if not rescore:
+                # evals no fetched chunk covered (assembly failure,
+                # cold shape, launch/fetch error) take the exact
+                # sequential path, preserving queue order
+                while k < j:
+                    ev, token, _job = run[k]
+                    self._process_sequential(ev, token)
                     k += 1
-                    rescore = True
             idx = k
+
+    def _replay_one(
+        self, ev, token, job, sim: _Sim,
+        rows: List[int], pulls: Optional[List[int]],
+    ) -> bool:
+        """Replay one prescored eval; returns False when the chained
+        state past it is suspect (failed pick, deviation, or replay
+        error) and the caller must re-prescore the remainder."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            clean = self._process_prescored(
+                ev, token, job, rows, sim, pulls=pulls
+            )
+            replay_dt = _time.monotonic() - t0
+            self._observe("replay", replay_dt)
+            self._replay_ewma_ms = (
+                0.8 * self._replay_ewma_ms
+                + 0.2 * replay_dt * 1000.0
+            )
+            self._count("prescored")
+            self._sample_eval_latency(ev)
+            # a failed prescored pick means the chained state past
+            # this eval is suspect — re-prescore
+            return clean
+        except _Deviation:
+            self._count("fallbacks")
+            self._process_sequential(ev, token)
+            return False
+        except Exception:  # noqa: BLE001
+            self._count("errors")
+            LOG.warning(
+                "prescored replay failed for eval %s", ev.id,
+                exc_info=True,
+            )
+            self._nack_quietly(ev, token)
+            return False
 
     def _process_sequential(self, ev, token) -> None:
         import time as _time
@@ -1219,17 +1478,28 @@ class BatchWorker(Worker):
         )
 
     def warm_shapes(
-        self, e_buckets=(8, BATCH_MAX), p_buckets=(16,),
+        self, e_buckets=(PIPELINE_CHUNK,), p_buckets=(16,),
         t_buckets=(1, 2),
     ) -> None:
         """Pre-compile the chained kernel for the common launch shapes
         so the first production batches don't pay the jit compile (the
         bench and server startup call this outside any timed region).
-        T buckets cover the single-group shape and the first multi-
-        task-group bucket (T=2 — jobs with 2 groups; 3-4-group jobs
-        pad to T=4 and compile on first sighting)."""
+        The default eval-axis bucket is PIPELINE_CHUNK — EVERY
+        production launch is a chunk of that width since the pipelined
+        prescore — warmed with return_carry=True exactly as
+        _launch_chunk dispatches it.  T buckets cover the single-group
+        shape and the first multi-task-group bucket (T=2 — jobs with 2
+        groups; 3-4-group jobs pad to T=4 and compile on first
+        sighting)."""
+        import jax
+
         table = self.store.node_table
         C = table.capacity
+        # the SAME device-resident columns production launches read:
+        # warming with the host numpy arrays would register float64
+        # signatures that never match the device mirror's canonical
+        # dtype when x64 is off (production TPU runs f32)
+        dev_cols = self._device_columns(table)
         for e in e_buckets:
             for p in p_buckets:
                 for t in t_buckets:
@@ -1251,13 +1521,7 @@ class BatchWorker(Worker):
                             "affinity": np.zeros((e, t, C)),
                         },
                     ):
-                        args = (
-                            table.cpu_total,
-                            table.mem_total,
-                            table.disk_total,
-                            table.cpu_used,
-                            table.mem_used,
-                            table.disk_used,
+                        args = dev_cols + (
                             stacked,
                             np.full(e, 1, np.int32),
                             int(p),
@@ -1270,12 +1534,13 @@ class BatchWorker(Worker):
                             spread=None,
                             deltas=self._zero_deltas(e, p),
                             pre=self._zero_pre(e),
+                            return_carry=True,
                         )
                         kwargs.update(extras)
-                        _r, _p = chained_plan_picks_cols(
+                        out = chained_plan_picks_cols(
                             *args, **kwargs
                         )
-                        np.asarray(_r), np.asarray(_p)
+                        jax.block_until_ready(out)
                         with self._compile_lock:
                             # must match _launch_ready's lookup key
                             # (fn-name prefix included), or warmed
@@ -1321,11 +1586,6 @@ class BatchWorker(Worker):
         hit = self._cand_cache.get(key)
         if hit is not None:
             return hit
-        if len(self._cand_cache) > 64 or (
-            self._cand_cache
-            and next(iter(self._cand_cache))[0] != gen
-        ):
-            self._cand_cache.clear()
         nodes, _by_dc = ready_nodes_in_dcs(snap, datacenters)
         rows = np.asarray(
             [table.row_of[n.id] for n in nodes], dtype=np.int32
@@ -1334,7 +1594,7 @@ class BatchWorker(Worker):
         present[rows] = True
         rest = np.nonzero(~present)[0].astype(np.int32)
         out = (nodes, rows, rest)
-        self._cand_cache[key] = out
+        self._cand_cache.put(key, out)
         return out
 
     @staticmethod
@@ -1365,14 +1625,9 @@ class BatchWorker(Worker):
         hit = self._mask_cache.get(key)
         if hit is not None:
             return hit
-        # bounded: one (bool[C], f64[C]) pair per distinct job spec —
-        # cap the count so thousands of one-off specs on a long-lived
+        # bounded LRU: one (bool[C], f64[C]) pair per distinct job
+        # spec, capped so thousands of one-off specs on a long-lived
         # stable topology can't accumulate hundreds of MB
-        if len(self._mask_cache) > 256 or (
-            self._mask_cache
-            and next(iter(self._mask_cache))[0] != gen
-        ):
-            self._mask_cache.clear()
         compiler = MaskCompiler(table)
         feasible = np.zeros(table.capacity, dtype=bool)
         feasible[rows] = True
@@ -1396,7 +1651,7 @@ class BatchWorker(Worker):
             total / sum_w if sum_w else np.zeros(table.capacity)
         )
         out = (feasible, aff_vec)
-        self._mask_cache[key] = out
+        self._mask_cache.put(key, out)
         return out
 
     def _device_affinity_column(
@@ -1442,12 +1697,6 @@ class BatchWorker(Worker):
         hit = self._dev_aff_cache.get(cache_key)
         if hit is not None:
             return hit
-        if len(self._dev_aff_cache) > 64 or (
-            self._dev_aff_cache
-            and next(iter(self._dev_aff_cache))[0]
-            != table.topo_generation
-        ):
-            self._dev_aff_cache.clear()
         from ..sched.device import matched_affinity_weight
         from ..structs import NodeDeviceResource
 
@@ -1480,7 +1729,7 @@ class BatchWorker(Worker):
         out = (
             (col / total_w, True) if total_w else (None, False)
         )
-        self._dev_aff_cache[cache_key] = out
+        self._dev_aff_cache.put(cache_key, out)
         return out
 
     def _device_request_codes(self, table, req) -> FrozenSet[int]:
@@ -1496,8 +1745,6 @@ class BatchWorker(Worker):
         hit = self._dev_codes_cache.get(key)
         if hit is not None:
             return hit
-        if len(self._dev_codes_cache) > 256:
-            self._dev_codes_cache.clear()
         compiler = MaskCompiler(table)
         codes = frozenset(
             code
@@ -1505,7 +1752,7 @@ class BatchWorker(Worker):
             if table.device_sig_matches(code, req.name)
             and compiler._device_sig_meets_constraints(code, req)
         )
-        self._dev_codes_cache[key] = codes
+        self._dev_codes_cache.put(key, codes)
         return codes
 
     def _node_reserved_port_column(self, snap, port: int) -> np.ndarray:
@@ -1519,11 +1766,6 @@ class BatchWorker(Worker):
         hit = self._port_col_cache.get(key)
         if hit is not None:
             return hit
-        if len(self._port_col_cache) > 256 or (
-            self._port_col_cache
-            and next(iter(self._port_col_cache))[0] != gen
-        ):
-            self._port_col_cache.clear()
         col = np.zeros(table.capacity, dtype=bool)
         for node_id, row in table.row_of.items():
             node = snap.node_by_id(node_id)
@@ -1547,14 +1789,110 @@ class BatchWorker(Worker):
                 if any(p.value == port for p in net.reserved_ports):
                     col[row] = True
                     break
-        self._port_col_cache[key] = col
+        self._port_col_cache.put(key, col)
         return col
+
+    # -- snapshot-delta input cache ------------------------------------
+
+    def _device_columns(self, table) -> tuple:
+        """The six shared node columns (cpu/mem/disk totals + used) as
+        device-resident arrays — the persistent padded arena the
+        pipelined prescore launches read instead of re-shipping all C
+        rows per flush.  Totals re-upload only on topology changes;
+        usage columns are delta-patched from the store's dirty-row log
+        (store.usage_delta_since): between consecutive flushes only the
+        rows the interleaved plan commits touched are scattered in.
+        Patching uses absolute SET of the current host values (never
+        accumulated deltas), so the device mirror is bit-identical to a
+        fresh upload.  Hit rate is exported as the
+        ``batch_worker.input_cache_hit_rate`` gauge."""
+        import jax
+
+        with self._usage_cache_lock:
+            return self._device_columns_locked(table, jax)
+
+    def _device_columns_locked(self, table, jax) -> tuple:
+        # table.epoch: a snapshot restore swaps in a FRESH NodeTable
+        # whose restarted generations could collide with the cached
+        # key and leave pre-restore usage on device permanently
+        key = (table.epoch, table.topo_generation, table.capacity)
+        cache = self._usage_cache
+        hit = False
+        if cache is None or cache["key"] != key:
+            # topology changed (join/leave/re-fingerprint/arena
+            # growth): rows may have been reassigned — full resync
+            gen, _rows = self.store.usage_delta_since(-1)
+            cols = tuple(
+                jax.device_put(col)
+                for col in (
+                    table.cpu_total,
+                    table.mem_total,
+                    table.disk_total,
+                    table.cpu_used,
+                    table.mem_used,
+                    table.disk_used,
+                )
+            )
+            cache = {"key": key, "gen": gen, "cols": cols}
+            self._usage_cache = cache
+        else:
+            gen, rows = self.store.usage_delta_since(cache["gen"])
+            cols = cache["cols"]
+            if len(rows) > max(64, table.capacity // 8):
+                # wide churn: one bulk upload beats many scatters
+                cols = cols[:3] + tuple(
+                    jax.device_put(col)
+                    for col in (
+                        table.cpu_used,
+                        table.mem_used,
+                        table.disk_used,
+                    )
+                )
+            elif rows:
+                idx = np.asarray(sorted(rows), dtype=np.int32)
+                # pad the row axis to a pow2 bucket so the scatter
+                # keeps one trace per bucket; padding indexes C
+                # (out of bounds -> dropped, never wrapped)
+                width = _pow2(len(idx), floor=8)
+                idx_p = np.full(width, table.capacity, np.int32)
+                idx_p[: len(idx)] = idx
+                patched = []
+                for col, src in zip(
+                    cols[3:],
+                    (table.cpu_used, table.mem_used, table.disk_used),
+                ):
+                    vals = np.zeros(width, dtype=src.dtype)
+                    vals[: len(idx)] = src[idx]
+                    patched.append(patch_rows(col, idx_p, vals))
+                cols = cols[:3] + tuple(patched)
+                hit = True
+            else:
+                hit = True  # nothing changed since the last sync
+            cache["cols"] = cols
+            cache["gen"] = gen
+        if hit:
+            self._input_cache_hits += 1
+        else:
+            self._input_cache_misses += 1
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            total = self._input_cache_hits + self._input_cache_misses
+            metrics.set_gauge(
+                "batch_worker.input_cache_hit_rate",
+                self._input_cache_hits / total if total else 0.0,
+            )
+        return cache["cols"]
 
     # ------------------------------------------------------------------
 
-    def _prescore(
+    def _assemble(
         self, snap, prescorable, sims: List[_Sim]
-    ) -> Dict[str, List[int]]:
+    ) -> _Assembled:
+        """Stage 1 of the prescore pipeline: pure host-side numpy input
+        staging for one admitted chain (no device work).  The result is
+        launched chunk-by-chunk by ``_launch_chunk`` and fetched
+        lazily, so device execution overlaps the host's replay of
+        earlier chunks."""
         table = snap.node_table
         C = table.capacity
         compiler = MaskCompiler(table)
@@ -1789,10 +2127,11 @@ class BatchWorker(Worker):
         # pre-compiles the coll0+affinity one; spread batches bucket
         # their (S, V1) axes to powers of two below to bound variants
         E_real = len(per_eval)
-        # two eval-axis buckets only (a small-batch/latency shape and
-        # the full-batch shape) so the device sees at most two compiled
-        # programs per pick bucket
-        E = 8 if E_real <= 8 else BATCH_MAX
+        # the eval axis pads to the next multiple of PIPELINE_CHUNK:
+        # every launch is a PIPELINE_CHUNK-wide slice of this arena, so
+        # the device sees ONE compiled program per pick bucket
+        # regardless of run length (padding waste < one chunk per run)
+        E = -(-E_real // PIPELINE_CHUNK) * PIPELINE_CHUNK
         P = 16 if max_picks <= 16 else _pow2(max_picks)
         T = _pow2(max_tgs)
         K = MAX_PENALTY_NODES
@@ -2036,20 +2375,25 @@ class BatchWorker(Worker):
         )
         wanted = np.zeros(E, np.int32)
         wanted[:E_real] = [s.placements for s in sims]
-        args = (
-            table.cpu_total,
-            table.mem_total,
-            table.disk_total,
-            table.cpu_used,
-            table.mem_used,
-            table.disk_used,
-            stacked,
-            np.asarray(n_cands, np.int32),
-            int(P),
+        use_mesh = (
+            self._mesh is not None
+            and T == 1
+            and port_ask_arr is None
+            and dev_ask_arr is None
+            and dev_aff is None
+            and occ0 is None
+            and dh_tg is None
+            and C % self._mesh.devices.size == 0
         )
-        kwargs = dict(
-            spread_fit=spread_fit,
+        return _Assembled(
+            E_real=E_real,
+            E=E,
+            P=int(P),
+            T=int(T),
+            stacked=stacked,
+            n_cands=np.asarray(n_cands, np.int32),
             wanted=wanted,
+            spread_fit=spread_fit,
             coll0=coll0,
             affinity=affinity,
             spread=spread_stack,
@@ -2063,97 +2407,225 @@ class BatchWorker(Worker):
             dev_aff_on=dev_aff_on,
             occ0=occ0,
             dh_tg=dh_tg,
-        )
-        use_mesh = (
-            self._mesh is not None
-            and T == 1
-            and port_ask_arr is None
-            and dev_ask_arr is None
-            and dev_aff is None
-            and occ0 is None
-            and dh_tg is None
-            and C % self._mesh.devices.size == 0
-        )
-        if use_mesh:
-            # single-group batches only: the sharded runner keeps the
-            # historical per-eval scalar layout, which the T=1 slices
-            # reproduce exactly (per-pick values are constant within a
-            # single-group eval).  Spread batches route through the
-            # with_spread variant (VERDICT r4 #9) — the kernel carries
-            # the (S, V+1) spread state replicated and reduces only
-            # the winner/evictee slot one-hots over shards
-            spread_arg = spread_stack
-            runner = self._sharded_runner(
-                int(P), spread_fit,
-                with_spread=spread_arg is not None,
-                spread_even=(
-                    spread_arg is not None
-                    and spread_arg.even is not None
-                ),
-            )
-            sh_args = (
+            host_cols=(
                 table.cpu_total,
                 table.mem_total,
                 table.disk_total,
                 table.cpu_used,
                 table.mem_used,
                 table.disk_used,
-                stacked.feasible[:, 0],
-                stacked.perm,
-                stacked.ask_cpu[:, 0],
-                stacked.ask_mem[:, 0],
-                stacked.ask_disk[:, 0],
-                stacked.desired_count[:, 0],
-                stacked.limit[:, 0],
-                wanted,
-                np.asarray(n_cands, np.int32),
-                stacked.distinct_hosts,
-                coll0[:, 0]
-                if coll0 is not None
-                else np.zeros((E, C), np.int32),
-                affinity[:, 0]
-                if affinity is not None
-                else np.zeros((E, C)),
-                deltas,
-                pre,
-            )
-            if spread_arg is not None:
-                sh_args = sh_args + (spread_arg,)
-            if not self._launch_ready(sh_args, {}, fn=runner):
-                self._count("cold_shape_fallbacks")
-                return {}
-            rows_out = np.asarray(runner(*sh_args))
-            pulls_out = None
-            # operators can tell "mesh used" from "mesh skipped"
-            # (VERDICT r3 weak #6: the sharded path degraded quietly)
-            self._count("mesh_used")
-        elif not self._launch_ready(args, kwargs):
+            ),
+            # the sharded runner reshards its own inputs; only the
+            # chunk path reads the device-resident mirror
+            dev_cols=(
+                None if use_mesh else self._device_columns(table)
+            ),
+            use_mesh=use_mesh,
+        )
+
+    # -- launch + fetch (pipeline stages 2 and 3) ----------------------
+
+    @staticmethod
+    def _chunk_slice(x, c0: int, c1: int):
+        """Slice the leading eval axis of an optional array or
+        NamedTuple-of-arrays input (fields may be None, e.g.
+        SpreadInputs.even)."""
+        if x is None:
+            return None
+        if isinstance(x, np.ndarray):
+            return x[c0:c1]
+        return type(x)(
+            *[None if f is None else f[c0:c1] for f in x]
+        )
+
+    def _donation_enabled(self) -> bool:
+        """Donating the carry buffers only helps (and is only honored)
+        off-CPU; resolved lazily so backend init stays off the module
+        import path."""
+        if self._donate_carries is None:
+            import jax
+
+            self._donate_carries = jax.default_backend() != "cpu"
+        return self._donate_carries
+
+    def _launch_chunk(
+        self, asm: _Assembled, c0: int, c1: int, carry,
+        check_ready: bool,
+    ):
+        """Stage 2: dispatch one PIPELINE_CHUNK-wide slice of the run,
+        chained on ``carry`` (the previous chunk's device carry-out;
+        None = chain start, which reads the persistent device usage
+        mirror and the host-built occupancy arenas).  NON-blocking —
+        the return value holds device futures; ``_fetch`` realizes
+        them.  Returns None while the launch shape compiles in the
+        background (cold-compile shield)."""
+        sl = self._chunk_slice
+        cols = asm.dev_cols
+        if carry is None:
+            used = cols[3:6]
+            ports = asm.port_used0
+            devs = asm.dev_free0
+        else:
+            used, ports, devs = carry
+        args = (
+            cols[0],
+            cols[1],
+            cols[2],
+            used[0],
+            used[1],
+            used[2],
+            sl(asm.stacked, c0, c1),
+            asm.n_cands[c0:c1],
+            asm.P,
+        )
+        kwargs = dict(
+            spread_fit=asm.spread_fit,
+            wanted=asm.wanted[c0:c1],
+            coll0=sl(asm.coll0, c0, c1),
+            affinity=sl(asm.affinity, c0, c1),
+            spread=sl(asm.spread, c0, c1),
+            deltas=sl(asm.deltas, c0, c1),
+            pre=sl(asm.pre, c0, c1),
+            port_ask=sl(asm.port_ask, c0, c1),
+            port_used0=ports,
+            dev_ask=sl(asm.dev_ask, c0, c1),
+            dev_free0=devs,
+            dev_aff=sl(asm.dev_aff, c0, c1),
+            dev_aff_on=sl(asm.dev_aff_on, c0, c1),
+            occ0=sl(asm.occ0, c0, c1),
+            dh_tg=sl(asm.dh_tg, c0, c1),
+            return_carry=True,
+        )
+        if check_ready and not self._launch_ready(args, kwargs):
             # first sighting of this launch shape: an XLA compile takes
             # seconds and must not stall the scheduling pipeline —
             # compile in the background, schedule these evals exactly
-            self._count("cold_shape_fallbacks")
-            return {}
-        else:
-            rows_j, pulls_j = chained_plan_picks_cols(
-                *args, **kwargs
+            return None
+        fn = chained_plan_picks_cols
+        if carry is not None and self._donation_enabled():
+            # mid-chain chunks may donate their carry-in (it is the
+            # previous launch's output, never read again); fall back to
+            # the plain executable until the donating one is compiled.
+            # clone_args: the shield "compiles" by executing, and a
+            # donating background execution on the LIVE args would
+            # consume the very carry the plain launch below is using
+            donated = chained_plan_picks_cols_donated()
+            if self._launch_ready(
+                args, kwargs, fn=donated, clone_args=True
+            ):
+                fn = donated
+        rows_j, pulls_j, carry_out = fn(*args, **kwargs)
+        return rows_j, pulls_j, carry_out
+
+    @staticmethod
+    def _fetch(handle) -> Tuple[np.ndarray, np.ndarray]:
+        """Stage 3: realize a chunk's device futures — the only point
+        the host blocks on the device."""
+        rows_j, pulls_j, _carry = handle
+        return np.asarray(rows_j), np.asarray(pulls_j)
+
+    def _launch_mesh(self, asm: _Assembled) -> Optional[np.ndarray]:
+        """Single sharded launch over the whole run (NOMAD_TPU_MESH):
+        the node-axis mesh runner keeps the historical stacked
+        one-launch layout — it doesn't surface the chain carry, so the
+        mesh path doesn't chunk-pipeline.  Returns rows[E, P] (numpy,
+        blocking) or None while the shape compiles in the
+        background."""
+        # single-group batches only: the sharded runner keeps the
+        # historical per-eval scalar layout, which the T=1 slices
+        # reproduce exactly (per-pick values are constant within a
+        # single-group eval).  Spread batches route through the
+        # with_spread variant (VERDICT r4 #9) — the kernel carries
+        # the (S, V+1) spread state replicated and reduces only
+        # the winner/evictee slot one-hots over shards
+        spread_arg = asm.spread
+        runner = self._sharded_runner(
+            asm.P, asm.spread_fit,
+            with_spread=spread_arg is not None,
+            spread_even=(
+                spread_arg is not None
+                and spread_arg.even is not None
+            ),
+        )
+        E, C = asm.stacked.perm.shape
+        stacked = asm.stacked
+        # the chunk-aligned arena (multiples of PIPELINE_CHUNK) would
+        # mint up to BATCH_MAX/PIPELINE_CHUNK sharded trace shapes per
+        # pick bucket; pad the eval axis back to the historical
+        # {8, BATCH_MAX} buckets with inert rows (wanted=0, n_cand=1)
+        # so the mesh runner keeps two compiled programs
+        E_bucket = 8 if E <= 8 else BATCH_MAX
+        pad_n = E_bucket - E
+
+        def pad_e(arr, fill):
+            if pad_n <= 0:
+                return arr
+            shape = (pad_n,) + arr.shape[1:]
+            return np.concatenate(
+                [arr, np.full(shape, fill, arr.dtype)]
             )
-            rows_out = np.asarray(rows_j)
-            pulls_out = np.asarray(pulls_j)
-        out: Dict[str, dict] = {}
-        for k, (ev, _token, _job) in enumerate(prescorable):
-            out[ev.id] = {
-                "rows": [
-                    int(r) for r in rows_out[k, : sims[k].placements]
-                ],
-                # mesh launches don't surface pulls; preempt retries
-                # deviate there
-                "pulls": (
-                    [int(p) for p in pulls_out[k, : sims[k].placements]]
-                    if pulls_out is not None
-                    else None
-                ),
-            }
-        return out
+
+        def pad_tuple(tup, fills):
+            if pad_n <= 0:
+                return tup
+            return type(tup)(
+                *[
+                    None if f is None else pad_e(f, fill)
+                    for f, fill in zip(tup, fills)
+                ]
+            )
+
+        perm_pad = stacked.perm
+        if pad_n > 0:
+            perm_pad = np.concatenate(
+                [
+                    stacked.perm,
+                    np.tile(
+                        np.arange(C, dtype=np.int32), (pad_n, 1)
+                    ),
+                ]
+            )
+        deltas = pad_tuple(asm.deltas, (-1, 0, 0, 0, 0, -1))
+        pre = pad_tuple(asm.pre, (0, 0, 0, 0))
+        if spread_arg is not None:
+            spread_arg = pad_tuple(
+                spread_arg, (0,) * len(spread_arg)
+            )
+        sh_args = asm.host_cols + (
+            pad_e(stacked.feasible[:, 0], False),
+            perm_pad,
+            pad_e(stacked.ask_cpu[:, 0], 0.0),
+            pad_e(stacked.ask_mem[:, 0], 0.0),
+            pad_e(stacked.ask_disk[:, 0], 0.0),
+            pad_e(stacked.desired_count[:, 0], 1),
+            pad_e(stacked.limit[:, 0], 1),
+            pad_e(asm.wanted, 0),
+            pad_e(asm.n_cands, 1),
+            pad_e(stacked.distinct_hosts, False),
+            pad_e(
+                asm.coll0[:, 0]
+                if asm.coll0 is not None
+                else np.zeros((E, C), np.int32),
+                0,
+            ),
+            pad_e(
+                asm.affinity[:, 0]
+                if asm.affinity is not None
+                else np.zeros((E, C)),
+                0.0,
+            ),
+            deltas,
+            pre,
+        )
+        if spread_arg is not None:
+            sh_args = sh_args + (spread_arg,)
+        if not self._launch_ready(sh_args, {}, fn=runner):
+            return None
+        rows_out = np.asarray(runner(*sh_args))
+        # operators can tell "mesh used" from "mesh skipped"
+        # (VERDICT r3 weak #6: the sharded path degraded quietly)
+        self._count("mesh_used")
+        return rows_out
 
     # -- cold-compile shield -------------------------------------------
 
@@ -2167,11 +2639,19 @@ class BatchWorker(Worker):
             for l in leaves
         )
 
-    def _launch_ready(self, args, kwargs, fn=None) -> bool:
+    def _launch_ready(
+        self, args, kwargs, fn=None, clone_args=False
+    ) -> bool:
         """Whether this launch shape has a compiled executable.  A new
         shape kicks off a background compile and returns False — the
         caller falls back to the exact sequential path until the
         executable is ready, so cold XLA compiles never block evals.
+
+        ``clone_args=True`` is REQUIRED when ``fn`` donates any of its
+        inputs: the shield compiles by executing, and a donating
+        background execution on the caller's live arrays would consume
+        buffers another launch is concurrently reading — the clone
+        gives the background run its own device copies to burn.
 
         NOMAD_TPU_SYNC_COMPILE=1 (the test suite, via conftest) makes
         cold compiles block instead, so prescore-rate assertions are
@@ -2200,7 +2680,17 @@ class BatchWorker(Worker):
             try:
                 import jax as _jax
 
-                _jax.block_until_ready(fn(*args, **kwargs))
+                a, k = args, kwargs
+                if clone_args:
+                    a, k = _jax.tree_util.tree_map(
+                        lambda leaf: (
+                            leaf.copy()
+                            if hasattr(leaf, "copy")
+                            else leaf
+                        ),
+                        (args, kwargs),
+                    )
+                _jax.block_until_ready(fn(*a, **k))
             except Exception:  # noqa: BLE001
                 ok = False
                 LOG.exception("background kernel compile failed")

@@ -17,9 +17,12 @@ in underneath.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
+
+LOG = logging.getLogger("nomad_tpu.server")
 
 from ..state.store import StateStore
 from ..structs import (
@@ -257,6 +260,28 @@ class Server:
             self.applier.start()
             for worker in self.workers:
                 worker.start()
+            # opt-in: pre-compile the pipelined prescore launch shapes
+            # off the scheduling path (production deployments set
+            # NOMAD_TPU_WARM_ON_START=1; test servers start hundreds
+            # of times and must not pay the XLA compiles).  Without it
+            # the cold-compile shield routes the first batches to the
+            # exact sequential path until the background compile lands.
+            # The warmup waits for the node join wave to settle first:
+            # compiled shapes embed the node arena capacity, so warming
+            # the initial near-empty table would compile executables no
+            # later launch matches
+            import os as _os
+
+            if _os.environ.get("NOMAD_TPU_WARM_ON_START") == "1":
+                for worker in self.workers:
+                    warm = getattr(worker, "warm_shapes", None)
+                    if warm is not None:
+                        threading.Thread(
+                            target=self._warm_when_topology_settles,
+                            args=(warm,),
+                            name="prescore-warmup",
+                            daemon=True,
+                        ).start()
             self.deployment_watcher.start()
             self.drainer.start()
             self.periodic.start()
@@ -271,6 +296,35 @@ class Server:
                 if node.status != NODE_STATUS_DOWN:
                     self._reset_heartbeat(node.id)
             self.restore_evals()
+
+    def _warm_when_topology_settles(
+        self, warm, poll_s: float = 5.0, timeout_s: float = 300.0
+    ) -> None:
+        """Run a worker's warm_shapes once the node table has at least
+        one row and its topology generation held still for one poll
+        interval (or the timeout passes).  Compiled launch shapes embed
+        the arena capacity, so warming before clients register would
+        burn the compiles on a capacity no production launch uses."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        last = None
+        while self._running and _time.monotonic() < deadline:
+            # re-read the table each poll: a snapshot restore replaces
+            # store.node_table, and a stale binding would see a frozen
+            # generation and fire mid-join-wave
+            table = self.store.node_table
+            gen = (table.epoch, table.topo_generation)
+            if table.n_rows > 0 and gen == last:
+                break
+            last = gen
+            _time.sleep(poll_s)
+        if not self._running:
+            return
+        try:
+            warm()
+        except Exception:  # noqa: BLE001 — warmup is best-effort
+            LOG.exception("prescore warmup failed")
 
     def revoke_leadership(self) -> None:
         """Disable leader-only services (reference leader.go

@@ -30,6 +30,7 @@ Key ideas:
 """
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
 
 import numpy as np
@@ -77,7 +78,14 @@ class _Column:
 
 
 class NodeTable:
+    # process-wide instance epoch: a snapshot restore REPLACES the
+    # store's table with a fresh one whose generation counters restart,
+    # so consumers keying caches on (generation, capacity) alone could
+    # collide with pre-restore state — the epoch disambiguates tables
+    _epochs = itertools.count()
+
     def __init__(self, capacity: int = MIN_CAPACITY) -> None:
+        self.epoch = next(NodeTable._epochs)
         self.capacity = capacity
         self.n_rows = 0  # high-water mark of used rows
         self.row_of: Dict[str, int] = {}
@@ -117,6 +125,17 @@ class NodeTable:
         # survive plan commits (usage changes every apply; topology
         # changes orders of magnitude less often)
         self.topo_generation = 0
+        # usage-delta log: monotone generation bumped on every usage
+        # write, plus row -> generation-last-dirtied.  Consumers that
+        # mirror the usage columns (the BatchWorker's device-resident
+        # input cache) record the generation they synced at and patch
+        # only rows dirtied since, instead of re-shipping all C rows
+        # per flush.  Bounded: one entry per row ever dirtied.
+        self.usage_generation = 0
+        self._usage_dirty: Dict[int, int] = {}
+        # row -> scheduling-relevant fingerprint of the node last
+        # upserted there, for topo-change detection (see upsert_node)
+        self._row_fingerprints: Dict[int, tuple] = {}
 
     # ------------------------------------------------------------------
     # arena management
@@ -191,15 +210,92 @@ class NodeTable:
     # mutation
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _node_fingerprint(node: "Node", eligible: bool) -> tuple:
+        """Everything about a node that any topology-keyed consumer
+        can observe (columns — materialized or lazily created later —
+        candidate sets, port-reservation columns, device inventory).
+        If this tuple is unchanged, re-upserting the node cannot
+        change any scheduling decision."""
+        res = node.node_resources
+        reserved = node.reserved_resources
+        return (
+            node.name,
+            node.datacenter,
+            node.node_class,
+            node.computed_class,
+            eligible,
+            float(res.cpu - reserved.cpu),
+            float(res.memory_mb - reserved.memory_mb),
+            float(res.disk_mb - reserved.disk_mb),
+            tuple(sorted(node.attributes.items())),
+            tuple(sorted(node.meta.items())),
+            tuple(
+                sorted(
+                    (k, bool(v)) for k, v in node.drivers.items()
+                )
+            ),
+            tuple(
+                sorted(
+                    (k, v.read_only)
+                    for k, v in node.host_volumes.items()
+                )
+            ),
+            tuple(
+                sorted(
+                    (k, bool(v))
+                    for k, v in node.csi_node_plugins.items()
+                )
+            ),
+            tuple(sorted(reserved.reserved_ports)),
+            tuple(
+                (
+                    net.mode or "host",
+                    net.ip or "",
+                    tuple(
+                        sorted(p.value for p in net.reserved_ports)
+                    ),
+                )
+                for net in res.networks
+            ),
+            tuple(
+                (
+                    g.vendor,
+                    g.type,
+                    g.name,
+                    tuple(
+                        sorted(
+                            (k, str(v))
+                            for k, v in g.attributes.items()
+                        )
+                    ),
+                    tuple(g.instance_ids),
+                )
+                for g in res.devices
+            ),
+        )
+
     def upsert_node(self, node: "Node") -> int:
         if not hasattr(self, "_nodes_cache"):
             self._nodes_cache: Dict[str, "Node"] = {}
         self._nodes_cache[node.id] = node
         row = self.row_of.get(node.id)
+        changed = row is None  # join = topology change by definition
         if row is None:
             row = self._alloc_row(node.id)
+        eligible = node.ready()
+        # topology change detection: heartbeats and periodic
+        # fingerprints re-upsert nodes with UNCHANGED state every few
+        # seconds; bumping topo_generation for those would thrash
+        # every topology-keyed cache downstream (candidate/mask/port
+        # columns, the BatchWorker's device-resident input mirror), so
+        # the bump happens only when the node's scheduling-relevant
+        # fingerprint actually moves
+        fp = self._node_fingerprint(node, eligible)
+        changed |= self._row_fingerprints.get(row) != fp
+        self._row_fingerprints[row] = fp
         self.active[row] = True
-        self.eligible[row] = node.ready()
+        self.eligible[row] = eligible
         res = node.node_resources
         reserved = node.reserved_resources
         self.cpu_total[row] = float(res.cpu - reserved.cpu)
@@ -224,7 +320,8 @@ class NodeTable:
         if groups or row in self.device_groups:
             self.device_groups[row] = groups
         self.generation += 1
-        self.topo_generation += 1
+        if changed:
+            self.topo_generation += 1
         return row
 
     def delete_node(self, node_id: str) -> None:
@@ -234,8 +331,11 @@ class NodeTable:
         self.active[row] = False
         self.eligible[row] = False
         self.cpu_used[row] = self.mem_used[row] = self.disk_used[row] = 0.0
+        self.usage_generation += 1
+        self._usage_dirty[row] = self.usage_generation
         self.node_ids[row] = None
         self.device_groups.pop(row, None)
+        self._row_fingerprints.pop(row, None)
         # a reused row must not inherit phantom device reservations
         for key in [k for k in self.device_used if k[0] == row]:
             del self.device_used[key]
@@ -255,6 +355,18 @@ class NodeTable:
         self.mem_used[row] = float(usage[1])
         self.disk_used[row] = float(usage[2])
         self.generation += 1
+        self.usage_generation += 1
+        self._usage_dirty[row] = self.usage_generation
+
+    def usage_rows_dirty_since(self, generation: int) -> List[int]:
+        """Rows whose usage columns changed after ``generation``.
+        Callers needing atomicity against concurrent writers go through
+        ``StateStore.usage_delta_since`` (takes the store lock)."""
+        return [
+            row
+            for row, g in self._usage_dirty.items()
+            if g > generation
+        ]
 
     # ------------------------------------------------------------------
     # views

@@ -798,6 +798,24 @@ class StateStore:
         free everywhere)."""
         return self._ports_live.get(port, {})
 
+    def usage_delta_since(
+        self, generation: int
+    ) -> Tuple[int, List[int]]:
+        """Atomic (current usage generation, rows dirtied after
+        ``generation``) for consumers that mirror the node table's
+        usage columns off-host (the BatchWorker's device-resident
+        input cache).  Taken under the store lock so a concurrent plan
+        apply can't dirty a row between the generation read and the
+        row scan — a racing write after release only makes the row
+        dirty again at a later generation, so the next delta re-patches
+        it with the same values (idempotent)."""
+        with self._lock:
+            table = self.node_table
+            return (
+                table.usage_generation,
+                table.usage_rows_dirty_since(generation),
+            )
+
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
         return self.allocs.get(alloc_id)
 

@@ -1058,6 +1058,8 @@ def test_warm_shapes_are_recognized_by_launch_gate(monkeypatch):
             coll0=None, affinity=None, spread=None,
             deltas=worker._zero_deltas(8, 16),
             pre=worker._zero_pre(8),
+            # production chunk launches always ask for the carry
+            return_carry=True,
         )
         assert worker._launch_ready(args, kwargs), (
             "pre-warmed launch shape not recognized"
@@ -1939,3 +1941,236 @@ def test_batch_pipeline_group_level_distinct_hosts():
     finally:
         seq.stop()
         bat.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipelined prescore: chunked carry launches + snapshot-delta input cache
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_carry_launches_match_single_launch():
+    """Splitting one E-eval chain into PIPELINE_CHUNK-wide launches
+    threaded through the kernel's carry output (return_carry=True) is
+    bit-identical to the single launch — the invariant the pipelined
+    prescore rests on (a lax.scan cut at an eval boundary)."""
+    import numpy as np
+
+    from nomad_tpu.ops.batch import (
+        ChainInputs,
+        chained_plan_picks_cols,
+    )
+
+    rng = np.random.default_rng(7)
+    C, E, P = 32, 16, 4
+    cpu_total = np.full(C, 4000.0)
+    mem_total = np.full(C, 8192.0)
+    disk_total = np.full(C, 100000.0)
+    used = (
+        rng.random(C) * 1000,
+        rng.random(C) * 2000,
+        rng.random(C) * 100,
+    )
+    stacked = ChainInputs(
+        feasible=np.ones((E, 1, C), bool),
+        perm=np.stack(
+            [rng.permutation(C).astype(np.int32) for _ in range(E)]
+        ),
+        ask_cpu=np.full((E, P), 100.0),
+        ask_mem=np.full((E, P), 256.0),
+        ask_disk=np.full((E, P), 300.0),
+        desired_count=np.full((E, P), 4, np.int32),
+        limit=np.full((E, P), 5, np.int32),
+        distinct_hosts=np.zeros(E, bool),
+        tg_idx=np.zeros((E, P), np.int32),
+    )
+    nc = np.full(E, C, np.int32)
+    wanted = np.full(E, 4, np.int32)
+    r_full, p_full = (
+        np.asarray(x)
+        for x in chained_plan_picks_cols(
+            cpu_total, mem_total, disk_total, *used,
+            stacked, nc, P, wanted=wanted,
+        )
+    )
+
+    def sl(x, a, b):
+        return type(x)(*[f[a:b] for f in x])
+
+    carry = None
+    rows, pulls = [], []
+    for a in range(0, E, 8):
+        b = a + 8
+        u = used if carry is None else carry[0]
+        r, p, carry = chained_plan_picks_cols(
+            cpu_total, mem_total, disk_total, u[0], u[1], u[2],
+            sl(stacked, a, b), nc[a:b], P, wanted=wanted[a:b],
+            return_carry=True,
+        )
+        rows.append(np.asarray(r))
+        pulls.append(np.asarray(p))
+    assert (np.concatenate(rows) == r_full).all()
+    assert (np.concatenate(pulls) == p_full).all()
+
+
+def test_pipelined_multi_chunk_gulp_matches_sequential():
+    """A burst larger than PIPELINE_CHUNK forces multi-chunk pipelined
+    runs (chunk N+1 chains on N's device carry while N-1 replays);
+    placements must stay bit-identical to the sequential scheduler."""
+    nodes = make_nodes(24, seed=21)
+    jobs = make_jobs(20, seed=22)
+
+    seq = Server(num_schedulers=1, seed=55, batch_pipeline=False)
+    bat = Server(num_schedulers=1, seed=55, batch_pipeline=True)
+    seq.start()
+    bat.start()
+    try:
+        for node in nodes:
+            seq.register_node(copy.deepcopy(node))
+            bat.register_node(copy.deepcopy(node))
+        for job in jobs:
+            seq.register_job(copy.deepcopy(job))
+        assert seq.drain_to_idle(30)
+        # burst-register so the worker drains multi-chunk gulps
+        for job in jobs:
+            bat.register_job(copy.deepcopy(job))
+        assert bat.drain_to_idle(60)
+        for job in jobs:
+            assert placements(seq, job.id) == placements(
+                bat, job.id
+            ), f"divergence for {job.id}"
+        worker = bat.workers[0]
+        assert worker.prescored > 0
+        assert worker.timings["assemble"] > 0.0
+        assert worker.timings["fetch"] > 0.0
+    finally:
+        seq.stop()
+        bat.stop()
+
+
+def test_input_cache_delta_patch_bit_identical():
+    """The device-resident usage mirror, delta-patched from the
+    store's dirty-row log, must stay bit-identical to from-scratch
+    assembly (the live table columns) after a plan commit, a node
+    drain, a node register and a driver re-fingerprint."""
+    import numpy as np
+
+    bat = Server(num_schedulers=1, seed=31, batch_pipeline=True)
+    bat.start()
+    try:
+        nodes = make_nodes(10, seed=5)
+        for node in nodes:
+            bat.register_node(node)
+        worker = bat.workers[0]
+        table = bat.store.node_table
+
+        def assert_mirror_exact(label):
+            cols = worker._device_columns(table)
+            for got, want in zip(
+                cols,
+                (
+                    table.cpu_total, table.mem_total,
+                    table.disk_total, table.cpu_used,
+                    table.mem_used, table.disk_used,
+                ),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(got), want, err_msg=label
+                )
+
+        assert_mirror_exact("initial sync")
+
+        # plan commit: usage columns change, topology doesn't -> the
+        # dirty-row patch path must reproduce the columns exactly
+        for job in make_jobs(3, seed=9):
+            bat.register_job(job)
+        assert bat.drain_to_idle(30)
+        assert_mirror_exact("after plan commit")
+        assert worker._input_cache_hits > 0, (
+            worker._input_cache_hits, worker._input_cache_misses
+        )
+
+        # node drain: topology generation bumps -> full resync
+        bat.store.update_node_drain(nodes[0].id, True)
+        assert_mirror_exact("after node drain")
+
+        # node register: arena may grow / new row
+        extra = make_nodes(1, seed=77)[0]
+        bat.register_node(extra)
+        assert_mirror_exact("after node register")
+
+        # driver re-fingerprint: re-upsert with changed attributes
+        # (totals untouched, but rows could have been reassigned)
+        refp = nodes[1]
+        refp.attributes = dict(refp.attributes)
+        refp.attributes["driver.raw_exec"] = "1"
+        bat.store.upsert_node(refp)
+        assert_mirror_exact("after driver re-fingerprint")
+
+        # steady state again: another commit after the topo churn
+        for job in make_jobs(2, seed=13):
+            job.id = job.id + "-post"
+            bat.register_job(job)
+        assert bat.drain_to_idle(30)
+        assert_mirror_exact("after post-churn commit")
+    finally:
+        bat.stop()
+
+
+def test_input_cache_hit_rate_exported_on_second_flush():
+    """Smoke: two consecutive flushes through the BatchWorker must
+    export a batch_worker.input_cache_hit_rate gauge > 0 on /v1/metrics
+    after the second flush — the delta cache can't silently stop
+    engaging."""
+    import json
+    import urllib.request
+
+    from nomad_tpu.api import start_http_server
+
+    bat = Server(num_schedulers=1, seed=17, batch_pipeline=True)
+    bat.start()
+    http = start_http_server(bat, port=0)
+    try:
+        for node in make_nodes(8, seed=4):
+            bat.register_node(node)
+        # flush 1: first sync of the device mirror (a miss)
+        bat.register_job(make_jobs(1, seed=41)[0])
+        assert bat.drain_to_idle(30)
+        # flush 2: the plan commit above dirtied rows -> delta patch
+        job2 = make_jobs(1, seed=42)[0]
+        job2.id = "cache-hit-probe"
+        bat.register_job(job2)
+        assert bat.drain_to_idle(30)
+        worker = bat.workers[0]
+        assert worker.prescored >= 2, (
+            worker.prescored, worker.fallbacks, worker.errors
+        )
+        base = f"http://127.0.0.1:{http.port}"
+        with urllib.request.urlopen(
+            base + "/v1/metrics", timeout=10
+        ) as resp:
+            dump = json.loads(resp.read())
+        rate = dump["gauges"].get(
+            "batch_worker.input_cache_hit_rate"
+        )
+        assert rate is not None, dump["gauges"]
+        assert rate > 0.0, dump["gauges"]
+    finally:
+        http.stop()
+        bat.stop()
+
+
+def test_assembly_caches_are_lru_not_clear_all():
+    """A one-off job signature must evict only the coldest cache entry,
+    not every warm one (the old clear-all-on-overflow behavior)."""
+    from nomad_tpu.server.batch_worker import _LRUCache
+
+    lru = _LRUCache(3)
+    for i in range(3):
+        lru.put(("gen", i), i)
+    # touch entry 0 so it is the warmest
+    assert lru.get(("gen", 0)) == 0
+    lru.put(("gen", 99), 99)  # one-off: evicts only the coldest (1)
+    assert lru.get(("gen", 1)) is None
+    assert lru.get(("gen", 0)) == 0
+    assert lru.get(("gen", 2)) == 2
+    assert lru.get(("gen", 99)) == 99

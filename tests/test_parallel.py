@@ -346,8 +346,11 @@ def test_batch_worker_sharded_prescore_matches_sequential(monkeypatch):
         node.node_resources.memory_mb = rng.choice([8192, 16384])
         node.computed_class = compute_node_class(node)
         nodes.append(node)
+    # 12 jobs: bursts bigger than one PIPELINE_CHUNK exercise the
+    # mesh path's eval-axis re-padding (chunk-aligned arena -> the
+    # historical {8, BATCH_MAX} sharded buckets)
     jobs = []
-    for i in range(6):
+    for i in range(12):
         job = mock.job(id=f"mesh-{i}")
         job.task_groups[0].count = rng.randint(1, 5)
         job.task_groups[0].tasks[0].resources.cpu = rng.choice(

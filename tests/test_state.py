@@ -173,3 +173,48 @@ def test_deleted_node_row_reuse_drops_device_reservations():
     store.upsert_node(gpu2)
     if store.node_table.row_of[gpu2.id] == row:
         assert key not in store.node_table.device_used
+
+
+def test_node_table_topo_generation_ignores_no_op_upserts():
+    """Heartbeats re-upsert nodes with unchanged state every few
+    seconds; those must NOT bump topo_generation (it would thrash
+    every topology-keyed cache — candidate/mask/port columns and the
+    BatchWorker's device-resident input mirror).  Real changes —
+    drain, attribute/fingerprint moves, resource changes — must."""
+    from nomad_tpu import mock
+    from nomad_tpu.state.store import StateStore
+
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(node)
+    table = store.node_table
+    gen = table.topo_generation
+
+    # no-op re-upsert (heartbeat shape): no topo bump
+    store.upsert_node(node)
+    assert table.topo_generation == gen
+
+    # status churn that leaves ready() unchanged: no topo bump
+    store.update_node_status(node.id, node.status)
+    assert table.topo_generation == gen
+
+    # attribute change (driver re-fingerprint): bump
+    node.attributes = dict(node.attributes)
+    node.attributes["driver.raw_exec"] = "1"
+    store.upsert_node(node)
+    assert table.topo_generation > gen
+    gen = table.topo_generation
+
+    # drain flips eligibility: bump
+    store.update_node_drain(node.id, True)
+    assert table.topo_generation > gen
+    gen = table.topo_generation
+
+    # usage writes never touch topology, only the usage delta log
+    ugen = table.usage_generation
+    table.update_node_usage(node.id, (100, 200, 300))
+    assert table.topo_generation == gen
+    assert table.usage_generation == ugen + 1
+    row = table.row_of[node.id]
+    assert row in table.usage_rows_dirty_since(ugen)
+    assert table.usage_rows_dirty_since(table.usage_generation) == []
