@@ -277,6 +277,27 @@ def bench_e2e():
             + stats.get("launch", 0.0)
             + stats.get("fetch", 0.0)
         ) / total_staged
+        # replay share + optimistic-replay outcome (the stage PR 2
+        # parallelized: speculative wave + conflict-checked commit)
+        replay_share = stats.get("replay", 0.0) / total_staged
+        replay_stats = {
+            "speculative": worker.replay_speculative,
+            "conflicts": worker.replay_conflicts,
+            "serial_fallbacks": worker.replay_serial_fallbacks,
+        }
+        spec_total = (
+            worker.replay_speculative + worker.replay_conflicts
+        )
+        replay_conflict_rate = (
+            worker.replay_conflicts / spec_total if spec_total else 0.0
+        )
+        log(
+            f"e2e-tpu replay: share={replay_share:.3f} "
+            f"speculative={replay_stats['speculative']} "
+            f"conflicts={replay_stats['conflicts']} "
+            f"serial_fallbacks={replay_stats['serial_fallbacks']} "
+            f"(conflict rate {replay_conflict_rate:.3f})"
+        )
 
         # parity: the serially-equivalent contract means the common
         # prefix of the two streams must be bit-identical
@@ -307,7 +328,8 @@ def bench_e2e():
         tpu.stop()
     return (
         oracle_rate, tpu_rate, p50, p99, same, stats,
-        prescore_share,
+        prescore_share, replay_share, replay_conflict_rate,
+        replay_stats,
     )
 
 
@@ -962,7 +984,8 @@ def main():
     _preflight()
     (
         oracle_rate, tpu_rate, p50, p99, same, stage_times,
-        prescore_share,
+        prescore_share, replay_share, replay_conflict_rate,
+        replay_stats,
     ) = bench_e2e()
     configs = bench_configs() if WITH_CONFIGS else {}
     kernel = bench_kernel_only() if WITH_KERNEL else {}
@@ -990,6 +1013,11 @@ def main():
                     k: round(v, 3) for k, v in stage_times.items()
                 },
                 "e2e_prescore_share": round(prescore_share, 3),
+                "e2e_replay_share": round(replay_share, 3),
+                "replay_conflict_rate": round(
+                    replay_conflict_rate, 3
+                ),
+                "replay_counters": replay_stats,
                 "kernel_batch_placements_per_sec": round(
                     kernel.get("kernel-batch", 0.0), 1
                 ),

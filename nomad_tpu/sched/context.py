@@ -105,6 +105,7 @@ class EvalContext:
         state: "StateSnapshot",
         plan: Plan,
         seed: Optional[int] = None,
+        speculative: bool = False,
     ) -> None:
         self.state = state
         self.plan = plan
@@ -113,6 +114,13 @@ class EvalContext:
         self.regex_cache: Dict = {}
         self.version_cache: Dict = {}
         self.rng = random.Random(seed)
+        # speculative replay mode (BatchWorker optimistic parallel
+        # replay): this context is pinned to a wave snapshot and runs
+        # concurrently with other evals' replays, so stack paths whose
+        # read set can't be conflict-checked per node (preemption
+        # passthrough walks EVERY candidate) must deviate to the
+        # serial path instead of answering from possibly-stale state
+        self.speculative = speculative
 
     def reset(self) -> None:
         """Called between placements (reference context.go:116 Reset)."""

@@ -76,12 +76,18 @@ SUPPORTED_TRIGGERS = {
 class GenericScheduler:
     def __init__(
         self, state, planner, batch: bool, use_tpu: Optional[bool] = None,
-        seed: Optional[int] = None,
+        seed: Optional[int] = None, speculative: bool = False,
     ) -> None:
         self.state = state
         self.planner = planner
         self.batch = batch
         self.seed = seed
+        # snapshot-pinned, side-effect-free replay mode: `state` is an
+        # immutable wave snapshot and `planner` a capturing facade (the
+        # BatchWorker's speculative planner) — the flag flows into the
+        # EvalContext so stacks can refuse paths that read beyond the
+        # conflict-checkable set
+        self.speculative = speculative
         if use_tpu is None:
             use_tpu = state.scheduler_config().tpu_scheduler_enabled
         self.use_tpu = use_tpu
@@ -190,7 +196,10 @@ class GenericScheduler:
             )
 
         self.failed_tg_allocs = {}
-        self.ctx = EvalContext(self.state, self.plan, seed=self.seed)
+        self.ctx = EvalContext(
+            self.state, self.plan, seed=self.seed,
+            speculative=self.speculative,
+        )
         self.stack = self._make_stack()
         if self.job is not None and not self.job.stopped():
             self.stack.set_job(self.job)

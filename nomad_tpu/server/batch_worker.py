@@ -46,7 +46,8 @@ import random
 import threading
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, FrozenSet, List, Optional, Tuple
+from dataclasses import replace as _dc_replace
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 LOG = logging.getLogger("nomad_tpu.server.batch_worker")
 
@@ -88,10 +89,23 @@ MAX_PRE_ROWS = 512  # pre-placement delta rows before falling back
 # BATCH_MAX - 1) and chunk N's device time overlaps chunk N-1's host
 # replay
 PIPELINE_CHUNK = 8
+# optimistic parallel replay: below this many prescored evals in a run
+# the speculative-wave dispatch overhead beats the win
+REPLAY_MIN_WAVE = 2
+# upper bound on retained dequeue timestamps: entries normally pop on
+# ack/nack, but an eval that dies between dequeue and either would
+# otherwise leak its stamp forever
+DEQ_TS_MAX = 1024
 
 
 class _Deviation(Exception):
     """The eval's control flow left the prescored fast path."""
+
+
+class _SpecAbort(Exception):
+    """Speculative replay left the provably-serial-equivalent path
+    (e.g. its plan did not verify as a clean full commit against the
+    wave snapshot); the eval must replay serially."""
 
 
 _LRU_MISS = object()
@@ -235,6 +249,79 @@ class _Assembled:
     use_mesh: bool = False
 
 
+class _SpecPlanner:
+    """Capturing Planner facade for speculative replay (phase A of the
+    optimistic parallel replay — see docs/ARCHITECTURE.md "Optimistic
+    parallel replay").  ``submit_plan`` verifies the plan against the
+    shared wave snapshot (reusing ``plan_apply.evaluate_plan``, the
+    same per-node check the applier runs) but commits NOTHING; every
+    planner side effect — plan submit, eval status writes,
+    blocked/follow-up eval creation — is recorded in call order and
+    replayed verbatim by the in-order commit phase.  A plan whose
+    speculative verification is not a clean full commit aborts the
+    speculation: the serial path owns partial commits and their
+    refresh/retry control flow."""
+
+    def __init__(self, snap) -> None:
+        self.snap = snap
+        self.ops: List[tuple] = []
+        # nodes the captured plans would mutate — part of the
+        # speculation's conflict read set
+        self.touched: Set[str] = set()
+
+    def submit_plan(self, plan):
+        from .plan_apply import evaluate_plan
+
+        plan.snapshot_index = self.snap.index
+        result, full = evaluate_plan(self.snap, plan)
+        if not full:
+            raise _SpecAbort("speculative verification was partial")
+        self.touched.update(plan.node_update)
+        self.touched.update(plan.node_allocation)
+        self.touched.update(plan.node_preemptions)
+        self.ops.append(("submit", plan))
+        return result, None
+
+    def update_eval(self, ev) -> None:
+        self.ops.append(("update_eval", ev))
+
+    def create_eval(self, ev) -> None:
+        self.ops.append(("create_eval", ev))
+
+    def reblock_eval(self, ev) -> None:
+        self.ops.append(("reblock_eval", ev))
+
+
+@dataclass
+class _Speculation:
+    """One eval's captured speculative replay, awaiting its in-order
+    conflict check + commit."""
+
+    ops: List[tuple]
+    # two-tier read set (see docs/ARCHITECTURE.md "Optimistic
+    # parallel replay").  strict_nodes: nodes hosting the job's
+    # allocs at speculation time — the reconciler, tainted scan and
+    # in-place update probes read them as real control-flow inputs,
+    # so ANY touch past the wave baseline conflicts.  plan_nodes:
+    # nodes the captured plans mutate — their reads are the winner
+    # verification whose fit the kernel chain already modeled for
+    # every earlier chain member, so touches the wave's OWN committed
+    # plans account for are expected; only an unexpected (external)
+    # touch conflicts.
+    strict_nodes: Set[str]
+    plan_nodes: Set[str]
+    # the _replay_one contract: False = a prescored pick failed, the
+    # chained state past this eval is suspect
+    clean: bool
+    # non-node reads the per-node ledger can't cover, re-checked at
+    # commit time: the job version the replay ran against, the
+    # scheduler-config table index, and (service evals) the absence
+    # of a deployment
+    job_fence: tuple = ()
+    config_index: int = -1
+    check_deployment: bool = False
+
+
 class PrescoredStack:
     """Stack whose select() replays a precomputed pick sequence.
 
@@ -344,6 +431,14 @@ class PrescoredStack:
             # chain past this eval is already marked suspect
             return self.inner.select(tg, options)
         if options is not None and options.preempt:
+            if getattr(self.ctx, "speculative", False):
+                # the passthrough's oracle walk reads EVERY candidate
+                # node — a read set the per-node conflict ledger can't
+                # cover — so a speculative replay hands preemption
+                # retries to the serial path
+                raise _Deviation(
+                    "preemption retry needs the serial replay"
+                )
             self._enter_passthrough()
             return self.inner.select(tg, options)
         if options is not None and options.preferred_nodes:
@@ -458,6 +553,43 @@ class BatchWorker(Worker):
         self.cold_shape_fallbacks = 0
         self.mesh_used = 0
         self.preempt_passthroughs = 0
+        # optimistic parallel replay (the same optimistic-concurrency
+        # shape as the plan applier): prescored evals replay
+        # speculatively on a thread pool against the shared wave
+        # snapshot, then commit in queue order behind a per-node
+        # conflict check — an eval whose read set was mutated by an
+        # earlier-committed plan (or an external writer) is discarded
+        # and re-replayed serially, so the committed outcome is
+        # bit-identical to the serial worker loop.
+        # NOMAD_TPU_PARALLEL_REPLAY=0 restores the serial replay loop.
+        self.parallel_replay = (
+            _os.environ.get("NOMAD_TPU_PARALLEL_REPLAY", "1") != "0"
+        )
+        # strict mode: ALL read nodes conflict on any touch, own-wave
+        # commits included — full bit-identity of alloc score metrics
+        # on wave-contended nodes, at the cost of serializing every
+        # contended eval (the relaxed default keeps decisions, plans
+        # and eval outcomes bit-identical; only contended-node score
+        # metrics may reflect the wave snapshot)
+        self.replay_strict = (
+            _os.environ.get("NOMAD_TPU_REPLAY_STRICT") == "1"
+        )
+        # node-touch counts of the last serial replay's committed
+        # plan (None = unknown writes), merged into the wave's
+        # expected-touch ledger so serial fallbacks don't poison the
+        # relaxed conflict check for later wave members
+        self._last_replay_touches: Optional[Dict[str, int]] = None
+        try:
+            self.replay_workers: Optional[int] = (
+                int(_os.environ.get("NOMAD_TPU_REPLAY_WORKERS", "0"))
+                or None
+            )
+        except ValueError:
+            self.replay_workers = None
+        self._replay_pool = None  # lazy EvaluatePool
+        self.replay_speculative = 0  # speculations committed
+        self.replay_conflicts = 0  # speculations discarded on conflict
+        self.replay_serial_fallbacks = 0  # wave evals replayed serially
         # dequeue timestamps for the per-eval service-latency samples
         self._deq_ts: Dict[str, float] = {}
         # adaptive batch sizing (VERDICT r3 #2): close the loop from
@@ -603,6 +735,57 @@ class BatchWorker(Worker):
         if metrics is not None:
             metrics.incr(f"batch_worker.{name}")
 
+    def _count_replay(self, kind: str) -> None:
+        """Optimistic-replay counters, exported under the `replay.`
+        namespace on /v1/metrics (speculative | conflicts |
+        serial_fallbacks)."""
+        attr = f"replay_{kind}"
+        setattr(self, attr, getattr(self, attr) + 1)
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.incr(f"replay.{kind}")
+
+    def _export_adaptive_gauges(self) -> None:
+        """The adaptive-cap inputs as /v1/metrics gauges, so an
+        operator can see WHY `_adaptive_cap` picked a gulp size (the
+        launch EWMA per trace bucket and the per-eval replay EWMA are
+        the whole decision)."""
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is None:
+            return
+        metrics.set_gauge(
+            "batch_worker.replay_ewma_ms", self._replay_ewma_ms
+        )
+        for bucket, ms in self._launch_ewma.items():
+            metrics.set_gauge(
+                f"batch_worker.launch_ewma_ms.e{bucket}", ms
+            )
+
+    def _replay_pool_instance(self):
+        """Lazy speculative-replay pool (the plan applier's
+        EvaluatePool shape, sized cores/2 unless
+        NOMAD_TPU_REPLAY_WORKERS overrides); its width is the
+        `batch_worker.replay_parallelism` gauge."""
+        if self._replay_pool is None:
+            from .plan_apply import EvaluatePool
+
+            self._replay_pool = EvaluatePool(
+                self.replay_workers,
+                thread_name_prefix="replay-spec",
+            )
+            metrics = getattr(self.server, "metrics", None)
+            if metrics is not None:
+                metrics.set_gauge(
+                    "batch_worker.replay_parallelism",
+                    self._replay_pool.workers,
+                )
+        return self._replay_pool
+
+    def stop(self) -> None:
+        super().stop()
+        if self._replay_pool is not None:
+            self._replay_pool.shutdown()
+
     # ------------------------------------------------------------------
 
     def _adaptive_cap(self) -> int:
@@ -642,9 +825,19 @@ class BatchWorker(Worker):
             metrics.set_gauge("batch_worker.adaptive_cap", cap)
         return cap
 
-    def run(self) -> None:
+    def _note_dequeue(self, ev: Evaluation) -> None:
+        """Stamp an eval's dequeue time for the service-latency
+        sample, shedding oldest-first past DEQ_TS_MAX — entries
+        normally pop on ack (_sample_eval_latency) or nack
+        (_nack_quietly), but an eval that crashes between dequeue and
+        either must not leak its stamp forever."""
         import time as _time
 
+        while len(self._deq_ts) >= DEQ_TS_MAX:
+            self._deq_ts.pop(next(iter(self._deq_ts)))
+        self._deq_ts[ev.id] = _time.monotonic()
+
+    def run(self) -> None:
         while not self._stop.is_set():
             batch: List[Tuple[Evaluation, str]] = []
             ev, token = self.server.broker.dequeue(
@@ -652,7 +845,7 @@ class BatchWorker(Worker):
             )
             if ev is None:
                 continue
-            self._deq_ts[ev.id] = _time.monotonic()
+            self._note_dequeue(ev)
             batch.append((ev, token))
             cap = self._adaptive_cap()
             while len(batch) < cap:
@@ -661,7 +854,7 @@ class BatchWorker(Worker):
                 )
                 if ev is None:
                     break
-                self._deq_ts[ev.id] = _time.monotonic()
+                self._note_dequeue(ev)
                 batch.append((ev, token))
             try:
                 self._process_batch(batch)
@@ -690,6 +883,7 @@ class BatchWorker(Worker):
             run = []
             self._process_sequential(ev, token)
         self._flush_run(run)
+        self._export_adaptive_gauges()
 
     def _flush_run(self, run) -> None:
         import time as _time
@@ -697,6 +891,10 @@ class BatchWorker(Worker):
         idx = 0
         while idx < len(run):
             snap = self.store.snapshot()
+            # global conflict fence for the optimistic replay wave:
+            # the ready-node-set generation at wave start (the
+            # per-node baseline is captured with the wave below)
+            wave_readiness = self.store.readiness_generation()
             # simulate the longest prefix we can model in the kernel
             t0 = _time.monotonic()
             sims: List[_Sim] = []
@@ -853,6 +1051,23 @@ class BatchWorker(Worker):
             rescore = False
             pipe_wall = 0.0  # device-path blocking time for the run
             launched_any = False
+            # optimistic parallel replay: big-enough runs replay
+            # speculatively on the pool as each chunk's rows land
+            # (overlapping later fetches), then commit in queue order
+            # behind the conflict check (_commit_wave)
+            wave = None
+            spec_pool = None
+            wave_base: Dict[str, int] = {}
+            if (
+                asm is not None
+                and self.parallel_replay
+                and asm.E_real >= REPLAY_MIN_WAVE
+            ):
+                wave = []
+                spec_pool = self._replay_pool_instance()
+                # touch-count baseline, captured before any
+                # speculation reads (launches haven't fetched yet)
+                wave_base = self.store.node_touch_counts()
             if asm is not None and asm.use_mesh:
                 t0 = _time.monotonic()
                 rows_arr = None
@@ -884,6 +1099,16 @@ class BatchWorker(Worker):
                         ]
                         # mesh launches don't surface pulls; preempt
                         # retries deviate there
+                        if wave is not None:
+                            wave.append((
+                                ev, token, job, sim, rows, None,
+                                spec_pool.submit(
+                                    self._speculate_one, snap,
+                                    wave_readiness, ev, job, sim,
+                                    rows, None,
+                                ),
+                            ))
+                            continue
                         ok = self._replay_one(
                             ev, token, job, sim, rows, None
                         )
@@ -976,6 +1201,16 @@ class BatchWorker(Worker):
                                 e - c0, : sim.placements
                             ]
                         ]
+                        if wave is not None:
+                            wave.append((
+                                ev, token, job, sim, rows, pulls,
+                                spec_pool.submit(
+                                    self._speculate_one, snap,
+                                    wave_readiness, ev, job, sim,
+                                    rows, pulls,
+                                ),
+                            ))
+                            continue
                         ok = self._replay_one(
                             ev, token, job, sim, rows, pulls
                         )
@@ -991,6 +1226,10 @@ class BatchWorker(Worker):
                 ms = pipe_wall * 1000.0
                 self._launch_ewma[bucket] = (
                     ms if prev is None else 0.8 * prev + 0.2 * ms
+                )
+            if wave:
+                k, rescore = self._commit_wave(
+                    wave, k, wave_base, wave_readiness
                 )
             if not rescore:
                 # evals no fetched chunk covered (assembly failure,
@@ -1011,6 +1250,9 @@ class BatchWorker(Worker):
         error) and the caller must re-prescore the remainder."""
         import time as _time
 
+        # None = unknown writes until a clean prescored replay records
+        # its committed plan's touches (the wave commit loop reads it)
+        self._last_replay_touches = None
         t0 = _time.monotonic()
         try:
             clean = self._process_prescored(
@@ -1039,6 +1281,299 @@ class BatchWorker(Worker):
             )
             self._nack_quietly(ev, token)
             return False
+
+    # -- optimistic parallel replay ------------------------------------
+
+    def _speculate_one(
+        self, snap, wave_readiness: int, ev, job, sim: _Sim,
+        rows: List[int], pulls: Optional[List[int]],
+    ) -> Optional[_Speculation]:
+        """Phase A (pool thread): replay one prescored eval against
+        the shared wave snapshot with every side effect captured
+        instead of applied.  Returns None when the eval must replay
+        serially — unsupported shape (active deployment, CSI
+        volumes), a deviation, or any error."""
+        try:
+            batch = ev.type == "batch"
+            if not batch and snap.latest_deployment_by_job(
+                ev.namespace, ev.job_id
+            ) is not None:
+                # deployment state is written by the watcher thread —
+                # a read the per-node conflict ledger can't cover
+                return None
+            for tg in job.task_groups:
+                for req in tg.volumes.values():
+                    if req.type == "csi":
+                        # claim races linearize at the applier; the
+                        # serial path owns them
+                        return None
+            if self.store.readiness_generation() != wave_readiness:
+                return None
+            # strict read set: nodes hosting the job's allocs — the
+            # reconciler, tainted-node scan and in-place update probes
+            # read them as real control-flow inputs, so any touch
+            # (even an own-wave commit) invalidates the speculation
+            strict_nodes = {
+                a.node_id
+                for a in snap.allocs_by_job(ev.namespace, ev.job_id)
+            }
+            # non-node fences, captured BEFORE the replay reads them:
+            # a job/config/deployment write between here and the
+            # commit check makes the commit check disagree and
+            # conflict; one between here and the replay's own read
+            # makes set_job deviate.  Either way the serial path wins.
+            job_now = snap.job_by_id(ev.namespace, ev.job_id)
+            job_fence = (
+                getattr(job_now, "version", -1),
+                getattr(job_now, "modify_index", -1),
+            )
+            config_index = self.store.table_index("scheduler_config")
+            # the broker's eval object must not see speculative writes
+            spec_ev = _dc_replace(ev)
+            spec_ev.snapshot_index = snap.index
+            planner = _SpecPlanner(snap)
+            scheduler, made = self._prescored_scheduler(
+                snap, planner, spec_ev, job, rows, sim, pulls,
+                speculative=True,
+            )
+            scheduler.process(spec_ev)
+            return _Speculation(
+                ops=planner.ops,
+                strict_nodes=strict_nodes,
+                # relaxed read set: the plan-touched nodes — their
+                # reads (winner verification, plan evaluation) check
+                # fit the kernel chain already modeled for every
+                # earlier chain member, so own-wave touches there are
+                # expected, not conflicts
+                plan_nodes=set(planner.touched),
+                clean=not (made and made[0].saw_failed_row),
+                job_fence=job_fence,
+                config_index=config_index,
+                check_deployment=not batch,
+            )
+        except (_Deviation, _SpecAbort):
+            return None
+        except Exception:  # noqa: BLE001 — the serial path recovers
+            LOG.debug(
+                "speculative replay failed for eval %s", ev.id,
+                exc_info=True,
+            )
+            return None
+
+    @staticmethod
+    def _merge_touches(
+        expect: Dict[str, int], touches: Dict[str, int]
+    ) -> None:
+        for node_id, count in touches.items():
+            expect[node_id] = expect.get(node_id, 0) + count
+
+    @staticmethod
+    def _plan_touches(node_update, node_allocation,
+                      node_preemptions) -> Dict[str, int]:
+        """node_id -> how many alloc writes committing these plan
+        collections performs (each alloc upsert bumps its node's
+        touch count once — store._upsert_allocs_locked)."""
+        touches: Dict[str, int] = {}
+        for coll in (node_update, node_allocation, node_preemptions):
+            for node_id, allocs in coll.items():
+                touches[node_id] = touches.get(node_id, 0) + len(
+                    allocs
+                )
+        return touches
+
+    def _commit_wave(
+        self, wave, k: int, wave_base: Dict[str, int],
+        wave_readiness: int,
+    ) -> Tuple[int, bool]:
+        """Phase B: walk the wave in queue order, committing each
+        eval's speculation when its read set survived every
+        earlier-committed plan (and external writers), and
+        re-replaying it serially otherwise.  ``wave_base`` is the
+        per-node touch-count baseline captured before any speculation
+        read; ``wave_expect`` accumulates the touches the wave's own
+        commits perform, so kernel-modeled self-conflicts don't
+        demote the whole wave.  Returns (next unhandled run index,
+        rescore); rescore=True means a replay marked the chained
+        state suspect — exactly the serial loop's contract, so the
+        caller re-prescores the remainder and the discarded
+        speculations past it are never applied."""
+        import time as _time
+
+        job_ledger: Set[tuple] = set()
+        wave_expect: Dict[str, int] = {}
+        rescore = False
+        for ev, token, job, sim, rows, pulls, fut in wave:
+            t0 = _time.monotonic()
+            try:
+                spec = fut.result()
+            except Exception:  # noqa: BLE001 — speculation-only work
+                spec = None
+            ok: Optional[bool] = None
+            committed = False
+            if spec is not None:
+                try:
+                    ok = self._commit_speculation(
+                        spec, ev, token, wave_base, wave_expect,
+                        wave_readiness, job_ledger,
+                    )
+                    committed = ok is not None
+                except Exception:  # noqa: BLE001
+                    self._count("errors")
+                    LOG.warning(
+                        "speculative commit failed for eval %s",
+                        ev.id, exc_info=True,
+                    )
+                    self._nack_quietly(ev, token)
+                    job_ledger.add((ev.namespace, ev.job_id))
+                    ok = False  # chain past this eval is suspect
+            if committed:
+                dt = _time.monotonic() - t0
+                self._observe("replay", dt)
+                self._replay_ewma_ms = (
+                    0.8 * self._replay_ewma_ms + 0.2 * dt * 1000.0
+                )
+            if ok is None:
+                # not speculated, or the speculation lost its race:
+                # replay serially against the updated state (the
+                # serial loop's own snapshot/fallback semantics)
+                if spec is not None:
+                    self._count_replay("conflicts")
+                self._count_replay("serial_fallbacks")
+                job_ledger.add((ev.namespace, ev.job_id))
+                ok = self._replay_one(ev, token, job, sim, rows, pulls)
+                # whitelist the serial commit's touches for later
+                # relaxed checks; None (unknown writes: deviation or
+                # error paths) leaves them unexpected, so overlapping
+                # later evals conflict — conservative
+                if self._last_replay_touches is not None:
+                    self._merge_touches(
+                        wave_expect, self._last_replay_touches
+                    )
+            k += 1
+            if not ok:
+                rescore = True
+                break
+        return k, rescore
+
+    def _commit_speculation(
+        self, spec: _Speculation, ev, token,
+        wave_base: Dict[str, int], wave_expect: Dict[str, int],
+        wave_readiness: int, job_ledger: Set[tuple],
+    ) -> Optional[bool]:
+        """Commit one speculative replay: conflict check, then replay
+        the captured transcript verbatim through the real planner
+        surface.  Returns the `_replay_one`-style ok flag, or None
+        when the speculation conflicts and must be discarded."""
+        key = (ev.namespace, ev.job_id)
+        if key in job_ledger:
+            # an earlier wave member of the SAME job committed: its
+            # allocs/evals are reads this reconciler pass depended on
+            return None
+        if self.store.readiness_generation() != wave_readiness:
+            # the ready-node set moved: candidate scans (and the
+            # nodes_available placement metrics) are stale
+            return None
+        # per-node conflict check against the touch-count ledger:
+        # strict nodes accept NO touch past the baseline; plan nodes
+        # accept exactly the touches this wave's own commits account
+        # for (kernel-modeled), so only external writes conflict
+        count = self.store.node_touch_count
+        for node_id in spec.strict_nodes:
+            if count(node_id) != wave_base.get(node_id, 0):
+                return None
+        for node_id in spec.plan_nodes:
+            expected = wave_base.get(node_id, 0) + (
+                0
+                if self.replay_strict
+                else wave_expect.get(node_id, 0)
+            )
+            if count(node_id) != expected:
+                return None
+        # non-node fences (reads the per-node ledger can't cover)
+        job_now = self.store.job_by_id(ev.namespace, ev.job_id)
+        if (
+            getattr(job_now, "version", -1),
+            getattr(job_now, "modify_index", -1),
+        ) != spec.job_fence:
+            return None
+        if (
+            self.store.table_index("scheduler_config")
+            != spec.config_index
+        ):
+            return None
+        if spec.check_deployment and (
+            self.store.latest_deployment_by_job(
+                ev.namespace, ev.job_id
+            )
+            is not None
+        ):
+            return None
+        commit_index = self.store.latest_index()
+        # the serial loop stamps each replay's fresh snapshot index on
+        # the eval's status writes; the commit point is that replay's
+        # moment in the serial order
+        ev.snapshot_index = commit_index
+        # plan submits apply FIRST (a transcript holds at most one —
+        # process() runs a single pass in speculation): if the applier
+        # partially commits despite the conflict check (external race
+        # between check and apply), NO other captured op has been
+        # applied yet, so the sequential recovery below re-runs the
+        # eval without duplicating blocked/follow-up evals.  Eval
+        # writes that preceded the submit in capture order land after
+        # it instead — safe, because BlockedEvals.block's
+        # missed-unblock check requeues a late-registered blocked
+        # eval past any capacity change our own commit triggered.
+        ordered = sorted(
+            spec.ops, key=lambda op: 0 if op[0] == "submit" else 1
+        )
+        for op, payload in ordered:
+            if op == "submit":
+                result, refreshed = self.submit_plan(payload)
+                if refreshed is not None or not result.is_full_commit(
+                    payload
+                ):
+                    # the conflict guard missed a race (external
+                    # writer between check and apply): the plan
+                    # partially committed, so the captured transcript
+                    # past this point is invalid.  Recover like the
+                    # serial partial-commit path — the real scheduler
+                    # on refreshed state sees the committed subset and
+                    # finishes the eval — and mark the chain suspect.
+                    LOG.warning(
+                        "speculative commit for eval %s was partial;"
+                        " recovering via the sequential path", ev.id,
+                    )
+                    self._count_replay("serial_fallbacks")
+                    job_ledger.add(key)
+                    self._process_sequential(ev, token)
+                    return False
+                # a full commit wrote exactly the plan's collections:
+                # record those touches as expected for later relaxed
+                # conflict checks in this wave
+                self._merge_touches(
+                    wave_expect,
+                    self._plan_touches(
+                        payload.node_update,
+                        payload.node_allocation,
+                        payload.node_preemptions,
+                    ),
+                )
+            else:
+                if getattr(payload, "id", None) == ev.id:
+                    payload.snapshot_index = commit_index
+                if op == "update_eval":
+                    self.update_eval(payload)
+                elif op == "create_eval":
+                    self.create_eval(payload)
+                else:
+                    self.reblock_eval(payload)
+        job_ledger.add(key)
+        self.evals_processed += 1
+        self.server.broker.ack(ev.id, token)
+        self._count("prescored")
+        self._count_replay("speculative")
+        self._sample_eval_latency(ev)
+        return spec.clean
 
     def _process_sequential(self, ev, token) -> None:
         import time as _time
@@ -2709,6 +3244,48 @@ class BatchWorker(Worker):
 
     # ------------------------------------------------------------------
 
+    def _prescored_scheduler(
+        self, snap, planner, ev: Evaluation, job: Job,
+        rows: List[int], sim: _Sim, pulls: Optional[List[int]],
+        speculative: bool = False,
+    ):
+        """The replay scheduler: a GenericScheduler whose stack
+        replays the prescored pick rows.  Shared by the serial replay
+        path (planner = this worker) and the speculative wave
+        (planner = a capturing _SpecPlanner pinned to the wave
+        snapshot).  Returns (scheduler, made); made[0] is the
+        PrescoredStack once the scheduler built it."""
+        made: list = []
+        pick_tgs = [
+            sim.tgs[s].name for s in sim.pick_tg
+        ] if sim.pick_tg else []
+        batch = ev.type == "batch"
+        sched = GenericScheduler(
+            snap, planner, batch=batch, use_tpu=False,
+            seed=self.seed, speculative=speculative,
+        )
+
+        def make_stack():
+            if made:
+                # a plan-submit retry re-runs _process_once against
+                # refreshed state; the prescored rows are stale there
+                raise _Deviation("scheduler retry")
+            inner = GenericStack(batch, sched.ctx)
+            stack = PrescoredStack(
+                sched.ctx, job, pick_tgs, rows,
+                snap.node_table, sim.penalties, inner,
+                evict_rows=sim.evict_rows,
+                pulls=pulls,
+                n_cand=getattr(sim, "replay_n_cand", 0),
+                order=getattr(sim, "replay_order", None),
+                batch=batch,
+            )
+            made.append(stack)
+            return stack
+
+        sched._make_stack = make_stack
+        return sched, made
+
     def _process_prescored(
         self, ev: Evaluation, token: str, job: Job,
         rows: List[int], sim: _Sim,
@@ -2721,44 +3298,22 @@ class BatchWorker(Worker):
             max(ev.modify_index, ev.snapshot_index), timeout=5.0
         )
         ev.snapshot_index = snap.index
-        made = []
-        pick_tgs = [
-            sim.tgs[s].name for s in sim.pick_tg
-        ] if sim.pick_tg else []
-
-        class _Factory:
-            def __call__(self, state, planner, batch, use_tpu=None,
-                         seed=None):
-                sched = GenericScheduler(
-                    state, planner, batch=batch, use_tpu=False, seed=seed
-                )
-
-                def make_stack():
-                    if made:
-                        # a plan-submit retry re-runs _process_once
-                        # against refreshed state; the prescored rows
-                        # are stale there
-                        raise _Deviation("scheduler retry")
-                    inner = GenericStack(batch, sched.ctx)
-                    stack = PrescoredStack(
-                        sched.ctx, job, pick_tgs, rows,
-                        snap.node_table, sim.penalties, inner,
-                        evict_rows=sim.evict_rows,
-                        pulls=pulls,
-                        n_cand=getattr(sim, "replay_n_cand", 0),
-                        order=getattr(sim, "replay_order", None),
-                        batch=ev.type == "batch",
-                    )
-                    made.append(stack)
-                    return stack
-
-                sched._make_stack = make_stack
-                return sched
-
-        scheduler = _Factory()(
-            snap, self, ev.type == "batch", seed=self.seed
+        scheduler, made = self._prescored_scheduler(
+            snap, self, ev, job, rows, sim, pulls
         )
         scheduler.process(ev)
+        # record the committed plan's node touches for the optimistic
+        # replay wave's expected-touch ledger ({} = no-op plan)
+        result = scheduler.plan_result
+        self._last_replay_touches = (
+            self._plan_touches(
+                result.node_update,
+                result.node_allocation,
+                result.node_preemptions,
+            )
+            if result is not None
+            else {}
+        )
         self.evals_processed += 1
         self.server.broker.ack(ev.id, token)
         if made and made[0].entered_passthrough:

@@ -113,17 +113,30 @@ class OptimisticState:
 
 class EvaluatePool:
     """Per-node plan verification fan-out (reference
-    plan_apply_pool.go:18 EvaluatePool, sized cores/2)."""
+    plan_apply_pool.go:18 EvaluatePool, sized cores/2).
+
+    The same pool shape backs the BatchWorker's optimistic parallel
+    replay: ``submit`` exposes the raw executor so a wave of
+    speculative eval replays can fan out across it without a second
+    thread-pool implementation."""
 
     # below this many nodes the dispatch overhead beats the win
     MIN_FANOUT = 4
 
-    def __init__(self, workers: Optional[int] = None) -> None:
+    def __init__(
+        self, workers: Optional[int] = None,
+        thread_name_prefix: str = "plan-eval",
+    ) -> None:
         self.workers = workers or max(1, (os.cpu_count() or 2) // 2)
         self.closed = False
         self._pool = ThreadPoolExecutor(
-            max_workers=self.workers, thread_name_prefix="plan-eval"
+            max_workers=self.workers,
+            thread_name_prefix=thread_name_prefix,
         )
+
+    def submit(self, fn, *args, **kwargs):
+        """Schedule arbitrary work on the pool; returns the Future."""
+        return self._pool.submit(fn, *args, **kwargs)
 
     def evaluate_nodes(
         self, store, plan: Plan, node_ids: List[str]

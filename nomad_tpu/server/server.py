@@ -194,6 +194,21 @@ class Server:
             self.workers = [
                 Worker(self, seed=seed) for _ in range(num_schedulers)
             ]
+        # pipeline-mode markers on /v1/metrics from construction time,
+        # so an operator can tell a batch-pipeline server (and whether
+        # its optimistic parallel replay is enabled) before any
+        # traffic populates the replay.* counters
+        self.metrics.set_gauge(
+            "server.batch_pipeline", 1.0 if batch_pipeline else 0.0
+        )
+        if batch_pipeline:
+            self.metrics.set_gauge(
+                "batch_worker.parallel_replay_enabled",
+                1.0 if any(
+                    getattr(w, "parallel_replay", False)
+                    for w in self.workers
+                ) else 0.0,
+            )
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = Drainer(self)
         self.periodic = PeriodicDispatcher(self)

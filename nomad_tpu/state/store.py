@@ -98,6 +98,21 @@ class StateStore:
 
         # columnar mirror of the node table + per-node live-usage columns
         self.node_table = NodeTable()
+        # per-node mutation fingerprints: node_id -> count of writes
+        # that touched that node's scheduling-relevant state (node
+        # record writes AND each alloc write on the node).  The
+        # BatchWorker's optimistic parallel replay uses them as its
+        # conflict ledger: a speculative replay may only commit when
+        # every node it read shows exactly the touch count it expects
+        # (wave-start baseline plus the wave's own committed plans) —
+        # any external write inflates the count and conflicts.  One
+        # int per live node (entries are pruned on delete_node, so
+        # node churn doesn't accumulate dead ids).
+        self._node_touch: Dict[str, int] = {}
+        # bumped only when the READY-node set can have changed (join,
+        # leave, status/eligibility/drain flips) — the global conflict
+        # fence for reads that scan all candidates (ready_nodes_in_dcs)
+        self._readiness_gen = 0
         # live allocated static host ports: port -> {node_id: count},
         # plus the reverse map so per-node refresh never scans the
         # whole port dict
@@ -199,9 +214,13 @@ class StateStore:
             else:
                 node.create_index = self._index + 1
             node.modify_index = self._index + 1
+            was_ready = existing is not None and existing.ready()
             self.nodes[node.id] = node
             self.node_table.upsert_node(node)
             index = self._bump("nodes")
+            self._touch_node(node.id)
+            if existing is None or was_ready != node.ready():
+                self._readiness_gen += 1
             # a changed node address must refresh the catalog entries of
             # allocs already running there (their instances captured the
             # old address when the alloc was last written)
@@ -229,6 +248,12 @@ class StateStore:
             if node_id in self.nodes:
                 del self.nodes[node_id]
                 self.node_table.delete_node(node_id)
+                self._readiness_gen += 1
+                # prune the conflict-ledger entry so churned node ids
+                # don't accumulate forever; the readiness bump above
+                # already conflicts any in-flight replay wave, so the
+                # count reset can't mask a mid-wave delete+re-register
+                self._node_touch.pop(node_id, None)
             return self._bump("nodes")
 
     def update_node_status(
@@ -240,21 +265,31 @@ class StateStore:
             node = self.nodes.get(node_id)
             if node is None:
                 raise KeyError(node_id)
+            was_ready = node.ready()
             node.status = status
             node.status_updated_at = time.time() if now is None else now
             node.modify_index = self._index + 1
             self.node_table.upsert_node(node)
-            return self._bump("nodes")
+            index = self._bump("nodes")
+            self._touch_node(node_id)
+            if was_ready != node.ready():
+                self._readiness_gen += 1
+            return index
 
     def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
         with self._lock:
             node = self.nodes.get(node_id)
             if node is None:
                 raise KeyError(node_id)
+            was_ready = node.ready()
             node.scheduling_eligibility = eligibility
             node.modify_index = self._index + 1
             self.node_table.upsert_node(node)
-            return self._bump("nodes")
+            index = self._bump("nodes")
+            self._touch_node(node_id)
+            if was_ready != node.ready():
+                self._readiness_gen += 1
+            return index
 
     def update_node_drain(
         self, node_id: str, drain: bool, strategy=None
@@ -272,7 +307,10 @@ class StateStore:
             )
             node.modify_index = self._index + 1
             self.node_table.upsert_node(node)
-            return self._bump("nodes")
+            index = self._bump("nodes")
+            self._touch_node(node_id)
+            self._readiness_gen += 1
+            return index
 
     def upsert_node_events(self, node_id: str, events) -> int:
         """Append to a node's bounded event history (reference
@@ -702,6 +740,9 @@ class StateStore:
                 was_live = False
             alloc.modify_index = self._index + 1
             self.allocs[alloc.id] = alloc
+            # conflict ledger: any alloc write mutates its node's
+            # schedulable state (usage, ports, devices, proposed set)
+            self._touch_node(alloc.node_id)
             self._allocs_by_node[alloc.node_id].add(alloc.id)
             self._allocs_by_job[(alloc.namespace, alloc.job_id)].add(alloc.id)
             if alloc.eval_id:
@@ -815,6 +856,33 @@ class StateStore:
                 table.usage_generation,
                 table.usage_rows_dirty_since(generation),
             )
+
+    def _touch_node(self, node_id: str) -> None:
+        """Bump a node's mutation fingerprint (called under the store
+        lock by every write that changes the node's schedulable
+        state)."""
+        self._node_touch[node_id] = self._node_touch.get(node_id, 0) + 1
+
+    def node_touch_count(self, node_id: str) -> int:
+        """Current mutation-fingerprint count for one node.
+        Lock-free: counts are ints assigned under the store lock, and
+        a racing write only makes a conflict check more
+        conservative."""
+        return self._node_touch.get(node_id, 0)
+
+    def node_touch_counts(self) -> Dict[str, int]:
+        """Snapshot of every node's mutation count (the optimistic
+        replay wave's conflict baseline), copied under the lock so it
+        is consistent with a single store index."""
+        with self._lock:
+            return dict(self._node_touch)
+
+    def readiness_generation(self) -> int:
+        """Generation of the ready-node set (bumped on join/leave and
+        status/eligibility/drain flips, NOT on usage churn) — the
+        global fence for speculative replays whose candidate scan
+        covers every node."""
+        return self._readiness_gen
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
         return self.allocs.get(alloc_id)
@@ -1035,6 +1103,12 @@ class StateSnapshot:
         reference nomad/job_endpoint.go Plan runs on a snapshot)."""
         self._job_override = job
 
+    def latest_index(self) -> int:
+        """The snapshot's fence index — lets store consumers that
+        only need the read surface plus an index (plan_apply's
+        evaluate_plan stamping refresh_index) accept a snapshot."""
+        return self.index
+
     # the scheduler-facing read surface
     def nodes(self) -> List[Node]:
         return list(self._store.iter_nodes())
@@ -1062,6 +1136,12 @@ class StateSnapshot:
 
     def live_port_nodes(self, port: int) -> Dict[str, int]:
         return self._store.live_port_nodes(port)
+
+    def node_touch_count(self, node_id: str) -> int:
+        return self._store.node_touch_count(node_id)
+
+    def readiness_generation(self) -> int:
+        return self._store.readiness_generation()
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
         return self._store.alloc_by_id(alloc_id)

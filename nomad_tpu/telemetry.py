@@ -80,6 +80,18 @@ class Metrics:
         with self._lock:
             self._samples[name].add(value)
 
+    def get_counter(self, name: str) -> float:
+        """O(1) single-counter read (tests/operators polling one hot
+        counter — e.g. the optimistic-replay `replay.*` family —
+        shouldn't pay for a full dump() copy)."""
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def get_gauge(self, name: str) -> Optional[float]:
+        """O(1) single-gauge read; None when the gauge was never set."""
+        with self._lock:
+            return self._gauges.get(name)
+
     @contextmanager
     def measure(self, name: str):
         """(reference go-metrics MeasureSince)"""

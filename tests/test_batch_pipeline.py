@@ -2174,3 +2174,264 @@ def test_assembly_caches_are_lru_not_clear_all():
     assert lru.get(("gen", 0)) == 0
     assert lru.get(("gen", 2)) == 2
     assert lru.get(("gen", 99)) == 99
+
+
+# ---------------------------------------------------------------------------
+# optimistic parallel replay (PR 2)
+# ---------------------------------------------------------------------------
+
+
+def _eval_outcomes(server, job_id):
+    """Terminal eval outcomes for a job, order-insensitive (eval ids
+    are random per server, so compare the decision-bearing fields)."""
+    return sorted(
+        (
+            e.status,
+            e.status_description,
+            tuple(sorted(e.queued_allocations.items())),
+        )
+        for e in server.store.evals_by_job("default", job_id)
+    )
+
+
+def _run_conflict_pair(monkeypatch, strict):
+    """Serial-replay vs parallel-replay servers on a tiny cluster
+    where every plan in a wave touches nodes an earlier-committed
+    plan mutated.  Returns (serial, par, jobs) after both drained."""
+    nodes = make_nodes(6, seed=5)
+    jobs = []
+    for i in range(10):
+        job = mock.job(id=f"conflict-{i}")
+        job.task_groups[0].count = random.Random(i).randint(2, 3)
+        job.task_groups[0].tasks[0].resources.cpu = 300
+        jobs.append(job)
+
+    monkeypatch.setenv("NOMAD_TPU_PARALLEL_REPLAY", "0")
+    serial = Server(num_schedulers=1, seed=42, batch_pipeline=True)
+    monkeypatch.setenv("NOMAD_TPU_PARALLEL_REPLAY", "1")
+    if strict:
+        monkeypatch.setenv("NOMAD_TPU_REPLAY_STRICT", "1")
+    par = Server(num_schedulers=1, seed=42, batch_pipeline=True)
+    assert not serial.workers[0].parallel_replay
+    assert par.workers[0].parallel_replay
+    assert par.workers[0].replay_strict == strict
+    serial.start()
+    par.start()
+    for node in nodes:
+        serial.register_node(copy.deepcopy(node))
+        par.register_node(copy.deepcopy(node))
+    for job in jobs:
+        serial.register_job(copy.deepcopy(job))
+    assert serial.drain_to_idle(30)
+    for job in jobs:
+        par.register_job(copy.deepcopy(job))
+    assert par.drain_to_idle(30)
+    return serial, par, jobs
+
+
+def test_parallel_replay_bit_identical_under_forced_conflicts(
+    monkeypatch,
+):
+    """The acceptance contract, strict mode: with a tiny cluster
+    every plan in a wave touches nodes an earlier-committed plan
+    mutated, forcing the conflict ledger to discard speculations and
+    re-replay serially — and the committed outcome must stay
+    bit-identical to the serial replay loop."""
+    serial, par, jobs = _run_conflict_pair(monkeypatch, strict=True)
+    try:
+        for job in jobs:
+            assert placements(serial, job.id) == placements(
+                par, job.id
+            ), f"divergence for {job.id}"
+            assert _eval_outcomes(serial, job.id) == _eval_outcomes(
+                par, job.id
+            ), f"eval outcome divergence for {job.id}"
+        worker = par.workers[0]
+        # the forced contention must actually exercise the conflict
+        # path (otherwise this test proves nothing)
+        assert worker.replay_conflicts > 0
+        assert worker.replay_serial_fallbacks > 0
+        assert worker.prescored > 0
+    finally:
+        serial.stop()
+        par.stop()
+
+
+def test_parallel_replay_relaxed_mode_decisions_match_under_contention(
+    monkeypatch,
+):
+    """Default (relaxed) mode on the same contended cluster: own-wave
+    plan-node touches are expected (the kernel chain modeled them),
+    so speculations commit — and placements plus eval outcomes must
+    still match the serial replay loop exactly."""
+    serial, par, jobs = _run_conflict_pair(monkeypatch, strict=False)
+    try:
+        for job in jobs:
+            assert placements(serial, job.id) == placements(
+                par, job.id
+            ), f"divergence for {job.id}"
+            assert _eval_outcomes(serial, job.id) == _eval_outcomes(
+                par, job.id
+            ), f"eval outcome divergence for {job.id}"
+        worker = par.workers[0]
+        # fresh jobs have no strict nodes, so the relaxed check must
+        # actually commit speculations despite the node contention
+        assert worker.replay_speculative > 0
+    finally:
+        serial.stop()
+        par.stop()
+
+
+def test_parallel_replay_commits_speculations_without_conflicts():
+    """Disjoint candidate sets (one job per datacenter) commit their
+    speculative replays — the fast path must actually engage, with
+    zero conflicts, and the counters must be visible on /v1/metrics."""
+    server = Server(num_schedulers=1, seed=11, batch_pipeline=True)
+    server.start()
+    try:
+        n_dcs = 6
+        for dc in range(n_dcs):
+            for node in make_nodes(2, seed=dc):
+                node.datacenter = f"dc{dc}"
+                node.computed_class = compute_node_class(node)
+                server.register_node(node)
+        for dc in range(n_dcs):
+            job = mock.job(id=f"dc-job-{dc}")
+            job.datacenters = [f"dc{dc}"]
+            job.task_groups[0].count = 2
+            server.register_job(job)
+        assert server.drain_to_idle(30)
+        worker = server.workers[0]
+        for dc in range(n_dcs):
+            assert len(placements(server, f"dc-job-{dc}")) == 2
+        assert worker.replay_speculative > 0
+        assert worker.replay_conflicts == 0
+        assert server.metrics.get_counter("replay.speculative") > 0
+        assert (
+            server.metrics.get_gauge("batch_worker.replay_parallelism")
+            >= 1
+        )
+        assert (
+            server.metrics.get_gauge(
+                "batch_worker.parallel_replay_enabled"
+            )
+            == 1.0
+        )
+    finally:
+        server.stop()
+
+
+def test_parallel_replay_failed_placements_match_serial(monkeypatch):
+    """Exhaustion (failed picks -> blocked evals) through the
+    speculative wave must produce the same blocked/complete eval
+    outcomes as the serial replay loop."""
+    nodes = make_nodes(3, seed=2)
+    jobs = []
+    for i in range(6):
+        job = mock.job(id=f"exhaust-{i}")
+        job.task_groups[0].count = 4
+        job.task_groups[0].tasks[0].resources.cpu = 3000
+        jobs.append(job)
+
+    monkeypatch.setenv("NOMAD_TPU_PARALLEL_REPLAY", "0")
+    serial = Server(num_schedulers=1, seed=3, batch_pipeline=True)
+    monkeypatch.setenv("NOMAD_TPU_PARALLEL_REPLAY", "1")
+    par = Server(num_schedulers=1, seed=3, batch_pipeline=True)
+    serial.start()
+    par.start()
+    try:
+        for node in nodes:
+            serial.register_node(copy.deepcopy(node))
+            par.register_node(copy.deepcopy(node))
+        for job in jobs:
+            serial.register_job(copy.deepcopy(job))
+        assert serial.drain_to_idle(30)
+        for job in jobs:
+            par.register_job(copy.deepcopy(job))
+        assert par.drain_to_idle(30)
+        for job in jobs:
+            assert placements(serial, job.id) == placements(
+                par, job.id
+            ), f"divergence for {job.id}"
+    finally:
+        serial.stop()
+        par.stop()
+
+
+def test_adaptive_cap_latency_budget_boundary_and_broker_errors():
+    """_adaptive_cap edges: the budget boundary is inclusive (est ==
+    budget keeps the big gulp; one tenth of a ms over drops to the
+    small bucket) and a broker error falls back to the full batch."""
+    from nomad_tpu.server.batch_worker import BATCH_MAX
+
+    bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
+    try:
+        worker = bat.workers[0]
+        worker.latency_budget_ms = 250.0
+        # keeping up (empty broker): estimated last-eval latency for
+        # the full batch = launch EWMA + 1 * replay EWMA
+        worker._replay_ewma_ms = 5.0
+        worker._launch_ewma = {8: 10.0, BATCH_MAX: 245.0}
+        assert worker._adaptive_cap() == worker.batch_max  # est == 250
+        worker._launch_ewma = {8: 10.0, BATCH_MAX: 245.1}
+        assert worker._adaptive_cap() == 8  # est just over budget
+
+        # a broken broker must not kill sizing: full batch fallback
+        class _Exploding:
+            def ready_count(self, schedulers):
+                raise RuntimeError("broker down")
+
+        real = bat.broker
+        bat.broker = _Exploding()
+        try:
+            worker._launch_ewma = {8: 9999.0, BATCH_MAX: 9999.0}
+            assert worker._adaptive_cap() == worker.batch_max
+        finally:
+            bat.broker = real
+    finally:
+        bat.stop()
+
+
+def test_adaptive_cap_inputs_exported_as_gauges():
+    """Operators can see WHY _adaptive_cap picked a gulp size: the
+    launch EWMA per trace bucket and the replay EWMA are /v1/metrics
+    gauges (satellite of PR 2)."""
+    server = Server(num_schedulers=1, seed=4, batch_pipeline=True)
+    server.start()
+    try:
+        for node in make_nodes(8, seed=1):
+            server.register_node(node)
+        for job in make_jobs(4, seed=2):
+            server.register_job(job)
+        assert server.drain_to_idle(30)
+        gauges = server.metrics.dump()["gauges"]
+        assert "batch_worker.replay_ewma_ms" in gauges
+        assert any(
+            k.startswith("batch_worker.launch_ewma_ms.e")
+            for k in gauges
+        ), gauges
+    finally:
+        server.stop()
+
+
+def test_deq_ts_is_bounded_and_popped_on_nack():
+    """The dequeue-timestamp map must not leak: nacked evals pop their
+    stamp, and the map sheds oldest-first past DEQ_TS_MAX even when
+    evals vanish without an ack or nack."""
+    from nomad_tpu.server.batch_worker import DEQ_TS_MAX
+    from nomad_tpu.structs import Evaluation
+
+    server = Server(num_schedulers=1, seed=6, batch_pipeline=True)
+    try:
+        worker = server.workers[0]
+        for i in range(DEQ_TS_MAX + 100):
+            worker._note_dequeue(Evaluation(id=f"ev-{i}"))
+        assert len(worker._deq_ts) <= DEQ_TS_MAX
+        # oldest were shed first
+        assert "ev-0" not in worker._deq_ts
+        ev = Evaluation(id="nacked")
+        worker._note_dequeue(ev)
+        worker._nack_quietly(ev, "tok")  # unknown token: still pops
+        assert "nacked" not in worker._deq_ts
+    finally:
+        server.stop()

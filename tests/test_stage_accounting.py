@@ -1,0 +1,43 @@
+"""Tier-1 wiring of tools/check_stage_accounting.py: every key in
+``BatchWorker.timings`` must be observed via ``_observe`` and exported
+through ``bench.py``'s ``e2e_stage_times_s``, so a new pipeline stage
+can't silently vanish from the bench or /v1/metrics."""
+import os
+import sys
+
+TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+)
+
+
+def _load():
+    sys.path.insert(0, TOOLS)
+    try:
+        import check_stage_accounting
+
+        return check_stage_accounting
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_every_stage_is_observed_and_exported():
+    lint = _load()
+    ok, problems = lint.check()
+    assert ok, problems
+
+
+def test_lint_detects_a_dropped_stage(tmp_path, monkeypatch):
+    """The lint actually fires: removing a stage's _observe call (here
+    simulated by pointing the lint at a stripped copy) must fail."""
+    lint = _load()
+    with open(lint.BATCH_WORKER) as fh:
+        src = fh.read()
+    assert 'self._observe("simulate"' in src
+    stripped = src.replace('self._observe("simulate"', '_unused("simulate"')
+    bad = tmp_path / "batch_worker.py"
+    bad.write_text(stripped)
+    monkeypatch.setattr(lint, "BATCH_WORKER", str(bad))
+    ok, problems = lint.check()
+    assert not ok
+    assert any("simulate" in p for p in problems)

@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""Stage-accounting lint: every pipeline stage the BatchWorker tracks
+must actually be observed and must flow into the bench output.
+
+Guards the invariant that keeps per-stage time attributable across
+rounds (a new stage added to ``BatchWorker.timings`` without an
+``_observe`` call, or a bench that stops exporting the timings dict
+wholesale, would silently vanish from BENCH_*.json and /v1/metrics):
+
+1. every key in the ``self.timings = {...}`` literal in
+   ``nomad_tpu/server/batch_worker.py`` appears in at least one
+   ``self._observe("<key>", ...)`` call;
+2. every ``self._observe("<key>", ...)`` call uses a declared key
+   (no orphan stages accumulating into nothing);
+3. ``bench.py`` builds its stage times from ``worker.timings``
+   wholesale (``dict(worker.timings)``) and exports them under the
+   ``e2e_stage_times_s`` JSON key, so new stages flow through without
+   a bench edit.
+
+Run directly (exits non-zero on violation) or via the tier-1 test in
+``tests/test_stage_accounting.py``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BATCH_WORKER = os.path.join(
+    REPO, "nomad_tpu", "server", "batch_worker.py"
+)
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _parse(path: str) -> ast.AST:
+    with open(path) as fh:
+        return ast.parse(fh.read(), filename=path)
+
+
+def timings_keys(tree: ast.AST) -> Set[str]:
+    """Keys of the ``self.timings = {...}`` dict literal."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and target.attr == "timings"
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                }
+    return set()
+
+
+def observed_keys(tree: ast.AST) -> Set[str]:
+    """First-arg string constants of every ``._observe(...)`` call."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "_observe"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def bench_exports_timings(tree: ast.AST, source: str) -> List[str]:
+    """Problems with bench.py's stage export (empty list = ok)."""
+    problems = []
+    wholesale = any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "dict"
+        and node.args
+        and isinstance(node.args[0], ast.Attribute)
+        and node.args[0].attr == "timings"
+        for node in ast.walk(tree)
+    )
+    if not wholesale:
+        problems.append(
+            "bench.py no longer snapshots the stage times wholesale "
+            "(expected a dict(worker.timings) call) — new stages "
+            "would silently drop from the bench"
+        )
+    if '"e2e_stage_times_s"' not in source:
+        problems.append(
+            "bench.py no longer exports the e2e_stage_times_s JSON key"
+        )
+    return problems
+
+
+def check() -> Tuple[bool, List[str]]:
+    problems: List[str] = []
+    bw_tree = _parse(BATCH_WORKER)
+    declared = timings_keys(bw_tree)
+    observed = observed_keys(bw_tree)
+    if not declared:
+        problems.append(
+            "could not find the self.timings literal in "
+            "batch_worker.py"
+        )
+    unobserved = declared - observed
+    if unobserved:
+        problems.append(
+            "timings keys never passed to _observe "
+            f"(stage time would stay 0 forever): {sorted(unobserved)}"
+        )
+    orphans = observed - declared
+    if orphans:
+        problems.append(
+            "_observe calls with keys missing from the timings "
+            f"literal (would KeyError at runtime): {sorted(orphans)}"
+        )
+    with open(BENCH) as fh:
+        bench_src = fh.read()
+    problems.extend(bench_exports_timings(ast.parse(bench_src), bench_src))
+    return not problems, problems
+
+
+def main() -> int:
+    ok, problems = check()
+    if ok:
+        print("stage accounting: OK")
+        return 0
+    for p in problems:
+        print(f"stage accounting: {p}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
