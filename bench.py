@@ -225,6 +225,63 @@ def pct(lat, q):
     return float(lat[min(len(lat) - 1, int(q * (len(lat) - 1)))])
 
 
+def trace_stage_seconds():
+    """Trace-derived per-stage seconds over the recorder ring: spans
+    named ``batch_worker.<stage>`` summed per stage, dividing each
+    chunk/run-wide span's duration by its ``members`` attr so the
+    totals are comparable with the worker's ``timings`` accounting
+    (which observes those stages once per chunk/run, not per eval)."""
+    from nomad_tpu.trace import TRACE
+
+    agg = {}
+    for trace in TRACE.recent(limit=100_000, full=True):
+        names = {s["name"] for s in trace["spans"]}
+        # the wave path's "replay" stage time is commit_wait + commit
+        # (exactly the interval _commit_wave observes into timings) —
+        # but ONLY for evals that committed speculatively.  A
+        # conflicted member records commit_wait AND a serial
+        # batch_worker.replay span while timings sees only the
+        # latter, so counting its wait would double-book the stage.
+        committed = "replay.commit" in names
+        for span in trace["spans"]:
+            name = span["name"]
+            if name.startswith("batch_worker."):
+                stage = name.split(".", 1)[1]
+                if stage in ("gulp", "fallback"):
+                    continue  # marks, not timed stages
+            elif name == "replay.commit" or (
+                name == "replay.commit_wait" and committed
+            ):
+                stage = "replay"
+            else:
+                continue
+            dur = span["dur_ms"] or 0.0
+            members = span["attrs"].get("members", 1) or 1
+            agg[stage] = agg.get(stage, 0.0) + dur / 1000.0 / members
+    return agg
+
+
+def cross_check_trace_stages(trace_stages, stage_times):
+    """Log the flight-recorder stage breakdown against the worker's
+    e2e_stage_times_s; returns the worst relative deviation over the
+    stages big enough to judge (>50ms on both sides).  The two views
+    measure the same intervals through different plumbing, so a large
+    gap means per-eval attribution went wrong — visible here instead
+    of silently shipping bogus traces."""
+    worst = 0.0
+    for stage, t_timings in sorted(stage_times.items()):
+        t_trace = trace_stages.get(stage, 0.0)
+        if min(t_trace, t_timings) < 0.05:
+            continue
+        rel = abs(t_trace - t_timings) / t_timings
+        worst = max(worst, rel)
+        log(
+            f"  trace-vs-timings {stage}: trace={t_trace:.2f}s "
+            f"timings={t_timings:.2f}s ({rel * 100:.0f}% apart)"
+        )
+    return worst
+
+
 def bench_e2e():
     # --- oracle side -----------------------------------------------------
     oracle = build_server(batch_pipeline=False)
@@ -253,11 +310,18 @@ def bench_e2e():
         log(f"  warmup {time.time()-t0:.1f}s")
         for k in worker.timings:
             worker.timings[k] = 0.0
+        # drop warmup traces so the trace-derived stage breakdown
+        # covers exactly the timed stream
+        from nomad_tpu.trace import TRACE as _trace
+
+        _trace.clear()
 
         tpu_rate, _lat, tpu_p = run_stream(
             tpu, E2E_JOBS, "e2e-tpu", "e2e"
         )
         stats = dict(worker.timings)
+        trace_stages = trace_stage_seconds()
+        cross_check_trace_stages(trace_stages, stats)
         total_staged = sum(stats.values()) or 1.0
         # the prescore pipeline reports per-stage: assemble (host
         # input staging), launch (non-blocking dispatch) and fetch
@@ -329,7 +393,7 @@ def bench_e2e():
     return (
         oracle_rate, tpu_rate, p50, p99, same, stats,
         prescore_share, replay_share, replay_conflict_rate,
-        replay_stats,
+        replay_stats, trace_stages,
     )
 
 
@@ -865,6 +929,81 @@ def _share_classes(nodes):
 
 
 WITH_CONFIGS = os.environ.get("BENCH_CONFIGS", "1") == "1"
+WITH_TRACE_OVERHEAD = os.environ.get("BENCH_TRACE_OVERHEAD", "1") == "1"
+
+
+def bench_trace_overhead():
+    """Cost of the always-on eval flight recorder: the same
+    config2-like batch stream (1k-ish queued allocs) through the batch
+    pipeline with tracing on vs NOMAD_TPU_TRACE=0, interleaved A/B/A/B
+    with min-of-reps per mode so scheduler noise doesn't masquerade as
+    recorder overhead.  Emits ``trace_overhead_pct`` so BENCH_* files
+    track the recorder's budget (<5% is the contract in
+    tests/test_trace.py)."""
+    from nomad_tpu.trace import TRACE
+
+    n_nodes = int(os.environ.get("BENCH_TRACE_NODES", 300))
+    n_jobs = int(os.environ.get("BENCH_TRACE_JOBS", 48))
+    reps = int(os.environ.get("BENCH_TRACE_REPS", 2))
+
+    def nodes():
+        rng = random.Random(11)
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"tr-node-{i:05d}")
+            n.node_resources.cpu = rng.choice([8000, 16000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def run_once(enabled, tag):
+        TRACE.set_enabled(enabled)
+        server = _mk_server(True)
+        try:
+            for node in nodes():
+                server.store.upsert_node(node)
+            server.start()
+            server.workers[0].warm_shapes()
+            jobs = []
+            for i in range(n_jobs):
+                job = mock.job(id=f"tr-{tag}-{i}")
+                job.type = "batch"
+                job.task_groups[0].count = 10
+                job.task_groups[0].tasks[0].resources.cpu = 300
+                jobs.append(job)
+            dt, _pmap, n = _run_jobs(server, jobs)
+            log(
+                f"trace-overhead {tag} "
+                f"trace={'on' if enabled else 'off'}:"
+                f" {n} placements in {dt:.2f}s"
+            )
+            return dt
+        finally:
+            server.stop()
+
+    times = {True: [], False: []}
+    was_enabled = TRACE.enabled
+    try:
+        # discarded warmup: the first run of this node-count pays the
+        # XLA compiles for its launch shapes, which would otherwise
+        # read as recorder overhead in whichever mode ran first
+        run_once(True, "warmup")
+        for rep in range(reps):
+            for enabled in (True, False):
+                times[enabled].append(
+                    run_once(enabled, f"r{rep}")
+                )
+    finally:
+        TRACE.set_enabled(was_enabled)
+        TRACE.clear()
+    t_on, t_off = min(times[True]), min(times[False])
+    pct_overhead = (t_on - t_off) / t_off * 100.0 if t_off else 0.0
+    log(
+        f"trace-overhead: on={t_on:.2f}s off={t_off:.2f}s "
+        f"-> {pct_overhead:+.1f}%"
+    )
+    return round(pct_overhead, 2)
 
 
 def bench_configs():
@@ -985,8 +1124,11 @@ def main():
     (
         oracle_rate, tpu_rate, p50, p99, same, stage_times,
         prescore_share, replay_share, replay_conflict_rate,
-        replay_stats,
+        replay_stats, trace_stages,
     ) = bench_e2e()
+    trace_overhead = (
+        bench_trace_overhead() if WITH_TRACE_OVERHEAD else None
+    )
     configs = bench_configs() if WITH_CONFIGS else {}
     kernel = bench_kernel_only() if WITH_KERNEL else {}
 
@@ -1012,6 +1154,13 @@ def main():
                 "e2e_stage_times_s": {
                     k: round(v, 3) for k, v in stage_times.items()
                 },
+                # the flight recorder's per-eval view of the same
+                # stages (chunk spans divided by membership), cross-
+                # checked against e2e_stage_times_s on stderr
+                "e2e_trace_stage_times_s": {
+                    k: round(v, 3) for k, v in trace_stages.items()
+                },
+                "trace_overhead_pct": trace_overhead,
                 "e2e_prescore_share": round(prescore_share, 3),
                 "e2e_replay_share": round(replay_share, 3),
                 "replay_conflict_rate": round(

@@ -1620,6 +1620,44 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond(metrics.dump() if metrics else {})
             return True
 
+        # -- eval flight recorder (per-eval span traces) ----------------
+        # agent:read like the other debug surfaces (monitor, pprof):
+        # traces carry job ids and node ids across every namespace
+        if path == "/v1/traces" and method == "GET":
+            self._check_acl("agent:read")
+            from ..trace import TRACE
+
+            slow_ms = None
+            if "slow_ms" in q:
+                try:
+                    slow_ms = float(q["slow_ms"])
+                except ValueError:
+                    raise HTTPError(400, "bad slow_ms")
+            try:
+                limit = int(q.get("limit", "64"))
+            except ValueError:
+                raise HTTPError(400, "bad limit")
+            self._respond(
+                TRACE.recent(
+                    slow_ms=slow_ms,
+                    outcome=q.get("outcome"),
+                    limit=max(1, min(limit, 1024)),
+                    full=q.get("full") == "1",
+                )
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/traces/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("agent:read")
+            from ..trace import TRACE
+
+            trace = TRACE.get(m.group(1))
+            if trace is None:
+                raise HTTPError(404, "trace not found")
+            self._respond(trace)
+            return True
+
         if path == "/v1/search" and method in ("POST", "PUT", "GET"):
             body = self._body() if method != "GET" else q
             prefix = body.get("Prefix") or body.get("prefix", "")

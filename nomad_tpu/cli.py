@@ -749,6 +749,9 @@ def cmd_operator_debug(args) -> None:
         "agent-self.json": ("GET", "/v1/agent/self"),
         "members.json": ("GET", "/v1/agent/members"),
         "metrics.json": ("GET", "/v1/metrics"),
+        # eval flight recorder: recent full traces, so a bundle from a
+        # misbehaving server carries per-eval stage/conflict evidence
+        "traces.json": ("GET", "/v1/traces?full=1&limit=256"),
         "monitor.json": ("GET", "/v1/agent/monitor"),
         "pprof-goroutine.json": ("GET", "/v1/agent/pprof/goroutine"),
         "pprof-heap.json": ("GET", "/v1/agent/pprof/heap"),

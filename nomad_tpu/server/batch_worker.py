@@ -76,6 +76,7 @@ from ..structs import (
     Job,
     TaskGroup,
 )
+from ..trace import TRACE
 from .worker import Worker
 
 BATCH_MAX = 64
@@ -704,11 +705,40 @@ class BatchWorker(Worker):
             self._sharded_runners[key] = runner
         return runner
 
-    def _observe(self, stage: str, dt: float) -> None:
+    def _observe(
+        self, stage: str, dt: float,
+        exemplar: Optional[str] = None,
+    ) -> None:
         self.timings[stage] += dt
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
-            metrics.add_sample(f"batch_worker.{stage}", dt * 1000.0)
+            # exemplar = the eval id (trace id) this sample belongs
+            # to, so a slow p99 sample on /v1/metrics links straight
+            # to /v1/traces/<id>
+            metrics.add_sample(
+                f"batch_worker.{stage}", dt * 1000.0,
+                exemplar=exemplar,
+            )
+
+    def _observe_chunk(
+        self, stage: str, run, idx: int, c0: int, c1_real: int,
+        t0: float, dt: float, **attrs,
+    ) -> None:
+        """Observe a chunk-wide stage interval and attribute it to
+        every member eval's trace: first member as the metrics
+        exemplar, and a per-member span carrying its chain position
+        plus the membership count (so trace aggregations can divide
+        the shared dt back out to match the timings accounting)."""
+        chunk_evs = [run[idx + e][0] for e in range(c0, c1_real)]
+        self._observe(
+            stage, dt,
+            exemplar=chunk_evs[0].id if chunk_evs else None,
+        )
+        for pos, c_ev in enumerate(chunk_evs):
+            TRACE.add_span(
+                c_ev.id, f"batch_worker.{stage}", t0, dt,
+                chain_pos=c0 + pos, members=len(chunk_evs), **attrs,
+            )
 
     def _sample_eval_latency(self, ev: Evaluation) -> None:
         """Per-eval service latency (dequeue -> processed), the
@@ -724,6 +754,7 @@ class BatchWorker(Worker):
             metrics.add_sample(
                 "batch_worker.eval_latency_ms",
                 (_time.monotonic() - t0) * 1000.0,
+                exemplar=ev.id,
             )
 
     def _count(self, name: str) -> None:
@@ -856,6 +887,11 @@ class BatchWorker(Worker):
                     break
                 self._note_dequeue(ev)
                 batch.append((ev, token))
+            for pos, (b_ev, _tok) in enumerate(batch):
+                TRACE.event(
+                    b_ev.id, "batch_worker.gulp",
+                    size=len(batch), pos=pos,
+                )
             try:
                 self._process_batch(batch)
             except Exception:  # noqa: BLE001
@@ -902,7 +938,8 @@ class BatchWorker(Worker):
             while j < len(run):
                 ev, _token, job = run[j]
                 try:
-                    sim = self._simulate(snap, ev, job)
+                    with TRACE.span(ev.id, "batch_worker.simulate"):
+                        sim = self._simulate(snap, ev, job)
                 except Exception:  # noqa: BLE001
                     # a broken simulation falls back to the exact path,
                     # but silently eating it would demote the fast path
@@ -917,7 +954,8 @@ class BatchWorker(Worker):
                     break
                 sims.append(sim)
                 j += 1
-            self._observe("simulate", _time.monotonic() - t0)
+            sim_exemplar = run[idx][0].id
+            self._observe("simulate", _time.monotonic() - t0, exemplar=sim_exemplar)
             # port/device chain gates: the kernel's occupancy carries
             # are monotone (placements occupy/consume; releases are
             # not modeled) and device pooling is exact only for
@@ -1046,7 +1084,19 @@ class BatchWorker(Worker):
                     "prescore assembly failed for %d evals",
                     len(sims), exc_info=True,
                 )
-            self._observe("assemble", _time.monotonic() - t0)
+            asm_dt = _time.monotonic() - t0
+            self._observe(
+                "assemble", asm_dt, exemplar=run[idx][0].id
+            )
+            # run-wide stage, attributed to every member eval: the
+            # `members` attr lets aggregations divide the shared dt
+            # back out so trace-derived stage sums match the
+            # batch_worker.timings accounting
+            for m_ev, _t, _jb in run[idx:j]:
+                TRACE.add_span(
+                    m_ev.id, "batch_worker.assemble", t0, asm_dt,
+                    members=len(sims), ok=asm is not None,
+                )
             k = idx
             rescore = False
             pipe_wall = 0.0  # device-path blocking time for the run
@@ -1085,7 +1135,10 @@ class BatchWorker(Worker):
                     self._count("cold_shape_fallbacks")
                 dt = _time.monotonic() - t0
                 pipe_wall += dt
-                self._observe("fetch", dt)
+                self._observe_chunk(
+                    "fetch", run, idx, 0, asm.E_real, t0, dt,
+                    mesh=True,
+                )
                 if rows_arr is not None:
                     launched_any = True
                     for e in range(asm.E_real):
@@ -1153,7 +1206,11 @@ class BatchWorker(Worker):
                             )
                         dt = _time.monotonic() - t0
                         pipe_wall += dt
-                        self._observe("launch", dt)
+                        self._observe_chunk(
+                            "launch", run, idx, c0,
+                            min(c1, asm.E_real), t0, dt,
+                            chunk=ci, ok=handle is not None,
+                        )
                         if handle is None:
                             stalled = True
                             break
@@ -1183,7 +1240,10 @@ class BatchWorker(Worker):
                         continue
                     dt = _time.monotonic() - t0
                     pipe_wall += dt
-                    self._observe("fetch", dt)
+                    self._observe_chunk(
+                        "fetch", run, idx, c0,
+                        min(c1, asm.E_real), t0, dt,
+                    )
                     for e in range(c0, min(c1, asm.E_real)):
                         if rescore:
                             break
@@ -1259,7 +1319,11 @@ class BatchWorker(Worker):
                 ev, token, job, rows, sim, pulls=pulls
             )
             replay_dt = _time.monotonic() - t0
-            self._observe("replay", replay_dt)
+            self._observe("replay", replay_dt, exemplar=ev.id)
+            TRACE.add_span(
+                ev.id, "batch_worker.replay", t0, replay_dt,
+                mode="serial", clean=clean,
+            )
             self._replay_ewma_ms = (
                 0.8 * self._replay_ewma_ms
                 + 0.2 * replay_dt * 1000.0
@@ -1269,8 +1333,12 @@ class BatchWorker(Worker):
             # a failed prescored pick means the chained state past
             # this eval is suspect — re-prescore
             return clean
-        except _Deviation:
+        except _Deviation as dev:
             self._count("fallbacks")
+            TRACE.event(
+                ev.id, "batch_worker.fallback",
+                reason="deviation", detail=str(dev),
+            )
             self._process_sequential(ev, token)
             return False
         except Exception:  # noqa: BLE001
@@ -1278,6 +1346,9 @@ class BatchWorker(Worker):
             LOG.warning(
                 "prescored replay failed for eval %s", ev.id,
                 exc_info=True,
+            )
+            TRACE.event(
+                ev.id, "batch_worker.fallback", reason="error"
             )
             self._nack_quietly(ev, token)
             return False
@@ -1294,71 +1365,101 @@ class BatchWorker(Worker):
         serially — unsupported shape (active deployment, CSI
         volumes), a deviation, or any error."""
         try:
-            batch = ev.type == "batch"
-            if not batch and snap.latest_deployment_by_job(
-                ev.namespace, ev.job_id
-            ) is not None:
-                # deployment state is written by the watcher thread —
-                # a read the per-node conflict ledger can't cover
-                return None
-            for tg in job.task_groups:
-                for req in tg.volumes.values():
-                    if req.type == "csi":
-                        # claim races linearize at the applier; the
-                        # serial path owns them
-                        return None
-            if self.store.readiness_generation() != wave_readiness:
-                return None
-            # strict read set: nodes hosting the job's allocs — the
-            # reconciler, tainted-node scan and in-place update probes
-            # read them as real control-flow inputs, so any touch
-            # (even an own-wave commit) invalidates the speculation
-            strict_nodes = {
-                a.node_id
-                for a in snap.allocs_by_job(ev.namespace, ev.job_id)
-            }
-            # non-node fences, captured BEFORE the replay reads them:
-            # a job/config/deployment write between here and the
-            # commit check makes the commit check disagree and
-            # conflict; one between here and the replay's own read
-            # makes set_job deviate.  Either way the serial path wins.
-            job_now = snap.job_by_id(ev.namespace, ev.job_id)
-            job_fence = (
-                getattr(job_now, "version", -1),
-                getattr(job_now, "modify_index", -1),
+            # span runs on the pool thread, so the trace records WHICH
+            # replay-spec thread carried this eval (straggler
+            # attribution across the wave)
+            with TRACE.span(
+                ev.id, "replay.speculate", speculative=True
+            ):
+                return self._speculate_inner(
+                    snap, wave_readiness, ev, job, sim, rows, pulls
+                )
+        except (_Deviation, _SpecAbort) as exc:
+            TRACE.event(
+                ev.id, "replay.serial_required",
+                reason="deviation", detail=str(exc),
             )
-            config_index = self.store.table_index("scheduler_config")
-            # the broker's eval object must not see speculative writes
-            spec_ev = _dc_replace(ev)
-            spec_ev.snapshot_index = snap.index
-            planner = _SpecPlanner(snap)
-            scheduler, made = self._prescored_scheduler(
-                snap, planner, spec_ev, job, rows, sim, pulls,
-                speculative=True,
-            )
-            scheduler.process(spec_ev)
-            return _Speculation(
-                ops=planner.ops,
-                strict_nodes=strict_nodes,
-                # relaxed read set: the plan-touched nodes — their
-                # reads (winner verification, plan evaluation) check
-                # fit the kernel chain already modeled for every
-                # earlier chain member, so own-wave touches there are
-                # expected, not conflicts
-                plan_nodes=set(planner.touched),
-                clean=not (made and made[0].saw_failed_row),
-                job_fence=job_fence,
-                config_index=config_index,
-                check_deployment=not batch,
-            )
-        except (_Deviation, _SpecAbort):
             return None
         except Exception:  # noqa: BLE001 — the serial path recovers
             LOG.debug(
                 "speculative replay failed for eval %s", ev.id,
                 exc_info=True,
             )
+            TRACE.event(
+                ev.id, "replay.serial_required", reason="error"
+            )
             return None
+
+    def _speculate_inner(
+        self, snap, wave_readiness: int, ev, job, sim: _Sim,
+        rows: List[int], pulls: Optional[List[int]],
+    ) -> Optional[_Speculation]:
+        batch = ev.type == "batch"
+        if not batch and snap.latest_deployment_by_job(
+            ev.namespace, ev.job_id
+        ) is not None:
+            # deployment state is written by the watcher thread —
+            # a read the per-node conflict ledger can't cover
+            TRACE.event(
+                ev.id, "replay.serial_required", reason="deployment"
+            )
+            return None
+        for tg in job.task_groups:
+            for req in tg.volumes.values():
+                if req.type == "csi":
+                    # claim races linearize at the applier; the
+                    # serial path owns them
+                    TRACE.event(
+                        ev.id, "replay.serial_required", reason="csi"
+                    )
+                    return None
+        if self.store.readiness_generation() != wave_readiness:
+            TRACE.event(
+                ev.id, "replay.serial_required", reason="readiness"
+            )
+            return None
+        # strict read set: nodes hosting the job's allocs — the
+        # reconciler, tainted-node scan and in-place update probes
+        # read them as real control-flow inputs, so any touch
+        # (even an own-wave commit) invalidates the speculation
+        strict_nodes = {
+            a.node_id
+            for a in snap.allocs_by_job(ev.namespace, ev.job_id)
+        }
+        # non-node fences, captured BEFORE the replay reads them:
+        # a job/config/deployment write between here and the
+        # commit check makes the commit check disagree and
+        # conflict; one between here and the replay's own read
+        # makes set_job deviate.  Either way the serial path wins.
+        job_now = snap.job_by_id(ev.namespace, ev.job_id)
+        job_fence = (
+            getattr(job_now, "version", -1),
+            getattr(job_now, "modify_index", -1),
+        )
+        config_index = self.store.table_index("scheduler_config")
+        # the broker's eval object must not see speculative writes
+        spec_ev = _dc_replace(ev)
+        spec_ev.snapshot_index = snap.index
+        planner = _SpecPlanner(snap)
+        scheduler, made = self._prescored_scheduler(
+            snap, planner, spec_ev, job, rows, sim, pulls,
+            speculative=True,
+        )
+        scheduler.process(spec_ev)
+        return _Speculation(
+            ops=planner.ops,
+            strict_nodes=strict_nodes,
+            # relaxed read set: the plan-touched nodes — their
+            # reads (winner verification, plan evaluation) check
+            # fit the kernel chain already modeled for every
+            # earlier chain member, so own-wave touches there are
+            # expected, not conflicts
+            plan_nodes=set(planner.touched),
+            clean=not (made and made[0].saw_failed_row),
+            job_fence=job_fence,
+            config_index=config_index,
+            check_deployment=not batch,
+        )
 
     @staticmethod
     def _merge_touches(
@@ -1408,9 +1509,18 @@ class BatchWorker(Worker):
                 spec = fut.result()
             except Exception:  # noqa: BLE001 — speculation-only work
                 spec = None
+            # the in-order commit's serialization wait: time this eval
+            # spent parked behind earlier wave members (plus any
+            # remainder of its own speculation)
+            wait_dt = _time.monotonic() - t0
+            TRACE.add_span(
+                ev.id, "replay.commit_wait", t0, wait_dt,
+                speculated=spec is not None,
+            )
             ok: Optional[bool] = None
             committed = False
             if spec is not None:
+                t_c = _time.monotonic()
                 try:
                     ok = self._commit_speculation(
                         spec, ev, token, wave_base, wave_expect,
@@ -1426,9 +1536,14 @@ class BatchWorker(Worker):
                     self._nack_quietly(ev, token)
                     job_ledger.add((ev.namespace, ev.job_id))
                     ok = False  # chain past this eval is suspect
+                if committed:
+                    TRACE.add_span(
+                        ev.id, "replay.commit", t_c,
+                        _time.monotonic() - t_c, clean=bool(ok),
+                    )
             if committed:
                 dt = _time.monotonic() - t0
-                self._observe("replay", dt)
+                self._observe("replay", dt, exemplar=ev.id)
                 self._replay_ewma_ms = (
                     0.8 * self._replay_ewma_ms + 0.2 * dt * 1000.0
                 )
@@ -1439,6 +1554,13 @@ class BatchWorker(Worker):
                 if spec is not None:
                     self._count_replay("conflicts")
                 self._count_replay("serial_fallbacks")
+                TRACE.event(
+                    ev.id, "replay.serial_fallback",
+                    reason=(
+                        "conflict" if spec is not None
+                        else "unspeculated"
+                    ),
+                )
                 job_ledger.add((ev.namespace, ev.job_id))
                 ok = self._replay_one(ev, token, job, sim, rows, pulls)
                 # whitelist the serial commit's touches for later
@@ -1468,10 +1590,16 @@ class BatchWorker(Worker):
         if key in job_ledger:
             # an earlier wave member of the SAME job committed: its
             # allocs/evals are reads this reconciler pass depended on
+            TRACE.event(
+                ev.id, "replay.conflict", fence="job_ledger"
+            )
             return None
         if self.store.readiness_generation() != wave_readiness:
             # the ready-node set moved: candidate scans (and the
             # nodes_available placement metrics) are stale
+            TRACE.event(
+                ev.id, "replay.conflict", fence="readiness"
+            )
             return None
         # per-node conflict check against the touch-count ledger:
         # strict nodes accept NO touch past the baseline; plan nodes
@@ -1480,6 +1608,10 @@ class BatchWorker(Worker):
         count = self.store.node_touch_count
         for node_id in spec.strict_nodes:
             if count(node_id) != wave_base.get(node_id, 0):
+                TRACE.event(
+                    ev.id, "replay.conflict",
+                    fence="strict_node", node=node_id,
+                )
                 return None
         for node_id in spec.plan_nodes:
             expected = wave_base.get(node_id, 0) + (
@@ -1488,6 +1620,10 @@ class BatchWorker(Worker):
                 else wave_expect.get(node_id, 0)
             )
             if count(node_id) != expected:
+                TRACE.event(
+                    ev.id, "replay.conflict",
+                    fence="plan_node", node=node_id,
+                )
                 return None
         # non-node fences (reads the per-node ledger can't cover)
         job_now = self.store.job_by_id(ev.namespace, ev.job_id)
@@ -1495,11 +1631,17 @@ class BatchWorker(Worker):
             getattr(job_now, "version", -1),
             getattr(job_now, "modify_index", -1),
         ) != spec.job_fence:
+            TRACE.event(
+                ev.id, "replay.conflict", fence="job_version"
+            )
             return None
         if (
             self.store.table_index("scheduler_config")
             != spec.config_index
         ):
+            TRACE.event(
+                ev.id, "replay.conflict", fence="scheduler_config"
+            )
             return None
         if spec.check_deployment and (
             self.store.latest_deployment_by_job(
@@ -1507,6 +1649,9 @@ class BatchWorker(Worker):
             )
             is not None
         ):
+            TRACE.event(
+                ev.id, "replay.conflict", fence="deployment"
+            )
             return None
         commit_index = self.store.latest_index()
         # the serial loop stamps each replay's fresh snapshot index on
@@ -1544,6 +1689,10 @@ class BatchWorker(Worker):
                         " recovering via the sequential path", ev.id,
                     )
                     self._count_replay("serial_fallbacks")
+                    TRACE.event(
+                        ev.id, "replay.serial_fallback",
+                        reason="partial_commit",
+                    )
                     job_ledger.add(key)
                     self._process_sequential(ev, token)
                     return False
@@ -1569,6 +1718,7 @@ class BatchWorker(Worker):
                     self.reblock_eval(payload)
         job_ledger.add(key)
         self.evals_processed += 1
+        TRACE.annotate(ev.id, outcome="speculative")
         self.server.broker.ack(ev.id, token)
         self._count("prescored")
         self._count_replay("speculative")
@@ -1578,12 +1728,17 @@ class BatchWorker(Worker):
     def _process_sequential(self, ev, token) -> None:
         import time as _time
 
+        # set before processing: process_eval acks (finishing the
+        # trace) inside, and the annotated outcome must be there first
+        TRACE.annotate(ev.id, outcome="sequential")
         t0 = _time.monotonic()
         try:
             self.process_eval(ev, token)
         except Exception:  # noqa: BLE001
             self._nack_quietly(ev, token)
-        self._observe("sequential", _time.monotonic() - t0)
+        dt = _time.monotonic() - t0
+        self._observe("sequential", dt, exemplar=ev.id)
+        TRACE.add_span(ev.id, "batch_worker.sequential", t0, dt)
         self._sample_eval_latency(ev)
 
     def _nack_quietly(self, ev, token) -> None:
@@ -3315,6 +3470,7 @@ class BatchWorker(Worker):
             else {}
         )
         self.evals_processed += 1
+        TRACE.annotate(ev.id, outcome="prescored")
         self.server.broker.ack(ev.id, token)
         if made and made[0].entered_passthrough:
             self._count("preempt_passthroughs")

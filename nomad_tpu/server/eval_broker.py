@@ -17,6 +17,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import Evaluation, new_id
+from ..trace import TRACE
 
 DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
@@ -216,6 +217,18 @@ class EvalBroker:
                     )
                     self.stats["total_unacked"] += 1
                     self.events.append((time.monotonic(), "deq", ev.id[:6], token[:6]))
+                    # flight recorder: the dequeue is the trace root —
+                    # every downstream span (pipeline stages, replay,
+                    # plan apply, store commit) attaches to it by
+                    # eval id
+                    TRACE.begin(
+                        ev.id,
+                        queue=ev.type,
+                        priority=ev.priority,
+                        namespace=ev.namespace,
+                        job_id=ev.job_id,
+                        triggered_by=ev.triggered_by,
+                    )
                     return ev, token
                 if not self._enabled:
                     return None, ""
@@ -261,6 +274,7 @@ class EvalBroker:
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
             self.events.append((time.monotonic(), "ack", eval_id[:6], ""))
+            TRACE.finish(eval_id, "ack")
             self._delivery_count.pop(eval_id, None)
             job_key = (ev.namespace, ev.job_id)
             if self._job_evals.get(job_key) == eval_id:
@@ -283,6 +297,7 @@ class EvalBroker:
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
             self.events.append((time.monotonic(), "nack", eval_id[:6], ""))
+            TRACE.finish(eval_id, "nack")
             job_key = (ev.namespace, ev.job_id)
             if self._job_evals.get(job_key) == eval_id:
                 del self._job_evals[job_key]

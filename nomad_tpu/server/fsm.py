@@ -22,6 +22,7 @@ import pickle
 from typing import Optional, Tuple
 
 from ..state.store import StateStore
+from ..trace import TRACE
 
 SNAPSHOT_VERSION = 1
 
@@ -377,7 +378,16 @@ class ServerFSM:
     def _apply_upsert_plan_results(self, result, eval_id):
         if getattr(result, "normalized", False):
             result = denormalize_plan_result(self.store, result)
-        return self.store.upsert_plan_results(result, eval_id)
+        index = self.store.upsert_plan_results(result, eval_id)
+        if eval_id:
+            # flight recorder: the replicated-apply path's commit mark
+            # (single-process servers commit via the store directly
+            # and get only the store.commit event)
+            TRACE.event(
+                eval_id, "fsm.apply",
+                kind="upsert_plan_results", index=index,
+            )
+        return index
 
     # ACL commands ------------------------------------------------------
 

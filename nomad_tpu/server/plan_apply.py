@@ -28,6 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from ..state.store import StateStore
+from ..trace import TRACE
 from ..structs import (
     Allocation,
     NetworkIndex,
@@ -403,10 +404,20 @@ class PlanApplier:
                 self.overlap_verifies += 1
                 if self.metrics is not None:
                     self.metrics.incr("plan.overlap_verify")
+            verify_dt = _time.monotonic() - start
             if self.metrics is not None:
                 # (reference plan_apply.go:401 plan.evaluate timing)
                 self.metrics.add_sample(
-                    "plan.evaluate", (_time.monotonic() - start) * 1000.0
+                    "plan.evaluate", verify_dt * 1000.0,
+                    exemplar=pending.plan.eval_id or None,
+                )
+            # flight recorder: the verification interval on the
+            # submitting eval's trace (applier-thread attribution)
+            if pending.plan.eval_id:
+                TRACE.add_span(
+                    pending.plan.eval_id, "plan.evaluate",
+                    start, verify_dt,
+                    overlay=bool(overlay), full=full,
                 )
             with self._lock:
                 self._inflight.append(result)
@@ -499,10 +510,18 @@ class PlanApplier:
             result.alloc_index = index
             self.applied += 1
             self._notify_capacity_change(result, index)
+            # flight recorder: the commit interval + committed index
+            # close the eval's write path (dequeue -> ... -> commit)
+            if plan.eval_id:
+                TRACE.add_span(
+                    plan.eval_id, "plan.apply", start,
+                    _time.monotonic() - start, index=index,
+                )
         if self.metrics is not None:
             # (reference plan_apply.go:185 plan.evaluate/apply timings)
             self.metrics.add_sample(
-                "plan.apply", (_time.monotonic() - start) * 1000.0
+                "plan.apply", (_time.monotonic() - start) * 1000.0,
+                exemplar=plan.eval_id or None,
             )
             self.metrics.incr("plan.applied")
             if not full:

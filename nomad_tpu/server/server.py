@@ -201,6 +201,13 @@ class Server:
         self.metrics.set_gauge(
             "server.batch_pipeline", 1.0 if batch_pipeline else 0.0
         )
+        # eval-flight-recorder mode marker (NOMAD_TPU_TRACE=0 opts
+        # out), so an operator can tell why /v1/traces is empty
+        from ..trace import TRACE as _trace
+
+        self.metrics.set_gauge(
+            "server.trace_enabled", 1.0 if _trace.enabled else 0.0
+        )
         if batch_pipeline:
             self.metrics.set_gauge(
                 "batch_worker.parallel_replay_enabled",

@@ -16,6 +16,7 @@ from typing import List, Optional, Tuple
 from ..sched import new_scheduler
 from ..state.store import StateSnapshot, StateStore
 from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_BLOCKED
+from ..trace import TRACE
 
 
 class Worker:
@@ -113,7 +114,12 @@ class Worker:
 
         start = _time.monotonic()
         try:
-            scheduler.process(ev)
+            with TRACE.span(
+                ev.id, "worker.invoke_scheduler",
+                type=ev.type,
+                speculative=getattr(scheduler, "speculative", False),
+            ):
+                scheduler.process(ev)
         except Exception:  # noqa: BLE001
             self.server.broker.nack(ev.id, token)
             raise

@@ -26,6 +26,7 @@ import time
 from collections import defaultdict
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..trace import TRACE
 from ..structs import (
     Allocation,
     ALLOC_CLIENT_STATUS_FAILED,
@@ -1038,6 +1039,13 @@ class StateStore:
                         ds.placed_canaries.append(alloc.id)
             index = self._bump("allocs", "deployments")
             self._notify_alloc_watchers(updates)
+            if eval_id:
+                # flight recorder: the eval's plan reached durable
+                # state at this raft index — the trace's commit mark
+                TRACE.event(
+                    eval_id, "store.commit", index=index,
+                    allocs=len(updates),
+                )
             return index
 
     def _claim_csi_for_alloc_locked(self, alloc: Allocation) -> None:

@@ -13,29 +13,42 @@ from typing import Dict, List, Optional, Tuple
 
 
 class _Summary:
-    __slots__ = ("count", "total", "min", "max", "_ring", "_ring_pos")
+    __slots__ = (
+        "count", "total", "min", "max", "_ring", "_ring_ex",
+        "_ring_pos",
+    )
 
     # sliding window for percentile estimates: large enough for a
     # stable p99 over recent traffic, small enough to stay O(1) memory
     RING = 2048
+    # exemplar trace ids reported per snapshot (the p99 ring entries)
+    EXEMPLARS = 4
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
-        self.max = 0.0
+        # -inf, not 0.0: an all-negative sample stream must report its
+        # true (negative) max, mirroring min's +inf idiom
+        self.max = float("-inf")
         self._ring: List[float] = []
+        # exemplar per ring slot: the trace (eval) id that produced
+        # the sample, or None — links a slow percentile to the eval
+        # that caused it (/v1/traces/<id>)
+        self._ring_ex: List[Optional[str]] = []
         self._ring_pos = 0
 
-    def add(self, value: float) -> None:
+    def add(self, value: float, exemplar: Optional[str] = None) -> None:
         self.count += 1
         self.total += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
         if len(self._ring) < self.RING:
             self._ring.append(value)
+            self._ring_ex.append(exemplar)
         else:
             self._ring[self._ring_pos] = value
+            self._ring_ex[self._ring_pos] = exemplar
             self._ring_pos = (self._ring_pos + 1) % self.RING
 
     def _percentile(self, ordered: List[float], q: float) -> float:
@@ -46,18 +59,40 @@ class _Summary:
         )
         return ordered[idx]
 
+    def _exemplars(self, p99: float) -> List[Dict]:
+        """Trace refs of the ring entries at or above p99, slowest
+        first — the samples an operator will want to explain.  A ref
+        is whatever the caller passed (callers pass eval ids), and
+        /v1/traces/<ref> resolves it — to the newest generation when
+        the eval was redelivered."""
+        tagged = sorted(
+            (
+                (v, ex)
+                for v, ex in zip(self._ring, self._ring_ex)
+                if ex is not None and v >= p99
+            ),
+            reverse=True,
+        )
+        return [
+            {"value": v, "trace_id": ex}
+            for v, ex in tagged[: self.EXEMPLARS]
+        ]
+
     def snapshot(self) -> Dict:
         ordered = sorted(self._ring)
+        p99 = self._percentile(ordered, 0.99)
         return {
             "count": self.count,
             "sum": self.total,
             "mean": self.total / self.count if self.count else 0.0,
             "min": self.min if self.count else 0.0,
-            "max": self.max,
+            "max": self.max if self.count else 0.0,
             # percentiles over the sliding window (last RING samples)
             "p50": self._percentile(ordered, 0.50),
             "p90": self._percentile(ordered, 0.90),
-            "p99": self._percentile(ordered, 0.99),
+            "p99": p99,
+            # trace exemplars for the slow tail (eval flight recorder)
+            "exemplars": self._exemplars(p99),
         }
 
 
@@ -76,9 +111,12 @@ class Metrics:
         with self._lock:
             self._gauges[name] = value
 
-    def add_sample(self, name: str, value: float) -> None:
+    def add_sample(
+        self, name: str, value: float,
+        exemplar: Optional[str] = None,
+    ) -> None:
         with self._lock:
-            self._samples[name].add(value)
+            self._samples[name].add(value, exemplar)
 
     def get_counter(self, name: str) -> float:
         """O(1) single-counter read (tests/operators polling one hot
@@ -113,19 +151,44 @@ class Metrics:
 
     def prometheus_text(self) -> str:
         lines: List[str] = []
+        # esc() is lossy (both "." and "-" map to "_"), so two
+        # distinct store names can collide into one scrape name —
+        # which Prometheus rejects as a duplicate series.  First
+        # occurrence (sorted order, counters < gauges < summaries)
+        # wins; later collisions are skipped with a comment so the
+        # scrape stays valid and the loss is visible.
+        emitted: set = set()
 
         def esc(name: str) -> str:
             return name.replace(".", "_").replace("-", "_")
 
+        def claim(name: str) -> Optional[str]:
+            base = esc(name)
+            if base in emitted:
+                lines.append(
+                    f"# collision: {name} already emitted as {base}"
+                )
+                return None
+            emitted.add(base)
+            return base
+
         with self._lock:
             for name, value in sorted(self._counters.items()):
-                lines.append(f"# TYPE {esc(name)} counter")
-                lines.append(f"{esc(name)} {value}")
+                base = claim(name)
+                if base is None:
+                    continue
+                lines.append(f"# TYPE {base} counter")
+                lines.append(f"{base} {value}")
             for name, value in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {esc(name)} gauge")
-                lines.append(f"{esc(name)} {value}")
+                base = claim(name)
+                if base is None:
+                    continue
+                lines.append(f"# TYPE {base} gauge")
+                lines.append(f"{base} {value}")
             for name, summary in sorted(self._samples.items()):
-                base = esc(name)
+                base = claim(name)
+                if base is None:
+                    continue
                 snap = summary.snapshot()
                 lines.append(f"# TYPE {base} summary")
                 lines.append(f"{base}_count {snap['count']}")

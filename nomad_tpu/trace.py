@@ -1,0 +1,451 @@
+"""Eval flight recorder: end-to-end per-eval span tracing.
+
+The batch pipeline's aggregate telemetry (`batch_worker.*` summaries,
+`replay.*` counters) says *that* a stage is slow, never *which eval*
+paid for it.  This module records one bounded trace per evaluation —
+spans (named, timed intervals) and events (zero-duration marks) —
+across every thread the eval's lifecycle touches: broker dequeue,
+batch-worker gulp/simulate/assemble/launch/fetch, speculative replay
+on the pool, the commit wave's ordering wait and conflict verdicts,
+plan verification/apply, and the store's commit index.
+
+Design constraints (always-on tracing must be free enough to forget):
+
+* **O(1) per span.**  A span append is a list append under a per-trace
+  lock; no allocation beyond the span record itself.
+* **Bounded retention.**  One process-wide ring of `TRACE_RING` traces
+  (active and completed alike — a trace that outlives the ring under
+  load is dropped, never grown), `MAX_SPANS` spans per trace
+  (overflow counts into `dropped`).
+* **Monotonic timestamps.**  `time.monotonic()` everywhere; one
+  wall-clock anchor per trace for display.
+* **Opt-out, not opt-in.**  `NOMAD_TPU_TRACE=0` turns every call into
+  a no-op (`Tracer.set_enabled` flips it at runtime for benches).
+
+The tracer is a process-wide singleton (`TRACE`), like the logging
+module: the broker, store and plan applier have no server reference,
+and eval ids are globally unique, so per-server registries would only
+add plumbing.  Cross-thread attribution is by eval id — every call
+site knows which eval it is working for — with per-(trace, thread)
+open-span stacks providing parent/child nesting.
+
+Span names used in instrumented modules must be declared in
+``SPAN_NAMES`` below; ``tools/check_stage_accounting.py`` lints
+``batch_worker.py`` and ``plan_apply.py`` against this registry so a
+renamed stage can't silently orphan its dashboard queries.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# retained traces (completed or in flight); at ~30 spans x ~150 bytes
+# per trace this bounds the recorder near 5 MB
+TRACE_RING = 1024
+# spans per trace before overflow counting kicks in
+MAX_SPANS = 256
+
+# the documented span/event name registry.  Every `.span/.add_span/
+# .event` literal in batch_worker.py and plan_apply.py must appear
+# here (tools/check_stage_accounting.py); names from other modules are
+# registered too so the registry is the one place to look up a trace.
+SPAN_NAMES = frozenset(
+    {
+        # broker lifecycle
+        "broker.dequeue",
+        # batch pipeline stages (per-eval attribution of the
+        # batch_worker.timings stages; chunk-wide spans carry a
+        # `members` attr so aggregate sums match the stage timings)
+        "batch_worker.gulp",
+        "batch_worker.simulate",
+        "batch_worker.assemble",
+        "batch_worker.launch",
+        "batch_worker.fetch",
+        "batch_worker.replay",
+        "batch_worker.sequential",
+        "batch_worker.fallback",
+        # optimistic parallel replay
+        "replay.speculate",
+        "replay.serial_required",
+        "replay.commit_wait",
+        "replay.commit",
+        "replay.conflict",
+        "replay.serial_fallback",
+        # sequential worker
+        "worker.invoke_scheduler",
+        # plan pipeline + state commit
+        "plan.evaluate",
+        "plan.apply",
+        "store.commit",
+        "fsm.apply",
+    }
+)
+
+
+class _NullSpan:
+    """Reusable no-op context manager for disabled/unknown traces."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("_trace", "_name", "_attrs", "_sid")
+
+    def __init__(self, trace: "Trace", name: str, attrs: dict) -> None:
+        self._trace = trace
+        self._name = name
+        self._attrs = attrs
+        self._sid = -1
+
+    def __enter__(self):
+        self._sid = self._trace.open_span(
+            self._name, time.monotonic(), self._attrs
+        )
+        return self
+
+    def __exit__(self, *exc):
+        self._trace.close_span(self._sid, time.monotonic())
+        return False
+
+
+class Trace:
+    """One eval's recorded lifecycle.  Span records are small lists
+    ``[sid, parent, name, start, duration, thread, attrs]`` —
+    ``duration`` stays None while the span is open."""
+
+    __slots__ = (
+        "eval_id",
+        "trace_id",
+        "t0",
+        "wall0",
+        "t_end",
+        "spans",
+        "attrs",
+        "outcome",
+        "finished",
+        "dropped",
+        "orphans",
+        "_open",
+        "_seq",
+        "_lock",
+    )
+
+    def __init__(self, eval_id: str, gen: int, attrs: dict) -> None:
+        self.eval_id = eval_id
+        self.trace_id = f"{eval_id}#{gen}"
+        self.t0 = time.monotonic()
+        self.wall0 = time.time()
+        self.t_end: Optional[float] = None
+        self.spans: List[list] = []
+        self.attrs = dict(attrs)
+        self.outcome: Optional[str] = None
+        self.finished = False
+        self.dropped = 0
+        self.orphans = 0
+        # thread id -> stack of open span ids (nesting is per thread;
+        # cross-thread spans attach at that thread's current depth)
+        self._open: Dict[int, List[int]] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # -- recording -----------------------------------------------------
+
+    def _parent_locked(self, tid: int) -> Optional[int]:
+        stack = self._open.get(tid)
+        return stack[-1] if stack else None
+
+    def open_span(self, name: str, start: float, attrs: dict) -> int:
+        tid = threading.get_ident()
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS or start < self.t0:
+                # over the cap, or a write from a SUPERSEDED attempt:
+                # after a redelivery the old attempt may still be
+                # running, and its by-eval-id writes resolve to this
+                # (newer) trace — an interval that began before this
+                # trace did belongs to the old generation, not here
+                self.dropped += 1
+                return -1
+            sid = self._seq
+            self._seq += 1
+            self.spans.append(
+                [
+                    sid,
+                    self._parent_locked(tid),
+                    name,
+                    start,
+                    None,
+                    threading.current_thread().name,
+                    attrs,
+                ]
+            )
+            self._open.setdefault(tid, []).append(sid)
+            return sid
+
+    def close_span(self, sid: int, end: float) -> None:
+        if sid < 0:
+            return
+        tid = threading.get_ident()
+        with self._lock:
+            stack = self._open.get(tid)
+            if stack and sid in stack:
+                # pop through sid: a crash that skipped inner exits
+                # must not leave phantom parents for later spans
+                while stack and stack.pop() != sid:
+                    pass
+                if not stack:
+                    self._open.pop(tid, None)
+            for span in self.spans:
+                if span[0] == sid:
+                    span[4] = end - span[3]
+                    return
+
+    def add_span(
+        self, name: str, start: float, duration: float, attrs: dict
+    ) -> None:
+        """Record an already-timed interval (stage times measured once
+        per chunk/run and attributed to each member eval)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS or start < self.t0:
+                # see open_span: pre-t0 starts are a superseded
+                # attempt's writes (best-effort — a stale write whose
+                # clock reads after this trace began is
+                # indistinguishable and slips through)
+                self.dropped += 1
+                return
+            sid = self._seq
+            self._seq += 1
+            self.spans.append(
+                [
+                    sid,
+                    self._parent_locked(tid),
+                    name,
+                    start,
+                    duration,
+                    threading.current_thread().name,
+                    attrs,
+                ]
+            )
+
+    def annotate(self, attrs: dict) -> None:
+        with self._lock:
+            self.attrs.update(attrs)
+
+    def finish(self, outcome: str) -> None:
+        with self._lock:
+            if self.finished:
+                return
+            self.finished = True
+            self.t_end = time.monotonic()
+            # a batch-worker path may have annotated a richer outcome
+            # ("speculative", "prescored", "sequential") — but only a
+            # successful ack consumes it: a nack or a redelivery
+            # supersede describes an attempt that did NOT stick, and
+            # must not masquerade as the annotated success
+            annotated = self.attrs.pop("outcome", None)
+            self.outcome = (
+                annotated if annotated and outcome == "ack" else outcome
+            )
+            self.orphans = sum(
+                1 for s in self.spans if s[4] is None
+            )
+
+    # -- serialization -------------------------------------------------
+
+    def duration_ms(self) -> Optional[float]:
+        if self.t_end is None:
+            return None
+        end = self.t_end
+        with self._lock:
+            for s in self.spans:
+                if s[4] is not None:
+                    end = max(end, s[3] + s[4])
+        return (end - self.t0) * 1000.0
+
+    def summary(self) -> Dict:
+        return {
+            "eval_id": self.eval_id,
+            "trace_id": self.trace_id,
+            "start": self.wall0,
+            "outcome": self.outcome,
+            "complete": self.finished,
+            "duration_ms": self.duration_ms(),
+            "spans": len(self.spans),
+            "dropped": self.dropped,
+            "orphans": self.orphans,
+            "attrs": dict(self.attrs),
+        }
+
+    def to_dict(self) -> Dict:
+        out = self.summary()
+        with self._lock:
+            out["spans"] = [
+                {
+                    "id": sid,
+                    "parent": parent,
+                    "name": name,
+                    "off_ms": (start - self.t0) * 1000.0,
+                    "dur_ms": (
+                        duration * 1000.0
+                        if duration is not None
+                        else None
+                    ),
+                    "thread": thread,
+                    "attrs": dict(attrs),
+                }
+                for sid, parent, name, start, duration, thread, attrs
+                in self.spans
+            ]
+        return out
+
+
+class Tracer:
+    def __init__(self, ring: int = TRACE_RING) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque = deque()
+        self._ring_cap = ring
+        # newest trace per eval id (ring members only) — the append
+        # surface every instrumented call site goes through
+        self._by_id: Dict[str, Trace] = {}
+        self._gen = itertools.count()
+        self.enabled = os.environ.get("NOMAD_TPU_TRACE", "1") != "0"
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def begin(self, eval_id: str, **attrs) -> None:
+        """Start (or restart, on redelivery) an eval's trace; records
+        the `broker.dequeue` mark as the root event."""
+        if not self.enabled or not eval_id:
+            return
+        trace = Trace(eval_id, next(self._gen), attrs)
+        with self._lock:
+            prior = self._by_id.get(eval_id)
+            if prior is not None and not prior.finished:
+                prior.finish("superseded")
+            self._by_id[eval_id] = trace
+            self._ring.append(trace)
+            while len(self._ring) > self._ring_cap:
+                evicted = self._ring.popleft()
+                if self._by_id.get(evicted.eval_id) is evicted:
+                    del self._by_id[evicted.eval_id]
+        trace.add_span("broker.dequeue", trace.t0, 0.0, attrs)
+
+    def finish(self, eval_id: str, outcome: str) -> None:
+        if not self.enabled:
+            return
+        trace = self._by_id.get(eval_id)
+        if trace is not None:
+            trace.finish(outcome)
+
+    # -- recording -----------------------------------------------------
+
+    def span(self, eval_id: str, name: str, **attrs):
+        """Context manager timing a span on the eval's trace; no-op
+        when tracing is off or the eval has no trace."""
+        if not self.enabled:
+            return _NULL
+        trace = self._by_id.get(eval_id)
+        if trace is None:
+            return _NULL
+        return _SpanCtx(trace, name, attrs)
+
+    def add_span(
+        self, eval_id: str, name: str, start: float,
+        duration: float, **attrs,
+    ) -> None:
+        if not self.enabled:
+            return
+        trace = self._by_id.get(eval_id)
+        if trace is not None:
+            trace.add_span(name, start, duration, attrs)
+
+    def event(self, eval_id: str, name: str, **attrs) -> None:
+        if not self.enabled:
+            return
+        trace = self._by_id.get(eval_id)
+        if trace is not None:
+            trace.add_span(name, time.monotonic(), 0.0, attrs)
+
+    def annotate(self, eval_id: str, **attrs) -> None:
+        if not self.enabled:
+            return
+        trace = self._by_id.get(eval_id)
+        if trace is not None:
+            trace.annotate(attrs)
+
+    # -- reads ---------------------------------------------------------
+
+    def get(self, ref: str) -> Optional[Dict]:
+        """Resolve a bare eval id (newest generation) OR a full
+        trace id (``<eval_id>#<gen>``, as listed by /v1/traces) —
+        an id copied from the listing must dereference even after a
+        redelivery superseded that generation."""
+        trace = self._by_id.get(ref)
+        if trace is not None:
+            return trace.to_dict()
+        if "#" in ref:
+            with self._lock:
+                candidates = list(self._ring)
+            for trace in reversed(candidates):
+                if trace.trace_id == ref:
+                    return trace.to_dict()
+        return None
+
+    def recent(
+        self,
+        slow_ms: Optional[float] = None,
+        outcome: Optional[str] = None,
+        limit: int = 64,
+        full: bool = False,
+    ) -> List[Dict]:
+        """Completed traces, newest first, optionally filtered to
+        slow (>= slow_ms total) or outcome-matching ones."""
+        with self._lock:
+            candidates = list(self._ring)
+        out: List[Dict] = []
+        for trace in reversed(candidates):
+            if not trace.finished:
+                continue
+            if outcome is not None and trace.outcome != outcome:
+                continue
+            if slow_ms is not None:
+                dur = trace.duration_ms()
+                if dur is None or dur < slow_ms:
+                    continue
+            out.append(trace.to_dict() if full else trace.summary())
+            if len(out) >= limit:
+                break
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._by_id.clear()
+
+
+TRACE = Tracer()
+
+__all__ = [
+    "MAX_SPANS",
+    "SPAN_NAMES",
+    "TRACE",
+    "TRACE_RING",
+    "Trace",
+    "Tracer",
+]

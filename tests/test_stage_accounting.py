@@ -27,6 +27,46 @@ def test_every_stage_is_observed_and_exported():
     assert ok, problems
 
 
+def test_lint_detects_unregistered_span_name(tmp_path, monkeypatch):
+    """The span-registry check actually fires: a span name used in
+    batch_worker.py that is missing from trace.SPAN_NAMES (here
+    simulated by pointing the lint at a registry copy with one name
+    renamed) must fail the lint."""
+    lint = _load()
+    with open(lint.TRACE_MOD) as fh:
+        src = fh.read()
+    assert '"batch_worker.simulate"' in src
+    stripped = src.replace(
+        '"batch_worker.simulate"', '"batch_worker.renamed_simulate"'
+    )
+    bad = tmp_path / "trace.py"
+    bad.write_text(stripped)
+    monkeypatch.setattr(lint, "TRACE_MOD", str(bad))
+    ok, problems = lint.check()
+    assert not ok
+    assert any(
+        "batch_worker.simulate" in p and "SPAN_NAMES" in p
+        for p in problems
+    ), problems
+
+
+def test_span_registry_and_usage_are_parsed():
+    """The lint's AST extraction sees real data on the live tree (an
+    empty 'used' set would make the registry check vacuous)."""
+    lint = _load()
+    registry = lint.span_registry(lint._parse(lint.TRACE_MOD))
+    used = lint.span_names_used(lint._parse(lint.BATCH_WORKER))
+    used |= lint.span_names_used(lint._parse(lint.PLAN_APPLY))
+    assert "batch_worker.simulate" in used
+    assert "replay.conflict" in used
+    assert "plan.apply" in used
+    # the chunk-wide stages are emitted via _observe_chunk's f-string
+    # name; the lint must still see them as batch_worker.<stage>
+    assert "batch_worker.launch" in used
+    assert "batch_worker.fetch" in used
+    assert used <= registry
+
+
 def test_lint_detects_a_dropped_stage(tmp_path, monkeypatch):
     """The lint actually fires: removing a stage's _observe call (here
     simulated by pointing the lint at a stripped copy) must fail."""

@@ -15,7 +15,13 @@ wholesale, would silently vanish from BENCH_*.json and /v1/metrics):
 3. ``bench.py`` builds its stage times from ``worker.timings``
    wholesale (``dict(worker.timings)``) and exports them under the
    ``e2e_stage_times_s`` JSON key, so new stages flow through without
-   a bench edit.
+   a bench edit;
+4. every flight-recorder span/event name used in
+   ``batch_worker.py`` and ``plan_apply.py`` (``TRACE.span(...)``,
+   ``TRACE.add_span(...)``, ``TRACE.event(...)``) is declared in the
+   ``SPAN_NAMES`` registry in ``nomad_tpu/trace.py`` — a renamed
+   stage must update the documented registry (and with it every
+   dashboard/report keyed on the name), never drift silently.
 
 Run directly (exits non-zero on violation) or via the tier-1 test in
 ``tests/test_stage_accounting.py``.
@@ -31,7 +37,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BATCH_WORKER = os.path.join(
     REPO, "nomad_tpu", "server", "batch_worker.py"
 )
+PLAN_APPLY = os.path.join(
+    REPO, "nomad_tpu", "server", "plan_apply.py"
+)
+TRACE_MOD = os.path.join(REPO, "nomad_tpu", "trace.py")
 BENCH = os.path.join(REPO, "bench.py")
+
+# the trace-recording call surface (nomad_tpu/trace.py Tracer)
+_TRACE_CALLS = {"span", "add_span", "event"}
 
 
 def _parse(path: str) -> ast.AST:
@@ -59,19 +72,73 @@ def timings_keys(tree: ast.AST) -> Set[str]:
 
 
 def observed_keys(tree: ast.AST) -> Set[str]:
-    """First-arg string constants of every ``._observe(...)`` call."""
+    """First-arg string constants of every ``._observe(...)`` call
+    (``._observe_chunk`` delegates its stage key to ``_observe``, so
+    its call sites count too)."""
     out: Set[str] = set()
     for node in ast.walk(tree):
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "_observe"
+            and node.func.attr in ("_observe", "_observe_chunk")
             and node.args
             and isinstance(node.args[0], ast.Constant)
             and isinstance(node.args[0].value, str)
         ):
             out.add(node.args[0].value)
     return out
+
+
+def span_names_used(tree: ast.AST) -> Set[str]:
+    """Span/event name literals passed to ``.span/.add_span/.event``
+    calls.  The name is the first *string-constant* positional (the
+    leading positional is the eval-id expression, never a literal).
+    ``._observe_chunk("<stage>", ...)`` emits its span name as
+    f"batch_worker.{stage}" — a non-constant the AST scan can't see —
+    so its stage constants count as that derived name here."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if (
+            node.func.attr == "_observe_chunk"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(f"batch_worker.{node.args[0].value}")
+            continue
+        if node.func.attr not in _TRACE_CALLS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ):
+                out.add(arg.value)
+                break
+    return out
+
+
+def span_registry(tree: ast.AST) -> Set[str]:
+    """String constants inside the ``SPAN_NAMES = frozenset({...})``
+    assignment in nomad_tpu/trace.py."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == "SPAN_NAMES"
+            ):
+                return {
+                    n.value
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+    return set()
 
 
 def bench_exports_timings(tree: ast.AST, source: str) -> List[str]:
@@ -120,6 +187,22 @@ def check() -> Tuple[bool, List[str]]:
         problems.append(
             "_observe calls with keys missing from the timings "
             f"literal (would KeyError at runtime): {sorted(orphans)}"
+        )
+    registry = span_registry(_parse(TRACE_MOD))
+    if not registry:
+        problems.append(
+            "could not find the SPAN_NAMES registry in "
+            "nomad_tpu/trace.py"
+        )
+    used = span_names_used(bw_tree) | span_names_used(
+        _parse(PLAN_APPLY)
+    )
+    unregistered = used - registry
+    if unregistered:
+        problems.append(
+            "span names used but missing from trace.SPAN_NAMES "
+            "(rename must update the documented registry): "
+            f"{sorted(unregistered)}"
         )
     with open(BENCH) as fh:
         bench_src = fh.read()

@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""Render eval flight-recorder traces as indented terminal waterfalls.
+
+Input is the JSON the server serves at ``/v1/traces/<eval_id>`` (one
+trace) or ``/v1/traces?full=1`` (a list).  Sources: an HTTP(S) URL, a
+file path, or ``-`` for stdin.
+
+    python tools/trace_report.py http://127.0.0.1:4646/v1/traces/abc123
+    python tools/trace_report.py 'http://127.0.0.1:4646/v1/traces?full=1&slow_ms=50'
+    curl -s .../v1/traces/abc123 | python tools/trace_report.py -
+
+Output per trace: a header line (eval id, outcome, total duration,
+span/drop counts) and one row per span — offset from the trace root,
+a depth-indented name, the span duration, a proportional bar, and the
+non-default attributes — so a slow eval reads as a waterfall:
+
+    trace 53a1b2#7 outcome=speculative 12.41ms spans=12
+        0.00ms  broker.dequeue            0.00ms            queue=service
+        0.21ms  batch_worker.simulate     1.20ms  ==
+        ...
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+BAR_WIDTH = 24
+
+
+def _load(source: str):
+    if source == "-":
+        return json.load(sys.stdin)
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(source) as resp:  # noqa: S310 — operator tool
+            return json.loads(resp.read())
+    with open(source) as fh:
+        return json.load(fh)
+
+
+def _depths(spans: List[Dict]) -> Dict[int, int]:
+    by_id = {s["id"]: s for s in spans}
+    depths: Dict[int, int] = {}
+
+    def depth(sid: int) -> int:
+        if sid in depths:
+            return depths[sid]
+        parent = by_id[sid].get("parent")
+        d = 0 if parent is None or parent not in by_id else (
+            depth(parent) + 1
+        )
+        depths[sid] = d
+        return d
+
+    for s in spans:
+        depth(s["id"])
+    return depths
+
+
+def _fmt_attrs(attrs: Dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def render_trace(trace: Dict) -> str:
+    """One trace -> waterfall text (no trailing newline)."""
+    spans = sorted(trace.get("spans") or [], key=lambda s: s["off_ms"])
+    total = trace.get("duration_ms")
+    header = (
+        f"trace {trace.get('trace_id', trace.get('eval_id', '?'))} "
+        f"outcome={trace.get('outcome')} "
+        + (f"{total:.2f}ms " if total is not None else "(in flight) ")
+        + f"spans={len(spans)}"
+    )
+    if trace.get("dropped"):
+        header += f" dropped={trace['dropped']}"
+    if trace.get("orphans"):
+        header += f" ORPHANS={trace['orphans']}"
+    if trace.get("attrs"):
+        header += "\n  " + _fmt_attrs(trace["attrs"])
+    lines = [header]
+    depths = _depths(spans)
+    name_w = max(
+        (len(s["name"]) + 2 * depths[s["id"]] for s in spans),
+        default=0,
+    )
+    scale = total if total else 1.0
+    for s in spans:
+        dur = s.get("dur_ms")
+        bar = ""
+        if dur and scale:
+            bar = "=" * max(1, round(dur / scale * BAR_WIDTH))
+        name = "  " * depths[s["id"]] + s["name"]
+        dur_txt = f"{dur:.2f}ms" if dur is not None else "OPEN"
+        row = (
+            f"  {s['off_ms']:9.2f}ms  {name:<{name_w}}  "
+            f"{dur_txt:>10}  {bar:<{BAR_WIDTH}}"
+        )
+        extras = dict(s.get("attrs") or {})
+        if s.get("thread"):
+            extras["thread"] = s["thread"]
+        if extras:
+            row += f"  {_fmt_attrs(extras)}"
+        lines.append(row.rstrip())
+    return "\n".join(lines)
+
+
+def render(payload) -> str:
+    """A trace dict or a list of them (summaries allowed) -> text."""
+    if isinstance(payload, list):
+        parts = []
+        for entry in payload:
+            if isinstance(entry.get("spans"), list):
+                parts.append(render_trace(entry))
+            else:
+                # listing without ?full=1: summaries only
+                dur = entry.get("duration_ms")
+                parts.append(
+                    f"trace {entry.get('trace_id')} "
+                    f"outcome={entry.get('outcome')} "
+                    + (
+                        f"{dur:.2f}ms "
+                        if dur is not None
+                        else "(in flight) "
+                    )
+                    + f"spans={entry.get('spans')} "
+                    "(fetch /v1/traces/<eval_id> for the waterfall)"
+                )
+        return "\n\n".join(parts)
+    return render_trace(payload)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    print(render(_load(argv[1])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
