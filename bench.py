@@ -930,6 +930,112 @@ def _share_classes(nodes):
 
 WITH_CONFIGS = os.environ.get("BENCH_CONFIGS", "1") == "1"
 WITH_TRACE_OVERHEAD = os.environ.get("BENCH_TRACE_OVERHEAD", "1") == "1"
+WITH_DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
+
+
+def bench_device_supervisor():
+    """Forced-failover microbench (device supervisor): a small batch
+    server with ``NOMAD_TPU_FAULT=wedge_launch`` armed, measuring the
+    wall time from the first submit to LOST detection and from
+    detection to the first placement committed on the CPU fallback,
+    plus the supervisor's probe-latency/failover stats.  Runs after
+    the headline benches so the injected fault can't touch them."""
+    import copy as _copy
+
+    from nomad_tpu.server import Server
+
+    knobs = {
+        "NOMAD_TPU_FAULT": "wedge_launch",
+        "NOMAD_TPU_WATCHDOG_MIN_S": "1.0",
+        "NOMAD_TPU_WATCHDOG_MAX_S": "1.0",
+        "NOMAD_TPU_PROBE_INTERVAL_S": "0.5",
+        "NOMAD_TPU_PROBE_TIMEOUT_S": "0.5",
+        # the backend is already initialized by this point in the
+        # bench; the injected wedge must trip at the 1s budget, not
+        # wait out the cold-start grace
+        "NOMAD_TPU_INIT_GRACE_S": "1.0",
+    }
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    server = None
+    try:
+        server = Server(
+            num_schedulers=1,
+            seed=SEED_BASE,
+            batch_pipeline=True,
+            heartbeat_ttl=1e9,
+        )
+        rng = random.Random(11)
+        cache = {}
+        for i in range(200):
+            n = mock.node(id=f"devbench-node-{i:04d}")
+            n.node_resources.cpu = rng.choice([8000, 16000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            key = (n.node_resources.cpu, n.node_resources.memory_mb)
+            if key not in cache:
+                cache[key] = compute_node_class(n)
+            n.computed_class = cache[key]
+            server.store.upsert_node(n)
+        server.start()
+        sup = server.device_supervisor
+        acks = []
+        orig_ack = server.broker.ack
+
+        def timed_ack(eval_id, token):
+            orig_ack(eval_id, token)
+            acks.append(time.monotonic())
+
+        server.broker.ack = timed_ack
+        t0 = time.monotonic()
+        n_jobs = 32
+        for i in range(n_jobs):
+            server.register_job(bench_job(i, prefix="devbench"))
+        drained = server.drain_to_idle(timeout=60.0)
+        server.broker.ack = orig_ack
+        t_lost = None
+        for h in sup.status()["history"]:
+            if h["to"] == "LOST":
+                # history stamps wall time; rebase onto the monotonic
+                # measurements
+                t_lost = time.monotonic() - (time.time() - h["at"])
+                break
+        detect_s = (t_lost - t0) if t_lost is not None else None
+        resume_s = None
+        if t_lost is not None:
+            after = [a for a in acks if a >= t_lost]
+            if after:
+                resume_s = after[0] - t_lost
+        placed = sum(
+            len(job_placements(server.store, f"devbench-{i}"))
+            for i in range(n_jobs)
+        )
+        status = sup.status()
+        out = {
+            "drained": drained,
+            "placements": placed,
+            "failover_count": status["failover_count"],
+            "watchdog_trips": status["watchdog_trips"],
+            "time_degraded_s": status["time_degraded_s"],
+            "probe_latency_ms_p50": status["probe_latency_ms"]["p50"],
+            "probe_latency_ms_p99": status["probe_latency_ms"]["p99"],
+            "detect_s": round(detect_s, 3)
+            if detect_s is not None
+            else None,
+            "detect_to_cpu_resume_s": round(resume_s, 3)
+            if resume_s is not None
+            else None,
+            "state": status["state"],
+        }
+        log(f"device-supervisor microbench: {json.dumps(out)}")
+        return out
+    finally:
+        if server is not None:
+            server.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def bench_trace_overhead():
@@ -1023,72 +1129,34 @@ def bench_configs():
 
 
 def _preflight() -> None:
-    """Bounded accelerator check before building the 10k-node world: a
-    wedged tunnel (another process died holding the chip's session)
-    blocks jax backend init indefinitely, and failing fast with a clear
-    message beats hanging until the driver's timeout.  Retries for a
-    while — stale sessions do expire."""
-    import threading
-
+    """Bounded accelerator check before building the 10k-node world,
+    delegated to the device supervisor's canary machinery
+    (``nomad_tpu.device.preflight``): take the cross-process device
+    lock, then retry a bounded-time backend init + canary kernel until
+    the accelerator answers or the budget passes — failing fast with a
+    clear message beats hanging until the driver's timeout."""
     total_s = float(os.environ.get("BENCH_PREFLIGHT_S", 600))
     if total_s <= 0:
         return  # explicit opt-out
-    # exclusive accelerator lock FIRST: a second jax process against
-    # the single-chip tunnel wedges the session for everyone (that is
-    # how round 3 lost its benchmark) — block here instead
-    from nomad_tpu.device_lock import ensure_device_lock
+    from nomad_tpu.device.preflight import (
+        HEALTHY_STATES,
+        run_preflight,
+    )
 
-    if not ensure_device_lock("bench.py"):
+    result = run_preflight(total_s=total_s, log=log)
+    log(f"preflight: {json.dumps(result)}")
+    if result["state"] in HEALTHY_STATES:
+        return
+    if result["state"] == "LOCK_BUSY":
         log("preflight: accelerator lock busy past deadline; aborting")
         sys.exit(2)
-    deadline = time.monotonic() + total_s
-    box: dict = {}
-
-    def probe() -> None:
-        # ONE long-lived prober: backend init is process-wide and
-        # memoized behind a lock, so parallel attempts would only
-        # queue on the same wedged call.  Init ERRORS (e.g. a stale
-        # session rejected by the server) retry until the deadline —
-        # stale sessions expire; a silent block is bounded by the
-        # outer wait.
-        while not box.get("stop") and "x" not in box:
-            try:
-                import jax
-                import jax.numpy as jnp
-
-                box["x"] = float(
-                    jax.jit(lambda a: a + 1)(jnp.ones(8)).sum()
-                )
-                return
-            except ImportError as exc:
-                # permanent: no amount of waiting installs jax
-                box["err"] = f"{type(exc).__name__}: {exc}"
-                box["fatal"] = True
-                return
-            except Exception as exc:  # noqa: BLE001
-                box["err"] = f"{type(exc).__name__}: {exc}"
-                time.sleep(10.0)
-
-    threading.Thread(target=probe, daemon=True).start()
-    logged = False
-    while time.monotonic() < deadline:
-        if "x" in box:
-            if logged or "err" in box:
-                log("preflight: device ok after retrying")
-            return
-        if box.get("fatal"):
-            log(f"preflight: fatal: {box['err']}")
-            sys.exit(2)
-        if not logged and time.monotonic() > deadline - total_s + 45:
-            log("preflight: device init slow/blocked; waiting")
-            logged = True
-        time.sleep(5.0)
-    box["stop"] = True
-    detail = box.get("err", "backend init blocked (no error raised)")
+    if result["state"] == "FATAL":
+        log(f"preflight: fatal: {result.get('error')}")
+        sys.exit(2)
     log(
         f"preflight: accelerator unreachable for {total_s:.0f}s "
-        f"({detail}) — likely a stale tunnel session; aborting "
-        "instead of hanging"
+        f"({result.get('error')}) — likely a stale tunnel session; "
+        "aborting instead of hanging"
     )
     # round-long retry evidence (tools/tpu_retry_loop.sh): surface the
     # attempt log so a failed bench records HOW MUCH recovery was
@@ -1131,6 +1199,13 @@ def main():
     )
     configs = bench_configs() if WITH_CONFIGS else {}
     kernel = bench_kernel_only() if WITH_KERNEL else {}
+    device = {}
+    if WITH_DEVICE:
+        try:
+            device = bench_device_supervisor()
+        except Exception as exc:  # noqa: BLE001
+            log(f"device-supervisor microbench FAILED: {exc!r}")
+            device = {"error": repr(exc)}
 
     n_check = min(E2E_ORACLE_JOBS, E2E_JOBS)
     parity_ok = same == n_check
@@ -1173,6 +1248,7 @@ def main():
                 "kernel_chained_placements_per_sec": round(
                     kernel.get("kernel-chained", 0.0), 1
                 ),
+                "device_supervisor": device,
                 "configs": configs,
             }
         )

@@ -1620,6 +1620,18 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond(metrics.dump() if metrics else {})
             return True
 
+        # -- accelerator supervisor status ------------------------------
+        # unauthenticated like /v1/metrics: this is the first endpoint
+        # an operator polls when the device wedges, and it must answer
+        # even when ACL state is part of what's broken
+        if path == "/v1/device" and method == "GET":
+            sup = getattr(srv, "device_supervisor", None)
+            if sup is None:
+                self._respond({"enabled": False, "state": "NONE"})
+            else:
+                self._respond(sup.status())
+            return True
+
         # -- eval flight recorder (per-eval span traces) ----------------
         # agent:read like the other debug surfaces (monitor, pprof):
         # traces carry job ids and node ids across every namespace

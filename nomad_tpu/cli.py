@@ -241,6 +241,7 @@ def cmd_agent(args) -> None:
         seed=cfg.server.seed,
         acl_enabled=cfg.acl.enabled,
         batch_pipeline=cfg.server.batch_pipeline,
+        device_config=cfg.device,
     )
     server.start()
     http = start_http_server(server, host=cfg.http.host, port=cfg.http.port)
@@ -749,6 +750,10 @@ def cmd_operator_debug(args) -> None:
         "agent-self.json": ("GET", "/v1/agent/self"),
         "members.json": ("GET", "/v1/agent/members"),
         "metrics.json": ("GET", "/v1/metrics"),
+        # accelerator supervisor: state machine + failover/canary
+        # history, so a bundle from a degraded server shows WHEN the
+        # device was lost and what tripped it
+        "device.json": ("GET", "/v1/device"),
         # eval flight recorder: recent full traces, so a bundle from a
         # misbehaving server carries per-eval stage/conflict evidence
         "traces.json": ("GET", "/v1/traces?full=1&limit=256"),
@@ -779,6 +784,44 @@ def cmd_operator_debug(args) -> None:
                 tar.add(p, arcname=f"nomad-debug/{name}")
     print(f"==> Wrote debug bundle to {out_path} "
           f"({len(names)} captures)")
+
+
+def cmd_device_status(args) -> None:
+    """Accelerator supervisor status (GET /v1/device)."""
+    st = _request("GET", "/v1/device")
+    if _emit(args, st):
+        return
+    if not st.get("enabled"):
+        print("Device supervision idle (no accelerator expected)")
+        return
+    lat = st.get("probe_latency_ms", {})
+    _table(
+        [
+            (
+                st.get("state", "?"),
+                st.get("backend", "?"),
+                st.get("failover_count", 0),
+                st.get("recovered_count", 0),
+                st.get("watchdog_trips", 0),
+                f"{st.get('canary_ok', 0)}/{st.get('canary_fail', 0)}",
+                f"{lat.get('p50', 0)}/{lat.get('p99', 0)}",
+            )
+        ],
+        [
+            "State", "Backend", "Failovers", "Recovered",
+            "WatchdogTrips", "Canary ok/fail", "Probe p50/p99 ms",
+        ],
+    )
+    if st.get("last_error"):
+        print(f"Last error: {st['last_error']}")
+    history = st.get("history", [])
+    if history:
+        print("Recent transitions:")
+        for h in history[-8:]:
+            print(
+                f"  {h.get('from')} -> {h.get('to')}: "
+                f"{h.get('reason')}"
+            )
 
 
 def cmd_operator_raft(args) -> None:
@@ -1898,6 +1941,12 @@ def build_parser() -> argparse.ArgumentParser:
     odbg = op_sub.add_parser("debug")
     odbg.add_argument("-output", dest="output", default="")
     odbg.set_defaults(fn=cmd_operator_debug)
+
+    devp = sub.add_parser("device")
+    devp_sub = devp.add_subparsers(dest="action", required=True)
+    dst = devp_sub.add_parser("status")
+    _add_fmt(dst)
+    dst.set_defaults(fn=cmd_device_status)
 
     mon = sub.add_parser("monitor")
     mon.add_argument(

@@ -46,6 +46,31 @@ class ClientConfig:
 
 
 @dataclass
+class DeviceConfig:
+    """Accelerator supervisor knobs (nomad_tpu/device).  ``None``
+    defers to the NOMAD_TPU_* env knob (and its default), so a config
+    file only pins what it names:
+
+        device {
+          probe_interval  = "30s"
+          probe_timeout   = "10s"
+          watchdog_factor = 20
+          watchdog_min    = "5s"
+          watchdog_max    = "2m"
+        }
+    """
+
+    probe_interval_s: Optional[float] = None
+    probe_timeout_s: Optional[float] = None
+    watchdog_factor: Optional[float] = None
+    watchdog_min_s: Optional[float] = None
+    watchdog_max_s: Optional[float] = None
+    lost_probes: Optional[int] = None
+    recover_canaries: Optional[int] = None
+    init_grace_s: Optional[float] = None
+
+
+@dataclass
 class HTTPConfig:
     host: str = "127.0.0.1"
     port: int = 4646
@@ -80,6 +105,7 @@ class AgentConfig:
     region: str = "global"
     server: ServerConfig = field(default_factory=ServerConfig)
     client: ClientConfig = field(default_factory=ClientConfig)
+    device: DeviceConfig = field(default_factory=DeviceConfig)
     http: HTTPConfig = field(default_factory=HTTPConfig)
     acl: ACLConfig = field(default_factory=ACLConfig)
     consul: ConsulConfig = field(default_factory=ConsulConfig)
@@ -143,6 +169,34 @@ def config_from_dict(raw: Dict) -> AgentConfig:
         heartbeat_interval_s=_duration_s(
             client.get("heartbeat_interval"), 10.0
         ),
+    )
+    device = _first(raw.get("device"), {}) or {}
+
+    def _dur_or_none(key):
+        value = device.get(key)
+        return None if value is None else _duration_s(value, 0.0)
+
+    cfg.device = DeviceConfig(
+        probe_interval_s=_dur_or_none("probe_interval"),
+        probe_timeout_s=_dur_or_none("probe_timeout"),
+        watchdog_factor=(
+            None
+            if device.get("watchdog_factor") is None
+            else float(device["watchdog_factor"])
+        ),
+        watchdog_min_s=_dur_or_none("watchdog_min"),
+        watchdog_max_s=_dur_or_none("watchdog_max"),
+        lost_probes=(
+            None
+            if device.get("lost_probes") is None
+            else int(device["lost_probes"])
+        ),
+        recover_canaries=(
+            None
+            if device.get("recover_canaries") is None
+            else int(device["recover_canaries"])
+        ),
+        init_grace_s=_dur_or_none("init_grace"),
     )
     http = _first(raw.get("http"), {}) or {}
     cfg.http = HTTPConfig(

@@ -1,0 +1,147 @@
+"""Bounded accelerator preflight: ``python -m nomad_tpu.device.preflight``.
+
+The supervisor's canary probe as a standalone check, absorbing the
+ad-hoc preflight that used to live in ``bench.py`` and the raw retry
+logic in ``tools/tpu_retry_loop.sh``: take the cross-process device
+lock, then retry a bounded-time backend-init + canary kernel until the
+accelerator answers or the deadline passes.
+
+Prints ONE machine-readable state line on stdout::
+
+    DEVICE_PREFLIGHT {"state": "HEALTHY", "attempts": 1, ...}
+
+and exits 0 when the device answered (or no accelerator is configured),
+2 otherwise — the contract ``tools/tpu_retry_loop.sh`` scripts against.
+
+Env knobs: ``NOMAD_TPU_PREFLIGHT_S`` (total budget, default 600; the
+legacy ``BENCH_PREFLIGHT_S`` is honored as a fallback), plus the
+supervisor's ``NOMAD_TPU_PROBE_TIMEOUT_S`` per-attempt deadline and the
+device lock's ``NOMAD_TPU_DEVICE_LOCK_WAIT``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, Optional
+
+from .supervisor import HEALTHY, DeviceSupervisor
+
+# preflight verdicts beyond the supervisor's state machine
+SKIPPED = "SKIPPED"  # explicit opt-out (budget <= 0)
+LOCK_BUSY = "LOCK_BUSY"  # another process holds the accelerator
+FATAL = "FATAL"  # permanent (e.g. jax not importable)
+UNREACHABLE = "UNREACHABLE"  # deadline passed without a canary pass
+# verdicts callers may proceed on
+HEALTHY_STATES = (HEALTHY, SKIPPED)
+
+_RETRY_SLEEP_S = 10.0
+
+
+def _stderr(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_preflight(
+    total_s: Optional[float] = None,
+    log: Callable[[str], None] = _stderr,
+) -> Dict:
+    """Probe the accelerator until it answers or ``total_s`` passes.
+    Returns the machine-readable result dict (the state line payload);
+    never raises."""
+    from ..device_lock import align_jax_platforms, ensure_device_lock
+
+    # honor an explicit CPU-only env even under a tunnel sitecustomize
+    # that pinned jax_platforms via config (config beats env)
+    align_jax_platforms()
+    if total_s is None:
+        total_s = float(
+            os.environ.get(
+                "NOMAD_TPU_PREFLIGHT_S",
+                os.environ.get("BENCH_PREFLIGHT_S", 600),
+            )
+        )
+    if total_s <= 0:
+        return {"state": SKIPPED, "attempts": 0}
+    # exclusive accelerator lock FIRST: a second jax process against a
+    # tunneled single-chip session wedges it for everyone
+    if not ensure_device_lock("device preflight"):
+        log("preflight: accelerator lock busy past deadline")
+        return {"state": LOCK_BUSY, "attempts": 0}
+    # a throwaway supervisor: its canary + bounded-call machinery IS
+    # the preflight; expected=True even on CPU-only boxes (a CPU canary
+    # passes instantly, preserving the old always-probe behavior).
+    # init_grace_s=0: preflight attempts must be bounded by the probe
+    # timeout alone — the OUTER total_s loop owns the slow-init wait
+    # (the single-flight canary keeps retries from stacking threads on
+    # the memoized init; once it completes, the next attempt passes)
+    sup = DeviceSupervisor(metrics=None, expected=True, init_grace_s=0.0)
+    deadline = time.monotonic() + total_s
+    attempts = 0
+    retried = False
+    try:
+        while True:
+            attempts += 1
+            t0 = time.monotonic()
+            ok = sup.probe_once()
+            if not ok and str(sup.last_error or "").startswith(
+                ("ImportError", "ModuleNotFoundError")
+            ):
+                # permanent: no amount of waiting installs jax
+                return {
+                    "state": FATAL,
+                    "attempts": attempts,
+                    "error": sup.last_error,
+                }
+            if ok:
+                if retried:
+                    log("preflight: device ok after retrying")
+                return {
+                    "state": HEALTHY,
+                    "attempts": attempts,
+                    "latency_ms": round(
+                        (time.monotonic() - t0) * 1000.0, 3
+                    ),
+                }
+            retried = True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            log(
+                f"preflight: canary failed "
+                f"({sup.last_error}); retrying "
+                f"({remaining:.0f}s left)"
+            )
+            time.sleep(min(_RETRY_SLEEP_S, max(0.0, remaining)))
+    finally:
+        sup.stop()
+    return {
+        "state": UNREACHABLE,
+        "attempts": attempts,
+        "budget_s": total_s,
+        "error": sup.last_error
+        or "backend init blocked (no error raised)",
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="nomad_tpu.device.preflight",
+        description="bounded accelerator canary probe",
+    )
+    parser.add_argument(
+        "--budget-s", type=float, default=None,
+        help="total retry budget (default NOMAD_TPU_PREFLIGHT_S/600)",
+    )
+    args = parser.parse_args(argv)
+    result = run_preflight(total_s=args.budget_s)
+    # the ONE machine-readable line scripts key on
+    print("DEVICE_PREFLIGHT " + json.dumps(result), flush=True)
+    return 0 if result["state"] in HEALTHY_STATES else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -525,6 +525,18 @@ class BatchWorker(Worker):
         from ..device_lock import ensure_device_lock
 
         ensure_device_lock("batch worker")
+        # accelerator supervisor (nomad_tpu/device): the launch/fetch
+        # stages run under its watchdog guards, and its backend epoch
+        # keys every cache that holds device-resident or
+        # backend-compiled state.  On a failover (or recovery
+        # flip-back) the transition listener flushes those caches so a
+        # re-targeted pipeline can never replay stale device buffers.
+        self.supervisor = getattr(server, "device_supervisor", None)
+        self._backend_epoch = (
+            self.supervisor.backend_epoch
+            if self.supervisor is not None
+            else 0
+        )
         # fallback evals are the shapes batching didn't cover: the
         # exact host stack beats per-pick device round trips there
         self.host_fallback = True
@@ -662,16 +674,16 @@ class BatchWorker(Worker):
         # opt-in: virtual CPU meshes make every launch slower (the
         # sharding tests cover parity); real multi-chip TPU deployments
         # set NOMAD_TPU_MESH=1
-        if _os.environ.get("NOMAD_TPU_MESH") == "1":
-            try:
-                import jax as _jax
-
-                if len(_jax.devices()) > 1:
-                    from ..parallel.mesh import make_mesh
-
-                    self._mesh = make_mesh(eval_axis=1)
-            except Exception:  # noqa: BLE001 — mesh is an optimization
-                self._mesh = None
+        self._mesh_requested = _os.environ.get("NOMAD_TPU_MESH") == "1"
+        if self._mesh_requested and (
+            self.supervisor is None
+            or not self.supervisor.failed_over()
+        ):
+            self._mesh = self._make_mesh()
+        # after the caches exist: a transition firing mid-construction
+        # must see a fully-initialized worker
+        if self.supervisor is not None:
+            self.supervisor.subscribe(self._on_device_transition)
         # stage timings (seconds, cumulative) — surfaced through
         # /v1/metrics so a production operator can see where batch time
         # goes and whether the fast path is actually being taken.  The
@@ -687,6 +699,101 @@ class BatchWorker(Worker):
             "replay": 0.0,
             "sequential": 0.0,
         }
+
+    def _make_mesh(self):
+        """Node-axis device mesh when the hardware offers >1 device;
+        None otherwise (and on any failure — the mesh is an
+        optimization, never a requirement)."""
+        try:
+            import jax as _jax
+
+            if len(_jax.devices()) > 1:
+                from ..parallel.mesh import make_mesh
+
+                return make_mesh(eval_axis=1)
+        except Exception:  # noqa: BLE001 — mesh is an optimization
+            pass
+        return None
+
+    # -- accelerator supervisor integration ----------------------------
+
+    def _guard_device(
+        self, stage: str, fn, exemplar: Optional[str] = None
+    ):
+        """Run a pipeline stage under the supervisor's launch
+        watchdog.  Without a supervisor (or while failed over to the
+        CPU backend, which cannot wedge) the call passes through."""
+        sup = self.supervisor
+        if sup is None:
+            return fn()
+        return sup.guard(stage, fn, eval_id=exemplar)
+
+    def _on_device_transition(
+        self, old: str, new: str, reason: str
+    ) -> None:
+        """Backend flip (failover to CPU, or recovery back to the
+        device): flush every cache keyed by — or holding buffers of —
+        the previous backend, so no launch can read stale device
+        state.  The epoch also keys the device mirror and the
+        compiled-shape shield, so even a racing in-flight reader
+        re-syncs rather than reusing a pre-flip entry."""
+        sup = self.supervisor
+        epoch = sup.backend_epoch
+        if epoch == self._backend_epoch:
+            return  # state moved but the pipeline target didn't
+        self._backend_epoch = epoch
+        # device-resident usage mirror: buffers live on the OLD
+        # backend.  Deliberately NOT under _usage_cache_lock: a wedged
+        # sacrificial assemble thread may be parked inside
+        # _device_columns_locked HOLDING that lock (device_put never
+        # returned), and this listener runs on the very thread the
+        # watchdog just protected — taking the lock here would
+        # re-wedge it.  The bare assignment is atomic, and an
+        # in-flight holder can at worst re-publish a dict whose key
+        # carries the OLD backend epoch, which the next lookup misses
+        # and fully resyncs.
+        self._usage_cache = None
+        # ... and REPLACE the lock itself: post-flip _device_columns
+        # calls run unguarded (CPU cannot wedge) and must never queue
+        # behind that abandoned holder.  Late writers racing the swap
+        # publish stale-epoch caches the key check discards.
+        self._usage_cache_lock = threading.Lock()
+        # host-assembly caches hold no device state, but flushing them
+        # keeps the post-flip world observably cold (and is cheap —
+        # one rebuild per entry)
+        self._cand_cache = _LRUCache(64)
+        self._mask_cache = _LRUCache(256)
+        self._port_col_cache = _LRUCache(256)
+        self._dev_codes_cache = _LRUCache(256)
+        self._dev_aff_cache = _LRUCache(64)
+        with self._compile_lock:
+            # compiled-shape shield: executables were compiled for the
+            # old backend (in-flight background compiles finish into
+            # the old epoch's key space and are simply never matched)
+            self._compiled.clear()
+            self._compile_failed.clear()
+        # rebind rather than clear(): this listener may run on the
+        # supervisor's probe thread while the worker thread iterates
+        # these dicts (_export_adaptive_gauges) — clearing mid-iteration
+        # raises RuntimeError there, a fresh dict does not
+        self._sharded_runners = {}
+        self._launch_ewma = {}
+        # donation only helps off-CPU; re-resolve for the new target
+        self._donate_carries = None
+        if sup.failed_over():
+            # sharded mesh path: off while on the CPU fallback
+            self._mesh = None
+        elif self._mesh_requested and self._mesh is None:
+            self._mesh = self._make_mesh()
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.set_gauge(
+                "batch_worker.backend_epoch", float(epoch)
+            )
+        LOG.warning(
+            "batch worker re-targeted (%s -> %s, %s): caches flushed, "
+            "backend epoch %d", old, new, reason, epoch,
+        )
 
     def _sharded_runner(self, n_picks: int, spread_fit: bool,
                         with_spread: bool = False,
@@ -1075,9 +1182,18 @@ class BatchWorker(Worker):
                 continue
             # ---- prescore pipeline: assemble -> launch -> fetch ----
             t0 = _time.monotonic()
+            # the backend this chain's inputs are staged for: a
+            # supervisor flip mid-chain (probe-driven failover, or a
+            # recovery) strands the staged dev_cols/handles on the old
+            # backend — they must be dropped, never executed
+            chain_epoch = self._backend_epoch
             asm = None
             try:
-                asm = self._assemble(snap, run[idx:j], sims)
+                asm = self._guard_device(
+                    "assemble",
+                    lambda: self._assemble(snap, run[idx:j], sims),
+                    exemplar=run[idx][0].id,
+                )
             except Exception:  # noqa: BLE001
                 self._count("errors")
                 LOG.warning(
@@ -1122,9 +1238,21 @@ class BatchWorker(Worker):
                 t0 = _time.monotonic()
                 rows_arr = None
                 cold = False
+                # a failover between assemble and launch disabled the
+                # mesh: skip the launch entirely (and don't miscount
+                # it as a cold-compile fallback — the failover is the
+                # cause, and its own counters already tell that story)
+                mesh_off = self._mesh is None
                 try:
-                    rows_arr = self._launch_mesh(asm)
-                    cold = rows_arr is None
+                    if not mesh_off:
+                        rows_arr = self._guard_device(
+                            "launch",
+                            lambda: self._launch_mesh(asm),
+                            exemplar=run[idx][0].id,
+                        )
+                        cold = rows_arr is None and not (
+                            self._mesh is None
+                        )
                 except Exception:  # noqa: BLE001
                     self._count("errors")
                     LOG.warning(
@@ -1183,6 +1311,21 @@ class BatchWorker(Worker):
                 ci = 0
                 stalled = False  # cold shape or launch/fetch failure
                 while (ci < len(chunks) or pending) and not rescore:
+                    if chain_epoch != self._backend_epoch:
+                        # a probe-driven failover (or recovery) flipped
+                        # the backend mid-chain: the pending handles
+                        # and asm buffers target the OLD backend, and
+                        # with the guard now in pass-through a fetch
+                        # against a wedged device would block forever.
+                        # Drop the in-flight work; the sequential path
+                        # covers the rest of the run.
+                        LOG.warning(
+                            "backend flipped mid-chain; dropping %d "
+                            "in-flight chunk(s)", len(pending),
+                        )
+                        pending.clear()
+                        stalled = True
+                        break
                     while (
                         not stalled
                         and ci < len(chunks)
@@ -1192,9 +1335,13 @@ class BatchWorker(Worker):
                         t0 = _time.monotonic()
                         handle = None
                         try:
-                            handle = self._launch_chunk(
-                                asm, c0, c1, carry,
-                                check_ready=ci == 0,
+                            handle = self._guard_device(
+                                "launch",
+                                lambda: self._launch_chunk(
+                                    asm, c0, c1, carry,
+                                    check_ready=ci == 0,
+                                ),
+                                exemplar=run[idx + c0][0].id,
                             )
                             if handle is None:
                                 self._count("cold_shape_fallbacks")
@@ -1223,7 +1370,11 @@ class BatchWorker(Worker):
                     (c0, c1), handle = pending.popleft()
                     t0 = _time.monotonic()
                     try:
-                        rows_arr, pulls_arr = self._fetch(handle)
+                        rows_arr, pulls_arr = self._guard_device(
+                            "fetch",
+                            lambda: self._fetch(handle),
+                            exemplar=run[idx + c0][0].id,
+                        )
                     except Exception:  # noqa: BLE001
                         self._count("errors")
                         LOG.warning(
@@ -2233,10 +2384,14 @@ class BatchWorker(Worker):
                         jax.block_until_ready(out)
                         with self._compile_lock:
                             # must match _launch_ready's lookup key
-                            # (fn-name prefix included), or warmed
-                            # shapes are never recognized
+                            # (fn-name prefix + backend epoch
+                            # included), or warmed shapes are never
+                            # recognized
                             self._compiled.add(
-                                ("chained_plan_picks_cols",)
+                                (
+                                    "chained_plan_picks_cols",
+                                    self._backend_epoch,
+                                )
                                 + self._launch_signature(
                                     args, kwargs
                                 )
@@ -2504,8 +2659,31 @@ class BatchWorker(Worker):
     def _device_columns_locked(self, table, jax) -> tuple:
         # table.epoch: a snapshot restore swaps in a FRESH NodeTable
         # whose restarted generations could collide with the cached
-        # key and leave pre-restore usage on device permanently
-        key = (table.epoch, table.topo_generation, table.capacity)
+        # key and leave pre-restore usage on device permanently.
+        # _backend_epoch: a supervisor failover/recovery re-targets
+        # the backend — a mirror uploaded to the old one must never
+        # satisfy a post-flip launch
+        key = (
+            self._backend_epoch,
+            table.epoch,
+            table.topo_generation,
+            table.capacity,
+        )
+        # explicit placement while failed over (the CPU backend);
+        # None = jax's default device
+        target = (
+            self.supervisor.jax_device()
+            if self.supervisor is not None
+            else None
+        )
+
+        def put(col):
+            return (
+                jax.device_put(col, target)
+                if target is not None
+                else jax.device_put(col)
+            )
+
         cache = self._usage_cache
         hit = False
         if cache is None or cache["key"] != key:
@@ -2513,7 +2691,7 @@ class BatchWorker(Worker):
             # growth): rows may have been reassigned — full resync
             gen, _rows = self.store.usage_delta_since(-1)
             cols = tuple(
-                jax.device_put(col)
+                put(col)
                 for col in (
                     table.cpu_total,
                     table.mem_total,
@@ -2531,7 +2709,7 @@ class BatchWorker(Worker):
             if len(rows) > max(64, table.capacity // 8):
                 # wide churn: one bulk upload beats many scatters
                 cols = cols[:3] + tuple(
-                    jax.device_put(col)
+                    put(col)
                     for col in (
                         table.cpu_used,
                         table.mem_used,
@@ -3131,7 +3309,14 @@ class BatchWorker(Worker):
     def _donation_enabled(self) -> bool:
         """Donating the carry buffers only helps (and is only honored)
         off-CPU; resolved lazily so backend init stays off the module
-        import path."""
+        import path.  While the supervisor has failed the pipeline
+        over, launches run on the CPU backend regardless of what
+        jax.default_backend() says — donation stays off."""
+        if (
+            self.supervisor is not None
+            and self.supervisor.failed_over()
+        ):
+            return False
         if self._donate_carries is None:
             import jax
 
@@ -3221,6 +3406,12 @@ class BatchWorker(Worker):
         mesh path doesn't chunk-pipeline.  Returns rows[E, P] (numpy,
         blocking) or None while the shape compiles in the
         background."""
+        if self._mesh is None:
+            # the supervisor disabled the mesh (failover) after this
+            # run was assembled — launching on the old backend's
+            # shards could block on a wedged device; the exact path
+            # covers these evals
+            return None
         # single-group batches only: the sharded runner keeps the
         # historical per-eval scalar layout, which the T=1 slices
         # reproduce exactly (per-pick values are constant within a
@@ -3352,9 +3543,12 @@ class BatchWorker(Worker):
             return True
         if fn is None:
             fn = chained_plan_picks_cols
-        sig = (getattr(fn, "__name__", str(fn)),) + (
-            self._launch_signature(args, kwargs)
-        )
+        # backend epoch in the key: an executable compiled before a
+        # supervisor failover/recovery targeted a different backend
+        sig = (
+            getattr(fn, "__name__", str(fn)),
+            self._backend_epoch,
+        ) + self._launch_signature(args, kwargs)
         with self._compile_lock:
             if sig in self._compiled:
                 return True

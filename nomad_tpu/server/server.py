@@ -165,6 +165,7 @@ class Server:
         batch_pipeline: bool = True,
         store: Optional[StateStore] = None,
         acls=None,
+        device_config=None,
     ) -> None:
         from ..acl import ACLStore
         from ..telemetry import Metrics
@@ -177,6 +178,16 @@ class Server:
             enabled=acl_enabled
         )
         self.metrics = Metrics()
+        # accelerator supervisor: owns device liveness (health probes,
+        # launch watchdogs, hot CPU failover) for every worker.  Built
+        # BEFORE the workers so they can subscribe to backend
+        # transitions; idle (no thread) on CPU-only deployments unless
+        # forced via NOMAD_TPU_SUPERVISOR=1 or an armed NOMAD_TPU_FAULT
+        from ..device import DeviceSupervisor
+
+        self.device_supervisor = DeviceSupervisor(
+            metrics=self.metrics, config=device_config
+        )
         self.broker = EvalBroker(nack_timeout=nack_timeout)
         self.blocked = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
@@ -280,6 +291,9 @@ class Server:
             self.blocked.set_enabled(True)
             self.plan_queue.set_enabled(True)
             self.applier.start()
+            # device supervision runs while this server schedules (a
+            # no-op on CPU-only deployments: no probe thread starts)
+            self.device_supervisor.start()
             for worker in self.workers:
                 worker.start()
             # opt-in: pre-compile the pipelined prescore launch shapes
@@ -304,6 +318,9 @@ class Server:
                             name="prescore-warmup",
                             daemon=True,
                         ).start()
+                        # the same warmup validates a RECOVERING device
+                        # before the supervisor flips the pipeline back
+                        self.device_supervisor.add_warm_hook(warm)
             self.deployment_watcher.start()
             self.drainer.start()
             self.periodic.start()
@@ -355,6 +372,7 @@ class Server:
             if not self._leader_established:
                 return
             self._leader_established = False
+            self.device_supervisor.stop()
             self.periodic.stop()
             self.deployment_watcher.stop()
             self.drainer.stop()

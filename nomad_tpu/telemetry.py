@@ -12,6 +12,17 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 
+def percentile(ordered: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list — the single
+    shared implementation (summary snapshots here, the device
+    supervisor's probe-latency status) so /v1/metrics and /v1/device
+    can never report different p99s for the same ring."""
+    if not ordered:
+        return 0.0
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
 class _Summary:
     __slots__ = (
         "count", "total", "min", "max", "_ring", "_ring_ex",
@@ -52,12 +63,7 @@ class _Summary:
             self._ring_pos = (self._ring_pos + 1) % self.RING
 
     def _percentile(self, ordered: List[float], q: float) -> float:
-        if not ordered:
-            return 0.0
-        idx = min(
-            len(ordered) - 1, int(round(q * (len(ordered) - 1)))
-        )
-        return ordered[idx]
+        return percentile(ordered, q)
 
     def _exemplars(self, p99: float) -> List[Dict]:
         """Trace refs of the ring entries at or above p99, slowest
@@ -129,6 +135,25 @@ class Metrics:
         """O(1) single-gauge read; None when the gauge was never set."""
         with self._lock:
             return self._gauges.get(name)
+
+    def preregister(
+        self,
+        counters=(),
+        gauges=(),
+        samples=(),
+    ) -> None:
+        """Zero-register metric names so they appear on /v1/metrics and
+        prometheus scrapes from process start (a `device.failover`
+        counter that only materializes DURING an incident would make
+        absence-of-series indistinguishable from absence-of-failures
+        on every dashboard)."""
+        with self._lock:
+            for name in counters:
+                self._counters[name] += 0.0
+            for name in gauges:
+                self._gauges.setdefault(name, 0.0)
+            for name in samples:
+                self._samples[name]  # defaultdict materializes it
 
     @contextmanager
     def measure(self, name: str):

@@ -77,6 +77,18 @@ SPAN_NAMES = frozenset(
         "replay.serial_fallback",
         # sequential worker
         "worker.invoke_scheduler",
+        # accelerator supervisor (nomad_tpu/device): failover
+        # incidents get their own trace (``device:failover:<n>``,
+        # rooted at device.incident); device.watchdog_trip also lands
+        # on the eval whose guarded stage tripped
+        "device.incident",
+        "device.failover",
+        "device.watchdog_trip",
+        "device.state_change",
+        "device.flush",
+        "device.probe",
+        "device.rewarm",
+        "device.recover",
         # plan pipeline + state commit
         "plan.evaluate",
         "plan.apply",
@@ -328,9 +340,13 @@ class Tracer:
 
     # -- lifecycle -----------------------------------------------------
 
-    def begin(self, eval_id: str, **attrs) -> None:
+    def begin(
+        self, eval_id: str, root_span: str = "broker.dequeue", **attrs
+    ) -> None:
         """Start (or restart, on redelivery) an eval's trace; records
-        the `broker.dequeue` mark as the root event."""
+        ``root_span`` (default `broker.dequeue`) as the root event —
+        non-eval traces (the device supervisor's failover incidents)
+        pass their own root name."""
         if not self.enabled or not eval_id:
             return
         trace = Trace(eval_id, next(self._gen), attrs)
@@ -344,7 +360,7 @@ class Tracer:
                 evicted = self._ring.popleft()
                 if self._by_id.get(evicted.eval_id) is evicted:
                     del self._by_id[evicted.eval_id]
-        trace.add_span("broker.dequeue", trace.t0, 0.0, attrs)
+        trace.add_span(root_span, trace.t0, 0.0, attrs)
 
     def finish(self, eval_id: str, outcome: str) -> None:
         if not self.enabled:

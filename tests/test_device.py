@@ -1,0 +1,620 @@
+"""Accelerator supervisor tests: watchdog bounded calls, the
+HEALTHY -> DEGRADED -> LOST -> RECOVERING state machine, hot CPU
+failover under injected faults (zero dropped evals, decision parity,
+flight-recorder incident traces), backend-cache invalidation, the
+/v1/device surface, and the preflight module.
+
+Everything runs on the CPU backend: ``NOMAD_TPU_FAULT`` makes the
+failure modes deterministic, which is the whole point of the fault
+hooks.
+"""
+import copy
+import json
+import random
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.device import (
+    CPU_ONLY,
+    DEGRADED,
+    HEALTHY,
+    LOST,
+    RECOVERING,
+    BudgetTracker,
+    DeviceSupervisor,
+    DeviceTimeout,
+    FaultPlan,
+    bounded_call,
+)
+from nomad_tpu.server import Server
+from nomad_tpu.structs import compute_node_class
+from nomad_tpu.telemetry import Metrics
+from nomad_tpu.trace import SPAN_NAMES, TRACE
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_nodes(n, seed=0):
+    rng = random.Random(seed)
+    nodes = []
+    for _ in range(n):
+        node = mock.node()
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def make_jobs(n, prefix, seed=1):
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(n):
+        job = mock.job(id=f"{prefix}-{i}")
+        job.task_groups[0].count = rng.randint(1, 4)
+        job.task_groups[0].tasks[0].resources.cpu = rng.choice(
+            [200, 500]
+        )
+        jobs.append(job)
+    return jobs
+
+
+def placements(server, job_id):
+    return sorted(
+        (a.name, a.node_id)
+        for a in server.store.allocs_by_job("default", job_id)
+        if not a.terminal_status()
+    )
+
+
+# -- watchdog primitives ------------------------------------------------
+
+
+def test_bounded_call_passthrough_and_timeout():
+    assert bounded_call(lambda: 41 + 1, 5.0) == 42
+    with pytest.raises(ValueError):
+        bounded_call(lambda: (_ for _ in ()).throw(ValueError("x")), 5.0)
+    t0 = time.monotonic()
+    with pytest.raises(DeviceTimeout) as exc:
+        bounded_call(lambda: time.sleep(30), 0.2, stage="fetch")
+    assert time.monotonic() - t0 < 5.0
+    assert exc.value.stage == "fetch"
+
+
+def test_bounded_call_reuses_worker_until_a_trip_burns_it():
+    """Healthy guarded calls share one sacrificial thread per calling
+    thread (no spawn on the hot path); a tripped deadline abandons it
+    and the next call mints a replacement."""
+    from nomad_tpu.device import watchdog
+
+    assert bounded_call(lambda: 1, 5.0) == 1
+    runner1 = watchdog._TLS.runner
+    assert bounded_call(lambda: 2, 5.0) == 2
+    assert watchdog._TLS.runner is runner1  # reused, not respawned
+    with pytest.raises(DeviceTimeout):
+        bounded_call(lambda: time.sleep(30), 0.2)
+    assert runner1.dead
+    assert bounded_call(lambda: 3, 5.0) == 3  # fresh runner
+    assert watchdog._TLS.runner is not runner1
+
+
+def test_budget_tracker_clamps_and_tracks():
+    tracker = BudgetTracker(factor=10.0, min_s=1.0, max_s=5.0)
+    # no history: the floor applies (a cold first launch must not trip
+    # on its own compile)
+    assert tracker.budget("launch") == 1.0
+    tracker.note("launch", 0.3)
+    assert tracker.budget("launch") == pytest.approx(3.0)
+    tracker.note("launch", 100.0)  # EWMA moves, budget hits the cap
+    assert tracker.budget("launch") == 5.0
+    snap = tracker.snapshot()
+    assert "launch" in snap and snap["launch"]["budget_s"] == 5.0
+
+
+def test_fault_plan_parsing(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FAULT", "wedge_launch,flaky:2")
+    plan = FaultPlan.from_env()
+    assert plan.active
+    assert plan.describe() == ["flaky:2", "wedge_launch"]
+    monkeypatch.setenv("NOMAD_TPU_FAULT", "typo_kind")
+    with pytest.raises(ValueError):
+        FaultPlan.from_env()
+    monkeypatch.delenv("NOMAD_TPU_FAULT")
+    assert not FaultPlan.from_env().active
+
+
+# -- state machine ------------------------------------------------------
+
+
+def test_cpu_only_supervisor_is_inert():
+    sup = DeviceSupervisor(metrics=Metrics())
+    assert sup.state() == CPU_ONLY
+    assert not sup.expected
+    assert not sup.failed_over()
+    # guard is a pure passthrough — no sacrificial thread, no budget
+    assert sup.guard("launch", lambda: "ok") == "ok"
+    sup.start()  # must not spawn a probe thread
+    assert sup._thread is None
+    sup.trip("manual")  # no accelerator -> nothing to lose
+    assert sup.state() == CPU_ONLY
+
+
+def test_state_machine_flaky_roundtrip_with_injected_canary():
+    calls = {"n": 0}
+
+    def canary():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise RuntimeError("flaky canary")
+        return 1.0
+
+    metrics = Metrics()
+    sup = DeviceSupervisor(
+        metrics=metrics,
+        expected=True,
+        canary=canary,
+        probe_interval_s=0.01,
+        probe_timeout_s=2.0,
+        lost_probes=2,
+        recover_canaries=2,
+    )
+    states = []
+    for _ in range(7):
+        sup.probe_once()
+        states.append(sup.state())
+    assert states[:5] == [DEGRADED, DEGRADED, LOST, RECOVERING, HEALTHY]
+    assert sup.failover_count == 1 and sup.recovered_count == 1
+    # one epoch per flip: failover + restore
+    assert sup.backend_epoch == 2
+    assert metrics.get_gauge("device.state") == 1.0
+    assert metrics.get_counter("device.failover") == 1.0
+    assert metrics.get_counter("device.canary_fail") == 3.0
+    # the incident trace closed with the recovery
+    trace = TRACE.get(sup.last_incident)
+    assert trace is not None and trace["outcome"] == "recovered"
+    names = [s["name"] for s in trace["spans"]]
+    assert "device.failover" in names and "device.recover" in names
+
+
+def test_probe_timeout_is_an_immediate_wedge():
+    sup = DeviceSupervisor(
+        metrics=Metrics(),
+        expected=True,
+        canary=lambda: time.sleep(30),
+        probe_interval_s=60.0,
+        probe_timeout_s=0.2,
+        init_grace_s=0.2,
+    )
+    assert not sup.probe_once()
+    # a canary that BLOCKS is a wedge: straight to LOST, no DEGRADED
+    assert sup.state() == LOST
+    assert sup.probe_timeouts == 1
+    sup.stop()
+
+
+def test_warm_hooks_run_after_restore_flip():
+    order = []
+    calls = {"n": 0}
+
+    def canary():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("down")
+        return 1.0
+
+    sup = DeviceSupervisor(
+        metrics=Metrics(),
+        expected=True,
+        canary=canary,
+        probe_interval_s=0.01,
+        probe_timeout_s=2.0,
+        lost_probes=1,
+        recover_canaries=1,
+    )
+    sup.add_warm_hook(lambda: order.append(("warm", None)))
+    sup.subscribe(
+        lambda old, new, reason: order.append(("flip", new))
+    )
+    sup.probe_once()  # fail -> DEGRADED
+    assert sup.state() == DEGRADED
+    sup.probe_once()  # fail streak 2 >= 1+lost_probes -> LOST
+    assert sup.state() == LOST
+    sup.probe_once()  # ok -> RECOVERING
+    assert sup.state() == RECOVERING
+    sup.probe_once()  # ok -> HEALTHY flip, then re-warm hooks
+    assert sup.state() == HEALTHY
+    # listener flips fired for both failover and restore, and the
+    # re-warm ran AFTER the restore flip — the hooks must compile for
+    # the restored backend under the post-restore epoch (before the
+    # flip they would target the CPU fallback and the flush would
+    # discard every warmed shape)
+    assert ("flip", LOST) in order and ("flip", HEALTHY) in order
+    assert order.index(("warm", None)) > order.index(
+        ("flip", HEALTHY)
+    )
+
+
+# -- forced-wedge failover soak ----------------------------------------
+
+
+def test_wedge_launch_failover_soak(monkeypatch):
+    """Under NOMAD_TPU_FAULT=wedge_launch a 64-eval soak must complete
+    with zero lost/duplicated evals, decisions bit-identical to an
+    unfaulted CPU run, detection well under 10s, and a well-nested
+    device.failover trace naming the tripped watchdog."""
+    nodes = make_nodes(20)
+    jobs = make_jobs(64, "wedge")
+
+    plain = Server(num_schedulers=1, seed=5, batch_pipeline=True)
+    plain.start()
+    try:
+        assert plain.device_supervisor.state() == CPU_ONLY
+        for node in nodes:
+            plain.register_node(copy.deepcopy(node))
+        for job in jobs:
+            plain.register_job(copy.deepcopy(job))
+        assert plain.drain_to_idle(60)
+        plain_p = {j.id: placements(plain, j.id) for j in jobs}
+    finally:
+        plain.stop()
+
+    monkeypatch.setenv("NOMAD_TPU_FAULT", "wedge_launch")
+    monkeypatch.setenv("NOMAD_TPU_WATCHDOG_MIN_S", "0.5")
+    monkeypatch.setenv("NOMAD_TPU_WATCHDOG_MAX_S", "0.5")
+    # no real backend init to grace here — the wedge must trip at the
+    # 0.5s budget, not after the 600s cold-start grace
+    monkeypatch.setenv("NOMAD_TPU_INIT_GRACE_S", "0.5")
+    # keep the (wedged) canary out of the picture: the launch watchdog
+    # is what must detect this fault
+    monkeypatch.setenv("NOMAD_TPU_PROBE_INTERVAL_S", "60")
+    faulted = Server(num_schedulers=1, seed=5, batch_pipeline=True)
+    faulted.start()
+    try:
+        sup = faulted.device_supervisor
+        assert sup.expected and sup.state() == HEALTHY
+        wall0 = time.time()
+        for node in nodes:
+            faulted.register_node(copy.deepcopy(node))
+        for job in jobs:
+            faulted.register_job(copy.deepcopy(job))
+        assert faulted.drain_to_idle(90)
+        # detection: the watchdog tripped the supervisor, failing the
+        # pipeline over — well under the 10s acceptance bound
+        assert sup.state() == LOST
+        assert sup.failover_count == 1
+        assert sup.watchdog_trips >= 1
+        lost_at = next(
+            h["at"]
+            for h in sup.status()["history"]
+            if h["to"] == LOST
+        )
+        assert lost_at - wall0 < 10.0
+        # zero lost/duplicated evals: every eval completed exactly once
+        evs = [
+            e
+            for e in faulted.store.evals.values()
+            if e.job_id.startswith("wedge-")
+        ]
+        assert len(evs) >= 64
+        assert all(e.status == "complete" for e in evs)
+        # decision parity with the unfaulted CPU run
+        for job in jobs:
+            assert placements(faulted, job.id) == plain_p[job.id], (
+                f"divergence for {job.id}"
+            )
+        # the worker flushed + re-keyed onto the CPU backend
+        worker = faulted.workers[0]
+        assert worker._backend_epoch == sup.backend_epoch == 1
+        assert worker._usage_cache is None or (
+            worker._usage_cache["key"][0] == 1
+        )
+        # the failover incident trace: recorded, well-nested, and
+        # naming the tripped watchdog
+        trace = TRACE.get(sup.last_incident)
+        assert trace is not None
+        names = [s["name"] for s in trace["spans"]]
+        assert names[0] == "device.incident"
+        assert "device.failover" in names
+        failover = next(
+            s for s in trace["spans"] if s["name"] == "device.failover"
+        )
+        assert failover["attrs"]["watchdog"] == "launch"
+        ids = {s["id"] for s in trace["spans"]}
+        for span in trace["spans"]:
+            assert span["name"] in SPAN_NAMES
+            assert span["dur_ms"] is not None  # nothing left open
+            assert span["parent"] is None or span["parent"] in ids
+        # /v1/device reflects it all
+        status = sup.status()
+        assert status["backend"] == "cpu"
+        assert status["failover_count"] == 1
+        assert status["faults"] == ["wedge_launch"]
+    finally:
+        faulted.stop()
+
+
+def test_flaky_fault_roundtrip_reenables_device_path(monkeypatch):
+    """NOMAD_TPU_FAULT=flaky round-trips LOST -> RECOVERING -> HEALTHY
+    through the live probe thread and re-enables the device path,
+    all visible via /v1/device and the device.state gauge."""
+    from nomad_tpu.api import start_http_server
+
+    monkeypatch.setenv("NOMAD_TPU_FAULT", "flaky:3")
+    monkeypatch.setenv("NOMAD_TPU_PROBE_INTERVAL_S", "0.03")
+    monkeypatch.setenv("NOMAD_TPU_PROBE_TIMEOUT_S", "5")
+    monkeypatch.setenv("NOMAD_TPU_LOST_PROBES", "2")
+    monkeypatch.setenv("NOMAD_TPU_RECOVER_CANARIES", "2")
+    # guards stay active while HEALTHY; a cold CPU compile must not
+    # masquerade as a wedge
+    monkeypatch.setenv("NOMAD_TPU_WATCHDOG_MIN_S", "60")
+    server = Server(num_schedulers=1, seed=3, batch_pipeline=True)
+    server.start()
+    http = start_http_server(server, port=0)
+    try:
+        sup = server.device_supervisor
+        assert wait_until(
+            lambda: sup.recovered_count >= 1
+            and sup.state() == HEALTHY,
+            timeout=15.0,
+        ), sup.status()
+        visited = {h["to"] for h in sup.status()["history"]}
+        assert {DEGRADED, LOST, RECOVERING, HEALTHY} <= visited
+        # device path re-enabled, worker re-keyed (failover + restore;
+        # the listener runs synchronously on the probe thread, so give
+        # it a beat past the state read)
+        assert sup.device_available()
+        assert wait_until(
+            lambda: server.workers[0]._backend_epoch == 2, 5.0
+        )
+        assert server.metrics.get_gauge("device.state") == 1.0
+        # the pipeline still schedules after the round trip
+        for node in make_nodes(8):
+            server.register_node(node)
+        for job in make_jobs(4, "flaky"):
+            server.register_job(job)
+        assert server.drain_to_idle(30)
+        assert placements(server, "flaky-0")
+        # /v1/device over HTTP
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/v1/device"
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["state"] == HEALTHY
+        assert body["failover_count"] == 1
+        assert body["recovered_count"] == 1
+        assert body["enabled"] is True
+    finally:
+        http.stop()
+        server.stop()
+
+
+def test_slow_fetch_trips_the_fetch_watchdog(monkeypatch):
+    """slow_fetch outlives the fetch budget (without wedging forever):
+    the deadline monitor must trip rather than stall the gulp, and the
+    evals still complete on the fallback path."""
+    monkeypatch.setenv("NOMAD_TPU_FAULT", "slow_fetch")
+    monkeypatch.setenv("NOMAD_TPU_WATCHDOG_MIN_S", "0.4")
+    monkeypatch.setenv("NOMAD_TPU_WATCHDOG_MAX_S", "0.4")
+    monkeypatch.setenv("NOMAD_TPU_INIT_GRACE_S", "0.4")
+    monkeypatch.setenv("NOMAD_TPU_PROBE_INTERVAL_S", "60")
+    server = Server(num_schedulers=1, seed=9, batch_pipeline=True)
+    server.start()
+    try:
+        for node in make_nodes(12):
+            server.register_node(node)
+        for job in make_jobs(8, "slowfetch"):
+            server.register_job(job)
+        assert server.drain_to_idle(60)
+        sup = server.device_supervisor
+        assert sup.state() == LOST
+        assert sup.watchdog_trips >= 1
+        assert any(
+            "watchdog:fetch" in h["reason"]
+            for h in sup.status()["history"]
+        )
+        # the fallback path still placed work
+        assert sum(
+            len(placements(server, f"slowfetch-{i}"))
+            for i in range(8)
+        ) > 0
+        evs = [
+            e
+            for e in server.store.evals.values()
+            if e.job_id.startswith("slowfetch-")
+        ]
+        assert all(e.status == "complete" for e in evs)
+    finally:
+        server.stop()
+
+
+# -- backend-cache invalidation ----------------------------------------
+
+
+def test_failover_flushes_backend_keyed_caches(monkeypatch):
+    """A supervisor transition must flush the device mirror, the
+    host-assembly LRUs and the compiled-shape shield, and bump the
+    backend epoch that keys them — a failover can never replay stale
+    device buffers."""
+    monkeypatch.setenv("NOMAD_TPU_SUPERVISOR", "1")
+    monkeypatch.setenv("NOMAD_TPU_PROBE_INTERVAL_S", "3600")
+    monkeypatch.setenv("NOMAD_TPU_WATCHDOG_MIN_S", "60")
+    nodes = make_nodes(10)
+    server = Server(num_schedulers=1, seed=2, batch_pipeline=True)
+    server.start()
+    try:
+        worker = server.workers[0]
+        sup = server.device_supervisor
+        for node in nodes:
+            server.register_node(copy.deepcopy(node))
+        for job in make_jobs(6, "flush-a"):
+            server.register_job(job)
+        assert server.drain_to_idle(30)
+        assert worker.prescored > 0
+        assert len(worker._mask_cache) > 0
+        assert worker._usage_cache is not None
+        assert worker._usage_cache["key"][0] == 0
+        mask_cache_before = worker._mask_cache
+
+        sup.trip("manual")
+        assert sup.state() == LOST
+        assert worker._backend_epoch == 1
+        assert worker._usage_cache is None
+        assert worker._mask_cache is not mask_cache_before
+        assert len(worker._mask_cache) == 0
+        assert len(worker._cand_cache) == 0
+        with worker._compile_lock:
+            assert not worker._compiled
+
+        # post-failover scheduling repopulates onto the new epoch and
+        # still matches an independent reference run
+        for job in make_jobs(6, "flush-b", seed=4):
+            server.register_job(job)
+        assert server.drain_to_idle(30)
+        assert worker._usage_cache is not None
+        assert worker._usage_cache["key"][0] == 1
+
+        ref = Server(num_schedulers=1, seed=2, batch_pipeline=False)
+        ref.start()
+        try:
+            for node in nodes:
+                ref.register_node(copy.deepcopy(node))
+            for job in make_jobs(6, "flush-a"):
+                ref.register_job(job)
+            assert ref.drain_to_idle(30)
+            for job in make_jobs(6, "flush-b", seed=4):
+                ref.register_job(job)
+            assert ref.drain_to_idle(30)
+            for i in range(6):
+                assert placements(server, f"flush-a-{i}") == (
+                    placements(ref, f"flush-a-{i}")
+                )
+                assert placements(server, f"flush-b-{i}") == (
+                    placements(ref, f"flush-b-{i}")
+                )
+        finally:
+            ref.stop()
+    finally:
+        server.stop()
+
+
+def test_failover_listener_survives_wedged_usage_lock_holder(
+    monkeypatch,
+):
+    """A wedged sacrificial thread can be abandoned while HOLDING
+    _usage_cache_lock (it was parked inside _device_columns).  The
+    failover listener runs on the thread the watchdog just protected,
+    so it must never block on that lock — the flush uses a bare
+    atomic assignment instead."""
+    monkeypatch.setenv("NOMAD_TPU_SUPERVISOR", "1")
+    monkeypatch.setenv("NOMAD_TPU_PROBE_INTERVAL_S", "3600")
+    server = Server(num_schedulers=1, seed=1, batch_pipeline=True)
+    server.start()
+    try:
+        worker = server.workers[0]
+        wedged_lock = worker._usage_cache_lock
+        assert wedged_lock.acquire(timeout=1)
+        try:
+            t0 = time.monotonic()
+            server.device_supervisor.trip("launch")
+            assert time.monotonic() - t0 < 2.0
+            assert server.device_supervisor.state() == LOST
+            assert worker._backend_epoch == 1
+            assert worker._usage_cache is None
+            # the lock itself was replaced, so post-failover CPU-path
+            # _device_columns never queues behind the wedged holder
+            assert worker._usage_cache_lock is not wedged_lock
+            for node in make_nodes(4):
+                server.register_node(node)
+            t0 = time.monotonic()
+            cols = worker._device_columns(
+                server.store.node_table
+            )
+            assert cols is not None
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            wedged_lock.release()
+    finally:
+        server.stop()
+
+
+# -- metrics + preflight -----------------------------------------------
+
+
+def test_device_metrics_preregistered():
+    """The whole device.* family is on /v1/metrics (and the prometheus
+    scrape) from server construction — absence-of-series must never be
+    confusable with absence-of-failures."""
+    server = Server(num_schedulers=1, batch_pipeline=True)
+    try:
+        text = server.metrics.prometheus_text()
+        for name in (
+            "device_state",
+            "device_backend_epoch",
+            "device_failover",
+            "device_canary_ok",
+            "device_watchdog_trips",
+            "device_probe_latency_ms_count",
+        ):
+            assert name in text, name
+        dump = server.metrics.dump()
+        assert dump["gauges"]["device.state"] == 0.0  # CPU_ONLY
+        assert dump["counters"]["device.failover"] == 0.0
+    finally:
+        server.stop()
+
+
+def test_preflight_healthy_on_cpu(capsys):
+    from nomad_tpu.device import preflight
+
+    result = preflight.run_preflight(total_s=30.0)
+    assert result["state"] == HEALTHY
+    assert result["attempts"] == 1
+    assert preflight.main(["--budget-s", "30"]) == 0
+    out = capsys.readouterr().out
+    line = next(
+        l for l in out.splitlines() if l.startswith("DEVICE_PREFLIGHT ")
+    )
+    payload = json.loads(line.split(" ", 1)[1])
+    assert payload["state"] == HEALTHY
+
+
+def test_preflight_init_block_unreachable(monkeypatch):
+    from nomad_tpu.device import preflight
+
+    monkeypatch.setenv("NOMAD_TPU_FAULT", "init_block")
+    monkeypatch.setenv("NOMAD_TPU_PROBE_TIMEOUT_S", "0.2")
+    t0 = time.monotonic()
+    result = preflight.run_preflight(total_s=0.6)
+    assert result["state"] == preflight.UNREACHABLE
+    assert result["attempts"] >= 1
+    assert time.monotonic() - t0 < 10.0
+    assert preflight.main(["--budget-s", "0.6"]) == 2
+
+
+def test_device_endpoint_idle_supervisor():
+    from nomad_tpu.api import start_http_server
+
+    server = Server(num_schedulers=1, batch_pipeline=True)
+    server.start()
+    http = start_http_server(server, port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/v1/device"
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["enabled"] is False
+        assert body["state"] == CPU_ONLY
+        assert body["failover_count"] == 0
+    finally:
+        http.stop()
+        server.stop()

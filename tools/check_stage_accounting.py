@@ -21,7 +21,18 @@ wholesale, would silently vanish from BENCH_*.json and /v1/metrics):
    ``TRACE.add_span(...)``, ``TRACE.event(...)``) is declared in the
    ``SPAN_NAMES`` registry in ``nomad_tpu/trace.py`` — a renamed
    stage must update the documented registry (and with it every
-   dashboard/report keyed on the name), never drift silently.
+   dashboard/report keyed on the name), never drift silently;
+5. every span/event name used by the accelerator supervisor
+   (``nomad_tpu/device/*.py``) is declared in ``SPAN_NAMES`` too, and
+   every ``device.*`` counter/gauge/sample it emits appears in the
+   ``METRIC_COUNTERS``/``METRIC_GAUGES``/``METRIC_SAMPLES`` registry
+   literals in ``device/supervisor.py`` — those are zero-registered
+   at supervisor construction, which is what guarantees
+   ``prometheus_text()`` exports the whole ``device.*`` family before
+   the first incident;
+6. the operator debug bundle (``cli.py`` ``cmd_operator_debug``)
+   captures ``/v1/device``, so a bundle from a degraded server always
+   carries the supervisor's state history.
 
 Run directly (exits non-zero on violation) or via the tier-1 test in
 ``tests/test_stage_accounting.py``.
@@ -42,6 +53,9 @@ PLAN_APPLY = os.path.join(
 )
 TRACE_MOD = os.path.join(REPO, "nomad_tpu", "trace.py")
 BENCH = os.path.join(REPO, "bench.py")
+DEVICE_DIR = os.path.join(REPO, "nomad_tpu", "device")
+DEVICE_SUPERVISOR = os.path.join(DEVICE_DIR, "supervisor.py")
+CLI = os.path.join(REPO, "nomad_tpu", "cli.py")
 
 # the trace-recording call surface (nomad_tpu/trace.py Tracer)
 _TRACE_CALLS = {"span", "add_span", "event"}
@@ -141,6 +155,57 @@ def span_registry(tree: ast.AST) -> Set[str]:
     return set()
 
 
+def device_metric_names(tree: ast.AST) -> Set[str]:
+    """``device.*`` metric-name literals emitted anywhere in a device
+    module: first string-constant positional of ``.incr(...)``,
+    ``.set_gauge(...)`` or ``.add_sample(...)`` calls."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("incr", "set_gauge", "add_sample")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("device.")
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def device_metric_registry(tree: ast.AST) -> Set[str]:
+    """String constants inside the ``METRIC_COUNTERS`` /
+    ``METRIC_GAUGES`` / ``METRIC_SAMPLES`` frozenset literals in
+    device/supervisor.py (the names zero-registered at supervisor
+    construction, hence always present in ``prometheus_text()``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id in (
+                "METRIC_COUNTERS",
+                "METRIC_GAUGES",
+                "METRIC_SAMPLES",
+            ):
+                out |= {
+                    n.value
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+    return out
+
+
+def _device_module_paths() -> List[str]:
+    return sorted(
+        os.path.join(DEVICE_DIR, name)
+        for name in os.listdir(DEVICE_DIR)
+        if name.endswith(".py")
+    )
+
+
 def bench_exports_timings(tree: ast.AST, source: str) -> List[str]:
     """Problems with bench.py's stage export (empty list = ok)."""
     problems = []
@@ -203,6 +268,43 @@ def check() -> Tuple[bool, List[str]]:
             "span names used but missing from trace.SPAN_NAMES "
             "(rename must update the documented registry): "
             f"{sorted(unregistered)}"
+        )
+    # accelerator supervisor: span names registered, device.* metrics
+    # zero-registered (so prometheus_text() always exports them)
+    device_spans: Set[str] = set()
+    device_metrics: Set[str] = set()
+    for path in _device_module_paths():
+        tree = _parse(path)
+        device_spans |= span_names_used(tree)
+        device_metrics |= device_metric_names(tree)
+    unregistered = device_spans - registry
+    if unregistered:
+        problems.append(
+            "device-supervisor span names missing from "
+            f"trace.SPAN_NAMES: {sorted(unregistered)}"
+        )
+    metric_registry = device_metric_registry(
+        _parse(DEVICE_SUPERVISOR)
+    )
+    if not metric_registry:
+        problems.append(
+            "could not find the METRIC_COUNTERS/GAUGES/SAMPLES "
+            "registry in device/supervisor.py"
+        )
+    unexported = device_metrics - metric_registry
+    if unexported:
+        problems.append(
+            "device.* metrics emitted but not in the supervisor's "
+            "zero-registered registry (they would be absent from "
+            f"prometheus_text() until the first incident): "
+            f"{sorted(unexported)}"
+        )
+    with open(CLI) as fh:
+        cli_src = fh.read()
+    if '"/v1/device"' not in cli_src.split("cmd_operator_debug", 1)[-1].split("def ", 1)[0]:
+        problems.append(
+            "the operator debug bundle (cli.cmd_operator_debug) no "
+            "longer captures /v1/device"
         )
     with open(BENCH) as fh:
         bench_src = fh.read()
