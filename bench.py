@@ -930,6 +930,9 @@ def _share_classes(nodes):
 
 WITH_CONFIGS = os.environ.get("BENCH_CONFIGS", "1") == "1"
 WITH_TRACE_OVERHEAD = os.environ.get("BENCH_TRACE_OVERHEAD", "1") == "1"
+WITH_EXPLAIN_OVERHEAD = (
+    os.environ.get("BENCH_EXPLAIN_OVERHEAD", "1") == "1"
+)
 WITH_DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
 
 
@@ -1112,6 +1115,79 @@ def bench_trace_overhead():
     return round(pct_overhead, 2)
 
 
+def bench_explain_overhead():
+    """Cost of the placement-explainability layer: the same
+    config2-like batch stream through the batch pipeline with the
+    explain layer on vs NOMAD_TPU_EXPLAIN=0, interleaved A/B/A/B with
+    min-of-reps per mode (the trace-overhead protocol).  Emits
+    ``explain_overhead_pct``; the acceptance contract is <3%
+    (tests/test_placement_explain.py gates the capture's per-select
+    cost, this gates the pipeline's recording cost)."""
+    from nomad_tpu.explain import EXPLAIN
+
+    n_nodes = int(os.environ.get("BENCH_EXPLAIN_NODES", 300))
+    n_jobs = int(os.environ.get("BENCH_EXPLAIN_JOBS", 48))
+    reps = int(os.environ.get("BENCH_EXPLAIN_REPS", 2))
+
+    def nodes():
+        rng = random.Random(12)
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"ex-node-{i:05d}")
+            n.node_resources.cpu = rng.choice([8000, 16000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def run_once(enabled, tag):
+        EXPLAIN.set_enabled(enabled)
+        server = _mk_server(True)
+        try:
+            for node in nodes():
+                server.store.upsert_node(node)
+            server.start()
+            server.workers[0].warm_shapes()
+            jobs = []
+            for i in range(n_jobs):
+                job = mock.job(id=f"ex-{tag}-{i}")
+                job.type = "batch"
+                job.task_groups[0].count = 10
+                job.task_groups[0].tasks[0].resources.cpu = 300
+                jobs.append(job)
+            dt, _pmap, n = _run_jobs(server, jobs)
+            log(
+                f"explain-overhead {tag} "
+                f"explain={'on' if enabled else 'off'}:"
+                f" {n} placements in {dt:.2f}s"
+            )
+            return dt
+        finally:
+            server.stop()
+
+    times = {True: [], False: []}
+    was_enabled = EXPLAIN.enabled
+    try:
+        # discarded warmup: first run pays this node-count's XLA
+        # compiles, which would read as explain overhead otherwise
+        run_once(True, "warmup")
+        for rep in range(reps):
+            for enabled in (True, False):
+                times[enabled].append(
+                    run_once(enabled, f"r{rep}")
+                )
+    finally:
+        EXPLAIN.set_enabled(was_enabled)
+        EXPLAIN.clear()
+    t_on, t_off = min(times[True]), min(times[False])
+    pct_overhead = (t_on - t_off) / t_off * 100.0 if t_off else 0.0
+    log(
+        f"explain-overhead: on={t_on:.2f}s off={t_off:.2f}s "
+        f"-> {pct_overhead:+.1f}%"
+    )
+    return round(pct_overhead, 2)
+
+
 def bench_configs():
     out = {}
     for name, fn in (
@@ -1197,6 +1273,9 @@ def main():
     trace_overhead = (
         bench_trace_overhead() if WITH_TRACE_OVERHEAD else None
     )
+    explain_overhead = (
+        bench_explain_overhead() if WITH_EXPLAIN_OVERHEAD else None
+    )
     configs = bench_configs() if WITH_CONFIGS else {}
     kernel = bench_kernel_only() if WITH_KERNEL else {}
     device = {}
@@ -1236,6 +1315,8 @@ def main():
                     k: round(v, 3) for k, v in trace_stages.items()
                 },
                 "trace_overhead_pct": trace_overhead,
+                # placement explainability (A/B'd like the recorder)
+                "explain_overhead_pct": explain_overhead,
                 "e2e_prescore_share": round(prescore_share, 3),
                 "e2e_replay_share": round(replay_share, 3),
                 "replay_conflict_rate": round(

@@ -1085,13 +1085,50 @@ class APIHandler(BaseHTTPRequestHandler):
             )
             return True
 
+        # placement explainability: the eval's retained per-TG score
+        # decomposition + filter attribution from the explain ring
+        # (cross-linked with /v1/traces/<eval_id>)
+        m = re.fullmatch(r"/v1/evaluation/([^/]+)/placement", path)
+        if m and method == "GET":
+            self._check_acl("read-job", ns)
+            from ..explain import EXPLAIN
+
+            record = EXPLAIN.get(m.group(1))
+            if record is None:
+                raise HTTPError(404, "no placement explanation retained")
+            self._respond(record)
+            return True
+
+        if path == "/v1/placements" and method == "GET":
+            # recent placement explanations (newest first) — the
+            # operator debug bundle's capture surface
+            self._check_acl("read-job", ns)
+            from ..explain import EXPLAIN
+
+            try:
+                limit = int(q.get("limit", "64"))
+            except ValueError:
+                raise HTTPError(400, "bad limit")
+            self._respond(EXPLAIN.recent(limit=min(limit, 1024)))
+            return True
+
         m = re.fullmatch(r"/v1/evaluation/([^/]+)", path)
         if m and method == "GET":
             self._check_acl("read-job", ns)
             ev = store.eval_by_id(m.group(1))
             if ev is None:
                 raise HTTPError(404, "eval not found")
-            self._respond(eval_to_dict(ev))
+            payload = eval_to_dict(ev)
+            if ev.failed_tg_allocs:
+                # mirror the plan API's full Nomad shape (snake_case
+                # struct fields stay for existing consumers)
+                from ..explain import alloc_metric_to_api
+
+                payload["FailedTGAllocs"] = {
+                    tg: alloc_metric_to_api(metric)
+                    for tg, metric in ev.failed_tg_allocs.items()
+                }
+            self._respond(payload)
             return True
 
         if path == "/v1/deployments" and method == "GET":

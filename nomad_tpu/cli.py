@@ -10,6 +10,7 @@
     nomad-tpu node eligibility -enable|-disable <id>
     nomad-tpu alloc status <alloc_id>
     nomad-tpu eval status <eval_id>
+    nomad-tpu eval explain <eval_id>           placement explanation
     nomad-tpu deployment status [id] | promote <id> | fail <id>
     nomad-tpu operator scheduler get-config|set-config [...]
     nomad-tpu system gc
@@ -757,6 +758,10 @@ def cmd_operator_debug(args) -> None:
         # eval flight recorder: recent full traces, so a bundle from a
         # misbehaving server carries per-eval stage/conflict evidence
         "traces.json": ("GET", "/v1/traces?full=1&limit=256"),
+        # placement explainability: recent per-eval score
+        # decompositions + filter attributions, cross-referenced with
+        # traces.json by eval id
+        "placements.json": ("GET", "/v1/placements?limit=256"),
         "monitor.json": ("GET", "/v1/agent/monitor"),
         "pprof-goroutine.json": ("GET", "/v1/agent/pprof/goroutine"),
         "pprof-heap.json": ("GET", "/v1/agent/pprof/heap"),
@@ -1201,6 +1206,92 @@ def cmd_eval_status(args) -> None:
     print(f"Status       = {ev['status']}")
     if ev.get("blocked_eval"):
         print(f"BlockedEval  = {ev['blocked_eval']}")
+
+
+def cmd_eval_explain(args) -> None:
+    """Render an eval's placement explanation
+    (GET /v1/evaluation/<id>/placement): winner, runners-up with
+    per-component score terms, and the top filter reasons."""
+    rec = _request(
+        "GET", f"/v1/evaluation/{args.eval_id}/placement"
+    )
+    if _emit(args, rec):
+        return
+    print(f"Eval         = {rec['EvalID']}")
+    print(f"Job ID       = {rec['JobID']}")
+    print(f"Type         = {rec['Type']} ({rec['TriggeredBy']})")
+    if rec.get("TraceID"):
+        print(f"Trace        = /v1/traces/{rec['EvalID']}")
+    for tg, g in (rec.get("TaskGroups") or {}).items():
+        metric = g.get("Metric") or {}
+        status = "FAILED" if g.get("Failed") else "placed"
+        print(
+            f"\nTask group {tg!r}: {g.get('Placed', 0)} {status}, "
+            f"{metric.get('NodesEvaluated', 0)} evaluated / "
+            f"{metric.get('NodesFiltered', 0)} filtered / "
+            f"{metric.get('NodesExhausted', 0)} exhausted"
+            + (
+                f" ({metric.get('CoalescedFailures')} coalesced)"
+                if metric.get("CoalescedFailures")
+                else ""
+            )
+        )
+        avail = metric.get("NodesAvailable") or {}
+        if avail:
+            print(
+                "Available    = "
+                + ", ".join(
+                    f"{dc}:{n}" for dc, n in sorted(avail.items())
+                )
+            )
+        if metric.get("AllocationTime"):
+            print(
+                f"AllocTime    = "
+                f"{metric['AllocationTime'] * 1000.0:.2f} ms"
+            )
+        winner = g.get("Winner", "")
+        meta = sorted(
+            metric.get("ScoreMetaData") or [],
+            key=lambda m: -m.get("NormScore", 0.0),
+        )
+        if meta:
+            rows = []
+            for m in meta:
+                scores = m.get("Scores") or {}
+                terms = ", ".join(
+                    f"{k}={v:.4f}"
+                    for k, v in sorted(scores.items())
+                    if k != "normalized-score"
+                )
+                rows.append(
+                    (
+                        ("*" if m["NodeID"] == winner else " ")
+                        + m["NodeID"][:8],
+                        f"{m.get('NormScore', 0.0):.4f}",
+                        terms,
+                    )
+                )
+            _table(rows, ["Node", "NormScore", "Score terms"])
+        reasons = sorted(
+            (metric.get("ConstraintFiltered") or {}).items(),
+            key=lambda kv: -kv[1],
+        )
+        exhausted = sorted(
+            (metric.get("DimensionExhausted") or {}).items(),
+            key=lambda kv: -kv[1],
+        )
+        if reasons or exhausted:
+            _table(
+                [
+                    (reason, n, "filtered")
+                    for reason, n in reasons[:8]
+                ]
+                + [
+                    (dim, n, "exhausted")
+                    for dim, n in exhausted[:8]
+                ],
+                ["Reason", "Nodes", "Kind"],
+            )
 
 
 def cmd_deployment(args) -> None:
@@ -1811,6 +1902,10 @@ def build_parser() -> argparse.ArgumentParser:
     evs.add_argument("eval_id")
     _add_fmt(evs)
     evs.set_defaults(fn=cmd_eval_status)
+    eve = ev_sub.add_parser("explain")
+    eve.add_argument("eval_id")
+    _add_fmt(eve)
+    eve.set_defaults(fn=cmd_eval_explain)
 
     dep = sub.add_parser("deployment")
     dep_sub = dep.add_subparsers(dest="action", required=True)

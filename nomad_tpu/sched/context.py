@@ -109,6 +109,7 @@ class EvalContext:
     ) -> None:
         self.state = state
         self.plan = plan
+        self._metric_seq = 0
         self.metrics = AllocMetric()
         self.eligibility = EvalEligibility()
         self.regex_cache: Dict = {}
@@ -124,7 +125,8 @@ class EvalContext:
 
     def reset(self) -> None:
         """Called between placements (reference context.go:116 Reset)."""
-        self.metrics = AllocMetric()
+        self._metric_seq += 1
+        self.metrics = AllocMetric(seq=self._metric_seq)
 
     def proposed_allocs(self, node_id: str) -> List[Allocation]:
         """(reference context.go:120 ProposedAllocs)"""

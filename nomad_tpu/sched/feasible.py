@@ -35,6 +35,9 @@ FILTER_CONSTRAINT_DRIVERS = "missing drivers"
 FILTER_CONSTRAINT_DEVICES = "missing devices"
 FILTER_CONSTRAINT_HOST_VOLUMES = "missing compatible host volumes"
 FILTER_CONSTRAINT_CSI_VOLUMES = "missing CSI plugins"
+FILTER_CONSTRAINT_NETWORK = "missing network"
+# the memoized-class short-circuit reason (FeasibilityWrapper)
+FILTER_CLASS_INELIGIBLE = "computed class ineligible"
 
 
 def resolve_target(target: str, node: Node) -> Tuple[Optional[str], bool]:
@@ -286,7 +289,7 @@ class NetworkChecker:
         for net in option.node_resources.networks:
             if (net.mode or "host") == self.network_mode:
                 return True
-        self.ctx.metrics.filter_node(option, "missing network")
+        self.ctx.metrics.filter_node(option, FILTER_CONSTRAINT_NETWORK)
         return False
 
 
@@ -521,7 +524,7 @@ class FeasibilityWrapper:
             job_escaped = job_unknown = False
             status = elig.job_status(option.computed_class)
             if status == CLASS_INELIGIBLE:
-                metrics.filter_node(option, "computed class ineligible")
+                metrics.filter_node(option, FILTER_CLASS_INELIGIBLE)
                 continue
             elif status == CLASS_ESCAPED:
                 job_escaped = True
@@ -544,7 +547,7 @@ class FeasibilityWrapper:
             tg_escaped = tg_unknown = False
             status = elig.task_group_status(self.tg, option.computed_class)
             if status == CLASS_INELIGIBLE:
-                metrics.filter_node(option, "computed class ineligible")
+                metrics.filter_node(option, FILTER_CLASS_INELIGIBLE)
                 continue
             elif status == CLASS_ELIGIBLE:
                 if self._available(option):

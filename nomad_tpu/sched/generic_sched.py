@@ -357,7 +357,14 @@ class GenericScheduler:
                 select_options = get_select_options(
                     prev_allocation, preferred_node
                 )
+                t_select = _time.monotonic()
                 option = self._select_next_option(tg, select_options)
+                # real per-TG allocation latency, reported by the plan
+                # API and /v1/evaluation/<id>/placement (reference
+                # structs.go AllocMetric.AllocationTime)
+                self.ctx.metrics.allocation_time_s = (
+                    _time.monotonic() - t_select
+                )
 
                 self.ctx.metrics.nodes_available = by_dc
 

@@ -231,13 +231,20 @@ class SystemScheduler:
         self._compute_placements(diff.place)
 
     def _compute_placements(self, place) -> None:
+        import time as _time
+
         node_by_id = {node.id: node for node in self.nodes}
         for missing in place:
             node = node_by_id.get(missing.alloc.node_id)
             if node is None:
                 continue
             self.stack.set_nodes([node])
+            t_select = _time.monotonic()
             option = self.stack.select(missing.task_group, None)
+            # per-TG allocation latency (AllocMetric.AllocationTime)
+            self.ctx.metrics.allocation_time_s = (
+                _time.monotonic() - t_select
+            )
 
             if option is None:
                 if self.ctx.metrics.nodes_filtered > 0:

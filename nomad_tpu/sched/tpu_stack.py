@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import jax
 import numpy as np
 
+from ..explain import EXPLAIN
 from ..ops.batch import BatchInputs, plan_picks_full, pow2_bucket
 from ..ops.constraints import MaskCompiler
 from ..ops.score import (
@@ -53,9 +54,24 @@ from ..structs import (
     CONSTRAINT_DISTINCT_PROPERTY,
     Job,
     Node,
+    NodeScoreMeta,
     TaskGroup,
 )
-from .context import EvalContext
+from .context import (
+    CLASS_ELIGIBLE,
+    CLASS_ESCAPED,
+    CLASS_INELIGIBLE,
+    CLASS_UNKNOWN,
+    EvalContext,
+)
+from .feasible import (
+    FILTER_CLASS_INELIGIBLE,
+    FILTER_CONSTRAINT_CSI_VOLUMES,
+    FILTER_CONSTRAINT_DEVICES,
+    FILTER_CONSTRAINT_DRIVERS,
+    FILTER_CONSTRAINT_HOST_VOLUMES,
+    FILTER_CONSTRAINT_NETWORK,
+)
 from .propertyset import PropertySet
 from .rank import BinPackIterator, RankedNode, StaticRankIterator
 from .stack import (
@@ -142,6 +158,13 @@ class TPUGenericStack:
         self._la_key: Optional[Tuple] = None
         self._la_counts: Tuple[int, int, int] = (0, 0, 0)
         self._la_generation = -1
+        # explain capture's shadow of the FeasibilityWrapper's
+        # computed-class memoization.  Deliberately NOT the shared
+        # EvalEligibility: that feeds blocked-eval unblocking, and an
+        # observability layer must never change scheduler behavior
+        # with its opt-out flag
+        self._explain_job_elig: Dict[str, int] = {}
+        self._explain_tg_elig: Dict[str, Dict[str, int]] = {}
 
     # ------------------------------------------------------------------
 
@@ -180,6 +203,8 @@ class TPUGenericStack:
         self._spread_psets.clear()
         self._spread_info.clear()
         self._sum_spread_weights = 0
+        self._explain_job_elig.clear()
+        self._explain_tg_elig.clear()
 
     # ------------------------------------------------------------------
 
@@ -283,6 +308,7 @@ class TPUGenericStack:
         pulls = self._la_pulls[self._la_idx]
         n_cand = len(self.candidate_rows)
         if row == NO_NODE:
+            self._capture_lookahead(tg, pulls)
             self._la_idx += 1
             if n_cand:
                 self._offset = (self._offset + pulls) % n_cand
@@ -295,10 +321,15 @@ class TPUGenericStack:
         option = self._verify_winner(node_id, tg)
         if option is None:
             # count-mask admitted a node exact assignment rejects:
-            # poison it and relaunch from current state
+            # poison it and relaunch from current state.  No explain
+            # capture here: the rejection's exhaustion was recorded by
+            # the verify chain, and the relaunch's walk captures this
+            # placement's full metrics with the row poisoned — the
+            # serial pull accounting exactly
             self._extra_excluded_rows.add(row)
             self._la_rows = None
             return _LA_MISS
+        self._capture_lookahead(tg, pulls)
         self._la_idx += 1
         if n_cand:
             self._offset = (self._offset + pulls) % n_cand
@@ -335,7 +366,7 @@ class TPUGenericStack:
 
         C = self.table.capacity
         self.ctx.reset()
-        static_mask = self._static_feasibility(tg)
+        checks, static_mask = self._static_checks(tg)
         candidate_mask = np.zeros(C, dtype=bool)
         candidate_mask[self.candidate_rows] = True
         d_cpu, d_mem, d_disk, collisions, job_rows, job_tg_rows = (
@@ -357,11 +388,15 @@ class TPUGenericStack:
             c.operand == CONSTRAINT_DISTINCT_HOSTS
             for c in tg.constraints
         )
+        dh_rows: Set[int] = set()
         if job_distinct:
-            mask[list(job_rows)] = False
+            dh_rows = {int(r) for r in job_rows}
         elif tg_distinct:
-            mask[list(job_tg_rows)] = False
-        mask &= self._distinct_property_mask(tg)
+            dh_rows = {int(r) for r in job_tg_rows}
+        if dh_rows:
+            mask[list(dh_rows)] = False
+        dp_mask, dp_psets = self._distinct_property_state(tg)
+        mask &= dp_mask
 
         penalty = np.zeros(C, dtype=bool)
         if options is not None and options.penalty_node_ids:
@@ -397,6 +432,11 @@ class TPUGenericStack:
         scores = np.full(C, -np.inf)
         feasible = mask & fit
         preempt_options: dict = {}
+        # rows the exact evict chain already evaluated (its metric
+        # side effects — exhaustion dims, binpack/preemption scores —
+        # land on ctx.metrics through the shared BinPackIterator, so
+        # the explain capture must not double-attribute them)
+        evict_checked: Set[int] = set()
         # vector fitness for fitting nodes (canonical f32-rounded pow)
         from ..structs.funcs import pow10_np
 
@@ -496,6 +536,7 @@ class TPUGenericStack:
                 or pre_disk < short_disk
             ):
                 continue  # provably cannot free enough
+            evict_checked.add(int(row))
             option = self._verify_winner(node_id, tg, evict=True)
             if option is None or option.preempted_allocs is None:
                 continue  # no viable preemption set: stays infeasible
@@ -532,6 +573,36 @@ class TPUGenericStack:
         rotated = np.concatenate(
             [cand[off:], cand[:off], rest]
         ).astype(np.int32)
+
+        def capture(pulls: int) -> None:
+            if not EXPLAIN.enabled:
+                return
+            self._capture_explain(
+                tg, rotated, pulls,
+                feasible_mask=mask,
+                used=(used_cpu, used_mem, used_disk),
+                asks=(ask_cpu, ask_mem, ask_disk),
+                collisions=collisions,
+                penalty=penalty,
+                affinity_vec=affinity_vec,
+                spread_vec=spread_vec,
+                has_affinities=has_affinities,
+                has_spreads=has_spreads,
+                spread_fit=spread_fit_alg,
+                checks=checks,
+                csi_mask=csi_mask,
+                dh_rows=dh_rows,
+                dp_mask=dp_mask,
+                dp_psets=dp_psets,
+                skip_rows={
+                    r for r in evict_checked
+                    if r not in preempt_options
+                },
+                preempt_scored={
+                    r: float(scores[r]) for r in preempt_options
+                },
+            )
+
         while True:
             chosen_row, _best, _n, pulls = jax.device_get(
                 _walk_only(
@@ -546,19 +617,23 @@ class TPUGenericStack:
             if chosen_row == NO_NODE:
                 if n_cand:
                     self._offset = (self._offset + pulls) % n_cand
+                capture(pulls)
                 self._populate_class_eligibility(tg, static_mask)
                 return None
             if chosen_row in preempt_options:
                 if n_cand:
                     self._offset = (self._offset + pulls) % n_cand
+                capture(pulls)
                 return preempt_options[chosen_row]
             node_id = self.table.node_ids[chosen_row]
             option = self._verify_winner(node_id, tg)
             if option is not None:
                 if n_cand:
                     self._offset = (self._offset + pulls) % n_cand
+                capture(pulls)
                 return option
             # exact-only dimensions failed: try with eviction
+            evict_checked.add(chosen_row)
             option = self._verify_winner(node_id, tg, evict=True)
             if option is not None and option.preempted_allocs:
                 terms = combine(chosen_row, list(option.scores))
@@ -589,7 +664,7 @@ class TPUGenericStack:
         C = self.table.capacity
         dtype = np.float64
 
-        static_mask = self._static_feasibility(tg)
+        checks, static_mask = self._static_checks(tg)
 
         candidate_mask = np.zeros(C, dtype=bool)
         candidate_mask[self.candidate_rows] = True
@@ -613,13 +688,17 @@ class TPUGenericStack:
         tg_distinct = any(
             c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints
         )
+        dh_rows: Set[int] = set()
         if job_distinct:
-            mask[list(job_rows)] = False
+            dh_rows = {int(r) for r in job_rows}
         elif tg_distinct:
-            mask[list(job_tg_rows)] = False
+            dh_rows = {int(r) for r in job_tg_rows}
+        if dh_rows:
+            mask[list(dh_rows)] = False
 
         # distinct_property (feasible.go:569)
-        mask &= self._distinct_property_mask(tg)
+        dp_mask, dp_psets = self._distinct_property_state(tg)
+        mask &= dp_mask
 
         penalty = np.zeros(C, dtype=bool)
         if options is not None and options.penalty_node_ids:
@@ -717,13 +796,16 @@ class TPUGenericStack:
             # poisoned row excluded
             return self._select_vectorized(tg, options)
 
+        used_cpu = self.table.cpu_used + d_cpu
+        used_mem = self.table.mem_used + d_mem
+        used_disk = self.table.disk_used + d_disk
         inputs = ScoreInputs(
             cpu_total=self.table.cpu_total,
             mem_total=self.table.mem_total,
             disk_total=self.table.disk_total,
-            cpu_used=self.table.cpu_used + d_cpu,
-            mem_used=self.table.mem_used + d_mem,
-            disk_used=self.table.disk_used + d_disk,
+            cpu_used=used_cpu,
+            mem_used=used_mem,
+            disk_used=used_disk,
             feasible=mask,
             collisions=collisions,
             penalty=penalty,
@@ -739,6 +821,29 @@ class TPUGenericStack:
         )
         spread_fit = spread_fit_alg
 
+        def capture(pulls: int) -> None:
+            if not EXPLAIN.enabled:
+                return
+            self._capture_explain(
+                tg, rotated, pulls,
+                feasible_mask=np.asarray(inputs.feasible),
+                used=(used_cpu, used_mem, used_disk),
+                asks=(ask_cpu, ask_mem, ask_disk),
+                collisions=collisions,
+                penalty=penalty,
+                affinity_vec=affinity_vec,
+                spread_vec=spread_vec,
+                has_affinities=has_affinities,
+                has_spreads=has_spreads,
+                spread_fit=spread_fit,
+                checks=checks,
+                csi_mask=csi_mask,
+                dh_rows=dh_rows,
+                dp_mask=dp_mask,
+                dp_psets=dp_psets,
+                skip_rows=self._extra_excluded_rows,
+            )
+
         while True:
             # one device->host sync for all outputs: device round trips
             # dominate per-select latency on tunneled hardware
@@ -749,6 +854,7 @@ class TPUGenericStack:
             if chosen_row == NO_NODE:
                 if n_cand:
                     self._offset = (self._offset + int(pulls)) % n_cand
+                capture(int(pulls))
                 self._populate_class_eligibility(tg, static_mask)
                 return None
             node_id = self.table.node_ids[chosen_row]
@@ -756,6 +862,7 @@ class TPUGenericStack:
             if option is not None:
                 if n_cand:
                     self._offset = (self._offset + int(pulls)) % n_cand
+                capture(int(pulls))
                 return option
             # count-mask admitted a node exact assignment rejects
             # (e.g. specific port collision): exclude and re-run; the
@@ -790,6 +897,335 @@ class TPUGenericStack:
         binpack.set_task_group(tg)
         return binpack.next()
 
+    # -- placement explainability (ISSUE 5) ----------------------------
+
+    def _capture_lookahead(self, tg: TaskGroup, pulls: int) -> None:
+        """Explain capture for a pick served from the look-ahead
+        cache, so the cache keeps its one-launch-per-group economics
+        with the recorder on.  The serve-path consistency checks
+        (same job version, table generation, plan advanced exactly as
+        the kernel modeled) guarantee a host-side recompute of the
+        plan-adjusted state sees precisely what the kernel's chained
+        carry saw for this pick; the serve preconditions (no
+        penalties, spreads, or distinct_property) zero the terms the
+        cache doesn't model."""
+        if not EXPLAIN.enabled:
+            return
+        C = self.table.capacity
+        checks, static_mask = self._static_checks(tg)
+        candidate_mask = np.zeros(C, dtype=bool)
+        candidate_mask[self.candidate_rows] = True
+        d_cpu, d_mem, d_disk, collisions, job_rows, job_tg_rows = (
+            self._plan_adjusted_state(tg)
+        )
+        mask = candidate_mask & static_mask & self.table.active
+        csi_mask = self._csi_feasibility(tg)
+        if csi_mask is not None:
+            mask &= csi_mask
+        job_distinct = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in self.job.constraints
+        )
+        tg_distinct = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in tg.constraints
+        )
+        dh_rows: Set[int] = set()
+        if job_distinct:
+            dh_rows = {int(r) for r in job_rows}
+        elif tg_distinct:
+            dh_rows = {int(r) for r in job_tg_rows}
+        if dh_rows:
+            mask[list(dh_rows)] = False
+        n_cand = len(self.candidate_rows)
+        cand = self.perm[:n_cand]
+        rest = self.perm[n_cand:]
+        off = self._offset % n_cand if n_cand else 0
+        rotated = np.concatenate(
+            [cand[off:], cand[:off], rest]
+        ).astype(np.int32)
+        affinity_vec = self._affinity_vector(tg)
+        has_affinities = bool(
+            list(self.job.affinities)
+            or list(tg.affinities)
+            or any(t.affinities for t in tg.tasks)
+        )
+        spread_fit = (
+            self.ctx.state.scheduler_config().effective_scheduler_algorithm()
+            == "spread"
+        )
+        self._capture_explain(
+            tg, rotated, int(pulls),
+            feasible_mask=mask,
+            used=(
+                self.table.cpu_used + d_cpu,
+                self.table.mem_used + d_mem,
+                self.table.disk_used + d_disk,
+            ),
+            asks=(
+                float(sum(t.resources.cpu for t in tg.tasks)),
+                float(sum(t.resources.memory_mb for t in tg.tasks)),
+                float(tg.ephemeral_disk.size_mb),
+            ),
+            collisions=collisions,
+            penalty=np.zeros(C, dtype=bool),
+            affinity_vec=affinity_vec,
+            spread_vec=np.zeros(C, dtype=np.float64),
+            has_affinities=has_affinities,
+            has_spreads=False,
+            spread_fit=spread_fit,
+            checks=checks,
+            csi_mask=csi_mask,
+            dh_rows=dh_rows,
+            dp_mask=np.ones(C, dtype=bool),
+            dp_psets=[],
+            skip_rows=self._extra_excluded_rows,
+        )
+
+    def _capture_explain(
+        self, tg: TaskGroup, rotated: np.ndarray, pulls: int, *,
+        feasible_mask, used, asks, collisions, penalty,
+        affinity_vec, spread_vec, has_affinities, has_spreads,
+        spread_fit, checks, csi_mask, dh_rows, dp_mask, dp_psets,
+        skip_rows=frozenset(), preempt_scored=None,
+    ) -> None:
+        """Reconstruct the serial iterator chain's AllocMetric from
+        the arrays this select already computed: the walk's `pulls`
+        bounds the evaluated prefix exactly as the reference's
+        StaticIterator would have, every feasible node in it gets the
+        per-component score decomposition (vector terms are
+        bit-identical to the kernel's, which is bit-identical to the
+        host chain's), fit failures get their first exhausted
+        dimension (superset order: cpu, memory, disk), and masked
+        nodes get first-failure attribution in FeasibilityWrapper
+        checker order — including the wrapper's computed-class
+        memoization ("computed class ineligible" after the first node
+        of a known-bad class, via the shared EvalEligibility).
+
+        ``skip_rows`` are rows whose metric side effects the exact
+        verification chain already recorded (poisoned winners, evict
+        re-evaluations); ``preempt_scored`` maps rows whose score was
+        spliced in by the preemption evaluation to their final
+        normalized score."""
+        from ..structs.funcs import pow10_np
+
+        metrics = self.ctx.metrics
+        metrics.nodes_evaluated += int(pulls)
+        if pulls <= 0:
+            return
+        evaluated = rotated[: int(pulls)]
+        used_cpu, used_mem, used_disk = used
+        ask_cpu, ask_mem, ask_disk = asks
+        fit = (
+            (used_cpu + ask_cpu <= self.table.cpu_total)
+            & (used_mem + ask_mem <= self.table.mem_total)
+            & (used_disk + ask_disk <= self.table.disk_total)
+        )
+        safe_cpu = np.where(
+            self.table.cpu_total > 0, self.table.cpu_total, 1.0
+        )
+        safe_mem = np.where(
+            self.table.mem_total > 0, self.table.mem_total, 1.0
+        )
+        free_cpu = 1.0 - (used_cpu + ask_cpu) / safe_cpu
+        free_mem = 1.0 - (used_mem + ask_mem) / safe_mem
+        base = pow10_np(free_cpu) + pow10_np(free_mem)
+        if spread_fit:
+            fitness = np.clip(base - 2.0, 0.0, 18.0)
+        else:
+            fitness = np.clip(20.0 - base, 0.0, 18.0)
+        preempt_scored = preempt_scored or {}
+        state = self.ctx.state
+        desired = float(tg.count)
+        # direct NodeScoreMeta writes via a node-id index:
+        # AllocMetric.score_node linearly scans score_meta per call,
+        # which goes quadratic when unlimited walks (affinities/
+        # spreads) score every candidate.  The index starts from the
+        # entries the exact verify chain already recorded (the winner)
+        meta_by_id = {m.node_id: m for m in metrics.score_meta}
+
+        def meta_for(node_id: str) -> NodeScoreMeta:
+            m = meta_by_id.get(node_id)
+            if m is None:
+                m = NodeScoreMeta(node_id=node_id)
+                metrics.score_meta.append(m)
+                meta_by_id[node_id] = m
+            return m
+
+        for r in (int(x) for x in evaluated):
+            if r in skip_rows:
+                continue
+            node = state.node_by_id(self.table.node_ids[r])
+            if node is None:
+                continue
+            if r in preempt_scored:
+                # binpack/devices/preemption terms were recorded by
+                # the exact evict chain; add the shared soft terms and
+                # the spliced normalized score
+                meta = meta_for(node.id)
+                self._record_soft_terms(meta.scores, r, collisions,
+                                        penalty, affinity_vec,
+                                        spread_vec, has_affinities,
+                                        has_spreads, desired,
+                                        terms=None)
+                meta.scores["normalized-score"] = preempt_scored[r]
+                meta.norm_score = preempt_scored[r]
+                continue
+            if feasible_mask[r] and fit[r]:
+                terms = [float(fitness[r]) / 18.0]
+                meta = meta_for(node.id)
+                meta.scores["binpack"] = terms[0]
+                self._record_soft_terms(meta.scores, r, collisions,
+                                        penalty, affinity_vec,
+                                        spread_vec, has_affinities,
+                                        has_spreads, desired,
+                                        terms=terms)
+                norm = sum(terms) / float(len(terms))
+                meta.scores["normalized-score"] = norm
+                meta.norm_score = norm
+                continue
+            if feasible_mask[r] and not fit[r]:
+                # resource exhaustion: first dimension in the serial
+                # superset order (structs.ComparableResources)
+                if used_cpu[r] + ask_cpu > self.table.cpu_total[r]:
+                    dim = "cpu"
+                elif used_mem[r] + ask_mem > self.table.mem_total[r]:
+                    dim = "memory"
+                else:
+                    dim = "disk"
+                metrics.exhausted_node(node, dim)
+                continue
+            self._attribute_filter(
+                node, r, tg, checks, csi_mask, dh_rows, dp_mask,
+                dp_psets,
+            )
+
+    def _record_soft_terms(
+        self, scores, r, collisions, penalty, affinity_vec,
+        spread_vec, has_affinities, has_spreads, desired, terms,
+    ) -> None:
+        """Record the rank chain's soft score components into one
+        node's scores dict under the serial iterators' exact
+        append/record conditions (rank.py: anti-affinity and
+        reschedule-penalty record 0 when inert; affinity/spread
+        record only non-zero).  Appends the *appended* terms to
+        ``terms`` when given (the normalization mean divides by the
+        append count, not the record count)."""
+        coll = int(collisions[r])
+        if coll > 0:
+            anti = -1.0 * float(coll + 1) / desired
+            if terms is not None:
+                terms.append(anti)
+            scores["job-anti-affinity"] = anti
+        else:
+            scores["job-anti-affinity"] = 0
+        if penalty[r]:
+            if terms is not None:
+                terms.append(-1.0)
+            scores["node-reschedule-penalty"] = -1
+        else:
+            scores["node-reschedule-penalty"] = 0
+        if not has_affinities:
+            scores["node-affinity"] = 0
+        elif affinity_vec[r] != 0.0:
+            aff = float(affinity_vec[r])
+            if terms is not None:
+                terms.append(aff)
+            scores["node-affinity"] = aff
+        if has_spreads and spread_vec[r] != 0.0:
+            sp = float(spread_vec[r])
+            if terms is not None:
+                terms.append(sp)
+            scores["allocation-spread"] = sp
+
+    def _explain_job_status(self, klass: str) -> int:
+        """The wrapper's job-level class status, answered from the
+        capture's SHADOW memoization (escape flags still come from
+        the shared eligibility — they are pure job-spec facts)."""
+        if self.ctx.eligibility.job_escaped or not klass:
+            return CLASS_ESCAPED
+        return self._explain_job_elig.get(klass, CLASS_UNKNOWN)
+
+    def _explain_tg_status(self, tg_name: str, klass: str) -> int:
+        if self.ctx.eligibility.tg_escaped.get(tg_name, False) or (
+            not klass
+        ):
+            return CLASS_ESCAPED
+        return self._explain_tg_elig.get(tg_name, {}).get(
+            klass, CLASS_UNKNOWN
+        )
+
+    def _attribute_filter(
+        self, node, row, tg, checks, csi_mask, dh_rows, dp_mask,
+        dp_psets,
+    ) -> None:
+        """Name the reason a masked node was masked, walking the same
+        checker order (and computed-class memoization) the serial
+        FeasibilityWrapper would — the reason strings are the shared
+        serial-chain vocabulary, never ad-hoc (lint-enforced by
+        tools/check_stage_accounting.py).  Memoization runs on a
+        shadow state private to the capture: the real EvalEligibility
+        drives blocked-eval unblocking and must not change with the
+        explain opt-out."""
+        metrics = self.ctx.metrics
+        klass = node.computed_class
+        status = self._explain_job_status(klass)
+        if status == CLASS_INELIGIBLE:
+            metrics.filter_node(node, FILTER_CLASS_INELIGIBLE)
+            return
+        job_escaped = status == CLASS_ESCAPED
+        job_unknown = status == CLASS_UNKNOWN
+        for mask, label, level in checks:
+            if level != "job":
+                continue
+            if not mask[row]:
+                if not job_escaped:
+                    self._explain_job_elig[klass] = CLASS_INELIGIBLE
+                metrics.filter_node(node, label)
+                return
+        if not job_escaped and job_unknown:
+            self._explain_job_elig[klass] = CLASS_ELIGIBLE
+        status = self._explain_tg_status(tg.name, klass)
+        if status == CLASS_INELIGIBLE:
+            metrics.filter_node(node, FILTER_CLASS_INELIGIBLE)
+            return
+        if status != CLASS_ELIGIBLE:
+            tg_escaped = status == CLASS_ESCAPED
+            tg_unknown = status == CLASS_UNKNOWN
+            for mask, label, level in checks:
+                if level != "tg":
+                    continue
+                if not mask[row]:
+                    if not tg_escaped:
+                        self._explain_tg_elig.setdefault(
+                            tg.name, {}
+                        )[klass] = CLASS_INELIGIBLE
+                    metrics.filter_node(node, label)
+                    return
+            if not tg_escaped and tg_unknown:
+                self._explain_tg_elig.setdefault(tg.name, {})[
+                    klass
+                ] = CLASS_ELIGIBLE
+        if csi_mask is not None and not csi_mask[row]:
+            metrics.filter_node(node, FILTER_CONSTRAINT_CSI_VOLUMES)
+            return
+        if row in dh_rows:
+            metrics.filter_node(node, CONSTRAINT_DISTINCT_HOSTS)
+            return
+        if dp_psets and not dp_mask[row]:
+            for pset in dp_psets:
+                ok, reason = pset.satisfies_distinct_properties(
+                    node, tg.name
+                )
+                if not ok:
+                    metrics.filter_node(node, reason)
+                    return
+            metrics.filter_node(node, CONSTRAINT_DISTINCT_PROPERTY)
+            return
+        # masked by a factor the serial source list never contains
+        # (vacant arena row, node deactivated mid-snapshot): nothing
+        # the serial chain would have named — leave unattributed
+
     # ------------------------------------------------------------------
 
     def _csi_feasibility(self, tg: TaskGroup) -> Optional[np.ndarray]:
@@ -812,52 +1248,76 @@ class TPUGenericStack:
             out &= col.codes != -1
         return out
 
-    def _static_feasibility(self, tg: TaskGroup) -> np.ndarray:
+    def _static_checks(self, tg: TaskGroup):
+        """Ordered ``(mask, label, level)`` triples in the serial
+        FeasibilityWrapper's exact checker order (stack.py
+        GenericStack: job constraints; then drivers, tg+task
+        constraints, host volumes, devices, network), plus the
+        combined AND with node eligibility folded in.  One structure
+        feeds both the select's feasibility mask and the explain
+        layer's per-node first-failure attribution, so the reason
+        vocabulary can never drift from the serial path's."""
         key = (self.job.id, self.job.version, tg.name, self.table.generation)
         cached = self._static_mask_cache.get(key)
         if cached is not None:
             return cached
         C = self.table.capacity
-        mask = self.table.eligible.copy()
+        checks: List[Tuple[np.ndarray, str, str]] = []
 
         for constraint in self.job.constraints:
             m = self.compiler.constraint_mask(constraint)
             if m is not None:
-                mask &= m
+                checks.append((m, str(constraint), "job"))
 
         constraints, drivers = task_group_constraints(tg)
+        if drivers:
+            driver_mask = np.ones(C, dtype=bool)
+            for driver in drivers:
+                col = self.table.column(f"driver.{driver}")
+                driver_mask &= col.codes != -1
+            checks.append(
+                (driver_mask, FILTER_CONSTRAINT_DRIVERS, "tg")
+            )
         for constraint in constraints:
             m = self.compiler.constraint_mask(constraint)
             if m is not None:
-                mask &= m
-        for driver in drivers:
-            col = self.table.column(f"driver.{driver}")
-            mask &= col.codes != -1
+                checks.append((m, str(constraint), "tg"))
         for name, req in tg.volumes.items():
             if req.type == "host":
                 col = self.table.column(f"hostvol.{req.source}")
                 if req.read_only:
-                    mask &= col.codes != -1
+                    m = col.codes != -1
                 else:
                     rw_code = col.interner.lookup("rw")
-                    mask &= col.codes == rw_code
+                    m = col.codes == rw_code
+                checks.append(
+                    (m, FILTER_CONSTRAINT_HOST_VOLUMES, "tg")
+                )
             # csi is handled dynamically in select(): volume records
             # and claim capacity change without a table-generation bump
-        if tg.networks:
-            mode = tg.networks[0].mode or "host"
-            if mode != "host":
-                col = self.table.column(f"netmode.{mode}")
-                mask &= col.codes != -1
-
         device_reqs = [
             req for task in tg.tasks for req in task.resources.devices
         ]
         dev_mask = self.compiler.device_feasibility(device_reqs)
         if dev_mask is not None:
-            mask &= dev_mask
+            checks.append((dev_mask, FILTER_CONSTRAINT_DEVICES, "tg"))
+        if tg.networks:
+            mode = tg.networks[0].mode or "host"
+            if mode != "host":
+                col = self.table.column(f"netmode.{mode}")
+                checks.append(
+                    (col.codes != -1, FILTER_CONSTRAINT_NETWORK, "tg")
+                )
 
-        self._static_mask_cache[key] = mask
-        return mask
+        combined = self.table.eligible.copy()
+        for m, _label, _level in checks:
+            combined &= m
+        cached = (checks, combined)
+        self._static_mask_cache[key] = cached
+        return cached
+
+    def _static_feasibility(self, tg: TaskGroup) -> np.ndarray:
+        return self._static_checks(tg)[1]
 
     # ------------------------------------------------------------------
 
@@ -1023,7 +1483,14 @@ class TPUGenericStack:
 
     # ------------------------------------------------------------------
 
-    def _distinct_property_mask(self, tg: TaskGroup) -> np.ndarray:
+    def _distinct_property_state(
+        self, tg: TaskGroup
+    ) -> Tuple[np.ndarray, List[PropertySet]]:
+        """Distinct-property feasibility mask plus the property sets
+        behind it — the mask drives the kernel; the psets let the
+        explain capture render the exact per-node reason string the
+        serial chain would (propertyset.py
+        satisfies_distinct_properties)."""
         C = self.table.capacity
         mask = np.ones(C, dtype=bool)
         constraints = [
@@ -1036,12 +1503,14 @@ class TPUGenericStack:
             if c.operand == CONSTRAINT_DISTINCT_PROPERTY
         ]
         if not constraints:
-            return mask
+            return mask, []
         from .feasible import target_column_key
 
+        psets: List[PropertySet] = []
         for constraint, scope in constraints:
             pset = PropertySet(self.ctx, self.job)
             pset.set_constraint(constraint, scope)
+            psets.append(pset)
             key = target_column_key(constraint.ltarget)
             if not key:
                 continue
@@ -1053,7 +1522,10 @@ class TPUGenericStack:
                 lut[i] = combined.get(value, 0) < allowed
             lut[-1] = False  # missing property fails
             mask &= lut[col.codes]
-        return mask
+        return mask, psets
+
+    def _distinct_property_mask(self, tg: TaskGroup) -> np.ndarray:
+        return self._distinct_property_state(tg)[0]
 
     # ------------------------------------------------------------------
 
@@ -1201,7 +1673,9 @@ class TPUSystemStack:
             mode = tg.networks[0].mode or "host"
             if mode != "host":
                 col = self.table.column(f"netmode.{mode}")
-                checks.append((col.codes != -1, "missing network"))
+                checks.append(
+                    (col.codes != -1, FILTER_CONSTRAINT_NETWORK)
+                )
 
         combined = np.ones(C, dtype=bool)
         for m, _label in checks:

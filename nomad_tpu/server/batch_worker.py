@@ -76,6 +76,7 @@ from ..structs import (
     Job,
     TaskGroup,
 )
+from ..explain import EXPLAIN
 from ..trace import TRACE
 from .worker import Worker
 
@@ -321,6 +322,10 @@ class _Speculation:
     job_fence: tuple = ()
     config_index: int = -1
     check_deployment: bool = False
+    # placement explanation built on the pool thread, published only
+    # if this speculation commits (a discarded speculation's replay
+    # never happened as far as the explain ring is concerned)
+    explain: Optional[Dict] = None
 
 
 class PrescoredStack:
@@ -444,6 +449,10 @@ class PrescoredStack:
             return self.inner.select(tg, options)
         if options is not None and options.preferred_nodes:
             raise _Deviation("preferred nodes need the sequential path")
+        # per-placement metric scope, like the serial chain's select
+        # (GenericStack.select -> ctx.reset): each placement's
+        # AllocMetric describes that placement, not the whole eval
+        self.ctx.reset()
         # skip picks of groups the scheduler has coalesced (their
         # first failure means no further selects for that group)
         while (
@@ -470,6 +479,14 @@ class PrescoredStack:
         row = self.rows[self.cursor]
         pick = self.cursor
         self.cursor += 1
+        if self.pulls is not None and pick < len(self.pulls):
+            # the chained kernel's per-pick source-pull count is
+            # exactly how many nodes the serial StaticIterator would
+            # have evaluated for this placement — recorded
+            # unconditionally so FailedTGAllocs on /v1/evaluation and
+            # the plan API report the same NodesEvaluated the serial
+            # path would, with or without the explain layer
+            self.ctx.metrics.nodes_evaluated += int(self.pulls[pick])
         if row < 0:
             # prescored failure: the chain's state past this eval is
             # suspect (the caller re-prescores).  Within THIS eval the
@@ -1598,6 +1615,7 @@ class BatchWorker(Worker):
         )
         scheduler.process(spec_ev)
         return _Speculation(
+            explain=EXPLAIN.build_record(spec_ev, scheduler),
             ops=planner.ops,
             strict_nodes=strict_nodes,
             # relaxed read set: the plan-touched nodes — their
@@ -1870,6 +1888,9 @@ class BatchWorker(Worker):
         job_ledger.add(key)
         self.evals_processed += 1
         TRACE.annotate(ev.id, outcome="speculative")
+        EXPLAIN.publish(
+            spec.explain, getattr(self.server, "metrics", None)
+        )
         self.server.broker.ack(ev.id, token)
         self._count("prescored")
         self._count_replay("speculative")
@@ -3665,6 +3686,9 @@ class BatchWorker(Worker):
         )
         self.evals_processed += 1
         TRACE.annotate(ev.id, outcome="prescored")
+        EXPLAIN.record_eval(
+            ev, scheduler, getattr(self.server, "metrics", None)
+        )
         self.server.broker.ack(ev.id, token)
         if made and made[0].entered_passthrough:
             self._count("preempt_passthroughs")

@@ -178,6 +178,13 @@ class Server:
             enabled=acl_enabled
         )
         self.metrics = Metrics()
+        # placement explainability: zero-register the placement.*
+        # counter/gauge families so dashboards see the whole reason
+        # vocabulary from process start (absence-of-series must mean
+        # absence-of-filtering, not "no eval explained yet")
+        from ..explain import preregister as _preregister_placement
+
+        _preregister_placement(self.metrics)
         # accelerator supervisor: owns device liveness (health probes,
         # launch watchdogs, hot CPU failover) for every worker.  Built
         # BEFORE the workers so they can subscribe to backend
@@ -1107,16 +1114,14 @@ class Server:
                 }
                 for tg, du in raw.items()
             }
+        from ..explain import alloc_metric_to_api
+
         failed = {}
         for e in recorder.evals:
             for tg, metric in (e.failed_tg_allocs or {}).items():
-                failed[tg] = {
-                    "NodesEvaluated": metric.nodes_evaluated,
-                    "NodesFiltered": metric.nodes_filtered,
-                    "NodesExhausted": metric.nodes_exhausted,
-                    "ConstraintFiltered": metric.constraint_filtered,
-                    "DimensionExhausted": metric.dimension_exhausted,
-                }
+                # full Nomad API AllocMetric shape (ScoreMetaData is
+                # top-K trimmed on this read)
+                failed[tg] = alloc_metric_to_api(metric)
         return {
             "Annotations": annotations,
             "FailedTGAllocs": failed,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Tuple
 
+from ..explain import EXPLAIN
 from ..sched import new_scheduler
 from ..state.store import StateSnapshot, StateStore
 from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_BLOCKED
@@ -130,6 +131,10 @@ class Worker:
                 (_time.monotonic() - start) * 1000.0,
             )
             metrics.incr("worker.evals_processed")
+        # placement explainability: retain this eval's per-TG score
+        # decomposition + filter attribution (/v1/evaluation/<id>/
+        # placement), cross-linked with its flight-recorder trace
+        EXPLAIN.record_eval(ev, scheduler, metrics)
         self.evals_processed += 1
         self.server.broker.ack(ev.id, token)
 

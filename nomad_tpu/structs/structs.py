@@ -1375,6 +1375,10 @@ class NodeScoreMeta:
 
 @dataclass
 class AllocMetric:
+    # monotone per-eval select sequence (EvalContext.reset stamps it):
+    # lets consumers pick the freshest placement's metric for a task
+    # group without relying on plan-collection iteration order
+    seq: int = 0
     nodes_evaluated: int = 0
     nodes_filtered: int = 0
     nodes_available: Dict[str, int] = field(default_factory=dict)  # dc -> count
@@ -1414,9 +1418,13 @@ class AllocMetric:
                 self.dimension_exhausted.get(dimension, 0) + 1
             )
 
+    # ScoreMetaData entries kept on any read/serialization surface
+    # (reference lib/kheap k=5)
+    SCORE_META_TOP_K = 5
+
     def score_node(self, node: Node, name: str, score: float) -> None:
-        # Top-K score metadata kept simple: record everything, trim on read
-        # (reference uses lib/kheap with k=5).
+        # Top-K score metadata kept simple: record everything, trim on
+        # read via top_score_meta (reference uses lib/kheap with k=5).
         for meta in self.score_meta:
             if meta.node_id == node.id:
                 meta.scores[name] = score
@@ -1432,6 +1440,40 @@ class AllocMetric:
         if not self.score_meta:
             return 0.0
         return max(m.norm_score for m in self.score_meta)
+
+    def node_norm_score(self, node_id: str) -> float:
+        for meta in self.score_meta:
+            if meta.node_id == node_id:
+                return meta.norm_score
+        return 0.0
+
+    def top_score_meta(
+        self, k: int = SCORE_META_TOP_K, winner_node_id: str = ""
+    ) -> List["NodeScoreMeta"]:
+        """The trim-on-read the score_node docstring promises: top-K
+        entries by norm_score (stable: earlier-scored wins ties), with
+        the actual winner always retained even when its normalized
+        score was not among the K best (preemption splices and walk
+        emission order can crown a non-maximal node).  The in-memory
+        list stays complete; every serialization surface reads through
+        here so score_meta can't ship 1k entries per eval."""
+        if len(self.score_meta) <= k:
+            return list(self.score_meta)
+        ranked = sorted(
+            range(len(self.score_meta)),
+            key=lambda i: (-self.score_meta[i].norm_score, i),
+        )
+        keep = set(ranked[:k])
+        if winner_node_id:
+            for i, meta in enumerate(self.score_meta):
+                if meta.node_id == winner_node_id and i not in keep:
+                    # the winner displaces the weakest kept entry
+                    keep.discard(ranked[k - 1])
+                    keep.add(i)
+                    break
+        return [
+            m for i, m in enumerate(self.score_meta) if i in keep
+        ]
 
 
 # ---------------------------------------------------------------------------

@@ -407,6 +407,12 @@ class Tracer:
 
     # -- reads ---------------------------------------------------------
 
+    def trace_id_of(self, eval_id: str) -> str:
+        """Current trace id for an eval (newest generation), "" when
+        untracked — the placement-explanation cross-link."""
+        trace = self._by_id.get(eval_id)
+        return trace.trace_id if trace is not None else ""
+
     def get(self, ref: str) -> Optional[Dict]:
         """Resolve a bare eval id (newest generation) OR a full
         trace id (``<eval_id>#<gen>``, as listed by /v1/traces) —

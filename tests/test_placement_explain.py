@@ -1,0 +1,678 @@
+"""Placement explainability (ISSUE 5).
+
+Serial-vs-vectorized AllocMetric parity: the same jobs/nodes through
+the oracle iterator chain and the kernel path must agree on
+nodes_evaluated, nodes_filtered, per-reason constraint_filtered
+totals, the exhaustion histograms, and the winner's normalized score
+— the explain capture reconstructs the serial chain's metrics from
+the kernel select's own outputs, so any drift is a bug.  Plus the
+retention ring, the HTTP/CLI surfaces, the top-K score-meta trim, and
+the zero-registered placement.* telemetry.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.explain import (
+    EXPLAIN,
+    PLACEMENT_COUNTERS,
+    PLACEMENT_GAUGES,
+    alloc_metric_to_api,
+    dimension_slug,
+    reason_slug,
+)
+from nomad_tpu.sched.feasible import (
+    FILTER_CLASS_INELIGIBLE,
+    FILTER_CONSTRAINT_CSI_VOLUMES,
+    FILTER_CONSTRAINT_DEVICES,
+    FILTER_CONSTRAINT_DRIVERS,
+    FILTER_CONSTRAINT_HOST_VOLUMES,
+    FILTER_CONSTRAINT_NETWORK,
+)
+from nomad_tpu.sched.generic_sched import BatchScheduler, ServiceScheduler
+from nomad_tpu.sched.testing import Harness
+from nomad_tpu.structs import (
+    AllocMetric,
+    Constraint,
+    NodeScoreMeta,
+    compute_node_class,
+)
+
+from conftest import heterogeneous_cluster
+
+
+def _placed_metrics(harness):
+    """alloc name -> metric summary tuple for the last computed plan
+    (read off the submitted plan so complete-failure runs, which
+    never submit, yield {})."""
+    out = {}
+    plans = harness.plans[-1:] if harness.plans else []
+    for plan in plans:
+        for v in plan.node_allocation.values():
+            for a in v:
+                m = a.metrics
+                out[a.name] = (
+                    m.nodes_evaluated,
+                    m.nodes_filtered,
+                    m.nodes_exhausted,
+                    dict(m.constraint_filtered),
+                    dict(m.class_filtered),
+                    dict(m.dimension_exhausted),
+                    m.node_norm_score(a.node_id),
+                )
+    return out
+
+
+def _failed_metrics(sched):
+    out = {}
+    for tg, m in sched.failed_tg_allocs.items():
+        out[tg] = (
+            m.nodes_evaluated,
+            m.nodes_filtered,
+            m.nodes_exhausted,
+            dict(m.constraint_filtered),
+            dict(m.class_filtered),
+            dict(m.dimension_exhausted),
+        )
+    return out
+
+
+def _score_meta(harness):
+    out = {}
+    plans = harness.plans[-1:] if harness.plans else []
+    for plan in plans:
+        for v in plan.node_allocation.values():
+            for a in v:
+                out[a.name] = sorted(
+                    (
+                        m.node_id,
+                        tuple(sorted(m.scores.items())),
+                        m.norm_score,
+                    )
+                    for m in a.metrics.score_meta
+                )
+    return out
+
+
+def run_both(harness, factory, evaluation, seed):
+    harness.reject_plan = True
+    s_oracle = harness.process(
+        factory, evaluation, use_tpu=False, seed=seed
+    )
+    oracle = (
+        _placed_metrics(harness),
+        _score_meta(harness),
+        _failed_metrics(s_oracle),
+    )
+    s_tpu = harness.process(
+        factory, evaluation, use_tpu=True, seed=seed
+    )
+    tpu = (
+        _placed_metrics(harness),
+        _score_meta(harness),
+        _failed_metrics(s_tpu),
+    )
+    return oracle, tpu
+
+
+# ---------------------------------------------------------------------------
+# serial-vs-vectorized metric parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_metric_parity_plain_service(harness, trial):
+    heterogeneous_cluster(harness, 50, seed=trial)
+    job = mock.job(datacenters=["dc1", "dc2"])
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    oracle, tpu = run_both(
+        harness, ServiceScheduler, ev, seed=trial * 17 + 3
+    )
+    assert oracle == tpu
+    # every placement recorded a full decomposition
+    assert all(
+        meta for meta in oracle[1].values()
+    ), "oracle recorded empty score meta"
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_metric_parity_constraint_filtering(harness, trial):
+    """Per-reason constraint_filtered totals — including the
+    computed-class memoization ('computed class ineligible' after the
+    first node of a known-bad class)."""
+    heterogeneous_cluster(harness, 50, seed=trial + 200)
+    job = mock.job(datacenters=["dc1", "dc2"])
+    job.constraints = [
+        Constraint("${attr.kernel.name}", "linux", "="),
+        Constraint("${attr.os.version}", "2[02].04", "regexp"),
+    ]
+    job.task_groups[0].constraints = [
+        Constraint("${attr.nomad.version}", ">= 0.9", "version"),
+        Constraint("${attr.rack}", "r4", "!="),
+    ]
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    oracle, tpu = run_both(
+        harness, ServiceScheduler, ev, seed=trial * 7 + 1
+    )
+    assert oracle == tpu
+    # the config actually exercised filtering
+    any_filtered = any(
+        t[1] > 0 for t in oracle[0].values()
+    )
+    assert any_filtered, "test config filtered nothing"
+
+
+def test_metric_parity_class_memoization(harness):
+    """All nodes share one computed class and fail a job constraint:
+    the serial wrapper filters the first node on the constraint and
+    the rest as 'computed class ineligible' — the capture must
+    reproduce both."""
+    nodes = []
+    for i in range(8):
+        n = mock.node()
+        n.attributes["rack"] = "r9"
+        n.computed_class = compute_node_class(n)
+        harness.store.upsert_node(n)
+        nodes.append(n)
+    # one eligible node with a distinct class so placement succeeds
+    good = mock.node()
+    good.attributes["rack"] = "r1"
+    good.node_class = "good"
+    good.computed_class = compute_node_class(good)
+    harness.store.upsert_node(good)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.constraints = [Constraint("${attr.rack}", "r9", "!=")]
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    oracle, tpu = run_both(harness, ServiceScheduler, ev, seed=5)
+    assert oracle == tpu
+    (metrics,) = oracle[0].values()
+    reasons = metrics[3]
+    if FILTER_CLASS_INELIGIBLE in reasons:
+        # at least one same-class node after the first was memoized
+        assert reasons[FILTER_CLASS_INELIGIBLE] >= 1
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_metric_parity_batch_multi_count(harness, trial):
+    """Batch multi-count jobs serve picks from the look-ahead cache
+    (one launch per group); the serve-side capture recomputes each
+    pick's plan-adjusted state host-side and must still match the
+    oracle placement-for-placement."""
+    heterogeneous_cluster(harness, 40, seed=trial + 100)
+    job = mock.batch_job(datacenters=["dc1", "dc2"])
+    job.task_groups[0].count = 7
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id, type="batch")
+    oracle, tpu = run_both(
+        harness, BatchScheduler, ev, seed=trial * 13 + 5
+    )
+    assert oracle == tpu
+
+
+def test_metric_parity_exhaustion_failure(harness):
+    """A job too big for every node: failed_tg_allocs must agree on
+    the full exhaustion histogram."""
+    heterogeneous_cluster(harness, 30, seed=7)
+    job = mock.job(datacenters=["dc1", "dc2"])
+    job.task_groups[0].tasks[0].resources.cpu = 100000
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    oracle, tpu = run_both(harness, ServiceScheduler, ev, seed=3)
+    assert oracle == tpu
+    failed = oracle[2]["web"]
+    assert failed[0] == 30  # every candidate evaluated
+    assert failed[5].get("cpu") == 30  # all exhausted on cpu
+
+
+def test_filter_totals_account_for_every_evaluated_node(harness):
+    """Acceptance criterion: filter-reason totals equal
+    nodes_evaluated - feasible_count (scored nodes + exhausted nodes
+    close the books)."""
+    heterogeneous_cluster(harness, 50, seed=31)
+    job = mock.job(datacenters=["dc1", "dc2"])
+    job.constraints = [Constraint("${attr.rack}", "r[0-2]", "regexp")]
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    harness.reject_plan = True
+    harness.process(ServiceScheduler, ev, use_tpu=True, seed=9)
+    for name, m in _placed_metrics(harness).items():
+        evaluated, filtered, exhausted = m[0], m[1], m[2]
+        assert sum(m[3].values()) == filtered
+        scored = 0
+        for v in harness.plans[-1].node_allocation.values():
+            for a in v:
+                if a.name == name:
+                    scored = len(a.metrics.score_meta)
+        assert filtered + exhausted == evaluated - scored
+
+
+def test_explain_disabled_skips_capture(harness):
+    """NOMAD_TPU_EXPLAIN=0: decisions identical, no vectorized-side
+    metric reconstruction (nodes_evaluated stays 0 on the kernel
+    path's successful selects)."""
+    heterogeneous_cluster(harness, 40, seed=3)
+    job = mock.batch_job(datacenters=["dc1", "dc2"])
+    job.task_groups[0].count = 5
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id, type="batch")
+    harness.reject_plan = True
+    harness.process(BatchScheduler, ev, use_tpu=False, seed=21)
+    oracle_placements = sorted(
+        (a.name, a.node_id)
+        for v in harness.plans[-1].node_allocation.values()
+        for a in v
+    )
+    EXPLAIN.set_enabled(False)
+    try:
+        harness.process(BatchScheduler, ev, use_tpu=True, seed=21)
+        tpu_placements = sorted(
+            (a.name, a.node_id)
+            for v in harness.plans[-1].node_allocation.values()
+            for a in v
+        )
+        assert oracle_placements == tpu_placements
+        evaluated = [
+            a.metrics.nodes_evaluated
+            for v in harness.plans[-1].node_allocation.values()
+            for a in v
+        ]
+        assert all(n == 0 for n in evaluated)
+    finally:
+        EXPLAIN.set_enabled(True)
+
+
+def test_allocation_time_stamped(harness):
+    heterogeneous_cluster(harness, 20, seed=1)
+    job = mock.job(datacenters=["dc1", "dc2"])
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    harness.reject_plan = True
+    harness.process(ServiceScheduler, ev, use_tpu=False, seed=1)
+    times = [
+        a.metrics.allocation_time_s
+        for v in harness.plans[-1].node_allocation.values()
+        for a in v
+    ]
+    assert times and all(t > 0.0 for t in times)
+
+
+# ---------------------------------------------------------------------------
+# top-K score-meta trim (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FakeNode:
+    def __init__(self, node_id):
+        self.id = node_id
+        self.node_class = ""
+
+
+def test_top_score_meta_trims_to_k():
+    m = AllocMetric()
+    for i in range(20):
+        m.score_node(_FakeNode(f"n{i:02d}"), "binpack", i * 0.01)
+        m.score_node(
+            _FakeNode(f"n{i:02d}"), "normalized-score", i * 0.01
+        )
+    top = m.top_score_meta()
+    assert len(top) == AllocMetric.SCORE_META_TOP_K
+    assert [t.node_id for t in top] == [
+        "n15", "n16", "n17", "n18", "n19"
+    ]
+    # in-memory list stays complete (trim is on read)
+    assert len(m.score_meta) == 20
+
+
+def test_top_score_meta_retains_winner():
+    m = AllocMetric()
+    for i in range(10):
+        m.score_node(
+            _FakeNode(f"n{i}"), "normalized-score", i * 0.1
+        )
+    top = m.top_score_meta(winner_node_id="n0")
+    assert len(top) == AllocMetric.SCORE_META_TOP_K
+    assert "n0" in {t.node_id for t in top}
+    # highest scorer still present
+    assert "n9" in {t.node_id for t in top}
+
+
+def test_top_score_meta_small_list_untouched():
+    m = AllocMetric()
+    m.score_meta.append(NodeScoreMeta(node_id="a", norm_score=1.0))
+    assert [s.node_id for s in m.top_score_meta()] == ["a"]
+
+
+def test_alloc_metric_to_api_shape():
+    m = AllocMetric()
+    m.nodes_evaluated = 3
+    m.filter_node(None, "missing drivers")
+    m.exhausted_node(None, "cpu")
+    for i in range(8):
+        m.score_node(
+            _FakeNode(f"n{i}"), "normalized-score", i * 0.1
+        )
+    api = alloc_metric_to_api(m, winner_node_id="n1")
+    for key in (
+        "NodesEvaluated", "NodesFiltered", "NodesAvailable",
+        "ClassFiltered", "ConstraintFiltered", "NodesExhausted",
+        "ClassExhausted", "DimensionExhausted", "QuotaExhausted",
+        "ScoreMetaData", "AllocationTime", "CoalescedFailures",
+    ):
+        assert key in api
+    assert len(api["ScoreMetaData"]) == AllocMetric.SCORE_META_TOP_K
+    assert "n1" in {s["NodeID"] for s in api["ScoreMetaData"]}
+
+
+# ---------------------------------------------------------------------------
+# reason vocabulary
+# ---------------------------------------------------------------------------
+
+
+def test_reason_slugs_cover_serial_vocabulary():
+    """Every serial-chain reason string folds into a non-'other' slug,
+    and every slug has a zero-registered counter."""
+    cases = {
+        FILTER_CLASS_INELIGIBLE: "class-ineligible",
+        FILTER_CONSTRAINT_DRIVERS: "missing-drivers",
+        FILTER_CONSTRAINT_DEVICES: "missing-devices",
+        FILTER_CONSTRAINT_HOST_VOLUMES: "missing-host-volumes",
+        FILTER_CONSTRAINT_CSI_VOLUMES: "missing-csi-plugins",
+        FILTER_CONSTRAINT_NETWORK: "missing-network",
+        "distinct_hosts": "distinct-hosts",
+        "distinct_property: rack=r1 used by 2 allocs": (
+            "distinct-property"
+        ),
+        'missing property "${meta.rack}"': "distinct-property",
+        "${attr.rack} = r4": "constraint",
+    }
+    for reason, slug in cases.items():
+        assert reason_slug(reason) == slug, reason
+        assert f"placement.filtered.{slug}" in PLACEMENT_COUNTERS
+    for dim, slug in {
+        "cpu": "cpu",
+        "memory": "memory",
+        "disk": "disk",
+        "network: port collision": "ports",
+        "reserved port collision": "ports",
+        "devices: no instances available": "devices",
+        "bandwidth exceeded": "bandwidth",
+    }.items():
+        assert dimension_slug(dim) == slug, dim
+        assert f"placement.exhausted.{slug}" in PLACEMENT_COUNTERS
+    assert "placement.score_spread" in PLACEMENT_GAUGES
+    assert "placement.winner_margin" in PLACEMENT_GAUGES
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: retention ring, endpoints, CLI, telemetry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def explain_world():
+    from nomad_tpu.api import start_http_server
+    from nomad_tpu.server import Server
+
+    server = Server(
+        num_schedulers=2, heartbeat_ttl=60.0, seed=33,
+        nack_timeout=5.0,
+    )
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    for _ in range(5):
+        server.register_node(mock.node())
+    job = mock.job(id="explainjob")
+    server.register_job(job)
+    assert server.drain_to_idle(20)
+    deadline = time.time() + 10
+    ev = None
+    while time.time() < deadline and ev is None:
+        for e in server.store.evals_by_job("default", "explainjob"):
+            if e.status == "complete":
+                ev = e
+        time.sleep(0.1)
+    assert ev is not None
+    yield {"server": server, "base": base, "eval": ev}
+    http.stop()
+    server.stop()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_placement_endpoint_breakdown(explain_world):
+    """A server-processed eval (whichever pipeline path took it) has
+    a retained per-TG breakdown with winner, availability and
+    evaluated accounting."""
+    base, ev = explain_world["base"], explain_world["eval"]
+    rec = _get(base, f"/v1/evaluation/{ev.id}/placement")
+    assert rec["EvalID"] == ev.id
+    assert rec["JobID"] == "explainjob"
+    tg = rec["TaskGroups"]["web"]
+    assert tg["Placed"] == 10
+    assert tg["Winner"]
+    m = tg["Metric"]
+    assert m["NodesEvaluated"] > 0
+    assert m["NodesAvailable"]  # by-dc histogram
+    assert m["AllocationTime"] > 0.0
+    assert 0 < len(m["ScoreMetaData"]) <= 5
+
+
+def test_placement_endpoint_kernel_path_acceptance(explain_world):
+    """Acceptance criterion: a kernel-path (TPUGenericStack)
+    placement's endpoint payload has per-component terms whose mean
+    (over appended terms, the documented normalization) equals the
+    recorded normalized score, and filter-reason totals equal
+    nodes_evaluated - feasible_count."""
+    base = explain_world["base"]
+    harness = Harness()
+    heterogeneous_cluster(harness, 40, seed=77)
+    job = mock.job(id="kernelexplain", datacenters=["dc1", "dc2"])
+    job.constraints = [
+        Constraint("${attr.rack}", "r[0-2]", "regexp")
+    ]
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    harness.reject_plan = True
+    scheduler = harness.process(
+        ServiceScheduler, ev, use_tpu=True, seed=41
+    )
+    # the ring is process-wide: record the kernel-path run and read
+    # it back through the server's HTTP surface
+    EXPLAIN.record_eval(ev, scheduler)
+    rec = _get(base, f"/v1/evaluation/{ev.id}/placement")
+    tg = rec["TaskGroups"]["web"]
+    m = tg["Metric"]
+    assert m["NodesEvaluated"] > 0
+    for sm in m["ScoreMetaData"]:
+        appended = [
+            v
+            for k, v in sm["Scores"].items()
+            if k != "normalized-score"
+            and not (
+                v == 0
+                and k in (
+                    "job-anti-affinity",
+                    "node-reschedule-penalty",
+                    "node-affinity",
+                )
+            )
+        ]
+        assert appended
+        assert abs(
+            sum(appended) / len(appended) - sm["NormScore"]
+        ) < 1e-12
+    # filter-reason totals equal nodes_evaluated - feasible_count
+    assert sum(m["ConstraintFiltered"].values()) == m["NodesFiltered"]
+    match = None
+    for v in scheduler.plan.node_allocation.values():
+        for a in v:
+            mm = a.metrics
+            if a.node_id == tg["Winner"] and (
+                mm.nodes_evaluated,
+                mm.nodes_filtered,
+                mm.nodes_exhausted,
+            ) == (
+                m["NodesEvaluated"],
+                m["NodesFiltered"],
+                m["NodesExhausted"],
+            ):
+                match = mm
+    assert match is not None
+    assert (
+        m["NodesFiltered"] + m["NodesExhausted"]
+        == m["NodesEvaluated"] - len(match.score_meta)
+    )
+
+
+def test_placement_listing_and_trace_cross_reference(explain_world):
+    base, ev = explain_world["base"], explain_world["eval"]
+    recents = _get(base, "/v1/placements?limit=16")
+    assert any(r["EvalID"] == ev.id for r in recents)
+    rec = _get(base, f"/v1/evaluation/{ev.id}/placement")
+    trace = _get(base, f"/v1/traces/{ev.id}")
+    assert rec["TraceID"] == trace["trace_id"]
+    assert (
+        trace["attrs"].get("placement")
+        == f"/v1/evaluation/{ev.id}/placement"
+    )
+
+
+def test_placement_endpoint_404_when_unknown(explain_world):
+    base = explain_world["base"]
+    try:
+        _get(base, "/v1/evaluation/no-such-eval/placement")
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 404
+    else:
+        raise AssertionError("expected 404")
+
+
+def test_eval_endpoint_full_failed_tg_shape(explain_world):
+    """/v1/evaluation/<id> mirrors the plan API's full Nomad
+    FailedTGAllocs shape for a blocked eval."""
+    server, base = explain_world["server"], explain_world["base"]
+    job = mock.job(id="toolarge")
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 10**9
+    server.register_job(job)
+    assert server.drain_to_idle(20)
+    blocked = [
+        e
+        for e in server.store.evals_by_job("default", "toolarge")
+        if e.failed_tg_allocs
+    ]
+    assert blocked
+    payload = _get(base, f"/v1/evaluation/{blocked[0].id}")
+    failed = payload["FailedTGAllocs"]["web"]
+    for key in (
+        "NodesEvaluated", "NodesFiltered", "ClassFiltered",
+        "ClassExhausted", "QuotaExhausted", "NodesAvailable",
+        "ScoreMetaData", "AllocationTime", "CoalescedFailures",
+        "DimensionExhausted", "ConstraintFiltered",
+    ):
+        assert key in failed
+    # the walk evaluated candidates before failing (exhaustion
+    # *attribution* depends on which pipeline path took the eval;
+    # the serial/kernel paths' histograms are covered by
+    # test_metric_parity_exhaustion_failure)
+    assert failed["NodesEvaluated"] > 0
+
+
+def test_plan_endpoint_full_failed_tg_shape(explain_world):
+    from nomad_tpu.api.codec import job_to_dict
+
+    base = explain_world["base"]
+    job = mock.job(id="planfail")
+    job.task_groups[0].tasks[0].resources.cpu = 10**9
+    body = json.dumps({"Job": job_to_dict(job)}).encode()
+    req = urllib.request.Request(
+        base + "/v1/job/planfail/plan",
+        data=body,
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        payload = json.loads(resp.read())
+    failed = payload["FailedTGAllocs"]["web"]
+    for key in (
+        "ClassFiltered", "ClassExhausted", "QuotaExhausted",
+        "NodesAvailable", "ScoreMetaData", "AllocationTime",
+        "CoalescedFailures",
+    ):
+        assert key in failed
+
+
+def test_placement_telemetry_zero_registered(explain_world):
+    server = explain_world["server"]
+    dump = server.metrics.dump()
+    for name in PLACEMENT_COUNTERS:
+        assert name in dump["counters"], name
+    for name in PLACEMENT_GAUGES:
+        assert name in dump["gauges"], name
+    assert dump["counters"]["placement.explained"] >= 1.0
+
+
+def test_cli_eval_explain_renders(explain_world, monkeypatch, capsys):
+    from nomad_tpu.cli import main
+
+    monkeypatch.setenv("NOMAD_ADDR", explain_world["base"])
+    main(["eval", "explain", explain_world["eval"].id])
+    out = capsys.readouterr().out
+    assert "Task group 'web'" in out
+    assert "NormScore" in out
+    # winner marker present
+    assert "*" in out
+
+
+def test_cli_eval_explain_json(explain_world, monkeypatch, capsys):
+    from nomad_tpu.cli import main
+
+    monkeypatch.setenv("NOMAD_ADDR", explain_world["base"])
+    main(["eval", "explain", "-json", explain_world["eval"].id])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["EvalID"] == explain_world["eval"].id
+
+
+def test_debug_bundle_captures_placements(
+    explain_world, monkeypatch, tmp_path
+):
+    import tarfile
+
+    from nomad_tpu.cli import main
+
+    monkeypatch.setenv("NOMAD_ADDR", explain_world["base"])
+    out = tmp_path / "bundle.tar.gz"
+    main(["operator", "debug", "-output", str(out)])
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+    assert "nomad-debug/placements.json" in names
+    assert "nomad-debug/traces.json" in names
+
+
+def test_explain_ring_bounded():
+    from nomad_tpu.explain import ExplainRecorder
+
+    rec = ExplainRecorder(ring=8)
+    rec.set_enabled(True)
+    for i in range(20):
+        rec.publish({"EvalID": f"e{i}", "TaskGroups": {}})
+    assert len(rec.recent(limit=100)) == 8
+    assert rec.get("e0") is None
+    assert rec.get("e19") is not None
+    # newest-wins per eval id: the superseded record leaves the
+    # listing too, not just the index
+    rec.publish({"EvalID": "e19", "TaskGroups": {}, "v": 2})
+    assert rec.get("e19")["v"] == 2
+    listed = [r for r in rec.recent(limit=100) if r["EvalID"] == "e19"]
+    assert len(listed) == 1 and listed[0]["v"] == 2

@@ -32,7 +32,24 @@ wholesale, would silently vanish from BENCH_*.json and /v1/metrics):
    the first incident;
 6. the operator debug bundle (``cli.py`` ``cmd_operator_debug``)
    captures ``/v1/device``, so a bundle from a degraded server always
-   carries the supervisor's state history.
+   carries the supervisor's state history;
+7. placement explainability (``nomad_tpu/explain.py``): every
+   ``placement.*`` metric name emitted is zero-registered — literal
+   names must appear in the ``PLACEMENT_COUNTERS``/
+   ``PLACEMENT_GAUGES`` registries, and f-string emissions may only
+   interpolate through the fixed ``reason_slug``/``dimension_slug``
+   vocabularies — and the server zero-registers the family at
+   construction;
+8. the vectorized path's filter-reason strings come from the shared
+   serial-chain constants: a string literal passed to
+   ``filter_node(...)`` in ``sched/tpu_stack.py`` must be one of the
+   ``FILTER_*`` constants' values (``sched/feasible.py``), and a
+   literal ``exhausted_node(...)`` dimension must be in the
+   ``allocs_fit`` superset vocabulary — ad-hoc strings would silently
+   drift from the serial path's vocabulary (and from the
+   ``placement.filtered.<slug>`` counter families keyed on it);
+9. the operator debug bundle captures ``/v1/placements`` so the
+   per-eval explanations travel with the traces they cross-reference.
 
 Run directly (exits non-zero on violation) or via the tier-1 test in
 ``tests/test_stage_accounting.py``.
@@ -56,6 +73,14 @@ BENCH = os.path.join(REPO, "bench.py")
 DEVICE_DIR = os.path.join(REPO, "nomad_tpu", "device")
 DEVICE_SUPERVISOR = os.path.join(DEVICE_DIR, "supervisor.py")
 CLI = os.path.join(REPO, "nomad_tpu", "cli.py")
+EXPLAIN_MOD = os.path.join(REPO, "nomad_tpu", "explain.py")
+TPU_STACK = os.path.join(REPO, "nomad_tpu", "sched", "tpu_stack.py")
+FEASIBLE = os.path.join(REPO, "nomad_tpu", "sched", "feasible.py")
+SERVER_MOD = os.path.join(REPO, "nomad_tpu", "server", "server.py")
+
+# allocs_fit / BinPackIterator exhaustion-dimension vocabulary a
+# literal exhausted_node() in the vectorized path may use
+EXHAUST_DIMENSIONS = {"cpu", "memory", "disk"}
 
 # the trace-recording call surface (nomad_tpu/trace.py Tracer)
 _TRACE_CALLS = {"span", "add_span", "event"}
@@ -206,6 +231,167 @@ def _device_module_paths() -> List[str]:
     )
 
 
+def _registry_tuple_names(tree: ast.AST, target_name: str) -> Set[str]:
+    """String constants reachable inside a module-level assignment
+    (handles the PLACEMENT_COUNTERS tuple-of-f-strings construction by
+    collecting the slug tuples it references too — callers pass the
+    pre-joined prefix checks separately)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == target_name
+            ):
+                return {
+                    n.value
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+    return set()
+
+
+def placement_metric_problems() -> List[str]:
+    """Check 7: placement.* emissions in explain.py stay inside the
+    zero-registered families.  Literal names must be registered
+    verbatim; f-string names may only be `placement.filtered.{...}` /
+    `placement.exhausted.{...}` with the slug produced by
+    reason_slug()/dimension_slug() (the fixed vocabularies)."""
+    problems: List[str] = []
+    tree = _parse(EXPLAIN_MOD)
+    counters = _registry_tuple_names(tree, "PLACEMENT_COUNTERS")
+    gauges = _registry_tuple_names(tree, "PLACEMENT_GAUGES")
+    filter_slugs = _registry_tuple_names(
+        tree, "PLACEMENT_FILTER_SLUGS"
+    )
+    exhaust_slugs = _registry_tuple_names(
+        tree, "PLACEMENT_EXHAUST_SLUGS"
+    )
+    if not (counters and gauges and filter_slugs and exhaust_slugs):
+        return [
+            "could not find the PLACEMENT_* registries in "
+            "nomad_tpu/explain.py"
+        ]
+    registered = (
+        counters
+        | gauges
+        | {f"placement.filtered.{s}" for s in filter_slugs}
+        | {f"placement.exhausted.{s}" for s in exhaust_slugs}
+    )
+    slug_fns = {"reason_slug", "dimension_slug"}
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("incr", "set_gauge", "add_sample")
+            and node.args
+        ):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(
+            arg.value, str
+        ):
+            if arg.value.startswith("placement.") and (
+                arg.value not in registered
+            ):
+                problems.append(
+                    f"placement metric {arg.value!r} emitted but not "
+                    "in the zero-registered PLACEMENT_* registries"
+                )
+            continue
+        if isinstance(arg, ast.JoinedStr):
+            prefix = ""
+            if arg.values and isinstance(arg.values[0], ast.Constant):
+                prefix = str(arg.values[0].value)
+            if not prefix.startswith("placement."):
+                continue
+            if prefix not in (
+                "placement.filtered.",
+                "placement.exhausted.",
+            ):
+                problems.append(
+                    f"dynamic placement metric prefix {prefix!r} has "
+                    "no zero-registered family"
+                )
+                continue
+            for part in arg.values[1:]:
+                if not isinstance(part, ast.FormattedValue):
+                    continue
+                call = part.value
+                ok = (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id in slug_fns
+                )
+                if not ok:
+                    problems.append(
+                        f"placement metric family {prefix!r} "
+                        "interpolates a value not produced by "
+                        "reason_slug()/dimension_slug() — the name "
+                        "space would be unbounded"
+                    )
+    with open(SERVER_MOD) as fh:
+        server_src = fh.read()
+    if "preregister" not in server_src or "explain" not in server_src:
+        problems.append(
+            "server.py no longer zero-registers the placement.* "
+            "families at construction (explain.preregister)"
+        )
+    return problems
+
+
+def reason_vocabulary_problems() -> List[str]:
+    """Check 8: reason-string literals used by the vectorized path
+    must come from the serial chain's shared vocabulary."""
+    problems: List[str] = []
+    feasible_tree = _parse(FEASIBLE)
+    allowed: Set[str] = set()
+    for node in ast.walk(feasible_tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id.startswith("FILTER_")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                allowed.add(node.value.value)
+    if not allowed:
+        return [
+            "could not find the FILTER_* reason constants in "
+            "sched/feasible.py"
+        ]
+    for node in ast.walk(_parse(TPU_STACK)):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+        ):
+            continue
+        literal = node.args[1].value
+        if node.func.attr == "filter_node" and literal not in allowed:
+            problems.append(
+                "ad-hoc filter reason literal in sched/tpu_stack.py: "
+                f"{literal!r} is not a shared FILTER_* constant value "
+                "(import the constant instead)"
+            )
+        if (
+            node.func.attr == "exhausted_node"
+            and literal not in EXHAUST_DIMENSIONS
+        ):
+            problems.append(
+                "ad-hoc exhaustion dimension literal in "
+                f"sched/tpu_stack.py: {literal!r} is outside the "
+                "allocs_fit superset vocabulary"
+            )
+    return problems
+
+
 def bench_exports_timings(tree: ast.AST, source: str) -> List[str]:
     """Problems with bench.py's stage export (empty list = ok)."""
     problems = []
@@ -301,11 +487,21 @@ def check() -> Tuple[bool, List[str]]:
         )
     with open(CLI) as fh:
         cli_src = fh.read()
-    if '"/v1/device"' not in cli_src.split("cmd_operator_debug", 1)[-1].split("def ", 1)[0]:
+    bundle_src = cli_src.split("cmd_operator_debug", 1)[-1].split(
+        "def ", 1
+    )[0]
+    if '"/v1/device"' not in bundle_src:
         problems.append(
             "the operator debug bundle (cli.cmd_operator_debug) no "
             "longer captures /v1/device"
         )
+    if "/v1/placements" not in bundle_src:
+        problems.append(
+            "the operator debug bundle (cli.cmd_operator_debug) no "
+            "longer captures /v1/placements"
+        )
+    problems.extend(placement_metric_problems())
+    problems.extend(reason_vocabulary_problems())
     with open(BENCH) as fh:
         bench_src = fh.read()
     problems.extend(bench_exports_timings(ast.parse(bench_src), bench_src))
