@@ -61,6 +61,10 @@ TG_COUNT = 10  # placements per eval
 E2E_JOBS = int(os.environ.get("BENCH_E2E_JOBS", 384))
 E2E_ORACLE_JOBS = int(os.environ.get("BENCH_E2E_ORACLE_JOBS", 48))
 PACED_JOBS = int(os.environ.get("BENCH_PACED_JOBS", 128))
+# paced-arrival latency sweep: jobs per offered-load point (3 points)
+SWEEP_JOBS = int(os.environ.get("BENCH_SWEEP_JOBS", 64))
+# offered load as fractions of the measured eval throughput
+SWEEP_FRACTIONS = (0.25, 0.5, 0.75)
 BATCH_E = 256
 BATCH_ROUNDS = 3
 SEED_BASE = 1000
@@ -169,10 +173,13 @@ def build_server(batch_pipeline):
 
 def run_stream(server, n_jobs, label, prefix, paced_rate=None):
     """Register n_jobs jobs, wait for the pipeline to drain, and return
-    (placements_per_sec, latencies_ms, placements_by_job).
+    (placements_per_sec, latencies_ms, placements_by_job,
+    latency_ms_by_eval_id).
 
     With paced_rate (evals/s), registrations are spaced to measure
-    service latency instead of burst queueing delay."""
+    service latency instead of burst queueing delay.  The per-eval-id
+    latency map keys are flight-recorder trace ids, so a sweep can
+    attach p99 exemplars that resolve on /v1/traces/<id>."""
     acks = {}
     submits = {}
     orig_ack = server.broker.ack
@@ -208,15 +215,18 @@ def run_stream(server, n_jobs, label, prefix, paced_rate=None):
         p = job_placements(server.store, f"{prefix}-{i}")
         placements[i] = p
         n_placed += len(p)
-    lat = sorted(
-        (acks[e] - submits[e]) * 1000.0 for e in acks if e in submits
-    )
+    lat_by_id = {
+        e: (acks[e] - submits[e]) * 1000.0
+        for e in acks
+        if e in submits
+    }
+    lat = sorted(lat_by_id.values())
     rate = n_placed / dt if dt > 0 else 0.0
     log(
         f"{label}: {n_jobs} evals, {n_placed} placements in {dt:.2f}s "
         f"-> {rate:.1f} placements/s"
     )
-    return rate, lat, placements
+    return rate, lat, placements, lat_by_id
 
 
 def pct(lat, q):
@@ -282,11 +292,63 @@ def cross_check_trace_stages(trace_stages, stage_times):
     return worst
 
 
+def latency_sweep(server, eval_rate):
+    """Offered-load vs latency curve (ROADMAP item 1: the <250 ms p99
+    target must be tracked per round, not one-off): three paced-
+    arrival phases at SWEEP_FRACTIONS of the measured eval
+    throughput, each reporting p50/p99 service latency plus the
+    flight-recorder trace ids of the evals at-or-past p99 — the
+    `latency_sweep` block in BENCH json, with exemplars that resolve
+    on /v1/traces/<id> (and in the bundled traces.json) so a slow
+    round is debuggable from its artifacts alone."""
+    from nomad_tpu.trace import TRACE
+
+    out = []
+    for s_i, frac in enumerate(SWEEP_FRACTIONS):
+        offered = max(1.0, eval_rate * frac)
+        _rate, lat, _p, lat_ids = run_stream(
+            server,
+            SWEEP_JOBS,
+            f"latency-sweep {frac:.2f}x ({offered:.1f} evals/s)",
+            f"sweep{s_i}",
+            paced_rate=offered,
+        )
+        p50, p99 = pct(lat, 0.50), pct(lat, 0.99)
+        # p99 exemplars: the slowest evals' trace ids (bounded), only
+        # ones the flight-recorder ring still holds
+        recorded = {
+            t["eval_id"] for t in TRACE.recent(limit=100_000)
+        }
+        exemplars = [
+            e
+            for e, ms in sorted(
+                lat_ids.items(), key=lambda kv: -kv[1]
+            )
+            if ms >= p99 and e in recorded
+        ][:3]
+        log(
+            f"  sweep {frac:.2f}x: offered={offered:.1f}/s "
+            f"p50={p50:.1f}ms p99={p99:.1f}ms "
+            f"exemplars={exemplars}"
+        )
+        out.append(
+            {
+                "offered_fraction": frac,
+                "offered_evals_per_sec": round(offered, 2),
+                "n_evals": len(lat),
+                "p50_ms": round(p50, 1),
+                "p99_ms": round(p99, 1),
+                "p99_trace_exemplars": exemplars,
+            }
+        )
+    return out
+
+
 def bench_e2e():
     # --- oracle side -----------------------------------------------------
     oracle = build_server(batch_pipeline=False)
     try:
-        oracle_rate, _lat, oracle_p = run_stream(
+        oracle_rate, _lat, oracle_p, _ids = run_stream(
             oracle, E2E_ORACLE_JOBS, "e2e-oracle", "e2e"
         )
     finally:
@@ -316,7 +378,7 @@ def bench_e2e():
 
         _trace.clear()
 
-        tpu_rate, _lat, tpu_p = run_stream(
+        tpu_rate, _lat, tpu_p, _ids = run_stream(
             tpu, E2E_JOBS, "e2e-tpu", "e2e"
         )
         stats = dict(worker.timings)
@@ -376,7 +438,7 @@ def bench_e2e():
 
         # --- paced phase for service latency ----------------------------
         paced_rate = max(2.0, tpu_rate / TG_COUNT * 0.8)
-        lat_rate, lat, _p = run_stream(
+        lat_rate, lat, _p, _lat_ids = run_stream(
             tpu,
             PACED_JOBS,
             f"e2e-tpu-paced ({paced_rate:.0f} evals/s offered)",
@@ -388,12 +450,16 @@ def bench_e2e():
             f"e2e-tpu paced latency: p50={p50:.1f}ms p99={p99:.1f}ms "
             f"({len(lat)} evals)"
         )
+
+        # --- offered-load latency sweep (3 rates) ------------------------
+        eval_rate = tpu_rate / TG_COUNT
+        sweep = latency_sweep(tpu, eval_rate)
     finally:
         tpu.stop()
     return (
         oracle_rate, tpu_rate, p50, p99, same, stats,
         prescore_share, replay_share, replay_conflict_rate,
-        replay_stats, trace_stages,
+        replay_stats, trace_stages, sweep,
     )
 
 
@@ -604,30 +670,44 @@ def _compare(label, build_nodes, build_jobs, n_oracle_jobs=None,
             if jobs and jobs[0].type != "system":
                 import copy as _copy
 
-                # two prime batches (single-eval and multi-chunk)
-                # compile this config's trace variants (spread/port/
-                # device columns) through the pipelined chunk launches
-                # — every production launch is one PIPELINE_CHUNK-wide
-                # slice — so nothing compiles inside the timed window;
-                # the
+                # prime batches compile this config's trace variants
+                # (spread/port/device columns) through the pipelined
+                # chunk launches at EVERY adaptive chunk width (the
+                # batch side pins the width per prime batch — gulp
+                # timing would otherwise make bucket coverage racy),
+                # so nothing compiles inside the timed window; the
                 # clones' placements join the parity contract and
                 # their capacity is returned before timing
                 # (desired-stop allocs are terminal for usage)
                 primes = []
-                for b, count in (("a", 1), ("b", 12)):
-                    batch = []
-                    for k in range(count):
-                        p = _copy.deepcopy(jobs[0])
-                        p.id = f"prime-{b}{k}-{jobs[0].id}"
-                        batch.append(p)
-                    _, pmap, _n = _run_jobs(
-                        server, batch, drain=600.0
-                    )
-                    primes.extend(batch)
-                    for p in batch:
-                        prime_by_side.setdefault(side, {})[
-                            p.id
-                        ] = pmap.get(p.id)
+                bw = server.workers[0] if batchy else None
+                orig_cw = bw._chunk_width if bw is not None else None
+                try:
+                    for b, count, width in (
+                        ("a", 1, 2), ("c", 3, 4), ("b", 12, 8)
+                    ):
+                        if bw is not None:
+                            bw._chunk_width = (
+                                lambda n, _w=width: min(
+                                    _w, bw.batch_max
+                                )
+                            )
+                        batch = []
+                        for k in range(count):
+                            p = _copy.deepcopy(jobs[0])
+                            p.id = f"prime-{b}{k}-{jobs[0].id}"
+                            batch.append(p)
+                        _, pmap, _n = _run_jobs(
+                            server, batch, drain=600.0
+                        )
+                        primes.extend(batch)
+                        for p in batch:
+                            prime_by_side.setdefault(side, {})[
+                                p.id
+                            ] = pmap.get(p.id)
+                finally:
+                    if bw is not None:
+                        bw._chunk_width = orig_cw
                 for p in primes:
                     server.deregister_job(
                         "default", p.id, purge=True
@@ -1268,7 +1348,7 @@ def main():
     (
         oracle_rate, tpu_rate, p50, p99, same, stage_times,
         prescore_share, replay_share, replay_conflict_rate,
-        replay_stats, trace_stages,
+        replay_stats, trace_stages, sweep,
     ) = bench_e2e()
     trace_overhead = (
         bench_trace_overhead() if WITH_TRACE_OVERHEAD else None
@@ -1303,6 +1383,10 @@ def main():
                 else 0.0,
                 "p99_eval_latency_ms": round(p99, 1),
                 "p50_eval_latency_ms": round(p50, 1),
+                # offered-load vs p50/p99 curve (3 paced rates) with
+                # flight-recorder trace-id exemplars at p99, so the
+                # <250 ms tail-latency target is tracked per round
+                "latency_sweep": sweep,
                 "oracle_e2e_placements_per_sec": round(oracle_rate, 1),
                 "parity_identical_evals": same,
                 "e2e_stage_times_s": {

@@ -1096,6 +1096,33 @@ def patch_rows(col, idx, vals):
     return col.at[idx].set(vals, mode="drop")
 
 
+_patch_rows_donated = None
+
+
+def patch_rows_donated():
+    """jit variant of `patch_rows` that donates the stale mirror
+    column: it is replaced in the caller's cache by the patched
+    output, so the old buffer is device memory the scatter can write
+    in place — with the chained-launch carry donation this makes the
+    steady-state sync path allocate nothing net on device.  (The
+    idx/vals staging uploads are NOT donated: their [width] shapes
+    can never alias the [C] output, so XLA could not honor it and
+    jax would warn on every width bucket.)  The caller
+    (BatchWorker._device_columns_locked) only selects this variant on
+    non-CPU backends, and only while no abandoned in-flight launch or
+    background shield compile could still be reading the column being
+    donated (it falls back to the copying `patch_rows` — and a full
+    re-upload — whenever that cannot be proven)."""
+    global _patch_rows_donated
+    if _patch_rows_donated is None:
+        fn = jax.jit(
+            patch_rows.__wrapped__, donate_argnums=(0,)
+        )
+        fn.__name__ = "patch_rows_donated"
+        _patch_rows_donated = fn
+    return _patch_rows_donated
+
+
 @functools.partial(
     jax.jit, static_argnames=("n_picks", "spread_fit")
 )

@@ -13,9 +13,18 @@ section 7.3).  The BatchWorker instead:
 3. *prescores* the run through a three-stage pipeline — assemble
    (host numpy staging into a chunk-aligned arena), launch
    (non-blocking `chained_plan_picks_cols` dispatches of
-   PIPELINE_CHUNK-wide slices, each chained on the previous chunk's
+   chunk-wide slices, each chained on the previous chunk's
    device-resident carry), fetch (deferred device_get) — so chunk N
-   executes on device while the host replays chunk N-1.  Every eval's
+   executes on device while the host replays chunk N-1.  The chunk
+   width is adapted per flush from the measured launch EWMAs
+   (CHUNK_BUCKETS compiled-shape ladder: wide under backlog, narrow
+   when latency-bound), and the chain stays OPEN while it is in
+   flight: evals dequeued while chunk N launches or replays are
+   gated, simulated against the chain snapshot and assembled into
+   chunk N+1 of the *same* chain (continuous micro-batching — see
+   docs/ARCHITECTURE.md "Continuous micro-batching";
+   NOMAD_TPU_ADMIT=0 restores the flush-boundary gulp loop).  Every
+   eval's
    full pick sequence runs with in-kernel plan-delta accumulation
    (pre-placement usage deltas, per-pick destructive evictions,
    per-pick penalty rows, failure coalescing) and the same seeded
@@ -84,13 +93,32 @@ BATCH_MAX = 64
 BATCH_WAIT_S = 0.005
 MAX_PENALTY_NODES = 8  # per-pick penalty row slots in StepDeltas
 MAX_PRE_ROWS = 512  # pre-placement delta rows before falling back
-# eval-axis width of one pipelined prescore launch: every run is
-# sliced into chunks of this size chained through the kernel's carry
-# output, so ALL production launches share ONE eval-axis trace bucket
-# (padding waste is < CHUNK evals per run instead of up to
+# eval-axis widths of one pipelined prescore launch: every run is
+# sliced into chunks chained through the kernel's carry output, so
+# production launches share a SMALL set of eval-axis trace buckets
+# (padding waste is < one chunk per run instead of up to
 # BATCH_MAX - 1) and chunk N's device time overlaps chunk N-1's host
-# replay
-PIPELINE_CHUNK = 8
+# replay.  The width is chosen per flush from the measured launch
+# EWMAs (_plan_chunk_width): the widest bucket under backlog (fewer
+# dispatches), a narrow one when latency-bound (the first replay —
+# and the first mid-chain admission point — arrives after ONE chunk's
+# device time, not eight evals' worth).  Restricting widths to this
+# ladder keeps the number of XLA trace shapes bounded exactly like
+# the old fixed width did.
+CHUNK_BUCKETS = (2, 4, 8)
+# widest chunk bucket, kept under its historical name: the assembly
+# arena, warm_shapes and the mesh path still use it as the default
+# eval-axis alignment
+PIPELINE_CHUNK = CHUNK_BUCKETS[-1]
+# continuous micro-batching counters, zero-registered at Server
+# construction (tools/check_stage_accounting.py check 10): every
+# `admission.*` name the worker emits must appear here, so dashboards
+# can tell "admission never engaged" from "admission not exported"
+ADMISSION_COUNTERS = (
+    "admission.admitted",
+    "admission.deferred",
+    "admission.chains",
+)
 # optimistic parallel replay: below this many prescored evals in a run
 # the speculative-wave dispatch overhead beats the win
 REPLAY_MIN_WAVE = 2
@@ -219,9 +247,10 @@ class _Assembled:
     """One admitted chain's kernel inputs, staged host-side by
     ``_assemble`` (the pipeline's first stage).  Every per-eval array
     carries a leading eval axis of ``E`` rows — ``E_real`` real evals
-    padded up to a multiple of PIPELINE_CHUNK with inert rows
+    padded up to a multiple of ``chunk`` with inert rows
     (wanted=0, n_cand=1) — so the launch stage can slice
-    PIPELINE_CHUNK-wide chunks that all share one trace bucket."""
+    ``chunk``-wide slices that all share one trace bucket per
+    width."""
 
     E_real: int
     E: int
@@ -249,6 +278,57 @@ class _Assembled:
     host_cols: tuple = ()
     dev_cols: Optional[tuple] = None
     use_mesh: bool = False
+    # eval-axis width this arena's E was aligned to (one launch =
+    # one `chunk`-wide slice); chosen per flush by _plan_chunk_width
+    chunk: int = PIPELINE_CHUNK
+
+
+class _AdmissionQueue:
+    """Mid-chain eval intake for the continuous micro-batching
+    pipeline: while a chunk chain is in flight, the worker polls the
+    broker through one of these (non-blocking) and admits gate-clean
+    evals as new chunks of the SAME chain.
+
+    FIFO discipline is absolute — the chain commits its members in
+    dequeue order, so an eval that fails an admission gate cannot be
+    skipped over: it is parked on ``deferred`` (the worker holds its
+    broker lease) and the queue CLOSES, guaranteeing no later dequeue
+    jumps the serial order.  The caller processes ``deferred`` as the
+    next gulp once the chain completes."""
+
+    __slots__ = ("worker", "deferred", "closed", "admitted_any")
+
+    def __init__(self, worker) -> None:
+        self.worker = worker
+        self.deferred: List[Tuple[Evaluation, str]] = []
+        self.closed = False
+        self.admitted_any = False
+
+    def poll(self, limit: int) -> List[Tuple[Evaluation, str]]:
+        """Dequeue up to ``limit`` already-queued evals without
+        waiting (an empty broker ends the round, never blocks the
+        chain)."""
+        out: List[Tuple[Evaluation, str]] = []
+        if self.closed or limit <= 0:
+            return out
+        worker = self.worker
+        broker = worker.server.broker
+        while len(out) < limit:
+            try:
+                ev, token = broker.dequeue(
+                    worker.schedulers, timeout=0.0
+                )
+            except Exception:  # noqa: BLE001 — intake is best-effort
+                break
+            if ev is None:
+                break
+            worker._note_dequeue(ev)
+            out.append((ev, token))
+        return out
+
+    def defer(self, ev: Evaluation, token: str) -> None:
+        self.deferred.append((ev, token))
+        self.closed = True
 
 
 class _SpecPlanner:
@@ -634,8 +714,41 @@ class BatchWorker(Worker):
             )
         except ValueError:
             self.latency_budget_ms = 250.0
-        self._launch_ewma: Dict[int, float] = {}  # E bucket -> ms
+        # per-chunk launch cost (dispatch + the blocking fetch wait),
+        # keyed by chunk WIDTH bucket (CHUNK_BUCKETS) — the adaptive
+        # gulp cap and the per-flush chunk-width policy both read it
+        self._launch_ewma: Dict[int, float] = {}  # chunk width -> ms
+        # first measured warm launch, used as the default estimate for
+        # buckets with no samples yet (replacing the old 50.0 ms
+        # constant, which misestimated both a laptop CPU backend and a
+        # tunneled TPU by an order of magnitude in opposite directions)
+        self._launch_ewma_seed: Optional[float] = None
         self._replay_ewma_ms = 5.0
+        # continuous micro-batching (NOMAD_TPU_ADMIT=0 restores the
+        # flush-boundary gulp loop): evals dequeued while a chunk
+        # chain is in flight are admitted into that chain's next chunk
+        # when the admission gates prove they would see exactly the
+        # state a fresh gulp would
+        self.admit_enabled = (
+            _os.environ.get("NOMAD_TPU_ADMIT", "1") != "0"
+        )
+        self.admission_admitted = 0
+        self.admission_deferred = 0
+        self.admission_chains = 0
+        # evals dequeued mid-chain but gated out of it: processed as
+        # the next gulp (run() drains this after every batch) so FIFO
+        # order with their chain is preserved
+        self._deferred: List[Tuple[Evaluation, str]] = []
+        # broker leases taken by mid-chain admission this batch:
+        # run()'s crash handler nacks these too (they are in neither
+        # the original gulp nor _deferred), so a crash between
+        # admission and ack can't strand a lease — and with it every
+        # later same-job eval — until the broker's nack timeout
+        self._admitted_live: List[Tuple[Evaluation, str]] = []
+        # abandoned in-flight launches (wedge/failover/fetch error)
+        # may still be reading the device usage mirror: the next
+        # mirror sync must re-upload instead of donating the buffers
+        self._mirror_dirty = False
         # host-assembly caches keyed by the node table's topology
         # generation (usage churn does NOT invalidate them): candidate
         # row layout per datacenter set, static feasibility /
@@ -711,6 +824,7 @@ class BatchWorker(Worker):
         self.timings = {
             "simulate": 0.0,
             "assemble": 0.0,
+            "admit": 0.0,
             "launch": 0.0,
             "fetch": 0.0,
             "replay": 0.0,
@@ -795,6 +909,12 @@ class BatchWorker(Worker):
         # raises RuntimeError there, a fresh dict does not
         self._sharded_runners = {}
         self._launch_ewma = {}
+        # the seed measurement came from the OLD backend — a TPU's
+        # first warm launch says nothing about the CPU fallback's
+        self._launch_ewma_seed = None
+        # in-flight launches abandoned by the flip may still read the
+        # mirror; force the next sync to re-upload (no donation)
+        self._mirror_dirty = True
         # donation only helps off-CPU; re-resolve for the new target
         self._donate_carries = None
         if sup.failed_over():
@@ -845,15 +965,16 @@ class BatchWorker(Worker):
             )
 
     def _observe_chunk(
-        self, stage: str, run, idx: int, c0: int, c1_real: int,
+        self, stage: str, run, base: int, c0: int, c1_real: int,
         t0: float, dt: float, **attrs,
     ) -> None:
         """Observe a chunk-wide stage interval and attribute it to
         every member eval's trace: first member as the metrics
         exemplar, and a per-member span carrying its chain position
         plus the membership count (so trace aggregations can divide
-        the shared dt back out to match the timings accounting)."""
-        chunk_evs = [run[idx + e][0] for e in range(c0, c1_real)]
+        the shared dt back out to match the timings accounting).
+        ``base`` is the run index of the chunk's arena's eval 0."""
+        chunk_evs = [run[base + e][0] for e in range(c0, c1_real)]
         self._observe(
             stage, dt,
             exemplar=chunk_evs[0].id if chunk_evs else None,
@@ -900,6 +1021,17 @@ class BatchWorker(Worker):
         if metrics is not None:
             metrics.incr(f"replay.{kind}")
 
+    def _count_admission(self, kind: str) -> None:
+        """Continuous micro-batching counters, exported under the
+        `admission.` namespace on /v1/metrics (admitted | deferred |
+        chains; the family is zero-registered at Server construction
+        from ADMISSION_COUNTERS)."""
+        attr = f"admission_{kind}"
+        setattr(self, attr, getattr(self, attr) + 1)
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.incr(f"admission.{kind}")
+
     def _export_adaptive_gauges(self) -> None:
         """The adaptive-cap inputs as /v1/metrics gauges, so an
         operator can see WHY `_adaptive_cap` picked a gulp size (the
@@ -943,15 +1075,98 @@ class BatchWorker(Worker):
 
     # ------------------------------------------------------------------
 
+    def _chunk_buckets(self) -> tuple:
+        """The compiled-shape chunk-width ladder, clamped to the
+        operator's batch ceiling (a NOMAD_TPU_BATCH_MAX below the
+        widest bucket must never mint launches wider than a gulp can
+        be)."""
+        buckets = tuple(
+            w for w in CHUNK_BUCKETS if w <= self.batch_max
+        )
+        return buckets or (self.batch_max,)
+
+    def _launch_cost_ms(self, width: int) -> float:
+        """Estimated cost of one ``width``-wide chunk launch (dispatch
+        + blocking fetch): the measured EWMA for that bucket, the
+        first warm launch observed on this backend for buckets with no
+        samples yet, or 50 ms before anything has been measured."""
+        default = (
+            self._launch_ewma_seed
+            if self._launch_ewma_seed is not None
+            else 50.0
+        )
+        return self._launch_ewma.get(width, default)
+
+    def _note_launch_cost(self, width: int, ms: float) -> None:
+        """Feed one chunk's measured device-path cost into the
+        adaptive sizing loop (and seed the default estimate from the
+        first warm measurement).  A sample an order of magnitude past
+        the latency budget is a synchronous cold XLA compile billed to
+        the launch (NOMAD_TPU_SYNC_COMPILE, or a first donated-variant
+        execution), not a launch cost — seeding or averaging it in
+        would collapse the cap/width policy to the smallest bucket
+        for hundreds of flushes, so it is dropped."""
+        ceiling = 20.0 * max(self.latency_budget_ms, 50.0)
+        if ms > ceiling:
+            return
+        if self._launch_ewma_seed is None:
+            self._launch_ewma_seed = ms
+        prev = self._launch_ewma.get(width)
+        self._launch_ewma[width] = (
+            ms if prev is None else 0.8 * prev + 0.2 * ms
+        )
+
+    def _plan_chunk_width(self, n_evals: int, backlog: int) -> int:
+        """Chunk width for a flush of ``n_evals`` given the backlog.
+
+        Saturated (or latency budget off): the widest bucket — fewer
+        dispatches, queueing dominates latency anyway.  Keeping up:
+        the smallest bucket covering the flush in one launch (a 1-2
+        eval interactive flush must not pay an 8-wide kernel), and for
+        bigger flushes the widest bucket UNLESS its measured launch
+        cost alone would eat over half the latency budget — then one
+        bucket narrower, so the first replay (and the first mid-chain
+        admission point) lands after a fraction of the budget instead
+        of all of it."""
+        buckets = self._chunk_buckets()
+        widest = buckets[-1]
+        if self.latency_budget_ms <= 0 or backlog >= self.batch_max:
+            return widest
+        for w in buckets:
+            if n_evals <= w:
+                return w
+        if len(buckets) > 1 and self._launch_cost_ms(widest) > (
+            self.latency_budget_ms / 2.0
+        ):
+            return buckets[-2]
+        return widest
+
+    def _chunk_width(self, n_evals: int) -> int:
+        """Per-flush chunk width (reads the live backlog), exported as
+        the ``batch_worker.chunk_width`` gauge."""
+        try:
+            backlog = self.server.broker.ready_count(self.schedulers)
+        except Exception:  # noqa: BLE001 — sizing is best-effort
+            backlog = self.batch_max
+        width = self._plan_chunk_width(n_evals, backlog)
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.set_gauge("batch_worker.chunk_width", width)
+        return width
+
     def _adaptive_cap(self) -> int:
         """Batch size for this gulp, from measured latency + backlog.
 
-        Keeping up (backlog < a full batch): pick the LARGEST trace
-        bucket whose estimated last-eval latency — launch EWMA for
-        that bucket + per-eval replay EWMA x evals ahead — fits the
-        budget; the smallest bucket when none does.  Saturated:
-        the full batch (queueing dominates latency anyway, amortizing
-        the launch maximizes drain rate)."""
+        Keeping up (backlog < a full batch): pick the LARGEST
+        candidate whose estimated last-eval latency — chunk launches
+        at that gulp size (the live chunk-width ladder's cost EWMAs)
+        plus per-eval replay EWMA x evals ahead — fits the budget; the
+        smallest candidate when none does.  Saturated: the full batch
+        (queueing dominates latency anyway, amortizing the launch
+        maximizes drain rate).  Candidates are the chunk-size buckets
+        themselves plus the operator ceiling, so the cap can drop all
+        the way to a 2-eval gulp when even one narrow launch barely
+        fits the budget."""
         if self.latency_budget_ms <= 0:
             return self.batch_max
         try:
@@ -960,19 +1175,18 @@ class BatchWorker(Worker):
             return self.batch_max
         if backlog >= self.batch_max:
             return self.batch_max
-        # gulp-size candidates, never above the operator's configured
-        # ceiling; launch EWMAs are keyed by the TRACE bucket the
-        # prescore pads to (8 or module BATCH_MAX), which is what a
-        # gulp of that size actually costs
+        # gulp-size candidates, derived from the live chunk-width
+        # ladder and never above the operator's configured ceiling
         candidates = sorted(
-            {min(8, self.batch_max), self.batch_max}
+            set(self._chunk_buckets()) | {self.batch_max}
         )
         cap = candidates[0]
         for c in candidates:
-            bucket = 8 if c <= 8 else BATCH_MAX
-            est = self._launch_ewma.get(
-                bucket, 50.0
-            ) + min(c, backlog + 1) * self._replay_ewma_ms
+            width = self._plan_chunk_width(c, backlog)
+            launches = -(-c // width)
+            est = launches * self._launch_cost_ms(width) + min(
+                c, backlog + 1
+            ) * self._replay_ewma_ms
             if est <= self.latency_budget_ms:
                 cap = c
         metrics = getattr(self.server, "metrics", None)
@@ -993,31 +1207,49 @@ class BatchWorker(Worker):
         self._deq_ts[ev.id] = _time.monotonic()
 
     def run(self) -> None:
+        import time as _time
+
+        # evals dequeued mid-chain by the admission queue but gated
+        # out of the chain: they hold broker leases and must be
+        # processed NEXT, before any fresh dequeue, to keep FIFO order
+        leftover: List[Tuple[Evaluation, str]] = []
         while not self._stop.is_set():
-            batch: List[Tuple[Evaluation, str]] = []
-            ev, token = self.server.broker.dequeue(
-                self.schedulers, timeout=0.1
-            )
-            if ev is None:
-                continue
-            self._note_dequeue(ev)
-            batch.append((ev, token))
-            cap = self._adaptive_cap()
-            while len(batch) < cap:
+            batch = leftover
+            leftover = []
+            if not batch:
                 ev, token = self.server.broker.dequeue(
-                    self.schedulers, timeout=BATCH_WAIT_S
+                    self.schedulers, timeout=0.1
                 )
                 if ev is None:
-                    break
+                    continue
                 self._note_dequeue(ev)
-                batch.append((ev, token))
+                batch = [(ev, token)]
+                cap = self._adaptive_cap()
+                # ONE fill deadline for the whole gulp: the old
+                # per-dequeue timeout waited up to cap x BATCH_WAIT_S
+                # on an empty queue, holding a lone interactive eval
+                # hostage to batch-fill timeouts.  Anything that
+                # arrives after the deadline is picked up mid-chain by
+                # the admission queue instead.
+                deadline = _time.monotonic() + BATCH_WAIT_S
+                while len(batch) < cap:
+                    wait = deadline - _time.monotonic()
+                    if wait <= 0:
+                        break
+                    ev, token = self.server.broker.dequeue(
+                        self.schedulers, timeout=wait
+                    )
+                    if ev is None:
+                        break
+                    self._note_dequeue(ev)
+                    batch.append((ev, token))
             for pos, (b_ev, _tok) in enumerate(batch):
                 TRACE.event(
                     b_ev.id, "batch_worker.gulp",
                     size=len(batch), pos=pos,
                 )
             try:
-                self._process_batch(batch)
+                leftover = self._process_batch(batch)
             except Exception:  # noqa: BLE001
                 # a crash here would silently kill the worker thread and
                 # strand every queued eval — log, nack, keep running
@@ -1025,14 +1257,29 @@ class BatchWorker(Worker):
                 LOG.exception("batch processing crashed")
                 for ev, token in batch:
                     self._nack_quietly(ev, token)
+                # ... including evals the admission queue dequeued
+                # mid-chain — parked (deferred) or already admitted
+                # into the crashed chain (_nack_quietly tolerates
+                # leases the flush did manage to ack or nack)
+                deferred, self._deferred = self._deferred, []
+                admitted, self._admitted_live = (
+                    self._admitted_live, []
+                )
+                for ev, token in deferred + admitted:
+                    self._nack_quietly(ev, token)
+                leftover = []
 
     # ------------------------------------------------------------------
 
-    def _process_batch(self, batch: List[Tuple[Evaluation, str]]) -> None:
+    def _process_batch(
+        self, batch: List[Tuple[Evaluation, str]]
+    ) -> List[Tuple[Evaluation, str]]:
         """Process the drained evals in queue order, prescoring each
         contiguous run of batchable evals in one chained kernel launch
         so the outcome is exactly what the serial worker loop would
-        produce."""
+        produce.  Returns the evals the admission queue dequeued
+        mid-chain but gated out — the caller must process them as the
+        next gulp (before dequeuing anything newer)."""
         run: List[Tuple[Evaluation, str, Job]] = []
         for ev, token in batch:
             job = self.store.job_by_id(ev.namespace, ev.job_id)
@@ -1042,10 +1289,18 @@ class BatchWorker(Worker):
             self._flush_run(run)
             run = []
             self._process_sequential(ev, token)
-        self._flush_run(run)
+        # only the batch's FINAL flush may admit mid-chain arrivals: a
+        # mid-batch flush has evals of this gulp still queued behind
+        # it, and an admitted (newer) eval would commit ahead of them
+        self._flush_run(run, admit=True)
         self._export_adaptive_gauges()
+        # normal completion: every admitted eval was acked, nacked or
+        # deferred inside the flush — the crash ledger is void
+        self._admitted_live = []
+        deferred, self._deferred = self._deferred, []
+        return deferred
 
-    def _flush_run(self, run) -> None:
+    def _flush_run(self, run, admit: bool = False) -> None:
         import time as _time
 
         idx = 0
@@ -1204,11 +1459,16 @@ class BatchWorker(Worker):
             # recovery) strands the staged dev_cols/handles on the old
             # backend — they must be dropped, never executed
             chain_epoch = self._backend_epoch
+            # adaptive micro-batch width for this flush, from the
+            # measured launch EWMAs + live backlog
+            chunk_w = self._chunk_width(len(sims))
             asm = None
             try:
                 asm = self._guard_device(
                     "assemble",
-                    lambda: self._assemble(snap, run[idx:j], sims),
+                    lambda: self._assemble(
+                        snap, run[idx:j], sims, chunk=chunk_w
+                    ),
                     exemplar=run[idx][0].id,
                 )
             except Exception:  # noqa: BLE001
@@ -1232,8 +1492,6 @@ class BatchWorker(Worker):
                 )
             k = idx
             rescore = False
-            pipe_wall = 0.0  # device-path blocking time for the run
-            launched_any = False
             # optimistic parallel replay: big-enough runs replay
             # speculatively on the pool as each chunk's rows land
             # (overlapping later fetches), then commit in queue order
@@ -1241,16 +1499,21 @@ class BatchWorker(Worker):
             wave = None
             spec_pool = None
             wave_base: Dict[str, int] = {}
+            # in-order commit state threaded across the incremental
+            # wave drains (job ledger + expected-touch accounting)
+            wave_state = {"job_ledger": set(), "expect": {}}
+            chain_base: Optional[Dict[str, int]] = None
             if (
                 asm is not None
                 and self.parallel_replay
                 and asm.E_real >= REPLAY_MIN_WAVE
             ):
-                wave = []
+                wave = deque()
                 spec_pool = self._replay_pool_instance()
                 # touch-count baseline, captured before any
                 # speculation reads (launches haven't fetched yet)
                 wave_base = self.store.node_touch_counts()
+                chain_base = wave_base
             if asm is not None and asm.use_mesh:
                 t0 = _time.monotonic()
                 rows_arr = None
@@ -1279,13 +1542,20 @@ class BatchWorker(Worker):
                 if cold:
                     self._count("cold_shape_fallbacks")
                 dt = _time.monotonic() - t0
-                pipe_wall += dt
                 self._observe_chunk(
                     "fetch", run, idx, 0, asm.E_real, t0, dt,
                     mesh=True,
                 )
                 if rows_arr is not None:
-                    launched_any = True
+                    # feed the adaptive sizing loop: the mesh launch
+                    # covers the whole run in one dispatch — spread
+                    # its blocking cost over the equivalent number of
+                    # widest-bucket chunks
+                    widest = self._chunk_buckets()[-1]
+                    eq_chunks = max(1, -(-asm.E_real // widest))
+                    self._note_launch_cost(
+                        widest, dt * 1000.0 / eq_chunks
+                    )
                     for e in range(asm.E_real):
                         if rescore:
                             break
@@ -1319,10 +1589,41 @@ class BatchWorker(Worker):
                 # and chunk N+1 chains on N's device-resident carry
                 # without a host round trip.  Splitting the eval scan
                 # at chunk boundaries is bit-identical to one launch.
+                # Each descriptor is (arena, slice start/end, run
+                # index of the arena's eval 0) — admitted chunks bring
+                # their own arena, chained on the live carry.
                 chunks = [
-                    (s, s + PIPELINE_CHUNK)
-                    for s in range(0, asm.E, PIPELINE_CHUNK)
+                    (asm, s, s + asm.chunk, idx)
+                    for s in range(0, asm.E, asm.chunk)
                 ]
+                # continuous micro-batching: while this chain is in
+                # flight, evals the broker receives are admitted as
+                # new chunks of the SAME chain — but only when the
+                # chain covers the whole remaining gulp (nothing
+                # queued behind it to leapfrog), no eval was already
+                # deferred this batch, and the chain carries no
+                # port/device occupancy (an admitted arena cannot
+                # splice into those slot axes)
+                admission = None
+                chain_jobs: Set[tuple] = set()
+                if (
+                    admit
+                    and self.admit_enabled
+                    and j == len(run)
+                    and not self._deferred
+                    and asm.port_ask is None
+                    and asm.dev_ask is None
+                ):
+                    admission = _AdmissionQueue(self)
+                    chain_jobs = {
+                        (r_ev.namespace, r_ev.job_id)
+                        for r_ev, _t, _jb in run[idx:j]
+                    }
+                    if chain_base is None:
+                        # touch-count baseline for the admission
+                        # strict-node gate (the wave captured it
+                        # already when parallel replay is on)
+                        chain_base = self.store.node_touch_counts()
                 pending = deque()
                 carry = None
                 ci = 0
@@ -1341,6 +1642,10 @@ class BatchWorker(Worker):
                             "in-flight chunk(s)", len(pending),
                         )
                         pending.clear()
+                        # the dropped launches may still be reading
+                        # the usage mirror on the old backend: the
+                        # next sync must re-upload, never donate
+                        self._mirror_dirty = True
                         stalled = True
                         break
                     while (
@@ -1348,17 +1653,22 @@ class BatchWorker(Worker):
                         and ci < len(chunks)
                         and len(pending) < self.pipeline_depth
                     ):
-                        c0, c1 = chunks[ci]
+                        casm, c0, c1, base = chunks[ci]
                         t0 = _time.monotonic()
                         handle = None
                         try:
                             handle = self._guard_device(
                                 "launch",
                                 lambda: self._launch_chunk(
-                                    asm, c0, c1, carry,
-                                    check_ready=ci == 0,
+                                    casm, c0, c1, carry,
+                                    # first slice of each arena: the
+                                    # cold-compile shield keys on the
+                                    # launch signature, which is
+                                    # identical for that arena's
+                                    # later slices
+                                    check_ready=c0 == 0,
                                 ),
-                                exemplar=run[idx + c0][0].id,
+                                exemplar=run[base + c0][0].id,
                             )
                             if handle is None:
                                 self._count("cold_shape_fallbacks")
@@ -1369,28 +1679,45 @@ class BatchWorker(Worker):
                                 exc_info=True,
                             )
                         dt = _time.monotonic() - t0
-                        pipe_wall += dt
                         self._observe_chunk(
-                            "launch", run, idx, c0,
-                            min(c1, asm.E_real), t0, dt,
+                            "launch", run, base, c0,
+                            min(c1, casm.E_real), t0, dt,
                             chunk=ci, ok=handle is not None,
                         )
                         if handle is None:
                             stalled = True
                             break
-                        launched_any = True
                         carry = handle[2]
-                        pending.append(((c0, c1), handle))
+                        pending.append((chunks[ci], handle, dt))
                         ci += 1
+                    if (
+                        admission is not None
+                        and not stalled
+                        and not rescore
+                    ):
+                        # poll while the oldest chunk executes on
+                        # device; an admitted group becomes the
+                        # chain's next chunk(s) and the launch loop
+                        # above dispatches it next iteration
+                        new_chunks, j = self._admit_into_chain(
+                            admission, snap, run, sims, idx, j,
+                            chain_jobs, chain_base, wave_readiness,
+                            chain_epoch, asm, chunk_w,
+                        )
+                        if new_chunks:
+                            chunks.extend(new_chunks)
+                            continue
                     if not pending:
                         break
-                    (c0, c1), handle = pending.popleft()
+                    (casm, c0, c1, base), handle, launch_dt = (
+                        pending.popleft()
+                    )
                     t0 = _time.monotonic()
                     try:
                         rows_arr, pulls_arr = self._guard_device(
                             "fetch",
                             lambda: self._fetch(handle),
-                            exemplar=run[idx + c0][0].id,
+                            exemplar=run[base + c0][0].id,
                         )
                     except Exception:  # noqa: BLE001
                         self._count("errors")
@@ -1401,22 +1728,29 @@ class BatchWorker(Worker):
                         # they share its failure: drop them and let the
                         # exact path cover the rest of the run
                         pending.clear()
+                        self._mirror_dirty = True
                         stalled = True
                         self._observe(
                             "fetch", _time.monotonic() - t0
                         )
                         continue
                     dt = _time.monotonic() - t0
-                    pipe_wall += dt
                     self._observe_chunk(
-                        "fetch", run, idx, c0,
-                        min(c1, asm.E_real), t0, dt,
+                        "fetch", run, base, c0,
+                        min(c1, casm.E_real), t0, dt,
                     )
-                    for e in range(c0, min(c1, asm.E_real)):
+                    # feed the adaptive sizing loop: this chunk's
+                    # blocking device-path cost (dispatch + the fetch
+                    # wait replay overlap didn't hide), keyed by its
+                    # width bucket
+                    self._note_launch_cost(
+                        c1 - c0, (launch_dt + dt) * 1000.0
+                    )
+                    for e in range(c0, min(c1, casm.E_real)):
                         if rescore:
                             break
-                        ev, token, job = run[idx + e]
-                        sim = sims[e]
+                        ev, token, job = run[base + e]
+                        sim = sims[base + e - idx]
                         rows = [
                             int(r)
                             for r in rows_arr[
@@ -1445,19 +1779,32 @@ class BatchWorker(Worker):
                         k += 1
                         if not ok:
                             rescore = True
-            if launched_any:
-                # feed the adaptive sizing loop: blocking device-path
-                # cost for a gulp of this size (launch dispatch plus
-                # the fetch waits replay overlap didn't hide)
-                bucket = 8 if len(sims) <= 8 else BATCH_MAX
-                prev = self._launch_ewma.get(bucket)
-                ms = pipe_wall * 1000.0
-                self._launch_ewma[bucket] = (
-                    ms if prev is None else 0.8 * prev + 0.2 * ms
-                )
-            if wave:
+                    if wave is not None and wave and not rescore:
+                        # continuous commit: drain the READY prefix of
+                        # the wave in order, so these evals ack now —
+                        # not when the (possibly admission-extended)
+                        # chain finally ends.  Blocking only happens
+                        # in the final drain below.
+                        k, rescore = self._commit_wave(
+                            wave, k, wave_base, wave_readiness,
+                            state=wave_state, drain_all=False,
+                        )
+                if pending:
+                    # a rescore exit abandoned in-flight launches that
+                    # may still read the usage mirror: the next sync
+                    # must re-upload instead of donating the buffers
+                    self._mirror_dirty = True
+                if admission is not None and admission.deferred:
+                    # gated-out arrivals: the worker holds their
+                    # leases; run() processes them as the next gulp
+                    self._deferred.extend(admission.deferred)
+            if wave and not rescore:
+                # final drain: block on whatever speculations are
+                # still running (a rescore above discards the rest —
+                # the outer loop re-prescores them on fresh state)
                 k, rescore = self._commit_wave(
-                    wave, k, wave_base, wave_readiness
+                    wave, k, wave_base, wave_readiness,
+                    state=wave_state, drain_all=True,
                 )
             if not rescore:
                 # evals no fetched chunk covered (assembly failure,
@@ -1468,6 +1815,190 @@ class BatchWorker(Worker):
                     self._process_sequential(ev, token)
                     k += 1
             idx = k
+
+    # -- continuous micro-batching (mid-chain admission) ---------------
+
+    def _admission_gates(
+        self, snap, ev: Evaluation, job: Optional[Job],
+        chain_jobs: Set[tuple], chain_base: Dict[str, int],
+        wave_readiness: int, chain_epoch: int,
+    ) -> Optional[str]:
+        """Serial-equivalence gates for admitting ``ev`` into an
+        in-flight chain.  Returns a defer reason, or None when the
+        eval would see EXACTLY the state a fresh gulp would: its
+        simulation runs against the chain snapshot, so every
+        reconciler input it reads there must be provably identical to
+        what a fresh snapshot would show — the usage columns evolve
+        inside the kernel carry (which models every earlier chain
+        member's deltas exactly), and everything the carry does NOT
+        model is fenced here, mirroring the optimistic replay wave's
+        conflict vocabulary.
+
+        Note what does NOT need a fence: job versions and deployment
+        state.  ``StateSnapshot`` is a live delegating view (mutation
+        is serialized behind the plan applier), so the admitted
+        eval's simulation reads the CURRENT job/deployment — exactly
+        what a fresh gulp's simulation would — and drift between
+        simulation and replay is caught by the replay's ``set_job``
+        deviation, the same way it is for gulped evals."""
+        if self._backend_epoch != chain_epoch:
+            return "backend_flip"
+        if not self._batchable(ev, job):
+            return "unbatchable"
+        if (ev.namespace, ev.job_id) in chain_jobs:
+            # a chain member of the same job is ahead of this eval:
+            # its commit changes allocs_by_job, the reconciler's
+            # primary input (the broker serializes same-job evals,
+            # but an ack mid-chain releases the next one)
+            return "job_in_chain"
+        if self.store.readiness_generation() != wave_readiness:
+            # the ready-node set moved since the chain started: one
+            # candidate world per chain is an assumption of the
+            # serial-equivalence argument (and of the wave's
+            # commit-time readiness fence)
+            return "readiness"
+        count = self.store.node_touch_count
+        for alloc in snap.allocs_by_job(ev.namespace, ev.job_id):
+            if count(alloc.node_id) != chain_base.get(
+                alloc.node_id, 0
+            ):
+                # a node hosting this job's allocs was written since
+                # the chain baseline (by a chain commit or an external
+                # writer): the reconciler/tainted-scan/in-place probes
+                # read it as a control-flow input — and in wave mode
+                # the commit-time strict-node fence would discard the
+                # speculation anyway; defer instead of churning
+                return "strict_node"
+        return None
+
+    def _admit_into_chain(
+        self, admission: _AdmissionQueue, snap, run, sims,
+        idx: int, j: int, chain_jobs: Set[tuple],
+        chain_base: Dict[str, int], wave_readiness: int,
+        chain_epoch: int, asm0: _Assembled, chunk_w: int,
+    ) -> Tuple[list, int]:
+        """One admission round: poll the broker for evals that arrived
+        while the chain is in flight, gate them, simulate the admitted
+        prefix against the chain snapshot and assemble it into new
+        chunk descriptor(s) chained on the live carry.  Appends
+        admitted members to ``run``/``sims`` (keeping the replay
+        loop's indexing contract) and returns (new descriptors,
+        updated j).  A gate failure defers the eval AND closes the
+        queue — FIFO with the chain is absolute."""
+        import time as _time
+
+        budget = self.batch_max - (j - idx)
+        polled = admission.poll(min(budget, chunk_w))
+        if not polled:
+            return [], j
+        t0 = _time.monotonic()
+        admitted: List[Tuple[Evaluation, str, Job]] = []
+        adm_sims: List[_Sim] = []
+        for ev, token in polled:
+            if admission.closed:
+                # an earlier poll member was deferred: everything
+                # after it defers too (no leapfrogging)
+                admission.deferred.append((ev, token))
+                self._count_admission("deferred")
+                TRACE.event(
+                    ev.id, "batch_worker.admit_deferred",
+                    reason="queue_closed",
+                )
+                continue
+            job = self.store.job_by_id(ev.namespace, ev.job_id)
+            reason = self._admission_gates(
+                snap, ev, job, chain_jobs, chain_base,
+                wave_readiness, chain_epoch,
+            )
+            sim = None
+            if reason is None:
+                try:
+                    sim = self._simulate(snap, ev, job)
+                except Exception:  # noqa: BLE001
+                    self._count("errors")
+                    LOG.warning(
+                        "admission simulate failed for eval %s",
+                        ev.id, exc_info=True,
+                    )
+                if sim is None:
+                    reason = "simulate"
+                elif sim.asked_ports and any(sim.asked_ports):
+                    # the chain's kernel carries no port-slot axis
+                    # (admission is disabled on chains that have one)
+                    reason = "ports"
+                elif any(d for d in sim.asked_devices):
+                    reason = "devices"
+            if reason is not None:
+                admission.defer(ev, token)
+                self._count_admission("deferred")
+                TRACE.event(
+                    ev.id, "batch_worker.admit_deferred",
+                    reason=reason,
+                )
+                continue
+            admitted.append((ev, token, job))
+            adm_sims.append(sim)
+        if not admitted:
+            return [], j
+        asm2 = None
+        try:
+            # same snapshot, same chunk width, SAME device-column
+            # mirror tuple as the chain head (re-syncing the mirror
+            # mid-chain would patch buffers in-flight launches read)
+            asm2 = self._assemble(
+                snap, admitted, adm_sims, chunk=chunk_w,
+                shared_cols=asm0.dev_cols, chain=True,
+            )
+        except Exception:  # noqa: BLE001
+            self._count("errors")
+            LOG.warning(
+                "admission assembly failed for %d evals",
+                len(admitted), exc_info=True,
+            )
+        if asm2 is None or (
+            asm2.port_ask is not None or asm2.dev_ask is not None
+        ):
+            # unreachable port/dev arenas are gated per-sim above;
+            # defensive — defer the whole admitted group, INSERTED
+            # AHEAD of any evals this round already gate-deferred:
+            # the admitted group was dequeued first, and the deferred
+            # list is replayed as the next gulp in list order, so
+            # appending would leapfrog the serial order
+            admission.closed = True
+            admission.deferred[0:0] = [
+                (ev, token) for ev, token, _job in admitted
+            ]
+            for ev, _token, _job in admitted:
+                self._count_admission("deferred")
+                TRACE.event(
+                    ev.id, "batch_worker.admit_deferred",
+                    reason="assembly",
+                )
+            return [], j
+        if not admission.admitted_any:
+            # first successful admission into THIS chain
+            admission.admitted_any = True
+            self._count_admission("chains")
+        base = len(run)  # == j: the chain covers the whole gulp
+        for (ev, token, job), sim in zip(admitted, adm_sims):
+            run.append((ev, token, job))
+            sims.append(sim)
+            chain_jobs.add((ev.namespace, ev.job_id))
+            self._admitted_live.append((ev, token))
+        dt = _time.monotonic() - t0
+        self._observe("admit", dt, exemplar=admitted[0][0].id)
+        for pos, (ev, _token, _job) in enumerate(admitted):
+            TRACE.add_span(
+                ev.id, "batch_worker.admit", t0, dt,
+                chain_pos=base - idx + pos,
+                members=len(admitted),
+            )
+            self._count_admission("admitted")
+        descriptors = [
+            (asm2, s, s + asm2.chunk, base)
+            for s in range(0, asm2.E, asm2.chunk)
+        ]
+        return descriptors, base + len(admitted)
 
     def _replay_one(
         self, ev, token, job, sim: _Sim,
@@ -1653,7 +2184,8 @@ class BatchWorker(Worker):
 
     def _commit_wave(
         self, wave, k: int, wave_base: Dict[str, int],
-        wave_readiness: int,
+        wave_readiness: int, state: Optional[dict] = None,
+        drain_all: bool = True,
     ) -> Tuple[int, bool]:
         """Phase B: walk the wave in queue order, committing each
         eval's speculation when its read set survived every
@@ -1666,13 +2198,28 @@ class BatchWorker(Worker):
         rescore); rescore=True means a replay marked the chained
         state suspect — exactly the serial loop's contract, so the
         caller re-prescores the remainder and the discarded
-        speculations past it are never applied."""
+        speculations past it are never applied.
+
+        ``wave`` is a deque consumed from the front.  With
+        ``drain_all=False`` the walk stops at the first member whose
+        speculation is still running — the continuous micro-batching
+        loop drains the READY prefix after every chunk fetch, so an
+        eval's ack lands one chunk after its rows do instead of at
+        the end of the (possibly admission-extended) chain.
+        ``state`` carries the in-order commit's job ledger and
+        expected-touch accounting across those incremental drains."""
         import time as _time
 
-        job_ledger: Set[tuple] = set()
-        wave_expect: Dict[str, int] = {}
+        if state is None:
+            state = {"job_ledger": set(), "expect": {}}
+        job_ledger: Set[tuple] = state["job_ledger"]
+        wave_expect: Dict[str, int] = state["expect"]
         rescore = False
-        for ev, token, job, sim, rows, pulls, fut in wave:
+        while wave:
+            fut = wave[0][6]
+            if not drain_all and not fut.done():
+                break
+            ev, token, job, sim, rows, pulls, fut = wave.popleft()
             t0 = _time.monotonic()
             try:
                 spec = fut.result()
@@ -2340,16 +2887,18 @@ class BatchWorker(Worker):
         )
 
     def warm_shapes(
-        self, e_buckets=(PIPELINE_CHUNK,), p_buckets=(16,),
+        self, e_buckets=None, p_buckets=(16,),
         t_buckets=(1, 2),
     ) -> None:
         """Pre-compile the chained kernel for the common launch shapes
         so the first production batches don't pay the jit compile (the
         bench and server startup call this outside any timed region).
-        The default eval-axis bucket is PIPELINE_CHUNK — EVERY
-        production launch is a chunk of that width since the pipelined
-        prescore — warmed with return_carry=True exactly as
-        _launch_chunk dispatches it.  T buckets cover the single-group
+        The default eval-axis buckets are the live chunk-width
+        ladder (``_chunk_buckets``, the CHUNK_BUCKETS constants
+        clamped to the operator's batch ceiling) — EVERY production
+        launch is a chunk of one of those widths since the pipelined
+        prescore went adaptive — warmed with return_carry=True
+        exactly as _launch_chunk dispatches it.  T buckets cover the single-group
         shape and the first multi-task-group bucket (T=2 — jobs with 2
         groups; 3-4-group jobs pad to T=4 and compile on first
         sighting)."""
@@ -2362,6 +2911,8 @@ class BatchWorker(Worker):
         # signatures that never match the device mirror's canonical
         # dtype when x64 is off (production TPU runs f32)
         dev_cols = self._device_columns(table)
+        if e_buckets is None:
+            e_buckets = self._chunk_buckets()
         for e in e_buckets:
             for p in p_buckets:
                 for t in t_buckets:
@@ -2724,6 +3275,9 @@ class BatchWorker(Worker):
             )
             cache = {"key": key, "gen": gen, "cols": cols}
             self._usage_cache = cache
+            # full re-upload: the cache now holds fresh buffers no
+            # abandoned launch has ever seen
+            self._mirror_dirty = False
         else:
             gen, rows = self.store.usage_delta_since(cache["gen"])
             cols = cache["cols"]
@@ -2737,6 +3291,7 @@ class BatchWorker(Worker):
                         table.disk_used,
                     )
                 )
+                self._mirror_dirty = False
             elif rows:
                 idx = np.asarray(sorted(rows), dtype=np.int32)
                 # pad the row axis to a pow2 bucket so the scatter
@@ -2745,15 +3300,52 @@ class BatchWorker(Worker):
                 width = _pow2(len(idx), floor=8)
                 idx_p = np.full(width, table.capacity, np.int32)
                 idx_p[: len(idx)] = idx
+                # hot-path donation (off-CPU): the stale column and
+                # the idx/vals staging buffers are consumed in place,
+                # so a steady-state delta sync allocates nothing net
+                # on device — UNLESS an abandoned in-flight launch or
+                # a background shield compile may still be reading
+                # the live column (then the copying patch keeps the
+                # old buffer intact for them)
+                with self._compile_lock:
+                    compiling = bool(self._compiling)
+                donate = (
+                    self._donation_enabled()
+                    and not self._mirror_dirty
+                    and not compiling
+                )
+                if donate:
+                    from ..ops.batch import patch_rows_donated
+
+                    patch = patch_rows_donated()
+                else:
+                    patch = patch_rows
                 patched = []
-                for col, src in zip(
-                    cols[3:],
-                    (table.cpu_used, table.mem_used, table.disk_used),
-                ):
-                    vals = np.zeros(width, dtype=src.dtype)
-                    vals[: len(idx)] = src[idx]
-                    patched.append(patch_rows(col, idx_p, vals))
+                try:
+                    for col, src in zip(
+                        cols[3:],
+                        (
+                            table.cpu_used,
+                            table.mem_used,
+                            table.disk_used,
+                        ),
+                    ):
+                        vals = np.zeros(width, dtype=src.dtype)
+                        vals[: len(idx)] = src[idx]
+                        patched.append(patch(col, idx_p, vals))
+                except Exception:
+                    # a partially-donated sync leaves already-deleted
+                    # buffers behind cache["cols"]; retrying the delta
+                    # against them would fail on every future flush —
+                    # drop the whole mirror so the next sync does a
+                    # full re-upload from host state
+                    self._usage_cache = None
+                    raise
                 cols = cols[:3] + tuple(patched)
+                # the patch produced fresh (or in-place-donated)
+                # buffers only this worker references: the next sync
+                # may donate again
+                self._mirror_dirty = False
                 hit = True
             else:
                 hit = True  # nothing changed since the last sync
@@ -2775,13 +3367,24 @@ class BatchWorker(Worker):
     # ------------------------------------------------------------------
 
     def _assemble(
-        self, snap, prescorable, sims: List[_Sim]
+        self, snap, prescorable, sims: List[_Sim],
+        chunk: int = PIPELINE_CHUNK,
+        shared_cols: Optional[tuple] = None,
+        chain: bool = False,
     ) -> _Assembled:
         """Stage 1 of the prescore pipeline: pure host-side numpy input
         staging for one admitted chain (no device work).  The result is
         launched chunk-by-chunk by ``_launch_chunk`` and fetched
         lazily, so device execution overlaps the host's replay of
-        earlier chunks."""
+        earlier chunks.
+
+        ``chunk`` aligns the eval axis (one launch = one chunk-wide
+        slice).  ``chain=True`` marks a mid-chain admission arena: it
+        must take the chunk path (never the mesh — the mesh launch
+        doesn't surface the carry the chain threads through) and
+        reuse the chain head's device mirror via ``shared_cols``
+        (re-syncing the mirror mid-chain would patch buffers the
+        in-flight launches are reading)."""
         table = snap.node_table
         C = table.capacity
         compiler = MaskCompiler(table)
@@ -3016,11 +3619,12 @@ class BatchWorker(Worker):
         # pre-compiles the coll0+affinity one; spread batches bucket
         # their (S, V1) axes to powers of two below to bound variants
         E_real = len(per_eval)
-        # the eval axis pads to the next multiple of PIPELINE_CHUNK:
-        # every launch is a PIPELINE_CHUNK-wide slice of this arena, so
-        # the device sees ONE compiled program per pick bucket
-        # regardless of run length (padding waste < one chunk per run)
-        E = -(-E_real // PIPELINE_CHUNK) * PIPELINE_CHUNK
+        # the eval axis pads to the next multiple of the flush's chunk
+        # width: every launch is a chunk-wide slice of this arena, so
+        # the device sees ONE compiled program per (width, pick)
+        # bucket regardless of run length (padding waste < one chunk
+        # per run)
+        E = -(-E_real // chunk) * chunk
         P = 16 if max_picks <= 16 else _pow2(max_picks)
         T = _pow2(max_tgs)
         K = MAX_PENALTY_NODES
@@ -3265,7 +3869,8 @@ class BatchWorker(Worker):
         wanted = np.zeros(E, np.int32)
         wanted[:E_real] = [s.placements for s in sims]
         use_mesh = (
-            self._mesh is not None
+            not chain
+            and self._mesh is not None
             and T == 1
             and port_ask_arr is None
             and dev_ask_arr is None
@@ -3305,11 +3910,20 @@ class BatchWorker(Worker):
                 table.disk_used,
             ),
             # the sharded runner reshards its own inputs; only the
-            # chunk path reads the device-resident mirror
+            # chunk path reads the device-resident mirror (a
+            # mid-chain admission arena reuses the chain head's
+            # mirror tuple instead of re-syncing)
             dev_cols=(
-                None if use_mesh else self._device_columns(table)
+                None
+                if use_mesh
+                else (
+                    shared_cols
+                    if shared_cols is not None
+                    else self._device_columns(table)
+                )
             ),
             use_mesh=use_mesh,
+            chunk=chunk,
         )
 
     # -- launch + fetch (pipeline stages 2 and 3) ----------------------
@@ -3348,7 +3962,7 @@ class BatchWorker(Worker):
         self, asm: _Assembled, c0: int, c1: int, carry,
         check_ready: bool,
     ):
-        """Stage 2: dispatch one PIPELINE_CHUNK-wide slice of the run,
+        """Stage 2: dispatch one chunk-wide slice of the run,
         chained on ``carry`` (the previous chunk's device carry-out;
         None = chain start, which reads the persistent device usage
         mirror and the host-built occupancy arenas).  NON-blocking —
@@ -3413,12 +4027,24 @@ class BatchWorker(Worker):
         rows_j, pulls_j, carry_out = fn(*args, **kwargs)
         return rows_j, pulls_j, carry_out
 
-    @staticmethod
-    def _fetch(handle) -> Tuple[np.ndarray, np.ndarray]:
+    def _fetch(self, handle) -> Tuple[np.ndarray, np.ndarray]:
         """Stage 3: realize a chunk's device futures — the only point
-        the host blocks on the device."""
+        the host blocks on the device.  Off-CPU the staging buffers
+        are released eagerly after the host copy: with the carry and
+        mirror-patch donation this closes the loop on steady-state
+        device allocation (a deep pipeline would otherwise hold every
+        in-flight chunk's rows/pulls until GC).  On the CPU backend
+        ``np.asarray`` may alias the buffer, so the handles are left
+        to the GC there."""
         rows_j, pulls_j, _carry = handle
-        return np.asarray(rows_j), np.asarray(pulls_j)
+        out = (np.asarray(rows_j), np.asarray(pulls_j))
+        if self._donation_enabled():
+            for arr in (rows_j, pulls_j):
+                try:
+                    arr.delete()
+                except Exception:  # noqa: BLE001 — eager-free only
+                    pass
+        return out
 
     def _launch_mesh(self, asm: _Assembled) -> Optional[np.ndarray]:
         """Single sharded launch over the whole run (NOMAD_TPU_MESH):

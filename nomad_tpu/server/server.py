@@ -234,6 +234,20 @@ class Server:
                     for w in self.workers
                 ) else 0.0,
             )
+            # continuous micro-batching: zero-register the admission.*
+            # counter family (absence-of-series must mean "admission
+            # never engaged", not "not exported") and expose the mode
+            # flag (NOMAD_TPU_ADMIT=0 restores flush-boundary gulps)
+            from .batch_worker import ADMISSION_COUNTERS
+
+            self.metrics.preregister(counters=ADMISSION_COUNTERS)
+            self.metrics.set_gauge(
+                "batch_worker.admit_enabled",
+                1.0 if any(
+                    getattr(w, "admit_enabled", False)
+                    for w in self.workers
+                ) else 0.0,
+            )
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = Drainer(self)
         self.periodic = PeriodicDispatcher(self)

@@ -61,6 +61,13 @@ SPAN_NAMES = frozenset(
         # batch_worker.timings stages; chunk-wide spans carry a
         # `members` attr so aggregate sums match the stage timings)
         "batch_worker.gulp",
+        # continuous micro-batching: `admit` spans an admission round's
+        # gate+simulate+assemble work on every admitted eval (with a
+        # `members` attr like the other chunk-wide stages);
+        # `admit_deferred` marks an eval that arrived mid-chain but
+        # failed an admission gate and was parked for the next gulp
+        "batch_worker.admit",
+        "batch_worker.admit_deferred",
         "batch_worker.simulate",
         "batch_worker.assemble",
         "batch_worker.launch",

@@ -1424,16 +1424,24 @@ def test_adaptive_batch_cap_tracks_latency_and_backlog():
     bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
     try:
         worker = bat.workers[0]
-        # keeping up + fast launches: full batch fits the budget
-        worker._launch_ewma = {8: 20.0, worker.batch_max: 60.0}
+        # keeping up + fast launches: a full batch of 8-wide chunk
+        # launches fits the budget
+        worker._launch_ewma = {2: 10.0, 4: 12.0, 8: 20.0}
         worker._replay_ewma_ms = 1.0
         assert worker._adaptive_cap() == worker.batch_max
 
-        # keeping up + slow full-batch launches: drop to the small
-        # bucket to bound the last eval's latency
-        worker._launch_ewma = {8: 40.0, worker.batch_max: 400.0}
+        # keeping up + slow launches: the full batch's chunk chain
+        # blows the budget, one wide chunk still fits -> cap 8
+        worker._launch_ewma = {2: 30.0, 4: 35.0, 8: 40.0}
         worker._replay_ewma_ms = 5.0
         assert worker._adaptive_cap() == 8
+
+        # launches so slow even one widest chunk misses the budget:
+        # the ladder lets the cap narrow to a 4-eval gulp (the old
+        # {8, batch_max} candidate set bottomed out at 8)
+        worker._launch_ewma = {2: 60.0, 4: 90.0, 8: 260.0}
+        worker._replay_ewma_ms = 5.0
+        assert worker._adaptive_cap() == 4
 
         # saturation: backlog >= a full batch -> throughput wins
         class _Broker:
@@ -1449,23 +1457,25 @@ def test_adaptive_batch_cap_tracks_latency_and_backlog():
 
         # explicit opt-out
         worker.latency_budget_ms = 0.0
-        worker._launch_ewma = {8: 9999.0, worker.batch_max: 9999.0}
+        worker._launch_ewma = {2: 9999.0, 4: 9999.0, 8: 9999.0}
         assert worker._adaptive_cap() == worker.batch_max
     finally:
         bat.stop()
 
 
 def test_adaptive_cap_respects_operator_ceiling(monkeypatch):
-    """With NOMAD_TPU_BATCH_MAX below the small bucket, the adaptive
-    cap must never exceed the operator's ceiling, and launch EWMAs
-    keyed by trace bucket still drive the decision for non-default
-    ceilings (code-review r4 findings)."""
+    """With NOMAD_TPU_BATCH_MAX below the widest chunk bucket, the
+    adaptive cap (and the chunk ladder itself) must never exceed the
+    operator's ceiling, and the measured chunk-cost EWMAs still drive
+    the decision for non-default ceilings (code-review r4
+    findings)."""
     monkeypatch.setenv("NOMAD_TPU_BATCH_MAX", "4")
     bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
     try:
         worker = bat.workers[0]
         assert worker.batch_max == 4
-        worker._launch_ewma = {8: 10.0}
+        assert worker._chunk_buckets() == (2, 4)
+        worker._launch_ewma = {2: 10.0, 4: 10.0}
         worker._replay_ewma_ms = 1.0
         assert worker._adaptive_cap() <= 4
     finally:
@@ -1474,13 +1484,12 @@ def test_adaptive_cap_respects_operator_ceiling(monkeypatch):
     bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
     try:
         worker = bat.workers[0]
-        from nomad_tpu.server.batch_worker import BATCH_MAX
-
-        # large-gulp launches are recorded under the TRACE bucket
-        # (module BATCH_MAX); a slow one must downsize a 32 gulp
-        worker._launch_ewma = {8: 40.0, BATCH_MAX: 400.0}
+        # a widest-bucket launch too slow for the budget narrows the
+        # chunk width AND the gulp: with an unmeasured narrow bucket
+        # (seeded at the 50 ms default) only a 4-eval gulp fits
+        worker._launch_ewma = {8: 400.0}
         worker._replay_ewma_ms = 5.0
-        assert worker._adaptive_cap() == 8
+        assert worker._adaptive_cap() == 4
     finally:
         bat.stop()
 
@@ -2360,20 +2369,20 @@ def test_parallel_replay_failed_placements_match_serial(monkeypatch):
 
 def test_adaptive_cap_latency_budget_boundary_and_broker_errors():
     """_adaptive_cap edges: the budget boundary is inclusive (est ==
-    budget keeps the big gulp; one tenth of a ms over drops to the
-    small bucket) and a broker error falls back to the full batch."""
-    from nomad_tpu.server.batch_worker import BATCH_MAX
-
+    budget keeps the big gulp; one tenth of a ms over drops to a
+    chunk-sized gulp) and a broker error falls back to the full
+    batch."""
     bat = Server(num_schedulers=1, seed=1, batch_pipeline=True)
     try:
         worker = bat.workers[0]
         worker.latency_budget_ms = 250.0
         # keeping up (empty broker): estimated last-eval latency for
-        # the full batch = launch EWMA + 1 * replay EWMA
+        # a 64-eval gulp = 8 launches x the 8-wide chunk cost EWMA
+        # + 1 * replay EWMA = 8 * 30.625 + 5 = 250.0 exactly
         worker._replay_ewma_ms = 5.0
-        worker._launch_ewma = {8: 10.0, BATCH_MAX: 245.0}
+        worker._launch_ewma = {2: 10.0, 4: 10.0, 8: 30.625}
         assert worker._adaptive_cap() == worker.batch_max  # est == 250
-        worker._launch_ewma = {8: 10.0, BATCH_MAX: 245.1}
+        worker._launch_ewma = {2: 10.0, 4: 10.0, 8: 30.6375}
         assert worker._adaptive_cap() == 8  # est just over budget
 
         # a broken broker must not kill sizing: full batch fallback
@@ -2384,7 +2393,7 @@ def test_adaptive_cap_latency_budget_boundary_and_broker_errors():
         real = bat.broker
         bat.broker = _Exploding()
         try:
-            worker._launch_ewma = {8: 9999.0, BATCH_MAX: 9999.0}
+            worker._launch_ewma = {2: 9999.0, 4: 9999.0, 8: 9999.0}
             assert worker._adaptive_cap() == worker.batch_max
         finally:
             bat.broker = real

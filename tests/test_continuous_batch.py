@@ -1,0 +1,505 @@
+"""Continuous micro-batching tests: evals admitted into an in-flight
+chunk chain must produce BIT-IDENTICAL decisions and AllocMetrics to
+the same evals run in a fresh gulp (the serial-equivalence contract
+extended across the admission boundary), under forced replay
+conflicts and a mid-chain device failover included — plus unit
+coverage of the admission gates, the adaptive chunk-width policy and
+the single-deadline gulp fill.
+"""
+import copy
+import random
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import compute_node_class
+
+
+def make_nodes(n, seed=0):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        node = mock.node(id=f"cb-node-{seed}-{i}")
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def make_jobs(n, prefix="cb", seed=1):
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(n):
+        job = mock.job(id=f"{prefix}-{i}")
+        job.task_groups[0].count = rng.randint(1, 4)
+        job.task_groups[0].tasks[0].resources.cpu = rng.choice(
+            [200, 400]
+        )
+        jobs.append(job)
+    return jobs
+
+
+def placements(server, job_id):
+    return sorted(
+        (a.name, a.node_id)
+        for a in server.store.allocs_by_job("default", job_id)
+        if not a.terminal_status()
+    )
+
+
+def eval_outcomes(server, job_id):
+    """Terminal eval outcomes, decision-bearing fields only (eval ids
+    are random per server)."""
+    return sorted(
+        (
+            e.status,
+            e.status_description,
+            tuple(sorted(e.queued_allocations.items())),
+        )
+        for e in server.store.evals_by_job("default", job_id)
+    )
+
+
+def alloc_metrics(server, job_id):
+    """Normalized AllocMetric view per eval of a job, from the explain
+    ring: per-TG placements, winner and the full API-shape metric
+    minus wall-clock fields."""
+    from nomad_tpu.explain import EXPLAIN
+
+    out = []
+    for ev in sorted(
+        server.store.evals_by_job("default", job_id),
+        key=lambda e: e.create_index,
+    ):
+        rec = EXPLAIN.get(ev.id)
+        if rec is None:
+            out.append(None)
+            continue
+        tgs = {}
+        for tg, entry in rec["TaskGroups"].items():
+            metric = entry.get("Metric")
+            if metric is not None:
+                metric = {
+                    k: v
+                    for k, v in metric.items()
+                    if k != "AllocationTime"
+                }
+            tgs[tg] = {
+                "Placed": entry["Placed"],
+                "Failed": entry["Failed"],
+                "Winner": entry["Winner"],
+                "Placements": sorted(
+                    (
+                        p["Name"],
+                        p["NodeID"],
+                        round(p["NormScore"], 9),
+                    )
+                    for p in entry["Placements"]
+                ),
+                "Metric": metric,
+            }
+        out.append(tgs)
+    return out
+
+
+def run_with_midchain_arrivals(jobs, split, seed=77, nodes_seed=3,
+                               n_nodes=16, epoch_bump=False):
+    """Batch server where jobs[:split] arrive as the gulp and
+    jobs[split:] arrive while the first chain's chunk 0 is being
+    launched (registered from inside the hooked _launch_chunk, so the
+    admission poll deterministically sees them mid-chain).  With
+    epoch_bump=True the hook additionally simulates a device failover
+    right after the SECOND launch — i.e. after the late evals were
+    admitted as chunk 2 of the chain — so the epoch check must drop
+    the in-flight (admitted) chunk cleanly with zero lost evals."""
+    server = Server(num_schedulers=1, seed=seed, batch_pipeline=True)
+    worker = server.workers[0]
+    late = [copy.deepcopy(j) for j in jobs[split:]]
+    fired = []
+    orig_launch = worker._launch_chunk
+
+    def hooked(asm, c0, c1, carry, check_ready):
+        fired.append(True)
+        if len(fired) == 1:
+            for job in late:
+                server.register_job(job)
+        out = orig_launch(asm, c0, c1, carry, check_ready)
+        if epoch_bump and len(fired) == 2:
+            worker._backend_epoch += 1
+        return out
+
+    worker._launch_chunk = hooked
+    for node in make_nodes(n_nodes, seed=nodes_seed):
+        server.register_node(copy.deepcopy(node))
+    for job in jobs[:split]:
+        server.register_job(copy.deepcopy(job))
+    server.start()
+    assert server.drain_to_idle(60)
+    assert fired, "the hooked launch never ran (no chain launched)"
+    return server
+
+
+def run_fresh_gulps(jobs, split, seed=77, nodes_seed=3, n_nodes=16,
+                    admit=True):
+    """Reference server: the SAME evals in the SAME order, but as two
+    flush-boundary gulps (drain between the halves, so nothing is
+    ever admitted mid-chain)."""
+    server = Server(num_schedulers=1, seed=seed, batch_pipeline=True)
+    for node in make_nodes(n_nodes, seed=nodes_seed):
+        server.register_node(copy.deepcopy(node))
+    server.start()
+    for job in jobs[:split]:
+        server.register_job(copy.deepcopy(job))
+    assert server.drain_to_idle(60)
+    for job in jobs[split:]:
+        server.register_job(copy.deepcopy(job))
+    assert server.drain_to_idle(60)
+    return server
+
+
+def test_admission_parity_bit_identical_vs_fresh_gulp(monkeypatch):
+    """The acceptance contract: evals admitted mid-chain produce
+    bit-identical placements, eval outcomes AND AllocMetrics to the
+    same evals run in fresh flush-boundary gulps.  Strict replay mode
+    pins score-metric bit-identity (the relaxed default's documented
+    envelope lets wave-contended node scores reflect the wave
+    snapshot, which differs once the wave composition does —
+    admission or not); decision/outcome parity in the relaxed default
+    is covered by the other tests here."""
+    monkeypatch.setenv("NOMAD_TPU_REPLAY_STRICT", "1")
+    jobs = make_jobs(8, prefix="adm", seed=11)
+    adm = run_with_midchain_arrivals(jobs, split=4, seed=77)
+    try:
+        fresh = run_fresh_gulps(jobs, split=4, seed=77)
+        try:
+            # metrics compared FIRST: the explain ring is process-wide
+            # and bounded, so read before any other server churns it
+            adm_metrics = {
+                j.id: alloc_metrics(adm, j.id) for j in jobs
+            }
+            fresh_metrics = {
+                j.id: alloc_metrics(fresh, j.id) for j in jobs
+            }
+            for job in jobs:
+                assert placements(adm, job.id) == placements(
+                    fresh, job.id
+                ), f"placement divergence for {job.id}"
+                assert eval_outcomes(adm, job.id) == eval_outcomes(
+                    fresh, job.id
+                ), f"eval outcome divergence for {job.id}"
+                assert (
+                    adm_metrics[job.id] == fresh_metrics[job.id]
+                ), f"AllocMetric divergence for {job.id}"
+            worker = adm.workers[0]
+            # the contract is vacuous unless admission actually fired
+            assert worker.admission_admitted > 0
+            assert worker.admission_chains > 0
+            assert (
+                adm.metrics.get_counter("admission.admitted")
+                == worker.admission_admitted
+            )
+        finally:
+            fresh.stop()
+    finally:
+        adm.stop()
+
+
+def test_admission_parity_under_forced_replay_conflicts(monkeypatch):
+    """Admitted evals on a tiny contended cluster — where wave
+    speculations lose their conflict checks and re-replay serially —
+    must still match the fresh-gulp outcomes exactly."""
+    monkeypatch.setenv("NOMAD_TPU_REPLAY_STRICT", "1")
+    nodes_kw = dict(nodes_seed=9, n_nodes=4)
+    jobs = make_jobs(10, prefix="conf", seed=13)
+    for job in jobs:
+        job.task_groups[0].count = 3
+        job.task_groups[0].tasks[0].resources.cpu = 300
+    adm = run_with_midchain_arrivals(
+        jobs, split=5, seed=21, **nodes_kw
+    )
+    try:
+        fresh = run_fresh_gulps(jobs, split=5, seed=21, **nodes_kw)
+        try:
+            for job in jobs:
+                assert placements(adm, job.id) == placements(
+                    fresh, job.id
+                ), f"divergence for {job.id}"
+                assert eval_outcomes(adm, job.id) == eval_outcomes(
+                    fresh, job.id
+                ), f"eval outcome divergence for {job.id}"
+            worker = adm.workers[0]
+            assert worker.admission_admitted > 0
+            # strict mode on a 4-node cluster with every plan touching
+            # the same nodes: the conflict path must actually engage
+            assert worker.replay_conflicts > 0
+        finally:
+            fresh.stop()
+    finally:
+        adm.stop()
+
+
+def test_admission_mid_chain_failover_drops_chain_cleanly():
+    """A supervisor epoch bump mid-chain (device failover) drops the
+    in-flight chain: every eval — gulped AND admitted — still
+    completes with fresh-gulp-identical placements, zero lost."""
+    jobs = make_jobs(8, prefix="flip", seed=17)
+    adm = run_with_midchain_arrivals(
+        jobs, split=4, seed=33, epoch_bump=True
+    )
+    try:
+        fresh = run_fresh_gulps(jobs, split=4, seed=33)
+        try:
+            for job in jobs:
+                assert placements(adm, job.id) == placements(
+                    fresh, job.id
+                ), f"divergence for {job.id}"
+                assert eval_outcomes(adm, job.id) == eval_outcomes(
+                    fresh, job.id
+                ), f"eval outcome divergence for {job.id}"
+            # the failover hit a chain that had actually admitted
+            assert adm.workers[0].admission_admitted > 0
+            # nothing stranded: the broker drained fully
+            assert adm.broker.stats["total_unacked"] == 0
+            assert adm.broker.stats["total_ready"] == 0
+        finally:
+            fresh.stop()
+    finally:
+        adm.stop()
+
+
+def test_admission_opt_out_restores_flush_boundary_loop(monkeypatch):
+    """NOMAD_TPU_ADMIT=0: arrivals mid-chain are never admitted (the
+    next gulp picks them up) and outcomes still match."""
+    monkeypatch.setenv("NOMAD_TPU_ADMIT", "0")
+    jobs = make_jobs(6, prefix="optout", seed=19)
+    adm = run_with_midchain_arrivals(jobs, split=3, seed=55)
+    try:
+        assert not adm.workers[0].admit_enabled
+        assert adm.workers[0].admission_admitted == 0
+        assert adm.metrics.get_gauge(
+            "batch_worker.admit_enabled"
+        ) == 0.0
+        monkeypatch.delenv("NOMAD_TPU_ADMIT")
+        fresh = run_fresh_gulps(jobs, split=3, seed=55)
+        try:
+            for job in jobs:
+                assert placements(adm, job.id) == placements(
+                    fresh, job.id
+                ), f"divergence for {job.id}"
+        finally:
+            fresh.stop()
+    finally:
+        adm.stop()
+
+
+def test_admission_defers_unbatchable_and_preserves_fifo():
+    """A non-batchable arrival (sticky disk) mid-chain defers — and
+    CLOSES the queue, so the batchable eval dequeued right after it
+    cannot leapfrog the serial order.  Both still complete."""
+    jobs = make_jobs(4, prefix="fifo", seed=23)
+    sticky = mock.job(id="fifo-sticky")
+    sticky.task_groups[0].ephemeral_disk.sticky = True
+    tail = make_jobs(1, prefix="fifo-tail", seed=29)[0]
+
+    server = Server(num_schedulers=1, seed=61, batch_pipeline=True)
+    worker = server.workers[0]
+    fired = []
+    orig_launch = worker._launch_chunk
+
+    def hooked(asm, c0, c1, carry, check_ready):
+        if not fired:
+            fired.append(True)
+            server.register_job(copy.deepcopy(sticky))
+            server.register_job(copy.deepcopy(tail))
+        return orig_launch(asm, c0, c1, carry, check_ready)
+
+    worker._launch_chunk = hooked
+    for node in make_nodes(12, seed=7):
+        server.register_node(node)
+    for job in jobs:
+        server.register_job(copy.deepcopy(job))
+    server.start()
+    try:
+        assert server.drain_to_idle(60)
+        assert fired
+        assert worker.admission_deferred >= 1
+        # everything placed despite the deferral
+        assert len(placements(server, "fifo-sticky")) > 0
+        assert len(placements(server, "fifo-tail-0")) > 0
+        for job in jobs:
+            assert len(placements(server, job.id)) > 0
+    finally:
+        server.stop()
+
+
+def test_admission_gates_unit():
+    """Gate-by-gate defer reasons, directly against a live store."""
+    server = Server(num_schedulers=1, seed=5, batch_pipeline=True)
+    worker = server.workers[0]
+    for node in make_nodes(4, seed=41):
+        server.register_node(node)
+    server.start()
+    try:
+        job = make_jobs(1, prefix="gate", seed=43)[0]
+        server.register_job(copy.deepcopy(job))
+        assert server.drain_to_idle(30)
+        ev = server.store.evals_by_job("default", job.id)[0]
+        snap = server.store.snapshot()
+        base = server.store.node_touch_counts()
+        readiness = server.store.readiness_generation()
+        live_job = server.store.job_by_id("default", job.id)
+
+        def gates(**over):
+            kw = dict(
+                snap=snap, ev=ev, job=live_job,
+                chain_jobs=set(), chain_base=base,
+                wave_readiness=readiness,
+                chain_epoch=worker._backend_epoch,
+            )
+            kw.update(over)
+            return worker._admission_gates(**kw)
+
+        # a drained job's eval passes every gate: its alloc-hosting
+        # nodes are untouched relative to the fresh baseline
+        assert gates() is None
+        # strict-node: a baseline that disagrees with the live touch
+        # count (the node was written after the chain snapshot) defers
+        alloc_nodes = {
+            a.node_id
+            for a in snap.allocs_by_job("default", job.id)
+        }
+        assert alloc_nodes
+        stale = dict(base)
+        nid = next(iter(alloc_nodes))
+        stale[nid] = stale.get(nid, 0) - 1
+        assert gates(chain_base=stale) == "strict_node"
+        # unbatchable shapes defer outright (sticky disk)
+        sticky_job = copy.deepcopy(live_job)
+        sticky_job.task_groups[0].ephemeral_disk.sticky = True
+        assert gates(job=sticky_job) == "unbatchable"
+        # a fresh job with no allocs passes every gate
+        job2 = make_jobs(1, prefix="gate2", seed=47)[0]
+        server.store.upsert_job(job2)
+        snap2 = server.store.snapshot()
+        ev2 = ev.__class__(
+            namespace="default", job_id=job2.id, type="service",
+            triggered_by="job-register",
+        )
+        live2 = server.store.job_by_id("default", job2.id)
+        ok_kw = dict(
+            snap=snap2, ev=ev2, job=live2,
+            chain_base=server.store.node_touch_counts(),
+            wave_readiness=server.store.readiness_generation(),
+        )
+        assert gates(**ok_kw) is None
+        # same job already in the chain
+        assert gates(
+            **ok_kw, chain_jobs={("default", job2.id)}
+        ) == "job_in_chain"
+        # backend flipped since the chain was assembled
+        assert gates(
+            **ok_kw, chain_epoch=worker._backend_epoch + 1
+        ) == "backend_flip"
+        # readiness generation moved
+        assert gates(**{
+            **ok_kw,
+            "wave_readiness": server.store.readiness_generation() - 1,
+        }) == "readiness"
+    finally:
+        server.stop()
+
+
+def test_plan_chunk_width_policy():
+    """The adaptive chunk-width ladder: widest under backlog or with
+    the budget off, sized-to-fit for small flushes, narrowed when the
+    measured wide-launch cost would eat most of the budget."""
+    server = Server(num_schedulers=1, seed=3, batch_pipeline=True)
+    try:
+        worker = server.workers[0]
+        assert worker._chunk_buckets() == (2, 4, 8)
+        # saturation or budget off: widest
+        assert worker._plan_chunk_width(4, worker.batch_max) == 8
+        worker.latency_budget_ms = 0.0
+        assert worker._plan_chunk_width(2, 0) == 8
+        worker.latency_budget_ms = 250.0
+        # keeping up: smallest bucket covering the flush
+        assert worker._plan_chunk_width(1, 0) == 2
+        assert worker._plan_chunk_width(2, 0) == 2
+        assert worker._plan_chunk_width(3, 0) == 4
+        assert worker._plan_chunk_width(8, 0) == 8
+        # fast wide launches: stay wide for big flushes
+        worker._launch_ewma = {8: 20.0}
+        assert worker._plan_chunk_width(30, 0) == 8
+        # slow wide launches (> budget/2): narrow one bucket
+        worker._launch_ewma = {8: 200.0}
+        assert worker._plan_chunk_width(30, 0) == 4
+        # the first measured warm launch seeds unmeasured buckets
+        worker._launch_ewma = {}
+        worker._launch_ewma_seed = None
+        assert worker._launch_cost_ms(8) == 50.0
+        worker._note_launch_cost(4, 12.0)
+        assert worker._launch_ewma_seed == 12.0
+        assert worker._launch_cost_ms(8) == 12.0
+        worker._note_launch_cost(8, 40.0)
+        assert worker._launch_ewma[8] == 40.0
+        worker._note_launch_cost(8, 20.0)
+        assert 20.0 < worker._launch_ewma[8] < 40.0
+    finally:
+        server.stop()
+
+
+def test_gulp_fill_single_deadline():
+    """The gulp fill waits ONE deadline total, not cap x BATCH_WAIT_S:
+    a lone interactive eval is dequeued and processed without being
+    held hostage to batch-fill timeouts."""
+    from nomad_tpu.server.batch_worker import BATCH_WAIT_S
+
+    server = Server(num_schedulers=1, seed=9, batch_pipeline=True)
+    for node in make_nodes(6, seed=3):
+        server.register_node(node)
+    server.start()
+    try:
+        job = make_jobs(1, prefix="lone", seed=59)[0]
+        waits = []
+        broker = server.broker
+        orig = broker.dequeue
+
+        def timed(schedulers, timeout=None):
+            if timeout is not None and timeout != 0.1:
+                waits.append(timeout)
+            return orig(schedulers, timeout=timeout)
+
+        broker.dequeue = timed
+        try:
+            server.register_job(copy.deepcopy(job))
+            assert server.drain_to_idle(30)
+        finally:
+            broker.dequeue = orig
+        # every fill wait fits inside ONE BATCH_WAIT_S deadline
+        # (admission polls pass timeout=0.0)
+        assert all(w <= BATCH_WAIT_S + 1e-9 for w in waits), waits
+        assert len(placements(server, job.id)) > 0
+    finally:
+        server.stop()
+
+
+def test_admission_counters_zero_registered():
+    """The admission.* family is visible on metrics dumps from
+    construction (absence-of-series == admission never engaged)."""
+    server = Server(num_schedulers=1, seed=2, batch_pipeline=True)
+    try:
+        counters = server.metrics.dump()["counters"]
+        for name in (
+            "admission.admitted",
+            "admission.deferred",
+            "admission.chains",
+        ):
+            assert name in counters, name
+            assert counters[name] == 0.0
+        assert (
+            server.metrics.get_gauge("batch_worker.admit_enabled")
+            == 1.0
+        )
+    finally:
+        server.stop()

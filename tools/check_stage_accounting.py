@@ -49,7 +49,20 @@ wholesale, would silently vanish from BENCH_*.json and /v1/metrics):
    drift from the serial path's vocabulary (and from the
    ``placement.filtered.<slug>`` counter families keyed on it);
 9. the operator debug bundle captures ``/v1/placements`` so the
-   per-eval explanations travel with the traces they cross-reference.
+   per-eval explanations travel with the traces they cross-reference;
+10. continuous micro-batching observability: the
+    ``batch_worker.admit`` span (and ``batch_worker.admit_deferred``
+    event) are declared in ``SPAN_NAMES``, and every ``admission.*``
+    counter the worker emits (literal first args of
+    ``incr/set_gauge/add_sample`` plus the ``self._count_admission(
+    "<kind>")`` call sites, which emit ``admission.<kind>``) appears
+    in the ``ADMISSION_COUNTERS`` registry literal in
+    ``batch_worker.py`` — which ``server.py`` zero-registers at
+    construction, so prometheus scrapes export the family before the
+    first mid-chain admission;
+11. bench.py exports the ``latency_sweep`` JSON block (offered-load
+    vs p50/p99 with p99 trace exemplars) — the per-round tracking of
+    the <250 ms tail-latency target.
 
 Run directly (exits non-zero on violation) or via the tier-1 test in
 ``tests/test_stage_accounting.py``.
@@ -392,6 +405,58 @@ def reason_vocabulary_problems() -> List[str]:
     return problems
 
 
+def admission_metric_problems(bw_tree: ast.AST) -> List[str]:
+    """Check 10 (counter half): every ``admission.*`` metric the
+    batch worker emits is in the zero-registered ADMISSION_COUNTERS
+    registry, and server.py actually zero-registers it."""
+    problems: List[str] = []
+    registry = _registry_tuple_names(bw_tree, "ADMISSION_COUNTERS")
+    if not registry:
+        return [
+            "could not find the ADMISSION_COUNTERS registry in "
+            "batch_worker.py"
+        ]
+    emitted: Set[str] = set()
+    for node in ast.walk(bw_tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+        ):
+            continue
+        if (
+            node.func.attr in ("incr", "set_gauge", "add_sample")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("admission.")
+        ):
+            emitted.add(node.args[0].value)
+        # _count_admission("<kind>") emits admission.<kind>
+        if (
+            node.func.attr == "_count_admission"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            emitted.add(f"admission.{node.args[0].value}")
+    unregistered = emitted - registry
+    if unregistered:
+        problems.append(
+            "admission.* metrics emitted but not in the "
+            "ADMISSION_COUNTERS registry (they would be absent from "
+            "prometheus scrapes until the first mid-chain "
+            f"admission): {sorted(unregistered)}"
+        )
+    with open(SERVER_MOD) as fh:
+        server_src = fh.read()
+    if "ADMISSION_COUNTERS" not in server_src:
+        problems.append(
+            "server.py no longer zero-registers the admission.* "
+            "family at construction (ADMISSION_COUNTERS preregister)"
+        )
+    return problems
+
+
 def bench_exports_timings(tree: ast.AST, source: str) -> List[str]:
     """Problems with bench.py's stage export (empty list = ok)."""
     problems = []
@@ -413,6 +478,13 @@ def bench_exports_timings(tree: ast.AST, source: str) -> List[str]:
     if '"e2e_stage_times_s"' not in source:
         problems.append(
             "bench.py no longer exports the e2e_stage_times_s JSON key"
+        )
+    # check 11: the paced-arrival latency sweep must keep flowing into
+    # BENCH json (the per-round tail-latency tracking)
+    if '"latency_sweep"' not in source:
+        problems.append(
+            "bench.py no longer exports the latency_sweep JSON block "
+            "(offered-load vs p50/p99 with p99 trace exemplars)"
         )
     return problems
 
@@ -455,6 +527,19 @@ def check() -> Tuple[bool, List[str]]:
             "(rename must update the documented registry): "
             f"{sorted(unregistered)}"
         )
+    # check 10 (span half): the continuous micro-batching admission
+    # stage must stay a registered, documented span name even if its
+    # call sites change shape
+    for required in (
+        "batch_worker.admit",
+        "batch_worker.admit_deferred",
+    ):
+        if required not in registry:
+            problems.append(
+                f"{required!r} missing from trace.SPAN_NAMES — the "
+                "mid-chain admission stage would vanish from every "
+                "trace-keyed dashboard"
+            )
     # accelerator supervisor: span names registered, device.* metrics
     # zero-registered (so prometheus_text() always exports them)
     device_spans: Set[str] = set()
@@ -502,6 +587,7 @@ def check() -> Tuple[bool, List[str]]:
         )
     problems.extend(placement_metric_problems())
     problems.extend(reason_vocabulary_problems())
+    problems.extend(admission_metric_problems(bw_tree))
     with open(BENCH) as fh:
         bench_src = fh.read()
     problems.extend(bench_exports_timings(ast.parse(bench_src), bench_src))
