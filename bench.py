@@ -1314,9 +1314,10 @@ def _preflight() -> None:
         f"({result.get('error')}) — likely a stale tunnel session; "
         "aborting instead of hanging"
     )
-    # round-long retry evidence (tools/tpu_retry_loop.sh): surface the
-    # attempt log so a failed bench records HOW MUCH recovery was
-    # attempted, not just this invocation's preflight
+    # round-long retry evidence (unattended loops over
+    # `python -m nomad_tpu.device.preflight`): surface the attempt
+    # log so a failed bench records HOW MUCH recovery was attempted,
+    # not just this invocation's preflight
     try:
         import glob as _glob
 

@@ -13,7 +13,7 @@ The device subsystem owns accelerator liveness for the whole server:
   every transition is testable on CPU;
 * ``preflight``   — ``python -m nomad_tpu.device.preflight``, the
   bounded canary probe absorbing the ad-hoc checks that used to live
-  in ``bench.py`` and ``tools/tpu_retry_loop.sh``.
+  in ``bench.py`` and the deleted ``tools/tpu_retry_loop.sh`` wrapper.
 """
 from .faults import FaultPlan, InjectedFault
 from .supervisor import (

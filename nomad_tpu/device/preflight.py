@@ -2,16 +2,18 @@
 
 The supervisor's canary probe as a standalone check, absorbing the
 ad-hoc preflight that used to live in ``bench.py`` and the raw retry
-logic in ``tools/tpu_retry_loop.sh``: take the cross-process device
-lock, then retry a bounded-time backend-init + canary kernel until the
-accelerator answers or the deadline passes.
+logic of the (since deleted) ``tools/tpu_retry_loop.sh`` wrapper:
+take the cross-process device lock, then retry a bounded-time
+backend-init + canary kernel until the accelerator answers or the
+deadline passes.
 
 Prints ONE machine-readable state line on stdout::
 
     DEVICE_PREFLIGHT {"state": "HEALTHY", "attempts": 1, ...}
 
 and exits 0 when the device answered (or no accelerator is configured),
-2 otherwise — the contract ``tools/tpu_retry_loop.sh`` scripts against.
+2 otherwise — the contract unattended retry loops script against
+(``while ! python -m nomad_tpu.device.preflight; do sleep ...; done``).
 
 Env knobs: ``NOMAD_TPU_PREFLIGHT_S`` (total budget, default 600; the
 legacy ``BENCH_PREFLIGHT_S`` is honored as a fallback), plus the

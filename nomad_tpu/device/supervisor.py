@@ -460,6 +460,7 @@ class DeviceSupervisor:
             else jax.devices()[0]
         )
         x = jax.device_put(jnp.ones(8), device)
+        # nomadlint: disable=jit-purity -- deliberate per-probe retrace: the canary must exercise the FULL trace+compile+execute+fetch path each probe (a cached wrapper would skip the compile wedge mode)
         return float(jax.jit(lambda a: a + 1)(x).sum())
 
     def _canary_call(self):

@@ -1,0 +1,167 @@
+"""Central registry of every ``NOMAD_TPU_*`` environment knob.
+
+One row per knob: its default, the module that owns (reads) it, and a
+one-line description.  This registry — together with the knob table
+in docs/ARCHITECTURE.md — is enforced by the ``config-drift`` rule of
+``python -m tools.nomadlint``: a knob read anywhere in ``nomad_tpu/``,
+``bench.py`` or ``tests/`` must appear here AND in the docs table, a
+registered knob must still be read somewhere, and a documented knob
+must still be registered.  New knobs therefore cannot ship
+undocumented, and removed ones cannot haunt the docs.
+
+The registry is data, not plumbing: call sites keep reading
+``os.environ`` directly (many are hot-path or import-time reads with
+bespoke parsing/clamping); this module exists so operators and the
+lint have ONE place to look.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+
+class EnvKnob(NamedTuple):
+    default: str  # human-readable default ("" = unset)
+    owner: str  # repo-relative owning module
+    doc: str  # one-line description
+
+
+ENV_KNOBS: Dict[str, EnvKnob] = {
+    # -- batch pipeline (server/batch_worker.py) ----------------------
+    "NOMAD_TPU_BATCH_MAX": EnvKnob(
+        "64", "nomad_tpu/server/batch_worker.py",
+        "max evals per prescore gulp (clamped to [1, 64])",
+    ),
+    "NOMAD_TPU_PARALLEL_REPLAY": EnvKnob(
+        "1", "nomad_tpu/server/batch_worker.py",
+        "0 restores the serial replay loop",
+    ),
+    "NOMAD_TPU_REPLAY_STRICT": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "1 serializes every wave-contended eval (full score-metric "
+        "bit-identity)",
+    ),
+    "NOMAD_TPU_REPLAY_WORKERS": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "replay pool size (0 = auto)",
+    ),
+    "NOMAD_TPU_LATENCY_BUDGET_MS": EnvKnob(
+        "250", "nomad_tpu/server/batch_worker.py",
+        "adaptive gulp cap: keep last-eval latency within this "
+        "budget when the worker keeps up (0 disables)",
+    ),
+    "NOMAD_TPU_ADMIT": EnvKnob(
+        "1", "nomad_tpu/server/batch_worker.py",
+        "0 restores flush-boundary gulps (no mid-chain admission)",
+    ),
+    "NOMAD_TPU_PIPELINE_DEPTH": EnvKnob(
+        "2", "nomad_tpu/server/batch_worker.py",
+        "chunk launches in flight before the host blocks on a fetch",
+    ),
+    "NOMAD_TPU_MESH": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "1 shards prescore launches over the node-axis device mesh",
+    ),
+    "NOMAD_TPU_SYNC_COMPILE": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "1 makes cold kernel compiles block (deterministic tests) "
+        "instead of background-compiling behind the shield",
+    ),
+    # -- server / broker ----------------------------------------------
+    "NOMAD_TPU_WARM_ON_START": EnvKnob(
+        "0", "nomad_tpu/server/server.py",
+        "1 pre-compiles prescore launch shapes off the scheduling "
+        "path once the node-join wave settles",
+    ),
+    "NOMAD_TPU_BROKER_WATCHDOG": EnvKnob(
+        "0", "nomad_tpu/server/eval_broker.py",
+        "1 makes the broker sweeper notify_all() every tick "
+        "(sandbox workaround for parked Condition waits)",
+    ),
+    # -- observability ------------------------------------------------
+    "NOMAD_TPU_TRACE": EnvKnob(
+        "1", "nomad_tpu/trace.py",
+        "0 turns the eval flight recorder into no-ops",
+    ),
+    "NOMAD_TPU_EXPLAIN": EnvKnob(
+        "1", "nomad_tpu/explain.py",
+        "0 turns placement-explanation capture into no-ops",
+    ),
+    # -- accelerator supervisor (nomad_tpu/device) --------------------
+    "NOMAD_TPU_SUPERVISOR": EnvKnob(
+        "auto", "nomad_tpu/device/supervisor.py",
+        "1 forces device supervision on, 0 off (default: on when "
+        "JAX_PLATFORMS names a non-cpu backend or a fault is armed)",
+    ),
+    "NOMAD_TPU_PROBE_INTERVAL_S": EnvKnob(
+        "30", "nomad_tpu/device/supervisor.py",
+        "canary probe cadence",
+    ),
+    "NOMAD_TPU_PROBE_TIMEOUT_S": EnvKnob(
+        "10", "nomad_tpu/device/supervisor.py",
+        "canary probe deadline",
+    ),
+    "NOMAD_TPU_LOST_PROBES": EnvKnob(
+        "2", "nomad_tpu/device/supervisor.py",
+        "consecutive canary failures past DEGRADED before LOST",
+    ),
+    "NOMAD_TPU_RECOVER_CANARIES": EnvKnob(
+        "3", "nomad_tpu/device/supervisor.py",
+        "consecutive canary passes before flipping back HEALTHY",
+    ),
+    "NOMAD_TPU_INIT_GRACE_S": EnvKnob(
+        "600", "nomad_tpu/device/supervisor.py",
+        "deadline floor until the device answers once (cold PJRT "
+        "init must not read as a wedge)",
+    ),
+    "NOMAD_TPU_WATCHDOG_FACTOR": EnvKnob(
+        "20", "nomad_tpu/device/supervisor.py",
+        "launch-watchdog budget = factor x stage EWMA",
+    ),
+    "NOMAD_TPU_WATCHDOG_MIN_S": EnvKnob(
+        "5", "nomad_tpu/device/supervisor.py",
+        "launch-watchdog budget floor",
+    ),
+    "NOMAD_TPU_WATCHDOG_MAX_S": EnvKnob(
+        "120", "nomad_tpu/device/supervisor.py",
+        "launch-watchdog budget ceiling",
+    ),
+    "NOMAD_TPU_FAULT": EnvKnob(
+        "", "nomad_tpu/device/faults.py",
+        "deterministic CPU fault plan "
+        "(wedge_launch|slow_fetch|init_block|flaky[:N])",
+    ),
+    "NOMAD_TPU_PREFLIGHT_S": EnvKnob(
+        "600", "nomad_tpu/device/preflight.py",
+        "total preflight retry budget for "
+        "`python -m nomad_tpu.device.preflight`",
+    ),
+    # -- device lock (nomad_tpu/device_lock.py) -----------------------
+    "NOMAD_TPU_DEVICE_LOCK": EnvKnob(
+        "/tmp/nomad_tpu_device.lock", "nomad_tpu/device_lock.py",
+        "cross-process accelerator lockfile path",
+    ),
+    "NOMAD_TPU_DEVICE_LOCK_WAIT": EnvKnob(
+        "block", "nomad_tpu/device_lock.py",
+        "seconds to wait for the device lock before giving up "
+        "(default: block forever)",
+    ),
+    # -- client -------------------------------------------------------
+    "NOMAD_TPU_EXEC_ISOLATION": EnvKnob(
+        "1", "nomad_tpu/client/drivers/exec.py",
+        "0 forces the in-process restricted-env spawn instead of "
+        "the isolated executor process",
+    ),
+    "NOMAD_TPU_FINGERPRINT_TIMEOUT_S": EnvKnob(
+        "20", "nomad_tpu/client/fingerprint.py",
+        "bounded TPU device-probe deadline during fingerprinting",
+    ),
+    "NOMAD_TPU_EXECUTOR_STATE": EnvKnob(
+        "auto", "nomad_tpu/client/executor.py",
+        "executor state directory (default: per-user temp dir)",
+    ),
+    # -- tests --------------------------------------------------------
+    "NOMAD_TPU_SOAK": EnvKnob(
+        "0", "tests/test_soak.py",
+        "1 opts in to the long-running soak tests",
+    ),
+}

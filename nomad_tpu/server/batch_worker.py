@@ -3332,6 +3332,7 @@ class BatchWorker(Worker):
                     ):
                         vals = np.zeros(width, dtype=src.dtype)
                         vals[: len(idx)] = src[idx]
+                        # nomadlint: disable=donation-safety -- verified safe: cache["cols"] is replaced by the patched outputs below before any later read, and the except path drops the whole mirror so a partially-donated sync can never be re-read
                         patched.append(patch(col, idx_p, vals))
                 except Exception:
                     # a partially-donated sync leaves already-deleted

@@ -1,80 +1,33 @@
 #!/usr/bin/env python3
-"""Stage-accounting lint: every pipeline stage the BatchWorker tracks
-must actually be observed and must flow into the bench output.
+"""Compatibility shim over ``tools/nomadlint``.
 
-Guards the invariant that keeps per-stage time attributable across
-rounds (a new stage added to ``BatchWorker.timings`` without an
-``_observe`` call, or a bench that stops exporting the timings dict
-wholesale, would silently vanish from BENCH_*.json and /v1/metrics):
+The 11 stage-accounting checks that used to live here as a 608-line
+monolith are now individual rules in the pluggable AST analysis suite
+(``tools/nomadlint/rules/stage_accounting.py`` — run them with
+``python -m tools.nomadlint``, which also carries the newer donation-
+safety / jit-purity / lock-discipline / config-drift passes).
 
-1. every key in the ``self.timings = {...}`` literal in
-   ``nomad_tpu/server/batch_worker.py`` appears in at least one
-   ``self._observe("<key>", ...)`` call;
-2. every ``self._observe("<key>", ...)`` call uses a declared key
-   (no orphan stages accumulating into nothing);
-3. ``bench.py`` builds its stage times from ``worker.timings``
-   wholesale (``dict(worker.timings)``) and exports them under the
-   ``e2e_stage_times_s`` JSON key, so new stages flow through without
-   a bench edit;
-4. every flight-recorder span/event name used in
-   ``batch_worker.py`` and ``plan_apply.py`` (``TRACE.span(...)``,
-   ``TRACE.add_span(...)``, ``TRACE.event(...)``) is declared in the
-   ``SPAN_NAMES`` registry in ``nomad_tpu/trace.py`` — a renamed
-   stage must update the documented registry (and with it every
-   dashboard/report keyed on the name), never drift silently;
-5. every span/event name used by the accelerator supervisor
-   (``nomad_tpu/device/*.py``) is declared in ``SPAN_NAMES`` too, and
-   every ``device.*`` counter/gauge/sample it emits appears in the
-   ``METRIC_COUNTERS``/``METRIC_GAUGES``/``METRIC_SAMPLES`` registry
-   literals in ``device/supervisor.py`` — those are zero-registered
-   at supervisor construction, which is what guarantees
-   ``prometheus_text()`` exports the whole ``device.*`` family before
-   the first incident;
-6. the operator debug bundle (``cli.py`` ``cmd_operator_debug``)
-   captures ``/v1/device``, so a bundle from a degraded server always
-   carries the supervisor's state history;
-7. placement explainability (``nomad_tpu/explain.py``): every
-   ``placement.*`` metric name emitted is zero-registered — literal
-   names must appear in the ``PLACEMENT_COUNTERS``/
-   ``PLACEMENT_GAUGES`` registries, and f-string emissions may only
-   interpolate through the fixed ``reason_slug``/``dimension_slug``
-   vocabularies — and the server zero-registers the family at
-   construction;
-8. the vectorized path's filter-reason strings come from the shared
-   serial-chain constants: a string literal passed to
-   ``filter_node(...)`` in ``sched/tpu_stack.py`` must be one of the
-   ``FILTER_*`` constants' values (``sched/feasible.py``), and a
-   literal ``exhausted_node(...)`` dimension must be in the
-   ``allocs_fit`` superset vocabulary — ad-hoc strings would silently
-   drift from the serial path's vocabulary (and from the
-   ``placement.filtered.<slug>`` counter families keyed on it);
-9. the operator debug bundle captures ``/v1/placements`` so the
-   per-eval explanations travel with the traces they cross-reference;
-10. continuous micro-batching observability: the
-    ``batch_worker.admit`` span (and ``batch_worker.admit_deferred``
-    event) are declared in ``SPAN_NAMES``, and every ``admission.*``
-    counter the worker emits (literal first args of
-    ``incr/set_gauge/add_sample`` plus the ``self._count_admission(
-    "<kind>")`` call sites, which emit ``admission.<kind>``) appears
-    in the ``ADMISSION_COUNTERS`` registry literal in
-    ``batch_worker.py`` — which ``server.py`` zero-registers at
-    construction, so prometheus scrapes export the family before the
-    first mid-chain admission;
-11. bench.py exports the ``latency_sweep`` JSON block (offered-load
-    vs p50/p99 with p99 trace exemplars) — the per-round tracking of
-    the <250 ms tail-latency target.
-
-Run directly (exits non-zero on violation) or via the tier-1 test in
-``tests/test_stage_accounting.py``.
+This module keeps the original surface — the path globals, the AST
+helpers and ``check() -> (ok, [problem strings])`` — so
+``tests/test_stage_accounting.py`` and operator muscle memory keep
+working unmodified.  The path globals are read at call time: tests
+monkeypatch them to point single files at mutated copies, and
+``check()`` forwards them as nomadlint Context overrides.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Set, Tuple
+from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.nomadlint import astutil as _astutil  # noqa: E402
+from tools.nomadlint.core import Context, run  # noqa: E402
+from tools.nomadlint.rules import MIGRATED_RULES  # noqa: E402
+
 BATCH_WORKER = os.path.join(
     REPO, "nomad_tpu", "server", "batch_worker.py"
 )
@@ -91,506 +44,40 @@ TPU_STACK = os.path.join(REPO, "nomad_tpu", "sched", "tpu_stack.py")
 FEASIBLE = os.path.join(REPO, "nomad_tpu", "sched", "feasible.py")
 SERVER_MOD = os.path.join(REPO, "nomad_tpu", "server", "server.py")
 
-# allocs_fit / BinPackIterator exhaustion-dimension vocabulary a
-# literal exhausted_node() in the vectorized path may use
-EXHAUST_DIMENSIONS = {"cpu", "memory", "disk"}
-
-# the trace-recording call surface (nomad_tpu/trace.py Tracer)
-_TRACE_CALLS = {"span", "add_span", "event"}
-
-
-def _parse(path: str) -> ast.AST:
-    with open(path) as fh:
-        return ast.parse(fh.read(), filename=path)
+# historical helper API, re-exported from the nomadlint toolbox
+_parse = _astutil.parse
+timings_keys = _astutil.timings_keys
+observed_keys = _astutil.observed_keys
+span_names_used = _astutil.span_names_used
+span_registry = _astutil.span_registry
 
 
-def timings_keys(tree: ast.AST) -> Set[str]:
-    """Keys of the ``self.timings = {...}`` dict literal."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        for target in node.targets:
-            if (
-                isinstance(target, ast.Attribute)
-                and target.attr == "timings"
-                and isinstance(node.value, ast.Dict)
-            ):
-                return {
-                    k.value
-                    for k in node.value.keys
-                    if isinstance(k, ast.Constant)
-                }
-    return set()
-
-
-def observed_keys(tree: ast.AST) -> Set[str]:
-    """First-arg string constants of every ``._observe(...)`` call
-    (``._observe_chunk`` delegates its stage key to ``_observe``, so
-    its call sites count too)."""
-    out: Set[str] = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ("_observe", "_observe_chunk")
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-        ):
-            out.add(node.args[0].value)
-    return out
-
-
-def span_names_used(tree: ast.AST) -> Set[str]:
-    """Span/event name literals passed to ``.span/.add_span/.event``
-    calls.  The name is the first *string-constant* positional (the
-    leading positional is the eval-id expression, never a literal).
-    ``._observe_chunk("<stage>", ...)`` emits its span name as
-    f"batch_worker.{stage}" — a non-constant the AST scan can't see —
-    so its stage constants count as that derived name here."""
-    out: Set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not isinstance(
-            node.func, ast.Attribute
-        ):
-            continue
-        if (
-            node.func.attr == "_observe_chunk"
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-        ):
-            out.add(f"batch_worker.{node.args[0].value}")
-            continue
-        if node.func.attr not in _TRACE_CALLS:
-            continue
-        for arg in node.args:
-            if isinstance(arg, ast.Constant) and isinstance(
-                arg.value, str
-            ):
-                out.add(arg.value)
-                break
-    return out
-
-
-def span_registry(tree: ast.AST) -> Set[str]:
-    """String constants inside the ``SPAN_NAMES = frozenset({...})``
-    assignment in nomad_tpu/trace.py."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        for target in node.targets:
-            if (
-                isinstance(target, ast.Name)
-                and target.id == "SPAN_NAMES"
-            ):
-                return {
-                    n.value
-                    for n in ast.walk(node.value)
-                    if isinstance(n, ast.Constant)
-                    and isinstance(n.value, str)
-                }
-    return set()
-
-
-def device_metric_names(tree: ast.AST) -> Set[str]:
-    """``device.*`` metric-name literals emitted anywhere in a device
-    module: first string-constant positional of ``.incr(...)``,
-    ``.set_gauge(...)`` or ``.add_sample(...)`` calls."""
-    out: Set[str] = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ("incr", "set_gauge", "add_sample")
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-            and node.args[0].value.startswith("device.")
-        ):
-            out.add(node.args[0].value)
-    return out
-
-
-def device_metric_registry(tree: ast.AST) -> Set[str]:
-    """String constants inside the ``METRIC_COUNTERS`` /
-    ``METRIC_GAUGES`` / ``METRIC_SAMPLES`` frozenset literals in
-    device/supervisor.py (the names zero-registered at supervisor
-    construction, hence always present in ``prometheus_text()``)."""
-    out: Set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        for target in node.targets:
-            if isinstance(target, ast.Name) and target.id in (
-                "METRIC_COUNTERS",
-                "METRIC_GAUGES",
-                "METRIC_SAMPLES",
-            ):
-                out |= {
-                    n.value
-                    for n in ast.walk(node.value)
-                    if isinstance(n, ast.Constant)
-                    and isinstance(n.value, str)
-                }
-    return out
-
-
-def _device_module_paths() -> List[str]:
-    return sorted(
-        os.path.join(DEVICE_DIR, name)
-        for name in os.listdir(DEVICE_DIR)
-        if name.endswith(".py")
+def _context() -> Context:
+    """Context bound to this module's (possibly monkeypatched) path
+    globals."""
+    return Context(
+        REPO,
+        overrides={
+            "batch_worker": BATCH_WORKER,
+            "plan_apply": PLAN_APPLY,
+            "trace": TRACE_MOD,
+            "bench": BENCH,
+            "device_dir": DEVICE_DIR,
+            "device_supervisor": DEVICE_SUPERVISOR,
+            "cli": CLI,
+            "explain": EXPLAIN_MOD,
+            "tpu_stack": TPU_STACK,
+            "feasible": FEASIBLE,
+            "server": SERVER_MOD,
+        },
     )
-
-
-def _registry_tuple_names(tree: ast.AST, target_name: str) -> Set[str]:
-    """String constants reachable inside a module-level assignment
-    (handles the PLACEMENT_COUNTERS tuple-of-f-strings construction by
-    collecting the slug tuples it references too — callers pass the
-    pre-joined prefix checks separately)."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        for target in node.targets:
-            if (
-                isinstance(target, ast.Name)
-                and target.id == target_name
-            ):
-                return {
-                    n.value
-                    for n in ast.walk(node.value)
-                    if isinstance(n, ast.Constant)
-                    and isinstance(n.value, str)
-                }
-    return set()
-
-
-def placement_metric_problems() -> List[str]:
-    """Check 7: placement.* emissions in explain.py stay inside the
-    zero-registered families.  Literal names must be registered
-    verbatim; f-string names may only be `placement.filtered.{...}` /
-    `placement.exhausted.{...}` with the slug produced by
-    reason_slug()/dimension_slug() (the fixed vocabularies)."""
-    problems: List[str] = []
-    tree = _parse(EXPLAIN_MOD)
-    counters = _registry_tuple_names(tree, "PLACEMENT_COUNTERS")
-    gauges = _registry_tuple_names(tree, "PLACEMENT_GAUGES")
-    filter_slugs = _registry_tuple_names(
-        tree, "PLACEMENT_FILTER_SLUGS"
-    )
-    exhaust_slugs = _registry_tuple_names(
-        tree, "PLACEMENT_EXHAUST_SLUGS"
-    )
-    if not (counters and gauges and filter_slugs and exhaust_slugs):
-        return [
-            "could not find the PLACEMENT_* registries in "
-            "nomad_tpu/explain.py"
-        ]
-    registered = (
-        counters
-        | gauges
-        | {f"placement.filtered.{s}" for s in filter_slugs}
-        | {f"placement.exhausted.{s}" for s in exhaust_slugs}
-    )
-    slug_fns = {"reason_slug", "dimension_slug"}
-    for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ("incr", "set_gauge", "add_sample")
-            and node.args
-        ):
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(
-            arg.value, str
-        ):
-            if arg.value.startswith("placement.") and (
-                arg.value not in registered
-            ):
-                problems.append(
-                    f"placement metric {arg.value!r} emitted but not "
-                    "in the zero-registered PLACEMENT_* registries"
-                )
-            continue
-        if isinstance(arg, ast.JoinedStr):
-            prefix = ""
-            if arg.values and isinstance(arg.values[0], ast.Constant):
-                prefix = str(arg.values[0].value)
-            if not prefix.startswith("placement."):
-                continue
-            if prefix not in (
-                "placement.filtered.",
-                "placement.exhausted.",
-            ):
-                problems.append(
-                    f"dynamic placement metric prefix {prefix!r} has "
-                    "no zero-registered family"
-                )
-                continue
-            for part in arg.values[1:]:
-                if not isinstance(part, ast.FormattedValue):
-                    continue
-                call = part.value
-                ok = (
-                    isinstance(call, ast.Call)
-                    and isinstance(call.func, ast.Name)
-                    and call.func.id in slug_fns
-                )
-                if not ok:
-                    problems.append(
-                        f"placement metric family {prefix!r} "
-                        "interpolates a value not produced by "
-                        "reason_slug()/dimension_slug() — the name "
-                        "space would be unbounded"
-                    )
-    with open(SERVER_MOD) as fh:
-        server_src = fh.read()
-    if "preregister" not in server_src or "explain" not in server_src:
-        problems.append(
-            "server.py no longer zero-registers the placement.* "
-            "families at construction (explain.preregister)"
-        )
-    return problems
-
-
-def reason_vocabulary_problems() -> List[str]:
-    """Check 8: reason-string literals used by the vectorized path
-    must come from the serial chain's shared vocabulary."""
-    problems: List[str] = []
-    feasible_tree = _parse(FEASIBLE)
-    allowed: Set[str] = set()
-    for node in ast.walk(feasible_tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        for target in node.targets:
-            if (
-                isinstance(target, ast.Name)
-                and target.id.startswith("FILTER_")
-                and isinstance(node.value, ast.Constant)
-                and isinstance(node.value.value, str)
-            ):
-                allowed.add(node.value.value)
-    if not allowed:
-        return [
-            "could not find the FILTER_* reason constants in "
-            "sched/feasible.py"
-        ]
-    for node in ast.walk(_parse(TPU_STACK)):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and len(node.args) >= 2
-            and isinstance(node.args[1], ast.Constant)
-            and isinstance(node.args[1].value, str)
-        ):
-            continue
-        literal = node.args[1].value
-        if node.func.attr == "filter_node" and literal not in allowed:
-            problems.append(
-                "ad-hoc filter reason literal in sched/tpu_stack.py: "
-                f"{literal!r} is not a shared FILTER_* constant value "
-                "(import the constant instead)"
-            )
-        if (
-            node.func.attr == "exhausted_node"
-            and literal not in EXHAUST_DIMENSIONS
-        ):
-            problems.append(
-                "ad-hoc exhaustion dimension literal in "
-                f"sched/tpu_stack.py: {literal!r} is outside the "
-                "allocs_fit superset vocabulary"
-            )
-    return problems
-
-
-def admission_metric_problems(bw_tree: ast.AST) -> List[str]:
-    """Check 10 (counter half): every ``admission.*`` metric the
-    batch worker emits is in the zero-registered ADMISSION_COUNTERS
-    registry, and server.py actually zero-registers it."""
-    problems: List[str] = []
-    registry = _registry_tuple_names(bw_tree, "ADMISSION_COUNTERS")
-    if not registry:
-        return [
-            "could not find the ADMISSION_COUNTERS registry in "
-            "batch_worker.py"
-        ]
-    emitted: Set[str] = set()
-    for node in ast.walk(bw_tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-        ):
-            continue
-        if (
-            node.func.attr in ("incr", "set_gauge", "add_sample")
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-            and node.args[0].value.startswith("admission.")
-        ):
-            emitted.add(node.args[0].value)
-        # _count_admission("<kind>") emits admission.<kind>
-        if (
-            node.func.attr == "_count_admission"
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-        ):
-            emitted.add(f"admission.{node.args[0].value}")
-    unregistered = emitted - registry
-    if unregistered:
-        problems.append(
-            "admission.* metrics emitted but not in the "
-            "ADMISSION_COUNTERS registry (they would be absent from "
-            "prometheus scrapes until the first mid-chain "
-            f"admission): {sorted(unregistered)}"
-        )
-    with open(SERVER_MOD) as fh:
-        server_src = fh.read()
-    if "ADMISSION_COUNTERS" not in server_src:
-        problems.append(
-            "server.py no longer zero-registers the admission.* "
-            "family at construction (ADMISSION_COUNTERS preregister)"
-        )
-    return problems
-
-
-def bench_exports_timings(tree: ast.AST, source: str) -> List[str]:
-    """Problems with bench.py's stage export (empty list = ok)."""
-    problems = []
-    wholesale = any(
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Name)
-        and node.func.id == "dict"
-        and node.args
-        and isinstance(node.args[0], ast.Attribute)
-        and node.args[0].attr == "timings"
-        for node in ast.walk(tree)
-    )
-    if not wholesale:
-        problems.append(
-            "bench.py no longer snapshots the stage times wholesale "
-            "(expected a dict(worker.timings) call) — new stages "
-            "would silently drop from the bench"
-        )
-    if '"e2e_stage_times_s"' not in source:
-        problems.append(
-            "bench.py no longer exports the e2e_stage_times_s JSON key"
-        )
-    # check 11: the paced-arrival latency sweep must keep flowing into
-    # BENCH json (the per-round tail-latency tracking)
-    if '"latency_sweep"' not in source:
-        problems.append(
-            "bench.py no longer exports the latency_sweep JSON block "
-            "(offered-load vs p50/p99 with p99 trace exemplars)"
-        )
-    return problems
 
 
 def check() -> Tuple[bool, List[str]]:
-    problems: List[str] = []
-    bw_tree = _parse(BATCH_WORKER)
-    declared = timings_keys(bw_tree)
-    observed = observed_keys(bw_tree)
-    if not declared:
-        problems.append(
-            "could not find the self.timings literal in "
-            "batch_worker.py"
-        )
-    unobserved = declared - observed
-    if unobserved:
-        problems.append(
-            "timings keys never passed to _observe "
-            f"(stage time would stay 0 forever): {sorted(unobserved)}"
-        )
-    orphans = observed - declared
-    if orphans:
-        problems.append(
-            "_observe calls with keys missing from the timings "
-            f"literal (would KeyError at runtime): {sorted(orphans)}"
-        )
-    registry = span_registry(_parse(TRACE_MOD))
-    if not registry:
-        problems.append(
-            "could not find the SPAN_NAMES registry in "
-            "nomad_tpu/trace.py"
-        )
-    used = span_names_used(bw_tree) | span_names_used(
-        _parse(PLAN_APPLY)
-    )
-    unregistered = used - registry
-    if unregistered:
-        problems.append(
-            "span names used but missing from trace.SPAN_NAMES "
-            "(rename must update the documented registry): "
-            f"{sorted(unregistered)}"
-        )
-    # check 10 (span half): the continuous micro-batching admission
-    # stage must stay a registered, documented span name even if its
-    # call sites change shape
-    for required in (
-        "batch_worker.admit",
-        "batch_worker.admit_deferred",
-    ):
-        if required not in registry:
-            problems.append(
-                f"{required!r} missing from trace.SPAN_NAMES — the "
-                "mid-chain admission stage would vanish from every "
-                "trace-keyed dashboard"
-            )
-    # accelerator supervisor: span names registered, device.* metrics
-    # zero-registered (so prometheus_text() always exports them)
-    device_spans: Set[str] = set()
-    device_metrics: Set[str] = set()
-    for path in _device_module_paths():
-        tree = _parse(path)
-        device_spans |= span_names_used(tree)
-        device_metrics |= device_metric_names(tree)
-    unregistered = device_spans - registry
-    if unregistered:
-        problems.append(
-            "device-supervisor span names missing from "
-            f"trace.SPAN_NAMES: {sorted(unregistered)}"
-        )
-    metric_registry = device_metric_registry(
-        _parse(DEVICE_SUPERVISOR)
-    )
-    if not metric_registry:
-        problems.append(
-            "could not find the METRIC_COUNTERS/GAUGES/SAMPLES "
-            "registry in device/supervisor.py"
-        )
-    unexported = device_metrics - metric_registry
-    if unexported:
-        problems.append(
-            "device.* metrics emitted but not in the supervisor's "
-            "zero-registered registry (they would be absent from "
-            f"prometheus_text() until the first incident): "
-            f"{sorted(unexported)}"
-        )
-    with open(CLI) as fh:
-        cli_src = fh.read()
-    bundle_src = cli_src.split("cmd_operator_debug", 1)[-1].split(
-        "def ", 1
-    )[0]
-    if '"/v1/device"' not in bundle_src:
-        problems.append(
-            "the operator debug bundle (cli.cmd_operator_debug) no "
-            "longer captures /v1/device"
-        )
-    if "/v1/placements" not in bundle_src:
-        problems.append(
-            "the operator debug bundle (cli.cmd_operator_debug) no "
-            "longer captures /v1/placements"
-        )
-    problems.extend(placement_metric_problems())
-    problems.extend(reason_vocabulary_problems())
-    problems.extend(admission_metric_problems(bw_tree))
-    with open(BENCH) as fh:
-        bench_src = fh.read()
-    problems.extend(bench_exports_timings(ast.parse(bench_src), bench_src))
+    """Run the 11 migrated stage-accounting rules; returns
+    ``(ok, [problem strings])`` like the historical monolith."""
+    result = run(_context(), MIGRATED_RULES)
+    problems = [f.message for f in result.findings]
     return not problems, problems
 
 

@@ -1,0 +1,28 @@
+"""nomadlint: the repo's pluggable AST static-analysis suite.
+
+    python -m tools.nomadlint            # run all rules on the repo
+    python -m tools.nomadlint --json     # machine-readable findings
+    python -m tools.nomadlint --list-rules
+    python -m tools.nomadlint --rules donation-safety,jit-purity
+    python -m tools.nomadlint --files path/to/file.py  # narrow scan
+    python -m tools.nomadlint --selfcheck  # every rule trips its
+                                           # bad fixture
+
+Exit codes: 0 = no unsuppressed findings, 1 = findings, 2 = usage.
+
+The 11 historical stage-accounting checks live here as rules (see
+``rules/stage_accounting.py``); ``tools/check_stage_accounting.py``
+is a compatibility shim over them.  Four newer passes target the
+donated/speculative/multi-threaded hot path: ``donation-safety``,
+``jit-purity``, ``lock-discipline`` and ``config-drift``.  See the
+"Static analysis" section of docs/ARCHITECTURE.md for the rule
+inventory, the suppression syntax and how to add a rule.
+"""
+from .core import (  # noqa: F401
+    Context,
+    Finding,
+    Rule,
+    all_rules,
+    register,
+    run,
+)

@@ -1,0 +1,147 @@
+"""CLI runner: ``python -m tools.nomadlint``."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import List
+
+from .core import Context, all_rules, run
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def selfcheck(ctx: Context) -> int:
+    """Every rule must trip on its bad fixture and stay quiet on its
+    clean fixture — the framework's own acceptance gate."""
+    rc = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        for cls in all_rules():
+            bad_ctx = cls.bad_fixture(ctx, tmp)
+            tripped = [
+                f
+                for f in cls().check(bad_ctx)
+                if f.rule == cls.name
+            ]
+            if not tripped:
+                print(
+                    f"SELFCHECK FAIL: rule {cls.name} did not "
+                    "trip on its bad fixture",
+                    file=sys.stderr,
+                )
+                rc = 1
+            clean_ctx = cls.clean_fixture(ctx, tmp)
+            quiet = cls().check(clean_ctx)
+            if clean_ctx is not ctx and quiet:
+                print(
+                    f"SELFCHECK FAIL: rule {cls.name} tripped on "
+                    f"its clean fixture: {quiet[0].message}",
+                    file=sys.stderr,
+                )
+                rc = 1
+            print(
+                f"selfcheck {cls.name}: bad fixture -> "
+                f"{len(tripped)} finding(s)"
+            )
+    return rc
+
+
+def main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.nomadlint",
+        description=(
+            "pluggable AST static analysis for this repo "
+            "(donation safety, jit purity, lock discipline, "
+            "config/registry drift, stage accounting)"
+        ),
+    )
+    parser.add_argument(
+        "--repo", default=REPO, help="repo root to lint"
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule subset (default: all)",
+    )
+    parser.add_argument(
+        "--files",
+        nargs="+",
+        help=(
+            "restrict repo-wide rules to these files (single-file "
+            "rules still read their fixed targets)"
+        ),
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule inventory and exit",
+    )
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="verify every rule trips its bad fixture",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in all_rules():
+            print(f"{cls.name:24s} {cls.description}")
+        return 0
+
+    overrides = {}
+    if args.files:
+        overrides["scan_files"] = [
+            os.path.abspath(f) for f in args.files
+        ]
+    ctx = Context(args.repo, overrides)
+
+    if args.selfcheck:
+        return selfcheck(Context(args.repo))
+
+    rule_names = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    try:
+        result = run(ctx, rule_names)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "ok": result.ok,
+                    "rules_run": result.rules_run,
+                    "findings": [
+                        f.to_dict(ctx.repo)
+                        for f in result.findings
+                    ],
+                    "suppressed": [
+                        f.to_dict(ctx.repo)
+                        for f in result.suppressed
+                    ],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in result.findings:
+            print(f.render(ctx.repo), file=sys.stderr)
+        print(
+            f"nomadlint: {len(result.rules_run)} rule(s), "
+            f"{len(result.findings)} finding(s), "
+            f"{len(result.suppressed)} suppressed"
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,244 @@
+"""Shared AST extraction helpers for nomadlint rules.
+
+These started life inside ``tools/check_stage_accounting.py`` (the
+608-line monolith the rule suite replaced); the compat shim re-exports
+them so the historical helper API keeps working.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+# the trace-recording call surface (nomad_tpu/trace.py Tracer)
+TRACE_CALLS = {"span", "add_span", "event"}
+
+# the telemetry emission surface (nomad_tpu/telemetry.py Metrics)
+METRIC_CALLS = ("incr", "set_gauge", "add_sample")
+
+
+def parse(path: str) -> ast.AST:
+    with open(path) as fh:
+        return ast.parse(fh.read(), filename=path)
+
+
+def read(path: str) -> str:
+    with open(path) as fh:
+        return fh.read()
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def timings_keys(tree: ast.AST) -> Set[str]:
+    """Keys of the ``self.timings = {...}`` dict literal."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and target.attr == "timings"
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                }
+    return set()
+
+
+def observed_keys(tree: ast.AST) -> Set[str]:
+    """First-arg string constants of every ``._observe(...)`` call
+    (``._observe_chunk`` delegates its stage key to ``_observe``, so
+    its call sites count too)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("_observe", "_observe_chunk")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def span_names_used(tree: ast.AST) -> Set[str]:
+    """Span/event name literals passed to ``.span/.add_span/.event``
+    calls.  The name is the first *string-constant* positional (the
+    leading positional is the eval-id expression, never a literal).
+    ``._observe_chunk("<stage>", ...)`` emits its span name as
+    f"batch_worker.{stage}" — a non-constant the AST scan can't see —
+    so its stage constants count as that derived name here."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if (
+            node.func.attr == "_observe_chunk"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(f"batch_worker.{node.args[0].value}")
+            continue
+        if node.func.attr not in TRACE_CALLS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ):
+                out.add(arg.value)
+                break
+    return out
+
+
+def span_registry(tree: ast.AST) -> Set[str]:
+    """String constants inside the ``SPAN_NAMES = frozenset({...})``
+    assignment in nomad_tpu/trace.py."""
+    return assigned_strings(tree, "SPAN_NAMES")
+
+
+def assigned_strings(tree: ast.AST, target_name: str) -> Set[str]:
+    """String constants reachable inside a module-level assignment to
+    ``target_name`` (registries are frozenset/tuple/dict literals —
+    collecting every string constant under the value covers all)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == target_name
+            ):
+                return {
+                    n.value
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+    return set()
+
+
+def dict_key_strings(tree: ast.AST, target_name: str) -> Set[str]:
+    """String KEYS of a module-level ``target_name = {...}`` dict
+    literal, annotated or not (values — defaults, owners, prose —
+    are not keys and must not leak into a registry extraction)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for target in targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == target_name
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+    return set()
+
+
+def metric_names_emitted(
+    tree: ast.AST, prefix: str
+) -> Set[str]:
+    """Metric-name literals with ``prefix`` emitted anywhere in a
+    module: first string-constant positional of ``.incr(...)``,
+    ``.set_gauge(...)`` or ``.add_sample(...)`` calls."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in METRIC_CALLS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith(prefix)
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def device_metric_registry(tree: ast.AST) -> Set[str]:
+    """String constants inside the ``METRIC_COUNTERS`` /
+    ``METRIC_GAUGES`` / ``METRIC_SAMPLES`` frozenset literals in
+    device/supervisor.py (the names zero-registered at supervisor
+    construction, hence always present in ``prometheus_text()``)."""
+    out: Set[str] = set()
+    for name in ("METRIC_COUNTERS", "METRIC_GAUGES", "METRIC_SAMPLES"):
+        out |= assigned_strings(tree, name)
+    return out
+
+
+def string_constants(
+    tree: ast.AST, *, skip_docstrings: bool = True
+) -> List[Tuple[str, int]]:
+    """All string constants in a module as (value, lineno), optionally
+    excluding docstrings (the first statement-expression string of a
+    module/class/function body)."""
+    doc_nodes: Set[int] = set()
+    if skip_docstrings:
+        for node in ast.walk(tree):
+            if isinstance(
+                node,
+                (
+                    ast.Module,
+                    ast.ClassDef,
+                    ast.FunctionDef,
+                    ast.AsyncFunctionDef,
+                ),
+            ):
+                body = getattr(node, "body", [])
+                if (
+                    body
+                    and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)
+                ):
+                    doc_nodes.add(id(body[0].value))
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in doc_nodes
+        ):
+            out.append((node.value, node.lineno))
+    return out
+
+
+def functions_by_name(
+    tree: ast.AST,
+) -> Dict[str, ast.FunctionDef]:
+    """Every (possibly nested) FunctionDef in a module by bare name.
+    On name collisions the first definition wins — good enough for the
+    module-local call resolution the rules do."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            out.setdefault(node.name, node)
+    return out

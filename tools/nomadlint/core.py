@@ -1,0 +1,342 @@
+"""nomadlint core: rule base class, findings, suppressions, runner.
+
+A rule is a class with a ``name``, a ``description`` and a
+``check(ctx) -> List[Finding]``.  Rules read repo files through a
+``Context`` so tests (and the ``check_stage_accounting`` compat shim)
+can point individual files at mutated copies without touching the
+working tree.
+
+Suppressions are source comments::
+
+    expr_that_trips()  # nomadlint: disable=<rule>[,<rule>...] -- why
+
+or, on their own line, applying to the next line::
+
+    # nomadlint: disable=<rule> -- why
+    expr_that_trips()
+
+``disable=all`` suppresses every rule on the line.  The justification
+(`` -- why``) is mandatory: a suppression without one is itself
+reported (rule ``bare-suppression``) — every deliberate skip must say
+why it is safe.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+# repo-relative default locations of the files rules inspect; a
+# Context override (keyed by the short name) substitutes a copy.
+DEFAULT_PATHS: Dict[str, str] = {
+    "batch_worker": "nomad_tpu/server/batch_worker.py",
+    "plan_apply": "nomad_tpu/server/plan_apply.py",
+    "trace": "nomad_tpu/trace.py",
+    "bench": "bench.py",
+    "device_dir": "nomad_tpu/device",
+    "device_supervisor": "nomad_tpu/device/supervisor.py",
+    "cli": "nomad_tpu/cli.py",
+    "explain": "nomad_tpu/explain.py",
+    "tpu_stack": "nomad_tpu/sched/tpu_stack.py",
+    "feasible": "nomad_tpu/sched/feasible.py",
+    "server": "nomad_tpu/server/server.py",
+    "envknobs": "nomad_tpu/envknobs.py",
+    "arch_doc": "docs/ARCHITECTURE.md",
+    "state_dir": "nomad_tpu/state",
+    "package": "nomad_tpu",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*nomadlint:\s*disable=([A-Za-z0-9_,\- ]+?)"
+    r"(?:\s+--\s+(\S.*))?\s*$"
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # absolute
+    line: int  # 1-based; 0 = whole-file / cross-file finding
+    message: str
+
+    def rel(self, repo: str) -> str:
+        try:
+            return os.path.relpath(self.path, repo)
+        except ValueError:
+            return self.path
+
+    def to_dict(self, repo: str) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.rel(repo),
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def render(self, repo: str) -> str:
+        loc = f"{self.rel(repo)}:{self.line}" if self.line else (
+            self.rel(repo)
+        )
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+class Context:
+    """Resolved file paths + parse caches for one lint run."""
+
+    def __init__(
+        self,
+        repo: str,
+        overrides: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.repo = os.path.abspath(repo)
+        self.overrides: Dict[str, str] = dict(overrides or {})
+        self._trees: Dict[str, ast.AST] = {}
+        self._sources: Dict[str, str] = {}
+
+    # -- path resolution ----------------------------------------------
+
+    def default_path(self, key: str) -> str:
+        return os.path.join(self.repo, *DEFAULT_PATHS[key].split("/"))
+
+    def path(self, key: str) -> str:
+        return self.overrides.get(key, self.default_path(key))
+
+    def scan_files(self, default_key: str = "package") -> List[str]:
+        """Python files a repo-wide rule should scan.  A
+        ``scan_files`` override (fixture runs) replaces the walk;
+        otherwise the ``default_key`` tree is walked with single-file
+        overrides substituted (so a rule pointed at a mutated
+        batch_worker copy sees the copy, not the original)."""
+        override = self.overrides.get("scan_files")
+        if override is not None:
+            return list(override)
+        subst = {
+            self.default_path(k): v
+            for k, v in self.overrides.items()
+            if k not in ("scan_files",) and isinstance(v, str)
+        }
+        out: List[str] = []
+        for dirpath, dirnames, filenames in os.walk(
+            self.path(default_key)
+        ):
+            dirnames[:] = [
+                d for d in dirnames if d != "__pycache__"
+            ]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                out.append(subst.get(p, p))
+        return out
+
+    # -- cached IO ----------------------------------------------------
+
+    def source(self, path: str) -> str:
+        if path not in self._sources:
+            with open(path) as fh:
+                self._sources[path] = fh.read()
+        return self._sources[path]
+
+    def tree(self, path: str) -> ast.AST:
+        if path not in self._trees:
+            self._trees[path] = ast.parse(
+                self.source(path), filename=path
+            )
+        return self._trees[path]
+
+    def with_overrides(self, **kw: object) -> "Context":
+        merged = dict(self.overrides)
+        merged.update(kw)  # type: ignore[arg-type]
+        return Context(self.repo, merged)
+
+
+class Rule:
+    """Base class.  Subclasses set ``name``/``description`` and
+    implement ``check``; ``bad_fixture`` returns a Context on which
+    the rule MUST report at least one finding (the self-test the
+    runner's ``--selfcheck`` and tests/test_nomadlint.py exercise)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: Context) -> List[Finding]:
+        raise NotImplementedError
+
+    @classmethod
+    def bad_fixture(cls, ctx: Context, tmpdir: str) -> Context:
+        raise NotImplementedError(
+            f"rule {cls.name} has no bad fixture"
+        )
+
+    @classmethod
+    def clean_fixture(cls, ctx: Context, tmpdir: str) -> Context:
+        """Context on which the rule must stay quiet.  Defaults to
+        the live repo (the repo-wide zero-findings invariant)."""
+        return ctx
+
+    # fixture helper: copy the file behind ``key`` into tmpdir with
+    # ``old`` replaced by ``new`` (or ``append`` added) and return a
+    # Context overriding that key.
+    @classmethod
+    def _mutated(
+        cls,
+        ctx: Context,
+        tmpdir: str,
+        key: str,
+        old: str = "",
+        new: str = "",
+        append: str = "",
+    ) -> Context:
+        src = ctx.source(ctx.path(key))
+        if old:
+            assert old in src, (cls.name, key, old)
+            src = src.replace(old, new)
+        if append:
+            src = src + "\n" + append
+        out = os.path.join(
+            tmpdir, f"{cls.name}_{os.path.basename(ctx.path(key))}"
+        )
+        with open(out, "w") as fh:
+            fh.write(src)
+        return ctx.with_overrides(**{key: out})
+
+
+_REGISTRY: List[Type[Rule]] = []
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    assert cls.name, cls
+    assert all(r.name != cls.name for r in _REGISTRY), cls.name
+    _REGISTRY.append(cls)
+    return cls
+
+
+def all_rules() -> List[Type[Rule]]:
+    # import for side effect: rule modules self-register
+    from . import rules  # noqa: F401
+
+    return list(_REGISTRY)
+
+
+# -- suppressions ------------------------------------------------------
+
+
+@dataclass
+class _Suppression:
+    rules: List[str]
+    reason: Optional[str]
+    line: int  # line the pragma is written on
+    applies_to: int  # line findings must be on to match
+    used: bool = field(default=False)
+
+
+def _file_suppressions(source: str) -> List[_Suppression]:
+    out: List[_Suppression] = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        names = [
+            part.strip()
+            for part in m.group(1).split(",")
+            if part.strip()
+        ]
+        standalone = text.lstrip().startswith("#")
+        out.append(
+            _Suppression(
+                rules=names,
+                reason=m.group(2),
+                line=i,
+                applies_to=i + 1 if standalone else i,
+            )
+        )
+    return out
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    rules_run: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def run(
+    ctx: Context,
+    rule_names: Optional[Sequence[str]] = None,
+) -> RunResult:
+    classes = all_rules()
+    if rule_names is not None:
+        wanted = set(rule_names)
+        unknown = wanted - {c.name for c in classes}
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s): {sorted(unknown)}"
+            )
+        classes = [c for c in classes if c.name in wanted]
+    findings: List[Finding] = []
+    for cls in classes:
+        findings.extend(cls().check(ctx))
+
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    cache: Dict[str, List[_Suppression]] = {}
+    for f in findings:
+        sups = None
+        if f.line:
+            if f.path not in cache:
+                try:
+                    cache[f.path] = _file_suppressions(
+                        ctx.source(f.path)
+                    )
+                except OSError:
+                    cache[f.path] = []
+            sups = [
+                s
+                for s in cache[f.path]
+                if s.applies_to == f.line
+                and ("all" in s.rules or f.rule in s.rules)
+            ]
+        if sups:
+            for s in sups:
+                s.used = True
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    # a suppression without a justification is itself a finding,
+    # whether or not it currently hides anything — a bare pragma
+    # left behind by a refactor (or typo'd onto the wrong line)
+    # would otherwise silently swallow the next finding that lands
+    # on it.  Scan the run's file set, not just files with findings.
+    for path in set(ctx.scan_files()) | set(cache):
+        if path not in cache:
+            try:
+                cache[path] = _file_suppressions(
+                    ctx.source(path)
+                )
+            except OSError:
+                cache[path] = []
+        for s in cache[path]:
+            if not s.reason:
+                kept.append(
+                    Finding(
+                        rule="bare-suppression",
+                        path=path,
+                        line=s.line,
+                        message=(
+                            "suppression without a justification "
+                            "(append `-- <one-line reason>`)"
+                        ),
+                    )
+                )
+    kept.sort(key=lambda f: (f.rule, f.path, f.line))
+    return RunResult(
+        findings=kept,
+        suppressed=suppressed,
+        rules_run=[c.name for c in classes],
+    )

@@ -1,0 +1,7 @@
+"""config-drift bad fixture: reads a knob the registry and docs
+don't know."""
+import os
+
+GOOD = os.environ.get("NOMAD_TPU_GOOD_KNOB", "1")
+# BAD: unregistered, undocumented
+ROGUE = os.environ.get("NOMAD_TPU_ROGUE_KNOB", "0")

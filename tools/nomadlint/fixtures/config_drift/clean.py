@@ -1,0 +1,5 @@
+"""config-drift clean fixture: every knob read is registered and
+documented."""
+import os
+
+GOOD = os.environ.get("NOMAD_TPU_GOOD_KNOB", "1")

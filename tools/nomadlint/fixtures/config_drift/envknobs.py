@@ -1,0 +1,4 @@
+"""config-drift fixture registry."""
+ENV_KNOBS = {
+    "NOMAD_TPU_GOOD_KNOB": ("1", "fixture.py", "a documented knob"),
+}

@@ -1,0 +1,30 @@
+"""donation-safety bad fixture: read-after-donate and donation of a
+persistent cache buffer.  Parsed by the lint, never imported."""
+import jax
+
+
+def patch_rows_donated():
+    return jax.jit(
+        lambda col, idx, vals: col.at[idx].set(vals),
+        donate_argnums=(0,),
+    )
+
+
+def sync(col, idx, vals):
+    patch = patch_rows_donated()
+    out = patch(col, idx, vals)
+    # BAD: `col` was donated above; this read sees freed memory on a
+    # real accelerator (CPU silently copies instead)
+    return col.sum() + out.sum()
+
+
+class Worker:
+    def __init__(self):
+        self._cols = None
+
+    def sync_cached(self, idx, vals):
+        patch = patch_rows_donated()
+        cols = self._cols
+        # BAD: donating a buffer the persistent cache still
+        # references — the next sync_cached call reads freed memory
+        return patch(cols[0], idx, vals)

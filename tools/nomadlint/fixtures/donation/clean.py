@@ -1,0 +1,37 @@
+"""donation-safety clean fixture: donated buffers are never read
+after the call, and loop-carried names rebind before use."""
+import jax
+
+
+def patch_rows_donated():
+    return jax.jit(
+        lambda col, idx, vals: col.at[idx].set(vals),
+        donate_argnums=(0,),
+    )
+
+
+def sync(col, idx, vals):
+    patch = patch_rows_donated()
+    out = patch(col, idx, vals)
+    return out.sum()
+
+
+def sync_rebind(buf, idx, vals):
+    # the idiomatic donation pattern: the assignment consuming the
+    # call rebinds the donated name to the call's output, so every
+    # later read (and the next loop iteration) sees the new buffer
+    patch = patch_rows_donated()
+    for _ in range(3):
+        buf = patch(buf, idx, vals)
+    return buf.sum()
+
+
+def sync_many(cols, idx, vals):
+    patch = patch_rows_donated()
+    patched = []
+    # the loop target rebinds `col` at the header each iteration, so
+    # the donation inside the body is never followed by a read of
+    # the donated buffer
+    for col in cols:
+        patched.append(patch(col, idx, vals))
+    return patched

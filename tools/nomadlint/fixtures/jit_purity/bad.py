@@ -1,0 +1,28 @@
+"""jit-purity bad fixture: impure helper reachable from a jit root,
+a trace-time global mutation, and a fresh-lambda jit per call."""
+import time
+
+import jax
+
+
+@jax.jit
+def kernel(x):
+    return _helper(x)
+
+
+_CALLS = 0
+
+
+def _helper(x):
+    # BAD: runs at trace time only; the timestamp is baked into the
+    # compiled executable
+    t = time.time()
+    global _CALLS
+    _CALLS += 1
+    return x * t
+
+
+def probe(x):
+    # BAD: a fresh lambda per call gets a fresh jit wrapper — every
+    # invocation re-traces and re-compiles
+    return jax.jit(lambda a: a + 1)(x)

@@ -1,0 +1,28 @@
+"""jit-purity clean fixture: pure jit-reachable code; impure code
+exists but is NOT reachable from any jit root."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x):
+    return _pure_helper(x) + 1.0
+
+
+def _pure_helper(x):
+    return jnp.maximum(x, 0.0)
+
+
+def host_timer():
+    # impure, but never reachable from a jit decoration: fine
+    return time.time()
+
+
+_probe_kernel = jax.jit(_pure_helper)
+
+
+def probe(x):
+    # module-cached wrapper: no per-call retrace
+    return _probe_kernel(x)

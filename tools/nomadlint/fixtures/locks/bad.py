@@ -1,0 +1,31 @@
+"""lock-discipline bad fixture: ABBA ordering cycle, a non-reentrant
+self-nest, and an unallowlisted lock replacement."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def backward(self):
+        # BAD: opposite order to forward() — ABBA deadlock window
+        with self._b_lock:
+            with self._a_lock:
+                pass
+
+    def nested_self(self):
+        with self._a_lock:
+            # BAD: non-reentrant Lock acquired while held
+            with self._a_lock:
+                pass
+
+    def reset(self):
+        # BAD: replacing a lock outside __init__ without an
+        # ALLOWLIST entry
+        self._a_lock = threading.Lock()

@@ -1,0 +1,28 @@
+"""lock-discipline clean fixture: consistent ordering, reentrant
+self-nesting only, locks created in __init__ alone."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._r_lock = threading.RLock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def forward_again(self):
+        with self._a_lock:
+            self._leaf()
+
+    def _leaf(self):
+        with self._b_lock:
+            pass
+
+    def reentrant(self):
+        with self._r_lock:
+            with self._r_lock:
+                pass

@@ -1,0 +1,9 @@
+"""Rule modules self-register on import (core.all_rules imports this
+package).  Order here is the order rules run and report."""
+from . import stage_accounting  # noqa: F401
+from . import donation  # noqa: F401
+from . import jit_purity  # noqa: F401
+from . import locks  # noqa: F401
+from . import config_drift  # noqa: F401
+
+MIGRATED_RULES = stage_accounting.MIGRATED_RULES

@@ -1,0 +1,603 @@
+"""Donation safety: arguments passed to a ``donate_argnums``/
+``donate_argnames``-jitted callable must not be read again in the
+enclosing scope after the call.
+
+Buffer donation is a no-op on the CPU backend, so a use-after-donate
+slips through every tier-1 test and only corrupts on real
+accelerators — exactly the bug class static analysis has to own
+(ROADMAP item 1's accelerator capture is the first time these paths
+run for real).
+
+Two sub-checks:
+
+* **read-after-donate** — a donated local name (or the base name of a
+  donated ``x[i]``/``x.attr`` expression, and the ``*args``/
+  ``**kwargs`` names of a starred donating call) is read again after
+  the call, before any rebinding.  Calls inside loops also treat
+  reads earlier in the loop body as "after" (the next iteration
+  re-executes them) unless the loop rebinds the name first — the
+  ``for col in ...`` iteration target is rebound at the loop header,
+  so patterns like the mirror-sync loop stay clean.
+* **persistent-donation** — the donated expression is rooted in
+  ``self.<attr>`` state (directly or through local aliases).
+  Donating a buffer a cache still references is a use-after-donate
+  on the *next* call unless the cache slot is overwritten before any
+  later read; such sites must be individually verified and carry a
+  justified suppression.
+
+Donating callables are discovered, not hardcoded: any ``jax.jit(...)``
+call carrying ``donate_argnums``/``donate_argnames`` marks its
+assignment target (and any function that returns it — the lazy
+factory pattern ``ops/batch.py`` uses) as donating, across every
+scanned module by symbol name.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Rule, register
+
+
+@dataclass
+class _DonationSpec:
+    positions: Set[int] = field(default_factory=set)
+    keywords: Set[str] = field(default_factory=set)
+
+    def merge(self, other: "_DonationSpec") -> None:
+        self.positions |= other.positions
+        self.keywords |= other.keywords
+
+
+def _jit_donation_spec(
+    call: ast.Call, local_defs: Dict[str, ast.FunctionDef]
+) -> Optional[_DonationSpec]:
+    """The donation spec of a ``jax.jit(...)`` call, or None when it
+    donates nothing.  ``donate_argnames`` are mapped to positional
+    indices when the wrapped function's def is resolvable in the
+    same module (callers pass those args positionally too)."""
+    from ..astutil import dotted_name
+
+    if dotted_name(call.func) not in ("jax.jit", "jit"):
+        return None
+    spec = _DonationSpec()
+    argnames: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(
+                    n.value, int
+                ):
+                    spec.positions.add(n.value)
+        elif kw.arg == "donate_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(
+                    n.value, str
+                ):
+                    argnames.add(n.value)
+    if not spec.positions and not argnames:
+        return None
+    spec.keywords |= argnames
+    if argnames and call.args:
+        # resolve the wrapped callable (possibly `f.__wrapped__`)
+        target = call.args[0]
+        if (
+            isinstance(target, ast.Attribute)
+            and target.attr == "__wrapped__"
+        ):
+            target = target.value
+        if isinstance(target, ast.Name):
+            fn = local_defs.get(target.id)
+            if fn is not None:
+                params = [a.arg for a in fn.args.args]
+                for name in argnames:
+                    if name in params:
+                        spec.positions.add(params.index(name))
+    return spec
+
+
+def _scope_nodes(scope: ast.AST):
+    """Walk a scope's own statements without descending into nested
+    function/class bodies (those are their own scopes)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node,
+            (
+                ast.FunctionDef,
+                ast.AsyncFunctionDef,
+                ast.Lambda,
+                ast.ClassDef,
+            ),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_donating_symbols(
+    trees: Dict[str, ast.AST]
+) -> Dict[str, _DonationSpec]:
+    """Module-level donating symbols across all scanned files, by
+    bare name: variables assigned a donating jit, and functions that
+    return one (factories)."""
+    from ..astutil import functions_by_name
+
+    symbols: Dict[str, _DonationSpec] = {}
+    for tree in trees.values():
+        local_defs = functions_by_name(tree)
+        donating_locals: Dict[Tuple[int, str], _DonationSpec] = {}
+
+        def note(scope_id: int, name: str, spec: _DonationSpec):
+            key = (scope_id, name)
+            if key in donating_locals:
+                donating_locals[key].merge(spec)
+            else:
+                donating_locals[key] = _DonationSpec(
+                    set(spec.positions), set(spec.keywords)
+                )
+
+        # pass 1: direct assignments/returns of donating jits
+        scopes = [(0, tree)] + [
+            (id(fn), fn) for fn in local_defs.values()
+        ]
+        for scope_id, scope in scopes:
+            for node in _scope_nodes(scope):
+                if (
+                    scope_id != 0
+                    and isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    # `return jax.jit(..., donate_*=...)` makes the
+                    # enclosing function a donating factory
+                    spec = _jit_donation_spec(
+                        node.value, local_defs
+                    )
+                    if spec is not None:
+                        symbols.setdefault(
+                            scope.name, _DonationSpec()
+                        ).merge(spec)
+                    continue
+                if not isinstance(node, ast.Assign):
+                    continue
+                spec = (
+                    _jit_donation_spec(node.value, local_defs)
+                    if isinstance(node.value, ast.Call)
+                    else None
+                )
+                if spec is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        note(scope_id, target.id, spec)
+                        if scope_id == 0 or any(
+                            isinstance(g, ast.Global)
+                            and target.id in g.names
+                            for g in ast.walk(scope)
+                        ):
+                            note(0, target.id, spec)
+        # pass 2 (fixpoint): aliases and factory returns
+        changed = True
+        while changed:
+            changed = False
+            for scope_id, scope in scopes:
+                for node in _scope_nodes(scope):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Name)
+                    ):
+                        src = donating_locals.get(
+                            (scope_id, node.value.id)
+                        ) or donating_locals.get(
+                            (0, node.value.id)
+                        )
+                        if src is None:
+                            continue
+                        for target in node.targets:
+                            if (
+                                isinstance(target, ast.Name)
+                                and (scope_id, target.id)
+                                not in donating_locals
+                            ):
+                                note(scope_id, target.id, src)
+                                changed = True
+                if scope_id == 0:
+                    continue
+                # a function returning a donating name is a factory
+                fn = scope
+                for node in _scope_nodes(fn):
+                    if (
+                        isinstance(node, ast.Return)
+                        and isinstance(node.value, ast.Name)
+                    ):
+                        src = donating_locals.get(
+                            (scope_id, node.value.id)
+                        ) or donating_locals.get(
+                            (0, node.value.id)
+                        )
+                        if src is not None and (
+                            fn.name not in symbols
+                            or symbols[fn.name].positions
+                            != src.positions
+                        ):
+                            symbols.setdefault(
+                                fn.name, _DonationSpec()
+                            ).merge(src)
+        for (scope_id, name), spec in donating_locals.items():
+            if scope_id == 0:
+                symbols.setdefault(name, _DonationSpec()).merge(
+                    spec
+                )
+    return symbols
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Base Name of an expression like ``x``, ``x[i]``, ``x.a[j]``;
+    None for anything not rooted at a local name."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _data_nodes(expr: ast.AST):
+    """Walk an expression yielding data-position nodes only: the
+    callee of a Call is skipped (a bound method reference like
+    ``self._chunk_slice`` is not buffer state)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        for fieldname, value in ast.iter_fields(node):
+            if isinstance(node, ast.Call) and fieldname == "func":
+                continue
+            if isinstance(value, ast.AST):
+                stack.append(value)
+            elif isinstance(value, list):
+                stack.extend(
+                    v for v in value if isinstance(v, ast.AST)
+                )
+
+
+def _is_self_rooted(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+        if (
+            isinstance(node, ast.Name)
+            and node.id == "self"
+        ):
+            return True
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+class _FnIndex:
+    """Per-function name-binding/read index for the dataflow scan."""
+
+    def __init__(self, fn: ast.FunctionDef) -> None:
+        self.fn = fn
+        self.reads: Dict[str, List[int]] = {}
+        self.binds: Dict[str, List[int]] = {}
+        # name -> RHS of its simple assignments (alias tracking)
+        self.sources: Dict[str, List[ast.AST]] = {}
+        self.loops: List[ast.AST] = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.While)):
+                self.loops.append(node)
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    self.reads.setdefault(node.id, []).append(
+                        node.lineno
+                    )
+                else:
+                    self.binds.setdefault(node.id, []).append(
+                        node.lineno
+                    )
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.sources.setdefault(
+                                n.id, []
+                            ).append(node.value)
+            if isinstance(node, ast.For):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        self.sources.setdefault(
+                            n.id, []
+                        ).append(node.iter)
+
+    def persistent(self, name: str, seen: Set[str] = None) -> bool:
+        """Whether ``name`` may alias state reachable from self.*
+        (through any of its assignment sources, transitively).
+        Callee positions are skipped: ``self.helper(x)`` flows data
+        through ``x``, not through the bound method."""
+        if seen is None:
+            seen = set()
+        if name in seen:
+            return False
+        seen.add(name)
+        for src in self.sources.get(name, []):
+            for node in _data_nodes(src):
+                if _is_self_rooted(node) and isinstance(
+                    node, (ast.Attribute, ast.Subscript)
+                ):
+                    return True
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    if node.id != name and self.persistent(
+                        node.id, seen
+                    ):
+                        return True
+        return False
+
+    def read_after(
+        self, name: str, call: ast.Call
+    ) -> Optional[int]:
+        """Line of a read of ``name`` after ``call`` (before any
+        rebinding), or a next-iteration read when the call sits in a
+        loop; None when no hazardous read exists."""
+        end = getattr(call, "end_lineno", call.lineno)
+        reads = sorted(self.reads.get(name, []))
+        binds = sorted(self.binds.get(name, []))
+        next_bind = next((b for b in binds if b > end), None)
+        for r in reads:
+            # a read on the rebinding line itself still evaluates
+            # before the new binding takes effect (x = x + 1)
+            if r > end and (next_bind is None or r <= next_bind):
+                return r
+        # loop wrap-around: the call's innermost enclosing loop
+        loop = None
+        for cand in self.loops:
+            if (
+                cand.lineno <= call.lineno
+                and getattr(cand, "end_lineno", cand.lineno)
+                >= end
+            ):
+                if loop is None or cand.lineno > loop.lineno:
+                    loop = cand
+        if loop is None:
+            return None
+        loop_end = getattr(loop, "end_lineno", loop.lineno)
+        in_loop_reads = [
+            r
+            for r in reads
+            if loop.lineno <= r <= loop_end and r <= end
+        ]
+        if not in_loop_reads:
+            return None
+        # safe when the loop rebinds the name before its first read
+        # in iteration order (the for-target binds at the header)
+        loop_binds = [
+            b
+            for b in binds
+            if loop.lineno <= b <= loop_end
+        ]
+        if isinstance(loop, ast.For):
+            for n in ast.walk(loop.target):
+                if (
+                    isinstance(n, ast.Name)
+                    and n.id == name
+                ):
+                    loop_binds.append(loop.lineno)
+        first_read = min(in_loop_reads)
+        if loop_binds and min(loop_binds) <= first_read:
+            return None
+        return first_read
+
+
+@register
+class DonationSafetyRule(Rule):
+    name = "donation-safety"
+    description = (
+        "no argument of a donating jit call is read after the call"
+    )
+
+    def check(self, ctx: Context) -> List[Finding]:
+        trees = {
+            path: ctx.tree(path) for path in ctx.scan_files()
+        }
+        symbols = _collect_donating_symbols(trees)
+        if not symbols:
+            return []
+        out: List[Finding] = []
+        seen = set()
+        for path, tree in trees.items():
+            for fn in [
+                n
+                for n in ast.walk(tree)
+                if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                )
+            ]:
+                # nested defs are analyzed both inside their parent
+                # (closure reads count) and standalone — dedupe
+                for f in self._check_function(path, fn, symbols):
+                    key = (f.path, f.line, f.message)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(f)
+        return out
+
+    def _check_function(
+        self,
+        path: str,
+        fn: ast.FunctionDef,
+        symbols: Dict[str, _DonationSpec],
+    ) -> List[Finding]:
+        index = _FnIndex(fn)
+        # names rebound by the assignment consuming a call's value
+        # (``buf = patch(buf, ...)``): the donated input is replaced
+        # by the call's output before any later read can happen, so
+        # reads after the call see the NEW binding — the idiomatic
+        # safe donation pattern, not a use-after-donate
+        rebound_at_call: Dict[int, Set[str]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                targets = [node.target]
+            else:
+                continue
+            names = {
+                n.id
+                for t in targets
+                for n in ast.walk(t)
+                if isinstance(n, ast.Name)
+            }
+            if not names:
+                continue
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    rebound_at_call.setdefault(
+                        id(sub), set()
+                    ).update(names)
+        # local aliases of donating callables: x = factory();
+        # y = x; fn = y  (conditional branches make a name only
+        # *potentially* donating — still analyzed)
+        donating: Dict[str, _DonationSpec] = {}
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                spec: Optional[_DonationSpec] = None
+                v = node.value
+                if (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in symbols
+                ):
+                    spec = symbols[v.func.id]
+                elif (
+                    isinstance(v, ast.Name)
+                    and v.id in donating
+                ):
+                    spec = donating[v.id]
+                if spec is None:
+                    continue
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Name)
+                        and t.id not in donating
+                    ):
+                        donating[t.id] = spec
+                        changed = True
+
+        out: List[Finding] = []
+        for call in [
+            n for n in ast.walk(fn) if isinstance(n, ast.Call)
+        ]:
+            spec: Optional[_DonationSpec] = None
+            callee = "?"
+            if isinstance(call.func, ast.Name):
+                if call.func.id in donating:
+                    spec = donating[call.func.id]
+                    callee = call.func.id
+            if spec is None and (
+                isinstance(call.func, ast.Call)
+                and isinstance(call.func.func, ast.Name)
+                and call.func.func.id in symbols
+            ):
+                # direct factory()(args...) invocation
+                spec = symbols[call.func.func.id]
+                callee = call.func.func.id
+            if spec is None:
+                continue
+            donated_exprs: List[ast.AST] = []
+            pos = 0
+            for arg in call.args:
+                if isinstance(arg, ast.Starred):
+                    # positions beyond this are unknowable: the
+                    # whole starred tuple is treated as donated
+                    donated_exprs.append(arg.value)
+                    pos = 10**6
+                    continue
+                if pos in spec.positions:
+                    donated_exprs.append(arg)
+                pos += 1
+            for kw in call.keywords:
+                if kw.arg is None:
+                    # **kwargs: the dict may carry donated keywords
+                    if spec.keywords:
+                        donated_exprs.append(kw.value)
+                elif kw.arg in spec.keywords:
+                    donated_exprs.append(kw.value)
+            for expr in donated_exprs:
+                name = _root_name(expr)
+                if name is None:
+                    if _is_self_rooted(expr):
+                        out.append(
+                            Finding(
+                                self.name, path, call.lineno,
+                                f"{callee}() donates an argument "
+                                "rooted in self.* state — a "
+                                "donated cache buffer is a "
+                                "use-after-donate on the next "
+                                "access unless the slot is "
+                                "overwritten first",
+                            )
+                        )
+                    continue
+                # the call's own assignment rebinding the donated
+                # name to its output makes later reads (including
+                # next loop iterations) see the fresh buffer — but
+                # a persistent self.* alias still holds the donated
+                # one, so that check below still applies
+                rebound = name in rebound_at_call.get(
+                    id(call), ()
+                )
+                read_line = (
+                    None
+                    if rebound
+                    else index.read_after(name, call)
+                )
+                if read_line is not None:
+                    out.append(
+                        Finding(
+                            self.name, path, call.lineno,
+                            f"argument {name!r} donated to "
+                            f"{callee}() is read again at line "
+                            f"{read_line} — use-after-donate "
+                            "only corrupts on real accelerators "
+                            "(CPU ignores donation)",
+                        )
+                    )
+                elif index.persistent(name):
+                    out.append(
+                        Finding(
+                            self.name, path, call.lineno,
+                            f"argument {name!r} donated to "
+                            f"{callee}() aliases persistent "
+                            "self.* state — verify the cache "
+                            "slot is overwritten before any "
+                            "later read and suppress with a "
+                            "justification",
+                        )
+                    )
+        return out
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "fixtures", "donation",
+        )
+        return ctx.with_overrides(
+            scan_files=[os.path.join(fixtures, "bad.py")]
+        )
+
+    @classmethod
+    def clean_fixture(cls, ctx, tmpdir):
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "fixtures", "donation",
+        )
+        return ctx.with_overrides(
+            scan_files=[os.path.join(fixtures, "clean.py")]
+        )

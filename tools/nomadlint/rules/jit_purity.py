@@ -1,0 +1,264 @@
+"""jit purity: functions reachable from a ``jax.jit`` decoration must
+stay pure, and jit wrappers must not be minted per call.
+
+A jitted function's Python body runs ONCE per trace-cache entry, not
+once per call: a ``time.time()``, ``os.environ`` read, RNG draw,
+``TRACE``/``Metrics`` emission or module-global mutation inside it
+executes at trace time, bakes its value into the compiled executable,
+and then silently never runs again — correct-looking on the first
+call, wrong forever after.  The CPU tier-1 suite can't catch the
+steady-state behavior difference, so this is a static pass.
+
+Sub-checks:
+
+* **impure-call** — a call to ``time.*``, ``os.environ``/
+  ``os.getenv``, ``random.*``/``np.random.*``, ``print``, ``TRACE.*``
+  or a Metrics emitter (``.incr/.set_gauge/.add_sample/.measure``)
+  inside a function reachable from a jit root.
+* **global-mutation** — a ``global`` statement inside a jit-reachable
+  function (trace-time writes to module state).
+* **fresh-jit** — ``jax.jit(lambda ...)`` inside a function body: a
+  fresh lambda per call gets a fresh jit wrapper, so every invocation
+  re-traces, re-lowers and re-compiles.  (The cached-factory pattern
+  — jit of a named module function memoized in a module global — is
+  fine and not flagged.)
+
+Reachability is module-local: jit roots are ``@jax.jit`` /
+``@functools.partial(jax.jit, ...)`` decorated defs plus ``X =
+jax.jit(f)`` assignments; from a root, any locally-defined function
+whose name is referenced in a reachable body is reachable (this
+catches helpers passed to ``lax.scan`` and friends).  Cross-module
+helpers are covered when their own module declares jit roots — true
+for ops/score.py, the one module the kernels import helpers from.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from ..astutil import dotted_name, functions_by_name
+from ..core import Context, Finding, Rule, register
+
+# dotted-call prefixes that are impure at trace time
+IMPURE_PREFIXES = (
+    "time.",
+    "os.environ",
+    "os.getenv",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+)
+IMPURE_NAMES = {"print", "input", "open"}
+EMITTER_ATTRS = {"incr", "set_gauge", "add_sample", "measure"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``functools.partial(jax.jit, ...)``."""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _jit_roots(
+    tree: ast.AST, defs: Dict[str, ast.FunctionDef]
+) -> List[ast.FunctionDef]:
+    roots: List[ast.FunctionDef] = []
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and any(_is_jit_expr(d) for d in node.decorator_list):
+            roots.append(node)
+        # X = jax.jit(f, ...) — f (or f.__wrapped__) by name
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and dotted_name(node.value.func) in ("jax.jit", "jit")
+            and node.value.args
+        ):
+            target = node.value.args[0]
+            if (
+                isinstance(target, ast.Attribute)
+                and target.attr == "__wrapped__"
+            ):
+                target = target.value
+            if (
+                isinstance(target, ast.Name)
+                and target.id in defs
+            ):
+                roots.append(defs[target.id])
+    return roots
+
+
+def _reachable(
+    roots: List[ast.FunctionDef],
+    defs: Dict[str, ast.FunctionDef],
+) -> List[ast.FunctionDef]:
+    seen: Set[int] = set()
+    out: List[ast.FunctionDef] = []
+    stack = list(roots)
+    while stack:
+        fn = stack.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append(fn)
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in defs
+            ):
+                stack.append(defs[node.id])
+    return out
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = (
+        "jit-reachable code is pure; no per-call jit of lambdas"
+    )
+
+    def check(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        for path in ctx.scan_files():
+            tree = ctx.tree(path)
+            defs = functions_by_name(tree)
+            roots = _jit_roots(tree, defs)
+            if roots:
+                out.extend(
+                    self._purity_findings(
+                        path, _reachable(roots, defs)
+                    )
+                )
+            out.extend(self._fresh_jit_findings(path, tree))
+        return out
+
+    @staticmethod
+    def _own_body(fn: ast.FunctionDef):
+        """Walk a function's body without descending into nested
+        defs (those are separately reachable when referenced, so
+        findings inside them attribute to the nested function)."""
+        stack: List[ast.AST] = list(
+            ast.iter_child_nodes(fn)
+        )
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _purity_findings(
+        self, path: str, fns: List[ast.FunctionDef]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in fns:
+            for node in self._own_body(fn):
+                if isinstance(node, ast.Global):
+                    out.append(
+                        Finding(
+                            self.name, path, node.lineno,
+                            f"jit-reachable {fn.name}() declares "
+                            "`global` — module state mutated at "
+                            "trace time runs once per compile, "
+                            "not once per call",
+                        )
+                    )
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = self._impure_call(node)
+                if reason:
+                    out.append(
+                        Finding(
+                            self.name, path, node.lineno,
+                            f"jit-reachable {fn.name}() calls "
+                            f"{reason} — executes at trace time "
+                            "only, its value is baked into the "
+                            "compiled executable",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _impure_call(node: ast.Call) -> Optional[str]:
+        name = dotted_name(node.func)
+        if name:
+            if name in IMPURE_NAMES:
+                return f"{name}()"
+            for prefix in IMPURE_PREFIXES:
+                if name == prefix.rstrip(".") or name.startswith(
+                    prefix
+                ):
+                    return f"{name}()"
+            if name.startswith("TRACE."):
+                return f"{name}() (flight-recorder emission)"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in EMITTER_ATTRS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            return (
+                f".{node.func.attr}(...) (metrics emission)"
+            )
+        return None
+
+    def _fresh_jit_findings(
+        self, path: str, tree: ast.AST
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            for call in ast.walk(node):
+                if (
+                    isinstance(call, ast.Call)
+                    and dotted_name(call.func)
+                    in ("jax.jit", "jit")
+                    and call.args
+                    and isinstance(call.args[0], ast.Lambda)
+                ):
+                    out.append(
+                        Finding(
+                            self.name, path, call.lineno,
+                            "jax.jit(lambda ...) inside "
+                            f"{node.name}() builds a fresh jit "
+                            "wrapper per call — every invocation "
+                            "re-traces and re-compiles; hoist the "
+                            "jitted kernel or cache the wrapper",
+                        )
+                    )
+        return out
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "fixtures", "jit_purity",
+        )
+        return ctx.with_overrides(
+            scan_files=[os.path.join(fixtures, "bad.py")]
+        )
+
+    @classmethod
+    def clean_fixture(cls, ctx, tmpdir):
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "fixtures", "jit_purity",
+        )
+        return ctx.with_overrides(
+            scan_files=[os.path.join(fixtures, "clean.py")]
+        )

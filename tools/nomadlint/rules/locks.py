@@ -1,0 +1,482 @@
+"""Lock discipline: static lock-acquisition graph over the
+multi-threaded server/state/device modules.
+
+The batch pipeline is speculative and multi-threaded (worker thread,
+replay pool, warmup thread, supervisor probe thread, background
+compile threads), and the GIL hides most interleavings from the CPU
+tier-1 suite — so ordering bugs are checked statically, the way the
+reference tree leans on ``go vet``/race CI.
+
+Sub-checks:
+
+* **lock-order** — build the acquired-while-holding graph: a ``with
+  self._x_lock:`` (or ``.acquire()``) nested inside another held
+  lock adds an edge, and calls made while holding a lock pull in the
+  transitive lock set of the (module-set-resolved) callee.  Any
+  cycle is a potential deadlock; a non-reentrant ``Lock`` nested
+  inside itself is a guaranteed one.
+* **lock-reinit** — replacing a lock object outside ``__init__``
+  (``self._x_lock = threading.Lock()`` in a regular method) silently
+  releases every queued waiter's mutual exclusion.  The supervisor
+  failover path does this DELIBERATELY (abandoning a lock a wedged
+  sacrificial thread may hold forever); every such deliberate skip
+  needs an ``ALLOWLIST`` entry here carrying its justification, and
+  stale entries (nothing matches anymore) are themselves findings so
+  the allowlist can't rot.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Rule, register
+
+# (file basename, "Class.method", lock attr) -> one-line justification
+ALLOWLIST: Dict[Tuple[str, str, str], str] = {
+    (
+        "batch_worker.py",
+        "BatchWorker._on_device_transition",
+        "_usage_cache_lock",
+    ): (
+        "documented wedge bypass: a sacrificial assemble thread may "
+        "be parked inside _device_columns_locked holding the lock "
+        "forever (device_put never returned); post-flip syncs must "
+        "not queue behind it, and the stale-epoch cache key discards "
+        "anything a late holder publishes"
+    ),
+}
+
+
+@dataclass
+class _LockInfo:
+    key: str  # "<basename>:<Class>.<attr>"
+    reentrant: bool
+    cls: str
+    attr: str
+
+
+@dataclass
+class _FnLocks:
+    """Per-method lock facts for the interprocedural closure."""
+
+    qualname: str  # "Class.method"
+    path: str
+    acquired: Set[str] = field(default_factory=set)
+    # (outer_key, inner_key, line) direct nesting edges
+    edges: List[Tuple[str, str, int]] = field(
+        default_factory=list
+    )
+    # method names called while holding key: [(held, name, line)]
+    held_calls: List[Tuple[str, str, int]] = field(
+        default_factory=list
+    )
+    # method names called anywhere (for the transitive closure)
+    calls: Set[str] = field(default_factory=set)
+
+
+def _lock_attrs_of_class(
+    cls: ast.ClassDef,
+) -> Dict[str, bool]:
+    """Lock attribute names assigned ``threading.Lock()`` /
+    ``threading.RLock()`` anywhere in the class -> reentrant?"""
+    out: Dict[str, bool] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr in ("Lock", "RLock")
+        ):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out[t.attr] = node.value.func.attr == "RLock"
+    return out
+
+
+def _self_lock_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _MethodScanner:
+    """Walks one method tracking the held-lock stack."""
+
+    def __init__(
+        self,
+        fn: ast.FunctionDef,
+        qualname: str,
+        path: str,
+        locks: Dict[str, _LockInfo],
+    ) -> None:
+        self.locks = locks
+        self.out = _FnLocks(qualname=qualname, path=path)
+        self._walk(fn, [])
+
+    def _lock_key(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_lock_attr(expr)
+        if attr is not None and attr in self.locks:
+            return self.locks[attr].key
+        return None
+
+    def _walk(self, node: ast.AST, held: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and child is not node:
+                # nested defs run later on other threads; their
+                # acquisitions are not nested under the current hold
+                self._walk_fn_body(child)
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                keys: List[str] = []
+                for item in child.items:
+                    key = self._lock_key(item.context_expr)
+                    if key is not None:
+                        keys.append(key)
+                        self._note_acquire(key, child.lineno, held)
+                self._walk(child, held + keys)
+                continue
+            # explicit lock.acquire(): held until release or method
+            # end (fixture support — live code uses `with`)
+            if (
+                isinstance(child, ast.Expr)
+                and isinstance(child.value, ast.Call)
+                and isinstance(child.value.func, ast.Attribute)
+                and child.value.func.attr == "acquire"
+            ):
+                key = self._lock_key(child.value.func.value)
+                if key is not None:
+                    self._note_acquire(
+                        key, child.lineno, held
+                    )
+                    held = held + [key]
+                    continue
+            if (
+                isinstance(child, ast.Expr)
+                and isinstance(child.value, ast.Call)
+                and isinstance(child.value.func, ast.Attribute)
+                and child.value.func.attr == "release"
+            ):
+                key = self._lock_key(child.value.func.value)
+                if key is not None and key in held:
+                    held = [k for k in held if k != key]
+                    continue
+            if isinstance(child, ast.Call):
+                name = self._callee_name(child)
+                if name:
+                    self.out.calls.add(name)
+                    for key in held:
+                        self.out.held_calls.append(
+                            (key, name, child.lineno)
+                        )
+            self._walk(child, held)
+
+    def _walk_fn_body(self, fn: ast.FunctionDef) -> None:
+        # nested function: scan with an empty hold stack but keep
+        # recording its acquisitions/calls under this method's entry
+        self._walk(fn, [])
+
+    @staticmethod
+    def _callee_name(call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return fn.id
+        return None
+
+    def _note_acquire(
+        self, key: str, line: int, held: List[str]
+    ) -> None:
+        self.out.acquired.add(key)
+        for outer in held:
+            self.out.edges.append((outer, key, line))
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "lock-order acyclicity + allowlisted lock replacement"
+    )
+
+    SCAN_KEYS = ("batch_worker", "plan_apply")
+
+    def _files(self, ctx: Context) -> List[str]:
+        override = ctx.overrides.get("scan_files")
+        if override is not None:
+            return list(override)
+        files = [ctx.path(k) for k in self.SCAN_KEYS]
+        for dir_key in ("state_dir", "device_dir"):
+            root = ctx.path(dir_key)
+            files.extend(
+                os.path.join(root, fn)
+                for fn in sorted(os.listdir(root))
+                if fn.endswith(".py")
+            )
+        return files
+
+    def check(self, ctx: Context) -> List[Finding]:
+        files = self._files(ctx)
+        locks: Dict[str, Dict[str, _LockInfo]] = {}
+        classes: List[Tuple[str, ast.ClassDef]] = []
+        for path in files:
+            for node in ctx.tree(path).body:
+                if isinstance(node, ast.ClassDef):
+                    classes.append((path, node))
+                    attrs = _lock_attrs_of_class(node)
+                    base = os.path.basename(path)
+                    locks[node.name] = {
+                        attr: _LockInfo(
+                            key=f"{base}:{node.name}.{attr}",
+                            reentrant=reentrant,
+                            cls=node.name,
+                            attr=attr,
+                        )
+                        for attr, reentrant in attrs.items()
+                    }
+
+        # per-method scan
+        fn_locks: Dict[str, List[_FnLocks]] = {}
+        scanned: List[_FnLocks] = []
+        reinits: List[Tuple[str, str, str, int]] = []
+        for path, cls in classes:
+            cls_locks = locks.get(cls.name, {})
+            for node in cls.body:
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                qual = f"{cls.name}.{node.name}"
+                scan = _MethodScanner(
+                    node, qual, path, cls_locks
+                ).out
+                scanned.append(scan)
+                fn_locks.setdefault(node.name, []).append(scan)
+                if node.name != "__init__":
+                    for sub in ast.walk(node):
+                        if not isinstance(sub, ast.Assign):
+                            continue
+                        if not (
+                            isinstance(sub.value, ast.Call)
+                            and isinstance(
+                                sub.value.func, ast.Attribute
+                            )
+                            and sub.value.func.attr
+                            in ("Lock", "RLock")
+                        ):
+                            continue
+                        for t in sub.targets:
+                            attr = _self_lock_attr(t)
+                            if attr is not None:
+                                reinits.append(
+                                    (path, qual, attr, sub.lineno)
+                                )
+
+        findings: List[Finding] = []
+
+        # -- lock-reinit vs allowlist -----------------------------
+        matched: Set[Tuple[str, str, str]] = set()
+        for path, qual, attr, line in reinits:
+            key = (os.path.basename(path), qual, attr)
+            if key in ALLOWLIST:
+                matched.add(key)
+                continue
+            findings.append(
+                Finding(
+                    self.name, path, line,
+                    f"{qual} replaces lock {attr!r} outside "
+                    "__init__ — waiters queued on the old object "
+                    "lose mutual exclusion; if deliberate, add an "
+                    "ALLOWLIST entry (tools/nomadlint/rules/"
+                    "locks.py) with its justification",
+                )
+            )
+        if "scan_files" not in ctx.overrides:
+            for key, _why in ALLOWLIST.items():
+                if key not in matched:
+                    findings.append(
+                        Finding(
+                            self.name,
+                            ctx.path("batch_worker"), 0,
+                            f"stale lock-reinit ALLOWLIST entry "
+                            f"{key!r}: no matching site exists — "
+                            "remove it so the allowlist can't rot",
+                        )
+                    )
+
+        # -- transitive lock closure per method -------------------
+        def resolve(name: str) -> Optional[_FnLocks]:
+            cands = fn_locks.get(name, [])
+            return cands[0] if len(cands) == 1 else None
+
+        closure: Dict[str, Set[str]] = {
+            s.qualname: set(s.acquired) for s in scanned
+        }
+        by_qual = {s.qualname: s for s in scanned}
+        changed = True
+        while changed:
+            changed = False
+            for s in scanned:
+                for name in s.calls:
+                    callee = resolve(name)
+                    if callee is None:
+                        continue
+                    add = closure[callee.qualname] - closure[
+                        s.qualname
+                    ]
+                    if add:
+                        closure[s.qualname] |= add
+                        changed = True
+
+        # -- edges: direct nesting + held calls -------------------
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        all_locks = {
+            info.key: info
+            for cls_map in locks.values()
+            for info in cls_map.values()
+        }
+        for s in scanned:
+            for outer, inner, line in s.edges:
+                edges.setdefault(
+                    (outer, inner), (s.path, line, s.qualname)
+                )
+            for held, name, line in s.held_calls:
+                callee = resolve(name)
+                if callee is None:
+                    continue
+                for inner in closure[callee.qualname]:
+                    edges.setdefault(
+                        (held, inner),
+                        (s.path, line, s.qualname),
+                    )
+
+        # -- self-deadlock + cycles -------------------------------
+        graph: Dict[str, Set[str]] = {}
+        for (outer, inner), (path, line, qual) in edges.items():
+            if outer == inner:
+                info = all_locks.get(outer)
+                if info is not None and not info.reentrant:
+                    findings.append(
+                        Finding(
+                            self.name, path, line,
+                            f"{qual} acquires non-reentrant lock "
+                            f"{outer} while already holding it — "
+                            "guaranteed self-deadlock",
+                        )
+                    )
+                continue
+            graph.setdefault(outer, set()).add(inner)
+
+        for cycle in _cycles(graph):
+            first = cycle[0]
+            # anchor the finding on the edge closing the cycle
+            path, line, qual = edges.get(
+                (cycle[-1], first),
+                edges.get((first, cycle[1 % len(cycle)]),
+                          ("", 0, "?")),
+            )
+            findings.append(
+                Finding(
+                    self.name, path or self._files(ctx)[0], line,
+                    "lock-order cycle (potential deadlock): "
+                    + " -> ".join(cycle + [first])
+                    + f" (closing edge in {qual})",
+                )
+            )
+        return findings
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "fixtures", "locks",
+        )
+        return ctx.with_overrides(
+            scan_files=[os.path.join(fixtures, "bad.py")]
+        )
+
+    @classmethod
+    def clean_fixture(cls, ctx, tmpdir):
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "fixtures", "locks",
+        )
+        return ctx.with_overrides(
+            scan_files=[os.path.join(fixtures, "clean.py")]
+        )
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Distinct elementary cycles (each reported once, smallest
+    rotation first) — Tarjan SCCs then one witness cycle per
+    non-trivial component."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in graph.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    nodes = set(graph)
+    for targets in graph.values():
+        nodes |= targets
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    # an SCC's node list is NOT a cycle path — report a witness
+    # path whose every consecutive pair (and closing edge) is a
+    # real edge, so the rendered lock order exists in the code
+    return [_witness_cycle(comp, graph) for comp in sccs]
+
+
+def _witness_cycle(
+    comp: List[str], graph: Dict[str, Set[str]]
+) -> List[str]:
+    """One concrete elementary cycle inside a non-trivial SCC."""
+    start = comp[0]
+    compset = set(comp)
+    dfs: List[Tuple[str, List[str]]] = [(start, [start])]
+    while dfs:
+        v, path = dfs.pop()
+        for w in sorted(graph.get(v, ()), reverse=True):
+            if w == start:
+                return path
+            if w in compset and w not in path:
+                dfs.append((w, path + [w]))
+    return comp  # unreachable: every SCC node lies on a cycle

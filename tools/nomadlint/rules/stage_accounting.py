@@ -1,0 +1,698 @@
+"""The 11 historical stage-accounting checks as individual rules.
+
+These migrated 1:1 from the ``tools/check_stage_accounting.py``
+monolith (which now shims onto them); the check numbers in each
+docstring refer to that file's original numbering, and the messages
+keep the original wording so operator muscle memory (and the tier-1
+test's substring asserts) survive the migration.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from .. import astutil
+from ..core import Context, Finding, Rule, register
+
+# allocs_fit / BinPackIterator exhaustion-dimension vocabulary a
+# literal exhausted_node() in the vectorized path may use
+EXHAUST_DIMENSIONS = {"cpu", "memory", "disk"}
+
+
+def _device_module_paths(ctx: Context) -> List[str]:
+    device_dir = ctx.path("device_dir")
+    subst = {}
+    sup = ctx.overrides.get("device_supervisor")
+    if sup:
+        subst[ctx.default_path("device_supervisor")] = sup
+    return sorted(
+        subst.get(
+            os.path.join(device_dir, name),
+            os.path.join(device_dir, name),
+        )
+        for name in os.listdir(device_dir)
+        if name.endswith(".py")
+    )
+
+
+@register
+class StageObservedRule(Rule):
+    """Check 1: every key in the ``self.timings = {...}`` literal in
+    batch_worker.py appears in at least one ``self._observe(...)``
+    call — a stage added without observation would stay 0 forever."""
+
+    name = "stage-observed"
+    description = (
+        "every BatchWorker.timings key is observed via _observe"
+    )
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("batch_worker")
+        tree = ctx.tree(path)
+        declared = astutil.timings_keys(tree)
+        if not declared:
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "could not find the self.timings literal in "
+                    "batch_worker.py",
+                )
+            ]
+        unobserved = declared - astutil.observed_keys(tree)
+        if unobserved:
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "timings keys never passed to _observe (stage "
+                    "time would stay 0 forever): "
+                    f"{sorted(unobserved)}",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            old='self._observe("simulate"',
+            new='_unused("simulate"',
+        )
+
+
+@register
+class StageOrphansRule(Rule):
+    """Check 2: every ``self._observe("<key>", ...)`` call uses a
+    declared timings key (no orphan stages accumulating into
+    nothing)."""
+
+    name = "stage-orphans"
+    description = "every _observe key is declared in timings"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("batch_worker")
+        tree = ctx.tree(path)
+        declared = astutil.timings_keys(tree)
+        if not declared:
+            # stage-observed already reports the missing literal
+            return []
+        orphans = astutil.observed_keys(tree) - declared
+        if orphans:
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "_observe calls with keys missing from the "
+                    "timings literal (would KeyError at runtime): "
+                    f"{sorted(orphans)}",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            old='self._observe("simulate"',
+            new='self._observe("bogus_simulate"',
+        )
+
+
+@register
+class BenchStageExportRule(Rule):
+    """Check 3: bench.py snapshots ``worker.timings`` wholesale
+    (``dict(worker.timings)``) and exports ``e2e_stage_times_s``, so
+    new stages flow into BENCH_*.json without a bench edit."""
+
+    name = "bench-stage-export"
+    description = "bench.py exports the stage timings wholesale"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("bench")
+        tree = ctx.tree(path)
+        source = ctx.source(path)
+        out: List[Finding] = []
+        wholesale = any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "dict"
+            and node.args
+            and isinstance(node.args[0], ast.Attribute)
+            and node.args[0].attr == "timings"
+            for node in ast.walk(tree)
+        )
+        if not wholesale:
+            out.append(
+                Finding(
+                    self.name, path, 0,
+                    "bench.py no longer snapshots the stage times "
+                    "wholesale (expected a dict(worker.timings) "
+                    "call) — new stages would silently drop from "
+                    "the bench",
+                )
+            )
+        if '"e2e_stage_times_s"' not in source:
+            out.append(
+                Finding(
+                    self.name, path, 0,
+                    "bench.py no longer exports the "
+                    "e2e_stage_times_s JSON key",
+                )
+            )
+        return out
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "bench",
+            old='"e2e_stage_times_s"',
+            new='"renamed_stage_times_s"',
+        )
+
+
+@register
+class SpanRegistryRule(Rule):
+    """Checks 4+5 (span half), generalized: every span/event name
+    literal used with ``TRACE.span/add_span/event`` anywhere in
+    ``nomad_tpu/`` must be declared in the ``SPAN_NAMES`` registry in
+    trace.py — a renamed stage must update the documented registry
+    (and with it every dashboard/report keyed on the name), never
+    drift silently.  Check 10's span half rides along: the
+    continuous-micro-batching admission names must stay registered
+    even if their call sites change shape."""
+
+    name = "span-registry"
+    description = "every span/event literal is in trace.SPAN_NAMES"
+
+    REQUIRED = ("batch_worker.admit", "batch_worker.admit_deferred")
+
+    def check(self, ctx: Context) -> List[Finding]:
+        trace_path = ctx.path("trace")
+        registry = astutil.span_registry(ctx.tree(trace_path))
+        if not registry:
+            return [
+                Finding(
+                    self.name, trace_path, 0,
+                    "could not find the SPAN_NAMES registry in "
+                    "nomad_tpu/trace.py",
+                )
+            ]
+        out: List[Finding] = []
+        trace_default = ctx.default_path("trace")
+        for path in ctx.scan_files():
+            # trace.py is the registry itself (its internal add_span
+            # plumbing passes variables, not stage literals)
+            if path in (trace_path, trace_default):
+                continue
+            used = astutil.span_names_used(ctx.tree(path))
+            unregistered = used - registry
+            if unregistered:
+                out.append(
+                    Finding(
+                        self.name, path, 0,
+                        "span names used but missing from "
+                        "trace.SPAN_NAMES (rename must update the "
+                        "documented registry): "
+                        f"{sorted(unregistered)}",
+                    )
+                )
+        for required in self.REQUIRED:
+            if required not in registry:
+                out.append(
+                    Finding(
+                        self.name, trace_path, 0,
+                        f"{required!r} missing from "
+                        "trace.SPAN_NAMES — the mid-chain admission "
+                        "stage would vanish from every trace-keyed "
+                        "dashboard",
+                    )
+                )
+        return out
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "trace",
+            old='"batch_worker.simulate"',
+            new='"batch_worker.renamed_simulate"',
+        )
+
+
+@register
+class DeviceMetricsRule(Rule):
+    """Check 5 (metric half): every ``device.*`` counter/gauge/sample
+    emitted by the accelerator supervisor modules appears in the
+    ``METRIC_COUNTERS``/``METRIC_GAUGES``/``METRIC_SAMPLES`` registry
+    literals in device/supervisor.py — those are zero-registered at
+    supervisor construction, which is what guarantees
+    ``prometheus_text()`` exports the whole family before the first
+    incident."""
+
+    name = "device-metrics"
+    description = "device.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        sup_path = ctx.path("device_supervisor")
+        registry = astutil.device_metric_registry(
+            ctx.tree(sup_path)
+        )
+        if not registry:
+            return [
+                Finding(
+                    self.name, sup_path, 0,
+                    "could not find the METRIC_COUNTERS/GAUGES/"
+                    "SAMPLES registry in device/supervisor.py",
+                )
+            ]
+        emitted: Set[str] = set()
+        for path in _device_module_paths(ctx):
+            emitted |= astutil.metric_names_emitted(
+                ctx.tree(path), "device."
+            )
+        unexported = emitted - registry
+        if unexported:
+            return [
+                Finding(
+                    self.name, sup_path, 0,
+                    "device.* metrics emitted but not in the "
+                    "supervisor's zero-registered registry (they "
+                    "would be absent from prometheus_text() until "
+                    f"the first incident): {sorted(unexported)}",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "device_supervisor",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.incr("device.bogus_metric")\n'
+            ),
+        )
+
+
+@register
+class DebugBundleDeviceRule(Rule):
+    """Check 6: the operator debug bundle (cli.py
+    ``cmd_operator_debug``) captures ``/v1/device``, so a bundle from
+    a degraded server always carries the supervisor's state
+    history."""
+
+    name = "debug-bundle-device"
+    description = "operator debug bundle captures /v1/device"
+
+    # quoted form: "/v1/devices" (the fingerprint family) must not
+    # satisfy the supervisor-status capture check
+    NEEDLE = '"/v1/device"'
+    ENDPOINT = "/v1/device"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("cli")
+        bundle_src = ctx.source(path).split(
+            "cmd_operator_debug", 1
+        )[-1].split("def ", 1)[0]
+        if self.NEEDLE not in bundle_src:
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "the operator debug bundle "
+                    "(cli.cmd_operator_debug) no longer captures "
+                    f"{self.ENDPOINT}",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        # drop the last path char: the mutated source must not keep
+        # the needle as a substring ("/v1/placements_renamed" would)
+        return cls._mutated(
+            ctx, tmpdir, "cli",
+            old=cls.ENDPOINT,
+            new=cls.ENDPOINT[:-1],
+        )
+
+
+@register
+class DebugBundlePlacementsRule(DebugBundleDeviceRule):
+    """Check 9: the operator debug bundle captures
+    ``/v1/placements`` so the per-eval explanations travel with the
+    traces they cross-reference."""
+
+    name = "debug-bundle-placements"
+    description = "operator debug bundle captures /v1/placements"
+
+    NEEDLE = "/v1/placements"
+    ENDPOINT = "/v1/placements"
+
+
+@register
+class PlacementMetricsRule(Rule):
+    """Check 7: placement.* emissions in explain.py stay inside the
+    zero-registered families.  Literal names must be registered
+    verbatim; f-string names may only be `placement.filtered.{...}` /
+    `placement.exhausted.{...}` with the slug produced by
+    reason_slug()/dimension_slug() (the fixed vocabularies); and the
+    server zero-registers the family at construction."""
+
+    name = "placement-metrics"
+    description = "placement.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("explain")
+        tree = ctx.tree(path)
+        problems: List[Finding] = []
+        counters = astutil.assigned_strings(
+            tree, "PLACEMENT_COUNTERS"
+        )
+        gauges = astutil.assigned_strings(tree, "PLACEMENT_GAUGES")
+        filter_slugs = astutil.assigned_strings(
+            tree, "PLACEMENT_FILTER_SLUGS"
+        )
+        exhaust_slugs = astutil.assigned_strings(
+            tree, "PLACEMENT_EXHAUST_SLUGS"
+        )
+        if not (
+            counters and gauges and filter_slugs and exhaust_slugs
+        ):
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "could not find the PLACEMENT_* registries in "
+                    "nomad_tpu/explain.py",
+                )
+            ]
+        registered = (
+            counters
+            | gauges
+            | {f"placement.filtered.{s}" for s in filter_slugs}
+            | {f"placement.exhausted.{s}" for s in exhaust_slugs}
+        )
+        slug_fns = {"reason_slug", "dimension_slug"}
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in astutil.METRIC_CALLS
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ):
+                if arg.value.startswith("placement.") and (
+                    arg.value not in registered
+                ):
+                    problems.append(
+                        Finding(
+                            self.name, path, node.lineno,
+                            f"placement metric {arg.value!r} "
+                            "emitted but not in the "
+                            "zero-registered PLACEMENT_* "
+                            "registries",
+                        )
+                    )
+                continue
+            if isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                if arg.values and isinstance(
+                    arg.values[0], ast.Constant
+                ):
+                    prefix = str(arg.values[0].value)
+                if not prefix.startswith("placement."):
+                    continue
+                if prefix not in (
+                    "placement.filtered.",
+                    "placement.exhausted.",
+                ):
+                    problems.append(
+                        Finding(
+                            self.name, path, node.lineno,
+                            "dynamic placement metric prefix "
+                            f"{prefix!r} has no zero-registered "
+                            "family",
+                        )
+                    )
+                    continue
+                for part in arg.values[1:]:
+                    if not isinstance(part, ast.FormattedValue):
+                        continue
+                    call = part.value
+                    ok = (
+                        isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)
+                        and call.func.id in slug_fns
+                    )
+                    if not ok:
+                        problems.append(
+                            Finding(
+                                self.name, path, node.lineno,
+                                "placement metric family "
+                                f"{prefix!r} interpolates a value "
+                                "not produced by reason_slug()/"
+                                "dimension_slug() — the name space "
+                                "would be unbounded",
+                            )
+                        )
+        server_path = ctx.path("server")
+        server_src = ctx.source(server_path)
+        if (
+            "preregister" not in server_src
+            or "explain" not in server_src
+        ):
+            problems.append(
+                Finding(
+                    self.name, server_path, 0,
+                    "server.py no longer zero-registers the "
+                    "placement.* families at construction "
+                    "(explain.preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "explain",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.incr("placement.bogus_metric")\n'
+            ),
+        )
+
+
+@register
+class ReasonVocabularyRule(Rule):
+    """Check 8: reason-string literals used by the vectorized path
+    must come from the serial chain's shared vocabulary — a string
+    literal passed to ``filter_node(...)`` in sched/tpu_stack.py must
+    be one of the ``FILTER_*`` constants' values (sched/feasible.py),
+    and a literal ``exhausted_node(...)`` dimension must be in the
+    ``allocs_fit`` superset vocabulary."""
+
+    name = "reason-vocab"
+    description = "vectorized-path reason literals use shared vocab"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        feasible_path = ctx.path("feasible")
+        allowed: Set[str] = set()
+        for node in ast.walk(ctx.tree(feasible_path)):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id.startswith("FILTER_")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    allowed.add(node.value.value)
+        if not allowed:
+            return [
+                Finding(
+                    self.name, feasible_path, 0,
+                    "could not find the FILTER_* reason constants "
+                    "in sched/feasible.py",
+                )
+            ]
+        stack_path = ctx.path("tpu_stack")
+        problems: List[Finding] = []
+        for node in ast.walk(ctx.tree(stack_path)):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                continue
+            literal = node.args[1].value
+            if (
+                node.func.attr == "filter_node"
+                and literal not in allowed
+            ):
+                problems.append(
+                    Finding(
+                        self.name, stack_path, node.lineno,
+                        "ad-hoc filter reason literal in "
+                        f"sched/tpu_stack.py: {literal!r} is not a "
+                        "shared FILTER_* constant value (import "
+                        "the constant instead)",
+                    )
+                )
+            if (
+                node.func.attr == "exhausted_node"
+                and literal not in EXHAUST_DIMENSIONS
+            ):
+                problems.append(
+                    Finding(
+                        self.name, stack_path, node.lineno,
+                        "ad-hoc exhaustion dimension literal in "
+                        f"sched/tpu_stack.py: {literal!r} is "
+                        "outside the allocs_fit superset "
+                        "vocabulary",
+                    )
+                )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "tpu_stack",
+            append=(
+                "def _nomadlint_bad_fixture(it, node):\n"
+                '    it.filter_node(node, "bogus ad-hoc reason")\n'
+            ),
+        )
+
+
+@register
+class AdmissionMetricsRule(Rule):
+    """Check 10 (counter half): every ``admission.*`` metric the
+    batch worker emits — literal first args of metric calls plus the
+    ``self._count_admission("<kind>")`` sites, which emit
+    ``admission.<kind>`` — is in the zero-registered
+    ``ADMISSION_COUNTERS`` registry, and server.py actually
+    zero-registers it."""
+
+    name = "admission-metrics"
+    description = "admission.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("batch_worker")
+        tree = ctx.tree(path)
+        registry = astutil.assigned_strings(
+            tree, "ADMISSION_COUNTERS"
+        )
+        if not registry:
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "could not find the ADMISSION_COUNTERS "
+                    "registry in batch_worker.py",
+                )
+            ]
+        emitted: Set[str] = set()
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            if (
+                node.func.attr in astutil.METRIC_CALLS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("admission.")
+            ):
+                emitted.add(node.args[0].value)
+            if (
+                node.func.attr == "_count_admission"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                emitted.add(f"admission.{node.args[0].value}")
+        problems: List[Finding] = []
+        unregistered = emitted - registry
+        if unregistered:
+            problems.append(
+                Finding(
+                    self.name, path, 0,
+                    "admission.* metrics emitted but not in the "
+                    "ADMISSION_COUNTERS registry (they would be "
+                    "absent from prometheus scrapes until the "
+                    "first mid-chain admission): "
+                    f"{sorted(unregistered)}",
+                )
+            )
+        server_path = ctx.path("server")
+        if "ADMISSION_COUNTERS" not in ctx.source(server_path):
+            problems.append(
+                Finding(
+                    self.name, server_path, 0,
+                    "server.py no longer zero-registers the "
+                    "admission.* family at construction "
+                    "(ADMISSION_COUNTERS preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.incr("admission.bogus_metric")\n'
+            ),
+        )
+
+
+@register
+class LatencySweepRule(Rule):
+    """Check 11: bench.py exports the ``latency_sweep`` JSON block
+    (offered-load vs p50/p99 with p99 trace exemplars) — the
+    per-round tracking of the <250 ms tail-latency target."""
+
+    name = "latency-sweep"
+    description = "bench.py exports the latency_sweep block"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("bench")
+        if '"latency_sweep"' not in ctx.source(path):
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "bench.py no longer exports the latency_sweep "
+                    "JSON block (offered-load vs p50/p99 with p99 "
+                    "trace exemplars)",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "bench",
+            old='"latency_sweep"',
+            new='"renamed_latency_sweep"',
+        )
+
+
+MIGRATED_RULES = (
+    "stage-observed",
+    "stage-orphans",
+    "bench-stage-export",
+    "span-registry",
+    "device-metrics",
+    "debug-bundle-device",
+    "placement-metrics",
+    "reason-vocab",
+    "debug-bundle-placements",
+    "admission-metrics",
+    "latency-sweep",
+)
