@@ -54,6 +54,16 @@ os.environ.setdefault("NOMAD_TPU_BROKER_WATCHDOG", "1")
 # (spread/affinity at 5k nodes) a sequential fallback eval costs ~25s —
 # far more than the compile it is dodging
 os.environ.setdefault("NOMAD_TPU_SYNC_COMPILE", "1")
+# virtual host devices for the multichip sweep: the flag only affects
+# the CPU platform, so on real hardware the sweep sees the real chips
+# and this is inert.  Must be set before jax initializes its backends.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 N_ALLOCS = int(os.environ.get("BENCH_ALLOCS", 100_000))
@@ -1009,11 +1019,38 @@ def _share_classes(nodes):
 
 
 WITH_CONFIGS = os.environ.get("BENCH_CONFIGS", "1") == "1"
+WITH_MULTICHIP = os.environ.get("BENCH_MULTICHIP", "1") == "1"
 WITH_TRACE_OVERHEAD = os.environ.get("BENCH_TRACE_OVERHEAD", "1") == "1"
 WITH_EXPLAIN_OVERHEAD = (
     os.environ.get("BENCH_EXPLAIN_OVERHEAD", "1") == "1"
 )
 WITH_DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
+
+
+def bench_multichip():
+    """Sweep the sharded chained pipeline over device counts
+    (1/2/4/8 on the virtual CPU mesh, the real chip counts on
+    hardware): placements/s, host->device bytes per warm mirror
+    flush (delta vs full), and per-device HLO FLOPs — the proof
+    block for the multi-chip hot path (`multichip` in BENCH json and
+    the MULTICHIP_r*.json tail)."""
+    from nomad_tpu.parallel.multichip import multichip_sweep
+
+    t0 = time.time()
+    block = multichip_sweep()
+    for p in block["points"]:
+        if "skipped" in p:
+            log(f"multichip d={p['n_devices']}: skipped")
+            continue
+        log(
+            f"multichip d={p['n_devices']}: "
+            f"{p['placements_per_sec']} placements/s, "
+            f"{p['per_device_flops']:.3g} flops/device, "
+            f"{p['bytes_per_flush_delta']}B delta vs "
+            f"{p['bytes_per_flush_full']}B full per flush"
+        )
+    log(f"multichip sweep took {time.time() - t0:.1f}s")
+    return block
 
 
 def bench_device_supervisor():
@@ -1359,6 +1396,13 @@ def main():
     )
     configs = bench_configs() if WITH_CONFIGS else {}
     kernel = bench_kernel_only() if WITH_KERNEL else {}
+    multichip = {}
+    if WITH_MULTICHIP:
+        try:
+            multichip = bench_multichip()
+        except Exception as exc:  # noqa: BLE001
+            log(f"multichip sweep FAILED: {exc!r}")
+            multichip = {"error": repr(exc)}
     device = {}
     if WITH_DEVICE:
         try:
@@ -1415,6 +1459,10 @@ def main():
                     kernel.get("kernel-chained", 0.0), 1
                 ),
                 "device_supervisor": device,
+                # sharded hot-path proof: placements/s, per-device
+                # HLO FLOPs, and host->device bytes/flush (delta vs
+                # full) vs device count on the node-axis mesh
+                "multichip": multichip,
                 "configs": configs,
             }
         )

@@ -61,6 +61,11 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "0", "nomad_tpu/server/batch_worker.py",
         "1 shards prescore launches over the node-axis device mesh",
     ),
+    "NOMAD_TPU_MESH_DEVICES": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "cap on the node-axis mesh device count (0 = all devices; "
+        "bench sweeps and deployments reserving chips set this)",
+    ),
     "NOMAD_TPU_SYNC_COMPILE": EnvKnob(
         "0", "nomad_tpu/server/batch_worker.py",
         "1 makes cold kernel compiles block (deterministic tests) "

@@ -1123,6 +1123,62 @@ def patch_rows_donated():
     return _patch_rows_donated
 
 
+_patch_rows_sharded_cache: dict = {}
+
+
+def patch_rows_sharded(mesh, donate: bool = False):
+    """Per-shard scatter-patch for a ``NamedSharding(P("nodes"))``
+    mirror column — the delta-sync primitive for the BatchWorker's
+    SHARDED device-resident usage mirror.  Each device receives the
+    replicated (idx, vals) staging buffers (O(dirty rows) bytes
+    host->device, total) and scatters only the rows that land in its
+    own node shard: one local scatter per shard, zero cross-shard
+    traffic.  Padding slots use ``idx == C`` (out of this shard's
+    range on every shard) and are dropped, exactly like `patch_rows`.
+
+    ``donate=True`` donates the stale column like `patch_rows_donated`
+    — the caller replaces it in its cache with the patched output, so
+    the scatter writes device memory in place.  The same exclusivity
+    gating applies: the caller must prove no abandoned in-flight
+    launch or background shield compile could still be reading the
+    buffer (BatchWorker falls back to the copying variant — and a full
+    re-upload — whenever that cannot be proven).  Compiled runners are
+    cached per (mesh, donate)."""
+    key = (mesh, bool(donate))
+    fn = _patch_rows_sharded_cache.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as _P
+
+        from ..parallel.mesh import shard_map as _shard_map
+
+        def _patch(col, idx, vals):
+            shard = jax.lax.axis_index("nodes")
+            size = col.shape[0]
+            local = idx - shard * size
+            ok = (local >= 0) & (local < size)
+            # misses (another shard's rows, padding) map to `size`,
+            # which mode="drop" discards
+            safe = jnp.where(ok, local, size)
+            return col.at[safe].set(vals, mode="drop")
+
+        wrapped = functools.partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=(_P("nodes"), _P(), _P()),
+            out_specs=_P("nodes"),
+        )(_patch)
+        fn = jax.jit(
+            wrapped, donate_argnums=(0,) if donate else ()
+        )
+        fn.__name__ = (
+            "patch_rows_sharded_donated"
+            if donate
+            else "patch_rows_sharded"
+        )
+        _patch_rows_sharded_cache[key] = fn
+    return fn
+
+
 @functools.partial(
     jax.jit, static_argnames=("n_picks", "spread_fit")
 )

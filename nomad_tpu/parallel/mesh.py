@@ -228,7 +228,8 @@ def _sharded_walk(final_full, feas_full, perm, off, lim, nc,
 def sharded_chained_plan(mesh: Mesh, n_picks: int,
                          spread_fit: bool = False,
                          with_spread: bool = False,
-                         spread_even: bool = False):
+                         spread_even: bool = False,
+                         return_carry: bool = False):
     """The production chained planner with REAL node-axis sharding:
     every per-pick quantity that is O(nodes) — fit masks, fitness,
     anti-affinity, penalties, usage scatter — is computed on the
@@ -251,7 +252,22 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
 
     Returns ``run(cpu_total, mem_total, disk_total, used0_cpu,
     used0_mem, used0_disk, feasible[E,C], perm[E,C], asks..., wanted,
-    limits, n_candidates, coll0[E,C], deltas, pre) -> rows[E,P]``.
+    limits, n_candidates, coll0[E,C], deltas, pre) ->
+    (rows[E,P], pulls[E,P])``.  ``pulls`` is the per-pick
+    source-iterator consumption — identical to the unsharded kernel's,
+    so mesh-path preempt retries replay through the same passthrough
+    machinery as the serial chain.
+
+    With ``return_carry=True`` the final eval-scan carry — the chained
+    (cpu, mem, disk) usage columns, still sharded ``P("nodes")`` — is
+    returned as a third output.  Feeding it into the next launch's
+    ``used0_*`` is bit-identical to one longer launch (a lax.scan cut
+    at an eval boundary), which is what lets the mesh path run through
+    the BatchWorker's double-buffered chunk pipeline: the sharded
+    usage columns thread chunk -> chunk entirely on-device.  The
+    ``used0_*`` inputs may be host arrays or device-resident
+    ``NamedSharding(P("nodes"))`` arrays (the sharded usage mirror /
+    the previous chunk's carry) — no resharding happens either way.
     """
     from ..ops.batch import (
         PreDeltas,
@@ -295,9 +311,16 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
             ),
         )
 
+    # rows/pulls are replicated by construction (post-all-gather walk);
+    # the usage carry stays sharded along the node axis so a chunked
+    # chain never gathers it
+    out_specs = (P(), P())
+    if return_carry:
+        out_specs = out_specs + ((col, col, col),)
+
     @jax.jit
     @functools.partial(
-        shard_map, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
     )
     def _run(
         cpu_total, mem_total, disk_total,
@@ -479,6 +502,9 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
                 ok = active & any_emitted
                 dead = dead | (active & ~any_emitted)
                 row = jnp.where(ok, win_row, NO_NODE)
+                # per-pick source consumption, surfaced exactly like
+                # the unsharded kernel (inactive picks pull nothing)
+                pulls_out = jnp.where(active, pulls, 0)
                 cpu_c = local_scatter(
                     cpu_c, row, jnp.asarray(a_cpu, dtype), ok
                 )
@@ -501,10 +527,10 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
                     return (
                         cpu_c, mem_c, disk_c, coll_c, pen_c, off,
                         dead, spread_prop, spread_clr,
-                    ), row
+                    ), (row, pulls_out)
                 return (
                     cpu_c, mem_c, disk_c, coll_c, pen_c, off, dead
-                ), row
+                ), (row, pulls_out)
 
             carry0 = (
                 cpu_u, mem_u, disk_u, coll_l,
@@ -517,11 +543,14 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
                     sp.proposed0.astype(cpu_total.dtype),
                     sp.cleared0.astype(cpu_total.dtype),
                 )
-            final_carry, rows = jax.lax.scan(
+            final_carry, (rows, pulls) = jax.lax.scan(
                 pick_step, carry0,
                 jnp.arange(n_picks, dtype=jnp.int32),
             )
-            return (final_carry[0], final_carry[1], final_carry[2]), rows
+            return (
+                (final_carry[0], final_carry[1], final_carry[2]),
+                (rows, pulls),
+            )
 
         used0 = (used0_cpu, used0_mem, used0_disk)
         xs_all = (
@@ -531,8 +560,10 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
         )
         if with_spread:
             xs_all = xs_all + (spread_all,)
-        _final, rows = jax.lax.scan(eval_step, used0, xs_all)
-        return rows
+        final, (rows, pulls) = jax.lax.scan(eval_step, used0, xs_all)
+        if return_carry:
+            return rows, pulls, final
+        return rows, pulls
 
     return _run
 

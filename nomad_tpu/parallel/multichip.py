@@ -1,0 +1,220 @@
+"""Multi-chip sweep: the numbers behind the bench's ``multichip``
+block and the driver's MULTICHIP_r*.json tail.
+
+For each device count the sweep builds an (evals=1, nodes=d) mesh and
+drives the PRODUCTION sharded chained runner
+(``sharded_chained_plan(..., return_carry=True)``) exactly the way the
+BatchWorker's mesh pipeline does: the eval axis split into chunk-wide
+launches whose sharded usage carry threads chunk -> chunk on-device.
+Three numbers per point:
+
+* ``placements_per_sec`` — warmed wall-clock over the chunked chain
+  (E evals x P picks per run, best of a few rounds);
+* ``per_device_flops`` — compiled cost analysis of one chunk launch
+  (XLA reports per-device FLOPs for SPMD programs, so this should
+  scale ~1/devices while the replicated walk keeps a floor — the same
+  quantity tests/test_parallel.py asserts on);
+* ``bytes_per_flush_delta`` vs ``bytes_per_flush_full`` — the
+  host->device staging bytes of one sharded-mirror delta sync
+  (``patch_rows_sharded``: an i32 index buffer + f64 value buffer per
+  used column, O(dirty rows)) against a full six-column re-upload
+  (O(nodes)), the transfer the sharded usage mirror removed from the
+  warm mesh flush.
+
+Shapes are deliberately modest (the point is scaling ratios, not
+absolute throughput) so the sweep also runs on the virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``) where hardware is
+unavailable.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def _chain_inputs(C: int, E: int, P: int, seed: int = 3):
+    """Synthetic single-group chained inputs in the sharded runner's
+    per-eval scalar layout (the worker's T=1 slices)."""
+    from ..ops.batch import PreDeltas, StepDeltas
+
+    rng = np.random.default_rng(seed)
+    n_cand = C - 8
+    K, R = 2, 1
+    perms = np.stack(
+        [
+            np.concatenate(
+                [rng.permutation(n_cand), np.arange(n_cand, C)]
+            )
+            for _ in range(E)
+        ]
+    ).astype(np.int32)
+    feas = np.zeros((E, C), dtype=bool)
+    feas[:, :n_cand] = rng.random((E, n_cand)) > 0.1
+    cols = (
+        np.full(C, 8000.0),
+        np.full(C, 16384.0),
+        np.full(C, 100_000.0),
+        rng.integers(0, 2000, C).astype(np.float64),
+        rng.integers(0, 4096, C).astype(np.float64),
+        np.zeros(C),
+    )
+    per_eval = (
+        feas,
+        perms,
+        np.full(E, 500.0),
+        np.full(E, 256.0),
+        np.full(E, 300.0),
+        np.full(E, P, np.int32),  # desired_count
+        np.full(E, 9, np.int32),  # limit
+        np.full(E, P, np.int32),  # wanted
+        np.full(E, n_cand, np.int32),
+        np.zeros(E, dtype=bool),  # distinct_hosts
+        np.zeros((E, C), np.int32),  # coll0
+        np.zeros((E, C)),  # affinity
+        StepDeltas(
+            evict_rows=np.full((E, P), -1, np.int32),
+            evict_cpu=np.zeros((E, P)),
+            evict_mem=np.zeros((E, P)),
+            evict_disk=np.zeros((E, P)),
+            evict_coll=np.zeros((E, P), np.int32),
+            penalty_rows=np.full((E, P, K), -1, np.int32),
+        ),
+        PreDeltas(
+            rows=np.zeros((E, R), np.int32),
+            cpu=np.zeros((E, R)),
+            mem=np.zeros((E, R)),
+            disk=np.zeros((E, R)),
+        ),
+    )
+    return cols, per_eval
+
+
+def _slice_eval(per_eval, a: int, b: int):
+    out: List[object] = []
+    for x in per_eval:
+        if isinstance(x, np.ndarray):
+            out.append(x[a:b])
+        else:
+            out.append(type(x)(*[f[a:b] for f in x]))
+    return tuple(out)
+
+
+def _mirror_sync_bytes(C: int, dirty_rows: int) -> dict:
+    """Staging bytes of one sharded-mirror sync, computed from the
+    exact buffers ``BatchWorker._device_columns_locked`` ships — and
+    therefore equal to what the ``mesh.bytes_per_flush`` gauge reads
+    for the same sync: each of the three used columns stages its own
+    pow2-padded i32 index buffer plus an f64 value buffer on the
+    delta path; the full path uploads six C-row f64 columns."""
+    from ..ops.batch import pow2_bucket
+
+    width = pow2_bucket(max(dirty_rows, 1), floor=8)
+    return {
+        "dirty_rows": dirty_rows,
+        "bytes_per_flush_delta": 3 * (width * 4 + width * 8),
+        "bytes_per_flush_full": 6 * C * 8,
+    }
+
+
+def multichip_sweep(
+    device_counts: Optional[Sequence[int]] = None,
+    C: int = 1024,
+    E: int = 16,
+    P: int = 4,
+    chunk: int = 8,
+    dirty_rows: int = 24,
+    rounds: int = 3,
+) -> dict:
+    """Sweep the sharded chained pipeline over device counts; returns
+    the bench's ``multichip`` block."""
+    import jax
+
+    from ..ops.batch import patch_rows_sharded
+    from .mesh import make_mesh, sharded_chained_plan
+
+    n_avail = len(jax.devices())
+    if device_counts is None:
+        device_counts = [d for d in (1, 2, 4, 8) if d <= n_avail]
+        if not device_counts:
+            device_counts = [1]
+    points = []
+    for d in device_counts:
+        mesh = make_mesh(int(d), eval_axis=1)
+        if mesh.devices.size != d:
+            points.append(
+                {"n_devices": int(d), "skipped": "devices"}
+            )
+            continue
+        runner = sharded_chained_plan(mesh, P, return_carry=True)
+        cols, per_eval = _chain_inputs(C, E, P)
+
+        def run_chain():
+            carry = cols[3:6]
+            rows_out = []
+            for a in range(0, E, chunk):
+                rows, _pulls, carry = runner(
+                    *cols[:3], *carry,
+                    *_slice_eval(per_eval, a, a + chunk),
+                )
+                rows_out.append(rows)
+            jax.block_until_ready(rows_out[-1])
+            return rows_out
+
+        run_chain()  # warm the (chunk, P) trace
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            run_chain()
+            best = min(best, time.perf_counter() - t0)
+        # per-device FLOPs of one chunk launch (the compiled SPMD
+        # program XLA actually executes per chunk)
+        lowered = runner.lower(
+            *cols[:3], *cols[3:6], *_slice_eval(per_eval, 0, chunk)
+        )
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        # one real sharded delta patch, to prove the path runs on
+        # this mesh (the byte accounting itself is closed-form)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        col = jax.device_put(
+            cols[3], NamedSharding(mesh, PartitionSpec("nodes"))
+        )
+        from ..ops.batch import pow2_bucket
+
+        width = pow2_bucket(dirty_rows, floor=8)
+        idx = np.full(width, C, np.int32)
+        idx[:dirty_rows] = np.arange(dirty_rows, dtype=np.int32)
+        vals = np.zeros(width)
+        jax.block_until_ready(
+            patch_rows_sharded(mesh)(col, idx, vals)
+        )
+        point = {
+            "n_devices": int(d),
+            "placements_per_sec": round((E * P) / best, 1),
+            "chunk_width": chunk,
+            "chunk_launches": -(-E // chunk),
+            "per_device_flops": flops,
+        }
+        point.update(_mirror_sync_bytes(C, dirty_rows))
+        points.append(point)
+    flops_pts = [
+        p for p in points if p.get("per_device_flops", 0.0) > 0.0
+    ]
+    block = {
+        "arena_nodes": C,
+        "evals": E,
+        "picks": P,
+        "points": points,
+    }
+    if len(flops_pts) >= 2:
+        block["flops_scaling_first_to_last"] = round(
+            flops_pts[0]["per_device_flops"]
+            / flops_pts[-1]["per_device_flops"],
+            2,
+        )
+    return block

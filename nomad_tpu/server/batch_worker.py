@@ -119,6 +119,21 @@ ADMISSION_COUNTERS = (
     "admission.deferred",
     "admission.chains",
 )
+# sharded (mesh) hot-path metrics, zero-registered at Server
+# construction (tools.nomadlint mesh-metrics): every `mesh.*` name the
+# worker emits must appear here, so dashboards can tell "mesh never
+# engaged" from "mesh not exported".  mesh.launches counts sharded
+# chunk dispatches; the gauges carry the sharded mirror's sync cost
+# (host->device bytes uploaded by the LAST mirror sync — O(dirty rows)
+# on the warm path, the acceptance gauge for the delta-patch contract),
+# the chunk width mesh flushes ran at, and the sharded mirror's
+# delta-hit rate
+MESH_COUNTERS = ("mesh.launches",)
+MESH_GAUGES = (
+    "mesh.bytes_per_flush",
+    "mesh.chunk_width",
+    "mesh.mirror_hit_rate",
+)
 # optimistic parallel replay: below this many prescored evals in a run
 # the speculative-wave dispatch overhead beats the win
 REPLAY_MIN_WAVE = 2
@@ -273,9 +288,9 @@ class _Assembled:
     dev_aff_on: Optional[np.ndarray]
     occ0: Optional[np.ndarray]
     dh_tg: Optional[np.ndarray]
-    # shared node columns: host refs (mesh path) and the delta-patched
-    # device mirror (chunk path; None when the mesh path is taken)
-    host_cols: tuple = ()
+    # the shared node columns every launch reads: the delta-patched
+    # device mirror — plain device arrays on the chunk path, the
+    # NamedSharding(P("nodes")) sharded mirror on the mesh path
     dev_cols: Optional[tuple] = None
     use_mesh: bool = False
     # eval-axis width this arena's E was aligned to (one launch =
@@ -723,6 +738,9 @@ class BatchWorker(Worker):
         # constant, which misestimated both a laptop CPU backend and a
         # tunneled TPU by an order of magnitude in opposite directions)
         self._launch_ewma_seed: Optional[float] = None
+        # separate seed for mesh dispatches (their first warm launch
+        # says nothing about single-chip chunks, and vice versa)
+        self._mesh_ewma_seed: Optional[float] = None
         self._replay_ewma_ms = 5.0
         # continuous micro-batching (NOMAD_TPU_ADMIT=0 restores the
         # flush-boundary gulp loop): evals dequeued while a chunk
@@ -746,9 +764,13 @@ class BatchWorker(Worker):
         # later same-job eval — until the broker's nack timeout
         self._admitted_live: List[Tuple[Evaluation, str]] = []
         # abandoned in-flight launches (wedge/failover/fetch error)
-        # may still be reading the device usage mirror: the next
-        # mirror sync must re-upload instead of donating the buffers
+        # may still be reading the device usage mirror(s): the next
+        # sync of EACH mirror must re-upload instead of donating the
+        # buffers (per-mirror flags: a plain re-upload must not
+        # re-enable donation on the sharded mirror, whose buffers the
+        # abandoned mesh launch may still hold)
         self._mirror_dirty = False
+        self._mirror_dirty_sharded = False
         # host-assembly caches keyed by the node table's topology
         # generation (usage churn does NOT invalidate them): candidate
         # row layout per datacenter set, static feasibility /
@@ -766,6 +788,15 @@ class BatchWorker(Worker):
         # of re-shipping all C rows.  {"key": (topo_gen, C),
         # "gen": usage generation synced, "cols": 6 device arrays}
         self._usage_cache: Optional[dict] = None
+        # the SHARDED twin (NOMAD_TPU_MESH): the same six columns as
+        # NamedSharding(P("nodes")) arrays over the node-axis mesh,
+        # delta-patched per shard (ops/batch.patch_rows_sharded) so a
+        # warm mesh flush ships O(dirty rows) bytes instead of full
+        # node columns.  Both mirrors share the dirty-row log but sync
+        # independently (each tracks its own generation)
+        self._usage_cache_sharded: Optional[dict] = None
+        self._mesh_mirror_hits = 0
+        self._mesh_mirror_misses = 0
         # serializes mirror syncs: the prescore-warmup thread
         # (NOMAD_TPU_WARM_ON_START) and the worker thread both call
         # _device_columns, and two interleaved delta syncs could
@@ -827,6 +858,8 @@ class BatchWorker(Worker):
             "admit": 0.0,
             "launch": 0.0,
             "fetch": 0.0,
+            "mesh_launch": 0.0,
+            "mesh_fetch": 0.0,
             "replay": 0.0,
             "sequential": 0.0,
         }
@@ -834,14 +867,27 @@ class BatchWorker(Worker):
     def _make_mesh(self):
         """Node-axis device mesh when the hardware offers >1 device;
         None otherwise (and on any failure — the mesh is an
-        optimization, never a requirement)."""
+        optimization, never a requirement).  NOMAD_TPU_MESH_DEVICES
+        caps the node axis (bench sweeps and deployments that reserve
+        chips for other work)."""
+        import os as _os
+
         try:
             import jax as _jax
 
-            if len(_jax.devices()) > 1:
+            n = len(_jax.devices())
+            try:
+                cap = int(
+                    _os.environ.get("NOMAD_TPU_MESH_DEVICES", "0")
+                )
+            except ValueError:
+                cap = 0
+            if cap > 0:
+                n = min(n, cap)
+            if n > 1:
                 from ..parallel.mesh import make_mesh
 
-                return make_mesh(eval_axis=1)
+                return make_mesh(n_devices=n, eval_axis=1)
         except Exception:  # noqa: BLE001 — mesh is an optimization
             pass
         return None
@@ -884,6 +930,9 @@ class BatchWorker(Worker):
         # carries the OLD backend epoch, which the next lookup misses
         # and fully resyncs.
         self._usage_cache = None
+        # the sharded mirror's buffers live on the old backend's mesh
+        # shards — same epoch-keyed flush
+        self._usage_cache_sharded = None
         # ... and REPLACE the lock itself: post-flip _device_columns
         # calls run unguarded (CPU cannot wedge) and must never queue
         # behind that abandoned holder.  Late writers racing the swap
@@ -909,12 +958,15 @@ class BatchWorker(Worker):
         # raises RuntimeError there, a fresh dict does not
         self._sharded_runners = {}
         self._launch_ewma = {}
-        # the seed measurement came from the OLD backend — a TPU's
+        # the seed measurements came from the OLD backend — a TPU's
         # first warm launch says nothing about the CPU fallback's
         self._launch_ewma_seed = None
+        self._mesh_ewma_seed = None
         # in-flight launches abandoned by the flip may still read the
-        # mirror; force the next sync to re-upload (no donation)
+        # mirrors; force the next sync of each to re-upload (no
+        # donation)
         self._mirror_dirty = True
+        self._mirror_dirty_sharded = True
         # donation only helps off-CPU; re-resolve for the new target
         self._donate_carries = None
         if sup.failed_over():
@@ -940,10 +992,14 @@ class BatchWorker(Worker):
         if runner is None:
             from ..parallel.mesh import sharded_chained_plan
 
+            # return_carry=True always: every production mesh launch
+            # is a chunk of a (possibly length-1) chain, and the
+            # sharded usage carry threads chunk -> chunk on-device
             runner = sharded_chained_plan(
                 self._mesh, n_picks, spread_fit,
                 with_spread=with_spread,
                 spread_even=spread_even,
+                return_carry=True,
             )
             runner.__name__ = f"sharded_chained_{n_picks}_{spread_fit}"
             self._sharded_runners[key] = runner
@@ -1044,8 +1100,14 @@ class BatchWorker(Worker):
             "batch_worker.replay_ewma_ms", self._replay_ewma_ms
         )
         for bucket, ms in self._launch_ewma.items():
+            # mesh buckets are ("mesh", width) tuples -> .m<width>
+            suffix = (
+                f"m{bucket[1]}"
+                if isinstance(bucket, tuple)
+                else f"e{bucket}"
+            )
             metrics.set_gauge(
-                f"batch_worker.launch_ewma_ms.e{bucket}", ms
+                f"batch_worker.launch_ewma_ms.{suffix}", ms
             )
 
     def _replay_pool_instance(self):
@@ -1085,19 +1147,30 @@ class BatchWorker(Worker):
         )
         return buckets or (self.batch_max,)
 
-    def _launch_cost_ms(self, width: int) -> float:
+    @staticmethod
+    def _ewma_key(width: int, mesh: bool):
+        """Launch-EWMA bucket key: mesh dispatches get their OWN
+        buckets — a sharded all-gather-bearing launch costs nothing
+        like a single-chip chunk of the same width, and smearing its
+        cost into the chunk buckets used to poison the adaptive
+        width/cap policy for both paths."""
+        return ("mesh", width) if mesh else width
+
+    def _launch_cost_ms(self, width: int, mesh: bool = False) -> float:
         """Estimated cost of one ``width``-wide chunk launch (dispatch
         + blocking fetch): the measured EWMA for that bucket, the
         first warm launch observed on this backend for buckets with no
-        samples yet, or 50 ms before anything has been measured."""
-        default = (
-            self._launch_ewma_seed
-            if self._launch_ewma_seed is not None
-            else 50.0
+        samples yet, or 50 ms before anything has been measured.
+        Mesh launches read (and seed) only mesh buckets."""
+        seed = self._mesh_ewma_seed if mesh else self._launch_ewma_seed
+        default = seed if seed is not None else 50.0
+        return self._launch_ewma.get(
+            self._ewma_key(width, mesh), default
         )
-        return self._launch_ewma.get(width, default)
 
-    def _note_launch_cost(self, width: int, ms: float) -> None:
+    def _note_launch_cost(
+        self, width: int, ms: float, mesh: bool = False
+    ) -> None:
         """Feed one chunk's measured device-path cost into the
         adaptive sizing loop (and seed the default estimate from the
         first warm measurement).  A sample an order of magnitude past
@@ -1109,14 +1182,20 @@ class BatchWorker(Worker):
         ceiling = 20.0 * max(self.latency_budget_ms, 50.0)
         if ms > ceiling:
             return
-        if self._launch_ewma_seed is None:
+        if mesh:
+            if self._mesh_ewma_seed is None:
+                self._mesh_ewma_seed = ms
+        elif self._launch_ewma_seed is None:
             self._launch_ewma_seed = ms
-        prev = self._launch_ewma.get(width)
-        self._launch_ewma[width] = (
+        key = self._ewma_key(width, mesh)
+        prev = self._launch_ewma.get(key)
+        self._launch_ewma[key] = (
             ms if prev is None else 0.8 * prev + 0.2 * ms
         )
 
-    def _plan_chunk_width(self, n_evals: int, backlog: int) -> int:
+    def _plan_chunk_width(
+        self, n_evals: int, backlog: int, mesh: bool = False
+    ) -> int:
         """Chunk width for a flush of ``n_evals`` given the backlog.
 
         Saturated (or latency budget off): the widest bucket — fewer
@@ -1135,20 +1214,21 @@ class BatchWorker(Worker):
         for w in buckets:
             if n_evals <= w:
                 return w
-        if len(buckets) > 1 and self._launch_cost_ms(widest) > (
-            self.latency_budget_ms / 2.0
-        ):
+        if len(buckets) > 1 and self._launch_cost_ms(
+            widest, mesh=mesh
+        ) > (self.latency_budget_ms / 2.0):
             return buckets[-2]
         return widest
 
-    def _chunk_width(self, n_evals: int) -> int:
+    def _chunk_width(self, n_evals: int, mesh: bool = False) -> int:
         """Per-flush chunk width (reads the live backlog), exported as
-        the ``batch_worker.chunk_width`` gauge."""
+        the ``batch_worker.chunk_width`` gauge.  ``mesh`` flushes plan
+        from the mesh launch-cost buckets."""
         try:
             backlog = self.server.broker.ready_count(self.schedulers)
         except Exception:  # noqa: BLE001 — sizing is best-effort
             backlog = self.batch_max
-        width = self._plan_chunk_width(n_evals, backlog)
+        width = self._plan_chunk_width(n_evals, backlog, mesh=mesh)
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
             metrics.set_gauge("batch_worker.chunk_width", width)
@@ -1460,8 +1540,14 @@ class BatchWorker(Worker):
             # backend — they must be dropped, never executed
             chain_epoch = self._backend_epoch
             # adaptive micro-batch width for this flush, from the
-            # measured launch EWMAs + live backlog
-            chunk_w = self._chunk_width(len(sims))
+            # measured launch EWMAs + live backlog.  On a mesh worker
+            # the width plans from the mesh cost buckets — most
+            # flushes there take the sharded path, and a mispredicted
+            # width for the ones that don't is a heuristic miss, not a
+            # correctness issue
+            chunk_w = self._chunk_width(
+                len(sims), mesh=self._mesh is not None
+            )
             asm = None
             try:
                 asm = self._guard_device(
@@ -1514,76 +1600,7 @@ class BatchWorker(Worker):
                 # speculation reads (launches haven't fetched yet)
                 wave_base = self.store.node_touch_counts()
                 chain_base = wave_base
-            if asm is not None and asm.use_mesh:
-                t0 = _time.monotonic()
-                rows_arr = None
-                cold = False
-                # a failover between assemble and launch disabled the
-                # mesh: skip the launch entirely (and don't miscount
-                # it as a cold-compile fallback — the failover is the
-                # cause, and its own counters already tell that story)
-                mesh_off = self._mesh is None
-                try:
-                    if not mesh_off:
-                        rows_arr = self._guard_device(
-                            "launch",
-                            lambda: self._launch_mesh(asm),
-                            exemplar=run[idx][0].id,
-                        )
-                        cold = rows_arr is None and not (
-                            self._mesh is None
-                        )
-                except Exception:  # noqa: BLE001
-                    self._count("errors")
-                    LOG.warning(
-                        "sharded prescore failed for %d evals",
-                        len(sims), exc_info=True,
-                    )
-                if cold:
-                    self._count("cold_shape_fallbacks")
-                dt = _time.monotonic() - t0
-                self._observe_chunk(
-                    "fetch", run, idx, 0, asm.E_real, t0, dt,
-                    mesh=True,
-                )
-                if rows_arr is not None:
-                    # feed the adaptive sizing loop: the mesh launch
-                    # covers the whole run in one dispatch — spread
-                    # its blocking cost over the equivalent number of
-                    # widest-bucket chunks
-                    widest = self._chunk_buckets()[-1]
-                    eq_chunks = max(1, -(-asm.E_real // widest))
-                    self._note_launch_cost(
-                        widest, dt * 1000.0 / eq_chunks
-                    )
-                    for e in range(asm.E_real):
-                        if rescore:
-                            break
-                        ev, token, job = run[idx + e]
-                        sim = sims[e]
-                        rows = [
-                            int(r)
-                            for r in rows_arr[e, : sim.placements]
-                        ]
-                        # mesh launches don't surface pulls; preempt
-                        # retries deviate there
-                        if wave is not None:
-                            wave.append((
-                                ev, token, job, sim, rows, None,
-                                spec_pool.submit(
-                                    self._speculate_one, snap,
-                                    wave_readiness, ev, job, sim,
-                                    rows, None,
-                                ),
-                            ))
-                            continue
-                        ok = self._replay_one(
-                            ev, token, job, sim, rows, None
-                        )
-                        k += 1
-                        if not ok:
-                            rescore = True
-            elif asm is not None:
+            if asm is not None:
                 # chunked double-buffered launches: chunk N executes
                 # on device while the host replays chunk N-1's picks,
                 # and chunk N+1 chains on N's device-resident carry
@@ -1591,11 +1608,21 @@ class BatchWorker(Worker):
                 # at chunk boundaries is bit-identical to one launch.
                 # Each descriptor is (arena, slice start/end, run
                 # index of the arena's eval 0) — admitted chunks bring
-                # their own arena, chained on the live carry.
+                # their own arena, chained on the live carry.  Mesh
+                # arenas (asm.use_mesh) run the SAME pipeline: the
+                # launch dispatches the node-sharded chained runner
+                # and the sharded usage carry threads chunk -> chunk
+                # on-device (mesh_launch/mesh_fetch stages).
                 chunks = [
                     (asm, s, s + asm.chunk, idx)
                     for s in range(0, asm.E, asm.chunk)
                 ]
+                if asm.use_mesh:
+                    metrics = getattr(self.server, "metrics", None)
+                    if metrics is not None:
+                        metrics.set_gauge(
+                            "mesh.chunk_width", asm.chunk
+                        )
                 # continuous micro-batching: while this chain is in
                 # flight, evals the broker receives are admitted as
                 # new chunks of the SAME chain — but only when the
@@ -1643,9 +1670,10 @@ class BatchWorker(Worker):
                         )
                         pending.clear()
                         # the dropped launches may still be reading
-                        # the usage mirror on the old backend: the
-                        # next sync must re-upload, never donate
-                        self._mirror_dirty = True
+                        # the usage mirrors on the old backend: the
+                        # next sync of each must re-upload, never
+                        # donate
+                        self._mark_mirror_dirty()
                         stalled = True
                         break
                     while (
@@ -1654,11 +1682,19 @@ class BatchWorker(Worker):
                         and len(pending) < self.pipeline_depth
                     ):
                         casm, c0, c1, base = chunks[ci]
+                        # mesh chunks time/trace/guard under their own
+                        # stage names: a sharded dispatch has its own
+                        # cost profile AND its own watchdog budget
+                        # (the supervisor budgets per stage key)
+                        launch_stage = (
+                            "mesh_launch" if casm.use_mesh
+                            else "launch"
+                        )
                         t0 = _time.monotonic()
                         handle = None
                         try:
                             handle = self._guard_device(
-                                "launch",
+                                launch_stage,
                                 lambda: self._launch_chunk(
                                     casm, c0, c1, carry,
                                     # first slice of each arena: the
@@ -1670,7 +1706,14 @@ class BatchWorker(Worker):
                                 ),
                                 exemplar=run[base + c0][0].id,
                             )
-                            if handle is None:
+                            if handle is None and not (
+                                casm.use_mesh and self._mesh is None
+                            ):
+                                # a mesh arena whose mesh vanished
+                                # (failover between assemble and
+                                # launch) is not a cold shape — the
+                                # failover's own counters tell that
+                                # story
                                 self._count("cold_shape_fallbacks")
                         except Exception:  # noqa: BLE001
                             self._count("errors")
@@ -1680,7 +1723,7 @@ class BatchWorker(Worker):
                             )
                         dt = _time.monotonic() - t0
                         self._observe_chunk(
-                            "launch", run, base, c0,
+                            launch_stage, run, base, c0,
                             min(c1, casm.E_real), t0, dt,
                             chunk=ci, ok=handle is not None,
                         )
@@ -1712,10 +1755,13 @@ class BatchWorker(Worker):
                     (casm, c0, c1, base), handle, launch_dt = (
                         pending.popleft()
                     )
+                    fetch_stage = (
+                        "mesh_fetch" if casm.use_mesh else "fetch"
+                    )
                     t0 = _time.monotonic()
                     try:
                         rows_arr, pulls_arr = self._guard_device(
-                            "fetch",
+                            fetch_stage,
                             lambda: self._fetch(handle),
                             exemplar=run[base + c0][0].id,
                         )
@@ -1728,23 +1774,25 @@ class BatchWorker(Worker):
                         # they share its failure: drop them and let the
                         # exact path cover the rest of the run
                         pending.clear()
-                        self._mirror_dirty = True
+                        self._mark_mirror_dirty()
                         stalled = True
                         self._observe(
-                            "fetch", _time.monotonic() - t0
+                            fetch_stage, _time.monotonic() - t0
                         )
                         continue
                     dt = _time.monotonic() - t0
                     self._observe_chunk(
-                        "fetch", run, base, c0,
+                        fetch_stage, run, base, c0,
                         min(c1, casm.E_real), t0, dt,
                     )
                     # feed the adaptive sizing loop: this chunk's
                     # blocking device-path cost (dispatch + the fetch
                     # wait replay overlap didn't hide), keyed by its
-                    # width bucket
+                    # width bucket — mesh dispatches into their own
+                    # buckets
                     self._note_launch_cost(
-                        c1 - c0, (launch_dt + dt) * 1000.0
+                        c1 - c0, (launch_dt + dt) * 1000.0,
+                        mesh=casm.use_mesh,
                     )
                     for e in range(c0, min(c1, casm.E_real)):
                         if rescore:
@@ -1791,9 +1839,9 @@ class BatchWorker(Worker):
                         )
                 if pending:
                     # a rescore exit abandoned in-flight launches that
-                    # may still read the usage mirror: the next sync
+                    # may still read the usage mirrors: the next sync
                     # must re-upload instead of donating the buffers
-                    self._mirror_dirty = True
+                    self._mark_mirror_dirty()
                 if admission is not None and admission.deferred:
                     # gated-out arrivals: the worker holds their
                     # leases; run() processes them as the next gulp
@@ -1942,12 +1990,14 @@ class BatchWorker(Worker):
             return [], j
         asm2 = None
         try:
-            # same snapshot, same chunk width, SAME device-column
-            # mirror tuple as the chain head (re-syncing the mirror
-            # mid-chain would patch buffers in-flight launches read)
+            # same snapshot, same chunk width, same backend path
+            # (sharded or not), SAME device-column mirror tuple as
+            # the chain head (re-syncing the mirror mid-chain would
+            # patch buffers in-flight launches read)
             asm2 = self._assemble(
                 snap, admitted, adm_sims, chunk=chunk_w,
                 shared_cols=asm0.dev_cols, chain=True,
+                mesh=asm0.use_mesh,
             )
         except Exception:  # noqa: BLE001
             self._count("errors")
@@ -1957,7 +2007,7 @@ class BatchWorker(Worker):
             )
         if asm2 is None or (
             asm2.port_ask is not None or asm2.dev_ask is not None
-        ):
+        ) or asm2.use_mesh != asm0.use_mesh:
             # unreachable port/dev arenas are gated per-sim above;
             # defensive — defer the whole admitted group, INSERTED
             # AHEAD of any evals this round already gate-deferred:
@@ -3211,7 +3261,14 @@ class BatchWorker(Worker):
 
     # -- snapshot-delta input cache ------------------------------------
 
-    def _device_columns(self, table) -> tuple:
+    def _mark_mirror_dirty(self) -> None:
+        """Abandoned in-flight launches may still be reading EITHER
+        device mirror: the next sync of each must re-upload instead of
+        donating the buffers out from under them."""
+        self._mirror_dirty = True
+        self._mirror_dirty_sharded = True
+
+    def _device_columns(self, table, sharded: bool = False) -> tuple:
         """The six shared node columns (cpu/mem/disk totals + used) as
         device-resident arrays — the persistent padded arena the
         pipelined prescore launches read instead of re-shipping all C
@@ -3222,76 +3279,108 @@ class BatchWorker(Worker):
         Patching uses absolute SET of the current host values (never
         accumulated deltas), so the device mirror is bit-identical to a
         fresh upload.  Hit rate is exported as the
-        ``batch_worker.input_cache_hit_rate`` gauge."""
+        ``batch_worker.input_cache_hit_rate`` gauge.
+
+        ``sharded=True`` returns the SHARDED twin: the same columns as
+        ``NamedSharding(P("nodes"))`` arrays over the node-axis mesh,
+        patched per shard (ops/batch.patch_rows_sharded) so a warm
+        mesh flush ships O(dirty rows) bytes host->device — the bytes
+        actually staged are exported as the ``mesh.bytes_per_flush``
+        gauge and the delta-hit rate as ``mesh.mirror_hit_rate``."""
         import jax
 
         with self._usage_cache_lock:
-            return self._device_columns_locked(table, jax)
+            return self._device_columns_locked(table, jax, sharded)
 
-    def _device_columns_locked(self, table, jax) -> tuple:
+    def _device_columns_locked(
+        self, table, jax, sharded: bool = False
+    ) -> tuple:
+        if sharded and self._mesh is None:
+            raise RuntimeError(
+                "sharded usage mirror requested without a mesh"
+            )
         # table.epoch: a snapshot restore swaps in a FRESH NodeTable
         # whose restarted generations could collide with the cached
         # key and leave pre-restore usage on device permanently.
         # _backend_epoch: a supervisor failover/recovery re-targets
         # the backend — a mirror uploaded to the old one must never
-        # satisfy a post-flip launch
+        # satisfy a post-flip launch.  The sharded mirror additionally
+        # keys on the mesh width (a rebuilt mesh re-lays the shards).
         key = (
             self._backend_epoch,
             table.epoch,
             table.topo_generation,
             table.capacity,
         )
-        # explicit placement while failed over (the CPU backend);
-        # None = jax's default device
-        target = (
-            self.supervisor.jax_device()
-            if self.supervisor is not None
-            else None
-        )
+        if sharded:
+            key = key + ("sharded", self._mesh.devices.size)
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
 
-        def put(col):
-            return (
-                jax.device_put(col, target)
-                if target is not None
-                else jax.device_put(col)
+            target_sharding = NamedSharding(self._mesh, _P("nodes"))
+
+            def put(col):
+                return jax.device_put(col, target_sharding)
+
+        else:
+            # explicit placement while failed over (the CPU backend);
+            # None = jax's default device
+            target = (
+                self.supervisor.jax_device()
+                if self.supervisor is not None
+                else None
             )
 
-        cache = self._usage_cache
+            def put(col):
+                return (
+                    jax.device_put(col, target)
+                    if target is not None
+                    else jax.device_put(col)
+                )
+
+        cache_attr = (
+            "_usage_cache_sharded" if sharded else "_usage_cache"
+        )
+        dirty_attr = (
+            "_mirror_dirty_sharded" if sharded else "_mirror_dirty"
+        )
+        cache = getattr(self, cache_attr)
         hit = False
+        bytes_up = 0
         if cache is None or cache["key"] != key:
             # topology changed (join/leave/re-fingerprint/arena
             # growth): rows may have been reassigned — full resync
             gen, _rows = self.store.usage_delta_since(-1)
-            cols = tuple(
-                put(col)
-                for col in (
-                    table.cpu_total,
-                    table.mem_total,
-                    table.disk_total,
-                    table.cpu_used,
-                    table.mem_used,
-                    table.disk_used,
-                )
+            host_cols = (
+                table.cpu_total,
+                table.mem_total,
+                table.disk_total,
+                table.cpu_used,
+                table.mem_used,
+                table.disk_used,
             )
+            cols = tuple(put(col) for col in host_cols)
+            bytes_up = sum(col.nbytes for col in host_cols)
             cache = {"key": key, "gen": gen, "cols": cols}
-            self._usage_cache = cache
+            setattr(self, cache_attr, cache)
             # full re-upload: the cache now holds fresh buffers no
             # abandoned launch has ever seen
-            self._mirror_dirty = False
+            setattr(self, dirty_attr, False)
         else:
             gen, rows = self.store.usage_delta_since(cache["gen"])
             cols = cache["cols"]
             if len(rows) > max(64, table.capacity // 8):
                 # wide churn: one bulk upload beats many scatters
-                cols = cols[:3] + tuple(
-                    put(col)
-                    for col in (
-                        table.cpu_used,
-                        table.mem_used,
-                        table.disk_used,
-                    )
+                host_used = (
+                    table.cpu_used,
+                    table.mem_used,
+                    table.disk_used,
                 )
-                self._mirror_dirty = False
+                cols = cols[:3] + tuple(
+                    put(col) for col in host_used
+                )
+                bytes_up = sum(col.nbytes for col in host_used)
+                setattr(self, dirty_attr, False)
             elif rows:
                 idx = np.asarray(sorted(rows), dtype=np.int32)
                 # pad the row axis to a pow2 bucket so the scatter
@@ -3311,10 +3400,16 @@ class BatchWorker(Worker):
                     compiling = bool(self._compiling)
                 donate = (
                     self._donation_enabled()
-                    and not self._mirror_dirty
+                    and not getattr(self, dirty_attr)
                     and not compiling
                 )
-                if donate:
+                if sharded:
+                    from ..ops.batch import patch_rows_sharded
+
+                    patch = patch_rows_sharded(
+                        self._mesh, donate=donate
+                    )
+                elif donate:
                     from ..ops.batch import patch_rows_donated
 
                     patch = patch_rows_donated()
@@ -3332,6 +3427,7 @@ class BatchWorker(Worker):
                     ):
                         vals = np.zeros(width, dtype=src.dtype)
                         vals[: len(idx)] = src[idx]
+                        bytes_up += idx_p.nbytes + vals.nbytes
                         # nomadlint: disable=donation-safety -- verified safe: cache["cols"] is replaced by the patched outputs below before any later read, and the except path drops the whole mirror so a partially-donated sync can never be re-read
                         patched.append(patch(col, idx_p, vals))
                 except Exception:
@@ -3340,29 +3436,59 @@ class BatchWorker(Worker):
                     # against them would fail on every future flush —
                     # drop the whole mirror so the next sync does a
                     # full re-upload from host state
-                    self._usage_cache = None
+                    setattr(self, cache_attr, None)
                     raise
                 cols = cols[:3] + tuple(patched)
                 # the patch produced fresh (or in-place-donated)
                 # buffers only this worker references: the next sync
                 # may donate again
-                self._mirror_dirty = False
+                setattr(self, dirty_attr, False)
                 hit = True
             else:
                 hit = True  # nothing changed since the last sync
             cache["cols"] = cols
             cache["gen"] = gen
-        if hit:
-            self._input_cache_hits += 1
-        else:
-            self._input_cache_misses += 1
         metrics = getattr(self.server, "metrics", None)
-        if metrics is not None:
-            total = self._input_cache_hits + self._input_cache_misses
-            metrics.set_gauge(
-                "batch_worker.input_cache_hit_rate",
-                self._input_cache_hits / total if total else 0.0,
-            )
+        if sharded:
+            if hit:
+                self._mesh_mirror_hits += 1
+            else:
+                self._mesh_mirror_misses += 1
+            if metrics is not None:
+                # the acceptance gauge for the sharded-mirror
+                # contract: a warm flush's upload is O(dirty rows)
+                # staging buffers, not O(nodes) columns
+                metrics.set_gauge(
+                    "mesh.bytes_per_flush", float(bytes_up)
+                )
+                total = (
+                    self._mesh_mirror_hits
+                    + self._mesh_mirror_misses
+                )
+                metrics.set_gauge(
+                    "mesh.mirror_hit_rate",
+                    self._mesh_mirror_hits / total if total else 0.0,
+                )
+        else:
+            if hit:
+                self._input_cache_hits += 1
+            else:
+                self._input_cache_misses += 1
+            if metrics is not None:
+                total = (
+                    self._input_cache_hits
+                    + self._input_cache_misses
+                )
+                metrics.set_gauge(
+                    "batch_worker.input_cache_hit_rate",
+                    self._input_cache_hits / total
+                    if total
+                    else 0.0,
+                )
+                metrics.set_gauge(
+                    "batch_worker.mirror_sync_bytes",
+                    float(bytes_up),
+                )
         return cache["cols"]
 
     # ------------------------------------------------------------------
@@ -3372,6 +3498,7 @@ class BatchWorker(Worker):
         chunk: int = PIPELINE_CHUNK,
         shared_cols: Optional[tuple] = None,
         chain: bool = False,
+        mesh: Optional[bool] = None,
     ) -> _Assembled:
         """Stage 1 of the prescore pipeline: pure host-side numpy input
         staging for one admitted chain (no device work).  The result is
@@ -3380,12 +3507,15 @@ class BatchWorker(Worker):
         earlier chunks.
 
         ``chunk`` aligns the eval axis (one launch = one chunk-wide
-        slice).  ``chain=True`` marks a mid-chain admission arena: it
-        must take the chunk path (never the mesh — the mesh launch
-        doesn't surface the carry the chain threads through) and
-        reuse the chain head's device mirror via ``shared_cols``
-        (re-syncing the mirror mid-chain would patch buffers the
-        in-flight launches are reading)."""
+        slice).  ``chain=True`` marks a mid-chain admission arena:
+        it must reuse the chain head's device mirror via
+        ``shared_cols`` (re-syncing the mirror mid-chain would patch
+        buffers the in-flight launches are reading) and stay on the
+        head's backend path — ``mesh`` pins that: None lets the arena
+        pick the sharded path whenever its shapes qualify, False
+        forces the single-chip chunk kernel, True allows the sharded
+        path only (the caller defers the arena when the shapes don't
+        qualify and ``use_mesh`` comes back False)."""
         table = snap.node_table
         C = table.capacity
         compiler = MaskCompiler(table)
@@ -3869,9 +3999,13 @@ class BatchWorker(Worker):
         )
         wanted = np.zeros(E, np.int32)
         wanted[:E_real] = [s.placements for s in sims]
-        use_mesh = (
-            not chain
-            and self._mesh is not None
+        # the sharded runner covers the single-group scalar layout
+        # (T=1, no port/device slot axes, no per-group vectors); the
+        # node axis must tile evenly over the mesh.  Mid-chain
+        # admission arenas qualify exactly like chain heads — an
+        # admitted chunk splices into a sharded chain identically
+        mesh_capable = (
+            self._mesh is not None
             and T == 1
             and port_ask_arr is None
             and dev_ask_arr is None
@@ -3879,6 +4013,9 @@ class BatchWorker(Worker):
             and occ0 is None
             and dh_tg is None
             and C % self._mesh.devices.size == 0
+        )
+        use_mesh = mesh_capable if mesh is None else (
+            bool(mesh) and mesh_capable
         )
         return _Assembled(
             E_real=E_real,
@@ -3902,26 +4039,15 @@ class BatchWorker(Worker):
             dev_aff_on=dev_aff_on,
             occ0=occ0,
             dh_tg=dh_tg,
-            host_cols=(
-                table.cpu_total,
-                table.mem_total,
-                table.disk_total,
-                table.cpu_used,
-                table.mem_used,
-                table.disk_used,
-            ),
-            # the sharded runner reshards its own inputs; only the
-            # chunk path reads the device-resident mirror (a
-            # mid-chain admission arena reuses the chain head's
-            # mirror tuple instead of re-syncing)
+            # the persistent delta-patched device mirror every launch
+            # reads — the SHARDED mirror for mesh arenas (a mid-chain
+            # admission arena reuses the chain head's mirror tuple
+            # instead of re-syncing: a re-sync would patch buffers
+            # the in-flight launches are reading)
             dev_cols=(
-                None
-                if use_mesh
-                else (
-                    shared_cols
-                    if shared_cols is not None
-                    else self._device_columns(table)
-                )
+                shared_cols
+                if shared_cols is not None
+                else self._device_columns(table, sharded=use_mesh)
             ),
             use_mesh=use_mesh,
             chunk=chunk,
@@ -3969,7 +4095,13 @@ class BatchWorker(Worker):
         mirror and the host-built occupancy arenas).  NON-blocking —
         the return value holds device futures; ``_fetch`` realizes
         them.  Returns None while the launch shape compiles in the
-        background (cold-compile shield)."""
+        background (cold-compile shield).  Mesh arenas dispatch the
+        node-sharded chained runner instead; the handle layout is
+        identical, so the pipeline/fetch machinery never cares."""
+        if asm.use_mesh:
+            return self._launch_chunk_mesh(
+                asm, c0, c1, carry, check_ready
+            )
         sl = self._chunk_slice
         cols = asm.dev_cols
         if carry is None:
@@ -4047,27 +4179,33 @@ class BatchWorker(Worker):
                     pass
         return out
 
-    def _launch_mesh(self, asm: _Assembled) -> Optional[np.ndarray]:
-        """Single sharded launch over the whole run (NOMAD_TPU_MESH):
-        the node-axis mesh runner keeps the historical stacked
-        one-launch layout — it doesn't surface the chain carry, so the
-        mesh path doesn't chunk-pipeline.  Returns rows[E, P] (numpy,
-        blocking) or None while the shape compiles in the
-        background."""
+    def _launch_chunk_mesh(
+        self, asm: _Assembled, c0: int, c1: int, carry,
+        check_ready: bool,
+    ):
+        """Stage 2, sharded (NOMAD_TPU_MESH): dispatch one chunk-wide
+        slice through the node-sharded chained runner
+        (parallel/mesh.py sharded_chained_plan).  The chain start
+        reads the persistent SHARDED usage mirror; later chunks chain
+        on the previous launch's sharded carry — the usage columns
+        thread chunk -> chunk on-device, never gathered to the host.
+        Single-group arenas only (asm.use_mesh gates the layout): the
+        T=1 slices reproduce the runner's per-eval scalar layout
+        exactly.  Spread batches route through the with_spread
+        variant — the (S, V+1) spread state rides replicated and only
+        the winner/evictee slot one-hots reduce over shards.  Returns
+        None while the shape compiles in the background, or when a
+        failover disabled the mesh after this arena was assembled
+        (launching on the old backend's shards could block on a
+        wedged device; the exact path covers these evals)."""
         if self._mesh is None:
-            # the supervisor disabled the mesh (failover) after this
-            # run was assembled — launching on the old backend's
-            # shards could block on a wedged device; the exact path
-            # covers these evals
             return None
-        # single-group batches only: the sharded runner keeps the
-        # historical per-eval scalar layout, which the T=1 slices
-        # reproduce exactly (per-pick values are constant within a
-        # single-group eval).  Spread batches route through the
-        # with_spread variant (VERDICT r4 #9) — the kernel carries
-        # the (S, V+1) spread state replicated and reduces only
-        # the winner/evictee slot one-hots over shards
-        spread_arg = asm.spread
+        cols = asm.dev_cols
+        used = cols[3:6] if carry is None else carry[0]
+        st = asm.stacked
+        E = c1 - c0
+        C = st.perm.shape[1]
+        spread_arg = self._chunk_slice(asm.spread, c0, c1)
         runner = self._sharded_runner(
             asm.P, asm.spread_fit,
             with_spread=spread_arg is not None,
@@ -4076,85 +4214,44 @@ class BatchWorker(Worker):
                 and spread_arg.even is not None
             ),
         )
-        E, C = asm.stacked.perm.shape
-        stacked = asm.stacked
-        # the chunk-aligned arena (multiples of PIPELINE_CHUNK) would
-        # mint up to BATCH_MAX/PIPELINE_CHUNK sharded trace shapes per
-        # pick bucket; pad the eval axis back to the historical
-        # {8, BATCH_MAX} buckets with inert rows (wanted=0, n_cand=1)
-        # so the mesh runner keeps two compiled programs
-        E_bucket = 8 if E <= 8 else BATCH_MAX
-        pad_n = E_bucket - E
-
-        def pad_e(arr, fill):
-            if pad_n <= 0:
-                return arr
-            shape = (pad_n,) + arr.shape[1:]
-            return np.concatenate(
-                [arr, np.full(shape, fill, arr.dtype)]
-            )
-
-        def pad_tuple(tup, fills):
-            if pad_n <= 0:
-                return tup
-            return type(tup)(
-                *[
-                    None if f is None else pad_e(f, fill)
-                    for f, fill in zip(tup, fills)
-                ]
-            )
-
-        perm_pad = stacked.perm
-        if pad_n > 0:
-            perm_pad = np.concatenate(
-                [
-                    stacked.perm,
-                    np.tile(
-                        np.arange(C, dtype=np.int32), (pad_n, 1)
-                    ),
-                ]
-            )
-        deltas = pad_tuple(asm.deltas, (-1, 0, 0, 0, 0, -1))
-        pre = pad_tuple(asm.pre, (0, 0, 0, 0))
-        if spread_arg is not None:
-            spread_arg = pad_tuple(
-                spread_arg, (0,) * len(spread_arg)
-            )
-        sh_args = asm.host_cols + (
-            pad_e(stacked.feasible[:, 0], False),
-            perm_pad,
-            pad_e(stacked.ask_cpu[:, 0], 0.0),
-            pad_e(stacked.ask_mem[:, 0], 0.0),
-            pad_e(stacked.ask_disk[:, 0], 0.0),
-            pad_e(stacked.desired_count[:, 0], 1),
-            pad_e(stacked.limit[:, 0], 1),
-            pad_e(asm.wanted, 0),
-            pad_e(asm.n_cands, 1),
-            pad_e(stacked.distinct_hosts, False),
-            pad_e(
-                asm.coll0[:, 0]
-                if asm.coll0 is not None
-                else np.zeros((E, C), np.int32),
-                0,
-            ),
-            pad_e(
-                asm.affinity[:, 0]
-                if asm.affinity is not None
-                else np.zeros((E, C)),
-                0.0,
-            ),
-            deltas,
-            pre,
+        args = cols[:3] + tuple(used) + (
+            st.feasible[c0:c1, 0],
+            st.perm[c0:c1],
+            st.ask_cpu[c0:c1, 0],
+            st.ask_mem[c0:c1, 0],
+            st.ask_disk[c0:c1, 0],
+            st.desired_count[c0:c1, 0],
+            st.limit[c0:c1, 0],
+            asm.wanted[c0:c1],
+            asm.n_cands[c0:c1],
+            st.distinct_hosts[c0:c1],
+            asm.coll0[c0:c1, 0]
+            if asm.coll0 is not None
+            else np.zeros((E, C), np.int32),
+            asm.affinity[c0:c1, 0]
+            if asm.affinity is not None
+            else np.zeros((E, C)),
+            self._chunk_slice(asm.deltas, c0, c1),
+            self._chunk_slice(asm.pre, c0, c1),
         )
         if spread_arg is not None:
-            sh_args = sh_args + (spread_arg,)
-        if not self._launch_ready(sh_args, {}, fn=runner):
+            args = args + (spread_arg,)
+        if check_ready and not self._launch_ready(
+            args, {}, fn=runner
+        ):
             return None
-        rows_out = np.asarray(runner(*sh_args))
-        # operators can tell "mesh used" from "mesh skipped"
-        # (VERDICT r3 weak #6: the sharded path degraded quietly)
-        self._count("mesh_used")
-        return rows_out
+        rows_j, pulls_j, used_out = runner(*args)
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.incr("mesh.launches")
+        if c0 == 0:
+            # once per arena: operators can tell "mesh used" from
+            # "mesh skipped" (VERDICT r3 weak #6: the sharded path
+            # degraded quietly)
+            self._count("mesh_used")
+        # same handle layout as the chunk path; the carry's port/dev
+        # slots are structurally absent on mesh arenas
+        return rows_j, pulls_j, (used_out, None, None)
 
     # -- cold-compile shield -------------------------------------------
 

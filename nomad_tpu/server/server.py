@@ -241,6 +241,15 @@ class Server:
             from .batch_worker import ADMISSION_COUNTERS
 
             self.metrics.preregister(counters=ADMISSION_COUNTERS)
+            # sharded hot path: zero-register the mesh.* family the
+            # same way (absence-of-series must mean "mesh never
+            # engaged" — NOMAD_TPU_MESH off or a single-device host —
+            # not "not exported")
+            from .batch_worker import MESH_COUNTERS, MESH_GAUGES
+
+            self.metrics.preregister(
+                counters=MESH_COUNTERS, gauges=MESH_GAUGES
+            )
             self.metrics.set_gauge(
                 "batch_worker.admit_enabled",
                 1.0 if any(

@@ -72,6 +72,12 @@ SPAN_NAMES = frozenset(
         "batch_worker.assemble",
         "batch_worker.launch",
         "batch_worker.fetch",
+        # sharded (NOMAD_TPU_MESH) chunk dispatch/realize: the same
+        # pipeline positions as launch/fetch, under their own names so
+        # mesh time is separable on every trace-keyed dashboard (and
+        # budgeted separately by the supervisor's stage watchdogs)
+        "batch_worker.mesh_launch",
+        "batch_worker.mesh_fetch",
         "batch_worker.replay",
         "batch_worker.sequential",
         "batch_worker.fallback",

@@ -503,3 +503,96 @@ def test_admission_counters_zero_registered():
         )
     finally:
         server.stop()
+
+
+# -- sharded hot path (NOMAD_TPU_MESH=1) parity ------------------------
+#
+# The same admission harness, run with the admission server on the
+# 8-device virtual CPU mesh (tests/conftest.py forces
+# --xla_force_host_platform_device_count=8) and the reference server
+# unsharded: decisions must be bit-identical sharded vs unsharded,
+# INCLUDING chunked chains with mid-chain admission and forced replay
+# conflicts — the acceptance contract for promoting the mesh path into
+# the first-class pipeline.
+
+
+def test_mesh_admission_parity_bit_identical_vs_unsharded(monkeypatch):
+    """Evals admitted mid-chain into a SHARDED chunk chain produce
+    bit-identical placements, outcomes and AllocMetrics to the same
+    evals run unsharded in fresh flush-boundary gulps."""
+    monkeypatch.setenv("NOMAD_TPU_REPLAY_STRICT", "1")
+    jobs = make_jobs(8, prefix="madm", seed=11)
+    monkeypatch.setenv("NOMAD_TPU_MESH", "1")
+    adm = run_with_midchain_arrivals(jobs, split=4, seed=77)
+    monkeypatch.setenv("NOMAD_TPU_MESH", "0")
+    try:
+        fresh = run_fresh_gulps(jobs, split=4, seed=77)
+        try:
+            adm_metrics = {
+                j.id: alloc_metrics(adm, j.id) for j in jobs
+            }
+            fresh_metrics = {
+                j.id: alloc_metrics(fresh, j.id) for j in jobs
+            }
+            for job in jobs:
+                assert placements(adm, job.id) == placements(
+                    fresh, job.id
+                ), f"placement divergence for {job.id}"
+                assert eval_outcomes(adm, job.id) == eval_outcomes(
+                    fresh, job.id
+                ), f"eval outcome divergence for {job.id}"
+                assert (
+                    adm_metrics[job.id] == fresh_metrics[job.id]
+                ), f"AllocMetric divergence for {job.id}"
+            worker = adm.workers[0]
+            # both contracts are vacuous unless they actually fired:
+            # the sharded runner dispatched AND admission spliced
+            # chunks into its chain
+            assert worker._mesh is not None
+            assert worker.mesh_used > 0
+            assert worker.admission_admitted > 0
+            assert worker.timings["mesh_fetch"] > 0.0
+            assert (
+                adm.metrics.get_counter("mesh.launches") > 0
+            )
+        finally:
+            fresh.stop()
+    finally:
+        adm.stop()
+
+
+def test_mesh_admission_parity_under_forced_replay_conflicts(
+    monkeypatch,
+):
+    """Sharded chains on a tiny contended cluster — wave speculations
+    losing their conflict checks and re-replaying serially — must
+    still match the unsharded fresh-gulp outcomes exactly."""
+    monkeypatch.setenv("NOMAD_TPU_REPLAY_STRICT", "1")
+    nodes_kw = dict(nodes_seed=9, n_nodes=4)
+    jobs = make_jobs(10, prefix="mconf", seed=13)
+    for job in jobs:
+        job.task_groups[0].count = 3
+        job.task_groups[0].tasks[0].resources.cpu = 300
+    monkeypatch.setenv("NOMAD_TPU_MESH", "1")
+    adm = run_with_midchain_arrivals(
+        jobs, split=5, seed=21, **nodes_kw
+    )
+    monkeypatch.setenv("NOMAD_TPU_MESH", "0")
+    try:
+        fresh = run_fresh_gulps(jobs, split=5, seed=21, **nodes_kw)
+        try:
+            for job in jobs:
+                assert placements(adm, job.id) == placements(
+                    fresh, job.id
+                ), f"divergence for {job.id}"
+                assert eval_outcomes(adm, job.id) == eval_outcomes(
+                    fresh, job.id
+                ), f"eval outcome divergence for {job.id}"
+            worker = adm.workers[0]
+            assert worker.mesh_used > 0
+            assert worker.admission_admitted > 0
+            assert worker.replay_conflicts > 0
+        finally:
+            fresh.stop()
+    finally:
+        adm.stop()

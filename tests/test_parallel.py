@@ -234,27 +234,30 @@ def test_sharded_chained_plan_matches_unsharded():
         distinct_hosts=dh,
         tg_idx=np.zeros((E, P), np.int32),
     )
-    ref = np.asarray(
-        chained_plan_picks_cols(
-            cpu_total, mem_total, disk_total,
-            used_cpu, used_mem, used_disk,
-            stacked, ncands, P,
-            wanted=wanted, coll0=coll0[:, None],
-            affinity=affinity[:, None],
-            deltas=deltas, pre=pre,
-        )[0]
+    ref_rows, ref_pulls = chained_plan_picks_cols(
+        cpu_total, mem_total, disk_total,
+        used_cpu, used_mem, used_disk,
+        stacked, ncands, P,
+        wanted=wanted, coll0=coll0[:, None],
+        affinity=affinity[:, None],
+        deltas=deltas, pre=pre,
     )
+    ref_rows = np.asarray(ref_rows)
     mesh = make_mesh(8, eval_axis=1)
     run = sharded_chained_plan(mesh, P)
-    got = np.asarray(
-        run(
-            cpu_total, mem_total, disk_total,
-            used_cpu, used_mem, used_disk,
-            feasible, perms, *asks, desired, limits, wanted,
-            ncands, dh, coll0, affinity, deltas, pre,
-        )
+    got_rows, got_pulls = run(
+        cpu_total, mem_total, disk_total,
+        used_cpu, used_mem, used_disk,
+        feasible, perms, *asks, desired, limits, wanted,
+        ncands, dh, coll0, affinity, deltas, pre,
     )
-    assert np.array_equal(ref, got), (ref, got)
+    got_rows = np.asarray(got_rows)
+    assert np.array_equal(ref_rows, got_rows), (ref_rows, got_rows)
+    # the surfaced pulls must match too: mesh-path preempt retries
+    # seed the sequential passthrough from them
+    assert np.array_equal(
+        np.asarray(ref_pulls), np.asarray(got_pulls)
+    )
 
 
 def test_sharded_chained_plan_flops_scale_with_devices():
@@ -492,14 +495,13 @@ def test_sharded_chained_plan_spread_matches_unsharded():
     )
     mesh = make_mesh(8, eval_axis=1)
     run = sharded_chained_plan(mesh, P, with_spread=True)
-    got = np.asarray(
-        run(
-            cpu_total, mem_total, disk_total,
-            used_cpu, used_mem, used_disk,
-            feasible, perms, *asks, desired_count, limits, wanted,
-            ncands, dh, coll0, affinity, deltas, pre, spread,
-        )
+    got, _pulls = run(
+        cpu_total, mem_total, disk_total,
+        used_cpu, used_mem, used_disk,
+        feasible, perms, *asks, desired_count, limits, wanted,
+        ncands, dh, coll0, affinity, deltas, pre, spread,
     )
+    got = np.asarray(got)
     assert np.array_equal(ref, got), (ref, got)
 
 

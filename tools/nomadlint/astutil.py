@@ -57,10 +57,55 @@ def timings_keys(tree: ast.AST) -> Set[str]:
     return set()
 
 
+def expr_strings(
+    expr: ast.AST, env: Optional[Dict[str, Set[str]]] = None
+) -> Set[str]:
+    """The string constants an expression may evaluate to: a plain
+    constant, BOTH arms of an ``"a" if cond else "b"`` conditional, or
+    — given ``env`` from :func:`literal_env` — a Name bound to such an
+    expression.  The mesh hot path selects its stage key this way
+    (``"mesh_launch" if asm.use_mesh else "launch"``), so key/span
+    accounting must see through the conditional."""
+    if isinstance(expr, ast.Constant) and isinstance(
+        expr.value, str
+    ):
+        return {expr.value}
+    if isinstance(expr, ast.IfExp):
+        return expr_strings(expr.body, env) | expr_strings(
+            expr.orelse, env
+        )
+    if env is not None and isinstance(expr, ast.Name):
+        return env.get(expr.id, set())
+    return set()
+
+
+def literal_env(tree: ast.AST) -> Dict[str, Set[str]]:
+    """name -> possible string values, from every simple
+    ``name = <string expr>`` assignment in the module.  Module-wide
+    (not scoped): collisions union, which can only over-approximate —
+    fine for registry-membership checks."""
+    env: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            vals = expr_strings(node.value)
+            if vals:
+                env.setdefault(
+                    node.targets[0].id, set()
+                ).update(vals)
+    return env
+
+
 def observed_keys(tree: ast.AST) -> Set[str]:
-    """First-arg string constants of every ``._observe(...)`` call
+    """First-arg stage keys of every ``._observe(...)`` call
     (``._observe_chunk`` delegates its stage key to ``_observe``, so
-    its call sites count too)."""
+    its call sites count too).  Conditional keys — the mesh path's
+    ``"mesh_launch" if ... else "launch"``, possibly bound to a local
+    first — contribute both arms."""
+    env = literal_env(tree)
     out: Set[str] = set()
     for node in ast.walk(tree):
         if (
@@ -68,10 +113,8 @@ def observed_keys(tree: ast.AST) -> Set[str]:
             and isinstance(node.func, ast.Attribute)
             and node.func.attr in ("_observe", "_observe_chunk")
             and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
         ):
-            out.add(node.args[0].value)
+            out |= expr_strings(node.args[0], env)
     return out
 
 
@@ -81,21 +124,21 @@ def span_names_used(tree: ast.AST) -> Set[str]:
     leading positional is the eval-id expression, never a literal).
     ``._observe_chunk("<stage>", ...)`` emits its span name as
     f"batch_worker.{stage}" — a non-constant the AST scan can't see —
-    so its stage constants count as that derived name here."""
+    so its stage keys (including both arms of the mesh path's
+    conditional, via :func:`expr_strings`) count as that derived name
+    here."""
+    env = literal_env(tree)
     out: Set[str] = set()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not isinstance(
             node.func, ast.Attribute
         ):
             continue
-        if (
-            node.func.attr == "_observe_chunk"
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-        ):
-            out.add(f"batch_worker.{node.args[0].value}")
-            continue
+        if node.func.attr == "_observe_chunk" and node.args:
+            stages = expr_strings(node.args[0], env)
+            if stages:
+                out |= {f"batch_worker.{s}" for s in stages}
+                continue
         if node.func.attr not in TRACE_CALLS:
             continue
         for arg in node.args:

@@ -183,7 +183,14 @@ class SpanRegistryRule(Rule):
     name = "span-registry"
     description = "every span/event literal is in trace.SPAN_NAMES"
 
-    REQUIRED = ("batch_worker.admit", "batch_worker.admit_deferred")
+    REQUIRED = (
+        "batch_worker.admit",
+        "batch_worker.admit_deferred",
+        # the sharded hot path's pipeline stages: mesh time must stay
+        # separable from single-chip chunk time on every dashboard
+        "batch_worker.mesh_launch",
+        "batch_worker.mesh_fetch",
+    )
 
     def check(self, ctx: Context) -> List[Finding]:
         trace_path = ctx.path("trace")
@@ -221,7 +228,7 @@ class SpanRegistryRule(Rule):
                     Finding(
                         self.name, trace_path, 0,
                         f"{required!r} missing from "
-                        "trace.SPAN_NAMES — the mid-chain admission "
+                        "trace.SPAN_NAMES — a required pipeline "
                         "stage would vanish from every trace-keyed "
                         "dashboard",
                     )
@@ -680,6 +687,102 @@ class LatencySweepRule(Rule):
             ctx, tmpdir, "bench",
             old='"latency_sweep"',
             new='"renamed_latency_sweep"',
+        )
+
+
+@register
+class MeshMetricsRule(Rule):
+    """Sharded hot path: every ``mesh.*`` counter/gauge the batch
+    worker emits is in the zero-registered ``MESH_COUNTERS`` /
+    ``MESH_GAUGES`` registries, and server.py zero-registers both at
+    construction — absence of a ``mesh.*`` series must mean "mesh
+    never engaged", never "not exported"."""
+
+    name = "mesh-metrics"
+    description = "mesh.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("batch_worker")
+        tree = ctx.tree(path)
+        registry = astutil.assigned_strings(
+            tree, "MESH_COUNTERS"
+        ) | astutil.assigned_strings(tree, "MESH_GAUGES")
+        if not registry:
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "could not find the MESH_COUNTERS/MESH_GAUGES "
+                    "registries in batch_worker.py",
+                )
+            ]
+        emitted = astutil.metric_names_emitted(tree, "mesh.")
+        problems: List[Finding] = []
+        unregistered = emitted - registry
+        if unregistered:
+            problems.append(
+                Finding(
+                    self.name, path, 0,
+                    "mesh.* metrics emitted but not in the "
+                    "MESH_COUNTERS/MESH_GAUGES registries (they "
+                    "would be absent from prometheus scrapes until "
+                    "the first sharded flush): "
+                    f"{sorted(unregistered)}",
+                )
+            )
+        server_path = ctx.path("server")
+        server_src = ctx.source(server_path)
+        for reg_name in ("MESH_COUNTERS", "MESH_GAUGES"):
+            if reg_name not in server_src:
+                problems.append(
+                    Finding(
+                        self.name, server_path, 0,
+                        "server.py no longer zero-registers the "
+                        f"mesh.* family at construction ({reg_name} "
+                        "preregister)",
+                    )
+                )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.set_gauge("mesh.bogus_metric", 1.0)\n'
+            ),
+        )
+
+
+@register
+class MultichipExportRule(Rule):
+    """Sharded hot path: bench.py exports the ``multichip`` JSON block
+    (placements/s, host->device bytes/flush, per-device FLOPs vs
+    device count) — the per-round proof that the node-sharded pipeline
+    actually scales, feeding the MULTICHIP_r*.json tail."""
+
+    name = "multichip-export"
+    description = "bench.py exports the multichip block"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("bench")
+        if '"multichip"' not in ctx.source(path):
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "bench.py no longer exports the multichip JSON "
+                    "block (placements/s, bytes/flush, per-device "
+                    "FLOPs vs device count on the node-axis mesh)",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "bench",
+            old='"multichip"',
+            new='"renamed_multichip"',
         )
 
 
