@@ -1025,6 +1025,167 @@ WITH_EXPLAIN_OVERHEAD = (
     os.environ.get("BENCH_EXPLAIN_OVERHEAD", "1") == "1"
 )
 WITH_DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
+WITH_STORM = os.environ.get("BENCH_STORM", "1") == "1"
+
+
+def bench_storm():
+    """Mass drain + scale-up replay: hundreds of pending evals of ONE
+    job family backlogged in the broker (the whole family registers
+    before leadership, so restore_evals enqueues it as one wave —
+    exactly the shape a drain or dispatch storm leaves), A/B'd
+    storm-on (`NOMAD_TPU_STORM=1`: one global assignment solve per
+    drained family prefix) vs storm-off (the per-eval chunk chain).
+    Exports placements/s per mode, the speedup, solver
+    rounds-to-converge / fallback / divergence counters, and the
+    aggregate placement-quality delta (sum of normalized scores) so
+    the relaxed serial equivalence is quantified, not just
+    permitted."""
+    n_nodes = int(os.environ.get("BENCH_STORM_NODES", 2000))
+    n_evals = int(os.environ.get("BENCH_STORM_EVALS", 480))
+    reps = int(os.environ.get("BENCH_STORM_REPS", 2))
+
+    def nodes():
+        rng = random.Random(21)
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"st-node-{i:05d}")
+            n.node_resources.cpu = rng.choice([8000, 16000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def run_once(storm_on, tag):
+        knobs = {
+            "NOMAD_TPU_STORM": "1" if storm_on else "0",
+            "NOMAD_TPU_STORM_MIN": os.environ.get(
+                "BENCH_STORM_MIN", "8"
+            ),
+            # one solve must cover the whole replayed backlog, or
+            # the A/B measures solve-count-dependent compile churn
+            "NOMAD_TPU_STORM_MAX": os.environ.get(
+                "BENCH_STORM_MAX", "512"
+            ),
+        }
+        saved = {k: os.environ.get(k) for k in knobs}
+        os.environ.update(knobs)
+        server = None
+        try:
+            server = _mk_server(True)
+            for node in nodes():
+                server.store.upsert_node(node)
+            jobs = []
+            for i in range(n_evals):
+                job = mock.job(
+                    id=f"stormfam-{tag}/dispatch-{i:04d}"
+                )
+                job.type = "batch"
+                job.task_groups[0].count = 1
+                # asks sized so binpack scores are non-trivial
+                # (~25% utilization per placement): the
+                # placement-quality delta below would be vacuous on
+                # near-zero BestFit-v3 scores
+                job.task_groups[0].tasks[0].resources.cpu = 2000
+                job.task_groups[0].tasks[
+                    0
+                ].resources.memory_mb = 4096
+                jobs.append(job)
+                server.register_job(job)
+            t0 = time.time()
+            server.start()
+            drained = server.drain_to_idle(timeout=300.0)
+            dt = time.time() - t0
+            placed = 0
+            score_sum = 0.0
+            for job in jobs:
+                for a in server.store.allocs_by_job(
+                    "default", job.id
+                ):
+                    if a.terminal_status():
+                        continue
+                    placed += 1
+                    if a.metrics is not None:
+                        # winner's normalized score, falling back to
+                        # its binpack component (the prescored exact
+                        # verify records binpack for every winner;
+                        # normalized-score only for walked nodes)
+                        for sm in a.metrics.score_meta:
+                            if sm.node_id == a.node_id:
+                                score_sum += sm.scores.get(
+                                    "normalized-score",
+                                    sm.scores.get(
+                                        "binpack", sm.norm_score
+                                    ),
+                                )
+                                break
+            terminal = sum(
+                1
+                for job in jobs
+                for e in server.store.evals_by_job(
+                    "default", job.id
+                )
+                if e.terminal_status()
+            )
+            worker = server.workers[0]
+            stats = {
+                "solves": worker.storm_solves,
+                "evals": worker.storm_evals,
+                "fallbacks": worker.storm_fallbacks,
+                "divergent_rows": worker.storm_divergent,
+                "rounds": server.metrics.get_gauge("storm.rounds"),
+            }
+            lost = n_evals - terminal + len(server.broker.failed())
+            log(
+                f"storm {tag} mode={'on' if storm_on else 'off'}: "
+                f"{placed} placements in {dt:.2f}s "
+                f"({placed / dt:.0f}/s), lost={lost}, "
+                f"score_sum={score_sum:.2f}, {stats}"
+            )
+            return dt, placed, score_sum, lost, stats
+        finally:
+            if server is not None:
+                server.stop()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # discarded warmups: each mode's first run pays its own XLA
+    # compiles (solver shapes on, chain shapes off) for this arena
+    run_once(True, "warm1")
+    run_once(False, "warm0")
+    best = {}
+    for rep in range(reps):
+        for on in (True, False):
+            dt, placed, score_sum, lost, stats = run_once(
+                on, f"r{rep}"
+            )
+            key = "on" if on else "off"
+            if key not in best or dt < best[key][0]:
+                best[key] = (dt, placed, score_sum, lost, stats)
+    dt_on, placed_on, score_on, lost_on, stats_on = best["on"]
+    dt_off, placed_off, score_off, lost_off, _stats_off = best["off"]
+    rate_on = placed_on / dt_on if dt_on else 0.0
+    rate_off = placed_off / dt_off if dt_off else 0.0
+    return {
+        "evals": n_evals,
+        "nodes": n_nodes,
+        "storm_placements_per_s": round(rate_on, 1),
+        "baseline_placements_per_s": round(rate_off, 1),
+        "storm_speedup": round(rate_on / rate_off, 2)
+        if rate_off
+        else 0.0,
+        "solver_rounds_to_converge": stats_on["rounds"],
+        "storm_solves": stats_on["solves"],
+        "storm_fallbacks": stats_on["fallbacks"],
+        "storm_divergent_rows": stats_on["divergent_rows"],
+        # aggregate placement quality: sum of normalized scores over
+        # all placed allocs, global solve minus greedy chain — the
+        # quantified face of the relaxed serial equivalence
+        "placement_quality_delta": round(score_on - score_off, 4),
+        "zero_lost": lost_on == 0 and lost_off == 0,
+    }
 
 
 def bench_multichip():
@@ -1403,6 +1564,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"multichip sweep FAILED: {exc!r}")
             multichip = {"error": repr(exc)}
+    storm = {}
+    if WITH_STORM:
+        try:
+            storm = bench_storm()
+        except Exception as exc:  # noqa: BLE001
+            log(f"storm scenario FAILED: {exc!r}")
+            storm = {"error": repr(exc)}
     device = {}
     if WITH_DEVICE:
         try:
@@ -1459,6 +1627,10 @@ def main():
                     kernel.get("kernel-chained", 0.0), 1
                 ),
                 "device_supervisor": device,
+                # global storm solver: mass-drain/scale-up replay
+                # A/B'd storm-on vs storm-off (placements/s, solver
+                # rounds, fallbacks, quality delta, zero-lost proof)
+                "storm": storm,
                 # sharded hot-path proof: placements/s, per-device
                 # HLO FLOPs, and host->device bytes/flush (delta vs
                 # full) vs device count on the node-axis mesh

@@ -1222,6 +1222,17 @@ def cmd_eval_explain(args) -> None:
     print(f"Type         = {rec['Type']} ({rec['TriggeredBy']})")
     if rec.get("TraceID"):
         print(f"Trace        = /v1/traces/{rec['EvalID']}")
+    storm = rec.get("Storm")
+    if storm:
+        # placements came from the global storm solve, not the
+        # per-eval greedy walk: show the auction round, the aggregate
+        # assignment score and how many rows diverged from the walk
+        print(
+            f"Storm        = solved round {storm.get('Round')}, "
+            f"score {storm.get('AssignmentScore')}, "
+            f"{storm.get('DivergentRows', 0)}/{storm.get('Rows', 0)}"
+            " rows diverged from the greedy walk"
+        )
     for tg, g in (rec.get("TaskGroups") or {}).items():
         metric = g.get("Metric") or {}
         status = "FAILED" if g.get("Failed") else "placed"

@@ -66,6 +66,27 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "cap on the node-axis mesh device count (0 = all devices; "
         "bench sweeps and deployments reserving chips set this)",
     ),
+    "NOMAD_TPU_STORM": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "1 coalesces same-family eval storms into one global "
+        "device assignment solve (serial equivalence explicitly "
+        "relaxed; divergences audited via the explain ring)",
+    ),
+    "NOMAD_TPU_STORM_MIN": EnvKnob(
+        "16", "nomad_tpu/server/batch_worker.py",
+        "storm trigger threshold: minimum contiguous same-family "
+        "broker backlog before a coalesced solve engages",
+    ),
+    "NOMAD_TPU_STORM_MAX": EnvKnob(
+        "256", "nomad_tpu/server/batch_worker.py",
+        "max evals drained into one storm solve (clamped to "
+        "[STORM_MIN, 1024])",
+    ),
+    "NOMAD_TPU_STORM_ROUNDS": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "cap on storm auction rounds (0 = auto: the padded row "
+        "bucket, the solver's convergence bound)",
+    ),
     "NOMAD_TPU_SYNC_COMPILE": EnvKnob(
         "0", "nomad_tpu/server/batch_worker.py",
         "1 makes cold kernel compiles block (deterministic tests) "

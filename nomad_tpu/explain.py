@@ -317,6 +317,19 @@ class ExplainRecorder:
         if metrics is not None:
             self._emit(record, metrics)
 
+    def annotate(self, eval_id: str, **fields) -> None:
+        """Merge extra keys into an eval's retained record (no-op when
+        the eval has no record — e.g. a discarded speculation).  The
+        storm solver tags committed records with its round and
+        assignment score this way, AFTER the commit decided which
+        replay actually published."""
+        if not self.enabled:
+            return
+        with self._lock:
+            record = self._by_id.get(eval_id)
+            if record is not None:
+                record.update(fields)
+
     def record_eval(self, ev, scheduler, metrics=None) -> None:
         """build_record + publish in one call (the serial paths)."""
         if not self.enabled:

@@ -1,0 +1,296 @@
+"""Global storm assignment solver: one device-resident solve for a
+whole backlog of pending evals.
+
+A placement storm (node drain, mass failure, dispatch scale-up) turns
+into hundreds of pending evals of one job family.  The per-eval chunk
+chain (batch_worker.py) walks them one placement at a time — fast per
+walk, but the work is still factored per eval.  CvxCluster (PAPERS.md)
+shows large granular allocation problems solved as ONE optimization
+run orders of magnitude faster than per-item heuristics, and the
+(pending-allocs x candidate-nodes) score matrix this repo already
+computes (ops/score.py) is exactly that problem's cost matrix.
+
+``storm_assignment`` coalesces the storm into a single jitted solve:
+
+1. **Score matrix.** The shared ``_score_vectors`` kernel scores every
+   (alloc row, node) pair in one broadcasted pass — same fit masks,
+   bin-packing curve, anti-affinity/penalty/affinity terms as the
+   serial chain, against the device-resident usage mirror columns
+   (plus the storm's staged pre-placement deltas).
+2. **Greedy warm start.** Each row's serial pick — the shuffled
+   limited-walk winner (``_limited_walk_argmax`` vmapped over rows,
+   with each eval's recorded rng order and visit limit).  A one-row
+   storm therefore converges to EXACTLY the chunk chain's selection
+   (the degenerate-parity contract), pulls included.
+3. **Auction rounds.** A ``lax.while_loop`` of bidding rounds resolves
+   contention: every unassigned row bids its best value
+   (score - node price) among nodes whose REMAINING capacity fits its
+   ask; each node then accepts the best-value PREFIX of its bidders
+   whose cumulative asks still fit (ties break to the lowest row
+   index — broker FIFO), debits its capacity and raises its price.
+   Acceptance never over-commits a node, and every bidding node
+   accepts at least its top bidder per round (an individual bid
+   already proved fit), so a storm of identical asks fills a node in
+   ONE round instead of one-acceptance-at-a-time and the loop
+   converges in a handful of rounds.  Rows left unassigned (nothing
+   feasible fits, or the round budget ran out) return ``NO_NODE`` and
+   their evals fall back to the serial chain — correctness never
+   depends on the solver.
+
+Serial equivalence is deliberately relaxed under contention: the
+auction maximizes cluster-wide score, not arrival-order greed.  Every
+divergence from the warm-start walk is reported per row (``greedy``
+output) so the scheduler can tag explain records with the solver
+round and assignment score.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .score import NO_NODE, ScoreInputs, _limited_walk_argmax, _score_vectors
+
+# per-acceptance price increment: enough to tie-break repeated
+# contention (scores live in roughly [-1, 1]) without distorting the
+# score landscape for uncontended rows
+PRICE_EPS = 0.01
+# tie-spreading jitter, orders of magnitude below PRICE_EPS (the
+# auction's own optimality tolerance): without it, every row whose
+# value ties at the max bids argmax's FIRST index, so a storm of
+# identical asks over hundreds of equally-scored nodes fills ONE
+# node per round instead of spreading — O(rows/node-capacity)
+# rounds.  The jitter only picks WHICH of the tied-max nodes a row
+# bids; the bid value itself stays un-jittered, so assignment
+# scores and the round-0 warm-start parity are untouched.
+TIE_JITTER = 1e-6
+
+
+class StormInputs(NamedTuple):
+    """Host-staged inputs of one storm solve.  ``E`` evals contribute
+    ``A`` pending-alloc rows over the ``C``-row node arena; per-eval
+    vectors are gathered per row through ``eval_of`` so E-axis data is
+    staged once per eval, not once per placement."""
+
+    feasible: jnp.ndarray  # bool[E, C] static feasibility per eval
+    affinity: jnp.ndarray  # f[E, C] normalized affinity score
+    collisions: jnp.ndarray  # i32[E, C] anti-affinity base counts
+    perm: jnp.ndarray  # i32[E, C] recorded serial walk order
+    limit: jnp.ndarray  # i32[E] visit limit (INT32_MAX = unlimited)
+    n_cand: jnp.ndarray  # i32[E] real candidates at perm's front
+    eval_of: jnp.ndarray  # i32[A] row -> eval index
+    penalty: jnp.ndarray  # bool[A, C] reschedule-penalty nodes
+    ask: jnp.ndarray  # f[A, 3] cpu/mem/disk ask per row
+    desired: jnp.ndarray  # i32[A] tg.count per row
+    real: jnp.ndarray  # bool[A] padding rows are never assigned
+    pre_cpu: jnp.ndarray  # f[C] staged pre-placement usage deltas
+    pre_mem: jnp.ndarray  # f[C]
+    pre_disk: jnp.ndarray  # f[C]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("spread_fit", "max_rounds")
+)
+def storm_assignment(
+    inp: StormInputs, cols, spread_fit: bool, max_rounds: int
+):
+    """Returns ``(assigned, pulls, accept_round, score, greedy,
+    rounds)``:
+
+    - assigned i32[A]: arena node row per alloc row, NO_NODE unsolved
+    - pulls i32[A]: serial walk pulls when the row kept its greedy
+      pick (exact chunk-chain pulls), the candidate count otherwise
+    - accept_round i32[A]: auction round the row was accepted in
+      (0 = warm start / uncontended; -1 = unsolved)
+    - score f[A]: the assignment's score matrix entry
+    - greedy i32[A]: the warm-start serial-walk winner, for
+      divergence accounting
+    - rounds i32: auction rounds run before convergence
+    """
+    cpu_t, mem_t, disk_t, cpu_u, mem_u, disk_u = cols
+    dtype = cpu_t.dtype
+    cpu_u = cpu_u + inp.pre_cpu
+    mem_u = mem_u + inp.pre_mem
+    disk_u = disk_u + inp.pre_disk
+    A = inp.ask.shape[0]
+    C = cpu_t.shape[0]
+    eo = inp.eval_of
+
+    # broadcasted score matrix: [C] shared columns + [A, 1] per-row
+    # asks flow through the SAME kernel the serial walk uses, so a
+    # storm row's score of a node is bit-identical to the chunk
+    # chain's first-pick score of it
+    si = ScoreInputs(
+        cpu_total=cpu_t,
+        mem_total=mem_t,
+        disk_total=disk_t,
+        cpu_used=cpu_u,
+        mem_used=mem_u,
+        disk_used=disk_u,
+        feasible=inp.feasible[eo],
+        collisions=inp.collisions[eo],
+        penalty=inp.penalty,
+        affinity_score=inp.affinity[eo],
+        spread_boost=jnp.zeros((), dtype),
+        perm=inp.perm[eo],
+        ask_cpu=inp.ask[:, 0:1],
+        ask_mem=inp.ask[:, 1:2],
+        ask_disk=inp.ask[:, 2:3],
+        desired_count=inp.desired[:, None],
+        limit=inp.limit[eo],
+        n_candidates=inp.n_cand[eo],
+    )
+    feas, scores = _score_vectors(si, spread_fit)
+    feas = feas & inp.real[:, None]
+
+    # greedy warm start: the serial chain's shuffled limited walk,
+    # one row at a time (vmapped) — the uncontended answer, and the
+    # degenerate one-row storm's EXACT answer
+    rows0, _best0, _nf, pulls0 = jax.vmap(_limited_walk_argmax)(
+        feas, scores, si.perm, si.limit, si.n_candidates
+    )
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype=scores.dtype)
+    row_ids = jnp.arange(A, dtype=jnp.int32)
+    node_ids = jnp.arange(C, dtype=jnp.int32)
+    # deterministic per-(row, node) tie-spreading perturbation (see
+    # TIE_JITTER): a fixed Knuth-hash lattice, no RNG state
+    jitter = (
+        (
+            (
+                row_ids[:, None] * jnp.int32(-1640531527)
+                + node_ids[None, :] * jnp.int32(40503)
+            )
+            & jnp.int32(0xFFFF)
+        ).astype(scores.dtype)
+        / 65536.0
+        * jnp.asarray(TIE_JITTER, scores.dtype)
+    )
+    free0 = jnp.stack(
+        [cpu_t - cpu_u, mem_t - mem_u, disk_t - disk_u], axis=1
+    )
+    rows0_c = jnp.clip(rows0, 0, C - 1)
+
+    def cond(st):
+        _assigned, _free, _price, _acc, rnd, progress = st
+        return (rnd < max_rounds) & progress
+
+    def body(st):
+        assigned, free, price, acc_round, rnd, _progress = st
+        unass = (assigned == NO_NODE) & inp.real
+        fits = jnp.all(
+            free[None, :, :] >= inp.ask[:, None, :], axis=2
+        )
+        ok = feas & fits & unass[:, None]
+        value = jnp.where(ok, scores - price[None, :], neg_inf)
+        # argmax over the jittered value picks WHICH tied-max node a
+        # row bids (spreading ties across equal nodes); the bid's
+        # VALUE is read back un-jittered
+        best_c = jnp.argmax(value + jitter, axis=1).astype(jnp.int32)
+        best_v = jnp.take_along_axis(
+            value, best_c[:, None], axis=1
+        )[:, 0]
+        # round 0 bids the serial walk winner when it still fits, so
+        # an uncontended storm IS the greedy walk; later rounds bid
+        # the price-adjusted argmax (global quality)
+        walk_v = jnp.take_along_axis(
+            value, rows0_c[:, None], axis=1
+        )[:, 0]
+        use_walk = (rnd == 0) & (rows0 >= 0) & (walk_v > neg_inf)
+        bid_c = jnp.where(use_walk, rows0_c, best_c)
+        bid_v = jnp.where(use_walk, walk_v, best_v)
+        has_bid = bid_v > neg_inf
+        # per-node PREFIX acceptance: each row's rank among its bid
+        # node's bidders comes from an [A, A] comparison (value
+        # descending, ties to the lowest row index — broker FIFO;
+        # far cheaper than an [A, C] sort), and node c accepts its
+        # top m_c bidders where m_c = floor(min_d free_dc /
+        # max-bidder-ask_dc) — accepting m rows each no larger than
+        # the max ask can never overcommit the node.  The top bidder
+        # is always accepted (its individual bid proved fit against
+        # this round's free), so every bid-receiving node makes
+        # progress each round — and a storm of identical asks fills
+        # a node in ONE round instead of one-acceptance-at-a-time
+        same = (
+            (bid_c[:, None] == bid_c[None, :])
+            & has_bid[:, None]
+            & has_bid[None, :]
+        )
+        better = (bid_v[None, :] > bid_v[:, None]) | (
+            (bid_v[None, :] == bid_v[:, None])
+            & (row_ids[None, :] < row_ids[:, None])
+        )
+        rank = jnp.sum(same & better, axis=1).astype(jnp.int32)
+        onehot = (bid_c[:, None] == node_ids[None, :]) & has_bid[
+            :, None
+        ]
+        maxask = jnp.max(
+            jnp.where(
+                onehot[:, :, None], inp.ask[:, None, :], 0.0
+            ),
+            axis=0,
+        )  # [C, 3]
+        m = jnp.min(
+            jnp.where(
+                maxask > 0,
+                jnp.floor(free / jnp.maximum(maxask, 1e-9)),
+                jnp.inf,
+            ),
+            axis=1,
+        )
+        accepted = has_bid & ((rank == 0) | (rank < m[bid_c]))
+        assigned = jnp.where(accepted, bid_c, assigned)
+        acc_round = jnp.where(accepted, rnd, acc_round)
+        acc_oh = (onehot & accepted[:, None]).astype(dtype)
+        free = free - acc_oh.T @ inp.ask
+        price = price + jnp.where(
+            jnp.any(onehot, axis=0),
+            jnp.asarray(PRICE_EPS, dtype),
+            0.0,
+        ).astype(dtype)
+        return (
+            assigned, free, price, acc_round,
+            rnd + 1, jnp.any(accepted),
+        )
+
+    assigned, _free, _price, acc_round, rounds, _prog = (
+        jax.lax.while_loop(
+            cond,
+            body,
+            (
+                jnp.full(A, NO_NODE, jnp.int32),
+                free0,
+                jnp.zeros(C, dtype),
+                jnp.full(A, -1, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(True),
+            ),
+        )
+    )
+    solved = assigned >= 0
+    kept_walk = solved & (assigned == rows0)
+    # pulls: exact serial walk pulls for rows that kept the greedy
+    # pick; a diverged pick examined every candidate
+    pulls = jnp.where(
+        kept_walk, pulls0, si.n_candidates
+    ).astype(jnp.int32)
+    score = jnp.where(
+        solved,
+        jnp.take_along_axis(
+            scores, jnp.clip(assigned, 0, C - 1)[:, None], axis=1
+        )[:, 0],
+        jnp.asarray(0.0, dtype=scores.dtype),
+    )
+    return assigned, pulls, acc_round, score, rows0, rounds
+
+
+def pad_axis(arr: np.ndarray, n: int, fill) -> np.ndarray:
+    """Pad ``arr``'s leading axis out to ``n`` rows of ``fill``."""
+    if arr.shape[0] == n:
+        return arr
+    out = np.full((n,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out

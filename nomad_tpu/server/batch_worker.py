@@ -134,6 +134,26 @@ MESH_GAUGES = (
     "mesh.chunk_width",
     "mesh.mirror_hit_rate",
 )
+# global storm solver (NOMAD_TPU_STORM=1) metrics, zero-registered at
+# Server construction (tools.nomadlint storm-metrics): every `storm.*`
+# name the worker emits must appear here, so dashboards can tell
+# "storm mode never engaged" from "storm not exported".  Counters:
+# solver launches, evals entering the storm path, alloc rows the
+# solver assigned, members that fell back to the serial chain, and
+# rows whose global assignment diverged from the greedy serial walk.
+# Gauges: the last solve's auction rounds-to-converge and the family
+# backlog the detector drained.
+STORM_COUNTERS = (
+    "storm.solves",
+    "storm.evals",
+    "storm.rows",
+    "storm.fallbacks",
+    "storm.divergent",
+)
+STORM_GAUGES = (
+    "storm.rounds",
+    "storm.backlog",
+)
 # optimistic parallel replay: below this many prescored evals in a run
 # the speculative-wave dispatch overhead beats the win
 REPLAY_MIN_WAVE = 2
@@ -344,6 +364,24 @@ class _AdmissionQueue:
     def defer(self, ev: Evaluation, token: str) -> None:
         self.deferred.append((ev, token))
         self.closed = True
+
+
+class _DoneFuture:
+    """Pre-resolved future for storm-wave members that skip
+    speculation (serial-fallback members; every member when parallel
+    replay is off): ``_commit_wave``'s drain loop needs only
+    ``done()`` and ``result()``."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value=None) -> None:
+        self._value = value
+
+    def done(self) -> bool:
+        return True
+
+    def result(self):
+        return self._value
 
 
 class _SpecPlanner:
@@ -750,6 +788,46 @@ class BatchWorker(Worker):
         self.admit_enabled = (
             _os.environ.get("NOMAD_TPU_ADMIT", "1") != "0"
         )
+        # global storm solver (NOMAD_TPU_STORM=1): when the broker
+        # holds a backlog of >= storm_min pending evals of ONE job
+        # family, the family prefix is drained atomically and solved
+        # as a single (pending-allocs x nodes) assignment on the
+        # device instead of walking the per-eval chunk chain.  Serial
+        # equivalence is explicitly relaxed behind this flag (the win
+        # is storm throughput + global placement quality); every
+        # member still commits through the _commit_wave conflict
+        # fences in broker FIFO order, with unsolvable or conflicted
+        # members falling back to the serial chain — zero evals lost.
+        self.storm_enabled = (
+            _os.environ.get("NOMAD_TPU_STORM") == "1"
+        )
+        try:
+            self.storm_min = max(
+                1, int(_os.environ.get("NOMAD_TPU_STORM_MIN", "16"))
+            )
+        except ValueError:
+            self.storm_min = 16
+        try:
+            self.storm_max = int(
+                _os.environ.get("NOMAD_TPU_STORM_MAX", "256")
+            )
+        except ValueError:
+            self.storm_max = 256
+        self.storm_max = max(self.storm_min, min(self.storm_max, 1024))
+        try:
+            # 0 = auto: the solve's padded row bucket (the auction
+            # assigns at least one row per round, so the bucket is
+            # the convergence bound)
+            self.storm_rounds = int(
+                _os.environ.get("NOMAD_TPU_STORM_ROUNDS", "0")
+            )
+        except ValueError:
+            self.storm_rounds = 0
+        self.storm_solves = 0
+        self.storm_evals = 0
+        self.storm_rows = 0
+        self.storm_fallbacks = 0
+        self.storm_divergent = 0
         self.admission_admitted = 0
         self.admission_deferred = 0
         self.admission_chains = 0
@@ -860,6 +938,8 @@ class BatchWorker(Worker):
             "fetch": 0.0,
             "mesh_launch": 0.0,
             "mesh_fetch": 0.0,
+            "storm_solve": 0.0,
+            "storm_decompose": 0.0,
             "replay": 0.0,
             "sequential": 0.0,
         }
@@ -1088,6 +1168,17 @@ class BatchWorker(Worker):
         if metrics is not None:
             metrics.incr(f"admission.{kind}")
 
+    def _count_storm(self, kind: str, n: int = 1) -> None:
+        """Global-storm-solver counters, exported under the `storm.`
+        namespace on /v1/metrics (solves | evals | rows | fallbacks |
+        divergent; the family is zero-registered at Server
+        construction from STORM_COUNTERS)."""
+        attr = f"storm_{kind}"
+        setattr(self, attr, getattr(self, attr) + n)
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.incr(f"storm.{kind}", float(n))
+
     def _export_adaptive_gauges(self) -> None:
         """The adaptive-cap inputs as /v1/metrics gauges, so an
         operator can see WHY `_adaptive_cap` picked a gulp size (the
@@ -1100,12 +1191,14 @@ class BatchWorker(Worker):
             "batch_worker.replay_ewma_ms", self._replay_ewma_ms
         )
         for bucket, ms in self._launch_ewma.items():
-            # mesh buckets are ("mesh", width) tuples -> .m<width>
-            suffix = (
-                f"m{bucket[1]}"
-                if isinstance(bucket, tuple)
-                else f"e{bucket}"
-            )
+            # mesh buckets are ("mesh", width) tuples -> .m<width>;
+            # the storm solver's dedicated bucket -> .storm
+            if isinstance(bucket, tuple):
+                suffix = f"m{bucket[1]}"
+            elif bucket == "storm":
+                suffix = "storm"
+            else:
+                suffix = f"e{bucket}"
             metrics.set_gauge(
                 f"batch_worker.launch_ewma_ms.{suffix}", ms
             )
@@ -1148,20 +1241,36 @@ class BatchWorker(Worker):
         return buckets or (self.batch_max,)
 
     @staticmethod
-    def _ewma_key(width: int, mesh: bool):
+    def _ewma_key(width: int, mesh: bool, storm: bool = False):
         """Launch-EWMA bucket key: mesh dispatches get their OWN
         buckets — a sharded all-gather-bearing launch costs nothing
         like a single-chip chunk of the same width, and smearing its
         cost into the chunk buckets used to poison the adaptive
-        width/cap policy for both paths."""
+        width/cap policy for both paths.  Storm solves likewise get a
+        single dedicated bucket (exported as
+        ``launch_ewma_ms.storm``): a whole-backlog assignment solve
+        is neither a chunk launch nor a mesh flush, and feeding its
+        wall time into the chunk buckets would make
+        ``_plan_chunk_width``/``_adaptive_cap`` plan chunk flushes
+        from solver costs (and vice versa let the solver inherit a
+        chunk-launch watchdog budget — the supervisor budgets key by
+        stage string, and the storm solve runs under its own
+        ``storm_solve`` stage)."""
+        if storm:
+            return "storm"
         return ("mesh", width) if mesh else width
 
-    def _launch_cost_ms(self, width: int, mesh: bool = False) -> float:
+    def _launch_cost_ms(
+        self, width: int, mesh: bool = False, storm: bool = False
+    ) -> float:
         """Estimated cost of one ``width``-wide chunk launch (dispatch
         + blocking fetch): the measured EWMA for that bucket, the
         first warm launch observed on this backend for buckets with no
         samples yet, or 50 ms before anything has been measured.
-        Mesh launches read (and seed) only mesh buckets."""
+        Mesh launches read (and seed) only mesh buckets; storm
+        solves read only theirs."""
+        if storm:
+            return self._launch_ewma.get("storm", 50.0)
         seed = self._mesh_ewma_seed if mesh else self._launch_ewma_seed
         default = seed if seed is not None else 50.0
         return self._launch_ewma.get(
@@ -1169,7 +1278,8 @@ class BatchWorker(Worker):
         )
 
     def _note_launch_cost(
-        self, width: int, ms: float, mesh: bool = False
+        self, width: int, ms: float, mesh: bool = False,
+        storm: bool = False,
     ) -> None:
         """Feed one chunk's measured device-path cost into the
         adaptive sizing loop (and seed the default estimate from the
@@ -1182,12 +1292,17 @@ class BatchWorker(Worker):
         ceiling = 20.0 * max(self.latency_budget_ms, 50.0)
         if ms > ceiling:
             return
-        if mesh:
+        if storm:
+            # the storm bucket seeds itself and never touches the
+            # chunk/mesh seeds: a backlog-wide solve's first warm
+            # wall time says nothing about a chunk dispatch
+            pass
+        elif mesh:
             if self._mesh_ewma_seed is None:
                 self._mesh_ewma_seed = ms
         elif self._launch_ewma_seed is None:
             self._launch_ewma_seed = ms
-        key = self._ewma_key(width, mesh)
+        key = self._ewma_key(width, mesh, storm)
         prev = self._launch_ewma.get(key)
         self._launch_ewma[key] = (
             ms if prev is None else 0.8 * prev + 0.2 * ms
@@ -1303,6 +1418,35 @@ class BatchWorker(Worker):
                 if ev is None:
                     continue
                 self._note_dequeue(ev)
+                # storm detection at the gulp boundary: a backlog of
+                # pending evals sharing this eval's job family above
+                # the trigger threshold is drained atomically and
+                # solved as ONE global assignment instead of feeding
+                # the per-eval chunk chain
+                if self.storm_enabled:
+                    storm = self._maybe_drain_storm(ev, token)
+                    if storm is not None:
+                        try:
+                            leftover = self._process_storm(storm)
+                        except Exception:  # noqa: BLE001
+                            self._count("errors")
+                            LOG.exception(
+                                "storm processing crashed"
+                            )
+                            for s_ev, s_token in storm:
+                                self._nack_quietly(s_ev, s_token)
+                            deferred, self._deferred = (
+                                self._deferred, []
+                            )
+                            admitted, self._admitted_live = (
+                                self._admitted_live, []
+                            )
+                            for s_ev, s_token in (
+                                deferred + admitted
+                            ):
+                                self._nack_quietly(s_ev, s_token)
+                            leftover = []
+                        continue
                 batch = [(ev, token)]
                 cap = self._adaptive_cap()
                 # ONE fill deadline for the whole gulp: the old
@@ -2050,6 +2194,324 @@ class BatchWorker(Worker):
         ]
         return descriptors, base + len(admitted)
 
+    # -- global storm solver (NOMAD_TPU_STORM=1) ------------------------
+
+    def _maybe_drain_storm(self, ev, token):
+        """Detect a storm at the gulp boundary: when the broker's
+        ready prefix continues ``ev``'s job family for at least
+        ``storm_min`` members total, drain that prefix atomically
+        (never leapfrogging unrelated evals) and return the FIFO
+        member list.  None = no storm; nothing was dequeued."""
+        from .eval_broker import job_family
+
+        family = job_family(ev)
+        if not family[1]:
+            return None
+        try:
+            drained = self.server.broker.drain_family(
+                self.schedulers,
+                family,
+                max_n=self.storm_max - 1,
+                min_n=max(0, self.storm_min - 1),
+            )
+        except Exception:  # noqa: BLE001 — detection is best-effort
+            LOG.warning("storm drain failed", exc_info=True)
+            return None
+        if len(drained) + 1 < self.storm_min:
+            return None
+        for d_ev, _tok in drained:
+            self._note_dequeue(d_ev)
+        members = [(ev, token)] + drained
+        # settle beats: a storm ARRIVES as a wave (drain loop,
+        # restore scan, dispatch burst), so keep absorbing the
+        # family prefix while it is still growing — one empty
+        # BATCH_WAIT_S beat ends the hunt.  Unrelated evals still
+        # fence the walk (drain_family never leapfrogs), so FIFO
+        # fairness is untouched, and a complete backlog costs one
+        # 5 ms beat — noise next to the solve it feeds.
+        import time as _time
+
+        waited = False
+        while len(members) < self.storm_max:
+            try:
+                more = self.server.broker.drain_family(
+                    self.schedulers,
+                    family,
+                    max_n=self.storm_max - len(members),
+                )
+            except Exception:  # noqa: BLE001 — growth is optional;
+                # the members already leased MUST still be processed
+                # (an escape here would kill the worker thread with
+                # up to storm_max leases outstanding)
+                LOG.warning(
+                    "storm settle drain failed", exc_info=True
+                )
+                break
+            if more:
+                for d_ev, _tok in more:
+                    self._note_dequeue(d_ev)
+                members.extend(more)
+                waited = False
+                continue
+            if waited:
+                break
+            _time.sleep(BATCH_WAIT_S)
+            waited = True
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.set_gauge("storm.backlog", float(len(members)))
+        for pos, (s_ev, _tok) in enumerate(members):
+            TRACE.event(
+                s_ev.id, "batch_worker.storm_gulp",
+                size=len(members), pos=pos,
+                family=f"{family[0]}/{family[1]}",
+            )
+        return members
+
+    def _process_storm(
+        self, members: List[Tuple[Evaluation, str]]
+    ) -> List[Tuple[Evaluation, str]]:
+        """Coalesce one family storm into a single global
+        (pending-allocs x candidate-nodes) assignment solve, then
+        decompose the converged assignment into per-eval prescored
+        plans that commit in broker FIFO order through the existing
+        ``_commit_wave`` conflict fences.  Any member the solver
+        cannot cover — ineligible shape, unassignable row, solve
+        failure, or a commit-time conflict cascade — re-enters the
+        normal batch path, so zero evals are ever lost and
+        correctness never depends on the solver.  Returns leftover
+        evals under the ``_process_batch`` contract."""
+        import time as _time
+
+        from ..explain import EXPLAIN
+        from ..sched.storm import StormMember, build_storm_problem, decompose
+
+        self._count_storm("evals", len(members))
+        snap = self.store.snapshot()
+        wave_readiness = self.store.readiness_generation()
+        wave_base = self.store.node_touch_counts()
+        chain_epoch = self._backend_epoch
+
+        # simulation pre-pass, FIFO order (the same host mirror of
+        # computeJobAllocs the chunk chain runs)
+        t0 = _time.monotonic()
+        storm_members: List[StormMember] = []
+        for ev, token in members:
+            job = self.store.job_by_id(ev.namespace, ev.job_id)
+            member = StormMember(ev=ev, token=token, job=job)
+            if not self._batchable(ev, job):
+                member.reason = "unbatchable"
+            else:
+                try:
+                    with TRACE.span(ev.id, "batch_worker.simulate"):
+                        member.sim = self._simulate(snap, ev, job)
+                except Exception:  # noqa: BLE001
+                    self._count("errors")
+                    LOG.warning(
+                        "storm simulate failed for eval %s", ev.id,
+                        exc_info=True,
+                    )
+                if member.sim is None:
+                    member.reason = "simulate"
+            storm_members.append(member)
+        dt_sim = _time.monotonic() - t0
+        self._observe("simulate", dt_sim, exemplar=members[0][0].id)
+
+        # stage + solve: one device call for the whole backlog
+        problem = None
+        try:
+            problem = build_storm_problem(self, snap, storm_members)
+        except Exception:  # noqa: BLE001
+            self._count("errors")
+            LOG.warning("storm staging failed", exc_info=True)
+        out = None
+        if problem is not None and problem.n_rows > 0:
+            t1 = _time.monotonic()
+            try:
+                out = self._guard_device(
+                    "storm_solve",
+                    lambda: self._storm_solve(problem, snap),
+                    exemplar=members[0][0].id,
+                )
+            except Exception:  # noqa: BLE001
+                self._count("errors")
+                LOG.warning("storm solve failed", exc_info=True)
+                # the abandoned solve may still read the usage
+                # mirror: the next sync must re-upload, not donate
+                self._mark_mirror_dirty()
+            dt = _time.monotonic() - t1
+            solver_members = [
+                m for m in storm_members if m.reason is None
+            ]
+            self._observe(
+                "storm_solve", dt, exemplar=members[0][0].id
+            )
+            for pos, m in enumerate(solver_members):
+                TRACE.add_span(
+                    m.ev.id, "batch_worker.storm_solve", t1, dt,
+                    chain_pos=pos, members=len(solver_members),
+                    rows=problem.n_rows, ok=out is not None,
+                )
+            # solver wall time feeds its OWN EWMA bucket
+            # (launch_ewma_ms.storm) — never the chunk-width buckets
+            # the adaptive gulp policy plans flushes from
+            self._note_launch_cost(0, dt * 1000.0, storm=True)
+            if chain_epoch != self._backend_epoch:
+                # a failover flipped the backend mid-solve: the
+                # assignment came from (or hung on) the old target
+                out = None
+                self._mark_mirror_dirty()
+        if problem is not None:
+            t2 = _time.monotonic()
+            solved_rows = decompose(problem, out)
+            dt2 = _time.monotonic() - t2
+            self._observe(
+                "storm_decompose", dt2, exemplar=members[0][0].id
+            )
+            if out is not None:
+                rounds = int(out[5])
+                self._count_storm("solves")
+                self._count_storm("rows", solved_rows)
+                divergent = sum(
+                    m.divergent_rows
+                    for m in storm_members
+                    if m.rows is not None
+                )
+                if divergent:
+                    self._count_storm("divergent", divergent)
+                metrics = getattr(self.server, "metrics", None)
+                if metrics is not None:
+                    metrics.set_gauge("storm.rounds", float(rounds))
+                for m in storm_members:
+                    if m.rows is not None:
+                        TRACE.add_span(
+                            m.ev.id,
+                            "batch_worker.storm_decompose",
+                            t2, dt2, rows=len(m.rows),
+                            round=m.solver_round,
+                            divergent=m.divergent_rows,
+                        )
+
+        # in-order commit through the existing conflict fences:
+        # solved members speculate on the replay pool (or replay
+        # their solver rows serially when parallel replay is off);
+        # fallback members ride the same wave with rows=None so FIFO
+        # order with their solved siblings is preserved
+        spec_pool = (
+            self._replay_pool_instance()
+            if self.parallel_replay
+            else None
+        )
+        wave = deque()
+        for m in storm_members:
+            if m.rows is not None:
+                fut = (
+                    spec_pool.submit(
+                        self._speculate_one, snap, wave_readiness,
+                        m.ev, m.job, m.sim, m.rows, m.pulls,
+                    )
+                    if spec_pool is not None
+                    else _DoneFuture(None)
+                )
+                wave.append((
+                    m.ev, m.token, m.job, m.sim, m.rows, m.pulls,
+                    fut,
+                ))
+            else:
+                self._count_storm("fallbacks")
+                TRACE.event(
+                    m.ev.id, "batch_worker.storm_fallback",
+                    reason=m.reason or "solver",
+                )
+                wave.append((
+                    m.ev, m.token, m.job, m.sim, None, None,
+                    _DoneFuture(None),
+                ))
+        wave_state = {"job_ledger": set(), "expect": {}}
+        _k, _rescore = self._commit_wave(
+            wave, 0, wave_base, wave_readiness,
+            state=wave_state, drain_all=True,
+        )
+        leftover: List[Tuple[Evaluation, str]] = []
+        if wave:
+            # a mid-wave rescore abandoned the remaining members'
+            # speculations; their leases are still held — re-feed
+            # them through the normal batch path (chunk chain or
+            # sequential), never dropping one.  Solver-placed
+            # members in the remainder are DEMOTED (rows cleared)
+            # so the explain/trace audit below never tags their
+            # eventual chunk-chain placements as solver output, and
+            # the fallback counter counts each member once (gated
+            # members were already counted at wave build).
+            remaining = [
+                (r_ev, r_token)
+                for (r_ev, r_token, *_rest) in wave
+            ]
+            remaining_ids = {r_ev.id for r_ev, _rt in remaining}
+            demoted = 0
+            for m in storm_members:
+                if m.ev.id in remaining_ids and m.rows is not None:
+                    m.rows = None
+                    m.pulls = None
+                    demoted += 1
+                    TRACE.event(
+                        m.ev.id, "batch_worker.storm_fallback",
+                        reason="rescore",
+                    )
+            if demoted:
+                self._count_storm("fallbacks", demoted)
+            leftover = self._process_batch(remaining)
+        # explain-ring audit trail: every committed member whose
+        # placements came from the solver carries the solver round,
+        # aggregate assignment score and greedy-walk divergence, so
+        # `eval explain` shows WHY the global solve differed from
+        # the serial walk
+        for m in storm_members:
+            if m.rows is None:
+                continue
+            EXPLAIN.annotate(
+                m.ev.id,
+                Storm={
+                    "Round": m.solver_round,
+                    "AssignmentScore": round(
+                        m.assignment_score, 6
+                    ),
+                    "DivergentRows": m.divergent_rows,
+                    "Rows": len(m.rows),
+                },
+            )
+            TRACE.annotate(
+                m.ev.id, outcome_detail="storm",
+                storm_round=m.solver_round,
+            )
+        self._export_adaptive_gauges()
+        return leftover
+
+    def _storm_solve(self, problem, snap):
+        """Dispatch one storm assignment solve against the
+        device-resident usage mirror and realize the outputs.  The
+        jitted solve (ops/solve.py) runs the score matrix build and
+        the auction ``while_loop`` entirely on device; shapes are
+        pow2-bucketed by the problem builder so traces stay cached
+        across storms.  ``snap`` is the SAME snapshot the problem
+        was staged against — the solve's arena row indices are only
+        meaningful against that table."""
+        import jax
+
+        from ..ops.solve import storm_assignment
+
+        table = snap.node_table
+        cols = self._device_columns(table)
+        max_rounds = problem.max_rounds
+        if self.storm_rounds > 0:
+            max_rounds = min(max_rounds, self.storm_rounds)
+        out = storm_assignment(
+            problem.inputs, cols,
+            spread_fit=problem.spread_fit,
+            max_rounds=max_rounds,
+        )
+        return tuple(np.asarray(x) for x in jax.device_get(out))
+
     def _replay_one(
         self, ev, token, job, sim: _Sim,
         rows: List[int], pulls: Optional[List[int]],
@@ -2062,6 +2524,17 @@ class BatchWorker(Worker):
         # None = unknown writes until a clean prescored replay records
         # its committed plan's touches (the wave commit loop reads it)
         self._last_replay_touches = None
+        if rows is None:
+            # storm wave member the solver could not cover: the full
+            # sequential path owns it.  True (not the chain's
+            # "suspect" False): storm rows are computed from the
+            # baseline + the solver's capacity model, not a
+            # sequential carry, so a fallback commit does not
+            # invalidate later members' rows — their own conflict
+            # fences see this commit's writes as unexpected touches
+            # and serialize exactly the members it actually affected.
+            self._process_sequential(ev, token)
+            return True
         t0 = _time.monotonic()
         try:
             clean = self._process_prescored(
@@ -3064,6 +3537,37 @@ class BatchWorker(Worker):
         self._cand_cache.put(key, out)
         return out
 
+    def _stage_walk_order(self, snap, job, sim):
+        """The per-eval walk-order staging shared by the chunk
+        assembler (`_assemble`) and the storm problem builder
+        (`sched/storm.build_storm_problem`): candidate layout, the
+        recorded serial shuffle when rng-aligned (seed-keyed
+        fallback otherwise), the arena-order perm, and the replay
+        passthrough mirror.  ONE definition on purpose — the storm
+        path's degenerate-parity contract depends on byte-identical
+        staging, and a copy here would drift silently.
+        Returns ``(rows, rest, n_cand, order, perm)``."""
+        nodes, rows, rest = self._candidates(
+            snap, job.datacenters
+        )
+        n_cand = len(nodes)
+        rng_aligned = (
+            sim.order is not None and len(sim.order) == n_cand
+        )
+        if rng_aligned:
+            order = sim.order
+        else:
+            order = shuffle_permutation(
+                random.Random(self.seed), n_cand
+            )
+        perm = np.concatenate([rows[order], rest])
+        # passthrough needs the rng-aligned order (the one the
+        # sequential shuffle would produce); a fallback shuffle
+        # keeps prescoring valid but gates preempt retries
+        sim.replay_order = order if rng_aligned else None
+        sim.replay_n_cand = n_cand
+        return rows, rest, n_cand, order, perm
+
     @staticmethod
     def _job_signature(job: Job, tg: TaskGroup) -> tuple:
         cons = tuple(
@@ -3529,25 +4033,9 @@ class BatchWorker(Worker):
         max_picks = 1
         max_tgs = 1
         for (ev, _token, job), sim in zip(prescorable, sims):
-            nodes, rows, rest = self._candidates(
-                snap, job.datacenters
+            rows, rest, n_cand, order, perm = (
+                self._stage_walk_order(snap, job, sim)
             )
-            n_cand = len(nodes)
-            rng_aligned = (
-                sim.order is not None and len(sim.order) == n_cand
-            )
-            if rng_aligned:
-                order = sim.order
-            else:
-                order = shuffle_permutation(
-                    random.Random(self.seed), n_cand
-                )
-            perm = np.concatenate([rows[order], rest])
-            # passthrough needs the rng-aligned order (the one the
-            # sequential shuffle would produce); a fallback shuffle
-            # keeps prescoring valid but gates preempt retries
-            sim.replay_order = order if rng_aligned else None
-            sim.replay_n_cand = n_cand
             tgs = sim.tgs or [job.task_groups[0]]
             tg = tgs[0]
             max_tgs = max(max_tgs, len(tgs))

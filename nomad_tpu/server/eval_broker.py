@@ -23,6 +23,29 @@ DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
 FAILED_QUEUE = "_failed"
 
+# job-id separators that mark a parent's spawned children: a dispatch
+# or periodic storm is hundreds of sibling jobs under one parent
+_FAMILY_SEPARATORS = ("/dispatch-", "/periodic-")
+
+
+def job_family(ev: Evaluation) -> Tuple[str, str]:
+    """The (namespace, parent job id) an eval's job belongs to.
+
+    Dispatch and periodic children (``parent/dispatch-x``,
+    ``parent/periodic-ts``) collapse onto their parent, so a mass
+    dispatch, a drain stopping hundreds of children, or a scale-up
+    wave all read as ONE family — the unit the batch worker's storm
+    detector coalesces into a single global assignment solve.  The
+    broker's one-outstanding-eval-per-job rule is untouched: family
+    members are sibling *jobs*, each with its own dedup key."""
+    job_id = ev.job_id or ""
+    for sep in _FAMILY_SEPARATORS:
+        i = job_id.find(sep)
+        if i >= 0:
+            job_id = job_id[:i]
+            break
+    return (ev.namespace, job_id)
+
 
 class _ReadyQueue:
     """Priority heap: highest priority first, then FIFO by create index."""
@@ -255,6 +278,99 @@ class EvalBroker:
             return None
         self.stats["total_ready"] -= 1
         return best_queue.pop()
+
+    def drain_family(
+        self,
+        schedulers: List[str],
+        family: Tuple[str, str],
+        max_n: int,
+        min_n: int = 1,
+    ) -> List[Tuple[Evaluation, str]]:
+        """Atomically dequeue the contiguous pop-order prefix of ready
+        evals whose :func:`job_family` equals ``family`` — never
+        leapfrogging an unrelated eval: the walk stops at the first
+        ready eval of another family (or at ``max_n``).
+
+        All-or-nothing below ``min_n``: when the prefix is shorter
+        than ``min_n`` NOTHING is dequeued and ``[]`` is returned, so
+        a storm probe that doesn't meet its trigger threshold leaves
+        the queue byte-identical (re-pushing popped evals would mint
+        fresh FIFO counters and reorder them within their priority
+        class).  Each drained eval gets the full ``dequeue``
+        bookkeeping — unack token, redelivery deadline, trace root —
+        so ack/nack (and nack-timeout redelivery) work unchanged.
+
+        This replaces the storm path's previous shape of N racing
+        ``dequeue()`` calls, which interleaved with other consumers
+        and could split one family's backlog across gulps."""
+        with self._lock:
+            self._promote_delayed_locked()
+            # cheap rejection before any copying: when the pop-order
+            # head is already another family the drainable prefix is
+            # empty, and storm probes run at EVERY gulp boundary —
+            # an O(ready backlog) shadow copy per dequeue would be
+            # quadratic under mixed traffic
+            head = None
+            head_priority = None
+            for name in schedulers:
+                q = self._ready.get(name)
+                if q is None or not len(q):
+                    continue
+                p = q.peek_priority()
+                if head_priority is None or p > head_priority:
+                    head_priority = p
+                    head = q.heap[0][2]
+            if head is None or job_family(head) != family:
+                return []
+            # phase 1: measure the prefix on shadow heaps (list copies
+            # preserve the heap invariant) so a too-short prefix pops
+            # nothing real
+            shadows = {
+                name: list(q.heap)
+                for name, q in self._ready.items()
+                if name in schedulers and len(q)
+            }
+            count = 0
+            while count < max_n:
+                best_name = None
+                best_priority = None
+                for name in schedulers:
+                    heap = shadows.get(name)
+                    if not heap:
+                        continue
+                    p = -heap[0][0]
+                    if best_priority is None or p > best_priority:
+                        best_priority = p
+                        best_name = name
+                if best_name is None:
+                    break
+                ev = heapq.heappop(shadows[best_name])[2]
+                if job_family(ev) != family:
+                    break
+                count += 1
+            if count < min_n:
+                return []
+            out: List[Tuple[Evaluation, str]] = []
+            for _ in range(count):
+                ev = self._pop_ready_locked(schedulers)
+                token = new_id()
+                self._unack[ev.id] = (
+                    ev, token, time.monotonic() + self.nack_timeout,
+                )
+                self.stats["total_unacked"] += 1
+                self.events.append(
+                    (time.monotonic(), "deq", ev.id[:6], token[:6])
+                )
+                TRACE.begin(
+                    ev.id,
+                    queue=ev.type,
+                    priority=ev.priority,
+                    namespace=ev.namespace,
+                    job_id=ev.job_id,
+                    triggered_by=ev.triggered_by,
+                )
+                out.append((ev, token))
+            return out
 
     def _promote_delayed_locked(self) -> None:
         now = time.time()

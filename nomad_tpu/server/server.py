@@ -250,6 +250,22 @@ class Server:
             self.metrics.preregister(
                 counters=MESH_COUNTERS, gauges=MESH_GAUGES
             )
+            # global storm solver: zero-register the storm.* family
+            # (absence-of-series must mean "no storm ever coalesced"
+            # — NOMAD_TPU_STORM off or backlog under the trigger —
+            # not "not exported") and expose the mode flag
+            from .batch_worker import STORM_COUNTERS, STORM_GAUGES
+
+            self.metrics.preregister(
+                counters=STORM_COUNTERS, gauges=STORM_GAUGES
+            )
+            self.metrics.set_gauge(
+                "batch_worker.storm_enabled",
+                1.0 if any(
+                    getattr(w, "storm_enabled", False)
+                    for w in self.workers
+                ) else 0.0,
+            )
             self.metrics.set_gauge(
                 "batch_worker.admit_enabled",
                 1.0 if any(

@@ -78,6 +78,18 @@ SPAN_NAMES = frozenset(
         # budgeted separately by the supervisor's stage watchdogs)
         "batch_worker.mesh_launch",
         "batch_worker.mesh_fetch",
+        # global storm solver (NOMAD_TPU_STORM=1): `storm_gulp` marks
+        # a family backlog drained for one coalesced solve (with the
+        # member's FIFO position), `storm_solve` spans the single
+        # device-side assignment solve on every member (members attr
+        # like the other chunk-wide stages), `storm_decompose` the
+        # per-eval plan decomposition, and `storm_fallback` marks a
+        # member handed back to the serial chain (gate reason /
+        # unsolved row / commit rescore) — never a dropped eval
+        "batch_worker.storm_gulp",
+        "batch_worker.storm_solve",
+        "batch_worker.storm_decompose",
+        "batch_worker.storm_fallback",
         "batch_worker.replay",
         "batch_worker.sequential",
         "batch_worker.fallback",

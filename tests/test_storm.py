@@ -1,0 +1,635 @@
+"""Global storm solver tests (NOMAD_TPU_STORM=1): the broker's
+atomic family drain, the device-side assignment solve, and the
+decompose-and-commit path.
+
+Contracts under test:
+
+- ``drain_family`` dequeues the contiguous pop-order prefix of one
+  job family — never leapfrogging unrelated evals, all-or-nothing
+  below its threshold, full unack/token bookkeeping per member.
+- Degenerate parity: a single-eval storm (threshold forced to 1)
+  produces bit-identical placements and AllocMetrics to the serial
+  chain — the solver's one-row assignment IS the greedy walk.
+- A mass family storm places every eval with zero losses, commits
+  through the existing conflict fences, and tags every solver-placed
+  eval's explain record with the auditable ``Storm`` block.
+- Ineligible members and solve failures fall back to the normal
+  batch path inside the same FIFO order — correctness never depends
+  on the solver.
+- ``NOMAD_TPU_STORM=0`` (the default) never engages any of it.
+"""
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import EvalBroker, Server
+from nomad_tpu.server.eval_broker import job_family
+from nomad_tpu.structs import compute_node_class
+
+
+# ---------------------------------------------------------------------------
+# job_family
+# ---------------------------------------------------------------------------
+
+
+def test_job_family_collapses_children():
+    base = mock.evaluation(job_id="ingest", namespace="default")
+    disp = mock.evaluation(
+        job_id="ingest/dispatch-1723-abcd", namespace="default"
+    )
+    peri = mock.evaluation(
+        job_id="ingest/periodic-1723", namespace="default"
+    )
+    other_ns = mock.evaluation(job_id="ingest", namespace="prod")
+    assert job_family(base) == ("default", "ingest")
+    assert job_family(disp) == job_family(base)
+    assert job_family(peri) == job_family(base)
+    assert job_family(other_ns) != job_family(base)
+    assert job_family(mock.evaluation(job_id="other")) != job_family(
+        base
+    )
+
+
+# ---------------------------------------------------------------------------
+# drain_family
+# ---------------------------------------------------------------------------
+
+
+def _mk_broker(**kw):
+    b = EvalBroker(**kw)
+    b.set_enabled(True)
+    return b
+
+
+def _fam_eval(i, fam="fam", priority=50):
+    return mock.evaluation(
+        job_id=f"{fam}/dispatch-{i:04d}", priority=priority
+    )
+
+
+def test_drain_family_contiguous_prefix_no_leapfrog():
+    b = _mk_broker()
+    front = [_fam_eval(i) for i in range(3)]
+    stranger = mock.evaluation(job_id="other-job")
+    tail = [_fam_eval(i) for i in range(3, 5)]
+    for ev in front + [stranger] + tail:
+        b.enqueue(ev)
+    out = b.drain_family(
+        ["service"], ("default", "fam"), max_n=10
+    )
+    # the walk stops at the first unrelated ready eval: the two
+    # family members QUEUED BEHIND the stranger are not leapfrogged
+    assert [ev.id for ev, _t in out] == [ev.id for ev in front]
+    nxt, tok = b.dequeue(["service"], timeout=1)
+    assert nxt is stranger
+    b.ack(nxt.id, tok)
+    for want in tail:
+        ev, tok = b.dequeue(["service"], timeout=1)
+        assert ev is want
+        b.ack(ev.id, tok)
+    for ev, tok in out:
+        b.ack(ev.id, tok)
+    assert b.stats["total_ready"] == 0
+    assert b.stats["total_unacked"] == 0
+
+
+def test_drain_family_respects_max_n():
+    b = _mk_broker()
+    evs = [_fam_eval(i) for i in range(6)]
+    for ev in evs:
+        b.enqueue(ev)
+    out = b.drain_family(["service"], ("default", "fam"), max_n=4)
+    assert [ev.id for ev, _t in out] == [ev.id for ev in evs[:4]]
+    # remainder still ready, in order
+    ev, tok = b.dequeue(["service"], timeout=1)
+    assert ev is evs[4]
+    b.nack(ev.id, tok)
+    for e, t in out:
+        b.ack(e.id, t)
+
+
+def test_drain_family_all_or_nothing_below_min():
+    b = _mk_broker()
+    evs = [_fam_eval(i) for i in range(2)]
+    for ev in evs:
+        b.enqueue(ev)
+    assert (
+        b.drain_family(
+            ["service"], ("default", "fam"), max_n=10, min_n=3
+        )
+        == []
+    )
+    # nothing was dequeued and FIFO order is untouched
+    assert b.stats["total_ready"] == 2
+    assert b.stats["total_unacked"] == 0
+    for want in evs:
+        ev, tok = b.dequeue(["service"], timeout=1)
+        assert ev is want
+        b.ack(ev.id, tok)
+
+
+def test_drain_family_priority_fences_the_prefix():
+    """A higher-priority unrelated eval pops first, so it FENCES the
+    drain even though family members are queued: the family is not
+    the pop-order prefix."""
+    b = _mk_broker()
+    for i in range(3):
+        b.enqueue(_fam_eval(i))
+    vip = mock.evaluation(job_id="vip", priority=90)
+    b.enqueue(vip)
+    assert (
+        b.drain_family(["service"], ("default", "fam"), max_n=10)
+        == []
+    )
+    ev, tok = b.dequeue(["service"], timeout=1)
+    assert ev is vip
+    b.ack(ev.id, tok)
+
+
+def test_drain_family_token_bookkeeping_and_nack():
+    b = _mk_broker(delivery_limit=5)
+    evs = [_fam_eval(i) for i in range(4)]
+    for ev in evs:
+        b.enqueue(ev)
+    out = b.drain_family(["service"], ("default", "fam"), max_n=10)
+    assert len(out) == 4
+    assert b.stats["total_unacked"] == 4
+    # a stale token is rejected exactly like dequeue()'s
+    with pytest.raises(ValueError):
+        b.ack(out[0][0].id, "bogus-token")
+    # ack half, nack half: nacked members re-enqueue and redeliver
+    for ev, tok in out[:2]:
+        b.ack(ev.id, tok)
+    for ev, tok in out[2:]:
+        b.nack(ev.id, tok)
+    redelivered = []
+    for _ in range(2):
+        ev, tok = b.dequeue(["service"], timeout=1)
+        redelivered.append(ev.id)
+        b.ack(ev.id, tok)
+    assert sorted(redelivered) == sorted(ev.id for ev, _t in out[2:])
+    assert b.stats["total_unacked"] == 0
+
+
+def test_drain_family_nack_timeout_redelivers():
+    b = _mk_broker(nack_timeout=0.1, delivery_limit=5)
+    for i in range(2):
+        b.enqueue(_fam_eval(i))
+    out = b.drain_family(["service"], ("default", "fam"), max_n=10)
+    assert len(out) == 2
+    # never ack: the sweeper must nack both for us
+    got = set()
+    for _ in range(2):
+        ev, tok = b.dequeue(["service"], timeout=3)
+        assert ev is not None
+        got.add(ev.id)
+        b.ack(ev.id, tok)
+    assert got == {ev.id for ev, _t in out}
+
+
+# ---------------------------------------------------------------------------
+# ops/solve.py unit level
+# ---------------------------------------------------------------------------
+
+
+def _solver_problem(E, A, C, ask=(100.0, 100.0, 100.0), limit=2,
+                    seed=0, shared_perm=False):
+    import jax.numpy as jnp
+
+    from nomad_tpu.ops.solve import StormInputs
+
+    rng = np.random.default_rng(seed)
+    if shared_perm:
+        perm = np.tile(
+            rng.permutation(C).astype(np.int32), (E, 1)
+        )
+    else:
+        perm = np.stack(
+            [rng.permutation(C).astype(np.int32) for _ in range(E)]
+        )
+    inp = StormInputs(
+        feasible=np.ones((E, C), bool),
+        affinity=np.zeros((E, C), np.float32),
+        collisions=np.zeros((E, C), np.int32),
+        perm=perm,
+        limit=np.full(E, limit, np.int32),
+        n_cand=np.full(E, C, np.int32),
+        eval_of=(np.arange(A) % E).astype(np.int32),
+        penalty=np.zeros((A, C), bool),
+        ask=np.tile(np.asarray(ask, np.float32), (A, 1)),
+        desired=np.ones(A, np.int32),
+        real=np.ones(A, bool),
+        pre_cpu=np.zeros(C, np.float32),
+        pre_mem=np.zeros(C, np.float32),
+        pre_disk=np.zeros(C, np.float32),
+    )
+    cols = tuple(
+        jnp.asarray(x)
+        for x in (
+            np.full(C, 4000.0, np.float32),
+            np.full(C, 8192.0, np.float32),
+            np.full(C, 100000.0, np.float32),
+            np.zeros(C, np.float32),
+            np.zeros(C, np.float32),
+            np.zeros(C, np.float32),
+        )
+    )
+    return inp, cols
+
+
+def test_solver_assigns_all_and_never_overcommits():
+    from nomad_tpu.ops.solve import storm_assignment
+
+    E = A = 32
+    C = 16
+    # 32 rows of 1000 cpu over 16 nodes of 4000: tight but feasible
+    inp, cols = _solver_problem(
+        E, A, C, ask=(1000.0, 100.0, 100.0), shared_perm=True
+    )
+    out = storm_assignment(
+        inp, cols, spread_fit=False, max_rounds=A
+    )
+    assigned = np.asarray(out[0])
+    assert (assigned >= 0).all()
+    counts = np.bincount(assigned, minlength=C)
+    assert counts.max() <= 4  # 4 x 1000 = the node's cpu capacity
+    # identical asks dog-piling one shared walk order must still
+    # converge in a handful of rounds, not one acceptance at a time
+    assert int(out[5]) <= 8
+
+
+def test_solver_one_row_is_exactly_the_greedy_walk():
+    from nomad_tpu.ops.score import (
+        ScoreInputs,
+        _limited_walk_argmax,
+        _score_vectors,
+    )
+    from nomad_tpu.ops.solve import storm_assignment
+
+    E, A, C = 1, 1, 12
+    inp, cols = _solver_problem(E, A, C, limit=3, seed=7)
+    assigned, pulls, acc_round, score, greedy, rounds = (
+        storm_assignment(inp, cols, spread_fit=False, max_rounds=4)
+    )
+    # the oracle: the serial chain's limited walk over the same score
+    # vectors
+    si = ScoreInputs(
+        cpu_total=cols[0], mem_total=cols[1], disk_total=cols[2],
+        cpu_used=cols[3], mem_used=cols[4], disk_used=cols[5],
+        feasible=np.ones((1, C), bool),
+        collisions=np.zeros((1, C), np.int32),
+        penalty=np.zeros((1, C), bool),
+        affinity_score=np.zeros((1, C), np.float32),
+        spread_boost=np.zeros((), np.float32),
+        perm=inp.perm,
+        ask_cpu=inp.ask[:, 0:1],
+        ask_mem=inp.ask[:, 1:2],
+        ask_disk=inp.ask[:, 2:3],
+        desired_count=inp.desired[:, None],
+        limit=inp.limit,
+        n_candidates=inp.n_cand,
+    )
+    import jax
+
+    feas, scores = _score_vectors(si, False)
+    want_row, _best, _nf, want_pulls = jax.vmap(
+        _limited_walk_argmax
+    )(feas, scores, si.perm, si.limit, si.n_candidates)
+    assert int(assigned[0]) == int(want_row[0])
+    assert int(assigned[0]) == int(greedy[0])
+    assert int(pulls[0]) == int(want_pulls[0])
+    assert int(acc_round[0]) == 0
+
+
+def test_solver_padding_rows_never_assigned():
+    from nomad_tpu.ops.solve import storm_assignment
+
+    E, A, C = 4, 8, 8
+    inp, cols = _solver_problem(E, A, C)
+    real = np.ones(A, bool)
+    real[5:] = False
+    inp = inp._replace(real=real)
+    out = storm_assignment(
+        inp, cols, spread_fit=False, max_rounds=A
+    )
+    assigned = np.asarray(out[0])
+    assert (assigned[5:] == -1).all()
+    assert (assigned[:5] >= 0).all()
+
+
+def test_solver_infeasible_row_returns_no_node():
+    from nomad_tpu.ops.solve import storm_assignment
+
+    E, A, C = 2, 2, 8
+    inp, cols = _solver_problem(E, A, C)
+    feasible = np.ones((E, C), bool)
+    feasible[1, :] = False
+    inp = inp._replace(feasible=feasible)
+    out = storm_assignment(
+        inp, cols, spread_fit=False, max_rounds=A
+    )
+    assigned = np.asarray(out[0])
+    assert int(assigned[0]) >= 0
+    assert int(assigned[1]) == -1
+    assert int(np.asarray(out[2])[1]) == -1  # acc_round unsolved
+
+
+# ---------------------------------------------------------------------------
+# server level
+# ---------------------------------------------------------------------------
+
+
+def make_nodes(n, seed=3):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        node = mock.node(id=f"storm-node-{seed}-{i:04d}")
+        node.node_resources.cpu = rng.choice([8000, 16000])
+        node.node_resources.memory_mb = rng.choice([16384, 32768])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def family_jobs(n, fam="stfam", count=1, cpu=2000):
+    jobs = []
+    for i in range(n):
+        job = mock.job(id=f"{fam}/dispatch-{i:04d}")
+        job.type = "batch"
+        job.task_groups[0].count = count
+        job.task_groups[0].tasks[0].resources.cpu = cpu
+        job.task_groups[0].tasks[0].resources.memory_mb = 4096
+        jobs.append(job)
+    return jobs
+
+
+def run_storm_server(jobs, n_nodes=24, nodes_seed=3, timeout=120):
+    """Jobs registered BEFORE leadership, so the whole family lands
+    in the broker as one restore wave — the mass-drain shape."""
+    server = Server(num_schedulers=1, seed=11, batch_pipeline=True)
+    for node in make_nodes(n_nodes, seed=nodes_seed):
+        server.register_node(copy.deepcopy(node))
+    for job in jobs:
+        server.register_job(copy.deepcopy(job))
+    server.start()
+    assert server.drain_to_idle(timeout)
+    return server
+
+
+def placements(server, job_id):
+    return sorted(
+        (a.name, a.node_id)
+        for a in server.store.allocs_by_job("default", job_id)
+        if not a.terminal_status()
+    )
+
+
+def eval_outcomes(server, job_id):
+    return sorted(
+        (
+            e.status,
+            e.status_description,
+            tuple(sorted(e.queued_allocations.items())),
+        )
+        for e in server.store.evals_by_job("default", job_id)
+    )
+
+
+def assert_zero_lost(server, jobs):
+    for job in jobs:
+        evs = server.store.evals_by_job("default", job.id)
+        assert evs, f"no evals for {job.id}"
+        assert all(e.terminal_status() for e in evs), (
+            f"non-terminal eval for {job.id}"
+        )
+    assert server.broker.failed() == []
+
+
+def explain_metric(server, job_id):
+    """Comparable AllocMetric view from the explain ring (wall-clock
+    fields and the storm audit annotation stripped)."""
+    from nomad_tpu.explain import EXPLAIN
+
+    out = []
+    for ev in sorted(
+        server.store.evals_by_job("default", job_id),
+        key=lambda e: e.create_index,
+    ):
+        rec = EXPLAIN.get(ev.id)
+        if rec is None:
+            out.append(None)
+            continue
+        tgs = {}
+        for tg, entry in rec["TaskGroups"].items():
+            metric = entry.get("Metric")
+            if metric is not None:
+                metric = {
+                    k: v
+                    for k, v in metric.items()
+                    if k != "AllocationTime"
+                }
+            tgs[tg] = {
+                "Placed": entry["Placed"],
+                "Failed": entry["Failed"],
+                "Winner": entry["Winner"],
+                "Placements": sorted(
+                    (
+                        p["Name"],
+                        p["NodeID"],
+                        round(p["NormScore"], 9),
+                    )
+                    for p in entry["Placements"]
+                ),
+                "Metric": metric,
+            }
+        out.append(tgs)
+    return out
+
+
+def test_storm_mass_family_zero_lost(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "8")
+    jobs = family_jobs(24)
+    server = run_storm_server(jobs)
+    try:
+        worker = server.workers[0]
+        assert worker.storm_solves >= 1
+        assert worker.storm_evals == 24
+        total_placed = 0
+        for job in jobs:
+            p = placements(server, job.id)
+            assert len(p) == 1, f"{job.id} placed {len(p)}"
+            total_placed += len(p)
+        assert total_placed == 24
+        assert_zero_lost(server, jobs)
+        # counters mirror to /v1/metrics (zero-registered family)
+        m = server.metrics
+        assert m.get_counter("storm.solves") == worker.storm_solves
+        assert m.get_counter("storm.evals") == worker.storm_evals
+        assert m.get_counter("storm.rows") == worker.storm_rows
+        assert m.get_gauge("storm.rounds") is not None
+        assert m.get_gauge("batch_worker.storm_enabled") == 1.0
+        # solver wall time feeds its own EWMA bucket, never the
+        # chunk-width buckets the adaptive gulp policy plans from
+        assert "storm" in worker._launch_ewma
+        assert (
+            m.get_gauge("batch_worker.launch_ewma_ms.storm")
+            is not None
+        )
+        # every solver-placed eval carries the auditable Storm block
+        from nomad_tpu.explain import EXPLAIN
+
+        tagged = 0
+        for job in jobs:
+            for ev in server.store.evals_by_job("default", job.id):
+                rec = EXPLAIN.get(ev.id)
+                if rec is None:
+                    continue
+                storm = rec.get("Storm")
+                if storm is not None:
+                    tagged += 1
+                    assert storm["Round"] >= 0
+                    assert storm["Rows"] == 1
+                    assert 0 <= storm["DivergentRows"] <= 1
+        assert tagged + worker.storm_fallbacks >= 24
+        assert tagged > 0
+    finally:
+        server.stop()
+
+
+def test_storm_below_threshold_never_engages(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "64")
+    jobs = family_jobs(6)
+    server = run_storm_server(jobs)
+    try:
+        worker = server.workers[0]
+        assert worker.storm_solves == 0
+        assert worker.storm_evals == 0
+        for job in jobs:
+            assert len(placements(server, job.id)) == 1
+        assert_zero_lost(server, jobs)
+    finally:
+        server.stop()
+
+
+def test_storm_off_is_inert(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_STORM", "0")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "1")
+    jobs = family_jobs(10)
+    server = run_storm_server(jobs)
+    try:
+        worker = server.workers[0]
+        assert not worker.storm_enabled
+        assert worker.storm_solves == 0
+        assert worker.storm_evals == 0
+        assert (
+            server.metrics.get_gauge("batch_worker.storm_enabled")
+            == 0.0
+        )
+        assert_zero_lost(server, jobs)
+    finally:
+        server.stop()
+
+
+def test_storm_degenerate_single_eval_parity(monkeypatch):
+    """The serial-equivalence floor: ONE pending eval forced through
+    the solver (threshold=1) must produce bit-identical placements,
+    eval outcomes and AllocMetrics to the storm-off chain — the
+    solver's one-row assignment is exactly the greedy walk, pulls
+    included."""
+    jobs = family_jobs(1, fam="degen")
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "1")
+    on = run_storm_server(jobs)
+    try:
+        on_metrics = {
+            j.id: explain_metric(on, j.id) for j in jobs
+        }
+        worker = on.workers[0]
+        assert worker.storm_solves == 1, "solver did not engage"
+        assert worker.storm_fallbacks == 0
+        assert worker.storm_divergent == 0
+        from nomad_tpu.explain import EXPLAIN
+
+        ev = on.store.evals_by_job("default", jobs[0].id)[0]
+        storm_tag = EXPLAIN.get(ev.id).get("Storm")
+        assert storm_tag is not None
+        assert storm_tag["Round"] == 0
+        assert storm_tag["DivergentRows"] == 0
+        monkeypatch.setenv("NOMAD_TPU_STORM", "0")
+        off = run_storm_server(jobs)
+        try:
+            off_metrics = {
+                j.id: explain_metric(off, j.id) for j in jobs
+            }
+            for job in jobs:
+                assert placements(on, job.id) == placements(
+                    off, job.id
+                )
+                assert eval_outcomes(on, job.id) == eval_outcomes(
+                    off, job.id
+                )
+                assert on_metrics[job.id] == off_metrics[job.id]
+        finally:
+            off.stop()
+    finally:
+        on.stop()
+
+
+def test_storm_ineligible_members_fall_back(monkeypatch):
+    """A family whose members the solver cannot model (spread jobs)
+    rides the same wave via the serial path: zero lost, everything
+    placed, fallbacks counted."""
+    from nomad_tpu.structs import Spread, SpreadTarget
+
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "4")
+    jobs = family_jobs(10, fam="mixfam")
+    for job in jobs[3:6]:
+        job.spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=50,
+                targets=(SpreadTarget(value="dc1", percent=100),),
+            )
+        ]
+    server = run_storm_server(jobs)
+    try:
+        worker = server.workers[0]
+        assert worker.storm_evals == 10
+        assert worker.storm_fallbacks >= 3
+        for job in jobs:
+            assert len(placements(server, job.id)) == 1
+        assert_zero_lost(server, jobs)
+    finally:
+        server.stop()
+
+
+def test_storm_solve_failure_loses_nothing(monkeypatch):
+    """The solver crashing mid-storm must degrade to the serial
+    chain for every member — zero lost evals, all placed."""
+    from nomad_tpu.server.batch_worker import BatchWorker
+
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "4")
+
+    def boom(self, problem, snap):
+        raise RuntimeError("injected solve failure")
+
+    monkeypatch.setattr(BatchWorker, "_storm_solve", boom)
+    jobs = family_jobs(10, fam="failfam")
+    server = run_storm_server(jobs)
+    try:
+        worker = server.workers[0]
+        assert worker.storm_evals == 10
+        assert worker.storm_solves == 0
+        assert worker.storm_fallbacks >= 10
+        for job in jobs:
+            assert len(placements(server, job.id)) == 1
+        assert_zero_lost(server, jobs)
+    finally:
+        server.stop()

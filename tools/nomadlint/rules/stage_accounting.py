@@ -190,6 +190,14 @@ class SpanRegistryRule(Rule):
         # separable from single-chip chunk time on every dashboard
         "batch_worker.mesh_launch",
         "batch_worker.mesh_fetch",
+        # the global storm solver's lifecycle: the coalesced drain,
+        # the single device solve, the per-eval decomposition, and
+        # every serial-chain fallback — the auditability half of the
+        # relaxed serial-equivalence contract
+        "batch_worker.storm_gulp",
+        "batch_worker.storm_solve",
+        "batch_worker.storm_decompose",
+        "batch_worker.storm_fallback",
     )
 
     def check(self, ctx: Context) -> List[Finding]:
@@ -750,6 +758,93 @@ class MeshMetricsRule(Rule):
             append=(
                 "def _nomadlint_bad_fixture(metrics):\n"
                 '    metrics.set_gauge("mesh.bogus_metric", 1.0)\n'
+            ),
+        )
+
+
+@register
+class StormMetricsRule(Rule):
+    """Global storm solver: every ``storm.*`` metric the batch worker
+    emits — literal first args of metric calls plus the
+    ``self._count_storm("<kind>")`` sites, which emit
+    ``storm.<kind>`` — is in the zero-registered ``STORM_COUNTERS`` /
+    ``STORM_GAUGES`` registries, and server.py zero-registers both at
+    construction: absence of a ``storm.*`` series must mean "no storm
+    ever coalesced", never "not exported"."""
+
+    name = "storm-metrics"
+    description = "storm.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("batch_worker")
+        tree = ctx.tree(path)
+        registry = astutil.assigned_strings(
+            tree, "STORM_COUNTERS"
+        ) | astutil.assigned_strings(tree, "STORM_GAUGES")
+        if not registry:
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "could not find the STORM_COUNTERS/STORM_GAUGES "
+                    "registries in batch_worker.py",
+                )
+            ]
+        emitted: Set[str] = set()
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            if (
+                node.func.attr in astutil.METRIC_CALLS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("storm.")
+            ):
+                emitted.add(node.args[0].value)
+            if (
+                node.func.attr == "_count_storm"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                emitted.add(f"storm.{node.args[0].value}")
+        problems: List[Finding] = []
+        unregistered = emitted - registry
+        if unregistered:
+            problems.append(
+                Finding(
+                    self.name, path, 0,
+                    "storm.* metrics emitted but not in the "
+                    "STORM_COUNTERS/STORM_GAUGES registries (they "
+                    "would be absent from prometheus scrapes until "
+                    "the first coalesced solve): "
+                    f"{sorted(unregistered)}",
+                )
+            )
+        server_path = ctx.path("server")
+        server_src = ctx.source(server_path)
+        for reg_name in ("STORM_COUNTERS", "STORM_GAUGES"):
+            if reg_name not in server_src:
+                problems.append(
+                    Finding(
+                        self.name, server_path, 0,
+                        "server.py no longer zero-registers the "
+                        f"storm.* family at construction ({reg_name} "
+                        "preregister)",
+                    )
+                )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            append=(
+                "def _nomadlint_bad_fixture(self):\n"
+                '    self._count_storm("bogus_kind")\n'
             ),
         )
 
