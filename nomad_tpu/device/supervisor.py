@@ -267,6 +267,10 @@ class DeviceSupervisor:
         self._probe_backend = plats[0] if plats else None
         self._cpu_device = None
         self._register_metrics()
+        # happens-before sanitizer (NOMAD_TPU_TSAN=1)
+        from ..tsan import maybe_instrument
+
+        maybe_instrument(self, "DeviceSupervisor")
 
     # -- construction helpers ------------------------------------------
 
@@ -745,6 +749,13 @@ class DeviceSupervisor:
         with self._lock:
             ordered = sorted(self._probe_ring)
             history = list(self._history)
+            return self._status_locked(ordered, history)
+
+    def _status_locked(self, ordered, history) -> Dict:
+        # the whole payload is read under self._lock (RLock): /v1/device
+        # polls race the probe thread's transitions, and a torn
+        # multi-field view (state from before a failover, epoch from
+        # after) would mislead exactly the operator debugging it
         return {
             "enabled": self.expected,
             "state": self._state,

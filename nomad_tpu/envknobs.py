@@ -87,6 +87,13 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "cap on storm auction rounds (0 = auto: the padded row "
         "bucket, the solver's convergence bound)",
     ),
+    "NOMAD_TPU_TSAN": EnvKnob(
+        "0", "nomad_tpu/tsan.py",
+        "1 turns on the happens-before sanitizer: shared-singleton "
+        "attribute accesses and lock ops are vector-clock logged, "
+        "and the tier-1 soak asserts conflicts stay inside the "
+        "static SHARED_STATE_ALLOWLIST",
+    ),
     "NOMAD_TPU_SYNC_COMPILE": EnvKnob(
         "0", "nomad_tpu/server/batch_worker.py",
         "1 makes cold kernel compiles block (deterministic tests) "

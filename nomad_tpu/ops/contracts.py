@@ -1,0 +1,275 @@
+"""Kernel shape-ladder contracts: the declared compiled-signature
+ladders of the production kernels, checked by ``jax.eval_shape``
+WITHOUT compiling anything.
+
+Silent recompiles are the accelerator failure mode the CPU tier-1
+suite structurally cannot see: a shape that misses its pow2 bucket,
+or a weak-type promotion that forks an extra signature, shows up only
+as a p99 latency cliff on the real backend (every novel signature is
+a multi-second XLA compile in the hot path).  Each contract here
+declares the EXACT ladder of input signatures a kernel is allowed to
+compile, and ``check_contracts`` statically asserts:
+
+1. **Ladder closure** — the declared ladder produces exactly
+   ``len(ladder)`` distinct input signatures (no accidental bucket
+   collapse, no per-size signature explosion);
+2. **Dtype closure** — ``eval_shape`` over every rung succeeds and
+   every output leaf's dtype stays inside the kernel's declared
+   closed set with ``weak_type=False`` (a weak-typed output chained
+   back in as an input would re-trace a second signature for the
+   same shapes).
+
+The ladders mirror the hot-path padding exactly: chunk widths are
+``batch_worker.CHUNK_BUCKETS`` (the nomadlint ``kernel-contract``
+rule cross-checks this file's ladder against that literal, so the
+two cannot drift), storm problems are pow2-bucketed by
+``sched/storm.build_storm_problem`` (E floor 4, A floor 8), and the
+mesh ladder expresses the node-axis widths as their shard-local
+column sizes (each mesh width partitions the same global C into a
+distinct per-shard signature).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Tuple
+
+import numpy as np
+
+# the device mirror's canonical dtype: production runs x64-off, so
+# the float64 host columns land on device as f32 (warm_shapes warms
+# with device columns for exactly this reason)
+F = np.float32
+I = np.int32
+B = np.bool_
+
+# chunk-kernel eval-axis ladder — MUST equal
+# batch_worker.CHUNK_BUCKETS (AST-cross-checked by nomadlint)
+CHUNK_LADDER: Tuple[int, ...] = (2, 4, 8)
+# storm (E, A) pow2 rungs exercised by the contract: the builder's
+# floors (E>=4, A>=8) upward through the common storm sizes
+STORM_LADDER: Tuple[Tuple[int, int], ...] = (
+    (4, 8),
+    (8, 16),
+    (16, 64),
+)
+# node-axis mesh widths: each width shards the same global arena
+# into C/width local columns — a distinct compiled signature per
+# width (parallel/mesh.sharded_chained_plan caches one runner per
+# (mesh, n_picks, ...) for the same reason)
+MESH_WIDTHS: Tuple[int, ...] = (1, 2, 4, 8)
+
+# representative fixed dims (any consistent values work: signatures
+# vary only along the declared ladder axis)
+_C = 64  # arena rows (global)
+_P = 16  # pick slots
+_T = 1  # task-group axis
+_K = 8  # MAX_PENALTY_NODES (batch_worker.py)
+
+
+class KernelContract(NamedTuple):
+    name: str
+    # () -> jitted kernel (lazy: jax imports stay off module import)
+    kernel: Callable
+    # ladder of (args, kwargs) spec tuples; array leaves are
+    # jax.ShapeDtypeStruct, statics are plain Python values
+    ladder: List[Tuple[tuple, dict]]
+    # allowed output dtypes (closed set)
+    out_dtypes: frozenset
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _cols(c: int) -> tuple:
+    return tuple(_sds((c,), F) for _ in range(6))
+
+
+def _chain_args(e: int, c: int) -> Tuple[tuple, dict]:
+    """One chunk-launch spec, mirroring warm_shapes' steady-state
+    variant (deltas + pre present, return_carry=True) — the exact
+    shape _launch_chunk dispatches."""
+    from .batch import ChainInputs, PreDeltas, StepDeltas
+
+    chain = ChainInputs(
+        feasible=_sds((e, _T, c), B),
+        perm=_sds((e, c), I),
+        ask_cpu=_sds((e, _P), F),
+        ask_mem=_sds((e, _P), F),
+        ask_disk=_sds((e, _P), F),
+        desired_count=_sds((e, _P), I),
+        limit=_sds((e, _P), I),
+        distinct_hosts=_sds((e,), B),
+        tg_idx=_sds((e, _P), I),
+    )
+    deltas = StepDeltas(
+        evict_rows=_sds((e, _P), I),
+        evict_cpu=_sds((e, _P), F),
+        evict_mem=_sds((e, _P), F),
+        evict_disk=_sds((e, _P), F),
+        evict_coll=_sds((e, _P), I),
+        penalty_rows=_sds((e, _P, _K), I),
+    )
+    pre = PreDeltas(
+        rows=_sds((e, 1), I),
+        cpu=_sds((e, 1), F),
+        mem=_sds((e, 1), F),
+        disk=_sds((e, 1), F),
+    )
+    args = _cols(c) + (chain, _sds((e,), I), _P)
+    kwargs = dict(
+        spread_fit=False,
+        wanted=_sds((e,), I),
+        deltas=deltas,
+        pre=pre,
+        return_carry=True,
+    )
+    return args, kwargs
+
+
+def _storm_args(e: int, a: int) -> Tuple[tuple, dict]:
+    from .solve import StormInputs
+
+    inp = StormInputs(
+        feasible=_sds((e, _C), B),
+        affinity=_sds((e, _C), F),
+        collisions=_sds((e, _C), I),
+        perm=_sds((e, _C), I),
+        limit=_sds((e,), I),
+        n_cand=_sds((e,), I),
+        eval_of=_sds((a,), I),
+        penalty=_sds((a, _C), B),
+        ask=_sds((a, 3), F),
+        desired=_sds((a,), I),
+        real=_sds((a,), B),
+        pre_cpu=_sds((_C,), F),
+        pre_mem=_sds((_C,), F),
+        pre_disk=_sds((_C,), F),
+    )
+    return (inp, _cols(_C)), dict(
+        spread_fit=False, max_rounds=a
+    )
+
+
+def _chunk_kernel():
+    from .batch import chained_plan_picks_cols
+
+    return chained_plan_picks_cols
+
+
+def _storm_kernel():
+    from .solve import storm_assignment
+
+    return storm_assignment
+
+
+def iter_contracts() -> List[KernelContract]:
+    """The production contracts: chunk, storm, mesh."""
+    chunk = KernelContract(
+        name="chunk",
+        kernel=_chunk_kernel,
+        ladder=[_chain_args(e, _C) for e in CHUNK_LADDER],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    storm = KernelContract(
+        name="storm",
+        kernel=_storm_kernel,
+        ladder=[_storm_args(e, a) for e, a in STORM_LADDER],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    # the mesh ladder: each node-axis width w runs the chained
+    # kernel over C/w shard-local columns — the per-width compiled
+    # signature the sharded runner cache keys on.  Expressed through
+    # the unsharded kernel so the contract needs no multi-device
+    # mesh to check (eval_shape of the shard body over local shapes
+    # IS the per-device signature).
+    mesh = KernelContract(
+        name="mesh",
+        kernel=_chunk_kernel,
+        ladder=[
+            _chain_args(CHUNK_LADDER[-1], _C // w)
+            for w in MESH_WIDTHS
+        ],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    return [chunk, storm, mesh]
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    """Canonical input signature: flattened (shape, dtype) leaves +
+    static values — what jit keys its executable cache on."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = [str(treedef)]
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            sig.append(("static", repr(leaf)))
+    return tuple(sig)
+
+
+def check_contracts(contracts=None) -> List[str]:
+    """Run every contract; returns human-readable violations (empty
+    = all green).  Uses ``eval_shape`` only — nothing compiles, so
+    the whole pass runs in milliseconds at lint/import time."""
+    import jax
+
+    violations: List[str] = []
+    for contract in (
+        contracts if contracts is not None else iter_contracts()
+    ):
+        kernel = contract.kernel()
+        sigs: Dict[tuple, int] = {}
+        for rung, (args, kwargs) in enumerate(contract.ladder):
+            sig = _signature(args, kwargs)
+            if sig in sigs:
+                violations.append(
+                    f"{contract.name}: ladder rung {rung} "
+                    f"collapses onto rung {sigs[sig]} — two "
+                    "declared shapes compile ONE signature, so "
+                    "the ladder overstates its coverage"
+                )
+                continue
+            sigs[sig] = rung
+            try:
+                eval_shape = getattr(
+                    kernel, "eval_shape", None
+                )
+                if eval_shape is not None:
+                    out = eval_shape(*args, **kwargs)
+                else:
+                    out = jax.eval_shape(
+                        kernel, *args, **kwargs
+                    )
+            except Exception as exc:  # noqa: BLE001
+                violations.append(
+                    f"{contract.name}: rung {rung} failed "
+                    f"eval_shape: {type(exc).__name__}: {exc}"
+                )
+                continue
+            for leaf in jax.tree_util.tree_leaves(out):
+                dt = str(getattr(leaf, "dtype", ""))
+                if dt not in contract.out_dtypes:
+                    violations.append(
+                        f"{contract.name}: rung {rung} output "
+                        f"dtype {dt} escapes the declared "
+                        f"closure {sorted(contract.out_dtypes)}"
+                        " — a promoted output chained back in "
+                        "forks a second compiled signature"
+                    )
+                if getattr(leaf, "weak_type", False):
+                    violations.append(
+                        f"{contract.name}: rung {rung} output "
+                        "is weak-typed — weak types silently "
+                        "re-trace when mixed with strong inputs"
+                    )
+        if len(sigs) != len(contract.ladder):
+            violations.append(
+                f"{contract.name}: {len(sigs)} distinct compiled "
+                f"signatures != declared ladder of "
+                f"{len(contract.ladder)}"
+            )
+    return violations

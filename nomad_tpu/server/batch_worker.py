@@ -943,6 +943,13 @@ class BatchWorker(Worker):
             "replay": 0.0,
             "sequential": 0.0,
         }
+        # happens-before sanitizer (NOMAD_TPU_TSAN=1): instruments
+        # as family "Worker" — the flowgraph collapses BatchWorker
+        # onto its root class, and the SHARED_STATE_ALLOWLIST keys
+        # by that family
+        from ..tsan import maybe_instrument
+
+        maybe_instrument(self, "Worker")
 
     def _make_mesh(self):
         """Node-axis device mesh when the hardware offers >1 device;
@@ -3794,6 +3801,7 @@ class BatchWorker(Worker):
         import jax
 
         with self._usage_cache_lock:
+            # nomadlint: disable=blocking-while-locked -- the mirror sync MUST serialize (two interleaved delta syncs corrupt generation tracking), so device_put runs under the lock by design; the wedge story is owned by the supervisor: a parked holder is abandoned, _on_device_transition REPLACES the lock (see lock-discipline ALLOWLIST) and stale-epoch publishes are discarded by the cache key
             return self._device_columns_locked(table, jax, sharded)
 
     def _device_columns_locked(
@@ -3932,7 +3940,7 @@ class BatchWorker(Worker):
                         vals = np.zeros(width, dtype=src.dtype)
                         vals[: len(idx)] = src[idx]
                         bytes_up += idx_p.nbytes + vals.nbytes
-                        # nomadlint: disable=donation-safety -- verified safe: cache["cols"] is replaced by the patched outputs below before any later read, and the except path drops the whole mirror so a partially-donated sync can never be re-read
+                        # nomadlint: disable=donation-safety -- re-verified for BOTH mirror variants (PR 8 audit): plain patch_rows_donated AND the sharded patch_rows_sharded(donate=True) donate a column of cache["cols"], which is replaced by the patched outputs below before any later read; donation is gated on the PER-MIRROR dirty flag + no background compiles, and the except path drops the whole mirror so a partially-donated sync can never be re-read
                         patched.append(patch(col, idx_p, vals))
                 except Exception:
                     # a partially-donated sync leaves already-deleted

@@ -112,6 +112,10 @@ class EvalBroker:
             "total_waiting": 0,
             "delivery_failures": 0,
         }
+        # happens-before sanitizer (NOMAD_TPU_TSAN=1)
+        from ..tsan import maybe_instrument
+
+        maybe_instrument(self, "EvalBroker")
 
     # ------------------------------------------------------------------
 
@@ -121,7 +125,7 @@ class EvalBroker:
         with self._lock:
             self._enabled = enabled
             if not enabled:
-                self.flush()
+                self._flush_locked()
             self._lock.notify_all()
             if enabled and self._ticker is None:
                 # the redelivery sweeper: expires unacked deliveries
@@ -170,6 +174,13 @@ class EvalBroker:
         return self._enabled
 
     def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        # callers already hold self._lock (re-entry would be legal —
+        # a bare Condition wraps an RLock — just pointless work);
+        # set_enabled flushes mid-critical-section through this
         self._ready.clear()
         self._unack.clear()
         self._job_evals.clear()

@@ -304,11 +304,18 @@ class Server:
         # reference's per-node timers are Go runtime timers, not
         # threads; the Python translation must not be thread-per-node)
         self._heartbeat_deadlines: Dict[str, float] = {}
+        # node id -> persistent client connection for log/fs
+        # proxying (populated from HTTP handler threads)
+        self._clients: Dict[str, object] = {}
         self._heartbeat_sweeper: Optional[threading.Thread] = None
         self._sweeper_lock = threading.Lock()
         self._running = False
         self._leader_established = False
         self._leader_lock = threading.Lock()
+        # happens-before sanitizer (NOMAD_TPU_TSAN=1)
+        from ..tsan import maybe_instrument
+
+        maybe_instrument(self, "Server")
 
     # -- lifecycle (reference leader.go:222 establishLeadership) -------
 
@@ -736,7 +743,7 @@ class Server:
         alloc = self.store.alloc_by_id(alloc_id)
         if alloc is None:
             raise KeyError(alloc_id)
-        client = getattr(self, "_clients", {}).get(alloc.node_id)
+        client = self._clients.get(alloc.node_id)
         if client is None:
             raise KeyError(f"no client connection for {alloc.node_id}")
         return client
@@ -1245,8 +1252,6 @@ class Server:
     # nomad/client_rpc.go persistent connections) -----------------------
 
     def register_client(self, node_id: str, client) -> None:
-        if not hasattr(self, "_clients"):
-            self._clients = {}
         self._clients[node_id] = client
 
     def read_task_log(
@@ -1257,7 +1262,7 @@ class Server:
         alloc = self.store.alloc_by_id(alloc_id)
         if alloc is None:
             raise KeyError(alloc_id)
-        client = getattr(self, "_clients", {}).get(alloc.node_id)
+        client = self._clients.get(alloc.node_id)
         if client is None:
             raise KeyError(f"no client connection for {alloc.node_id}")
         if hasattr(client, "read_task_log"):

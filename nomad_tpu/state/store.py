@@ -126,6 +126,11 @@ class StateStore:
         self._alloc_watchers: List[
             Callable[[List[Allocation]], None]
         ] = []
+        # happens-before sanitizer (NOMAD_TPU_TSAN=1): inert one env
+        # read otherwise
+        from ..tsan import maybe_instrument
+
+        maybe_instrument(self, "StateStore")
 
     # ------------------------------------------------------------------
     # index plumbing

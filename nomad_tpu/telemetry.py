@@ -108,6 +108,10 @@ class Metrics:
         self._counters: Dict[str, float] = defaultdict(float)
         self._gauges: Dict[str, float] = {}
         self._samples: Dict[str, _Summary] = defaultdict(_Summary)
+        # happens-before sanitizer (NOMAD_TPU_TSAN=1)
+        from .tsan import maybe_instrument
+
+        maybe_instrument(self, "Metrics")
 
     def incr(self, name: str, value: float = 1.0) -> None:
         with self._lock:

@@ -359,6 +359,10 @@ class Tracer:
         self._by_id: Dict[str, Trace] = {}
         self._gen = itertools.count()
         self.enabled = os.environ.get("NOMAD_TPU_TRACE", "1") != "0"
+        # happens-before sanitizer (NOMAD_TPU_TSAN=1)
+        from .tsan import maybe_instrument
+
+        maybe_instrument(self, "Tracer")
 
     def set_enabled(self, enabled: bool) -> None:
         self.enabled = bool(enabled)

@@ -1,0 +1,532 @@
+"""Happens-before sanitizer for the shared-state allowlist
+(``NOMAD_TPU_TSAN=1``).
+
+The static race detector (nomadlint ``shared-state-guard``) proves
+which shared attributes are consistently locked and forces a
+justified ``SHARED_STATE_ALLOWLIST`` entry for every deliberate
+exception (GIL-atomic counters, epoch-keyed cache rebinds).  This
+module keeps that allowlist honest from the RUNTIME direction: with
+``NOMAD_TPU_TSAN=1`` the shared singletons instrument their
+attribute accesses and lock operations into a vector-clock
+happens-before log, and the tier-1 soak (tests/test_tsan.py) asserts
+that every conflicting access pair observed while 64 evals storm the
+pipeline is either lock-ordered or inside the static allowlist.  A
+pair outside both is a bug one of the two analyses missed.
+
+Mechanics (FastTrack-shaped, full vector clocks for simplicity):
+
+* every thread carries a vector clock; lock release publishes the
+  holder's clock on the lock, acquire joins it — the classic
+  release/acquire edge;
+* ``threading.Thread.start/run/join``, ``threading.Event.set/wait``
+  and ``concurrent.futures.Future.result`` are patched (ONLY while
+  the knob is set) to add fork/join, publish/absorb and
+  task-completion edges — the handoffs the pipeline actually uses
+  (watchdog sacrificial threads signal through Events; the replay
+  pool hands results back through Futures);
+* ``maybe_instrument(obj, family)`` retypes the instance so
+  ``__getattribute__``/``__setattr__`` record instance-dict accesses
+  and wrap lock attributes (including locks REPLACED after init —
+  the supervisor-failover swap) in tracking proxies keyed by the
+  underlying primitive, so ``Condition(self._lock)`` aliases unify;
+* two accesses to one ``(family, attr)`` from different threads with
+  at least one write and no happens-before path are recorded as a
+  conflict (deduped per attribute).
+
+Everything is inert without the knob: ``maybe_instrument`` is one
+env read, no classes are retyped and no stdlib methods are patched.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_LOCK_TYPES = (
+    type(threading.Lock()),
+    type(threading.RLock()),
+)
+
+
+def enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_TSAN") == "1"
+
+
+# -- vector clocks -----------------------------------------------------
+
+
+def _join(a: Dict[int, int], b: Dict[int, int]) -> None:
+    for t, c in b.items():
+        if a.get(t, 0) < c:
+            a[t] = c
+
+
+class _Runtime:
+    """Process-wide happens-before state.  Internal lock is a leaf:
+    held only for table updates, never while calling out."""
+
+    def __init__(self) -> None:
+        # RLock: patched Event.set can re-enter (Thread bootstrap
+        # sets _started before registering in threading._active, and
+        # a current_thread() fallback would construct a _DummyThread
+        # whose __init__ sets ANOTHER event)
+        self._mu = threading.RLock()
+        self._clocks: Dict[int, Dict[int, int]] = {}
+        self._lock_clocks: Dict[int, Dict[int, int]] = {}
+        # pending fork edges: thread object id -> parent clock
+        self._forks: Dict[int, Dict[int, int]] = {}
+        # published clocks: event/future id -> clock
+        self._published: Dict[int, Dict[int, int]] = {}
+        # (family, obj id, attr) -> last write (tid, epoch) and
+        # reads {tid: epoch}; conflicts dedupe per (family, attr)
+        self._writes: Dict[
+            Tuple[str, int, str], Tuple[int, int]
+        ] = {}
+        self._reads: Dict[
+            Tuple[str, int, str], Dict[int, int]
+        ] = {}
+        self._conflicts: Dict[Tuple[str, str], Dict] = {}
+        self._names: Dict[int, str] = {}
+
+    # -- clock helpers (call with self._mu held) ----------------------
+
+    def _clock(self, tid: int) -> Dict[int, int]:
+        c = self._clocks.get(tid)
+        if c is None:
+            c = {tid: 1}
+            self._clocks[tid] = c
+            # NON-creating name lookup: current_thread() during
+            # thread bootstrap would construct a _DummyThread (and
+            # recursively fire the patched Event.set)
+            th = getattr(threading, "_active", {}).get(tid)
+            self._names[tid] = (
+                th.name if th is not None else f"thread-{tid}"
+            )
+        return c
+
+    def _tick(self, tid: int) -> None:
+        c = self._clock(tid)
+        c[tid] = c.get(tid, 0) + 1
+
+    # -- edges --------------------------------------------------------
+
+    def lock_acquired(self, key: int) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            _join(self._clock(tid), self._lock_clocks.get(key, {}))
+
+    def lock_released(self, key: int) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            self._lock_clocks[key] = dict(self._clock(tid))
+            self._tick(tid)
+
+    def fork(self, thread_obj_id: int) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            self._forks[thread_obj_id] = dict(self._clock(tid))
+            self._tick(tid)
+
+    def absorb_fork(self, thread_obj_id: int) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            parent = self._forks.pop(thread_obj_id, None)
+            if parent:
+                _join(self._clock(tid), parent)
+
+    def publish(self, key: int) -> None:
+        """Event.set / task completion: expose the publisher's
+        clock under ``key`` for a later absorb."""
+        tid = threading.get_ident()
+        with self._mu:
+            self._published[key] = dict(self._clock(tid))
+            self._tick(tid)
+
+    def absorb(self, key: int) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            pub = self._published.get(key)
+            if pub:
+                _join(self._clock(tid), pub)
+
+    def absorb_once(self, key: int) -> None:
+        """Absorb-and-forget for single-consumer edges (the pool
+        submit token): keeps ``_published`` from growing one entry
+        per submit for the process lifetime.  Events/futures keep
+        their entries — they legitimately have multiple waiters."""
+        tid = threading.get_ident()
+        with self._mu:
+            pub = self._published.pop(key, None)
+            if pub:
+                _join(self._clock(tid), pub)
+
+    def thread_finished(self, thread_obj_id: int) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            self._published[thread_obj_id] = dict(
+                self._clock(tid)
+            )
+
+    # -- accesses ------------------------------------------------------
+
+    def access(
+        self, family: str, obj_id: int, attr: str, kind: str
+    ) -> None:
+        tid = threading.get_ident()
+        # keyed per INSTANCE: two live objects of one family have
+        # disjoint state (and disjoint locks), so cross-instance
+        # accesses must never read as a race on one attribute.
+        # Conflicts still REPORT per (family, attr).
+        key = (family, obj_id, attr)
+        with self._mu:
+            clock = self._clock(tid)
+            my_epoch = clock.get(tid, 1)
+
+            def hb(other_tid: int, other_epoch: int) -> bool:
+                return clock.get(other_tid, 0) >= other_epoch
+
+            report_key = (family, attr)
+            w = self._writes.get(key)
+            if (
+                w is not None
+                and w[0] != tid
+                and not hb(*w)
+                and report_key not in self._conflicts
+            ):
+                self._conflicts[report_key] = {
+                    "family": family,
+                    "attr": attr,
+                    "kinds": f"w-{kind}",
+                    "tids": (w[0], tid),
+                }
+            if kind == "w":
+                for rtid, repoch in self._reads.get(
+                    key, {}
+                ).items():
+                    if (
+                        rtid != tid
+                        and not hb(rtid, repoch)
+                        and report_key not in self._conflicts
+                    ):
+                        self._conflicts[report_key] = {
+                            "family": family,
+                            "attr": attr,
+                            "kinds": "r-w",
+                            "tids": (rtid, tid),
+                        }
+                self._writes[key] = (tid, my_epoch)
+                self._reads.pop(key, None)
+            else:
+                self._reads.setdefault(key, {})[tid] = my_epoch
+
+    def conflicts(self) -> List[Dict]:
+        active = getattr(threading, "_active", {})
+
+        def name_of(t: int) -> str:
+            th = active.get(t)
+            if th is not None:
+                return th.name
+            return self._names.get(t, f"thread-{t}")
+
+        with self._mu:
+            out = []
+            for c in self._conflicts.values():
+                rec = dict(c)
+                rec["threads"] = tuple(
+                    name_of(t) for t in rec.pop("tids")
+                )
+                out.append(rec)
+            return sorted(
+                out, key=lambda c: (c["family"], c["attr"])
+            )
+
+    def reset_accesses(self) -> None:
+        with self._mu:
+            self._writes.clear()
+            self._reads.clear()
+            self._conflicts.clear()
+
+
+_runtime: Optional[_Runtime] = None
+_runtime_mu = threading.Lock()
+_patched = False
+
+
+def _rt() -> _Runtime:
+    global _runtime
+    with _runtime_mu:
+        if _runtime is None:
+            _runtime = _Runtime()
+        return _runtime
+
+
+def conflicts() -> List[Dict]:
+    """Conflicting access pairs observed so far (deduped per
+    attribute); empty when the sanitizer never ran."""
+    if _runtime is None:
+        return []
+    return _runtime.conflicts()
+
+
+def reset() -> None:
+    """Drop recorded accesses/conflicts (per-test isolation).  Clock
+    state survives — happens-before is a property of the process."""
+    if _runtime is not None:
+        _runtime.reset_accesses()
+
+
+# -- lock proxies ------------------------------------------------------
+
+
+class _TsanLock:
+    """Tracking proxy delegating to the real primitive.  HB edges key
+    on the UNDERLYING object's id, so a Condition wrapping the same
+    lock and the proxy itself publish to one clock."""
+
+    def __init__(self, real) -> None:
+        object.__setattr__(self, "_tsan_real", real)
+        object.__setattr__(self, "_tsan_key", id(real))
+
+    def acquire(self, *a, **k):
+        got = self._tsan_real.acquire(*a, **k)
+        if got and enabled():
+            _rt().lock_acquired(self._tsan_key)
+        return got
+
+    def release(self):
+        if enabled():
+            _rt().lock_released(self._tsan_key)
+        return self._tsan_real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(
+            object.__getattribute__(self, "_tsan_real"), name
+        )
+
+
+class _TsanCondition(_TsanLock):
+    """Condition proxy: wait() releases/re-acquires the underlying
+    lock — modelled as release -> absorb-on-wake -> acquire."""
+
+    def __init__(self, real) -> None:
+        object.__setattr__(self, "_tsan_real", real)
+        inner = getattr(real, "_lock", real)
+        object.__setattr__(self, "_tsan_key", id(inner))
+
+    def wait(self, timeout=None):
+        if not enabled():
+            return self._tsan_real.wait(timeout)
+        key = object.__getattribute__(self, "_tsan_key")
+        _rt().lock_released(key)
+        got = self._tsan_real.wait(timeout)
+        _rt().lock_acquired(key)
+        return got
+
+    def wait_for(self, predicate, timeout=None):
+        if not enabled():
+            return self._tsan_real.wait_for(predicate, timeout)
+        key = object.__getattribute__(self, "_tsan_key")
+        _rt().lock_released(key)
+        got = self._tsan_real.wait_for(predicate, timeout)
+        _rt().lock_acquired(key)
+        return got
+
+
+def _wrap_lock(value):
+    if isinstance(value, (_TsanLock, _TsanCondition)):
+        return value
+    if isinstance(value, threading.Condition):
+        return _TsanCondition(value)
+    if isinstance(value, _LOCK_TYPES):
+        return _TsanLock(value)
+    return value
+
+
+# -- stdlib handoff edges ---------------------------------------------
+
+
+def _ensure_patched() -> None:
+    """Patch the handoff primitives ONCE (only reached when the knob
+    is set).  Every wrapper re-checks ``enabled()`` and passes
+    straight through when the knob is off — so after a TSAN test
+    unsets the env var, the rest of the process (e.g. the remaining
+    tier-1 suite sharing this interpreter) pays one env read per
+    handoff, never clock bookkeeping, and the clock/publish tables
+    stop growing."""
+    global _patched
+    if _patched:
+        return
+    with _runtime_mu:
+        if _patched:
+            return
+        _orig_start = threading.Thread.start
+        _orig_run = threading.Thread.run
+        _orig_join = threading.Thread.join
+
+        def start(self):
+            if enabled():
+                _rt().fork(id(self))
+            return _orig_start(self)
+
+        def run(self):
+            if not enabled():
+                return _orig_run(self)
+            _rt().absorb_fork(id(self))
+            try:
+                return _orig_run(self)
+            finally:
+                _rt().thread_finished(id(self))
+
+        def join(self, timeout=None):
+            out = _orig_join(self, timeout)
+            if enabled() and not self.is_alive():
+                _rt().absorb(id(self))
+            return out
+
+        threading.Thread.start = start  # type: ignore[assignment]
+        threading.Thread.run = run  # type: ignore[assignment]
+        threading.Thread.join = join  # type: ignore[assignment]
+
+        _orig_set = threading.Event.set
+        _orig_wait = threading.Event.wait
+
+        def eset(self):
+            if enabled():
+                _rt().publish(id(self))
+            return _orig_set(self)
+
+        def ewait(self, timeout=None):
+            got = _orig_wait(self, timeout)
+            if got and enabled():
+                _rt().absorb(id(self))
+            return got
+
+        threading.Event.set = eset  # type: ignore[assignment]
+        threading.Event.wait = ewait  # type: ignore[assignment]
+
+        from concurrent.futures import Future
+
+        _orig_set_result = Future.set_result
+        _orig_set_exc = Future.set_exception
+        _orig_result = Future.result
+
+        def set_result(self, result):
+            if enabled():
+                _rt().publish(id(self))
+            return _orig_set_result(self, result)
+
+        def set_exception(self, exc):
+            if enabled():
+                _rt().publish(id(self))
+            return _orig_set_exc(self, exc)
+
+        def result(self, timeout=None):
+            out = _orig_result(self, timeout)
+            if enabled():
+                _rt().absorb(id(self))
+            return out
+
+        Future.set_result = set_result  # type: ignore[assignment]
+        Future.set_exception = set_exception  # type: ignore[assignment]
+        Future.result = result  # type: ignore[assignment]
+
+        # submit-side edge: work submitted to a pool thread sees
+        # everything the submitter wrote before submit()
+        from concurrent.futures import ThreadPoolExecutor
+
+        _orig_submit = ThreadPoolExecutor.submit
+
+        def submit(self, fn, *args, **kwargs):
+            if not enabled():
+                return _orig_submit(self, fn, *args, **kwargs)
+            token = object()
+            _rt().publish(id(token))
+
+            def wrapped(*a, **k):
+                # the closure pins `token`, so its id stays unique
+                _rt().absorb_once(id(token))
+                return fn(*a, **k)
+
+            return _orig_submit(self, wrapped, *args, **kwargs)
+
+        ThreadPoolExecutor.submit = submit  # type: ignore[assignment]
+        _patched = True
+
+
+# -- instance instrumentation -----------------------------------------
+
+_subclass_cache: Dict[Tuple[type, str], type] = {}
+
+
+def _instrumented_subclass(cls: type, family: str) -> type:
+    cached = _subclass_cache.get((cls, family))
+    if cached is not None:
+        return cached
+
+    def __getattribute__(self, name):
+        value = object.__getattribute__(self, name)
+        if name.startswith("_tsan") or name.startswith("__"):
+            return value
+        if isinstance(value, (_TsanLock, _TsanCondition)):
+            return value
+        # an instrumented instance can outlive the TSAN window (a
+        # singleton constructed while the knob was set): re-check,
+        # so clock bookkeeping stops the moment the knob clears
+        if not enabled():
+            return value
+        try:
+            d = object.__getattribute__(self, "__dict__")
+        except AttributeError:
+            return value
+        if name in d:
+            _rt().access(family, id(self), name, "r")
+        return value
+
+    def __setattr__(self, name, value):
+        if not name.startswith("_tsan") and enabled():
+            value = _wrap_lock(value)
+            _rt().access(family, id(self), name, "w")
+        object.__setattr__(self, name, value)
+
+    sub = type(
+        f"_Tsan{cls.__name__}",
+        (cls,),
+        {
+            "__getattribute__": __getattribute__,
+            "__setattr__": __setattr__,
+        },
+    )
+    _subclass_cache[(cls, family)] = sub
+    return sub
+
+
+def maybe_instrument(obj, family: str) -> None:
+    """Retype ``obj`` for access tracking when NOMAD_TPU_TSAN=1.
+    Call at the END of ``__init__`` — construction writes happen
+    before any thread can see the object, so they are not recorded,
+    and existing lock attributes are wrapped in one pass.  ``family``
+    names the attribute namespace and must match the flowgraph's
+    family key (subclasses collapse onto their root: BatchWorker
+    instruments as "Worker")."""
+    if not enabled():
+        return
+    _ensure_patched()
+    cls = type(obj)
+    try:
+        wrapped = {
+            k: _wrap_lock(v) for k, v in obj.__dict__.items()
+        }
+        obj.__dict__.update(wrapped)
+        obj.__class__ = _instrumented_subclass(cls, family)
+    except (TypeError, AttributeError):
+        # slotted classes cannot be retyped — skip silently, the
+        # static analysis still covers them
+        return

@@ -1,0 +1,412 @@
+"""Flowgraph core + whole-program concurrency rules (tier-1).
+
+Covers the cross-module analysis layer the shared-state-guard /
+blocking-while-locked / kernel-contract / concurrency-doc rules ride
+on: thread-entry discovery (including virtual dispatch, callback
+registration and lifecycle pseudo-entries), guaranteed-held lock
+dataflow on the synthetic two-thread fixture, entry conflict
+semantics, the astutil conditional-stage-key edge cases, the
+``--files`` narrowing contract for cross-file rules, the
+stale-suppression finding, and the ``--json`` finding schema
+downstream tooling consumes.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.nomadlint import Context, run  # noqa: E402
+from tools.nomadlint.flowgraph import (  # noqa: E402
+    Entry,
+    build_flowgraph,
+    entries_conflict,
+)
+
+FIXTURES = os.path.join(
+    REPO, "tools", "nomadlint", "fixtures"
+)
+
+
+def _ctx(**overrides):
+    return Context(REPO, overrides or None)
+
+
+def _fixture_ctx(sub, name):
+    return Context(
+        REPO,
+        {"scan_files": [os.path.join(FIXTURES, sub, name)]},
+    )
+
+
+# ---------------------------------------------------------------------------
+# flowgraph core on the synthetic two-thread fixture
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_entries_and_guards():
+    g = build_flowgraph(_fixture_ctx("shared_state", "bad.py"))
+    entry_methods = {e.method for e in g.entries}
+    assert "Thing._loop" in entry_methods
+    assert "Thing._poker" in entry_methods
+    # guarded: every access site holds the one lock
+    guarded = g.shared_access[("Thing", "guarded")]
+    assert guarded
+    assert all(s.guards for s in guarded)
+    common = set.intersection(*(set(s.guards) for s in guarded))
+    assert common
+    # racy: the loop thread's increment holds nothing
+    racy = g.shared_access[("Thing", "racy")]
+    assert any(not s.guards and s.kind == "w" for s in racy)
+
+
+def test_fixture_two_thread_entries_have_distinct_groups():
+    g = build_flowgraph(_fixture_ctx("shared_state", "bad.py"))
+    loop = next(e for e in g.entries if e.method == "Thing._loop")
+    poker = next(
+        e for e in g.entries if e.method == "Thing._poker"
+    )
+    assert loop.group != poker.group
+    assert entries_conflict(loop, poker)
+
+
+def test_entry_conflict_semantics():
+    a = Entry("thread:A.run", "A.run", "thread", "x.py:1",
+              None, group="x.py:1", multi=False)
+    b = Entry("thread:B.run", "B.run", "thread", "x.py:1",
+              None, group="x.py:1", multi=False)
+    c = Entry("http:H.do_GET", "H.do_GET", "http", "h.py:1",
+              None, group="http:H.do_GET", multi=True)
+    # virtual-dispatch siblings of one spawn never race on one self
+    assert not entries_conflict(a, b)
+    # an HTTP handler overlaps itself (ThreadingHTTPServer)
+    assert entries_conflict(c, c)
+    assert entries_conflict(a, c)
+
+
+def test_live_flowgraph_discovers_known_entries_and_locks():
+    g = build_flowgraph(_ctx())
+    methods = {e.method for e in g.entries}
+    # spawn discovery: worker thread, probe thread, broker sweeper,
+    # pool dispatch, nested compile closure, HTTP dispatch
+    assert "BatchWorker.run" in methods
+    assert "DeviceSupervisor._probe_loop" in methods
+    assert "EvalBroker._tick" in methods
+    assert "BatchWorker._speculate_one" in methods
+    assert (
+        "BatchWorker._launch_ready.<compile_in_background>"
+        in methods
+    )
+    assert "APIHandler.do_GET" in methods
+    # callback registration: the supervisor invokes these on its
+    # probe thread / the tripping worker thread
+    assert "BatchWorker._on_device_transition" in methods
+    assert "BatchWorker.warm_shapes" in methods
+    # lifecycle pseudo-entries (the operator thread)
+    assert "Server.stop" in methods
+    # lock table speaks the lock-discipline vocabulary
+    assert (
+        "batch_worker.py:BatchWorker._usage_cache_lock" in g.locks
+    )
+    assert "store.py:StateStore._lock" in g.locks
+    assert g.locks["store.py:StateStore._lock"]  # RLock
+
+
+def test_condition_canonicalizes_to_wrapped_lock():
+    g = build_flowgraph(_ctx())
+    # StateStore._watch_cond = threading.Condition(self._lock):
+    # holding the condition IS holding the lock — one key, not two
+    assert "store.py:StateStore._watch_cond" not in g.locks
+
+
+def test_guaranteed_held_intersection():
+    """A method called both with and without a lock held must not
+    count the lock as a guaranteed guard."""
+    fix = os.path.join(FIXTURES, "shared_state", "bad.py")
+    g = build_flowgraph(
+        Context(REPO, {"scan_files": [fix]})
+    )
+    # _poker reads racy with NO guard even though _loop's guarded
+    # access holds the lock — per-site facts stay separate
+    racy_sites = g.shared_access[("Thing", "racy")]
+    by_kind = {(s.kind, bool(s.guards)) for s in racy_sites}
+    assert ("w", False) in by_kind
+
+
+# ---------------------------------------------------------------------------
+# concurrency rules over the fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_shared_state_rule_names_both_sites_and_entries():
+    from tools.nomadlint.rules.concurrency import (
+        SharedStateGuardRule,
+    )
+
+    findings = SharedStateGuardRule().check(
+        _fixture_ctx("shared_state", "bad.py")
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "Thing.racy" in msg
+    assert "Thing._loop" in msg and "Thing._poker" in msg
+    assert "no common lock" in msg
+
+
+def test_blocking_rule_direct_transitive_and_event_wait():
+    from tools.nomadlint.rules.concurrency import (
+        BlockingWhileLockedRule,
+    )
+
+    findings = BlockingWhileLockedRule().check(
+        _fixture_ctx("blocking", "bad.py")
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.sleep()" in msgs
+    assert "device_get" in msgs  # two frames down
+    assert "_stop.wait()" in msgs  # Event wait under a lock
+    clean = BlockingWhileLockedRule().check(
+        _fixture_ctx("blocking", "clean.py")
+    )
+    assert clean == []  # Condition.wait under its own lock exempt
+
+
+def test_shared_state_allowlist_entries_all_live():
+    """Every SHARED_STATE_ALLOWLIST entry must match a live race
+    pair (the rule reports stale entries as findings on full
+    runs)."""
+    from tools.nomadlint.rules.concurrency import (
+        SharedStateGuardRule,
+    )
+
+    findings = SharedStateGuardRule().check(_ctx())
+    assert findings == [], [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# astutil conditional-stage-key edge cases
+# ---------------------------------------------------------------------------
+
+
+def _parse(src):
+    import ast
+
+    return ast.parse(src)
+
+
+def test_expr_strings_nested_ternary():
+    import ast
+
+    from tools.nomadlint.astutil import expr_strings
+
+    expr = ast.parse(
+        '"a" if x else ("b" if y else "c")', mode="eval"
+    ).body
+    assert expr_strings(expr) == {"a", "b", "c"}
+
+
+def test_literal_env_reassigned_across_branches():
+    from tools.nomadlint.astutil import literal_env
+
+    tree = _parse(
+        "if cond:\n"
+        '    stage = "mesh_launch"\n'
+        "else:\n"
+        '    stage = "launch" if warm else "fetch"\n'
+        'stage = "storm_solve"\n'
+    )
+    env = literal_env(tree)
+    # module-wide union: every branch's binding is a possible value
+    assert env["stage"] == {
+        "mesh_launch", "launch", "fetch", "storm_solve",
+    }
+
+
+def test_observed_keys_through_conditional_local():
+    from tools.nomadlint.astutil import observed_keys
+
+    tree = _parse(
+        "class W:\n"
+        "    def go(self, mesh):\n"
+        '        key = "mesh_launch" if mesh else "launch"\n'
+        "        self._observe(key, 1.0)\n"
+        '        self._observe("fetch" if mesh else "launch", 2.0)\n'
+    )
+    assert observed_keys(tree) == {
+        "mesh_launch", "launch", "fetch",
+    }
+
+
+def test_span_names_through_observe_chunk_conditional():
+    from tools.nomadlint.astutil import span_names_used
+
+    tree = _parse(
+        "class W:\n"
+        "    def go(self, mesh):\n"
+        '        stage = "mesh_launch" if mesh else "launch"\n'
+        "        self._observe_chunk(stage, 0, [])\n"
+    )
+    assert span_names_used(tree) == {
+        "batch_worker.mesh_launch", "batch_worker.launch",
+    }
+
+
+# ---------------------------------------------------------------------------
+# --files narrowing contract + stale suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_narrowed_run_still_runs_cross_file_rules_fully():
+    """config-drift's dead-registry direction (4) needs the full
+    usage scan: a --files run must not skip it (declared file
+    dependencies override narrowing)."""
+    result = run(
+        _ctx(
+            narrow_files=[
+                os.path.join(REPO, "nomad_tpu", "envknobs.py")
+            ]
+        ),
+        ["config-drift"],
+    )
+    assert result.ok  # full scan ran: no false dead-row findings
+
+
+def test_narrowed_run_restricts_per_file_rules():
+    import tempfile
+
+    bad = (
+        "import jax\n"
+        "def make():\n"
+        "    return jax.jit(lambda x: x, donate_argnums=(0,))\n"
+        "def use(a):\n"
+        "    f = make()\n"
+        "    out = f(a)\n"
+        "    return a + out\n"
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bad_donate.py")
+        with open(path, "w") as fh:
+            fh.write(bad)
+        result = run(
+            _ctx(narrow_files=[path]), ["donation-safety"]
+        )
+    assert not result.ok
+    assert result.findings[0].rule == "donation-safety"
+
+
+def test_stale_suppression_is_a_finding(tmp_path):
+    """A justified suppression that hides nothing is itself a
+    finding on a full-rule run (and the live tree has none)."""
+    stale = tmp_path / "stale.py"
+    stale.write_text(
+        "# nomadlint: disable=donation-safety -- justified once\n"
+        "x = 1\n"
+    )
+    result = run(_ctx(scan_files=[str(stale)]))
+    hits = [
+        f
+        for f in result.findings
+        if f.rule == "stale-suppression"
+        and f.path == str(stale)
+    ]
+    assert len(hits) == 1
+    assert hits[0].line == 1
+    # narrowed (--files) runs must NOT report stale suppressions:
+    # the rule that would have matched may not have seen its file
+    narrowed = run(
+        _ctx(
+            scan_files=[str(stale)],
+            narrow_files=[str(stale)],
+        )
+    )
+    assert not [
+        f
+        for f in narrowed.findings
+        if f.rule == "stale-suppression"
+    ]
+    # the live tree carries no stale suppressions
+    full = run(_ctx())
+    assert not [
+        f for f in full.findings if f.rule == "stale-suppression"
+    ]
+    assert full.ok
+
+
+# ---------------------------------------------------------------------------
+# --json schema (downstream tooling contract)
+# ---------------------------------------------------------------------------
+
+
+def test_json_finding_schema():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.nomadlint", "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    payload = json.loads(out.stdout)
+    assert set(payload) == {
+        "ok", "rules_run", "findings", "suppressed",
+    }
+    assert payload["ok"] is True
+    assert isinstance(payload["rules_run"], list)
+    assert all(isinstance(r, str) for r in payload["rules_run"])
+    assert len(payload["rules_run"]) >= 20
+    for entry in payload["findings"] + payload["suppressed"]:
+        assert set(entry) == {"rule", "path", "line", "message"}
+        assert isinstance(entry["rule"], str)
+        assert isinstance(entry["path"], str)
+        assert not os.path.isabs(entry["path"])  # repo-relative
+        assert isinstance(entry["line"], int)
+        assert isinstance(entry["message"], str)
+    # the three live suppressions ride along machine-readably
+    sup_rules = {e["rule"] for e in payload["suppressed"]}
+    assert "donation-safety" in sup_rules
+    assert "jit-purity" in sup_rules
+    assert "blocking-while-locked" in sup_rules
+
+
+def test_dump_flowgraph_cli():
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "tools.nomadlint",
+            "--dump-flowgraph",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert out.returncode == 0
+    assert "**Thread entries**" in out.stdout
+    assert "BatchWorker.run" in out.stdout
+    assert "**Locks**" in out.stdout
+    assert "_usage_cache_lock" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# kernel-contract specifics beyond the generic fixture round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_contract_ladder_drift_detected(tmp_path):
+    from tools.nomadlint.rules.kernel_contract import (
+        KernelContractRule,
+    )
+
+    rule = KernelContractRule()
+    ctx = rule._mutated(
+        _ctx(), str(tmp_path), "batch_worker",
+        old="CHUNK_BUCKETS = (2, 4, 8)",
+        new="CHUNK_BUCKETS = (2, 4)",
+    )
+    findings = rule.check(ctx)
+    assert any("drifted" in f.message for f in findings)
+
+
+def test_kernel_contract_live_ladders_green():
+    from nomad_tpu.ops.contracts import check_contracts
+
+    assert check_contracts() == []

@@ -1,0 +1,150 @@
+"""Happens-before sanitizer (NOMAD_TPU_TSAN=1) — the runtime half of
+the shared-state contract.
+
+The static race detector (nomadlint ``shared-state-guard``) forces a
+justified ``SHARED_STATE_ALLOWLIST`` entry for every deliberately
+unguarded cross-thread attribute.  These tests keep that list honest
+from the runtime direction:
+
+- a 64-eval storm soak through the REAL pipeline (broker drain ->
+  storm solve -> speculative replay -> commit) with the sanitizer on
+  must observe ZERO conflicting access pairs outside the static
+  allowlist — a pair outside both is a race one analysis missed;
+- the detector itself is proven non-vacuous on a toy raced object
+  (otherwise an instrumentation regression would green the soak by
+  simply observing nothing).
+"""
+from __future__ import annotations
+
+import threading
+
+from nomad_tpu import tsan
+
+
+def _allowed(conflict) -> bool:
+    # the RULE's own matcher, so the soak and the static detector
+    # can never drift on allowlist semantics
+    from tools.nomadlint.rules.concurrency import _allowlisted
+
+    return (
+        _allowlisted(conflict["family"], conflict["attr"]) >= 0
+    )
+
+
+def test_tsan_detects_unordered_access(monkeypatch):
+    """Sanity: the sanitizer must FLAG a genuinely raced attribute
+    and stay quiet about a consistently locked one — the soak's
+    zero-outside-allowlist assert is only meaningful if detection
+    works."""
+    monkeypatch.setenv("NOMAD_TPU_TSAN", "1")
+    tsan.reset()
+
+    class Toy:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.guarded = 0
+            self.racy = 0
+            tsan.maybe_instrument(self, "TsanToy")
+
+    toy = Toy()
+
+    def loop():
+        for _ in range(100):
+            with toy._lock:
+                toy.guarded += 1
+            toy.racy += 1
+
+    t = threading.Thread(target=loop, name="tsan-toy")
+    t.start()
+    for _ in range(100):
+        with toy._lock:
+            toy.guarded += 1
+        toy.racy += 1
+    t.join()
+
+    found = {
+        c["attr"]
+        for c in tsan.conflicts()
+        if c["family"] == "TsanToy"
+    }
+    assert "racy" in found
+    assert "guarded" not in found
+    tsan.reset()
+
+
+def test_tsan_lock_ordering_suppresses_conflicts(monkeypatch):
+    """Release/acquire edges order accesses: a value handed from one
+    thread to another THROUGH a lock never conflicts."""
+    monkeypatch.setenv("NOMAD_TPU_TSAN", "1")
+    tsan.reset()
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+            tsan.maybe_instrument(self, "TsanBox")
+
+    box = Box()
+
+    def writer():
+        for i in range(50):
+            with box._lock:
+                box.value = i
+
+    t = threading.Thread(target=writer, name="tsan-box")
+    t.start()
+    for _ in range(50):
+        with box._lock:
+            _ = box.value
+    t.join()
+    assert [
+        c for c in tsan.conflicts() if c["family"] == "TsanBox"
+    ] == []
+    tsan.reset()
+
+
+def test_tsan_storm_soak_conflicts_within_allowlist(monkeypatch):
+    """64-eval storm soak with the sanitizer on: the full pipeline
+    (atomic family drain, device solve, speculative replay pool,
+    incremental wave commit, broker sweeper, plan applier) runs
+    instrumented, and every conflicting access pair observed at
+    runtime must be lock-ordered or inside the STATIC allowlist."""
+    from test_storm import (
+        assert_zero_lost,
+        family_jobs,
+        placements,
+        run_storm_server,
+    )
+
+    monkeypatch.setenv("NOMAD_TPU_TSAN", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "8")
+    tsan.reset()
+    jobs = family_jobs(64, fam="tsanfam")
+    server = run_storm_server(jobs, timeout=240)
+    try:
+        worker = server.workers[0]
+        # the soak must exercise the real machinery, not idle past it
+        assert worker.storm_solves >= 1
+        for job in jobs:
+            assert len(placements(server, job.id)) == 1
+        assert_zero_lost(server, jobs)
+    finally:
+        server.stop()
+
+    observed = tsan.conflicts()
+    assert observed, (
+        "the sanitizer observed NO conflicting pairs at all — the "
+        "allowlisted GIL-atomic paths (StateStore lock-free reads, "
+        "epoch-keyed cache flushes) run on every soak, so an empty "
+        "log means instrumentation regressed, not that the code "
+        "got race-free"
+    )
+    outside = [c for c in observed if not _allowed(c)]
+    assert outside == [], (
+        "runtime-observed conflicting access pairs OUTSIDE the "
+        f"static SHARED_STATE_ALLOWLIST: {outside} — either a lock "
+        "is missing or the static analysis needs a justified "
+        "allowlist entry"
+    )
+    tsan.reset()

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# One-shot CI gate: static analysis + analysis self-test + a fast
+# tier-1 smoke subset.  Everything here must stay green on every
+# commit; the full tier-1 suite (ROADMAP.md) remains the merge gate.
+#
+#   tools/ci_check.sh            # run everything
+#   SMOKE=0 tools/ci_check.sh    # lint + selfcheck only
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== nomadlint: repo-wide run (22 rules, zero findings) =="
+python -m tools.nomadlint
+
+echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
+python -m tools.nomadlint --selfcheck
+
+if [ "${SMOKE:-1}" = "1" ]; then
+    echo "== tier-1 smoke subset =="
+    # the analysis layer's own tests + the TSAN soak + one
+    # pipeline-parity file: fast (<2 min), catches wiring breaks;
+    # NOT a substitute for the full tier-1 run
+    JAX_PLATFORMS=cpu python -m pytest -q \
+        -p no:cacheprovider -m 'not slow' \
+        tests/test_nomadlint.py \
+        tests/test_flowgraph.py \
+        tests/test_tsan.py \
+        tests/test_stage_accounting.py
+fi
+
+echo "ci_check: all green"

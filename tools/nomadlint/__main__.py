@@ -86,6 +86,21 @@ def main(argv: List[str]) -> int:
         "--selfcheck", action="store_true",
         help="verify every rule trips its bad fixture",
     )
+    parser.add_argument(
+        "--dump-flowgraph", action="store_true",
+        help=(
+            "print the whole-program concurrency view (thread "
+            "entries, lock table, shared attributes + guards)"
+        ),
+    )
+    parser.add_argument(
+        "--write-doc", action="store_true",
+        help=(
+            "regenerate the docs/ARCHITECTURE.md Concurrency-model "
+            "section from the flowgraph (concurrency-doc rule "
+            "enforces freshness)"
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -93,9 +108,53 @@ def main(argv: List[str]) -> int:
             print(f"{cls.name:24s} {cls.description}")
         return 0
 
+    if args.dump_flowgraph or args.write_doc:
+        from .flowgraph import flowgraph, render_dump
+
+        ctx = Context(args.repo)
+        dump = render_dump(flowgraph(ctx), ctx.repo)
+        if args.dump_flowgraph:
+            print(dump)
+        if args.write_doc:
+            from .rules.concurrency_doc import (
+                MARK_BEGIN,
+                MARK_END,
+            )
+
+            doc_path = ctx.path("arch_doc")
+            with open(doc_path) as fh:
+                doc = fh.read()
+            if MARK_BEGIN not in doc or MARK_END not in doc:
+                print(
+                    "docs/ARCHITECTURE.md has no flowgraph "
+                    f"markers ({MARK_BEGIN!r}); add a Concurrency "
+                    "model section with begin/end markers first",
+                    file=sys.stderr,
+                )
+                return 2
+            head, rest = doc.split(MARK_BEGIN, 1)
+            _stale, tail = rest.split(MARK_END, 1)
+            with open(doc_path, "w") as fh:
+                fh.write(
+                    head
+                    + MARK_BEGIN
+                    + "\n\n"
+                    + dump.strip()
+                    + "\n\n"
+                    + MARK_END
+                    + tail
+                )
+            print(f"wrote flowgraph section to {doc_path}")
+        return 0
+
     overrides = {}
     if args.files:
-        overrides["scan_files"] = [
+        # CLI narrowing is "narrow_files", NOT the fixtures'
+        # "scan_files": cross-file rules (config-drift, the
+        # flowgraph rules) declare file dependencies spanning the
+        # repo and always run against the full set — a narrowed run
+        # must not false-pass by hiding one side of a pair
+        overrides["narrow_files"] = [
             os.path.abspath(f) for f in args.files
         ]
     ctx = Context(args.repo, overrides)

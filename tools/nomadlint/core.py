@@ -33,7 +33,14 @@ from typing import Dict, List, Optional, Sequence, Tuple, Type
 DEFAULT_PATHS: Dict[str, str] = {
     "batch_worker": "nomad_tpu/server/batch_worker.py",
     "plan_apply": "nomad_tpu/server/plan_apply.py",
+    "worker": "nomad_tpu/server/worker.py",
+    "eval_broker": "nomad_tpu/server/eval_broker.py",
+    "api_http": "nomad_tpu/api/http.py",
+    "ops_batch": "nomad_tpu/ops/batch.py",
+    "ops_solve": "nomad_tpu/ops/solve.py",
+    "ops_contracts": "nomad_tpu/ops/contracts.py",
     "trace": "nomad_tpu/trace.py",
+    "telemetry": "nomad_tpu/telemetry.py",
     "bench": "bench.py",
     "device_dir": "nomad_tpu/device",
     "device_supervisor": "nomad_tpu/device/supervisor.py",
@@ -105,11 +112,19 @@ class Context:
 
     def scan_files(self, default_key: str = "package") -> List[str]:
         """Python files a repo-wide rule should scan.  A
-        ``scan_files`` override (fixture runs) replaces the walk;
-        otherwise the ``default_key`` tree is walked with single-file
+        ``scan_files`` override (fixture runs) replaces the walk; a
+        ``narrow_files`` override (the CLI's ``--files``) restricts
+        per-file rules the same way — but cross-file rules
+        (``Rule.cross_file``) never see it: the runner hands them a
+        de-narrowed context, because a rule that needs both sides of
+        a pair (config registry + docs table, a race pair's two
+        access sites) would silently false-pass on half its inputs.
+        Otherwise the ``default_key`` tree is walked with single-file
         overrides substituted (so a rule pointed at a mutated
         batch_worker copy sees the copy, not the original)."""
         override = self.overrides.get("scan_files")
+        if override is None:
+            override = self.overrides.get("narrow_files")
         if override is not None:
             return list(override)
         subst = {
@@ -156,10 +171,17 @@ class Rule:
     """Base class.  Subclasses set ``name``/``description`` and
     implement ``check``; ``bad_fixture`` returns a Context on which
     the rule MUST report at least one finding (the self-test the
-    runner's ``--selfcheck`` and tests/test_nomadlint.py exercise)."""
+    runner's ``--selfcheck`` and tests/test_nomadlint.py exercise).
+
+    ``cross_file = True`` declares that the rule's inputs span files
+    (both sides of a registry/doc pair, a race pair's two access
+    sites): the runner then ignores CLI ``--files`` narrowing for
+    this rule and hands it the full file set, so a narrowed run can
+    never false-pass by hiding one side."""
 
     name: str = ""
     description: str = ""
+    cross_file: bool = False
 
     def check(self, ctx: Context) -> List[Finding]:
         raise NotImplementedError
@@ -279,9 +301,22 @@ def run(
                 f"unknown rule(s): {sorted(unknown)}"
             )
         classes = [c for c in classes if c.name in wanted]
+    # cross-file rules ignore CLI --files narrowing: they need both
+    # sides of their pairs, so they run against the full file set
+    full_ctx = ctx
+    if "narrow_files" in ctx.overrides and any(
+        c.cross_file for c in classes
+    ):
+        merged = {
+            k: v
+            for k, v in ctx.overrides.items()
+            if k != "narrow_files"
+        }
+        full_ctx = Context(ctx.repo, merged)
     findings: List[Finding] = []
     for cls in classes:
-        findings.extend(cls().check(ctx))
+        rule_ctx = full_ctx if cls.cross_file else ctx
+        findings.extend(cls().check(rule_ctx))
 
     kept: List[Finding] = []
     suppressed: List[Finding] = []
@@ -334,6 +369,37 @@ def run(
                         ),
                     )
                 )
+    # a justified suppression that no longer hides anything is dead
+    # weight with teeth: the next finding that lands on its line is
+    # silently swallowed.  Only a FULL run can tell (a --rules or
+    # --files narrowing legitimately skips the rule that would have
+    # matched), and suppressions naming unregistered rules are left
+    # to the bare/typo case above.
+    if rule_names is None and "narrow_files" not in ctx.overrides:
+        registered = {c.name for c in classes}
+        for path, sups in cache.items():
+            for s in sups:
+                if (
+                    s.reason
+                    and not s.used
+                    and "all" not in s.rules
+                    and set(s.rules) <= registered
+                ):
+                    kept.append(
+                        Finding(
+                            rule="stale-suppression",
+                            path=path,
+                            line=s.line,
+                            message=(
+                                "suppression for "
+                                f"{','.join(s.rules)} hides no "
+                                "finding anymore — the code it "
+                                "justified changed; remove it so "
+                                "it can't swallow the next "
+                                "finding on this line"
+                            ),
+                        )
+                    )
     kept.sort(key=lambda f: (f.rule, f.path, f.line))
     return RunResult(
         findings=kept,

@@ -1,0 +1,32 @@
+"""blocking-while-locked bad fixture: a direct ``time.sleep`` under
+a lock, and a transitive one — a lock-holding call reaching a
+blocking device fetch two frames down."""
+import threading
+import time
+
+import jax
+
+
+class Thing:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.value = None
+
+    def direct(self) -> None:
+        with self._lock:
+            time.sleep(0.5)
+
+    def transitive(self) -> None:
+        with self._lock:
+            self._refresh()
+
+    def _refresh(self) -> None:
+        self._fetch_cols()
+
+    def _fetch_cols(self) -> None:
+        self.value = jax.device_get(self.value)
+
+    def event_wait(self) -> None:
+        with self._lock:
+            self._stop.wait(1.0)

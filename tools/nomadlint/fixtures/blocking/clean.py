@@ -1,0 +1,25 @@
+"""blocking-while-locked clean fixture: blocking ops run outside the
+critical section, and a Condition waits under its own lock (which it
+releases — the idiom, not a wedge)."""
+import threading
+import time
+
+import jax
+
+
+class Thing:
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self.value = None
+
+    def poll(self) -> None:
+        with self._lock:
+            self._lock.wait(0.1)
+        time.sleep(0.01)
+
+    def refresh(self) -> None:
+        with self._lock:
+            stale = self.value
+        fresh = jax.device_get(stale)
+        with self._lock:
+            self.value = fresh

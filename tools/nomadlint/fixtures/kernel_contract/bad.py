@@ -1,0 +1,26 @@
+"""kernel-contract bad fixture: a ladder whose two rungs collapse
+onto ONE compiled signature, and whose output dtype escapes the
+declared closure."""
+import jax
+import numpy as np
+
+from nomad_tpu.ops.contracts import KernelContract
+
+
+def _kernel():
+    return jax.jit(lambda x: x * 2.0)
+
+
+def iter_contracts():
+    sds = jax.ShapeDtypeStruct
+    rung = ((sds((8,), np.float32),), {})
+    return [
+        KernelContract(
+            name="drifty",
+            kernel=_kernel,
+            # duplicate rungs: declared ladder of 2, ONE signature
+            ladder=[rung, rung],
+            # kernel outputs float32 — escapes this closure
+            out_dtypes=frozenset({"int32"}),
+        )
+    ]

@@ -1,0 +1,24 @@
+"""kernel-contract clean fixture: distinct rungs, closed dtypes."""
+import jax
+import numpy as np
+
+from nomad_tpu.ops.contracts import KernelContract
+
+
+def _kernel():
+    return jax.jit(lambda x: x * np.float32(2.0))
+
+
+def iter_contracts():
+    sds = jax.ShapeDtypeStruct
+    return [
+        KernelContract(
+            name="steady",
+            kernel=_kernel,
+            ladder=[
+                ((sds((4,), np.float32),), {}),
+                ((sds((8,), np.float32),), {}),
+            ],
+            out_dtypes=frozenset({"float32"}),
+        )
+    ]

@@ -1,0 +1,34 @@
+"""shared-state-guard bad fixture: a two-thread object with one
+properly guarded attribute and one raced one — the rule must flag
+``racy`` (written from the loop thread, read from the poke thread,
+no common lock) and stay quiet about ``guarded``."""
+import threading
+
+
+class Thing:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.guarded = 0
+        self.racy = 0
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self._loop, name="loop", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._poker, name="poker", daemon=True
+        ).start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                self.guarded += 1
+            self.racy += 1
+
+    def _poker(self) -> None:
+        while True:
+            with self._lock:
+                if self.guarded > 10:
+                    self.guarded = 0
+            if self.racy > 10:
+                self.racy = 0

@@ -1,0 +1,31 @@
+"""shared-state-guard clean fixture: every cross-thread attribute
+shares one lock across all access sites."""
+import threading
+
+
+class Thing:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.guarded = 0
+        self.other = 0
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self._loop, name="loop", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._poker, name="poker", daemon=True
+        ).start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                self.guarded += 1
+                self.other = self.guarded * 2
+
+    def _poker(self) -> None:
+        while True:
+            with self._lock:
+                if self.guarded > 10:
+                    self.guarded = 0
+                    self.other = 0

@@ -1,0 +1,1201 @@
+"""Cross-module flow core: thread entries, reachable call graphs and
+shared-attribute access sets for the concurrency rules.
+
+The batch pipeline is deeply multi-threaded (worker thread, replay
+pool, admission, supervisor probe + watchdog sacrificial threads,
+background compile threads, broker sweeper, heartbeat sweeper, HTTP
+handler threads) and the GIL hides nearly every interleaving from the
+CPU tier-1 suite.  This module computes, once per lint run, the facts
+the whole-program rules consume:
+
+* **Thread entries** — every function a new thread can start in:
+  ``threading.Thread(target=...)`` construction (including nested-def
+  targets like the background compile closure), ``*.submit(fn, ...)``
+  pool dispatch (``EvaluatePool.submit``), and HTTP handler dispatch
+  (``do_*`` methods of ``BaseHTTPRequestHandler`` subclasses — each
+  request runs on its own ``ThreadingHTTPServer`` thread).  Spawning
+  ``self.run`` dispatches virtually: every scanned subclass override
+  is an entry too (``Worker.start`` starts ``BatchWorker.run``).
+* **Per-entry call graphs** — reachability from each entry over a
+  module-set-wide call graph: ``self.m()`` resolves through the class
+  and its scanned bases, bare names through nested defs then module
+  functions, and ``obj.m()`` through a globally unique method name
+  (the same over-approximation the lock-discipline rule uses).
+* **Attribute access sets** — for the shared singletons (``Server``,
+  ``BatchWorker``/``Worker``, ``StateStore``, ``EvalBroker``,
+  ``DeviceSupervisor``, ``Tracer``/``TRACE``, ``Metrics``): every
+  ``self.<attr>`` read/write with the set of locks *guaranteed held*
+  at the access — the lexically held locks plus the intersection of
+  locks held on every call path from the entry (a guard that only
+  SOME paths hold is not a guard).
+
+Lock identity matches the lock-discipline rule's
+``<basename>:<Class>.<attr>`` keys so findings from both rules speak
+the same vocabulary.  ``threading.Condition(self._x)`` canonicalizes
+to the wrapped lock's key (holding the condition IS holding the
+lock); a bare ``threading.Condition()`` is its own lock.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .core import Context
+
+# path keys (core.DEFAULT_PATHS) making up the flowgraph module set
+FLOW_FILE_KEYS = (
+    "batch_worker",
+    "plan_apply",
+    "server",
+    "worker",
+    "eval_broker",
+    "api_http",
+    "trace",
+    "telemetry",
+)
+FLOW_DIR_KEYS = ("state_dir", "device_dir")
+
+# the shared singletons whose attributes the race detector guards.
+# Subclass families collapse onto their root (BatchWorker extends
+# Worker: one object at runtime, one attribute namespace here).
+SHARED_CLASSES = frozenset(
+    {
+        "Server",
+        "Worker",
+        "BatchWorker",
+        "StateStore",
+        "EvalBroker",
+        "DeviceSupervisor",
+        "Tracer",
+        "Metrics",
+    }
+)
+
+# names too generic to resolve by global uniqueness: obj.flush() on a
+# file object must not resolve to EvalBroker.flush just because no
+# other SCANNED class defines one.  Self-calls resolve through the
+# class and are unaffected; for foreign-object calls these produce no
+# edge (under-approximation on the side of precision — the TSAN
+# runtime cross-check covers what static reachability misses).
+GENERIC_NAMES = frozenset(
+    {
+        "flush", "get", "put", "pop", "push", "update", "items",
+        "keys", "values", "copy", "close", "read", "write", "send",
+        "recv", "clear", "append", "add", "remove", "discard",
+        "wait", "notify", "notify_all", "acquire", "release",
+        "join", "open", "result", "done", "set", "is_set", "start",
+        "stop", "run", "submit", "count", "index", "sort", "next",
+        "encode", "decode", "strip", "split", "format", "render",
+        "name", "status", "snapshot", "describe", "list",
+    }
+)
+
+# registration calls whose callable arguments later run on ANOTHER
+# thread (the supervisor invokes transition listeners on its probe
+# thread AND on whichever worker thread tripped a watchdog; warm
+# hooks run on the probe thread during recovery validation).  Each
+# registered callable becomes its own entry.
+CALLBACK_REGISTRARS = frozenset(
+    {"subscribe", "add_warm_hook", "add_done_callback"}
+)
+
+# lifecycle methods run on the OPERATOR (main/test) thread — a real
+# concurrent participant the spawn scan can't see (nothing spawns
+# the main thread).  They share ONE entry group: a single operator
+# thread drives start/stop/leadership, so they never race each
+# other, but they DO race every spawned thread (stop() flipping
+# _running under a live sweeper is exactly the TSAN-observed pair
+# that motivated this).
+LIFECYCLE_ROOTS = (
+    "start",
+    "stop",
+    "establish_leadership",
+    "revoke_leadership",
+)
+
+# method calls on self.<attr> that mutate the container in place —
+# counted as WRITES to the attribute for race purposes
+MUTATING_ATTRS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "extend",
+        "insert",
+        "add",
+        "discard",
+        "remove",
+        "pop",
+        "popleft",
+        "popitem",
+        "clear",
+        "update",
+        "setdefault",
+        "put",
+        "acquire",
+        "release",
+    }
+)
+
+
+@dataclass(frozen=True)
+class Access:
+    """One ``self.<attr>`` touch inside a method body."""
+
+    attr: str
+    kind: str  # "r" | "w"
+    line: int
+    held: FrozenSet[str]  # lock keys lexically held at the site
+
+
+@dataclass(frozen=True)
+class CallSite:
+    name: str  # bare callee name (attr or function name)
+    on_self: bool  # self.name(...) — resolve through the class
+    line: int
+    held: FrozenSet[str]
+    dotted: Optional[str] = None  # full a.b.c chain when resolvable
+    recv_attr: Optional[str] = None  # X of self.X.name(...)
+
+
+@dataclass(frozen=True)
+class SpawnSite:
+    """A thread-entry creation: Thread(target=...) or pool submit."""
+
+    target: str  # bare target name
+    on_self: bool
+    kind: str  # "thread" | "pool"
+    line: int
+    label: Optional[str]  # Thread name= constant when present
+
+
+@dataclass
+class MethodInfo:
+    qualname: str  # "Class.method" / "module:func" / "outer.<nested>"
+    cls: Optional[str]
+    name: str
+    path: str
+    lineno: int
+    accesses: List[Access] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    # names of nested defs declared in this body (for resolution)
+    nested: Dict[str, str] = field(default_factory=dict)
+    # local name -> (method name, via_self) for ``x = self._m`` and
+    # ``x = getattr(obj, "m", ...)`` aliases (spawn-target support)
+    local_refs: Dict[str, Tuple[str, bool]] = field(
+        default_factory=dict
+    )
+
+
+@dataclass(frozen=True)
+class Entry:
+    """A thread entrypoint: the function a fresh thread starts in.
+
+    ``group`` models instance-concurrency: virtual-dispatch siblings
+    of ONE spawn site (``Worker.start`` starting ``self.run`` covers
+    ``Worker.run`` and ``BatchWorker.run``) share a group — a given
+    instance runs exactly one of them, so same-group entries never
+    race against each other on ``self``.  ``multi`` marks entries
+    that can run CONCURRENTLY WITH THEMSELVES against one shared
+    object (HTTP handlers on a ThreadingHTTPServer, pool submits):
+    those conflict with their own group too."""
+
+    key: str  # unique id, e.g. "thread:BatchWorker.run"
+    method: str  # qualname of the entry method
+    kind: str  # "thread" | "pool" | "http"
+    spawned_at: str  # "path:line" of the spawning site
+    label: Optional[str]  # thread name when statically known
+    group: str = ""  # spawn-site identity (virtual siblings share)
+    multi: bool = False  # may self-overlap on one shared object
+
+    def render(self) -> str:
+        tag = f" [{self.label}]" if self.label else ""
+        return f"{self.method} ({self.kind}{tag})"
+
+
+def entries_conflict(a: Entry, b: Entry) -> bool:
+    """Whether two entries can touch ONE object concurrently: any
+    two distinct spawn groups can; a group overlaps itself only when
+    the entry is ``multi`` (HTTP / pool fan-out)."""
+    if a.group != b.group:
+        return True
+    return a.multi or b.multi
+
+
+@dataclass(frozen=True)
+class AttrSite:
+    """One access to a shared attribute, entry-qualified."""
+
+    entry: Entry
+    method: str
+    path: str
+    line: int
+    kind: str  # "r" | "w"
+    guards: FrozenSet[str]  # locks guaranteed held at the access
+
+
+class FlowGraph:
+    """The computed whole-program view.  Build with
+    :func:`build_flowgraph`; rules consume the tables below.
+
+    * ``entries`` — every discovered thread entry
+    * ``locks`` — lock key -> reentrant? (Condition keys collapsed)
+    * ``shared_access`` — (family, attr) -> [AttrSite, ...]
+    * ``methods`` — qualname -> MethodInfo
+    """
+
+    def __init__(self) -> None:
+        self.entries: List[Entry] = []
+        self.locks: Dict[str, bool] = {}
+        self.methods: Dict[str, MethodInfo] = {}
+        self.shared_access: Dict[
+            Tuple[str, str], List[AttrSite]
+        ] = {}
+        # family -> class names collapsed into it
+        self.families: Dict[str, List[str]] = {}
+        # per-entry reachable method qualnames (incl. entry itself)
+        self.reachable: Dict[str, Set[str]] = {}
+        # per-entry, per-method locks guaranteed held ON ENTRY to the
+        # method (intersection over all call paths from the entry)
+        self.held_in: Dict[str, Dict[str, FrozenSet[str]]] = {}
+        # blocking-op closure: qualname -> {op: witness-path} of
+        # blocking calls reachable from the method (transitive);
+        # the witness names the call chain for findings
+        self.blocking: Dict[str, Dict[str, str]] = {}
+
+
+# -- class table -------------------------------------------------------
+
+
+def _flow_files(ctx: Context) -> List[str]:
+    override = ctx.overrides.get("scan_files")
+    if override is not None:
+        return list(override)
+    files = [ctx.path(k) for k in FLOW_FILE_KEYS]
+    for dir_key in FLOW_DIR_KEYS:
+        root = ctx.path(dir_key)
+        files.extend(
+            os.path.join(root, fn)
+            for fn in sorted(os.listdir(root))
+            if fn.endswith(".py") and fn != "__init__.py"
+        )
+    return [f for f in files if os.path.exists(f)]
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Dict[str, Tuple[bool, Optional[str]]]:
+    """lock attr -> (reentrant?, wrapped_attr).  ``wrapped_attr`` is
+    set for ``threading.Condition(self._x)`` — acquiring the condition
+    acquires ``self._x``."""
+    out: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("Lock", "RLock", "Condition")
+        ):
+            continue
+        wrapped: Optional[str] = None
+        if call.func.attr == "Condition" and call.args:
+            first = call.args[0]
+            if (
+                isinstance(first, ast.Attribute)
+                and isinstance(first.value, ast.Name)
+                and first.value.id == "self"
+            ):
+                wrapped = first.attr
+        reentrant = call.func.attr == "RLock"
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out[t.attr] = (reentrant, wrapped)
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _self_root_attr(node: ast.AST) -> Optional[str]:
+    """The first attribute off ``self`` in a chain like
+    ``self.x.y[k].z`` (-> ``x``); None when not self-rooted."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        attr = _self_attr(node)
+        if attr is not None:
+            return attr
+        node = node.value
+    return None
+
+
+class _BodyScanner:
+    """Walks one function body recording accesses/calls/spawns with
+    the lexically-held lock stack.  Nested defs get their OWN
+    MethodInfo (they run later, possibly on another thread) — the
+    parent records them in ``nested`` for name resolution and spawn
+    targets."""
+
+    def __init__(
+        self,
+        fn: ast.AST,
+        info: MethodInfo,
+        lock_keys: Dict[str, str],
+        sink: Dict[str, MethodInfo],
+        data_attrs: Set[str],
+    ) -> None:
+        self.info = info
+        self.lock_keys = lock_keys  # self attr -> canonical lock key
+        self.sink = sink
+        self.data_attrs = data_attrs
+        self._walk_body(fn, frozenset())
+
+    def _lock_key(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None:
+            return self.lock_keys.get(attr)
+        return None
+
+    def _note_access(
+        self, attr: str, kind: str, line: int, held: FrozenSet[str]
+    ) -> None:
+        if attr in self.data_attrs:
+            self.info.accesses.append(
+                Access(attr=attr, kind=kind, line=line, held=held)
+            )
+
+    def _callable_ref(
+        self, expr: ast.AST
+    ) -> Optional[Tuple[str, bool]]:
+        """(name, on_self) for a callable-reference expression:
+        ``self._m``, a local aliasing one, or ``getattr(x, "m")``."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            return (attr, True)
+        if isinstance(expr, ast.Name):
+            ref = self.info.local_refs.get(expr.id)
+            if ref is not None:
+                return ref
+            return (expr.id, False)
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id == "getattr"
+            and len(expr.args) >= 2
+            and isinstance(expr.args[1], ast.Constant)
+            and isinstance(expr.args[1].value, str)
+        ):
+            return (expr.args[1].value, False)
+        return None
+
+    def _spawns_from_call(
+        self, call: ast.Call
+    ) -> List[SpawnSite]:
+        fn = call.func
+        out: List[SpawnSite] = []
+        # threading.Thread(target=X, args=(...), name="...") — the
+        # target AND any callable passed through args runs on the
+        # new thread (Server hands each worker's warm_shapes to the
+        # warmup thread this way)
+        is_thread = (
+            isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+        ) or (isinstance(fn, ast.Name) and fn.id == "Thread")
+        if is_thread:
+            target = None
+            label = None
+            extra: List[ast.AST] = []
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+                elif kw.arg == "name" and isinstance(
+                    kw.value, ast.Constant
+                ):
+                    label = str(kw.value.value)
+                elif kw.arg == "args" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)
+                ):
+                    extra.extend(kw.value.elts)
+            if target is None:
+                return out
+            for expr in [target] + extra:
+                ref = self._callable_ref(expr)
+                if ref is not None:
+                    out.append(
+                        SpawnSite(
+                            ref[0], ref[1], "thread",
+                            call.lineno, label,
+                        )
+                    )
+            return out
+        if not isinstance(fn, ast.Attribute) or not call.args:
+            return out
+        # pool.submit(fn, ...): only resolvable first args count —
+        # the generic forwarding inside EvaluatePool.submit passes a
+        # parameter through, which the OUTER call site resolves
+        if fn.attr == "submit":
+            ref = self._callable_ref(call.args[0])
+            if ref is not None:
+                out.append(
+                    SpawnSite(
+                        ref[0], ref[1], "pool", call.lineno, None
+                    )
+                )
+            return out
+        # callback registration: the registered callable later runs
+        # on the registrar's thread(s) — its own entry
+        if fn.attr in CALLBACK_REGISTRARS:
+            for arg in call.args:
+                ref = self._callable_ref(arg)
+                if ref is not None:
+                    out.append(
+                        SpawnSite(
+                            ref[0], ref[1], "callback",
+                            call.lineno, fn.attr,
+                        )
+                    )
+        return out
+
+    def _walk_body(
+        self, node: ast.AST, held: FrozenSet[str]
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # nested def: its body runs later (often on another
+                # thread) — scanned as its own method
+                nested_qual = (
+                    f"{self.info.qualname}.<{child.name}>"
+                )
+                self.info.nested[child.name] = nested_qual
+                sub = MethodInfo(
+                    qualname=nested_qual,
+                    cls=self.info.cls,
+                    name=child.name,
+                    path=self.info.path,
+                    lineno=child.lineno,
+                )
+                self.sink[nested_qual] = sub
+                scanner = _BodyScanner.__new__(_BodyScanner)
+                scanner.info = sub
+                scanner.lock_keys = self.lock_keys
+                scanner.sink = self.sink
+                scanner.data_attrs = self.data_attrs
+                scanner._walk_body(child, frozenset())
+                # parent nesteds are resolvable from the child too
+                sub.nested.update(self.info.nested)
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in child.items:
+                    key = self._lock_key(item.context_expr)
+                    if key is not None:
+                        inner = inner | {key}
+                # the with-items themselves evaluate under the OUTER
+                # hold; attr reads there (self._lock) are lock attrs,
+                # not data attrs, so just descend into the body
+                self._walk_body(child, inner)
+                continue
+            self._visit(child, held)
+            self._walk_body(child, held)
+
+    def _visit(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is None:
+                # self.x.y = v / del self.x.y: a store through a
+                # sub-object mutates the object x holds — a WRITE
+                # on x, same as the Subscript case below (the inner
+                # self.x Load is additionally recorded by the walk)
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    root = _self_root_attr(node.value)
+                    if (
+                        root is not None
+                        and root not in self.lock_keys
+                    ):
+                        self._note_access(
+                            root, "w", node.lineno, held
+                        )
+                return
+            if attr in self.lock_keys:
+                return
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                self._note_access(attr, "w", node.lineno, held)
+            else:
+                self._note_access(attr, "r", node.lineno, held)
+            return
+        if isinstance(node, ast.Subscript):
+            # self.x[k] = v / del self.x[k]: mutation of x
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                root = _self_root_attr(node.value)
+                if (
+                    root is not None
+                    and root not in self.lock_keys
+                ):
+                    self._note_access(
+                        root, "w", node.lineno, held
+                    )
+            return
+        if isinstance(node, ast.Assign):
+            # local callable aliases: x = self._m / getattr(o, "m")
+            if len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                ref = self._callable_ref(node.value)
+                if ref is not None and (
+                    _self_attr(node.value) is not None
+                    or isinstance(node.value, ast.Call)
+                ):
+                    self.info.local_refs[
+                        node.targets[0].id
+                    ] = ref
+            return
+        if not isinstance(node, ast.Call):
+            return
+        self.info.spawns.extend(self._spawns_from_call(node))
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            base_attr = _self_attr(fn.value)
+            if base_attr is not None and fn.attr in MUTATING_ATTRS:
+                # self.x.append(...) mutates x in place
+                self._note_access(
+                    base_attr, "w", node.lineno, held
+                )
+            on_self = (
+                isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"
+            )
+            self.info.calls.append(
+                CallSite(
+                    fn.attr,
+                    on_self,
+                    node.lineno,
+                    held,
+                    dotted=_dotted(fn),
+                    recv_attr=base_attr,
+                )
+            )
+        elif isinstance(fn, ast.Name):
+            self.info.calls.append(
+                CallSite(
+                    fn.id, False, node.lineno, held, dotted=fn.id
+                )
+            )
+
+
+# -- graph construction ------------------------------------------------
+
+
+def _class_defs(
+    ctx: Context, files: Iterable[str]
+) -> List[Tuple[str, ast.ClassDef]]:
+    out = []
+    for path in files:
+        for node in ctx.tree(path).body:
+            if isinstance(node, ast.ClassDef):
+                out.append((path, node))
+    return out
+
+
+def _family_of(
+    cls_name: str, bases: Dict[str, List[str]]
+) -> str:
+    """Topmost scanned base (BatchWorker -> Worker); cycles cannot
+    occur in Python inheritance."""
+    cur = cls_name
+    while True:
+        parents = [b for b in bases.get(cur, []) if b in bases]
+        if not parents:
+            return cur
+        cur = parents[0]
+
+
+# blocking-op vocabulary (blocking-while-locked): operations that can
+# park a thread for unbounded (or device-scale) time.  A Condition
+# ``.wait`` on the HELD lock itself releases it — the one blocking
+# call that is safe (and idiomatic) under its own lock.
+BLOCKING_DOTTED_PREFIXES = (
+    "time.sleep",
+    "_time.sleep",
+    "jax.block_until_ready",
+    "_jax.block_until_ready",
+    "jax.device_get",
+    "jax.device_put",
+    "socket.",
+    "requests.",
+    "urllib.",
+)
+BLOCKING_ATTRS = frozenset(
+    {
+        "block_until_ready",
+        "device_get",
+        "device_put",
+        "recv",
+        "accept",
+        "urlopen",
+        "read_response",
+    }
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def blocking_op(
+    call: CallSite, lock_attr_names: Set[str]
+) -> Optional[str]:
+    """A human-readable blocking-op name when ``call`` can park the
+    calling thread for unbounded (or device-scale) time; None when it
+    cannot.  A ``.wait()`` on a lock/condition attribute is exempt —
+    a Condition.wait RELEASES the lock it wraps, so waiting under its
+    own lock is the idiom, not a wedge."""
+    if call.dotted:
+        for prefix in BLOCKING_DOTTED_PREFIXES:
+            if call.dotted == prefix.rstrip(
+                "."
+            ) or call.dotted.startswith(prefix):
+                return f"{call.dotted}()"
+    if call.name in BLOCKING_ATTRS:
+        return f".{call.name}()"
+    if call.name == "wait" and call.recv_attr is not None:
+        if call.recv_attr in lock_attr_names:
+            return None
+        return f"self.{call.recv_attr}.wait()"
+    return None
+
+
+def build_flowgraph(ctx: Context) -> FlowGraph:
+    """Parse the flow module set and compute the whole-program
+    tables.  Pure function of the Context (tests substitute fixture
+    files through ``scan_files`` overrides)."""
+    g = FlowGraph()
+    files = _flow_files(ctx)
+    classes = _class_defs(ctx, files)
+
+    # inheritance families (scanned classes only)
+    bases: Dict[str, List[str]] = {}
+    for _path, cls in classes:
+        bases[cls.name] = [
+            b.id for b in cls.bases if isinstance(b, ast.Name)
+        ]
+    family: Dict[str, str] = {
+        name: _family_of(name, bases) for name in bases
+    }
+    for name, fam in family.items():
+        g.families.setdefault(fam, []).append(name)
+
+    # lock tables per family; canonical keys use the DEFINING class
+    lock_keys_by_class: Dict[str, Dict[str, str]] = {}
+    for path, cls in classes:
+        base = os.path.basename(path)
+        attrs = _lock_attrs(cls)
+        keys: Dict[str, str] = {}
+        for attr, (reentrant, wrapped) in attrs.items():
+            canonical = wrapped if wrapped in attrs else attr
+            key = f"{base}:{cls.name}.{canonical}"
+            keys[attr] = key
+            g.locks.setdefault(
+                key, attrs[canonical][0] if wrapped else reentrant
+            )
+        lock_keys_by_class[cls.name] = keys
+    # subclasses see base-class locks (self._lock in a BatchWorker
+    # method is Worker's lock when Worker defined it)
+    for name in bases:
+        merged: Dict[str, str] = {}
+        chain = [name]
+        cur = name
+        while True:
+            parents = [
+                b for b in bases.get(cur, []) if b in bases
+            ]
+            if not parents:
+                break
+            cur = parents[0]
+            chain.append(cur)
+        for cls_name in reversed(chain):
+            merged.update(lock_keys_by_class.get(cls_name, {}))
+        lock_keys_by_class[name] = merged
+
+    # data attributes per family (anything assigned via self.<attr>)
+    data_attrs_by_family: Dict[str, Set[str]] = {}
+    for path, cls in classes:
+        fam = family[cls.name]
+        attrs = data_attrs_by_family.setdefault(fam, set())
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        attrs.add(a)
+                    elif isinstance(
+                        t, (ast.Subscript, ast.Attribute)
+                    ):
+                        base_a = _self_attr(
+                            getattr(t, "value", None)
+                        )
+                        if base_a is not None:
+                            attrs.add(base_a)
+            elif isinstance(
+                node, (ast.AugAssign, ast.AnnAssign)
+            ):
+                a = _self_attr(node.target)
+                if a is not None:
+                    attrs.add(a)
+    # lock attrs are modelled as locks, not data (their replacement
+    # is the lock-discipline rule's business); Event attrs are sync
+    # primitives with their own internal lock — set/clear/wait on
+    # them is signalling, not shared data
+    for path, cls in classes:
+        fam = family[cls.name]
+        for attr in lock_keys_by_class.get(cls.name, ()):
+            data_attrs_by_family.get(fam, set()).discard(attr)
+        for node in ast.walk(cls):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "Event"
+            ):
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        data_attrs_by_family.get(
+                            fam, set()
+                        ).discard(a)
+
+    # scan every method (+ module functions) into MethodInfo
+    by_name: Dict[str, List[MethodInfo]] = {}
+    by_class: Dict[Tuple[str, str], MethodInfo] = {}
+    # fixture runs (scan_files override) track every class: the
+    # synthetic two-thread fixtures don't impersonate production
+    # class names
+    track_all = "scan_files" in ctx.overrides
+    for path, cls in classes:
+        fam = family[cls.name]
+        shared = (
+            track_all
+            or fam in SHARED_CLASSES
+            or cls.name in SHARED_CLASSES
+        )
+        data_attrs = (
+            data_attrs_by_family.get(fam, set()) if shared else set()
+        )
+        for node in cls.body:
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            qual = f"{cls.name}.{node.name}"
+            info = MethodInfo(
+                qualname=qual,
+                cls=cls.name,
+                name=node.name,
+                path=path,
+                lineno=node.lineno,
+            )
+            g.methods[qual] = info
+            _BodyScanner(
+                node,
+                info,
+                lock_keys_by_class.get(cls.name, {}),
+                g.methods,
+                data_attrs,
+            )
+            by_class[(cls.name, node.name)] = info
+            by_name.setdefault(node.name, []).append(info)
+    for path in files:
+        base = os.path.basename(path)
+        for node in ctx.tree(path).body:
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            qual = f"{base}:{node.name}"
+            info = MethodInfo(
+                qualname=qual,
+                cls=None,
+                name=node.name,
+                path=path,
+                lineno=node.lineno,
+            )
+            g.methods[qual] = info
+            _BodyScanner(node, info, {}, g.methods, set())
+            by_name.setdefault(node.name, []).append(info)
+    # subclass map for virtual dispatch on self-spawns
+    subclasses: Dict[str, List[str]] = {}
+    for name, parents in bases.items():
+        for p in parents:
+            if p in bases:
+                subclasses.setdefault(p, []).append(name)
+
+    def resolve(
+        site_cls: Optional[str], call: CallSite, info: MethodInfo
+    ) -> Optional[MethodInfo]:
+        """One callee for a call site, or None (unresolvable /
+        ambiguous — over-approximation stops there)."""
+        if call.name in info.nested:
+            return g.methods.get(info.nested[call.name])
+        if call.on_self and site_cls is not None:
+            cur: Optional[str] = site_cls
+            while cur is not None:
+                hit = by_class.get((cur, call.name))
+                if hit is not None:
+                    return hit
+                parents = [
+                    b for b in bases.get(cur, []) if b in bases
+                ]
+                cur = parents[0] if parents else None
+        if call.name in GENERIC_NAMES:
+            return None
+        cands = by_name.get(call.name, [])
+        real = [c for c in cands if "<" not in c.qualname]
+        if len(real) == 1:
+            return real[0]
+        return None
+
+    def resolve_spawn(
+        info: MethodInfo, spawn: SpawnSite
+    ) -> List[MethodInfo]:
+        """Entry methods a spawn can start — virtual dispatch on
+        self-targets (Worker.start spawning self.run also starts
+        every scanned override)."""
+        out: List[MethodInfo] = []
+        if spawn.target in info.nested:
+            hit = g.methods.get(info.nested[spawn.target])
+            return [hit] if hit is not None else []
+        if spawn.on_self and info.cls is not None:
+            roots = [info.cls] + [
+                sub
+                for sub in _all_subclasses(info.cls, subclasses)
+            ]
+            for cls_name in roots:
+                cur: Optional[str] = cls_name
+                while cur is not None:
+                    hit = by_class.get((cur, spawn.target))
+                    if hit is not None:
+                        if hit not in out:
+                            out.append(hit)
+                        break
+                    parents = [
+                        b
+                        for b in bases.get(cur, [])
+                        if b in bases
+                    ]
+                    cur = parents[0] if parents else None
+            return out
+        cands = [
+            c
+            for c in by_name.get(spawn.target, [])
+            if "<" not in c.qualname
+        ]
+        if len(cands) == 1:
+            return cands
+        return []
+
+    # -- thread entries ------------------------------------------------
+    seen_entries: Set[Tuple[str, str]] = set()
+    for info in list(g.methods.values()):
+        for spawn in info.spawns:
+            for target in resolve_spawn(info, spawn):
+                key = (spawn.kind, target.qualname)
+                if key in seen_entries:
+                    continue
+                seen_entries.add(key)
+                site = (
+                    f"{os.path.basename(info.path)}:{spawn.line}"
+                )
+                g.entries.append(
+                    Entry(
+                        key=f"{spawn.kind}:{target.qualname}",
+                        method=target.qualname,
+                        kind=spawn.kind,
+                        spawned_at=site,
+                        label=spawn.label,
+                        group=site,
+                        # pool submits fan out concurrently; a
+                        # registered callback can be invoked from
+                        # SEVERAL threads at once (the supervisor
+                        # fires listeners from its probe thread AND
+                        # from whichever worker thread tripped a
+                        # watchdog) — both self-overlap
+                        multi=spawn.kind in ("pool", "callback"),
+                    )
+                )
+    # HTTP handler dispatch: each request runs on its own thread
+    for path, cls in classes:
+        if not any(
+            isinstance(b, ast.Name)
+            and b.id == "BaseHTTPRequestHandler"
+            for b in cls.bases
+        ):
+            continue
+        for node in cls.body:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name.startswith("do_"):
+                qual = f"{cls.name}.{node.name}"
+                if ("http", qual) not in seen_entries:
+                    seen_entries.add(("http", qual))
+                    g.entries.append(
+                        Entry(
+                            key=f"http:{qual}",
+                            method=qual,
+                            kind="http",
+                            spawned_at=(
+                                f"{os.path.basename(path)}:"
+                                f"{node.lineno}"
+                            ),
+                            label=node.name,
+                            group=f"http:{qual}",
+                            multi=True,
+                        )
+                    )
+    # operator-thread lifecycle entries (shared classes only)
+    for path, cls in classes:
+        fam = family[cls.name]
+        if not (
+            track_all
+            or fam in SHARED_CLASSES
+            or cls.name in SHARED_CLASSES
+        ):
+            continue
+        for node in cls.body:
+            if (
+                isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                )
+                and node.name in LIFECYCLE_ROOTS
+            ):
+                qual = f"{cls.name}.{node.name}"
+                if ("main", qual) in seen_entries:
+                    continue
+                seen_entries.add(("main", qual))
+                g.entries.append(
+                    Entry(
+                        key=f"main:{qual}",
+                        method=qual,
+                        kind="main",
+                        spawned_at=(
+                            f"{os.path.basename(path)}:"
+                            f"{node.lineno}"
+                        ),
+                        label="lifecycle",
+                        group="main",
+                        multi=False,
+                    )
+                )
+    g.entries.sort(key=lambda e: e.key)
+
+    # -- per-entry reachability + guaranteed-held dataflow -------------
+    for entry in g.entries:
+        held_in: Dict[str, FrozenSet[str]] = {
+            entry.method: frozenset()
+        }
+        work = [entry.method]
+        reach = {entry.method}
+        while work:
+            qual = work.pop()
+            info = g.methods.get(qual)
+            if info is None:
+                continue
+            incoming = held_in.get(qual, frozenset())
+            for call in info.calls:
+                callee = resolve(info.cls, call, info)
+                if callee is None:
+                    continue
+                at_callee = incoming | call.held
+                prev = held_in.get(callee.qualname)
+                if prev is None:
+                    held_in[callee.qualname] = frozenset(at_callee)
+                    reach.add(callee.qualname)
+                    work.append(callee.qualname)
+                else:
+                    merged = prev & at_callee
+                    if merged != prev:
+                        held_in[callee.qualname] = merged
+                        work.append(callee.qualname)
+        g.reachable[entry.key] = reach
+        g.held_in[entry.key] = held_in
+
+    # -- shared attribute access sets ----------------------------------
+    for entry in g.entries:
+        held_in = g.held_in[entry.key]
+        for qual in g.reachable[entry.key]:
+            info = g.methods.get(qual)
+            if info is None or info.cls is None:
+                continue
+            fam = family.get(info.cls, info.cls)
+            if not track_all and (
+                fam not in SHARED_CLASSES
+                and info.cls not in SHARED_CLASSES
+            ):
+                continue
+            # constructor-time writes happen-before thread start
+            if info.name == "__init__":
+                continue
+            base_held = held_in.get(qual, frozenset())
+            for acc in info.accesses:
+                g.shared_access.setdefault(
+                    (fam, acc.attr), []
+                ).append(
+                    AttrSite(
+                        entry=entry,
+                        method=qual,
+                        path=info.path,
+                        line=acc.line,
+                        kind=acc.kind,
+                        guards=acc.held | base_held,
+                    )
+                )
+
+    # -- blocking closure ----------------------------------------------
+    lock_attr_names: Set[str] = set()
+    for keys in lock_keys_by_class.values():
+        lock_attr_names |= set(keys)
+    g.lock_attr_names = lock_attr_names  # type: ignore[attr-defined]
+    for qual, info in g.methods.items():
+        ops: Dict[str, str] = {}
+        for call in info.calls:
+            op = blocking_op(call, lock_attr_names)
+            if op is not None:
+                ops.setdefault(
+                    op, f"{op} at line {call.line}"
+                )
+        g.blocking[qual] = ops
+    changed = True
+    while changed:
+        changed = False
+        for qual, info in g.methods.items():
+            acc = g.blocking[qual]
+            for call in info.calls:
+                callee = resolve(info.cls, call, info)
+                if callee is None:
+                    continue
+                for op, path in g.blocking.get(
+                    callee.qualname, {}
+                ).items():
+                    if op not in acc:
+                        acc[op] = f"{callee.qualname} -> {path}"
+                        changed = True
+    # `resolve` is closed over the run's tables — expose it for the
+    # rules (blocking-while-locked re-resolves call sites)
+    g.resolve = resolve  # type: ignore[attr-defined]
+    return g
+
+
+def _all_subclasses(
+    name: str, subclasses: Dict[str, List[str]]
+) -> List[str]:
+    out: List[str] = []
+    stack = list(subclasses.get(name, []))
+    while stack:
+        cur = stack.pop()
+        if cur in out:
+            continue
+        out.append(cur)
+        stack.extend(subclasses.get(cur, []))
+    return out
+
+
+# -- cached per-context build -----------------------------------------
+
+
+def flowgraph(ctx: Context) -> FlowGraph:
+    """Context-cached build: the concurrency rules (and the CLI dump)
+    share one graph per lint run.  Cached on the Context itself so a
+    recycled object id can never alias a stale graph."""
+    g = getattr(ctx, "_flowgraph_cache", None)
+    if g is None:
+        g = build_flowgraph(ctx)
+        ctx._flowgraph_cache = g  # type: ignore[attr-defined]
+    return g
+
+
+# -- operator dump (feeds docs/ARCHITECTURE.md "Concurrency model") ----
+
+
+def render_dump(g: FlowGraph, repo: str) -> str:
+    """Deterministic markdown rendering of the flowgraph: thread
+    entries, lock table, shared attributes and their guards.  The
+    docs/ARCHITECTURE.md "Concurrency model" section embeds this
+    verbatim (concurrency-doc rule), so the doc cannot drift from the
+    analysis."""
+    lines: List[str] = []
+    lines.append("**Thread entries** (who starts code where):")
+    lines.append("")
+    for e in g.entries:
+        lines.append(
+            f"- `{e.method}` — {e.kind}"
+            + (f" `{e.label}`" if e.label else "")
+            + f", spawned at `{e.spawned_at}`"
+        )
+    lines.append("")
+    lines.append("**Locks**:")
+    lines.append("")
+    for key in sorted(g.locks):
+        kind = "RLock" if g.locks[key] else "Lock"
+        lines.append(f"- `{key}` ({kind})")
+    lines.append("")
+    lines.append(
+        "**Shared attributes** (written from one thread entry and "
+        "touched from another; guard = lock held at every access, "
+        "`unguarded` = allowlisted in "
+        "tools/nomadlint/rules/concurrency.py):"
+    )
+    lines.append("")
+    for (fam, attr) in sorted(g.shared_access):
+        sites = g.shared_access[(fam, attr)]
+        # same pair test as shared-state-guard: a write from one
+        # entry and a touch from a CONFLICTING entry (same-group
+        # virtual siblings never overlap on one instance) — attrs
+        # without such a pair are not shared state and would make
+        # the `unguarded = allowlisted` legend a lie
+        if not any(
+            a.kind == "w" and entries_conflict(a.entry, b.entry)
+            for a in sites
+            for b in sites
+        ):
+            continue
+        entries = sorted({s.entry.method for s in sites})
+        common = None
+        for s in sites:
+            common = (
+                set(s.guards)
+                if common is None
+                else common & set(s.guards)
+            )
+        guard = (
+            f"`{sorted(common)[0]}`"
+            if common
+            else "unguarded"
+        )
+        lines.append(
+            f"- `{fam}.{attr}` — touched by "
+            f"{len(entries)} entries "
+            f"({', '.join(f'`{e}`' for e in entries[:4])}"
+            + (", …" if len(entries) > 4 else "")
+            + f"); guard: {guard}"
+        )
+    lines.append("")
+    return "\n".join(lines)

@@ -5,5 +5,8 @@ from . import donation  # noqa: F401
 from . import jit_purity  # noqa: F401
 from . import locks  # noqa: F401
 from . import config_drift  # noqa: F401
+from . import concurrency  # noqa: F401
+from . import kernel_contract  # noqa: F401
+from . import concurrency_doc  # noqa: F401
 
 MIGRATED_RULES = stage_accounting.MIGRATED_RULES

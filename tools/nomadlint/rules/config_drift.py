@@ -41,6 +41,12 @@ class ConfigDriftRule(Rule):
     description = (
         "NOMAD_TPU_* knobs registered in envknobs.py + documented"
     )
+    # needs BOTH sides of every pair (usage scan + registry + docs
+    # table): a --files-narrowed run sees only a slice of the reads,
+    # so direction 4 (dead registry rows) would false-fire and
+    # direction 1 would false-pass — the runner always hands this
+    # rule the full file set
+    cross_file = True
 
     def _usage(self, ctx: Context) -> Dict[str, List]:
         """knob -> [(path, line), ...] across the scan scope."""

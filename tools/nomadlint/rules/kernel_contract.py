@@ -1,0 +1,127 @@
+"""kernel-contract: the declared shape ladders of the production
+kernels (chunk / storm / mesh) hold under ``jax.eval_shape``.
+
+Recompile drift is invisible to the CPU tier-1 suite — a collapsed
+pow2 bucket or a weak-type promotion only shows up as a multi-second
+XLA compile in the accelerator hot path (a p99 cliff).  This rule
+runs ``nomad_tpu/ops/contracts.py`` at lint time: every declared
+ladder rung must be a distinct compiled signature, ``eval_shape``
+must succeed on each, and output dtypes must stay inside the
+declared closed set with no weak types.  It also AST-cross-checks
+the contract's chunk ladder against ``batch_worker.CHUNK_BUCKETS``
+so the contract cannot drift from the worker's live bucket policy.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import List, Optional, Tuple
+
+from ..core import Context, Finding, Rule, register
+
+
+def _chunk_buckets_literal(tree: ast.AST) -> Optional[Tuple[int, ...]]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == "CHUNK_BUCKETS"
+            ):
+                vals = [
+                    n.value
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)
+                ]
+                return tuple(vals)
+    return None
+
+
+def _load_fixture_contracts(path: str):
+    spec = importlib.util.spec_from_file_location(
+        f"_kc_fixture_{abs(hash(path))}", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.iter_contracts()
+
+
+@register
+class KernelContractRule(Rule):
+    name = "kernel-contract"
+    description = (
+        "compiled-signature count == declared shape ladder; "
+        "output dtype closure (no weak types)"
+    )
+    cross_file = True
+
+    def check(self, ctx: Context) -> List[Finding]:
+        from nomad_tpu.ops import contracts as live
+
+        contracts_path = ctx.path("ops_contracts")
+        findings: List[Finding] = []
+        override = ctx.overrides.get("ops_contracts")
+        if override is not None:
+            try:
+                contract_list = _load_fixture_contracts(override)
+            except Exception as exc:  # noqa: BLE001
+                return [
+                    Finding(
+                        self.name, override, 0,
+                        f"contract module failed to load: {exc}",
+                    )
+                ]
+            violations = live.check_contracts(contract_list)
+            return [
+                Finding(self.name, override, 0, v)
+                for v in violations
+            ]
+        for v in live.check_contracts():
+            findings.append(
+                Finding(self.name, contracts_path, 0, v)
+            )
+        # ladder drift: the contract's chunk ladder must equal the
+        # worker's live CHUNK_BUCKETS literal
+        declared = _chunk_buckets_literal(
+            ctx.tree(ctx.path("batch_worker"))
+        )
+        if declared is None:
+            findings.append(
+                Finding(
+                    self.name, ctx.path("batch_worker"), 0,
+                    "could not find the CHUNK_BUCKETS literal — "
+                    "the kernel contract cross-check needs it",
+                )
+            )
+        elif tuple(live.CHUNK_LADDER) != declared:
+            findings.append(
+                Finding(
+                    self.name, contracts_path, 0,
+                    f"contracts.CHUNK_LADDER {live.CHUNK_LADDER} "
+                    "!= batch_worker.CHUNK_BUCKETS "
+                    f"{declared} — the declared kernel ladder "
+                    "drifted from the live chunk-width policy",
+                )
+            )
+        return findings
+
+    @classmethod
+    def _fixture(cls, ctx: Context, which: str) -> Context:
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "fixtures", "kernel_contract",
+        )
+        return ctx.with_overrides(
+            ops_contracts=os.path.join(fixtures, f"{which}.py")
+        )
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._fixture(ctx, "bad")
+
+    @classmethod
+    def clean_fixture(cls, ctx, tmpdir):
+        return cls._fixture(ctx, "clean")
