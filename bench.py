@@ -1194,7 +1194,9 @@ def bench_multichip():
     hardware): placements/s, host->device bytes per warm mirror
     flush (delta vs full), and per-device HLO FLOPs — the proof
     block for the multi-chip hot path (`multichip` in BENCH json and
-    the MULTICHIP_r*.json tail)."""
+    the MULTICHIP_r*.json tail).  The `multihost` row spawns the
+    2-process distributed smoke: the same pipeline across PROCESSES
+    (per-host flush bytes, sharded-vs-single storm solve)."""
     from nomad_tpu.parallel.multichip import multichip_sweep
 
     t0 = time.time()
@@ -1209,6 +1211,21 @@ def bench_multichip():
             f"{p['per_device_flops']:.3g} flops/device, "
             f"{p['bytes_per_flush_delta']}B delta vs "
             f"{p['bytes_per_flush_full']}B full per flush"
+        )
+    mh = block.get("multihost", {})
+    if "skipped" in mh:
+        log(f"multichip multihost: skipped ({mh['skipped']})")
+    elif mh:
+        log(
+            f"multichip multihost: {mh['procs']} procs x "
+            f"{mh['devices_per_host']} devices, "
+            f"{mh['placements_per_sec']} placements/s e2e, "
+            f"{mh['bytes_per_flush_delta_per_host']}B delta vs "
+            f"{mh['bytes_per_flush_full_per_host']}B full per host"
+            f"/flush, storm sharded "
+            f"{mh['storm_solve_sharded_ms']}ms vs single "
+            f"{mh['storm_solve_single_device_ms']}ms "
+            f"(bit_identical={mh['storm_bit_identical']})"
         )
     log(f"multichip sweep took {time.time() - t0:.1f}s")
     return block

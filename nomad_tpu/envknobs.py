@@ -87,6 +87,27 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "cap on storm auction rounds (0 = auto: the padded row "
         "bucket, the solver's convergence bound)",
     ),
+    # -- multi-host mesh (nomad_tpu/parallel/mesh.py) -----------------
+    "NOMAD_TPU_DIST": EnvKnob(
+        "0", "nomad_tpu/parallel/mesh.py",
+        "1 opts this process into the multi-host pod mesh "
+        "(jax.distributed init; single-process stays the "
+        "zero-config default)",
+    ),
+    "NOMAD_TPU_DIST_COORD": EnvKnob(
+        "127.0.0.1:8476", "nomad_tpu/parallel/mesh.py",
+        "coordinator address (process 0's host:port) for the "
+        "distributed init",
+    ),
+    "NOMAD_TPU_DIST_PROCS": EnvKnob(
+        "1", "nomad_tpu/parallel/mesh.py",
+        "total processes in the multi-host world (<=1 keeps "
+        "distributed init off)",
+    ),
+    "NOMAD_TPU_DIST_ID": EnvKnob(
+        "0", "nomad_tpu/parallel/mesh.py",
+        "this process's id in [0, NOMAD_TPU_DIST_PROCS)",
+    ),
     "NOMAD_TPU_TSAN": EnvKnob(
         "0", "nomad_tpu/tsan.py",
         "1 turns on the happens-before sanitizer: shared-singleton "

@@ -25,10 +25,11 @@ in-kernel vocab-count carry is the planned extension.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import List, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..device_lock import align_jax_platforms
 from .score import MAX_SKIP, NO_NODE, SKIP_THRESHOLD, _pow10 as _pow10_f32
@@ -1177,6 +1178,82 @@ def patch_rows_sharded(mesh, donate: bool = False):
         )
         _patch_rows_sharded_cache[key] = fn
     return fn
+
+
+def patch_rows_hostlocal(mesh, donate: bool = False):
+    """Per-DEVICE staging variant of `patch_rows_sharded` for MULTI-
+    host meshes: the delta-sync primitive of the cross-host flush
+    protocol.  ``idx`` and ``vals`` arrive as ``[D, w]`` arrays
+    sharded ``P("nodes")`` along the leading device axis — device d's
+    row holds ONLY the dirty rows landing in its own node shard, with
+    indices already shard-LOCAL and padding slots set to the shard
+    size (out of bounds -> dropped, exactly like `patch_rows`).  Each
+    host therefore builds and ships staging for its own devices'
+    dirty rows and nothing else: a warm cross-host flush costs every
+    host O(its dirty rows) bytes, never a replicated buffer and never
+    a full column over the network.  ``w`` is the pow2 bucket of the
+    LARGEST per-device dirty count (a shared static shape — every
+    process must compile the identical program).
+
+    Bit-identical to `patch_rows_sharded` on the same dirty set: both
+    reduce to one local in-shard scatter per device.  The single-
+    process mirror keeps the replicated PR 8 staging (same bytes,
+    same trace); this variant exists for the world where "replicated"
+    means a network broadcast.  ``donate=True`` follows
+    `patch_rows_donated`'s exclusivity contract."""
+    key = (mesh, "hostlocal", bool(donate))
+    fn = _patch_rows_sharded_cache.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as _P
+
+        from ..parallel.mesh import shard_map as _shard_map
+
+        def _patch(col, idx, vals):
+            # leading axis: this device's single [1, w] staging row;
+            # indices are pre-localized, padding == shard size drops
+            return col.at[idx[0]].set(vals[0], mode="drop")
+
+        wrapped = functools.partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=(_P("nodes"), _P("nodes"), _P("nodes")),
+            out_specs=_P("nodes"),
+        )(_patch)
+        fn = jax.jit(
+            wrapped, donate_argnums=(0,) if donate else ()
+        )
+        fn.__name__ = (
+            "patch_rows_hostlocal_donated"
+            if donate
+            else "patch_rows_hostlocal"
+        )
+        _patch_rows_sharded_cache[key] = fn
+    return fn
+
+
+def hostlocal_staging(
+    mesh, idx: np.ndarray, capacity: int
+) -> Tuple[np.ndarray, List[np.ndarray], int]:
+    """Build the `patch_rows_hostlocal` index staging for a dirty-row
+    set: returns ``(idx_stack[D, w] shard-local i32, order, w)`` where
+    ``order[d]`` is the slice of ``idx`` (global rows, sorted) that
+    landed in device d's shard — the caller gathers each column's
+    values with it.  Deterministic across processes: every process
+    computes the identical stack from the shared dirty log, then
+    ships only its own devices' rows (`mesh_put`)."""
+    n_dev = int(mesh.devices.size)
+    size = capacity // n_dev
+    per_dev = [
+        idx[(idx >= d * size) & (idx < (d + 1) * size)]
+        for d in range(n_dev)
+    ]
+    w = pow2_bucket(
+        max(1, max(len(s) for s in per_dev)), floor=8
+    )
+    idx_stack = np.full((n_dev, w), size, np.int32)
+    for d, sel in enumerate(per_dev):
+        idx_stack[d, : len(sel)] = sel - d * size
+    return idx_stack, per_dev, w
 
 
 @functools.partial(

@@ -56,6 +56,19 @@ STORM_LADDER: Tuple[Tuple[int, int], ...] = (
 # width (parallel/mesh.sharded_chained_plan caches one runner per
 # (mesh, n_picks, ...) for the same reason)
 MESH_WIDTHS: Tuple[int, ...] = (1, 2, 4, 8)
+# MULTI-host node-axis widths (ROADMAP item 3): a NOMAD_TPU_DIST pod
+# spans hosts x per-host devices, so the GLOBAL device count — and
+# with it every shard-local column size, on EVERY process — walks
+# this ladder.  A pod resize that silently forked an undeclared
+# signature would recompile the chained runner AND the sharded storm
+# solve on all hosts at once (a pod-wide p99 cliff); the
+# `kernel-contract` nomadlint rule fails when this ladder is absent
+# or collapsed
+MESH_HOST_WIDTHS: Tuple[int, ...] = (8, 16, 32)
+# pod-scale arena rows (global) for the multi-host rungs: large
+# enough that every declared width yields a distinct non-trivial
+# shard-local column size
+_C_POD = 512
 
 # representative fixed dims (any consistent values work: signatures
 # vary only along the declared ladder axis)
@@ -128,26 +141,28 @@ def _chain_args(e: int, c: int) -> Tuple[tuple, dict]:
     return args, kwargs
 
 
-def _storm_args(e: int, a: int) -> Tuple[tuple, dict]:
+def _storm_args(
+    e: int, a: int, c: int = _C
+) -> Tuple[tuple, dict]:
     from .solve import StormInputs
 
     inp = StormInputs(
-        feasible=_sds((e, _C), B),
-        affinity=_sds((e, _C), F),
-        collisions=_sds((e, _C), I),
-        perm=_sds((e, _C), I),
+        feasible=_sds((e, c), B),
+        affinity=_sds((e, c), F),
+        collisions=_sds((e, c), I),
+        perm=_sds((e, c), I),
         limit=_sds((e,), I),
         n_cand=_sds((e,), I),
         eval_of=_sds((a,), I),
-        penalty=_sds((a, _C), B),
+        penalty=_sds((a, c), B),
         ask=_sds((a, 3), F),
         desired=_sds((a,), I),
         real=_sds((a,), B),
-        pre_cpu=_sds((_C,), F),
-        pre_mem=_sds((_C,), F),
-        pre_disk=_sds((_C,), F),
+        pre_cpu=_sds((c,), F),
+        pre_mem=_sds((c,), F),
+        pre_disk=_sds((c,), F),
     )
-    return (inp, _cols(_C)), dict(
+    return (inp, _cols(c)), dict(
         spread_fit=False, max_rounds=a
     )
 
@@ -193,7 +208,38 @@ def iter_contracts() -> List[KernelContract]:
         ],
         out_dtypes=frozenset({"int32", "float32", "bool"}),
     )
-    return [chunk, storm, mesh]
+    # the multi-host ladders: a pod of W global devices runs every
+    # per-shard program over C_pod/W local columns on EVERY process —
+    # one distinct compiled signature per declared pod width, for
+    # both the chained runner (mesh_host) and the sharded storm
+    # auction (storm_mesh).  Expressed through the unsharded kernels
+    # over shard-local shapes so the contract needs no live
+    # multi-process world to check: eval_shape of the shard body over
+    # local columns IS the per-device signature (modulo the
+    # replicated walk inputs, which do not vary along this ladder).
+    mesh_host = KernelContract(
+        name="mesh_host",
+        kernel=_chunk_kernel,
+        ladder=[
+            _chain_args(CHUNK_LADDER[-1], _C_POD // w)
+            for w in MESH_HOST_WIDTHS
+        ],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    storm_mesh = KernelContract(
+        name="storm_mesh",
+        kernel=_storm_kernel,
+        ladder=[
+            _storm_args(
+                STORM_LADDER[-1][0],
+                STORM_LADDER[-1][1],
+                _C_POD // w,
+            )
+            for w in MESH_HOST_WIDTHS
+        ],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    return [chunk, storm, mesh, mesh_host, storm_mesh]
 
 
 def _signature(args: tuple, kwargs: dict) -> tuple:

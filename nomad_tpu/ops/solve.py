@@ -287,6 +287,311 @@ def storm_assignment(
     return assigned, pulls, acc_round, score, rows0, rounds
 
 
+_storm_sharded_cache: dict = {}
+
+
+def storm_in_specs() -> "StormInputs":
+    """The node-sharded solve's `StormInputs` PartitionSpecs — the
+    ONE definition shared by `storm_assignment_sharded` (shard_map
+    in_specs) and `sched/storm.py stage_for_mesh` (host staging), so
+    placement and program can never drift (same contract as
+    `parallel/mesh.py chain_in_specs` for the chained runner):
+    node-indexed leaves shard `P('nodes')`, per-eval / per-row
+    leaves replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    node2 = P(None, "nodes")
+    col = P("nodes")
+    rep = P()
+    return StormInputs(
+        feasible=node2,
+        affinity=node2,
+        collisions=node2,
+        perm=rep,
+        limit=rep,
+        n_cand=rep,
+        eval_of=rep,
+        penalty=node2,
+        ask=rep,
+        desired=rep,
+        real=rep,
+        pre_cpu=col,
+        pre_mem=col,
+        pre_disk=col,
+    )
+
+
+def storm_assignment_sharded(
+    mesh, spread_fit: bool, max_rounds: int
+):
+    """Node-sharded twin of `storm_assignment` for the (multi-host)
+    mesh: BIT-IDENTICAL in every output — assignments, pulls,
+    acceptance rounds, scores, greedy picks AND the round count — to
+    the single-device solve on the same inputs, with the O(A x C)
+    work distributed along the node axis the mesh already shards.
+
+    The auction decomposes along exactly that axis (the CvxCluster
+    observation: bid/accept rounds are per-node parallel by
+    construction):
+
+    * **Score matrix** — each device runs the shared `_score_vectors`
+      kernel over its own ``C/D`` node shard of the usage-mirror
+      columns: [A, C/D] local scores, zero communication.
+    * **Bid phase** — rows bid against their LOCAL node shard: the
+      per-shard max of the tie-jittered value plus the lowest local
+      index achieving it, then one ``pmax``/``pmin`` pair (O(A)
+      scalars, not O(C)) picks each row's global winner — the same
+      node argmax-first-index would pick on one device, bit-for-bit,
+      because max is exact and the jitter lattice is computed from
+      GLOBAL node ids.
+    * **Acceptance** — per-node prefix acceptance stays shard-local:
+      each node's bidder one-hots, max-ask budget ``m`` and
+      capacity/price debits live on the shard that owns the node; the
+      [A, A] rank comparison is replicated per-row math.  Reads of a
+      single node's value/budget by its (replicated) row resolve by
+      ownership: the owning shard contributes, everyone else adds
+      0.0, one psum — exact, since only one shard owns any node.
+    * **Warm start** — the greedy serial walk needs the full permuted
+      score vector, so scores/feasibility all-gather ONCE before the
+      round loop ([A, C] f+bool, freed after `_limited_walk_argmax`);
+      the per-round auction state never gathers.
+
+    Compiled runners are cached per (mesh, spread_fit, max_rounds);
+    inputs follow `sched/storm.py stage_for_mesh`'s placement (node-
+    axis leaves sharded P('nodes'), per-row leaves replicated) and the
+    sharded usage-mirror columns feed ``cols`` directly.  Requires
+    the arena capacity to tile evenly over the mesh (the caller's
+    ``mesh_capable`` gate)."""
+    key = (mesh, bool(spread_fit), int(max_rounds))
+    fn = _storm_sharded_cache.get(key)
+    if fn is not None:
+        return fn
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map
+
+    in_specs = (storm_in_specs(), (P("nodes"),) * 6)
+    out_specs = (P(),) * 6
+
+    def _run(inp: StormInputs, cols):
+        cpu_t, mem_t, disk_t, cpu_u, mem_u, disk_u = cols
+        dtype = cpu_t.dtype
+        cpu_u = cpu_u + inp.pre_cpu
+        mem_u = mem_u + inp.pre_mem
+        disk_u = disk_u + inp.pre_disk
+        A = inp.ask.shape[0]
+        C = inp.perm.shape[1]  # global arena rows
+        S = cpu_t.shape[0]  # this shard's rows
+        shard = jax.lax.axis_index("nodes")
+        lo = (shard * S).astype(jnp.int32)
+        node_l = lo + jnp.arange(S, dtype=jnp.int32)
+        eo = inp.eval_of
+
+        si = ScoreInputs(
+            cpu_total=cpu_t,
+            mem_total=mem_t,
+            disk_total=disk_t,
+            cpu_used=cpu_u,
+            mem_used=mem_u,
+            disk_used=disk_u,
+            feasible=inp.feasible[eo],
+            collisions=inp.collisions[eo],
+            penalty=inp.penalty,
+            affinity_score=inp.affinity[eo],
+            spread_boost=jnp.zeros((), dtype),
+            perm=inp.perm[eo],  # global — consumed by the walk only
+            ask_cpu=inp.ask[:, 0:1],
+            ask_mem=inp.ask[:, 1:2],
+            ask_disk=inp.ask[:, 2:3],
+            desired_count=inp.desired[:, None],
+            limit=inp.limit[eo],
+            n_candidates=inp.n_cand[eo],
+        )
+        feas_l, scores_l = _score_vectors(si, spread_fit)
+        feas_l = feas_l & inp.real[:, None]
+
+        # warm start: the one full gather of the solve — the serial
+        # walk consumes the global permuted ordering
+        scores_full = jax.lax.all_gather(
+            scores_l, "nodes", axis=1, tiled=True
+        )
+        feas_full = jax.lax.all_gather(
+            feas_l, "nodes", axis=1, tiled=True
+        )
+        rows0, _best0, _nf, pulls0 = jax.vmap(
+            _limited_walk_argmax
+        )(feas_full, scores_full, si.perm, si.limit,
+          si.n_candidates)
+
+        neg_inf = jnp.asarray(-jnp.inf, dtype=scores_l.dtype)
+        big = jnp.asarray(2**31 - 1, jnp.int32)
+        row_ids = jnp.arange(A, dtype=jnp.int32)
+        jitter_l = (
+            (
+                (
+                    row_ids[:, None] * jnp.int32(-1640531527)
+                    + node_l[None, :] * jnp.int32(40503)
+                )
+                & jnp.int32(0xFFFF)
+            ).astype(scores_l.dtype)
+            / 65536.0
+            * jnp.asarray(TIE_JITTER, scores_l.dtype)
+        )
+        free0_l = jnp.stack(
+            [cpu_t - cpu_u, mem_t - mem_u, disk_t - disk_u],
+            axis=1,
+        )
+        rows0_c = jnp.clip(rows0, 0, C - 1)
+
+        def read_row_at(arr_l, gidx):
+            """Ownership read of [A, S]-local ``arr_l`` at the global
+            node index ``gidx[A]``: the owning shard contributes its
+            value, everyone else 0.0 — exact under psum (one owner)."""
+            loc = gidx - lo
+            mine = (loc >= 0) & (loc < S)
+            safe = jnp.clip(loc, 0, S - 1)
+            v = jnp.take_along_axis(
+                arr_l, safe[:, None], axis=1
+            )[:, 0]
+            return jax.lax.psum(
+                jnp.where(mine, v, jnp.zeros_like(v)), "nodes"
+            )
+
+        def read_node_at(vec_l, gidx):
+            """Same ownership read for a node-indexed [S] vector."""
+            loc = gidx - lo
+            mine = (loc >= 0) & (loc < S)
+            safe = jnp.clip(loc, 0, S - 1)
+            v = vec_l[safe]
+            return jax.lax.psum(
+                jnp.where(mine, v, jnp.zeros_like(v)), "nodes"
+            )
+
+        def cond(st):
+            _assigned, _free, _price, _acc, rnd, progress = st
+            return (rnd < max_rounds) & progress
+
+        def body(st):
+            assigned, free_l, price_l, acc_round, rnd, _progress = st
+            unass = (assigned == NO_NODE) & inp.real
+            fits_l = jnp.all(
+                free_l[None, :, :] >= inp.ask[:, None, :], axis=2
+            )
+            ok_l = feas_l & fits_l & unass[:, None]
+            value_l = jnp.where(
+                ok_l, scores_l - price_l[None, :], neg_inf
+            )
+            # the bid: per-shard jittered max + lowest local index at
+            # it, then one pmax/pmin pair — the single-device
+            # ``argmax(value + jitter)`` (first index at the max)
+            # reconstructed exactly
+            jv_l = value_l + jitter_l
+            gmax = jax.lax.pmax(jnp.max(jv_l, axis=1), "nodes")
+            cand_l = jv_l == gmax[:, None]
+            lidx = jnp.min(
+                jnp.where(cand_l, node_l[None, :], big), axis=1
+            )
+            best_c = jax.lax.pmin(lidx, "nodes").astype(jnp.int32)
+            best_v = read_row_at(value_l, best_c)
+            # round 0 bids the serial walk winner when it still fits,
+            # so an uncontended storm IS the greedy walk
+            walk_v = read_row_at(value_l, rows0_c)
+            use_walk = (
+                (rnd == 0) & (rows0 >= 0) & (walk_v > neg_inf)
+            )
+            bid_c = jnp.where(use_walk, rows0_c, best_c)
+            bid_v = jnp.where(use_walk, walk_v, best_v)
+            has_bid = bid_v > neg_inf
+            # replicated [A, A] rank math — identical on every shard
+            same = (
+                (bid_c[:, None] == bid_c[None, :])
+                & has_bid[:, None]
+                & has_bid[None, :]
+            )
+            better = (bid_v[None, :] > bid_v[:, None]) | (
+                (bid_v[None, :] == bid_v[:, None])
+                & (row_ids[None, :] < row_ids[:, None])
+            )
+            rank = jnp.sum(same & better, axis=1).astype(jnp.int32)
+            # shard-local prefix acceptance: bidder one-hots, max-ask
+            # budget and the capacity/price debits all live on the
+            # shard owning the node
+            onehot_l = (
+                bid_c[:, None] == node_l[None, :]
+            ) & has_bid[:, None]
+            maxask_l = jnp.max(
+                jnp.where(
+                    onehot_l[:, :, None], inp.ask[:, None, :], 0.0
+                ),
+                axis=0,
+            )  # [S, 3]
+            m_l = jnp.min(
+                jnp.where(
+                    maxask_l > 0,
+                    jnp.floor(
+                        free_l / jnp.maximum(maxask_l, 1e-9)
+                    ),
+                    jnp.inf,
+                ),
+                axis=1,
+            )
+            m_at_bid = read_node_at(m_l, bid_c)
+            accepted = has_bid & ((rank == 0) | (rank < m_at_bid))
+            assigned = jnp.where(accepted, bid_c, assigned)
+            acc_round = jnp.where(accepted, rnd, acc_round)
+            acc_oh_l = (onehot_l & accepted[:, None]).astype(dtype)
+            free_l = free_l - acc_oh_l.T @ inp.ask
+            price_l = price_l + jnp.where(
+                jnp.any(onehot_l, axis=0),
+                jnp.asarray(PRICE_EPS, dtype),
+                0.0,
+            ).astype(dtype)
+            return (
+                assigned, free_l, price_l, acc_round,
+                rnd + 1, jnp.any(accepted),
+            )
+
+        assigned, _free, _price, acc_round, rounds, _prog = (
+            jax.lax.while_loop(
+                cond,
+                body,
+                (
+                    jnp.full(A, NO_NODE, jnp.int32),
+                    free0_l,
+                    jnp.zeros(S, dtype),
+                    jnp.full(A, -1, jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(True),
+                ),
+            )
+        )
+        solved = assigned >= 0
+        kept_walk = solved & (assigned == rows0)
+        pulls = jnp.where(
+            kept_walk, pulls0, si.n_candidates
+        ).astype(jnp.int32)
+        score = jnp.where(
+            solved,
+            read_row_at(
+                scores_l, jnp.clip(assigned, 0, C - 1)
+            ),
+            jnp.asarray(0.0, dtype=scores_l.dtype),
+        )
+        return assigned, pulls, acc_round, score, rows0, rounds
+
+    wrapped = functools.partial(
+        shard_map, mesh=mesh, in_specs=in_specs,
+        out_specs=out_specs,
+    )(_run)
+    fn = jax.jit(wrapped)
+    fn.__name__ = (
+        f"storm_assignment_sharded_r{max_rounds}"
+        f"{'_spread' if spread_fit else ''}"
+    )
+    _storm_sharded_cache[key] = fn
+    return fn
+
+
 def pad_axis(arr: np.ndarray, n: int, fill) -> np.ndarray:
     """Pad ``arr``'s leading axis out to ``n`` rows of ``fill``."""
     if arr.shape[0] == n:

@@ -24,7 +24,8 @@ decisions stay bit-identical to the single-chip kernel.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+import os
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +53,160 @@ from ..ops.batch import BatchInputs, plan_picks
 from ..ops.score import ScoreInputs, _limited_walk_argmax, _score_vectors
 
 
+# -- multi-host distribution (NOMAD_TPU_DIST_*) ------------------------
+#
+# The same NamedSharding program that shards the node axis across one
+# host's chips runs UNCHANGED across processes on a TPU pod: each
+# process holds its own slice of every P("nodes") array, the jitted
+# shard_map collectives rendezvous over ICI/DCN, and every process
+# executes the identical SPMD launch sequence (the multi-controller
+# contract).  `distributed_init` is the one-time bring-up; the
+# zero-config default (knobs unset, or one process) stays exactly the
+# single-process mesh of PR 8.
+
+
+class DistConfig(NamedTuple):
+    coordinator: str  # host:port of process 0's coordinator service
+    num_processes: int
+    process_id: int
+
+
+def dist_config() -> Optional[DistConfig]:
+    """The NOMAD_TPU_DIST_* knobs, or None when multi-host is not
+    opted into (`NOMAD_TPU_DIST` != 1).  With the opt-in set, a
+    malformed process count / id RAISES instead of being coerced: a
+    member silently degrading to single-host is exactly the
+    peer-deadlock the loud-failure contract exists to prevent."""
+    if os.environ.get("NOMAD_TPU_DIST") != "1":
+        return None
+    coord = os.environ.get(
+        "NOMAD_TPU_DIST_COORD", "127.0.0.1:8476"
+    )
+    try:
+        procs = int(os.environ.get("NOMAD_TPU_DIST_PROCS", "1"))
+        pid = int(os.environ.get("NOMAD_TPU_DIST_ID", "0"))
+    except ValueError as exc:
+        raise ValueError(
+            "NOMAD_TPU_DIST=1 but NOMAD_TPU_DIST_PROCS/"
+            "NOMAD_TPU_DIST_ID are not integers — refusing to "
+            "guess: a member that silently fell back to "
+            "single-host would deadlock its peers' first "
+            f"collective ({exc})"
+        ) from exc
+    if procs <= 1:
+        # documented off-switch: <=1 keeps distributed init off
+        return DistConfig(coord, 1, 0)
+    if not 0 <= pid < procs:
+        raise ValueError(
+            f"NOMAD_TPU_DIST_ID={pid} out of range for "
+            f"NOMAD_TPU_DIST_PROCS={procs}"
+        )
+    return DistConfig(coord, procs, pid)
+
+
+_dist_initialized = False
+
+
+def distributed_init() -> bool:
+    """Idempotent `jax.distributed.initialize` from the
+    NOMAD_TPU_DIST_* knobs.  Returns True when this process is part
+    of a live multi-process world, False for the single-process
+    default (knobs unset, or NOMAD_TPU_DIST_PROCS <= 1 — with one
+    process nothing needs a coordinator, and calling initialize after
+    the backend warmed up would be an error in embedding tests).
+
+    Must run before the first backend touch (`jax.devices()` et al.);
+    `make_mesh` and the BatchWorker's mesh construction both call it
+    first, so a server whose operator set the knobs joins the pod
+    before any kernel compiles.  A misconfigured world (bad
+    coordinator, wrong process count) RAISES rather than silently
+    degrading to single-process: the peers would deadlock waiting for
+    this process inside their first collective.
+
+    On the CPU backend (the tier-1-hermetic harness: spawned local
+    processes) cross-process collectives need the gloo implementation;
+    it is selected here before the backend initializes.
+    """
+    global _dist_initialized
+    cfg = dist_config()
+    if cfg is None or cfg.num_processes <= 1:
+        return False
+    if _dist_initialized:
+        return True
+    from ..device_lock import _cpu_only
+
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if not plats or _cpu_only(plats):
+        # CPU multiprocess computations are only implemented over
+        # gloo; must be picked before the backend client exists.
+        # Unset JAX_PLATFORMS counts too — a host whose backend
+        # merely RESOLVES to cpu would otherwise handshake fine and
+        # then stall every peer at the first collective (the late,
+        # pod-wide failure the loud-misconfig contract forbids)
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        except Exception:
+            if _cpu_only(plats):
+                # an explicitly-CPU world cannot collectivize
+                # without gloo — fail now, not mid-chain
+                raise
+            # unset platform on an accelerator build without the
+            # option: the accelerator runtime owns collectives
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _dist_initialized = True
+    return True
+
+
+def host_count(mesh: Mesh) -> int:
+    """Distinct processes contributing devices to this mesh."""
+    return len({d.process_index for d in mesh.devices.flat})
+
+
+def is_multihost(mesh: Mesh) -> bool:
+    return host_count(mesh) > 1
+
+
+def local_device_positions(mesh: Mesh) -> list:
+    """Positions along the mesh's flattened device order owned by
+    THIS process — the rows of a per-device staging stack this host
+    actually ships (everything else is another host's slice)."""
+    me = jax.process_index()
+    return [
+        i
+        for i, d in enumerate(mesh.devices.flat)
+        if d.process_index == me
+    ]
+
+
+def local_device_count(mesh: Mesh) -> int:
+    """This process's devices on the mesh's node axis — the divisor
+    of every per-host traffic figure."""
+    return len(local_device_positions(mesh))
+
+
+def mesh_put(mesh: Mesh, arr, spec) -> jax.Array:
+    """Commit a host array onto the mesh under ``spec``.  Fully
+    addressable (single process): a plain ``device_put`` — byte-for-
+    byte the PR 8 path.  Multi-host: ``make_array_from_callback``, so
+    each process stages ONLY its own addressable shards (a replicated
+    spec stages one copy per local device; a P("nodes") column stages
+    this host's rows and nothing else) — no host ever ships another
+    host's slice, and no full column crosses the network."""
+    sh = NamedSharding(mesh, spec)
+    if sh.is_fully_addressable:
+        return jax.device_put(arr, sh)
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(
+        host.shape, sh, lambda idx: host[idx]
+    )
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     eval_axis: Optional[int] = None,
@@ -59,10 +214,16 @@ def make_mesh(
 ) -> Mesh:
     """Build an (evals, nodes) mesh over the available devices.  When the
     default backend has fewer devices than requested, fall back to the
-    CPU backend (virtual host devices for sharding tests)."""
+    CPU backend (virtual host devices for sharding tests).
+
+    With the NOMAD_TPU_DIST_* knobs set, `distributed_init` joins the
+    multi-process world first and ``jax.devices()`` returns EVERY
+    host's devices — the node axis then spans the whole pod and the
+    same sharded programs run unchanged across processes."""
     from ..device_lock import align_jax_platforms
 
     align_jax_platforms()
+    distributed_init()
     devices = jax.devices(backend) if backend else jax.devices()
     if n_devices is not None and len(devices) < n_devices:
         try:
@@ -225,6 +386,77 @@ def _sharded_walk(final_full, feas_full, perm, off, lim, nc,
     return row, any_emitted, pulls
 
 
+def chain_in_specs(
+    with_spread: bool = False, spread_even: bool = False
+) -> tuple:
+    """The sharded chained runner's input PartitionSpecs, positionally
+    aligned with `sharded_chained_plan`'s argument tuple.  Shared by
+    the runner itself and `place_chain_inputs` (the multi-host launch
+    staging), so the two cannot drift."""
+    from ..ops.batch import PreDeltas, SpreadInputs, StepDeltas
+
+    col = P("nodes")
+    in_specs = (
+        col, col, col,            # totals
+        col, col, col,            # used0
+        P(None, "nodes"),         # feasible [E, C]
+        P(),                      # perm [E, C] replicated (global ids)
+        P(), P(), P(),            # asks [E]
+        P(),                      # desired_count [E]
+        P(),                      # limit [E]
+        P(),                      # wanted [E]
+        P(),                      # n_candidates [E]
+        P(),                      # distinct_hosts [E]
+        P(None, "nodes"),         # coll0 [E, C]
+        P(None, "nodes"),         # affinity [E, C]
+        StepDeltas(               # leading axis E, row-space
+            evict_rows=P(), evict_cpu=P(), evict_mem=P(),
+            evict_disk=P(), evict_coll=P(), penalty_rows=P(),
+        ),
+        PreDeltas(rows=P(), cpu=P(), mem=P(), disk=P()),
+    )
+    if with_spread:
+        in_specs = in_specs + (
+            SpreadInputs(              # leading axis E
+                codes=P(None, None, "nodes"),  # [E, S, C]
+                desired=P(), used0=P(), proposed0=P(),
+                cleared0=P(), weight=P(), active=P(),
+                # percent-only batches pass even=None (skips tracing
+                # the min/max block, mirroring the unsharded kernel)
+                even=P() if spread_even else None,
+            ),
+        )
+    return in_specs
+
+
+def place_chain_inputs(
+    mesh: Mesh, args: tuple,
+    with_spread: bool = False, spread_even: bool = False,
+) -> tuple:
+    """Commit a chunk launch's host-staged arguments onto a MULTI-host
+    mesh under the runner's own in_specs: node-axis leaves land as each
+    process's own shard slices, per-eval leaves replicate onto local
+    devices only, and already-committed device arrays (the sharded
+    usage mirror, the previous chunk's carry) pass through untouched.
+    Single-process launches never need this — jit places host arrays
+    itself — but a multi-controller jit cannot conjure a global array
+    from process-local host data."""
+    specs = chain_in_specs(with_spread, spread_even)
+
+    def place(a, s):
+        if a is None:
+            return None
+        if hasattr(a, "_fields"):  # NamedTuple-of-arrays inputs
+            return type(a)(
+                *[place(f, sf) for f, sf in zip(a, s)]
+            )
+        if isinstance(a, jax.Array):  # carry / mirror: committed
+            return a
+        return mesh_put(mesh, a, s)
+
+    return tuple(place(a, s) for a, s in zip(args, specs))
+
+
 def sharded_chained_plan(mesh: Mesh, n_picks: int,
                          spread_fit: bool = False,
                          with_spread: bool = False,
@@ -269,47 +501,13 @@ def sharded_chained_plan(mesh: Mesh, n_picks: int,
     ``NamedSharding(P("nodes"))`` arrays (the sharded usage mirror /
     the previous chunk's carry) — no resharding happens either way.
     """
-    from ..ops.batch import (
-        PreDeltas,
-        SpreadInputs,
-        StepDeltas,
-        spread_contribution,
-    )
+    from ..ops.batch import spread_contribution
     from ..ops.score import NO_NODE
 
     n_dev = mesh.devices.size
     col = P("nodes")
 
-    in_specs = (
-        col, col, col,            # totals
-        col, col, col,            # used0
-        P(None, "nodes"),         # feasible [E, C]
-        P(),                      # perm [E, C] replicated (global ids)
-        P(), P(), P(),            # asks [E]
-        P(),                      # desired_count [E]
-        P(),                      # limit [E]
-        P(),                      # wanted [E]
-        P(),                      # n_candidates [E]
-        P(),                      # distinct_hosts [E]
-        P(None, "nodes"),         # coll0 [E, C]
-        P(None, "nodes"),         # affinity [E, C]
-        StepDeltas(               # leading axis E, row-space
-            evict_rows=P(), evict_cpu=P(), evict_mem=P(),
-            evict_disk=P(), evict_coll=P(), penalty_rows=P(),
-        ),
-        PreDeltas(rows=P(), cpu=P(), mem=P(), disk=P()),
-    )
-    if with_spread:
-        in_specs = in_specs + (
-            SpreadInputs(              # leading axis E
-                codes=P(None, None, "nodes"),  # [E, S, C]
-                desired=P(), used0=P(), proposed0=P(),
-                cleared0=P(), weight=P(), active=P(),
-                # percent-only batches pass even=None (skips tracing
-                # the min/max block, mirroring the unsharded kernel)
-                even=P() if spread_even else None,
-            ),
-        )
+    in_specs = chain_in_specs(with_spread, spread_even)
 
     # rows/pulls are replicated by construction (post-all-gather walk);
     # the usage carry stays sharded along the node axis so a chunked

@@ -297,6 +297,28 @@ def build_storm_problem(
     )
 
 
+def stage_for_mesh(inputs, mesh):
+    """Commit one storm's staged ``StormInputs`` onto the node-axis
+    mesh for the SHARDED solve (`ops/solve.py
+    storm_assignment_sharded`): node-indexed leaves land sharded
+    ``P("nodes")`` — on a multi-host mesh each process ships ONLY its
+    own shards' slices of the [E, C]/[A, C] masks and the pre-
+    placement columns, so staging a pod-wide storm costs every host
+    O(rows x C/hosts) bytes, not the full problem — and per-eval /
+    per-row leaves replicate onto local devices.  The arena capacity
+    must tile evenly over the mesh (the caller's gate; same condition
+    as ``mesh_capable``)."""
+    from ..ops.solve import StormInputs, storm_in_specs
+    from ..parallel.mesh import mesh_put
+
+    return StormInputs(
+        *(
+            mesh_put(mesh, np.asarray(leaf), spec)
+            for leaf, spec in zip(inputs, storm_in_specs())
+        )
+    )
+
+
 def decompose(problem: StormProblem, out) -> int:
     """Map the converged assignment back onto the members: fill each
     solved member's ``(rows, pulls)`` pick lists (broker FIFO order is

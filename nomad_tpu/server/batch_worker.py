@@ -132,6 +132,7 @@ MESH_COUNTERS = ("mesh.launches",)
 MESH_GAUGES = (
     "mesh.bytes_per_flush",
     "mesh.chunk_width",
+    "mesh.hosts",
     "mesh.mirror_hit_rate",
 )
 # global storm solver (NOMAD_TPU_STORM=1) metrics, zero-registered at
@@ -909,6 +910,11 @@ class BatchWorker(Worker):
         # FLOPs scale ~1/devices (parallel/mesh.py
         # sharded_chained_plan)
         self._mesh = None
+        # processes contributing devices to the mesh (1 = the PR 8
+        # single-host world; >1 = a NOMAD_TPU_DIST multi-host pod,
+        # which flips the mirror staging to the per-host protocol and
+        # pins compiles inline — see _launch_chunk_mesh)
+        self._mesh_hosts = 1
         self._sharded_runners: Dict[tuple, object] = {}
         # opt-in: virtual CPU meshes make every launch slower (the
         # sharding tests cover parity); real multi-chip TPU deployments
@@ -956,9 +962,21 @@ class BatchWorker(Worker):
         None otherwise (and on any failure — the mesh is an
         optimization, never a requirement).  NOMAD_TPU_MESH_DEVICES
         caps the node axis (bench sweeps and deployments that reserve
-        chips for other work)."""
+        chips for other work).
+
+        With the NOMAD_TPU_DIST_* knobs set, the multi-host world is
+        joined FIRST (`distributed_init`, idempotent) so
+        ``jax.devices()`` counts every host's devices and the node
+        axis spans the pod.  A misconfigured world raises out of here
+        deliberately — the peer processes would deadlock inside their
+        first collective waiting for a member that silently fell back
+        to single-host."""
         import os as _os
 
+        from ..parallel.mesh import distributed_init
+
+        distributed_init()
+        self._mesh_hosts = 1
         try:
             import jax as _jax
 
@@ -972,9 +990,11 @@ class BatchWorker(Worker):
             if cap > 0:
                 n = min(n, cap)
             if n > 1:
-                from ..parallel.mesh import make_mesh
+                from ..parallel.mesh import host_count, make_mesh
 
-                return make_mesh(n_devices=n, eval_axis=1)
+                mesh = make_mesh(n_devices=n, eval_axis=1)
+                self._mesh_hosts = host_count(mesh)
+                return mesh
         except Exception:  # noqa: BLE001 — mesh is an optimization
             pass
         return None
@@ -1057,14 +1077,27 @@ class BatchWorker(Worker):
         # donation only helps off-CPU; re-resolve for the new target
         self._donate_carries = None
         if sup.failed_over():
-            # sharded mesh path: off while on the CPU fallback
+            # sharded mesh path: off while on the CPU fallback.  On a
+            # multi-host mesh this is ALSO the peer-death path: a dead
+            # process surfaces as a collective error on the healthy
+            # hosts, the watchdog trips the supervisor, and every
+            # in-flight chain drops through the exact-sequential
+            # fallback — zero lost evals, same as a wedged chip
             self._mesh = None
+            self._mesh_hosts = 1
         elif self._mesh_requested and self._mesh is None:
             self._mesh = self._make_mesh()
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
             metrics.set_gauge(
                 "batch_worker.backend_epoch", float(epoch)
+            )
+            # the pod-width gauge must not report the old world
+            # through the exact incident it exists for (a peer-death
+            # failover drops the mesh; the sharded sync that normally
+            # refreshes it cannot run while failed over)
+            metrics.set_gauge(
+                "mesh.hosts", float(self._mesh_hosts)
             )
         LOG.warning(
             "batch worker re-targeted (%s -> %s, %s): caches flushed, "
@@ -2502,16 +2535,42 @@ class BatchWorker(Worker):
         pow2-bucketed by the problem builder so traces stay cached
         across storms.  ``snap`` is the SAME snapshot the problem
         was staged against — the solve's arena row indices are only
-        meaningful against that table."""
+        meaningful against that table.
+
+        On a mesh worker the solve runs NODE-SHARDED over the same
+        mesh (and the same sharded usage mirror) as the chunk chain:
+        each device scores and auctions its own node shard, and the
+        assignment is bit-identical to the single-device solve — on a
+        multi-host mesh this is the path that solves pod-wide storms
+        no single chip's HBM could hold."""
         import jax
 
         from ..ops.solve import storm_assignment
 
         table = snap.node_table
-        cols = self._device_columns(table)
         max_rounds = problem.max_rounds
         if self.storm_rounds > 0:
             max_rounds = min(max_rounds, self.storm_rounds)
+        mesh = self._mesh
+        if (
+            mesh is not None
+            and table.capacity % mesh.devices.size == 0
+        ):
+            from ..ops.solve import storm_assignment_sharded
+            from ..sched.storm import stage_for_mesh
+
+            cols = self._device_columns(table, sharded=True)
+            fn = storm_assignment_sharded(
+                mesh,
+                spread_fit=problem.spread_fit,
+                max_rounds=max_rounds,
+            )
+            inp = stage_for_mesh(problem.inputs, mesh)
+            out = fn(inp, cols)
+            # replicated outputs: every process holds the full
+            # answer — no cross-host fetch
+            return tuple(np.asarray(x) for x in out)
+        cols = self._device_columns(table)
         out = storm_assignment(
             problem.inputs, cols,
             spread_fit=problem.spread_fit,
@@ -3826,13 +3885,30 @@ class BatchWorker(Worker):
         )
         if sharded:
             key = key + ("sharded", self._mesh.devices.size)
-            from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as _P
 
-            target_sharding = NamedSharding(self._mesh, _P("nodes"))
+            from ..parallel.mesh import (
+                local_device_positions,
+                mesh_put,
+            )
+
+            # multi-host: each process stages ONLY its own shards
+            # (mesh_put -> make_array_from_callback); fully
+            # addressable meshes keep the PR 8 device_put byte-for-
+            # byte.  Every byte figure below is PER HOST: this
+            # process's host->device staging, the pod's per-host
+            # cross-host flush cost
+            multihost = self._mesh_hosts > 1
+            n_dev = self._mesh.devices.size
+            local_pos = (
+                local_device_positions(self._mesh)
+                if multihost
+                else list(range(n_dev))
+            )
+            n_local = len(local_pos)
 
             def put(col):
-                return jax.device_put(col, target_sharding)
+                return mesh_put(self._mesh, col, _P("nodes"))
 
         else:
             # explicit placement while failed over (the CPU backend);
@@ -3873,6 +3949,10 @@ class BatchWorker(Worker):
             )
             cols = tuple(put(col) for col in host_cols)
             bytes_up = sum(col.nbytes for col in host_cols)
+            if sharded and multihost:
+                # cold resync on a pod: each host uploads only its
+                # own 1/hosts slice of every column
+                bytes_up = bytes_up * n_local // n_dev
             cache = {"key": key, "gen": gen, "cols": cols}
             setattr(self, cache_attr, cache)
             # full re-upload: the cache now holds fresh buffers no
@@ -3892,15 +3972,11 @@ class BatchWorker(Worker):
                     put(col) for col in host_used
                 )
                 bytes_up = sum(col.nbytes for col in host_used)
+                if sharded and multihost:
+                    bytes_up = bytes_up * n_local // n_dev
                 setattr(self, dirty_attr, False)
             elif rows:
                 idx = np.asarray(sorted(rows), dtype=np.int32)
-                # pad the row axis to a pow2 bucket so the scatter
-                # keeps one trace per bucket; padding indexes C
-                # (out of bounds -> dropped, never wrapped)
-                width = _pow2(len(idx), floor=8)
-                idx_p = np.full(width, table.capacity, np.int32)
-                idx_p[: len(idx)] = idx
                 # hot-path donation (off-CPU): the stale column and
                 # the idx/vals staging buffers are consumed in place,
                 # so a steady-state delta sync allocates nothing net
@@ -3915,18 +3991,51 @@ class BatchWorker(Worker):
                     and not getattr(self, dirty_attr)
                     and not compiling
                 )
-                if sharded:
-                    from ..ops.batch import patch_rows_sharded
+                idx_dev = per_dev = idx_p = None
+                if sharded and multihost:
+                    # per-host flush protocol: every process builds
+                    # the SAME [D, w] shard-local staging from the
+                    # shared dirty log, then ships ONLY its own
+                    # devices' rows (mesh_put) — a warm cross-host
+                    # flush costs each host O(its dirty rows) bytes,
+                    # never a replicated buffer over the network
+                    from ..ops.batch import (
+                        hostlocal_staging,
+                        patch_rows_hostlocal,
+                    )
 
-                    patch = patch_rows_sharded(
+                    patch = patch_rows_hostlocal(
                         self._mesh, donate=donate
                     )
-                elif donate:
-                    from ..ops.batch import patch_rows_donated
-
-                    patch = patch_rows_donated()
+                    idx_stack, per_dev, width = hostlocal_staging(
+                        self._mesh, idx, table.capacity
+                    )
+                    idx_dev = mesh_put(
+                        self._mesh, idx_stack, _P("nodes")
+                    )
+                    # the index staging ships once for all three
+                    # value columns
+                    bytes_up += n_local * width * 4
                 else:
-                    patch = patch_rows
+                    # replicated staging: pad the row axis to a pow2
+                    # bucket so the scatter keeps one trace per
+                    # bucket; padding indexes C (out of bounds ->
+                    # dropped, never wrapped)
+                    width = _pow2(len(idx), floor=8)
+                    idx_p = np.full(width, table.capacity, np.int32)
+                    idx_p[: len(idx)] = idx
+                    if sharded:
+                        from ..ops.batch import patch_rows_sharded
+
+                        patch = patch_rows_sharded(
+                            self._mesh, donate=donate
+                        )
+                    elif donate:
+                        from ..ops.batch import patch_rows_donated
+
+                        patch = patch_rows_donated()
+                    else:
+                        patch = patch_rows
                 patched = []
                 try:
                     for col, src in zip(
@@ -3937,6 +4046,28 @@ class BatchWorker(Worker):
                             table.disk_used,
                         ),
                     ):
+                        if idx_dev is not None:
+                            # multi-host: per-device value staging in
+                            # the shard-local layout of idx_stack —
+                            # only THIS host's rows are gathered
+                            # (mesh_put ships nothing else; remote
+                            # rows would be (H-1)/H wasted work on
+                            # the hot flush path)
+                            vals_stack = np.zeros(
+                                (n_dev, width), dtype=src.dtype
+                            )
+                            for d in local_pos:
+                                sel = per_dev[d]
+                                vals_stack[d, : len(sel)] = src[sel]
+                            bytes_up += (
+                                n_local * width * src.dtype.itemsize
+                            )
+                            vals_dev = mesh_put(
+                                self._mesh, vals_stack, _P("nodes")
+                            )
+                            # nomadlint: disable=donation-safety -- re-verified for the multi-host mirror (this PR): patch_rows_hostlocal(donate=True) donates a column of cache["cols"], replaced by the patched outputs below before any later read; same per-mirror dirty-flag + no-background-compile gating, same drop-the-mirror except path
+                            patched.append(patch(col, idx_dev, vals_dev))
+                            continue
                         vals = np.zeros(width, dtype=src.dtype)
                         vals[: len(idx)] = src[idx]
                         bytes_up += idx_p.nbytes + vals.nbytes
@@ -3980,6 +4111,11 @@ class BatchWorker(Worker):
                 metrics.set_gauge(
                     "mesh.mirror_hit_rate",
                     self._mesh_mirror_hits / total if total else 0.0,
+                )
+                # pod visibility: how many processes the node axis
+                # spans (1 = single-host PR 8 mesh)
+                metrics.set_gauge(
+                    "mesh.hosts", float(self._mesh_hosts)
                 )
         else:
             if hit:
@@ -4732,7 +4868,28 @@ class BatchWorker(Worker):
         )
         if spread_arg is not None:
             args = args + (spread_arg,)
-        if check_ready and not self._launch_ready(
+        if self._mesh_hosts > 1:
+            # multi-host: a multi-controller jit cannot conjure a
+            # global array from process-local host data — commit the
+            # staged args under the runner's own in_specs (each host
+            # ships only its shards; carry/mirror pass through).  The
+            # cold-compile shield is ALSO bypassed: it "compiles" by
+            # executing on a background thread, and a collective
+            # execution outside the lockstep launch order would
+            # deadlock the pod — first-dispatch compiles block inline
+            # instead (pods warm shapes at start, every process
+            # running the same warm sequence)
+            from ..parallel.mesh import place_chain_inputs
+
+            args = place_chain_inputs(
+                self._mesh, args,
+                with_spread=spread_arg is not None,
+                spread_even=(
+                    spread_arg is not None
+                    and spread_arg.even is not None
+                ),
+            )
+        elif check_ready and not self._launch_ready(
             args, {}, fn=runner
         ):
             return None

@@ -1,0 +1,422 @@
+"""Multi-host mesh tests (ROADMAP item 3): the sharded storm auction
+must be bit-identical to the single-device solve, the per-host flush
+primitive must be bit-identical to the replicated PR 8 staging, the
+single-process distributed path must be bit-identical to the PR 8
+sharded path (the degenerate-parity floor), and a REAL 2-process
+jax.distributed world (spawned CPU workers, gloo collectives) must
+run the full assemble/launch/fetch/replay chain with zero lost evals
+and cross-host parity.
+"""
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import compute_node_class
+
+
+def _mesh8():
+    from nomad_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8, eval_axis=1)
+
+
+# ---------------------------------------------------------------------------
+# sharded storm auction == single-device solve, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _storm_problem(E, A, C, ask=(100.0, 100.0, 100.0), limit=2,
+                   seed=0, shared_perm=False, feas_p=0.15):
+    from nomad_tpu.ops.solve import StormInputs
+
+    rng = np.random.default_rng(seed)
+    if shared_perm:
+        perm = np.tile(
+            rng.permutation(C).astype(np.int32), (E, 1)
+        )
+    else:
+        perm = np.stack(
+            [rng.permutation(C).astype(np.int32) for _ in range(E)]
+        )
+    inp = StormInputs(
+        feasible=rng.random((E, C)) > feas_p,
+        affinity=np.where(
+            rng.random((E, C)) > 0.8, rng.random((E, C)), 0.0
+        ),
+        collisions=(rng.random((E, C)) > 0.9).astype(np.int32),
+        perm=perm,
+        limit=np.full(E, limit, np.int32),
+        n_cand=np.full(E, C, np.int32),
+        eval_of=(np.arange(A) % E).astype(np.int32),
+        penalty=rng.random((A, C)) > 0.95,
+        ask=np.tile(np.asarray(ask, np.float64), (A, 1)),
+        desired=np.ones(A, np.int32),
+        real=np.ones(A, bool),
+        pre_cpu=np.zeros(C),
+        pre_mem=np.zeros(C),
+        pre_disk=np.zeros(C),
+    )
+    cols = tuple(
+        np.asarray(x, np.float64)
+        for x in (
+            np.full(C, 4000.0),
+            np.full(C, 8192.0),
+            np.full(C, 100000.0),
+            rng.integers(0, 2000, C).astype(np.float64),
+            rng.integers(0, 4096, C).astype(np.float64),
+            np.zeros(C),
+        )
+    )
+    return inp, cols
+
+
+def _run_both(inp, cols, max_rounds, mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nomad_tpu.ops.solve import (
+        storm_assignment,
+        storm_assignment_sharded,
+    )
+    from nomad_tpu.sched.storm import stage_for_mesh
+
+    single = storm_assignment(
+        inp, cols, spread_fit=False, max_rounds=max_rounds
+    )
+    sharded = storm_assignment_sharded(
+        mesh, spread_fit=False, max_rounds=max_rounds
+    )(
+        stage_for_mesh(inp, mesh),
+        tuple(
+            jax.device_put(
+                c, NamedSharding(mesh, P("nodes"))
+            )
+            for c in cols
+        ),
+    )
+    return (
+        tuple(np.asarray(x) for x in single),
+        tuple(np.asarray(x) for x in sharded),
+    )
+
+
+NAMES = ("assigned", "pulls", "acc_round", "score", "greedy",
+         "rounds")
+
+
+@pytest.mark.parametrize(
+    "E,A,C,kw",
+    [
+        # identical-ask dog-pile on one shared walk order: the
+        # contention case the auction exists for
+        (16, 64, 256, dict(ask=(1000.0, 100.0, 100.0),
+                           shared_perm=True)),
+        # mixed random feasibility / affinities / penalties
+        (8, 32, 64, dict(seed=3)),
+        (4, 8, 128, dict(seed=9, limit=5)),
+        # degenerate one-row storm: the greedy-walk parity floor
+        (1, 1, 16, dict(seed=7, limit=3)),
+        # infeasible-heavy: NO_NODE rows must match too
+        (16, 128, 64, dict(ask=(3000.0, 4000.0, 50000.0), seed=5)),
+    ],
+)
+def test_sharded_storm_bit_identical_to_single_device(E, A, C, kw):
+    """Every output of the node-sharded auction — assignments, pulls,
+    acceptance rounds, scores, greedy picks AND the round count —
+    must equal the single-device solve bit-for-bit, including
+    NO_NODE rows."""
+    inp, cols = _storm_problem(E, A, C, **kw)
+    single, sharded = _run_both(inp, cols, A, _mesh8())
+    for name, s, m in zip(NAMES, single, sharded):
+        assert np.array_equal(s, m), (
+            f"sharded storm diverged in {name}"
+        )
+
+
+def test_sharded_storm_padding_and_rounds():
+    """Padding rows stay NO_NODE on the sharded path, and a
+    round-capped solve caps identically."""
+    inp, cols = _storm_problem(4, 16, 64, seed=2)
+    real = np.ones(16, bool)
+    real[11:] = False
+    inp = inp._replace(real=real)
+    single, sharded = _run_both(inp, cols, 2, _mesh8())
+    for name, s, m in zip(NAMES, single, sharded):
+        assert np.array_equal(s, m), name
+    assert (single[0][11:] == -1).all()
+    assert int(single[5]) <= 2
+
+
+# ---------------------------------------------------------------------------
+# per-host flush primitive == replicated PR 8 staging
+# ---------------------------------------------------------------------------
+
+
+def test_patch_rows_hostlocal_matches_replicated():
+    """`patch_rows_hostlocal` (per-device shard-local staging — the
+    multi-host protocol) must produce a mirror bit-identical to
+    `patch_rows_sharded` (replicated staging — the PR 8 protocol)
+    for the same dirty set."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nomad_tpu.ops.batch import (
+        hostlocal_staging,
+        patch_rows_hostlocal,
+        patch_rows_sharded,
+        pow2_bucket,
+    )
+
+    mesh = _mesh8()
+    C = 64
+    rng = np.random.default_rng(13)
+    col_host = rng.random(C)
+    sharding = NamedSharding(mesh, P("nodes"))
+
+    for dirty in (
+        [0],                       # one shard only
+        [3, 8, 9, 17, 40, 63],     # several shards
+        list(range(24, 48)),       # two full shards
+        sorted(rng.choice(C, 20, replace=False).tolist()),
+    ):
+        idx = np.asarray(sorted(dirty), np.int32)
+        vals_src = rng.random(C)
+
+        # replicated PR 8 staging
+        width = pow2_bucket(len(idx), floor=8)
+        idx_p = np.full(width, C, np.int32)
+        idx_p[: len(idx)] = idx
+        vals_p = np.zeros(width)
+        vals_p[: len(idx)] = vals_src[idx]
+        a = patch_rows_sharded(mesh)(
+            jax.device_put(col_host, sharding), idx_p, vals_p
+        )
+
+        # per-device shard-local staging
+        idx_stack, per_dev, w = hostlocal_staging(mesh, idx, C)
+        n_dev = mesh.devices.size
+        vals_stack = np.zeros((n_dev, w))
+        for d, sel in enumerate(per_dev):
+            vals_stack[d, : len(sel)] = vals_src[sel]
+        b = patch_rows_hostlocal(mesh)(
+            jax.device_put(col_host, sharding),
+            jax.device_put(idx_stack, sharding),
+            jax.device_put(vals_stack, sharding),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=str(dirty)
+        )
+        # and both equal the host-side oracle
+        want = col_host.copy()
+        want[idx] = vals_src[idx]
+        np.testing.assert_array_equal(np.asarray(b), want)
+
+
+# ---------------------------------------------------------------------------
+# single-process degenerate parity: DIST=1 == the PR 8 sharded path
+# ---------------------------------------------------------------------------
+
+
+def _make_nodes(n, seed=0):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        node = mock.node(id=f"dp-node-{seed}-{i}")
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def _make_jobs(n, seed=1):
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(n):
+        job = mock.job(id=f"dp-{i}")
+        job.task_groups[0].count = rng.randint(1, 4)
+        job.task_groups[0].tasks[0].resources.cpu = rng.choice(
+            [200, 400]
+        )
+        jobs.append(job)
+    return jobs
+
+
+def _placements(server, jobs):
+    return sorted(
+        (j.id, a.name, a.node_id)
+        for j in jobs
+        for a in server.store.allocs_by_job("default", j.id)
+        if not a.terminal_status()
+    )
+
+
+def _outcomes(server, jobs):
+    return sorted(
+        (
+            j.id,
+            e.status,
+            e.status_description,
+            tuple(sorted(e.queued_allocations.items())),
+        )
+        for j in jobs
+        for e in server.store.evals_by_job("default", j.id)
+    )
+
+
+def _metrics_view(server, jobs):
+    """AllocMetrics from the explain ring, wall-clock fields
+    stripped."""
+    from nomad_tpu.explain import EXPLAIN
+
+    out = []
+    for j in jobs:
+        for ev in sorted(
+            server.store.evals_by_job("default", j.id),
+            key=lambda e: e.create_index,
+        ):
+            rec = EXPLAIN.get(ev.id)
+            if rec is None:
+                out.append((j.id, None))
+                continue
+            tgs = {}
+            for tg, entry in rec["TaskGroups"].items():
+                metric = entry.get("Metric")
+                if metric is not None:
+                    metric = {
+                        k: v
+                        for k, v in metric.items()
+                        if k != "AllocationTime"
+                    }
+                tgs[tg] = (
+                    entry["Placed"], entry["Failed"],
+                    entry["Winner"], metric,
+                )
+            out.append((j.id, tgs))
+    return out
+
+
+def _run_server(jobs, nodes):
+    from nomad_tpu.ops.batch import pow2_bucket
+
+    server = Server(
+        num_schedulers=1, seed=47, batch_pipeline=True
+    )
+    for node in nodes:
+        server.register_node(copy.deepcopy(node))
+    server.start()
+    try:
+        worker = server.workers[0]
+        assert worker._mesh is not None
+        assert worker._mesh_hosts == 1
+        for job in jobs:
+            server.register_job(copy.deepcopy(job))
+        assert server.drain_to_idle(60)
+        table = server.store.node_table
+        # warm sharded flush with a known dirty set: the byte
+        # accounting must be the PR 8 replicated closed form
+        gen = worker._usage_cache_sharded["gen"]
+        _, dirty = server.store.usage_delta_since(gen)
+        worker._device_columns(table, sharded=True)
+        staged = server.metrics.get_gauge("mesh.bytes_per_flush")
+        if dirty:
+            width = pow2_bucket(len(dirty), floor=8)
+            assert staged == 3 * (width * 4 + width * 8)
+        else:
+            assert staged == 0.0
+        assert server.metrics.get_gauge("mesh.hosts") == 1.0
+        return (
+            _placements(server, jobs),
+            _outcomes(server, jobs),
+            _metrics_view(server, jobs),
+            staged,
+        )
+    finally:
+        server.stop()
+
+
+def test_single_process_dist_path_bit_identical(monkeypatch):
+    """With one process, the distributed mesh path (NOMAD_TPU_DIST=1)
+    must be bit-identical to the PR 8 sharded path: placements,
+    outcomes, AllocMetrics and mirror flush bytes."""
+    monkeypatch.setenv("NOMAD_TPU_MESH", "1")
+    # strict replay: relaxed mode's wave-snapshot score envelope is
+    # documented run-to-run jitter — strict pins full score-metric
+    # bit-identity (same contract the PR 8 parity suite uses)
+    monkeypatch.setenv("NOMAD_TPU_REPLAY_STRICT", "1")
+    jobs = _make_jobs(8, seed=3)
+    nodes = _make_nodes(12, seed=5)
+
+    monkeypatch.delenv("NOMAD_TPU_DIST", raising=False)
+    base = _run_server(jobs, nodes)
+
+    monkeypatch.setenv("NOMAD_TPU_DIST", "1")
+    monkeypatch.setenv("NOMAD_TPU_DIST_PROCS", "1")
+    monkeypatch.setenv("NOMAD_TPU_DIST_ID", "0")
+    dist = _run_server(jobs, nodes)
+
+    assert base[0] == dist[0], "placements diverged"
+    assert base[1] == dist[1], "eval outcomes diverged"
+    assert base[2] == dist[2], "AllocMetrics diverged"
+    assert base[3] == dist[3], "mirror flush bytes diverged"
+    assert base[0], "nothing placed"
+
+
+def test_dist_config_misconfig_raises(monkeypatch):
+    """An opted-in world with malformed knobs must RAISE, never
+    silently degrade to single-host — the peers would deadlock in
+    their first collective waiting for the missing member."""
+    from nomad_tpu.parallel.mesh import dist_config
+
+    monkeypatch.setenv("NOMAD_TPU_DIST", "1")
+    monkeypatch.setenv("NOMAD_TPU_DIST_PROCS", "two")
+    with pytest.raises(ValueError):
+        dist_config()
+    monkeypatch.setenv("NOMAD_TPU_DIST_PROCS", "2")
+    monkeypatch.setenv("NOMAD_TPU_DIST_ID", "2")
+    with pytest.raises(ValueError):
+        dist_config()
+    monkeypatch.setenv("NOMAD_TPU_DIST_ID", "1")
+    cfg = dist_config()
+    assert (cfg.num_processes, cfg.process_id) == (2, 1)
+    # the documented off-switch: <=1 keeps distributed init off
+    monkeypatch.setenv("NOMAD_TPU_DIST_PROCS", "0")
+    assert dist_config().num_processes == 1
+    monkeypatch.setenv("NOMAD_TPU_DIST", "0")
+    assert dist_config() is None
+
+
+# ---------------------------------------------------------------------------
+# the real thing: a 2-process jax.distributed world
+# ---------------------------------------------------------------------------
+
+
+def test_two_process_distributed_smoke():
+    """Spawn a REAL 2-process distributed world (CPU backend, gloo)
+    and run the full assemble/launch/fetch/replay chain, the
+    per-host cross-host flush, and the sharded storm solve through
+    it — zero lost evals, closed-form per-host flush bytes, storm
+    solve bit-identical to single-device, and placement digests
+    identical across processes."""
+    from nomad_tpu.parallel.dist_smoke import launch
+
+    row = launch(procs=2, timeout=360.0)
+    assert row["procs"] == 2
+    assert row["global_devices"] == 4
+    assert row["zero_lost"] is True
+    assert row["cross_host_parity"] is True
+    assert row["chain"]["mesh_launches"] >= 1
+    assert row["chain"]["placements"] > 0
+    assert row["storm"]["solves"] >= 1
+    assert row["storm_kernel"]["bit_identical"] is True
+    # the acceptance gauge: per-host cross-host traffic is O(dirty
+    # rows), not O(nodes)
+    flush = row["flush"]
+    assert (
+        flush["bytes_per_flush_delta_per_host"]
+        < flush["bytes_per_flush_full_per_host"]
+    )

@@ -25,6 +25,14 @@ if [ "${SMOKE:-1}" = "1" ]; then
         tests/test_flowgraph.py \
         tests/test_tsan.py \
         tests/test_stage_accounting.py
+
+    echo "== 2-process distributed smoke (CPU backend, gloo) =="
+    # the multi-host mesh gate: distributed init, pod-mesh chain with
+    # zero lost evals, per-host O(dirty rows) cross-host flush, and
+    # the sharded storm solve bit-identical to single-device — the
+    # launcher kills a deadlocked world at the timeout, so a
+    # collective hang fails the gate instead of wedging it
+    python -m nomad_tpu.parallel.dist_smoke --procs 2 --timeout 360
 fi
 
 echo "ci_check: all green"

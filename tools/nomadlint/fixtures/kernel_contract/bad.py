@@ -1,6 +1,7 @@
 """kernel-contract bad fixture: a ladder whose two rungs collapse
-onto ONE compiled signature, and whose output dtype escapes the
-declared closure."""
+onto ONE compiled signature, whose output dtype escapes the declared
+closure — and NO multi-host pod ladder (no MESH_HOST_WIDTHS), so pod
+recompiles could drift silently."""
 import jax
 import numpy as np
 

@@ -1,8 +1,11 @@
-"""kernel-contract clean fixture: distinct rungs, closed dtypes."""
+"""kernel-contract clean fixture: distinct rungs, closed dtypes,
+and a declared multi-host pod ladder."""
 import jax
 import numpy as np
 
 from nomad_tpu.ops.contracts import KernelContract
+
+MESH_HOST_WIDTHS = (8, 16)
 
 
 def _kernel():

@@ -125,6 +125,7 @@ SHARED_STATE_ALLOWLIST: Tuple[Tuple[str, str, str], ...] = (
         r"_backend_epoch|_cand_cache|_mask_cache|_port_col_cache"
         r"|_dev_codes_cache|_dev_aff_cache|_donate_carries"
         r"|_launch_ewma|_launch_ewma_seed|_mesh_ewma_seed|_mesh"
+        r"|_mesh_hosts"
         r"|_sharded_runners|_mirror_dirty|_mirror_dirty_sharded"
         r"|_usage_cache|_usage_cache_sharded",
         "the documented wedge-bypass epoch protocol: "

@@ -9,7 +9,10 @@ ladder rung must be a distinct compiled signature, ``eval_shape``
 must succeed on each, and output dtypes must stay inside the
 declared closed set with no weak types.  It also AST-cross-checks
 the contract's chunk ladder against ``batch_worker.CHUNK_BUCKETS``
-so the contract cannot drift from the worker's live bucket policy.
+so the contract cannot drift from the worker's live bucket policy,
+and requires the MULTI-host pod ladder (``MESH_HOST_WIDTHS`` plus
+the ``mesh_host``/``storm_mesh`` contracts) — a pod resize walking
+an undeclared width would recompile every process's kernels at once.
 """
 from __future__ import annotations
 
@@ -21,23 +24,37 @@ from typing import List, Optional, Tuple
 from ..core import Context, Finding, Rule, register
 
 
-def _chunk_buckets_literal(tree: ast.AST) -> Optional[Tuple[int, ...]]:
+def _int_tuple_literal(
+    tree: ast.AST, name: str
+) -> Optional[Tuple[int, ...]]:
     for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):  # NAME: Tuple[...] = (...)
+            targets = [node.target]
+            value = node.value
+        else:
             continue
-        for target in node.targets:
+        if value is None:
+            continue
+        for target in targets:
             if (
                 isinstance(target, ast.Name)
-                and target.id == "CHUNK_BUCKETS"
+                and target.id == name
             ):
                 vals = [
                     n.value
-                    for n in ast.walk(node.value)
+                    for n in ast.walk(value)
                     if isinstance(n, ast.Constant)
                     and isinstance(n.value, int)
                 ]
                 return tuple(vals)
     return None
+
+
+def _chunk_buckets_literal(tree: ast.AST) -> Optional[Tuple[int, ...]]:
+    return _int_tuple_literal(tree, "CHUNK_BUCKETS")
 
 
 def _load_fixture_contracts(path: str):
@@ -64,21 +81,51 @@ class KernelContractRule(Rule):
         contracts_path = ctx.path("ops_contracts")
         findings: List[Finding] = []
         override = ctx.overrides.get("ops_contracts")
+        # multi-host ladder presence (override-aware): a contracts
+        # module without a declared MESH_HOST_WIDTHS pod ladder lets
+        # a pod resize recompile every process's kernels silently —
+        # ROADMAP item 3 names this check explicitly
+        ladder_path = override or contracts_path
+        host_widths = _int_tuple_literal(
+            ctx.tree(ladder_path), "MESH_HOST_WIDTHS"
+        )
+        if not host_widths:
+            findings.append(
+                Finding(
+                    self.name, ladder_path, 0,
+                    "no MESH_HOST_WIDTHS multi-host shape ladder "
+                    "declared — pod recompiles can drift silently",
+                )
+            )
         if override is not None:
             try:
                 contract_list = _load_fixture_contracts(override)
             except Exception as exc:  # noqa: BLE001
-                return [
+                return findings + [
                     Finding(
                         self.name, override, 0,
                         f"contract module failed to load: {exc}",
                     )
                 ]
             violations = live.check_contracts(contract_list)
-            return [
+            return findings + [
                 Finding(self.name, override, 0, v)
                 for v in violations
             ]
+        # the live module's pod ladder must be wired into real
+        # contracts, not just declared: one rung per width for both
+        # the chained runner and the sharded storm solve
+        names = {c.name for c in live.iter_contracts()}
+        for required in ("mesh_host", "storm_mesh"):
+            if required not in names:
+                findings.append(
+                    Finding(
+                        self.name, contracts_path, 0,
+                        f"no '{required}' contract in "
+                        "iter_contracts() — the declared multi-host "
+                        "ladder is not checked against any kernel",
+                    )
+                )
         for v in live.check_contracts():
             findings.append(
                 Finding(self.name, contracts_path, 0, v)
