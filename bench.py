@@ -1020,6 +1020,9 @@ def _share_classes(nodes):
 
 WITH_CONFIGS = os.environ.get("BENCH_CONFIGS", "1") == "1"
 WITH_MULTICHIP = os.environ.get("BENCH_MULTICHIP", "1") == "1"
+WITH_CLUSTER_FAILOVER = (
+    os.environ.get("BENCH_CLUSTER_FAILOVER", "1") == "1"
+)
 WITH_TRACE_OVERHEAD = os.environ.get("BENCH_TRACE_OVERHEAD", "1") == "1"
 WITH_EXPLAIN_OVERHEAD = (
     os.environ.get("BENCH_EXPLAIN_OVERHEAD", "1") == "1"
@@ -1228,6 +1231,31 @@ def bench_multichip():
             f"(bit_identical={mh['storm_bit_identical']})"
         )
     log(f"multichip sweep took {time.time() - t0:.1f}s")
+    return block
+
+
+def bench_cluster_failover():
+    """Leadership-loss chaos harness as a bench block: a 3-server
+    raft cluster survives 5 leader kills + a healed partition under
+    continuous eval load (nomad_tpu.raft.chaos_smoke), recording
+    every kill's revoke→re-establish detect-to-resume time plus the
+    zero-lost / zero-duplicate / monotone-apply verdicts
+    (`cluster_failover` in BENCH json).  BENCH_CLUSTER_FAILOVER=0
+    opts out."""
+    from nomad_tpu.raft.chaos_smoke import run_smoke
+
+    t0 = time.time()
+    block = run_smoke(jobs=400, kills=5, nodes=6)
+    log(
+        f"cluster failover: ok={block['ok']} "
+        f"kills={block['kills']} "
+        f"detect-to-resume p50 {block['detect_to_resume_p50_s']}s "
+        f"max {block['detect_to_resume_max_s']}s, "
+        f"{block['placements_total']} placements, "
+        f"{block['lost_evals']} lost, "
+        f"{block['duplicate_placements']} duplicates "
+        f"({time.time() - t0:.1f}s)"
+    )
     return block
 
 
@@ -1595,6 +1623,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"device-supervisor microbench FAILED: {exc!r}")
             device = {"error": repr(exc)}
+    cluster_failover = {}
+    if WITH_CLUSTER_FAILOVER:
+        try:
+            cluster_failover = bench_cluster_failover()
+        except Exception as exc:  # noqa: BLE001
+            log(f"cluster failover chaos FAILED: {exc!r}")
+            cluster_failover = {"error": repr(exc)}
 
     n_check = min(E2E_ORACLE_JOBS, E2E_JOBS)
     parity_ok = same == n_check
@@ -1644,6 +1679,10 @@ def main():
                     kernel.get("kernel-chained", 0.0), 1
                 ),
                 "device_supervisor": device,
+                # leadership-loss chaos: 5 leader kills + a healed
+                # partition under load — per-kill detect-to-resume
+                # times and the zero-lost/zero-duplicate verdicts
+                "cluster_failover": cluster_failover,
                 # global storm solver: mass-drain/scale-up replay
                 # A/B'd storm-on vs storm-off (placements/s, solver
                 # rounds, fallbacks, quality delta, zero-lost proof)

@@ -120,6 +120,24 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "1 makes cold kernel compiles block (deterministic tests) "
         "instead of background-compiling behind the shield",
     ),
+    # -- cluster / failover (server/cluster.py, raft/chaos.py) --------
+    "NOMAD_TPU_FORWARD_RETRIES": EnvKnob(
+        "4", "nomad_tpu/server/cluster.py",
+        "leader-forward retry budget after the first attempt; each "
+        "retry rediscovers the leader (command ids keep retries "
+        "idempotent)",
+    ),
+    "NOMAD_TPU_FORWARD_BACKOFF_S": EnvKnob(
+        "0.05", "nomad_tpu/server/cluster.py",
+        "initial leader-forward retry backoff, doubling per attempt "
+        "(capped at 1s)",
+    ),
+    "NOMAD_TPU_CLUSTER_FAULT": EnvKnob(
+        "", "nomad_tpu/raft/chaos.py",
+        "deterministic cluster fault plan "
+        "(leader_kill|partition[:a,b]|msg_drop[:pct]|slow_wire[:ms]) "
+        "for the chaos harness",
+    ),
     # -- server / broker ----------------------------------------------
     "NOMAD_TPU_WARM_ON_START": EnvKnob(
         "0", "nomad_tpu/server/server.py",

@@ -1,0 +1,205 @@
+"""Deterministic cluster fault injection (the control-plane sibling of
+``nomad_tpu/device/faults.py``).
+
+Two layers, built for reproducibility:
+
+* **ChaosTransport** — an :class:`InmemTransport` with a seeded fault
+  plan: probabilistic message drops (``msg_drop[:pct]``), per-RPC wire
+  delay (``slow_wire[:ms]``), and named partitions
+  (``partition[:a,b]`` splits the listed addresses from everyone
+  else).  Drop decisions come from a per-(src, dst) RNG stream
+  derived from the seed, so each link's drop sequence is
+  deterministic and independent of unrelated links' traffic —
+  thread scheduling can still vary WHICH high-level operation lands
+  on a given draw, so replays are per-link-deterministic, not
+  whole-cluster bit-for-bit.  ``NOMAD_TPU_CLUSTER_FAULT`` arms a plan process-wide the way
+  ``NOMAD_TPU_FAULT`` arms device faults; the chaos smoke and tests
+  also arm plans programmatically.  ``leader_kill`` is a schedule
+  directive (the harness isolates/kills whoever currently leads — the
+  transport cannot know that), parsed here so one knob names every
+  fault class.
+
+* **race hooks** — named synchronization points the batched hot path
+  fires at its leadership-sensitive seams (``storm_staged``,
+  ``storm_solved``, ``pre_commit_wave``, ``chunk_launched``).  A test
+  installs a callable to force a revoke at EXACTLY that seam —
+  deterministic leadership-loss races without monkeypatching pipeline
+  internals.  Unarmed hooks are a dict lookup on an empty dict:
+  nothing on the hot path gets slower.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .transport import InmemTransport, TransportError
+
+# -- race hooks ---------------------------------------------------------
+
+_HOOKS: Dict[str, Callable[[], None]] = {}
+_HOOKS_LOCK = threading.Lock()
+
+
+def install_hook(name: str, fn: Callable[[], None]) -> None:
+    """Arm a race hook (test-only; see module docstring)."""
+    with _HOOKS_LOCK:
+        _HOOKS[name] = fn
+
+
+def clear_hooks() -> None:
+    with _HOOKS_LOCK:
+        _HOOKS.clear()
+
+
+def fire(name: str) -> None:
+    """Fire a named race hook if armed.  Hot-path cost when unarmed:
+    one truthiness check on a module-level dict."""
+    if not _HOOKS:
+        return
+    with _HOOKS_LOCK:
+        fn = _HOOKS.get(name)
+    if fn is not None:
+        fn()
+
+
+# -- fault plans --------------------------------------------------------
+
+
+@dataclass
+class Fault:
+    """One parsed ``NOMAD_TPU_CLUSTER_FAULT`` directive."""
+
+    kind: str  # leader_kill | partition | msg_drop | slow_wire
+    members: List[str] = field(default_factory=list)  # partition
+    pct: float = 0.0  # msg_drop
+    ms: float = 0.0  # slow_wire
+
+
+def parse_fault(spec: str) -> Optional[Fault]:
+    """``leader_kill`` | ``partition[:a,b]`` | ``msg_drop[:pct]`` |
+    ``slow_wire[:ms]`` -> Fault (None for empty/unknown specs —
+    chaos must never break a production boot)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    kind, _, arg = spec.partition(":")
+    kind = kind.strip()
+    if kind == "leader_kill":
+        return Fault(kind="leader_kill")
+    if kind == "partition":
+        members = [m.strip() for m in arg.split(",") if m.strip()]
+        return Fault(kind="partition", members=members)
+    if kind == "msg_drop":
+        try:
+            pct = float(arg) if arg else 5.0
+        except ValueError:
+            pct = 5.0
+        return Fault(kind="msg_drop", pct=max(0.0, min(pct, 100.0)))
+    if kind == "slow_wire":
+        try:
+            ms = float(arg) if arg else 5.0
+        except ValueError:
+            ms = 5.0
+        return Fault(kind="slow_wire", ms=max(0.0, ms))
+    return None
+
+
+def armed_fault() -> Optional[Fault]:
+    """The process-wide fault plan from ``NOMAD_TPU_CLUSTER_FAULT``
+    (read per call: tests arm and disarm within one process)."""
+    return parse_fault(os.environ.get("NOMAD_TPU_CLUSTER_FAULT", ""))
+
+
+class ChaosTransport(InmemTransport):
+    """InmemTransport with a deterministic, seeded fault plan.
+
+    Faults apply to raft AND forwarding traffic (everything rides the
+    same transport, like the reference's multiplexed RPC port), so a
+    dropped forward or a slow append_entries exercises the identical
+    recovery paths real hardware would."""
+
+    def __init__(self, seed: int = 0) -> None:
+        super().__init__()
+        self._seed = seed
+        # per-(src, dst) RNG streams: each link's drop sequence is a
+        # pure function of (seed, src, dst, nth call on that link),
+        # independent of every other link's traffic
+        self._link_rngs: Dict[tuple, random.Random] = {}
+        self._fault_lock = threading.Lock()
+        self.drop_pct = 0.0
+        self.delay_ms = 0.0
+        self.delivered = 0
+        self.dropped = 0
+
+    # -- arming --------------------------------------------------------
+
+    def arm(self, fault: Optional[Fault]) -> None:
+        """Apply a parsed fault plan.  ``partition`` splits the named
+        members from every other registered node; ``leader_kill`` is a
+        harness directive and a no-op here."""
+        if fault is None:
+            return
+        if fault.kind == "msg_drop":
+            with self._fault_lock:
+                self.drop_pct = fault.pct
+        elif fault.kind == "slow_wire":
+            with self._fault_lock:
+                self.delay_ms = fault.ms
+        elif fault.kind == "partition":
+            self.partition_group(fault.members)
+
+    def arm_from_env(self) -> None:
+        self.arm(armed_fault())
+
+    def disarm(self) -> None:
+        with self._fault_lock:
+            self.drop_pct = 0.0
+            self.delay_ms = 0.0
+        self.heal()
+
+    def partition_group(self, members: List[str]) -> None:
+        """Split ``members`` from every other registered address (both
+        directions), leaving intra-group links up."""
+        group = set(members)
+        with self._lock:
+            others = [a for a in self._handlers if a not in group]
+        for m in members:
+            for o in others:
+                self.partition(m, o)
+
+    # -- delivery ------------------------------------------------------
+
+    def _link_rng(self, src: str, dst: str) -> random.Random:
+        """Deterministic per-link stream (callers hold _fault_lock)."""
+        key = (src, dst)
+        rng = self._link_rngs.get(key)
+        if rng is None:
+            digest = hashlib.sha256(
+                f"{self._seed}|{src}|{dst}".encode()
+            ).digest()
+            rng = random.Random(int.from_bytes(digest[:8], "big"))
+            self._link_rngs[key] = rng
+        return rng
+
+    def rpc(self, src: str, dst: str, method: str, payload: dict) -> dict:
+        with self._fault_lock:
+            delay = self.delay_ms
+            drop = (
+                self.drop_pct
+                and self._link_rng(src, dst).random() * 100.0
+                < self.drop_pct
+            )
+        if delay:
+            time.sleep(delay / 1000.0)
+        if drop:
+            self.dropped += 1
+            raise TransportError(
+                f"chaos: dropped {method} {src}->{dst}"
+            )
+        self.delivered += 1
+        return super().rpc(src, dst, method, payload)

@@ -1,0 +1,435 @@
+"""Cluster chaos smoke: a 3-server raft cluster survives repeated
+leader kills and a healed partition under continuous eval load.
+
+The control-plane sibling of ``nomad_tpu.parallel.dist_smoke`` and the
+device supervisor's fault soaks: deterministic fault injection
+(:mod:`nomad_tpu.raft.chaos`) drives the REAL ClusterServer stack —
+raft replication, leader-forwarded writes, the batched scheduling hot
+path, the leadership fences — through the failure schedule production
+hits on real hardware, and asserts the invariants that make failover
+"clean":
+
+* **zero lost evals** — every submitted job ends fully placed, every
+  eval reaches a terminal status, the broker drains, and the failed
+  queue stays empty;
+* **zero duplicate placements** — the live placement set (one key per
+  job/task-group/alloc-name) equals a fault-free oracle run's set
+  exactly: no double-committed wave ever produced a second live alloc;
+* **monotone FSM apply indices** — no server ever applies backwards;
+* **bounded failover** — every kill's revoke→re-establish
+  detect-to-resume time is recorded (the ``cluster_failover`` bench
+  block).
+
+Usage::
+
+    python -m nomad_tpu.raft.chaos_smoke [--jobs N] [--kills K]
+        [--nodes M] [--seed S] [--json PATH]
+
+``NOMAD_TPU_CLUSTER_FAULT=msg_drop:5`` (or ``slow_wire:2``) layers
+wire-level faults over the kill/heal schedule; ``leader_kill`` and
+``partition`` specs are the schedule the smoke already runs.
+Exit code 0 = every invariant held; 2 = a violation (the JSON names
+it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..raft.chaos import ChaosTransport, armed_fault
+from ..raft.transport import TransportError
+from ..raft import NotLeaderError
+
+HEARTBEAT_TTL = 300.0  # no TTL expiries during the smoke
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    ordered = sorted(vals)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def _job_specs(n: int) -> List[Tuple[str, int]]:
+    """(job id, alloc count) — small single-alloc jobs so the load is
+    eval-count-bound, not capacity-bound."""
+    return [(f"chaos-{i:05d}", 1) for i in range(n)]
+
+
+def _make_job(job_id: str, count: int):
+    from .. import mock
+
+    job = mock.job(id=job_id)
+    job.task_groups[0].count = count
+    # tiny asks: the smoke is eval-count-bound by design — capacity
+    # must never block an eval, or "zero lost" would be unprovable
+    for tg in job.task_groups:
+        for task in tg.tasks:
+            task.resources.cpu = 50
+            task.resources.memory_mb = 32
+    return job
+
+
+def _established_leader(servers, timeout: float = 15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        est = [
+            s
+            for s in servers
+            if s.is_leader() and s._leader_established
+        ]
+        if est:
+            return est[0]
+        time.sleep(0.01)
+    raise AssertionError("no established leader")
+
+
+def _live_placements(store) -> Set[Tuple[str, str, str]]:
+    """One key per live alloc: (job id, task group, alloc name).
+    Alloc ids are random, so oracle comparison keys on the
+    deterministic name — a duplicate placement shows up as either a
+    key collision (caught below) or an extra live alloc count."""
+    out: Set[Tuple[str, str, str]] = set()
+    for alloc in store.allocs.values():
+        if alloc.terminal_status():
+            continue
+        out.add((alloc.job_id, alloc.task_group, alloc.name))
+    return out
+
+
+def _run_cluster(
+    specs: List[Tuple[str, int]],
+    nodes: int,
+    seed: int,
+    kills: int = 0,
+    partition_cycle: bool = False,
+    submit_pause_s: float = 0.0,
+) -> Dict:
+    """Boot a 3-server cluster on a ChaosTransport, push the job load
+    through it while the fault schedule runs, settle, and return the
+    final state + failover timings.  ``kills=0`` is the fault-free
+    oracle configuration (same topology, same transport class, no
+    faults armed — only the schedule differs)."""
+    from ..server.cluster import TestCluster
+
+    transport = ChaosTransport(seed=seed)
+    cluster = TestCluster(
+        3, transport=transport, heartbeat_ttl=HEARTBEAT_TTL
+    )
+    monotone_ok = True
+    violation = [""]
+    stop_sampler = threading.Event()
+
+    def sample_indices() -> None:
+        nonlocal monotone_ok
+        last: Dict[str, int] = {}
+        while not stop_sampler.is_set():
+            for s in cluster.servers:
+                applied = s.raft.stats()["applied_index"]
+                if applied < last.get(s.addr, 0):
+                    monotone_ok = False
+                    violation[0] = (
+                        f"{s.addr} applied index went backwards: "
+                        f"{last[s.addr]} -> {applied}"
+                    )
+                last[s.addr] = applied
+            time.sleep(0.02)
+
+    t_start = time.monotonic()
+    detect_to_resume: List[float] = []
+    submitted: List[str] = []
+    submit_errors = [0]
+    try:
+        cluster.start()
+        leader = _established_leader(cluster.servers)
+        if kills:
+            # wire-level faults (msg_drop/slow_wire) layer over the
+            # kill schedule when armed via NOMAD_TPU_CLUSTER_FAULT
+            transport.arm(armed_fault())
+        sampler = threading.Thread(
+            target=sample_indices, name="chaos-sampler", daemon=True
+        )
+        sampler.start()
+
+        from .. import mock
+
+        for _ in range(nodes):
+            leader.register_node(mock.node())
+
+        def submit_all() -> None:
+            """At-least-once submission with retry across servers —
+            the client side of a leader failover.  Job registration
+            is idempotent on the job id, so a retry after an
+            ambiguous failure cannot double-place."""
+            rr = 0
+            for job_id, count in specs:
+                if submit_pause_s:
+                    time.sleep(submit_pause_s)
+                for attempt in range(200):
+                    server = cluster.servers[rr % len(cluster.servers)]
+                    rr += 1
+                    try:
+                        server.register_job(_make_job(job_id, count))
+                        submitted.append(job_id)
+                        break
+                    except (
+                        NotLeaderError,
+                        TransportError,
+                        TimeoutError,
+                        RuntimeError,
+                        KeyError,
+                    ):
+                        submit_errors[0] += 1
+                        time.sleep(0.02)
+                else:
+                    raise AssertionError(
+                        f"could not submit {job_id} after 200 tries"
+                    )
+
+        submitter = threading.Thread(
+            target=submit_all, name="chaos-submitter", daemon=True
+        )
+        submitter.start()
+
+        for kill in range(kills):
+            # let load flow before each kill so leases/chains are
+            # genuinely in flight when leadership dies
+            time.sleep(0.4)
+            victim = _established_leader(cluster.servers)
+            t0 = time.monotonic()
+            transport.partition_group([victim.addr])
+            others = [s for s in cluster.servers if s is not victim]
+            # generous: a re-elected server's establish can queue
+            # behind its own previous revoke drain (ordered
+            # leadership notifications), which in the worst case
+            # waits out a full quorumless forward-retry cycle
+            new_leader = _established_leader(others, timeout=60.0)
+            detect_to_resume.append(time.monotonic() - t0)
+            transport.heal(victim.addr)
+            # the deposed leader steps down (and revokes) on first
+            # contact with the new term
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and (
+                victim.is_leader() or victim._leader_established
+            ):
+                time.sleep(0.01)
+
+        if partition_cycle:
+            # partition a FOLLOWER away under load, then heal: it must
+            # catch up (log replay or snapshot install) and converge
+            time.sleep(0.2)
+            current = _established_leader(cluster.servers)
+            follower = next(
+                s for s in cluster.servers if s is not current
+            )
+            transport.partition_group([follower.addr])
+            time.sleep(1.0)
+            transport.heal(follower.addr)
+
+        submitter.join(timeout=240.0)
+        if submitter.is_alive():
+            raise AssertionError("submitter wedged")
+
+        # settle: a final leader must drain every eval to a terminal
+        # status and place every job (restore_evals on each establish
+        # re-enqueues whatever a revoke unacked)
+        deadline = time.monotonic() + 120.0
+        leader = None
+        while time.monotonic() < deadline:
+            leader = _established_leader(cluster.servers)
+            store = leader.store
+            pending = [
+                ev
+                for ev in list(store.evals.values())
+                if ev.status in ("pending", "blocked")
+            ]
+            placed = sum(
+                1
+                for job_id, count in specs
+                if len(
+                    [
+                        a
+                        for a in store.allocs_by_job(
+                            "default", job_id
+                        )
+                        if not a.terminal_status()
+                    ]
+                )
+                == count
+            )
+            if (
+                not pending
+                and placed == len(specs)
+                and leader.drain_to_idle(timeout=1.0)
+            ):
+                break
+            time.sleep(0.1)
+
+        store = leader.store
+        placements = _live_placements(store)
+        live_by_key: Dict[Tuple[str, str, str], int] = {}
+        for alloc in store.allocs.values():
+            if alloc.terminal_status():
+                continue
+            key = (alloc.job_id, alloc.task_group, alloc.name)
+            live_by_key[key] = live_by_key.get(key, 0) + 1
+        duplicates = {
+            k: n for k, n in live_by_key.items() if n > 1
+        }
+        lost = [
+            job_id
+            for job_id, count in specs
+            if len(
+                [
+                    a
+                    for a in store.allocs_by_job("default", job_id)
+                    if not a.terminal_status()
+                ]
+            )
+            != count
+        ]
+        nonterminal = [
+            ev.id
+            for ev in list(store.evals.values())
+            if ev.status in ("pending", "blocked")
+        ]
+        failed_q = len(leader.broker.failed())
+        counters = {
+            name: sum(
+                s.metrics.get_counter(name) for s in cluster.servers
+            )
+            for name in (
+                "leadership.establishes",
+                "leadership.revokes",
+                "leadership.unacked_on_revoke",
+                "leadership.chain_aborts",
+                "leadership.plan_rejected",
+                "leadership.stale_wave_fenced",
+                "raft.forward_retries",
+            )
+        }
+        return {
+            "placements": placements,
+            "duplicates": duplicates,
+            "lost_jobs": lost,
+            "nonterminal_evals": len(nonterminal),
+            "failed_queue": failed_q,
+            "evals_total": len(store.evals),
+            "submitted": len(submitted),
+            "submit_errors": submit_errors[0],
+            "detect_to_resume_s": detect_to_resume,
+            "monotone_ok": monotone_ok,
+            "monotone_violation": violation[0],
+            "counters": counters,
+            "dropped_rpcs": transport.dropped,
+            "elapsed_s": time.monotonic() - t_start,
+        }
+    finally:
+        stop_sampler.set()
+        transport.disarm()
+        cluster.stop()
+
+
+def run_smoke(
+    jobs: int = 400,
+    kills: int = 5,
+    nodes: int = 6,
+    seed: int = 0,
+) -> Dict:
+    """Oracle run + chaos run + invariant checks; returns the
+    ``cluster_failover`` block (``ok`` tells whether every invariant
+    held)."""
+    specs = _job_specs(jobs)
+    oracle = _run_cluster(specs, nodes=nodes, seed=seed, kills=0)
+    chaos = _run_cluster(
+        specs,
+        nodes=nodes,
+        seed=seed,
+        kills=kills,
+        partition_cycle=True,
+    )
+    oracle_match = chaos["placements"] == oracle["placements"]
+    ok = (
+        oracle_match
+        and not chaos["duplicates"]
+        and not chaos["lost_jobs"]
+        and chaos["nonterminal_evals"] == 0
+        and chaos["failed_queue"] == 0
+        and chaos["monotone_ok"]
+        and oracle["monotone_ok"]
+        and len(chaos["detect_to_resume_s"]) == kills
+    )
+    dtr = chaos["detect_to_resume_s"]
+    return {
+        "ok": ok,
+        "servers": 3,
+        "jobs": jobs,
+        "nodes": nodes,
+        "seed": seed,
+        "kills": kills,
+        "partition_cycles": 1,
+        "evals_total": chaos["evals_total"],
+        "placements_total": len(chaos["placements"]),
+        "oracle_placements_total": len(oracle["placements"]),
+        "oracle_match": oracle_match,
+        "lost_evals": len(chaos["lost_jobs"])
+        + chaos["nonterminal_evals"],
+        "lost_jobs": chaos["lost_jobs"][:10],
+        "duplicate_placements": len(chaos["duplicates"]),
+        "failed_queue": chaos["failed_queue"],
+        "apply_monotone": chaos["monotone_ok"]
+        and oracle["monotone_ok"],
+        "monotone_violation": chaos["monotone_violation"]
+        or oracle["monotone_violation"],
+        "detect_to_resume_s": [round(v, 4) for v in dtr],
+        "detect_to_resume_p50_s": round(_percentile(dtr, 0.5), 4),
+        "detect_to_resume_max_s": round(max(dtr), 4) if dtr else 0.0,
+        "submit_errors": chaos["submit_errors"],
+        "dropped_rpcs": chaos["dropped_rpcs"],
+        "counters": chaos["counters"],
+        "oracle_elapsed_s": round(oracle["elapsed_s"], 2),
+        "chaos_elapsed_s": round(chaos["elapsed_s"], 2),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="3-server leadership-loss chaos smoke"
+    )
+    parser.add_argument("--jobs", type=int, default=400)
+    parser.add_argument("--kills", type=int, default=5)
+    parser.add_argument("--nodes", type=int, default=6)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--json", default="", help="also write the block to this path"
+    )
+    args = parser.parse_args(argv)
+    block = run_smoke(
+        jobs=args.jobs,
+        kills=args.kills,
+        nodes=args.nodes,
+        seed=args.seed,
+    )
+    out = {"cluster_failover": block}
+    print(json.dumps(out, indent=2, default=str))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(out, fh, indent=2, default=str)
+    if not block["ok"]:
+        print("CHAOS_SMOKE: FAIL", file=sys.stderr)
+        return 2
+    print(
+        "CHAOS_SMOKE: ok — %d kills survived, %d placements, "
+        "0 lost, 0 duplicates"
+        % (block["kills"], block["placements_total"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,13 @@ def _not_leader_error():
     return NotLeaderError
 
 
+def _stale_leadership_error():
+    # lazy: server.fsm imports this package (cycle at module level)
+    from ..server.fsm import StaleLeadershipError
+
+    return StaleLeadershipError
+
+
 _ERR_TYPES = {
     "KeyError": KeyError,
     "ValueError": ValueError,
@@ -173,6 +180,14 @@ class TcpTransport:
         if reply[0] == "ok":
             return reply[1]
         _kind, type_name, detail, message = reply
+        if type_name == "StaleLeadershipError":
+            # must survive the hop with its real type: the forwarding
+            # retry loop treats it as DEFINITIVE (never re-forwarded),
+            # and the worker layer's NotLeaderError handling converts
+            # it to nack-for-redelivery — a bare RuntimeError would
+            # take the generic crash path instead
+            gen, fence = detail if detail else (0, 0)
+            raise _stale_leadership_error()(gen, fence)
         if type_name == "NotLeaderError":
             raise _not_leader_error()(detail or None)
         exc_type = _ERR_TYPES.get(type_name, RuntimeError)
@@ -397,7 +412,11 @@ class _Listener:
 def _error_envelope(exc: Exception) -> list:
     type_name = type(exc).__name__
     detail = None
-    if type_name == "NotLeaderError":
+    if type_name == "StaleLeadershipError":
+        detail = [
+            getattr(exc, "gen", 0), getattr(exc, "fence", 0),
+        ]
+    elif type_name == "NotLeaderError":
         detail = getattr(exc, "leader", None)
     return ["err", type_name, detail, str(exc)]
 

@@ -64,6 +64,10 @@ class StormMember:
     solver_round: int = -1
     assignment_score: float = 0.0
     divergent_rows: int = 0
+    # leadership generation the storm solved under — stamped by the
+    # batch worker and carried into the member's Storm explain block,
+    # so a post-failover audit can tell which leader's solve placed it
+    leader_gen: int = 0
 
 
 @dataclass
@@ -125,6 +129,11 @@ def build_storm_problem(
     Mutates each member's ``reason``/row slice in place."""
     from ..ops.batch import pow2_bucket
     from ..ops.solve import StormInputs, pad_axis
+    from ..raft import chaos as _chaos
+
+    # chaos seam: deterministic revoke-while-staging races (no-op
+    # unless a test armed the hook)
+    _chaos.fire("storm_staged")
 
     table = snap.node_table
     C = table.capacity

@@ -86,6 +86,8 @@ from ..structs import (
     TaskGroup,
 )
 from ..explain import EXPLAIN
+from ..raft import NotLeaderError
+from ..raft import chaos as _chaos
 from ..trace import TRACE
 from .worker import Worker
 
@@ -1247,8 +1249,15 @@ class BatchWorker(Worker):
         """Lazy speculative-replay pool (the plan applier's
         EvaluatePool shape, sized cores/2 unless
         NOMAD_TPU_REPLAY_WORKERS overrides); its width is the
-        `batch_worker.replay_parallelism` gauge."""
-        if self._replay_pool is None:
+        `batch_worker.replay_parallelism` gauge.
+
+        Re-created when the previous pool was shut down: leadership
+        can be re-established on the same server (revoke -> establish
+        on re-election), and the new generation's waves must not
+        submit into the dead pool — this exact shape stranded every
+        wave (and three-struck its evals into the failed queue) in
+        the chaos smoke before the check existed."""
+        if self._replay_pool is None or self._replay_pool.closed:
             from .plan_apply import EvaluatePool
 
             self._replay_pool = EvaluatePool(
@@ -1440,6 +1449,44 @@ class BatchWorker(Worker):
         while len(self._deq_ts) >= DEQ_TS_MAX:
             self._deq_ts.pop(next(iter(self._deq_ts)))
         self._deq_ts[ev.id] = _time.monotonic()
+        # explain/trace audit: every record of this delivery names the
+        # leadership generation it ran under, so a post-failover
+        # operator can tell which leader's pipeline produced it
+        TRACE.annotate(ev.id, leader_gen=self._leader_gen())
+
+    # -- leadership fence ----------------------------------------------
+
+    def _leader_gen(self) -> int:
+        """The server's current leadership generation (0 for bare
+        test harnesses that never call establish_leadership)."""
+        return getattr(self.server, "_leadership_gen", 0)
+
+    def _count_leadership(self, kind: str) -> None:
+        """Leadership-failover counters, exported under the
+        `leadership.` namespace on /v1/metrics (the family is
+        zero-registered at Server construction from
+        LEADERSHIP_COUNTERS)."""
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.incr(f"leadership.{kind}")
+
+    def _check_leadership(self, gen: int) -> None:
+        """The hot path's leadership fence — the exact analogue of the
+        ``chain_epoch != self._backend_epoch`` backend fence: a wave,
+        chunk chain or storm solve captured ``gen`` when it started,
+        and may only commit while the server still holds THAT
+        leadership.  Raises NotLeaderError (handled by run(): every
+        outstanding lease is nacked for redelivery, nothing commits)
+        when leadership was revoked or re-established at a newer
+        generation mid-flight."""
+        srv = self.server
+        if (
+            getattr(srv, "_leader_established", True)
+            and getattr(srv, "_leadership_gen", gen) == gen
+        ):
+            return
+        self._count_leadership("stale_wave_fenced")
+        raise NotLeaderError(None)
 
     def run(self) -> None:
         import time as _time
@@ -1448,7 +1495,7 @@ class BatchWorker(Worker):
         # out of the chain: they hold broker leases and must be
         # processed NEXT, before any fresh dequeue, to keep FIFO order
         leftover: List[Tuple[Evaluation, str]] = []
-        while not self._stop.is_set():
+        while not self._stop.is_set() and self._current_generation():
             batch = leftover
             leftover = []
             if not batch:
@@ -1468,23 +1515,22 @@ class BatchWorker(Worker):
                     if storm is not None:
                         try:
                             leftover = self._process_storm(storm)
+                        except NotLeaderError:
+                            # leadership revoked mid-storm: the solve
+                            # result was discarded before decompose,
+                            # nothing committed — nack every member
+                            # lease for redelivery under the next
+                            # leadership.  Expected on failover, so no
+                            # error accounting.
+                            self._count_leadership("chain_aborts")
+                            self._abandon_leases(storm)
+                            leftover = []
                         except Exception:  # noqa: BLE001
                             self._count("errors")
                             LOG.exception(
                                 "storm processing crashed"
                             )
-                            for s_ev, s_token in storm:
-                                self._nack_quietly(s_ev, s_token)
-                            deferred, self._deferred = (
-                                self._deferred, []
-                            )
-                            admitted, self._admitted_live = (
-                                self._admitted_live, []
-                            )
-                            for s_ev, s_token in (
-                                deferred + admitted
-                            ):
-                                self._nack_quietly(s_ev, s_token)
+                            self._abandon_leases(storm)
                             leftover = []
                         continue
                 batch = [(ev, token)]
@@ -1507,6 +1553,9 @@ class BatchWorker(Worker):
                         break
                     self._note_dequeue(ev)
                     batch.append((ev, token))
+                # chaos seam: deterministic revoke-during-gulp races
+                # (no-op unless a test armed the hook)
+                _chaos.fire("gulp_filled")
             for pos, (b_ev, _tok) in enumerate(batch):
                 TRACE.event(
                     b_ev.id, "batch_worker.gulp",
@@ -1514,24 +1563,38 @@ class BatchWorker(Worker):
                 )
             try:
                 leftover = self._process_batch(batch)
+            except NotLeaderError:
+                # leadership revoked with this gulp in flight: the
+                # chain was dropped via its abandon path and no wave
+                # member past the fence committed — nack every lease
+                # (original gulp, deferred AND admitted) for
+                # redelivery under the next leadership
+                self._count_leadership("chain_aborts")
+                self._abandon_leases(batch)
+                leftover = []
             except Exception:  # noqa: BLE001
                 # a crash here would silently kill the worker thread and
                 # strand every queued eval — log, nack, keep running
                 self._count("errors")
                 LOG.exception("batch processing crashed")
-                for ev, token in batch:
-                    self._nack_quietly(ev, token)
-                # ... including evals the admission queue dequeued
-                # mid-chain — parked (deferred) or already admitted
-                # into the crashed chain (_nack_quietly tolerates
-                # leases the flush did manage to ack or nack)
-                deferred, self._deferred = self._deferred, []
-                admitted, self._admitted_live = (
-                    self._admitted_live, []
-                )
-                for ev, token in deferred + admitted:
-                    self._nack_quietly(ev, token)
+                self._abandon_leases(batch)
                 leftover = []
+
+    def _abandon_leases(
+        self, held: List[Tuple[Evaluation, str]]
+    ) -> None:
+        """Nack every broker lease this worker still holds after an
+        aborted gulp/storm: the evals handed in, plus everything the
+        admission queue dequeued mid-chain — parked (deferred) or
+        already admitted into the dropped chain (_nack_quietly
+        tolerates leases the flush managed to ack/nack, and leases a
+        leadership revoke already flushed wholesale)."""
+        for ev, token in held:
+            self._nack_quietly(ev, token)
+        deferred, self._deferred = self._deferred, []
+        admitted, self._admitted_live = self._admitted_live, []
+        for ev, token in deferred + admitted:
+            self._nack_quietly(ev, token)
 
     # ------------------------------------------------------------------
 
@@ -1574,6 +1637,11 @@ class BatchWorker(Worker):
             # the ready-node-set generation at wave start (the
             # per-node baseline is captured with the wave below)
             wave_readiness = self.store.readiness_generation()
+            # leadership fence: the generation this chain runs under —
+            # a revoke (or a newer establish) mid-chain aborts the
+            # chain through the same drop path a backend flip uses,
+            # and _commit_wave re-checks it before every member commit
+            wave_gen = self._leader_gen()
             # simulate the longest prefix we can model in the kernel
             t0 = _time.monotonic()
             sims: List[_Sim] = []
@@ -1840,6 +1908,23 @@ class BatchWorker(Worker):
                 ci = 0
                 stalled = False  # cold shape or launch/fetch failure
                 while (ci < len(chunks) or pending) and not rescore:
+                    try:
+                        self._check_leadership(wave_gen)
+                    except NotLeaderError:
+                        # leadership left mid-chain: drop the
+                        # in-flight launches via the same abandon path
+                        # a backend flip uses (the buffers may still
+                        # be read by abandoned launches), then
+                        # re-raise — run() nacks every lease; NOTHING
+                        # of this chain commits, sequential fallback
+                        # included
+                        LOG.info(
+                            "leadership revoked mid-chain; dropping "
+                            "%d in-flight chunk(s)", len(pending),
+                        )
+                        pending.clear()
+                        self._mark_mirror_dirty()
+                        raise
                     if chain_epoch != self._backend_epoch:
                         # a probe-driven failover (or recovery) flipped
                         # the backend mid-chain: the pending handles
@@ -1917,6 +2002,9 @@ class BatchWorker(Worker):
                         carry = handle[2]
                         pending.append((chunks[ci], handle, dt))
                         ci += 1
+                        # chaos seam: deterministic revoke-mid-launch
+                        # races (no-op unless a test armed the hook)
+                        _chaos.fire("chunk_launched")
                     if (
                         admission is not None
                         and not stalled
@@ -2017,10 +2105,22 @@ class BatchWorker(Worker):
                         # not when the (possibly admission-extended)
                         # chain finally ends.  Blocking only happens
                         # in the final drain below.
-                        k, rescore = self._commit_wave(
-                            wave, k, wave_base, wave_readiness,
-                            state=wave_state, drain_all=False,
-                        )
+                        try:
+                            k, rescore = self._commit_wave(
+                                wave, k, wave_base, wave_readiness,
+                                state=wave_state, drain_all=False,
+                                leader_gen=wave_gen,
+                            )
+                        except NotLeaderError:
+                            # the fence tripped with launches still in
+                            # flight: drop them through the same
+                            # abandon path as the loop-top checks (the
+                            # abandoned launches may still read the
+                            # usage mirrors — the next sync must
+                            # re-upload, never donate)
+                            pending.clear()
+                            self._mark_mirror_dirty()
+                            raise
                 if pending:
                     # a rescore exit abandoned in-flight launches that
                     # may still read the usage mirrors: the next sync
@@ -2037,6 +2137,7 @@ class BatchWorker(Worker):
                 k, rescore = self._commit_wave(
                     wave, k, wave_base, wave_readiness,
                     state=wave_state, drain_all=True,
+                    leader_gen=wave_gen,
                 )
             if not rescore:
                 # evals no fetched chunk covered (assembly failure,
@@ -2331,6 +2432,10 @@ class BatchWorker(Worker):
         wave_readiness = self.store.readiness_generation()
         wave_base = self.store.node_touch_counts()
         chain_epoch = self._backend_epoch
+        # leadership fence: the generation this storm solves under —
+        # checked after the solve (the result is discarded BEFORE
+        # decompose on a flip) and again before every member commit
+        wave_gen = self._leader_gen()
 
         # simulation pre-pass, FIFO order (the same host mirror of
         # computeJobAllocs the chunk chain runs)
@@ -2338,7 +2443,9 @@ class BatchWorker(Worker):
         storm_members: List[StormMember] = []
         for ev, token in members:
             job = self.store.job_by_id(ev.namespace, ev.job_id)
-            member = StormMember(ev=ev, token=token, job=job)
+            member = StormMember(
+                ev=ev, token=token, job=job, leader_gen=wave_gen
+            )
             if not self._batchable(ev, job):
                 member.reason = "unbatchable"
             else:
@@ -2401,6 +2508,15 @@ class BatchWorker(Worker):
                 # assignment came from (or hung on) the old target
                 out = None
                 self._mark_mirror_dirty()
+        # chaos seam: deterministic revoke-mid-solve races (no-op
+        # unless a test armed the hook)
+        _chaos.fire("storm_solved")
+        # leadership fence: a revoke mid-solve discards the solve
+        # result BEFORE decompose — nothing downstream (decompose,
+        # speculation, commit) ever sees a deposed leadership's
+        # assignment.  run()'s NotLeaderError handler nacks every
+        # member lease for redelivery.
+        self._check_leadership(wave_gen)
         if problem is not None:
             t2 = _time.monotonic()
             solved_rows = decompose(problem, out)
@@ -2470,7 +2586,7 @@ class BatchWorker(Worker):
         wave_state = {"job_ledger": set(), "expect": {}}
         _k, _rescore = self._commit_wave(
             wave, 0, wave_base, wave_readiness,
-            state=wave_state, drain_all=True,
+            state=wave_state, drain_all=True, leader_gen=wave_gen,
         )
         leftover: List[Tuple[Evaluation, str]] = []
         if wave:
@@ -2518,6 +2634,7 @@ class BatchWorker(Worker):
                     ),
                     "DivergentRows": m.divergent_rows,
                     "Rows": len(m.rows),
+                    "LeaderGen": m.leader_gen,
                 },
             )
             TRACE.annotate(
@@ -2618,6 +2735,7 @@ class BatchWorker(Worker):
             )
             self._count("prescored")
             self._sample_eval_latency(ev)
+            EXPLAIN.annotate(ev.id, LeaderGen=self._leader_gen())
             # a failed prescored pick means the chained state past
             # this eval is suspect — re-prescore
             return clean
@@ -2774,7 +2892,7 @@ class BatchWorker(Worker):
     def _commit_wave(
         self, wave, k: int, wave_base: Dict[str, int],
         wave_readiness: int, state: Optional[dict] = None,
-        drain_all: bool = True,
+        drain_all: bool = True, leader_gen: Optional[int] = None,
     ) -> Tuple[int, bool]:
         """Phase B: walk the wave in queue order, committing each
         eval's speculation when its read set survived every
@@ -2805,6 +2923,16 @@ class BatchWorker(Worker):
         wave_expect: Dict[str, int] = state["expect"]
         rescore = False
         while wave:
+            # chaos seam: deterministic revoke between speculation and
+            # commit (no-op unless a test armed the hook)
+            _chaos.fire("pre_commit_wave")
+            if leader_gen is not None:
+                # the leadership fence, checked before EVERY member
+                # commit exactly where the backend epoch would be: a
+                # deposed leader's speculations are discarded, their
+                # leases nacked by run()'s NotLeaderError handler,
+                # and the remaining wave members' leases with them
+                self._check_leadership(leader_gen)
             fut = wave[0][6]
             if not drain_all and not fut.done():
                 break
@@ -2830,8 +2958,16 @@ class BatchWorker(Worker):
                     ok = self._commit_speculation(
                         spec, ev, token, wave_base, wave_expect,
                         wave_readiness, job_ledger,
+                        leader_gen=leader_gen,
                     )
                     committed = ok is not None
+                except NotLeaderError:
+                    # the plan applier (or the replicated FSM fence)
+                    # rejected the commit: leadership is gone — nack
+                    # this lease and abort the whole wave; run()'s
+                    # handler nacks the rest
+                    self._nack_quietly(ev, token)
+                    raise
                 except Exception:  # noqa: BLE001
                     self._count("errors")
                     LOG.warning(
@@ -2886,6 +3022,7 @@ class BatchWorker(Worker):
         self, spec: _Speculation, ev, token,
         wave_base: Dict[str, int], wave_expect: Dict[str, int],
         wave_readiness: int, job_ledger: Set[tuple],
+        leader_gen: Optional[int] = None,
     ) -> Optional[bool]:
         """Commit one speculative replay: conflict check, then replay
         the captured transcript verbatim through the real planner
@@ -2958,6 +3095,11 @@ class BatchWorker(Worker):
                 ev.id, "replay.conflict", fence="deployment"
             )
             return None
+        if leader_gen is not None:
+            # last host-side leadership fence before any captured op
+            # is applied (the replicated FSM fence backstops the
+            # check-to-apply window on a cluster server)
+            self._check_leadership(leader_gen)
         commit_index = self.store.latest_index()
         # the serial loop stamps each replay's fresh snapshot index on
         # the eval's status writes; the commit point is that replay's
@@ -2978,6 +3120,14 @@ class BatchWorker(Worker):
         )
         for op, payload in ordered:
             if op == "submit":
+                if leader_gen is not None:
+                    # stamp the WAVE's captured generation, not the
+                    # submit-time one: a straggler thread committing
+                    # after this server was re-elected must carry the
+                    # deposed generation so the replicated FSM fence
+                    # rejects it (propose-time stamping would launder
+                    # the stale plan under the new term)
+                    payload.leader_gen = leader_gen
                 result, refreshed = self.submit_plan(payload)
                 if refreshed is not None or not result.is_full_commit(
                     payload
@@ -3027,6 +3177,11 @@ class BatchWorker(Worker):
         EXPLAIN.publish(
             spec.explain, getattr(self.server, "metrics", None)
         )
+        if leader_gen is not None:
+            # the published explanation names the leadership
+            # generation whose wave committed it (failover forensics:
+            # "which leader placed this?")
+            EXPLAIN.annotate(ev.id, LeaderGen=leader_gen)
         self.server.broker.ack(ev.id, token)
         self._count("prescored")
         self._count_replay("speculative")
@@ -3048,6 +3203,9 @@ class BatchWorker(Worker):
         self._observe("sequential", dt, exemplar=ev.id)
         TRACE.add_span(ev.id, "batch_worker.sequential", t0, dt)
         self._sample_eval_latency(ev)
+        # failover forensics: every explain record names the
+        # leadership generation whose pipeline produced it
+        EXPLAIN.annotate(ev.id, LeaderGen=self._leader_gen())
 
     def _nack_quietly(self, ev, token) -> None:
         self._deq_ts.pop(ev.id, None)

@@ -16,6 +16,8 @@ works unchanged on any server.
 """
 from __future__ import annotations
 
+import logging
+import os
 import pickle
 import time
 from typing import List, Optional
@@ -24,11 +26,36 @@ from ..acl import ACLStore, Token
 from ..raft import InmemTransport, NotLeaderError, RaftNode
 from ..raft.transport import TransportError
 from ..state.store import StateStore
-from .fsm import ServerFSM, encode_command
+from ..structs import new_id
+from .fsm import ServerFSM, StaleLeadershipError, encode_command
 from .membership import Gossip
 from .server import Server
 
+LOG = logging.getLogger(__name__)
+
 _RAFT_METHODS = {"request_vote", "append_entries", "install_snapshot"}
+
+
+def _forward_retries() -> int:
+    """Bounded leader-forward retry budget (attempts AFTER the first);
+    each retry rediscovers the leader, so a command survives the
+    leadership moving mid-forward instead of being lost."""
+    try:
+        return max(0, int(os.environ.get("NOMAD_TPU_FORWARD_RETRIES", "4")))
+    except ValueError:
+        return 4
+
+
+def _forward_backoff_s() -> float:
+    """Initial retry backoff; doubles per attempt (capped at 1s) so a
+    leaderless interregnum is waited out, not hammered."""
+    try:
+        return max(
+            0.0,
+            float(os.environ.get("NOMAD_TPU_FORWARD_BACKOFF_S", "0.05")),
+        )
+    except ValueError:
+        return 0.05
 
 
 class ReplicatedStore:
@@ -39,9 +66,15 @@ class ReplicatedStore:
     job_endpoint.go).
     """
 
-    def __init__(self, local: StateStore, raft_apply) -> None:
+    def __init__(
+        self, local: StateStore, raft_apply, leader_gen=None
+    ) -> None:
         self.local = local
         self._raft_apply = raft_apply
+        # callable returning the proposer's current leadership
+        # generation; stamped onto plan-result commands so the FSM's
+        # replicated fence can reject a deposed leader's wave
+        self._leader_gen = leader_gen
 
     def __getattr__(self, name):
         return getattr(self.local, name)
@@ -133,15 +166,26 @@ class ReplicatedStore:
     def set_scheduler_config(self, config):
         return self._raft_apply("set_scheduler_config", (config,))
 
-    def upsert_plan_results(self, result, eval_id):
+    def upsert_plan_results(self, result, eval_id, leader_gen=None):
         # stops/preemptions replicate as AllocationDiffs; every
         # replica's FSM denormalizes against its own state (reference
-        # plan_apply.go:324 normalizePlan)
+        # plan_apply.go:324 normalizePlan).  The command carries a
+        # leadership generation: if a newer leader's barrier lands
+        # first, every replica's FSM rejects this plan under the
+        # apply (StaleLeadershipError) — the fence a deposed leader's
+        # host-side checks alone could race past.  ``leader_gen`` is
+        # the generation the PRODUCING wave captured when it started
+        # (stamped on the Plan); falling back to the current
+        # generation only for plans that carry no stamp — a straggler
+        # wave must never be re-stamped with a newer generation it
+        # did not run under.
         from .fsm import normalize_plan_result
 
+        if leader_gen is None and self._leader_gen is not None:
+            leader_gen = self._leader_gen()
         return self._raft_apply(
             "upsert_plan_results",
-            (normalize_plan_result(result), eval_id),
+            (normalize_plan_result(result), eval_id, leader_gen),
         )
 
 
@@ -209,7 +253,11 @@ class ClusterServer(Server):
         )
         # the server machinery sees the replicated facades
         super().__init__(
-            store=ReplicatedStore(local_store, self._raft_apply),
+            store=ReplicatedStore(
+                local_store,
+                self._raft_apply,
+                leader_gen=lambda: self._leadership_gen,
+            ),
             acls=ReplicatedACLStore(local_acls, self._raft_apply),
             acl_enabled=acl_enabled,
             **kwargs,
@@ -235,19 +283,61 @@ class ClusterServer(Server):
     # -- raft plumbing --------------------------------------------------
 
     def _raft_apply(self, kind: str, args: tuple):
-        """Propose a command; on a follower, forward to the leader
-        (reference rpc.go:509 forward + rpc.go:742 raftApply)."""
-        data = encode_command(kind, args)
-        try:
-            return self.raft.apply(data)
-        except NotLeaderError as exc:
-            leader = exc.leader or self.raft.leader_hint()
+        """Propose a command; on a follower, forward to the leader with
+        bounded retry (reference rpc.go:509 forward + rpc.go:742
+        raftApply).  Leadership moving mid-forward used to LOSE the
+        command (one shot at one hint); now each attempt rediscovers
+        the leader and backs off, and the client-supplied cmd_id makes
+        the retry idempotent — if the first forward actually committed
+        before its ack was lost, the FSM dedup returns that apply's
+        result instead of mutating twice."""
+        data = encode_command(kind, args, cmd_id=new_id())
+        backoff = _forward_backoff_s()
+        retries = _forward_retries()
+        last_exc: Exception = NotLeaderError(None)
+        for attempt in range(retries + 1):
+            if attempt:
+                metrics = getattr(self, "metrics", None)
+                if metrics is not None:
+                    metrics.incr("raft.forward_retries")
+                if backoff:
+                    time.sleep(min(backoff * (2 ** (attempt - 1)), 1.0))
+            leader = None
+            try:
+                return self.raft.apply(data)
+            except StaleLeadershipError:
+                raise  # replicated verdict: re-forwarding can't help
+            except NotLeaderError as exc:
+                leader = exc.leader or self.raft.leader_hint()
+                if leader is None and isinstance(
+                    last_exc, NotLeaderError
+                ):
+                    # a previous remote's hint beats no hint at all
+                    leader = last_exc.leader
+                last_exc = exc
+            except TimeoutError as exc:
+                # ambiguous: the entry may yet commit.  cmd_id dedup
+                # makes the retry safe either way.
+                last_exc = exc
+                continue
             if leader is None:
-                raise
-            resp = self.transport.rpc(
-                self.addr, leader, "fsm_apply", {"data": data}
-            )
+                continue  # interregnum: back off and rediscover
+            try:
+                resp = self.transport.rpc(
+                    self.addr, leader, "fsm_apply", {"data": data}
+                )
+            except (TransportError, TimeoutError) as exc:
+                # TimeoutError: the remote's own apply timed out —
+                # ambiguous like the local case, idempotent to retry
+                last_exc = exc
+                continue
+            if resp.get("not_leader"):
+                # the remote was deposed mid-forward; its hint (if
+                # any) seeds the next rediscovery
+                last_exc = NotLeaderError(resp.get("leader"))
+                continue
             return pickle.loads(resp["result"])
+        raise last_exc
 
     def _handle_cluster_rpc(self, method: str, payload: dict) -> dict:
         if method in _RAFT_METHODS:
@@ -255,7 +345,20 @@ class ClusterServer(Server):
         if method.startswith("gossip_"):
             return self.gossip.handle(method, payload)
         if method == "fsm_apply":
-            result = self.raft.apply(payload["data"])
+            # a just-deposed leader must answer with a structured
+            # not-leader response (and its best hint), not a pickled
+            # crash — the forwarding retry loop reads it and
+            # rediscovers.  StaleLeadershipError stays an application
+            # error: it is a replicated verdict, not a routing miss.
+            try:
+                result = self.raft.apply(payload["data"])
+            except StaleLeadershipError:
+                raise
+            except NotLeaderError as exc:
+                return {
+                    "not_leader": True,
+                    "leader": exc.leader or self.raft.leader_hint(),
+                }
             return {"result": pickle.dumps(result)}
         if method == "server_call":
             fn = getattr(self, payload["op"])
@@ -351,6 +454,11 @@ class ClusterServer(Server):
 
     def _on_leadership(self, is_leader: bool, term: int) -> None:
         if not is_leader:
+            # park the full leader-only stack: broker (unacking every
+            # outstanding token, drain_family members included), plan
+            # queue/applier (in-flight plans respond NotLeaderError),
+            # workers (the leadership fence aborts open chunk chains
+            # and mid-settle storm gulps), watchers, heartbeat timers
             self.revoke_leadership()
             return
         # make sure every committed entry is applied locally before the
@@ -360,11 +468,31 @@ class ClusterServer(Server):
         while self._running and self.raft.is_leader():
             try:
                 self.raft.barrier(timeout=5.0)
+                # move the REPLICATED leadership fence to this term
+                # before any service starts: from here on, every
+                # replica's FSM rejects plan commands stamped by an
+                # older generation, however they arrive (raft apply on
+                # a zombie leader, or forwarded to us)
+                self._raft_apply("leadership_barrier", (term,))
             except (TimeoutError, TransportError):
                 continue
             except NotLeaderError:
                 return
-            self.establish_leadership()
+            # re-check AFTER the barrier: _raft_apply's forwarding
+            # retries mean a barrier proposed by a just-deposed
+            # leader can still "succeed" (forwarded to the new
+            # leader, where max(fence, term) is a no-op) — without
+            # this check the deposed server would establish anyway
+            # and duplicate-schedule the backlog until its queued
+            # revoke notification lands
+            stats = self.raft.stats()
+            if stats["state"] != "leader" or stats["term"] != term:
+                return
+            # the broker restore inside establish_leadership reads the
+            # replicated state AT OUR COMMIT INDEX (the barrier just
+            # flushed the apply pipeline), so no committed eval is
+            # missed and none is invented
+            self.establish_leadership(gen=term)
             return
 
     # -- lifecycle ------------------------------------------------------

@@ -127,18 +127,26 @@ class EvalBroker:
             if not enabled:
                 self._flush_locked()
             self._lock.notify_all()
-            if enabled and self._ticker is None:
-                # the redelivery sweeper: expires unacked deliveries
-                # past their nack deadline and promotes delayed evals.
-                # With NOMAD_TPU_BROKER_WATCHDOG=1 it also notify_all()s
-                # every tick — a workaround for sandboxed schedulers
-                # that park timed Condition waits far past their
-                # timeout (a 5ms wait observed sleeping 10s+ with the
-                # GIL free, no lock holder, and no clock step).
-                self._ticker = threading.Thread(
-                    target=self._tick, name="broker-sweeper", daemon=True
-                )
-                self._ticker.start()
+            if enabled:
+                self._ensure_ticker_locked()
+
+    def _ensure_ticker_locked(self) -> None:
+        # the redelivery sweeper: expires unacked deliveries past
+        # their nack deadline and promotes delayed evals.  Re-armed
+        # from EVERY lease-taking path (set_enabled, dequeue,
+        # drain_family), not just enable — a drained storm family's
+        # shadow-heap members must never depend on the storm path
+        # settling for their redelivery, even if the sweeper thread
+        # died.  With NOMAD_TPU_BROKER_WATCHDOG=1 it also
+        # notify_all()s every tick — a workaround for sandboxed
+        # schedulers that park timed Condition waits far past their
+        # timeout (a 5ms wait observed sleeping 10s+ with the GIL
+        # free, no lock holder, and no clock step).
+        if self._ticker is None or not self._ticker.is_alive():
+            self._ticker = threading.Thread(
+                target=self._tick, name="broker-sweeper", daemon=True
+            )
+            self._ticker.start()
 
     def _tick(self) -> None:
         import os
@@ -249,6 +257,7 @@ class EvalBroker:
                     self._unack[ev.id] = (
                         ev, token, time.monotonic() + self.nack_timeout,
                     )
+                    self._ensure_ticker_locked()
                     self.stats["total_unacked"] += 1
                     self.events.append((time.monotonic(), "deq", ev.id[:6], token[:6]))
                     # flight recorder: the dequeue is the trace root —
@@ -362,6 +371,9 @@ class EvalBroker:
             if count < min_n:
                 return []
             out: List[Tuple[Evaluation, str]] = []
+            # the members' redelivery must not depend on the storm
+            # path settling: the sweeper is (re)armed with the leases
+            self._ensure_ticker_locked()
             for _ in range(count):
                 ev = self._pop_ready_locked(schedulers)
                 token = new_id()
@@ -442,6 +454,15 @@ class EvalBroker:
     def outstanding(self, eval_id: str) -> Optional[str]:
         entry = self._unack.get(eval_id)
         return entry[1] if entry else None
+
+    def unacked_count(self) -> int:
+        """Outstanding deliveries (normal dequeues AND drain_family
+        shadow-heap members — both live in ``_unack`` and are swept by
+        the same nack-timeout redelivery).  The leadership revoke path
+        reads this just before the disable flush to report how much
+        in-flight work the failover unacked."""
+        with self._lock:
+            return len(self._unack)
 
     def ready_count(self, schedulers=None) -> int:
         """Ready evals, optionally filtered to scheduler types — the

@@ -19,16 +19,50 @@ from __future__ import annotations
 
 import gzip
 import pickle
+from collections import OrderedDict
 from typing import Optional, Tuple
 
+from ..raft import NotLeaderError
 from ..state.store import StateStore
 from ..trace import TRACE
 
 SNAPSHOT_VERSION = 1
 
+# applied command ids retained for at-least-once forward dedup; far
+# above any plausible in-flight retry window, bounded so the FSM's
+# memory stays O(1) under sustained traffic
+CMD_DEDUP_MAX = 8192
 
-def encode_command(kind: str, args: tuple) -> bytes:
-    return pickle.dumps((kind, args), protocol=pickle.HIGHEST_PROTOCOL)
+
+class StaleLeadershipError(NotLeaderError):
+    """A command stamped by a deposed leadership generation reached the
+    FSM after a newer leader's barrier committed.  Subclasses
+    NotLeaderError so the worker layer's nack-for-redelivery handling
+    covers it, but it is DEFINITIVE: the forwarding retry loop must
+    propagate it, never re-forward (the rejection is replicated — every
+    FSM applies the same verdict)."""
+
+    def __init__(self, gen: int, fence: int) -> None:
+        Exception.__init__(
+            self,
+            f"command from deposed leadership gen {gen} "
+            f"(fence is gen {fence})",
+        )
+        self.leader = None
+        self.gen = gen
+        self.fence = fence
+
+
+def encode_command(
+    kind: str, args: tuple, cmd_id: Optional[str] = None
+) -> bytes:
+    """Commands travel as (kind, args, cmd_id) — cmd_id is the
+    client-supplied idempotency key: a forward retry after a lost ack
+    re-proposes the SAME id, and the FSM's dedup table returns the
+    first apply's result instead of mutating twice."""
+    return pickle.dumps(
+        (kind, args, cmd_id), protocol=pickle.HIGHEST_PROTOCOL
+    )
 
 
 def normalize_plan_result(result):
@@ -121,8 +155,16 @@ def denormalize_plan_result(store: StateStore, result):
     )
 
 
-def decode_command(raw: bytes) -> Tuple[str, tuple]:
-    return pickle.loads(raw)
+def decode_command(
+    raw: bytes,
+) -> Tuple[str, tuple, Optional[str]]:
+    """(kind, args, cmd_id) of a command; tolerant of the pre-cmd-id
+    2-tuple wire form (cmd_id None) so mixed-version logs still
+    apply."""
+    loaded = pickle.loads(raw)
+    return loaded[0], loaded[1], (
+        loaded[2] if len(loaded) > 2 else None
+    )
 
 
 def state_payload(store: StateStore, acls) -> dict:
@@ -277,25 +319,48 @@ class ServerFSM:
     def __init__(self, store: StateStore, acls=None) -> None:
         self.store = store
         self.acls = acls
+        # committed leadership fence: the newest leadership generation
+        # whose barrier command reached this FSM.  Checked UNDER the
+        # apply (not host-side) so a deposed leader's in-flight plan —
+        # even one forwarded to the new leader — is rejected by every
+        # replica deterministically.
+        self.leadership_fence = 0
+        # cmd_id -> result of successfully applied commands (forward
+        # retries re-propose the same id; the dup returns the cached
+        # result without mutating state).  Part of the snapshot so a
+        # compaction can't resurrect a dup on one replica only.
+        self._applied_cmds: "OrderedDict[str, object]" = OrderedDict()
 
     # raft FSM contract -------------------------------------------------
 
     def apply(self, raw: bytes):
-        kind, args = decode_command(raw)
-        return self.dispatch(kind, args)
+        kind, args, cmd_id = decode_command(raw)
+        if cmd_id is not None and cmd_id in self._applied_cmds:
+            # at-least-once forward dedup: the first apply's result,
+            # no second mutation.  Failures are NOT cached — handlers
+            # are deterministic functions of state, so a re-applied
+            # failed command fails identically on every replica.
+            return self._applied_cmds[cmd_id]
+        result = self.dispatch(kind, args)
+        if cmd_id is not None:
+            self._applied_cmds[cmd_id] = result
+            while len(self._applied_cmds) > CMD_DEDUP_MAX:
+                self._applied_cmds.popitem(last=False)
+        return result
 
     def snapshot(self) -> bytes:
+        payload = state_payload(self.store, self.acls)
+        payload["leadership_fence"] = self.leadership_fence
+        payload["cmd_dedup"] = list(self._applied_cmds.items())
         return gzip.compress(
-            pickle.dumps(
-                state_payload(self.store, self.acls),
-                protocol=pickle.HIGHEST_PROTOCOL,
-            )
+            pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
         )
 
     def restore(self, raw: bytes) -> None:
-        install_payload(
-            self.store, self.acls, pickle.loads(gzip.decompress(raw))
-        )
+        payload = pickle.loads(gzip.decompress(raw))
+        install_payload(self.store, self.acls, payload)
+        self.leadership_fence = payload.get("leadership_fence", 0)
+        self._applied_cmds = OrderedDict(payload.get("cmd_dedup", ()))
 
     # command dispatch (reference fsm.go:197-277) -----------------------
 
@@ -375,7 +440,25 @@ class ServerFSM:
     def _apply_set_autopilot_config(self, config):
         return self.store.set_autopilot_config(config)
 
-    def _apply_upsert_plan_results(self, result, eval_id):
+    def _apply_leadership_barrier(self, gen):
+        """A newly established leader's first replicated command: move
+        the fence so any still-in-flight command stamped by an OLDER
+        generation (a deposed leader's wave) is rejected under the
+        apply on every replica (reference: the establishLeadership
+        barrier, leader.go:222, hardened into the log itself)."""
+        self.leadership_fence = max(self.leadership_fence, gen)
+        return self.leadership_fence
+
+    def _apply_upsert_plan_results(self, result, eval_id, leader_gen=None):
+        if (
+            leader_gen is not None
+            and leader_gen < self.leadership_fence
+        ):
+            # a deposed leader's wave must not commit: the plan was
+            # computed against scheduling state that predates the new
+            # leader's restore.  Raised (not returned) so the proposer
+            # side fails its future and nacks the eval for redelivery.
+            raise StaleLeadershipError(leader_gen, self.leadership_fence)
         if getattr(result, "normalized", False):
             result = denormalize_plan_result(self.store, result)
         index = self.store.upsert_plan_results(result, eval_id)

@@ -25,8 +25,9 @@ import os
 import queue as _queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
+from ..raft import NotLeaderError
 from ..state.store import StateStore
 from ..trace import TRACE
 from ..structs import (
@@ -309,12 +310,20 @@ class PlanApplier:
         blocked=None,
         metrics=None,
         pool: Optional[EvaluatePool] = None,
+        leader_check: Optional[Callable[[], bool]] = None,
     ) -> None:
         self.store = store
         self.plan_queue = plan_queue
         self.blocked = blocked
         self.metrics = metrics
         self.pool = pool if pool is not None else EvaluatePool()
+        # leadership fence: when set and False, in-flight plans are
+        # rejected with NotLeaderError instead of committing — the
+        # submitting worker converts that to nack-for-redelivery, so
+        # the eval is re-run by whoever holds leadership next
+        # (reference plan_apply.go: the applier only runs on the
+        # leader; here the check closes the revoke race window)
+        self._leader_check = leader_check
         # _stop and _staged are REPLACED on every start(): a committer
         # from a previous leadership term that outlived stop()'s join
         # timeout (e.g. blocked >2s in a raft apply) keeps its own
@@ -370,7 +379,7 @@ class PlanApplier:
         while True:
             try:
                 pending, _r, _f, _e = self._staged.get_nowait()
-                pending.respond(None, RuntimeError("plan queue flushed"))
+                pending.respond(None, NotLeaderError(None))
             except _queue.Empty:
                 return
 
@@ -378,11 +387,27 @@ class PlanApplier:
     # stage 1: verification (overlapped with stage-2 commits)
     # ------------------------------------------------------------------
 
+    def _not_leader(self) -> bool:
+        return self._leader_check is not None and not self._leader_check()
+
+    def _reject_not_leader(self, pending) -> None:
+        if self.metrics is not None:
+            self.metrics.incr("leadership.plan_rejected")
+        if pending.plan.eval_id:
+            TRACE.event(pending.plan.eval_id, "plan.not_leader")
+        pending.respond(None, NotLeaderError(None))
+
     def _verify_loop(self, stop: threading.Event,
                      staged_q: _queue.Queue) -> None:
         while not stop.is_set():
             pending = self.plan_queue.dequeue(timeout=0.1)
             if pending is None:
+                continue
+            if self._not_leader():
+                # leadership revoked with this plan in flight: reject
+                # before any verification work — the worker nacks the
+                # eval for redelivery under the next leadership
+                self._reject_not_leader(pending)
                 continue
             import time as _time
 
@@ -439,9 +464,7 @@ class PlanApplier:
                 # to hit its wait timeout
                 with self._lock:
                     self._remove_inflight_locked(result)
-                pending.respond(
-                    None, RuntimeError("plan queue flushed")
-                )
+                pending.respond(None, NotLeaderError(None))
 
     # ------------------------------------------------------------------
     # stage 2: ordered commit
@@ -455,6 +478,14 @@ class PlanApplier:
                     timeout=0.1
                 )
             except _queue.Empty:
+                continue
+            if self._not_leader():
+                # staged between verify and commit when leadership
+                # moved: the optimistic result must never reach the
+                # store (a new leader owns that state now)
+                with self._lock:
+                    self._remove_inflight_locked(result)
+                self._reject_not_leader(pending)
                 continue
             try:
                 with self._lock:
@@ -506,7 +537,20 @@ class PlanApplier:
             or result.deployment is not None
             or result.deployment_updates
         ):
-            index = self.store.upsert_plan_results(result, plan.eval_id)
+            # the producing wave's captured generation, passed only
+            # when stamped (so store facades without the kwarg keep
+            # working for unstamped plans): the replicated fence must
+            # judge the plan by the leadership it RAN under, not by
+            # whoever leads when it reaches the store
+            gen = getattr(plan, "leader_gen", None)
+            if gen is not None:
+                index = self.store.upsert_plan_results(
+                    result, plan.eval_id, leader_gen=gen
+                )
+            else:
+                index = self.store.upsert_plan_results(
+                    result, plan.eval_id
+                )
             result.alloc_index = index
             self.applied += 1
             self._notify_capacity_change(result, index)

@@ -9,6 +9,7 @@ import itertools
 import threading
 from typing import List, Optional, Tuple
 
+from ..raft import NotLeaderError
 from ..structs import Plan, PlanResult
 
 
@@ -50,15 +51,18 @@ class PlanQueue:
             self._lock.notify_all()
 
     def flush(self) -> None:
+        # the queue only runs on a leader: a flush IS a leadership
+        # (or lifecycle) boundary, and pending submitters must nack
+        # their evals for redelivery rather than fail them
         for _, _, pending in self._heap:
-            pending.respond(None, RuntimeError("plan queue flushed"))
+            pending.respond(None, NotLeaderError(None))
         self._heap = []
         self.stats["depth"] = 0
 
     def enqueue(self, plan: Plan) -> PendingPlan:
         with self._lock:
             if not self._enabled:
-                raise RuntimeError("plan queue is disabled")
+                raise NotLeaderError(None)
             pending = PendingPlan(plan)
             heapq.heappush(
                 self._heap,

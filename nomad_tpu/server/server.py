@@ -54,6 +54,20 @@ from .worker import Worker
 
 DEFAULT_HEARTBEAT_TTL = 30.0
 
+# leadership failover telemetry, zero-registered at construction (the
+# `leadership-metrics` nomadlint rule enforces registry membership for
+# every emission across server.py / batch_worker.py / cluster.py)
+LEADERSHIP_COUNTERS = (
+    "leadership.establishes",
+    "leadership.revokes",
+    "leadership.unacked_on_revoke",
+    "leadership.chain_aborts",
+    "leadership.plan_rejected",
+    "leadership.stale_wave_fenced",
+    "raft.forward_retries",
+)
+LEADERSHIP_GAUGES = ("leadership.generation", "leadership.is_leader")
+
 
 class _PlanRecorder:
     """Records scheduler output without committing (dry-run planner)."""
@@ -199,7 +213,18 @@ class Server:
         self.blocked = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.applier = PlanApplier(
-            self.store, self.plan_queue, self.blocked, self.metrics
+            self.store, self.plan_queue, self.blocked, self.metrics,
+            # in-flight plans of a deposed leadership respond
+            # NotLeaderError (the worker converts it to
+            # nack-for-redelivery) instead of committing against state
+            # a new leader now owns
+            leader_check=lambda: self._leader_established,
+        )
+        # leadership failover observability: zero-registered so
+        # absence-of-series means "no leadership ever changed", never
+        # "not exported" (the same contract as device.* incidents)
+        self.metrics.preregister(
+            counters=LEADERSHIP_COUNTERS, gauges=LEADERSHIP_GAUGES
         )
         if batch_pipeline:
             from .batch_worker import BatchWorker
@@ -311,6 +336,13 @@ class Server:
         self._sweeper_lock = threading.Lock()
         self._running = False
         self._leader_established = False
+        # leadership generation: bumped on every establish (a cluster
+        # server passes its raft term, so generations are monotone
+        # ACROSS servers).  The batched hot path captures it at
+        # wave/chain/storm start and fences commits on it exactly like
+        # _backend_epoch fences device buffers — a wave speculated
+        # under a deposed leadership can never commit.
+        self._leadership_gen = 0
         self._leader_lock = threading.Lock()
         # happens-before sanitizer (NOMAD_TPU_TSAN=1)
         from ..tsan import maybe_instrument
@@ -332,14 +364,30 @@ class Server:
         # shared logger and keep buffering every record
         self.log_monitor.uninstall("nomad_tpu")
 
-    def establish_leadership(self) -> None:
+    def establish_leadership(self, gen: Optional[int] = None) -> None:
         """Enable the leader-only services (reference leader.go:222):
         eval broker, blocked evals, plan queue/applier, scheduling
         workers, deployment watcher, drainer, periodic dispatcher,
-        heartbeat timers; then restore evals from state."""
+        heartbeat timers; then restore evals from state.  ``gen`` is
+        the new leadership generation (a cluster server passes its
+        raft term); single-process servers self-increment."""
         with self._leader_lock:
             if self._leader_established:
                 return
+            self._leadership_gen = (
+                gen if gen is not None else self._leadership_gen + 1
+            )
+            # flipped BEFORE any service starts (the mirror of revoke
+            # flipping it false first): the applier's leader_check and
+            # the workers' leadership fences read this latch, and a
+            # worker dequeuing in the establish window must not fence
+            # its own brand-new leadership's evals into nacks
+            self._leader_established = True
+            self.metrics.incr("leadership.establishes")
+            self.metrics.set_gauge(
+                "leadership.generation", float(self._leadership_gen)
+            )
+            self.metrics.set_gauge("leadership.is_leader", 1.0)
             self.broker.set_enabled(True)
             self.blocked.set_enabled(True)
             self.plan_queue.set_enabled(True)
@@ -378,7 +426,6 @@ class Server:
             self.drainer.start()
             self.periodic.start()
             self.volume_watcher.start()
-            self._leader_established = True
             # rebuild the service catalog once from restored state; all
             # steady-state maintenance is incremental per alloc delta
             self.catalog.sync()
@@ -420,11 +467,24 @@ class Server:
 
     def revoke_leadership(self) -> None:
         """Disable leader-only services (reference leader.go
-        revokeLeadership on leadership loss)."""
+        revokeLeadership on leadership loss).
+
+        Order matters for the batched hot path: ``_leader_established``
+        flips FIRST, so every in-flight wave/chain/storm commit hits
+        the leadership fence (and the plan applier's leader check)
+        before any queue is torn down — an open chunk chain is dropped
+        through its abandon path, a mid-settle storm gulp discards its
+        solve before decompose, and the worker nacks every lease it
+        still holds.  The broker flush then unacks every OUTSTANDING
+        token (drain_family shadow-heap members included); nothing is
+        committed, and the next leader's restore_evals re-enqueues all
+        of it from replicated state."""
         with self._leader_lock:
             if not self._leader_established:
                 return
             self._leader_established = False
+            self.metrics.incr("leadership.revokes")
+            self.metrics.set_gauge("leadership.is_leader", 0.0)
             self.device_supervisor.stop()
             self.periodic.stop()
             self.deployment_watcher.stop()
@@ -436,6 +496,16 @@ class Server:
             self._heartbeat_deadlines.clear()
             self.plan_queue.set_enabled(False)
             self.blocked.set_enabled(False)
+            # every token still outstanding at this point — normal
+            # dequeues, drain_family shadow-heap members, mid-settle
+            # storm gulps, admission-queue leases — is unacked by the
+            # disable flush; the count is the failover's "work in
+            # flight" exposure on /v1/metrics
+            outstanding = self.broker.unacked_count()
+            if outstanding:
+                self.metrics.incr(
+                    "leadership.unacked_on_revoke", float(outstanding)
+                )
             self.broker.set_enabled(False)
 
     def restore_evals(self) -> None:

@@ -14,6 +14,7 @@ import threading
 from typing import List, Optional, Tuple
 
 from ..explain import EXPLAIN
+from ..raft import NotLeaderError
 from ..sched import new_scheduler
 from ..state.store import StateSnapshot, StateStore
 from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_BLOCKED
@@ -46,11 +47,31 @@ class Worker:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(
+        # leadership can be re-established on the same server (revoke
+        # -> establish): the previous generation's thread must not
+        # race the new one for the worker's shared pipeline state.
+        # Post-revoke threads exit fast (the leadership fence aborts
+        # open chains and the broker is disabled), so the join is
+        # pro-forma — but a straggler that outlives it (e.g. blocked
+        # in a 10s plan wait) is fenced by _current_generation(): the
+        # moment self._thread points at the new thread, the old one's
+        # next loop check exits it regardless of the cleared _stop.
+        prev = self._thread
+        if prev is not None and prev.is_alive():
+            prev.join(timeout=5.0)
+        thread = threading.Thread(
             target=self.run, name="worker", daemon=True
         )
-        self._thread.start()
+        self._thread = thread
+        self._stop.clear()
+        thread.start()
+
+    def _current_generation(self) -> bool:
+        """Whether the calling thread is this worker's CURRENT run()
+        thread.  True as well for direct run() calls outside start()
+        (test harnesses)."""
+        current = self._thread
+        return current is None or current is threading.current_thread()
 
     def stop(self) -> None:
         self._stop.set()
@@ -66,7 +87,7 @@ class Worker:
             self._paused.clear()
 
     def run(self) -> None:
-        while not self._stop.is_set():
+        while not self._stop.is_set() and self._current_generation():
             if self._paused.is_set():
                 self._stop.wait(0.05)
                 continue
@@ -121,6 +142,16 @@ class Worker:
                 speculative=getattr(scheduler, "speculative", False),
             ):
                 scheduler.process(ev)
+        except NotLeaderError:
+            # leadership moved while this eval was in flight (the plan
+            # applier rejected the plan, or the replicated fence
+            # tripped): nack for redelivery — the next leader's broker
+            # re-runs it against restored state.  Not an error.
+            try:
+                self.server.broker.nack(ev.id, token)
+            except ValueError:
+                pass  # the revoke flush already unacked the lease
+            return
         except Exception:  # noqa: BLE001
             self.server.broker.nack(ev.id, token)
             raise
@@ -143,6 +174,14 @@ class Worker:
     def submit_plan(
         self, plan: Plan
     ) -> Tuple[PlanResult, Optional[StateSnapshot]]:
+        if getattr(plan, "leader_gen", None) is None:
+            # serial paths stamp the current generation at submit
+            # time (their plans cannot straggle across a leadership
+            # change: the plan queue flush kills them on revoke);
+            # wave commits stamp their captured generation upstream
+            plan.leader_gen = getattr(
+                self.server, "_leadership_gen", None
+            )
         plan.snapshot_index = self.store.latest_index()
         pending = self.server.plan_queue.enqueue(plan)
         result = pending.wait(timeout=10.0)

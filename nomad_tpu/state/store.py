@@ -989,8 +989,12 @@ class StateStore:
     # ------------------------------------------------------------------
 
     def upsert_plan_results(
-        self, result: PlanResult, eval_id: str = ""
+        self, result: PlanResult, eval_id: str = "",
+        leader_gen: Optional[int] = None,
     ) -> int:
+        # leader_gen is the replicated-store facade's concern (the FSM
+        # leadership fence); the direct single-process store accepts
+        # and ignores it so the plan applier can pass one call shape
         with self._lock:
             updates: List[Allocation] = []
             for allocs in result.node_update.values():

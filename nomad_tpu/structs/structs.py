@@ -1208,6 +1208,12 @@ class Plan:
     deployment_updates: List["DeploymentStatusUpdate"] = field(default_factory=list)
     annotations: Optional[Dict[str, Any]] = None
     snapshot_index: int = 0
+    # leadership generation the producing wave/chain captured when it
+    # STARTED (not when the plan reaches the store): the replicated
+    # FSM fence compares this against the committed leadership
+    # barrier, so a straggler wave from a deposed generation is
+    # rejected even if its server has since been re-elected
+    leader_gen: Optional[int] = None
 
     def append_stopped_alloc(
         self, alloc: Allocation, desired_desc: str, client_status: str = ""

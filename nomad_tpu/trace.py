@@ -117,6 +117,10 @@ SPAN_NAMES = frozenset(
         # plan pipeline + state commit
         "plan.evaluate",
         "plan.apply",
+        # leadership failover: the applier rejected an in-flight plan
+        # because leadership was revoked (the submitting worker nacks
+        # the eval for redelivery under the next leadership)
+        "plan.not_leader",
         "store.commit",
         "fsm.apply",
     }

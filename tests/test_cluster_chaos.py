@@ -1,0 +1,329 @@
+"""Cluster failover semantics: the forwarding retry loop survives
+leadership moving mid-forward, the FSM's replicated leadership fence
+rejects a deposed leader's plan, command-id dedup makes forwards
+idempotent, and the chaos smoke's kill/heal schedule holds its
+invariants at test scale."""
+import pickle
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft import NotLeaderError
+from nomad_tpu.raft.chaos import ChaosTransport, parse_fault
+from nomad_tpu.raft.transport import TransportError
+from nomad_tpu.server.cluster import TestCluster
+from nomad_tpu.server.fsm import (
+    ServerFSM,
+    StaleLeadershipError,
+    encode_command,
+)
+
+
+def wait_until(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def chaos_cluster():
+    transport = ChaosTransport(seed=7)
+    c = TestCluster(3, transport=transport, heartbeat_ttl=120.0)
+    c.start()
+    yield c, transport
+    transport.disarm()
+    c.stop()
+
+
+def _new_leader(cluster, exclude, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        est = [
+            s
+            for s in cluster.servers
+            if s is not exclude
+            and s.is_leader()
+            and s._leader_established
+        ]
+        if est:
+            return est[0]
+        time.sleep(0.02)
+    raise AssertionError("no new leader")
+
+
+def test_forward_retry_survives_leadership_move(
+    chaos_cluster, monkeypatch
+):
+    """A follower write issued DURING the interregnum is not lost: the
+    retry loop backs off, rediscovers the new leader, and commits."""
+    monkeypatch.setenv("NOMAD_TPU_FORWARD_RETRIES", "12")
+    cluster, transport = chaos_cluster
+    leader = cluster.wait_for_leader()
+    follower = cluster.followers()[0]
+    # depose the leader; immediately push a write through the follower
+    transport.partition_group([leader.addr])
+    from nomad_tpu.structs import Namespace
+
+    follower.store.upsert_namespace(
+        Namespace(name="survived", description="forwarded")
+    )
+    new_leader = _new_leader(cluster, exclude=leader)
+    transport.heal(leader.addr)
+    assert (
+        new_leader.fsm.store.namespaces.get("survived") is not None
+    )
+    total_retries = sum(
+        s.metrics.get_counter("raft.forward_retries")
+        for s in cluster.servers
+    )
+    assert total_retries >= 1.0
+
+
+def test_remote_fsm_apply_returns_structured_not_leader(
+    chaos_cluster,
+):
+    """Satellite: a forwarded fsm_apply landing on a non-leader must
+    answer with a structured not-leader response (plus a hint), never
+    a crash."""
+    cluster, transport = chaos_cluster
+    leader = cluster.wait_for_leader()
+    follower = cluster.followers()[0]
+    data = encode_command(
+        "upsert_namespace",
+        (__import__("nomad_tpu.structs", fromlist=["Namespace"])
+         .Namespace(name="x", description=""),),
+        cmd_id="cmd-structured",
+    )
+    resp = transport.rpc(
+        leader.addr, follower.addr, "fsm_apply", {"data": data}
+    )
+    assert resp.get("not_leader") is True
+    assert resp.get("leader") == leader.addr
+
+
+def test_stale_leadership_plan_cannot_commit(chaos_cluster):
+    """Acceptance: a deposed leader's in-flight plan — even forwarded
+    to the NEW leader — is rejected under the raft apply by the
+    replicated generation fence, and nothing lands in any store."""
+    from nomad_tpu.structs import Allocation, PlanResult
+
+    cluster, transport = chaos_cluster
+    old_leader = cluster.wait_for_leader()
+    for _ in range(3):
+        old_leader.register_node(mock.node())
+    old_gen = old_leader._leadership_gen
+    assert old_gen >= 1
+
+    # depose: isolate, elect, heal — the old leader steps down but
+    # its host-side _leadership_gen still says old_gen (it never
+    # re-established), exactly like a wave captured pre-revoke
+    transport.partition_group([old_leader.addr])
+    new_leader = _new_leader(cluster, exclude=old_leader)
+    transport.heal(old_leader.addr)
+    wait_until(
+        lambda: not old_leader.is_leader()
+        and not old_leader._leader_established,
+        msg="old leader stepped down",
+    )
+    assert new_leader._leadership_gen > old_gen
+    # the barrier replicated: every FSM's fence moved to the new gen
+    wait_until(
+        lambda: all(
+            s.fsm.leadership_fence == new_leader._leadership_gen
+            for s in cluster.servers
+        ),
+        msg="fence replication",
+    )
+
+    # the deposed leader now tries to commit the wave it had in
+    # flight: its ReplicatedStore stamps the OLD generation, the
+    # forward lands on the new leader, and the FSM rejects it
+    node_id = next(iter(old_leader.store.nodes))
+    alloc = mock.alloc(node_id=node_id)
+    alloc.job = mock.job(id="zombie")
+    alloc.job_id = "zombie"
+    result = PlanResult(node_allocation={node_id: [alloc]})
+    with pytest.raises(StaleLeadershipError):
+        old_leader.store.upsert_plan_results(result, "ev-zombie")
+    for s in cluster.servers:
+        assert s.fsm.store.alloc_by_id(alloc.id) is None, (
+            f"zombie alloc committed on {s.addr}"
+        )
+    # ... while the new leadership's own plans commit fine
+    alloc2 = mock.alloc(node_id=node_id)
+    alloc2.job = mock.job(id="fresh")
+    alloc2.job_id = "fresh"
+    new_leader.store.upsert_plan_results(
+        PlanResult(node_allocation={node_id: [alloc2]}), "ev-fresh"
+    )
+    assert new_leader.fsm.store.alloc_by_id(alloc2.id) is not None
+
+
+def test_stale_leadership_error_survives_tcp_hop():
+    """The replicated fence's verdict must keep its real type across
+    a framed-TCP forward: the retry loop treats StaleLeadershipError
+    as definitive, and a bare RuntimeError would take the generic
+    crash path instead of nack-for-redelivery."""
+    import socket
+
+    from nomad_tpu.raft.tcp import TcpTransport
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    transport = TcpTransport()
+    addr = f"127.0.0.1:{free_port()}"
+
+    def handler(method, payload):
+        raise StaleLeadershipError(3, 7)
+
+    transport.register(addr, handler)
+    try:
+        with pytest.raises(StaleLeadershipError) as exc_info:
+            transport.rpc(addr, addr, "fsm_apply", {})
+        assert exc_info.value.gen == 3
+        assert exc_info.value.fence == 7
+    finally:
+        transport.close()
+
+
+def test_straggler_wave_generation_is_not_laundered(chaos_cluster):
+    """A plan stamped with a deposed generation is rejected even when
+    it reaches the store THROUGH the current leader (a straggler
+    thread on a re-elected server must not get re-stamped with the
+    new term)."""
+    from nomad_tpu.structs import Allocation, PlanResult
+
+    cluster, _transport = chaos_cluster
+    leader = cluster.wait_for_leader()
+    for _ in range(2):
+        leader.register_node(mock.node())
+    gen = leader._leadership_gen
+    node_id = next(iter(leader.store.nodes))
+    alloc = mock.alloc(node_id=node_id)
+    alloc.job = mock.job(id="straggler")
+    alloc.job_id = "straggler"
+    result = PlanResult(node_allocation={node_id: [alloc]})
+    # the wave's captured (older) generation rides the call even on
+    # the current leader — the FSM fence judges by it
+    with pytest.raises(StaleLeadershipError):
+        leader.store.upsert_plan_results(
+            result, "ev-straggler", leader_gen=gen - 1
+        )
+    assert leader.fsm.store.alloc_by_id(alloc.id) is None
+    # the captured CURRENT generation passes
+    leader.store.upsert_plan_results(
+        result, "ev-straggler", leader_gen=gen
+    )
+    assert leader.fsm.store.alloc_by_id(alloc.id) is not None
+
+
+def test_fsm_command_dedup_is_idempotent():
+    """The same cmd_id applied twice (a forward retried after a lost
+    ack) mutates state once and returns the first apply's result."""
+    from nomad_tpu.acl import ACLStore
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import Evaluation, new_id
+
+    fsm = ServerFSM(StateStore(), ACLStore())
+    ev = Evaluation(
+        id=new_id(), namespace="default", job_id="j", type="batch"
+    )
+    raw = encode_command("upsert_evals", ([ev], 1.0), cmd_id="dup-1")
+    first = fsm.apply(raw)
+    index_after_first = fsm.store.latest_index()
+    second = fsm.apply(raw)
+    assert second == first
+    assert fsm.store.latest_index() == index_after_first
+    # a distinct cmd_id applies normally
+    raw2 = encode_command("upsert_evals", ([ev], 1.0), cmd_id="dup-2")
+    fsm.apply(raw2)
+    assert fsm.store.latest_index() > index_after_first
+    # dedup state survives snapshot/restore (a compaction must not
+    # resurrect a dup on a restored replica)
+    snap = fsm.snapshot()
+    fsm2 = ServerFSM(StateStore(), ACLStore())
+    fsm2.restore(snap)
+    index_restored = fsm2.store.latest_index()
+    assert fsm2.apply(raw) == first
+    assert fsm2.store.latest_index() == index_restored
+
+
+def test_leadership_barrier_fences_older_generations():
+    from nomad_tpu.acl import ACLStore
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import PlanResult
+
+    fsm = ServerFSM(StateStore(), ACLStore())
+    assert fsm.dispatch("leadership_barrier", (5,)) == 5
+    # fences never move backwards
+    assert fsm.dispatch("leadership_barrier", (3,)) == 5
+    with pytest.raises(StaleLeadershipError):
+        fsm.dispatch(
+            "upsert_plan_results", (PlanResult(), "ev", 4)
+        )
+    # current and newer generations (and unstamped legacy commands)
+    # pass
+    fsm.dispatch("upsert_plan_results", (PlanResult(), "ev", 5))
+    fsm.dispatch("upsert_plan_results", (PlanResult(), "ev", None))
+
+
+def test_parse_fault_specs():
+    assert parse_fault("leader_kill").kind == "leader_kill"
+    part = parse_fault("partition:server-0,server-1")
+    assert part.kind == "partition"
+    assert part.members == ["server-0", "server-1"]
+    assert parse_fault("msg_drop:7.5").pct == 7.5
+    assert parse_fault("slow_wire:3").ms == 3.0
+    assert parse_fault("") is None
+    assert parse_fault("bogus") is None
+
+
+def test_chaos_transport_msg_drop_is_deterministic():
+    calls = []
+
+    def handler(method, payload):
+        calls.append(method)
+        return {"ok": True}
+
+    def run(seed):
+        t = ChaosTransport(seed=seed)
+        t.register("a", handler)
+        t.register("b", handler)
+        t.arm(parse_fault("msg_drop:40"))
+        outcomes = []
+        for _ in range(50):
+            try:
+                t.rpc("a", "b", "ping", {})
+                outcomes.append(1)
+            except TransportError:
+                outcomes.append(0)
+        return outcomes
+
+    first = run(3)
+    assert 0 in first and 1 in first
+    assert first == run(3)  # seeded: bit-identical replay
+    assert first != run(4) or True  # different seed may differ
+
+
+def test_chaos_smoke_invariants_small():
+    """The chaos smoke at test scale: 2 kills + a healed partition
+    under load, zero lost / zero duplicates vs the oracle."""
+    from nomad_tpu.raft.chaos_smoke import run_smoke
+
+    block = run_smoke(jobs=40, kills=2, nodes=4)
+    assert block["ok"], block
+    assert block["oracle_match"]
+    assert block["lost_evals"] == 0
+    assert block["duplicate_placements"] == 0
+    assert block["apply_monotone"]
+    assert len(block["detect_to_resume_s"]) == 2
+    assert block["detect_to_resume_max_s"] < 30.0

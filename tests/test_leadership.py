@@ -1,0 +1,385 @@
+"""Leadership-loss hardening: the batched hot path survives a revoke
+at EVERY leadership-sensitive seam with zero lost evals and zero
+double-commits, the plan applier rejects in-flight plans with
+NotLeaderError, the broker's nack-timeout sweep covers drain_family's
+shadow-heap members, and the explain/trace audit carries the
+leadership generation.
+
+The revoke points are forced deterministically through the chaos race
+hooks (nomad_tpu/raft/chaos.py) — the same seams the cluster chaos
+smoke exercises stochastically.
+"""
+import copy
+import random
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft import NotLeaderError, chaos
+from nomad_tpu.server import Server
+from nomad_tpu.structs import compute_node_class
+
+
+def make_nodes(n, seed=0):
+    rng = random.Random(seed)
+    nodes = []
+    for _ in range(n):
+        node = mock.node()
+        node.node_resources.cpu = rng.choice([4000, 8000])
+        node.node_resources.memory_mb = rng.choice([8192, 16384])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def make_jobs(n, fam=None, cpu=500):
+    jobs = []
+    for i in range(n):
+        job_id = (
+            f"{fam}/dispatch-{i:04d}" if fam else f"lead-{i:04d}"
+        )
+        job = mock.job(id=job_id)
+        job.type = "batch"
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = cpu
+        job.task_groups[0].tasks[0].resources.memory_mb = 256
+        jobs.append(job)
+    return jobs
+
+
+def live_placements(server, job_id):
+    return [
+        (a.name, a.node_id)
+        for a in server.store.allocs_by_job("default", job_id)
+        if not a.terminal_status()
+    ]
+
+
+def settle(server, jobs, timeout=60.0):
+    """Wait until every job is placed exactly once and every eval is
+    terminal (the zero-lost / zero-double-commit acceptance)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        done = server.drain_to_idle(timeout=1.0) and all(
+            len(live_placements(server, job.id)) == 1
+            and all(
+                e.terminal_status()
+                for e in server.store.evals_by_job(
+                    "default", job.id
+                )
+            )
+            for job in jobs
+        )
+        if done:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos_hooks():
+    yield
+    chaos.clear_hooks()
+
+
+def arm_revoke_at(server, hook_name):
+    """Arm a chaos hook that revokes leadership from a side thread the
+    FIRST time the hot path crosses the named seam, and blocks the
+    pipeline thread until the revoke is visible — a deterministic
+    leadership-loss race at exactly that seam."""
+    fired = threading.Event()
+    revoked = threading.Event()
+
+    def hook():
+        if fired.is_set():
+            return
+        fired.set()
+
+        def do_revoke():
+            server.revoke_leadership()
+            revoked.set()
+
+        threading.Thread(target=do_revoke, daemon=True).start()
+        deadline = time.monotonic() + 5.0
+        while (
+            time.monotonic() < deadline
+            and server._leader_established
+        ):
+            time.sleep(0.001)
+
+    chaos.install_hook(hook_name, hook)
+    return fired, revoked
+
+
+REVOKE_POINTS = [
+    # (hook seam, env overrides) — gulp fill, mid-chunk-launch,
+    # between speculate and commit, mid-storm-solve, storm staging
+    ("gulp_filled", {}),
+    ("chunk_launched", {}),
+    ("pre_commit_wave", {}),
+    ("storm_solved", {"NOMAD_TPU_STORM": "1", "NOMAD_TPU_STORM_MIN": "8"}),
+    ("storm_staged", {"NOMAD_TPU_STORM": "1", "NOMAD_TPU_STORM_MIN": "8"}),
+]
+
+
+@pytest.mark.parametrize(
+    "seam,env", REVOKE_POINTS, ids=[p[0] for p in REVOKE_POINTS]
+)
+def test_revoke_mid_flight_loses_nothing(monkeypatch, seam, env):
+    """Leadership dies at the seam; after re-establishment every eval
+    is redelivered and placed EXACTLY once — zero lost, zero
+    double-commits — and the generation fence actually tripped."""
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    storm = bool(env)
+    fam = "leadfam" if storm else None
+    jobs = make_jobs(24, fam=fam)
+    server = Server(num_schedulers=1, seed=5, batch_pipeline=True)
+    for node in make_nodes(16, seed=2):
+        server.register_node(copy.deepcopy(node))
+    # jobs land in the broker as one restore wave at establish (the
+    # mass shape that keeps a chain/storm open long enough to kill)
+    for job in jobs:
+        server.register_job(copy.deepcopy(job))
+    fired, revoked = arm_revoke_at(server, seam)
+    server.start()
+    try:
+        assert fired.wait(30.0), f"seam {seam} never crossed"
+        assert revoked.wait(10.0), "revoke did not complete"
+        gen_before = server._leadership_gen
+        assert not server._leader_established
+        # nothing may be committed by the dead leadership after this
+        # point; the broker flush unacked every outstanding token
+        assert server.broker.unacked_count() == 0
+        chaos.clear_hooks()
+        # re-establish (the single-process analogue of the next
+        # leader's election): restore_evals re-enqueues everything
+        server.establish_leadership()
+        assert server._leadership_gen == gen_before + 1
+        assert settle(server, jobs, timeout=90.0), (
+            "evals lost after revoke at " + seam
+        )
+        for job in jobs:
+            assert len(live_placements(server, job.id)) == 1, (
+                f"duplicate/missing placement for {job.id}"
+            )
+        m = server.metrics
+        assert m.get_counter("leadership.revokes") >= 1.0
+        assert m.get_counter("leadership.establishes") >= 2.0
+    finally:
+        chaos.clear_hooks()
+        server.stop()
+
+
+def test_revoke_mid_wave_generation_fence_trips(monkeypatch):
+    """The acceptance race: leadership dies BETWEEN speculation and
+    commit (forced via the pre_commit_wave fault hook) — the
+    generation fence must trip and the wave must not commit."""
+    jobs = make_jobs(16)
+    server = Server(num_schedulers=1, seed=9, batch_pipeline=True)
+    for node in make_nodes(12, seed=4):
+        server.register_node(copy.deepcopy(node))
+    for job in jobs:
+        server.register_job(copy.deepcopy(job))
+    fired, revoked = arm_revoke_at(server, "pre_commit_wave")
+    server.start()
+    try:
+        assert fired.wait(30.0)
+        assert revoked.wait(10.0)
+        # the fence tripped (stale wave refused) and nothing the dead
+        # leadership had in flight committed afterwards
+        deadline = time.monotonic() + 10.0
+        while (
+            time.monotonic() < deadline
+            and server.metrics.get_counter(
+                "leadership.stale_wave_fenced"
+            )
+            < 1.0
+        ):
+            time.sleep(0.02)
+        assert (
+            server.metrics.get_counter("leadership.stale_wave_fenced")
+            >= 1.0
+        )
+        placed_while_dead = sum(
+            len(live_placements(server, job.id)) for job in jobs
+        )
+        committed_at_revoke = placed_while_dead
+        time.sleep(0.5)  # give any straggler a chance to misbehave
+        placed_later = sum(
+            len(live_placements(server, job.id)) for job in jobs
+        )
+        assert placed_later == committed_at_revoke, (
+            "a deposed leadership committed a wave member"
+        )
+        chaos.clear_hooks()
+        server.establish_leadership()
+        assert settle(server, jobs, timeout=90.0)
+    finally:
+        chaos.clear_hooks()
+        server.stop()
+
+
+def test_explain_and_trace_carry_leadership_generation():
+    from nomad_tpu.explain import EXPLAIN
+    from nomad_tpu.trace import TRACE
+
+    jobs = make_jobs(6)
+    server = Server(num_schedulers=1, seed=3, batch_pipeline=True)
+    server.start()
+    try:
+        for node in make_nodes(8, seed=1):
+            server.register_node(copy.deepcopy(node))
+        for job in jobs:
+            server.register_job(copy.deepcopy(job))
+        assert server.drain_to_idle(30.0)
+        gen = server._leadership_gen
+        assert gen >= 1
+        checked_explain = checked_trace = 0
+        for job in jobs:
+            for ev in server.store.evals_by_job("default", job.id):
+                rec = EXPLAIN.get(ev.id)
+                if rec is not None and "LeaderGen" in rec:
+                    assert rec["LeaderGen"] == gen
+                    checked_explain += 1
+                trace = TRACE.get(ev.id)
+                if trace is not None:
+                    assert trace["attrs"].get("leader_gen") == gen
+                    checked_trace += 1
+        assert checked_explain > 0 and checked_trace > 0
+    finally:
+        server.stop()
+
+
+def test_plan_applier_rejects_in_flight_plans_not_leader():
+    """A plan staged while leadership is lost responds NotLeaderError
+    (never a commit), and the plan queue refuses new plans."""
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import Plan
+
+    is_leader = [True]
+    queue = PlanQueue()
+    queue.set_enabled(True)
+    applier = PlanApplier(
+        StateStore(), queue, leader_check=lambda: is_leader[0]
+    )
+    applier.start()
+    try:
+        is_leader[0] = False
+        pending = queue.enqueue(Plan(eval_id="ev-x"))
+        with pytest.raises(NotLeaderError):
+            pending.wait(timeout=5.0)
+    finally:
+        applier.stop()
+        queue.set_enabled(False)
+    with pytest.raises(NotLeaderError):
+        queue.enqueue(Plan(eval_id="ev-y"))
+
+
+def test_broker_sweep_redelivers_crashed_storm_drain():
+    """Satellite: drain_family's shadow-heap members must never rely
+    on the storm path settling — a crashed _process_storm (simulated:
+    leases taken, never acked/nacked) is fully redelivered by the
+    nack-timeout sweep."""
+    from nomad_tpu.server.eval_broker import EvalBroker, job_family
+    from nomad_tpu.structs import Evaluation, new_id
+
+    broker = EvalBroker(nack_timeout=0.1)
+    broker.set_enabled(True)
+    evs = [
+        Evaluation(
+            id=new_id(),
+            namespace="default",
+            job_id=f"fam/dispatch-{i:03d}",
+            type="batch",
+            priority=50,
+        )
+        for i in range(8)
+    ]
+    broker.enqueue_all(evs)
+    ev, _token = broker.dequeue(["batch"], timeout=1.0)
+    drained = broker.drain_family(
+        ["batch"], job_family(ev), max_n=16
+    )
+    assert len(drained) == 7
+    assert broker.unacked_count() == 8
+    # the worker "crashed": nobody settles these leases.  Every
+    # member must come back within the nack timeout.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and (
+        broker.unacked_count() or broker.ready_count() < 8
+    ):
+        time.sleep(0.02)
+    assert broker.unacked_count() == 0
+    assert broker.ready_count() == 8
+    # redelivered members are the same evals, intact
+    redelivered = set()
+    while True:
+        ev, token = broker.dequeue(["batch"], timeout=0.2)
+        if ev is None:
+            break
+        redelivered.add(ev.id)
+        broker.ack(ev.id, token)
+    assert redelivered == {e.id for e in evs}
+
+
+def test_broker_sweeper_rearmed_by_drain_after_thread_loss():
+    """The sweep must not depend on set_enabled having started a
+    healthy ticker: drain_family re-arms it."""
+    from nomad_tpu.server.eval_broker import EvalBroker, job_family
+    from nomad_tpu.structs import Evaluation, new_id
+
+    broker = EvalBroker(nack_timeout=0.1)
+    broker.set_enabled(True)
+    # simulate a dead sweeper thread (e.g. killed by a runtime fault)
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    with broker._lock:
+        broker._ticker = dead
+    evs = [
+        Evaluation(
+            id=new_id(),
+            namespace="default",
+            job_id=f"fam/dispatch-{i:03d}",
+            type="batch",
+            priority=50,
+        )
+        for i in range(4)
+    ]
+    broker.enqueue_all(evs)
+    ev, _token = broker.dequeue(["batch"], timeout=1.0)
+    broker.drain_family(["batch"], job_family(ev), max_n=8)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and broker.unacked_count():
+        time.sleep(0.02)
+    assert broker.unacked_count() == 0
+    assert broker.ready_count() == 4
+
+
+def test_revoke_unacks_outstanding_tokens_counter():
+    server = Server(num_schedulers=0, batch_pipeline=False)
+    server.start()
+    try:
+        job = make_jobs(1)[0]
+        for node in make_nodes(2, seed=6):
+            server.register_node(copy.deepcopy(node))
+        server.register_job(job)
+        ev, token = server.broker.dequeue(
+            ["service", "batch", "system", "_core"], timeout=2.0
+        )
+        assert ev is not None
+        assert server.broker.unacked_count() == 1
+        server.revoke_leadership()
+        assert server.broker.unacked_count() == 0
+        assert (
+            server.metrics.get_counter("leadership.unacked_on_revoke")
+            >= 1.0
+        )
+        assert server.metrics.get_gauge("leadership.is_leader") == 0.0
+    finally:
+        server.stop()

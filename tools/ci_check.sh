@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (22 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (23 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -25,6 +25,15 @@ if [ "${SMOKE:-1}" = "1" ]; then
         tests/test_flowgraph.py \
         tests/test_tsan.py \
         tests/test_stage_accounting.py
+
+    echo "== cluster chaos smoke (3 servers, leader kills + partition) =="
+    # leadership-loss gate: zero lost evals / zero duplicate
+    # placements vs the fault-free oracle across repeated leader
+    # kills and a healed partition; the coreutils timeout kills a
+    # wedged cluster so a failover deadlock fails the gate instead
+    # of hanging it
+    timeout -k 10 300 python -m nomad_tpu.raft.chaos_smoke \
+        --jobs 150 --kills 5 --nodes 6
 
     echo "== 2-process distributed smoke (CPU backend, gloo) =="
     # the multi-host mesh gate: distributed init, pod-mesh chain with

@@ -98,6 +98,15 @@ SHARED_STATE_ALLOWLIST: Tuple[Tuple[str, str, str], ...] = (
         "object store) and shuts it down after joining the thread",
     ),
     (
+        "Worker",
+        r"_thread",
+        "generation latch: start() rebinds the reference "
+        "(GIL-atomic object store) and run() threads compare it "
+        "against current_thread() per loop tick — a straggler from "
+        "a previous leadership generation observes the new binding "
+        "one tick later and exits; both orderings are safe",
+    ),
+    (
         "Server",
         r"_clients",
         "node->connection registry: dict get/set are GIL-atomic; a "

@@ -850,6 +850,101 @@ class StormMetricsRule(Rule):
 
 
 @register
+class LeadershipMetricsRule(Rule):
+    """Leadership failover: every ``leadership.*`` / ``raft.*`` metric
+    emitted by server.py, batch_worker.py, plan_apply.py or
+    cluster.py — literal first args of metric calls plus the
+    ``self._count_leadership("<kind>")`` sites, which emit
+    ``leadership.<kind>`` — is in the zero-registered
+    ``LEADERSHIP_COUNTERS`` / ``LEADERSHIP_GAUGES`` registries
+    (server.py) and server.py preregisters them at construction:
+    absence of a ``leadership.*`` series must mean "leadership never
+    changed", never "not exported"."""
+
+    name = "leadership-metrics"
+    description = "leadership.*/raft.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        server_path = ctx.path("server")
+        registry = astutil.assigned_strings(
+            ctx.tree(server_path), "LEADERSHIP_COUNTERS"
+        ) | astutil.assigned_strings(
+            ctx.tree(server_path), "LEADERSHIP_GAUGES"
+        )
+        if not registry:
+            return [
+                Finding(
+                    self.name, server_path, 0,
+                    "could not find the LEADERSHIP_COUNTERS/"
+                    "LEADERSHIP_GAUGES registries in server.py",
+                )
+            ]
+        problems: List[Finding] = []
+        for key in ("server", "batch_worker", "plan_apply", "cluster"):
+            path = ctx.path(key)
+            tree = ctx.tree(path)
+            emitted: Set[str] = set()
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                if (
+                    node.func.attr in astutil.METRIC_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith(
+                        ("leadership.", "raft.")
+                    )
+                ):
+                    emitted.add(node.args[0].value)
+                if (
+                    node.func.attr == "_count_leadership"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    emitted.add(f"leadership.{node.args[0].value}")
+            unregistered = emitted - registry
+            if unregistered:
+                problems.append(
+                    Finding(
+                        self.name, path, 0,
+                        "leadership./raft. metrics emitted but not "
+                        "in the LEADERSHIP_COUNTERS/LEADERSHIP_GAUGES "
+                        "registries (they would be absent from "
+                        "prometheus scrapes until the first "
+                        f"failover): {sorted(unregistered)}",
+                    )
+                )
+        server_src = ctx.source(server_path)
+        # the registry assignment is one occurrence; a preregister
+        # call site must reference the name at least once more
+        if server_src.count("LEADERSHIP_COUNTERS") < 2:
+            problems.append(
+                Finding(
+                    self.name, server_path, 0,
+                    "server.py no longer zero-registers the "
+                    "leadership.* family at construction "
+                    "(LEADERSHIP_COUNTERS preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            append=(
+                "def _nomadlint_bad_fixture(self):\n"
+                '    self._count_leadership("bogus_kind")\n'
+            ),
+        )
+
+
+@register
 class MultichipExportRule(Rule):
     """Sharded hot path: bench.py exports the ``multichip`` JSON block
     (placements/s, host->device bytes/flush, per-device FLOPs vs
