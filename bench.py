@@ -1029,6 +1029,38 @@ WITH_EXPLAIN_OVERHEAD = (
 )
 WITH_DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
 WITH_STORM = os.environ.get("BENCH_STORM", "1") == "1"
+WITH_SWARM = os.environ.get("BENCH_SWARM", "1") == "1"
+
+
+def bench_swarm():
+    """Swarm-scale SLO harness as a bench block
+    (nomad_tpu.loadgen.swarm_smoke): a >=2k-node heartbeat storm plus
+    >=1k concurrent HTTP submitters with an injected 500-node mass
+    death — exporting heartbeat success, shed/accepted/deferred
+    counts, the death wave's storm-solve count and the
+    flight-recorder p99 exemplars (`swarm` in BENCH json).
+    BENCH_SWARM=0 opts out; BENCH_SWARM_{NODES,SUBMITTERS,DEATH}
+    rescale."""
+    from nomad_tpu.loadgen.swarm_smoke import run_swarm
+
+    t0 = time.time()
+    block = run_swarm(
+        nodes=int(os.environ.get("BENCH_SWARM_NODES", 2200)),
+        submitters=int(
+            os.environ.get("BENCH_SWARM_SUBMITTERS", 1100)
+        ),
+        death=int(os.environ.get("BENCH_SWARM_DEATH", 500)),
+    )
+    log(
+        f"swarm: ok={block['ok']} "
+        f"hb={block['heartbeat_success']:.4%} "
+        f"sheds={block['sheds']:.0f} "
+        f"death {block['death_nodes']} nodes in "
+        f"{block['storm_solves']:.0f} solve(s), "
+        f"eval p99 {block['eval_latency_p99_ms']}ms "
+        f"({time.time() - t0:.1f}s)"
+    )
+    return block
 
 
 def bench_storm():
@@ -1630,6 +1662,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"cluster failover chaos FAILED: {exc!r}")
             cluster_failover = {"error": repr(exc)}
+    swarm = {}
+    if WITH_SWARM:
+        try:
+            swarm = bench_swarm()
+        except Exception as exc:  # noqa: BLE001
+            log(f"swarm harness FAILED: {exc!r}")
+            swarm = {"error": repr(exc)}
 
     n_check = min(E2E_ORACLE_JOBS, E2E_JOBS)
     parity_ok = same == n_check
@@ -1683,6 +1722,11 @@ def main():
                 # partition under load — per-kill detect-to-resume
                 # times and the zero-lost/zero-duplicate verdicts
                 "cluster_failover": cluster_failover,
+                # swarm-scale SLO harness: overload sheds + mass
+                # node-death storm recovery against the real HTTP
+                # API (zero lost / zero false downs / hb >=99.9% /
+                # <=2 solves / p99 exemplars)
+                "swarm": swarm,
                 # global storm solver: mass-drain/scale-up replay
                 # A/B'd storm-on vs storm-off (placements/s, solver
                 # rounds, fallbacks, quality delta, zero-lost proof)

@@ -72,11 +72,25 @@ class APIHandler(BaseHTTPRequestHandler):
 
     # -- plumbing -------------------------------------------------------
 
-    def _body(self) -> Dict:
+    def _consume_body(self) -> None:
+        """Drain the request body exactly once, at dispatch entry.
+
+        With HTTP/1.1 keep-alive, a handler that responds without
+        reading its request body leaves those bytes in the stream —
+        the NEXT request parse then reads ``{}`` as a request line
+        and answers 501, poisoning every other request on a
+        persistent connection (found by the swarm harness, whose
+        generators hold one connection per worker; urllib-based
+        tests reconnect per request and never hit it).  Draining up
+        front also lets the overload shed path answer 429 without
+        the connection-corruption tax."""
         length = int(self.headers.get("Content-Length") or 0)
-        if not length:
+        self._raw_body = self.rfile.read(length) if length > 0 else b""
+
+    def _body(self) -> Dict:
+        raw = getattr(self, "_raw_body", b"")
+        if not raw:
             return {}
-        raw = self.rfile.read(length)
         try:
             return json.loads(raw)
         except ValueError as exc:
@@ -268,11 +282,51 @@ class APIHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._dispatch("DELETE")
 
+    def _shed(self, retry_after_s: float, mode: int) -> None:
+        """429 + Retry-After: the backpressure half of the overload
+        ladder.  Clients (the CLI, the swarm harness, any
+        well-behaved SDK) back off for Retry-After seconds and retry
+        — bounded sheds absorb the overload instead of an unbounded
+        broker backlog absorbing the p99."""
+        from ..server.overload import MODE_NAMES
+
+        data = json.dumps(
+            {
+                "error": "server overloaded",
+                "Mode": MODE_NAMES[mode],
+                "RetryAfter": retry_after_s,
+            }
+        ).encode()
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        self.send_header(
+            "Retry-After", str(max(1, int(round(retry_after_s))))
+        )
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _dispatch(self, method: str) -> None:
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = {k: v[0] for k, v in parse_qs(url.query).items()}
         try:
+            self._consume_body()
+            # ingress backpressure (server/overload.py): admission by
+            # priority class BEFORE any state read or body parse —
+            # heartbeats > plan/blocking queries > job submissions.
+            # Shed requests cost the server one classify + one
+            # counter, which is the whole point.
+            ctl = getattr(self.server_ref, "overload", None)
+            if ctl is not None:
+                from ..server.overload import classify_request
+
+                admitted, retry_after = ctl.admit(
+                    classify_request(method, path)
+                )
+                if not admitted:
+                    self._shed(retry_after, ctl.mode)
+                    return
             # blocking queries (reference rpc.go:780 blockingRPC): a GET
             # with ?index=N long-polls until the state advances past N
             # (or the wait expires), then responds with fresh data; the
@@ -299,7 +353,13 @@ class APIHandler(BaseHTTPRequestHandler):
                     )
                 except ValueError:
                     raise HTTPError(400, "bad index/wait")
-                if authed:
+                if authed and ctl is not None:
+                    # degradation rung between "served" and "shed":
+                    # at SHEDDING+, long-polls answer immediately
+                    # (current state, X-Nomad-Index intact) instead
+                    # of pinning a server thread for the wait
+                    wait_s = ctl.blocking_wait_budget(wait_s)
+                if authed and wait_s > 0:
                     self.server_ref.store.wait_for_index(
                         min_index, timeout=wait_s
                     )
@@ -1667,6 +1727,18 @@ class APIHandler(BaseHTTPRequestHandler):
                 self._respond({"enabled": False, "state": "NONE"})
             else:
                 self._respond(sup.status())
+            return True
+
+        # -- overload / degradation ladder ------------------------------
+        # unauthenticated and NEVER shed, like /v1/metrics: the first
+        # endpoint an operator (or a backing-off client) polls when
+        # the server starts answering 429s
+        if path == "/v1/overload" and method == "GET":
+            ctl = getattr(srv, "overload", None)
+            if ctl is None:
+                self._respond({"enabled": False, "mode": 0})
+            else:
+                self._respond(ctl.status())
             return True
 
         # -- eval flight recorder (per-eval span traces) ----------------

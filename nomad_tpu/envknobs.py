@@ -138,6 +138,45 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "(leader_kill|partition[:a,b]|msg_drop[:pct]|slow_wire[:ms]) "
         "for the chaos harness",
     ),
+    # -- overload control plane (server/overload.py, server.py) -------
+    "NOMAD_TPU_OVERLOAD": EnvKnob(
+        "1", "nomad_tpu/server/overload.py",
+        "0 disables ingress backpressure (every request admitted, "
+        "mode pinned NORMAL)",
+    ),
+    "NOMAD_TPU_OVERLOAD_DEPTH": EnvKnob(
+        "512", "nomad_tpu/server/overload.py",
+        "broker pending-depth threshold for SHEDDING (EMERGENCY "
+        "engages at 4x)",
+    ),
+    "NOMAD_TPU_OVERLOAD_AGE_S": EnvKnob(
+        "30", "nomad_tpu/server/overload.py",
+        "oldest-ready-eval age threshold for SHEDDING (EMERGENCY "
+        "at 4x) — the measured commit-wave lag signal",
+    ),
+    "NOMAD_TPU_OVERLOAD_P99_MS": EnvKnob(
+        "0", "nomad_tpu/server/overload.py",
+        "flight-recorder eval-latency p99 threshold for SHEDDING "
+        "(EMERGENCY at 4x); 0 disables the latency signal",
+    ),
+    "NOMAD_TPU_OVERLOAD_SHED_FLOOR": EnvKnob(
+        "2", "nomad_tpu/server/overload.py",
+        "lowest priority class SHEDDING may shed (2 = job "
+        "submissions only; 1 also sheds queries; heartbeats are "
+        "never shed)",
+    ),
+    "NOMAD_TPU_OVERLOAD_WAVE_MIN": EnvKnob(
+        "8", "nomad_tpu/server/server.py",
+        "TTL expiries per sweep that count as a correlated mass "
+        "node-death (smaller waves transition immediately)",
+    ),
+    "NOMAD_TPU_OVERLOAD_WAVE_GATHER_S": EnvKnob(
+        "auto", "nomad_tpu/server/server.py",
+        "max time a detected mass-death wave gathers straggler TTL "
+        "expiries before the batched down transition commits "
+        "(auto = heartbeat_ttl/3 clamped to [2.5, 10]s, so the "
+        "budget always exceeds the 2s quiet-stream settle)",
+    ),
     # -- server / broker ----------------------------------------------
     "NOMAD_TPU_WARM_ON_START": EnvKnob(
         "0", "nomad_tpu/server/server.py",

@@ -1,0 +1,14 @@
+"""Swarm-scale load generation against the real HTTP API.
+
+``swarm.py`` holds the reusable traffic generators (heartbeat storm,
+submitter swarm, blocking-query fan-out, rolling drains);
+``python -m nomad_tpu.loadgen.swarm_smoke`` composes them into the
+SLO-gated overload/mass-death smoke exported as the bench ``swarm``
+block.
+"""
+from .swarm import (  # noqa: F401
+    BlockingFanout,
+    HeartbeatStorm,
+    HttpSession,
+    SubmitterSwarm,
+)

@@ -1,0 +1,344 @@
+"""Swarm traffic generators: millions-of-users-shaped load against
+the REAL HTTP API (ROADMAP item 2's load-harness half).
+
+Thousands of logical clients are multiplexed over a small worker-
+thread pool, each worker holding ONE persistent HTTP/1.1 connection
+(``HttpSession``) — the server's ThreadingHTTPServer then carries one
+thread per generator worker, not one per logical client, so a 2k-node
+heartbeat storm plus 1k submitters is a few dozen OS threads on each
+side instead of thousands.
+
+Every generator honors the server's backpressure contract: a 429
+response is counted as a shed and retried after its ``Retry-After``
+advice — the client half of the overload ladder.  Heartbeats are
+never expected to shed (the server exempts the liveness plane), so
+the storm counts any heartbeat failure against the SLO.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class HttpSession:
+    """One persistent HTTP/1.1 connection with reconnect-on-error —
+    the per-worker client half of the swarm."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        retry_conn: bool = True,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """(status, lowercased headers, raw body); reconnects once on
+        a torn connection (keep-alive churn, server restart)."""
+        payload = (
+            json.dumps(body).encode() if body is not None else None
+        )
+        headers = (
+            {"Content-Type": "application/json"} if payload else {}
+        )
+        try:
+            conn = self._connection()
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return (
+                resp.status,
+                {k.lower(): v for k, v in resp.getheaders()},
+                data,
+            )
+        except (http.client.HTTPException, OSError):
+            self.close()
+            if not retry_conn:
+                raise
+            return self.request(method, path, body, retry_conn=False)
+
+
+class _Workers:
+    """Shared start/stop shape for the generators below."""
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def _spawn(self, n: int, target: Callable[[int], None]) -> None:
+        for i in range(n):
+            t = threading.Thread(
+                target=target,
+                args=(i,),
+                name=f"{self._name}-{i}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+
+class HeartbeatStorm(_Workers):
+    """Every node heartbeats each ``period_s`` over the real HTTP
+    API; ``kill()`` silences a node set (the mass-death injection —
+    from the server's view the rack just went dark).  Any non-200 on
+    a live node counts against the heartbeat SLO."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        node_ids: Sequence[str],
+        period_s: float,
+        threads: int = 16,
+    ) -> None:
+        super().__init__("hb-storm")
+        self.period_s = period_s
+        self._ok_n = 0
+        self._fail_n = 0
+        self._dead: set = set()
+        self._lock = threading.Lock()
+        threads = max(1, min(threads, len(node_ids) or 1))
+        slices = [list(node_ids[i::threads]) for i in range(threads)]
+        self._host, self._port = host, port
+        self._slices = slices
+        self._spawn(threads, self._run)
+
+    def kill(self, node_ids: Sequence[str]) -> None:
+        with self._lock:
+            self._dead.update(node_ids)
+
+    def counts(self) -> Tuple[int, int]:
+        with self._lock:
+            return self._ok_n, self._fail_n
+
+    def _run(self, idx: int) -> None:
+        session = HttpSession(self._host, self._port)
+        mine = self._slices[idx]
+        if not mine:
+            return
+        # spread each worker's nodes over the period so heartbeats
+        # arrive as a steady storm, not a thundering phase-locked herd
+        gap = self.period_s / len(mine)
+        while not self._stop.is_set():
+            for node_id in mine:
+                if self._stop.is_set():
+                    return
+                with self._lock:
+                    dead = node_id in self._dead
+                if not dead:
+                    try:
+                        status, _h, _b = session.request(
+                            "POST", f"/v1/node/{node_id}/heartbeat",
+                            body={},
+                        )
+                        ok = status == 200
+                    except (http.client.HTTPException, OSError):
+                        ok = False
+                    # counted under the lock: += on an attribute is
+                    # not atomic across 32 workers, and a lost bump
+                    # would skew the hb-success SLO either way
+                    with self._lock:
+                        if ok:
+                            self._ok_n += 1
+                        else:
+                            self._fail_n += 1
+                self._stop.wait(gap)
+        session.close()
+
+
+class SubmitterSwarm(_Workers):
+    """``n_submitters`` logical clients, each registering one job and
+    retrying on 429 per the server's Retry-After advice (scaled by
+    ``retry_scale`` so a smoke run doesn't spend minutes sleeping on
+    honest backoff).  A submitter is DONE only when its job was
+    accepted — sheds absorb the overload, they never lose work."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        n_submitters: int,
+        make_job: Callable[[int], dict],
+        threads: int = 24,
+        # honor Retry-After at face value: a shed client that comes
+        # back early just re-arrives inside the same overload (and
+        # burns generator CPU the heartbeat plane needs)
+        retry_scale: float = 1.0,
+        max_attempts: int = 400,
+    ) -> None:
+        super().__init__("submitter")
+        self.accepted = 0
+        self.sheds = 0
+        self.errors = 0
+        self.failed: List[int] = []
+        self.latencies_ms: List[float] = []
+        self.retry_after_honored = 0
+        self._lock = threading.Lock()
+        self._host, self._port = host, port
+        self._make_job = make_job
+        self._retry_scale = retry_scale
+        self._max_attempts = max_attempts
+        threads = max(1, min(threads, n_submitters or 1))
+        self._slices = [
+            list(range(n_submitters))[i::threads]
+            for i in range(threads)
+        ]
+        self._spawn(threads, self._run)
+
+    def done(self) -> bool:
+        return all(not t.is_alive() for t in self._threads)
+
+    def _run(self, idx: int) -> None:
+        session = HttpSession(self._host, self._port)
+        rng = random.Random(idx)
+        for sub_i in self._slices[idx]:
+            if self._stop.is_set():
+                break
+            job = self._make_job(sub_i)
+            t0 = time.monotonic()
+            for _attempt in range(self._max_attempts):
+                if self._stop.is_set():
+                    break
+                try:
+                    status, headers, _body = session.request(
+                        "POST", "/v1/jobs", body={"Job": job}
+                    )
+                except (http.client.HTTPException, OSError):
+                    with self._lock:
+                        self.errors += 1
+                    time.sleep(0.05)
+                    continue
+                if status == 200:
+                    with self._lock:
+                        self.accepted += 1
+                        self.latencies_ms.append(
+                            (time.monotonic() - t0) * 1000.0
+                        )
+                    break
+                if status == 429:
+                    # the backpressure contract: honor Retry-After
+                    # (scaled), with a little jitter so the shed herd
+                    # doesn't re-arrive in one wave
+                    advice = float(headers.get("retry-after", 1))
+                    with self._lock:
+                        self.sheds += 1
+                        self.retry_after_honored += 1
+                    time.sleep(
+                        advice * self._retry_scale
+                        * (0.5 + rng.random())
+                    )
+                    continue
+                with self._lock:
+                    self.errors += 1
+                time.sleep(0.05)
+            else:
+                with self._lock:
+                    self.failed.append(sub_i)
+        session.close()
+
+
+class BlockingFanout(_Workers):
+    """Long-poll fan-out: each worker loops blocking queries with the
+    last X-Nomad-Index, the read-heavy half of a million-user UI.
+    Under SHEDDING the server answers immediately (degraded, counted
+    server-side as overload.deferred) — the fan-out only counts hard
+    failures."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        threads: int = 8,
+        path: str = "/v1/nodes",
+        wait_s: float = 2.0,
+    ) -> None:
+        super().__init__("blocking")
+        self.responses = 0
+        self.failures = 0
+        self._lock = threading.Lock()
+        self._host, self._port = host, port
+        self._path = path
+        self._wait_s = wait_s
+        self._spawn(threads, self._run)
+
+    def _run(self, idx: int) -> None:
+        session = HttpSession(self._host, self._port)
+        index = 1
+        while not self._stop.is_set():
+            try:
+                status, headers, _body = session.request(
+                    "GET",
+                    f"{self._path}?index={index}"
+                    f"&wait={self._wait_s}",
+                )
+                if status == 200:
+                    with self._lock:
+                        self.responses += 1
+                    index = int(
+                        headers.get("x-nomad-index", index) or index
+                    )
+                else:
+                    with self._lock:
+                        self.failures += 1
+                    self._stop.wait(0.1)
+            except (http.client.HTTPException, OSError):
+                with self._lock:
+                    self.failures += 1
+                self._stop.wait(0.1)
+        session.close()
+
+
+def rolling_drain(
+    host: str,
+    port: int,
+    node_ids: Sequence[str],
+    pause_s: float = 0.2,
+) -> int:
+    """Drain the given nodes one at a time over the HTTP API (the
+    operator's rolling-maintenance shape under load); returns the
+    count drained successfully."""
+    session = HttpSession(host, port)
+    drained = 0
+    for node_id in node_ids:
+        try:
+            status, _h, _b = session.request(
+                "POST",
+                f"/v1/node/{node_id}/drain",
+                body={"DrainSpec": {"Deadline": int(600e9)}},
+            )
+            if status == 200:
+                drained += 1
+        except (http.client.HTTPException, OSError):
+            pass
+        time.sleep(pause_s)
+    session.close()
+    return drained

@@ -1,0 +1,578 @@
+"""Swarm-scale SLO smoke: overload + mass node-death against the
+real HTTP API.
+
+The control plane's production claim is not "fast when polite" — it
+is "inside its SLO when thousands of clients arrive at once AND a
+rack dies mid-storm".  This harness plays that day (ROADMAP item 2's
+load-harness half) against ONE real server over HTTP:
+
+* a **heartbeat storm**: every registered node heartbeats on period
+  over the API (the liveness plane the overload ladder must never
+  shed);
+* a **submitter swarm**: ``--submitters`` logical clients registering
+  jobs concurrently, honoring 429 + Retry-After — the traffic that
+  MUST overload the default-sized broker and be shed, not queued into
+  p99 oblivion;
+* a **blocking-query fan-out** long-polling state (degrades to
+  non-blocking under SHEDDING);
+* a **rolling drain** of a few nodes (operator maintenance under
+  load);
+* an injected **mass node-death**: ``--death`` nodes go silent at
+  once; the heartbeat sweeper must gather their TTL expiries into ONE
+  batched down-transition whose replan evals ride ONE storm family
+  through the global assignment solver.
+
+SLO gates (exit 0 = all held, 2 = the JSON names the violation):
+
+* **zero lost evals** — every base job and every accepted submission
+  ends fully placed; no pending/blocked evals; empty failed queue;
+* **zero false node-downs** — no node that kept heartbeating was
+  ever marked down (an overloaded leader shedding heartbeats would
+  trip exactly this);
+* **heartbeat success >= 99.9%**;
+* **<= --max-solves storm solves** replan the death wave (storms are
+  impossible elsewhere: submission jobs are single-eval families);
+* **bounded sheds** — overload engaged (sheds > 0) and every shed
+  submitter eventually succeeded;
+* **p99 within budget** — the flight-recorder eval-latency p99 (with
+  trace exemplars) stays under ``--p99-budget-ms``.
+
+Usage::
+
+    python -m nomad_tpu.loadgen.swarm_smoke [--nodes N]
+        [--submitters S] [--death D] [--ttl SEC] [--json PATH]
+
+The result is the bench ``swarm`` block (bench.py embeds it under
+``BENCH_SWARM=1``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+# knob defaults for the smoke, applied BEFORE nomad_tpu imports so
+# construction-time reads see them; explicit operator env wins
+_SMOKE_ENV = {
+    # the death wave must coalesce into one global solve
+    "NOMAD_TPU_STORM": "1",
+    "NOMAD_TPU_STORM_MIN": "8",
+    "NOMAD_TPU_STORM_MAX": "1024",
+    # overload must ENGAGE under the submitter swarm
+    "NOMAD_TPU_OVERLOAD": "1",
+    "NOMAD_TPU_OVERLOAD_AGE_S": "15",
+}
+
+
+def _apply_env(submitters: int) -> None:
+    for key, value in _SMOKE_ENV.items():
+        os.environ.setdefault(key, value)
+    # depth threshold far below the swarm size so shedding (not an
+    # unbounded backlog) absorbs the burst, at every --submitters
+    # scale; explicit operator env wins
+    os.environ.setdefault(
+        "NOMAD_TPU_OVERLOAD_DEPTH", str(max(24, submitters // 8))
+    )
+    # the wave gather budget stays on its "auto" default
+    # (heartbeat_ttl/3): the smoke's heartbeat phases spread a rack
+    # death's expiries across one hb period (ttl/4), which auto
+    # covers
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    ordered = sorted(vals)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def _base_job(job_id: str):
+    """One-alloc service job shaped for the storm solver's capacity
+    model (single TG, cpu/mem only) with immediate reschedule, so a
+    node death replans it in the node-update eval itself instead of
+    parking a delayed follow-up outside the storm family."""
+    from .. import mock
+    from ..structs import ReschedulePolicy
+
+    job = mock.job(id=job_id)
+    job.task_groups[0].count = 1
+    for tg in job.task_groups:
+        tg.reschedule_policy = ReschedulePolicy(
+            attempts=0,
+            interval_s=0,
+            delay_s=0,
+            delay_function="constant",
+            max_delay_s=0,
+            unlimited=True,
+        )
+        for task in tg.tasks:
+            task.resources.cpu = 50
+            task.resources.memory_mb = 32
+    return job
+
+
+def _submit_job_dict(i: int) -> dict:
+    """Wire-form submission job (what a real client POSTs)."""
+    return {
+        "ID": f"swarm-sub-{i:05d}",
+        "Name": f"swarm-sub-{i:05d}",
+        "Type": "service",
+        # below the base jobs' priority 50: death-wave replans jump
+        # the submission backlog, like production node recovery should
+        "Priority": 40,
+        "Datacenters": ["dc1"],
+        "TaskGroups": [
+            {
+                "Name": "g",
+                "Count": 1,
+                "Tasks": [
+                    {
+                        "Name": "t",
+                        "Driver": "mock_driver",
+                        "Config": {"run_for": -1},
+                        "Resources": {"CPU": 50, "MemoryMB": 32},
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def _fully_placed(store, namespace: str, job_id: str, count: int):
+    live = [
+        a
+        for a in store.allocs_by_job(namespace, job_id)
+        if not a.terminal_status()
+    ]
+    return len(live) == count
+
+
+def run_swarm(
+    nodes: int = 2200,
+    submitters: int = 1100,
+    death: int = 500,
+    ttl_s: float = 15.0,
+    drains: int = 6,
+    base_jobs: Optional[int] = None,
+    max_solves: int = 2,
+    # generous by design: under a deliberate 1k-client overload the
+    # p99 carries the bounded shed/queue delay plus the storm solve's
+    # one-off XLA compile on cold CPU backends — the gate is
+    # "bounded", not "fast while being deliberately drowned"
+    p99_budget_ms: float = 30000.0,
+    seed: int = 0,
+    # the liveness plane must be provisioned for the load: with too
+    # few generator threads a busy run delays heartbeats past the
+    # TTL and manufactures transient false node-downs — the exact
+    # failure the harness exists to catch server-side
+    hb_threads: int = 32,
+    submit_threads: int = 16,
+    settle_timeout_s: float = 300.0,
+) -> Dict:
+    """Run the swarm scenario; returns the bench ``swarm`` block
+    (``ok`` = every SLO held, ``violations`` names what didn't)."""
+    _apply_env(submitters)
+
+    from .. import mock
+    from ..api import start_http_server
+    from ..server import Server
+    from ..structs import ALLOC_CLIENT_STATUS_RUNNING, NODE_STATUS_DOWN
+    from .swarm import (
+        BlockingFanout,
+        HeartbeatStorm,
+        SubmitterSwarm,
+        rolling_drain,
+    )
+
+    rng = random.Random(seed)
+    if base_jobs is None:
+        base_jobs = max(64, death + death // 5)
+    t_start = time.monotonic()
+    violations: List[str] = []
+
+    server = Server(
+        num_schedulers=1,
+        heartbeat_ttl=ttl_s,
+        seed=seed,
+        # a mass-death wave leases hundreds of members in one
+        # drain_family; the serial-fallback tail of a 500-node wave
+        # must not outlive its lease, or at-least-once redelivery
+        # re-coalesces still-in-progress members into EXTRA storm
+        # solves (observed at 30s under deliberate overload)
+        nack_timeout=180.0,
+    )
+    server.start()
+    # spread placement: the base workload must cover the node
+    # population, or the injected rack death hits empty nodes and the
+    # replan wave is vacuous
+    from ..structs import SchedulerConfiguration
+
+    server.store.set_scheduler_config(
+        SchedulerConfiguration(scheduler_algorithm="spread")
+    )
+    http = start_http_server(server, port=0)
+    host, port = "127.0.0.1", http.port
+
+    phase_s: Dict[str, float] = {}
+    storm = fanout = swarm = None
+    try:
+        # -- setup: nodes + base workload (direct calls; the LOAD
+        # goes over HTTP, the fixture doesn't have to) ---------------
+        t0 = time.monotonic()
+        node_ids = []
+        for _ in range(nodes):
+            node = mock.node()
+            server.register_node(node)
+            node_ids.append(node.id)
+        for i in range(base_jobs):
+            server.register_job(_base_job(f"swarm-base-{i:05d}"))
+        if not server.drain_to_idle(timeout=240.0):
+            violations.append("base workload did not settle")
+        # mark running so a node death registers as alloc loss
+        running = []
+        for i in range(base_jobs):
+            for alloc in server.store.allocs_by_job(
+                "default", f"swarm-base-{i:05d}"
+            ):
+                if not alloc.terminal_status():
+                    alloc.client_status = ALLOC_CLIENT_STATUS_RUNNING
+                    running.append(alloc)
+        server.store.upsert_allocs(running)
+        phase_s["setup"] = time.monotonic() - t0
+
+        # victims: nodes actually hosting base allocs first (the
+        # death must force replans), padded with empty nodes
+        hosting = list(
+            {
+                a.node_id
+                for a in running
+            }
+        )
+        rng.shuffle(hosting)
+        victims = hosting[:death]
+        if len(victims) < death:
+            spare = [n for n in node_ids if n not in set(victims)]
+            rng.shuffle(spare)
+            victims += spare[: death - len(victims)]
+        victim_set = set(victims)
+        affected_jobs = {
+            (a.namespace, a.job_id)
+            for a in running
+            if a.node_id in victim_set
+        }
+
+        # -- swarm on: heartbeat storm + blocking fan-out ------------
+        storm = HeartbeatStorm(
+            host, port, node_ids,
+            period_s=ttl_s / 4.0, threads=hb_threads,
+        )
+        fanout = BlockingFanout(host, port, threads=8)
+
+        # transient false-down monitor: a live node marked down and
+        # revived before the end-state check is STILL a false
+        # node-down (the SLO is "never", not "not at the end")
+        transient_false_downs: set = set()
+        monitor_stop = threading.Event()
+
+        def monitor_downs() -> None:
+            while not monitor_stop.is_set():
+                for node in server.store.iter_nodes():
+                    if (
+                        node.id not in victim_set
+                        and node.status == NODE_STATUS_DOWN
+                    ):
+                        transient_false_downs.add(node.id)
+                monitor_stop.wait(0.5)
+
+        threading.Thread(
+            target=monitor_downs, name="down-monitor", daemon=True
+        ).start()
+
+        solves_before = server.metrics.get_counter("storm.solves")
+        waves_before = server.metrics.get_counter(
+            "overload.node_down_waves"
+        )
+
+        # rolling drain of a few live non-victim nodes under the
+        # heartbeat storm, BEFORE the submitter swarm: node drain is
+        # an operator write (submit class), so once overload engages
+        # it would be shed — correctly, but then nothing drains
+        drain_candidates = [
+            n for n in node_ids if n not in victim_set
+        ][:drains]
+        drained = rolling_drain(host, port, drain_candidates)
+
+        # -- submitter swarm (the overload) --------------------------
+        t0 = time.monotonic()
+        swarm = SubmitterSwarm(
+            host, port, submitters,
+            make_job=_submit_job_dict,
+            threads=submit_threads,
+        )
+
+        # -- mass death, injected while the swarm is still loud ------
+        time.sleep(1.0)
+        t_kill = time.monotonic()
+        storm.kill(victims)
+
+        # the wave: every victim down, in few batched transitions
+        deadline = time.monotonic() + ttl_s * 3 + 30.0
+        while time.monotonic() < deadline:
+            down = sum(
+                1
+                for nid in victims
+                if (n := server.store.node_by_id(nid)) is not None
+                and n.status == NODE_STATUS_DOWN
+            )
+            if down == len(victims):
+                break
+            time.sleep(0.25)
+        down = sum(
+            1
+            for nid in victims
+            if (n := server.store.node_by_id(nid)) is not None
+            and n.status == NODE_STATUS_DOWN
+        )
+        detect_s = time.monotonic() - t_kill
+        if down != len(victims):
+            violations.append(
+                f"mass death incomplete: {down}/{len(victims)} down"
+            )
+        phase_s["death_detect"] = detect_s
+
+        # -- drain: swarm done, backlog empty, overload recovered ----
+        deadline = time.monotonic() + settle_timeout_s
+        while time.monotonic() < deadline:
+            if swarm.done():
+                break
+            time.sleep(0.5)
+        if not swarm.done():
+            swarm.stop()
+            violations.append("submitter swarm wedged")
+        phase_s["submit"] = time.monotonic() - t0
+
+        t0 = time.monotonic()
+        deadline = time.monotonic() + settle_timeout_s
+        while time.monotonic() < deadline:
+            pending = [
+                ev
+                for ev in list(server.store.evals.values())
+                if ev.status in ("pending", "blocked")
+            ]
+            if not pending and server.drain_to_idle(timeout=2.0):
+                break
+            time.sleep(0.5)
+        phase_s["settle"] = time.monotonic() - t0
+        monitor_stop.set()
+    finally:
+        for gen in (storm, fanout, swarm):
+            if gen is not None:
+                gen.stop()
+
+    # -- collect + gate ----------------------------------------------
+    store = server.store
+    metrics = server.metrics.dump()
+    counters = metrics["counters"]
+    solves = counters.get("storm.solves", 0.0) - solves_before
+    waves = (
+        counters.get("overload.node_down_waves", 0.0) - waves_before
+    )
+
+    # zero lost evals
+    nonterminal = [
+        ev.id
+        for ev in list(store.evals.values())
+        if ev.status in ("pending", "blocked")
+    ]
+    failed_queue = len(server.broker.failed())
+    lost_jobs: List[str] = []
+    for i in range(base_jobs):
+        job_id = f"swarm-base-{i:05d}"
+        if not _fully_placed(store, "default", job_id, 1):
+            lost_jobs.append(job_id)
+    accepted_missing = 0
+    for i in range(submitters):
+        job_id = f"swarm-sub-{i:05d}"
+        if store.job_by_id("default", job_id) is None:
+            continue  # never accepted (counted via swarm.failed)
+        if not _fully_placed(store, "default", job_id, 1):
+            accepted_missing += 1
+            lost_jobs.append(job_id)
+    if nonterminal:
+        violations.append(
+            f"{len(nonterminal)} non-terminal evals after settle"
+        )
+    if failed_queue:
+        violations.append(f"{failed_queue} evals in the failed queue")
+    if lost_jobs:
+        violations.append(
+            f"{len(lost_jobs)} jobs not fully placed"
+        )
+    if swarm is not None and swarm.failed:
+        violations.append(
+            f"{len(swarm.failed)} submitters never succeeded"
+        )
+
+    # zero false node-downs: every non-victim node kept heartbeating
+    # and must never have been marked down — transients included
+    # (the monitor sampled the whole run)
+    false_downs = sorted(
+        transient_false_downs
+        | {
+            n.id
+            for n in store.iter_nodes()
+            if n.id not in victim_set
+            and n.status == NODE_STATUS_DOWN
+        }
+    )
+    if false_downs:
+        violations.append(
+            f"{len(false_downs)} false node-downs (overload shed "
+            "heartbeats?)"
+        )
+
+    # heartbeat SLO
+    hb_ok, hb_fail = storm.counts() if storm is not None else (0, 0)
+    hb_total = hb_ok + hb_fail
+    hb_success = hb_ok / hb_total if hb_total else 0.0
+    if hb_total == 0 or hb_success < 0.999:
+        violations.append(
+            f"heartbeat success {hb_success:.4%} < 99.9%"
+        )
+
+    # the death wave rode the storm solver, in <= max_solves solves
+    if solves > max_solves:
+        violations.append(
+            f"death wave took {solves:.0f} storm solves "
+            f"(> {max_solves})"
+        )
+    if affected_jobs and solves < 1:
+        violations.append(
+            "death wave never reached the storm solver"
+        )
+
+    # overload engaged and stayed bounded
+    sheds = counters.get("overload.shed", 0.0)
+    if sheds <= 0:
+        violations.append(
+            "overload never engaged (no sheds) — the swarm did not "
+            "exercise backpressure"
+        )
+
+    # flight-recorder p99 + exemplars
+    lat = metrics["samples"].get("batch_worker.eval_latency_ms", {})
+    p99 = lat.get("p99", 0.0)
+    exemplars = lat.get("exemplars", [])
+    if p99 > p99_budget_ms:
+        violations.append(
+            f"eval latency p99 {p99:.0f}ms > budget "
+            f"{p99_budget_ms:.0f}ms"
+        )
+
+    submit_lat = swarm.latencies_ms if swarm is not None else []
+    block = {
+        "ok": not violations,
+        "violations": violations,
+        "nodes": nodes,
+        "submitters": submitters,
+        "death_nodes": death,
+        "base_jobs": base_jobs,
+        "ttl_s": ttl_s,
+        "drained": drained,
+        "affected_jobs": len(affected_jobs),
+        "down_waves": waves,
+        "storm_solves": solves,
+        "storm_evals": counters.get("storm.evals", 0.0),
+        "storm_fallbacks": counters.get("storm.fallbacks", 0.0),
+        "death_detect_s": round(phase_s.get("death_detect", 0.0), 2),
+        "heartbeats_ok": hb_ok,
+        "heartbeats_failed": hb_fail,
+        "heartbeat_success": round(hb_success, 6),
+        "false_node_downs": len(false_downs),
+        "sheds": sheds,
+        "accepted": counters.get("overload.accepted", 0.0),
+        "deferred": counters.get("overload.deferred", 0.0),
+        "submit_sheds": swarm.sheds if swarm is not None else 0,
+        "submit_errors": swarm.errors if swarm is not None else 0,
+        "retry_after_honored": (
+            swarm.retry_after_honored if swarm is not None else 0
+        ),
+        "submit_p50_ms": round(_percentile(submit_lat, 0.50), 1),
+        "submit_p99_ms": round(_percentile(submit_lat, 0.99), 1),
+        "eval_latency_p50_ms": round(lat.get("p50", 0.0), 1),
+        "eval_latency_p99_ms": round(p99, 1),
+        "p99_budget_ms": p99_budget_ms,
+        "p99_exemplars": exemplars,
+        "blocking_responses": (
+            fanout.responses if fanout is not None else 0
+        ),
+        "overload_mode_final": server.overload.mode,
+        "phase_s": {k: round(v, 2) for k, v in phase_s.items()},
+        "elapsed_s": round(time.monotonic() - t_start, 2),
+    }
+    http.stop()
+    server.stop()
+    return block
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="swarm-scale overload + mass-death SLO smoke"
+    )
+    parser.add_argument("--nodes", type=int, default=2200)
+    parser.add_argument("--submitters", type=int, default=1100)
+    parser.add_argument("--death", type=int, default=500)
+    parser.add_argument("--ttl", type=float, default=15.0)
+    parser.add_argument("--drains", type=int, default=6)
+    parser.add_argument("--base-jobs", type=int, default=None)
+    parser.add_argument("--max-solves", type=int, default=2)
+    parser.add_argument(
+        "--p99-budget-ms", type=float, default=30000.0
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--json", default="", help="also write the block to this path"
+    )
+    args = parser.parse_args(argv)
+    block = run_swarm(
+        nodes=args.nodes,
+        submitters=args.submitters,
+        death=args.death,
+        ttl_s=args.ttl,
+        drains=args.drains,
+        base_jobs=args.base_jobs,
+        max_solves=args.max_solves,
+        p99_budget_ms=args.p99_budget_ms,
+        seed=args.seed,
+    )
+    out = {"swarm": block}
+    print(json.dumps(out, indent=2, default=str))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(out, fh, indent=2, default=str)
+    if not block["ok"]:
+        print("SWARM_SMOKE: FAIL", file=sys.stderr)
+        return 2
+    print(
+        "SWARM_SMOKE: ok — %d nodes stormed, %d submitters, "
+        "%d-node death in %.0f solve(s), hb %.3f%%, %d sheds"
+        % (
+            block["nodes"],
+            block["submitters"],
+            block["death_nodes"],
+            block["storm_solves"],
+            block["heartbeat_success"] * 100.0,
+            int(block["sheds"]),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,22 @@ class ReplicatedStore:
             (node_id, status, time.time() if now is None else now),
         )
 
+    def update_node_statuses(
+        self, node_ids, status, now=None, message=""
+    ):
+        # one FSM command for the whole down-node wave: a mass
+        # node-death replicates as ONE log entry applied atomically
+        # on every replica, not hundreds of raft round trips
+        return self._raft_apply(
+            "update_node_statuses",
+            (
+                list(node_ids),
+                status,
+                time.time() if now is None else now,
+                message,
+            ),
+        )
+
     def update_node_eligibility(self, node_id, eligibility):
         return self._raft_apply(
             "update_node_eligibility", (node_id, eligibility)

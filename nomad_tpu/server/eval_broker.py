@@ -37,7 +37,17 @@ def job_family(ev: Evaluation) -> Tuple[str, str]:
     wave all read as ONE family — the unit the batch worker's storm
     detector coalesces into a single global assignment solve.  The
     broker's one-outstanding-eval-per-job rule is untouched: family
-    members are sibling *jobs*, each with its own dedup key."""
+    members are sibling *jobs*, each with its own dedup key.
+
+    An explicit ``family_hint`` on the eval overrides the job-id
+    derivation: the heartbeat sweeper stamps every replan eval of one
+    mass node-death wave with the wave's hint, so a 500-node rack
+    death — evals across MANY unrelated jobs — still coalesces into
+    one storm family (and one global assignment solve) instead of
+    hundreds of per-job chunk-chain walks."""
+    hint = getattr(ev, "family_hint", "")
+    if hint:
+        return (ev.namespace, hint)
     job_id = ev.job_id or ""
     for sep in _FAMILY_SEPARATORS:
         i = job_id.find(sep)
@@ -96,6 +106,12 @@ class EvalBroker:
         # create_index asc) -- reference eval_broker.go:117
         self._pending: Dict[Tuple[str, str], List] = {}
         self._pending_counter = itertools.count()
+        # eval id -> monotonic instant it became READY (insertion
+        # order == enqueue order, so the first entry is the oldest):
+        # feeds oldest_pending_age(), the overload ladder's queueing-
+        # delay signal.  Redelivered evals re-stamp — age measures
+        # time-in-ready, not time-since-first-submit
+        self._ready_ts: Dict[str, float] = {}
         # delayed evals: (wait_until, n, eval)
         self._delayed: List[Tuple[float, int, Evaluation]] = []
         self._delivery_count: Dict[str, int] = {}
@@ -190,11 +206,20 @@ class EvalBroker:
         # a bare Condition wraps an RLock — just pointless work);
         # set_enabled flushes mid-critical-section through this
         self._ready.clear()
+        self._ready_ts.clear()
         self._unack.clear()
         self._job_evals.clear()
         self._pending.clear()
         self._delayed.clear()
         self._delivery_count.clear()
+        # the stats must follow the queues they describe: a stale
+        # total_blocked after a flush pinned pending_depth() above
+        # the overload threshold forever (mode never recovered), and
+        # a stale total_unacked would wedge drain_to_idle
+        self.stats["total_ready"] = 0
+        self.stats["total_unacked"] = 0
+        self.stats["total_blocked"] = 0
+        self.stats["total_waiting"] = 0
 
     # ------------------------------------------------------------------
 
@@ -238,6 +263,8 @@ class EvalBroker:
                 return
             self._job_evals[job_key] = ev.id
         self._ready.setdefault(queue, _ReadyQueue()).push(ev)
+        if queue != FAILED_QUEUE:
+            self._ready_ts[ev.id] = time.monotonic()
         self.stats["total_ready"] += 1
 
     # ------------------------------------------------------------------
@@ -297,7 +324,10 @@ class EvalBroker:
         if best_queue is None:
             return None
         self.stats["total_ready"] -= 1
-        return best_queue.pop()
+        ev = best_queue.pop()
+        if ev is not None:
+            self._ready_ts.pop(ev.id, None)
+        return ev
 
     def drain_family(
         self,
@@ -463,6 +493,30 @@ class EvalBroker:
         in-flight work the failover unacked."""
         with self._lock:
             return len(self._unack)
+
+    def pending_depth(self) -> int:
+        """Backlog the broker has accepted but no worker has started:
+        ready evals (failed queue excluded — poison evals are parked,
+        not pending) plus the per-job pending heaps.  The overload
+        ladder's depth signal."""
+        with self._lock:
+            ready = sum(
+                len(q)
+                for name, q in self._ready.items()
+                if name != FAILED_QUEUE
+            )
+            return ready + self.stats["total_blocked"]
+
+    def oldest_pending_age(self) -> float:
+        """Seconds the oldest READY eval has been waiting for a
+        worker — the commit-wave lag the next accepted request will
+        inherit before its eval even starts.  0.0 when nothing is
+        ready.  O(1): ``_ready_ts`` is insertion-ordered and enqueue
+        stamps are monotone, so the first entry is the oldest."""
+        with self._lock:
+            for ts in self._ready_ts.values():
+                return max(0.0, time.monotonic() - ts)
+            return 0.0
 
     def ready_count(self, schedulers=None) -> int:
         """Ready evals, optionally filtered to scheduler types — the

@@ -379,6 +379,14 @@ class ServerFSM:
     def _apply_update_node_status(self, node_id, status, now=None):
         return self.store.update_node_status(node_id, status, now)
 
+    def _apply_update_node_statuses(
+        self, node_ids, status, now=None, message=""
+    ):
+        # one mass node-death wave = one command = one atomic apply
+        return self.store.update_node_statuses(
+            node_ids, status, now, message
+        )
+
     def _apply_update_node_eligibility(self, node_id, eligibility):
         return self.store.update_node_eligibility(node_id, eligibility)
 

@@ -17,6 +17,7 @@ in underneath.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -226,6 +227,22 @@ class Server:
         self.metrics.preregister(
             counters=LEADERSHIP_COUNTERS, gauges=LEADERSHIP_GAUGES
         )
+        # ingress backpressure: overload is a first-class server state
+        # (NORMAL -> SHEDDING -> EMERGENCY mode ladder driven by
+        # broker depth / oldest-pending-age / flight-recorder p99)
+        # with priority-classed shedding at the HTTP ingress.  The
+        # overload.* family is zero-registered here so dashboards can
+        # tell "never overloaded" from "not exported".
+        from .overload import (
+            OVERLOAD_COUNTERS,
+            OVERLOAD_GAUGES,
+            OverloadController,
+        )
+
+        self.overload = OverloadController(self)
+        self.metrics.preregister(
+            counters=OVERLOAD_COUNTERS, gauges=OVERLOAD_GAUGES
+        )
         if batch_pipeline:
             from .batch_worker import BatchWorker
 
@@ -329,6 +346,46 @@ class Server:
         # reference's per-node timers are Go runtime timers, not
         # threads; the Python translation must not be thread-per-node)
         self._heartbeat_deadlines: Dict[str, float] = {}
+        # mass node-death gather: node id -> monotonic instant its TTL
+        # expiry was detected.  A sweep that detects a correlated wave
+        # (>= _wave_min expiries) holds the down transition briefly
+        # (up to _wave_gather_s, settling one sweep after the last new
+        # expiry) so a rack death whose members' heartbeat phases
+        # straddle sweep boundaries still commits as ONE batched
+        # transition + ONE storm-family replan wave.  A heartbeat
+        # arriving mid-gather pulls its node back out (zero false
+        # node-downs).  Small waves (< _wave_min) settle just ONE
+        # sweep — a single-node death pays one sweep interval of
+        # extra detection latency, and a rack death's leading edge
+        # merges into the mass wave behind it.
+        self._down_wave: Dict[str, float] = {}
+        self._wave_counter = itertools.count(1)
+        import os as _os
+
+        try:
+            self._wave_min = max(
+                1,
+                int(
+                    _os.environ.get("NOMAD_TPU_OVERLOAD_WAVE_MIN", "8")
+                ),
+            )
+        except ValueError:
+            self._wave_min = 8
+        # gather budget: "auto" (default) derives it from the TTL —
+        # a rack death's expiries spread over roughly one heartbeat
+        # period (clients beat at a fraction of the TTL), so the
+        # budget must exceed the 2s quiet-stream settle or the
+        # settle could never engage and every >2s-spread death
+        # would fragment
+        raw_gather = _os.environ.get(
+            "NOMAD_TPU_OVERLOAD_WAVE_GATHER_S", "auto"
+        )
+        try:
+            self._wave_gather_s = max(0.0, float(raw_gather))
+        except ValueError:
+            self._wave_gather_s = min(
+                10.0, max(2.5, heartbeat_ttl / 3.0)
+            )
         # node id -> persistent client connection for log/fs
         # proxying (populated from HTTP handler threads)
         self._clients: Dict[str, object] = {}
@@ -434,6 +491,10 @@ class Server:
             for node in self.store.iter_nodes():
                 if node.status != NODE_STATUS_DOWN:
                     self._reset_heartbeat(node.id)
+            # even with zero known nodes, arm TTL enforcement now — a
+            # sweeper that died under the previous leadership must
+            # never stay dead into this one
+            self._ensure_sweeper()
             self.restore_evals()
 
     def _warm_when_topology_settles(
@@ -494,6 +555,7 @@ class Server:
                 worker.stop()
             self.applier.stop()
             self._heartbeat_deadlines.clear()
+            self._down_wave.clear()
             self.plan_queue.set_enabled(False)
             self.blocked.set_enabled(False)
             # every token still outstanding at this point — normal
@@ -864,6 +926,7 @@ class Server:
         if node is None:
             raise KeyError(node_id)
         self._heartbeat_deadlines.pop(node_id, None)
+        self._down_wave.pop(node_id, None)
         # delete first so the fanned-out evals schedule against a
         # state where the node is already gone
         self.store.delete_node(node_id)
@@ -1015,10 +1078,24 @@ class Server:
         # Node.UpdateStatus)
         if not (self._running and self._leader_established):
             self._heartbeat_deadlines.pop(node_id, None)
+            self._down_wave.pop(node_id, None)
             return
         self._heartbeat_deadlines[node_id] = (
             time.monotonic() + self.heartbeat_ttl
         )
+        # a node heartbeating while its expiry sits in a gathering
+        # down-wave was never dead: pull it back out before the wave
+        # commits (zero false node-downs under mass-death gather)
+        self._down_wave.pop(node_id, None)
+        self._ensure_sweeper()
+
+    def _ensure_sweeper(self) -> None:
+        """(Re)spawn the heartbeat sweeper if it is missing or died.
+        Called from every heartbeat reset AND from leadership
+        establish — a crashed sweeper must never silently stop TTL
+        enforcement for as long as traffic flows."""
+        if not (self._running and self._leader_established):
+            return
         with self._sweeper_lock:
             if self._heartbeat_sweeper is None or not (
                 self._heartbeat_sweeper.is_alive()
@@ -1037,29 +1114,123 @@ class Server:
             )
             time.sleep(interval)
             if not self._leader_established:
+                self._down_wave.clear()
                 continue
-            now = time.monotonic()
-            expired = [
-                node_id
-                for node_id, deadline in list(
-                    self._heartbeat_deadlines.items()
-                )
-                if deadline <= now
-            ]
-            for node_id in expired:
-                current = self._heartbeat_deadlines.get(node_id)
-                if current is None or current > now:
-                    continue  # heartbeated (refreshed) since the scan
-                self._heartbeat_deadlines.pop(node_id, None)
-                self._heartbeat_expired(node_id)
+            try:
+                self._sweep_once(interval)
+            except Exception:  # noqa: BLE001 — TTL enforcement must
+                # survive any single sweep's failure; a dead sweeper
+                # silently stops node-death detection cluster-wide
+                LOG.exception("heartbeat sweep failed")
 
-    def _heartbeat_expired(self, node_id: str) -> None:
-        """Missed TTL: node goes down, evals fan out
-        (reference heartbeat.go:135 invalidateHeartbeat)."""
-        try:
-            self.update_node_status(node_id, NODE_STATUS_DOWN)
-        except KeyError:
-            pass
+    def _sweep_once(self, interval: float) -> None:
+        """One sweep: collect every TTL expiry, fold it into the
+        pending down-wave, and commit the wave as ONE batched
+        transition when it has settled (or immediately when it is
+        below the mass-death gather threshold)."""
+        now = time.monotonic()
+        expired = [
+            node_id
+            for node_id, deadline in list(
+                self._heartbeat_deadlines.items()
+            )
+            if deadline <= now
+        ]
+        for node_id in expired:
+            current = self._heartbeat_deadlines.get(node_id)
+            if current is None or current > now:
+                continue  # heartbeated (refreshed) since the scan
+            self._heartbeat_deadlines.pop(node_id, None)
+            self._down_wave[node_id] = now
+        if not self._down_wave:
+            return
+        stamps = list(self._down_wave.values())
+        wave_started = min(stamps)
+        last_new = max(stamps)
+        if len(self._down_wave) >= self._wave_min:
+            # correlated failure: settle until the expiry stream has
+            # been quiet for two full seconds (heartbeat phases
+            # spread a rack death across sweeps, and scheduler work
+            # under overload stalls sweeps mid-stream — a short
+            # settle fragments the wave, and a fragment whose jobs
+            # overlap the first wave's outstanding evals trickles
+            # through the per-job pending heaps into extra storm
+            # solves), capped by the gather budget.
+            settle_s = max(interval, min(2.0, self._wave_gather_s))
+        else:
+            # below the mass threshold: hold ONE extra sweep.  A
+            # rack death's leading edge (the first sweep sees only a
+            # couple of nodes, which may host dozens of jobs) must
+            # merge into the mass wave behind it instead of
+            # committing — and storming — on its own; a genuinely
+            # single node death pays one sweep interval of extra
+            # detection latency.
+            settle_s = interval
+        if (
+            now - last_new < settle_s
+            and now - wave_started < self._wave_gather_s
+        ):
+            return
+        wave = list(self._down_wave.keys())
+        self._down_wave.clear()
+        self._heartbeats_expired(wave)
+
+    def _heartbeats_expired(self, node_ids: List[str]) -> None:
+        """Missed TTLs: the whole wave goes down in ONE batched state
+        transition (one FSM apply — a 500-node rack death is one
+        replicated command, not 500 serialized writes under the store
+        lock), and its replan evals are enqueued as ONE storm family
+        so the batch worker coalesces the replanning into a global
+        assignment solve instead of per-eval chunk-chain walks
+        (reference heartbeat.go:135 invalidateHeartbeat, batched)."""
+        from ..trace import TRACE
+
+        node_ids = [
+            node_id
+            for node_id in node_ids
+            # a member whose deadline was RE-ARMED between the wave
+            # snapshot and this commit heartbeated through the race
+            # window — it was never dead, drop it (the last line of
+            # the zero-false-node-downs defense; the mid-gather pop
+            # in _reset_heartbeat covers the gather window, this
+            # covers the snapshot->commit window)
+            if node_id not in self._heartbeat_deadlines
+            and (node := self.store.node_by_id(node_id)) is not None
+            and node.status != NODE_STATUS_DOWN
+        ]
+        if not node_ids:
+            return
+        self.store.update_node_statuses(
+            node_ids,
+            NODE_STATUS_DOWN,
+            message="Node heartbeat missed",
+        )
+        # one family hint per wave: replan evals across MANY unrelated
+        # jobs still coalesce into one storm drain (job_family honors
+        # the hint); single-node waves carry it too — harmless below
+        # the storm trigger threshold
+        wave_n = next(self._wave_counter)
+        hint = f"node-down:w{wave_n}"
+        evals = self._create_node_evals_batch(
+            node_ids, family_hint=hint
+        )
+        self.metrics.incr("overload.node_down_waves")
+        self.metrics.set_gauge(
+            "overload.last_wave_nodes", float(len(node_ids))
+        )
+        # flight-recorder incident: one trace per down-wave, the
+        # operator's handle for "which nodes, how many evals, which
+        # storm family" after a mass death
+        incident = f"node_down_wave:{wave_n}"
+        TRACE.begin(
+            incident,
+            root_span="server.node_down_wave",
+            nodes=len(node_ids),
+            evals=len(evals),
+            family=hint,
+            sample_nodes=node_ids[:8],
+        )
+        TRACE.finish(incident, "recorded")
 
     def _emit_node_event(
         self, node_id: str, message: str, subsystem: str = "Cluster"
@@ -1124,33 +1295,66 @@ class Server:
     def _create_node_evals(self, node_id: str) -> List[Evaluation]:
         """One eval per job with allocs on the node, plus system jobs
         (reference node_endpoint.go:1316 createNodeEvals)."""
+        return self._create_node_evals_batch([node_id])
+
+    def _create_node_evals_batch(
+        self, node_ids: List[str], family_hint: str = ""
+    ) -> List[Evaluation]:
+        """The wave form of ``_create_node_evals``: ONE eval per
+        affected (namespace, job) across the whole node wave — a
+        500-node death whose allocs span 120 jobs creates 120 evals,
+        not 500 x per-node fan-outs — persisted in one upsert and
+        stamped with the wave's ``family_hint`` so the broker's
+        storm detector sees them as one family."""
         evals = []
         seen_jobs = set()
-        for alloc in self.store.allocs_by_node(node_id):
-            key = (alloc.namespace, alloc.job_id)
-            if key in seen_jobs:
-                continue
-            seen_jobs.add(key)
-            job = self.store.job_by_id(*key)
-            sched_type = job.type if job is not None else JOB_TYPE_SERVICE
-            ev = Evaluation(
-                namespace=alloc.namespace,
-                priority=job.priority if job else 50,
-                type=sched_type,
-                triggered_by=EVAL_TRIGGER_NODE_UPDATE,
-                job_id=alloc.job_id,
-                node_id=node_id,
-                status=EVAL_STATUS_PENDING,
-            )
-            evals.append(ev)
+        for node_id in node_ids:
+            for alloc in self.store.allocs_by_node(node_id):
+                key = (alloc.namespace, alloc.job_id)
+                if key in seen_jobs:
+                    continue
+                seen_jobs.add(key)
+                job = self.store.job_by_id(*key)
+                sched_type = (
+                    job.type if job is not None else JOB_TYPE_SERVICE
+                )
+                ev = Evaluation(
+                    namespace=alloc.namespace,
+                    priority=job.priority if job else 50,
+                    type=sched_type,
+                    triggered_by=EVAL_TRIGGER_NODE_UPDATE,
+                    job_id=alloc.job_id,
+                    node_id=node_id,
+                    family_hint=family_hint,
+                    status=EVAL_STATUS_PENDING,
+                )
+                evals.append(ev)
+        # system jobs: ONE pass for the whole wave (seen_jobs dedups
+        # to one eval per job anyway — scanning iter_jobs once per
+        # node made a 500-node death O(nodes x jobs) store calls in
+        # the sweeper's critical replan path); a job fires off the
+        # first wave node matching its datacenters
+        wave_nodes = [
+            (node_id, node)
+            for node_id in node_ids
+            if (node := self.store.node_by_id(node_id)) is not None
+        ]
         for job in self.store.iter_jobs():
             if job.type != "system" or job.stopped():
                 continue
             key = (job.namespace, job.id)
             if key in seen_jobs:
                 continue
-            node = self.store.node_by_id(node_id)
-            if node is None or job.datacenters and node.datacenter not in job.datacenters:
+            trigger = next(
+                (
+                    node_id
+                    for node_id, node in wave_nodes
+                    if not job.datacenters
+                    or node.datacenter in job.datacenters
+                ),
+                None,
+            )
+            if trigger is None:
                 continue
             seen_jobs.add(key)
             evals.append(
@@ -1160,14 +1364,28 @@ class Server:
                     type="system",
                     triggered_by=EVAL_TRIGGER_NODE_UPDATE,
                     job_id=job.id,
-                    node_id=node_id,
+                    node_id=trigger,
+                    family_hint=family_hint,
                     status=EVAL_STATUS_PENDING,
                 )
             )
         if evals:
             self.store.upsert_evals(evals)
-            for ev in evals:
-                self.on_eval_update(ev)
+            if family_hint:
+                # the whole wave lands in ONE broker lock acquisition:
+                # per-eval enqueues trickle the family in, and a GIL
+                # hiccup mid-loop lets the storm detector's settle
+                # beat cut the stream — fragmenting a 500-node death
+                # into several solves
+                self.broker.enqueue_all(
+                    [ev for ev in evals if ev.should_enqueue()]
+                )
+                for ev in evals:
+                    if not ev.should_enqueue():
+                        self.on_eval_update(ev)
+            else:
+                for ev in evals:
+                    self.on_eval_update(ev)
         return evals
 
     # -- client-side alloc updates (reference node_endpoint.go:1065) ----

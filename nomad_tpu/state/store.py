@@ -282,6 +282,53 @@ class StateStore:
                 self._readiness_gen += 1
             return index
 
+    def update_node_statuses(
+        self,
+        node_ids,
+        status: str,
+        now: Optional[float] = None,
+        message: str = "",
+    ) -> int:
+        """One batched status transition for a whole wave of nodes —
+        the mass node-death path.  ONE lock acquisition and ONE index
+        bump cover every member (a 500-node rack death is one FSM
+        apply, not 500 serialized writes under the lock), and the
+        optional ``message`` lands as one NodeEvent per member inside
+        the same critical section.  Unknown node ids are skipped (a
+        purge racing the sweep must not fail the wave).  ``now`` is
+        stamped by the proposer (FSM determinism, like
+        update_node_status)."""
+        from ..structs import NodeEvent
+
+        stamp = time.time() if now is None else now
+        with self._lock:
+            readiness_flips = 0
+            touched = False
+            for node_id in node_ids:
+                node = self.nodes.get(node_id)
+                if node is None:
+                    continue
+                touched = True
+                was_ready = node.ready()
+                node.status = status
+                node.status_updated_at = stamp
+                node.modify_index = self._index + 1
+                self.node_table.upsert_node(node)
+                self._touch_node(node_id)
+                if was_ready != node.ready():
+                    readiness_flips += 1
+                if message:
+                    ev = NodeEvent(
+                        message=message, subsystem="Cluster"
+                    )
+                    ev.create_index = self._index + 1
+                    node.add_event(ev)
+            if readiness_flips:
+                self._readiness_gen += 1
+            if not touched:
+                return self._index
+            return self._bump("nodes")
+
     def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
         with self._lock:
             node = self.nodes.get(node_id)

@@ -1108,6 +1108,11 @@ class Evaluation:
     escaped_computed_class: bool = False
     quota_limit_reached: str = ""
     annotate_plan: bool = False
+    # storm-family override for the eval broker's job_family(): the
+    # heartbeat sweeper stamps every replan eval of one mass
+    # node-death wave with the wave's hint so evals across unrelated
+    # jobs coalesce into ONE storm solve; "" = derive from job_id
+    family_hint: str = ""
     queued_allocations: Dict[str, int] = field(default_factory=dict)
     leader_ack: str = ""
     snapshot_index: int = 0

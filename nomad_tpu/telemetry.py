@@ -140,6 +140,14 @@ class Metrics:
         with self._lock:
             return self._gauges.get(name)
 
+    def get_sample(self, name: str) -> Optional[Dict]:
+        """Snapshot of ONE summary (None when never sampled) without
+        paying for a full dump() copy — the overload controller polls
+        the flight-recorder latency p99 at mode-evaluation cadence."""
+        with self._lock:
+            summary = self._samples.get(name)
+            return summary.snapshot() if summary is not None else None
+
     def preregister(
         self,
         counters=(),

@@ -114,6 +114,14 @@ SPAN_NAMES = frozenset(
         "device.probe",
         "device.rewarm",
         "device.recover",
+        # overload control plane: `ingress.shed` roots one incident
+        # trace (``overload:<n>``) per excursion from NORMAL — its
+        # annotations carry the trigger signals and final shed counts;
+        # `server.node_down_wave` roots one trace per batched mass
+        # node-death transition (``node_down_wave:<n>``) naming the
+        # wave's node count, replan evals and storm family
+        "ingress.shed",
+        "server.node_down_wave",
         # plan pipeline + state commit
         "plan.evaluate",
         "plan.apply",

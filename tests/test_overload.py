@@ -1,0 +1,567 @@
+"""Overload-graceful control plane: admission backpressure (429 +
+Retry-After by priority class, mode ladder), batched mass node-death
+storm recovery, and the broker backlog signals feeding both."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.server import Server
+from nomad_tpu.server.overload import (
+    MODE_EMERGENCY,
+    MODE_NORMAL,
+    MODE_SHEDDING,
+    PRI_HEARTBEAT,
+    PRI_QUERY,
+    PRI_SUBMIT,
+    classify_request,
+)
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_RUNNING,
+    Evaluation,
+    NODE_STATUS_DOWN,
+)
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, dict(resp.getheaders()), json.loads(resp.read())
+
+
+def _flood_broker(server, n):
+    """Park n evals in the ready backlog (workers must be stopped)."""
+    evals = [Evaluation(job_id=f"flood-{i}") for i in range(n)]
+    server.store.upsert_evals(evals)
+    server.broker.enqueue_all(evals)
+
+
+def _stopped_server(**kw):
+    """Started server whose workers are stopped, so broker backlog
+    accumulates deterministically."""
+    server = Server(
+        num_schedulers=1, heartbeat_ttl=60.0, seed=7,
+        batch_pipeline=False, **kw,
+    )
+    server.start()
+    for w in server.workers:
+        w.stop()
+    return server
+
+
+# -- broker signals ----------------------------------------------------
+
+
+def test_broker_pending_depth_and_oldest_age():
+    server = _stopped_server()
+    try:
+        broker = server.broker
+        assert broker.pending_depth() == 0
+        assert broker.oldest_pending_age() == 0.0
+        _flood_broker(server, 5)
+        assert broker.pending_depth() == 5
+        time.sleep(0.05)
+        age = broker.oldest_pending_age()
+        assert age > 0.0
+        # same-job evals park in the per-job pending heap and still
+        # count toward the accepted-but-unstarted depth
+        dup = [Evaluation(job_id="flood-0") for _ in range(3)]
+        server.store.upsert_evals(dup)
+        broker.enqueue_all(dup)
+        assert broker.pending_depth() == 8
+        # dequeues drain the age tracker
+        ev, token = broker.dequeue(["service"], timeout=1.0)
+        assert ev is not None
+        assert broker.pending_depth() == 7
+        broker.nack(ev.id, token)
+    finally:
+        server.stop()
+
+
+# -- priority classes --------------------------------------------------
+
+
+def test_classify_request_priority_classes():
+    assert classify_request("POST", "/v1/node/abc/heartbeat") == PRI_HEARTBEAT
+    assert classify_request("POST", "/v1/node/register") == PRI_HEARTBEAT
+    assert classify_request("PUT", "/v1/node/abc/allocs") == PRI_HEARTBEAT
+    assert classify_request("GET", "/v1/jobs") == PRI_QUERY
+    assert classify_request("POST", "/v1/job/web/plan") == PRI_QUERY
+    assert classify_request("POST", "/v1/search") == PRI_QUERY
+    assert classify_request("POST", "/v1/jobs") == PRI_SUBMIT
+    assert classify_request("DELETE", "/v1/job/web") == PRI_SUBMIT
+    # observability is exempt — never shed
+    assert classify_request("GET", "/v1/metrics") is None
+    assert classify_request("GET", "/v1/overload") is None
+    assert classify_request("GET", "/v1/device") is None
+
+
+# -- mode ladder -------------------------------------------------------
+
+
+def test_mode_ladder_escalates_and_recovers(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_DEPTH", "4")
+    server = _stopped_server()
+    try:
+        ctl = server.overload
+        assert ctl.evaluate(force=True) == MODE_NORMAL
+        _flood_broker(server, 6)  # >= 4, < 16
+        assert ctl.evaluate(force=True) == MODE_SHEDDING
+        _flood_broker(server, 20)  # total 26 >= 4x4
+        assert ctl.evaluate(force=True) == MODE_EMERGENCY
+        # incident trace opened on the excursion
+        from nomad_tpu.trace import TRACE
+
+        trace = TRACE.get("overload:1")
+        assert trace is not None
+        assert trace["spans"][0]["name"] == "ingress.shed"
+        # draining the backlog de-escalates one rung per cooldown,
+        # never instantly
+        server.broker.flush()
+        assert ctl.evaluate(force=True) == MODE_EMERGENCY
+        assert wait_until(
+            lambda: ctl.evaluate(force=True) == MODE_SHEDDING,
+            timeout=5.0,
+        )
+        assert wait_until(
+            lambda: ctl.evaluate(force=True) == MODE_NORMAL,
+            timeout=5.0,
+        )
+        # recovery closes the incident
+        trace = TRACE.get("overload:1")
+        assert trace["outcome"] == "recovered"
+        assert "shed_total" in trace["attrs"]
+    finally:
+        server.stop()
+
+
+def test_overload_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD", "0")
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_DEPTH", "1")
+    server = _stopped_server()
+    try:
+        _flood_broker(server, 50)
+        assert server.overload.evaluate(force=True) == MODE_NORMAL
+        ok, _retry = server.overload.admit(PRI_SUBMIT)
+        assert ok
+    finally:
+        server.stop()
+
+
+# -- HTTP 429 path -----------------------------------------------------
+
+
+@pytest.fixture
+def shedding_api(monkeypatch):
+    """HTTP server held at SHEDDING: backlog between 1x and 4x the
+    depth threshold."""
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_DEPTH", "8")
+    server = _stopped_server()
+    _flood_broker(server, 12)  # SHEDDING band: [8, 32)
+    assert server.overload.evaluate(force=True) == MODE_SHEDDING
+    http = start_http_server(server, port=0)
+    yield server, f"http://127.0.0.1:{http.port}"
+    http.stop()
+    server.stop()
+
+
+def test_http_submission_shed_with_retry_after(shedding_api):
+    server, base = shedding_api
+    job = {
+        "ID": "shed-me",
+        "Type": "service",
+        "TaskGroups": [
+            {
+                "Name": "g",
+                "Count": 1,
+                "Tasks": [{"Name": "t", "Driver": "mock_driver"}],
+            }
+        ],
+    }
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, "/v1/jobs", {"Job": job})
+    assert exc.value.code == 429
+    retry_after = exc.value.headers.get("Retry-After")
+    assert retry_after is not None and float(retry_after) >= 1
+    body = json.loads(exc.value.read())
+    assert body["Mode"] == "SHEDDING"
+    # the job was never accepted
+    assert server.store.job_by_id("default", "shed-me") is None
+    assert server.metrics.get_counter("overload.shed") >= 1
+
+
+def test_http_heartbeats_and_queries_survive_shedding(shedding_api):
+    server, base = shedding_api
+    node = mock.node()
+    server.store.upsert_node(node)
+    status, headers, _body = _post(
+        base, f"/v1/node/{node.id}/heartbeat", {}
+    )
+    assert status == 200
+    # queries (class 1) are above the default shed floor (2)
+    jobs = _get(base, "/v1/jobs")
+    assert isinstance(jobs, list)
+    # observability endpoints always answer
+    payload = _get(base, "/v1/overload")
+    assert payload["mode_name"] == "SHEDDING"
+    assert payload["signals"]["depth"] >= 8
+
+
+def test_http_emergency_sheds_queries_never_heartbeats(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_DEPTH", "4")
+    server = _stopped_server()
+    _flood_broker(server, 40)  # >= 4x4: EMERGENCY
+    assert server.overload.evaluate(force=True) == MODE_EMERGENCY
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(base, "/v1/jobs")
+        assert exc.value.code == 429
+        assert float(exc.value.headers.get("Retry-After")) >= 1
+        node = mock.node()
+        server.store.upsert_node(node)
+        status, _h, _b = _post(
+            base, f"/v1/node/{node.id}/heartbeat", {}
+        )
+        assert status == 200
+    finally:
+        http.stop()
+        server.stop()
+
+
+def test_http_blocking_query_degrades_to_nonblocking(shedding_api):
+    server, base = shedding_api
+    index = server.store.latest_index()
+    t0 = time.monotonic()
+    # a blocking query past the latest index would normally park for
+    # the full wait; under SHEDDING it answers immediately
+    with urllib.request.urlopen(
+        base + f"/v1/nodes?index={index + 100}&wait=5", timeout=10
+    ) as resp:
+        assert resp.status == 200
+        assert resp.headers.get("X-Nomad-Index") is not None
+    assert time.monotonic() - t0 < 2.0
+    assert server.metrics.get_counter("overload.deferred") >= 1
+
+
+def test_http_keepalive_survives_bodyless_handlers():
+    """Regression: handlers that answered without reading the request
+    body used to poison HTTP/1.1 keep-alive connections (the unread
+    body parsed as the next request line -> 501)."""
+    from nomad_tpu.loadgen.swarm import HttpSession
+
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=7)
+    server.start()
+    http = start_http_server(server, port=0)
+    try:
+        node = mock.node()
+        server.register_node(node)
+        session = HttpSession("127.0.0.1", http.port)
+        for _ in range(4):
+            status, _h, _b = session.request(
+                "POST", f"/v1/node/{node.id}/heartbeat", body={}
+            )
+            assert status == 200
+        session.close()
+    finally:
+        http.stop()
+        server.stop()
+
+
+# -- mass node-death ---------------------------------------------------
+
+
+def _running_world(server, n_nodes, n_jobs, count=1):
+    """n_nodes registered + n_jobs placed and marked running."""
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        server.register_node(n)
+    jobs = []
+    for i in range(n_jobs):
+        job = mock.job(id=f"mass-{i:03d}")
+        job.task_groups[0].count = count
+        for tg in job.task_groups:
+            for task in tg.tasks:
+                task.resources.cpu = 50
+                task.resources.memory_mb = 32
+        server.register_job(job)
+        jobs.append(job)
+    assert server.drain_to_idle(20)
+    running = []
+    for job in jobs:
+        for alloc in server.store.allocs_by_job("default", job.id):
+            if not alloc.terminal_status():
+                alloc.client_status = ALLOC_CLIENT_STATUS_RUNNING
+                running.append(alloc)
+    server.store.upsert_allocs(running)
+    return nodes, jobs
+
+
+def _all_replaced(server, jobs, dead_ids, count=1):
+    for job in jobs:
+        live = [
+            a
+            for a in server.store.allocs_by_job("default", job.id)
+            if not a.terminal_status()
+        ]
+        if len(live) != count:
+            return False
+        if any(a.node_id in dead_ids for a in live):
+            return False
+    return True
+
+
+def test_mass_death_one_batched_wave(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_WAVE_GATHER_S", "1.0")
+    server = Server(num_schedulers=1, heartbeat_ttl=0.5, seed=3)
+    server.start()
+    try:
+        nodes, jobs = _running_world(server, 12, 6)
+        # every node stops heartbeating: one sweep catches the whole
+        # cohort (deadlines were all set in the same register wave)
+        assert wait_until(
+            lambda: all(
+                server.store.node_by_id(n.id).status
+                == NODE_STATUS_DOWN
+                for n in nodes
+            ),
+            timeout=10.0,
+        )
+        # ONE wave: one counter bump, one batched transition (every
+        # downed node shares the wave's single index bump)
+        assert (
+            server.metrics.get_counter("overload.node_down_waves")
+            == 1
+        )
+        assert (
+            server.metrics.get_gauge("overload.last_wave_nodes")
+            == 12.0
+        )
+        indices = {
+            server.store.node_by_id(n.id).modify_index for n in nodes
+        }
+        assert len(indices) == 1
+        # the replan evals share the wave's storm family hint
+        hinted = {
+            ev.family_hint
+            for ev in server.store.evals.values()
+            if ev.family_hint
+        }
+        assert hinted == {"node-down:w1"}
+        # wave incident trace
+        from nomad_tpu.trace import TRACE
+
+        trace = TRACE.get("node_down_wave:1")
+        assert trace is not None
+        assert trace["attrs"]["nodes"] == 12
+        assert trace["attrs"]["evals"] == 6
+        # zero lost: nothing pending, failed queue empty (the world
+        # has no live nodes left, so replans block/complete but the
+        # evals must all be terminal or blocked-for-capacity)
+        assert server.drain_to_idle(20)
+        assert not server.broker.failed()
+    finally:
+        server.stop()
+
+
+def test_heartbeat_mid_gather_prevents_false_down(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_WAVE_MIN", "2")
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_WAVE_GATHER_S", "3.0")
+    server = Server(num_schedulers=1, heartbeat_ttl=0.6, seed=3)
+    server.start()
+    try:
+        nodes = [mock.node() for _ in range(4)]
+        for n in nodes:
+            server.register_node(n)
+        survivor = nodes[0]
+        # keep ONE node heartbeating while the rest go dark; its TTL
+        # expiry may enter the gather window between beats, but the
+        # heartbeat must pull it back out before the wave commits
+        stop = threading.Event()
+
+        def beat():
+            while not stop.is_set():
+                try:
+                    server.heartbeat(survivor.id)
+                except KeyError:
+                    pass
+                stop.wait(0.15)
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        try:
+            assert wait_until(
+                lambda: all(
+                    server.store.node_by_id(n.id).status
+                    == NODE_STATUS_DOWN
+                    for n in nodes[1:]
+                ),
+                timeout=10.0,
+            )
+            assert (
+                server.store.node_by_id(survivor.id).status
+                != NODE_STATUS_DOWN
+            )
+        finally:
+            stop.set()
+            t.join(timeout=5)
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("storm_on", [True, False])
+def test_mass_death_storm_recovery_and_serial_parity(
+    monkeypatch, storm_on
+):
+    """A mass death replans every affected job with ZERO lost evals —
+    through at most 2 global storm solves when the solver is on, and
+    identically (every job fully replaced off the dead nodes) through
+    the serial chain when it is off."""
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1" if storm_on else "0")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "4")
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_WAVE_GATHER_S", "1.0")
+    server = Server(num_schedulers=1, heartbeat_ttl=0.6, seed=3)
+    server.start()
+    try:
+        nodes, jobs = _running_world(server, 24, 8)
+        victims = {
+            a.node_id
+            for job in jobs
+            for a in server.store.allocs_by_job("default", job.id)
+        }
+        # keep every non-victim node alive
+        survivors = [n for n in nodes if n.id not in victims]
+        stop = threading.Event()
+
+        def beat():
+            while not stop.is_set():
+                for n in survivors:
+                    try:
+                        server.heartbeat(n.id)
+                    except KeyError:
+                        pass
+                stop.wait(0.15)
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        try:
+            assert wait_until(
+                lambda: all(
+                    server.store.node_by_id(nid).status
+                    == NODE_STATUS_DOWN
+                    for nid in victims
+                ),
+                timeout=10.0,
+            )
+            # zero lost: every job fully replaced off the dead nodes
+            assert wait_until(
+                lambda: _all_replaced(server, jobs, victims),
+                timeout=20.0,
+            ), {
+                job.id: [
+                    (a.node_id in victims, a.client_status)
+                    for a in server.store.allocs_by_job(
+                        "default", job.id
+                    )
+                    if not a.terminal_status()
+                ]
+                for job in jobs
+            }
+            assert server.drain_to_idle(20)
+            assert not server.broker.failed()
+            solves = server.metrics.get_counter("storm.solves")
+            if storm_on:
+                # the wave rode the global solver, coalesced
+                assert 1 <= solves <= 2, solves
+            else:
+                assert solves == 0
+        finally:
+            stop.set()
+            t.join(timeout=5)
+    finally:
+        server.stop()
+
+
+# -- sweeper hardening -------------------------------------------------
+
+
+def test_sweeper_respawns_after_death():
+    server = Server(num_schedulers=1, heartbeat_ttl=0.4, seed=3)
+    server.start()
+    try:
+        node = mock.node()
+        server.register_node(node)
+        sweeper = server._heartbeat_sweeper
+        assert sweeper is not None and sweeper.is_alive()
+        # simulate a dead sweeper thread (crashed/never spawned)
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        with server._sweeper_lock:
+            server._heartbeat_sweeper = dead
+        # the next heartbeat re-arms TTL enforcement
+        server.heartbeat(node.id)
+        assert server._heartbeat_sweeper is not dead
+        assert server._heartbeat_sweeper.is_alive()
+        # and TTL expiry still fires
+        assert wait_until(
+            lambda: server.store.node_by_id(node.id).status
+            == NODE_STATUS_DOWN,
+            timeout=10.0,
+        )
+    finally:
+        server.stop()
+
+
+def test_sweeper_survives_sweep_crash(monkeypatch):
+    server = Server(num_schedulers=1, heartbeat_ttl=0.3, seed=3)
+    server.start()
+    try:
+        node = mock.node()
+        server.register_node(node)
+        crashes = {"n": 0}
+        original = server._sweep_once
+
+        def flaky(interval):
+            if crashes["n"] < 2:
+                crashes["n"] += 1
+                raise RuntimeError("injected sweep crash")
+            return original(interval)
+
+        monkeypatch.setattr(server, "_sweep_once", flaky)
+        # the sweeper thread must survive the injected crashes and
+        # still enforce the TTL afterwards
+        assert wait_until(
+            lambda: server.store.node_by_id(node.id).status
+            == NODE_STATUS_DOWN,
+            timeout=10.0,
+        )
+        assert crashes["n"] == 2
+        assert server._heartbeat_sweeper.is_alive()
+    finally:
+        server.stop()

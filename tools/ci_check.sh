@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (23 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (25 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -34,6 +34,18 @@ if [ "${SMOKE:-1}" = "1" ]; then
     # of hanging it
     timeout -k 10 300 python -m nomad_tpu.raft.chaos_smoke \
         --jobs 150 --kills 5 --nodes 6
+
+    echo "== swarm overload + mass-death SLO smoke (scaled down) =="
+    # the overload-graceful control-plane gate: heartbeat storm +
+    # concurrent submitters over the real HTTP API with an injected
+    # mass node-death — zero lost evals, zero false node-downs,
+    # hb >=99.9%, <=2 storm solves, bounded sheds.  Scaled below the
+    # acceptance run (2200/1100/500, exercised by bench) to fit the
+    # CI budget; the kill-timeout fails a wedged swarm instead of
+    # hanging the gate
+    timeout -k 10 300 python -m nomad_tpu.loadgen.swarm_smoke \
+        --nodes 600 --submitters 240 --death 120 --ttl 8 \
+        --base-jobs 150
 
     echo "== 2-process distributed smoke (CPU backend, gloo) =="
     # the multi-host mesh gate: distributed init, pod-mesh chain with

@@ -49,6 +49,7 @@ DEFAULT_PATHS: Dict[str, str] = {
     "tpu_stack": "nomad_tpu/sched/tpu_stack.py",
     "feasible": "nomad_tpu/sched/feasible.py",
     "server": "nomad_tpu/server/server.py",
+    "overload": "nomad_tpu/server/overload.py",
     "cluster": "nomad_tpu/server/cluster.py",
     "envknobs": "nomad_tpu/envknobs.py",
     "arch_doc": "docs/ARCHITECTURE.md",

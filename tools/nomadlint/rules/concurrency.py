@@ -115,11 +115,14 @@ SHARED_STATE_ALLOWLIST: Tuple[Tuple[str, str, str], ...] = (
     ),
     (
         "Server",
-        r"_heartbeat_deadlines",
-        "per-node deadline map: HTTP threads set single keys, the "
-        "sweeper iterates a list() snapshot and pops expired ones; "
-        "dict ops are GIL-atomic and a deadline racing its own "
-        "expiry is re-armed by the node's next heartbeat",
+        r"_heartbeat_deadlines|_down_wave",
+        "per-node deadline map + the pending mass-death gather set: "
+        "HTTP threads set/pop single keys, the sweeper iterates "
+        "list() snapshots and pops expired ones; dict ops are "
+        "GIL-atomic, a deadline racing its own expiry is re-armed "
+        "by the node's next heartbeat, and the wave commit "
+        "re-verifies each member against the live store (already-"
+        "down and re-heartbeated nodes drop out)",
     ),
     (
         "Tracer",

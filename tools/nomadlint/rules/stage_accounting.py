@@ -186,6 +186,12 @@ class SpanRegistryRule(Rule):
     REQUIRED = (
         "batch_worker.admit",
         "batch_worker.admit_deferred",
+        # the overload control plane's incident roots: the per-
+        # excursion shed incident and the batched mass node-death
+        # wave — without them an overload or a rack death leaves no
+        # flight-recorder trail
+        "ingress.shed",
+        "server.node_down_wave",
         # the sharded hot path's pipeline stages: mesh time must stay
         # separable from single-chip chunk time on every dashboard
         "batch_worker.mesh_launch",
@@ -941,6 +947,119 @@ class LeadershipMetricsRule(Rule):
                 "def _nomadlint_bad_fixture(self):\n"
                 '    self._count_leadership("bogus_kind")\n'
             ),
+        )
+
+
+@register
+class OverloadMetricsRule(Rule):
+    """Overload control plane: every ``overload.*`` metric emitted by
+    overload.py, server.py or api/http.py — literal first args of
+    metric calls — is in the zero-registered ``OVERLOAD_COUNTERS`` /
+    ``OVERLOAD_GAUGES`` registries (overload.py) and server.py
+    preregisters both at construction: absence of an ``overload.*``
+    series must mean "never overloaded", never "not exported"."""
+
+    name = "overload-metrics"
+    description = "overload.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        overload_path = ctx.path("overload")
+        registry = astutil.assigned_strings(
+            ctx.tree(overload_path), "OVERLOAD_COUNTERS"
+        ) | astutil.assigned_strings(
+            ctx.tree(overload_path), "OVERLOAD_GAUGES"
+        )
+        if not registry:
+            return [
+                Finding(
+                    self.name, overload_path, 0,
+                    "could not find the OVERLOAD_COUNTERS/"
+                    "OVERLOAD_GAUGES registries in overload.py",
+                )
+            ]
+        problems: List[Finding] = []
+        for key in ("overload", "server", "api_http"):
+            path = ctx.path(key)
+            tree = ctx.tree(path)
+            emitted: Set[str] = set()
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                if (
+                    node.func.attr in astutil.METRIC_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("overload.")
+                ):
+                    emitted.add(node.args[0].value)
+            unregistered = emitted - registry
+            if unregistered:
+                problems.append(
+                    Finding(
+                        self.name, path, 0,
+                        "overload.* metrics emitted but not in the "
+                        "OVERLOAD_COUNTERS/OVERLOAD_GAUGES "
+                        "registries (they would be absent from "
+                        "prometheus scrapes until the first "
+                        f"overload): {sorted(unregistered)}",
+                    )
+                )
+        server_src = ctx.source(ctx.path("server"))
+        if "OVERLOAD_COUNTERS" not in server_src:
+            problems.append(
+                Finding(
+                    self.name, ctx.path("server"), 0,
+                    "server.py no longer zero-registers the "
+                    "overload.* family at construction "
+                    "(OVERLOAD_COUNTERS preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "overload",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.incr("overload.bogus_metric")\n'
+            ),
+        )
+
+
+@register
+class SwarmExportRule(Rule):
+    """Swarm harness: bench.py exports the ``swarm`` JSON block (the
+    SLO-gated overload + mass-death run: heartbeat success, sheds,
+    storm-solve count, p99 exemplars) — the per-round proof that the
+    control plane degrades instead of collapsing."""
+
+    name = "swarm-export"
+    description = "bench.py exports the swarm block"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("bench")
+        if '"swarm"' not in ctx.source(path):
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "bench.py no longer exports the swarm JSON "
+                    "block (SLO-gated overload + mass node-death "
+                    "harness results)",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "bench",
+            old='"swarm"',
+            new='"renamed_swarm"',
         )
 
 
