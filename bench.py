@@ -1030,6 +1030,47 @@ WITH_EXPLAIN_OVERHEAD = (
 WITH_DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
 WITH_STORM = os.environ.get("BENCH_STORM", "1") == "1"
 WITH_SWARM = os.environ.get("BENCH_SWARM", "1") == "1"
+WITH_CLUSTER_FANOUT = (
+    os.environ.get("BENCH_CLUSTER_FANOUT", "1") == "1"
+)
+
+
+def bench_cluster_fanout():
+    """Follower scheduling fan-out as a bench block
+    (nomad_tpu.server.fanout_bench): the same storm-shaped workload
+    played through 1/3/5-server clusters with NOMAD_TPU_FANOUT=1,
+    recording per-topology wall placements/s AND planning-capacity
+    placements/s (evals / bottleneck server's worker-thread CPU —
+    the scheduling-throughput bound once each server owns real
+    cores; the whole bench shares one process, so on a single-core
+    harness wall clock cannot scale), the 3v1/5v1 capacity
+    speedups, zero-lost and placement-set-parity verdicts
+    (`cluster_fanout` in BENCH json).  The acceptance bar is >=2x
+    capacity from 1 to 3 servers with parity intact.
+    BENCH_CLUSTER_FANOUT=0 opts out; BENCH_FANOUT_{FAMILIES,JOBS,
+    NODES,REPS} rescale."""
+    from nomad_tpu.server.fanout_bench import run_fanout_bench
+
+    t0 = time.time()
+    block = run_fanout_bench(
+        server_counts=(1, 3, 5),
+        families=int(os.environ.get("BENCH_FANOUT_FAMILIES", 600)),
+        jobs_per=int(os.environ.get("BENCH_FANOUT_JOBS", 1)),
+        nodes=int(os.environ.get("BENCH_FANOUT_NODES", 2048)),
+        reps=int(os.environ.get("BENCH_FANOUT_REPS", 5)),
+    )
+    ratios = ", ".join(
+        f"{r['servers']}s={r['capacity_placements_per_s']}/s"
+        f"(wall {r['wall_placements_per_s']}/s)"
+        for r in block["runs"]
+    )
+    log(
+        f"cluster fanout: ok={block['ok']} capacity {ratios} "
+        f"(3v1 {block['speedup_3v1']}x, 5v1 {block['speedup_5v1']}x) "
+        f"lost={block['lost_total']} parity={block['parity_ok']} "
+        f"({time.time() - t0:.1f}s)"
+    )
+    return block
 
 
 def bench_swarm():
@@ -1669,6 +1710,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"swarm harness FAILED: {exc!r}")
             swarm = {"error": repr(exc)}
+    cluster_fanout = {}
+    if WITH_CLUSTER_FANOUT:
+        try:
+            cluster_fanout = bench_cluster_fanout()
+        except Exception as exc:  # noqa: BLE001
+            log(f"cluster fanout bench FAILED: {exc!r}")
+            cluster_fanout = {"error": repr(exc)}
 
     n_check = min(E2E_ORACLE_JOBS, E2E_JOBS)
     parity_ok = same == n_check
@@ -1722,6 +1770,11 @@ def main():
                 # partition under load — per-kill detect-to-resume
                 # times and the zero-lost/zero-duplicate verdicts
                 "cluster_failover": cluster_failover,
+                # follower scheduling fan-out: placements/s through
+                # 1/3/5-server clusters on the same storm workload
+                # (>=2x 3v1 acceptance) with zero-lost and
+                # placement-set-parity verdicts
+                "cluster_fanout": cluster_fanout,
                 # swarm-scale SLO harness: overload sheds + mass
                 # node-death storm recovery against the real HTTP
                 # API (zero lost / zero false downs / hb >=99.9% /

@@ -138,6 +138,32 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "(leader_kill|partition[:a,b]|msg_drop[:pct]|slow_wire[:ms]) "
         "for the chaos harness",
     ),
+    # -- follower scheduling fan-out (server/fanout.py) ---------------
+    "NOMAD_TPU_FANOUT": EnvKnob(
+        "0", "nomad_tpu/server/fanout.py",
+        "1 turns followers into schedulers: each runs the full TPU "
+        "batch pipeline against its local replicated state, leasing "
+        "evals from the leader's broker over RPC with commit "
+        "serialized on the leader's plan queue",
+    ),
+    "NOMAD_TPU_FANOUT_WORKERS": EnvKnob(
+        "1", "nomad_tpu/server/fanout.py",
+        "fan-out batch workers per follower server",
+    ),
+    "NOMAD_TPU_FANOUT_LEASE_N": EnvKnob(
+        "8", "nomad_tpu/server/fanout.py",
+        "max broker leases granted per remote dequeue RPC (the "
+        "surplus buffers locally, so gulp fills are buffer pops, "
+        "not round trips)",
+    ),
+    "NOMAD_TPU_FANOUT_REFRESH_WAIT_S": EnvKnob(
+        "5", "nomad_tpu/server/fanout.py",
+        "budget a follower waits for its local FSM apply to catch "
+        "up (eval modify-index fence at the gulp boundary, "
+        "refresh-index after a partial commit, own-commit "
+        "alloc-index catch-up); past it the leases nack for "
+        "redelivery",
+    ),
     # -- overload control plane (server/overload.py, server.py) -------
     "NOMAD_TPU_OVERLOAD": EnvKnob(
         "1", "nomad_tpu/server/overload.py",

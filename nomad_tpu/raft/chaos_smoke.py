@@ -114,7 +114,11 @@ def _run_cluster(
     through it while the fault schedule runs, settle, and return the
     final state + failover timings.  ``kills=0`` is the fault-free
     oracle configuration (same topology, same transport class, no
-    faults armed — only the schedule differs)."""
+    faults armed — only the schedule differs).  With
+    ``NOMAD_TPU_FANOUT=1`` in the environment (the ``--fanout``
+    flag), followers plan through the whole schedule — every kill
+    then also exercises remote leases dying with the leadership and
+    follower plans being fenced by the replicated generation check."""
     from ..server.cluster import TestCluster
 
     transport = ChaosTransport(seed=seed)
@@ -311,6 +315,12 @@ def _run_cluster(
                 "leadership.plan_rejected",
                 "leadership.stale_wave_fenced",
                 "raft.forward_retries",
+                # follower fan-out (0 unless NOMAD_TPU_FANOUT=1):
+                # plans actually produced on followers, and submits
+                # a leadership move rejected mid-flight
+                "fanout.plans_submitted",
+                "fanout.plan_not_leader",
+                "fanout.lease_gen_flips",
             )
         }
         return {
@@ -340,20 +350,43 @@ def run_smoke(
     kills: int = 5,
     nodes: int = 6,
     seed: int = 0,
+    fanout: bool = False,
 ) -> Dict:
     """Oracle run + chaos run + invariant checks; returns the
     ``cluster_failover`` block (``ok`` tells whether every invariant
-    held)."""
+    held).  ``fanout=True`` arms ``NOMAD_TPU_FANOUT=1`` for BOTH
+    runs: followers plan throughout, so the kill schedule also
+    exercises remote-lease reclamation and the replicated generation
+    fence on follower plans — and the smoke additionally asserts the
+    fan-out actually engaged (follower plans > 0)."""
+    import os as _os
+
     specs = _job_specs(jobs)
-    oracle = _run_cluster(specs, nodes=nodes, seed=seed, kills=0)
-    chaos = _run_cluster(
-        specs,
-        nodes=nodes,
-        seed=seed,
-        kills=kills,
-        partition_cycle=True,
-    )
+    saved = _os.environ.get("NOMAD_TPU_FANOUT")
+    if fanout:
+        _os.environ["NOMAD_TPU_FANOUT"] = "1"
+    try:
+        oracle = _run_cluster(
+            specs, nodes=nodes, seed=seed, kills=0
+        )
+        chaos = _run_cluster(
+            specs,
+            nodes=nodes,
+            seed=seed,
+            kills=kills,
+            partition_cycle=True,
+        )
+    finally:
+        if fanout:
+            if saved is None:
+                _os.environ.pop("NOMAD_TPU_FANOUT", None)
+            else:
+                _os.environ["NOMAD_TPU_FANOUT"] = saved
     oracle_match = chaos["placements"] == oracle["placements"]
+    fanout_engaged = (
+        not fanout
+        or chaos["counters"]["fanout.plans_submitted"] > 0
+    )
     ok = (
         oracle_match
         and not chaos["duplicates"]
@@ -363,11 +396,14 @@ def run_smoke(
         and chaos["monotone_ok"]
         and oracle["monotone_ok"]
         and len(chaos["detect_to_resume_s"]) == kills
+        and fanout_engaged
     )
     dtr = chaos["detect_to_resume_s"]
     return {
         "ok": ok,
         "servers": 3,
+        "fanout": fanout,
+        "fanout_engaged": fanout_engaged,
         "jobs": jobs,
         "nodes": nodes,
         "seed": seed,
@@ -406,6 +442,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--nodes", type=int, default=6)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
+        "--fanout",
+        action="store_true",
+        help="run with follower scheduling fan-out enabled "
+        "(NOMAD_TPU_FANOUT=1 for both the oracle and chaos runs)",
+    )
+    parser.add_argument(
         "--json", default="", help="also write the block to this path"
     )
     args = parser.parse_args(argv)
@@ -414,6 +456,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         kills=args.kills,
         nodes=args.nodes,
         seed=args.seed,
+        fanout=args.fanout,
     )
     out = {"cluster_failover": block}
     print(json.dumps(out, indent=2, default=str))

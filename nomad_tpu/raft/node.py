@@ -561,6 +561,17 @@ class RaftNode:
             ):
                 self.commit_index = majority_idx
                 self._apply_cv.notify_all()
+                # push the advanced commit index to followers NOW
+                # (one extra, entry-less append_entries round) instead
+                # of letting them sit out a heartbeat interval: every
+                # follower-side wait on a committed write — snapshot
+                # fences, blocking queries, a fan-out worker catching
+                # its local apply up to its own plan — otherwise pays
+                # ~heartbeat_interval of pure notification latency.
+                # Self-limiting: the wake fires only when the index
+                # ADVANCED, and the no-op round it triggers cannot
+                # advance it again.
+                self._wake.set()
 
     # -- apply loop -----------------------------------------------------
 

@@ -1499,6 +1499,16 @@ class BatchWorker(Worker):
             batch = leftover
             leftover = []
             if not batch:
+                if self._paused.is_set():
+                    # honor Worker.set_pause (leaders park half their
+                    # workers; benches stage backlogs behind it) —
+                    # the base run() checked it, this override never
+                    # did, making pause a silent no-op for the whole
+                    # batch pipeline.  Checked only between gulps: a
+                    # leftover batch still holds broker leases and
+                    # must finish first.
+                    self._stop.wait(0.05)
+                    continue
                 ev, token = self.server.broker.dequeue(
                     self.schedulers, timeout=0.1
                 )

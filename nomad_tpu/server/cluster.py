@@ -295,6 +295,14 @@ class ClusterServer(Server):
         from .autopilot import Autopilot
 
         self.autopilot = Autopilot(self)
+        # follower scheduling fan-out (NOMAD_TPU_FANOUT=1): while this
+        # server is a follower, a monitor runs batch workers that
+        # lease evals from the leader's broker over the transport,
+        # plan on LOCAL replicated state + local device, and submit
+        # plans into the leader's serialized plan queue
+        from .fanout import FanoutManager
+
+        self.fanout = FanoutManager(self, seed=kwargs.get("seed"))
 
     # -- raft plumbing --------------------------------------------------
 
@@ -376,6 +384,14 @@ class ClusterServer(Server):
                     "leader": exc.leader or self.raft.leader_hint(),
                 }
             return {"result": pickle.dumps(result)}
+        if method == "broker_dequeue":
+            return self._handle_broker_dequeue(payload)
+        if method == "broker_drain_family":
+            return self._handle_broker_drain_family(payload)
+        if method in ("broker_ack", "broker_nack"):
+            return self._handle_broker_settle(method, payload)
+        if method == "submit_plan":
+            return self._handle_submit_plan(payload)
         if method == "server_call":
             fn = getattr(self, payload["op"])
             args, kw = pickle.loads(payload["args"])
@@ -387,6 +403,133 @@ class ClusterServer(Server):
             result = self._leader_route(payload["op"], *args, **kw)
             return {"result": pickle.dumps(result)}
         raise ValueError(f"unknown cluster rpc {method!r}")
+
+    # -- follower fan-out RPC surface (leader side) ---------------------
+    #
+    # The remote half of the reference's worker/plan-queue split: any
+    # server's scheduling workers lease evals from the LEADER's broker
+    # and submit plans into the LEADER's serialized plan queue.  Every
+    # lease-granting response is stamped with the leadership
+    # generation it was issued under, so follower plans carry the
+    # generation the replicated StaleLeadershipError fence judges
+    # them by.
+
+    def _fanout_not_leader(self) -> dict:
+        return {"not_leader": True, "leader": self.raft.leader_hint()}
+
+    def _fanout_serving(self) -> bool:
+        return self._leader_established and self.is_leader()
+
+    def _lease_response(self, leases) -> dict:
+        """Package granted leases: pickled (the follower must get its
+        OWN object graph, never aliases into our store), stamped with
+        the current generation, with the ready backlog piggybacked
+        for the follower's adaptive sizing."""
+        gen = self._leadership_gen
+        if leases and not self._leader_established:
+            # revoked between the dequeue and this stamp: the broker
+            # flush already unacked these tokens — hand back nothing
+            # rather than leases that die on first ack
+            for ev, token in leases:
+                try:
+                    self.broker.nack(ev.id, token)
+                except ValueError:
+                    pass
+            return self._fanout_not_leader()
+        if leases:
+            self.metrics.incr(
+                "fanout.remote_leases_granted", float(len(leases))
+            )
+            self.metrics.set_gauge(
+                "fanout.remote_unacked",
+                float(self.broker.remote_unacked_count()),
+            )
+        return {
+            "leases": pickle.dumps(list(leases)),
+            "gen": gen,
+            "ready": self.broker.ready_count(),
+            # the follower's apply fence: enqueued eval OBJECTS carry
+            # modify_index=0 (the raft round trip stamps the FSM's
+            # copy, not the enqueuer's), and the leader never noticed
+            # because its own store has always applied everything it
+            # proposed.  A remote planner has no such guarantee, so
+            # every lease ships the leader's index AT GRANT TIME — an
+            # upper bound on the eval's creating write, which is
+            # certainly committed (the eval came out of the broker).
+            # The client stamps it as the eval's snapshot_index and
+            # the follower waits for local apply to reach it before
+            # planning; without this a lagging follower reads the
+            # eval's job as nonexistent and completes it as a no-op
+            # deregister — a silently lost placement.
+            "min_index": self.store.latest_index(),
+        }
+
+    def _handle_broker_dequeue(self, payload: dict) -> dict:
+        if not self._fanout_serving():
+            return self._fanout_not_leader()
+        leases = self.broker.dequeue_remote(
+            payload["schedulers"],
+            timeout=min(1.0, float(payload.get("timeout", 0.0))),
+            max_n=int(payload.get("n", 1)),
+            peer=payload.get("server", "?"),
+        )
+        return self._lease_response(leases)
+
+    def _handle_broker_drain_family(self, payload: dict) -> dict:
+        if not self._fanout_serving():
+            return self._fanout_not_leader()
+        leases = self.broker.drain_family_remote(
+            payload["schedulers"],
+            tuple(payload["family"]),
+            max_n=int(payload["max_n"]),
+            min_n=int(payload.get("min_n", 1)),
+            peer=payload.get("server", "?"),
+        )
+        return self._lease_response(leases)
+
+    def _handle_broker_settle(self, method: str, payload: dict) -> dict:
+        if not self._fanout_serving():
+            return self._fanout_not_leader()
+        settle = (
+            self.broker.ack
+            if method == "broker_ack"
+            else self.broker.nack
+        )
+        try:
+            settle(payload["eval_id"], payload["token"])
+        except ValueError:
+            # token expired (nack-timeout redelivery beat the remote
+            # worker) or died with a broker flush: structured, so the
+            # follower raises its local ValueError instead of
+            # unpickling a crash
+            return {"error": "token"}
+        self.metrics.set_gauge(
+            "fanout.remote_unacked",
+            float(self.broker.remote_unacked_count()),
+        )
+        return {}
+
+    def _handle_submit_plan(self, payload: dict) -> dict:
+        if not self._leader_established:
+            return self._fanout_not_leader()
+        plan = pickle.loads(payload["plan"])
+        try:
+            pending = self.plan_queue.enqueue(plan)
+            result = pending.wait(timeout=10.0)
+        except StaleLeadershipError as exc:
+            # replicated verdict — definitive, never re-forwarded
+            return {"stale_leadership": (exc.gen, exc.fence)}
+        except NotLeaderError as exc:
+            return {
+                "not_leader": True,
+                "leader": exc.leader or self.raft.leader_hint(),
+            }
+        except TimeoutError:
+            return {"timeout": True}
+        if result is None:
+            return {"rejected": True}
+        self.metrics.incr("fanout.remote_plans")
+        return {"result": pickle.dumps(result)}
 
     def broadcast_peer_removal(self, peer: str) -> bool:
         """Autopilot removal: commit the config change through the raft
@@ -518,9 +661,15 @@ class ClusterServer(Server):
         self.gossip.start()
         self.raft.start()
         self.autopilot.start()
+        # follower fan-out workers start/stop with this server's raft
+        # role (no-op unless NOMAD_TPU_FANOUT=1)
+        self.fanout.start()
 
     def stop(self) -> None:
         self._running = False
+        # fan-out first: its workers RPC over the transport this stop
+        # is about to quiesce
+        self.fanout.stop()
         self.autopilot.stop()
         self.raft.stop()
         # graceful departure: broadcast LEFT so peers don't gossip a

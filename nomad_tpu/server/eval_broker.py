@@ -115,6 +115,13 @@ class EvalBroker:
         # delayed evals: (wait_until, n, eval)
         self._delayed: List[Tuple[float, int, Evaluation]] = []
         self._delivery_count: Dict[str, int] = {}
+        # eval id -> peer server address for leases granted over the
+        # cluster transport (follower scheduling fan-out).  Remote
+        # leases live in _unack like any other delivery — the same
+        # nack-timeout sweeper reclaims a dead follower's leases —
+        # this map only attributes them per server for the stats
+        # surface and post-mortem accounting.
+        self._remote_leases: Dict[str, str] = {}
         self._ticker: Optional[threading.Thread] = None
         self.ticks = 0
         # tiny event ring for post-mortem debugging (eval id prefix,
@@ -126,6 +133,7 @@ class EvalBroker:
             "total_unacked": 0,
             "total_blocked": 0,
             "total_waiting": 0,
+            "total_remote_unacked": 0,
             "delivery_failures": 0,
         }
         # happens-before sanitizer (NOMAD_TPU_TSAN=1)
@@ -220,6 +228,11 @@ class EvalBroker:
         self.stats["total_unacked"] = 0
         self.stats["total_blocked"] = 0
         self.stats["total_waiting"] = 0
+        # remote leases die with the flush like every other token: a
+        # follower's next ack/nack gets a token mismatch and the
+        # next leader's restore_evals re-enqueues the evals
+        self._remote_leases.clear()
+        self.stats["total_remote_unacked"] = 0
 
     # ------------------------------------------------------------------
 
@@ -425,6 +438,97 @@ class EvalBroker:
                 out.append((ev, token))
             return out
 
+    def dequeue_remote(
+        self,
+        schedulers: List[str],
+        timeout: Optional[float] = None,
+        max_n: int = 1,
+        peer: str = "",
+    ) -> List[Tuple[Evaluation, str]]:
+        """Lease up to ``max_n`` ready evals for a REMOTE scheduling
+        server (follower fan-out): one blocking dequeue, then a
+        non-blocking sweep to fill the batch — one RPC round trip
+        amortizes over the whole lease batch.
+
+        Each lease gets the full ``dequeue`` bookkeeping (unack
+        token, redelivery deadline, trace root), PLUS per-server
+        attribution in ``_remote_leases`` so the stats surface can
+        say which peer holds what.  The nack-timeout sweeper is
+        re-armed HERE as well (the ``_ensure_ticker_locked`` pattern
+        every lease-taking path follows): a follower that dies
+        holding leases must never depend on any other path having
+        armed the sweeper for its redelivery — a dead sweeper here
+        would wedge ``drain_to_idle`` forever."""
+        out: List[Tuple[Evaluation, str]] = []
+        ev, token = self.dequeue(schedulers, timeout=timeout)
+        if ev is None:
+            return out
+        out.append((ev, token))
+        while len(out) < max_n:
+            ev, token = self.dequeue(schedulers, timeout=0.0)
+            if ev is None:
+                break
+            out.append((ev, token))
+        self._track_remote(out, peer)
+        return out
+
+    def drain_family_remote(
+        self,
+        schedulers: List[str],
+        family: Tuple[str, str],
+        max_n: int,
+        min_n: int = 1,
+        peer: str = "",
+    ) -> List[Tuple[Evaluation, str]]:
+        """``drain_family`` on behalf of a remote server: the drain is
+        atomic HERE, so a family gulp always lands whole on the one
+        server that pulled the trigger eval — a storm solve is never
+        split across followers."""
+        out = self.drain_family(schedulers, family, max_n, min_n)
+        self._track_remote(out, peer)
+        return out
+
+    def _track_remote(
+        self, leases: List[Tuple[Evaluation, str]], peer: str
+    ) -> None:
+        if not leases:
+            return
+        with self._lock:
+            # re-arm the redelivery sweeper from the remote path too:
+            # these leases' redelivery must survive a follower death
+            # even if every local lease-taking path has gone idle
+            self._ensure_ticker_locked()
+            for ev, token in leases:
+                # the dequeue and this attribution are separate lock
+                # acquisitions: a revoke flush (or a racing sweeper
+                # nack) in between already invalidated the token, and
+                # recording it anyway would leave a permanent orphan
+                # in the per-peer accounting (nothing pops an entry
+                # whose ack/nack can only raise).  Only a lease still
+                # live under ITS token is attributed.
+                entry = self._unack.get(ev.id)
+                if entry is not None and entry[1] == token:
+                    self._remote_leases[ev.id] = peer
+            self.stats["total_remote_unacked"] = len(
+                self._remote_leases
+            )
+
+    def remote_unacked_count(self) -> int:
+        """Leases currently held by remote servers (subset of
+        ``unacked_count``: every one also lives in ``_unack`` under
+        the same nack-timeout)."""
+        with self._lock:
+            return len(self._remote_leases)
+
+    def remote_lease_stats(self) -> Dict[str, int]:
+        """Outstanding remote leases per peer server — which follower
+        holds how much in-flight scheduling work right now."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for peer in self._remote_leases.values():
+                out[peer] = out.get(peer, 0) + 1
+            return out
+
     def _promote_delayed_locked(self) -> None:
         now = time.time()
         while self._delayed and self._delayed[0][0] <= now:
@@ -442,6 +546,10 @@ class EvalBroker:
             ev, _, _deadline = entry
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
+            if self._remote_leases.pop(eval_id, None) is not None:
+                self.stats["total_remote_unacked"] = len(
+                    self._remote_leases
+                )
             self.events.append((time.monotonic(), "ack", eval_id[:6], ""))
             TRACE.finish(eval_id, "ack")
             self._delivery_count.pop(eval_id, None)
@@ -465,6 +573,10 @@ class EvalBroker:
             ev, _, _deadline = entry
             del self._unack[eval_id]
             self.stats["total_unacked"] -= 1
+            if self._remote_leases.pop(eval_id, None) is not None:
+                self.stats["total_remote_unacked"] = len(
+                    self._remote_leases
+                )
             self.events.append((time.monotonic(), "nack", eval_id[:6], ""))
             TRACE.finish(eval_id, "nack")
             job_key = (ev.namespace, ev.job_id)
@@ -486,11 +598,13 @@ class EvalBroker:
         return entry[1] if entry else None
 
     def unacked_count(self) -> int:
-        """Outstanding deliveries (normal dequeues AND drain_family
-        shadow-heap members — both live in ``_unack`` and are swept by
-        the same nack-timeout redelivery).  The leadership revoke path
-        reads this just before the disable flush to report how much
-        in-flight work the failover unacked."""
+        """Outstanding deliveries: normal dequeues, drain_family
+        shadow-heap members AND remote (fan-out RPC) leases — all
+        live in ``_unack`` and are swept by the same nack-timeout
+        redelivery, so a dead follower's leases count here until the
+        sweeper reclaims them.  The leadership revoke path reads this
+        just before the disable flush to report how much in-flight
+        work the failover unacked."""
         with self._lock:
             return len(self._unack)
 

@@ -1,0 +1,560 @@
+"""Cluster fan-out bench: scheduling throughput vs server count.
+
+Boots 1 / 3 / 5-server raft clusters with follower fan-out enabled
+(``NOMAD_TPU_FANOUT=1``) and plays the SAME workload through each
+topology: by default the swarm shape (hundreds of independent
+single-alloc jobs staged as one standing backlog — ``--jobs-per`` >1
+switches to dispatch-family storm shape, where each family is
+coalescible into global assignment solves).  With one server every
+placement is planned on the leader; with 3/5 the same backlog fans
+out across follower planners while commit stays serialized on the
+leader's plan queue.
+
+Two throughput numbers per topology, deliberately distinct:
+
+* ``wall_placements_per_s`` — raw wall-clock drain rate.  The whole
+  bench runs in ONE process (``TestCluster``), so on a single-core
+  harness host every "server" shares one CPU and one GIL and this
+  number CANNOT scale however well planning distributes — the same
+  situation as the PR 8 mesh bench, whose virtual CPU devices
+  measure per-device FLOP scaling rather than wall clock.
+* ``capacity_placements_per_s`` — evals divided by the BOTTLENECK
+  server's worker-thread CPU time (``/proc/self/task/<tid>/stat``,
+  threads named ``worker@<addr>``; parallel replay is pinned off so
+  replay work lands on the worker thread).  Planning CPU is what
+  each server's own cores must serially grind through on a real
+  deployment, so the busiest server bounds cluster scheduling
+  throughput — and unlike wall-clock stage timings it does not
+  inflate with GIL waits on a contended core.  The headline
+  ``speedup_3v1`` is computed on THIS number: the measured
+  load-spread of the planning plane, including every fan-out
+  overhead that burns worker CPU (lease/plan pickling, remote
+  snapshot staleness, rescore loops, conflict fallbacks).  Each
+  topology runs ``reps`` times and the best-capacity rep represents
+  it — on a shared core every noise source (GIL-lottery imbalance,
+  cache thrash) biases capacity strictly downward, so best-of-N is
+  the least-biased estimator of the machine-independent value; even
+  so, expect run-to-run swing on a 1-CPU harness (``host_cpus`` is
+  exported so readers can judge).
+
+A warmup pass (untimed, same workload, blocking compiles) runs
+first so XLA compiles land outside every measured topology —
+without it the first topology eats multi-second kernel compiles and
+the comparison measures compile order, not scheduling.
+
+Correctness is gated alongside throughput: every run must place
+every job (zero lost evals, empty failed queue, no leaked remote
+leases) and every topology's placement set must match the
+single-server oracle's placement-key set (order-independent ``(job,
+task-group, alloc-name)`` keys — fan-out must change WHERE planning
+happens, never WHAT gets placed).
+
+Usage::
+
+    python -m nomad_tpu.server.fanout_bench [--servers 1,3,5]
+        [--families F] [--jobs-per M] [--nodes N] [--json PATH]
+
+Exit code 0 = every invariant held (speedups are reported, not gated
+here — the BENCH acceptance asserts the 1->3 ratio); 2 = a lost
+eval / parity violation (the JSON names it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+HEARTBEAT_TTL = 300.0  # no TTL expiries during the bench
+
+# worker stage timings that constitute PLANNING work — everything the
+# batch pipeline burns CPU on per eval.  Waits and RPC round trips
+# are deliberately absent: on a real deployment they overlap planning
+PLANNING_STAGES = (
+    "simulate",
+    "assemble",
+    "admit",
+    "launch",
+    "fetch",
+    "mesh_launch",
+    "mesh_fetch",
+    "storm_solve",
+    "storm_decompose",
+    "replay",
+    "sequential",
+)
+
+
+def _live_placements(store) -> Set[Tuple[str, str, str]]:
+    out: Set[Tuple[str, str, str]] = set()
+    for alloc in store.allocs.values():
+        if alloc.terminal_status():
+            continue
+        out.add((alloc.job_id, alloc.task_group, alloc.name))
+    return out
+
+
+def _make_nodes(n: int):
+    import random
+
+    from .. import mock
+
+    rng = random.Random(7)
+    out = []
+    for i in range(n):
+        node = mock.node(id=f"fan-node-{i:05d}")
+        node.node_resources.cpu = rng.choice([8000, 16000])
+        node.node_resources.memory_mb = rng.choice([16384, 32768])
+        out.append(node)
+    return out
+
+
+def _family_jobs(families: int, jobs_per: int, tag: str = ""):
+    """Storm-shaped load: ``families`` dispatch families of
+    ``jobs_per`` sibling jobs each — the broker's family detector
+    coalesces each contiguous family prefix into one global solve,
+    and distinct families fan out across servers."""
+    from .. import mock
+
+    out = []
+    for f in range(families):
+        for i in range(jobs_per):
+            job = mock.job(
+                id=f"fanfam{tag}-{f:03d}/dispatch-{i:04d}"
+            )
+            job.type = "batch"
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].resources.cpu = 500
+            job.task_groups[0].tasks[0].resources.memory_mb = 1024
+            out.append(job)
+    return out
+
+
+def _worker_cpu_by_server(cluster) -> Dict[str, float]:
+    """Per-server worker-thread CPU seconds, read from
+    ``/proc/self/task/*/stat`` by thread name (``worker@<addr>``).
+
+    CPU time is the contention-proof planning metric on a shared
+    host: wall-clock stage timings inflate with every other runnable
+    thread (a GIL wait is "busy" wall time), while CPU time counts
+    only executed work — and a commit-plane wait or an idle dequeue
+    burns none.  With parallel replay off (the bench pins it off so
+    replay work lands on the worker thread), a worker thread's CPU
+    IS that server's planning compute."""
+    import threading
+
+    hz = float(os.sysconf("SC_CLK_TCK"))
+    out: Dict[str, float] = {
+        server.addr: 0.0 for server in cluster.servers
+    }
+    for thread in threading.enumerate():
+        name = thread.name
+        if not name.startswith("worker@"):
+            continue
+        addr = name.split("@", 1)[1]
+        if addr not in out:
+            continue
+        tid = thread.native_id
+        if tid is None:
+            continue
+        try:
+            with open(f"/proc/self/task/{tid}/stat") as fh:
+                data = fh.read()
+        except OSError:
+            continue  # thread exited mid-scan
+        fields = data[data.rindex(")") + 2 :].split()
+        out[addr] += (int(fields[11]) + int(fields[12])) / hz
+    return {addr: round(cpu, 4) for addr, cpu in out.items()}
+
+
+def _planning_busy_by_server(
+    cluster,
+) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Per-server (planning busy, commit wait) wall seconds: the sum
+    of every worker's planning-stage timings net of its
+    ``plan_wait_s``, plus that wait itself — the leader's own batch
+    workers and any follower fan-out workers.  Captured BEFORE
+    cluster.stop() tears the fan-out fleets down."""
+    busy_out: Dict[str, float] = {}
+    wait_out: Dict[str, float] = {}
+    for server in cluster.servers:
+        busy = 0.0
+        wait = 0.0
+        workers = list(getattr(server, "workers", ()))
+        fanout = getattr(server, "fanout", None)
+        if fanout is not None:
+            workers.extend(fanout.workers)
+        for worker in workers:
+            timings = getattr(worker, "timings", None)
+            if not timings:
+                continue
+            busy += sum(
+                timings.get(stage, 0.0) for stage in PLANNING_STAGES
+            )
+            # the replay/sequential stages contain the time the
+            # worker sat BLOCKED on the serialized commit plane
+            # (plan-queue verdict; for fan-out workers the remote
+            # submit RPC + local-apply catch-up) — commit latency,
+            # not planning compute.  Tracked uniformly by
+            # Worker.plan_wait_s and netted out, then reported
+            # separately: commit is the part that stays serialized
+            # by design.
+            wait += getattr(worker, "plan_wait_s", 0.0)
+        busy_out[server.addr] = round(max(0.0, busy - wait), 4)
+        wait_out[server.addr] = round(wait, 4)
+    return busy_out, wait_out
+
+
+def _run_topology(
+    n_servers: int,
+    nodes: int,
+    families: int,
+    jobs_per: int,
+    seed: int = 0,
+    tag: str = "",
+) -> Dict:
+    from ..raft import NotLeaderError
+    from ..raft.transport import TransportError
+    from .cluster import TestCluster
+
+    cluster = TestCluster(
+        n_servers,
+        heartbeat_ttl=HEARTBEAT_TTL,
+        name_prefix=f"fan{tag}{n_servers}",
+    )
+    try:
+        cluster.start()
+        leader = cluster.wait_for_leader(timeout=30.0)
+        for node in _make_nodes(nodes):
+            leader.register_node(node)
+
+        # NOTE: job ids are identical across every topology and rep
+        # (the tag names only the throwaway cluster) — placement-set
+        # parity compares keys that embed the job id
+        # stage the backlog with every consumer PAUSED, then release:
+        # the measured drain starts from a standing same-family
+        # backlog — the mass-drain / restore-wave shape the storm
+        # detector exists for (PR 9's bench registers its family
+        # before leadership for the same reason).  Unpaused
+        # submission would let N racing consumers hold queue depth
+        # at ~zero and the comparison would measure arrival pacing,
+        # not scheduling throughput.
+        def _all_workers():
+            out = []
+            for server in cluster.servers:
+                out.extend(getattr(server, "workers", ()))
+                fanout = getattr(server, "fanout", None)
+                if fanout is not None:
+                    out.extend(fanout.workers)
+            return out
+
+        if n_servers > 1:
+            # fan-out fleets spawn async once a leader is known
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                fleets = [
+                    s.fanout.workers
+                    for s in cluster.servers
+                    if not s.is_leader()
+                ]
+                if fleets and all(fleets):
+                    break
+                time.sleep(0.02)
+        for worker in _all_workers():
+            worker.set_pause(True)
+        jobs = _family_jobs(families, jobs_per)
+        rr = 0
+        for job in jobs:
+            for _attempt in range(100):
+                server = cluster.servers[rr % n_servers]
+                rr += 1
+                try:
+                    server.register_job(job)
+                    break
+                except (
+                    NotLeaderError,
+                    TransportError,
+                    TimeoutError,
+                ):
+                    time.sleep(0.02)
+            else:
+                raise AssertionError(f"could not submit {job.id}")
+        t0 = time.monotonic()
+        for worker in _all_workers():
+            worker.set_pause(False)
+        # settle: every job fully placed and the pipeline idle
+        deadline = time.monotonic() + 240.0
+        placed = 0
+        while time.monotonic() < deadline:
+            leader = cluster.wait_for_leader(timeout=30.0)
+            store = leader.store
+            placed = sum(
+                1
+                for job in jobs
+                if any(
+                    not a.terminal_status()
+                    for a in store.allocs_by_job("default", job.id)
+                )
+            )
+            if placed == len(jobs) and leader.drain_to_idle(
+                timeout=1.0
+            ):
+                break
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+        busy, commit_wait = _planning_busy_by_server(cluster)
+        cpu = _worker_cpu_by_server(cluster)
+        bottleneck = max(cpu.values()) if cpu else 0.0
+        store = leader.store
+        placements = _live_placements(store)
+        lost = len(jobs) - placed
+        counters = {
+            name: sum(
+                s.metrics.get_counter(name)
+                for s in cluster.servers
+            )
+            for name in (
+                "fanout.remote_dequeues",
+                "fanout.leases",
+                "fanout.plans_submitted",
+                "fanout.remote_leases_granted",
+                "storm.solves",
+                "storm.evals",
+            )
+        }
+        return {
+            "servers": n_servers,
+            "wall_s": round(elapsed, 3),
+            "placements": placements,
+            "placements_total": len(placements),
+            "wall_placements_per_s": round(
+                len(placements) / elapsed, 1
+            )
+            if elapsed > 0
+            else 0.0,
+            "planning_wall_s": busy,
+            "planning_cpu_s": cpu,
+            "commit_wait_s": commit_wait,
+            "bottleneck_planning_s": round(bottleneck, 4),
+            "capacity_placements_per_s": round(
+                len(placements) / bottleneck, 1
+            )
+            if bottleneck > 0
+            else 0.0,
+            "lost": lost,
+            "failed_queue": len(leader.broker.failed()),
+            "remote_unacked_after": (
+                leader.broker.remote_unacked_count()
+            ),
+            "follower_plans": counters["fanout.plans_submitted"],
+            "counters": counters,
+        }
+    finally:
+        cluster.stop()
+
+
+def run_fanout_bench(
+    server_counts: Tuple[int, ...] = (1, 3, 5),
+    families: int = 600,
+    jobs_per: int = 1,
+    nodes: int = 2048,
+    seed: int = 0,
+    reps: int = 5,
+) -> Dict:
+    """The ``cluster_fanout`` bench block: an untimed warmup,
+    ``reps`` runs per topology on the same workload (the BEST
+    capacity run represents each topology: on a shared-core harness
+    every noise source — GIL-lottery load imbalance, cache thrash,
+    background threads — biases measured capacity strictly DOWNWARD
+    from the machine-independent ideal, extra CPU inflates the
+    denominator and imbalance can only raise the bottleneck share
+    above total/N, so best-of-N is the least-biased estimator),
+    wall + planning-capacity throughput ratios against the
+    single-server oracle, and the correctness gates (zero lost
+    across EVERY rep, placement-set parity, no leaked remote
+    leases)."""
+    knobs = {
+        "NOMAD_TPU_FANOUT": "1",
+        "NOMAD_TPU_STORM": "1",
+        "NOMAD_TPU_STORM_MIN": "8",
+        "NOMAD_TPU_STORM_MAX": "512",
+        # replay on the worker thread: the per-server planning-CPU
+        # attribution reads worker-thread CPU clocks, and on the
+        # bench's single-core harness the replay pool gains nothing
+        # anyway
+        "NOMAD_TPU_PARALLEL_REPLAY": "0",
+        # fine-grained work units: small gulps and small lease
+        # batches are the work-stealing grain that keeps the
+        # planning load balanced across servers (a 64-eval hoard on
+        # one server would become the bottleneck), and they pin the
+        # compiled-shape universe to a closed, warmable set
+        "NOMAD_TPU_BATCH_MAX": "8",
+        "NOMAD_TPU_FANOUT_LEASE_N": "4",
+    }
+    saved = {k: os.environ.get(k) for k in knobs}
+    saved["NOMAD_TPU_SYNC_COMPILE"] = os.environ.get(
+        "NOMAD_TPU_SYNC_COMPILE"
+    )
+    os.environ.update(knobs)
+    try:
+        # warmup: the FULL workload through throwaway clusters with
+        # blocking compiles — a fragmented multi-consumer backlog
+        # exercises the NARROW chunk widths (2/4) and partial storm
+        # buckets a 1-server warmup never compiles, and the measured
+        # topologies would otherwise eat those compiles as
+        # cold-shape sequential fallbacks (measuring compile order,
+        # not scheduling)
+        os.environ["NOMAD_TPU_SYNC_COMPILE"] = "1"
+        for i, warm_n in enumerate(
+            sorted({min(n, 3) for n in server_counts})
+        ):
+            _run_topology(
+                warm_n,
+                nodes=nodes,
+                families=families,
+                jobs_per=jobs_per,
+                seed=seed,
+                tag=f"w{i}",
+            )
+        if saved["NOMAD_TPU_SYNC_COMPILE"] is None:
+            os.environ.pop("NOMAD_TPU_SYNC_COMPILE", None)
+        else:
+            os.environ["NOMAD_TPU_SYNC_COMPILE"] = saved[
+                "NOMAD_TPU_SYNC_COMPILE"
+            ]
+        all_runs: Dict[int, List[Dict]] = {}
+        for n in server_counts:
+            all_runs[n] = [
+                _run_topology(
+                    n,
+                    nodes=nodes,
+                    families=families,
+                    jobs_per=jobs_per,
+                    seed=seed,
+                    tag=f"r{rep}" if rep else "",
+                )
+                for rep in range(max(1, reps))
+            ]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def best_run(candidates: List[Dict]) -> Dict:
+        return max(
+            candidates,
+            key=lambda r: r["capacity_placements_per_s"],
+        )
+
+    runs = [best_run(all_runs[n]) for n in server_counts]
+    flat = [r for rs in all_runs.values() for r in rs]
+    oracle = runs[0]
+    expected = families * jobs_per
+    parity_ok = all(
+        r["placements"] == oracle["placements"] for r in flat
+    )
+    lost_total = sum(r["lost"] for r in flat)
+    fanout_engaged = all(
+        r["follower_plans"] > 0 for r in flat if r["servers"] > 1
+    )
+    leaked = sum(r["remote_unacked_after"] for r in flat)
+    ok = (
+        parity_ok
+        and lost_total == 0
+        and leaked == 0
+        and oracle["placements_total"] == expected
+        and all(r["failed_queue"] == 0 for r in flat)
+        and fanout_engaged
+    )
+    by_servers = {r["servers"]: r for r in runs}
+
+    def speedup(n: int, key: str) -> Optional[float]:
+        run = by_servers.get(n)
+        if run is None or oracle[key] <= 0:
+            return None
+        return round(run[key] / oracle[key], 2)
+
+    return {
+        "ok": ok,
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "nodes": nodes,
+        "families": families,
+        "jobs_per_family": jobs_per,
+        "reps_per_topology": max(1, reps),
+        "evals_total": expected,
+        "parity_ok": parity_ok,
+        "lost_total": lost_total,
+        "leaked_remote_leases": leaked,
+        "fanout_engaged": fanout_engaged,
+        # headline: planning-plane load-spread (the scheduling-
+        # throughput bound once each server owns its cores); wall
+        # ratios ride along for the honest single-process view
+        "speedup_3v1": speedup(3, "capacity_placements_per_s"),
+        "speedup_5v1": speedup(5, "capacity_placements_per_s"),
+        "wall_speedup_3v1": speedup(3, "wall_placements_per_s"),
+        "wall_speedup_5v1": speedup(5, "wall_placements_per_s"),
+        "runs": [
+            {k: v for k, v in r.items() if k != "placements"}
+            for r in runs
+        ],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="follower fan-out scheduling-throughput bench"
+    )
+    parser.add_argument("--servers", default="1,3,5")
+    parser.add_argument("--families", type=int, default=600)
+    parser.add_argument("--jobs-per", type=int, default=1)
+    parser.add_argument("--nodes", type=int, default=2048)
+    parser.add_argument("--reps", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--json", default="", help="also write the block to this path"
+    )
+    args = parser.parse_args(argv)
+    counts = tuple(
+        int(tok) for tok in args.servers.split(",") if tok.strip()
+    )
+    block = run_fanout_bench(
+        server_counts=counts,
+        families=args.families,
+        jobs_per=args.jobs_per,
+        nodes=args.nodes,
+        seed=args.seed,
+        reps=args.reps,
+    )
+    out = {"cluster_fanout": block}
+    print(json.dumps(out, indent=2, default=str))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(out, fh, indent=2, default=str)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    if not block["ok"]:
+        print("FANOUT_BENCH: FAIL", file=sys.stderr)
+        # hard-exit (bench.py does the same): daemon threads may sit
+        # inside XLA calls and CPython teardown then aborts
+        os._exit(2)
+    ratios = ", ".join(
+        f"{r['servers']}s={r['capacity_placements_per_s']}/s"
+        for r in block["runs"]
+    )
+    print(
+        "FANOUT_BENCH: ok — capacity %s (3v1 %sx, wall 3v1 %sx)"
+        % (
+            ratios,
+            block["speedup_3v1"],
+            block["wall_speedup_3v1"],
+        )
+    )
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

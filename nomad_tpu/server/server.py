@@ -243,6 +243,16 @@ class Server:
         self.metrics.preregister(
             counters=OVERLOAD_COUNTERS, gauges=OVERLOAD_GAUGES
         )
+        # follower scheduling fan-out: zero-register the fanout.*
+        # family (absence-of-series must mean "fan-out never engaged"
+        # — single server, or NOMAD_TPU_FANOUT off — not "not
+        # exported").  The registries live in server/fanout.py; the
+        # manager itself exists only on ClusterServer.
+        from .fanout import FANOUT_COUNTERS, FANOUT_GAUGES
+
+        self.metrics.preregister(
+            counters=FANOUT_COUNTERS, gauges=FANOUT_GAUGES
+        )
         if batch_pipeline:
             from .batch_worker import BatchWorker
 

@@ -43,6 +43,14 @@ class Worker:
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.evals_processed = 0
+        # cumulative wall seconds this worker spent BLOCKED on the
+        # serialized commit plane (plan-queue verdicts, and for
+        # follower fan-out workers the remote submit RPC + local-
+        # apply catch-up).  Kept separate from the planning-stage
+        # timings: the fan-out bench reports planning busy-time net
+        # of commit waits, since commit is the part that stays
+        # serialized by design while planning scales with servers.
+        self.plan_wait_s = 0.0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -59,8 +67,16 @@ class Worker:
         prev = self._thread
         if prev is not None and prev.is_alive():
             prev.join(timeout=5.0)
+        # the thread name carries the owning server's address (when
+        # it has one — cluster servers do) so per-thread accounting
+        # (/proc/self/task/*/stat, py-spy, the fan-out bench's
+        # planning-CPU attribution) can tell one server's workers
+        # from another's inside a multi-server test process
+        addr = getattr(self.server, "addr", "")
         thread = threading.Thread(
-            target=self.run, name="worker", daemon=True
+            target=self.run,
+            name=f"worker@{addr}" if addr else "worker",
+            daemon=True,
         )
         self._thread = thread
         self._stop.clear()
@@ -174,6 +190,8 @@ class Worker:
     def submit_plan(
         self, plan: Plan
     ) -> Tuple[PlanResult, Optional[StateSnapshot]]:
+        import time as _time
+
         if getattr(plan, "leader_gen", None) is None:
             # serial paths stamp the current generation at submit
             # time (their plans cannot straggle across a leadership
@@ -183,14 +201,20 @@ class Worker:
                 self.server, "_leadership_gen", None
             )
         plan.snapshot_index = self.store.latest_index()
-        pending = self.server.plan_queue.enqueue(plan)
-        result = pending.wait(timeout=10.0)
-        if result is None:
-            raise RuntimeError("plan rejected")
-        if result.refresh_index:
-            snap = self.store.snapshot_min_index(result.refresh_index)
-            return result, snap
-        return result, None
+        t0 = _time.monotonic()
+        try:
+            pending = self.server.plan_queue.enqueue(plan)
+            result = pending.wait(timeout=10.0)
+            if result is None:
+                raise RuntimeError("plan rejected")
+            if result.refresh_index:
+                snap = self.store.snapshot_min_index(
+                    result.refresh_index
+                )
+                return result, snap
+            return result, None
+        finally:
+            self.plan_wait_s += _time.monotonic() - t0
 
     def update_eval(self, ev: Evaluation) -> None:
         self.store.upsert_evals([ev])

@@ -122,6 +122,13 @@ SPAN_NAMES = frozenset(
         # wave's node count, replan evals and storm family
         "ingress.shed",
         "server.node_down_wave",
+        # follower scheduling fan-out (NOMAD_TPU_FANOUT=1):
+        # `fanout.remote_dequeue` spans the lease RPC on every eval a
+        # follower dequeued from the leader's broker (members = lease
+        # batch size), `fanout.plan_submit` spans the remote
+        # serialized-commit round trip into the leader's plan queue
+        "fanout.remote_dequeue",
+        "fanout.plan_submit",
         # plan pipeline + state commit
         "plan.evaluate",
         "plan.apply",

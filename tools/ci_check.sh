@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (25 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (27 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -34,6 +34,27 @@ if [ "${SMOKE:-1}" = "1" ]; then
     # of hanging it
     timeout -k 10 300 python -m nomad_tpu.raft.chaos_smoke \
         --jobs 150 --kills 5 --nodes 6
+
+    echo "== follower fan-out bench (1 vs 3 servers, scaled down) =="
+    # horizontal-scaling gate: the same storm workload through a
+    # 1-server and a 3-server fan-out cluster — zero lost evals,
+    # placement-set parity vs the single-server oracle, fan-out
+    # actually engaged (follower plans > 0), no leaked remote
+    # leases.  Scaled below the BENCH acceptance run (which asserts
+    # the >=2x 3v1 speedup at 12x24x512); the kill-timeout fails a
+    # wedged cluster instead of hanging the gate
+    timeout -k 10 300 python -m nomad_tpu.server.fanout_bench \
+        --servers 1,3 --families 120 --jobs-per 1 --nodes 256 \
+        --reps 1
+
+    echo "== cluster chaos smoke with fan-out (3 servers) =="
+    # leadership-loss gate UNDER fan-out: followers plan through 3
+    # leader kills + a healed partition — remote leases die with
+    # each leadership, redelivery reclaims them, and the replicated
+    # generation fence rejects deposed-leader plans; zero lost, zero
+    # duplicates vs the fault-free oracle
+    timeout -k 10 300 python -m nomad_tpu.raft.chaos_smoke \
+        --jobs 120 --kills 3 --nodes 6 --fanout
 
     echo "== swarm overload + mass-death SLO smoke (scaled down) =="
     # the overload-graceful control-plane gate: heartbeat storm +

@@ -51,6 +51,7 @@ DEFAULT_PATHS: Dict[str, str] = {
     "server": "nomad_tpu/server/server.py",
     "overload": "nomad_tpu/server/overload.py",
     "cluster": "nomad_tpu/server/cluster.py",
+    "fanout": "nomad_tpu/server/fanout.py",
     "envknobs": "nomad_tpu/envknobs.py",
     "arch_doc": "docs/ARCHITECTURE.md",
     "state_dir": "nomad_tpu/state",

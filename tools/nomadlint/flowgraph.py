@@ -54,6 +54,7 @@ FLOW_FILE_KEYS = (
     "api_http",
     "trace",
     "telemetry",
+    "fanout",
 )
 FLOW_DIR_KEYS = ("state_dir", "device_dir")
 
