@@ -1033,6 +1033,56 @@ WITH_SWARM = os.environ.get("BENCH_SWARM", "1") == "1"
 WITH_CLUSTER_FANOUT = (
     os.environ.get("BENCH_CLUSTER_FANOUT", "1") == "1"
 )
+WITH_BIGWORLD = os.environ.get("BENCH_BIGWORLD", "1") == "1"
+
+
+def bench_bigworld():
+    """Million-node composed topology as a bench block
+    (nomad_tpu.loadgen.bigworld_smoke): a >=1M-node / >=10M-alloc
+    synthetic world seeded through the raft log, planned by >=2
+    fan-out followers each heading a live 2-process jax.distributed
+    mesh (pod streaming, NOMAD_TPU_POD_CHECK digest parity on every
+    launch) — exporting placements/s, each follower's per-host
+    bytes-per-flush gauge, and the snapshot catch-up time of a
+    SIGKILLed-and-restarted follower (`bigworld` in BENCH json).
+    The reduced-scale twin of this block (with the single-server
+    placement-parity oracle) gates tools/ci_check.sh.
+    BENCH_BIGWORLD=0 opts out; BENCH_BIGWORLD_{NODES,ALLOCS,JOBS,
+    STORM_JOBS,TIMEOUT,ORACLE} rescale."""
+    from nomad_tpu.loadgen.bigworld_smoke import run_bigworld
+
+    t0 = time.time()
+    block = run_bigworld(
+        nodes=int(os.environ.get("BENCH_BIGWORLD_NODES", 1_000_000)),
+        allocs=int(
+            os.environ.get("BENCH_BIGWORLD_ALLOCS", 10_000_000)
+        ),
+        jobs=int(os.environ.get("BENCH_BIGWORLD_JOBS", 8)),
+        storm_jobs=int(
+            os.environ.get("BENCH_BIGWORLD_STORM_JOBS", 8)
+        ),
+        # the full-scale world seeds for minutes per replica; the
+        # oracle replay doubles the drive, so it is opt-in here and
+        # always-on in the reduced-scale ci_check gate
+        oracle=os.environ.get("BENCH_BIGWORLD_ORACLE", "0") == "1",
+        timeout=float(
+            os.environ.get("BENCH_BIGWORLD_TIMEOUT", 3600)
+        ),
+    )
+    flushes = ", ".join(
+        f"{addr}={int(b)}B"
+        for addr, b in block["bytes_per_flush_per_host"].items()
+    )
+    log(
+        f"bigworld: {block['world']['nodes']} nodes / "
+        f"{block['world']['allocs']} allocs, "
+        f"{block['topology']['followers']} followers x "
+        f"{block['topology']['procs_per_follower']}-proc mesh: "
+        f"{block['placements_per_s']}/s, flush {flushes}, "
+        f"catchup {block['catchup']['catchup_s']}s, "
+        f"lost={block['lost']} ({time.time() - t0:.1f}s)"
+    )
+    return block
 
 
 def bench_cluster_fanout():
@@ -1717,6 +1767,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"cluster fanout bench FAILED: {exc!r}")
             cluster_fanout = {"error": repr(exc)}
+    bigworld = {}
+    if WITH_BIGWORLD:
+        try:
+            bigworld = bench_bigworld()
+        except Exception as exc:  # noqa: BLE001
+            log(f"bigworld bench FAILED: {exc!r}")
+            bigworld = {"error": repr(exc)}
 
     n_check = min(E2E_ORACLE_JOBS, E2E_JOBS)
     parity_ok = same == n_check
@@ -1775,6 +1832,12 @@ def main():
                 # (>=2x 3v1 acceptance) with zero-lost and
                 # placement-set-parity verdicts
                 "cluster_fanout": cluster_fanout,
+                # million-node composed topology: fan-out followers
+                # each heading a multi-process pod mesh over a
+                # raft-seeded >=1M-node world (placements/s,
+                # per-host bytes-per-flush, follower snapshot
+                # catch-up time, zero-lost + pod digest parity)
+                "bigworld": bigworld,
                 # swarm-scale SLO harness: overload sheds + mass
                 # node-death storm recovery against the real HTTP
                 # API (zero lost / zero false downs / hb >=99.9% /

@@ -108,6 +108,36 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "0", "nomad_tpu/parallel/mesh.py",
         "this process's id in [0, NOMAD_TPU_DIST_PROCS)",
     ),
+    "NOMAD_TPU_DIST_NS": EnvKnob(
+        "", "nomad_tpu/parallel/mesh.py",
+        "world namespace suffix: with NS set, "
+        "NOMAD_TPU_DIST_<KNOB>_<NS> overrides the bare knob, so N "
+        "follower-headed worlds can coexist in one env block "
+        "(composed fan-out topologies)",
+    ),
+    "NOMAD_TPU_POD_PORT": EnvKnob(
+        "", "nomad_tpu/server/batch_worker.py",
+        "pod-head stream port: process 0 of a multi-host world "
+        "serves the mesh-operation stream (parallel/pod.py) that "
+        "peer processes replay in FIFO order",
+    ),
+    "NOMAD_TPU_POD_CHECK": EnvKnob(
+        "0", "nomad_tpu/parallel/pod.py",
+        "1 makes every pod chain/storm launch round-trip a result "
+        "digest from every peer — the head/peer bit-parity gate",
+    ),
+    "NOMAD_TPU_SMOKE_NODES": EnvKnob(
+        "12", "nomad_tpu/parallel/dist_smoke.py",
+        "dist_smoke world size: registered nodes",
+    ),
+    "NOMAD_TPU_SMOKE_JOBS": EnvKnob(
+        "12", "nomad_tpu/parallel/dist_smoke.py",
+        "dist_smoke chain-phase eval count",
+    ),
+    "NOMAD_TPU_SMOKE_FAMILY": EnvKnob(
+        "16", "nomad_tpu/parallel/dist_smoke.py",
+        "dist_smoke storm-phase family size",
+    ),
     "NOMAD_TPU_TSAN": EnvKnob(
         "0", "nomad_tpu/tsan.py",
         "1 turns on the happens-before sanitizer: shared-singleton "
@@ -155,6 +185,12 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "max broker leases granted per remote dequeue RPC (the "
         "surplus buffers locally, so gulp fills are buffer pops, "
         "not round trips)",
+    ),
+    "NOMAD_TPU_FANOUT_MESH": EnvKnob(
+        "0", "nomad_tpu/server/batch_worker.py",
+        "1 reserves the device mesh (and the pod head) for the "
+        "follower fan-out worker — main workers in the same process "
+        "stay meshless instead of racing it for the world",
     ),
     "NOMAD_TPU_FANOUT_REFRESH_WAIT_S": EnvKnob(
         "5", "nomad_tpu/server/fanout.py",

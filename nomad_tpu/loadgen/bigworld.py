@@ -1,0 +1,166 @@
+"""Synthetic million-node world seeding (the ``bigworld`` arena).
+
+The paper's scale claim (ROADMAP item 2) is a 1M-node / 10M-alloc
+world; no real fleet that size fits a CPU-harness process if every
+node costs a scheduling fingerprint (~1KB) and every allocation a
+full ``Allocation`` dataclass (~3KB).  This module builds the world
+the memory-lean way:
+
+* **Lean nodes.** Real ``Node`` objects — every scheduler path that
+  reads ``store.nodes`` keeps working — but all container fields
+  (attributes, meta, drivers, host volumes, CSI plugins, reserved
+  resources) and the per-shape ``NodeResources`` are SHARED template
+  objects, and ``computed_class`` is computed once per (dc, shape)
+  prototype instead of hashed per node.  A node costs its instance
+  dict plus one id string.  Registration goes through
+  ``StateStore.bulk_register_nodes`` (one index bump, sliced column
+  writes, no per-row fingerprints).
+
+* **Array-backed allocations.** The 10M allocations exist only as a
+  usage ledger: per-alloc (row, cpu, mem, disk) arrays aggregated
+  into the node table's usage columns via ``np.add.at`` and retained
+  as per-row ballast (``StateStore.bulk_seed_usage``) so later real
+  alloc writes recompute usage ON TOP of the seeded base.  They carry
+  no ports, devices or job linkage — pure capacity pressure, which is
+  exactly what the placement kernels read.
+
+Expansion is a deterministic function of the spec (seeded numpy PCG,
+no wall clock), so the ``seed_world`` FSM command replays identically
+on every raft replica and the hermetic harness can seed follower
+processes independently and still agree bit-for-bit.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..structs import (
+    NODE_STATUS_READY,
+    Node,
+    NodeReservedResources,
+    NodeResources,
+    compute_node_class,
+)
+
+# (cpu MHz, memory MB, disk MB) machine shapes, cycled across rows
+SHAPES: Tuple[Tuple[int, int, int], ...] = (
+    (8_000, 16_384, 200_000),
+    (16_000, 32_768, 400_000),
+    (32_000, 65_536, 800_000),
+)
+
+# per-allocation asks, cycled by the seeded RNG; mean ~283 MHz so the
+# default 10 allocs/node land well under the smallest shape
+ALLOC_CPU = (100, 250, 500)
+ALLOC_MEM = (128, 256, 512)
+ALLOC_DISK = (0, 100, 300)
+
+
+def normalize_spec(spec: Optional[dict]) -> dict:
+    """Fill defaults and coerce types so every replica expands the
+    SAME world from the command payload."""
+    spec = dict(spec or {})
+    return {
+        "nodes": int(spec.get("nodes", 1_000_000)),
+        "allocs": int(spec.get("allocs", 10_000_000)),
+        "dcs": max(1, int(spec.get("dcs", 4))),
+        "seed": int(spec.get("seed", 0)),
+        "prefix": str(spec.get("prefix", "bw")),
+    }
+
+
+def world_datacenters(spec: Optional[dict]) -> List[str]:
+    spec = normalize_spec(spec)
+    return [f"{spec['prefix']}-dc{i}" for i in range(spec["dcs"])]
+
+
+def build_nodes(spec: dict) -> List[Node]:
+    """The lean-node expansion: one prototype per (dc, shape) carries
+    the shared containers and the precomputed class hash."""
+    n = spec["nodes"]
+    dcs = world_datacenters(spec)
+    prefix = spec["prefix"]
+    attrs = {"kernel.name": "linux", "cpu.arch": "amd64"}
+    meta: Dict[str, str] = {}
+    drivers = {"exec": True}
+    empty: Dict[str, object] = {}
+    reserved = NodeReservedResources()
+    protos = []
+    for di, dc in enumerate(dcs):
+        for si, (cpu, mem, disk) in enumerate(SHAPES):
+            res = NodeResources(cpu=cpu, memory_mb=mem, disk_mb=disk)
+            proto = Node(
+                id=f"{prefix}-proto-{di}-{si}",
+                datacenter=dc,
+                node_class="bigworld",
+                attributes=attrs,
+                meta=meta,
+                node_resources=res,
+                reserved_resources=reserved,
+                drivers=drivers,
+                host_volumes=empty,  # type: ignore[arg-type]
+                csi_node_plugins=empty,  # type: ignore[arg-type]
+                status=NODE_STATUS_READY,
+            )
+            proto.computed_class = compute_node_class(proto)
+            protos.append(proto)
+    n_proto = len(protos)
+    out: List[Node] = []
+    for i in range(n):
+        p = protos[i % n_proto]
+        out.append(
+            Node(
+                id=f"{prefix}-{i:08d}",
+                datacenter=p.datacenter,
+                node_class=p.node_class,
+                attributes=p.attributes,
+                meta=p.meta,
+                node_resources=p.node_resources,
+                reserved_resources=p.reserved_resources,
+                drivers=p.drivers,
+                host_volumes=p.host_volumes,
+                csi_node_plugins=p.csi_node_plugins,
+                status=NODE_STATUS_READY,
+                computed_class=p.computed_class,
+            )
+        )
+    return out
+
+
+def build_alloc_ledger(
+    spec: dict,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(node_idx, cpu, mem, disk) arrays — one entry per synthetic
+    allocation, node indices relative to the spec's node block."""
+    m = spec["allocs"]
+    rng = np.random.default_rng(spec["seed"])
+    node_idx = rng.integers(0, spec["nodes"], size=m, dtype=np.int64)
+    pick = rng.integers(0, len(ALLOC_CPU), size=m)
+    cpu = np.asarray(ALLOC_CPU, dtype=np.float64)[pick]
+    mem = np.asarray(ALLOC_MEM, dtype=np.float64)[pick]
+    disk = np.asarray(ALLOC_DISK, dtype=np.float64)[pick]
+    return node_idx, cpu, mem, disk
+
+
+def seed_world(store, spec: Optional[dict]) -> dict:
+    """Expand ``spec`` into the store: bulk node registration plus the
+    array-backed allocation ballast.  Deterministic — this is the body
+    of the ``seed_world`` FSM command, applied on every replica."""
+    spec = normalize_spec(spec)
+    table = store.node_table
+    start = table.n_rows
+    nodes = build_nodes(spec)
+    store.bulk_register_nodes(nodes)
+    node_idx, cpu, mem, disk = build_alloc_ledger(spec)
+    index = store.bulk_seed_usage(
+        start + node_idx, cpu, mem, disk,
+        alloc_count=spec["allocs"],
+    )
+    return {
+        "index": index,
+        "nodes": spec["nodes"],
+        "allocs": spec["allocs"],
+        "row_start": start,
+        "datacenters": world_datacenters(spec),
+    }

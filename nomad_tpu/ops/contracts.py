@@ -65,6 +65,15 @@ MESH_WIDTHS: Tuple[int, ...] = (1, 2, 4, 8)
 # `kernel-contract` nomadlint rule fails when this ladder is absent
 # or collapsed
 MESH_HOST_WIDTHS: Tuple[int, ...] = (8, 16, 32)
+# fan-out pod widths: the GLOBAL device counts a follower-headed
+# mesh may span (follower process + its pod peers, hosts x per-host
+# devices).  Small by design — a fan-out follower heads a slice of
+# the machine, not the whole pod — and a hard gate, not advisory:
+# BatchWorker._attach_pod refuses to head a world whose width is
+# undeclared here, because every undeclared width would compile a
+# fresh chained-runner AND sharded-storm signature on N followers
+# at once (the fan-out analogue of the pod-wide p99 cliff above)
+MESH_FANOUT_WIDTHS: Tuple[int, ...] = (2, 4, 8)
 # pod-scale arena rows (global) for the multi-host rungs: large
 # enough that every declared width yields a distinct non-trivial
 # shard-local column size
@@ -239,7 +248,39 @@ def iter_contracts() -> List[KernelContract]:
         ],
         out_dtypes=frozenset({"int32", "float32", "bool"}),
     )
-    return [chunk, storm, mesh, mesh_host, storm_mesh]
+    # the fan-out ladders: a follower-headed pod of W global devices
+    # (parallel/pod.py streams the launch sequence; every member —
+    # head and peers — compiles the same per-shard program over
+    # C_pod/W local columns).  Same expression trick as mesh_host:
+    # the unsharded kernel over shard-local shapes needs no live
+    # world.  _attach_pod gates the live width against
+    # MESH_FANOUT_WIDTHS so no follower can compile off-ladder.
+    mesh_fanout = KernelContract(
+        name="mesh_fanout",
+        kernel=_chunk_kernel,
+        ladder=[
+            _chain_args(CHUNK_LADDER[-1], _C_POD // w)
+            for w in MESH_FANOUT_WIDTHS
+        ],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    storm_fanout = KernelContract(
+        name="storm_fanout",
+        kernel=_storm_kernel,
+        ladder=[
+            _storm_args(
+                STORM_LADDER[-1][0],
+                STORM_LADDER[-1][1],
+                _C_POD // w,
+            )
+            for w in MESH_FANOUT_WIDTHS
+        ],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    return [
+        chunk, storm, mesh, mesh_host, storm_mesh,
+        mesh_fanout, storm_fanout,
+    ]
 
 
 def _signature(args: tuple, kwargs: dict) -> tuple:

@@ -51,14 +51,37 @@ import sys
 import time
 from typing import List, Optional
 
-# fixed world shape: small enough that compiles dominate nothing,
+# default world shape: small enough that compiles dominate nothing,
 # big enough that every device owns multiple node rows and every
-# phase crosses the process boundary
+# phase crosses the process boundary.  The NOMAD_TPU_SMOKE_* knobs
+# scale the SAME worker (one code path) from this tier-1 tiny world
+# up to the bigworld reduced-scale CI drive (loadgen/bigworld_smoke)
 DEVICES_PER_PROC = 2
 CHAIN_NODES = 12  # -> capacity 16: tiles over 4 devices
 CHAIN_JOBS = 12
 FAMILY_JOBS = 16
 KERNEL_E, KERNEL_A, KERNEL_C = 16, 64, 256
+
+
+def _world_knob(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def smoke_world() -> dict:
+    """The world-size knobs, defaulted to the tier-1 tiny world:
+    NOMAD_TPU_SMOKE_NODES (cluster size), NOMAD_TPU_SMOKE_JOBS
+    (chain-phase evals), NOMAD_TPU_SMOKE_FAMILY (storm family
+    size)."""
+    return {
+        "nodes": _world_knob("NOMAD_TPU_SMOKE_NODES", CHAIN_NODES),
+        "jobs": _world_knob("NOMAD_TPU_SMOKE_JOBS", CHAIN_JOBS),
+        "family": _world_knob(
+            "NOMAD_TPU_SMOKE_FAMILY", FAMILY_JOBS
+        ),
+    }
 
 
 def _free_port() -> int:
@@ -314,10 +337,15 @@ def run_worker() -> int:
 
     rank = jax.process_index()
     procs = jax.process_count()
+    world = smoke_world()
+    n_nodes, n_jobs, n_family = (
+        world["nodes"], world["jobs"], world["family"]
+    )
     result = {
         "procs": procs,
         "devices_per_host": jax.local_device_count(),
         "global_devices": jax.device_count(),
+        "world": world,
     }
 
     from nomad_tpu.server import Server
@@ -336,9 +364,9 @@ def run_worker() -> int:
     # starts, so the gulp composition — and with it the collective
     # launch sequence — is identical on every process
     worker.start = lambda: None  # type: ignore[method-assign]
-    for node in _make_nodes(CHAIN_NODES, seed=5):
+    for node in _make_nodes(n_nodes, seed=5):
         server.register_node(node)
-    chain_jobs = _make_jobs(CHAIN_JOBS, seed=7)
+    chain_jobs = _make_jobs(n_jobs, seed=7)
     for job in chain_jobs:
         server.register_job(job)
     server.start()
@@ -355,7 +383,7 @@ def run_worker() -> int:
         )
 
         # -- phase: chain (assemble/launch/fetch/replay) --------------
-        members = _drain_broker(server, worker, CHAIN_JOBS)
+        members = _drain_broker(server, worker, n_jobs)
         t0 = time.monotonic()
         leftover = worker._process_batch(members)
         for _ in range(8):
@@ -371,7 +399,7 @@ def run_worker() -> int:
         assert placed, "chain placed nothing"
         _assert_same_everywhere("chain placements", placed)
         result["chain"] = {
-            "evals": CHAIN_JOBS,
+            "evals": n_jobs,
             "placements": len(placed),
             "placements_per_sec": round(len(placed) / chain_dt, 1),
             "mesh_launches": worker.mesh_used,
@@ -419,7 +447,7 @@ def run_worker() -> int:
         }
 
         # -- phase: storm (sharded auction over the pod mesh) ---------
-        fam_jobs = _family_jobs(FAMILY_JOBS)
+        fam_jobs = _family_jobs(n_family)
         for job in fam_jobs:
             server.register_job(job)
         # wait for the whole wave to land, then dequeue ONE member
@@ -428,7 +456,7 @@ def run_worker() -> int:
         deadline = time.monotonic() + 30.0
         while (
             server.broker.ready_count(worker.schedulers)
-            < FAMILY_JOBS
+            < n_family
             and time.monotonic() < deadline
         ):
             time.sleep(0.02)
@@ -440,7 +468,7 @@ def run_worker() -> int:
             f"stray eval {ev0.job_id} raced the storm phase"
         )
         storm = worker._maybe_drain_storm(ev0, token0)
-        assert storm is not None and len(storm) == FAMILY_JOBS, (
+        assert storm is not None and len(storm) == n_family, (
             "storm detector missed the family backlog"
         )
         leftover = worker._process_storm(storm)
@@ -455,7 +483,7 @@ def run_worker() -> int:
         storm_placed = _placements(server, fam_jobs)
         _assert_same_everywhere("storm placements", storm_placed)
         result["storm"] = {
-            "members": FAMILY_JOBS,
+            "members": n_family,
             "solves": worker.storm_solves,
             "fallbacks": worker.storm_fallbacks,
             "placements": len(storm_placed),

@@ -7,10 +7,16 @@ configuration: servers gossip marks failed/left get removed from the
 raft peer set — but only while a quorum of the original configuration
 stays intact, so a partition can never talk the leader into shrinking
 below safety (reference autopilot.go pruneDeadServers' quorum check).
+The reverse direction runs too: a gossip-alive server missing from the
+configuration gets re-added (reference leader.go reconcileMember ->
+addRaftPeer), so a hard-killed server that restarts at the same
+address after cleanup pruned it rejoins replication instead of
+sitting alive-but-empty forever.
 """
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -54,6 +60,7 @@ class Autopilot:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.removed: List[str] = []
+        self.readded: List[str] = []
 
     @property
     def config(self) -> AutopilotConfig:
@@ -84,6 +91,7 @@ class Autopilot:
             try:
                 if self.cluster.is_leader():
                     self.prune_dead_servers()
+                    self.readd_joined_servers()
             except Exception:  # noqa: BLE001
                 pass
 
@@ -124,6 +132,39 @@ class Autopilot:
                 removed.append(addr)
         self.removed.extend(removed)
         return removed
+
+    def readd_joined_servers(self) -> List[str]:
+        """Re-add gossip-alive same-region servers missing from the
+        raft configuration (reference leader.go reconcileMember ->
+        addRaftPeer).  Dead-server cleanup pruned a hard-killed
+        server; when it restarts at the same address it refutes the
+        DEAD rumor and is alive in serf again — but absent from the
+        peer set the leader never replicates to it, so it would sit
+        READY with an empty store forever.  Gated on the member being
+        stably alive (reference ServerStabilizationTime) so a flapping
+        server is not re-added mid-flap.  Returns the addresses added
+        this pass."""
+        raft = self.cluster.raft
+        peers = set(raft.peers) | {raft.addr}
+        now = time.monotonic()
+        window = self.config.server_stabilization_time_s
+        region = getattr(self.cluster, "region", None)
+        added: List[str] = []
+        for m in self.cluster.gossip.alive_members():
+            if m.addr in peers:
+                continue
+            if getattr(m, "role", "server") != "server":
+                continue
+            # the WAN pool carries other regions' servers for
+            # federation routing; they belong to their own raft
+            if region is not None and m.region != region:
+                continue
+            if now - m.status_time < window:
+                continue
+            if self.cluster.broadcast_peer_add(m.addr) is not False:
+                added.append(m.addr)
+        self.readded.extend(added)
+        return added
 
     # ------------------------------------------------------------------
 

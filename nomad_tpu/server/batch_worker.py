@@ -670,6 +670,13 @@ class PrescoredStack:
 class BatchWorker(Worker):
     """Worker that drains and prescores evals in batches."""
 
+    # FanoutBatchWorker (server/fanout.py) overrides this marker.
+    # With NOMAD_TPU_FANOUT_MESH=1 only the marked worker may bring
+    # up the device mesh — a process hosting both the leader's main
+    # workers and a follower fan-out worker must not have two workers
+    # racing for one jax.distributed world / pod head port.
+    _is_fanout_worker = False
+
     def __init__(self, server, **kwargs) -> None:
         super().__init__(server, **kwargs)
         # exclusive accelerator lock before any backend init: a second
@@ -917,11 +924,20 @@ class BatchWorker(Worker):
         # which flips the mirror staging to the per-host protocol and
         # pins compiles inline — see _launch_chunk_mesh)
         self._mesh_hosts = 1
+        # pod head service (NOMAD_TPU_POD_PORT, process 0 of a
+        # multi-host world): streams this worker's mesh-operation
+        # sequence to the other world members so a fan-out follower
+        # can head a pod WITHOUT lockstep peers (parallel/pod.py).
+        # None in the PR 11 lockstep mode and on single-host meshes.
+        self._pod = None
         self._sharded_runners: Dict[tuple, object] = {}
         # opt-in: virtual CPU meshes make every launch slower (the
         # sharding tests cover parity); real multi-chip TPU deployments
         # set NOMAD_TPU_MESH=1
-        self._mesh_requested = _os.environ.get("NOMAD_TPU_MESH") == "1"
+        self._mesh_requested = (
+            _os.environ.get("NOMAD_TPU_MESH") == "1"
+            and self._mesh_allowed()
+        )
         if self._mesh_requested and (
             self.supervisor is None
             or not self.supervisor.failed_over()
@@ -958,6 +974,24 @@ class BatchWorker(Worker):
         from ..tsan import maybe_instrument
 
         maybe_instrument(self, "Worker")
+
+    def _mesh_allowed(self) -> bool:
+        """Whether THIS worker may own the device mesh.
+
+        Default: yes.  With NOMAD_TPU_FANOUT_MESH=1 the mesh is
+        reserved for the follower fan-out worker
+        (``_is_fanout_worker``): the fan-out deployment runs ONE
+        fanout worker per server process heading a multi-process
+        ``jax.distributed`` world, and the leader-side main workers
+        on the same process must stay meshless or they would race the
+        fanout worker for the world's coordinator and the pod head
+        port.
+        """
+        import os as _os
+
+        if _os.environ.get("NOMAD_TPU_FANOUT_MESH") != "1":
+            return True
+        return bool(getattr(self, "_is_fanout_worker", False))
 
     def _make_mesh(self):
         """Node-axis device mesh when the hardware offers >1 device;
@@ -996,10 +1030,62 @@ class BatchWorker(Worker):
 
                 mesh = make_mesh(n_devices=n, eval_axis=1)
                 self._mesh_hosts = host_count(mesh)
+                if self._mesh_hosts > 1:
+                    self._attach_pod()
+                metrics = getattr(self.server, "metrics", None)
+                if metrics is not None:
+                    # published at bring-up (not first sync): the
+                    # bigworld harness reads this gauge to confirm a
+                    # follower's pod formed before any eval arrives
+                    metrics.set_gauge(
+                        "mesh.hosts", float(self._mesh_hosts)
+                    )
                 return mesh
         except Exception:  # noqa: BLE001 — mesh is an optimization
-            pass
+            self._mesh_hosts = 1
         return None
+
+    def _attach_pod(self) -> None:
+        """Pod-head mode: with NOMAD_TPU_POD_PORT set, process 0 of a
+        multi-host world serves the mesh-operation stream the other
+        members replay (parallel/pod.py).  Idempotent — a failover
+        recovery rebuilds the mesh over the SAME world, and the
+        already-connected peers keep following the stream (the
+        post-flip full resync re-establishes their mirrors).  Failing
+        to bring the service up falls through to _make_mesh's
+        no-mesh path: degraded to the exact launches, never a pod
+        half-joined at a collective."""
+        if self._pod is not None:
+            return
+        import os as _os
+
+        port = _os.environ.get("NOMAD_TPU_POD_PORT")
+        if not port:
+            return
+        import jax as _jax
+
+        if _jax.process_index() != 0:
+            return
+        from ..ops.contracts import MESH_FANOUT_WIDTHS
+        from ..parallel.pod import PodService
+
+        n_global = len(_jax.devices())
+        if n_global not in MESH_FANOUT_WIDTHS:
+            # pod-ladder gate: an undeclared fan-out width would
+            # compile off-contract chained/storm signatures on every
+            # follower at once.  Raising drops the whole mesh in
+            # _make_mesh (exact launches only — a meshed head
+            # without its pod service would deadlock the peers'
+            # first collective instead)
+            LOG.warning(
+                "fan-out pod width %d not in MESH_FANOUT_WIDTHS %s"
+                " — mesh declined",
+                n_global, MESH_FANOUT_WIDTHS,
+            )
+            raise RuntimeError("undeclared fan-out pod width")
+        self._pod = PodService(
+            int(port), n_peers=_jax.process_count() - 1
+        )
 
     # -- accelerator supervisor integration ----------------------------
 
@@ -1276,6 +1362,20 @@ class BatchWorker(Worker):
         super().stop()
         if self._replay_pool is not None:
             self._replay_pool.shutdown()
+
+    def dispose(self) -> None:
+        """Final disposal (process shutdown / fleet discard), as
+        opposed to ``stop()``, which both leadership cycles and
+        fan-out teardown treat as a PAUSE: the pod head service (and
+        with it the peers' device mirrors) must survive stop/start
+        cycles — a re-established fleet catches the peers up in
+        O(dirty rows) deltas instead of rebuilding the world — and a
+        pod head cannot be re-bound while the old service still owns
+        the port."""
+        self.stop()
+        if self._pod is not None:
+            self._pod.close()
+            self._pod = None
 
     # ------------------------------------------------------------------
 
@@ -2692,11 +2792,27 @@ class BatchWorker(Worker):
                 spread_fit=problem.spread_fit,
                 max_rounds=max_rounds,
             )
+            if self._pod is not None:
+                # pod head: the storm inputs are plain host numpy —
+                # peers stage them against the mesh themselves and
+                # solve over their own mirror shards (synced by the
+                # _device_columns call above, which streamed first)
+                self._pod.send(
+                    "storm",
+                    tuple(problem.inputs),
+                    problem.spread_fit,
+                    max_rounds,
+                )
             inp = stage_for_mesh(problem.inputs, mesh)
             out = fn(inp, cols)
             # replicated outputs: every process holds the full
             # answer — no cross-host fetch
-            return tuple(np.asarray(x) for x in out)
+            host_out = tuple(np.asarray(x) for x in out)
+            if self._pod is not None and self._pod.check:
+                from ..parallel.pod import result_digest
+
+                self._pod.check_results(result_digest(*host_out))
+            return host_out
         cols = self._device_columns(table)
         out = storm_assignment(
             problem.inputs, cols,
@@ -4115,6 +4231,11 @@ class BatchWorker(Worker):
                 table.mem_used,
                 table.disk_used,
             )
+            if sharded and multihost and self._pod is not None:
+                # pod head: peers rebuild their mirror shards from
+                # the same host columns before any launch can read
+                # them (FIFO: this precedes every later chain/storm)
+                self._pod.send("mirror_full", host_cols)
             cols = tuple(put(col) for col in host_cols)
             bytes_up = sum(col.nbytes for col in host_cols)
             if sharded and multihost:
@@ -4136,6 +4257,8 @@ class BatchWorker(Worker):
                     table.mem_used,
                     table.disk_used,
                 )
+                if sharded and multihost and self._pod is not None:
+                    self._pod.send("mirror_bulk", host_used)
                 cols = cols[:3] + tuple(
                     put(col) for col in host_used
                 )
@@ -4172,6 +4295,24 @@ class BatchWorker(Worker):
                         patch_rows_hostlocal,
                     )
 
+                    if self._pod is not None:
+                        # pod head: ship the sorted dirty rows plus
+                        # their three value columns ONCE — O(dirty
+                        # rows) bytes on the wire; each peer gathers
+                        # its own shards' rows out of them and runs
+                        # this same flush protocol locally
+                        self._pod.send(
+                            "mirror_delta", idx,
+                            tuple(
+                                src[idx]
+                                for src in (
+                                    table.cpu_used,
+                                    table.mem_used,
+                                    table.disk_used,
+                                )
+                            ),
+                            table.capacity,
+                        )
                     patch = patch_rows_hostlocal(
                         self._mesh, donate=donate
                     )
@@ -5049,6 +5190,28 @@ class BatchWorker(Worker):
             # running the same warm sequence)
             from ..parallel.mesh import place_chain_inputs
 
+            if self._pod is not None:
+                # pod head: peers rebuild this launch from the host
+                # args tail plus their OWN device-resident mirror /
+                # carry (which track ours message-for-message); the
+                # send precedes the execution so the collective order
+                # is the stream order on every member
+                self._pod.send(
+                    "chain",
+                    {
+                        "n_picks": asm.P,
+                        "spread_fit": asm.spread_fit,
+                        "with_spread": spread_arg is not None,
+                        "spread_even": (
+                            spread_arg is not None
+                            and spread_arg.even is not None
+                        ),
+                        "used": (
+                            "mirror" if carry is None else "carry"
+                        ),
+                    },
+                    args[6:],
+                )
             args = place_chain_inputs(
                 self._mesh, args,
                 with_spread=spread_arg is not None,
@@ -5062,6 +5225,10 @@ class BatchWorker(Worker):
         ):
             return None
         rows_j, pulls_j, used_out = runner(*args)
+        if self._pod is not None and self._pod.check:
+            from ..parallel.pod import result_digest
+
+            self._pod.check_results(result_digest(rows_j, pulls_j))
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
             metrics.incr("mesh.launches")

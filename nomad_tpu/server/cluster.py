@@ -543,6 +543,17 @@ class ClusterServer(Server):
         except (NotLeaderError, TimeoutError, TransportError):
             return False  # retried by the next autopilot pass
 
+    def broadcast_peer_add(self, peer: str) -> bool:
+        """Autopilot reconcile: commit the re-add through the raft log
+        (reference leader.go addRaftPeer applies raft.AddVoter) so
+        every member converges on the restored peer set.  Returns
+        whether the change committed."""
+        try:
+            self.raft.add_server(peer)
+            return True
+        except (NotLeaderError, TimeoutError, TransportError):
+            return False  # retried by the next autopilot pass
+
     # -- membership / federation ---------------------------------------
 
     def join(self, seed_addr: str) -> int:

@@ -583,6 +583,11 @@ def _make_fanout_worker(view: FollowerView, seed=None):
         replicated state + local device for planning, remote leases
         and remote (serialized) plan commit."""
 
+        # under NOMAD_TPU_FANOUT_MESH=1 this is the one worker class
+        # allowed to bring up the device mesh (and head the pod) —
+        # see BatchWorker._mesh_allowed
+        _is_fanout_worker = True
+
         def __init__(self, server, **kwargs) -> None:
             super().__init__(server, **kwargs)
             self._refresh_wait_s = fanout_refresh_wait_s()
@@ -721,7 +726,7 @@ class FanoutManager:
         if thread is not None:
             thread.join(timeout=5.0)
         self._thread = None
-        self._stop_workers()
+        self._stop_workers(dispose=True)
 
     # -- monitor loop --------------------------------------------------
 
@@ -774,15 +779,38 @@ class FanoutManager:
                     "fanout.workers", float(len(self.workers))
                 )
 
-    def _stop_workers(self) -> None:
+    def _stop_workers(self, dispose: bool = False) -> None:
+        """Tear the fleet down.  ``dispose=False`` (a leadership
+        change) PARKS the workers rather than discarding them: their
+        device mirrors — and, on a pod head, the mesh peers' mirror
+        shards, which a discarded worker could never rebuild (the old
+        pod service still owns the port) — stay resident, so
+        re-establishing the fleet catches up in O(dirty rows) deltas
+        instead of a full-world resync.  A parked worker's mirrors
+        are marked dirty exactly like ``_on_device_transition``: an
+        abandoned in-flight launch may still be reading them, so the
+        catch-up sync must re-upload rather than donate the buffers
+        out from under it — without this, a re-established fleet
+        plans against a mirror whose buffers a straggler consumed.
+        ``dispose=True`` (manager shutdown) additionally releases the
+        workers and their pod service."""
         with self._lock:
             if not self._active and not self.workers:
                 return
             self._active = False
-            workers, self.workers = self.workers, []
+            if dispose:
+                workers, self.workers = self.workers, []
+            else:
+                workers = list(self.workers)
             view = self.view
         for worker in workers:
-            worker.stop()
+            if dispose and hasattr(worker, "dispose"):
+                worker.dispose()
+            else:
+                worker.stop()
+            mark = getattr(worker, "_mark_mirror_dirty", None)
+            if mark is not None:
+                mark()
         # buffered (undelivered) leases must not sit out the nack
         # timeout: hand them straight back for redelivery
         if view is not None:

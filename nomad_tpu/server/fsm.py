@@ -193,6 +193,23 @@ def state_payload(store: StateStore, acls) -> dict:
                 for k, v in store.scaling_events.items()
             },
         }
+        # bigworld allocation ballast (array-backed seeded usage) is
+        # replicated state: persist it keyed by node id so restore can
+        # re-row it against the rebuilt node table
+        if store._seed_usage is not None:
+            base = store._seed_usage
+            nz = (base[0] + base[1] + base[2]).nonzero()[0]
+            ids = store.node_table.node_ids
+            payload["seed_usage"] = {
+                ids[row]: (
+                    float(base[0][row]),
+                    float(base[1][row]),
+                    float(base[2][row]),
+                )
+                for row in nz.tolist()
+                if ids[row] is not None
+            }
+            payload["seed_alloc_count"] = store._seed_alloc_count
     if acls is not None:
         payload["acl_policies"] = list(acls.policies.values())
         payload["acl_tokens"] = list(acls.tokens_by_accessor.values())
@@ -250,6 +267,28 @@ def install_payload(store: StateStore, acls, payload: dict) -> int:
         # from the restored live allocs.
         store._ports_live.clear()
         store._ports_by_node.clear()
+        # re-row the seeded allocation ballast BEFORE the usage
+        # recompute below — _live_usage_for_node reads it per node
+        seed_usage = payload.get("seed_usage")
+        if seed_usage:
+            import numpy as np
+
+            cap = store.node_table.capacity
+            base = [np.zeros(cap, dtype=np.float64) for _ in range(3)]
+            for nid, (c, m, d) in seed_usage.items():
+                row = store.node_table.row_of.get(nid)
+                if row is None:
+                    continue
+                base[0][row] = c
+                base[1][row] = m
+                base[2][row] = d
+            store._seed_usage = base
+            store._seed_alloc_count = payload.get(
+                "seed_alloc_count", 0
+            )
+        else:
+            store._seed_usage = None
+            store._seed_alloc_count = 0
         for node_id in store.nodes:
             store.node_table.update_node_usage(
                 node_id, store._live_usage_for_node(node_id)
@@ -372,6 +411,14 @@ class ServerFSM:
 
     def _apply_upsert_node(self, node):
         return self.store.upsert_node(node)
+
+    def _apply_seed_world(self, spec):
+        """Deterministic synthetic-world expansion (bigworld): the log
+        carries the tiny spec, every replica expands it to the same
+        bulk-registered nodes + allocation ballast locally."""
+        from ..loadgen.bigworld import seed_world
+
+        return seed_world(self.store, spec)
 
     def _apply_delete_node(self, node_id):
         return self.store.delete_node(node_id)

@@ -56,6 +56,13 @@ def main(argv=None) -> int:
         "--num-schedulers", type=int, default=None,
     )
     parser.add_argument(
+        "--seed-world", default="",
+        help="JSON bigworld spec (loadgen/bigworld.py); once a leader "
+        "is known the spec is raft-applied and every replica expands "
+        "it deterministically — prints 'SEEDED nodes=N allocs=M' when "
+        "the apply commits",
+    )
+    parser.add_argument(
         "--tls-ca", default="",
         help="CA bundle for mutual-TLS server<->server RPC "
         "(reference helper/tlsutil; requires --tls-cert/--tls-key)",
@@ -84,6 +91,24 @@ def main(argv=None) -> int:
             key_file=args.tls_key,
             server_name=args.tls_server_name,
         )
+    import os
+
+    if os.environ.get("NOMAD_TPU_DIST") == "1":
+        # bring up this process's jax.distributed world BEFORE any
+        # code can touch the local backend: a server that wins the
+        # first election compiles exact-path kernels immediately, and
+        # a backend initialized single-process cannot join a
+        # multi-process world afterwards.  Failure is non-fatal — the
+        # fan-out worker simply runs meshless (exact path).
+        try:
+            from ..parallel.mesh import distributed_init
+
+            distributed_init()
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"distributed init failed: {exc}", file=sys.stderr
+            )
+
     transport = TcpTransport(tls=tls)
     extra = {}
     if args.heartbeat_ttl is not None:
@@ -109,6 +134,41 @@ def main(argv=None) -> int:
         server, host=args.http_host, port=args.http_port
     )
     print(f"READY addr={args.addr} http={http.port}", flush=True)
+
+    if args.seed_world:
+        import json
+
+        spec = json.loads(args.seed_world)
+
+        def _seed():
+            # _raft_apply forwards to the leader with bounded retry;
+            # loop across interregnums until the apply commits (the
+            # harness watches for the SEEDED line)
+            while True:
+                try:
+                    out = server._raft_apply("seed_world", (spec,))
+                except Exception as exc:  # noqa: BLE001
+                    print(
+                        f"seed-world retry: {exc}",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    import time
+
+                    time.sleep(0.5)
+                    continue
+                print(
+                    "SEEDED nodes={nodes} allocs={allocs}".format(
+                        nodes=out.get("nodes"),
+                        allocs=out.get("allocs"),
+                    ),
+                    flush=True,
+                )
+                return
+
+        threading.Thread(
+            target=_seed, name="seed-world", daemon=True
+        ).start()
 
     stop = threading.Event()
 

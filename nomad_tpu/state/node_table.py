@@ -31,7 +31,8 @@ Key ideas:
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Tuple, TYPE_CHECKING
 
 import numpy as np
 
@@ -130,9 +131,24 @@ class NodeTable:
         # mirror the usage columns (the BatchWorker's device-resident
         # input cache) record the generation they synced at and patch
         # only rows dirtied since, instead of re-shipping all C rows
-        # per flush.  Bounded: one entry per row ever dirtied.
+        # per flush.
+        #
+        # The query must cost O(rows dirtied since), not O(rows ever
+        # dirtied): a follower catching up from a short lag over a
+        # million-row arena cannot afford a full scan of the dirty map
+        # per flush.  So writes append to a generation-ordered log
+        # (parallel int lists, gens nondecreasing) that the query
+        # bisects; the map keeps only each row's LATEST generation and
+        # drives coalescing — whenever the log grows past twice the
+        # map, it is rebuilt from the map (one entry per row, sorted by
+        # generation).  Coalescing is lossless for every "dirty since
+        # g" query: a row dirtied after g has latest-gen > g, and the
+        # latest entry is exactly what survives.  Amortized O(1) per
+        # write, log length bounded by 2x rows-currently-dirty.
         self.usage_generation = 0
         self._usage_dirty: Dict[int, int] = {}
+        self._usage_log_gens: List[int] = []
+        self._usage_log_rows: List[int] = []
         # row -> scheduling-relevant fingerprint of the node last
         # upserted there, for topo-change detection (see upsert_node)
         self._row_fingerprints: Dict[int, tuple] = {}
@@ -332,7 +348,7 @@ class NodeTable:
         self.eligible[row] = False
         self.cpu_used[row] = self.mem_used[row] = self.disk_used[row] = 0.0
         self.usage_generation += 1
-        self._usage_dirty[row] = self.usage_generation
+        self._log_usage_dirty(row)
         self.node_ids[row] = None
         self.device_groups.pop(row, None)
         self._row_fingerprints.pop(row, None)
@@ -356,17 +372,154 @@ class NodeTable:
         self.disk_used[row] = float(usage[2])
         self.generation += 1
         self.usage_generation += 1
+        self._log_usage_dirty(row)
+
+    def _log_usage_dirty(self, row: int) -> None:
+        """Record ``row`` as dirtied at the CURRENT usage_generation
+        (caller bumps first) and coalesce the log when it outgrows the
+        per-row map."""
         self._usage_dirty[row] = self.usage_generation
+        self._usage_log_gens.append(self.usage_generation)
+        self._usage_log_rows.append(row)
+        if (
+            len(self._usage_log_gens) > 64
+            and len(self._usage_log_gens) > 2 * len(self._usage_dirty)
+        ):
+            self.compact_usage_log()
+
+    def compact_usage_log(self) -> None:
+        """Coalesce the usage-delta log down to one entry per dirty
+        row (its latest generation), preserving generation order."""
+        items = sorted(self._usage_dirty.items(), key=lambda kv: kv[1])
+        self._usage_log_rows = [row for row, _ in items]
+        self._usage_log_gens = [g for _, g in items]
+
+    def usage_log_len(self) -> int:
+        """Current (possibly uncoalesced) log length — observability
+        for the compaction tests and the bigworld accounting."""
+        return len(self._usage_log_gens)
 
     def usage_rows_dirty_since(self, generation: int) -> List[int]:
-        """Rows whose usage columns changed after ``generation``.
-        Callers needing atomicity against concurrent writers go through
+        """Rows whose usage columns changed after ``generation``, in
+        O(log L + rows-dirtied-since) via a bisect on the
+        generation-ordered log (duplicates coalesced).  Callers needing
+        atomicity against concurrent writers go through
         ``StateStore.usage_delta_since`` (takes the store lock)."""
-        return [
-            row
-            for row, g in self._usage_dirty.items()
-            if g > generation
-        ]
+        i = bisect_right(self._usage_log_gens, generation)
+        if i == len(self._usage_log_gens):
+            return []
+        return list(dict.fromkeys(self._usage_log_rows[i:]))
+
+    # ------------------------------------------------------------------
+    # bulk (columnar) registration — the bigworld seeding path
+    # ------------------------------------------------------------------
+
+    def bulk_register_nodes(self, nodes: Sequence["Node"]) -> np.ndarray:
+        """Register many FRESH nodes in one columnar pass.
+
+        The per-node ``upsert_node`` costs a scheduling fingerprint
+        (a ~1KB tuple kept per row for topo-change detection) plus a
+        per-call generation bump; at a million rows the fingerprints
+        alone are a gigabyte and the column writes dominate seed time.
+        This path assigns one contiguous row block, fills the numpy
+        columns with sliced writes, and skips the fingerprints
+        entirely — a later real ``upsert_node`` of the same id sees a
+        fingerprint miss and bumps ``topo_generation``, which is the
+        conservative (correct) direction.  Caller guarantees no id is
+        already registered.  All new rows are marked usage-dirty under
+        a single generation so delta mirrors pick them up.
+        """
+        n = len(nodes)
+        if n == 0:
+            return np.zeros(0, dtype=np.int32)
+        if not hasattr(self, "_nodes_cache"):
+            self._nodes_cache: Dict[str, "Node"] = {}
+        self._ensure_capacity(self.n_rows + n)
+        start = self.n_rows
+        self.n_rows += n
+        ids = [node.id for node in nodes]
+        self.row_of.update(zip(ids, range(start, start + n)))
+        self.node_ids[start : start + n] = ids
+        self._nodes_cache.update(zip(ids, nodes))
+        self.active[start : start + n] = True
+        cpu = np.empty(n, dtype=np.float64)
+        mem = np.empty(n, dtype=np.float64)
+        disk = np.empty(n, dtype=np.float64)
+        elig = np.empty(n, dtype=bool)
+        for i, node in enumerate(nodes):
+            res = node.node_resources
+            reserved = node.reserved_resources
+            cpu[i] = res.cpu - reserved.cpu
+            mem[i] = res.memory_mb - reserved.memory_mb
+            disk[i] = res.disk_mb - reserved.disk_mb
+            elig[i] = node.ready()
+            if res.devices:
+                groups: List[Tuple[int, int]] = []
+                for g in res.devices:
+                    sig = (
+                        g.vendor,
+                        g.type,
+                        g.name,
+                        tuple(
+                            sorted(
+                                (k, str(v))
+                                for k, v in g.attributes.items()
+                            )
+                        ),
+                    )
+                    code = self.device_sigs.code(repr(sig))
+                    self._device_sig_meta[code] = sig
+                    groups.append((code, len(g.instance_ids)))
+                self.device_groups[start + i] = groups
+        self.eligible[start : start + n] = elig
+        self.cpu_total[start : start + n] = cpu
+        self.mem_total[start : start + n] = mem
+        self.disk_total[start : start + n] = disk
+        for key, col in self.columns.items():
+            for i, node in enumerate(nodes):
+                value = _resolve_column_value(node, key)
+                col.codes[start + i] = (
+                    col.interner.code(value)
+                    if value is not None
+                    else MISSING
+                )
+        self.generation += 1
+        self.topo_generation += 1
+        self.usage_generation += 1
+        g = self.usage_generation
+        rows = range(start, start + n)
+        self._usage_dirty.update(dict.fromkeys(rows, g))
+        self._usage_log_gens.extend([g] * n)
+        self._usage_log_rows.extend(rows)
+        return np.arange(start, start + n, dtype=np.int32)
+
+    def bulk_set_usage(
+        self,
+        rows: np.ndarray,
+        cpu: np.ndarray,
+        mem: np.ndarray,
+        disk: np.ndarray,
+    ) -> None:
+        """Vectorized usage write for many rows under ONE generation —
+        the seeding path's counterpart of ``update_node_usage`` (which
+        costs a generation bump and a log append per row)."""
+        if len(rows) == 0:
+            return
+        self.cpu_used[rows] = cpu
+        self.mem_used[rows] = mem
+        self.disk_used[rows] = disk
+        self.generation += 1
+        self.usage_generation += 1
+        g = self.usage_generation
+        row_list = np.asarray(rows).tolist()
+        self._usage_dirty.update(dict.fromkeys(row_list, g))
+        self._usage_log_gens.extend([g] * len(row_list))
+        self._usage_log_rows.extend(row_list)
+        if (
+            len(self._usage_log_gens) > 64
+            and len(self._usage_log_gens) > 2 * len(self._usage_dirty)
+        ):
+            self.compact_usage_log()
 
     # ------------------------------------------------------------------
     # views

@@ -26,6 +26,8 @@ import time
 from collections import defaultdict
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+import numpy as np
+
 from ..trace import TRACE
 from ..structs import (
     Allocation,
@@ -119,6 +121,15 @@ class StateStore:
         # whole port dict
         self._ports_live: Dict[int, Dict[str, int]] = {}
         self._ports_by_node: Dict[str, set] = {}
+
+        # bigworld allocation ballast: per-row (cpu, mem, disk) usage
+        # seeded by bulk_seed_usage WITHOUT materializing Allocation
+        # objects (10M allocs as dataclasses would cost tens of GB;
+        # the array ledger is three f64 columns).  _live_usage_for_node
+        # adds the row's ballast on every recompute so a real alloc
+        # landing on a seeded node doesn't wipe the seeded base.
+        self._seed_usage: Optional[List[np.ndarray]] = None
+        self._seed_alloc_count = 0
 
         # change notification for blocking queries
         self._watch_cond = threading.Condition(self._lock)
@@ -249,9 +260,87 @@ class StateStore:
         nets = node.node_resources.networks
         return nets[0].ip if nets else ""
 
+    def bulk_register_nodes(self, nodes: List[Node]) -> int:
+        """Register many FRESH synthetic nodes under ONE index bump —
+        the bigworld seeding path.  Callers pre-set computed_class
+        (the per-node class hash over a million template-sharing nodes
+        is pure waste) and guarantee the ids are new.  Per-node touch
+        counts are not seeded: an absent entry reads as 0, which is a
+        valid conflict-ledger baseline."""
+        if not nodes:
+            return self._index
+        with self._lock:
+            idx = self._index + 1
+            for node in nodes:
+                node.create_index = idx
+                node.modify_index = idx
+                self.nodes[node.id] = node
+            self.node_table.bulk_register_nodes(nodes)
+            self._readiness_gen += 1
+            return self._bump("nodes")
+
+    def bulk_seed_usage(
+        self,
+        rows: np.ndarray,
+        cpu: np.ndarray,
+        mem: np.ndarray,
+        disk: np.ndarray,
+        alloc_count: int = 0,
+    ) -> int:
+        """Add allocation ballast to node rows as array columns — the
+        usage the rows' live allocs WOULD exert if ``alloc_count``
+        Allocation objects had been upserted, without materializing
+        any of them.  Idempotent consumers see it as a normal usage
+        delta (one generation, all touched rows dirty)."""
+        with self._lock:
+            cap = self.node_table.capacity
+            if self._seed_usage is None or len(
+                self._seed_usage[0]
+            ) < cap:
+                grown = [
+                    np.zeros(cap, dtype=np.float64) for _ in range(3)
+                ]
+                if self._seed_usage is not None:
+                    for g, o in zip(grown, self._seed_usage):
+                        g[: len(o)] = o
+                self._seed_usage = grown
+            # this call's per-row aggregate (many allocs can land on
+            # one row), folded into both the persistent ballast and
+            # the live usage columns on top of whatever real allocs
+            # already exert there
+            agg = [np.zeros(cap, dtype=np.float64) for _ in range(3)]
+            np.add.at(agg[0], rows, cpu)
+            np.add.at(agg[1], rows, mem)
+            np.add.at(agg[2], rows, disk)
+            for base, a in zip(self._seed_usage, agg):
+                base += a
+            touched = np.unique(rows)
+            table = self.node_table
+            table.bulk_set_usage(
+                touched,
+                table.cpu_used[touched] + agg[0][touched],
+                table.mem_used[touched] + agg[1][touched],
+                table.disk_used[touched] + agg[2][touched],
+            )
+            self._seed_alloc_count += int(alloc_count)
+            return self._bump("allocs")
+
+    def seeded_alloc_count(self) -> int:
+        """How many synthetic allocations back the ballast columns."""
+        return self._seed_alloc_count
+
     def delete_node(self, node_id: str) -> int:
         with self._lock:
             if node_id in self.nodes:
+                # a freed row can be reused by a future join; it must
+                # not inherit this node's seeded allocation ballast
+                if self._seed_usage is not None:
+                    row = self.node_table.row_of.get(node_id)
+                    if row is not None and row < len(
+                        self._seed_usage[0]
+                    ):
+                        for base in self._seed_usage:
+                            base[row] = 0.0
                 del self.nodes[node_id]
                 self.node_table.delete_node(node_id)
                 self._readiness_gen += 1
@@ -814,6 +903,12 @@ class StateStore:
 
     def _live_usage_for_node(self, node_id: str):
         cpu = mem = disk = 0
+        if self._seed_usage is not None:
+            row = self.node_table.row_of.get(node_id)
+            if row is not None and row < len(self._seed_usage[0]):
+                cpu = int(self._seed_usage[0][row])
+                mem = int(self._seed_usage[1][row])
+                disk = int(self._seed_usage[2][row])
         for aid in self._allocs_by_node.get(node_id, ()):
             a = self.allocs[aid]
             if a.terminal_status():

@@ -1,0 +1,253 @@
+"""Million-node world seeding (nomad_tpu/loadgen/bigworld.py) and the
+node table's coalescing dirty-row log.
+
+Covers the O(dirty rows) contract the composed fan-out × pod topology
+leans on: log compaction must be lossless for every "dirty since g"
+query (bit-identity against an uncompacted reference), bulk columnar
+registration must match the per-node upsert path, seeded allocation
+ballast must replicate through the seed_world FSM command and survive
+a snapshot round-trip, and the closed-form byte accounting of a
+delta catch-up must hold.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from nomad_tpu.loadgen import bigworld
+from nomad_tpu.server import fsm
+from nomad_tpu.state import NodeTable, StateStore
+
+SPEC = {"nodes": 300, "allocs": 3_000, "dcs": 2, "seed": 7, "prefix": "bwt"}
+
+
+def _seeded_store(spec=None):
+    store = StateStore()
+    result = bigworld.seed_world(store, spec or SPEC)
+    return store, result
+
+
+# ---------------------------------------------------------------------
+# dirty-row log: compaction bit-identity
+# ---------------------------------------------------------------------
+
+
+def test_compaction_is_lossless_for_every_dirty_since_query():
+    """Coalescing keeps one entry per row (its latest generation);
+    every ``usage_rows_dirty_since(g)`` answer must be identical to a
+    full uncompacted reference log, before and after compaction."""
+    table = NodeTable()
+    nodes = bigworld.build_nodes(bigworld.normalize_spec(SPEC))[:48]
+    for node in nodes:
+        table.upsert_node(node)
+    rng = np.random.default_rng(3)
+    # reference log: every (generation, row) write ever made; start
+    # from the upsert-time dirty marks
+    ref = [(gen, row) for row, gen in table._usage_dirty.items()]
+    # hammer a small row set so the log outgrows the dirty map and
+    # auto-compaction actually triggers
+    hot = [1, 3, 5, 7, 11]
+    for _ in range(400):
+        row = int(rng.choice(hot))
+        node_id = table.node_ids[row]
+        table.update_node_usage(node_id, (1.0, 2.0, 3.0))
+        ref.append((table.usage_generation, row))
+    assert table.usage_log_len() <= max(
+        64, 2 * len(table._usage_dirty)
+    ), "auto-compaction failed to bound the log"
+
+    def reference_since(g):
+        return {row for gen, row in ref if gen > g}
+
+    gens = sorted({g for g, _ in ref} | {0, table.usage_generation})
+    for g in gens:
+        got = table.usage_rows_dirty_since(g)
+        assert len(got) == len(set(got)), "duplicates survived"
+        assert set(got) == reference_since(g), f"mismatch at gen {g}"
+    # explicit compaction must not change a single answer
+    table.compact_usage_log()
+    assert table.usage_log_len() == len(table._usage_dirty)
+    for g in gens:
+        assert set(table.usage_rows_dirty_since(g)) == reference_since(
+            g
+        ), f"compaction changed the answer at gen {g}"
+
+
+def test_dirty_log_length_stays_o_dirty_rows_under_rewrites():
+    """A follower catching up over a million-row arena depends on the
+    log being bounded by rows-currently-dirty, not writes-ever-made:
+    rewriting the same row thousands of times must not grow it."""
+    table = NodeTable()
+    nodes = bigworld.build_nodes(bigworld.normalize_spec(SPEC))[:8]
+    for node in nodes:
+        table.upsert_node(node)
+    nid = table.node_ids[0]
+    for i in range(5_000):
+        table.update_node_usage(nid, (float(i), 0.0, 0.0))
+    assert table.usage_log_len() <= max(64, 2 * len(table._usage_dirty))
+    assert len(table._usage_dirty) <= len(nodes)
+
+
+# ---------------------------------------------------------------------
+# bulk columnar registration vs per-node upsert
+# ---------------------------------------------------------------------
+
+
+def test_bulk_register_matches_per_node_upsert_columns():
+    spec = bigworld.normalize_spec(SPEC)
+    nodes = bigworld.build_nodes(spec)[:64]
+    bulk, ref = NodeTable(), NodeTable()
+    rows = bulk.bulk_register_nodes(nodes)
+    for node in nodes:
+        ref.upsert_node(node)
+    assert list(rows) == [ref.row_of[n.id] for n in nodes]
+    n = len(nodes)
+    for col in (
+        "active", "eligible",
+        "cpu_total", "mem_total", "disk_total",
+        "cpu_used", "mem_used", "disk_used",
+    ):
+        assert np.array_equal(
+            getattr(bulk, col)[:n], getattr(ref, col)[:n]
+        ), f"column {col} diverged"
+    # every bulk row is usage-dirty under ONE generation so delta
+    # mirrors pick the whole block up in a single catch-up query
+    gens = {bulk._usage_dirty[r] for r in range(n)}
+    assert gens == {bulk.usage_generation}
+    assert set(bulk.usage_rows_dirty_since(0)) == set(range(n))
+
+
+def test_store_bulk_register_is_one_index_bump():
+    store = StateStore()
+    before = store._index
+    nodes = bigworld.build_nodes(bigworld.normalize_spec(SPEC))[:32]
+    index = store.bulk_register_nodes(nodes)
+    assert index == before + 1
+    assert all(n.id in store.nodes for n in nodes)
+    assert all(n.modify_index == index for n in nodes)
+
+
+# ---------------------------------------------------------------------
+# seed_world: determinism + ballast semantics
+# ---------------------------------------------------------------------
+
+
+def test_seed_world_is_deterministic_across_replicas():
+    """The FSM command replays on every raft replica: two independent
+    expansions of the same spec must agree bit-for-bit on the usage
+    columns the placement kernels read."""
+    a, ra = _seeded_store()
+    b, rb = _seeded_store()
+    assert ra["nodes"] == rb["nodes"] == SPEC["nodes"]
+    assert ra["datacenters"] == rb["datacenters"]
+    n = SPEC["nodes"]
+    ta, tb = a.node_table, b.node_table
+    for col in ("cpu_used", "mem_used", "disk_used", "cpu_total"):
+        assert np.array_equal(
+            getattr(ta, col)[:n], getattr(tb, col)[:n]
+        ), f"replica divergence in {col}"
+    assert a.seeded_alloc_count() == b.seeded_alloc_count() == SPEC["allocs"]
+
+
+def test_seed_world_ballast_survives_usage_recompute():
+    """Seeded ballast is a floor under real usage: recomputing a
+    node's usage from its (zero) live allocs must keep the ballast."""
+    store, _ = _seeded_store()
+    table = store.node_table
+    nid = table.node_ids[0]
+    before = float(table.cpu_used[0])
+    assert before > 0.0, "row 0 drew no ballast — pick a luckier seed"
+    store.node_table.update_node_usage(
+        nid, store._live_usage_for_node(nid)
+    )
+    assert float(table.cpu_used[0]) == before
+
+
+def test_deleted_node_row_does_not_leak_ballast():
+    """A freed row reused by a future join must not inherit the dead
+    node's seeded allocation ballast."""
+    store, _ = _seeded_store()
+    table = store.node_table
+    nid = table.node_ids[0]
+    assert store._seed_usage is not None
+    store.delete_node(nid)
+    assert store._seed_usage[0][0] == 0.0
+    assert store._seed_usage[1][0] == 0.0
+    assert store._seed_usage[2][0] == 0.0
+
+
+def test_usage_delta_since_covers_seeded_block():
+    store, result = _seeded_store()
+    gen, rows = store.usage_delta_since(0)
+    assert gen == store.node_table.usage_generation
+    start = result["row_start"]
+    assert set(rows) >= set(range(start, start + SPEC["nodes"]))
+    # a consumer synced at `gen` has nothing to catch up
+    assert store.usage_delta_since(gen) == (gen, [])
+
+
+def test_catchup_byte_closed_form():
+    """The per-flush wire cost of a delta catch-up is the closed form
+    the bigworld accounting reports: idx(int32) + 3 value columns
+    (float64) over the dirty rows — O(dirty rows), independent of
+    world size."""
+    store, _ = _seeded_store()
+    table = store.node_table
+    gen0 = table.usage_generation
+    k = 17
+    for row in range(k):
+        table.update_node_usage(
+            table.node_ids[row], (5.0, 6.0, 7.0)
+        )
+    _, dirty = store.usage_delta_since(gen0)
+    assert len(dirty) == k
+    idx = np.asarray(dirty, dtype=np.int32)
+    vals = [
+        np.asarray(table.cpu_used[idx], dtype=np.float64),
+        np.asarray(table.mem_used[idx], dtype=np.float64),
+        np.asarray(table.disk_used[idx], dtype=np.float64),
+    ]
+    nbytes = idx.nbytes + sum(v.nbytes for v in vals)
+    assert nbytes == k * 4 + 3 * k * 8
+
+
+# ---------------------------------------------------------------------
+# seed_world through the FSM: command + snapshot round-trip
+# ---------------------------------------------------------------------
+
+
+def test_seed_world_snapshot_round_trip_preserves_ballast():
+    """Ballast is replicated state: a snapshot install on a fresh
+    store must rebuild the same usage columns (re-rowed by node id)
+    and the seeded alloc count."""
+    store, _ = _seeded_store()
+    payload = fsm.state_payload(store, None)
+    assert payload["seed_alloc_count"] == SPEC["allocs"]
+    fresh = StateStore()
+    fsm.install_payload(fresh, None, payload)
+    assert fresh.seeded_alloc_count() == SPEC["allocs"]
+    src, dst = store.node_table, fresh.node_table
+    for nid in list(store.nodes)[:50]:
+        srow, drow = src.row_of[nid], dst.row_of[nid]
+        for col in ("cpu_used", "mem_used", "disk_used"):
+            assert getattr(src, col)[srow] == getattr(dst, col)[drow], (
+                f"{col} diverged for {nid} after restore"
+            )
+    # the restored ballast keeps protecting the floor
+    nid = dst.node_ids[0]
+    before = float(dst.cpu_used[0])
+    dst.update_node_usage(nid, fresh._live_usage_for_node(nid))
+    assert float(dst.cpu_used[0]) == before
+
+
+def test_seed_world_fsm_command_applies_on_replica():
+    """The encoded command path a follower replays: decode + apply
+    must seed the same world the leader expanded."""
+    from nomad_tpu.server.fsm import ServerFSM
+
+    store = StateStore()
+    f = ServerFSM.__new__(ServerFSM)
+    f.store = store
+    result = f._apply_seed_world(SPEC)
+    assert result["nodes"] == SPEC["nodes"]
+    assert len(store.nodes) == SPEC["nodes"]
+    assert store.seeded_alloc_count() == SPEC["allocs"]

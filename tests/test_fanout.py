@@ -446,3 +446,79 @@ def test_chaos_smoke_with_fanout_small():
     assert block["lost_evals"] == 0
     assert block["duplicate_placements"] == 0
     assert block["counters"]["fanout.plans_submitted"] > 0
+
+
+# ---------------------------------------------------------------------
+# mirror lifecycle on lease handback (park vs dispose)
+# ---------------------------------------------------------------------
+
+
+def test_stop_workers_parks_fleet_and_marks_mirrors_dirty(monkeypatch):
+    """A leadership-change teardown PARKS the fan-out workers — same
+    objects, device mirrors marked dirty — so re-establishment catches
+    up in O(dirty rows) deltas instead of a full-world resync; only
+    manager shutdown disposes the fleet."""
+    monkeypatch.setenv("NOMAD_TPU_FANOUT", "1")
+    monkeypatch.setenv("NOMAD_TPU_FANOUT_WORKERS", "1")
+    cluster = TestCluster(3, heartbeat_ttl=300.0)
+    cluster.start()
+    try:
+        cluster.wait_for_leader(timeout=30.0)
+        followers = cluster.followers()
+        wait_until(
+            lambda: all(f.fanout.active() for f in followers),
+            msg="followers fanned out",
+        )
+        mgr = followers[0].fanout
+        workers = list(mgr.workers)
+        assert workers, "no fan-out workers established"
+        assert all(
+            getattr(w, "_is_fanout_worker", False) for w in workers
+        )
+        # quiesce the monitor so the park below isn't instantly undone
+        # (its exit path runs the same park teardown)
+        mgr._stop.set()
+        mgr._thread.join(timeout=10.0)
+        mgr._thread = None
+        # a fresh worker starts dirty; clear so the assert is real
+        for w in workers:
+            w._mirror_dirty = False
+            w._mirror_dirty_sharded = False
+        mgr._stop_workers()
+        assert not mgr.active()
+        assert mgr.workers == workers, "park discarded the fleet"
+        for w in workers:
+            assert w._mirror_dirty and w._mirror_dirty_sharded, (
+                "parked worker's mirrors not marked dirty — the "
+                "catch-up sync would donate buffers an abandoned "
+                "launch may still be reading"
+            )
+        # re-establishment reuses the SAME parked workers
+        mgr._ensure_workers()
+        wait_until(lambda: mgr.active(), msg="fleet re-established")
+        assert mgr.workers == workers
+        # manager shutdown is the dispose path: fleet released
+        mgr.stop()
+        assert mgr.workers == []
+        assert not mgr.active()
+    finally:
+        cluster.stop()
+
+
+def test_fanout_mesh_knob_reserves_mesh_for_fanout_workers(monkeypatch):
+    """With NOMAD_TPU_FANOUT_MESH=1 only the marked fan-out worker may
+    bring the device mesh up — a process hosting both a leader's main
+    workers and a follower fan-out worker must not have two workers
+    racing for one jax.distributed world / pod head port."""
+    from types import SimpleNamespace
+
+    from nomad_tpu.server.batch_worker import BatchWorker
+
+    monkeypatch.delenv("NOMAD_TPU_FANOUT_MESH", raising=False)
+    plain = SimpleNamespace(_is_fanout_worker=False)
+    marked = SimpleNamespace(_is_fanout_worker=True)
+    assert BatchWorker._mesh_allowed(plain)
+    assert BatchWorker._mesh_allowed(marked)
+    monkeypatch.setenv("NOMAD_TPU_FANOUT_MESH", "1")
+    assert not BatchWorker._mesh_allowed(plain)
+    assert BatchWorker._mesh_allowed(marked)

@@ -206,6 +206,128 @@ def test_autopilot_disabled_by_config():
     assert ap.prune_dead_servers() == []
 
 
+def test_autopilot_readds_stably_alive_server():
+    """A server pruned by dead-server cleanup that restarts at the
+    same address is gossip-alive but absent from the raft config; the
+    reconcile pass must re-add it (reference leader.go
+    reconcileMember -> addRaftPeer) or it never receives another log
+    entry.  Members inside the stabilization window, other regions'
+    servers, and non-server roles stay out."""
+
+    from types import SimpleNamespace
+
+    old = time.monotonic() - 60.0
+
+    class FakeGossip:
+        def alive_members(self):
+            return [
+                SimpleNamespace(  # self: already in config
+                    addr="a", role="server", region="global",
+                    status_time=old,
+                ),
+                SimpleNamespace(  # the restarted server
+                    addr="c", role="server", region="global",
+                    status_time=old,
+                ),
+                SimpleNamespace(  # still inside stabilization
+                    addr="d", role="server", region="global",
+                    status_time=time.monotonic(),
+                ),
+                SimpleNamespace(  # federation route, not our raft
+                    addr="e", role="server", region="eu",
+                    status_time=old,
+                ),
+            ]
+
+    class FakeRaft:
+        addr = "a"
+        peers = ["b"]
+
+    class FakeCluster:
+        gossip = FakeGossip()
+        raft = FakeRaft()
+        region = "global"
+        added = []
+
+        def is_leader(self):
+            return True
+
+        def broadcast_peer_add(self, addr):
+            self.added.append(addr)
+            return True
+
+    cluster = FakeCluster()
+    ap = Autopilot(cluster)
+    assert ap.readd_joined_servers() == ["c"]
+    assert cluster.added == ["c"]
+    assert ap.readded == ["c"]
+
+
+def test_autopilot_readd_commits_through_raft_log():
+    """End-to-end on a real cluster: prune a hard-killed follower,
+    heal the partition so its (restarted) gossip refutes the DEAD
+    rumor, and the reconcile pass restores it to every member's
+    replicated configuration."""
+    c = TestCluster(3, heartbeat_ttl=60.0)
+    c.start()
+    try:
+        leader = c.wait_for_leader()
+        victim = c.followers()[0]
+        victim.raft.stop()
+        for s in c.servers:
+            if s.addr != victim.addr:
+                c.transport.partition(victim.addr, s.addr)
+        wait_until(
+            lambda: any(
+                m.addr == victim.addr and m.status in ("dead", "left")
+                for m in leader.gossip.all_members()
+            ),
+            timeout=20.0,
+            msg="gossip marks victim failed",
+        )
+        # the background autopilot loop may beat the explicit call;
+        # assert the effect, not which pass won
+        leader.autopilot.prune_dead_servers()
+        wait_until(
+            lambda: victim.addr not in leader.raft.peers,
+            timeout=10.0,
+            msg="dead-server cleanup prunes the victim",
+        )
+        # "restart": heal the partition; the victim's still-running
+        # gossip refutes the DEAD rumor exactly like a relaunched
+        # process at the same address would
+        for s in c.servers:
+            if s.addr != victim.addr:
+                c.transport.heal(victim.addr, s.addr)
+        wait_until(
+            lambda: any(
+                m.addr == victim.addr and m.status == "alive"
+                for m in leader.gossip.all_members()
+            ),
+            timeout=20.0,
+            msg="gossip sees victim alive again",
+        )
+        # bypass the stabilization wait: the window is operator
+        # config, not part of the mechanism under test
+        leader.autopilot._default_config.server_stabilization_time_s = 0.0
+        leader.autopilot.readd_joined_servers()
+        wait_until(
+            lambda: victim.addr in leader.raft.peers,
+            timeout=15.0,
+            msg="reconcile re-adds the restarted server",
+        )
+        other = [
+            s for s in c.followers() if s.addr != victim.addr
+        ][0]
+        wait_until(
+            lambda: victim.addr in other.raft.peers,
+            timeout=5.0,
+            msg="follower applies the replicated re-add",
+        )
+    finally:
+        c.stop()
+
+
 # ---------------------------------------------------------------------------
 # multiregion
 # ---------------------------------------------------------------------------

@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (27 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (28 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -75,6 +75,20 @@ if [ "${SMOKE:-1}" = "1" ]; then
     # launcher kills a deadlocked world at the timeout, so a
     # collective hang fails the gate instead of wedging it
     python -m nomad_tpu.parallel.dist_smoke --procs 2 --timeout 360
+
+    echo "== composed bigworld smoke (fan-out followers x pod mesh) =="
+    # the composed-topology gate at reduced scale: a 3-server cluster
+    # seeded via the seed_world FSM command, every follower heading a
+    # 2-process jax.distributed pod, schedulers ONLY on the fan-out
+    # followers — zero lost evals, placement-set parity vs the
+    # single-server oracle, pod digest parity on every mesh launch
+    # (POD_CHECK), and a killed follower+peer pair catching back up
+    # from the dirty-row log.  Scaled well below the BENCH acceptance
+    # run (>=1M nodes / >=10M allocs); the kill-timeout fails a
+    # wedged world instead of hanging the gate
+    timeout -k 10 1800 python -m nomad_tpu.loadgen.bigworld_smoke \
+        --nodes 128 --allocs 1024 --jobs 2 --storm-jobs 8 \
+        --timeout 900
 fi
 
 echo "ci_check: all green"

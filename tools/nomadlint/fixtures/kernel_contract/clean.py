@@ -1,11 +1,12 @@
 """kernel-contract clean fixture: distinct rungs, closed dtypes,
-and a declared multi-host pod ladder."""
+and declared multi-host + fan-out pod ladders."""
 import jax
 import numpy as np
 
 from nomad_tpu.ops.contracts import KernelContract
 
 MESH_HOST_WIDTHS = (8, 16)
+MESH_FANOUT_WIDTHS = (2, 4)
 
 
 def _kernel():

@@ -134,6 +134,15 @@ SHARED_STATE_ALLOWLIST: Tuple[Tuple[str, str, str], ...] = (
     ),
     (
         "Worker",
+        r"_pod",
+        "set-once pod-service latch: _attach_pod checks-then-binds "
+        "a complete PodService (GIL-atomic object store) from the "
+        "mesh bring-up path and is idempotent across leadership "
+        "rebuilds; dispose() closes it only after stop() joined "
+        "the worker thread, so no launch can race the teardown",
+    ),
+    (
+        "Worker",
         r"_backend_epoch|_cand_cache|_mask_cache|_port_col_cache"
         r"|_dev_codes_cache|_dev_aff_cache|_donate_carries"
         r"|_launch_ewma|_launch_ewma_seed|_mesh_ewma_seed|_mesh"

@@ -97,6 +97,22 @@ class KernelContractRule(Rule):
                     "declared — pod recompiles can drift silently",
                 )
             )
+        # fan-out pod ladder (follower-headed meshes): same silent-
+        # recompile exposure, multiplied by the follower count — and
+        # _attach_pod's live width gate reads this literal, so its
+        # absence would also disable the gate
+        fanout_widths = _int_tuple_literal(
+            ctx.tree(ladder_path), "MESH_FANOUT_WIDTHS"
+        )
+        if not fanout_widths:
+            findings.append(
+                Finding(
+                    self.name, ladder_path, 0,
+                    "no MESH_FANOUT_WIDTHS fan-out pod shape "
+                    "ladder declared — follower-headed mesh "
+                    "recompiles can drift silently",
+                )
+            )
         if override is not None:
             try:
                 contract_list = _load_fixture_contracts(override)
@@ -116,7 +132,10 @@ class KernelContractRule(Rule):
         # contracts, not just declared: one rung per width for both
         # the chained runner and the sharded storm solve
         names = {c.name for c in live.iter_contracts()}
-        for required in ("mesh_host", "storm_mesh"):
+        for required in (
+            "mesh_host", "storm_mesh",
+            "mesh_fanout", "storm_fanout",
+        ):
             if required not in names:
                 findings.append(
                     Finding(

@@ -1226,6 +1226,40 @@ class MultichipExportRule(Rule):
         )
 
 
+@register
+class BigworldExportRule(Rule):
+    """Composed topology: bench.py exports the ``bigworld`` JSON block
+    (placements/s, per-host bytes/flush, snapshot catch-up seconds for
+    the million-node world driven by fan-out followers heading pod
+    meshes) — the per-round proof that the composed follower × pod
+    stack holds at world scale."""
+
+    name = "bigworld-export"
+    description = "bench.py exports the bigworld block"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        path = ctx.path("bench")
+        if '"bigworld"' not in ctx.source(path):
+            return [
+                Finding(
+                    self.name, path, 0,
+                    "bench.py no longer exports the bigworld JSON "
+                    "block (placements/s, per-host bytes/flush, "
+                    "snapshot catch-up on the fan-out × pod composed "
+                    "topology)",
+                )
+            ]
+        return []
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "bench",
+            old='"bigworld"',
+            new='"renamed_bigworld"',
+        )
+
+
 MIGRATED_RULES = (
     "stage-observed",
     "stage-orphans",
