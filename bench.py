@@ -1029,6 +1029,7 @@ WITH_EXPLAIN_OVERHEAD = (
 )
 WITH_DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
 WITH_STORM = os.environ.get("BENCH_STORM", "1") == "1"
+WITH_POLICY = os.environ.get("BENCH_POLICY", "1") == "1"
 WITH_SWARM = os.environ.get("BENCH_SWARM", "1") == "1"
 WITH_CLUSTER_FANOUT = (
     os.environ.get("BENCH_CLUSTER_FANOUT", "1") == "1"
@@ -1311,6 +1312,329 @@ def bench_storm():
         # quantified face of the relaxed serial equivalence
         "placement_quality_delta": round(score_on - score_off, 4),
         "zero_lost": lost_on == 0 and lost_off == 0,
+    }
+
+
+def bench_policy():
+    """Policy-weighted scoring A/B (sched/policy.py fused into the
+    score kernel).  Three sub-measurements:
+
+    1. **kernel overhead** — the jitted single-select kernel with
+       identity weights (throughput 1.0 on every node: present, fused,
+       ranking-neutral) vs policy-off, same arena; acceptance is <3%
+       added kernel time for the fused terms.
+    2. **heterogeneity-aware throughput** — a mixed-node-class world
+       (1/3 "fast", 2/3 "slow"), jobs carrying a Gavel-style
+       throughput-by-class table, A/B'd NOMAD_TPU_POLICY=1 vs =0:
+       placements/s both modes plus the share of placements landing
+       on fast nodes (policy-off ~ the fast fraction; policy-on
+       should go to ~1.0 while capacity lasts).
+    3. **migration cost on a mass replan** — every job destructively
+       updated at once (the drain/replan shape), A/B'd on/off: the
+       count of replacement allocs that left their incumbent node.
+       Stickiness must cut migrations at equal-or-better aggregate
+       normalized score."""
+    import jax
+
+    from nomad_tpu.ops.score import (
+        PolicyTerms,
+        ScoreInputs,
+        score_and_select_packed,
+    )
+    from nomad_tpu.structs import PolicySpec
+
+    C = int(os.environ.get("BENCH_POLICY_C", 4096))
+    k_reps = int(os.environ.get("BENCH_POLICY_KERNEL_REPS", 300))
+    n_nodes = int(os.environ.get("BENCH_POLICY_NODES", 300))
+    n_jobs = int(os.environ.get("BENCH_POLICY_JOBS", 64))
+
+    # -- 1. kernel-time overhead with identity weights ---------------
+    def _mk_inputs(dtype):
+        rng = np.random.default_rng(11)
+        base = ScoreInputs(
+            cpu_total=np.full(C, 4000.0, dtype),
+            mem_total=np.full(C, 8192.0, dtype),
+            disk_total=np.full(C, 98304.0, dtype),
+            cpu_used=rng.uniform(0, 2000, C).astype(dtype),
+            mem_used=rng.uniform(0, 4096, C).astype(dtype),
+            disk_used=np.zeros(C, dtype),
+            feasible=np.ones(C, dtype=bool),
+            collisions=np.zeros(C, dtype=np.int32),
+            penalty=np.zeros(C, dtype=bool),
+            affinity_score=np.zeros(C, dtype),
+            spread_boost=np.zeros(C, dtype),
+            perm=np.arange(C, dtype=np.int32),
+            ask_cpu=np.asarray(500.0, dtype),
+            ask_mem=np.asarray(1024.0, dtype),
+            ask_disk=np.asarray(300.0, dtype),
+            desired_count=np.asarray(1, np.int32),
+            limit=np.asarray(2**31 - 1, np.int32),
+            n_candidates=np.asarray(C, np.int32),
+        )
+        identity = base._replace(
+            # identity weights, the hot single-select shape: a
+            # pre-scaled all-ones throughput term, no migration group
+            # (None group = absent pytree leaf, exactly what tpu_stack
+            # stages when the TG has no live allocs)
+            policy=PolicyTerms(
+                tput_term=np.ones(C, dtype),
+                has_tput=np.asarray(1.0, dtype),
+                mig_term=None,
+            )
+        )
+        return base, identity
+
+    def measure(dtype):
+        base, identity = _mk_inputs(dtype)
+
+        def time_block(inp):
+            t0 = time.perf_counter()
+            for _ in range(k_reps):
+                out = score_and_select_packed(inp)
+            jax.block_until_ready(out)
+            return time.perf_counter() - t0
+
+        # interleaved min-of-rounds: alternating off/on blocks and
+        # taking each side's floor cancels machine drift between the
+        # two measurements (sequential blocks read CPU frequency/noise
+        # drift as kernel overhead)
+        score_and_select_packed(base).block_until_ready()  # compile
+        score_and_select_packed(identity).block_until_ready()
+        t_off = t_on = None
+        for _ in range(8):
+            d_off = time_block(base)
+            d_on = time_block(identity)
+            t_off = d_off if t_off is None else min(t_off, d_off)
+            t_on = d_on if t_on is None else min(t_on, d_on)
+        pct = round(100.0 * (t_on - t_off) / t_off, 2)
+        log(
+            f"policy kernel {np.dtype(dtype).name}: "
+            f"off={t_off * 1e3 / k_reps:.3f}ms "
+            f"on={t_on * 1e3 / k_reps:.3f}ms per select ({pct:+.2f}%)"
+        )
+        return pct
+
+    # the acceptance metric runs at f32 — the accelerator dtype the
+    # production select path compiles at (the f64 build exists for the
+    # CPU bit-parity harness and is reported alongside for reference)
+    kernel_overhead_pct = measure(np.float32)
+    kernel_overhead_pct_f64 = measure(np.float64)
+
+    # -- shared e2e scaffolding --------------------------------------
+    def mk_nodes(tag):
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"pol-{tag}-node-{i:04d}")
+            n.node_class = "fast" if i % 3 == 0 else "slow"
+            n.node_resources.cpu = 8000
+            n.node_resources.memory_mb = 16384
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    def run_world(policy_on, tag, migration):
+        saved = os.environ.get("NOMAD_TPU_POLICY")
+        os.environ["NOMAD_TPU_POLICY"] = "1" if policy_on else "0"
+        server = None
+        try:
+            server = _mk_server(True)
+            nodes = mk_nodes(tag)
+            for node in nodes:
+                server.store.upsert_node(node)
+            class_of = {n.id: n.node_class for n in nodes}
+
+            def mk_job(i, env_v):
+                job = mock.job(id=f"pol-{tag}-job-{i:03d}")
+                job.type = "service"
+                job.task_groups[0].count = 1
+                job.task_groups[0].tasks[0].resources.cpu = 1500
+                job.task_groups[0].tasks[
+                    0
+                ].resources.memory_mb = 3072
+                job.task_groups[0].tasks[0].env = {"V": env_v}
+                job.policy = PolicySpec(
+                    throughput=(
+                        {} if migration
+                        else {"fast": 2.0, "slow": 1.0}
+                    ),
+                    migration_coefficient=(
+                        0.5 if migration else 0.0
+                    ),
+                )
+                return job
+
+            jobs = [mk_job(i, "1") for i in range(n_jobs)]
+            t0 = time.time()
+            for job in jobs:
+                server.register_job(job)
+            server.start()
+            server.drain_to_idle(timeout=300.0)
+            dt1 = time.time() - t0
+            if migration:
+                # filler load that binpack-TIES the incumbent at
+                # replan time: each filler alloc parks one node at
+                # exactly the incumbent's discounted utilization, so
+                # a policy-off replacement sees dozens of
+                # equal-scoring hosts and scatters on the tie-break
+                # shuffle; the migration penalty breaks the same tie
+                # toward the incumbent at an identical winning
+                # binpack score (equal aggregate, fewer moves)
+                filler = mock.job(id=f"pol-{tag}-filler")
+                filler.type = "service"
+                filler.task_groups[0].count = n_jobs
+                # (6000cpu, 12288mb, 1200disk) == a packed incumbent
+                # (5 x 1500/3072/300) minus the replanned alloc's own
+                # discount — every fit dimension ties exactly
+                filler.task_groups[0].tasks[0].resources.cpu = 6000
+                filler.task_groups[0].tasks[
+                    0
+                ].resources.memory_mb = 12288
+                filler.task_groups[0].ephemeral_disk.size_mb = 1200
+                server.register_job(filler)
+                server.drain_to_idle(timeout=300.0)
+                # scale-up wave: as many fresh nodes again join
+                # before the replan.  The serial walk's power-of-two-
+                # choices window is a seeded shuffle over the
+                # candidate list, so the grown list shifts the window
+                # off the incumbents — the policy-off replan can no
+                # longer see them and churns, while the weighted path
+                # (unlimited walk + reschedule penalty) holds every
+                # alloc in place at an equal-or-better binpack score
+                extra = []
+                for i in range(n_nodes):
+                    node = mock.node(id=f"pol-{tag}-new-{i:04d}")
+                    node.node_class = "slow"
+                    node.node_resources.cpu = 8000
+                    node.node_resources.memory_mb = 16384
+                    extra.append(node)
+                _share_classes(extra)
+                for node in extra:
+                    server.store.upsert_node(node)
+
+            def live_nodes():
+                # desired_status filter: a destructive update leaves
+                # the predecessor non-terminal but desired=stop
+                out = {}
+                for job in jobs:
+                    for a in server.store.allocs_by_job(
+                        "default", job.id
+                    ):
+                        if (
+                            a.desired_status == "run"
+                            and not a.terminal_status()
+                        ):
+                            out[job.id] = a.node_id
+                return out
+
+            def score_sum():
+                total = 0.0
+                for job in jobs:
+                    for a in server.store.allocs_by_job(
+                        "default", job.id
+                    ):
+                        if (
+                            a.desired_status != "run"
+                            or a.terminal_status()
+                            or a.metrics is None
+                        ):
+                            continue
+                        # the binpack component is the packing-
+                        # quality objective present under BOTH knob
+                        # settings (normalized-score folds the policy
+                        # terms in, so it isn't mode-comparable)
+                        for sm in a.metrics.score_meta:
+                            if sm.node_id == a.node_id:
+                                total += sm.scores.get(
+                                    "binpack", sm.norm_score
+                                )
+                                break
+                return total
+
+            before = live_nodes()
+            placed = len(before)
+            fast_share = (
+                sum(
+                    1 for nid in before.values()
+                    if class_of.get(nid) == "fast"
+                ) / placed
+                if placed
+                else 0.0
+            )
+            migrations = None
+            dt2 = 0.0
+            if migration:
+                # mass replan: every job destructively updated in one
+                # wave (env change -> replacement placements)
+                t0 = time.time()
+                for i in range(n_jobs):
+                    server.register_job(mk_job(i, "2"))
+                server.drain_to_idle(timeout=300.0)
+                dt2 = time.time() - t0
+                after = live_nodes()
+                migrations = sum(
+                    1
+                    for jid, nid in after.items()
+                    if before.get(jid) not in (None, nid)
+                )
+            rate = placed / dt1 if dt1 else 0.0
+            result = {
+                "placed": placed,
+                "placements_per_s": round(rate, 1),
+                "fast_share": round(fast_share, 3),
+                "migrations": migrations,
+                "replan_s": round(dt2, 2),
+                "score_sum": round(score_sum(), 4),
+            }
+            log(
+                f"policy {tag} mode="
+                f"{'on' if policy_on else 'off'}: {result}"
+            )
+            return result
+        finally:
+            if server is not None:
+                server.stop()
+            if saved is None:
+                os.environ.pop("NOMAD_TPU_POLICY", None)
+            else:
+                os.environ["NOMAD_TPU_POLICY"] = saved
+
+    # -- 2. heterogeneity-aware throughput A/B -----------------------
+    tput_on = run_world(True, "tput-on", migration=False)
+    tput_off = run_world(False, "tput-off", migration=False)
+    # -- 3. migration-cost-aware mass replan A/B ---------------------
+    mig_on = run_world(True, "mig-on", migration=True)
+    mig_off = run_world(False, "mig-off", migration=True)
+
+    return {
+        "kernel_overhead_pct": kernel_overhead_pct,
+        "kernel_overhead_pct_f64": kernel_overhead_pct_f64,
+        "kernel_overhead_ok": kernel_overhead_pct < 3.0,
+        "throughput": {
+            "on": tput_on,
+            "off": tput_off,
+            # fast-node capture: policy-on must beat the off-mode
+            # (~fast-fraction) share
+            "fast_share_gain": round(
+                tput_on["fast_share"] - tput_off["fast_share"], 3
+            ),
+        },
+        "migration": {
+            "on": mig_on,
+            "off": mig_off,
+            "migrations_avoided": (
+                (mig_off["migrations"] or 0)
+                - (mig_on["migrations"] or 0)
+            ),
+            # the acceptance pair: fewer migrations at equal-or-
+            # better aggregate normalized score
+            "fewer_migrations": (
+                (mig_on["migrations"] or 0)
+                <= (mig_off["migrations"] or 0)
+            ),
+            "score_delta": round(
+                mig_on["score_sum"] - mig_off["score_sum"], 4
+            ),
+        },
     }
 
 
@@ -1739,6 +2063,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"storm scenario FAILED: {exc!r}")
             storm = {"error": repr(exc)}
+    policy = {}
+    if WITH_POLICY:
+        try:
+            policy = bench_policy()
+        except Exception as exc:  # noqa: BLE001
+            log(f"policy scenario FAILED: {exc!r}")
+            policy = {"error": repr(exc)}
     device = {}
     if WITH_DEVICE:
         try:
@@ -1847,6 +2178,11 @@ def main():
                 # A/B'd storm-on vs storm-off (placements/s, solver
                 # rounds, fallbacks, quality delta, zero-lost proof)
                 "storm": storm,
+                # policy-weighted scoring: fused-kernel overhead with
+                # identity weights (<3% gate), heterogeneous-class
+                # throughput capture A/B, and mass-replan migration
+                # count A/B at equal-or-better aggregate score
+                "policy": policy,
                 # sharded hot-path proof: placements/s, per-device
                 # HLO FLOPs, and host->device bytes/flush (delta vs
                 # full) vs device count on the node-axis mesh

@@ -651,6 +651,40 @@ def job_from_dict(raw: Dict) -> Job:
         for tg in job.task_groups:
             if tg.update is None:
                 tg.update = job.update
+    pol = _get(raw, "policy", "Policy")
+    if pol:
+        from ..structs import PolicySpec
+
+        job.policy = PolicySpec(
+            throughput={
+                str(k): float(v)
+                for k, v in (
+                    _get(pol, "throughput", "Throughput", default={})
+                    or {}
+                ).items()
+            },
+            throughput_coefficient=float(
+                _get(
+                    pol,
+                    "throughput_coefficient",
+                    "ThroughputCoefficient",
+                    default=1.0,
+                )
+            ),
+            migration_coefficient=float(
+                _get(
+                    pol,
+                    "migration_coefficient",
+                    "MigrationCoefficient",
+                    default=0.0,
+                )
+            ),
+            min_runtime_s=float(
+                _get(
+                    pol, "min_runtime_s", "MinRuntimeS", default=0.0
+                )
+            ),
+        )
     per = _get(raw, "periodic", "Periodic")
     if per:
         job.periodic = Periodic(

@@ -87,6 +87,27 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "cap on storm auction rounds (0 = auto: the padded row "
         "bucket, the solver's convergence bound)",
     ),
+    # -- policy-weighted scoring (sched/policy.py) --------------------
+    "NOMAD_TPU_POLICY": EnvKnob(
+        "1", "nomad_tpu/sched/policy.py",
+        "0 disables the policy-weighted scoring layer (jobs carrying "
+        "a policy stanza score as policy-less)",
+    ),
+    "NOMAD_TPU_POLICY_TPUT_COEF": EnvKnob(
+        "", "nomad_tpu/sched/policy.py",
+        "operator override for every job's throughput coefficient "
+        "(unset = per-job spec value)",
+    ),
+    "NOMAD_TPU_POLICY_MIG_COEF": EnvKnob(
+        "", "nomad_tpu/sched/policy.py",
+        "operator override for every job's migration stickiness "
+        "coefficient (unset = per-job spec value)",
+    ),
+    "NOMAD_TPU_POLICY_CACHE": EnvKnob(
+        "64", "nomad_tpu/sched/policy.py",
+        "LRU capacity of the assembled throughput-tensor cache "
+        "(keyed by table epoch / job version / topo generation)",
+    ),
     # -- multi-host mesh (nomad_tpu/parallel/mesh.py) -----------------
     "NOMAD_TPU_DIST": EnvKnob(
         "0", "nomad_tpu/parallel/mesh.py",

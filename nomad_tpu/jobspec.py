@@ -492,6 +492,23 @@ def parse(text: str) -> Job:
             "meta_required": par.get("meta_required", []) or [],
             "meta_optional": par.get("meta_optional", []) or [],
         }
+    pol = _first(body.get("policy"))
+    if pol:
+        job_dict["policy"] = {
+            "throughput": {
+                str(k): float(v)
+                for k, v in (
+                    _first(pol.get("throughput"), {}) or {}
+                ).items()
+            },
+            "throughput_coefficient": float(
+                pol.get("throughput_coefficient", 1.0)
+            ),
+            "migration_coefficient": float(
+                pol.get("migration_coefficient", 0.0)
+            ),
+            "min_runtime_s": _duration_s(pol.get("min_runtime"), 0.0),
+        }
     mr = _first(body.get("multiregion"))
     if mr:
         strat = _first(mr.get("strategy"), {}) or {}

@@ -151,7 +151,7 @@ def _chain_args(e: int, c: int) -> Tuple[tuple, dict]:
 
 
 def _storm_args(
-    e: int, a: int, c: int = _C
+    e: int, a: int, c: int = _C, weighted: bool = False
 ) -> Tuple[tuple, dict]:
     from .solve import StormInputs
 
@@ -170,6 +170,13 @@ def _storm_args(
         pre_cpu=_sds((c,), F),
         pre_mem=_sds((c,), F),
         pre_disk=_sds((c,), F),
+        # the policy-weighted variant adds three leaves — pre-scaled
+        # term rows plus the append-count flag (sched/policy staging);
+        # the unweighted pytree keeps them None — absent leaves, so
+        # the base ladder's signatures are untouched
+        policy_tput_term=_sds((e, c), F) if weighted else None,
+        policy_has_tput=_sds((e,), F) if weighted else None,
+        policy_mig_term=_sds((e, c), F) if weighted else None,
     )
     return (inp, _cols(c)), dict(
         spread_fit=False, max_rounds=a
@@ -200,6 +207,20 @@ def iter_contracts() -> List[KernelContract]:
         name="storm",
         kernel=_storm_kernel,
         ladder=[_storm_args(e, a) for e, a in STORM_LADDER],
+        out_dtypes=frozenset({"int32", "float32", "bool"}),
+    )
+    # the policy-weighted storm variant: a weighted storm carries
+    # three extra pytree leaves (policy_* — sched/storm staging), so
+    # every (E, A) rung forks ONE additional declared signature; a
+    # policy-less storm stays bit-on the base storm ladder (None
+    # fields contribute no leaves)
+    storm_weighted = KernelContract(
+        name="storm_weighted",
+        kernel=_storm_kernel,
+        ladder=[
+            _storm_args(e, a, weighted=True)
+            for e, a in STORM_LADDER
+        ],
         out_dtypes=frozenset({"int32", "float32", "bool"}),
     )
     # the mesh ladder: each node-axis width w runs the chained
@@ -278,7 +299,7 @@ def iter_contracts() -> List[KernelContract]:
         out_dtypes=frozenset({"int32", "float32", "bool"}),
     )
     return [
-        chunk, storm, mesh, mesh_host, storm_mesh,
+        chunk, storm, storm_weighted, mesh, mesh_host, storm_mesh,
         mesh_fanout, storm_fanout,
     ]
 

@@ -22,7 +22,7 @@ cluster churn; vacant rows are masked.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +31,39 @@ import numpy as np
 MAX_SKIP = 3  # (reference stack.go:17)
 SKIP_THRESHOLD = 0.0  # (reference stack.go:13)
 NO_NODE = -1
+
+
+class PolicyTerms(NamedTuple):
+    """Optional policy terms fused into the score pass (Gavel-style
+    heterogeneity throughput + migration stickiness), PRE-SCALED by
+    their coefficients host-side (one numpy mul at assembly — f64
+    multiplication is deterministic, so host and device scaling are
+    bit-identical and the kernel saves the per-candidate ops).  Shapes
+    follow the ScoreInputs they ride in: per-node vectors broadcast
+    exactly like `feasible` ([C] for a single select, [A, C] after the
+    storm solver's per-row gather), flags like `desired_count` ([] or
+    [A, 1]).
+
+    Each term group is independently optional: a None group is absent
+    from the pytree, so a throughput-only job (the common identity-
+    weights shape) pays ONE vector add plus a scalar count bump and a
+    migration-only job pays only the penalty ops.  Single selects drop
+    whichever group is inert; storms keep both groups dense (all-zero
+    rows for policy-less evals are float-exact no-ops) so one compiled
+    signature covers every mixed storm.
+
+    `tput_term` is `tput_coef * tput_norm[node]`, appended for EVERY
+    candidate when present (zeros included — an unknown node class
+    pulls the mean down exactly like the serial oracle); `has_tput` is
+    its 0/1 append-count flag (per-eval in storms).  `mig_term` is
+    `mig_coef * mig[node]` where mig is -1 on every node EXCEPT those
+    currently hosting this TG's live allocs; it appends only where
+    non-zero (node-reschedule-penalty convention — the incumbent's
+    score mean stays untouched, movers are dragged down)."""
+
+    tput_term: Optional[jnp.ndarray]  # f[C] coef * normalized tput
+    has_tput: Optional[jnp.ndarray]  # f 0/1 flag, paired with tput_term
+    mig_term: Optional[jnp.ndarray]  # f[C] coef * (-1 off-host, 0 on)
 
 
 def _pow10(x, dtype):
@@ -65,6 +98,13 @@ class ScoreInputs(NamedTuple):
     desired_count: jnp.ndarray  # i32 scalar (tg.count)
     limit: jnp.ndarray  # i32 scalar (visit limit; INT32_MAX = unlimited)
     n_candidates: jnp.ndarray  # i32 scalar
+    # policy-weighted scoring: absent (None) for policy-less jobs.  A
+    # None NamedTuple field contributes no pytree leaves, so the
+    # policy-off kernel keeps today's compiled signatures AND traces
+    # the bit-identical computation (the fused terms below are guarded
+    # by a trace-time `is not None`); a present PolicyTerms forks one
+    # new pinned signature per ladder rung (ops/contracts.py).
+    policy: Optional[PolicyTerms] = None
 
 
 def _score_vectors(inp: ScoreInputs, spread_fit: bool):
@@ -119,6 +159,25 @@ def _score_vectors(inp: ScoreInputs, spread_fit: bool):
     has_spread = inp.spread_boost != 0.0
     score_sum = score_sum + jnp.where(has_spread, inp.spread_boost, 0.0)
     count = count + has_spread.astype(dtype)
+
+    # policy-weighted terms append LAST so the serial oracle's
+    # left-to-right float-sum order is preserved (PolicyIterator sits
+    # after SpreadIterator in the chain).  Trace-time guard: with
+    # policy=None this block vanishes and the kernel is bit-identical
+    # to the policy-less build.
+    if inp.policy is not None:
+        pol = inp.policy
+        # terms arrive pre-scaled (PolicyTerms docstring), so each
+        # present group is one add into the running sum: the term is
+        # already 0 wherever it must not append (a zero add is exact —
+        # score_sum is never -0.0, and np.zeros stages +0.0), so only
+        # the count needs a flag/predicate
+        if pol.tput_term is not None:
+            score_sum = score_sum + pol.tput_term
+            count = count + pol.has_tput
+        if pol.mig_term is not None:
+            score_sum = score_sum + pol.mig_term
+            count = count + (pol.mig_term != 0.0).astype(dtype)
 
     final = score_sum / count
     return feasible, final

@@ -46,13 +46,19 @@ round and assignment score.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .score import NO_NODE, ScoreInputs, _limited_walk_argmax, _score_vectors
+from .score import (
+    NO_NODE,
+    PolicyTerms,
+    ScoreInputs,
+    _limited_walk_argmax,
+    _score_vectors,
+)
 
 # per-acceptance price increment: enough to tie-break repeated
 # contention (scores live in roughly [-1, 1]) without distorting the
@@ -89,6 +95,16 @@ class StormInputs(NamedTuple):
     pre_cpu: jnp.ndarray  # f[C] staged pre-placement usage deltas
     pre_mem: jnp.ndarray  # f[C]
     pre_disk: jnp.ndarray  # f[C]
+    # policy-weighted scoring (sched/policy.py): absent (None) for
+    # unweighted storms — None fields contribute no pytree leaves, so
+    # the unweighted solve keeps today's compiled signatures and
+    # traces bit-identically.  A weighted storm stages PRE-SCALED
+    # per-eval term rows (ops/score.py PolicyTerms); policy-less evals
+    # in a mixed storm carry all-zero rows, which add float-exactly
+    # nothing, so ONE compiled signature covers every mix.
+    policy_tput_term: Optional[jnp.ndarray] = None  # f[E, C] coef*tput
+    policy_has_tput: Optional[jnp.ndarray] = None  # f[E] 0/1 flag
+    policy_mig_term: Optional[jnp.ndarray] = None  # f[E, C] coef*mig
 
 
 @functools.partial(
@@ -142,6 +158,15 @@ def storm_assignment(
         desired_count=inp.desired[:, None],
         limit=inp.limit[eo],
         n_candidates=inp.n_cand[eo],
+        policy=(
+            None
+            if inp.policy_tput_term is None
+            else PolicyTerms(
+                tput_term=inp.policy_tput_term[eo],
+                has_tput=inp.policy_has_tput[eo][:, None],
+                mig_term=inp.policy_mig_term[eo],
+            )
+        ),
     )
     feas, scores = _score_vectors(si, spread_fit)
     feas = feas & inp.real[:, None]
@@ -290,14 +315,16 @@ def storm_assignment(
 _storm_sharded_cache: dict = {}
 
 
-def storm_in_specs() -> "StormInputs":
+def storm_in_specs(weighted: bool = False) -> "StormInputs":
     """The node-sharded solve's `StormInputs` PartitionSpecs — the
     ONE definition shared by `storm_assignment_sharded` (shard_map
     in_specs) and `sched/storm.py stage_for_mesh` (host staging), so
     placement and program can never drift (same contract as
     `parallel/mesh.py chain_in_specs` for the chained runner):
     node-indexed leaves shard `P('nodes')`, per-eval / per-row
-    leaves replicate."""
+    leaves replicate.  ``weighted`` mirrors the input layout: the
+    policy leaves stay None (no pytree leaves) for unweighted storms
+    and shard like their siblings when staged."""
     from jax.sharding import PartitionSpec as P
 
     node2 = P(None, "nodes")
@@ -318,11 +345,14 @@ def storm_in_specs() -> "StormInputs":
         pre_cpu=col,
         pre_mem=col,
         pre_disk=col,
+        policy_tput_term=node2 if weighted else None,
+        policy_has_tput=rep if weighted else None,
+        policy_mig_term=node2 if weighted else None,
     )
 
 
 def storm_assignment_sharded(
-    mesh, spread_fit: bool, max_rounds: int
+    mesh, spread_fit: bool, max_rounds: int, weighted: bool = False
 ):
     """Node-sharded twin of `storm_assignment` for the (multi-host)
     mesh: BIT-IDENTICAL in every output — assignments, pulls,
@@ -362,7 +392,7 @@ def storm_assignment_sharded(
     sharded usage-mirror columns feed ``cols`` directly.  Requires
     the arena capacity to tile evenly over the mesh (the caller's
     ``mesh_capable`` gate)."""
-    key = (mesh, bool(spread_fit), int(max_rounds))
+    key = (mesh, bool(spread_fit), int(max_rounds), bool(weighted))
     fn = _storm_sharded_cache.get(key)
     if fn is not None:
         return fn
@@ -370,7 +400,7 @@ def storm_assignment_sharded(
 
     from ..parallel.mesh import shard_map
 
-    in_specs = (storm_in_specs(), (P("nodes"),) * 6)
+    in_specs = (storm_in_specs(weighted), (P("nodes"),) * 6)
     out_specs = (P(),) * 6
 
     def _run(inp: StormInputs, cols):
@@ -406,6 +436,16 @@ def storm_assignment_sharded(
             desired_count=inp.desired[:, None],
             limit=inp.limit[eo],
             n_candidates=inp.n_cand[eo],
+            policy=(
+                None
+                if inp.policy_tput_term is None
+                else PolicyTerms(
+                    # local node shard, same gather as feasible
+                    tput_term=inp.policy_tput_term[eo],
+                    has_tput=inp.policy_has_tput[eo][:, None],
+                    mig_term=inp.policy_mig_term[eo],
+                )
+            ),
         )
         feas_l, scores_l = _score_vectors(si, spread_fit)
         feas_l = feas_l & inp.real[:, None]

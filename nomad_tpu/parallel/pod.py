@@ -332,15 +332,17 @@ class PodPeer:
         from ..sched.storm import stage_for_mesh
 
         assert self.mirror is not None, "storm before mirror sync"
-        key = (spread_fit, max_rounds)
+        inputs = StormInputs(*inputs_host)
+        weighted = inputs.policy_tput_term is not None
+        key = (spread_fit, max_rounds, weighted)
         fn = self._storm_fns.get(key)
         if fn is None:
             fn = storm_assignment_sharded(
                 self.mesh, spread_fit=spread_fit,
-                max_rounds=max_rounds,
+                max_rounds=max_rounds, weighted=weighted,
             )
             self._storm_fns[key] = fn
-        inp = stage_for_mesh(StormInputs(*inputs_host), self.mesh)
+        inp = stage_for_mesh(inputs, self.mesh)
         out = fn(inp, self.mirror)
         if self.check:
             return result_digest(*out)

@@ -495,6 +495,84 @@ class ScoreNormalizationIterator:
         self.source.reset()
 
 
+class PolicyIterator:
+    """Policy-weighted scoring, the serial oracle half (sched/policy.py
+    holds the shared resolution/assembly; ops/score.py the fused kernel
+    terms).  Sits between SpreadIterator and PreemptionScoringIterator
+    so the policy terms append LAST among the soft scores — the same
+    left-to-right float-sum position the kernel fuses them at.
+
+    Append conventions mirror the kernel bit-for-bit: the throughput
+    term appends for EVERY node when the policy carries a throughput
+    table (zeros included — binpack convention); the migration term is
+    a penalty on non-incumbent nodes, appended only where non-zero
+    (node-reschedule-penalty convention, recorded as 0 elsewhere like
+    job-anti-affinity)."""
+
+    def __init__(self, ctx: EvalContext, source) -> None:
+        self.ctx = ctx
+        self.source = source
+        self.job: Optional[Job] = None
+        self.resolved = None
+        self.tg_name = ""
+        self.sticky: set = set()
+
+    def set_job(self, job: Job) -> None:
+        from .policy import resolve
+
+        self.job = job
+        self.resolved = resolve(job)
+
+    def set_task_group(self, tg: TaskGroup) -> None:
+        from .policy import sticky_node_ids
+
+        self.tg_name = tg.name
+        if self.resolved is not None:
+            self.sticky = sticky_node_ids(
+                self.resolved, self.job, tg.name, self.ctx.state
+            )
+        else:
+            self.sticky = set()
+
+    def has_policy(self) -> bool:
+        return self.resolved is not None
+
+    def next(self) -> Optional[RankedNode]:
+        option = self.source.next()
+        if option is None or self.resolved is None:
+            return option
+        pol = self.resolved
+        if pol.has_tput:
+            value = pol.tput_coef * pol.tput_value(
+                option.node.node_class
+            )
+            option.scores.append(value)
+            self.ctx.metrics.score_node(
+                option.node, "policy.throughput", value
+            )
+        # penalty shape (see policy.migration_vector): non-incumbent
+        # nodes pay -coef, the incumbent's mean stays untouched; inert
+        # when the TG has no live allocs
+        mig = 0.0
+        if self.sticky:
+            mig = pol.mig_coef * (
+                0.0 if option.node.id in self.sticky else -1.0
+            )
+        if mig != 0.0:
+            option.scores.append(mig)
+            self.ctx.metrics.score_node(
+                option.node, "policy.migration", mig
+            )
+        elif pol.mig_coef != 0.0:
+            self.ctx.metrics.score_node(
+                option.node, "policy.migration", 0
+            )
+        return option
+
+    def reset(self) -> None:
+        self.source.reset()
+
+
 class PreemptionScoringIterator:
     """Logistic net-priority score when the placement would preempt
     (reference rank.go:714)."""

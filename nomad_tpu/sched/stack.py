@@ -38,6 +38,7 @@ from .rank import (
     JobAntiAffinityIterator,
     NodeAffinityIterator,
     NodeReschedulingPenaltyIterator,
+    PolicyIterator,
     PreemptionScoringIterator,
     RankedNode,
     ScoreNormalizationIterator,
@@ -131,7 +132,11 @@ class GenericStack:
             ctx, self.node_rescheduling_penalty
         )
         self.spread = SpreadIterator(ctx, self.node_affinity)
-        preemption_scorer = PreemptionScoringIterator(ctx, self.spread)
+        # policy-weighted scoring appends AFTER spread so the terms
+        # land last in the left-to-right float sum, matching the
+        # kernel's fusion point (ops/score.py PolicyTerms)
+        self.policy = PolicyIterator(ctx, self.spread)
+        preemption_scorer = PreemptionScoringIterator(ctx, self.policy)
         self.score_norm = ScoreNormalizationIterator(ctx, preemption_scorer)
         self.limit = LimitIterator(
             ctx, self.score_norm, 2, SKIP_SCORE_THRESHOLD, MAX_SKIP
@@ -156,6 +161,7 @@ class GenericStack:
         self.job_anti_aff.set_job(job)
         self.node_affinity.set_job(job)
         self.spread.set_job(job)
+        self.policy.set_job(job)
         self.ctx.eligibility.set_job(job)
 
     def select(
@@ -199,8 +205,17 @@ class GenericStack:
         self.job_anti_aff.set_task_group(tg)
         self.node_affinity.set_task_group(tg)
         self.spread.set_task_group(tg)
+        self.policy.set_task_group(tg)
 
-        if self.node_affinity.has_affinities() or self.spread.has_spreads():
+        # policy joins affinity/spread in the "scoring is not purely
+        # random" unlimited-walk rule: weighted scores must survey the
+        # whole candidate set (tpu_stack and storm staging apply the
+        # same rule so the kernel walk stays bit-identical)
+        if (
+            self.node_affinity.has_affinities()
+            or self.spread.has_spreads()
+            or self.policy.has_policy()
+        ):
             self.limit.set_limit(2**31 - 1)
 
         return self.max_score.next()

@@ -130,6 +130,13 @@ def build_storm_problem(
     from ..ops.batch import pow2_bucket
     from ..ops.solve import StormInputs, pad_axis
     from ..raft import chaos as _chaos
+    from ..trace import TRACE
+    from .policy import (
+        migration_vector,
+        resolve,
+        sticky_node_ids,
+        tput_tensor,
+    )
 
     # chaos seam: deterministic revoke-while-staging races (no-op
     # unless a test armed the hook)
@@ -145,6 +152,16 @@ def build_storm_problem(
     perm_e: List[np.ndarray] = []
     limit_e: List[int] = []
     ncand_e: List[int] = []
+    # policy-weighted rows (sched/policy.py): PRE-SCALED term rows
+    # (ops/score.py PolicyTerms) staged per eval so a mixed storm
+    # fuses weighted and unweighted members into ONE solve —
+    # policy-less evals carry all-zero rows, which add float-exactly
+    # nothing
+    pol_tput_e: List[np.ndarray] = []
+    pol_has_e: List[float] = []
+    pol_mig_e: List[np.ndarray] = []
+    any_policy = False
+    metrics = getattr(getattr(worker, "server", None), "metrics", None)
     eval_of: List[int] = []
     ask_rows: List[Tuple[float, float, float]] = []
     desired_rows: List[int] = []
@@ -181,9 +198,46 @@ def build_storm_problem(
             or list(tg.affinities)
             or any(t.affinities for t in tg.tasks)
         )
+        pol = resolve(job)
+        if pol is not None:
+            # same assembly the single-eval vectorized select runs:
+            # cached throughput tensor + live-alloc stickiness vector,
+            # all from replicated state (followers stage identically),
+            # pre-scaled by the coefficients here so the kernel adds
+            # the rows as-is (host f64 muls are bit-identical to the
+            # device muls they replace)
+            with TRACE.span(ev.id, "batch_worker.policy_assemble"):
+                tput_term = (
+                    pol.tput_coef
+                    * tput_tensor(
+                        pol, job, table, dtype=dtype, metrics=metrics
+                    )
+                    if pol.has_tput
+                    else np.zeros(C, dtype=dtype)
+                )
+                sticky = sticky_node_ids(pol, job, tg.name, snap)
+                mig_term = (
+                    pol.mig_coef
+                    * migration_vector(sticky, table, dtype=dtype)
+                    if sticky
+                    else np.zeros(C, dtype=dtype)
+                )
+            any_policy = True
+            if metrics is not None:
+                metrics.incr("policy.storm_evals")
+            pol_tput_e.append(tput_term)
+            pol_has_e.append(1.0 if pol.has_tput else 0.0)
+            pol_mig_e.append(mig_term)
+        else:
+            pol_tput_e.append(np.zeros(C, dtype=dtype))
+            pol_has_e.append(0.0)
+            pol_mig_e.append(np.zeros(C, dtype=dtype))
         limit = (
             _INT32_MAX
-            if has_aff
+            # weighted scoring joins affinity in the unlimited-walk
+            # rule (stack.py select): the survey must cover every
+            # candidate or the kernel/oracle walks diverge
+            if has_aff or pol is not None
             else compute_visit_limit(n_cand, ev.type == "batch")
         )
         e_i = n_evals
@@ -291,6 +345,20 @@ def build_storm_problem(
         pre_cpu=pre_cpu,
         pre_mem=pre_mem,
         pre_disk=pre_disk,
+        # None (not zeros) when no member carries a policy: absent
+        # pytree leaves keep the unweighted solve's compiled
+        # signature, so policy-off storms trace bit-identically
+        policy_tput_term=pad_axis(np.stack(pol_tput_e), E, 0)
+        if any_policy
+        else None,
+        policy_has_tput=pad_axis(
+            np.asarray(pol_has_e, dtype=dtype), E, 0
+        )
+        if any_policy
+        else None,
+        policy_mig_term=pad_axis(np.stack(pol_mig_e), E, 0)
+        if any_policy
+        else None,
     )
     spread_fit = (
         snap.scheduler_config().effective_scheduler_algorithm()
@@ -320,10 +388,13 @@ def stage_for_mesh(inputs, mesh):
     from ..ops.solve import StormInputs, storm_in_specs
     from ..parallel.mesh import mesh_put
 
+    weighted = inputs.policy_tput_term is not None
     return StormInputs(
         *(
-            mesh_put(mesh, np.asarray(leaf), spec)
-            for leaf, spec in zip(inputs, storm_in_specs())
+            None
+            if leaf is None
+            else mesh_put(mesh, np.asarray(leaf), spec)
+            for leaf, spec in zip(inputs, storm_in_specs(weighted))
         )
     )
 

@@ -46,6 +46,7 @@ from ..ops.batch import BatchInputs, plan_picks_full, pow2_bucket
 from ..ops.constraints import MaskCompiler
 from ..ops.score import (
     NO_NODE,
+    PolicyTerms,
     ScoreInputs,
     score_and_select_packed,
 )
@@ -273,6 +274,39 @@ class TPUGenericStack:
             sum(len(v) for v in p.node_preemptions.values()),
         )
 
+    def _policy_state(self, tg: TaskGroup, dtype=np.float64):
+        """The job's resolved policy plus arena-shaped, PRE-SCALED
+        term vectors (sched/policy.py, ops/score.py PolicyTerms):
+        ``(resolved, tput_term[C] | None, mig_term[C] | None)`` or
+        None.  An inert group stays None so the kernel traces only the
+        ops the select needs (the identity-weights hot shape is one
+        vector add).  The throughput tensor is cached keyed by (table
+        epoch, job version, topo generation); the stickiness vector is
+        rebuilt per select from the job's live allocs — O(allocs of
+        this TG), the same replicated state fan-out followers hold."""
+        from .policy import (
+            migration_vector,
+            resolve,
+            sticky_node_ids,
+            tput_tensor,
+        )
+
+        pol = resolve(self.job)
+        if pol is None:
+            return None
+        tput_term = None
+        if pol.has_tput:
+            tput_term = pol.tput_coef * tput_tensor(
+                pol, self.job, self.table, dtype=dtype
+            )
+        sticky = sticky_node_ids(pol, self.job, tg.name, self.ctx.state)
+        mig_term = None
+        if sticky:
+            mig_term = pol.mig_coef * migration_vector(
+                sticky, self.table, dtype=dtype
+            )
+        return pol, tput_term, mig_term
+
     def _lookahead_serve(self, tg: TaskGroup, options):
         """Answer a select from the pre-computed pick cache when the
         scheduler's state advanced exactly as the kernel modelled it:
@@ -411,8 +445,11 @@ class TPUGenericStack:
             or list(tg.affinities)
             or any(t.affinities for t in tg.tasks)
         )
+        policy_state = self._policy_state(tg)
         limit = (
-            INT32_MAX if (has_affinities or has_spreads) else self.limit
+            INT32_MAX
+            if (has_affinities or has_spreads or policy_state is not None)
+            else self.limit
         )
         ask_cpu = float(sum(t.resources.cpu for t in tg.tasks))
         ask_mem = float(sum(t.resources.memory_mb for t in tg.tasks))
@@ -458,6 +495,14 @@ class TPUGenericStack:
         else:
             fitness = np.clip(20.0 - base, 0.0, 18.0)
 
+        # policy term vectors (the serial PolicyIterator sits between
+        # spread and preemption scoring, so these append after spread
+        # and before the preemption term everywhere below)
+        tput_term = mig_term = None
+        pol = None
+        if policy_state is not None:
+            pol, tput_term, mig_term = policy_state
+
         def combine(row, first_terms):
             terms = list(first_terms)
             if collisions[row] > 0:
@@ -470,6 +515,10 @@ class TPUGenericStack:
                 terms.append(float(affinity_vec[row]))
             if spread_vec[row] != 0.0:
                 terms.append(float(spread_vec[row]))
+            if tput_term is not None:
+                terms.append(float(tput_term[row]))
+            if mig_term is not None and mig_term[row] != 0.0:
+                terms.append(float(mig_term[row]))
             return terms
 
         # vectorized mean-combine for fitting nodes (same term order
@@ -496,6 +545,13 @@ class TPUGenericStack:
             + has_aff.astype(np.float64)
             + has_spread.astype(np.float64)
         )
+        if tput_term is not None:
+            sum_v = sum_v + tput_term
+            count_v = count_v + 1.0
+        if mig_term is not None:
+            has_mig = mig_term != 0.0
+            sum_v = sum_v + np.where(has_mig, mig_term, 0.0)
+            count_v = count_v + has_mig.astype(np.float64)
         scores[feasible] = (sum_v / count_v)[feasible]
 
         # preemption evaluation for masked nodes that did NOT fit.
@@ -601,6 +657,7 @@ class TPUGenericStack:
                 preempt_scored={
                     r: float(scores[r]) for r in preempt_options
                 },
+                policy_state=policy_state,
             )
 
         while True:
@@ -715,9 +772,12 @@ class TPUGenericStack:
             or list(tg.affinities)
             or any(t.affinities for t in tg.tasks)
         )
+        policy_state = self._policy_state(tg, dtype)
+        # policy joins affinity/spread in the unlimited-walk rule
+        # (stack.py select: weighted scoring surveys every candidate)
         limit = (
             INT32_MAX
-            if (has_affinities or has_spreads)
+            if (has_affinities or has_spreads or policy_state is not None)
             else self.limit
         )
 
@@ -747,6 +807,7 @@ class TPUGenericStack:
             tg.count > 1
             and n_cand > 1
             and not has_spreads
+            and policy_state is None
             and (options is None or not options.penalty_node_ids)
             and not any(
                 c.operand == CONSTRAINT_DISTINCT_PROPERTY
@@ -799,6 +860,23 @@ class TPUGenericStack:
         used_cpu = self.table.cpu_used + d_cpu
         used_mem = self.table.mem_used + d_mem
         used_disk = self.table.disk_used + d_disk
+        policy_terms = None
+        if policy_state is not None:
+            _pol, tput_term, mig_term = policy_state
+            # both groups inert (armed coefficient, no live allocs
+            # yet): skip the PolicyTerms node entirely so the trace —
+            # and the compiled-signature cache — match policy-off (the
+            # unlimited-walk limit above still applies either way)
+            if tput_term is not None or mig_term is not None:
+                policy_terms = PolicyTerms(
+                    tput_term=tput_term,
+                    has_tput=(
+                        None
+                        if tput_term is None
+                        else np.asarray(1.0, dtype)
+                    ),
+                    mig_term=mig_term,
+                )
         inputs = ScoreInputs(
             cpu_total=self.table.cpu_total,
             mem_total=self.table.mem_total,
@@ -818,6 +896,7 @@ class TPUGenericStack:
             desired_count=np.asarray(tg.count, np.int32),
             limit=np.asarray(limit, np.int32),
             n_candidates=np.asarray(n_cand, np.int32),
+            policy=policy_terms,
         )
         spread_fit = spread_fit_alg
 
@@ -842,6 +921,7 @@ class TPUGenericStack:
                 dp_mask=dp_mask,
                 dp_psets=dp_psets,
                 skip_rows=self._extra_excluded_rows,
+                policy_state=policy_state,
             )
 
         while True:
@@ -987,7 +1067,7 @@ class TPUGenericStack:
         feasible_mask, used, asks, collisions, penalty,
         affinity_vec, spread_vec, has_affinities, has_spreads,
         spread_fit, checks, csi_mask, dh_rows, dp_mask, dp_psets,
-        skip_rows=frozenset(), preempt_scored=None,
+        skip_rows=frozenset(), preempt_scored=None, policy_state=None,
     ) -> None:
         """Reconstruct the serial iterator chain's AllocMetric from
         the arrays this select already computed: the walk's `pulls`
@@ -1037,6 +1117,9 @@ class TPUGenericStack:
         preempt_scored = preempt_scored or {}
         state = self.ctx.state
         desired = float(tg.count)
+        pol = tput_term = mig_term = None
+        if policy_state is not None:
+            pol, tput_term, mig_term = policy_state
         # direct NodeScoreMeta writes via a node-id index:
         # AllocMetric.score_node linearly scans score_meta per call,
         # which goes quadratic when unlimited walks (affinities/
@@ -1067,7 +1150,9 @@ class TPUGenericStack:
                                         penalty, affinity_vec,
                                         spread_vec, has_affinities,
                                         has_spreads, desired,
-                                        terms=None)
+                                        terms=None, pol=pol,
+                                        tput_term=tput_term,
+                                        mig_term=mig_term)
                 meta.scores["normalized-score"] = preempt_scored[r]
                 meta.norm_score = preempt_scored[r]
                 continue
@@ -1079,7 +1164,9 @@ class TPUGenericStack:
                                         penalty, affinity_vec,
                                         spread_vec, has_affinities,
                                         has_spreads, desired,
-                                        terms=terms)
+                                        terms=terms, pol=pol,
+                                        tput_term=tput_term,
+                                        mig_term=mig_term)
                 norm = sum(terms) / float(len(terms))
                 meta.scores["normalized-score"] = norm
                 meta.norm_score = norm
@@ -1103,6 +1190,7 @@ class TPUGenericStack:
     def _record_soft_terms(
         self, scores, r, collisions, penalty, affinity_vec,
         spread_vec, has_affinities, has_spreads, desired, terms,
+        pol=None, tput_term=None, mig_term=None,
     ) -> None:
         """Record the rank chain's soft score components into one
         node's scores dict under the serial iterators' exact
@@ -1137,6 +1225,23 @@ class TPUGenericStack:
             if terms is not None:
                 terms.append(sp)
             scores["allocation-spread"] = sp
+        # policy components mirror rank.py PolicyIterator: throughput
+        # records (and appends) for every node when the table is
+        # present; migration appends only non-zero, records 0 when the
+        # coefficient is armed but this node is not sticky
+        if pol is not None:
+            if tput_term is not None:
+                tv = float(tput_term[r])
+                if terms is not None:
+                    terms.append(tv)
+                scores["policy.throughput"] = tv
+            mv = 0.0 if mig_term is None else float(mig_term[r])
+            if mv != 0.0:
+                if terms is not None:
+                    terms.append(mv)
+                scores["policy.migration"] = mv
+            elif pol.mig_coef != 0.0:
+                scores["policy.migration"] = 0
 
     def _explain_job_status(self, klass: str) -> int:
         """The wrapper's job-level class status, answered from the

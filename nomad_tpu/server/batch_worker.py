@@ -1307,6 +1307,15 @@ class BatchWorker(Worker):
         if metrics is not None:
             metrics.incr(f"storm.{kind}", float(n))
 
+    def _count_policy(self, kind: str) -> None:
+        """Policy-weighted-scoring counters, exported under the
+        `policy.` namespace on /v1/metrics (the family is
+        zero-registered at Server construction from
+        sched/policy.py POLICY_COUNTERS)."""
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.incr(f"policy.{kind}")
+
     def _export_adaptive_gauges(self) -> None:
         """The adaptive-cap inputs as /v1/metrics gauges, so an
         operator can see WHY `_adaptive_cap` picked a gulp size (the
@@ -1740,6 +1749,8 @@ class BatchWorker(Worker):
     def _flush_run(self, run, admit: bool = False) -> None:
         import time as _time
 
+        from ..sched.policy import resolve as _policy_resolve
+
         idx = 0
         while idx < len(run):
             snap = self.store.snapshot()
@@ -1758,6 +1769,16 @@ class BatchWorker(Worker):
             j = idx
             while j < len(run):
                 ev, _token, job = run[j]
+                if job is not None and _policy_resolve(job) is not None:
+                    # the chunk chain's carry does not model policy
+                    # terms; a weighted eval ends the prefix and runs
+                    # the single-eval vectorized select (sequential
+                    # path -> tpu_stack fuses PolicyTerms).  Storms
+                    # stay eligible — build_storm_problem stages
+                    # policy rows into the solve itself.
+                    if j == idx:
+                        self._count_policy("evals")
+                    break
                 try:
                     with TRACE.span(ev.id, "batch_worker.simulate"):
                         sim = self._simulate(snap, ev, job)
@@ -2791,6 +2812,7 @@ class BatchWorker(Worker):
                 mesh,
                 spread_fit=problem.spread_fit,
                 max_rounds=max_rounds,
+                weighted=problem.inputs.policy_tput_term is not None,
             )
             if self._pod is not None:
                 # pod head: the storm inputs are plain host numpy —

@@ -253,6 +253,16 @@ class Server:
         self.metrics.preregister(
             counters=FANOUT_COUNTERS, gauges=FANOUT_GAUGES
         )
+        # policy-weighted scoring: zero-register the policy.* family
+        # (absence-of-series must mean "no policy-weighted select ever
+        # ran" — no job carries a PolicySpec, or NOMAD_TPU_POLICY=0 —
+        # not "not exported").  Registered outside the batch_pipeline
+        # gate: weighted tensor assembly runs in BOTH pipeline modes.
+        from ..sched.policy import POLICY_COUNTERS, POLICY_GAUGES
+
+        self.metrics.preregister(
+            counters=POLICY_COUNTERS, gauges=POLICY_GAUGES
+        )
         if batch_pipeline:
             from .batch_worker import BatchWorker
 

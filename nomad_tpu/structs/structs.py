@@ -348,6 +348,33 @@ class Spread:
     targets: Tuple[SpreadTarget, ...] = ()
 
 
+@dataclass
+class PolicySpec:
+    """Placement-policy weights riding the job: a Gavel-style
+    throughput-by-node-class table (normalized to its max and folded
+    into the score mean for every candidate) and a migration-cost
+    coefficient (a reschedule penalty on every node EXCEPT those
+    currently hosting this TG's live allocs — the incumbent's score
+    mean is untouched, movers are dragged down — so drains and mass
+    replans avoid unnecessary migrations).  Assembled into
+    per-(TG, node) weight tensors by sched/policy.py and fused into
+    the score kernel."""
+
+    # node-class -> relative throughput (any positive scale; the
+    # assembler normalizes by the table max).  Empty = no
+    # heterogeneity term.
+    throughput: Dict[str, float] = field(default_factory=dict)
+    throughput_coefficient: float = 1.0
+    # > 0 enables the migration-cost penalty term
+    migration_coefficient: float = 0.0
+    # only allocs running at least this long mark their node sticky
+    # ("penalize moving LONG-RUNNING allocs"); 0 = all live allocs
+    min_runtime_s: float = 0.0
+
+    def active(self) -> bool:
+        return bool(self.throughput) or self.migration_coefficient != 0.0
+
+
 # ---------------------------------------------------------------------------
 # Node
 # ---------------------------------------------------------------------------
@@ -827,6 +854,9 @@ class Job:
     parent_id: str = ""
     all_at_once: bool = False
     update: Optional[UpdateStrategy] = None
+    # placement-policy weights (heterogeneity throughput + migration
+    # cost) consumed by the score kernel; None = policy-less
+    policy: Optional[PolicySpec] = None
     meta: Dict[str, str] = field(default_factory=dict)
     stop: bool = False
     status: str = JOB_STATUS_PENDING

@@ -87,6 +87,10 @@ SPAN_NAMES = frozenset(
         # member handed back to the serial chain (gate reason /
         # unsolved row / commit rescore) — never a dropped eval
         "batch_worker.storm_gulp",
+        # policy-weighted scoring (sched/policy.py): spans one storm
+        # member's weight-tensor assembly — cached-throughput lookup
+        # plus the live-alloc stickiness scan — inside staging
+        "batch_worker.policy_assemble",
         "batch_worker.storm_solve",
         "batch_worker.storm_decompose",
         "batch_worker.storm_fallback",

@@ -1,0 +1,759 @@
+"""Policy-weighted scoring (sched/policy.py + ops/score.py
+PolicyTerms): the fused kernel must stay bit-identical to the serial
+weighted rank chain, to the policy-off kernel when no weights ride the
+job, and to itself across every execution tier (single select, one-row
+storm, node-sharded storm, fan-out follower).
+
+Contracts under test:
+
+- **Weighted parity** — a job carrying a PolicySpec (Gavel-style
+  throughput-by-node-class table and/or migration-cost coefficient)
+  places bit-identically through the vectorized kernel and the serial
+  PolicyIterator oracle, AllocMetrics included (score_meta records the
+  per-policy components on both sides).
+- **Migration stickiness** — a destructive mass update of a placed job
+  keeps every replacement on its incumbent node (the reschedule
+  penalty drags every OTHER node's mean down), and stays
+  oracle-parity while doing it.
+- **Policy-off bit-identity** — NOMAD_TPU_POLICY=0 (or simply no
+  spec) places exactly like a job with no policy: the None PolicyTerms
+  contributes no pytree leaves, so the kernel trace is the policy-less
+  build.
+- **One-row storm parity** — a weighted eval forced through the storm
+  solver (threshold 1) produces bit-identical placements, eval
+  outcomes and AllocMetrics to the storm-off chain, strict replay on.
+- **Sharded solve bit-identity** — the node-sharded weighted auction
+  equals the single-device weighted solve in every output.
+- **Fan-out followers** — followers assemble the same weight tensors
+  from their own replicated state (zero new RPCs: the assembly reads
+  only the job spec, node table and alloc index they already hold).
+- **Tensor-cache invalidation** — the throughput-tensor cache turns
+  over on job version bumps and node re-fingerprints
+  (topo_generation), never serving a stale arena.
+"""
+import copy
+import random
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.sched.generic_sched import ServiceScheduler
+from nomad_tpu.structs import PolicySpec, compute_node_class
+
+
+TPUT_TABLE = {"fast": 2.0, "slow": 1.0}
+
+
+def policy_cluster(harness, n_nodes, seed=0, classes=("fast", "slow")):
+    """Mixed-node-class cluster: every third node 'fast', ample
+    resources so throughput weighting (not fit) decides placement."""
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.node_class = classes[0] if i % 3 == 0 else classes[1]
+        n.node_resources.cpu = rng.choice([4000, 8000])
+        n.node_resources.memory_mb = rng.choice([8192, 16384])
+        n.attributes["rack"] = f"r{rng.randint(0, 4)}"
+        n.computed_class = compute_node_class(n)
+        harness.store.upsert_node(n)
+        nodes.append(n)
+    return nodes
+
+
+def policy_job(tput=None, mig=0.0, count=6, cpu=500, mem=512, **kw):
+    job = mock.job(**kw)
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].resources.cpu = cpu
+    job.task_groups[0].tasks[0].resources.memory_mb = mem
+    job.policy = PolicySpec(
+        throughput=dict(tput or {}), migration_coefficient=mig
+    )
+    return job
+
+
+def _plan_placements(harness):
+    return sorted(
+        (a.name, a.node_id)
+        for v in harness.plans[-1].node_allocation.values()
+        for a in v
+    )
+
+
+def _plan_score_meta(harness):
+    """alloc name -> every scored node's (id, component scores, norm)
+    — the AllocMetrics face of parity, policy.* components included."""
+    out = {}
+    for v in harness.plans[-1].node_allocation.values():
+        for a in v:
+            out[a.name] = sorted(
+                (
+                    m.node_id,
+                    tuple(sorted(m.scores.items())),
+                    m.norm_score,
+                )
+                for m in a.metrics.score_meta
+            )
+    return out
+
+
+def run_both(harness, evaluation, seed):
+    harness.reject_plan = True
+    harness.process(
+        ServiceScheduler, evaluation, use_tpu=False, seed=seed
+    )
+    oracle = (_plan_placements(harness), _plan_score_meta(harness))
+    harness.process(
+        ServiceScheduler, evaluation, use_tpu=True, seed=seed
+    )
+    tpu = (_plan_placements(harness), _plan_score_meta(harness))
+    harness.reject_plan = False
+    return oracle, tpu
+
+
+def assert_identical(harness, evaluation, seed):
+    (o_place, o_meta), (t_place, t_meta) = run_both(
+        harness, evaluation, seed
+    )
+    assert o_place == t_place, (
+        f"placements diverged:\n oracle={o_place}\n tpu={t_place}"
+    )
+    assert o_meta == t_meta, "AllocMetrics (score_meta) diverged"
+    return o_place, o_meta
+
+
+# ---------------------------------------------------------------------------
+# weighted kernel vs serial weighted-rank oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_throughput_weighted_parity(harness, trial):
+    """Heterogeneity-aware throughput: vectorized weighted select ==
+    serial PolicyIterator chain, placements and AllocMetrics, and the
+    weights actually steer placement onto the fast class."""
+    nodes = policy_cluster(harness, 36, seed=trial)
+    job = policy_job(tput=TPUT_TABLE)
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    placements, meta = assert_identical(harness, ev, seed=trial * 7 + 1)
+    assert len(placements) == 6
+    class_of = {n.id: n.node_class for n in nodes}
+    assert all(
+        class_of[node_id] == "fast" for _, node_id in placements
+    ), "throughput table did not steer placements to the fast class"
+    # the explain decomposition records the throughput component for
+    # every placed alloc's winner
+    for rows in meta.values():
+        assert any(
+            "policy.throughput" in dict(scores)
+            for _nid, scores, _norm in rows
+        )
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_policy_with_affinity_and_spread_parity(harness, trial):
+    """Policy terms append AFTER affinity/spread in the chain: the
+    combined soft-score ordering must stay bit-identical."""
+    from nomad_tpu.structs import Affinity, Spread, SpreadTarget
+
+    policy_cluster(harness, 30, seed=trial + 50)
+    job = policy_job(tput=TPUT_TABLE, mig=0.25, count=8)
+    job.affinities = [Affinity("${attr.rack}", "r1", "=", 40)]
+    job.spreads = [
+        Spread(
+            attribute="${attr.rack}",
+            weight=30,
+            targets=(SpreadTarget("r0", 60), SpreadTarget("r2", 40)),
+        )
+    ]
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    placements, _ = assert_identical(harness, ev, seed=trial * 5 + 2)
+    assert len(placements) == 8
+
+
+def test_migration_penalty_holds_incumbents_and_stays_parity(harness):
+    """A destructive mass update (env bump) of a placed job: the
+    migration penalty must keep every replacement on its incumbent
+    node, bit-identically between kernel and oracle."""
+    policy_cluster(harness, 24, seed=9)
+    job = policy_job(tput=None, mig=0.5, count=6)
+    job.task_groups[0].tasks[0].env = {"V": "1"}
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    harness.process(ServiceScheduler, ev, use_tpu=True, seed=3)
+    incumbents = sorted(
+        (a.name, a.node_id)
+        for a in harness.store.allocs_by_job("default", job.id)
+        if not a.terminal_status()
+    )
+    assert len(incumbents) == 6
+
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].tasks[0].env = {"V": "2"}  # destructive
+    harness.store.upsert_job(job2)
+    ev2 = mock.evaluation(job_id=job.id)
+    placements, _ = assert_identical(harness, ev2, seed=4)
+    assert len(placements) == 6
+    assert sorted(n for _, n in placements) == sorted(
+        n for _, n in incumbents
+    ), "migration penalty failed to hold the incumbent nodes"
+
+
+def test_migration_zero_runtime_cutoff_fresh_placement(harness):
+    """min_runtime_s in the future: no alloc is sticky yet, the
+    migration group stays inert (None term) and parity holds."""
+    policy_cluster(harness, 18, seed=11)
+    job = policy_job(tput=TPUT_TABLE, mig=0.5, count=4)
+    job.policy.min_runtime_s = 3600.0
+    harness.store.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id)
+    placements, _ = assert_identical(harness, ev, seed=5)
+    assert len(placements) == 4
+
+
+# ---------------------------------------------------------------------------
+# policy-off bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_policy_off_knob_matches_specless_job(harness, monkeypatch):
+    """NOMAD_TPU_POLICY=0 with a spec'd job must place exactly like
+    the same job with no spec at all — the kernel sees policy=None
+    either way (same compiled signature, same trace)."""
+    policy_cluster(harness, 30, seed=21)
+    spec_job = policy_job(tput=TPUT_TABLE, mig=0.5, id="knob-a")
+    bare_job = policy_job(tput=TPUT_TABLE, id="knob-b")
+    bare_job.policy = None
+    harness.store.upsert_job(spec_job)
+    harness.store.upsert_job(bare_job)
+    harness.reject_plan = True
+
+    monkeypatch.setenv("NOMAD_TPU_POLICY", "0")
+    harness.process(
+        ServiceScheduler,
+        mock.evaluation(job_id=spec_job.id),
+        use_tpu=True,
+        seed=6,
+    )
+    off_placements = _plan_placements(harness)
+    off_meta = _plan_score_meta(harness)
+    monkeypatch.delenv("NOMAD_TPU_POLICY")
+    harness.process(
+        ServiceScheduler,
+        mock.evaluation(job_id=bare_job.id),
+        use_tpu=True,
+        seed=6,
+    )
+    bare_placements = _plan_placements(harness)
+    assert sorted(n for _, n in off_placements) == sorted(
+        n for _, n in bare_placements
+    )
+    # the disabled layer records NO policy components
+    for rows in off_meta.values():
+        for _nid, scores, _norm in rows:
+            assert not any(
+                k.startswith("policy.") for k, _v in dict(scores).items()
+            )
+
+
+def test_resolve_knob_overrides(monkeypatch):
+    from nomad_tpu.sched.policy import resolve
+
+    job = policy_job(tput=TPUT_TABLE, mig=0.5)
+    pol = resolve(job)
+    assert pol is not None
+    assert pol.tput_coef == 1.0 and pol.mig_coef == 0.5
+    # normalized by the table max, once, host-side
+    assert pol.tput_value("fast") == 1.0
+    assert pol.tput_value("slow") == 0.5
+    assert pol.tput_value("unknown") == 0.0
+    monkeypatch.setenv("NOMAD_TPU_POLICY_TPUT_COEF", "2.5")
+    monkeypatch.setenv("NOMAD_TPU_POLICY_MIG_COEF", "0.75")
+    pol = resolve(job)
+    assert pol.tput_coef == 2.5 and pol.mig_coef == 0.75
+    monkeypatch.setenv("NOMAD_TPU_POLICY", "0")
+    assert resolve(job) is None
+
+
+# ---------------------------------------------------------------------------
+# one-row storm parity (strict replay)
+# ---------------------------------------------------------------------------
+
+
+def _storm_nodes(n, seed=3):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        node = mock.node(id=f"pol-storm-node-{seed}-{i:04d}")
+        node.node_class = "fast" if i % 3 == 0 else "slow"
+        node.node_resources.cpu = rng.choice([8000, 16000])
+        node.node_resources.memory_mb = rng.choice([16384, 32768])
+        node.computed_class = compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def _storm_policy_jobs(n, fam="polfam"):
+    jobs = []
+    for i in range(n):
+        job = mock.job(id=f"{fam}/dispatch-{i:04d}")
+        job.type = "batch"
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 4096
+        job.policy = PolicySpec(throughput=dict(TPUT_TABLE))
+        jobs.append(job)
+    return jobs
+
+
+def _run_storm_server(jobs, n_nodes=18, timeout=120):
+    from nomad_tpu.server import Server
+
+    server = Server(num_schedulers=1, seed=11, batch_pipeline=True)
+    for node in _storm_nodes(n_nodes):
+        server.register_node(copy.deepcopy(node))
+    for job in jobs:
+        server.register_job(copy.deepcopy(job))
+    server.start()
+    assert server.drain_to_idle(timeout)
+    return server
+
+
+def _placements(server, job_id):
+    return sorted(
+        (a.name, a.node_id)
+        for a in server.store.allocs_by_job("default", job_id)
+        if not a.terminal_status()
+    )
+
+
+def _explain_metric(server, job_id, scores=True):
+    """Comparable AllocMetric view from the explain ring.  With
+    scores=False the score decomposition (ScoreMetaData + the
+    placements' NormScore) is stripped: the storm replay re-verifies
+    winners through a bare binpack pass and records the compact
+    winner metric (batch_worker.py select), so soft-term score
+    fidelity through the solver is compared only where the serial
+    chain records the same compact shape."""
+    from nomad_tpu.explain import EXPLAIN
+
+    out = []
+    for ev in sorted(
+        server.store.evals_by_job("default", job_id),
+        key=lambda e: e.create_index,
+    ):
+        rec = EXPLAIN.get(ev.id)
+        if rec is None:
+            out.append(None)
+            continue
+        tgs = {}
+        for tg, entry in rec["TaskGroups"].items():
+            metric = entry.get("Metric")
+            if metric is not None:
+                drop = {"AllocationTime"}
+                if not scores:
+                    drop.add("ScoreMetaData")
+                metric = {
+                    k: v
+                    for k, v in metric.items()
+                    if k not in drop
+                }
+            tgs[tg] = {
+                "Placed": entry["Placed"],
+                "Winner": entry["Winner"],
+                "Placements": sorted(
+                    (p["Name"], p["NodeID"])
+                    + (
+                        (round(p["NormScore"], 9),)
+                        if scores
+                        else ()
+                    )
+                    for p in entry["Placements"]
+                ),
+                "Metric": metric,
+            }
+        out.append(tgs)
+    return out
+
+
+def _eval_outcomes(server, job_id):
+    return sorted(
+        (
+            e.status,
+            e.status_description,
+            tuple(sorted(e.queued_allocations.items())),
+        )
+        for e in server.store.evals_by_job("default", job_id)
+    )
+
+
+def test_one_row_weighted_storm_parity(monkeypatch):
+    """A weighted eval forced through the storm solver (threshold 1,
+    strict replay) is bit-identical to the storm-off weighted chain in
+    placements and eval outcomes, and matches the serial metric modulo
+    the score decomposition (the storm replay's winner re-verification
+    records the compact binpack metric by design — batch_worker.py
+    select — for weighted and affinity members alike).  The weighted
+    unlimited walk still rides through the solver: NodesEvaluated on
+    the storm side is every candidate, exactly as the serial chain
+    with a resolved policy, and the serial side's full decomposition
+    carries policy.throughput."""
+    monkeypatch.setenv("NOMAD_TPU_REPLAY_STRICT", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "1")
+    jobs = _storm_policy_jobs(1, fam="poldegen")
+    on = _run_storm_server(jobs)
+    try:
+        worker = on.workers[0]
+        assert worker.storm_solves == 1, "solver did not engage"
+        assert worker.storm_fallbacks == 0
+        assert worker.storm_divergent == 0
+        assert on.metrics.get_counter("policy.storm_evals") == 1
+        on_place = _placements(on, jobs[0].id)
+        on_out = _eval_outcomes(on, jobs[0].id)
+        on_metric = _explain_metric(on, jobs[0].id, scores=False)
+        # the resolved policy forced the unlimited walk through the
+        # solver's pull accounting: every candidate evaluated
+        evaluated = [
+            entry["Metric"]["NodesEvaluated"]
+            for tgs in on_metric
+            if tgs
+            for entry in tgs.values()
+            if entry["Metric"]
+        ]
+        assert evaluated == [18], evaluated
+        monkeypatch.setenv("NOMAD_TPU_STORM", "0")
+        off = _run_storm_server(jobs)
+        try:
+            assert on_place == _placements(off, jobs[0].id)
+            assert on_out == _eval_outcomes(off, jobs[0].id)
+            assert on_metric == _explain_metric(
+                off, jobs[0].id, scores=False
+            )
+            # the serial-equivalent chain records the per-policy
+            # decomposition for every scored candidate
+            off_full = _explain_metric(off, jobs[0].id, scores=True)
+            winner_scores = [
+                dict(sm.get("Scores") or {})
+                for tgs in off_full
+                if tgs
+                for entry in tgs.values()
+                if entry["Metric"]
+                for sm in entry["Metric"]["ScoreMetaData"]
+            ]
+            assert winner_scores and all(
+                "policy.throughput" in s for s in winner_scores
+            ), winner_scores
+        finally:
+            off.stop()
+    finally:
+        on.stop()
+
+
+def test_mass_weighted_storm_places_on_fast_class(monkeypatch):
+    """A weighted family storm: the fused per-eval rows steer every
+    solver placement onto the fast class, zero lost."""
+    monkeypatch.setenv("NOMAD_TPU_STORM", "1")
+    monkeypatch.setenv("NOMAD_TPU_STORM_MIN", "6")
+    jobs = _storm_policy_jobs(12, fam="polmass")
+    server = _run_storm_server(jobs, n_nodes=24)
+    try:
+        worker = server.workers[0]
+        assert worker.storm_evals == 12
+        class_of = {
+            n.id: n.node_class for n in _storm_nodes(24)
+        }
+        placed = []
+        for job in jobs:
+            p = _placements(server, job.id)
+            assert len(p) == 1
+            placed.extend(p)
+        fast = sum(
+            1 for _, nid in placed if class_of[nid] == "fast"
+        )
+        # 8 fast nodes x 8000+ cpu hold all 12 x 2000cpu asks
+        assert fast == 12, f"only {fast}/12 on the fast class"
+        for job in jobs:
+            evs = server.store.evals_by_job("default", job.id)
+            assert all(e.terminal_status() for e in evs)
+        assert server.broker.failed() == []
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# sharded weighted solve == single-device weighted solve
+# ---------------------------------------------------------------------------
+
+
+def _mesh8():
+    from nomad_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8, eval_axis=1)
+
+
+def _weighted_storm_problem(E, A, C, seed=0, limit=2):
+    from nomad_tpu.ops.solve import StormInputs
+
+    rng = np.random.default_rng(seed)
+    perm = np.stack(
+        [rng.permutation(C).astype(np.int32) for _ in range(E)]
+    )
+    # mixed storm: some evals weighted (throughput and/or migration),
+    # some policy-less (all-zero rows — the float-exact no-op)
+    has_tput = (rng.random(E) > 0.3).astype(np.float64)
+    tput_term = np.where(
+        has_tput[:, None] > 0, 0.8 * rng.random((E, C)), 0.0
+    )
+    mig_term = np.where(
+        rng.random((E, C)) > 0.7, -0.5, 0.0
+    ) * (rng.random(E) > 0.5)[:, None]
+    inp = StormInputs(
+        feasible=rng.random((E, C)) > 0.15,
+        affinity=np.where(
+            rng.random((E, C)) > 0.8, rng.random((E, C)), 0.0
+        ),
+        collisions=(rng.random((E, C)) > 0.9).astype(np.int32),
+        perm=perm,
+        limit=np.full(E, limit, np.int32),
+        n_cand=np.full(E, C, np.int32),
+        eval_of=(np.arange(A) % E).astype(np.int32),
+        penalty=rng.random((A, C)) > 0.95,
+        ask=np.tile(
+            np.asarray((100.0, 100.0, 100.0), np.float64), (A, 1)
+        ),
+        desired=np.ones(A, np.int32),
+        real=np.ones(A, bool),
+        pre_cpu=np.zeros(C),
+        pre_mem=np.zeros(C),
+        pre_disk=np.zeros(C),
+        policy_tput_term=tput_term,
+        policy_has_tput=has_tput,
+        policy_mig_term=mig_term,
+    )
+    cols = tuple(
+        np.asarray(x, np.float64)
+        for x in (
+            np.full(C, 4000.0),
+            np.full(C, 8192.0),
+            np.full(C, 100000.0),
+            rng.integers(0, 2000, C).astype(np.float64),
+            rng.integers(0, 4096, C).astype(np.float64),
+            np.zeros(C),
+        )
+    )
+    return inp, cols
+
+
+@pytest.mark.parametrize(
+    "E,A,C,seed",
+    [
+        (8, 32, 64, 3),
+        (4, 8, 128, 9),
+        (1, 1, 16, 7),  # degenerate weighted one-row storm
+    ],
+)
+def test_sharded_weighted_storm_bit_identical(E, A, C, seed):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nomad_tpu.ops.solve import (
+        storm_assignment,
+        storm_assignment_sharded,
+    )
+    from nomad_tpu.sched.storm import stage_for_mesh
+
+    inp, cols = _weighted_storm_problem(E, A, C, seed=seed)
+    single = storm_assignment(
+        inp, cols, spread_fit=False, max_rounds=A
+    )
+    mesh = _mesh8()
+    sharded = storm_assignment_sharded(
+        mesh, spread_fit=False, max_rounds=A, weighted=True
+    )(
+        stage_for_mesh(inp, mesh),
+        tuple(
+            jax.device_put(c, NamedSharding(mesh, P("nodes")))
+            for c in cols
+        ),
+    )
+    names = (
+        "assigned", "pulls", "acc_round", "score", "greedy", "rounds"
+    )
+    for name, s, m in zip(names, single, sharded):
+        assert np.array_equal(np.asarray(s), np.asarray(m)), (
+            f"sharded weighted storm diverged in {name}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# fan-out followers assemble from replicated state
+# ---------------------------------------------------------------------------
+
+
+def test_fanout_follower_assembles_policy_from_replicated_state(
+    monkeypatch,
+):
+    """A 3-server fan-out cluster placing weighted jobs matches the
+    single-server oracle's live placement set AND its policy outcome
+    (every placement steered onto the fast class), and the policy
+    tensors were assembled on the follower(s) from their own
+    replicated store — the policy.* series move on a non-leader
+    server, with zero policy-specific RPCs (there are none to call)."""
+    from tests.test_fanout import _live_placements, wait_until
+
+    from nomad_tpu.server import Server
+    from nomad_tpu.server.cluster import TestCluster
+
+    n_jobs = 18
+    nodes = _storm_nodes(12, seed=5)
+    class_of = {n.id: n.node_class for n in nodes}
+    jobs = []
+    for i in range(n_jobs):
+        job = policy_job(
+            tput=TPUT_TABLE, count=1, cpu=1000, mem=1024,
+            id=f"pol-fo-{i:04d}",
+        )
+        jobs.append(job)
+
+    def _live_nodes(store):
+        return sorted(
+            (a.job_id, a.name, class_of[a.node_id])
+            for a in store.allocs.values()
+            if not a.terminal_status()
+        )
+
+    oracle = Server(num_schedulers=1, seed=0, batch_pipeline=True)
+    oracle.start()
+    try:
+        for node in nodes:
+            oracle.register_node(copy.deepcopy(node))
+        for job in jobs:
+            oracle.register_job(copy.deepcopy(job))
+        assert oracle.drain_to_idle(timeout=60.0)
+        want = _live_placements(oracle.store)
+        want_classes = _live_nodes(oracle.store)
+        assert oracle.metrics.get_counter("policy.evals") > 0
+    finally:
+        oracle.stop()
+    assert len(want) == n_jobs
+    assert all(cls == "fast" for _j, _n, cls in want_classes), (
+        "oracle did not steer onto the fast class"
+    )
+
+    monkeypatch.setenv("NOMAD_TPU_FANOUT", "1")
+    cluster = TestCluster(3, heartbeat_ttl=300.0)
+    cluster.start()
+    try:
+        leader = cluster.wait_for_leader(timeout=30.0)
+        for node in nodes:
+            leader.register_node(copy.deepcopy(node))
+        for i, job in enumerate(jobs):
+            cluster.servers[i % 3].register_job(copy.deepcopy(job))
+        wait_until(
+            lambda: len(
+                _live_placements(
+                    cluster.wait_for_leader(timeout=30.0).store
+                )
+            )
+            == n_jobs
+            and cluster.wait_for_leader(timeout=30.0).drain_to_idle(
+                timeout=1.0
+            ),
+            timeout=90.0,
+            msg="fan-out drain",
+        )
+        leader = cluster.wait_for_leader(timeout=30.0)
+        assert _live_placements(leader.store) == want
+        # same policy outcome as the oracle: the fan-out followers'
+        # weighted walks landed every placement on the fast class
+        assert _live_nodes(leader.store) == want_classes
+        follower_plans = sum(
+            s.metrics.get_counter("fanout.plans_submitted")
+            for s in cluster.servers
+        )
+        assert follower_plans > 0, "fan-out never engaged"
+        follower_policy_evals = sum(
+            s.metrics.get_counter("policy.evals")
+            + s.metrics.get_counter("policy.storm_evals")
+            for s in cluster.servers
+            if not s.is_leader()
+        )
+        assert follower_policy_evals > 0, (
+            "no follower ever assembled policy tensors from its "
+            "replicated state"
+        )
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# tensor cache invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_tput_tensor_cache_turnover(harness):
+    from nomad_tpu.sched.policy import (
+        clear_tput_cache,
+        migration_vector,
+        resolve,
+        tput_tensor,
+    )
+
+    nodes = policy_cluster(harness, 12, seed=31)
+    table = harness.snapshot().node_table
+    job = policy_job(tput=TPUT_TABLE)
+    pol = resolve(job)
+    clear_tput_cache()
+
+    t1 = tput_tensor(pol, job, table)
+    t2 = tput_tensor(pol, job, table)
+    assert t2 is t1, "warm assembly must be a cache hit"
+    # values follow the interned node.class column
+    for n in nodes:
+        row = table.row_of[n.id]
+        want = 1.0 if n.node_class == "fast" else 0.5
+        assert t1[row] == want
+
+    # job version bump (spec update) -> new tensor
+    job_v2 = copy.deepcopy(job)
+    job_v2.version = job.version + 1
+    t3 = tput_tensor(pol, job_v2, table)
+    assert t3 is not t1
+
+    # node re-fingerprint: class change bumps topo_generation and
+    # invalidates — the stale arena is never served
+    gen0 = table.topo_generation
+    flipped = copy.deepcopy(nodes[1])
+    flipped.node_class = "fast"
+    harness.store.upsert_node(flipped)
+    table2 = harness.snapshot().node_table
+    assert table2.topo_generation > gen0
+    t4 = tput_tensor(pol, job, table2)
+    assert t4 is not t1
+    assert t4[table2.row_of[flipped.id]] == 1.0
+
+    clear_tput_cache()
+    t5 = tput_tensor(pol, job, table2)
+    assert t5 is not t4
+    np.testing.assert_array_equal(np.asarray(t5), np.asarray(t4))
+
+
+def test_migration_vector_shape(harness):
+    """Penalty semantics: -1 everywhere EXCEPT the sticky rows, and
+    all-zero (inert) when the sticky set is empty — a bonus on the
+    incumbent would backfire under mean-of-components scoring."""
+    from nomad_tpu.sched.policy import migration_vector
+
+    nodes = policy_cluster(harness, 8, seed=41)
+    table = harness.snapshot().node_table
+    assert not migration_vector(set(), table).any()
+    sticky = {nodes[2].id, nodes[5].id}
+    mig = migration_vector(sticky, table)
+    for n in nodes:
+        row = table.row_of[n.id]
+        assert mig[row] == (0.0 if n.id in sticky else -1.0)

@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (28 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (29 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -67,6 +67,29 @@ if [ "${SMOKE:-1}" = "1" ]; then
     timeout -k 10 300 python -m nomad_tpu.loadgen.swarm_smoke \
         --nodes 600 --submitters 240 --death 120 --ttl 8 \
         --base-jobs 150
+
+    echo "== policy-weighted scoring A/B (scaled down) =="
+    # the policy-layer gate: heterogeneity-aware throughput must pull
+    # placements onto fast nodes and migration-cost stickiness must
+    # cut mass-replan churn at equal-or-better aggregate binpack
+    # score, both A/B'd against NOMAD_TPU_POLICY=0 on the same world.
+    # Scaled below the BENCH acceptance run (which also asserts the
+    # <3% identity-weights kernel overhead at f32 — too noisy to gate
+    # on a shared CI box); the kill-timeout fails a wedged world
+    timeout -k 10 600 env JAX_PLATFORMS=cpu BENCH_POLICY_C=1024 \
+        BENCH_POLICY_KERNEL_REPS=40 BENCH_POLICY_NODES=90 \
+        BENCH_POLICY_JOBS=24 python -c "
+import bench
+out = bench.bench_policy()
+assert out['throughput']['fast_share_gain'] > 0.2, out['throughput']
+assert out['migration']['fewer_migrations'], out['migration']
+assert out['migration']['score_delta'] >= 0.0, out['migration']
+print('policy gate green:', {
+    'fast_share_gain': out['throughput']['fast_share_gain'],
+    'migrations_avoided': out['migration']['migrations_avoided'],
+    'score_delta': out['migration']['score_delta'],
+})
+"
 
     echo "== 2-process distributed smoke (CPU backend, gloo) =="
     # the multi-host mesh gate: distributed init, pod-mesh chain with

@@ -48,6 +48,8 @@ DEFAULT_PATHS: Dict[str, str] = {
     "explain": "nomad_tpu/explain.py",
     "tpu_stack": "nomad_tpu/sched/tpu_stack.py",
     "feasible": "nomad_tpu/sched/feasible.py",
+    "sched_policy": "nomad_tpu/sched/policy.py",
+    "sched_storm": "nomad_tpu/sched/storm.py",
     "server": "nomad_tpu/server/server.py",
     "overload": "nomad_tpu/server/overload.py",
     "cluster": "nomad_tpu/server/cluster.py",

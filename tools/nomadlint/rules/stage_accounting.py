@@ -210,6 +210,10 @@ class SpanRegistryRule(Rule):
         "batch_worker.storm_solve",
         "batch_worker.storm_decompose",
         "batch_worker.storm_fallback",
+        # policy-weighted scoring: the per-member weight-tensor
+        # assembly inside storm staging — without it a weighted
+        # storm's staging cost is invisible on every trace dashboard
+        "batch_worker.policy_assemble",
     )
 
     def check(self, ctx: Context) -> List[Finding]:
@@ -857,6 +861,104 @@ class StormMetricsRule(Rule):
             append=(
                 "def _nomadlint_bad_fixture(self):\n"
                 '    self._count_storm("bogus_kind")\n'
+            ),
+        )
+
+
+@register
+class PolicyMetricsRule(Rule):
+    """Policy-weighted scoring: every ``policy.*`` metric emitted
+    anywhere in the layer — literal first args of metric calls in
+    sched/policy.py (tensor-cache accounting), sched/storm.py
+    (weighted staging), batch_worker.py and tpu_stack.py, plus the
+    ``self._count_policy("<kind>")`` sites, which emit
+    ``policy.<kind>`` — is in the zero-registered ``POLICY_COUNTERS``
+    / ``POLICY_GAUGES`` registries (sched/policy.py), and server.py
+    zero-registers both at construction: absence of a ``policy.*``
+    series must mean "no policy-weighted select ever ran", never
+    "not exported"."""
+
+    name = "policy-metrics"
+    description = "policy.* emissions are zero-registered"
+
+    SCAN_KEYS = (
+        "sched_policy", "sched_storm", "batch_worker", "tpu_stack"
+    )
+
+    def check(self, ctx: Context) -> List[Finding]:
+        policy_path = ctx.path("sched_policy")
+        registry = astutil.assigned_strings(
+            ctx.tree(policy_path), "POLICY_COUNTERS"
+        ) | astutil.assigned_strings(
+            ctx.tree(policy_path), "POLICY_GAUGES"
+        )
+        if not registry:
+            return [
+                Finding(
+                    self.name, policy_path, 0,
+                    "could not find the POLICY_COUNTERS/"
+                    "POLICY_GAUGES registries in sched/policy.py",
+                )
+            ]
+        problems: List[Finding] = []
+        for key in self.SCAN_KEYS:
+            path = ctx.path(key)
+            tree = ctx.tree(path)
+            emitted: Set[str] = set()
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                if (
+                    node.func.attr in astutil.METRIC_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("policy.")
+                ):
+                    emitted.add(node.args[0].value)
+                if (
+                    node.func.attr == "_count_policy"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    emitted.add(f"policy.{node.args[0].value}")
+            unregistered = emitted - registry
+            if unregistered:
+                problems.append(
+                    Finding(
+                        self.name, path, 0,
+                        "policy.* metrics emitted but not in the "
+                        "POLICY_COUNTERS/POLICY_GAUGES registries "
+                        "(they would be absent from prometheus "
+                        "scrapes until the first weighted select): "
+                        f"{sorted(unregistered)}",
+                    )
+                )
+        server_path = ctx.path("server")
+        server_src = ctx.source(server_path)
+        for reg_name in ("POLICY_COUNTERS", "POLICY_GAUGES"):
+            if reg_name not in server_src:
+                problems.append(
+                    Finding(
+                        self.name, server_path, 0,
+                        "server.py no longer zero-registers the "
+                        f"policy.* family at construction ({reg_name}"
+                        " preregister)",
+                    )
+                )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            append=(
+                "def _nomadlint_bad_fixture(self):\n"
+                '    self._count_policy("bogus_kind")\n'
             ),
         )
 
