@@ -1035,6 +1035,7 @@ WITH_CLUSTER_FANOUT = (
     os.environ.get("BENCH_CLUSTER_FANOUT", "1") == "1"
 )
 WITH_BIGWORLD = os.environ.get("BENCH_BIGWORLD", "1") == "1"
+WITH_CLUSTER_OBS = os.environ.get("BENCH_CLUSTER_OBS", "1") == "1"
 
 
 def bench_bigworld():
@@ -1119,6 +1120,181 @@ def bench_cluster_fanout():
         f"cluster fanout: ok={block['ok']} capacity {ratios} "
         f"(3v1 {block['speedup_3v1']}x, 5v1 {block['speedup_5v1']}x) "
         f"lost={block['lost_total']} parity={block['parity_ok']} "
+        f"({time.time() - t0:.1f}s)"
+    )
+    return block
+
+
+def bench_cluster_obs():
+    """Cluster-scope observability costs (`cluster_obs` in BENCH
+    json): (a) stitched-trace overhead on the fan-out path — the same
+    3-server fan-out workload with the recorder on vs off,
+    interleaved A/B with a discarded warmup and min-of-reps (the
+    trace-overhead protocol), the `on` runs also proving stitching
+    engaged (>=1 trace with spans from >=2 servers, zero orphans);
+    (b) leader fan-in query latency (`cluster_query("metrics")`)
+    at 1 vs 3 vs 5 servers, median of 15 queries; (c) the metric
+    history ring's memory footprint at full depth on a
+    representative registry.  The acceptance contract is <5% trace
+    overhead (same tolerance shape as tests/test_trace.py) with
+    stitching engaged.  BENCH_CLUSTER_OBS=0 opts out;
+    BENCH_OBS_{FAMILIES,NODES,REPS} rescale."""
+    from nomad_tpu.server.cluster import TestCluster
+    from nomad_tpu.server.fanout_bench import _run_topology
+    from nomad_tpu.telemetry import Metrics, MetricsHistory
+    from nomad_tpu.trace import TRACE
+
+    t0 = time.time()
+    families = int(os.environ.get("BENCH_OBS_FAMILIES", 120))
+    nodes = int(os.environ.get("BENCH_OBS_NODES", 256))
+    reps = int(os.environ.get("BENCH_OBS_REPS", 2))
+
+    knobs = {
+        "NOMAD_TPU_FANOUT": "1",
+        "NOMAD_TPU_BATCH_MAX": "8",
+        "NOMAD_TPU_FANOUT_LEASE_N": "4",
+    }
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+
+    def run_once(enabled, tag):
+        TRACE.set_enabled(enabled)
+        TRACE.clear()
+        r = _run_topology(
+            3,
+            nodes=nodes,
+            families=families,
+            jobs_per=1,
+            tag=f"ob{tag}",
+        )
+        stitched = 0
+        orphans = 0
+        if enabled:
+            for trace in TRACE.recent(limit=256, full=True):
+                if not trace["complete"]:
+                    continue
+                orphans += trace["orphans"]
+                lanes = {
+                    (s.get("attrs") or {}).get("server_id")
+                    for s in trace["spans"]
+                }
+                if len(lanes) >= 2:
+                    stitched += 1
+        log(
+            f"cluster-obs {tag} trace="
+            f"{'on' if enabled else 'off'}: "
+            f"{r['placements_total']} placements in "
+            f"{r['wall_s']:.2f}s"
+            + (
+                f" stitched={stitched} orphans={orphans}"
+                if enabled
+                else ""
+            )
+        )
+        return r["wall_s"], stitched, orphans
+
+    times = {True: [], False: []}
+    stitched_min = None
+    orphans_total = 0
+    was_enabled = TRACE.enabled
+    try:
+        # discarded warmup: first run of this topology pays the XLA
+        # compiles for its launch shapes
+        run_once(True, "warmup")
+        for rep in range(reps):
+            for enabled in (True, False):
+                dt, stitched, orphans = run_once(
+                    enabled, f"r{rep}"
+                )
+                times[enabled].append(dt)
+                if enabled:
+                    stitched_min = (
+                        stitched
+                        if stitched_min is None
+                        else min(stitched_min, stitched)
+                    )
+                    orphans_total += orphans
+    finally:
+        TRACE.set_enabled(was_enabled)
+        TRACE.clear()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    t_on, t_off = min(times[True]), min(times[False])
+    pct = (t_on - t_off) / t_off * 100.0 if t_off else 0.0
+    # the <5% contract with the same additive slack the unit gate
+    # uses: tiny absolute wall times make pure ratios noise-bound
+    overhead_ok = t_on <= t_off * 1.05 + 0.2
+
+    # -- fan-in query latency vs topology size -------------------
+    fanin = {}
+    for n in (1, 3, 5):
+        cluster = TestCluster(
+            n, heartbeat_ttl=300.0, name_prefix=f"obq{n}-"
+        )
+        try:
+            cluster.start()
+            leader = cluster.wait_for_leader(timeout=30.0)
+            leader.metrics.incr("obs.bench_probe")
+            samples = []
+            for _ in range(15):
+                q0 = time.perf_counter()
+                out = leader.cluster_query("metrics")
+                samples.append(
+                    (time.perf_counter() - q0) * 1000.0
+                )
+                assert out["asked"] == n and not out["unreachable"]
+            samples.sort()
+            fanin[f"{n}_servers_ms"] = round(
+                samples[len(samples) // 2], 3
+            )
+        finally:
+            cluster.stop()
+
+    # -- history-ring footprint at full depth --------------------
+    m = Metrics()
+    for i in range(48):
+        m.incr(f"obs.bench_counter_{i:02d}")
+    for i in range(12):
+        m.set_gauge(f"obs.bench_gauge_{i:02d}", float(i))
+    for i in range(8):
+        for v in range(512):
+            m.add_sample(f"obs.bench_sample_{i}_ms", float(v))
+    hist = MetricsHistory(m, windows=60, interval_s=60.0)
+    for _ in range(60):
+        hist.snapshot_once()
+    ring_bytes = len(json.dumps(hist.to_dict()))
+
+    block = {
+        "ok": bool(
+            overhead_ok
+            and (stitched_min or 0) > 0
+            and orphans_total == 0
+        ),
+        "families": families,
+        "nodes": nodes,
+        "reps": reps,
+        "trace_on_s": round(t_on, 3),
+        "trace_off_s": round(t_off, 3),
+        "stitched_overhead_pct": round(pct, 2),
+        "overhead_ok": overhead_ok,
+        "stitched_traces_min": stitched_min,
+        "orphan_spans": orphans_total,
+        "fanin_query_latency": fanin,
+        "history_ring": {
+            "windows": 60,
+            "total_bytes": ring_bytes,
+            "bytes_per_window": round(ring_bytes / 60.0, 1),
+        },
+    }
+    log(
+        f"cluster obs: ok={block['ok']} overhead "
+        f"on={t_on:.2f}s off={t_off:.2f}s ({pct:+.1f}%) "
+        f"stitched>={stitched_min} orphans={orphans_total} "
+        f"fanin={fanin} ring={ring_bytes}B "
         f"({time.time() - t0:.1f}s)"
     )
     return block
@@ -2098,6 +2274,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"cluster fanout bench FAILED: {exc!r}")
             cluster_fanout = {"error": repr(exc)}
+    cluster_obs = {}
+    if WITH_CLUSTER_OBS:
+        try:
+            cluster_obs = bench_cluster_obs()
+        except Exception as exc:  # noqa: BLE001
+            log(f"cluster obs bench FAILED: {exc!r}")
+            cluster_obs = {"error": repr(exc)}
     bigworld = {}
     if WITH_BIGWORLD:
         try:
@@ -2163,6 +2346,12 @@ def main():
                 # (>=2x 3v1 acceptance) with zero-lost and
                 # placement-set-parity verdicts
                 "cluster_fanout": cluster_fanout,
+                # cluster-scope observability: stitched-trace
+                # overhead A/B on the fan-out path (<5% with
+                # stitching engaged and zero orphans), leader
+                # fan-in query latency at 1/3/5 servers, and the
+                # metric history ring's full-depth footprint
+                "cluster_obs": cluster_obs,
                 # million-node composed topology: fan-out followers
                 # each heading a multi-process pod mesh over a
                 # raft-seeded >=1M-node world (placements/s,

@@ -268,6 +268,20 @@ class APIHandler(BaseHTTPRequestHandler):
         ):
             raise HTTPError(403, "Permission denied")
 
+    @staticmethod
+    def _cluster_obs(srv, what: str, params: dict) -> dict:
+        """Cluster observability fan-in when the server is
+        cluster-capable; a single-process Server answers with its
+        local share in the same merged shape."""
+        query = getattr(srv, "cluster_query", None)
+        if query is not None:
+            return query(what, params)
+        return {
+            "servers": {"local": srv._obs_local(what, params)},
+            "asked": 1,
+            "unreachable": 0,
+        }
+
     # -- dispatch -------------------------------------------------------
 
     def do_GET(self):
@@ -1154,6 +1168,21 @@ class APIHandler(BaseHTTPRequestHandler):
             from ..explain import EXPLAIN
 
             record = EXPLAIN.get(m.group(1))
+            if record is None and hasattr(srv, "cluster_query"):
+                # follower-planned eval: the explain record lives on
+                # whichever server ran the scheduler — fan the lookup
+                # out so the operator never has to know which one
+                merged = self._cluster_obs(
+                    srv, "explain", {"eval_id": m.group(1)}
+                )
+                for addr, result in merged["servers"].items():
+                    if result.get("unreachable"):
+                        continue
+                    found = result.get("explain")
+                    if found is not None:
+                        record = dict(found)
+                        record["served_by"] = addr
+                        break
             if record is None:
                 raise HTTPError(404, "no placement explanation retained")
             self._respond(record)
@@ -1717,6 +1746,24 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond(metrics.dump() if metrics else {})
             return True
 
+        # metric time-series history: the retained snapshot windows
+        # (NOMAD_TPU_OBS_HISTORY_N x NOMAD_TPU_OBS_HISTORY_S), or one
+        # metric's series with ?name=.  Unauthenticated and never
+        # shed, like /v1/metrics — it shares the prefix on purpose.
+        if path == "/v1/metrics/history" and method == "GET":
+            history = getattr(srv, "metrics_history", None)
+            if history is None:
+                self._respond({"enabled": False, "windows": []})
+                return True
+            name = q.get("name")
+            if name:
+                self._respond(
+                    {"name": name, "series": history.series(name)}
+                )
+            else:
+                self._respond(history.to_dict())
+            return True
+
         # -- accelerator supervisor status ------------------------------
         # unauthenticated like /v1/metrics: this is the first endpoint
         # an operator polls when the device wedges, and it must answer
@@ -1777,6 +1824,126 @@ class APIHandler(BaseHTTPRequestHandler):
             if trace is None:
                 raise HTTPError(404, "trace not found")
             self._respond(trace)
+            return True
+
+        # -- cluster-scope observability (leader fan-in) ----------------
+        # the serving server fans the query out to every known peer
+        # over the cluster transport (bounded by
+        # NOMAD_TPU_OBS_FANIN_TIMEOUT_S); peers that time out are
+        # marked unreachable in `servers`, never a failed query.  On a
+        # single-process Server the same endpoints answer with the
+        # local share only.
+        if path == "/v1/cluster/traces" and method == "GET":
+            self._check_acl("agent:read")
+            params = {
+                "limit": q.get("limit", "64"),
+                "outcome": q.get("outcome"),
+                "full": q.get("full") == "1",
+            }
+            if "slow_ms" in q:
+                params["slow_ms"] = q["slow_ms"]
+            merged = self._cluster_obs(srv, "traces", params)
+            traces = []
+            status = {}
+            seen = set()
+            for addr, result in merged["servers"].items():
+                if result.get("unreachable"):
+                    status[addr] = "unreachable"
+                    continue
+                status[addr] = "ok"
+                for entry in result.get("traces", []):
+                    # dedup by trace id: with a shared in-process
+                    # tracer (TestCluster) every server reports the
+                    # same traces; first reporter wins the "server"
+                    # attribution (local share is queried first)
+                    tid = entry.get("trace_id") or entry.get("eval_id")
+                    if tid in seen:
+                        continue
+                    seen.add(tid)
+                    entry["server"] = addr
+                    traces.append(entry)
+            traces.sort(key=lambda t: t.get("start", 0), reverse=True)
+            try:
+                limit = int(params["limit"])
+            except ValueError:
+                raise HTTPError(400, "bad limit")
+            self._respond(
+                {
+                    "traces": traces[: max(1, min(limit, 1024))],
+                    "servers": status,
+                    "unreachable": merged["unreachable"],
+                }
+            )
+            return True
+
+        m = re.fullmatch(r"/v1/cluster/traces/([^/]+)", path)
+        if m and method == "GET":
+            self._check_acl("agent:read")
+            merged = self._cluster_obs(srv, "trace", {"ref": m.group(1)})
+            best = None
+            best_server = None
+            status = {}
+            for addr, result in merged["servers"].items():
+                if result.get("unreachable"):
+                    status[addr] = "unreachable"
+                    continue
+                status[addr] = "ok"
+                trace = result.get("trace")
+                if trace is None:
+                    continue
+                # the stitched whole lives on the server that rooted
+                # the trace (the leader at dequeue time) — prefer the
+                # most complete copy: finished beats in flight, more
+                # spans beats fewer
+                key = (
+                    1 if trace.get("complete") else 0,
+                    len(trace.get("spans") or ()),
+                )
+                if best is None or key > best_key:
+                    best, best_key, best_server = trace, key, addr
+            if best is None:
+                raise HTTPError(404, "trace not found on any server")
+            best["server"] = best_server
+            best["servers"] = status
+            self._respond(best)
+            return True
+
+        if path == "/v1/cluster/metrics" and method == "GET":
+            self._check_acl("agent:read")
+            merged = self._cluster_obs(srv, "metrics", {})
+            servers = {
+                addr: (
+                    {"unreachable": True}
+                    if result.get("unreachable")
+                    else result.get("metrics", {})
+                )
+                for addr, result in merged["servers"].items()
+            }
+            self._respond(
+                {
+                    "servers": servers,
+                    "unreachable": merged["unreachable"],
+                }
+            )
+            return True
+
+        if path == "/v1/cluster/metrics/history" and method == "GET":
+            self._check_acl("agent:read")
+            merged = self._cluster_obs(srv, "metrics_history", {})
+            servers = {
+                addr: (
+                    {"unreachable": True}
+                    if result.get("unreachable")
+                    else result.get("history", {})
+                )
+                for addr, result in merged["servers"].items()
+            }
+            self._respond(
+                {
+                    "servers": servers,
+                    "unreachable": merged["unreachable"],
+                }
+            )
             return True
 
         if path == "/v1/search" and method in ("POST", "PUT", "GET"):

@@ -758,6 +758,21 @@ def cmd_operator_debug(args) -> None:
         # eval flight recorder: recent full traces, so a bundle from a
         # misbehaving server carries per-eval stage/conflict evidence
         "traces.json": ("GET", "/v1/traces?full=1&limit=256"),
+        # metric time-series history: the last N snapshot windows, so
+        # the bundle shows "p99 over the last ten minutes", not just
+        # the instant the operator finally ran the capture
+        "metrics-history.json": ("GET", "/v1/metrics/history"),
+        # cluster-scope views (leader fan-in over every peer; on a
+        # single-process server these answer with the local share):
+        # stitched cross-server traces and every server's metrics,
+        # with unreachable peers marked rather than omitted silently
+        "cluster-traces.json": (
+            "GET", "/v1/cluster/traces?full=1&limit=256"
+        ),
+        "cluster-metrics.json": ("GET", "/v1/cluster/metrics"),
+        "cluster-metrics-history.json": (
+            "GET", "/v1/cluster/metrics/history"
+        ),
         # placement explainability: recent per-eval score
         # decompositions + filter attributions, cross-referenced with
         # traces.json by eval id
@@ -1220,6 +1235,10 @@ def cmd_eval_explain(args) -> None:
     print(f"Eval         = {rec['EvalID']}")
     print(f"Job ID       = {rec['JobID']}")
     print(f"Type         = {rec['Type']} ({rec['TriggeredBy']})")
+    if rec.get("served_by"):
+        # follower-planned eval: the record came back through the
+        # cluster fan-in from the server that ran the scheduler
+        print(f"Served by    = {rec['served_by']}")
     if rec.get("TraceID"):
         print(f"Trace        = /v1/traces/{rec['EvalID']}")
     storm = rec.get("Storm")

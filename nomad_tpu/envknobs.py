@@ -280,6 +280,27 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "1", "nomad_tpu/explain.py",
         "0 turns placement-explanation capture into no-ops",
     ),
+    "NOMAD_TPU_OBS_HISTORY": EnvKnob(
+        "1", "nomad_tpu/telemetry.py",
+        "0 disables the periodic metric time-series history ring "
+        "(snapshot thread never starts, /v1/metrics/history empty)",
+    ),
+    "NOMAD_TPU_OBS_HISTORY_N": EnvKnob(
+        "60", "nomad_tpu/telemetry.py",
+        "metric history depth: how many snapshot windows the ring "
+        "retains (min 2)",
+    ),
+    "NOMAD_TPU_OBS_HISTORY_S": EnvKnob(
+        "10", "nomad_tpu/telemetry.py",
+        "metric history cadence: seconds between snapshot windows "
+        "(default N*S = a 10-minute rolling view)",
+    ),
+    "NOMAD_TPU_OBS_FANIN_TIMEOUT_S": EnvKnob(
+        "2.0", "nomad_tpu/server/cluster.py",
+        "per-query wall budget for the leader's /v1/cluster/* "
+        "fan-in: peers not answered within it are marked "
+        "unreachable in the merged (partial) result",
+    ),
     # -- accelerator supervisor (nomad_tpu/device) --------------------
     "NOMAD_TPU_SUPERVISOR": EnvKnob(
         "auto", "nomad_tpu/device/supervisor.py",

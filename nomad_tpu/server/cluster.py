@@ -27,6 +27,7 @@ from ..raft import InmemTransport, NotLeaderError, RaftNode
 from ..raft.transport import TransportError
 from ..state.store import StateStore
 from ..structs import new_id
+from ..trace import TRACE
 from .fsm import ServerFSM, StaleLeadershipError, encode_command
 from .membership import Gossip
 from .server import Server
@@ -56,6 +57,21 @@ def _forward_backoff_s() -> float:
         )
     except ValueError:
         return 0.05
+
+
+def obs_fanin_timeout_s() -> float:
+    """Whole-query budget for a /v1/cluster/* fan-in: peers not
+    answered (or not even asked) inside it are marked `unreachable`
+    in the merged result rather than failing the query."""
+    try:
+        return max(
+            0.0,
+            float(
+                os.environ.get("NOMAD_TPU_OBS_FANIN_TIMEOUT_S", "2.0")
+            ),
+        )
+    except ValueError:
+        return 2.0
 
 
 class ReplicatedStore:
@@ -392,6 +408,13 @@ class ClusterServer(Server):
             return self._handle_broker_settle(method, payload)
         if method == "submit_plan":
             return self._handle_submit_plan(payload)
+        if method == "obs_query":
+            # cluster observability fan-in: read-only, answered by
+            # EVERY server (not leader-gated) — each server's trace
+            # ring / metrics / history is its own
+            return self._obs_local(
+                payload["what"], payload.get("params") or {}
+            )
         if method == "server_call":
             fn = getattr(self, payload["op"])
             args, kw = pickle.loads(payload["args"])
@@ -444,8 +467,19 @@ class ClusterServer(Server):
                 "fanout.remote_unacked",
                 float(self.broker.remote_unacked_count()),
             )
+        # distributed trace propagation: every lease ships the trace
+        # context its broker-dequeue root was begun under (full trace
+        # id — generation counters are per-process — plus the
+        # wall-clock anchor), so the follower records its pipeline
+        # spans into a segment under OUR trace id
+        ctxs = {}
+        for ev, _token in leases:
+            ctx = TRACE.export_context(ev.id)
+            if ctx is not None:
+                ctxs[ev.id] = ctx
         return {
             "leases": pickle.dumps(list(leases)),
+            "trace_ctx": ctxs,
             "gen": gen,
             "ready": self.broker.ready_count(),
             # the follower's apply fence: enqueued eval OBJECTS carry
@@ -487,7 +521,24 @@ class ClusterServer(Server):
         )
         return self._lease_response(leases)
 
+    def _absorb_remote_segment(self, payload: dict) -> None:
+        """Stitch a follower's shipped span segment into the local
+        trace ring.  Runs BEFORE any leadership/token verdict on
+        purpose: a segment straggling in from a reclaimed lease still
+        documents work that happened, and trace-id routing lands it in
+        the generation it ran under — never the redelivered attempt."""
+        segment = payload.get("segment")
+        if not segment:
+            return
+        absorbed = TRACE.absorb_segment(segment)
+        self.metrics.incr("cluster.segments_absorbed")
+        if absorbed:
+            self.metrics.incr(
+                "cluster.segment_spans", float(absorbed)
+            )
+
     def _handle_broker_settle(self, method: str, payload: dict) -> dict:
+        self._absorb_remote_segment(payload)
         if not self._fanout_serving():
             return self._fanout_not_leader()
         settle = (
@@ -510,6 +561,7 @@ class ClusterServer(Server):
         return {}
 
     def _handle_submit_plan(self, payload: dict) -> dict:
+        self._absorb_remote_segment(payload)
         if not self._leader_established:
             return self._fanout_not_leader()
         plan = pickle.loads(payload["plan"])
@@ -562,6 +614,71 @@ class ClusterServer(Server):
 
     def server_members(self):
         return self.gossip.member_list()
+
+    # -- cluster observability fan-in -----------------------------------
+
+    def cluster_query(self, what: str, params: Optional[dict] = None):
+        """Fan a read-only observability query out to every known
+        same-region server over the cluster transport and merge the
+        answers.  Bounded by ``NOMAD_TPU_OBS_FANIN_TIMEOUT_S``:
+        partial results are marked per-server ``unreachable`` rather
+        than failing the whole query — a wedged peer must never make
+        the CLUSTER unobservable.  Returns
+        ``{"servers": {addr: result-or-{"unreachable": True}},
+        "asked": n, "unreachable": k}``."""
+        params = params or {}
+        budget = obs_fanin_timeout_s()
+        t0 = time.monotonic()
+        servers: dict = {self.addr: self._obs_local(what, params)}
+        unreachable = 0
+        peers = [
+            m
+            for m in self.gossip.all_members()
+            if m.addr != self.addr and m.region == self.region
+            and m.status != "left"
+        ]
+        for member in peers:
+            if time.monotonic() - t0 > budget:
+                servers[member.addr] = {"unreachable": True}
+                unreachable += 1
+                continue
+            try:
+                servers[member.addr] = self.transport.rpc(
+                    self.addr,
+                    member.addr,
+                    "obs_query",
+                    {"what": what, "params": params},
+                )
+            except (TransportError, TimeoutError, ValueError):
+                servers[member.addr] = {"unreachable": True}
+                unreachable += 1
+        self.metrics.incr("cluster.fanin_queries")
+        if unreachable:
+            self.metrics.incr(
+                "cluster.fanin_unreachable", float(unreachable)
+            )
+        # per-eval queries mark the fan-in on the eval's own trace —
+        # the waterfall shows when the operator came asking
+        eval_ref = params.get("eval_id") or (
+            params.get("ref", "").rsplit("#", 1)[0]
+            if what == "trace"
+            else ""
+        )
+        if eval_ref:
+            TRACE.add_span(
+                eval_ref,
+                "cluster.fanin",
+                t0,
+                time.monotonic() - t0,
+                what=what,
+                servers=len(servers),
+                unreachable=unreachable,
+            )
+        return {
+            "servers": servers,
+            "asked": len(servers),
+            "unreachable": unreachable,
+        }
 
     def _on_member_event(self, kind: str, member) -> None:
         # (reference serf.go nodeJoin/nodeFailed -> reconcile); raft
@@ -669,6 +786,9 @@ class ClusterServer(Server):
 
     def start(self) -> None:
         self._running = True
+        # metric history runs on every server, leader or follower —
+        # fan-in queries merge the whole cluster's rings
+        self.metrics_history.start()
         self.gossip.start()
         self.raft.start()
         self.autopilot.start()
@@ -683,11 +803,15 @@ class ClusterServer(Server):
         self.fanout.stop()
         self.autopilot.stop()
         self.raft.stop()
+        self.metrics_history.stop()
         # graceful departure: broadcast LEFT so peers don't gossip a
         # failure (serf Leave vs. a detected member-failed)
         self.gossip.leave()
         self.revoke_leadership()
         self._heartbeat_deadlines.clear()
+        # see Server.stop: a still-open overload incident settles as
+        # `shed` rather than dangling in flight forever
+        self.overload.close_incident()
         self.log_monitor.uninstall("nomad_tpu")
 
 

@@ -215,6 +215,13 @@ class EvalBroker:
         # set_enabled flushes mid-critical-section through this
         self._ready.clear()
         self._ready_ts.clear()
+        # in-flight traces must not dangle as "in flight" forever in
+        # /v1/traces after a leadership revoke: every unacked delivery
+        # dies with this flush, so settle its trace with an explicit
+        # `revoked` outcome (the next leadership's redelivery begins a
+        # fresh generation)
+        for eval_id in self._unack:
+            TRACE.finish(eval_id, "revoked")
         self._unack.clear()
         self._job_evals.clear()
         self._pending.clear()

@@ -76,6 +76,7 @@ FANOUT_COUNTERS = (
     "fanout.lease_gen_flips",  # leadership generation changed under us
     "fanout.stale_lease_drops",  # buffered leases dropped on a flip
     "fanout.apply_wait_timeouts",  # local FSM apply lagged past budget
+    "fanout.segments_shipped",  # trace segments shipped to the leader
     # leader side
     "fanout.remote_leases_granted",
     "fanout.remote_plans",
@@ -202,6 +203,19 @@ class RemoteBrokerClient:
             ev.snapshot_index = max(
                 ev.snapshot_index or 0, min_index
             )
+        # distributed trace propagation: each lease carries the
+        # LEADER's trace context — open a local recording segment
+        # under the leader's trace id so every pipeline span this
+        # server records for the eval lands in the segment and ships
+        # back on settle/submit (stale leases nacked below close
+        # their segments through the same ship path)
+        ctxs = resp.get("trace_ctx") or {}
+        for ev, _token in leases:
+            ctx = ctxs.get(ev.id)
+            if ctx:
+                TRACE.begin_segment(
+                    ev.id, ctx, server_id=self._server.addr
+                )
         stale: List[Tuple[Evaluation, str]] = []
         with self._lock:
             self._ready_hint = int(resp.get("ready", 0))
@@ -312,11 +326,26 @@ class RemoteBrokerClient:
             ):
                 return None, ""
 
+    def _ship_segment(
+        self, eval_id: str, close: bool
+    ) -> Optional[dict]:
+        """Export the eval's recorded trace segment for piggybacking
+        on the settle/submit RPC (``close=True`` on settle retires the
+        local buffer — the eval is leaving this server for good)."""
+        segment = TRACE.export_segment(
+            eval_id, self._server.addr, close=close
+        )
+        if segment is not None:
+            self._count("segments_shipped")
+        return segment
+
     def ack(self, eval_id: str, token: str) -> None:
+        payload = {"eval_id": eval_id, "token": token}
+        segment = self._ship_segment(eval_id, close=True)
+        if segment is not None:
+            payload["segment"] = segment
         try:
-            resp = self._rpc(
-                "broker_ack", {"eval_id": eval_id, "token": token}
-            )
+            resp = self._rpc("broker_ack", payload)
         except (TransportError, TimeoutError) as exc:
             # the lease holder is unreachable: the lease will expire
             # into a nack-timeout redelivery, and re-running the eval
@@ -328,10 +357,12 @@ class RemoteBrokerClient:
         self._count("acks")
 
     def nack(self, eval_id: str, token: str) -> None:
+        payload = {"eval_id": eval_id, "token": token}
+        segment = self._ship_segment(eval_id, close=True)
+        if segment is not None:
+            payload["segment"] = segment
         try:
-            resp = self._rpc(
-                "broker_nack", {"eval_id": eval_id, "token": token}
-            )
+            resp = self._rpc("broker_nack", payload)
         except (TransportError, TimeoutError) as exc:
             raise ValueError(f"remote nack failed: {exc}") from exc
         if resp.get("not_leader") or resp.get("error"):
@@ -487,10 +518,18 @@ class RemotePlanQueue:
         self._broker = broker
 
     def enqueue(self, plan) -> _DonePending:
+        payload = {"plan": pickle.dumps(plan)}
+        eval_id = getattr(plan, "eval_id", None)
+        if eval_id:
+            # ship the spans closed so far (assemble/launch/fetch/
+            # replay) with the submit — if this server dies between
+            # submit and settle, the leader's stitched trace still
+            # shows where the planning time went
+            segment = self._broker._ship_segment(eval_id, close=False)
+            if segment is not None:
+                payload["segment"] = segment
         try:
-            resp = self._broker._rpc(
-                "submit_plan", {"plan": pickle.dumps(plan)}
-            )
+            resp = self._broker._rpc("submit_plan", payload)
         except (TransportError, TimeoutError) as exc:
             # leader unreachable mid-submit: nothing committed that we
             # know of — surface as a leadership problem so the worker

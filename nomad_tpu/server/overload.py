@@ -117,6 +117,9 @@ _EXEMPT_PREFIXES = (
     "/v1/status",
     "/v1/operator",
     "/v1/traces",
+    # cluster fan-in queries: an overloaded leader shedding the
+    # cluster-wide views would blind the operator to the overload
+    "/v1/cluster",
 )
 
 # the liveness plane: heartbeats, node/client registration and
@@ -320,6 +323,29 @@ class OverloadController:
     @property
     def mode(self) -> int:
         return self._mode
+
+    def close_incident(self) -> None:
+        """Teardown hook (server stop / leadership revoke): an
+        excursion that never walked back to NORMAL would otherwise
+        leave its incident trace dangling in flight forever — settle
+        it with an explicit `shed` outcome so /v1/traces?outcome=
+        filters and trace_report's in-flight header stay honest."""
+        from ..trace import TRACE
+
+        with self._lock:
+            incident = self._incident_id
+            self._incident_id = None
+            if incident is None:
+                return
+            metrics = getattr(self.server, "metrics", None)
+            shed = (
+                metrics.get_counter("overload.shed")
+                - self._incident_shed_at_start
+                if metrics is not None
+                else 0.0
+            )
+        TRACE.annotate(incident, shed_total=shed)
+        TRACE.finish(incident, "shed")
 
     # -- admission -----------------------------------------------------
 

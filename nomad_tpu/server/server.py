@@ -253,6 +253,21 @@ class Server:
         self.metrics.preregister(
             counters=FANOUT_COUNTERS, gauges=FANOUT_GAUGES
         )
+        # cluster-scope observability: zero-register the obs.* /
+        # cluster.* family (absence-of-series must mean "no segment
+        # ever stitched / no fan-in ever asked", not "not exported")
+        # and stand up the metric time-series history ring — its
+        # snapshot thread starts with the server lifecycle
+        from ..telemetry import (
+            CLUSTER_OBS_COUNTERS,
+            CLUSTER_OBS_GAUGES,
+            MetricsHistory,
+        )
+
+        self.metrics.preregister(
+            counters=CLUSTER_OBS_COUNTERS, gauges=CLUSTER_OBS_GAUGES
+        )
+        self.metrics_history = MetricsHistory(self.metrics)
         # policy-weighted scoring: zero-register the policy.* family
         # (absence-of-series must mean "no policy-weighted select ever
         # ran" — no job carries a PolicySpec, or NOMAD_TPU_POLICY=0 —
@@ -431,12 +446,19 @@ class Server:
     def start(self) -> None:
         """Single-process mode: this server is always the leader."""
         self._running = True
+        # history snapshots run for the whole server lifetime, not
+        # just leadership — a follower's metrics are history too
+        self.metrics_history.start()
         self.establish_leadership()
 
     def stop(self) -> None:
         self._running = False
         self.revoke_leadership()
+        self.metrics_history.stop()
         self._heartbeat_deadlines.clear()
+        # an overload excursion that never walked back to NORMAL must
+        # not leave its incident trace dangling in flight
+        self.overload.close_incident()
         # detach the monitor handler or stopped servers pile up on the
         # shared logger and keep buffering every record
         self.log_monitor.uninstall("nomad_tpu")
@@ -1654,6 +1676,40 @@ class Server:
         )
         self.store.upsert_evals([ev])
         self.on_eval_update(ev)
+
+    # -- cluster observability (one server's share of a fan-in) ----------
+
+    def _obs_local(self, what: str, params: dict) -> dict:
+        """Serve this server's share of a cluster observability query
+        (the `obs_query` RPC target, and the local half of every
+        /v1/cluster/* merge).  Read-only and NOT leader-gated: every
+        server's trace ring / metrics / history is its own."""
+        from ..trace import TRACE
+
+        if what == "traces":
+            slow_ms = params.get("slow_ms")
+            limit = int(params.get("limit", 64))
+            return {
+                "traces": TRACE.recent(
+                    slow_ms=float(slow_ms)
+                    if slow_ms is not None
+                    else None,
+                    outcome=params.get("outcome"),
+                    limit=max(1, min(limit, 1024)),
+                    full=bool(params.get("full")),
+                )
+            }
+        if what == "trace":
+            return {"trace": TRACE.get(params.get("ref", ""))}
+        if what == "metrics":
+            return {"metrics": self.metrics.dump()}
+        if what == "metrics_history":
+            return {"history": self.metrics_history.to_dict()}
+        if what == "explain":
+            from ..explain import EXPLAIN
+
+            return {"explain": EXPLAIN.get(params.get("eval_id", ""))}
+        raise ValueError(f"unknown obs query {what!r}")
 
     # -- helpers ---------------------------------------------------------
 

@@ -5,11 +5,52 @@ payload, `prometheus_text()` the scrape format).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
+
+# cluster-scope observability telemetry, zero-registered at Server
+# construction (the `cluster-obs-metrics` nomadlint rule enforces
+# registry membership for every obs.* / cluster.* emission)
+CLUSTER_OBS_COUNTERS = (
+    # leader side of cross-server trace stitching (cluster.py)
+    "cluster.segments_absorbed",  # follower segments stitched in
+    "cluster.segment_spans",  # spans absorbed from segments
+    # leader fan-in queries (/v1/cluster/*)
+    "cluster.fanin_queries",
+    "cluster.fanin_unreachable",  # per-peer timeouts/failures
+    # metric time-series history (MetricsHistory below)
+    "obs.history_snapshots",
+)
+CLUSTER_OBS_GAUGES = (
+    "obs.history_windows",  # windows currently retained in the ring
+)
+
+
+def obs_history_enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_OBS_HISTORY", "1") != "0"
+
+
+def obs_history_windows() -> int:
+    try:
+        return max(
+            2, int(os.environ.get("NOMAD_TPU_OBS_HISTORY_N", "60"))
+        )
+    except ValueError:
+        return 60
+
+
+def obs_history_interval_s() -> float:
+    try:
+        return max(
+            0.05,
+            float(os.environ.get("NOMAD_TPU_OBS_HISTORY_S", "10")),
+        )
+    except ValueError:
+        return 10.0
 
 
 def percentile(ordered: List[float], q: float) -> float:
@@ -186,6 +227,24 @@ class Metrics:
                 },
             }
 
+    def dump_lean(self) -> Dict:
+        """dump() without the per-summary exemplar scan — the history
+        snapshotter's cadence payload (exemplar trace refs are a
+        point-in-time debugging surface, not a time series)."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "samples": {
+                    k: {
+                        "count": s.count,
+                        "p50": percentile(sorted(s._ring), 0.50),
+                        "p99": percentile(sorted(s._ring), 0.99),
+                    }
+                    for k, s in self._samples.items()
+                },
+            }
+
     def prometheus_text(self) -> str:
         lines: List[str] = []
         # esc() is lossy (both "." and "-" map to "_"), so two
@@ -239,3 +298,115 @@ class Metrics:
                         f'{base}{{quantile="{q}"}} {snap[key]}'
                     )
         return "\n".join(lines) + "\n"
+
+
+class MetricsHistory:
+    """Fixed-size ring of periodic metric snapshots — the first way to
+    see "p99 over the last N minutes" without an external scraper, and
+    the training-data surface the future self-tuning controller reads.
+
+    Every ``NOMAD_TPU_OBS_HISTORY_S`` seconds a snapshot thread
+    (`obs-history`) captures all registered counters (cumulative),
+    gauges (point-in-time) and sample summaries (count + p50/p99 over
+    the summary's sliding window, read at the window boundary) into a
+    ``NOMAD_TPU_OBS_HISTORY_N``-deep ring.  Memory is bounded at
+    windows x registered-metric-count small floats — sizing math in
+    docs/ARCHITECTURE.md "Cluster observability".
+
+    Served as /v1/metrics/history, captured in the operator debug
+    bundle, and fanned in cluster-wide via /v1/cluster/* queries.
+    """
+
+    def __init__(
+        self,
+        metrics: Metrics,
+        windows: Optional[int] = None,
+        interval_s: Optional[float] = None,
+    ) -> None:
+        self.metrics = metrics
+        self.enabled = obs_history_enabled()
+        self.windows = (
+            windows if windows is not None else obs_history_windows()
+        )
+        self.interval_s = (
+            interval_s
+            if interval_s is not None
+            else obs_history_interval_s()
+        )
+        self._ring: deque = deque(maxlen=self.windows)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if not self.enabled or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="obs-history", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.snapshot_once()
+
+    # -- capture -------------------------------------------------------
+
+    def snapshot_once(self) -> Dict:
+        """Capture one window (also the debug-bundle/test entry point,
+        so a capture never has to wait out the interval)."""
+        dump = self.metrics.dump_lean()
+        window = {
+            "t": time.time(),
+            "counters": dump["counters"],
+            "gauges": dump["gauges"],
+            "samples": dump["samples"],
+        }
+        with self._lock:
+            self._ring.append(window)
+            retained = len(self._ring)
+        self.metrics.incr("obs.history_snapshots")
+        self.metrics.set_gauge("obs.history_windows", float(retained))
+        return window
+
+    # -- reads ---------------------------------------------------------
+
+    def to_dict(self) -> Dict:
+        """/v1/metrics/history payload: every retained window, oldest
+        first, plus the sizing that produced them."""
+        with self._lock:
+            windows = list(self._ring)
+        return {
+            "enabled": self.enabled,
+            "interval_s": self.interval_s,
+            "max_windows": self.windows,
+            "windows": windows,
+        }
+
+    def series(self, name: str) -> List[Dict]:
+        """One metric's time series across the retained windows —
+        [{t, value}] for counters/gauges, [{t, count, p50, p99}] for
+        samples."""
+        with self._lock:
+            windows = list(self._ring)
+        out: List[Dict] = []
+        for w in windows:
+            if name in w["samples"]:
+                entry = dict(w["samples"][name])
+                entry["t"] = w["t"]
+                out.append(entry)
+            elif name in w["counters"]:
+                out.append({"t": w["t"], "value": w["counters"][name]})
+            elif name in w["gauges"]:
+                out.append({"t": w["t"], "value": w["gauges"][name]})
+        return out

@@ -133,6 +133,14 @@ SPAN_NAMES = frozenset(
         # serialized-commit round trip into the leader's plan queue
         "fanout.remote_dequeue",
         "fanout.plan_submit",
+        # cluster-scope observability: `fanout.remote_span_ship`
+        # marks a follower exporting its recorded span segment back
+        # to the leader (piggybacked on the settle/submit RPC;
+        # spans = segment size), `cluster.fanin` spans a leader's
+        # fan-in query over the cluster transport (servers = peers
+        # asked, unreachable = peers that timed out)
+        "fanout.remote_span_ship",
+        "cluster.fanin",
         # plan pipeline + state commit
         "plan.evaluate",
         "plan.apply",
@@ -201,6 +209,7 @@ class Trace:
         "_open",
         "_seq",
         "_lock",
+        "_shipped",
     )
 
     def __init__(self, eval_id: str, gen: int, attrs: dict) -> None:
@@ -220,6 +229,9 @@ class Trace:
         self._open: Dict[int, List[int]] = {}
         self._seq = 0
         self._lock = threading.Lock()
+        # span ids already exported by export_segment (segment traces
+        # on fan-out followers only; empty everywhere else)
+        self._shipped: set = set()
 
     # -- recording -----------------------------------------------------
 
@@ -323,6 +335,94 @@ class Trace:
                 1 for s in self.spans if s[4] is None
             )
 
+    # -- cross-server segment shipping ---------------------------------
+
+    def export_segment(self, server_id: str) -> Optional[Dict]:
+        """Export the CLOSED spans not shipped by a previous export as
+        a wire segment (fan-out followers piggyback this on the settle
+        / submit RPC).  Offsets are seconds relative to this trace's
+        ``t0``; the segment carries the trace's ``wall0`` wall-clock
+        anchor so the receiver can map them onto its own monotonic
+        clock (clock skew between hosts shows up as shifted lanes —
+        trace_report flags skew-suspect gaps rather than us trusting
+        cross-host monotonic deltas)."""
+        with self._lock:
+            fresh = [
+                s
+                for s in self.spans
+                if s[4] is not None and s[0] not in self._shipped
+            ]
+            for s in fresh:
+                self._shipped.add(s[0])
+            spans = [
+                {
+                    "id": s[0],
+                    "parent": s[1],
+                    "name": s[2],
+                    "off": s[3] - self.t0,
+                    "dur": s[4],
+                    "thread": s[5],
+                    "attrs": dict(s[6]),
+                }
+                for s in fresh
+            ]
+            attrs = dict(self.attrs)
+        if not spans and "outcome" not in attrs:
+            return None
+        return {
+            "trace_id": self.trace_id,
+            "server_id": server_id,
+            "wall0": self.wall0,
+            "spans": spans,
+            "attrs": attrs,
+        }
+
+    def absorb_segment(self, segment: Dict) -> int:
+        """Merge a shipped segment's spans into this trace: remote
+        offsets are re-anchored via the wall-clock deltas, span ids are
+        remapped into this trace's sequence (parent links within the
+        segment batch are preserved; a parent shipped in an *earlier*
+        batch attaches flat), and every span is stamped with the
+        shipping ``server_id``.  Bypasses the pre-``t0`` staleness
+        guard on purpose — segment routing already matched the full
+        trace id, so generation confusion is impossible here and a
+        skewed remote clock must not silently drop spans."""
+        base = self.t0 + (segment.get("wall0", self.wall0) - self.wall0)
+        server_id = segment.get("server_id", "")
+        absorbed = 0
+        with self._lock:
+            remap: Dict[int, int] = {}
+            for s in segment.get("spans", ()):
+                if len(self.spans) >= MAX_SPANS:
+                    self.dropped += 1
+                    continue
+                sid = self._seq
+                self._seq += 1
+                remap[s["id"]] = sid
+                attrs = dict(s.get("attrs") or {})
+                if server_id:
+                    attrs.setdefault("server_id", server_id)
+                self.spans.append(
+                    [
+                        sid,
+                        remap.get(s.get("parent")),
+                        s["name"],
+                        base + s["off"],
+                        s["dur"],
+                        s.get("thread", ""),
+                        attrs,
+                    ]
+                )
+                absorbed += 1
+            if self.finished:
+                # late segment into an already-settled trace (the
+                # normal nack/redelivery race): keep the orphan count
+                # honest for the spans that just landed
+                self.orphans = sum(
+                    1 for s in self.spans if s[4] is None
+                )
+        return absorbed
+
     # -- serialization -------------------------------------------------
 
     def duration_ms(self) -> Optional[float]:
@@ -380,6 +480,12 @@ class Tracer:
         # newest trace per eval id (ring members only) — the append
         # surface every instrumented call site goes through
         self._by_id: Dict[str, Trace] = {}
+        # follower-side recording buffers for evals leased from a
+        # remote leader, keyed by eval id: they carry the LEADER's
+        # trace id, collect this server's pipeline spans, and are
+        # shipped back (export_segment) rather than retained — they
+        # never enter the ring
+        self._segments: Dict[str, Trace] = {}
         self._gen = itertools.count()
         self.enabled = os.environ.get("NOMAD_TPU_TRACE", "1") != "0"
         # happens-before sanitizer (NOMAD_TPU_TSAN=1)
@@ -421,14 +527,140 @@ class Tracer:
         if trace is not None:
             trace.finish(outcome)
 
+    # -- cross-server propagation --------------------------------------
+
+    def export_context(self, eval_id: str) -> Optional[Dict]:
+        """Trace context shipped with a remote broker lease: the full
+        trace id (generation counters are per-process, so the string is
+        the only cross-server identity) plus the wall-clock anchor the
+        follower needs to re-anchor its segment offsets."""
+        trace = self._by_id.get(eval_id)
+        if trace is None:
+            return None
+        return {"trace_id": trace.trace_id, "wall0": trace.wall0}
+
+    def begin_segment(self, eval_id: str, ctx: Dict, **attrs) -> None:
+        """Follower side of lease propagation: open a local recording
+        segment under the LEADER's trace id.  Instrumented call sites
+        resolve by eval id, so every existing pipeline span lands here
+        transparently; the segment is shipped back on settle/submit
+        and never enters the local ring.  A redelivered lease opens a
+        fresh segment that supersedes the old one — same semantics as
+        ``begin`` on the leader."""
+        if not self.enabled or not eval_id or not ctx:
+            return
+        trace_id = ctx.get("trace_id") or ""
+        if not trace_id:
+            return
+        segment = Trace(eval_id, 0, attrs)
+        segment.trace_id = trace_id
+        with self._lock:
+            prior = self._segments.get(eval_id)
+            if prior is not None and not prior.finished:
+                prior.finish("superseded")
+            self._segments[eval_id] = segment
+
+    def export_segment(
+        self,
+        eval_id: str,
+        server_id: str,
+        close: bool = False,
+        outcome: str = "shipped",
+    ) -> Optional[Dict]:
+        """Export the eval's segment spans closed since the last
+        export; ``close=True`` (the settle RPC) also retires the local
+        segment so the follower isn't left holding in-flight buffers
+        for evals it no longer owns."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            segment = self._segments.get(eval_id)
+        if segment is None:
+            return None
+        # the ship itself is part of the record: a zero-duration mark
+        # on the segment (and in this batch) shows when each export
+        # left this server on the stitched waterfall
+        segment.add_span(
+            "fanout.remote_span_ship",
+            time.monotonic(),
+            0.0,
+            {"server_id": server_id},
+        )
+        out = segment.export_segment(server_id)
+        if close:
+            with self._lock:
+                if self._segments.get(eval_id) is segment:
+                    del self._segments[eval_id]
+            segment.finish(outcome)
+        return out
+
+    def absorb_segment(self, segment: Optional[Dict]) -> int:
+        """Leader side: merge a shipped segment into the ring trace
+        with the MATCHING full trace id.  Routing by trace id — not
+        bare eval id — is what makes redelivery supersede across
+        servers: a segment straggling in from a dead follower carries
+        the old generation's trace id and lands in that (settled)
+        trace, never interleaving into the redelivered attempt."""
+        if not self.enabled or not segment:
+            return 0
+        trace_id = segment.get("trace_id") or ""
+        if not trace_id:
+            return 0
+        eval_id = trace_id.rsplit("#", 1)[0]
+        target = self._by_id.get(eval_id)
+        if target is None or target.trace_id != trace_id:
+            target = None
+            with self._lock:
+                candidates = list(self._ring)
+            for trace in reversed(candidates):
+                if trace.trace_id == trace_id:
+                    target = trace
+                    break
+        if target is None:
+            return 0
+        absorbed = target.absorb_segment(segment)
+        attrs = segment.get("attrs") or {}
+        outcome = attrs.get("outcome")
+        if outcome and not target.finished:
+            # the follower's richer outcome annotation ("speculative",
+            # "prescored", ...) travels in the segment attrs; a
+            # successful ack consumes it in Trace.finish
+            target.annotate({"outcome": outcome})
+        return absorbed
+
+    def open_segments(self) -> int:
+        """Count of live follower-side recording segments."""
+        with self._lock:
+            return len(self._segments)
+
     # -- recording -----------------------------------------------------
+
+    def _resolve(self, eval_id: str) -> Optional[Trace]:
+        """Recording target for an eval: a live leased segment wins
+        over the ring entry, but only while it is current — if the
+        eval was re-begun locally under a NEW trace id (the lease was
+        reclaimed and redelivered here), the stale segment is dropped
+        rather than swallowing the new attempt's spans."""
+        with self._lock:
+            segment = self._segments.get(eval_id)
+            if segment is not None:
+                current = self._by_id.get(eval_id)
+                if (
+                    current is None
+                    or current.trace_id == segment.trace_id
+                ):
+                    return segment
+                del self._segments[eval_id]
+        if segment is not None:
+            segment.finish("superseded")
+        return self._by_id.get(eval_id)
 
     def span(self, eval_id: str, name: str, **attrs):
         """Context manager timing a span on the eval's trace; no-op
         when tracing is off or the eval has no trace."""
         if not self.enabled:
             return _NULL
-        trace = self._by_id.get(eval_id)
+        trace = self._resolve(eval_id)
         if trace is None:
             return _NULL
         return _SpanCtx(trace, name, attrs)
@@ -439,21 +671,21 @@ class Tracer:
     ) -> None:
         if not self.enabled:
             return
-        trace = self._by_id.get(eval_id)
+        trace = self._resolve(eval_id)
         if trace is not None:
             trace.add_span(name, start, duration, attrs)
 
     def event(self, eval_id: str, name: str, **attrs) -> None:
         if not self.enabled:
             return
-        trace = self._by_id.get(eval_id)
+        trace = self._resolve(eval_id)
         if trace is not None:
             trace.add_span(name, time.monotonic(), 0.0, attrs)
 
     def annotate(self, eval_id: str, **attrs) -> None:
         if not self.enabled:
             return
-        trace = self._by_id.get(eval_id)
+        trace = self._resolve(eval_id)
         if trace is not None:
             trace.annotate(attrs)
 
@@ -461,8 +693,10 @@ class Tracer:
 
     def trace_id_of(self, eval_id: str) -> str:
         """Current trace id for an eval (newest generation), "" when
-        untracked — the placement-explanation cross-link."""
-        trace = self._by_id.get(eval_id)
+        untracked — the placement-explanation cross-link.  On a
+        fan-out follower this resolves through the leased segment, so
+        the link points at the leader's stitched trace."""
+        trace = self._resolve(eval_id)
         return trace.trace_id if trace is not None else ""
 
     def get(self, ref: str) -> Optional[Dict]:
@@ -511,6 +745,7 @@ class Tracer:
         with self._lock:
             self._ring.clear()
             self._by_id.clear()
+            self._segments.clear()
 
 
 TRACE = Tracer()

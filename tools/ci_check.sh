@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (29 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (30 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -88,6 +88,31 @@ print('policy gate green:', {
     'fast_share_gain': out['throughput']['fast_share_gain'],
     'migrations_avoided': out['migration']['migrations_avoided'],
     'score_delta': out['migration']['score_delta'],
+})
+"
+
+    echo "== cluster observability gate (stitching + fan-in, scaled) =="
+    # the cluster-scope observability gate: the fan-out workload with
+    # the flight recorder A/B'd on/off — trace overhead within the
+    # <5% contract (with the unit gate's additive slack), stitched
+    # cross-server traces actually produced (spans from >=2 servers
+    # on one leader-side waterfall), zero orphan spans, the leader
+    # fan-in query answering at 1/3/5 servers, and the metric
+    # history ring capped at its configured depth.  Scaled below the
+    # BENCH acceptance run; the kill-timeout fails a wedged cluster
+    timeout -k 10 600 env JAX_PLATFORMS=cpu BENCH_OBS_FAMILIES=48 \
+        BENCH_OBS_NODES=128 BENCH_OBS_REPS=1 python -c "
+import bench
+out = bench.bench_cluster_obs()
+assert out['overhead_ok'], out
+assert out['stitched_traces_min'] > 0, out
+assert out['orphan_spans'] == 0, out
+assert len(out['fanin_query_latency']) == 3, out
+assert out['history_ring']['windows'] == 60, out
+print('cluster-obs gate green:', {
+    'overhead_pct': out['stitched_overhead_pct'],
+    'stitched_min': out['stitched_traces_min'],
+    'fanin_ms': out['fanin_query_latency'],
 })
 "
 

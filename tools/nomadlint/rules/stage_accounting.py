@@ -192,6 +192,13 @@ class SpanRegistryRule(Rule):
         # follower-planned eval's trace loses its cross-server hops
         "fanout.remote_dequeue",
         "fanout.plan_submit",
+        # cluster-scope observability: the follower's segment-ship
+        # marker (the stitched waterfall's cross-server seam) and
+        # the leader's fan-in query span — without them a stitched
+        # trace can't show WHEN spans left the follower, and a slow
+        # /v1/cluster/* query has no flight-recorder trail
+        "fanout.remote_span_ship",
+        "cluster.fanin",
         # the overload control plane's incident roots: the per-
         # excursion shed incident and the batched mass node-death
         # wave — without them an overload or a rack death leaves no
@@ -1228,6 +1235,90 @@ class FanoutMetricsRule(Rule):
             append=(
                 "def _nomadlint_bad_fixture(self):\n"
                 '    self._count_fanout("bogus_kind")\n'
+            ),
+        )
+
+
+@register
+class ClusterObsMetricsRule(Rule):
+    """Cluster-scope observability plane: every ``cluster.*`` /
+    ``obs.*`` metric emitted by telemetry.py, cluster.py, fanout.py,
+    server.py or api/http.py — literal first args of metric calls —
+    is in the zero-registered ``CLUSTER_OBS_COUNTERS`` /
+    ``CLUSTER_OBS_GAUGES`` registries (telemetry.py) and server.py
+    preregisters both at construction: absence of a
+    ``cluster.fanin_queries`` or ``obs.history_snapshots`` series
+    must mean "nothing happened", never "not exported"."""
+
+    name = "cluster-obs-metrics"
+    description = "cluster.*/obs.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        telemetry_path = ctx.path("telemetry")
+        registry = astutil.assigned_strings(
+            ctx.tree(telemetry_path), "CLUSTER_OBS_COUNTERS"
+        ) | astutil.assigned_strings(
+            ctx.tree(telemetry_path), "CLUSTER_OBS_GAUGES"
+        )
+        if not registry:
+            return [
+                Finding(
+                    self.name, telemetry_path, 0,
+                    "could not find the CLUSTER_OBS_COUNTERS/"
+                    "CLUSTER_OBS_GAUGES registries in telemetry.py",
+                )
+            ]
+        problems: List[Finding] = []
+        for key in (
+            "telemetry", "cluster", "fanout", "server", "api_http",
+        ):
+            path = ctx.path(key)
+            emitted: Set[str] = set()
+            for node in ast.walk(ctx.tree(path)):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in astutil.METRIC_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith(
+                        ("cluster.", "obs.")
+                    )
+                ):
+                    emitted.add(node.args[0].value)
+            unregistered = emitted - registry
+            if unregistered:
+                problems.append(
+                    Finding(
+                        self.name, path, 0,
+                        "cluster.*/obs.* metrics emitted but not in "
+                        "the CLUSTER_OBS_COUNTERS/CLUSTER_OBS_GAUGES "
+                        "registries (they would be absent from "
+                        "prometheus scrapes until the first fan-in "
+                        "query or history snapshot): "
+                        f"{sorted(unregistered)}",
+                    )
+                )
+        server_src = ctx.source(ctx.path("server"))
+        if "CLUSTER_OBS_COUNTERS" not in server_src:
+            problems.append(
+                Finding(
+                    self.name, ctx.path("server"), 0,
+                    "server.py no longer zero-registers the "
+                    "cluster.*/obs.* families at construction "
+                    "(CLUSTER_OBS_COUNTERS preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "cluster",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.incr("cluster.bogus_metric")\n'
             ),
         )
 

@@ -2,22 +2,33 @@
 """Render eval flight-recorder traces as indented terminal waterfalls.
 
 Input is the JSON the server serves at ``/v1/traces/<eval_id>`` (one
-trace) or ``/v1/traces?full=1`` (a list).  Sources: an HTTP(S) URL, a
-file path, or ``-`` for stdin.
+trace), ``/v1/traces?full=1`` (a list), or the cluster-scope
+``/v1/cluster/traces[/<ref>]`` fan-in shapes.  Sources: an HTTP(S)
+URL, a file path, or ``-`` for stdin.
 
     python tools/trace_report.py http://127.0.0.1:4646/v1/traces/abc123
     python tools/trace_report.py 'http://127.0.0.1:4646/v1/traces?full=1&slow_ms=50'
-    curl -s .../v1/traces/abc123 | python tools/trace_report.py -
+    curl -s .../v1/cluster/traces/abc123 | python tools/trace_report.py -
 
 Output per trace: a header line (eval id, outcome, total duration,
 span/drop counts) and one row per span — offset from the trace root,
-a depth-indented name, the span duration, a proportional bar, and the
-non-default attributes — so a slow eval reads as a waterfall:
+a per-server lane tag, a depth-indented name, the span duration, a
+proportional bar, and the non-default attributes — so a slow eval
+reads as a waterfall:
 
     trace 53a1b2#7 outcome=speculative 12.41ms spans=12
-        0.00ms  broker.dequeue            0.00ms            queue=service
-        0.21ms  batch_worker.simulate     1.20ms  ==
+        0.00ms  [leader  ]  broker.dequeue            0.00ms  queue=service
+        0.21ms  [server-1]  batch_worker.simulate     1.20ms  ==
         ...
+
+Stitched cross-server traces get one lane per ``server_id``: spans a
+follower recorded and shipped back carry that follower's id in the
+lane column, spans the serving server recorded itself show in the
+``leader`` lane.  Remote segments are re-anchored onto the leader's
+clock via wall-time deltas, so a span that lands before the trace
+root or past its end is flagged ``CLOCK-SKEW?`` rather than silently
+reordered — the gap is real evidence of clock disagreement between
+the two servers, not of time travel.
 """
 from __future__ import annotations
 
@@ -26,6 +37,10 @@ import sys
 from typing import Dict, List
 
 BAR_WIDTH = 24
+# remote segments are wall-clock re-anchored; offsets outside the
+# trace's own [0, total] envelope by more than this many ms are
+# flagged as clock-skew suspects instead of being trusted
+SKEW_EPS_MS = 0.05
 
 
 def _load(source: str):
@@ -63,20 +78,51 @@ def _fmt_attrs(attrs: Dict) -> str:
     return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
 
 
+def _lane(span: Dict, local: str) -> str:
+    return (span.get("attrs") or {}).get("server_id") or local
+
+
+def _skew_suspect(span: Dict, total) -> bool:
+    off = span.get("off_ms", 0.0)
+    if off < -SKEW_EPS_MS:
+        return True
+    dur = span.get("dur_ms")
+    if total is not None and dur is not None:
+        return off + dur > total + SKEW_EPS_MS
+    return False
+
+
 def render_trace(trace: Dict) -> str:
     """One trace -> waterfall text (no trailing newline)."""
     spans = sorted(trace.get("spans") or [], key=lambda s: s["off_ms"])
     total = trace.get("duration_ms")
+    # lane name for spans the serving server recorded itself: the
+    # cluster endpoint stamps the winning server as "server"
+    local = trace.get("server") or "leader"
+    lanes = {_lane(s, local) for s in spans}
+    multi_lane = len(lanes) > 1
+    skew = sum(1 for s in spans if _skew_suspect(s, total))
     header = (
         f"trace {trace.get('trace_id', trace.get('eval_id', '?'))} "
         f"outcome={trace.get('outcome')} "
         + (f"{total:.2f}ms " if total is not None else "(in flight) ")
         + f"spans={len(spans)}"
     )
+    if multi_lane:
+        header += f" servers={len(lanes)}"
     if trace.get("dropped"):
         header += f" dropped={trace['dropped']}"
     if trace.get("orphans"):
         header += f" ORPHANS={trace['orphans']}"
+    if skew:
+        header += f" CLOCK-SKEW-SUSPECT={skew}"
+    if trace.get("servers"):
+        # cluster fan-in pick: which peers answered the query
+        reach = trace["servers"]
+        bad = sorted(a for a, st in reach.items() if st != "ok")
+        header += f"\n  fan-in: asked={len(reach)}" + (
+            f" unreachable={','.join(bad)}" if bad else ""
+        )
     if trace.get("attrs"):
         header += "\n  " + _fmt_attrs(trace["attrs"])
     lines = [header]
@@ -85,6 +131,7 @@ def render_trace(trace: Dict) -> str:
         (len(s["name"]) + 2 * depths[s["id"]] for s in spans),
         default=0,
     )
+    lane_w = max((len(lane) for lane in lanes), default=0)
     scale = total if total else 1.0
     for s in spans:
         dur = s.get("dur_ms")
@@ -93,21 +140,42 @@ def render_trace(trace: Dict) -> str:
             bar = "=" * max(1, round(dur / scale * BAR_WIDTH))
         name = "  " * depths[s["id"]] + s["name"]
         dur_txt = f"{dur:.2f}ms" if dur is not None else "OPEN"
+        lane_txt = (
+            f"[{_lane(s, local):<{lane_w}}]  " if multi_lane else ""
+        )
         row = (
-            f"  {s['off_ms']:9.2f}ms  {name:<{name_w}}  "
+            f"  {s['off_ms']:9.2f}ms  {lane_txt}{name:<{name_w}}  "
             f"{dur_txt:>10}  {bar:<{BAR_WIDTH}}"
         )
         extras = dict(s.get("attrs") or {})
+        if multi_lane:
+            extras.pop("server_id", None)  # shown as the lane tag
         if s.get("thread"):
             extras["thread"] = s["thread"]
         if extras:
             row += f"  {_fmt_attrs(extras)}"
+        if _skew_suspect(s, total):
+            row = row.rstrip() + "  CLOCK-SKEW?"
         lines.append(row.rstrip())
     return "\n".join(lines)
 
 
 def render(payload) -> str:
     """A trace dict or a list of them (summaries allowed) -> text."""
+    if isinstance(payload, dict) and isinstance(
+        payload.get("traces"), list
+    ):
+        # /v1/cluster/traces fan-in envelope: unwrap, keep the
+        # per-server reachability as a trailer
+        parts = [render(payload["traces"])]
+        reach = payload.get("servers") or {}
+        bad = sorted(a for a, st in reach.items() if st != "ok")
+        if reach:
+            parts.append(
+                f"fan-in: asked={len(reach)}"
+                + (f" unreachable={','.join(bad)}" if bad else "")
+            )
+        return "\n\n".join(p for p in parts if p)
     if isinstance(payload, list):
         parts = []
         for entry in payload:
@@ -116,6 +184,11 @@ def render(payload) -> str:
             else:
                 # listing without ?full=1: summaries only
                 dur = entry.get("duration_ms")
+                where = (
+                    f" server={entry['server']}"
+                    if entry.get("server")
+                    else ""
+                )
                 parts.append(
                     f"trace {entry.get('trace_id')} "
                     f"outcome={entry.get('outcome')} "
@@ -124,8 +197,9 @@ def render(payload) -> str:
                         if dur is not None
                         else "(in flight) "
                     )
-                    + f"spans={entry.get('spans')} "
-                    "(fetch /v1/traces/<eval_id> for the waterfall)"
+                    + f"spans={entry.get('spans')}"
+                    + where
+                    + " (fetch /v1/traces/<eval_id> for the waterfall)"
                 )
         return "\n\n".join(parts)
     return render_trace(payload)
