@@ -1036,6 +1036,7 @@ WITH_CLUSTER_FANOUT = (
 )
 WITH_BIGWORLD = os.environ.get("BENCH_BIGWORLD", "1") == "1"
 WITH_CLUSTER_OBS = os.environ.get("BENCH_CLUSTER_OBS", "1") == "1"
+WITH_FEDERATION = os.environ.get("BENCH_FEDERATION", "1") == "1"
 
 
 def bench_bigworld():
@@ -1326,6 +1327,33 @@ def bench_swarm():
         f"death {block['death_nodes']} nodes in "
         f"{block['storm_solves']:.0f} solve(s), "
         f"eval p99 {block['eval_latency_p99_ms']}ms "
+        f"({time.time() - t0:.1f}s)"
+    )
+    return block
+
+
+def bench_federation():
+    """Geo-plane SLO harness as a bench block
+    (nomad_tpu.loadgen.geo_smoke): two 3-server regions federated
+    over one WAN — cross-region forward latency, fan-out registration
+    latency, shed-redirect p99, region-kill detect/failover times and
+    the wan-reads-stay-zero verdict (`federation` in BENCH json).
+    BENCH_FEDERATION=0 opts out; BENCH_FEDERATION_FLOOD rescales the
+    shed flood."""
+    from nomad_tpu.loadgen.geo_smoke import run_geo
+
+    t0 = time.time()
+    block = run_geo(
+        flood_submitters=int(
+            os.environ.get("BENCH_FEDERATION_FLOOD", 96)
+        ),
+    )
+    log(
+        f"federation: ok={block['ok']} "
+        f"forward p99 {block['forward_p99_ms']}ms "
+        f"fanout max {block['fanout_register_max_ms']}ms "
+        f"kill detect {block['kill_detect_s']}s "
+        f"failover p99 {block['failover_p99_s']}s "
         f"({time.time() - t0:.1f}s)"
     )
     return block
@@ -2288,6 +2316,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"bigworld bench FAILED: {exc!r}")
             bigworld = {"error": repr(exc)}
+    federation = {}
+    if WITH_FEDERATION:
+        try:
+            federation = bench_federation()
+        except Exception as exc:  # noqa: BLE001
+            log(f"federation bench FAILED: {exc!r}")
+            federation = {"error": repr(exc)}
 
     n_check = min(E2E_ORACLE_JOBS, E2E_JOBS)
     parity_ok = same == n_check
@@ -2358,6 +2393,12 @@ def main():
                 # per-host bytes-per-flush, follower snapshot
                 # catch-up time, zero-lost + pod digest parity)
                 "bigworld": bigworld,
+                # multi-region federation: two 3-server regions over
+                # one WAN — cross-region forward latency, fan-out
+                # registration latency, shed-redirect p99 and the
+                # region-kill drill's detect/failover times
+                # (wan_reads stays zero for region-local traffic)
+                "federation": federation,
                 # swarm-scale SLO harness: overload sheds + mass
                 # node-death storm recovery against the real HTTP
                 # API (zero lost / zero false downs / hb >=99.9% /

@@ -14,12 +14,13 @@ namespace capability the route requires (reference nomad/acl.go).
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 from dataclasses import replace as dc_replace
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, urlencode, urlparse
 
 from ..structs import DrainStrategy, SchedulerConfiguration, PreemptionConfig
 from .codec import (
@@ -61,6 +62,21 @@ class HTTPError(Exception):
     def __init__(self, code: int, message: str) -> None:
         super().__init__(message)
         self.code = code
+
+
+def _fed_proxy_timeout_s() -> float:
+    """Deadline for a ?region= read proxied to another region's
+    advertised HTTP address — a wedged remote region must cost the
+    caller a bounded wait, never a pinned thread."""
+    try:
+        return max(
+            0.1,
+            float(
+                os.environ.get("NOMAD_TPU_FED_PROXY_TIMEOUT_S", "2")
+            ),
+        )
+    except ValueError:
+        return 2.0
 
 
 class APIHandler(BaseHTTPRequestHandler):
@@ -269,10 +285,18 @@ class APIHandler(BaseHTTPRequestHandler):
             raise HTTPError(403, "Permission denied")
 
     @staticmethod
-    def _cluster_obs(srv, what: str, params: dict) -> dict:
+    def _cluster_obs(
+        srv, what: str, params: dict, region: Optional[str] = None
+    ) -> dict:
         """Cluster observability fan-in when the server is
         cluster-capable; a single-process Server answers with its
-        local share in the same merged shape."""
+        local share in the same merged shape.  The fan-in is
+        region-local by construction — an explicit ``region``
+        (the ?region= escape hatch) forwards the whole query to that
+        region's leader and counts a federation.wan_reads."""
+        regional = getattr(srv, "cluster_query_region", None)
+        if regional is not None:
+            return regional(what, params, region=region)
         query = getattr(srv, "cluster_query", None)
         if query is not None:
             return query(what, params)
@@ -301,21 +325,46 @@ class APIHandler(BaseHTTPRequestHandler):
         ladder.  Clients (the CLI, the swarm harness, any
         well-behaved SDK) back off for Retry-After seconds and retry
         — bounded sheds absorb the overload instead of an unbounded
-        broker backlog absorbing the p99."""
+        broker backlog absorbing the p99.
+
+        On a federated server, the shed also names the nearest
+        healthy OTHER region (X-Nomad-Retry-Region, with one of its
+        advertised HTTP addresses) derived from gossip health — a
+        redirect-aware client moves its traffic to the next region
+        instead of hammering this dying one."""
         from ..server.overload import MODE_NAMES
 
-        data = json.dumps(
-            {
-                "error": "server overloaded",
-                "Mode": MODE_NAMES[mode],
-                "RetryAfter": retry_after_s,
-            }
-        ).encode()
+        body = {
+            "error": "server overloaded",
+            "Mode": MODE_NAMES[mode],
+            "RetryAfter": retry_after_s,
+        }
+        hint = None
+        fed = getattr(self.server_ref, "federation", None)
+        if fed is not None:
+            try:
+                hint = fed.nearest_healthy_region()
+            except Exception:  # noqa: BLE001 — hint is best-effort
+                hint = None
+        if hint is not None:
+            region, http_addr = hint
+            body["RetryRegion"] = region
+            body["RetryRegionAddr"] = http_addr
+            metrics = getattr(self.server_ref, "metrics", None)
+            if metrics is not None:
+                metrics.incr("federation.shed_redirects")
+        data = json.dumps(body).encode()
         self.send_response(429)
         self.send_header("Content-Type", "application/json")
         self.send_header(
             "Retry-After", str(max(1, int(round(retry_after_s))))
         )
+        if hint is not None:
+            self.send_header("X-Nomad-Retry-Region", hint[0])
+            if hint[1]:
+                self.send_header(
+                    "X-Nomad-Retry-Region-Addr", hint[1]
+                )
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -341,6 +390,23 @@ class APIHandler(BaseHTTPRequestHandler):
                 if not admitted:
                     self._shed(retry_after, ctl.mode)
                     return
+            # the ?region= escape hatch: reads stay region-local by
+            # default; an EXPLICIT foreign region proxies the GET to
+            # that region's advertised HTTP address and counts a
+            # federation.wan_reads.  /v1/cluster/* keeps its own
+            # transport-level forward (works without remote HTTP
+            # listeners), so it is excluded here.
+            region = query.get("region")
+            srv = self.server_ref
+            if (
+                method == "GET"
+                and region
+                and region != getattr(srv, "region", region)
+                and getattr(srv, "federation", None) is not None
+                and not path.startswith("/v1/cluster")
+            ):
+                self._proxy_region(region, path, query)
+                return
             # blocking queries (reference rpc.go:780 blockingRPC): a GET
             # with ?index=N long-polls until the state advances past N
             # (or the wait expires), then responds with fresh data; the
@@ -395,6 +461,59 @@ class APIHandler(BaseHTTPRequestHandler):
             self._error(400, str(exc))
         except Exception as exc:  # noqa: BLE001
             self._error(500, f"{type(exc).__name__}: {exc}")
+
+    def _proxy_region(
+        self, region: str, path: str, query: Dict[str, str]
+    ) -> None:
+        """Forward one GET to ``region``'s advertised HTTP address
+        (learned through WAN gossip) and relay the answer verbatim —
+        the explicit WAN read the federation.wan_reads counter
+        accounts for."""
+        import urllib.error
+        import urllib.request
+
+        srv = self.server_ref
+        target = srv.federation.http_addr_in(region)
+        if target is None:
+            raise HTTPError(
+                502, f"no HTTP address known in region {region!r}"
+            )
+        metrics = getattr(srv, "metrics", None)
+        if metrics is not None:
+            metrics.incr("federation.wan_reads")
+        qs = urlencode(
+            {k: v for k, v in query.items() if k != "region"}
+        )
+        url = f"http://{target}{path}" + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(url, method="GET")
+        token = self.headers.get("X-Nomad-Token")
+        if token:
+            req.add_header("X-Nomad-Token", token)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=_fed_proxy_timeout_s()
+            ) as resp:
+                code = resp.status
+                ctype = resp.headers.get(
+                    "Content-Type", "application/json"
+                )
+                data = resp.read()
+        except urllib.error.HTTPError as exc:
+            code = exc.code
+            ctype = exc.headers.get(
+                "Content-Type", "application/json"
+            )
+            data = exc.read()
+        except (OSError, urllib.error.URLError) as exc:
+            raise HTTPError(
+                502, f"region {region!r} proxy failed: {exc}"
+            )
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("X-Nomad-Proxied-Region", region)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     # -- routes (reference http.go registerHandlers) --------------------
 
@@ -561,6 +680,24 @@ class APIHandler(BaseHTTPRequestHandler):
             self._check_acl("read-job", ns)
             try:
                 self._respond(srv.job_summary(ns, m.group(1)))
+            except KeyError:
+                raise HTTPError(404, "job not found")
+            return True
+
+        m = re.fullmatch(r"/v1/job/([^/]+)/federation", path)
+        if m and method == "GET":
+            # per-region registration/placement status of a federated
+            # job: the local region answers from local state, every
+            # other region in the job's Multiregion block is asked
+            # live over region_call
+            self._check_acl("read-job", ns)
+            fed = getattr(srv, "federation", None)
+            if fed is None:
+                raise HTTPError(
+                    400, "server is not federation-capable"
+                )
+            try:
+                self._respond(fed.federation_status(ns, m.group(1)))
             except KeyError:
                 raise HTTPError(404, "job not found")
             return True
@@ -1842,7 +1979,9 @@ class APIHandler(BaseHTTPRequestHandler):
             }
             if "slow_ms" in q:
                 params["slow_ms"] = q["slow_ms"]
-            merged = self._cluster_obs(srv, "traces", params)
+            merged = self._cluster_obs(
+                srv, "traces", params, region=q.get("region")
+            )
             traces = []
             status = {}
             seen = set()
@@ -1879,7 +2018,10 @@ class APIHandler(BaseHTTPRequestHandler):
         m = re.fullmatch(r"/v1/cluster/traces/([^/]+)", path)
         if m and method == "GET":
             self._check_acl("agent:read")
-            merged = self._cluster_obs(srv, "trace", {"ref": m.group(1)})
+            merged = self._cluster_obs(
+                srv, "trace", {"ref": m.group(1)},
+                region=q.get("region"),
+            )
             best = None
             best_server = None
             status = {}
@@ -1910,7 +2052,9 @@ class APIHandler(BaseHTTPRequestHandler):
 
         if path == "/v1/cluster/metrics" and method == "GET":
             self._check_acl("agent:read")
-            merged = self._cluster_obs(srv, "metrics", {})
+            merged = self._cluster_obs(
+                srv, "metrics", {}, region=q.get("region")
+            )
             servers = {
                 addr: (
                     {"unreachable": True}
@@ -1929,7 +2073,9 @@ class APIHandler(BaseHTTPRequestHandler):
 
         if path == "/v1/cluster/metrics/history" and method == "GET":
             self._check_acl("agent:read")
-            merged = self._cluster_obs(srv, "metrics_history", {})
+            merged = self._cluster_obs(
+                srv, "metrics_history", {}, region=q.get("region")
+            )
             servers = {
                 addr: (
                     {"unreachable": True}
@@ -2308,4 +2454,11 @@ class HTTPServer:
 def start_http_server(server, host="127.0.0.1", port=0) -> HTTPServer:
     http = HTTPServer(server, host, port)
     http.start()
+    # gossip the bound HTTP address (cluster servers only): other
+    # regions learn where to send redirected traffic — the shed
+    # retry-region hint and the ?region= proxy both resolve through
+    # these advertised addresses
+    advertise = getattr(server, "advertise_http", None)
+    if advertise is not None:
+        advertise(f"{host}:{http.port}")
     return http

@@ -221,6 +221,30 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "alloc-index catch-up); past it the leases nack for "
         "redelivery",
     ),
+    # -- multi-region federation (server/federation.py) ---------------
+    "NOMAD_TPU_FED_RETRIES": EnvKnob(
+        "4", "nomad_tpu/server/federation.py",
+        "cross-region forward retry budget after the first attempt; "
+        "each retry re-resolves the target region's membership from "
+        "gossip (fan-out command ids keep retries idempotent)",
+    ),
+    "NOMAD_TPU_FED_BACKOFF_S": EnvKnob(
+        "0.05", "nomad_tpu/server/federation.py",
+        "initial cross-region retry backoff, doubling per attempt "
+        "(capped at 1s)",
+    ),
+    "NOMAD_TPU_REGION_PROBE_S": EnvKnob(
+        "0.5", "nomad_tpu/server/federation.py",
+        "federation router cadence: how often the gossip-derived "
+        "region health/routing snapshot (and the federation.* "
+        "gauges) refresh",
+    ),
+    "NOMAD_TPU_FED_PROXY_TIMEOUT_S": EnvKnob(
+        "2", "nomad_tpu/api/http.py",
+        "deadline for a ?region= HTTP read proxied to another "
+        "region's advertised HTTP address (the explicit WAN-read "
+        "escape hatch)",
+    ),
     # -- overload control plane (server/overload.py, server.py) -------
     "NOMAD_TPU_OVERLOAD": EnvKnob(
         "1", "nomad_tpu/server/overload.py",

@@ -1,0 +1,1031 @@
+"""Geo-plane SLO smoke: two federated regions under swarm load.
+
+The federation claim is not "regions can talk" — it is "a global job
+lands in every region it names, region-local traffic never crosses
+the WAN, and a whole region dying redirects its submitters without
+losing a single accepted eval elsewhere".  This harness plays that
+day against TWO real 3-server clusters (east/west) on one in-memory
+transport, each fronted by real HTTP servers:
+
+* **federation both ways**: a ``Multiregion`` job submitted via east
+  and another via west, each fanned out with per-region count
+  overrides; per-region placement must match a single-region oracle
+  cluster fed the identical nodes and jobspec (placement parity — the
+  geo plane may route, never re-schedule);
+* **region-local reads stay local**: per-region heartbeat storms,
+  submitter swarms and blocking fan-outs run over HTTP, after which
+  ``federation.wan_reads`` must be ZERO on every server — only the
+  explicit ``?region=`` escape hatch may cross the WAN (exercised and
+  asserted to increment);
+* **shed-redirect**: a flood against the east leader trips the
+  overload ladder; sheds must carry the ``X-Nomad-Retry-Region`` hint
+  and redirected submitters must land on west within the SLO;
+* **region-kill drill**: all three east servers go dark at once
+  (transport down + HTTP stopped, the SIGKILL shape); a fresh
+  submitter wave pointed at the dead region must fail over via its
+  cached retry-region hint within the SLO, and the surviving region
+  ends with zero lost evals (no pending/blocked, empty failed queue,
+  every accepted job fully placed);
+* **rejoin**: the transport heals, east re-elects and re-advertises
+  fresh HTTP addresses over gossip; a final multiregion job submitted
+  via west must place in BOTH regions again.
+
+SLO gates (exit 0 = all held, 2 = the JSON names the violation).
+
+Usage::
+
+    python -m nomad_tpu.loadgen.geo_smoke [--nodes-per-region N]
+        [--flood-submitters S] [--redirect-slo SEC] [--json PATH]
+
+The result is the bench ``federation`` block (bench.py embeds it
+under ``BENCH_FEDERATION=1``).
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import http.client
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# knob defaults for the smoke, applied BEFORE nomad_tpu imports so
+# construction-time reads see them; explicit operator env wins
+_SMOKE_ENV = {
+    # the flood phase must ENGAGE overload (and thereby the
+    # retry-region hint on sheds)
+    "NOMAD_TPU_OVERLOAD": "1",
+    "NOMAD_TPU_OVERLOAD_AGE_S": "10",
+    # fast region-table refresh so rejoin detection is not the
+    # long pole of the drill
+    "NOMAD_TPU_REGION_PROBE_S": "0.2",
+}
+
+
+def _apply_env(flood_submitters: int) -> None:
+    for key, value in _SMOKE_ENV.items():
+        os.environ.setdefault(key, value)
+    # depth threshold far below the flood so the east leader sheds
+    # (and hints west) instead of queueing the burst
+    os.environ.setdefault(
+        "NOMAD_TPU_OVERLOAD_DEPTH",
+        str(max(8, flood_submitters // 12)),
+    )
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    ordered = sorted(vals)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def _sub_job_dict(job_id: str, datacenters: List[str]) -> dict:
+    """Wire-form single-alloc service job (what a real client POSTs).
+
+    Submitter jobs list BOTH datacenters so a shed-redirected or
+    failed-over submission is placeable in whichever region accepts
+    it — the redirect contract is "your work lands somewhere", not
+    "your work lands where you first knocked".
+    """
+    return {
+        "ID": job_id,
+        "Name": job_id,
+        "Type": "service",
+        "Priority": 40,
+        "Datacenters": list(datacenters),
+        "TaskGroups": [
+            {
+                "Name": "g",
+                "Count": 1,
+                "Tasks": [
+                    {
+                        "Name": "t",
+                        "Driver": "mock_driver",
+                        "Config": {"run_for": -1},
+                        "Resources": {"CPU": 50, "MemoryMB": 32},
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def _mr_job_dict(
+    job_id: str, east_count: int, west_count: int
+) -> dict:
+    """Wire-form Multiregion job: one jobspec, per-region count and
+    datacenter overrides (the fan-out input)."""
+    return {
+        "ID": job_id,
+        "Name": job_id,
+        "Type": "service",
+        "Priority": 50,
+        "Datacenters": ["dc-east", "dc-west"],
+        "Multiregion": {
+            "Strategy": {"MaxParallel": 1},
+            "Regions": [
+                {
+                    "Name": "east",
+                    "Count": east_count,
+                    "Datacenters": ["dc-east"],
+                },
+                {
+                    "Name": "west",
+                    "Count": west_count,
+                    "Datacenters": ["dc-west"],
+                },
+            ],
+        },
+        "TaskGroups": [
+            {
+                "Name": "web",
+                "Count": 1,
+                "Tasks": [
+                    {
+                        "Name": "t",
+                        "Driver": "mock_driver",
+                        "Config": {"run_for": -1},
+                        "Resources": {"CPU": 50, "MemoryMB": 32},
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def _fully_placed(store, namespace, job_id, count) -> bool:
+    live = [
+        a
+        for a in store.allocs_by_job(namespace, job_id)
+        if not a.terminal_status()
+    ]
+    return len(live) == count
+
+
+def _placements(store, namespace, job_id) -> List[Tuple[str, str]]:
+    return sorted(
+        (a.task_group, a.node_id)
+        for a in store.allocs_by_job(namespace, job_id)
+        if not a.terminal_status()
+    )
+
+
+def _drain_region(leader, timeout_s: float) -> bool:
+    """Leader-side settle: broker idle AND no pending/blocked evals."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        pending = [
+            ev
+            for ev in list(leader.store.evals.values())
+            if ev.status in ("pending", "blocked")
+        ]
+        if not pending and leader.drain_to_idle(timeout=2.0):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+class RedirectSubmitter:
+    """``n`` logical clients each registering one job against a
+    primary region over HTTP, honoring 429 Retry-After AND following
+    the shed's ``X-Nomad-Retry-Region-Addr`` hint to the suggested
+    region.  Hint addresses learned from any shed are shared across
+    the client population (the cached region table a real
+    multi-region client keeps), so a client whose primary stops
+    answering entirely — the region-kill drill — fails over to the
+    last healthy region it heard about.
+
+    ``redirect_latencies_s`` records, per redirected submission, the
+    time from the first shed/failure to acceptance elsewhere — the
+    redirect SLO input.
+    """
+
+    def __init__(
+        self,
+        primary_addr: str,
+        n: int,
+        make_job,
+        threads: int = 12,
+        max_attempts: int = 200,
+        seed_hints: Optional[List[str]] = None,
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.primary = primary_addr
+        self.n = n
+        self._make_job = make_job
+        self._timeout_s = timeout_s
+        self._max_attempts = max_attempts
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.accepted = 0
+        self.sheds = 0
+        self.errors = 0
+        self.redirects = 0
+        self.failed: List[str] = []
+        self.redirect_latencies_s: List[float] = []
+        self.hint_regions: set = set()
+        # learned region table: insertion-ordered so the freshest
+        # hint wins on failover
+        self._known: List[str] = [primary_addr]
+        for hint in seed_hints or []:
+            self._learn(hint)
+        self._bad: set = set()
+        threads = max(1, min(threads, n or 1))
+        self._slices = [list(range(n))[i::threads] for i in range(threads)]
+        self._threads = [
+            threading.Thread(
+                target=self._run, args=(i,),
+                name=f"geo-submitter-{i}", daemon=True,
+            )
+            for i in range(threads)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- shared region table ----------------------------------------
+
+    def _learn(self, addr: str) -> None:
+        with self._lock:
+            if addr in self._known:
+                self._known.remove(addr)
+            self._known.append(addr)
+
+    def _mark_bad(self, addr: str) -> None:
+        with self._lock:
+            self._bad.add(addr)
+
+    def _failover(self, current: str) -> Optional[str]:
+        with self._lock:
+            live = [
+                a
+                for a in self._known
+                if a not in self._bad and a != current
+            ]
+        return live[-1] if live else None
+
+    # -- lifecycle ---------------------------------------------------
+
+    def done(self) -> bool:
+        return all(not t.is_alive() for t in self._threads)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    # -- workers -----------------------------------------------------
+
+    def _session(self, sessions: dict, addr: str):
+        from .swarm import HttpSession
+
+        if addr not in sessions:
+            host, port = addr.rsplit(":", 1)
+            sessions[addr] = HttpSession(
+                host, int(port), timeout=self._timeout_s
+            )
+        return sessions[addr]
+
+    def _run(self, idx: int) -> None:
+        rng = random.Random(idx)
+        sessions: dict = {}
+        for sub_i in self._slices[idx]:
+            if self._stop.is_set():
+                break
+            self._one(sub_i, rng, sessions)
+        for sess in sessions.values():
+            sess.close()
+
+    def _one(self, sub_i: int, rng, sessions: dict) -> None:
+        job = self._make_job(sub_i)
+        addr = self.primary
+        first_block: Optional[float] = None
+        redirected = False
+        for _ in range(self._max_attempts):
+            if self._stop.is_set():
+                break
+            sess = self._session(sessions, addr)
+            try:
+                status, headers, _body = sess.request(
+                    "POST", "/v1/jobs", {"Job": job}
+                )
+            except (http.client.HTTPException, OSError):
+                # region gone dark: remember it, fail over to the
+                # freshest hinted region
+                self._mark_bad(addr)
+                if first_block is None:
+                    first_block = time.monotonic()
+                nxt = self._failover(addr)
+                if nxt is not None:
+                    if nxt != self.primary:
+                        redirected = True
+                    addr = nxt
+                time.sleep(0.05 + rng.random() * 0.1)
+                continue
+            if status == 200:
+                with self._lock:
+                    self.accepted += 1
+                    if redirected and first_block is not None:
+                        self.redirects += 1
+                        self.redirect_latencies_s.append(
+                            time.monotonic() - first_block
+                        )
+                return
+            if status == 429:
+                if first_block is None:
+                    first_block = time.monotonic()
+                hint_addr = headers.get(
+                    "x-nomad-retry-region-addr", ""
+                )
+                hint_region = headers.get("x-nomad-retry-region", "")
+                with self._lock:
+                    self.sheds += 1
+                    if hint_region:
+                        self.hint_regions.add(hint_region)
+                if hint_addr:
+                    self._learn(hint_addr)
+                with self._lock:
+                    hint_ok = (
+                        hint_addr
+                        and hint_addr != addr
+                        and hint_addr not in self._bad
+                    )
+                if hint_ok:
+                    # take the hint: retry in the suggested region
+                    addr = hint_addr
+                    redirected = True
+                    time.sleep(0.02 + rng.random() * 0.05)
+                else:
+                    try:
+                        retry_after = float(
+                            headers.get("retry-after", "0.25")
+                        )
+                    except ValueError:
+                        retry_after = 0.25
+                    time.sleep(
+                        min(retry_after, 1.5)
+                        * (0.5 + rng.random())
+                    )
+                continue
+            # 5xx (leaderless window, proxy failure): brief backoff
+            with self._lock:
+                self.errors += 1
+            if first_block is None:
+                first_block = time.monotonic()
+            time.sleep(0.2 + rng.random() * 0.2)
+        else:
+            with self._lock:
+                self.failed.append(job["ID"])
+
+
+def run_geo(
+    nodes_per_region: int = 10,
+    local_submitters: int = 24,
+    flood_submitters: int = 96,
+    kill_submitters: int = 24,
+    redirect_slo_s: float = 20.0,
+    seed: int = 0,
+    settle_timeout_s: float = 240.0,
+) -> Dict:
+    """Run the geo scenario; returns the bench ``federation`` block
+    (``ok`` = every SLO held, ``violations`` names what didn't)."""
+    _apply_env(flood_submitters)
+
+    from .. import mock
+    from ..api import start_http_server
+    from ..raft.transport import InmemTransport
+    from ..server import Server
+    from ..server.cluster import TestCluster
+    from .swarm import BlockingFanout, HeartbeatStorm, SubmitterSwarm
+
+    t_start = time.monotonic()
+    violations: List[str] = []
+    phase_s: Dict[str, float] = {}
+    timings: Dict[str, float] = {}
+
+    transport = InmemTransport()
+    # one scheduler per server: the flood must outpace the consumer
+    # side so the overload ladder engages organically (same seed as
+    # the parity oracles — placement must be reproducible)
+    clusters = {
+        "east": TestCluster(
+            3, transport=transport, region="east",
+            name_prefix="east", heartbeat_ttl=600.0, seed=seed,
+            num_schedulers=1,
+        ),
+        "west": TestCluster(
+            3, transport=transport, region="west",
+            name_prefix="west", heartbeat_ttl=600.0, seed=seed,
+            num_schedulers=1,
+        ),
+    }
+    https: Dict[str, list] = {"east": [], "west": []}
+    oracles: List[Server] = []
+    generators: list = []
+
+    def _leader(name: str):
+        return clusters[name].wait_for_leader(timeout=10.0)
+
+    def _leader_http_addr(name: str) -> str:
+        leader = _leader(name)
+        for srv, http_srv in zip(
+            clusters[name].servers, https[name]
+        ):
+            if srv is leader:
+                return f"127.0.0.1:{http_srv.port}"
+        raise AssertionError(f"no http server for {name} leader")
+
+    try:
+        # -- phase: boot — two regions, one WAN ----------------------
+        t0 = time.monotonic()
+        for cl in clusters.values():
+            cl.start()
+        # WAN join: east and west gossip into one member list
+        clusters["west"].servers[0].join(
+            clusters["east"].servers[0].addr
+        )
+        for name, cl in clusters.items():
+            for srv in cl.servers:
+                https[name].append(start_http_server(srv, port=0))
+        leaders = {name: _leader(name) for name in clusters}
+
+        # every server's region table must show both regions with
+        # advertised HTTP addresses before traffic starts
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            views = [
+                srv.federation.regions()
+                for cl in clusters.values()
+                for srv in cl.servers
+            ]
+            if all(
+                view.get(r, {}).get("members", 0) == 3
+                and view.get(r, {}).get("http")
+                for view in views
+                for r in ("east", "west")
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            violations.append(
+                "region tables never converged with HTTP addresses"
+            )
+
+        # nodes: identical pristine copies are kept per region so the
+        # single-region parity oracles schedule over the same world
+        node_ids: Dict[str, List[str]] = {}
+        pristine: Dict[str, list] = {}
+        for name in ("east", "west"):
+            node_ids[name], pristine[name] = [], []
+            for _ in range(nodes_per_region):
+                node = mock.node(datacenter=f"dc-{name}")
+                pristine[name].append(copy.deepcopy(node))
+                leaders[name].register_node(node)
+                node_ids[name].append(node.id)
+        phase_s["boot"] = time.monotonic() - t0
+
+        # -- phase: federation both ways + placement parity ----------
+        t0 = time.monotonic()
+        from .swarm import HttpSession
+
+        fanout_register_ms: List[float] = []
+        mr_specs = {
+            # submitted via EAST, overrides for both regions
+            "geo-mr-east": ("east", _mr_job_dict("geo-mr-east", 2, 3)),
+            # submitted via WEST (the other way around)
+            "geo-mr-west": ("west", _mr_job_dict("geo-mr-west", 1, 2)),
+        }
+        for job_id, (via, job_dict) in mr_specs.items():
+            host, port = _leader_http_addr(via).rsplit(":", 1)
+            sess = HttpSession(host, int(port), timeout=30.0)
+            t_reg = time.monotonic()
+            status, _h, body = sess.request(
+                "POST", "/v1/jobs", {"Job": job_dict}
+            )
+            fanout_register_ms.append(
+                (time.monotonic() - t_reg) * 1000.0
+            )
+            sess.close()
+            if status != 200:
+                violations.append(
+                    f"{job_id} register via {via} -> HTTP {status}: "
+                    f"{body[:200]!r}"
+                )
+            for name in ("east", "west"):
+                if not _drain_region(leaders[name], settle_timeout_s):
+                    violations.append(
+                        f"{name} did not settle after {job_id}"
+                    )
+
+        expected = {
+            "geo-mr-east": {"east": 2, "west": 3},
+            "geo-mr-west": {"east": 1, "west": 2},
+        }
+        for job_id, counts in expected.items():
+            for name, count in counts.items():
+                if not _fully_placed(
+                    leaders[name].store, "default", job_id, count
+                ):
+                    violations.append(
+                        f"{job_id} not fully placed in {name} "
+                        f"(want {count})"
+                    )
+
+        # federation status endpoint aggregates every region's view
+        host, port = _leader_http_addr("east").rsplit(":", 1)
+        sess = HttpSession(host, int(port), timeout=30.0)
+        status, _h, body = sess.request(
+            "GET", "/v1/job/geo-mr-east/federation"
+        )
+        fed_status = json.loads(body) if status == 200 else {}
+        sess.close()
+        if status != 200:
+            violations.append(
+                f"/v1/job/geo-mr-east/federation -> HTTP {status}"
+            )
+        else:
+            for name, count in expected["geo-mr-east"].items():
+                region_view = fed_status.get("regions", {}).get(
+                    name, {}
+                )
+                if not region_view.get("registered") or (
+                    region_view.get("groups", {}).get("web") != count
+                ):
+                    violations.append(
+                        f"federation status wrong for {name}: "
+                        f"{region_view!r}"
+                    )
+
+        # parity: a single-region oracle fed the identical nodes and
+        # jobspecs must produce the identical placement set — the geo
+        # plane routes, it never re-schedules
+        from ..api.codec import job_from_dict
+
+        for name in ("east", "west"):
+            oracle = Server(
+                num_schedulers=1, heartbeat_ttl=600.0, seed=seed
+            )
+            # interpolate the multiregion overrides as this region
+            oracle.region = name
+            oracles.append(oracle)
+            oracle.start()
+            for node in pristine[name]:
+                oracle.register_node(copy.deepcopy(node))
+            # same jobs, same order as the cluster applied them
+            for job_id, (_via, job_dict) in mr_specs.items():
+                oracle.register_job(job_from_dict(dict(job_dict)))
+                if not oracle.drain_to_idle(timeout=60.0):
+                    violations.append(
+                        f"{name} oracle did not settle on {job_id}"
+                    )
+            for job_id in mr_specs:
+                got = _placements(
+                    leaders[name].store, "default", job_id
+                )
+                want = _placements(oracle.store, "default", job_id)
+                if got != want:
+                    violations.append(
+                        f"placement parity broken for {job_id} in "
+                        f"{name}: cluster={got} oracle={want}"
+                    )
+        phase_s["federate"] = time.monotonic() - t0
+
+        # -- phase: region-local swarm load, wan_reads must stay 0 ---
+        t0 = time.monotonic()
+        storms, swarms, fanouts = {}, {}, {}
+        for name in ("east", "west"):
+            host, port = _leader_http_addr(name).rsplit(":", 1)
+            storms[name] = HeartbeatStorm(
+                host, int(port), node_ids[name],
+                period_s=2.0, threads=8,
+            )
+            dcs = [f"dc-{name}"]
+            swarms[name] = SubmitterSwarm(
+                host, int(port), local_submitters,
+                make_job=lambda i, _n=name, _d=dcs: _sub_job_dict(
+                    f"geo-local-{_n}-{i:04d}", _d
+                ),
+                threads=8,
+            )
+            fanouts[name] = BlockingFanout(host, int(port), threads=4)
+            generators.extend(
+                (storms[name], swarms[name], fanouts[name])
+            )
+        deadline = time.monotonic() + settle_timeout_s
+        while time.monotonic() < deadline:
+            if all(sw.done() for sw in swarms.values()):
+                break
+            time.sleep(0.25)
+        for name, sw in swarms.items():
+            if not sw.done():
+                violations.append(f"{name} local swarm wedged")
+            if sw.failed:
+                violations.append(
+                    f"{len(sw.failed)} {name} local submitters "
+                    "never succeeded"
+                )
+        for gen in generators:
+            gen.stop()
+        for name in ("east", "west"):
+            if not _drain_region(leaders[name], settle_timeout_s):
+                violations.append(
+                    f"{name} did not settle after local load"
+                )
+
+        # THE geo-plane read contract: all of the above was
+        # region-local traffic — not one read crossed the WAN
+        wan_reads_local = {
+            srv.addr: srv.metrics.get_counter("federation.wan_reads")
+            for cl in clusters.values()
+            for srv in cl.servers
+        }
+        leaked = {a: c for a, c in wan_reads_local.items() if c > 0}
+        if leaked:
+            violations.append(
+                f"region-local traffic crossed the WAN: {leaked}"
+            )
+        phase_s["local_load"] = time.monotonic() - t0
+
+        # -- phase: the explicit ?region= escape hatch ---------------
+        t0 = time.monotonic()
+        host, port = _leader_http_addr("east").rsplit(":", 1)
+        east_leader = leaders["east"]
+        sess = HttpSession(host, int(port), timeout=30.0)
+        forward_ms: List[float] = []
+        before = east_leader.metrics.get_counter(
+            "federation.wan_reads"
+        )
+        # proxied API read: east answers with west's node list
+        status, headers, body = sess.request(
+            "GET", "/v1/nodes?region=west"
+        )
+        if status != 200 or len(json.loads(body)) != len(
+            node_ids["west"]
+        ):
+            violations.append(
+                f"?region=west node proxy failed: HTTP {status}"
+            )
+        elif headers.get("x-nomad-proxied-region") != "west":
+            violations.append(
+                "proxied response missing X-Nomad-Proxied-Region"
+            )
+        # forwarded cluster read, timed (the bench forward latency)
+        for _ in range(20):
+            t_req = time.monotonic()
+            status, _h, _b = sess.request(
+                "GET", "/v1/cluster/metrics?region=west"
+            )
+            forward_ms.append((time.monotonic() - t_req) * 1000.0)
+            if status != 200:
+                violations.append(
+                    f"/v1/cluster/metrics?region=west -> {status}"
+                )
+                break
+        sess.close()
+        after = east_leader.metrics.get_counter(
+            "federation.wan_reads"
+        )
+        if after <= before:
+            violations.append(
+                "?region= escape hatch did not count wan_reads"
+            )
+        phase_s["escape_hatch"] = time.monotonic() - t0
+
+        # -- phase: shed-redirect flood ------------------------------
+        t0 = time.monotonic()
+        flood = RedirectSubmitter(
+            _leader_http_addr("east"),
+            flood_submitters,
+            make_job=lambda i: _sub_job_dict(
+                f"geo-flood-{i:04d}", ["dc-east", "dc-west"]
+            ),
+            threads=16,
+        )
+        generators.append(flood)
+        deadline = time.monotonic() + settle_timeout_s
+        while time.monotonic() < deadline:
+            if flood.done():
+                break
+            time.sleep(0.25)
+        if not flood.done():
+            flood.stop()
+            violations.append("flood swarm wedged")
+        if flood.failed:
+            violations.append(
+                f"{len(flood.failed)} flood submitters never "
+                "succeeded"
+            )
+        if flood.sheds <= 0:
+            violations.append(
+                "flood never shed — overload (and the retry-region "
+                "hint) was not exercised"
+            )
+        if "west" not in flood.hint_regions:
+            violations.append(
+                f"sheds never hinted west: {flood.hint_regions!r}"
+            )
+        if flood.redirects <= 0:
+            violations.append("no submitter followed the hint")
+        redirect_p99 = _percentile(flood.redirect_latencies_s, 0.99)
+        if redirect_p99 > redirect_slo_s:
+            violations.append(
+                f"shed-redirect p99 {redirect_p99:.1f}s > SLO "
+                f"{redirect_slo_s:.0f}s"
+            )
+        phase_s["flood"] = time.monotonic() - t0
+
+        # -- phase: region-kill drill --------------------------------
+        t0 = time.monotonic()
+        for name in ("east", "west"):
+            _drain_region(leaders[name], settle_timeout_s)
+        east_primary = _leader_http_addr("east")
+        t_kill = time.monotonic()
+        # all three east servers at once: transport dark (raft,
+        # gossip and federation RPC all dead) and HTTP refused — the
+        # SIGKILL shape, no graceful leave
+        for srv in clusters["east"].servers:
+            transport.set_down(srv.addr)
+        for http_srv in https["east"]:
+            http_srv.stop()
+
+        # a fresh submitter wave aimed at the DEAD region, carrying
+        # only the region table the flood learned from shed hints
+        kill_wave = RedirectSubmitter(
+            east_primary,
+            kill_submitters,
+            make_job=lambda i: _sub_job_dict(
+                f"geo-kill-{i:04d}", ["dc-east", "dc-west"]
+            ),
+            threads=8,
+            seed_hints=list(flood._known[1:]),  # hints only
+        )
+        generators.append(kill_wave)
+
+        # west notices the region death through gossip
+        west_leader = leaders["west"]
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            view = west_leader.federation.regions()
+            # a fully-dead region drops out of the table entirely
+            if view.get("east", {}).get("members", 0) == 0:
+                break
+            time.sleep(0.1)
+        else:
+            violations.append("west never noticed the east kill")
+        timings["kill_detect_s"] = time.monotonic() - t_kill
+
+        deadline = time.monotonic() + settle_timeout_s
+        while time.monotonic() < deadline:
+            if kill_wave.done():
+                break
+            time.sleep(0.25)
+        if not kill_wave.done():
+            kill_wave.stop()
+            violations.append("kill-wave swarm wedged")
+        if kill_wave.failed:
+            violations.append(
+                f"{len(kill_wave.failed)} kill-wave submitters lost "
+                "their work"
+            )
+        failover_p99 = _percentile(
+            kill_wave.redirect_latencies_s, 0.99
+        )
+        timings["failover_p99_s"] = failover_p99
+        if kill_wave.accepted and failover_p99 > redirect_slo_s:
+            violations.append(
+                f"kill failover p99 {failover_p99:.1f}s > SLO "
+                f"{redirect_slo_s:.0f}s"
+            )
+
+        # zero lost evals in the surviving region
+        if not _drain_region(west_leader, settle_timeout_s):
+            violations.append("west did not settle after the kill")
+        nonterminal = [
+            ev.id
+            for ev in list(west_leader.store.evals.values())
+            if ev.status in ("pending", "blocked")
+        ]
+        if nonterminal:
+            violations.append(
+                f"{len(nonterminal)} non-terminal evals in west "
+                "after the kill"
+            )
+        if west_leader.broker.failed():
+            violations.append(
+                f"{len(west_leader.broker.failed())} evals in west's "
+                "failed queue after the kill"
+            )
+        west_missing = [
+            job.id
+            for job in west_leader.store.iter_jobs()
+            if job.id.startswith(("geo-kill-", "geo-flood-"))
+            and not _fully_placed(
+                west_leader.store, "default", job.id, 1
+            )
+        ]
+        if west_missing:
+            violations.append(
+                f"{len(west_missing)} accepted jobs not placed in "
+                "west after the kill"
+            )
+        phase_s["region_kill"] = time.monotonic() - t0
+
+        # -- phase: rejoin — east heals and re-federates -------------
+        t0 = time.monotonic()
+        for srv in clusters["east"].servers:
+            transport.set_down(srv.addr, down=False)
+        # fresh HTTP listeners, re-advertised over gossip
+        https["east"] = [
+            start_http_server(srv, port=0)
+            for srv in clusters["east"].servers
+        ]
+        deadline = time.monotonic() + 60.0
+        east_leader = None
+        while time.monotonic() < deadline:
+            try:
+                east_leader = clusters["east"].wait_for_leader(
+                    timeout=5.0
+                )
+                break
+            except AssertionError:
+                continue
+        if east_leader is None:
+            violations.append("east never re-elected after the heal")
+        new_addrs = {
+            f"127.0.0.1:{http_srv.port}" for http_srv in https["east"]
+        }
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            view = west_leader.federation.regions()
+            east_view = view.get("east", {})
+            if east_view.get("members", 0) == 3 and new_addrs & set(
+                east_view.get("http", [])
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            violations.append(
+                "west never saw east rejoin with fresh HTTP "
+                "addresses"
+            )
+        timings["rejoin_detect_s"] = time.monotonic() - t0
+
+        # a multiregion job submitted via WEST must place in BOTH
+        # regions again
+        if east_leader is not None:
+            leaders["east"] = east_leader
+            host, port = _leader_http_addr("west").rsplit(":", 1)
+            sess = HttpSession(host, int(port), timeout=30.0)
+            t_reg = time.monotonic()
+            status, _h, body = sess.request(
+                "POST",
+                "/v1/jobs",
+                {"Job": _mr_job_dict("geo-rejoin", 1, 1)},
+            )
+            fanout_register_ms.append(
+                (time.monotonic() - t_reg) * 1000.0
+            )
+            sess.close()
+            if status != 200:
+                violations.append(
+                    f"rejoin register -> HTTP {status}: "
+                    f"{body[:200]!r}"
+                )
+            for name in ("east", "west"):
+                if not _drain_region(leaders[name], settle_timeout_s):
+                    violations.append(
+                        f"{name} did not settle after rejoin"
+                    )
+                if not _fully_placed(
+                    leaders[name].store, "default", "geo-rejoin", 1
+                ):
+                    violations.append(
+                        f"geo-rejoin not placed in {name}"
+                    )
+            # east drained: pre-kill accepted work survived the drill
+            if not _drain_region(east_leader, settle_timeout_s):
+                violations.append("east did not settle after rejoin")
+        phase_s["rejoin"] = time.monotonic() - t0
+    finally:
+        for gen in generators:
+            try:
+                gen.stop()
+            except Exception:
+                pass
+        for servers in https.values():
+            for http_srv in servers:
+                try:
+                    http_srv.stop()
+                except Exception:
+                    pass
+        transport.heal()
+        for cl in clusters.values():
+            try:
+                cl.stop()
+            except Exception:
+                pass
+        for oracle in oracles:
+            try:
+                oracle.stop()
+            except Exception:
+                pass
+
+    def _sum_counter(name: str) -> float:
+        return sum(
+            srv.metrics.get_counter(name)
+            for cl in clusters.values()
+            for srv in cl.servers
+        )
+
+    block = {
+        "ok": not violations,
+        "violations": violations,
+        "regions": 2,
+        "servers_per_region": 3,
+        "nodes_per_region": nodes_per_region,
+        "local_submitters": local_submitters,
+        "flood_submitters": flood_submitters,
+        "kill_submitters": kill_submitters,
+        "forwarded": _sum_counter("federation.forwarded"),
+        "fanout_jobs": _sum_counter("federation.fanout_jobs"),
+        "fanout_regions": _sum_counter("federation.fanout_regions"),
+        "wan_reads": _sum_counter("federation.wan_reads"),
+        "rpc_errors": _sum_counter("federation.rpc_errors"),
+        "retries": _sum_counter("federation.retries"),
+        "shed_redirects": _sum_counter("federation.shed_redirects"),
+        "forward_p50_ms": round(_percentile(forward_ms, 0.50), 2),
+        "forward_p99_ms": round(_percentile(forward_ms, 0.99), 2),
+        "fanout_register_p50_ms": round(
+            _percentile(fanout_register_ms, 0.50), 1
+        ),
+        "fanout_register_max_ms": round(
+            max(fanout_register_ms or [0.0]), 1
+        ),
+        "flood_sheds": flood.sheds,
+        "flood_redirects": flood.redirects,
+        "redirect_p99_s": round(redirect_p99, 2),
+        "kill_detect_s": round(timings.get("kill_detect_s", 0.0), 2),
+        "failover_p99_s": round(
+            timings.get("failover_p99_s", 0.0), 2
+        ),
+        "rejoin_detect_s": round(
+            timings.get("rejoin_detect_s", 0.0), 2
+        ),
+        "redirect_slo_s": redirect_slo_s,
+        "phase_s": {k: round(v, 2) for k, v in phase_s.items()},
+        "elapsed_s": round(time.monotonic() - t_start, 2),
+    }
+    return block
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="two-region federation + region-kill SLO smoke"
+    )
+    parser.add_argument("--nodes-per-region", type=int, default=10)
+    parser.add_argument("--local-submitters", type=int, default=24)
+    parser.add_argument("--flood-submitters", type=int, default=96)
+    parser.add_argument("--kill-submitters", type=int, default=24)
+    parser.add_argument("--redirect-slo", type=float, default=20.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--json", default="", help="also write the block to this path"
+    )
+    args = parser.parse_args(argv)
+    block = run_geo(
+        nodes_per_region=args.nodes_per_region,
+        local_submitters=args.local_submitters,
+        flood_submitters=args.flood_submitters,
+        kill_submitters=args.kill_submitters,
+        redirect_slo_s=args.redirect_slo,
+        seed=args.seed,
+    )
+    out = {"federation": block}
+    print(json.dumps(out, indent=2, default=str))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(out, fh, indent=2, default=str)
+    if not block["ok"]:
+        print("GEO_SMOKE: FAIL", file=sys.stderr)
+        return 2
+    print(
+        "GEO_SMOKE: ok — 2 regions federated both ways, "
+        "%d wan reads (escape hatch only), %d sheds redirected, "
+        "kill detected in %.1fs, failover p99 %.1fs, rejoined"
+        % (
+            int(block["wan_reads"]),
+            int(block["flood_sheds"]),
+            block["kill_detect_s"],
+            block["failover_p99_s"],
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -319,10 +319,17 @@ class ClusterServer(Server):
         from .fanout import FanoutManager
 
         self.fanout = FanoutManager(self, seed=kwargs.get("seed"))
+        # the geo plane: per-server router resolving home regions,
+        # forwarding region_call RPCs with bounded retry, fanning
+        # Multiregion jobs out, and snapshotting gossip into the
+        # region health table behind the shed-redirect hint
+        from .federation import FederationRouter
+
+        self.federation = FederationRouter(self)
 
     # -- raft plumbing --------------------------------------------------
 
-    def _raft_apply(self, kind: str, args: tuple):
+    def _raft_apply(self, kind: str, args: tuple, cmd_id: str = None):
         """Propose a command; on a follower, forward to the leader with
         bounded retry (reference rpc.go:509 forward + rpc.go:742
         raftApply).  Leadership moving mid-forward used to LOSE the
@@ -330,8 +337,11 @@ class ClusterServer(Server):
         the leader and backs off, and the client-supplied cmd_id makes
         the retry idempotent — if the first forward actually committed
         before its ack was lost, the FSM dedup returns that apply's
-        result instead of mutating twice."""
-        data = encode_command(kind, args, cmd_id=new_id())
+        result instead of mutating twice.  Callers with their own
+        idempotency scope (cross-region fan-out) pass an explicit
+        cmd_id so even a WHOLE retried call dedups, not just one
+        forward attempt."""
+        data = encode_command(kind, args, cmd_id=cmd_id or new_id())
         backoff = _forward_backoff_s()
         retries = _forward_retries()
         last_exc: Exception = NotLeaderError(None)
@@ -420,12 +430,61 @@ class ClusterServer(Server):
             args, kw = pickle.loads(payload["args"])
             return {"result": pickle.dumps(fn(*args, **kw))}
         if method == "region_call":
-            # a request that entered through another region's servers
-            # (reference rpc.go:645 forwardRegion lands it here)
-            args, kw = pickle.loads(payload["args"])
-            result = self._leader_route(payload["op"], *args, **kw)
-            return {"result": pickle.dumps(result)}
+            return self._handle_region_call(payload)
         raise ValueError(f"unknown cluster rpc {method!r}")
+
+    def _handle_region_call(self, payload: dict) -> dict:
+        """The WAN half of forwardRegion (reference rpc.go:645): a
+        request that entered through another region's servers lands
+        here.  A pickled remote exception used to surface as a raw
+        unpickle crash at the caller; every outcome is now a
+        structured envelope — ``wrong_region`` (stale gossip routed
+        to the wrong region; carries our actual region + leader
+        hint), ``not_leader`` (interregnum; carries the hint),
+        ``{error, kind}`` for unknown ops / timeouts / application
+        errors — the same contract ``fsm_apply`` answers with, so
+        the calling router can tell a retryable routing miss from a
+        definitive verdict."""
+        op = payload.get("op", "")
+        want = payload.get("region")
+        if want is not None and want != self.region:
+            return {
+                "wrong_region": True,
+                "region": self.region,
+                "leader": self.raft.leader_hint(),
+                "error": (
+                    f"server {self.addr} is in region "
+                    f"{self.region!r}, not {want!r}"
+                ),
+                "kind": "wrong_region",
+            }
+        if op not in _REGION_API:
+            return {
+                "error": f"unknown region op {op!r}",
+                "kind": "unknown_op",
+            }
+        try:
+            args, kw = pickle.loads(payload["args"])
+            result = self._leader_route(op, *args, **kw)
+        except StaleLeadershipError:
+            raise  # replicated verdict; the raft layer owns it
+        except NotLeaderError as exc:
+            return {
+                "not_leader": True,
+                "leader": exc.leader or self.raft.leader_hint(),
+                "error": f"no leader in region {self.region!r}",
+                "kind": "not_leader",
+            }
+        except (TimeoutError, TransportError) as exc:
+            return {
+                "error": str(exc) or type(exc).__name__,
+                "kind": "timeout"
+                if isinstance(exc, TimeoutError)
+                else "transport",
+            }
+        except Exception as exc:  # noqa: BLE001 — envelope, not crash
+            return {"error": str(exc), "kind": "app"}
+        return {"result": pickle.dumps(result)}
 
     # -- follower fan-out RPC surface (leader side) ---------------------
     #
@@ -689,20 +748,106 @@ class ClusterServer(Server):
 
     def forward_region(self, region: str, op: str, *args, **kw):
         """Route an API call to a server in another region (reference
-        rpc.go:645 forwardRegion: pick a random known server there)."""
-        if region == self.region:
-            return self._leader_route(op, *args, **kw)
-        import random as _random
+        rpc.go:645 forwardRegion).  Thin compat shim over the
+        federation router, which owns retry/backoff and envelope
+        interpretation."""
+        return self.federation.forward(region, op, *args, **kw)
 
-        members = self.gossip.members_in_region(region)
-        if not members:
-            raise KeyError(f"no path to region {region!r}")
-        target = _random.choice(members)
-        resp = self.transport.rpc(
-            self.addr, target.addr, "region_call",
-            {"op": op, "args": pickle.dumps((args, kw))},
+    def advertise_http(self, http_addr: str) -> None:
+        """Record this server's HTTP advertise address into its gossip
+        Member record (and rumor it), so every region learns where to
+        send redirected HTTP traffic — the retry-region shed hint is
+        built from these."""
+        self.gossip.advertise_http(http_addr)
+
+    def federated_register(self, job, fed_cmd_id: str):
+        """Target-region half of cross-region job fan-out: specialize
+        the fanned jobspec for THIS region (per-region count /
+        datacenters / meta overrides from its MultiregionRegion
+        entry), then propose job+eval as ONE FSM command under the
+        fan-out's per-region command id.  A retried fan-out (lost
+        ack, coordinator leadership moved) re-proposes the same id
+        and dedups in the FSM; the eval id is derived from the same
+        id, so the broker's eval-id dedup absorbs the re-enqueue too
+        — a retried fan-out can never double-register or
+        double-schedule."""
+        import hashlib
+
+        job.region = self.region
+        self._validate_job(job)
+        self._inject_connect_sidecars(job)
+        self._interpolate_multiregion(job)
+        from ..structs import (
+            EVAL_STATUS_PENDING,
+            EVAL_TRIGGER_JOB_REGISTER,
+            Evaluation,
         )
-        return pickle.loads(resp["result"])
+
+        if job.periodic is not None or job.parameterized is not None:
+            self._raft_apply(
+                "upsert_job", (job, 6), cmd_id=fed_cmd_id
+            )
+            return None
+        ev = Evaluation(
+            id=hashlib.sha256(
+                f"fed-eval:{fed_cmd_id}".encode()
+            ).hexdigest()[:32],
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            status=EVAL_STATUS_PENDING,
+        )
+        applied = self._raft_apply(
+            "register_job_federated",
+            (job, ev, time.time()),
+            cmd_id=fed_cmd_id,
+        )
+        self.on_eval_update(applied if applied is not None else ev)
+        return applied
+
+    def federation_job_status(self, namespace: str, job_id: str):
+        """This region's registration/placement summary for one job —
+        the per-region leaf the /v1/job/<id>/federation aggregation
+        collects."""
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            return {"registered": False, "region": self.region}
+        evals = self.store.evals_by_job(namespace, job_id)
+        statuses: dict = {}
+        for ev in evals:
+            statuses[ev.status] = statuses.get(ev.status, 0) + 1
+        return {
+            "registered": True,
+            "region": self.region,
+            "version": job.version,
+            "groups": {tg.name: tg.count for tg in job.task_groups},
+            "evals": statuses,
+            "allocs": len(
+                self.store.allocs_by_job(namespace, job_id)
+            ),
+        }
+
+    def cluster_query_region(
+        self,
+        what: str,
+        params: Optional[dict] = None,
+        region: Optional[str] = None,
+    ):
+        """Observability fan-in with the region boundary enforced:
+        no region (or our own) answers from the LOCAL region's
+        servers only — reads never cross the WAN implicitly.  An
+        explicit foreign region is the ?region= escape hatch: the
+        query forwards to that region's leader and counts against
+        ``federation.wan_reads`` (asserted zero for region-local
+        traffic in the geo harness)."""
+        if region is None or region == self.region:
+            return self.cluster_query(what, params)
+        self.metrics.incr("federation.wan_reads")
+        return self.federation.forward(
+            region, "cluster_query", what, params
+        )
 
     def remote_call(self, op: str, *args, **kw):
         """Invoke a Server API method on the current leader
@@ -712,9 +857,15 @@ class ClusterServer(Server):
     def _leader_route(self, op: str, *args, **kw):
         """Run a Server API method on the leader (reference
         rpc.go:509 forward): locally when we are the leader, otherwise
-        over the transport."""
+        over the transport.  Ops resolve on the Server base first —
+        cluster-level ops (federation, observability) are real
+        ClusterServer methods, never forwarders, so falling back to
+        the subclass cannot recurse."""
         if self.is_leader():
-            return getattr(Server, op)(self, *args, **kw)
+            fn = getattr(Server, op, None)
+            if fn is None:
+                fn = getattr(type(self), op)
+            return fn(self, *args, **kw)
         leader = self.raft.leader_hint()
         if leader is None:
             raise NotLeaderError(None)
@@ -795,11 +946,14 @@ class ClusterServer(Server):
         # follower fan-out workers start/stop with this server's raft
         # role (no-op unless NOMAD_TPU_FANOUT=1)
         self.fanout.start()
+        # geo router: snapshots gossip into the region health table
+        self.federation.start()
 
     def stop(self) -> None:
         self._running = False
         # fan-out first: its workers RPC over the transport this stop
-        # is about to quiesce
+        # is about to quiesce; same for the federation router
+        self.federation.stop()
         self.fanout.stop()
         self.autopilot.stop()
         self.raft.stop()
@@ -853,30 +1007,54 @@ def _make_forwarder(op):
 for _op in _LEADER_API:
     setattr(ClusterServer, _op, _make_forwarder(_op))
 
+# The op surface a region_call may invoke: the leader-forwarded Server
+# API plus the cluster-level federation/observability ops.  Anything
+# else answers a structured unknown_op envelope — the WAN boundary is
+# not a generic RPC into arbitrary attributes.
+_REGION_API = frozenset(_LEADER_API) | {
+    "federated_register",
+    "federation_job_status",
+    "cluster_query",
+    "fanout_multiregion",
+}
+
 
 def _register_job_federated(self, job):
     """Jobs carry a region (structs.Job.Region); a submission landing
     in the wrong region hops to the right one first (reference
-    job_endpoint.go forwarding via rpc.go:645).  A job that never
-    named a region (the struct default) resolves to the receiving
-    server's region, as the reference agent does, unless the default
-    region actually exists in the federation."""
-    from ..structs import DEFAULT_REGION
-
-    region = job.region
-    if (
-        region == DEFAULT_REGION
-        and region != self.region
-        and not self.gossip.members_in_region(region)
-    ):
-        region = self.region
+    job_endpoint.go forwarding via rpc.go:645), with the federation
+    router owning the retry/backoff and envelope handling.  A job
+    that never named a region (the struct default) resolves to the
+    receiving server's region, as the reference agent does, unless
+    the default region actually exists in the federation.  A job
+    carrying a Multiregion block goes to its home region's leader
+    and fans out from there."""
+    region = self.federation.home_region(job)
+    if job.multiregion is not None and job.multiregion.regions:
+        if not region or region == self.region:
+            ev, _statuses = self._leader_route(
+                "fanout_multiregion", job
+            )
+            return ev
+        ev, _statuses = self.federation.forward(
+            region, "fanout_multiregion", job
+        )
+        return ev
     if not region or region == self.region:
         job.region = self.region
         return self._leader_route("register_job", job)
-    return self.forward_region(region, "register_job", job)
+    return self.federation.forward(region, "register_job", job)
+
+
+def _fanout_multiregion(self, job):
+    """Home-region coordinator entry for a Multiregion jobspec: runs
+    on the home region's leader, fans per-region registrations out
+    through the router (idempotent per-region cmd ids)."""
+    return self.federation.fanout_job(job)
 
 
 ClusterServer.register_job = _register_job_federated
+ClusterServer.fanout_multiregion = _fanout_multiregion
 
 
 class TestCluster:

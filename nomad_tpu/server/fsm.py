@@ -457,6 +457,20 @@ class ServerFSM:
     def _apply_upsert_evals(self, evals, now=None):
         return self.store.upsert_evals(evals, now)
 
+    def _apply_register_job_federated(self, job, ev, now=None):
+        """Cross-region fan-out registration: job + its triggering
+        eval as ONE log entry, so the target region can never hold a
+        registered job without its eval (or vice versa) across a
+        fan-out retry.  The command id is the fan-out's per-region
+        id — a re-fanned registration dedups in apply() and returns
+        this first apply's eval unchanged.  Timestamps and the eval
+        id are proposer-fixed so every replica applies identically."""
+        self.store.upsert_job(job, 6)
+        if ev is not None:
+            ev.job_modify_index = job.modify_index
+            self.store.upsert_evals([ev], now)
+        return ev
+
     def _apply_delete_eval(self, eval_id):
         return self.store.delete_eval(eval_id)
 

@@ -41,6 +41,11 @@ class Member:
     incarnation: int = 0
     status: str = ALIVE
     status_time: float = field(default_factory=time.monotonic)
+    # HTTP advertise address (host:port), rumored alongside the RPC
+    # addr so other REGIONS learn where to redirect HTTP traffic —
+    # the X-Nomad-Retry-Region shed hint is built from these.  Empty
+    # until the member's HTTP listener binds and advertises.
+    http_addr: str = ""
 
     def record(self) -> Tuple:
         return (
@@ -50,6 +55,7 @@ class Member:
             self.role,
             self.incarnation,
             self.status,
+            self.http_addr,
         )
 
 
@@ -122,6 +128,17 @@ class Gossip:
                 pass
         self.stop()
 
+    def advertise_http(self, http_addr: str) -> None:
+        """Set our HTTP advertise address and outbid every cached view
+        of us with an incarnation bump — without the bump the new
+        field would lose the rumor race to any equal-incarnation
+        record already circulating.  Piggybacking spreads it from
+        here; no broadcast needed."""
+        with self._lock:
+            me = self.members[self.name]
+            me.http_addr = http_addr
+            me.incarnation += 1
+
     # -- joining --------------------------------------------------------
 
     def join(self, seed_addr: str) -> int:
@@ -188,6 +205,7 @@ class Gossip:
                 {
                     "Name": m.name,
                     "Addr": m.addr,
+                    "HTTPAddr": m.http_addr,
                     "Region": m.region,
                     "Role": m.role,
                     "Status": m.status,
@@ -349,7 +367,12 @@ class Gossip:
     def _merge(self, records) -> None:
         events = []
         with self._lock:
-            for name, addr, region, role, inc, status in records:
+            for rec in records:
+                # records from a pre-http_addr peer are 6-tuples;
+                # tolerate both wire shapes so a mixed-version pool
+                # still converges (memberlist's protocol-version skew)
+                name, addr, region, role, inc, status = rec[:6]
+                http = rec[6] if len(rec) > 6 else ""
                 if name == self.name:
                     # refutation (SWIM): if the pool thinks we're gone,
                     # outbid the rumor with a higher incarnation.  A
@@ -368,7 +391,10 @@ class Gossip:
                     continue
                 cur = self.members.get(name)
                 if cur is None:
-                    m = Member(name, addr, region, role, inc, status)
+                    m = Member(
+                        name, addr, region, role, inc, status,
+                        http_addr=http,
+                    )
                     self.members[name] = m
                     if status == ALIVE:
                         events.append(("member-join", m))
@@ -382,6 +408,8 @@ class Gossip:
                     cur.status = status
                     cur.status_time = time.monotonic()
                     cur.addr, cur.region, cur.role = addr, region, role
+                    if http:
+                        cur.http_addr = http
                     if status == ALIVE and old_status != ALIVE:
                         events.append(("member-join", cur))
                     elif status == DEAD and old_status != DEAD:

@@ -253,6 +253,16 @@ class Server:
         self.metrics.preregister(
             counters=FANOUT_COUNTERS, gauges=FANOUT_GAUGES
         )
+        # multi-region federation: zero-register the federation.*
+        # family (absence-of-series must mean "single region, nothing
+        # ever crossed the WAN", not "not exported").  The registries
+        # live in server/federation.py; the router itself exists only
+        # on ClusterServer.
+        from .federation import FEDERATION_COUNTERS, FEDERATION_GAUGES
+
+        self.metrics.preregister(
+            counters=FEDERATION_COUNTERS, gauges=FEDERATION_GAUGES
+        )
         # cluster-scope observability: zero-register the obs.* /
         # cluster.* family (absence-of-series must mean "no segment
         # ever stitched / no fan-in ever asked", not "not exported")

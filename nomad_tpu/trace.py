@@ -141,6 +141,15 @@ SPAN_NAMES = frozenset(
         # asked, unreachable = peers that timed out)
         "fanout.remote_span_ship",
         "cluster.fanin",
+        # multi-region federation: `federation.forward` roots one
+        # trace (``federation:<n>``) per cross-region call — its
+        # spans carry the target region, op, attempt number and the
+        # server that finally answered; `federation.fanout` roots one
+        # trace (``federation:fanout:<id>``) per Multiregion job
+        # fanned from the home region's leader, with a forward span
+        # per target region
+        "federation.forward",
+        "federation.fanout",
         # plan pipeline + state commit
         "plan.evaluate",
         "plan.apply",

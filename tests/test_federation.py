@@ -1,0 +1,391 @@
+"""Multi-region federation tests: the geo plane (server/federation.py
++ the region_call envelope in server/cluster.py + the HTTP surface).
+
+Scope here is the ROUTER: envelope kinds, retry/rerouting behavior,
+fan-out idempotence, the federation status aggregation, the shed
+redirect hint and the wan-reads boundary.  The full geo drill (region
+kill, failover SLO, placement parity vs oracles) lives in
+nomad_tpu/loadgen/geo_smoke.py and runs from tools/ci_check.sh.
+"""
+import json
+import pickle
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.raft.transport import InmemTransport
+from nomad_tpu.server.cluster import TestCluster
+from nomad_tpu.server.federation import FederationError
+from nomad_tpu.server.overload import MODE_SHEDDING
+from nomad_tpu.structs import (
+    Multiregion,
+    MultiregionRegion,
+)
+
+
+def wait_until(pred, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def geo():
+    transport = InmemTransport()
+    east = TestCluster(
+        3, transport=transport, region="east", name_prefix="east",
+        heartbeat_ttl=60.0,
+    )
+    west = TestCluster(
+        3, transport=transport, region="west", name_prefix="west",
+        heartbeat_ttl=60.0,
+    )
+    east.start()
+    west.start()
+    west.servers[0].join(east.servers[0].addr)
+    east_leader = east.wait_for_leader()
+    west_leader = west.wait_for_leader()
+    wait_until(
+        lambda: len(east_leader.gossip.members_in_region("west")) == 3
+        and len(west_leader.gossip.members_in_region("east")) == 3,
+        msg="WAN membership convergence",
+    )
+    yield transport, east, west, east_leader, west_leader
+    east.stop()
+    west.stop()
+
+
+def _mr_job(job_id, east_count, west_count):
+    job = mock.job(id=job_id)
+    job.task_groups[0].count = 1
+    job.multiregion = Multiregion(
+        regions=[
+            MultiregionRegion(name="east", count=east_count),
+            MultiregionRegion(name="west", count=west_count),
+        ]
+    )
+    return job
+
+
+# -- region_call envelope hardening -----------------------------------
+
+
+def test_region_call_unknown_op_envelope(geo):
+    _t, _e, _w, east_leader, _wl = geo
+    resp = east_leader._handle_region_call(
+        {
+            "op": "definitely_not_an_op",
+            "region": "east",
+            "args": pickle.dumps(((), {})),
+        }
+    )
+    assert resp["kind"] == "unknown_op"
+    assert "definitely_not_an_op" in resp["error"]
+    assert "result" not in resp
+
+
+def test_region_call_wrong_region_envelope(geo):
+    """Stale gossip can route a forward to a server that is not in
+    the intended region; the answer must be structured (our region +
+    leader hint), never an execution in the wrong region."""
+    _t, _e, _w, east_leader, _wl = geo
+    resp = east_leader._handle_region_call(
+        {
+            "op": "register_job",
+            "region": "west",
+            "args": pickle.dumps(((mock.job(id="misrouted"),), {})),
+        }
+    )
+    assert resp["wrong_region"] is True
+    assert resp["region"] == "east"
+    assert resp["kind"] == "wrong_region"
+    # the misrouted job must NOT have registered here
+    assert east_leader.store.job_by_id("default", "misrouted") is None
+
+
+def test_region_call_application_error_is_definitive(geo):
+    """A validation failure from the remote leader comes back as a
+    structured {error, kind: app} — and the router raises it without
+    burning retries (the remote's verdict is replicated truth)."""
+    _t, _e, _w, east_leader, west_leader = geo
+    bad = mock.job(id="bad-job")
+    bad.task_groups = []  # fails validation in the west leader
+    retries_before = east_leader.metrics.get_counter(
+        "federation.retries"
+    )
+    with pytest.raises(FederationError) as err:
+        east_leader.federation.forward("west", "register_job", bad)
+    assert err.value.kind == "app"
+    assert (
+        east_leader.metrics.get_counter("federation.retries")
+        == retries_before
+    )
+
+
+def test_forward_unknown_region_exhausts_budget(geo):
+    _t, _e, _w, east_leader, _wl = geo
+    router = east_leader.federation
+    router.retries, router.backoff_s = 1, 0.0  # fast budget for test
+    with pytest.raises(FederationError) as err:
+        router.forward("atlantis", "cluster_query", "metrics", None)
+    assert err.value.kind == "unknown_region"
+
+
+def test_forward_transport_failure_kind(geo):
+    """Every west server unreachable from the east leader (but still
+    rumored ALIVE by the rest of the pool): the forward must exhaust
+    its budget with a transport-kind error, not hang or crash."""
+    transport, _e, west, east_leader, _wl = geo
+    router = east_leader.federation
+    router.retries, router.backoff_s = 2, 0.0
+    for srv in west.servers:
+        transport.partition(east_leader.addr, srv.addr)
+    try:
+        with pytest.raises(FederationError) as err:
+            router.forward("west", "cluster_query", "metrics", None)
+        assert err.value.kind in ("transport", "timeout")
+        assert east_leader.metrics.get_counter(
+            "federation.rpc_errors"
+        ) >= 3
+    finally:
+        transport.heal(east_leader.addr)
+
+
+def test_forward_survives_remote_leader_kill(geo):
+    """Mid-federation leadership loss in the target region: the
+    bounded retry loop re-resolves membership / follows not_leader
+    hints and the call still lands."""
+    transport, _e, west, east_leader, west_leader = geo
+    transport.set_down(west_leader.addr)
+    try:
+        wait_until(
+            lambda: any(
+                s.is_leader() and s._leader_established
+                for s in west.servers
+                if s is not west_leader
+            ),
+            msg="west re-election",
+        )
+        for _ in range(2):
+            # a register_job forward must land on the NEW west leader
+            job = mock.job(id=f"reroute-{_}")
+            job.task_groups[0].count = 1
+            job.region = "west"
+            east_leader.federation.forward(
+                "west", "register_job", job
+            )
+        new_leader = next(
+            s
+            for s in west.servers
+            if s is not west_leader and s.is_leader()
+        )
+        assert new_leader.store.job_by_id("default", "reroute-0")
+    finally:
+        transport.set_down(west_leader.addr, down=False)
+
+
+# -- fan-out: idempotence + per-region counts -------------------------
+
+
+def test_federated_register_idempotent_per_cmd_id(geo):
+    """The fan-out contract: a retried forward re-proposes the SAME
+    per-region command id and must dedup in the target FSM — one job,
+    one eval, no double scheduling."""
+    _t, _e, _w, _el, west_leader = geo
+    west_leader.register_node(mock.node())
+    job = mock.job(id="fed-idem")
+    job.task_groups[0].count = 1
+    ev1 = west_leader.federated_register(job, "fanout-1:west")
+    ev2 = west_leader.federated_register(
+        mock.job(id="fed-idem"), "fanout-1:west"
+    )
+    assert ev1 is not None and ev2 is not None
+    assert ev1.id == ev2.id  # deterministic eval id from the cmd id
+    evals = [
+        ev
+        for ev in west_leader.store.evals.values()
+        if ev.job_id == "fed-idem"
+    ]
+    assert len(evals) == 1
+    stored = west_leader.store.job_by_id("default", "fed-idem")
+    assert stored is not None and stored.version == 0
+
+
+def test_multiregion_fanout_per_region_counts(geo):
+    _t, east, _w, east_leader, west_leader = geo
+    for _ in range(2):
+        east_leader.register_node(mock.node())
+        west_leader.register_node(mock.node())
+    # submitted via an east FOLLOWER: home-routes to the east leader,
+    # which fans out with per-region count overrides
+    ev = east.followers()[0].register_job(_mr_job("geo-fan", 1, 2))
+    assert ev is not None
+    assert east_leader.drain_to_idle(timeout=10.0)
+    assert west_leader.drain_to_idle(timeout=10.0)
+    east_allocs = east_leader.store.allocs_by_job("default", "geo-fan")
+    west_allocs = west_leader.store.allocs_by_job("default", "geo-fan")
+    assert len([a for a in east_allocs if not a.terminal_status()]) == 1
+    assert len([a for a in west_allocs if not a.terminal_status()]) == 2
+    # each region interpolated its own copy
+    assert east_leader.store.job_by_id("default", "geo-fan").region == "east"
+    assert west_leader.store.job_by_id("default", "geo-fan").region == "west"
+
+
+def test_federation_status_aggregates_regions(geo):
+    _t, _e, _w, east_leader, west_leader = geo
+    east_leader.register_node(mock.node())
+    west_leader.register_node(mock.node())
+    east_leader.register_job(_mr_job("geo-status", 1, 1))
+    east_leader.drain_to_idle(timeout=10.0)
+    west_leader.drain_to_idle(timeout=10.0)
+    status = east_leader.federation.federation_status(
+        "default", "geo-status"
+    )
+    assert status["home"] == "east"
+    assert status["multiregion"] is True
+    assert set(status["regions"]) == {"east", "west"}
+    for name in ("east", "west"):
+        view = status["regions"][name]
+        assert view["registered"] is True
+        assert view["region"] == name
+        assert view["groups"] == {"web": 1}
+        assert view["allocs"] == 1
+    with pytest.raises(KeyError):
+        east_leader.federation.federation_status("default", "no-such")
+
+
+# -- region health table + shed redirect ------------------------------
+
+
+def test_nearest_healthy_region_deterministic(geo):
+    _t, east, west, east_leader, _wl = geo
+    for i, srv in enumerate(west.servers):
+        srv.advertise_http(f"127.0.0.1:91{i}")
+    wait_until(
+        lambda: len(
+            east_leader.federation.refresh()
+            .get("west", {})
+            .get("http", [])
+        )
+        == 3,
+        msg="http advertise rumors",
+    )
+    region, addr = east_leader.federation.nearest_healthy_region()
+    assert region == "west"
+    assert addr == "127.0.0.1:910"  # sorted-first: deterministic
+    assert (
+        east_leader.federation.http_addr_in("west") == "127.0.0.1:910"
+    )
+    assert east_leader.federation.http_addr_in("atlantis") is None
+
+
+def test_shed_carries_retry_region_hint(geo, monkeypatch):
+    """A SHEDDING region's 429 must point global traffic at the
+    nearest healthy region (header + body), and count the redirect."""
+    _t, _e, west, east_leader, _wl = geo
+    for i, srv in enumerate(west.servers):
+        srv.advertise_http(f"127.0.0.1:92{i}")
+    wait_until(
+        lambda: len(
+            east_leader.federation.refresh()
+            .get("west", {})
+            .get("http", [])
+        )
+        == 3,
+        msg="http advertise rumors",
+    )
+    monkeypatch.setattr(
+        east_leader.overload,
+        "evaluate",
+        lambda force=False: MODE_SHEDDING,
+    )
+    http = start_http_server(east_leader, port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/v1/jobs",
+            data=json.dumps(
+                {"Job": {"ID": "shed-me", "Type": "service",
+                         "TaskGroups": [{"Name": "g", "Count": 1,
+                                         "Tasks": [{"Name": "t",
+                                                    "Driver": "mock_driver"}]}]}}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        resp = err.value
+        assert resp.code == 429
+        assert resp.headers["X-Nomad-Retry-Region"] == "west"
+        assert (
+            resp.headers["X-Nomad-Retry-Region-Addr"]
+            == "127.0.0.1:920"
+        )
+        body = json.loads(resp.read())
+        assert body["RetryRegion"] == "west"
+        assert east_leader.metrics.get_counter(
+            "federation.shed_redirects"
+        ) >= 1
+    finally:
+        http.stop()
+
+
+# -- the wan-reads boundary -------------------------------------------
+
+
+def test_region_local_reads_never_cross_wan(geo):
+    _t, _e, _w, east_leader, _wl = geo
+    east_leader.cluster_query_region("metrics", None, region=None)
+    east_leader.cluster_query_region("metrics", None, region="east")
+    assert (
+        east_leader.metrics.get_counter("federation.wan_reads") == 0
+    )
+
+
+def test_explicit_region_param_counts_wan_read(geo):
+    _t, _e, _w, east_leader, west_leader = geo
+    out = east_leader.cluster_query_region(
+        "metrics", None, region="west"
+    )
+    assert east_leader.metrics.get_counter("federation.wan_reads") == 1
+    # the merged answer comes from WEST's servers, not ours
+    assert west_leader.addr in out["servers"]
+    assert east_leader.addr not in out["servers"]
+
+
+# -- HTTP federation endpoint -----------------------------------------
+
+
+def test_http_job_federation_endpoint(geo):
+    _t, _e, _w, east_leader, west_leader = geo
+    east_leader.register_node(mock.node())
+    west_leader.register_node(mock.node())
+    east_leader.register_job(_mr_job("geo-http", 1, 1))
+    east_leader.drain_to_idle(timeout=10.0)
+    west_leader.drain_to_idle(timeout=10.0)
+    http = start_http_server(east_leader, port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/v1/job/geo-http/federation",
+            timeout=10,
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert payload["home"] == "east"
+        assert payload["regions"]["west"]["registered"] is True
+        assert payload["regions"]["west"]["groups"] == {"web": 1}
+        # unknown job -> 404, not a traceback
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}"
+                "/v1/job/no-such/federation",
+                timeout=10,
+            )
+        assert err.value.code == 404
+    finally:
+        http.stop()

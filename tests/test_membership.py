@@ -232,3 +232,156 @@ def test_regions_listing(federation):
     )
     members = leader.server_members()
     assert len(members) == 6
+
+
+# -- member wire records (http_addr rides the gossip) -----------------
+
+
+def test_member_record_round_trip_with_http_addr():
+    """record() -> _merge() round-trips every field, including the
+    HTTP advertise address federation redirects are built from."""
+    from nomad_tpu.server.membership import Member
+
+    src = Member(
+        "m1", "addr1", region="east", role="server",
+        incarnation=3, status=ALIVE, http_addr="127.0.0.1:4646",
+    )
+    rec = src.record()
+    assert rec[-1] == "127.0.0.1:4646"
+
+    sink = Gossip("g0", "g0", InmemTransport())
+    sink._merge([rec])
+    got = sink.members["m1"]
+    assert (got.name, got.addr, got.region, got.role) == (
+        "m1", "addr1", "east", "server",
+    )
+    assert got.incarnation == 3
+    assert got.http_addr == "127.0.0.1:4646"
+
+
+def test_member_merge_tolerates_legacy_six_tuple():
+    """A pre-http_addr peer gossips 6-tuples; a mixed-version pool
+    must still converge (http_addr stays empty, never a crash)."""
+    sink = Gossip("g0", "g0", InmemTransport())
+    sink._merge([("old", "old-addr", "west", "server", 1, ALIVE)])
+    got = sink.members["old"]
+    assert got.status == ALIVE
+    assert got.http_addr == ""
+    # a later 7-tuple from an upgraded peer fills the field in
+    sink._merge(
+        [("old", "old-addr", "west", "server", 2, ALIVE, "h:1")]
+    )
+    assert sink.members["old"].http_addr == "h:1"
+
+
+def test_advertise_http_bumps_incarnation_and_spreads():
+    """advertise_http must outbid equal-incarnation cached views: the
+    bump makes the new field win the rumor race pool-wide."""
+    _, pool = make_pool(3)
+    try:
+        wait_until(
+            lambda: all(len(g.alive_members()) == 3 for g in pool)
+        )
+        inc_before = pool[0].members["g0"].incarnation
+        pool[0].advertise_http("127.0.0.1:4646")
+        assert pool[0].members["g0"].incarnation == inc_before + 1
+        wait_until(
+            lambda: all(
+                g.members["g0"].http_addr == "127.0.0.1:4646"
+                for g in pool
+            ),
+            msg="http advertise rumor spread",
+        )
+        listed = {
+            m["Name"]: m["HTTPAddr"] for m in pool[-1].member_list()
+        }
+        assert listed["g0"] == "127.0.0.1:4646"
+    finally:
+        for g in pool:
+            g.stop()
+
+
+# -- members_in_region under churn ------------------------------------
+
+
+def make_region_pool(regions, transport=None, **kw):
+    """One gossip pool spanning several regions (the WAN shape)."""
+    transport = transport or InmemTransport()
+    pool = []
+    for i, region in enumerate(regions):
+        g = Gossip(
+            f"r{i}", f"r{i}", transport, region=region, **kw
+        )
+        transport.register(g.addr, lambda m, p, g=g: g.handle(m, p))
+        pool.append(g)
+    for g in pool:
+        g.start()
+    for g in pool[1:]:
+        g.join(pool[0].addr)
+    return transport, pool
+
+
+@pytest.mark.parametrize("churn", ["died", "left"])
+def test_members_in_region_all_gone_is_empty(churn):
+    """A region whose members all churned out must resolve to an
+    EMPTY routing table — stale ALIVE entries here would aim
+    cross-region forwards (and shed redirects) at a dead region."""
+    transport, pool = make_region_pool(
+        ["a", "a", "b", "b"], suspicion_timeout=0.3
+    )
+    observers = pool[:2]
+    b_members = pool[2:]
+    try:
+        wait_until(
+            lambda: all(
+                len(g.members_in_region("b")) == 2 for g in observers
+            ),
+            msg="region b discovered",
+        )
+        if churn == "left":
+            for g in b_members:
+                g.leave()
+        else:
+            for g in b_members:
+                transport.isolate(g.addr)
+        wait_until(
+            lambda: all(
+                g.members_in_region("b") == [] for g in observers
+            ),
+            msg="region b emptied",
+        )
+        # region a is untouched by b's churn
+        assert all(
+            len(g.members_in_region("a")) == 2 for g in observers
+        )
+    finally:
+        for g in pool:
+            g.stop()
+
+
+def test_members_in_region_refutation_restores():
+    """A falsely-dead region refutes the rumor and returns to the
+    routing table — forwards resume without operator action."""
+    transport, pool = make_region_pool(
+        ["a", "a", "b"], suspicion_timeout=0.3
+    )
+    observer, b_member = pool[0], pool[-1]
+    try:
+        wait_until(
+            lambda: len(observer.members_in_region("b")) == 1,
+            msg="region b discovered",
+        )
+        transport.isolate(b_member.addr)
+        wait_until(
+            lambda: observer.members_in_region("b") == [],
+            msg="region b falsely dead",
+        )
+        transport.heal()
+        wait_until(
+            lambda: len(observer.members_in_region("b")) == 1,
+            msg="region b refuted back",
+        )
+        assert observer.members[b_member.name].incarnation > 0
+    finally:
+        for g in pool:
+            g.stop()

@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (30 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (31 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -67,6 +67,19 @@ if [ "${SMOKE:-1}" = "1" ]; then
     timeout -k 10 300 python -m nomad_tpu.loadgen.swarm_smoke \
         --nodes 600 --submitters 240 --death 120 --ttl 8 \
         --base-jobs 150
+
+    echo "== geo federation smoke (2 regions x 3 servers + kill drill) =="
+    # the geo-plane gate: a Multiregion job federated both ways with
+    # placement parity vs per-region single-region oracles, zero WAN
+    # reads for region-local traffic (?region= escape hatch asserted
+    # to count), shed submitters redirected to the healthy region
+    # within the SLO, and the full region-kill drill — all three east
+    # servers dark at once, zero lost evals in west, failed-over
+    # submitters landing via their cached retry-region hint, east
+    # re-federating after the heal.  The kill-timeout fails a wedged
+    # geo plane instead of hanging the gate
+    timeout -k 10 300 python -m nomad_tpu.loadgen.geo_smoke \
+        --flood-submitters 96 --redirect-slo 20
 
     echo "== policy-weighted scoring A/B (scaled down) =="
     # the policy-layer gate: heterogeneity-aware throughput must pull

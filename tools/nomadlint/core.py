@@ -54,6 +54,7 @@ DEFAULT_PATHS: Dict[str, str] = {
     "overload": "nomad_tpu/server/overload.py",
     "cluster": "nomad_tpu/server/cluster.py",
     "fanout": "nomad_tpu/server/fanout.py",
+    "federation": "nomad_tpu/server/federation.py",
     "envknobs": "nomad_tpu/envknobs.py",
     "arch_doc": "docs/ARCHITECTURE.md",
     "state_dir": "nomad_tpu/state",

@@ -221,6 +221,12 @@ class SpanRegistryRule(Rule):
         # assembly inside storm staging — without it a weighted
         # storm's staging cost is invisible on every trace dashboard
         "batch_worker.policy_assemble",
+        # multi-region federation: the cross-region forward and the
+        # Multiregion fan-out roots — without them a WAN hop leaves
+        # no flight-recorder trail and a fanned job's per-region
+        # registrations can't be attributed
+        "federation.forward",
+        "federation.fanout",
     )
 
     def check(self, ctx: Context) -> List[Finding]:
@@ -1450,6 +1456,89 @@ class BigworldExportRule(Rule):
             ctx, tmpdir, "bench",
             old='"bigworld"',
             new='"renamed_bigworld"',
+        )
+
+
+@register
+class FederationMetricsRule(Rule):
+    """Multi-region federation plane: every ``federation.*`` metric
+    emitted by federation.py, cluster.py, server.py or api/http.py —
+    literal first args of metric calls — is in the zero-registered
+    ``FEDERATION_COUNTERS`` / ``FEDERATION_GAUGES`` registries
+    (federation.py) and server.py preregisters both at construction:
+    absence of a ``federation.wan_reads`` or
+    ``federation.forwarded`` series must mean "single region,
+    nothing ever crossed the WAN", never "not exported"."""
+
+    name = "federation-metrics"
+    description = "federation.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        federation_path = ctx.path("federation")
+        registry = astutil.assigned_strings(
+            ctx.tree(federation_path), "FEDERATION_COUNTERS"
+        ) | astutil.assigned_strings(
+            ctx.tree(federation_path), "FEDERATION_GAUGES"
+        )
+        if not registry:
+            return [
+                Finding(
+                    self.name, federation_path, 0,
+                    "could not find the FEDERATION_COUNTERS/"
+                    "FEDERATION_GAUGES registries in federation.py",
+                )
+            ]
+        problems: List[Finding] = []
+        for key in ("federation", "cluster", "server", "api_http"):
+            path = ctx.path(key)
+            tree = ctx.tree(path)
+            emitted: Set[str] = set()
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                if (
+                    node.func.attr in astutil.METRIC_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("federation.")
+                ):
+                    emitted.add(node.args[0].value)
+            unregistered = emitted - registry
+            if unregistered:
+                problems.append(
+                    Finding(
+                        self.name, path, 0,
+                        "federation.* metrics emitted but not in "
+                        "the FEDERATION_COUNTERS/FEDERATION_GAUGES "
+                        "registries (they would be absent from "
+                        "prometheus scrapes until the first WAN "
+                        f"crossing): {sorted(unregistered)}",
+                    )
+                )
+        server_src = ctx.source(ctx.path("server"))
+        if "FEDERATION_COUNTERS" not in server_src:
+            problems.append(
+                Finding(
+                    self.name, ctx.path("server"), 0,
+                    "server.py no longer zero-registers the "
+                    "federation.* family at construction "
+                    "(FEDERATION_COUNTERS preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "federation",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.incr("federation.bogus_metric")\n'
+            ),
         )
 
 
