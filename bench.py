@@ -1036,6 +1036,7 @@ WITH_CLUSTER_FANOUT = (
 )
 WITH_BIGWORLD = os.environ.get("BENCH_BIGWORLD", "1") == "1"
 WITH_CLUSTER_OBS = os.environ.get("BENCH_CLUSTER_OBS", "1") == "1"
+WITH_SLO = os.environ.get("BENCH_SLO", "1") == "1"
 WITH_FEDERATION = os.environ.get("BENCH_FEDERATION", "1") == "1"
 
 
@@ -1296,6 +1297,214 @@ def bench_cluster_obs():
         f"on={t_on:.2f}s off={t_off:.2f}s ({pct:+.1f}%) "
         f"stitched>={stitched_min} orphans={orphans_total} "
         f"fanin={fanin} ring={ring_bytes}B "
+        f"({time.time() - t0:.1f}s)"
+    )
+    return block
+
+
+def bench_slo():
+    """Control-loop flight-data costs (`slo` in BENCH json): (a) the
+    decision ledger's overhead — the same config2-like batch stream
+    as the trace-overhead bench with the ledger on vs
+    ``NOMAD_TPU_DECISIONS=0``, interleaved A/B with a discarded
+    warmup and min-of-reps; the acceptance contract is <3% (the
+    ledger is one dict build + a lock'd append per CHANGED choice, so
+    it should be noise); (b) a site-coverage soak — a scaled swarm
+    run (overload sheds + mass node-death storms against the real
+    HTTP API) plus a 3-server fan-out round — proving the
+    decision-ledger lint is non-vacuous at runtime: the chunk-width,
+    admission, overload, storm and fan-out sites all wrote records;
+    (c) the SLO engine's burn-rate grades over a real history ring
+    after a placement round.  BENCH_SLO=0 opts out;
+    BENCH_SLO_{NODES,JOBS,REPS} and BENCH_SLO_SWARM_* rescale."""
+    from nomad_tpu.decisions import DECISIONS
+    from nomad_tpu.loadgen.swarm_smoke import run_swarm
+    from nomad_tpu.server.fanout_bench import _run_topology
+
+    t0 = time.time()
+    n_nodes = int(os.environ.get("BENCH_SLO_NODES", 300))
+    n_jobs = int(os.environ.get("BENCH_SLO_JOBS", 48))
+    reps = int(os.environ.get("BENCH_SLO_REPS", 2))
+
+    def nodes():
+        rng = random.Random(13)
+        out = []
+        for i in range(n_nodes):
+            n = mock.node(id=f"sl-node-{i:05d}")
+            n.node_resources.cpu = rng.choice([8000, 16000])
+            n.node_resources.memory_mb = rng.choice([16384, 32768])
+            out.append(n)
+        _share_classes(out)
+        return out
+
+    slo_report = {}
+
+    def run_once(enabled, tag, capture_slo=False):
+        DECISIONS.set_enabled(enabled)
+        DECISIONS.clear()
+        server = _mk_server(True)
+        try:
+            for node in nodes():
+                server.store.upsert_node(node)
+            server.start()
+            server.workers[0].warm_shapes()
+            jobs = []
+            for i in range(n_jobs):
+                job = mock.job(id=f"sl-{tag}-{i}")
+                job.type = "batch"
+                job.task_groups[0].count = 10
+                job.task_groups[0].tasks[0].resources.cpu = 300
+                jobs.append(job)
+            dt, _pmap, n = _run_jobs(server, jobs)
+            if capture_slo:
+                # grade the round through the real ring: >=2
+                # snapshots so counter deltas exist
+                server.metrics_history.snapshot_once()
+                server.metrics_history.snapshot_once()
+                st = server.slo.status()
+                slo_report.update(
+                    worst=st["worst"],
+                    objectives={
+                        o["name"]: o["status"]
+                        for o in st["objectives"]
+                    },
+                )
+            log(
+                f"slo-overhead {tag} "
+                f"ledger={'on' if enabled else 'off'}:"
+                f" {n} placements in {dt:.2f}s"
+            )
+            return dt
+        finally:
+            server.stop()
+
+    times = {True: [], False: []}
+    counts = {}
+    try:
+        # discarded warmup (pays the XLA compiles for this node
+        # count); also the slo-status capture round
+        run_once(True, "warmup", capture_slo=True)
+        for rep in range(reps):
+            for enabled in (True, False):
+                times[enabled].append(run_once(enabled, f"r{rep}"))
+
+        # -- site-coverage soak ----------------------------------
+        # the decision-ledger lint proves every registered site HAS
+        # a record call; this proves the calls actually fire under
+        # the workloads they steer
+        DECISIONS.set_enabled(True)
+        DECISIONS.clear()
+        swarm = run_swarm(
+            nodes=int(os.environ.get("BENCH_SLO_SWARM_NODES", 600)),
+            submitters=int(
+                os.environ.get("BENCH_SLO_SWARM_SUBMITTERS", 240)
+            ),
+            death=int(os.environ.get("BENCH_SLO_SWARM_DEATH", 120)),
+            ttl_s=float(os.environ.get("BENCH_SLO_SWARM_TTL", 8.0)),
+            base_jobs=int(
+                os.environ.get("BENCH_SLO_SWARM_BASE_JOBS", 150)
+            ),
+        )
+        # targeted admission probe: a non-batchable (sticky-disk)
+        # arrival mid-chain is the deterministic way to fire the
+        # admission-defer gate (the swarm's arrivals usually coalesce
+        # into storms instead)
+        probe = _mk_server(True)
+        probe_worker = probe.workers[0]
+        fired = []
+        orig_launch = probe_worker._launch_chunk
+
+        def hooked(asm, c0, c1, carry, check_ready):
+            if not fired:
+                fired.append(True)
+                sticky = mock.job(id="slo-adm-sticky")
+                sticky.task_groups[0].ephemeral_disk.sticky = True
+                probe.register_job(sticky)
+            return orig_launch(asm, c0, c1, carry, check_ready)
+
+        probe_worker._launch_chunk = hooked
+        try:
+            pn = []
+            for i in range(12):
+                n = mock.node(id=f"sl-adm-node-{i:02d}")
+                pn.append(n)
+            _share_classes(pn)
+            for n in pn:
+                probe.register_node(n)
+            for i in range(4):
+                job = mock.job(id=f"sl-adm-{i}")
+                job.type = "batch"
+                job.task_groups[0].count = 8
+                probe.register_job(job)
+            probe.start()
+            probe.drain_to_idle(60)
+        finally:
+            probe.stop()
+
+        fanout_knobs = {
+            "NOMAD_TPU_FANOUT": "1",
+            "NOMAD_TPU_BATCH_MAX": "8",
+            "NOMAD_TPU_FANOUT_LEASE_N": "4",
+        }
+        saved = {k: os.environ.get(k) for k in fanout_knobs}
+        os.environ.update(fanout_knobs)
+        try:
+            _run_topology(
+                3,
+                nodes=int(
+                    os.environ.get("BENCH_SLO_FANOUT_NODES", 128)
+                ),
+                families=int(
+                    os.environ.get("BENCH_SLO_FANOUT_FAMILIES", 48)
+                ),
+                jobs_per=1,
+                tag="slf",
+            )
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        counts = DECISIONS.counts()
+    finally:
+        DECISIONS.set_enabled(True)
+        DECISIONS.clear()
+
+    t_on, t_off = min(times[True]), min(times[False])
+    pct = (t_on - t_off) / t_off * 100.0 if t_off else 0.0
+    # <3% with the same additive slack shape the other overhead
+    # gates use: tiny absolute wall times make pure ratios noisy
+    overhead_ok = t_on <= t_off * 1.03 + 0.2
+    required = (
+        "chunk_width",
+        "admission_defer",
+        "overload_mode",
+        "storm_trigger",
+        "fanout_lease",
+    )
+    missing = sorted(s for s in required if not counts.get(s))
+    block = {
+        "ok": bool(
+            overhead_ok and not missing and swarm.get("ok")
+        ),
+        "nodes": n_nodes,
+        "jobs": n_jobs,
+        "reps": reps,
+        "ledger_on_s": round(t_on, 3),
+        "ledger_off_s": round(t_off, 3),
+        "ledger_overhead_pct": round(pct, 2),
+        "overhead_ok": overhead_ok,
+        "site_records": counts,
+        "sites_missing": missing,
+        "swarm_ok": swarm.get("ok"),
+        "swarm_violations": swarm.get("violations", []),
+        "slo_status": slo_report,
+    }
+    log(
+        f"slo: ok={block['ok']} ledger overhead on={t_on:.2f}s "
+        f"off={t_off:.2f}s ({pct:+.1f}%) sites={sorted(counts)} "
+        f"missing={missing} worst={slo_report.get('worst')} "
         f"({time.time() - t0:.1f}s)"
     )
     return block
@@ -2309,6 +2518,13 @@ def main():
         except Exception as exc:  # noqa: BLE001
             log(f"cluster obs bench FAILED: {exc!r}")
             cluster_obs = {"error": repr(exc)}
+    slo = {}
+    if WITH_SLO:
+        try:
+            slo = bench_slo()
+        except Exception as exc:  # noqa: BLE001
+            log(f"slo bench FAILED: {exc!r}")
+            slo = {"error": repr(exc)}
     bigworld = {}
     if WITH_BIGWORLD:
         try:
@@ -2387,6 +2603,12 @@ def main():
                 # fan-in query latency at 1/3/5 servers, and the
                 # metric history ring's full-depth footprint
                 "cluster_obs": cluster_obs,
+                # control-loop flight data: decision-ledger overhead
+                # A/B (<3%), runtime site coverage under the swarm +
+                # fan-out soak (the decision-ledger lint's
+                # non-vacuity proof), and the SLO engine's burn-rate
+                # grades over a real history ring
+                "slo": slo,
                 # million-node composed topology: fan-out followers
                 # each heading a multi-process pod mesh over a
                 # raft-seeded >=1M-node world (placements/s,

@@ -1925,6 +1925,40 @@ class APIHandler(BaseHTTPRequestHandler):
                 self._respond(ctl.status())
             return True
 
+        # -- SLO burn-rate status --------------------------------------
+        # unauthenticated and never shed, like /v1/overload: "are we
+        # meeting our objectives" must answer exactly when we aren't
+        if path == "/v1/slo" and method == "GET":
+            slo = getattr(srv, "slo", None)
+            if slo is None:
+                self._respond(
+                    {"enabled": False, "objectives": [], "worst": "OK"}
+                )
+            else:
+                self._respond(slo.status())
+            return True
+
+        # -- adaptive-decision ledger ----------------------------------
+        # agent:read like /v1/traces: decision inputs carry job ids,
+        # node counts and backlog shapes across every namespace
+        if path == "/v1/decisions" and method == "GET":
+            self._check_acl("agent:read")
+            from ..decisions import DECISIONS
+
+            try:
+                limit = int(q.get("limit", "64"))
+            except ValueError:
+                raise HTTPError(400, "bad limit")
+            self._respond(
+                DECISIONS.to_dict(
+                    site=q.get("site"),
+                    outcome=q.get("outcome"),
+                    trace=q.get("trace"),
+                    limit=max(1, min(limit, 1024)),
+                )
+            )
+            return True
+
         # -- eval flight recorder (per-eval span traces) ----------------
         # agent:read like the other debug surfaces (monitor, pprof):
         # traces carry job ids and node ids across every namespace
@@ -2087,6 +2121,72 @@ class APIHandler(BaseHTTPRequestHandler):
             self._respond(
                 {
                     "servers": servers,
+                    "unreachable": merged["unreachable"],
+                }
+            )
+            return True
+
+        if path == "/v1/cluster/slo" and method == "GET":
+            self._check_acl("agent:read")
+            merged = self._cluster_obs(
+                srv, "slo", {}, region=q.get("region")
+            )
+            servers = {
+                addr: (
+                    {"unreachable": True}
+                    if result.get("unreachable")
+                    else result.get("slo", {})
+                )
+                for addr, result in merged["servers"].items()
+            }
+            self._respond(
+                {
+                    "servers": servers,
+                    "unreachable": merged["unreachable"],
+                }
+            )
+            return True
+
+        if path == "/v1/cluster/decisions" and method == "GET":
+            self._check_acl("agent:read")
+            params = {
+                "limit": q.get("limit", "64"),
+                "site": q.get("site"),
+                "outcome": q.get("outcome"),
+                "trace": q.get("trace"),
+            }
+            merged = self._cluster_obs(
+                srv, "decisions", params, region=q.get("region")
+            )
+            decisions = []
+            status = {}
+            seen = set()
+            for addr, result in merged["servers"].items():
+                if result.get("unreachable"):
+                    status[addr] = "unreachable"
+                    continue
+                status[addr] = "ok"
+                share = result.get("decisions", {})
+                for rec in share.get("decisions", []):
+                    # dedup by ledger seq: with a shared in-process
+                    # ledger (TestCluster) every server reports the
+                    # same records; first reporter wins attribution
+                    if rec.get("seq") in seen:
+                        continue
+                    seen.add(rec.get("seq"))
+                    rec["server"] = addr
+                    decisions.append(rec)
+            decisions.sort(
+                key=lambda r: r.get("seq", 0), reverse=True
+            )
+            try:
+                limit = int(params["limit"])
+            except ValueError:
+                raise HTTPError(400, "bad limit")
+            self._respond(
+                {
+                    "decisions": decisions[: max(1, min(limit, 1024))],
+                    "servers": status,
                     "unreachable": merged["unreachable"],
                 }
             )

@@ -777,6 +777,17 @@ def cmd_operator_debug(args) -> None:
         # decompositions + filter attributions, cross-referenced with
         # traces.json by eval id
         "placements.json": ("GET", "/v1/placements?limit=256"),
+        # control-loop flight data: SLO burn-rate status plus the
+        # adaptive-decision ledger, cross-referenced with traces.json
+        # by trace id — a bundle from a misbehaving server says WHAT
+        # objective is burning and WHY each control loop chose what
+        # it chose
+        "slo.json": ("GET", "/v1/slo"),
+        "decisions.json": ("GET", "/v1/decisions?limit=256"),
+        "cluster-slo.json": ("GET", "/v1/cluster/slo"),
+        "cluster-decisions.json": (
+            "GET", "/v1/cluster/decisions?limit=256"
+        ),
         "monitor.json": ("GET", "/v1/agent/monitor"),
         "pprof-goroutine.json": ("GET", "/v1/agent/pprof/goroutine"),
         "pprof-heap.json": ("GET", "/v1/agent/pprof/heap"),
@@ -842,6 +853,80 @@ def cmd_device_status(args) -> None:
                 f"  {h.get('from')} -> {h.get('to')}: "
                 f"{h.get('reason')}"
             )
+
+
+def cmd_slo_status(args) -> None:
+    """SLO burn-rate status (GET /v1/slo)."""
+    st = _request("GET", "/v1/slo")
+    if _emit(args, st):
+        return
+    if not st.get("enabled"):
+        print("SLO engine disabled (NOMAD_TPU_SLO=0)")
+        return
+    win = st.get("windows", {})
+    print(
+        f"Worst: {st.get('worst', 'OK')}  "
+        f"(windows fast={win.get('fast_n')} slow={win.get('slow_n')} "
+        f"x {win.get('interval_s')}s, retained={win.get('retained')})"
+    )
+    _table(
+        [
+            (
+                o.get("name", "?"),
+                o.get("status", "?"),
+                o.get("burn_fast", 0),
+                o.get("burn_slow", 0),
+                o.get("target_ms", "-"),
+                o.get("budget", "-"),
+            )
+            for o in st.get("objectives", [])
+        ],
+        [
+            "Objective", "Status", "BurnFast", "BurnSlow",
+            "Target ms", "Budget",
+        ],
+    )
+
+
+def cmd_decisions(args) -> None:
+    """Adaptive-decision ledger (GET /v1/decisions)."""
+    qs = []
+    for key in ("site", "outcome", "trace"):
+        val = getattr(args, key, None)
+        if val:
+            qs.append(f"{key}={urllib.parse.quote(val)}")
+    qs.append(f"limit={getattr(args, 'limit', None) or 32}")
+    st = _request("GET", "/v1/decisions?" + "&".join(qs))
+    if _emit(args, st):
+        return
+    if not st.get("enabled"):
+        print("Decision ledger disabled (NOMAD_TPU_DECISIONS=0)")
+        return
+    ring = st.get("ring", {})
+    print(
+        f"Ring: {ring.get('depth', 0)}/{ring.get('cap', 0)} "
+        f"(evicted {ring.get('evicted', 0)})"
+    )
+    rows = []
+    for rec in st.get("decisions", []):
+        inputs = rec.get("inputs", {})
+        brief = " ".join(
+            f"{k}={inputs[k]}" for k in sorted(inputs)[:3]
+        )
+        rows.append(
+            (
+                rec.get("seq", 0),
+                rec.get("site", "?"),
+                rec.get("action", "?"),
+                rec.get("outcome", "?"),
+                rec.get("trace_id") or "-",
+                brief,
+            )
+        )
+    _table(
+        rows,
+        ["Seq", "Site", "Action", "Outcome", "Trace", "Inputs"],
+    )
 
 
 def cmd_operator_raft(args) -> None:
@@ -2072,6 +2157,22 @@ def build_parser() -> argparse.ArgumentParser:
     dst = devp_sub.add_parser("status")
     _add_fmt(dst)
     dst.set_defaults(fn=cmd_device_status)
+
+    slop = sub.add_parser("slo")
+    slop_sub = slop.add_subparsers(dest="action", required=True)
+    sst = slop_sub.add_parser("status")
+    _add_fmt(sst)
+    sst.set_defaults(fn=cmd_slo_status)
+
+    decp = sub.add_parser("decisions")
+    decp.add_argument("-site", dest="site", default="")
+    decp.add_argument("-outcome", dest="outcome", default="")
+    decp.add_argument("-trace", dest="trace", default="")
+    decp.add_argument(
+        "-limit", dest="limit", type=int, default=32
+    )
+    _add_fmt(decp)
+    decp.set_defaults(fn=cmd_decisions)
 
     mon = sub.add_parser("monitor")
     mon.add_argument(

@@ -116,6 +116,10 @@ METRIC_GAUGES = frozenset(
 METRIC_SAMPLES = frozenset(
     {
         "device.probe_latency_ms",
+        # failover detect-to-resume: LOST transition to the restored
+        # HEALTHY flip, the latency the SLO engine's
+        # failover_detect_to_resume objective grades
+        "device.failover_resume_ms",
     }
 )
 
@@ -251,6 +255,10 @@ class DeviceSupervisor:
         self.last_error: Optional[str] = None
         self._incident: Optional[str] = None
         self.last_incident: Optional[str] = None
+        # detect-to-resume stopwatch: stamped at failover, read (and
+        # cleared) when the restored flip samples
+        # device.failover_resume_ms
+        self._failover_at: Optional[float] = None
         # unhealthy-time accounting (bench time_degraded_s): cumulative
         # seconds spent outside HEALTHY/CPU_ONLY plus the live segment
         self._unhealthy_accum = 0.0
@@ -437,6 +445,24 @@ class DeviceSupervisor:
         LOG.warning(
             "device watchdog tripped: stage %s exceeded %.2fs budget",
             stage, budget_s,
+        )
+        from ..decisions import DECISIONS
+
+        ewma = self.budgets.ewma(stage)
+        DECISIONS.record(
+            "watchdog_budget",
+            "trip",
+            inputs={
+                "stage": stage,
+                "budget_s": round(budget_s, 3),
+                "ewma_s": round(ewma, 4) if ewma is not None else None,
+                "factor": self.budgets.factor,
+                "backend_epoch": self.backend_epoch,
+            },
+            alternatives=["keep_waiting"],
+            outcome="lost",
+            trace_id=eval_id or self._incident or "",
+            metrics=self.metrics,
         )
         self._transition(LOST, f"watchdog:{stage}", stage=stage)
 
@@ -662,10 +688,16 @@ class DeviceSupervisor:
             restored = new == HEALTHY and old == RECOVERING
             if failover or restored:
                 self.backend_epoch += 1
+            failover_at = None
             if failover:
                 self.failover_count += 1
+                # detect-to-resume stopwatch start: sampled (and
+                # cleared) by the matching restored transition
+                self._failover_at = now
             if restored:
                 self.recovered_count += 1
+                failover_at = self._failover_at
+                self._failover_at = None
             self._history.append(
                 {
                     "at": self._since_wall,
@@ -709,6 +741,12 @@ class DeviceSupervisor:
                         )
         if restored:
             self._incr("device.recovered")
+            if failover_at is not None and self.metrics is not None:
+                self.metrics.add_sample(
+                    "device.failover_resume_ms",
+                    (time.monotonic() - failover_at) * 1000.0,
+                    exemplar=self._incident or "",
+                )
             self._close_incident(reason)
 
     def _open_incident(

@@ -319,6 +319,49 @@ ENV_KNOBS: Dict[str, EnvKnob] = {
         "metric history cadence: seconds between snapshot windows "
         "(default N*S = a 10-minute rolling view)",
     ),
+    "NOMAD_TPU_SLO": EnvKnob(
+        "1", "nomad_tpu/slo.py",
+        "0 disables SLO burn-rate grading (/v1/slo reports every "
+        "objective OK with zero burn)",
+    ),
+    "NOMAD_TPU_SLO_FAST_N": EnvKnob(
+        "6", "nomad_tpu/slo.py",
+        "fast burn window: newest history snapshots graded for "
+        "'is it happening now' (min 2)",
+    ),
+    "NOMAD_TPU_SLO_SLOW_N": EnvKnob(
+        "30", "nomad_tpu/slo.py",
+        "slow burn window: newest history snapshots graded for "
+        "'is it material' (min 2)",
+    ),
+    "NOMAD_TPU_SLO_WARN": EnvKnob(
+        "1.0", "nomad_tpu/slo.py",
+        "WARN threshold: either window burning at >= this rate",
+    ),
+    "NOMAD_TPU_SLO_BURN": EnvKnob(
+        "2.0", "nomad_tpu/slo.py",
+        "BURNING threshold: BOTH windows burning at >= this rate",
+    ),
+    "NOMAD_TPU_SLO_P99_MS": EnvKnob(
+        "250", "nomad_tpu/slo.py",
+        "interactive_placement_p99 objective target: windowed "
+        "eval-latency p99 budget",
+    ),
+    "NOMAD_TPU_SLO_FAILOVER_MS": EnvKnob(
+        "60000", "nomad_tpu/slo.py",
+        "failover_detect_to_resume objective target: device "
+        "failover-to-restored p99 budget",
+    ),
+    "NOMAD_TPU_DECISIONS": EnvKnob(
+        "1", "nomad_tpu/decisions.py",
+        "0 turns the adaptive-decision ledger into no-ops (sites "
+        "skip record assembly entirely)",
+    ),
+    "NOMAD_TPU_DECISIONS_RING": EnvKnob(
+        "512", "nomad_tpu/decisions.py",
+        "decision-ledger ring depth: newest-wins retention bound "
+        "(min 16)",
+    ),
     "NOMAD_TPU_OBS_FANIN_TIMEOUT_S": EnvKnob(
         "2.0", "nomad_tpu/server/cluster.py",
         "per-query wall budget for the leader's /v1/cluster/* "

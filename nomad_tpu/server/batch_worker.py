@@ -85,6 +85,7 @@ from ..structs import (
     Job,
     TaskGroup,
 )
+from ..decisions import DECISIONS
 from ..explain import EXPLAIN
 from ..raft import NotLeaderError
 from ..raft import chaos as _chaos
@@ -790,6 +791,13 @@ class BatchWorker(Worker):
         # says nothing about single-chip chunks, and vice versa)
         self._mesh_ewma_seed: Optional[float] = None
         self._replay_ewma_ms = 5.0
+        # decision-ledger dedup: chunk width / adaptive cap are
+        # per-gulp hot paths, so they ledger only when the CHOICE
+        # changes — a steady-state 64-wide drain is one record, not
+        # ten thousand (which would evict every other site's flight
+        # data from the bounded ring)
+        self._last_chunk_width = 0
+        self._last_adaptive_cap = 0
         # continuous micro-batching (NOMAD_TPU_ADMIT=0 restores the
         # flush-boundary gulp loop): evals dequeued while a chunk
         # chain is in flight are admitted into that chain's next chunk
@@ -1307,6 +1315,24 @@ class BatchWorker(Worker):
         if metrics is not None:
             metrics.incr(f"storm.{kind}", float(n))
 
+    def _record_decision(self, site: str, action: str, **kw) -> None:
+        """Ledger hook (nomad_tpu/decisions.py): every adaptive
+        decision in this worker funnels through here so the inputs
+        snapshot always carries the two epochs a post-hoc reader
+        needs to interpret it — the leadership generation the
+        decision ran under and the backend epoch its cost EWMAs were
+        measured against."""
+        inputs = dict(kw.pop("inputs", None) or {})
+        inputs.setdefault("leader_gen", self._leader_gen())
+        inputs.setdefault("backend_epoch", self._backend_epoch)
+        DECISIONS.record(
+            site,
+            action,
+            inputs=inputs,
+            metrics=getattr(self.server, "metrics", None),
+            **kw,
+        )
+
     def _count_policy(self, kind: str) -> None:
         """Policy-weighted-scoring counters, exported under the
         `policy.` namespace on /v1/metrics (the family is
@@ -1505,6 +1531,23 @@ class BatchWorker(Worker):
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
             metrics.set_gauge("batch_worker.chunk_width", width)
+        if DECISIONS.enabled and width != self._last_chunk_width:
+            self._last_chunk_width = width
+            buckets = self._chunk_buckets()
+            self._record_decision(
+                "chunk_width",
+                f"width={width}",
+                inputs={
+                    "n_evals": n_evals,
+                    "backlog": backlog,
+                    "budget_ms": self.latency_budget_ms,
+                    "launch_cost_ms": round(
+                        self._launch_cost_ms(width, mesh=mesh), 3
+                    ),
+                    "mesh": mesh,
+                },
+                alternatives=[f"width={w}" for w in buckets],
+            )
         return width
 
     def _adaptive_cap(self) -> int:
@@ -1545,6 +1588,18 @@ class BatchWorker(Worker):
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
             metrics.set_gauge("batch_worker.adaptive_cap", cap)
+        if DECISIONS.enabled and cap != self._last_adaptive_cap:
+            self._last_adaptive_cap = cap
+            self._record_decision(
+                "adaptive_cap",
+                f"cap={cap}",
+                inputs={
+                    "backlog": backlog,
+                    "budget_ms": self.latency_budget_ms,
+                    "replay_ewma_ms": round(self._replay_ewma_ms, 3),
+                },
+                alternatives=[f"cap={c}" for c in candidates],
+            )
         return cap
 
     def _note_dequeue(self, ev: Evaluation) -> None:
@@ -1649,6 +1704,15 @@ class BatchWorker(Worker):
                             LOG.exception(
                                 "storm processing crashed"
                             )
+                            # the members' waterfalls must explain
+                            # the detour: they were coalesced into a
+                            # storm that never committed, and will
+                            # reappear via lease redelivery
+                            for s_ev, _tok in storm:
+                                TRACE.event(
+                                    s_ev.id, "storm.fallback",
+                                    reason="storm_crash",
+                                )
                             self._abandon_leases(storm)
                             leftover = []
                         continue
@@ -2368,6 +2432,11 @@ class BatchWorker(Worker):
                     ev.id, "batch_worker.admit_deferred",
                     reason="queue_closed",
                 )
+                self._record_decision(
+                    "admission_defer", "defer",
+                    inputs={"chain_epoch": chain_epoch},
+                    outcome="queue_closed", trace_id=ev.id,
+                )
                 continue
             job = self.store.job_by_id(ev.namespace, ev.job_id)
             reason = self._admission_gates(
@@ -2398,6 +2467,15 @@ class BatchWorker(Worker):
                 TRACE.event(
                     ev.id, "batch_worker.admit_deferred",
                     reason=reason,
+                )
+                self._record_decision(
+                    "admission_defer", "defer",
+                    inputs={
+                        "chain_epoch": chain_epoch,
+                        "wave_readiness": wave_readiness,
+                        "chunk_w": chunk_w,
+                    },
+                    outcome=reason, trace_id=ev.id,
                 )
                 continue
             admitted.append((ev, token, job))
@@ -2439,6 +2517,14 @@ class BatchWorker(Worker):
                 TRACE.event(
                     ev.id, "batch_worker.admit_deferred",
                     reason="assembly",
+                )
+                self._record_decision(
+                    "admission_defer", "defer_group",
+                    inputs={
+                        "chain_epoch": chain_epoch,
+                        "group": len(admitted),
+                    },
+                    outcome="assembly", trace_id=ev.id,
                 )
             return [], j
         if not admission.admitted_any:
@@ -2494,6 +2580,17 @@ class BatchWorker(Worker):
         for d_ev, _tok in drained:
             self._note_dequeue(d_ev)
         members = [(ev, token)] + drained
+        self._record_decision(
+            "storm_trigger", "drain_family",
+            inputs={
+                "family": f"{family[0]}/{family[1]}",
+                "drained": len(members),
+                "storm_min": self.storm_min,
+                "storm_max": self.storm_max,
+            },
+            alternatives=["serial_gulp"],
+            trace_id=ev.id,
+        )
         # settle beats: a storm ARRIVES as a wave (drain loop,
         # restore scan, dispatch burst), so keep absorbing the
         # family prefix while it is still growing — one empty
@@ -2504,6 +2601,8 @@ class BatchWorker(Worker):
         import time as _time
 
         waited = False
+        beats = 0
+        absorbed = 0
         while len(members) < self.storm_max:
             try:
                 more = self.server.broker.drain_family(
@@ -2523,12 +2622,26 @@ class BatchWorker(Worker):
                 for d_ev, _tok in more:
                     self._note_dequeue(d_ev)
                 members.extend(more)
+                absorbed += len(more)
                 waited = False
                 continue
             if waited:
                 break
             _time.sleep(BATCH_WAIT_S)
             waited = True
+            beats += 1
+        self._record_decision(
+            "storm_settle",
+            "solve" if len(members) < self.storm_max else "solve_full",
+            inputs={
+                "members": len(members),
+                "beats": beats,
+                "absorbed": absorbed,
+                "storm_max": self.storm_max,
+            },
+            alternatives=["keep_waiting"],
+            trace_id=ev.id,
+        )
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
             metrics.set_gauge("storm.backlog", float(len(members)))
@@ -2707,7 +2820,7 @@ class BatchWorker(Worker):
             else:
                 self._count_storm("fallbacks")
                 TRACE.event(
-                    m.ev.id, "batch_worker.storm_fallback",
+                    m.ev.id, "storm.fallback",
                     reason=m.reason or "solver",
                 )
                 wave.append((
@@ -2742,7 +2855,7 @@ class BatchWorker(Worker):
                     m.pulls = None
                     demoted += 1
                     TRACE.event(
-                        m.ev.id, "batch_worker.storm_fallback",
+                        m.ev.id, "storm.fallback",
                         reason="rescore",
                     )
             if demoted:

@@ -23,6 +23,13 @@ DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
 FAILED_QUEUE = "_failed"
 
+# exported once the server wires its Metrics handle in (the broker is
+# constructed before telemetry): a delivery-exhausted eval parked in
+# the failed queue is the zero-lost-evals SLO's only burn signal, so
+# absence of the series must mean "nothing lost", not "not exported"
+# — Server zero-registers the family at construction
+BROKER_COUNTERS = ("broker.delivery_failures",)
+
 # job-id separators that mark a parent's spawned children: a dispatch
 # or periodic storm is hundreds of sibling jobs under one parent
 _FAMILY_SEPARATORS = ("/dispatch-", "/periodic-")
@@ -136,6 +143,9 @@ class EvalBroker:
             "total_remote_unacked": 0,
             "delivery_failures": 0,
         }
+        # the owning server's Metrics handle (set post-construction;
+        # None on bare brokers in unit tests)
+        self.metrics = None
         # happens-before sanitizer (NOMAD_TPU_TSAN=1)
         from ..tsan import maybe_instrument
 
@@ -593,6 +603,8 @@ class EvalBroker:
             self._delivery_count[eval_id] = count
             if count >= self.delivery_limit:
                 self.stats["delivery_failures"] += 1
+                if self.metrics is not None:
+                    self.metrics.incr("broker.delivery_failures")
                 self._enqueue_locked(ev, FAILED_QUEUE)
             else:
                 self._enqueue_locked(ev, ev.type)

@@ -52,6 +52,7 @@ import time
 from collections import deque
 from typing import Deque, List, Optional, Tuple
 
+from ..decisions import DECISIONS
 from ..raft import NotLeaderError
 from ..raft.transport import TransportError
 from ..structs import Evaluation
@@ -153,6 +154,11 @@ class RemoteBrokerClient:
         # dedicated RPC per sizing decision
         self._ready_hint = 0
         self.lease_n = fanout_lease_n()
+        # decision-ledger dedup: lease absorption is per-RPC hot, so
+        # the fanout_lease site ledgers only when the grant size
+        # changes (or the generation flips) — the steady drip of
+        # identical full grants is one record, not thousands
+        self._last_lease_grant = -1
 
     # -- plumbing ------------------------------------------------------
 
@@ -256,6 +262,24 @@ class RemoteBrokerClient:
         if leases:
             self._count("remote_dequeues")
             self._count("leases", float(len(leases)))
+        if DECISIONS.enabled and (
+            len(leases) != self._last_lease_grant or stale
+        ):
+            self._last_lease_grant = len(leases)
+            DECISIONS.record(
+                "fanout_lease",
+                f"granted={len(leases)}",
+                inputs={
+                    "requested": self.lease_n,
+                    "ready_hint": self._ready_hint,
+                    "lease_gen": self.lease_gen,
+                    "stale_dropped": len(stale),
+                    "buffered": buffer,
+                },
+                alternatives=[f"requested={self.lease_n}"],
+                outcome="stale_drop" if stale else "absorbed",
+                metrics=self._metrics(),
+            )
         return leases
 
     def _pop_buffered(self) -> Tuple[Optional[Evaluation], str]:
@@ -661,6 +685,21 @@ def _make_fanout_worker(view: FollowerView, seed=None):
                 return True
             except TimeoutError:
                 self._count_fanout("apply_wait_timeouts")
+                DECISIONS.record(
+                    "fanout_nack",
+                    "nack_redeliver",
+                    inputs={
+                        "held": len(held),
+                        "target_index": target,
+                        "local_index": self.store.latest_index(),
+                        "wait_s": self._refresh_wait_s,
+                        "leader_gen": self._leader_gen(),
+                    },
+                    alternatives=["keep_waiting"],
+                    outcome="apply_wait_timeout",
+                    trace_id=held[0][0].id if held else "",
+                    metrics=getattr(self.server, "metrics", None),
+                )
                 for ev, token in held:
                     self._nack_quietly(ev, token)
                 return False
@@ -698,6 +737,22 @@ def _make_fanout_worker(view: FollowerView, seed=None):
                 self._count_fanout("plans_submitted")
                 if result.refresh_index:
                     self._count_fanout("plan_refresh_waits")
+                    DECISIONS.record(
+                        "fanout_nack",
+                        "refresh_wait",
+                        inputs={
+                            "refresh_index": result.refresh_index,
+                            "local_index": self.store.latest_index(),
+                            "wait_s": self._refresh_wait_s,
+                            "leader_gen": self._leader_gen(),
+                        },
+                        alternatives=["plan_on_stale_snapshot"],
+                        outcome="partial_commit",
+                        trace_id=plan.eval_id or "",
+                        metrics=getattr(
+                            self.server, "metrics", None
+                        ),
+                    )
                     snap = self.store.snapshot_min_index(
                         result.refresh_index,
                         timeout=self._refresh_wait_s,

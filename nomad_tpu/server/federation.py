@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..decisions import DECISIONS
 from ..raft import NotLeaderError
 from ..raft.transport import TransportError
 from ..structs import DEFAULT_REGION, new_id
@@ -150,6 +151,11 @@ class FederationRouter:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._seq = itertools.count(1)
+        # decision-ledger dedup: the retry-region pick is read on
+        # every shed redirect, so the federation_retry site ledgers
+        # only when the CHOICE changes (membership churn, region
+        # death/heal), not on every hint read
+        self._last_retry_pick: Optional[str] = "unset"
 
     # -- lifecycle ------------------------------------------------------
 
@@ -237,10 +243,35 @@ class FederationRouter:
             if region != self.server.region and e["members"] > 0
         ]
         if not candidates:
+            if DECISIONS.enabled and self._last_retry_pick is not None:
+                self._last_retry_pick = None
+                DECISIONS.record(
+                    "federation_retry",
+                    "none",
+                    inputs={"local_region": self.server.region},
+                    outcome="no_healthy_region",
+                    metrics=getattr(self.server, "metrics", None),
+                )
             return None
         region, entry = min(
             candidates, key=lambda kv: (-kv[1]["members"], kv[0])
         )
+        if DECISIONS.enabled and region != self._last_retry_pick:
+            self._last_retry_pick = region
+            DECISIONS.record(
+                "federation_retry",
+                f"region={region}",
+                inputs={
+                    "local_region": self.server.region,
+                    "members": entry["members"],
+                },
+                alternatives=[
+                    f"region={r}(members={e['members']})"
+                    for r, e in sorted(candidates)
+                ],
+                outcome="redirect_hint",
+                metrics=getattr(self.server, "metrics", None),
+            )
         http = sorted(entry["http"])
         return region, (http[0] if http else "")
 

@@ -117,6 +117,11 @@ _EXEMPT_PREFIXES = (
     "/v1/status",
     "/v1/operator",
     "/v1/traces",
+    # control-loop flight data: the SLO burn-rate view and the
+    # decision ledger are exactly what an operator reads to judge an
+    # overload excursion — shedding them defeats their purpose
+    "/v1/slo",
+    "/v1/decisions",
     # cluster fan-in queries: an overloaded leader shedding the
     # cluster-wide views would blind the operator to the overload
     "/v1/cluster",
@@ -275,6 +280,7 @@ class OverloadController:
     def _transition_locked(
         self, new_mode: int, depth: float, age: float, p99: float
     ) -> None:
+        from ..decisions import DECISIONS
         from ..trace import TRACE
 
         old = self._mode
@@ -284,6 +290,17 @@ class OverloadController:
             metrics.set_gauge("overload.mode", float(new_mode))
             metrics.set_gauge("overload.broker_depth", depth)
             metrics.set_gauge("overload.oldest_age_s", age)
+        # every eval in flight right now ran through this regime
+        # shift — stamp its waterfall (bounded broadcast) so a
+        # shed/degraded eval explains itself without a /v1/overload
+        # join; the incident trace gets the same mark below via its
+        # annotations
+        for eid in TRACE.in_flight_ids(limit=64):
+            TRACE.event(
+                eid, "overload.mode_change",
+                old=MODE_NAMES[old], new=MODE_NAMES[new_mode],
+            )
+        prev_incident = self._incident_id
         if old == MODE_NORMAL and new_mode > MODE_NORMAL:
             # one incident trace per excursion from NORMAL: the
             # operator's post-mortem handle for "what shed, and why"
@@ -319,6 +336,28 @@ class OverloadController:
                 TRACE.annotate(self._incident_id, shed_total=shed)
                 TRACE.finish(self._incident_id, "recovered")
                 self._incident_id = None
+        DECISIONS.record(
+            "overload_mode",
+            f"{MODE_NAMES[old]}->{MODE_NAMES[new_mode]}",
+            inputs={
+                "broker_depth": depth,
+                "oldest_age_s": round(age, 3),
+                "p99_ms": round(p99, 1),
+                "leader_gen": getattr(
+                    self.server, "_leadership_gen", 0
+                ),
+            },
+            alternatives=[
+                name
+                for i, name in enumerate(MODE_NAMES)
+                if i != new_mode
+            ],
+            outcome="escalate" if new_mode > old else "recover",
+            # joins the excursion's incident trace: the id minted on
+            # the way up, retained here on the final walk-down too
+            trace_id=self._incident_id or prev_incident or "",
+            metrics=metrics,
+        )
 
     @property
     def mode(self) -> int:

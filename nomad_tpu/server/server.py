@@ -211,6 +211,15 @@ class Server:
             metrics=self.metrics, config=device_config
         )
         self.broker = EvalBroker(nack_timeout=nack_timeout)
+        # lost-eval accounting: the broker is constructed without a
+        # telemetry handle, so wire ours in and zero-register its
+        # family — broker.delivery_failures is the zero-lost-evals
+        # SLO's burn signal, and absence-of-series must mean "nothing
+        # ever lost", not "not exported"
+        from .eval_broker import BROKER_COUNTERS
+
+        self.broker.metrics = self.metrics
+        self.metrics.preregister(counters=BROKER_COUNTERS)
         self.blocked = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.applier = PlanApplier(
@@ -278,6 +287,27 @@ class Server:
             counters=CLUSTER_OBS_COUNTERS, gauges=CLUSTER_OBS_GAUGES
         )
         self.metrics_history = MetricsHistory(self.metrics)
+        # control-loop flight data: the SLO engine grades declared
+        # objectives over the history ring just stood up, and the
+        # process-wide decision ledger records why every adaptive
+        # site chose what it chose.  Both families are
+        # zero-registered (absence-of-series must mean "never
+        # evaluated" / "site never fired", not "not exported").
+        from ..decisions import (
+            DECISION_COUNTERS,
+            DECISION_GAUGES,
+            DECISIONS,
+        )
+        from ..slo import SLO_COUNTERS, SLO_GAUGES, SLOEngine
+
+        self.metrics.preregister(
+            counters=DECISION_COUNTERS, gauges=DECISION_GAUGES
+        )
+        self.metrics.preregister(
+            counters=SLO_COUNTERS, gauges=SLO_GAUGES
+        )
+        self.decisions = DECISIONS
+        self.slo = SLOEngine(self.metrics, self.metrics_history)
         # policy-weighted scoring: zero-register the policy.* family
         # (absence-of-series must mean "no policy-weighted select ever
         # ran" — no job carries a PolicySpec, or NOMAD_TPU_POLICY=0 —
@@ -1719,6 +1749,18 @@ class Server:
             from ..explain import EXPLAIN
 
             return {"explain": EXPLAIN.get(params.get("eval_id", ""))}
+        if what == "slo":
+            return {"slo": self.slo.status()}
+        if what == "decisions":
+            limit = int(params.get("limit", 64))
+            return {
+                "decisions": self.decisions.to_dict(
+                    site=params.get("site"),
+                    outcome=params.get("outcome"),
+                    trace=params.get("trace"),
+                    limit=max(1, min(limit, 1024)),
+                )
+            }
         raise ValueError(f"unknown obs query {what!r}")
 
     # -- helpers ---------------------------------------------------------

@@ -83,9 +83,10 @@ SPAN_NAMES = frozenset(
         # member's FIFO position), `storm_solve` spans the single
         # device-side assignment solve on every member (members attr
         # like the other chunk-wide stages), `storm_decompose` the
-        # per-eval plan decomposition, and `storm_fallback` marks a
+        # per-eval plan decomposition, and `storm.fallback` marks a
         # member handed back to the serial chain (gate reason /
-        # unsolved row / commit rescore) — never a dropped eval
+        # unsolved row / commit rescore / whole-storm crash) — never
+        # a dropped eval
         "batch_worker.storm_gulp",
         # policy-weighted scoring (sched/policy.py): spans one storm
         # member's weight-tensor assembly — cached-throughput lookup
@@ -93,7 +94,7 @@ SPAN_NAMES = frozenset(
         "batch_worker.policy_assemble",
         "batch_worker.storm_solve",
         "batch_worker.storm_decompose",
-        "batch_worker.storm_fallback",
+        "storm.fallback",
         "batch_worker.replay",
         "batch_worker.sequential",
         "batch_worker.fallback",
@@ -125,6 +126,11 @@ SPAN_NAMES = frozenset(
         # node-death transition (``node_down_wave:<n>``) naming the
         # wave's node count, replan evals and storm family
         "ingress.shed",
+        # `overload.mode_change` lands on BOTH the overload incident
+        # trace and every in-flight eval trace at the moment the mode
+        # ladder moves, so a shed or degraded eval's waterfall says
+        # which regime it ran under without joining against /v1/overload
+        "overload.mode_change",
         "server.node_down_wave",
         # follower scheduling fan-out (NOMAD_TPU_FANOUT=1):
         # `fanout.remote_dequeue` spans the lease RPC on every eval a
@@ -746,6 +752,27 @@ class Tracer:
                 if dur is None or dur < slow_ms:
                     continue
             out.append(trace.to_dict() if full else trace.summary())
+            if len(out) >= limit:
+                break
+        return out
+
+    def in_flight_ids(self, limit: int = 64) -> List[str]:
+        """Eval ids with an open (unfinished) trace, newest first.
+
+        The broadcast hook for cross-cutting marks: the overload
+        ladder stamps ``overload.mode_change`` on every in-flight
+        waterfall so the evals that RAN THROUGH a regime shift say
+        so.  Bounded by ``limit`` — a broadcast must never turn a
+        mode flip into an O(ring) stall."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            candidates = list(self._ring)
+        out: List[str] = []
+        for trace in reversed(candidates):
+            if trace.finished:
+                continue
+            out.append(trace.eval_id)
             if len(out) >= limit:
                 break
         return out

@@ -1,0 +1,565 @@
+"""Control-loop flight data: the SLO engine's multi-window burn-rate
+math (crossing WARN/BURNING in both directions), the bounded
+adaptive-decision ledger (ring eviction, newest-first reads, per-site
+record shapes from real decision sites, trace-id joins), the HTTP +
+CLI surfaces (/v1/slo, /v1/decisions with filters, the cluster fan-in
+variants), the operator debug bundle capture, and the
+``NOMAD_TPU_SLO=0`` / ``NOMAD_TPU_DECISIONS=0`` opt-outs."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+from types import SimpleNamespace
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import start_http_server
+from nomad_tpu.decisions import (
+    DECISION_SITES,
+    DECISIONS,
+    DecisionLedger,
+)
+from nomad_tpu.server import Server
+from nomad_tpu.server.cluster import TestCluster
+from nomad_tpu.slo import SLOEngine
+from nomad_tpu.structs import Evaluation
+from nomad_tpu.telemetry import Metrics, MetricsHistory
+from nomad_tpu.trace import TRACE
+
+
+def wait_until(cond, timeout=30.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    """The ledger is a process-wide singleton (like TRACE): start
+    every test from an empty, enabled ring so cross-test records
+    can't satisfy an assertion here."""
+    DECISIONS.set_enabled(True)
+    DECISIONS.clear()
+    yield
+    DECISIONS.set_enabled(True)
+    DECISIONS.clear()
+
+
+# -- burn-rate math ----------------------------------------------------
+
+
+def _win(counters=None, samples=None):
+    return {
+        "t": 0.0,
+        "counters": dict(counters or {}),
+        "gauges": {},
+        "samples": dict(samples or {}),
+    }
+
+
+def _lat_win(p99):
+    return _win(
+        samples={
+            "batch_worker.eval_latency_ms": {
+                "count": 10, "p50": p99 / 2, "p99": p99,
+            }
+        }
+    )
+
+
+def _engine(windows, **env):
+    hist = SimpleNamespace(
+        to_dict=lambda: {
+            "enabled": True,
+            "interval_s": 15.0,
+            "max_windows": 60,
+            "windows": windows,
+        }
+    )
+    return SLOEngine(Metrics(), hist)
+
+
+def _obj(status, name):
+    return next(
+        o for o in status["objectives"] if o["name"] == name
+    )
+
+
+def test_latency_objective_burns_then_recovers(monkeypatch):
+    """p99 over target in every window -> burn 1/budget = 20x in
+    BOTH windows -> BURNING; once the fast window clears, the grade
+    steps down (slow alone is history, not an alert)."""
+    monkeypatch.setenv("NOMAD_TPU_SLO_FAST_N", "2")
+    monkeypatch.setenv("NOMAD_TPU_SLO_SLOW_N", "6")
+    hot = [_lat_win(900.0) for _ in range(6)]
+    st = _engine(hot).status()
+    obj = _obj(st, "interactive_placement_p99")
+    assert obj["status"] == "BURNING"
+    assert obj["burn_fast"] == pytest.approx(20.0)
+    assert obj["burn_slow"] == pytest.approx(20.0)
+    assert st["worst"] == "BURNING"
+
+    # recovery direction: the last fast_n windows are clean, the slow
+    # window still remembers the excursion -> WARN, not BURNING
+    cooled = hot[:4] + [_lat_win(10.0), _lat_win(10.0)]
+    st = _engine(cooled).status()
+    obj = _obj(st, "interactive_placement_p99")
+    assert obj["status"] == "WARN"
+    assert obj["burn_fast"] == 0.0
+    assert obj["burn_slow"] > 0.0
+
+    # fully healed history grades OK
+    st = _engine([_lat_win(10.0) for _ in range(6)]).status()
+    assert _obj(st, "interactive_placement_p99")["status"] == "OK"
+    assert st["worst"] == "OK"
+
+
+def test_burning_requires_both_windows(monkeypatch):
+    """A fast-only spike (noise) stays WARN even at 20x burn; only a
+    spike that is also material over the slow window pages."""
+    monkeypatch.setenv("NOMAD_TPU_SLO_FAST_N", "2")
+    monkeypatch.setenv("NOMAD_TPU_SLO_SLOW_N", "30")
+    spike = [_lat_win(10.0) for _ in range(28)] + [
+        _lat_win(900.0), _lat_win(900.0),
+    ]
+    obj = _obj(
+        _engine(spike).status(), "interactive_placement_p99"
+    )
+    assert obj["burn_fast"] == pytest.approx(20.0)
+    assert obj["burn_fast"] >= 2.0 > obj["burn_slow"]
+    assert obj["status"] == "WARN"
+
+
+def test_zero_tolerance_and_ratio_objectives():
+    """zero_lost_evals burns at the cap on ANY counter movement;
+    shed_rate burns at shed/(shed+accepted)/budget."""
+    quiet = [
+        _win(counters={
+            "broker.delivery_failures": 0,
+            "overload.shed": 0,
+            "overload.accepted": 100 * i,
+        })
+        for i in range(4)
+    ]
+    st = _engine(quiet).status()
+    assert _obj(st, "zero_lost_evals")["status"] == "OK"
+    assert _obj(st, "shed_rate")["status"] == "OK"
+
+    bad = [
+        _win(counters={
+            "broker.delivery_failures": i,
+            "overload.shed": 30 * i,
+            "overload.accepted": 70 * i,
+        })
+        for i in range(4)
+    ]
+    st = _engine(bad).status()
+    lost = _obj(st, "zero_lost_evals")
+    assert lost["status"] == "BURNING"
+    assert lost["burn_fast"] == 1000.0
+    shed = _obj(st, "shed_rate")
+    # 30% shed against a 5% budget = 6x burn in both windows
+    assert shed["burn_fast"] == pytest.approx(6.0)
+    assert shed["status"] == "BURNING"
+    assert st["worst"] == "BURNING"
+
+
+def test_empty_ring_never_pages():
+    """<2 windows means no deltas and no rates: every objective OK —
+    the engine must not page a freshly started server."""
+    for windows in ([], [_lat_win(900.0)]):
+        st = _engine(windows).status()
+        assert st["worst"] == "OK"
+        assert all(
+            o["burn_fast"] == 0.0 for o in st["objectives"]
+        )
+
+
+def test_status_exports_slo_metrics():
+    m = Metrics()
+    hist = SimpleNamespace(
+        to_dict=lambda: {"interval_s": 15.0, "windows": []}
+    )
+    engine = SLOEngine(m, hist)
+    engine.status()
+    engine.status()
+    assert m.get_counter("slo.evaluations") == 2
+    assert m.get_gauge("slo.worst") == 0.0
+
+
+def test_slo_disabled_reports_inert(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_SLO", "0")
+    st = _engine([_lat_win(900.0) for _ in range(6)]).status()
+    assert st["enabled"] is False
+    assert st["worst"] == "OK"
+    assert all(o["burn_fast"] == 0.0 for o in st["objectives"])
+
+
+# -- decision ledger ---------------------------------------------------
+
+
+def test_ledger_ring_bounds_and_newest_first():
+    led = DecisionLedger(ring=16)
+    for i in range(40):
+        led.record("chunk_width", f"width={i}")
+    d = led.to_dict(limit=100)
+    assert d["ring"]["depth"] == 16
+    assert d["ring"]["cap"] == 16
+    assert d["ring"]["evicted"] == 24
+    # newest-first, oldest evicted
+    actions = [r["action"] for r in d["decisions"]]
+    assert actions[0] == "width=39"
+    assert "width=0" not in actions
+    # seq keeps counting across evictions
+    assert d["decisions"][0]["seq"] == 40
+    assert d["counts"] == {"chunk_width": 16}
+
+
+def test_ledger_filters_and_trace_join():
+    led = DecisionLedger(ring=64)
+    led.record(
+        "admission_defer", "defer",
+        outcome="queue_closed", trace_id="ev-1",
+    )
+    led.record("overload_mode", "NORMAL->SHEDDING",
+               outcome="escalate", trace_id="overload:7")
+    led.record("admission_defer", "defer",
+               outcome="assembly", trace_id="ev-2")
+    assert [
+        r["trace_id"] for r in led.recent(site="admission_defer")
+    ] == ["ev-2", "ev-1"]
+    assert [
+        r["site"] for r in led.recent(outcome="escalate")
+    ] == ["overload_mode"]
+    # the trace filter is the join key back to /v1/traces/<id>
+    assert [
+        r["action"] for r in led.recent(trace="overload:7")
+    ] == ["NORMAL->SHEDDING"]
+    assert led.recent(trace="nope") == []
+
+
+def test_ledger_record_shape_and_site_counters():
+    led = DecisionLedger(ring=16)
+    m = Metrics()
+    rec = led.record(
+        "storm_trigger", "drain_family",
+        inputs={"family": "f", "drained": 3},
+        alternatives=["serial_gulp"],
+        trace_id="ev-9", metrics=m,
+    )
+    assert set(rec) == {
+        "seq", "t", "site", "action", "inputs", "alternatives",
+        "outcome", "trace_id",
+    }
+    assert rec["outcome"] == "applied"
+    assert m.get_counter("decision.recorded") == 1
+    assert m.get_counter("decision.site.storm_trigger") == 1
+    assert m.get_gauge("decision.ring_depth") == 1.0
+
+
+def test_ledger_disabled_is_inert(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_DECISIONS", "0")
+    led = DecisionLedger(ring=16)
+    m = Metrics()
+    assert led.record("chunk_width", "width=4", metrics=m) is None
+    d = led.to_dict()
+    assert d["enabled"] is False
+    assert d["ring"]["depth"] == 0
+    assert m.get_counter("decision.recorded") == 0
+
+
+def test_every_registered_site_has_counter():
+    """Runtime mirror of the decision-ledger lint: the zero-registered
+    family covers every site, so a fired site is always countable."""
+    from nomad_tpu.decisions import DECISION_COUNTERS
+
+    for slug in DECISION_SITES:
+        assert f"decision.site.{slug}" in DECISION_COUNTERS
+
+
+# -- real decision sites write real records ---------------------------
+
+
+def _flood_broker(server, n):
+    evals = [Evaluation(job_id=f"flood-{i}") for i in range(n)]
+    server.store.upsert_evals(evals)
+    server.broker.enqueue_all(evals)
+
+
+def test_overload_transitions_ledger_and_trace_events(monkeypatch):
+    """The mode ladder records an overload_mode decision per rung
+    (inputs snapshot + alternatives + incident trace join) and
+    broadcasts overload.mode_change onto in-flight traces."""
+    monkeypatch.setenv("NOMAD_TPU_OVERLOAD_DEPTH", "4")
+    TRACE.clear()
+    server = Server(
+        num_schedulers=1, heartbeat_ttl=60.0, seed=7,
+        batch_pipeline=False,
+    )
+    server.start()
+    try:
+        for w in server.workers:
+            w.stop()
+        TRACE.begin("ev-inflight", queue="service")
+        _flood_broker(server, 6)
+        server.overload.evaluate(force=True)
+        recs = DECISIONS.recent(site="overload_mode")
+        assert recs, "escalation did not ledger"
+        rec = recs[0]
+        assert rec["action"] == "NORMAL->SHEDDING"
+        assert rec["outcome"] == "escalate"
+        assert rec["inputs"]["broker_depth"] >= 4
+        assert "EMERGENCY" in rec["alternatives"]
+        assert rec["trace_id"].startswith("overload:")
+        # the incident trace id joins back to the ledger
+        assert DECISIONS.recent(trace=rec["trace_id"])
+        # satellite: in-flight traces got the mode_change event
+        trace = TRACE.get("ev-inflight")
+        events = [
+            s for s in trace["spans"]
+            if s["name"] == "overload.mode_change"
+        ]
+        assert events, trace["spans"]
+        assert events[0]["attrs"]["new"] == "SHEDDING"
+
+        server.broker.flush()
+        wait_until(
+            lambda: (
+                server.overload.evaluate(force=True) == 0
+            ),
+            timeout=10.0,
+            msg="recover to NORMAL",
+        )
+        outcomes = {
+            r["outcome"]
+            for r in DECISIONS.recent(site="overload_mode")
+        }
+        assert "recover" in outcomes
+        assert server.metrics.get_counter(
+            "decision.site.overload_mode"
+        ) >= 2
+    finally:
+        server.stop()
+        TRACE.clear()
+
+
+def test_scheduling_load_ledgers_chunk_width():
+    """A real placement round exercises the batch worker's
+    chunk-width planner; change-only recording still yields the
+    first-width record with the planner's inputs snapshot."""
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=7)
+    server.start()
+    try:
+        for i in range(8):
+            server.register_node(mock.node(id=f"slo-node-{i:02d}"))
+        for i in range(4):
+            job = mock.job(id=f"slo-job-{i}")
+            job.task_groups[0].count = 1
+            server.register_job(job)
+        assert server.drain_to_idle(30)
+        wait_until(
+            lambda: DECISIONS.recent(site="chunk_width"),
+            timeout=10.0,
+            msg="chunk_width record",
+        )
+        rec = DECISIONS.recent(site="chunk_width")[0]
+        assert rec["action"].startswith("width=")
+        for key in (
+            "n_evals", "backlog", "budget_ms", "leader_gen",
+            "backend_epoch",
+        ):
+            assert key in rec["inputs"], rec["inputs"]
+        assert rec["alternatives"], rec
+    finally:
+        server.stop()
+
+
+# -- HTTP + cluster surfaces ------------------------------------------
+
+
+@pytest.fixture
+def api():
+    server = Server(num_schedulers=1, heartbeat_ttl=60.0, seed=7)
+    server.start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    yield server, base
+    http.stop()
+    server.stop()
+
+
+def test_http_slo_endpoint(api):
+    server, base = api
+    server.metrics_history.snapshot_once()
+    server.metrics_history.snapshot_once()
+    st = _get(base, "/v1/slo")
+    assert st["enabled"] is True
+    assert len(st["objectives"]) >= 5
+    assert {o["name"] for o in st["objectives"]} >= {
+        "interactive_placement_p99",
+        "zero_lost_evals",
+        "shed_rate",
+        "storm_fallback_rate",
+        "failover_detect_to_resume",
+    }
+    assert st["worst"] in ("OK", "WARN", "BURNING")
+    assert st["windows"]["retained"] >= 2
+
+
+def test_http_decisions_endpoint_filters(api):
+    server, base = api
+    DECISIONS.record(
+        "fanout_nack", "refresh_wait",
+        outcome="partial_commit", trace_id="ev-x",
+        metrics=server.metrics,
+    )
+    DECISIONS.record(
+        "watchdog_budget", "trip", outcome="lost",
+        metrics=server.metrics,
+    )
+    d = _get(base, "/v1/decisions")
+    assert d["enabled"] is True
+    assert len(d["decisions"]) == 2
+    assert d["sites"] == sorted(DECISION_SITES)
+    only = _get(base, "/v1/decisions?site=fanout_nack")
+    assert [
+        r["site"] for r in only["decisions"]
+    ] == ["fanout_nack"]
+    by_trace = _get(base, "/v1/decisions?trace=ev-x")
+    assert len(by_trace["decisions"]) == 1
+    by_outcome = _get(base, "/v1/decisions?outcome=lost")
+    assert [
+        r["site"] for r in by_outcome["decisions"]
+    ] == ["watchdog_budget"]
+    try:
+        urllib.request.urlopen(
+            base + "/v1/decisions?limit=bogus", timeout=10
+        )
+        assert False, "expected 400"
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+
+
+def test_cluster_slo_and_decisions_fanin(monkeypatch):
+    """Any server answers /v1/cluster/slo per-server and
+    /v1/cluster/decisions as one seq-deduplicated merged ledger
+    (the ledger is process-wide in TestCluster, so without the dedup
+    every record would appear 3x)."""
+    monkeypatch.setenv("NOMAD_TPU_OBS_FANIN_TIMEOUT_S", "2.0")
+    cluster = TestCluster(3, heartbeat_ttl=300.0)
+    cluster.start()
+    http = None
+    try:
+        leader = cluster.wait_for_leader(timeout=30.0)
+        http = start_http_server(leader, port=0)
+        base = f"http://127.0.0.1:{http.port}"
+        DECISIONS.record(
+            "federation_retry", "pick=west",
+            metrics=leader.metrics,
+        )
+        merged = _get(base, "/v1/cluster/slo")
+        assert merged["unreachable"] == 0
+        assert len(merged["servers"]) == 3
+        for payload in merged["servers"].values():
+            assert len(payload["objectives"]) >= 5
+        dec = _get(base, "/v1/cluster/decisions?limit=64")
+        assert len(dec["servers"]) == 3
+        seqs = [r["seq"] for r in dec["decisions"]]
+        assert len(seqs) == len(set(seqs)), "fan-in must dedup"
+        assert any(
+            r["site"] == "federation_retry"
+            for r in dec["decisions"]
+        )
+        assert all(r.get("server") for r in dec["decisions"])
+    finally:
+        if http is not None:
+            http.stop()
+        cluster.stop()
+
+
+# -- CLI + debug bundle ------------------------------------------------
+
+
+def test_cli_slo_status_and_decisions(api, monkeypatch, capsys):
+    from nomad_tpu.cli import main
+
+    server, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    DECISIONS.record(
+        "adaptive_cap", "cap=48",
+        inputs={"backlog": 12}, metrics=server.metrics,
+    )
+    main(["slo", "status"])
+    out = capsys.readouterr().out
+    assert "Worst:" in out
+    assert "interactive_placement_p99" in out
+
+    main(["slo", "status", "-json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert len(payload["objectives"]) >= 5
+
+    main(["decisions", "-site", "adaptive_cap"])
+    out = capsys.readouterr().out
+    assert "adaptive_cap" in out
+    assert "cap=48" in out
+
+    main(["decisions", "-json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ring"]["depth"] >= 1
+
+
+def test_debug_bundle_captures_slo_and_decisions(
+    api, monkeypatch, tmp_path
+):
+    import tarfile
+
+    from nomad_tpu.cli import main
+
+    _, base = api
+    monkeypatch.setenv("NOMAD_ADDR", base)
+    out = tmp_path / "bundle.tar.gz"
+    main(["operator", "debug", "-output", str(out)])
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+    assert "nomad-debug/slo.json" in names
+    assert "nomad-debug/decisions.json" in names
+    assert "nomad-debug/cluster-slo.json" in names
+    assert "nomad-debug/cluster-decisions.json" in names
+
+
+# -- the engine wired to the real history ring ------------------------
+
+
+def test_engine_reads_real_history_ring():
+    """End-to-end against a real MetricsHistory: shed counters pushed
+    through real snapshots drive the shed_rate objective from OK to
+    BURNING."""
+    m = Metrics()
+    m.preregister(
+        counters=("overload.shed", "overload.accepted"),
+    )
+    hist = MetricsHistory(m, windows=8, interval_s=60.0)
+    engine = SLOEngine(m, hist)
+    hist.snapshot_once()
+    for _ in range(4):
+        for _ in range(40):
+            m.incr("overload.shed")
+        for _ in range(60):
+            m.incr("overload.accepted")
+        hist.snapshot_once()
+    st = engine.status()
+    shed = _obj(st, "shed_rate")
+    # 40% shed over a 5% budget = 8x burn in both windows
+    assert shed["burn_fast"] == pytest.approx(8.0)
+    assert shed["status"] == "BURNING"
+    assert st["worst"] == "BURNING"

@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nomadlint: repo-wide run (31 rules, zero findings) =="
+echo "== nomadlint: repo-wide run (35 rules, zero findings) =="
 python -m tools.nomadlint
 
 echo "== nomadlint: selfcheck (every rule trips its bad fixture) =="
@@ -126,6 +126,31 @@ print('cluster-obs gate green:', {
     'overhead_pct': out['stitched_overhead_pct'],
     'stitched_min': out['stitched_traces_min'],
     'fanin_ms': out['fanin_query_latency'],
+})
+"
+
+    echo "== control-loop flight-data gate (ledger A/B + site coverage) =="
+    # the flight-data gate: decision-ledger overhead A/B within the
+    # <3% contract (with the additive slack every overhead gate uses
+    # on this shared box), every registered decision site writing
+    # records under the swarm + admission-probe + fan-out soak (the
+    # decision-ledger lint's non-vacuity proof), and the SLO engine
+    # grading a real history ring.  The placement A/B is scaled down;
+    # the swarm runs at the same scale as the swarm gate above
+    timeout -k 10 600 env JAX_PLATFORMS=cpu BENCH_SLO_NODES=100 \
+        BENCH_SLO_JOBS=12 BENCH_SLO_REPS=1 \
+        BENCH_SLO_FANOUT_NODES=96 BENCH_SLO_FANOUT_FAMILIES=24 \
+        python -c "
+import bench
+out = bench.bench_slo()
+assert out['overhead_ok'], out
+assert not out['sites_missing'], out
+assert out['swarm_ok'], out
+assert len(out['slo_status']['objectives']) >= 5, out
+print('slo gate green:', {
+    'ledger_overhead_pct': out['ledger_overhead_pct'],
+    'sites': sorted(out['site_records']),
+    'worst': out['slo_status']['worst'],
 })
 "
 

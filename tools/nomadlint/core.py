@@ -56,6 +56,8 @@ DEFAULT_PATHS: Dict[str, str] = {
     "fanout": "nomad_tpu/server/fanout.py",
     "federation": "nomad_tpu/server/federation.py",
     "envknobs": "nomad_tpu/envknobs.py",
+    "decisions": "nomad_tpu/decisions.py",
+    "slo": "nomad_tpu/slo.py",
     "arch_doc": "docs/ARCHITECTURE.md",
     "state_dir": "nomad_tpu/state",
     "package": "nomad_tpu",

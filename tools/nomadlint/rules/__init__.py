@@ -8,5 +8,6 @@ from . import config_drift  # noqa: F401
 from . import concurrency  # noqa: F401
 from . import kernel_contract  # noqa: F401
 from . import concurrency_doc  # noqa: F401
+from . import decision_ledger  # noqa: F401
 
 MIGRATED_RULES = stage_accounting.MIGRATED_RULES

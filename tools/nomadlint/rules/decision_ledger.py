@@ -1,0 +1,288 @@
+"""Control-loop flight-data rules: the ``DECISION_SITES`` registry in
+``nomad_tpu/decisions.py`` is the contract that every adaptive
+decision site actually ledgers — both directions are checked
+statically — and the ``slo.*`` / ``decision.*`` metric families are
+zero-registered at Server construction like every other family."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .. import astutil
+from ..core import Context, Finding, Rule, register
+from .stage_accounting import DebugBundleDeviceRule
+
+
+def decision_sites(tree: ast.AST) -> Dict[str, str]:
+    """The literal ``DECISION_SITES`` dict (slug -> path key),
+    annotated assignment or plain."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for target in targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == "DECISION_SITES"
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {
+                    k.value: v.value
+                    for k, v in zip(
+                        node.value.keys, node.value.values
+                    )
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                }
+    return {}
+
+
+def recorded_slugs(tree: ast.AST) -> Set[str]:
+    """Site slugs a module ledgers: the literal first argument of
+    ``DECISIONS.record("slug", ...)`` calls (any attribute path
+    ending in ``.record`` on a ``DECISIONS``/``decisions`` object)
+    and of ``self._record_decision("slug", ...)`` helper calls."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        dotted = astutil.dotted_name(node.func) or ""
+        if dotted.endswith("._record_decision"):
+            out.add(node.args[0].value)
+        elif dotted.endswith(".record") and (
+            "DECISIONS" in dotted or "decisions" in dotted
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+@register
+class DecisionLedgerRule(Rule):
+    """Check: every slug in the ``DECISION_SITES`` registry is
+    ledgered by the module that owns it, every ``record("slug")``
+    call site uses a registered slug, every slug has its
+    ``decision.site.<slug>`` counter in ``DECISION_COUNTERS``, and
+    server.py zero-registers the family at construction."""
+
+    name = "decision-ledger"
+    description = (
+        "DECISION_SITES registry matches the record() call sites"
+    )
+
+    def check(self, ctx: Context) -> List[Finding]:
+        dec_path = ctx.path("decisions")
+        tree = ctx.tree(dec_path)
+        sites = decision_sites(tree)
+        problems: List[Finding] = []
+        if not sites:
+            return [
+                Finding(
+                    self.name, dec_path, 0,
+                    "could not find the literal DECISION_SITES "
+                    "registry in decisions.py",
+                )
+            ]
+        counters = astutil.assigned_strings(
+            tree, "DECISION_COUNTERS"
+        )
+        missing_counters = {
+            slug
+            for slug in sites
+            if f"decision.site.{slug}" not in counters
+        }
+        if missing_counters:
+            problems.append(
+                Finding(
+                    self.name, dec_path, 0,
+                    "registered decision sites without a "
+                    "decision.site.<slug> counter in "
+                    "DECISION_COUNTERS (their firing would be "
+                    "invisible on /v1/metrics): "
+                    f"{sorted(missing_counters)}",
+                )
+            )
+        # group the registry by owning module, then check both
+        # directions per module: a registered slug must be recorded
+        # there, and a recorded slug must be registered (anywhere —
+        # helper modules may ledger a site its owner declares)
+        by_module: Dict[str, Set[str]] = {}
+        for slug, key in sites.items():
+            by_module.setdefault(key, set()).add(slug)
+        for key, slugs in sorted(by_module.items()):
+            try:
+                mod_path = ctx.path(key)
+                mod_tree = ctx.tree(mod_path)
+            except (KeyError, OSError):
+                problems.append(
+                    Finding(
+                        self.name, dec_path, 0,
+                        f"DECISION_SITES maps to unknown module "
+                        f"key {key!r}",
+                    )
+                )
+                continue
+            recorded = recorded_slugs(mod_tree)
+            silent = slugs - recorded
+            if silent:
+                problems.append(
+                    Finding(
+                        self.name, mod_path, 0,
+                        "registered decision sites that never "
+                        "record a DecisionRecord here (the ledger "
+                        "would silently miss this control loop): "
+                        f"{sorted(silent)}",
+                    )
+                )
+            unregistered = recorded - set(sites)
+            if unregistered:
+                problems.append(
+                    Finding(
+                        self.name, mod_path, 0,
+                        "record() call sites using slugs missing "
+                        "from the DECISION_SITES registry: "
+                        f"{sorted(unregistered)}",
+                    )
+                )
+        server_path = ctx.path("server")
+        server_src = ctx.source(server_path)
+        if "DECISION_COUNTERS" not in server_src:
+            problems.append(
+                Finding(
+                    self.name, server_path, 0,
+                    "server.py no longer zero-registers the "
+                    "decision.* family at construction "
+                    "(DECISION_COUNTERS preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "batch_worker",
+            append=(
+                "def _nomadlint_bad_fixture():\n"
+                '    DECISIONS.record("bogus_site", "x")\n'
+            ),
+        )
+
+
+@register
+class SLOMetricsRule(Rule):
+    """Check: every ``slo.*`` / ``decision.*`` metric emitted by the
+    engine and ledger is in the zero-registered ``SLO_*`` /
+    ``DECISION_*`` registries, and server.py registers both at
+    construction (absence-of-series must mean "never evaluated" /
+    "site never fired", not "not exported")."""
+
+    name = "slo-metrics"
+    description = "slo.*/decision.* emissions are zero-registered"
+
+    def check(self, ctx: Context) -> List[Finding]:
+        problems: List[Finding] = []
+        slo_path = ctx.path("slo")
+        slo_tree = ctx.tree(slo_path)
+        slo_registry = astutil.assigned_strings(
+            slo_tree, "SLO_COUNTERS"
+        ) | astutil.assigned_strings(slo_tree, "SLO_GAUGES")
+        emitted = astutil.metric_names_emitted(slo_tree, "slo.")
+        unregistered = emitted - slo_registry
+        if not slo_registry:
+            problems.append(
+                Finding(
+                    self.name, slo_path, 0,
+                    "could not find the SLO_COUNTERS/SLO_GAUGES "
+                    "registries in slo.py",
+                )
+            )
+        elif unregistered:
+            problems.append(
+                Finding(
+                    self.name, slo_path, 0,
+                    "slo.* metrics emitted but not in the SLO_* "
+                    "registries: " f"{sorted(unregistered)}",
+                )
+            )
+        dec_path = ctx.path("decisions")
+        dec_tree = ctx.tree(dec_path)
+        dec_registry = astutil.assigned_strings(
+            dec_tree, "DECISION_COUNTERS"
+        ) | astutil.assigned_strings(dec_tree, "DECISION_GAUGES")
+        dec_emitted = {
+            name
+            for name in astutil.metric_names_emitted(
+                dec_tree, "decision."
+            )
+            # per-site counters are registered via the literal
+            # decision.site.<slug> rows (decision-ledger rule);
+            # dynamic f-string emissions don't surface here anyway
+        }
+        dec_unregistered = dec_emitted - dec_registry
+        if dec_unregistered:
+            problems.append(
+                Finding(
+                    self.name, dec_path, 0,
+                    "decision.* metrics emitted but not in the "
+                    "DECISION_* registries: "
+                    f"{sorted(dec_unregistered)}",
+                )
+            )
+        server_path = ctx.path("server")
+        if "SLO_COUNTERS" not in ctx.source(server_path):
+            problems.append(
+                Finding(
+                    self.name, server_path, 0,
+                    "server.py no longer zero-registers the slo.* "
+                    "family at construction (SLO_COUNTERS "
+                    "preregister)",
+                )
+            )
+        return problems
+
+    @classmethod
+    def bad_fixture(cls, ctx, tmpdir):
+        return cls._mutated(
+            ctx, tmpdir, "slo",
+            append=(
+                "def _nomadlint_bad_fixture(metrics):\n"
+                '    metrics.incr("slo.bogus_metric")\n'
+            ),
+        )
+
+
+@register
+class DebugBundleSLORule(DebugBundleDeviceRule):
+    """Check: the operator debug bundle captures ``/v1/slo`` so a
+    bundle from a misbehaving server says which objective was
+    burning when the capture ran."""
+
+    name = "debug-bundle-slo"
+    description = "operator debug bundle captures /v1/slo"
+
+    # quoted form: the cluster variant ("/v1/cluster/slo") must not
+    # satisfy the local-status capture check
+    NEEDLE = '"/v1/slo"'
+    ENDPOINT = "/v1/slo"
+
+
+@register
+class DebugBundleDecisionsRule(DebugBundleDeviceRule):
+    """Check: the operator debug bundle captures ``/v1/decisions``
+    so the adaptive-decision flight data travels with the traces it
+    cross-references."""
+
+    name = "debug-bundle-decisions"
+    description = "operator debug bundle captures /v1/decisions"
+
+    NEEDLE = "/v1/decisions"
+    ENDPOINT = "/v1/decisions"

@@ -216,7 +216,7 @@ class SpanRegistryRule(Rule):
         "batch_worker.storm_gulp",
         "batch_worker.storm_solve",
         "batch_worker.storm_decompose",
-        "batch_worker.storm_fallback",
+        "storm.fallback",
         # policy-weighted scoring: the per-member weight-tensor
         # assembly inside storm staging — without it a weighted
         # storm's staging cost is invisible on every trace dashboard
